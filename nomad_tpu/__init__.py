@@ -1,0 +1,18 @@
+"""nomad_tpu — a TPU-native distributed workload orchestrator.
+
+A brand-new framework with the capabilities of HashiCorp Nomad (the
+reference implementation surveyed in SURVEY.md): jobs / task groups /
+allocations, pluggable feasibility constraints, binpack / spread scoring,
+preemption, deployments, an optimistically-concurrent eval broker + serialized
+plan applier over MVCC replicated state, and a client execution plane with
+pluggable task drivers.
+
+It is *not* a port. The scheduling hot path — feasibility masking, scoring,
+and global assignment — runs as batched JAX/XLA kernels (`nomad_tpu.ops`)
+operating on dense (evals x nodes) tensors produced by the tensorization
+layer (`nomad_tpu.tensor`), exposed as the pluggable scheduler algorithm
+``"tpu-binpack"`` alongside the classic per-node greedy path
+(``"binpack"`` / ``"spread"``, reference: nomad/structs/operator.go:199-255).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,34 @@
+"""Domain model for nomad_tpu.
+
+Semantics (not shape) mirror the reference's nomad/structs/structs.go
+(13.5k lines); here the model is split into focused modules and kept
+tensor-friendly: every resource quantity has a fixed position in a dense
+numpy vector (see resources.RESOURCE_DIMS) so snapshots can be lowered to
+device arrays without per-object walks.
+"""
+
+from .enums import *  # noqa: F401,F403
+from .resources import (  # noqa: F401
+    RESOURCE_DIMS,
+    R_CPU,
+    R_MEM,
+    R_DISK,
+    Resources,
+    NodeResources,
+    NodeReservedResources,
+    comparable,
+)
+from .constraint import Constraint, Affinity, Spread, SpreadTarget  # noqa: F401
+from .job import Job, TaskGroup, Task, UpdateStrategy, RestartPolicy, ReschedulePolicy, EphemeralDisk  # noqa: F401
+from .node import Node, DrainStrategy  # noqa: F401
+from .alloc import Allocation, AllocMetric, RescheduleTracker, RescheduleEvent, DesiredTransition  # noqa: F401
+from .evaluation import Evaluation  # noqa: F401
+from .plan import Plan, PlanResult  # noqa: F401
+from .deployment import Deployment, DeploymentState  # noqa: F401
+from .funcs import (  # noqa: F401
+    score_fit_binpack,
+    score_fit_spread,
+    allocs_fit,
+    compute_free_percentage,
+    BINPACK_MAX_FIT_SCORE,
+)

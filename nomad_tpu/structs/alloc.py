@@ -1,0 +1,181 @@
+"""Allocation + metrics (reference structs.go Allocation:10694, AllocMetric:11716)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import enums
+from .resources import Resources, comparable
+
+
+@dataclass(slots=True)
+class RescheduleEvent:
+    reschedule_time: float = 0.0
+    prev_alloc_id: str = ""
+    prev_node_id: str = ""
+    delay_s: float = 0.0
+
+
+@dataclass(slots=True)
+class RescheduleTracker:
+    """History of reschedule attempts, chained through replacements
+    (reference structs.go RescheduleTracker; generic_sched.go:839)."""
+
+    events: List[RescheduleEvent] = field(default_factory=list)
+
+
+@dataclass(slots=True)
+class DesiredTransition:
+    """Server-requested transitions (reference structs.go DesiredTransition;
+    set by the drainer and `alloc stop`)."""
+
+    migrate: bool = False
+    reschedule: bool = False
+    force_reschedule: bool = False
+    no_shutdown_delay: bool = False
+
+
+@dataclass(slots=True)
+class AllocMetric:
+    """Why/how a placement was made (reference structs.go AllocMetric:11716;
+    populated by the ranking pipeline and surfaced by `alloc status`)."""
+
+    nodes_evaluated: int = 0
+    nodes_filtered: int = 0
+    nodes_in_pool: int = 0
+    nodes_available: Dict[str, int] = field(default_factory=dict)       # per-dc
+    class_filtered: Dict[str, int] = field(default_factory=dict)
+    constraint_filtered: Dict[str, int] = field(default_factory=dict)
+    nodes_exhausted: int = 0
+    class_exhausted: Dict[str, int] = field(default_factory=dict)
+    dimension_exhausted: Dict[str, int] = field(default_factory=dict)
+    quota_exhausted: List[str] = field(default_factory=list)
+    scores: Dict[str, float] = field(default_factory=dict)              # "node.scorer" -> score
+    allocation_time_s: float = 0.0
+    coalesced_failures: int = 0
+
+    def exhaust_node(self, dimension: str) -> None:
+        self.nodes_exhausted += 1
+        if dimension:
+            self.dimension_exhausted[dimension] = self.dimension_exhausted.get(dimension, 0) + 1
+
+    def filter_node(self, reason: str) -> None:
+        self.nodes_filtered += 1
+        if reason:
+            self.constraint_filtered[reason] = self.constraint_filtered.get(reason, 0) + 1
+
+
+@dataclass(slots=True)
+class NetworkStatus:
+    interface_name: str = ""
+    address: str = ""
+    dns: Optional[dict] = None
+
+
+@dataclass(slots=True)
+class AllocatedPort:
+    label: str = ""
+    value: int = 0
+    to: int = 0
+    host_ip: str = ""
+
+
+@dataclass(slots=True)
+class Allocation:
+    """A placement of a task group on a node (reference structs.go Allocation:10694).
+
+    `allocated_vec` is the dense comparable resource total for this alloc
+    (cpu, mem, disk) — the quantity the fit math and tensor cache consume.
+    """
+
+    id: str = ""
+    eval_id: str = ""
+    name: str = ""               # "<job>.<group>[<index>]"
+    namespace: str = "default"
+    node_id: str = ""
+    node_name: str = ""
+    job_id: str = ""
+    job: object = None           # snapshot of the Job at placement time
+    job_version: int = 0
+    task_group: str = ""
+    allocated_vec: np.ndarray = field(default_factory=lambda: comparable())
+    allocated_ports: List[AllocatedPort] = field(default_factory=list)
+    allocated_devices: Dict[str, List[str]] = field(default_factory=dict)  # device id -> instance ids
+    allocated_cores: List[int] = field(default_factory=list)
+    desired_status: str = enums.ALLOC_DESIRED_RUN
+    desired_description: str = ""
+    desired_transition: DesiredTransition = field(default_factory=DesiredTransition)
+    client_status: str = enums.ALLOC_CLIENT_PENDING
+    client_description: str = ""
+    task_states: Dict[str, object] = field(default_factory=dict)
+    deployment_id: str = ""
+    deployment_status: Optional[dict] = None
+    canary: bool = False
+    previous_allocation: str = ""
+    next_allocation: str = ""
+    reschedule_tracker: Optional[RescheduleTracker] = None
+    follow_up_eval_id: str = ""
+    preempted_by_allocation: str = ""
+    metrics: Optional[AllocMetric] = None
+    allocated_at: float = 0.0
+    modify_time: float = 0.0
+    create_index: int = 0
+    modify_index: int = 0
+    alloc_modify_index: int = 0
+
+    # --- status predicates (reference structs.go Allocation.*TerminalStatus) ---
+
+    def server_terminal(self) -> bool:
+        return self.desired_status in (enums.ALLOC_DESIRED_STOP, enums.ALLOC_DESIRED_EVICT)
+
+    def client_terminal(self) -> bool:
+        return self.client_status in (
+            enums.ALLOC_CLIENT_COMPLETE,
+            enums.ALLOC_CLIENT_FAILED,
+            enums.ALLOC_CLIENT_LOST,
+        )
+
+    def terminal_status(self) -> bool:
+        """Either side says it's over (reference Allocation.TerminalStatus)."""
+        return self.server_terminal() or self.client_terminal()
+
+    def should_count_for_usage(self) -> bool:
+        """Whether this alloc consumes node resources in fit math:
+        client-terminal allocs are free (reference funcs.go:150-153
+        AllocsFit skips ClientTerminalStatus)."""
+        return not self.client_terminal()
+
+    def migrate_disk(self) -> bool:
+        if self.job is None:
+            return False
+        tg = self.job.lookup_task_group(self.task_group)
+        return tg is not None and tg.ephemeral_disk.migrate
+
+    def index(self) -> int:
+        """Parse the bracketed index out of the alloc name
+        (reference structs.go AllocName / AllocIndexFromName)."""
+        l = self.name.rfind("[")
+        r = self.name.rfind("]")
+        if l == -1 or r == -1 or r <= l:
+            return -1
+        try:
+            return int(self.name[l + 1:r])
+        except ValueError:
+            return -1
+
+    def copy_for_update(self) -> "Allocation":
+        """Shallow-ish copy used when mutating an alloc into a new raft
+        generation (MVCC tables hold immutable-by-convention rows)."""
+        import copy as _copy
+
+        new = _copy.copy(self)
+        new.desired_transition = _copy.copy(self.desired_transition)
+        return new
+
+
+def alloc_name(job_id: str, group: str, index: int) -> str:
+    """Reference structs.AllocName format "<job>.<group>[<index>]"."""
+    return f"{job_id}.{group}[{index}]"

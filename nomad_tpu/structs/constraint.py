@@ -1,0 +1,56 @@
+"""Constraints, affinities and spreads (reference structs.go:9673-9950)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass(slots=True, frozen=True)
+class Constraint:
+    """A hard placement constraint.
+
+    ltarget/rtarget are interpolation strings like "${attr.kernel.name}";
+    operand is one of the 15 operators (reference structs.go:9660-9676,
+    checked in scheduler/feasible.go:833 checkConstraint).
+    """
+
+    ltarget: str = ""
+    rtarget: str = ""
+    operand: str = "="
+
+    def key(self) -> tuple:
+        return (self.ltarget, self.rtarget, self.operand)
+
+
+@dataclass(slots=True, frozen=True)
+class Affinity:
+    """A soft placement preference with weight in [-100, 100]
+    (reference structs.go:9788; scored in scheduler/rank.go:710)."""
+
+    ltarget: str = ""
+    rtarget: str = ""
+    operand: str = "="
+    weight: int = 50
+
+    def key(self) -> tuple:
+        return (self.ltarget, self.rtarget, self.operand, self.weight)
+
+
+@dataclass(slots=True, frozen=True)
+class SpreadTarget:
+    """Desired percentage for one attribute value (reference structs.go SpreadTarget)."""
+
+    value: str = ""
+    percent: int = 0
+
+
+@dataclass(slots=True)
+class Spread:
+    """Spread allocations across values of an attribute, optionally with
+    per-value target percentages (reference structs.go:9879; scored in
+    scheduler/spread.go:19)."""
+
+    attribute: str = ""
+    weight: int = 50
+    targets: List[SpreadTarget] = field(default_factory=list)

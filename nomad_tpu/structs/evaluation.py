@@ -1,0 +1,74 @@
+"""Evaluation (reference structs.go Evaluation:12193)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from . import enums
+
+
+@dataclass(slots=True)
+class Evaluation:
+    """A request to (re)schedule a job — the unit of scheduler work
+    (reference structs.go Evaluation:12193; processed via
+    scheduler.Scheduler.Process, scheduler/scheduler.go:59)."""
+
+    id: str = ""
+    namespace: str = "default"
+    priority: int = 50
+    type: str = enums.JOB_TYPE_SERVICE          # which scheduler processes it
+    triggered_by: str = enums.TRIGGER_JOB_REGISTER
+    job_id: str = ""
+    job_modify_index: int = 0
+    node_id: str = ""
+    node_modify_index: int = 0
+    deployment_id: str = ""
+    status: str = enums.EVAL_STATUS_PENDING
+    status_description: str = ""
+    wait_until: float = 0.0                      # delayed evals (broker delay heap)
+    next_eval: str = ""
+    previous_eval: str = ""
+    blocked_eval: str = ""
+    related_evals: list = field(default_factory=list)
+    # For blocked evals (reference structs.go Evaluation.{ClassEligibility,...},
+    # consumed by nomad/blocked_evals.go):
+    class_eligibility: Dict[str, bool] = field(default_factory=dict)
+    escaped_computed_class: bool = False
+    quota_limit_reached: str = ""
+    # Failed-placement bookkeeping: task group -> AllocMetric
+    failed_tg_allocs: Dict[str, object] = field(default_factory=dict)
+    # task group -> desired changes annotation (nomad plan)
+    plan_annotations: Optional[dict] = None
+    queued_allocations: Dict[str, int] = field(default_factory=dict)
+    snapshot_index: int = 0
+    create_index: int = 0
+    modify_index: int = 0
+    create_time: float = 0.0
+    modify_time: float = 0.0
+    leader_ack: str = ""                         # broker delivery token
+
+    def terminal_status(self) -> bool:
+        return self.status in (
+            enums.EVAL_STATUS_COMPLETE,
+            enums.EVAL_STATUS_FAILED,
+            enums.EVAL_STATUS_CANCELLED,
+        )
+
+    def should_enqueue(self) -> bool:
+        """Reference structs.go Evaluation.ShouldEnqueue."""
+        return self.status == enums.EVAL_STATUS_PENDING
+
+    def should_block(self) -> bool:
+        return self.status == enums.EVAL_STATUS_BLOCKED
+
+    def make_plan(self, job) -> "object":
+        """Reference structs.go Evaluation.MakePlan."""
+        from .plan import Plan
+
+        return Plan(
+            eval_id=self.id,
+            priority=self.priority,
+            job=job,
+            all_at_once=bool(job.all_at_once) if job is not None else False,
+        )

@@ -1,0 +1,108 @@
+"""Fit & scoring math (reference nomad/structs/funcs.go:141-278).
+
+These are the scalar/host-side versions, written against dense resource
+vectors so they vectorize over nodes with numpy. The JAX device kernels in
+nomad_tpu.ops.scoring reproduce exactly the same formulas; differential
+tests pin them together.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from .resources import R_CPU, R_MEM, RESOURCE_DIMS, dim_name
+
+# Reference scheduler/rank.go:18 binPackingMaxFitScore
+BINPACK_MAX_FIT_SCORE = 18.0
+
+
+def compute_free_percentage(available_vec: np.ndarray, util_vec: np.ndarray) -> Tuple[float, float]:
+    """Free fraction of cpu/mem after `util` is placed
+    (reference funcs.go:213 computeFreePercentage).
+
+    available_vec = node total - node reserved.
+
+    A zero-capacity dimension with nonzero util yields free = -inf (Go's
+    float division by zero gives +Inf utilization), which clamps to the
+    max binpack score downstream — same end behavior as the reference. The
+    0/0 case (zero capacity, zero util) is pinned to free = 0.0 rather
+    than Go's NaN so no NaN ever escapes into the kernels.
+    """
+    with np.errstate(divide="ignore", invalid="ignore"):
+        free_cpu = 1.0 - (util_vec[R_CPU] / available_vec[R_CPU])
+        free_mem = 1.0 - (util_vec[R_MEM] / available_vec[R_MEM])
+    if np.isnan(free_cpu):
+        free_cpu = 0.0
+    if np.isnan(free_mem):
+        free_mem = 0.0
+    return free_cpu, free_mem
+
+
+def score_fit_binpack(available_vec: np.ndarray, util_vec: np.ndarray) -> float:
+    """BestFit-v3: score = 20 - (10^freeCpu + 10^freeMem), clamped [0, 18]
+    (reference funcs.go:236 ScoreFitBinPack)."""
+    free_cpu, free_mem = compute_free_percentage(available_vec, util_vec)
+    total = 10.0 ** free_cpu + 10.0 ** free_mem
+    return float(np.clip(20.0 - total, 0.0, BINPACK_MAX_FIT_SCORE))
+
+
+def score_fit_spread(available_vec: np.ndarray, util_vec: np.ndarray) -> float:
+    """WorstFit: score = (10^freeCpu + 10^freeMem) - 2, clamped [0, 18]
+    (reference funcs.go:263 ScoreFitSpread)."""
+    free_cpu, free_mem = compute_free_percentage(available_vec, util_vec)
+    total = 10.0 ** free_cpu + 10.0 ** free_mem
+    return float(np.clip(total - 2.0, 0.0, BINPACK_MAX_FIT_SCORE))
+
+
+def allocs_fit(node, allocs: Iterable, check_devices: bool = False):
+    """Do these allocs fit on the node? -> (fit, failing_dimension, used_vec)
+
+    Mirrors reference funcs.go:141 AllocsFit: client-terminal allocs are
+    free; reserved cores must not overlap; used must be a subset of
+    available (total - reserved); optional device oversubscription check.
+    Port-collision checking lives in network.py and is consulted by the
+    plan applier separately.
+    """
+    used = np.zeros(RESOURCE_DIMS, dtype=np.float64)
+    seen_cores: set = set()
+    core_overlap = False
+    dev_used: dict = {}
+
+    for alloc in allocs:
+        if not alloc.should_count_for_usage():
+            continue
+        used += alloc.allocated_vec
+        for core in alloc.allocated_cores:
+            if core in seen_cores:
+                core_overlap = True
+            seen_cores.add(core)
+        if check_devices:
+            for dev_id, inst in alloc.allocated_devices.items():
+                dev_used[dev_id] = dev_used.get(dev_id, 0) + len(inst)
+
+    if core_overlap:
+        return False, "cores", used
+
+    available = node.available_vec()
+    over = used > available
+    if over.any():
+        return False, dim_name(int(np.argmax(over))), used
+
+    if check_devices:
+        for group in node.resources.devices:
+            cap = len(group.instance_ids)
+            if dev_used.get(group.id, 0) > cap:
+                return False, "device oversubscribed", used
+
+    return True, "", used
+
+
+def proposed_usage(allocs: Iterable) -> np.ndarray:
+    """Sum of comparable usage for non-client-terminal allocs."""
+    used = np.zeros(RESOURCE_DIMS, dtype=np.float64)
+    for alloc in allocs:
+        if alloc.should_count_for_usage():
+            used += alloc.allocated_vec
+    return used

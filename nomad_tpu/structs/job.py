@@ -1,0 +1,214 @@
+"""Job / TaskGroup / Task (reference structs.go Job:4317, TaskGroup:6609, Task:7609)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from . import enums
+from .constraint import Affinity, Constraint, Spread
+from .resources import Resources
+
+
+@dataclass(slots=True)
+class RestartPolicy:
+    """Client-side restart policy (reference structs.go RestartPolicy)."""
+
+    attempts: int = 2
+    interval_s: float = 30 * 60.0
+    delay_s: float = 15.0
+    mode: str = "fail"  # fail | delay
+
+
+@dataclass(slots=True)
+class ReschedulePolicy:
+    """Server-side reschedule-on-failure policy (reference structs.go ReschedulePolicy;
+    consumed by the reconciler, scheduler/reconcile.go:1336)."""
+
+    attempts: int = 0
+    interval_s: float = 0.0
+    delay_s: float = 30.0
+    delay_function: str = "exponential"  # constant | exponential | fibonacci
+    max_delay_s: float = 3600.0
+    unlimited: bool = True
+
+
+@dataclass(slots=True)
+class UpdateStrategy:
+    """Rolling-update / deployment strategy (reference structs.go UpdateStrategy)."""
+
+    max_parallel: int = 1
+    health_check: str = "checks"
+    min_healthy_time_s: float = 10.0
+    healthy_deadline_s: float = 300.0
+    progress_deadline_s: float = 600.0
+    auto_revert: bool = False
+    auto_promote: bool = False
+    canary: int = 0
+    stagger_s: float = 30.0
+
+
+@dataclass(slots=True)
+class EphemeralDisk:
+    """Task-group scratch disk (reference structs.go EphemeralDisk)."""
+
+    size_mb: int = 300
+    sticky: bool = False
+    migrate: bool = False
+
+
+@dataclass(slots=True)
+class MigrateStrategy:
+    """Drain migration strategy (reference structs.go MigrateStrategy)."""
+
+    max_parallel: int = 1
+    health_check: str = "checks"
+    min_healthy_time_s: float = 10.0
+    healthy_deadline_s: float = 300.0
+
+
+@dataclass(slots=True)
+class Service:
+    """Service registration attached to a group/task (reference structs/services.go)."""
+
+    name: str = ""
+    port_label: str = ""
+    provider: str = "builtin"
+    tags: List[str] = field(default_factory=list)
+    checks: List[dict] = field(default_factory=list)
+
+
+@dataclass(slots=True)
+class LogConfig:
+    max_files: int = 10
+    max_file_size_mb: int = 10
+
+
+@dataclass(slots=True)
+class Task:
+    """A unit of work executed by a driver (reference structs.go Task:7609)."""
+
+    name: str = "task"
+    driver: str = "mock"
+    config: Dict[str, object] = field(default_factory=dict)
+    env: Dict[str, str] = field(default_factory=dict)
+    resources: Resources = field(default_factory=Resources)
+    constraints: List[Constraint] = field(default_factory=list)
+    affinities: List[Affinity] = field(default_factory=list)
+    services: List[Service] = field(default_factory=list)
+    leader: bool = False
+    lifecycle_hook: str = ""      # "" (main) | prestart | poststart | poststop
+    lifecycle_sidecar: bool = False
+    kill_timeout_s: float = 5.0
+    log_config: LogConfig = field(default_factory=LogConfig)
+    artifacts: List[dict] = field(default_factory=list)
+    templates: List[dict] = field(default_factory=list)
+    user: str = ""
+    meta: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass(slots=True)
+class TaskGroup:
+    """A co-scheduled set of tasks; the unit of placement
+    (reference structs.go TaskGroup:6609)."""
+
+    name: str = "group"
+    count: int = 1
+    tasks: List[Task] = field(default_factory=list)
+    constraints: List[Constraint] = field(default_factory=list)
+    affinities: List[Affinity] = field(default_factory=list)
+    spreads: List[Spread] = field(default_factory=list)
+    restart_policy: RestartPolicy = field(default_factory=RestartPolicy)
+    reschedule_policy: Optional[ReschedulePolicy] = None
+    update: Optional[UpdateStrategy] = None
+    migrate: Optional[MigrateStrategy] = None
+    ephemeral_disk: EphemeralDisk = field(default_factory=EphemeralDisk)
+    networks: List = field(default_factory=list)
+    services: List[Service] = field(default_factory=list)
+    max_client_disconnect_s: Optional[float] = None
+    stop_after_client_disconnect_s: Optional[float] = None
+    meta: Dict[str, str] = field(default_factory=dict)
+
+    def combined_resources(self) -> Resources:
+        """Sum of task asks plus the group ephemeral disk: what one
+        allocation of this group consumes (reference: the scheduler sums
+        task resources per group, scheduler/rank.go:370-430)."""
+        total = Resources(cpu=0, memory_mb=0, disk_mb=float(self.ephemeral_disk.size_mb))
+        for t in self.tasks:
+            total.cpu += t.resources.cpu
+            total.memory_mb += t.resources.memory_mb
+            total.memory_max_mb += (t.resources.memory_max_mb or t.resources.memory_mb)
+            total.cores += t.resources.cores
+            total.networks.extend(t.resources.networks)
+            total.devices.extend(t.resources.devices)
+        total.networks.extend(self.networks)
+        return total
+
+
+@dataclass(slots=True)
+class PeriodicConfig:
+    """Cron-style launch config (reference structs.go PeriodicConfig)."""
+
+    enabled: bool = True
+    spec: str = ""
+    spec_type: str = "cron"
+    prohibit_overlap: bool = False
+    timezone: str = "UTC"
+
+
+@dataclass(slots=True)
+class ParameterizedJobConfig:
+    payload: str = "optional"
+    meta_required: List[str] = field(default_factory=list)
+    meta_optional: List[str] = field(default_factory=list)
+
+
+@dataclass(slots=True)
+class Job:
+    """A declared workload (reference structs.go Job:4317)."""
+
+    id: str = ""
+    name: str = ""
+    namespace: str = "default"
+    type: str = enums.JOB_TYPE_SERVICE
+    priority: int = 50
+    region: str = "global"
+    datacenters: List[str] = field(default_factory=lambda: ["dc1"])
+    node_pool: str = enums.NODE_POOL_DEFAULT
+    constraints: List[Constraint] = field(default_factory=list)
+    affinities: List[Affinity] = field(default_factory=list)
+    spreads: List[Spread] = field(default_factory=list)
+    task_groups: List[TaskGroup] = field(default_factory=list)
+    periodic: Optional[PeriodicConfig] = None
+    parameterized: Optional[ParameterizedJobConfig] = None
+    all_at_once: bool = False
+    stop: bool = False
+    status: str = enums.JOB_STATUS_PENDING
+    version: int = 0
+    stable: bool = False
+    submit_time: float = 0.0
+    create_index: int = 0
+    modify_index: int = 0
+    job_modify_index: int = 0
+    meta: Dict[str, str] = field(default_factory=dict)
+    parent_id: str = ""
+    dispatched: bool = False
+    payload: bytes = b""
+
+    def lookup_task_group(self, name: str) -> Optional[TaskGroup]:
+        for tg in self.task_groups:
+            if tg.name == name:
+                return tg
+        return None
+
+    @property
+    def is_periodic(self) -> bool:
+        return self.periodic is not None
+
+    @property
+    def is_parameterized(self) -> bool:
+        return self.parameterized is not None and not self.dispatched
+
+    def stopped(self) -> bool:
+        """Reference structs.go Job.Stopped: purely the user-set stop flag."""
+        return self.stop

@@ -1,0 +1,106 @@
+"""Node (reference structs.go Node:2052)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from . import enums
+from .resources import NodeReservedResources, NodeResources
+
+import numpy as np
+
+
+@dataclass(slots=True)
+class DrainStrategy:
+    """Node drain spec (reference structs.go DrainStrategy)."""
+
+    deadline_s: float = 0.0
+    ignore_system_jobs: bool = False
+    force_deadline: float = 0.0  # absolute unix time when the drain force-completes
+
+
+@dataclass(slots=True)
+class Node:
+    """A machine in the cluster (reference structs.go Node:2052).
+
+    `attributes` and `meta` are flat string maps, addressed from
+    constraints via "${attr.x}" / "${meta.x}" / "${node.x}" interpolation
+    targets (reference client/taskenv + scheduler/feasible.go:1427
+    resolveTarget).
+    """
+
+    id: str = ""
+    name: str = ""
+    datacenter: str = "dc1"
+    node_class: str = ""
+    node_pool: str = enums.NODE_POOL_DEFAULT
+    attributes: Dict[str, str] = field(default_factory=dict)
+    meta: Dict[str, str] = field(default_factory=dict)
+    resources: NodeResources = field(default_factory=NodeResources)
+    reserved: NodeReservedResources = field(default_factory=NodeReservedResources)
+    links: Dict[str, str] = field(default_factory=dict)
+    drivers: Dict[str, bool] = field(default_factory=dict)  # driver name -> healthy
+    status: str = enums.NODE_STATUS_READY
+    scheduling_eligibility: str = enums.NODE_SCHED_ELIGIBLE
+    drain_strategy: Optional[DrainStrategy] = None
+    status_updated_at: float = 0.0
+    create_index: int = 0
+    modify_index: int = 0
+    # Computed node class: hash of scheduling-relevant fields, memoized
+    # feasibility key (reference structs/node_class.go ComputeClass,
+    # scheduler/context.go:261 EvalEligibility).
+    computed_class: str = ""
+
+    @property
+    def drain(self) -> bool:
+        return self.drain_strategy is not None
+
+    def ready(self) -> bool:
+        """Schedulable check (reference structs.go Node.Ready)."""
+        return (
+            self.status == enums.NODE_STATUS_READY
+            and not self.drain
+            and self.scheduling_eligibility == enums.NODE_SCHED_ELIGIBLE
+        )
+
+    def available_vec(self) -> np.ndarray:
+        """Total minus agent-reserved resources — the denominator for fit
+        scoring (reference nomad/structs/funcs.go:213 computeFreePercentage)."""
+        return self.resources.vec() - self.reserved.vec()
+
+    def compute_class(self) -> str:
+        """Hash scheduling-relevant fields into an equivalence class.
+
+        Nodes in the same class are interchangeable for feasibility
+        checking, which the scheduler exploits for memoization and the
+        tensorizer for row dedup (reference structs/node_class.go,
+        scheduler/feasible.go:1115 FeasibilityWrapper).
+        """
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=16)
+
+        def put(*fields: str) -> None:
+            # NUL-separated so ("ab","c") never collides with ("a","bc")
+            for f in fields:
+                h.update(f.encode())
+                h.update(b"\x00")
+
+        put(self.datacenter, self.node_class, self.node_pool)
+        for k in sorted(self.attributes):
+            # unique-per-node attrs are excluded from the class hash
+            if k.startswith("unique."):
+                continue
+            put(k, str(self.attributes[k]))
+        for k in sorted(self.meta):
+            if k.startswith("unique."):
+                continue
+            put(k, str(self.meta[k]))
+        for k in sorted(self.drivers):
+            put(k, "1" if self.drivers[k] else "0")
+        put(repr(self.resources.vec().tolist()), repr(self.reserved.vec().tolist()))
+        for d in self.resources.devices:
+            put(d.id, str(len(d.instance_ids)))
+        self.computed_class = h.hexdigest()
+        return self.computed_class

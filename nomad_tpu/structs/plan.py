@@ -1,0 +1,105 @@
+"""Plan / PlanResult (reference structs.go Plan:12582, PlanResult:12837).
+
+A plan is a scheduler's *proposed* state mutation: placements, evictions
+and preemptions keyed by node. It is submitted to the leader's serialized
+plan applier which re-verifies per-node fit against the latest state and
+may partially commit (reference nomad/plan_apply.go:96-211).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass(slots=True)
+class Plan:
+    eval_id: str = ""
+    priority: int = 50
+    job: object = None
+    all_at_once: bool = False
+    # node id -> allocs to stop/evict (full alloc rows with updated desired status)
+    node_update: Dict[str, list] = field(default_factory=dict)
+    # node id -> new/updated allocs to place
+    node_allocation: Dict[str, list] = field(default_factory=dict)
+    # node id -> allocs preempted to make room
+    node_preemptions: Dict[str, list] = field(default_factory=dict)
+    deployment: object = None
+    deployment_updates: List[object] = field(default_factory=list)
+    eval_updates: List[object] = field(default_factory=list)   # e.g. blocked eval created atomically
+    annotations: Optional[dict] = None
+    snapshot_index: int = 0
+
+    def append_alloc(self, alloc) -> None:
+        self.node_allocation.setdefault(alloc.node_id, []).append(alloc)
+
+    def append_stopped_alloc(self, alloc, desired_desc: str, client_status: str = "") -> None:
+        """Mark an alloc for stopping (reference structs.go Plan.AppendStoppedAlloc)."""
+        from . import enums
+
+        updated = alloc.copy_for_update()
+        updated.desired_status = enums.ALLOC_DESIRED_STOP
+        updated.desired_description = desired_desc
+        if client_status:
+            updated.client_status = client_status
+        self.node_update.setdefault(alloc.node_id, []).append(updated)
+
+    def append_preempted_alloc(self, alloc, preempting_alloc_id: str) -> None:
+        from . import enums
+
+        updated = alloc.copy_for_update()
+        updated.desired_status = enums.ALLOC_DESIRED_EVICT
+        updated.desired_description = f"Preempted by alloc ID {preempting_alloc_id}"
+        updated.preempted_by_allocation = preempting_alloc_id
+        self.node_preemptions.setdefault(alloc.node_id, []).append(updated)
+
+    def is_no_op(self) -> bool:
+        return (
+            not self.node_update
+            and not self.node_allocation
+            and not self.node_preemptions
+            and self.deployment is None
+            and not self.deployment_updates
+        )
+
+    def normalize(self) -> None:
+        """Strip job copies from stop/preempt rows before 'raft' apply
+        (reference plan normalization, structs.go Plan.NormalizeAllocations)."""
+        for allocs in self.node_update.values():
+            for a in allocs:
+                a.job = None
+        for allocs in self.node_preemptions.values():
+            for a in allocs:
+                a.job = None
+
+
+@dataclass(slots=True)
+class PlanResult:
+    """What the plan applier actually committed (reference structs.go PlanResult:12837)."""
+
+    node_update: Dict[str, list] = field(default_factory=dict)
+    node_allocation: Dict[str, list] = field(default_factory=dict)
+    node_preemptions: Dict[str, list] = field(default_factory=dict)
+    deployment: object = None
+    deployment_updates: List[object] = field(default_factory=list)
+    # If set, the plan was partially committed and the scheduler should
+    # refresh its snapshot to at least this index before retrying
+    # (reference plan_apply.go partial commit + RefreshIndex).
+    refresh_index: int = 0
+    alloc_index: int = 0
+    rejected_nodes: List[str] = field(default_factory=list)
+
+    def full_commit(self, plan: Plan) -> tuple:
+        """(fully_committed, num_expected, num_actual)
+        (reference structs.go PlanResult.FullCommit)."""
+        expected = sum(len(v) for v in plan.node_allocation.values())
+        actual = sum(len(v) for v in self.node_allocation.values())
+        return expected == actual, expected, actual
+
+    def is_no_op(self) -> bool:
+        return (
+            not self.node_update
+            and not self.node_allocation
+            and not self.deployment_updates
+            and self.deployment is None
+        )

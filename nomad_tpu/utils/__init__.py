@@ -1,0 +1,3 @@
+"""Cross-cutting helpers (reference helper/ — 40 packages; only what we need)."""
+
+from .ids import generate_uuid, short_id  # noqa: F401

@@ -1,0 +1,190 @@
+"""Domain-model unit tests (modeled on reference nomad/structs/*_test.go)."""
+
+import numpy as np
+import pytest
+
+from nomad_tpu import structs
+from nomad_tpu.structs import (
+    Allocation,
+    Job,
+    Node,
+    Resources,
+    TaskGroup,
+    Task,
+    allocs_fit,
+    comparable,
+    score_fit_binpack,
+    score_fit_spread,
+)
+from nomad_tpu.structs import enums
+from nomad_tpu.structs.alloc import alloc_name
+from nomad_tpu.structs.resources import NodeResources, R_CPU, R_MEM, R_DISK
+
+
+def make_node(cpu=4000, mem=8192, disk=100 * 1024):
+    return Node(
+        id="n1",
+        resources=NodeResources(cpu=cpu, memory_mb=mem, disk_mb=disk),
+    )
+
+
+def make_alloc(cpu=1000, mem=1024, client_status=enums.ALLOC_CLIENT_RUNNING):
+    return Allocation(
+        id="a1",
+        allocated_vec=comparable(cpu, mem, 0),
+        client_status=client_status,
+    )
+
+
+class TestScoreFit:
+    """Pin the exact reference formulas (funcs.go:236-278)."""
+
+    def test_binpack_empty_node(self):
+        node = make_node()
+        # zero utilization: free=1.0 both dims -> 20 - (10+10) = 0
+        assert score_fit_binpack(node.available_vec(), comparable()) == 0.0
+
+    def test_binpack_full_node(self):
+        node = make_node()
+        util = comparable(4000, 8192, 0)
+        # 100% util: 20 - (10^0 + 10^0) = 18
+        assert score_fit_binpack(node.available_vec(), util) == 18.0
+
+    def test_binpack_half(self):
+        node = make_node()
+        util = comparable(2000, 4096, 0)
+        expected = 20.0 - 2 * 10.0 ** 0.5
+        assert score_fit_binpack(node.available_vec(), util) == pytest.approx(expected)
+
+    def test_spread_is_inverse_shape(self):
+        node = make_node()
+        assert score_fit_spread(node.available_vec(), comparable()) == 18.0
+        assert score_fit_spread(node.available_vec(), comparable(4000, 8192, 0)) == 0.0
+
+    def test_reserved_subtracted(self):
+        node = make_node()
+        node.reserved.cpu = 2000
+        node.reserved.memory_mb = 4096
+        util = comparable(2000, 4096, 0)
+        # util == available -> perfect fit
+        assert score_fit_binpack(node.available_vec(), util) == 18.0
+
+
+class TestAllocsFit:
+    def test_fits(self):
+        node = make_node()
+        fit, dim, used = allocs_fit(node, [make_alloc()])
+        assert fit and dim == ""
+        assert used[R_CPU] == 1000
+
+    def test_cpu_exhausted(self):
+        node = make_node(cpu=1000)
+        fit, dim, used = allocs_fit(node, [make_alloc(cpu=600), make_alloc(cpu=600)])
+        assert not fit and dim == "cpu"
+
+    def test_memory_exhausted(self):
+        node = make_node(mem=1024)
+        fit, dim, _ = allocs_fit(node, [make_alloc(mem=2048)])
+        assert not fit and dim == "memory"
+
+    def test_client_terminal_allocs_are_free(self):
+        # reference funcs.go:150 skips ClientTerminalStatus allocs
+        node = make_node(cpu=1000)
+        dead = make_alloc(cpu=900, client_status=enums.ALLOC_CLIENT_COMPLETE)
+        live = make_alloc(cpu=900)
+        fit, _, used = allocs_fit(node, [dead, live])
+        assert fit
+        assert used[R_CPU] == 900
+
+    def test_core_overlap(self):
+        node = make_node()
+        a, b = make_alloc(), make_alloc()
+        a.allocated_cores = [0, 1]
+        b.allocated_cores = [1, 2]
+        fit, dim, _ = allocs_fit(node, [a, b])
+        assert not fit and dim == "cores"
+
+    def test_device_oversubscription(self):
+        from nomad_tpu.structs.resources import NodeDeviceResource
+
+        node = make_node()
+        node.resources.devices = [
+            NodeDeviceResource(vendor="nvidia", type="gpu", name="t4", instance_ids=["i0", "i1"])
+        ]
+        a = make_alloc()
+        a.allocated_devices = {"nvidia/gpu/t4": ["i0", "i1"]}
+        b = make_alloc()
+        b.allocated_devices = {"nvidia/gpu/t4": ["i0"]}
+        fit, dim, _ = allocs_fit(node, [a, b], check_devices=True)
+        assert not fit and dim == "device oversubscribed"
+
+
+class TestNode:
+    def test_ready(self):
+        n = make_node()
+        assert n.ready()
+        n.scheduling_eligibility = enums.NODE_SCHED_INELIGIBLE
+        assert not n.ready()
+
+    def test_compute_class_stable_and_discriminating(self):
+        a, b = make_node(), make_node()
+        a.attributes = {"kernel.name": "linux", "unique.hostname": "a"}
+        b.attributes = {"kernel.name": "linux", "unique.hostname": "b"}
+        # unique.* attrs excluded -> same class
+        assert a.compute_class() == b.compute_class()
+        b.attributes["kernel.name"] = "darwin"
+        assert a.compute_class() != b.compute_class()
+
+    def test_compute_class_sensitive_to_resources(self):
+        a, b = make_node(), make_node(cpu=8000)
+        assert a.compute_class() != b.compute_class()
+
+
+class TestTaskGroup:
+    def test_combined_resources(self):
+        tg = TaskGroup(
+            name="web",
+            tasks=[
+                Task(name="app", resources=Resources(cpu=500, memory_mb=256)),
+                Task(name="sidecar", resources=Resources(cpu=100, memory_mb=64)),
+            ],
+        )
+        total = tg.combined_resources()
+        assert total.cpu == 600
+        assert total.memory_mb == 320
+        assert total.disk_mb == 300  # default ephemeral disk
+
+
+class TestAlloc:
+    def test_terminal_predicates(self):
+        a = make_alloc()
+        assert not a.terminal_status()
+        a.desired_status = enums.ALLOC_DESIRED_STOP
+        assert a.server_terminal() and a.terminal_status()
+
+    def test_alloc_name_index(self):
+        a = Allocation(name=alloc_name("job1", "web", 7))
+        assert a.name == "job1.web[7]"
+        assert a.index() == 7
+
+
+class TestPlan:
+    def test_append_stopped_preserves_original(self):
+        from nomad_tpu.structs import Plan
+
+        plan = Plan()
+        a = make_alloc()
+        a.node_id = "n1"
+        plan.append_stopped_alloc(a, "no longer needed")
+        assert a.desired_status == enums.ALLOC_DESIRED_RUN  # original untouched
+        stopped = plan.node_update["n1"][0]
+        assert stopped.desired_status == enums.ALLOC_DESIRED_STOP
+
+    def test_make_plan(self):
+        from nomad_tpu.structs import Evaluation
+
+        ev = Evaluation(id="e1", priority=70)
+        job = Job(id="j1")
+        plan = ev.make_plan(job)
+        assert plan.eval_id == "e1" and plan.priority == 70 and plan.job is job
+        assert plan.is_no_op()
