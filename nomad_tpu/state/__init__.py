@@ -1,0 +1,13 @@
+"""MVCC replicated state store (reference nomad/state — go-memdb based).
+
+The reference uses go-memdb's radix-tree MVCC. Here each table is a dict
+of per-key version chains: a snapshot is just a captured generation
+number (O(1)), reads at a generation binary-search tiny per-key chains,
+and the writer (the single serialized FSM apply path) garbage-collects
+versions older than the oldest live snapshot. Secondary indexes are
+immutable cons-lists so snapshots see a consistent membership view
+without copying.
+"""
+
+from .mvcc import VersionedTable, ConsList, cons, cons_iter  # noqa: F401
+from .store import StateStore, StateSnapshot  # noqa: F401

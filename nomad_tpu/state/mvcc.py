@@ -1,0 +1,194 @@
+"""MVCC primitives: versioned tables and persistent cons-lists.
+
+Design notes (TPU-first): snapshots must be O(1) to take and cheap to
+read because every scheduler worker snapshots per evaluation
+(reference nomad/worker.go:591 snapshotMinIndex) and the leader plan
+applier snapshots per plan (nomad/plan_apply.go:217). Writes are
+serialized through the FSM (nomad/fsm.go:228), so the writer needs no
+locking against other writers — only readers taking snapshots
+concurrently, which a generation counter handles.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+_TOMBSTONE = object()
+
+
+class ConsList:
+    """Immutable singly-linked list cell. Sharing-friendly secondary-index
+    value: appending is O(1) and never disturbs older snapshots."""
+
+    __slots__ = ("head", "tail", "length")
+
+    def __init__(self, head: Any, tail: Optional["ConsList"]):
+        self.head = head
+        self.tail = tail
+        self.length = 1 + (tail.length if tail is not None else 0)
+
+
+def cons(head: Any, tail: Optional[ConsList]) -> ConsList:
+    return ConsList(head, tail)
+
+
+def cons_iter(cell: Optional[ConsList]) -> Iterator[Any]:
+    while cell is not None:
+        yield cell.head
+        cell = cell.tail
+
+
+def cons_from_iter(items) -> Optional[ConsList]:
+    cell = None
+    for it in items:
+        cell = ConsList(it, cell)
+    return cell
+
+
+class _Chain:
+    """Per-key version chain: parallel arrays of (generation, value)."""
+
+    __slots__ = ("gens", "vals")
+
+    def __init__(self):
+        self.gens: List[int] = []
+        self.vals: List[Any] = []
+
+
+class VersionedTable:
+    """A dict of version chains keyed by primary key.
+
+    The single writer calls put/delete with a monotonically increasing
+    generation; readers call get/iterate with a captured generation.
+    Chains are pruned against `min_live_gen` opportunistically on write.
+    """
+
+    __slots__ = ("name", "_rows",)
+
+    def __init__(self, name: str):
+        self.name = name
+        self._rows: Dict[Any, _Chain] = {}
+
+    def __len__(self):
+        return len(self._rows)
+
+    def put(self, key: Any, value: Any, gen: int, min_live_gen: int) -> None:
+        chain = self._rows.get(key)
+        if chain is None:
+            chain = _Chain()
+            self._rows[key] = chain
+        if chain.gens and chain.gens[-1] == gen:
+            chain.vals[-1] = value
+        else:
+            chain.gens.append(gen)
+            chain.vals.append(value)
+        if len(chain.gens) > 1 and chain.gens[0] < min_live_gen:
+            self._prune(chain, min_live_gen)
+
+    def delete(self, key: Any, gen: int, min_live_gen: int) -> None:
+        if key in self._rows:
+            self.put(key, _TOMBSTONE, gen, min_live_gen)
+
+    def _prune(self, chain: _Chain, min_live_gen: int) -> None:
+        # keep the newest version at or below min_live_gen plus everything after
+        i = bisect.bisect_right(chain.gens, min_live_gen) - 1
+        if i > 0:
+            del chain.gens[:i]
+            del chain.vals[:i]
+
+    def get(self, key: Any, gen: int) -> Any:
+        chain = self._rows.get(key)
+        if chain is None:
+            return None
+        gens = chain.gens
+        # fast path: latest version visible
+        if gens[-1] <= gen:
+            v = chain.vals[-1]
+            return None if v is _TOMBSTONE else v
+        i = bisect.bisect_right(gens, gen) - 1
+        if i < 0:
+            return None
+        v = chain.vals[i]
+        return None if v is _TOMBSTONE else v
+
+    def get_latest(self, key: Any) -> Any:
+        chain = self._rows.get(key)
+        if chain is None or not chain.gens:
+            return None
+        v = chain.vals[-1]
+        return None if v is _TOMBSTONE else v
+
+    def iterate(self, gen: int) -> Iterator[Tuple[Any, Any]]:
+        for key, chain in self._rows.items():
+            gens = chain.gens
+            if gens[-1] <= gen:
+                v = chain.vals[-1]
+            else:
+                i = bisect.bisect_right(gens, gen) - 1
+                if i < 0:
+                    continue
+                v = chain.vals[i]
+            if v is not _TOMBSTONE:
+                yield key, v
+
+    def compact_key(self, key: Any, min_live_gen: int) -> None:
+        chain = self._rows.get(key)
+        if chain is None:
+            return
+        self._prune(chain, min_live_gen)
+        if len(chain.gens) == 1 and chain.vals[0] is _TOMBSTONE and chain.gens[0] <= min_live_gen:
+            del self._rows[key]
+
+    def sweep(self, min_live_gen: int) -> int:
+        """Prune all chains and drop rows whose only surviving version is
+        a tombstone no live snapshot can see. Returns rows dropped. Called
+        from the GC path (core scheduler), not the hot write path."""
+        dead = []
+        for key, chain in self._rows.items():
+            if len(chain.gens) > 1:
+                self._prune(chain, min_live_gen)
+            if len(chain.gens) == 1 and chain.vals[0] is _TOMBSTONE and chain.gens[0] <= min_live_gen:
+                dead.append(key)
+        for key in dead:
+            del self._rows[key]
+        return len(dead)
+
+
+class SnapshotTracker:
+    """Tracks live snapshot generations so the writer knows how far back
+    version chains must be retained. Thread-safe; snapshots auto-release
+    via finalizers but may release explicitly."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._live: Dict[int, int] = {}  # gen -> refcount
+        self._min_cache = 0
+
+    def acquire(self, gen: int) -> None:
+        with self._lock:
+            self._live[gen] = self._live.get(gen, 0) + 1
+
+    def acquire_atomic(self, get_gen: Callable[[], int]) -> int:
+        """Read the current generation and register it in one critical
+        section, so a concurrent writer's min_live() can never miss a
+        snapshot that was being taken (prune race)."""
+        with self._lock:
+            gen = get_gen()
+            self._live[gen] = self._live.get(gen, 0) + 1
+            return gen
+
+    def release(self, gen: int) -> None:
+        with self._lock:
+            n = self._live.get(gen, 0) - 1
+            if n <= 0:
+                self._live.pop(gen, None)
+            else:
+                self._live[gen] = n
+
+    def min_live(self, current_gen: int) -> int:
+        with self._lock:
+            if not self._live:
+                return current_gen
+            return min(self._live)

@@ -1,0 +1,583 @@
+"""The replicated state store (reference nomad/state/state_store.go, 7.5k LoC).
+
+Single serialized writer (the FSM apply path, reference nomad/fsm.go:228)
++ many concurrent snapshot readers. Every mutation commits at a new
+monotonically-increasing raft-style index which doubles as the MVCC
+generation.
+
+Write protocol: `_begin()` allocates the next generation *privately*;
+mutations land in version chains at that generation; `_commit()` then
+publishes the index and wakes blocking readers. Readers can therefore
+never observe a half-applied generation, and snapshot acquisition is
+atomic with the writer's min-live computation (both go through the
+tracker's lock), so pruning can never strand a just-taken snapshot.
+
+Rows are immutable by convention (same contract as go-memdb in the
+reference): mutators always insert fresh objects; `copy_for_update`-style
+shallow copies are used when deriving new rows from old ones.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+import weakref
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+
+from ..structs import enums
+from ..structs.alloc import Allocation
+from ..structs.deployment import Deployment
+from ..structs.evaluation import Evaluation
+from ..structs.job import Job
+from ..structs.node import Node
+from .mvcc import ConsList, SnapshotTracker, VersionedTable, cons, cons_from_iter, cons_iter
+
+
+class StateSnapshot:
+    """A point-in-time read-only view (reference state_store.go:224 Snapshot).
+
+    Cheap to hold: just a generation number. Release explicitly (context
+    manager / close) or let the finalizer do it.
+    """
+
+    def __init__(self, store: "StateStore", gen: int):
+        # gen must already be acquired in the store's tracker
+        self._store = store
+        self.index = gen
+        self._finalizer = weakref.finalize(self, store._tracker.release, gen)
+
+    def close(self) -> None:
+        self._finalizer()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # --- nodes ---
+
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return self._store._nodes.get(node_id, self.index)
+
+    def nodes(self) -> Iterator[Node]:
+        return (n for _, n in self._store._nodes.iterate(self.index))
+
+    def ready_nodes_in_pool(self, datacenters: Iterable[str], node_pool: str) -> List[Node]:
+        """Reference scheduler/util.go:50 readyNodesInDCsAndPool."""
+        dcs = set(datacenters)
+        any_dc = "*" in dcs
+        out = []
+        for n in self.nodes():
+            if not n.ready():
+                continue
+            if not any_dc and n.datacenter not in dcs:
+                continue
+            if node_pool != enums.NODE_POOL_ALL and n.node_pool != node_pool:
+                continue
+            out.append(n)
+        return out
+
+    # --- jobs ---
+
+    def job_by_id(self, job_id: str, namespace: str = "default") -> Optional[Job]:
+        return self._store._jobs.get((namespace, job_id), self.index)
+
+    def jobs(self) -> Iterator[Job]:
+        return (j for _, j in self._store._jobs.iterate(self.index))
+
+    def job_version(self, job_id: str, version: int, namespace: str = "default") -> Optional[Job]:
+        return self._store._job_versions.get((namespace, job_id, version), self.index)
+
+    # --- evals ---
+
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        return self._store._evals.get(eval_id, self.index)
+
+    def evals_by_job(self, job_id: str, namespace: str = "default") -> List[Evaluation]:
+        cell = self._store._evals_by_job.get((namespace, job_id), self.index)
+        out, seen = [], set()
+        for eid in cons_iter(cell):
+            if eid in seen:
+                continue
+            seen.add(eid)
+            ev = self.eval_by_id(eid)
+            if ev is not None:
+                out.append(ev)
+        return out
+
+    def evals(self) -> Iterator[Evaluation]:
+        return (e for _, e in self._store._evals.iterate(self.index))
+
+    # --- allocs ---
+
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        return self._store._allocs.get(alloc_id, self.index)
+
+    def allocs(self) -> Iterator[Allocation]:
+        return (a for _, a in self._store._allocs.iterate(self.index))
+
+    def _ids_from_index(self, table: VersionedTable, key) -> Iterator[str]:
+        cell = table.get(key, self.index)
+        seen = set()
+        for _id in cons_iter(cell):
+            if _id not in seen:
+                seen.add(_id)
+                yield _id
+
+    def _allocs_from_index(self, table: VersionedTable, key) -> List[Allocation]:
+        out = []
+        for aid in self._ids_from_index(table, key):
+            a = self._store._allocs.get(aid, self.index)
+            if a is not None:
+                out.append(a)
+        return out
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        return self._allocs_from_index(self._store._allocs_by_node, node_id)
+
+    def allocs_by_node_terminal(self, node_id: str, terminal: bool) -> List[Allocation]:
+        return [a for a in self.allocs_by_node(node_id) if a.terminal_status() == terminal]
+
+    def allocs_by_job(self, job_id: str, namespace: str = "default") -> List[Allocation]:
+        return self._allocs_from_index(self._store._allocs_by_job, (namespace, job_id))
+
+    def allocs_by_eval(self, eval_id: str) -> List[Allocation]:
+        return self._allocs_from_index(self._store._allocs_by_eval, eval_id)
+
+    # --- deployments ---
+
+    def deployment_by_id(self, dep_id: str) -> Optional[Deployment]:
+        return self._store._deployments.get(dep_id, self.index)
+
+    def deployments_by_job(self, job_id: str, namespace: str = "default") -> List[Deployment]:
+        out = []
+        for did in self._ids_from_index(self._store._deployments_by_job, (namespace, job_id)):
+            d = self._store._deployments.get(did, self.index)
+            if d is not None:
+                out.append(d)
+        return out
+
+    def latest_deployment_by_job(self, job_id: str, namespace: str = "default") -> Optional[Deployment]:
+        best = None
+        for d in self.deployments_by_job(job_id, namespace):
+            if best is None or d.create_index > best.create_index:
+                best = d
+        return best
+
+
+class StateStore:
+    """MVCC tables + serialized write path (reference nomad/state/state_store.go).
+
+    Commit listeners let derived caches (the tensorizer's usage arrays,
+    the event broker) update incrementally without rescans.
+    """
+
+    def __init__(self):
+        self._write_lock = threading.RLock()
+        self._index = 0          # last *published* (committed) generation
+        self._next_gen = 0       # last allocated generation (>= _index during a write)
+        self._tracker = SnapshotTracker()
+        self._cond = threading.Condition()
+
+        self._nodes = VersionedTable("nodes")
+        self._jobs = VersionedTable("jobs")                  # key (ns, job_id)
+        self._job_versions = VersionedTable("job_versions")  # key (ns, job_id, version)
+        self._evals = VersionedTable("evals")
+        self._allocs = VersionedTable("allocs")
+        self._deployments = VersionedTable("deployments")
+        # secondary indexes: cons-lists of ids (append-only; compacted on GC)
+        self._allocs_by_node = VersionedTable("allocs_by_node")
+        self._allocs_by_job = VersionedTable("allocs_by_job")
+        self._allocs_by_eval = VersionedTable("allocs_by_eval")
+        self._evals_by_job = VersionedTable("evals_by_job")
+        self._deployments_by_job = VersionedTable("deployments_by_job")
+
+        self._all_tables = [
+            self._nodes, self._jobs, self._job_versions, self._evals, self._allocs,
+            self._deployments, self._allocs_by_node, self._allocs_by_job,
+            self._allocs_by_eval, self._evals_by_job, self._deployments_by_job,
+        ]
+        self._listeners: List[Callable[[int, list], None]] = []
+
+    # --- infrastructure ---
+
+    @property
+    def latest_index(self) -> int:
+        return self._index
+
+    def snapshot(self) -> StateSnapshot:
+        gen = self._tracker.acquire_atomic(lambda: self._index)
+        return StateSnapshot(self, gen)
+
+    def snapshot_min_index(self, index: int, timeout: float = 5.0) -> StateSnapshot:
+        """Block until the store has applied `index`, then snapshot
+        (reference state_store.go:251 SnapshotMinIndex; used by workers at
+        nomad/worker.go:591)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._index < index:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"state store did not reach index {index} (at {self._index})")
+                self._cond.wait(remaining)
+        return self.snapshot()
+
+    def add_commit_listener(self, fn: Callable[[int, list], None]) -> None:
+        self._listeners.append(fn)
+
+    def _begin(self) -> Tuple[int, int]:
+        """Allocate the next generation (unpublished) and compute the
+        prune floor. Must hold _write_lock."""
+        self._next_gen += 1
+        # Readers can only ever be at <= the published index, and
+        # acquire_atomic serializes with this floor computation.
+        live = self._tracker.min_live(self._index)
+        return self._next_gen, live
+
+    def _commit(self, gen: int, events: list) -> None:
+        with self._cond:
+            self._index = gen
+            self._cond.notify_all()
+        for fn in self._listeners:
+            fn(gen, events)
+
+    def compact(self) -> int:
+        """Prune version chains and drop invisible tombstones across all
+        tables (called from the GC core job). Returns rows dropped."""
+        with self._write_lock:
+            floor = self._tracker.min_live(self._index)
+            return sum(t.sweep(floor) for t in self._all_tables)
+
+    # --- node mutations (reference FSM ApplyNode*) ---
+
+    def upsert_node(self, node: Node) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            prev = self._nodes.get_latest(node.id)
+            if prev is not None:
+                node.create_index = prev.create_index
+                # preserve fields the fingerprint re-registration doesn't own
+                if node.drain_strategy is None and prev.drain_strategy is not None:
+                    node.drain_strategy = prev.drain_strategy
+                    node.scheduling_eligibility = prev.scheduling_eligibility
+            else:
+                node.create_index = gen
+            node.modify_index = gen
+            if not node.computed_class:
+                node.compute_class()
+            self._nodes.put(node.id, node, gen, live)
+            self._commit(gen, [("node-upsert", node)])
+            return gen
+
+    def _update_node(self, node_id: str, event: str, mutate) -> int:
+        with self._write_lock:
+            node = self._nodes.get_latest(node_id)
+            if node is None:
+                raise KeyError(f"node {node_id} not found")
+            gen, live = self._begin()
+            node = copy.copy(node)
+            mutate(node)
+            node.modify_index = gen
+            self._nodes.put(node_id, node, gen, live)
+            self._commit(gen, [(event, node)])
+            return gen
+
+    def update_node_status(self, node_id: str, status: str, ts: float = 0.0) -> int:
+        def mut(n):
+            n.status = status
+            n.status_updated_at = ts or time.time()
+        return self._update_node(node_id, "node-status", mut)
+
+    def update_node_eligibility(self, node_id: str, eligibility: str) -> int:
+        def mut(n):
+            n.scheduling_eligibility = eligibility
+        return self._update_node(node_id, "node-eligibility", mut)
+
+    def update_node_drain(self, node_id: str, drain_strategy, mark_eligible: bool = False) -> int:
+        def mut(n):
+            n.drain_strategy = drain_strategy
+            if drain_strategy is not None:
+                n.scheduling_eligibility = enums.NODE_SCHED_INELIGIBLE
+            elif mark_eligible:
+                n.scheduling_eligibility = enums.NODE_SCHED_ELIGIBLE
+        return self._update_node(node_id, "node-drain", mut)
+
+    def delete_node(self, node_id: str) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            node = self._nodes.get_latest(node_id)
+            self._nodes.delete(node_id, gen, live)
+            self._commit(gen, [("node-delete", node)])
+            return gen
+
+    # --- job mutations (reference FSM ApplyJobRegister/Deregister) ---
+
+    def upsert_job(self, job: Job) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            key = (job.namespace, job.id)
+            prev = self._jobs.get_latest(key)
+            if prev is not None:
+                job.create_index = prev.create_index
+                job.version = prev.version + 1
+            else:
+                job.create_index = gen
+                job.version = 0
+                if job.status != enums.JOB_STATUS_DEAD:
+                    job.status = enums.JOB_STATUS_PENDING
+            job.modify_index = gen
+            job.job_modify_index = gen
+            # Store a snapshot row so a re-upserted caller object can't
+            # rewrite version history in place.
+            row = copy.copy(job)
+            self._jobs.put(key, row, gen, live)
+            self._job_versions.put((job.namespace, job.id, job.version), row, gen, live)
+            self._commit(gen, [("job-upsert", row)])
+            return gen
+
+    def delete_job(self, job_id: str, namespace: str = "default", purge: bool = True) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            key = (namespace, job_id)
+            job = self._jobs.get_latest(key)
+            if purge:
+                self._jobs.delete(key, gen, live)
+            elif job is not None:
+                job = copy.copy(job)
+                job.stop = True
+                job.modify_index = gen
+                self._jobs.put(key, job, gen, live)
+            self._commit(gen, [("job-delete", job)])
+            return gen
+
+    def update_job_status(self, job_id: str, status: str, namespace: str = "default") -> int:
+        with self._write_lock:
+            key = (namespace, job_id)
+            job = self._jobs.get_latest(key)
+            if job is None:
+                raise KeyError(f"job {job_id} not found")
+            gen, live = self._begin()
+            job = copy.copy(job)
+            job.status = status
+            job.modify_index = gen
+            self._jobs.put(key, job, gen, live)
+            self._commit(gen, [("job-status", job)])
+            return gen
+
+    # --- eval mutations (reference FSM ApplyUpdateEval) ---
+
+    def upsert_evals(self, evals: List[Evaluation]) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            events = []
+            for ev in evals:
+                self._put_eval(ev, gen, live)
+                events.append(("eval-upsert", ev))
+            self._commit(gen, events)
+            return gen
+
+    def _put_eval(self, ev: Evaluation, gen: int, live: int) -> None:
+        prev = self._evals.get_latest(ev.id)
+        ev.create_index = prev.create_index if prev is not None else gen
+        ev.modify_index = gen
+        self._evals.put(ev.id, ev, gen, live)
+        if prev is None:
+            key = (ev.namespace, ev.job_id)
+            cell = self._evals_by_job.get_latest(key)
+            self._evals_by_job.put(key, cons(ev.id, cell), gen, live)
+
+    def delete_evals(self, eval_ids: List[str]) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            dead = set(eval_ids)
+            jobs_touched = set()
+            for eid in eval_ids:
+                ev = self._evals.get_latest(eid)
+                if ev is not None:
+                    jobs_touched.add((ev.namespace, ev.job_id))
+                self._evals.delete(eid, gen, live)
+            # compact the job index so dead eval ids don't accumulate
+            for key in jobs_touched:
+                cell = self._evals_by_job.get_latest(key)
+                ids = [i for i in cons_iter(cell) if i not in dead]
+                if cell is not None and len(ids) != cell.length:
+                    self._evals_by_job.put(key, cons_from_iter(reversed(ids)), gen, live)
+            self._commit(gen, [("eval-delete", eval_ids)])
+            return gen
+
+    # --- alloc mutations ---
+
+    def upsert_allocs(self, allocs: List[Allocation]) -> int:
+        """Server-side alloc upsert (placements, desired-status changes)."""
+        with self._write_lock:
+            gen, live = self._begin()
+            events = []
+            for alloc in allocs:
+                self._put_alloc(alloc, gen, live)
+                events.append(("alloc-upsert", alloc))
+            self._commit(gen, events)
+            return gen
+
+    def _put_alloc(self, alloc: Allocation, gen: int, live: int) -> None:
+        prev = self._allocs.get_latest(alloc.id)
+        if prev is not None:
+            alloc.create_index = prev.create_index
+            # client status is owned by the client update path; preserve it
+            # on server-side rewrites unless explicitly set terminal
+            if alloc.client_status == enums.ALLOC_CLIENT_PENDING and prev.client_status:
+                alloc.client_status = prev.client_status
+        else:
+            alloc.create_index = gen
+        alloc.modify_index = gen
+        self._allocs.put(alloc.id, alloc, gen, live)
+        if prev is None:
+            cell = self._allocs_by_node.get_latest(alloc.node_id)
+            self._allocs_by_node.put(alloc.node_id, cons(alloc.id, cell), gen, live)
+            jkey = (alloc.namespace, alloc.job_id)
+            jcell = self._allocs_by_job.get_latest(jkey)
+            self._allocs_by_job.put(jkey, cons(alloc.id, jcell), gen, live)
+            ecell = self._allocs_by_eval.get_latest(alloc.eval_id)
+            self._allocs_by_eval.put(alloc.eval_id, cons(alloc.id, ecell), gen, live)
+
+    def update_allocs_from_client(self, updates: List[Allocation]) -> int:
+        """Client status sync (reference FSM ApplyAllocClientUpdate;
+        client batches at client/client.go:2198)."""
+        with self._write_lock:
+            gen, live = self._begin()
+            events = []
+            for upd in updates:
+                existing = self._allocs.get_latest(upd.id)
+                if existing is None:
+                    continue
+                merged = copy.copy(existing)
+                merged.client_status = upd.client_status
+                merged.client_description = upd.client_description
+                merged.task_states = upd.task_states or merged.task_states
+                merged.deployment_status = upd.deployment_status or merged.deployment_status
+                merged.modify_index = gen
+                merged.modify_time = time.time()
+                self._allocs.put(merged.id, merged, gen, live)
+                events.append(("alloc-client-update", merged))
+            self._commit(gen, events)
+            return gen
+
+    def update_alloc_desired_transitions(
+            self, transitions: Dict[str, object], evals: List[Evaluation] = ()) -> int:
+        """Reference FSM ApplyAllocUpdateDesiredTransition (used by drainer)."""
+        with self._write_lock:
+            gen, live = self._begin()
+            events = []
+            for alloc_id, transition in transitions.items():
+                existing = self._allocs.get_latest(alloc_id)
+                if existing is None:
+                    continue
+                merged = copy.copy(existing)
+                merged.desired_transition = transition
+                merged.modify_index = gen
+                self._allocs.put(alloc_id, merged, gen, live)
+                events.append(("alloc-transition", merged))
+            for ev in evals:
+                self._put_eval(ev, gen, live)
+                events.append(("eval-upsert", ev))
+            self._commit(gen, events)
+            return gen
+
+    # --- the plan-apply mutation (reference state_store.go:369 UpsertPlanResults) ---
+
+    def upsert_plan_results(
+        self,
+        result_allocs: List[Allocation],
+        stopped_allocs: List[Allocation] = (),
+        preempted_allocs: List[Allocation] = (),
+        deployment: Optional[Deployment] = None,
+        deployment_updates: List = (),
+        evals: List[Evaluation] = (),
+    ) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            events = []
+            for alloc in stopped_allocs:
+                self._put_alloc(alloc, gen, live)
+                events.append(("alloc-stop", alloc))
+            for alloc in preempted_allocs:
+                self._put_alloc(alloc, gen, live)
+                events.append(("alloc-preempt", alloc))
+            for alloc in result_allocs:
+                self._put_alloc(alloc, gen, live)
+                events.append(("alloc-upsert", alloc))
+            if deployment is not None:
+                self._put_deployment(deployment, gen, live)
+                events.append(("deployment-upsert", deployment))
+            for du in deployment_updates:
+                dep = self._deployments.get_latest(du.deployment_id)
+                if dep is not None:
+                    dep = copy.copy(dep)
+                    dep.status = du.status
+                    dep.status_description = du.status_description
+                    dep.modify_index = gen
+                    self._deployments.put(dep.id, dep, gen, live)
+                    events.append(("deployment-update", dep))
+            for ev in evals:
+                self._put_eval(ev, gen, live)
+                events.append(("eval-upsert", ev))
+            self._commit(gen, events)
+            return gen
+
+    # --- deployments ---
+
+    def _put_deployment(self, dep: Deployment, gen: int, live: int) -> None:
+        prev = self._deployments.get_latest(dep.id)
+        dep.create_index = prev.create_index if prev is not None else gen
+        dep.modify_index = gen
+        self._deployments.put(dep.id, dep, gen, live)
+        if prev is None:
+            key = (dep.namespace, dep.job_id)
+            cell = self._deployments_by_job.get_latest(key)
+            self._deployments_by_job.put(key, cons(dep.id, cell), gen, live)
+
+    def upsert_deployment(self, dep: Deployment) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            self._put_deployment(dep, gen, live)
+            self._commit(gen, [("deployment-upsert", dep)])
+            return gen
+
+    def update_deployment_status(self, dep_id: str, status: str, description: str = "") -> int:
+        with self._write_lock:
+            dep = self._deployments.get_latest(dep_id)
+            if dep is None:
+                raise KeyError(f"deployment {dep_id} not found")
+            gen, live = self._begin()
+            dep = copy.copy(dep)
+            dep.status = status
+            if description:
+                dep.status_description = description
+            dep.modify_index = gen
+            self._deployments.put(dep_id, dep, gen, live)
+            self._commit(gen, [("deployment-update", dep)])
+            return gen
+
+    # --- GC (reference nomad/core_sched.go) ---
+
+    def gc_terminal_allocs(self, before_index: int) -> int:
+        """Drop client-terminal allocs older than before_index and compact
+        the cons-list indexes (reference core_sched.go allocation GC)."""
+        with self._write_lock:
+            gen, live = self._begin()
+            dead = [a.id for _, a in self._allocs.iterate(gen)
+                    if a.terminal_status() and a.client_terminal()
+                    and a.modify_index < before_index]
+            dead_set = set(dead)
+            for aid in dead:
+                self._allocs.delete(aid, gen, live)
+            # rebuild secondary indexes without the dead ids
+            for table in (self._allocs_by_node, self._allocs_by_job, self._allocs_by_eval):
+                for key, cell in list(table.iterate(gen)):
+                    ids = [i for i in cons_iter(cell) if i not in dead_set]
+                    if len(ids) != cell.length:
+                        table.put(key, cons_from_iter(reversed(ids)), gen, live)
+            self._commit(gen, [("alloc-gc", dead)])
+            return len(dead)

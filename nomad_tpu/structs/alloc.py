@@ -8,7 +8,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from . import enums
-from .resources import Resources, comparable
+from .resources import comparable
 
 
 @dataclass(slots=True)

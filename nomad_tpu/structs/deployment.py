@@ -54,6 +54,8 @@ class Deployment:
         )
 
     def has_auto_promote(self) -> bool:
+        if not self.active():
+            return False
         return all(
             s.auto_promote for s in self.task_groups.values() if s.desired_canaries > 0
         ) and self.requires_promotion()

@@ -8,7 +8,7 @@ tests pin them together.
 
 from __future__ import annotations
 
-from typing import Iterable, List, Optional, Tuple
+from typing import Iterable, Tuple
 
 import numpy as np
 
@@ -25,9 +25,9 @@ def compute_free_percentage(available_vec: np.ndarray, util_vec: np.ndarray) -> 
     available_vec = node total - node reserved.
 
     A zero-capacity dimension with nonzero util yields free = -inf (Go's
-    float division by zero gives +Inf utilization), which clamps to the
-    max binpack score downstream — same end behavior as the reference. The
-    0/0 case (zero capacity, zero util) is pinned to free = 0.0 rather
+    float division by zero gives +Inf utilization), so that dimension's
+    10^free term vanishes downstream — same end behavior as the reference.
+    The 0/0 case (zero capacity, zero util) is pinned to free = 0.0 rather
     than Go's NaN so no NaN ever escapes into the kernels.
     """
     with np.errstate(divide="ignore", invalid="ignore"):
@@ -62,8 +62,8 @@ def allocs_fit(node, allocs: Iterable, check_devices: bool = False):
     Mirrors reference funcs.go:141 AllocsFit: client-terminal allocs are
     free; reserved cores must not overlap; used must be a subset of
     available (total - reserved); optional device oversubscription check.
-    Port-collision checking lives in network.py and is consulted by the
-    plan applier separately.
+    Port-collision checking is a separate concern (a network-index module
+    will own it once port scheduling lands) — not part of this predicate.
     """
     used = np.zeros(RESOURCE_DIMS, dtype=np.float64)
     seen_cores: set = set()

@@ -135,13 +135,16 @@ class TaskGroup:
         task resources per group, scheduler/rank.go:370-430)."""
         total = Resources(cpu=0, memory_mb=0, disk_mb=float(self.ephemeral_disk.size_mb))
         for t in self.tasks:
-            total.cpu += t.resources.cpu
-            total.memory_mb += t.resources.memory_mb
-            total.memory_max_mb += (t.resources.memory_max_mb or t.resources.memory_mb)
-            total.cores += t.resources.cores
-            total.networks.extend(t.resources.networks)
-            total.devices.extend(t.resources.devices)
-        total.networks.extend(self.networks)
+            c = t.resources.copy()  # don't alias the task's network/device objects
+            total.cpu += c.cpu
+            total.memory_mb += c.memory_mb
+            total.memory_max_mb += (c.memory_max_mb or c.memory_mb)
+            total.cores += c.cores
+            total.networks.extend(c.networks)
+            total.devices.extend(c.devices)
+        import copy as _copy
+
+        total.networks.extend(_copy.deepcopy(self.networks))
         return total
 
 

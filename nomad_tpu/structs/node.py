@@ -100,6 +100,10 @@ class Node:
         for k in sorted(self.drivers):
             put(k, "1" if self.drivers[k] else "0")
         put(repr(self.resources.vec().tolist()), repr(self.reserved.vec().tolist()))
+        put(str(self.resources.total_cores),
+            str(self.resources.min_dynamic_port), str(self.resources.max_dynamic_port))
+        for numa in self.resources.numa:
+            put(str(numa.id), repr(numa.cores))
         for d in self.resources.devices:
             put(d.id, str(len(d.instance_ids)))
         self.computed_class = h.hexdigest()

@@ -1,0 +1,244 @@
+"""State store tests (modeled on reference nomad/state/state_store_test.go)."""
+
+import threading
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import enums
+
+
+@pytest.fixture
+def store():
+    return StateStore()
+
+
+class TestNodes:
+    def test_upsert_and_get(self, store):
+        n = mock.node()
+        idx = store.upsert_node(n)
+        snap = store.snapshot()
+        got = snap.node_by_id(n.id)
+        assert got is n
+        assert got.create_index == idx and got.modify_index == idx
+
+    def test_snapshot_isolation(self, store):
+        n = mock.node()
+        store.upsert_node(n)
+        snap_before = store.snapshot()
+        store.update_node_status(n.id, enums.NODE_STATUS_DOWN)
+        snap_after = store.snapshot()
+        assert snap_before.node_by_id(n.id).status == enums.NODE_STATUS_READY
+        assert snap_after.node_by_id(n.id).status == enums.NODE_STATUS_DOWN
+
+    def test_delete_node_tombstone(self, store):
+        n = mock.node()
+        store.upsert_node(n)
+        snap_before = store.snapshot()
+        store.delete_node(n.id)
+        assert store.snapshot().node_by_id(n.id) is None
+        assert snap_before.node_by_id(n.id) is not None
+        assert list(store.snapshot().nodes()) == []
+
+    def test_ready_nodes_filtering(self, store):
+        ready = mock.node()
+        wrong_dc = mock.node(datacenter="dc2")
+        down = mock.node()
+        for n in (ready, wrong_dc, down):
+            store.upsert_node(n)
+        store.update_node_status(down.id, enums.NODE_STATUS_DOWN)
+        snap = store.snapshot()
+        ids = {n.id for n in snap.ready_nodes_in_pool(["dc1"], "default")}
+        assert ids == {ready.id}
+        ids_star = {n.id for n in snap.ready_nodes_in_pool(["*"], "all")}
+        assert ids_star == {ready.id, wrong_dc.id}
+
+    def test_reregister_preserves_drain(self, store):
+        from nomad_tpu.structs import DrainStrategy
+
+        n = mock.node()
+        store.upsert_node(n)
+        store.update_node_drain(n.id, DrainStrategy(deadline_s=3600))
+        # client re-registers (fingerprint): drain must survive
+        n2 = mock.node(id=n.id)
+        store.upsert_node(n2)
+        got = store.snapshot().node_by_id(n.id)
+        assert got.drain and got.scheduling_eligibility == enums.NODE_SCHED_INELIGIBLE
+
+
+class TestJobs:
+    def test_versioning(self, store):
+        j = mock.job()
+        store.upsert_job(j)
+        assert j.version == 0
+        import copy
+
+        j2 = copy.copy(j)
+        store.upsert_job(j2)
+        assert j2.version == 1
+        snap = store.snapshot()
+        assert snap.job_by_id(j.id).version == 1
+        assert snap.job_version(j.id, 0) is not None
+
+    def test_deregister_no_purge(self, store):
+        j = mock.job()
+        store.upsert_job(j)
+        store.delete_job(j.id, purge=False)
+        got = store.snapshot().job_by_id(j.id)
+        assert got is not None and got.stop
+
+    def test_deregister_purge(self, store):
+        j = mock.job()
+        store.upsert_job(j)
+        store.delete_job(j.id, purge=True)
+        assert store.snapshot().job_by_id(j.id) is None
+
+
+class TestEvalsAndAllocs:
+    def test_eval_index(self, store):
+        j = mock.job()
+        ev = mock.eval_for(j)
+        store.upsert_evals([ev])
+        snap = store.snapshot()
+        assert snap.eval_by_id(ev.id) is ev
+        assert [e.id for e in snap.evals_by_job(j.id)] == [ev.id]
+
+    def test_allocs_by_node_and_job(self, store):
+        j = mock.job()
+        n1, n2 = mock.node(), mock.node()
+        a1, a2, a3 = mock.alloc(j, n1, 0), mock.alloc(j, n1, 1), mock.alloc(j, n2, 2)
+        store.upsert_allocs([a1, a2, a3])
+        snap = store.snapshot()
+        assert {a.id for a in snap.allocs_by_node(n1.id)} == {a1.id, a2.id}
+        assert {a.id for a in snap.allocs_by_job(j.id)} == {a1.id, a2.id, a3.id}
+
+    def test_client_update_merges(self, store):
+        a = mock.alloc()
+        store.upsert_allocs([a])
+        upd = mock.alloc(id=a.id, client_status=enums.ALLOC_CLIENT_FAILED)
+        upd.id = a.id
+        store.update_allocs_from_client([upd])
+        got = store.snapshot().alloc_by_id(a.id)
+        assert got.client_status == enums.ALLOC_CLIENT_FAILED
+        # desired status untouched by client path
+        assert got.desired_status == enums.ALLOC_DESIRED_RUN
+
+    def test_terminal_filter(self, store):
+        j, n = mock.job(), mock.node()
+        live = mock.alloc(j, n, 0)
+        dead = mock.alloc(j, n, 1, desired_status=enums.ALLOC_DESIRED_STOP)
+        store.upsert_allocs([live, dead])
+        snap = store.snapshot()
+        assert [a.id for a in snap.allocs_by_node_terminal(n.id, False)] == [live.id]
+        assert [a.id for a in snap.allocs_by_node_terminal(n.id, True)] == [dead.id]
+
+    def test_plan_results_upsert(self, store):
+        j, n = mock.job(), mock.node()
+        store.upsert_job(j)
+        victim = mock.alloc(j, n, 0)
+        store.upsert_allocs([victim])
+        stopped = victim.copy_for_update()
+        stopped.desired_status = enums.ALLOC_DESIRED_STOP
+        placement = mock.alloc(j, n, 1)
+        idx = store.upsert_plan_results([placement], stopped_allocs=[stopped])
+        snap = store.snapshot()
+        assert snap.alloc_by_id(victim.id).desired_status == enums.ALLOC_DESIRED_STOP
+        assert snap.alloc_by_id(placement.id) is placement
+        assert snap.index == idx
+
+    def test_gc_compacts_indexes(self, store):
+        j, n = mock.job(), mock.node()
+        dead = mock.alloc(j, n, 0, desired_status=enums.ALLOC_DESIRED_STOP,
+                          client_status=enums.ALLOC_CLIENT_COMPLETE)
+        live = mock.alloc(j, n, 1)
+        store.upsert_allocs([dead, live])
+        removed = store.gc_terminal_allocs(before_index=store.latest_index + 1)
+        assert removed == 1
+        snap = store.snapshot()
+        assert snap.alloc_by_id(dead.id) is None
+        assert [a.id for a in snap.allocs_by_node(n.id)] == [live.id]
+
+
+class TestMVCCInfra:
+    def test_snapshot_min_index_blocks(self, store):
+        n = mock.node()
+        target = store.latest_index + 1
+
+        def writer():
+            import time
+
+            time.sleep(0.05)
+            store.upsert_node(n)
+
+        t = threading.Thread(target=writer)
+        t.start()
+        snap = store.snapshot_min_index(target, timeout=2.0)
+        t.join()
+        assert snap.index >= target
+        assert snap.node_by_id(n.id) is not None
+
+    def test_snapshot_min_index_timeout(self, store):
+        with pytest.raises(TimeoutError):
+            store.snapshot_min_index(999, timeout=0.05)
+
+    def test_version_pruning(self, store):
+        n = mock.node()
+        store.upsert_node(n)
+        # many writes with no live snapshots -> chains stay short
+        for _ in range(50):
+            store.update_node_status(n.id, enums.NODE_STATUS_READY)
+        chain = store._nodes._rows[n.id]
+        assert len(chain.gens) < 5
+
+    def test_commit_listener(self, store):
+        seen = []
+        store.add_commit_listener(lambda idx, events: seen.extend(events))
+        n = mock.node()
+        store.upsert_node(n)
+        assert seen and seen[0][0] == "node-upsert"
+
+
+class TestReviewRegressions:
+    def test_same_object_reupsert_does_not_corrupt_history(self, store):
+        j = mock.job()
+        store.upsert_job(j)
+        store.upsert_job(j)  # same live object again
+        snap = store.snapshot()
+        v0, v1 = snap.job_version(j.id, 0), snap.job_version(j.id, 1)
+        assert v0 is not None and v1 is not None and v0 is not v1
+        assert v0.version == 0 and v1.version == 1
+
+    def test_delete_evals_compacts_job_index(self, store):
+        j = mock.job()
+        evs = [mock.eval_for(j) for _ in range(5)]
+        store.upsert_evals(evs)
+        store.delete_evals([e.id for e in evs[:4]])
+        cell = store._evals_by_job.get_latest((j.namespace, j.id))
+        assert cell.length == 1
+
+    def test_sweep_drops_invisible_tombstones(self, store):
+        n = mock.node()
+        store.upsert_node(n)
+        store.delete_node(n.id)
+        assert n.id in store._nodes._rows
+        dropped = store.compact()
+        assert dropped >= 1
+        assert n.id not in store._nodes._rows
+
+    def test_allocs_by_eval_index(self, store):
+        j, n = mock.job(), mock.node()
+        a = mock.alloc(j, n, 0)
+        store.upsert_allocs([a])
+        assert [x.id for x in store.snapshot().allocs_by_eval(a.eval_id)] == [a.id]
+
+    def test_deployments_by_job_index(self, store):
+        from nomad_tpu.structs import Deployment
+
+        d1 = Deployment(id="d1", job_id="j1")
+        d2 = Deployment(id="d2", job_id="j1")
+        store.upsert_deployment(d1)
+        store.upsert_deployment(d2)
+        snap = store.snapshot()
+        assert {d.id for d in snap.deployments_by_job("j1")} == {"d1", "d2"}
+        assert snap.latest_deployment_by_job("j1").id == "d2"
