@@ -1,0 +1,26 @@
+"""Scheduling layer (reference scheduler/ — 45k LoC).
+
+Two placement backends share the same semantics:
+
+- the *host path* (this package: feasible.py, rank.py) — per-node greedy
+  evaluation reproducing the reference's iterator chain exactly; it is
+  the oracle for differential tests and the fallback for tiny clusters;
+- the *TPU path* (nomad_tpu.tensor + nomad_tpu.ops) — batched dense
+  kernels over (evals x nodes) tensors, selected via
+  SchedulerAlgorithm="tpu-binpack".
+
+Schedulers (service/batch/system/sysbatch) and the reconciler sit above
+both and don't know which backend placed their allocations.
+"""
+
+from .context import EvalContext  # noqa: F401
+from .feasible import (  # noqa: F401
+    check_constraint,
+    constraint_mask,
+    feasible_mask,
+    resolve_target,
+)
+from .rank import RankedNode, select_best_node, score_nodes  # noqa: F401
+from .scheduler import NewScheduler, Scheduler, BUILTIN_SCHEDULERS  # noqa: F401
+from .generic_sched import GenericScheduler  # noqa: F401
+from .system_sched import SystemScheduler  # noqa: F401

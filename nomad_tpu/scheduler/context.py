@@ -1,0 +1,108 @@
+"""Per-evaluation context (reference scheduler/context.go).
+
+Carries the immutable state snapshot, the in-progress plan, parse caches
+(regexp/version, reference context.go:15), the computed-class eligibility
+memoizer (context.go:261 EvalEligibility), and per-placement metrics.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional
+
+from ..structs import AllocMetric, Job, Node, Plan, TaskGroup
+from ..structs import enums
+
+
+class EvalEligibility:
+    """Memoizes feasibility per computed node class so a 10k-node cluster
+    with 20 classes does ~20 constraint evaluations, not 10k
+    (reference context.go:261; escape semantics for unique-attr
+    constraints per context.go:292-305)."""
+
+    def __init__(self):
+        self.job: Dict[str, bool] = {}       # class -> eligible at job level
+        self.tg: Dict[str, Dict[str, bool]] = {}  # tg name -> class -> eligible
+        self.job_escaped = False
+        self.tg_escaped: Dict[str, bool] = {}
+
+    def set_job(self, job: Job) -> None:
+        from .feasible import is_class_escaped
+
+        self.job_escaped = any(
+            is_class_escaped(c.ltarget) or is_class_escaped(c.rtarget)
+            for c in job.constraints
+        )
+        for tg in job.task_groups:
+            constraints = list(tg.constraints)
+            for t in tg.tasks:
+                constraints.extend(t.constraints)
+            self.tg_escaped[tg.name] = any(
+                is_class_escaped(c.ltarget) or is_class_escaped(c.rtarget)
+                for c in constraints
+            )
+
+    def job_status(self, klass: str) -> Optional[bool]:
+        if self.job_escaped or not klass:
+            return None
+        return self.job.get(klass)
+
+    def set_job_status(self, klass: str, eligible: bool) -> None:
+        if not self.job_escaped and klass:
+            self.job[klass] = eligible
+
+    def tg_status(self, tg_name: str, klass: str) -> Optional[bool]:
+        if self.tg_escaped.get(tg_name) or not klass:
+            return None
+        return self.tg.get(tg_name, {}).get(klass)
+
+    def set_tg_status(self, tg_name: str, klass: str, eligible: bool) -> None:
+        if not self.tg_escaped.get(tg_name) and klass:
+            self.tg.setdefault(tg_name, {})[klass] = eligible
+
+
+class EvalContext:
+    """Reference scheduler/context.go EvalContext."""
+
+    def __init__(self, snapshot, plan: Optional[Plan] = None, eval_id: str = "",
+                 logger=None):
+        self.snapshot = snapshot
+        self.plan = plan
+        self.eval_id = eval_id
+        self.regex_cache: dict = {}
+        self.version_cache: dict = {}
+        self.eligibility = EvalEligibility()
+        self.metrics: Optional[AllocMetric] = None
+        self.logger = logger
+
+    def new_metrics(self) -> AllocMetric:
+        self.metrics = AllocMetric()
+        return self.metrics
+
+    def proposed_allocs(self, node_id: str) -> List:
+        """The node's allocs as they would be if the in-progress plan
+        committed: state minus evictions/preemptions plus placements
+        (reference context.go:176 ProposedAllocs)."""
+        existing = self.snapshot.allocs_by_node_terminal(node_id, False)
+        if self.plan is None:
+            return existing
+        removed = set()
+        for a in self.plan.node_update.get(node_id, ()):
+            removed.add(a.id)
+        for a in self.plan.node_preemptions.get(node_id, ()):
+            removed.add(a.id)
+        out = [a for a in existing if a.id not in removed]
+        # placements may update an existing alloc in place (inplace update):
+        placed_ids = {a.id for a in self.plan.node_allocation.get(node_id, ())}
+        out = [a for a in out if a.id not in placed_ids]
+        out.extend(self.plan.node_allocation.get(node_id, ()))
+        return out
+
+    def shuffled_nodes(self, nodes: List[Node], attempt: int = 0) -> List[Node]:
+        """Deterministic shuffle seeded by eval id + retry attempt
+        (reference scheduler/util.go:167 shuffleNodes, seeded by eval and
+        plan-attempt index so retries explore different prefixes)."""
+        rng = random.Random(f"{self.eval_id}:{attempt}")
+        out = list(nodes)
+        rng.shuffle(out)
+        return out

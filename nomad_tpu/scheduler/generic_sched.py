@@ -1,0 +1,251 @@
+"""Service + batch scheduler (reference scheduler/generic_sched.go, 945 LoC).
+
+Retry loop: reconcile -> place -> submit plan -> on partial commit refresh
+snapshot and retry (<=5 attempts service / 2 batch); unplaceable allocs
+produce/refresh a blocked evaluation (reference generic_sched.go:149-356).
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+import time
+from typing import List, Optional
+
+from ..structs import enums
+from ..structs.alloc import Allocation, RescheduleEvent, RescheduleTracker
+from ..structs.evaluation import Evaluation
+from ..utils import generate_uuid
+from .context import EvalContext
+from .placer import HostPlacer, placer_for_algorithm
+from .reconcile import AllocReconciler, PlacementRequest
+from .util import tainted_nodes, update_non_terminal_allocs_to_lost
+
+MAX_SERVICE_ATTEMPTS = 5  # reference generic_sched.go:94
+MAX_BATCH_ATTEMPTS = 2
+
+BLOCKED_EVAL_MAX_PLAN_DESC = "created due to placement conflicts"
+BLOCKED_EVAL_FAILED_PLACEMENT_DESC = "created to place remaining allocations"
+
+
+class GenericScheduler:
+    def __init__(self, state, planner, *, batch: bool = False,
+                 sched_config=None, logger=None, placer=None):
+        self.state = state            # a StateSnapshot-like view
+        self.planner = planner
+        self.batch = batch
+        self.sched_config = sched_config
+        self.logger = logger
+        algorithm = (sched_config.scheduler_algorithm
+                     if sched_config is not None else enums.SCHED_ALG_BINPACK)
+        self.placer = placer if placer is not None else placer_for_algorithm(algorithm)
+        self.max_attempts = MAX_BATCH_ATTEMPTS if batch else MAX_SERVICE_ATTEMPTS
+
+        self.eval: Optional[Evaluation] = None
+        self.plan = None
+        self.failed_tg_allocs = {}
+        self.queued_allocs = {}
+        self.blocked: Optional[Evaluation] = None
+        self.followups: List[Evaluation] = []
+
+    # -- Scheduler interface --
+
+    def process(self, evaluation: Evaluation) -> None:
+        self.eval = evaluation
+        try:
+            self._process_with_retries()
+        except Exception as e:  # reference recovers panics into failed evals
+            if self.logger:
+                self.logger.exception("scheduler panic")
+            self._set_status(enums.EVAL_STATUS_FAILED, str(e))
+            raise
+
+    # -- core loop --
+
+    def _process_with_retries(self) -> None:
+        for attempt in range(self.max_attempts):
+            done = self._attempt(attempt)
+            if done:
+                return
+        # exceeded plan attempts: fail this eval but queue a blocked eval
+    # so the work is not lost (reference generic_sched.go:151-170)
+        self._create_blocked_eval(max_plan=True)
+        self._set_status(enums.EVAL_STATUS_FAILED, "maximum attempts reached")
+
+    def _attempt(self, attempt: int) -> bool:
+        ev = self.eval
+        self.failed_tg_allocs = {}
+        self.queued_allocs = {}
+        self.followups = []
+        job = self.state.job_by_id(ev.job_id, ev.namespace)
+        self.plan = ev.make_plan(job)
+        ctx = EvalContext(self.state, self.plan, eval_id=ev.id, logger=self.logger)
+        if job is not None:
+            ctx.eligibility.set_job(job)
+
+        all_allocs = self.state.allocs_by_job(ev.job_id, ev.namespace)
+        tainted = tainted_nodes(self.state, all_allocs)
+        update_non_terminal_allocs_to_lost(self.plan, tainted, all_allocs)
+
+        reconciler = AllocReconciler(
+            job if (job is not None and not job.stopped()) else None,
+            ev.job_id, all_allocs, tainted, batch=self.batch, eval_id=ev.id)
+        results = reconciler.compute()
+
+        # plan stops
+        for tg_name, g in results.groups.items():
+            for alloc, desc, client_status in g.stop:
+                self.plan.append_stopped_alloc(alloc, desc, client_status)
+            for alloc in g.destructive_update:
+                self.plan.append_stopped_alloc(
+                    alloc, "alloc is being updated due to job update")
+            self.followups.extend(g.followup_evals)
+            # annotate failed-then-delayed allocs with their followup eval
+            for alloc_id, feval_id in g.delayed_reschedule.items():
+                orig = next((a for a in all_allocs if a.id == alloc_id), None)
+                if orig is not None:
+                    upd = orig.copy_for_update()
+                    upd.follow_up_eval_id = feval_id
+                    self.plan.node_allocation.setdefault(upd.node_id, []).append(upd)
+
+        # build placement request list (destructive updates also re-place)
+        requests: List[PlacementRequest] = []
+        job_obj = job
+        for tg_name, g in results.groups.items():
+            tg = job_obj.lookup_task_group(tg_name) if job_obj else None
+            for alloc in g.destructive_update:
+                requests.append(PlacementRequest(
+                    name=alloc.name, task_group=tg, previous_alloc=alloc))
+            requests.extend(g.place)
+
+        if requests and job_obj is not None:
+            self._compute_placements(ctx, job_obj, requests, attempt)
+
+        # no-op plan with nothing failed: done
+        if self.plan.is_no_op() and not self.failed_tg_allocs:
+            self._finish_success()
+            return True
+
+        # submit
+        result, new_state = self.planner.submit_plan(self.plan)
+        if new_state is not None:
+            # partial commit: retry against fresher state
+            self.state = new_state
+            full, expected, actual = result.full_commit(self.plan)
+            if not full:
+                return False
+
+        self._finish_success()
+        return True
+
+    def _compute_placements(self, ctx: EvalContext, job, requests, attempt: int) -> None:
+        ev = self.eval
+        nodes = self.state.ready_nodes_in_pool(job.datacenters, job.node_pool)
+        preemption_enabled = (
+            self.sched_config.preemption_enabled_for(job.type)
+            if self.sched_config is not None else False)
+
+        now = time.time()
+
+        def commit(req, option):
+            tg = req.task_group
+            if option is None:
+                # failed placement: coalesce per task group
+                m = ctx.metrics
+                prev = self.failed_tg_allocs.get(tg.name)
+                if prev is None:
+                    self.failed_tg_allocs[tg.name] = m
+                else:
+                    prev.coalesced_failures += 1
+                self.queued_allocs[tg.name] = self.queued_allocs.get(tg.name, 0)
+                return
+
+            alloc = Allocation(
+                id=generate_uuid(),
+                eval_id=ev.id,
+                name=req.name,
+                namespace=job.namespace,
+                node_id=option.node.id,
+                node_name=option.node.name,
+                job_id=job.id,
+                job=job,
+                job_version=job.version,
+                task_group=tg.name,
+                allocated_vec=tg.combined_resources().vec(),
+                desired_status=enums.ALLOC_DESIRED_RUN,
+                client_status=enums.ALLOC_CLIENT_PENDING,
+                metrics=ctx.metrics,
+                allocated_at=now,
+            )
+            if req.previous_alloc is not None:
+                prev = req.previous_alloc
+                alloc.previous_allocation = prev.id
+                if req.reschedule:
+                    tracker = RescheduleTracker(
+                        events=list(prev.reschedule_tracker.events)
+                        if prev.reschedule_tracker else [])
+                    tracker.events.append(RescheduleEvent(
+                        reschedule_time=now, prev_alloc_id=prev.id,
+                        prev_node_id=prev.node_id))
+                    alloc.reschedule_tracker = tracker
+                    # link old -> new
+                    upd = prev.copy_for_update()
+                    upd.next_allocation = alloc.id
+                    self.plan.node_allocation.setdefault(upd.node_id, []).append(upd)
+            if option.preempted_allocs:
+                for victim in option.preempted_allocs:
+                    self.plan.append_preempted_alloc(victim, alloc.id)
+            self.plan.append_alloc(alloc)
+            self.queued_allocs[tg.name] = self.queued_allocs.get(tg.name, 0) + 1
+
+        self.placer.place(
+            ctx, job, requests, nodes, commit,
+            batch=self.batch, preemption_enabled=preemption_enabled,
+            attempt=attempt)
+
+    # -- eval bookkeeping --
+
+    def _finish_success(self) -> None:
+        for f in self.followups:
+            self.planner.create_eval(f)
+        if self.failed_tg_allocs:
+            self._create_blocked_eval(max_plan=False)
+            self._set_status(enums.EVAL_STATUS_COMPLETE,
+                             "complete with failed placements")
+        else:
+            self._set_status(enums.EVAL_STATUS_COMPLETE, "")
+
+    def _create_blocked_eval(self, max_plan: bool) -> None:
+        ev = self.eval
+        if ev.status == enums.EVAL_STATUS_BLOCKED or ev.triggered_by == enums.TRIGGER_QUEUED_ALLOCS:
+            # this eval IS a blocked eval being retried: reblock it
+            reblocked = _copy.copy(ev)
+            reblocked.status = enums.EVAL_STATUS_BLOCKED
+            self.planner.reblock_eval(reblocked)
+            self.blocked = reblocked
+            return
+        blocked = Evaluation(
+            id=generate_uuid(),
+            namespace=ev.namespace,
+            priority=ev.priority,
+            type=ev.type,
+            triggered_by=enums.TRIGGER_MAX_PLANS if max_plan else enums.TRIGGER_QUEUED_ALLOCS,
+            job_id=ev.job_id,
+            status=enums.EVAL_STATUS_BLOCKED,
+            status_description=(BLOCKED_EVAL_MAX_PLAN_DESC if max_plan
+                                else BLOCKED_EVAL_FAILED_PLACEMENT_DESC),
+            previous_eval=ev.id,
+        )
+        # class eligibility lets the blocked-evals tracker unblock cheaply
+        # (reference generic_sched.go:225 createBlockedEval)
+        self.planner.create_eval(blocked)
+        self.blocked = blocked
+
+    def _set_status(self, status: str, desc: str) -> None:
+        ev = _copy.copy(self.eval)
+        ev.status = status
+        ev.status_description = desc
+        ev.failed_tg_allocs = self.failed_tg_allocs
+        ev.queued_allocations = dict(self.queued_allocs)
+        if self.blocked is not None:
+            ev.blocked_eval = self.blocked.id
+        self.planner.update_eval(ev)

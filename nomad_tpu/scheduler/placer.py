@@ -1,0 +1,75 @@
+"""Placement backends.
+
+The scheduler asks a Placer to choose nodes for a batch of placement
+requests. Two implementations share this interface:
+
+- HostPlacer: per-request greedy select (reference stack.go Select) —
+  exact reference behavior;
+- TPUPlacer (nomad_tpu.tensor.placer): lowers the whole request batch to
+  dense tensors and solves placement as one fused JAX program. Selected
+  via SchedulerAlgorithm="tpu-binpack".
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..structs import Job, Node, enums
+from .context import EvalContext
+from .rank import NodeScorer, RankedNode, select_best_node
+from .reconcile import PlacementRequest
+
+
+class HostPlacer:
+    """Greedy per-placement selection — the reference semantics."""
+
+    def __init__(self, algorithm: str = enums.SCHED_ALG_BINPACK):
+        self.algorithm = algorithm
+
+    def place(
+        self,
+        ctx: EvalContext,
+        job: Job,
+        requests: Sequence[PlacementRequest],
+        nodes: Sequence[Node],
+        commit,
+        *,
+        batch: bool = False,
+        preemption_enabled: bool = False,
+        attempt: int = 0,
+    ) -> None:
+        """Select a node for each request, calling ``commit(req, option)``
+        immediately after each decision. The commit callback appends the
+        alloc to the in-progress plan, which is how subsequent selections
+        see earlier ones via ctx.proposed_allocs (the reference appends in
+        the computePlacements loop, generic_sched.go:511-600)."""
+        scorers: Dict[str, NodeScorer] = {}
+        for req in requests:
+            tg = req.task_group
+            scorer = scorers.get(tg.name)
+            if scorer is None:
+                scorer = NodeScorer(ctx, job, tg, algorithm=self.algorithm,
+                                    preemption_enabled=preemption_enabled)
+                scorers[tg.name] = scorer
+            penalty = frozenset({req.ignore_node}) if req.ignore_node else frozenset()
+            option = select_best_node(
+                ctx, job, tg, nodes,
+                batch=batch,
+                algorithm=self.algorithm,
+                preemption_enabled=preemption_enabled,
+                penalty_nodes=penalty,
+                scorer=scorer,
+                attempt=attempt,
+            )
+            if option is not None:
+                scorer.record_placement(option.node)
+            commit(req, option)
+
+
+def placer_for_algorithm(algorithm: str):
+    """Factory honoring SchedulerConfiguration.scheduler_algorithm."""
+    if algorithm == enums.SCHED_ALG_TPU_BINPACK:
+        from ..tensor.placer import TPUPlacer
+
+        return TPUPlacer()
+    return HostPlacer(algorithm=algorithm)

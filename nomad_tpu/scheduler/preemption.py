@@ -1,0 +1,100 @@
+"""Preemption victim selection (reference scheduler/preemption.go, 779 LoC).
+
+Implements the reference's core heuristic: only allocations of strictly
+lower job priority are evictable; candidates are considered in ascending
+priority groups and chosen by resource distance (how closely the victim's
+resources match the remaining need, preemption.go basicResourceDistance),
+stopping as soon as the ask fits.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..structs import allocs_fit
+from ..structs.alloc import Allocation
+from ..structs.resources import RESOURCE_DIMS
+
+
+def basic_resource_distance(need: np.ndarray, have: np.ndarray) -> float:
+    """Euclidean distance between normalized resource vectors
+    (reference preemption.go basicResourceDistance)."""
+    d = 0.0
+    for i in range(RESOURCE_DIMS):
+        if need[i] > 0:
+            d += ((have[i] - need[i]) / need[i]) ** 2
+    return float(np.sqrt(d))
+
+
+def preempt_for_task_group(
+    node,
+    proposed: Sequence[Allocation],
+    ask_vec: np.ndarray,
+    current_priority: int,
+    check_devices: bool = False,
+    ask_devices=(),
+) -> Optional[List[Allocation]]:
+    """Pick a minimal set of lower-priority allocs whose removal lets the
+    ask fit (reference preemption.go:127 PreemptForTaskGroup). Returns
+    None/empty when impossible."""
+    candidates = [
+        a for a in proposed
+        if a.job is not None and a.job.priority < current_priority
+        and a.should_count_for_usage()
+    ]
+    if not candidates:
+        return None
+
+    # group by priority ascending; within a group prefer the alloc whose
+    # resources best match what's still missing (smallest distance to need)
+    candidates.sort(key=lambda a: (a.job.priority,))
+
+    victims: List[Allocation] = []
+    victim_ids = set()
+
+    placement = Allocation(
+        id="_cand", allocated_vec=ask_vec,
+        allocated_devices={d.name: ["?"] * d.count for d in ask_devices}
+        if check_devices else {})
+
+    def fits_now() -> bool:
+        remaining = [a for a in proposed if a.id not in victim_ids]
+        fit, _, _ = allocs_fit(node, remaining + [placement],
+                               check_devices=check_devices)
+        return fit
+
+    if fits_now():
+        return None  # nothing to preempt; caller shouldn't have asked
+
+    # iterate priority groups from lowest
+    i = 0
+    while i < len(candidates):
+        prio = candidates[i].job.priority
+        group = []
+        while i < len(candidates) and candidates[i].job.priority == prio:
+            group.append(candidates[i])
+            i += 1
+        # within the group, repeatedly take the best-matching alloc
+        while group:
+            # distance to the *remaining* need
+            used = np.zeros(RESOURCE_DIMS)
+            for a in proposed:
+                if a.id not in victim_ids and a.should_count_for_usage():
+                    used += a.allocated_vec
+            need = used + ask_vec - node.available_vec()
+            need = np.maximum(need, 0.0)
+            group.sort(key=lambda a: basic_resource_distance(need, a.allocated_vec))
+            pick = group.pop(0)
+            victims.append(pick)
+            victim_ids.add(pick.id)
+            if fits_now():
+                # drop any victim that is no longer necessary (reference
+                # filterSuperset behavior: remove redundant evictions)
+                for v in sorted(victims, key=lambda a: -a.job.priority):
+                    victim_ids.discard(v.id)
+                    if not fits_now():
+                        victim_ids.add(v.id)
+                return [v for v in victims if v.id in victim_ids]
+    return None

@@ -1,0 +1,280 @@
+"""Service/batch reconciler (reference scheduler/reconcile.go, 1,510 LoC).
+
+Computes the desired-vs-actual diff for one job: which allocations to
+place, stop, migrate, destructively update, reschedule now, or reschedule
+later. The placement *node* decisions happen downstream (host greedy path
+or TPU batch solver); the reconciler only decides *what* must change.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..structs import Job, Node, TaskGroup, enums
+from ..structs.alloc import Allocation
+from ..structs.evaluation import Evaluation
+from ..structs.job import ReschedulePolicy
+from ..utils import generate_uuid
+from .util import AllocNameIndex
+
+
+@dataclass
+class PlacementRequest:
+    """One allocation that must be placed (reference reconcile_util.go:27
+    placementResult)."""
+
+    name: str
+    task_group: TaskGroup
+    previous_alloc: Optional[Allocation] = None
+    reschedule: bool = False
+    canary: bool = False
+    ignore_node: str = ""  # node of the failed previous alloc (penalty)
+
+
+@dataclass
+class GroupResult:
+    place: List[PlacementRequest] = field(default_factory=list)
+    stop: List[Tuple[Allocation, str, str]] = field(default_factory=list)  # alloc, desc, client_status
+    destructive_update: List[Allocation] = field(default_factory=list)
+    inplace_update: List[Allocation] = field(default_factory=list)
+    migrate: List[Allocation] = field(default_factory=list)
+    lost: List[Allocation] = field(default_factory=list)
+    ignore: int = 0
+    # failed allocs whose reschedule policy is exhausted/disabled: they
+    # still occupy their slot (the group runs degraded, not crash-looping)
+    failed_no_reschedule: int = 0
+    followup_evals: List[Evaluation] = field(default_factory=list)
+    # rescheduled-later allocs -> their followup eval id
+    delayed_reschedule: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ReconcileResults:
+    groups: Dict[str, GroupResult] = field(default_factory=dict)
+    desired_tg_updates: Dict[str, Dict[str, int]] = field(default_factory=dict)
+
+    def total_places(self) -> int:
+        return sum(len(g.place) + len(g.destructive_update) for g in self.groups.values())
+
+
+# --- reschedule policy (reference reconcile.go:1336 + structs RescheduleTracker) ---
+
+
+def _fib_delay(base: float, attempt: int, max_delay: float) -> float:
+    a, b = base, base
+    for _ in range(max(0, attempt - 1)):
+        a, b = b, min(a + b, max_delay)
+    return min(b if attempt > 0 else base, max_delay)
+
+
+def reschedule_delay(policy: ReschedulePolicy, attempt: int) -> float:
+    if policy.delay_function == "exponential":
+        return min(policy.delay_s * (2 ** attempt), policy.max_delay_s)
+    if policy.delay_function == "fibonacci":
+        return min(_fib_delay(policy.delay_s, attempt, policy.max_delay_s), policy.max_delay_s)
+    return policy.delay_s
+
+
+def should_reschedule(alloc: Allocation, policy: Optional[ReschedulePolicy],
+                      now: float, is_batch: bool) -> Tuple[str, float]:
+    """-> ("now"|"later"|"no", eligible_time). Mirrors reference
+    Allocation.NextRescheduleTime / RescheduleEligible."""
+    if policy is None:
+        policy = ReschedulePolicy() if not is_batch else ReschedulePolicy(
+            attempts=1, interval_s=24 * 3600, unlimited=False)
+    if not policy.unlimited and policy.attempts <= 0:
+        return "no", 0.0
+    events = alloc.reschedule_tracker.events if alloc.reschedule_tracker else []
+    if not policy.unlimited:
+        window_start = now - policy.interval_s
+        attempts_in_window = sum(1 for e in events if e.reschedule_time >= window_start)
+        if attempts_in_window >= policy.attempts:
+            return "no", 0.0
+    attempt = len(events)
+    delay = reschedule_delay(policy, attempt)
+    fail_time = alloc.task_finished_at or alloc.modify_time or now
+    eligible = fail_time + delay
+    if eligible <= now:
+        return "now", eligible
+    return "later", eligible
+
+
+# --- the reconciler ---
+
+
+class AllocReconciler:
+    """Reference scheduler/reconcile.go:60 allocReconciler (core subset:
+    deployments/canaries land with the deployment watcher)."""
+
+    def __init__(self, job: Optional[Job], job_id: str, existing: List[Allocation],
+                 tainted: Dict[str, Node], *, batch: bool = False,
+                 now: Optional[float] = None, eval_id: str = ""):
+        self.job = job
+        self.job_id = job_id
+        self.existing = existing
+        self.tainted = tainted
+        self.batch = batch
+        self.now = now if now is not None else _time.time()
+        self.eval_id = eval_id
+
+    def compute(self) -> ReconcileResults:
+        results = ReconcileResults()
+        stopped = self.job is None or self.job.stopped()
+
+        # bucket allocs by task group (reference allocMatrix)
+        matrix: Dict[str, List[Allocation]] = {}
+        for a in self.existing:
+            matrix.setdefault(a.task_group, []).append(a)
+
+        groups = {tg.name: tg for tg in (self.job.task_groups if self.job else [])}
+
+        # groups that no longer exist in the job: stop everything
+        for tg_name, allocs in matrix.items():
+            if stopped or tg_name not in groups:
+                g = results.groups.setdefault(tg_name, GroupResult())
+                for a in allocs:
+                    if not a.terminal_status():
+                        g.stop.append((a, "alloc not needed due to job update", ""))
+
+        if stopped:
+            return results
+
+        for tg_name, tg in groups.items():
+            g = self._compute_group(tg, matrix.get(tg_name, []))
+            results.groups[tg_name] = g
+            results.desired_tg_updates[tg_name] = {
+                "place": len(g.place),
+                "stop": len(g.stop),
+                "destructive_update": len(g.destructive_update),
+                "in_place_update": len(g.inplace_update),
+                "migrate": len(g.migrate),
+                "ignore": g.ignore,
+            }
+        return results
+
+    def _compute_group(self, tg: TaskGroup, allocs: List[Allocation]) -> GroupResult:
+        g = GroupResult()
+        desired = tg.count
+
+        # partition current allocs (reference reconcile_util.go filterByTainted)
+        live: List[Allocation] = []          # running/pending on healthy nodes
+        batch_done = 0                       # completed batch allocs: work is done
+        for a in allocs:
+            if a.server_terminal():
+                continue  # already being stopped
+            node = self.tainted.get(a.node_id)
+            if node is not None:
+                if node.status == enums.NODE_STATUS_DOWN:
+                    if not a.client_terminal():
+                        g.lost.append(a)
+                    continue
+                if node.drain:
+                    if not a.client_terminal():
+                        g.migrate.append(a)
+                    continue
+            if a.client_status == enums.ALLOC_CLIENT_FAILED:
+                self._handle_failed(tg, a, g)
+                continue
+            if a.client_status == enums.ALLOC_CLIENT_COMPLETE:
+                if self.batch:
+                    # batch allocs that completed are done: they count
+                    # toward desired and are never replaced
+                    g.ignore += 1
+                    batch_done += 1
+                # service: a complete alloc no longer counts toward desired;
+                # replacement is placed below by the count math
+                continue
+            live.append(a)
+
+        # destructive updates: job version changed (reference: in-place vs
+        # destructive via tasksUpdated; spec diffing lands with deployments,
+        # so any version bump is destructive here)
+        if self.job is not None:
+            updated = [a for a in live if a.job_version != self.job.version]
+            if updated:
+                # honor update.max_parallel per pass when configured
+                mp = max(1, tg.update.max_parallel) if tg.update else len(updated)
+                g.destructive_update.extend(updated[:mp])
+                live = [a for a in live if a.id not in
+                        {x.id for x in g.destructive_update}]
+                live.extend(updated[mp:])  # remaining old-version stay for now
+                g.ignore += len(updated[mp:])
+
+        # scale down: too many live + migrating allocs (reference computeStop)
+        keep = live
+        if len(live) + len(g.migrate) > desired:
+            excess = len(live) + len(g.migrate) - desired
+            # stop live allocs first, highest name-index first
+            by_index = sorted(live, key=lambda a: a.index(), reverse=True)
+            stop_live = by_index[:excess]
+            for a in stop_live:
+                g.stop.append((a, "alloc not needed due to job update", ""))
+            keep = by_index[len(stop_live):]
+            excess -= len(stop_live)
+            # still over: cancel migrations (stop without replacement)
+            while excess > 0 and g.migrate:
+                a = g.migrate.pop()
+                g.stop.append((a, "alloc not needed due to job update", ""))
+                excess -= 1
+        g.ignore += len(keep)
+
+        # placements: migrations and lost get replacements with chains
+        name_index = AllocNameIndex(self.job_id, tg.name, desired,
+                                    in_use=[a for a in allocs if not a.terminal_status()])
+
+        for a in g.migrate:
+            g.stop.append((a, "alloc is being migrated", ""))
+            g.place.append(PlacementRequest(
+                name=a.name, task_group=tg, previous_alloc=a))
+        for a in g.lost:
+            # the scheduler marks these lost in the plan; place replacements
+            g.place.append(PlacementRequest(
+                name=a.name, task_group=tg, previous_alloc=a))
+
+        # net new placements to reach desired count
+        have = (len(keep) + len(g.migrate) + len(g.lost)
+                + len(g.destructive_update) + batch_done
+                + g.failed_no_reschedule)
+        missing = max(0, desired - have - self._pending_reschedules(g))
+        for name in name_index.next_batch(missing):
+            g.place.append(PlacementRequest(name=name, task_group=tg))
+        return g
+
+    def _pending_reschedules(self, g: GroupResult) -> int:
+        """Replacements already queued via the failed-alloc path."""
+        return sum(1 for p in g.place if p.reschedule) + len(g.delayed_reschedule)
+
+    def _handle_failed(self, tg: TaskGroup, alloc: Allocation, g: GroupResult) -> None:
+        """Failed alloc: reschedule now, later (follow-up eval), or leave
+        (reference reconcile.go:1277-1398)."""
+        # an alloc that already has a replacement is ignored
+        if alloc.next_allocation:
+            g.ignore += 1
+            return
+        decision, eligible = should_reschedule(
+            alloc, tg.reschedule_policy, self.now, self.batch)
+        if decision == "now":
+            g.place.append(PlacementRequest(
+                name=alloc.name, task_group=tg, previous_alloc=alloc,
+                reschedule=True, ignore_node=alloc.node_id))
+        elif decision == "later":
+            ev = Evaluation(
+                id=generate_uuid(),
+                namespace=alloc.namespace,
+                priority=self.job.priority if self.job else 50,
+                type=self.job.type if self.job else enums.JOB_TYPE_SERVICE,
+                triggered_by=enums.TRIGGER_RETRY_FAILED_ALLOC,
+                job_id=self.job_id,
+                status=enums.EVAL_STATUS_PENDING,
+                wait_until=eligible,
+            )
+            g.followup_evals.append(ev)
+            g.delayed_reschedule[alloc.id] = ev.id
+        else:
+            # "no": reschedule policy exhausted/disabled — the alloc stays
+            # failed and keeps its slot; placing a fresh alloc here would
+            # bypass the policy and crash-loop forever
+            g.failed_no_reschedule += 1
+            g.ignore += 1

@@ -1,0 +1,76 @@
+"""Scheduler interfaces + factory (reference scheduler/scheduler.go:27-151).
+
+`State` is any object with the StateSnapshot query surface; `Planner` is
+how a scheduler submits plans and creates evals without knowing whether
+it runs inside a test harness or a server worker.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Protocol
+
+from ..structs import enums
+from ..structs.evaluation import Evaluation
+from ..structs.plan import Plan, PlanResult
+
+SCHEDULER_VERSION = 1
+
+
+class Planner(Protocol):
+    """Reference scheduler/scheduler.go:126 Planner."""
+
+    def submit_plan(self, plan: Plan) -> tuple:
+        """-> (PlanResult, new_state_or_None). A non-None state means the
+        plan was partially applied and the scheduler should retry against
+        the fresher snapshot (reference worker.go:650 SubmitPlan)."""
+        ...
+
+    def update_eval(self, evaluation: Evaluation) -> None: ...
+
+    def create_eval(self, evaluation: Evaluation) -> None: ...
+
+    def reblock_eval(self, evaluation: Evaluation) -> None: ...
+
+
+class Scheduler(Protocol):
+    """Reference scheduler/scheduler.go:59."""
+
+    def process(self, evaluation: Evaluation) -> None: ...
+
+
+def NewScheduler(sched_type: str, state, planner: Planner, *,
+                 sched_config=None, logger=None, placer=None) -> "Scheduler":
+    """Factory (reference scheduler/scheduler.go:36 NewScheduler)."""
+    factory = BUILTIN_SCHEDULERS.get(sched_type)
+    if factory is None:
+        raise ValueError(f"unknown scheduler type {sched_type!r}")
+    return factory(state, planner, sched_config=sched_config, logger=logger,
+                   placer=placer)
+
+
+def _make_registry():
+    from .generic_sched import GenericScheduler
+    from .system_sched import SystemScheduler
+
+    return {
+        enums.JOB_TYPE_SERVICE: lambda s, p, **kw: GenericScheduler(s, p, batch=False, **kw),
+        enums.JOB_TYPE_BATCH: lambda s, p, **kw: GenericScheduler(s, p, batch=True, **kw),
+        enums.JOB_TYPE_SYSTEM: lambda s, p, **kw: SystemScheduler(s, p, sysbatch=False, **kw),
+        enums.JOB_TYPE_SYSBATCH: lambda s, p, **kw: SystemScheduler(s, p, sysbatch=True, **kw),
+    }
+
+
+class _LazyRegistry(dict):
+    def __missing__(self, key):
+        self.update(_make_registry())
+        if key in self:
+            return self[key]
+        raise KeyError(key)
+
+    def get(self, key, default=None):
+        if not self:
+            self.update(_make_registry())
+        return super().get(key, default)
+
+
+BUILTIN_SCHEDULERS: Dict[str, Callable] = _LazyRegistry()

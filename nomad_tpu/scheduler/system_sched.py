@@ -1,0 +1,165 @@
+"""System + sysbatch scheduler (reference scheduler/scheduler_system.go +
+system_util.go): place one alloc of each task group on every feasible
+node; diff-based, no reconciler.
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..structs import enums
+from ..structs.alloc import Allocation, alloc_name
+from ..structs.evaluation import Evaluation
+from ..utils import generate_uuid
+from .context import EvalContext
+from .rank import NodeScorer, _class_feasible
+from .util import tainted_nodes, update_non_terminal_allocs_to_lost
+
+
+class SystemScheduler:
+    def __init__(self, state, planner, *, sysbatch: bool = False,
+                 sched_config=None, logger=None, placer=None):
+        self.state = state
+        self.planner = planner
+        self.sysbatch = sysbatch
+        self.sched_config = sched_config
+        self.logger = logger
+        self.eval: Optional[Evaluation] = None
+        self.plan = None
+        self.failed_tg_allocs = {}
+        self.queued_allocs = {}
+
+    def process(self, evaluation: Evaluation) -> None:
+        self.eval = evaluation
+        for attempt in range(2):
+            if self._attempt(attempt):
+                return
+        self._set_status(enums.EVAL_STATUS_FAILED, "maximum attempts reached")
+
+    def _attempt(self, attempt: int) -> bool:
+        ev = self.eval
+        self.failed_tg_allocs = {}
+        job = self.state.job_by_id(ev.job_id, ev.namespace)
+        self.plan = ev.make_plan(job)
+        ctx = EvalContext(self.state, self.plan, eval_id=ev.id, logger=self.logger)
+
+        all_allocs = self.state.allocs_by_job(ev.job_id, ev.namespace)
+        tainted = tainted_nodes(self.state, all_allocs)
+        update_non_terminal_allocs_to_lost(self.plan, tainted, all_allocs)
+
+        stopped = job is None or job.stopped()
+        nodes = ([] if stopped else
+                 self.state.ready_nodes_in_pool(job.datacenters, job.node_pool))
+        node_ids = {n.id for n in nodes}
+
+        # existing live allocs keyed by (node, task group)
+        # (reference system_util.go:299 diffSystemAllocs)
+        live: Dict[Tuple[str, str], Allocation] = {}
+        for a in all_allocs:
+            if a.terminal_status():
+                continue
+            live[(a.node_id, a.task_group)] = a
+
+        # stop allocs on nodes that are gone/ineligible or whose group vanished
+        if job is not None:
+            valid_groups = {tg.name for tg in job.task_groups}
+        else:
+            valid_groups = set()
+        for (node_id, tg_name), a in live.items():
+            if node_id in tainted:
+                continue  # handled via lost/migrate path
+            if stopped or node_id not in node_ids or tg_name not in valid_groups:
+                self.plan.append_stopped_alloc(a, "alloc not needed")
+
+        if not stopped:
+            ctx.eligibility.set_job(job)
+            preemption_enabled = (
+                self.sched_config.preemption_enabled_for(job.type)
+                if self.sched_config is not None else True)
+            now = time.time()
+            for tg in job.task_groups:
+                scorer = NodeScorer(ctx, job, tg,
+                                    preemption_enabled=preemption_enabled,
+                                    current_priority=job.priority)
+                for node in nodes:
+                    existing = live.get((node.id, tg.name))
+                    if existing is not None:
+                        if existing.job_version == job.version:
+                            continue  # in place and current
+                        # destructive update
+                        self.plan.append_stopped_alloc(
+                            existing, "alloc is being updated due to job update")
+                    # sysbatch: completed allocs shouldn't rerun
+                    if self.sysbatch:
+                        prior = next(
+                            (a for a in all_allocs
+                             if a.node_id == node.id and a.task_group == tg.name
+                             and a.client_status == enums.ALLOC_CLIENT_COMPLETE
+                             and a.job_version == job.version), None)
+                        if prior is not None:
+                            continue
+                    metrics = ctx.new_metrics()
+                    metrics.nodes_evaluated += 1
+                    if not _class_feasible(ctx, job, tg, node):
+                        self._record_failure(tg.name, ctx)
+                        continue
+                    option = scorer.rank(node)
+                    if option is None:
+                        self._record_failure(tg.name, ctx)
+                        continue
+                    alloc = Allocation(
+                        id=generate_uuid(),
+                        eval_id=ev.id,
+                        name=alloc_name(job.id, tg.name, 0),
+                        namespace=job.namespace,
+                        node_id=node.id,
+                        node_name=node.name,
+                        job_id=job.id,
+                        job=job,
+                        job_version=job.version,
+                        task_group=tg.name,
+                        allocated_vec=tg.combined_resources().vec(),
+                        desired_status=enums.ALLOC_DESIRED_RUN,
+                        client_status=enums.ALLOC_CLIENT_PENDING,
+                        metrics=metrics,
+                        allocated_at=now,
+                    )
+                    if existing is not None:
+                        alloc.previous_allocation = existing.id
+                    if option.preempted_allocs:
+                        for victim in option.preempted_allocs:
+                            self.plan.append_preempted_alloc(victim, alloc.id)
+                    self.plan.append_alloc(alloc)
+                    self.queued_allocs[tg.name] = self.queued_allocs.get(tg.name, 0) + 1
+
+        if self.plan.is_no_op() and not self.failed_tg_allocs:
+            self._set_status(enums.EVAL_STATUS_COMPLETE, "")
+            return True
+
+        result, new_state = self.planner.submit_plan(self.plan)
+        if new_state is not None:
+            self.state = new_state
+            full, _, _ = result.full_commit(self.plan)
+            if not full:
+                return False
+        self._set_status(enums.EVAL_STATUS_COMPLETE, "")
+        return True
+
+    def _record_failure(self, tg_name: str, ctx: EvalContext) -> None:
+        # system jobs don't create blocked evals; they surface failed
+        # placements on the eval (reference scheduler_system.go)
+        prev = self.failed_tg_allocs.get(tg_name)
+        if prev is None:
+            self.failed_tg_allocs[tg_name] = ctx.metrics
+        else:
+            prev.coalesced_failures += 1
+
+    def _set_status(self, status: str, desc: str) -> None:
+        ev = _copy.copy(self.eval)
+        ev.status = status
+        ev.status_description = desc
+        ev.failed_tg_allocs = self.failed_tg_allocs
+        ev.queued_allocations = dict(self.queued_allocs)
+        self.planner.update_eval(ev)

@@ -1,0 +1,79 @@
+"""Scheduler utilities (reference scheduler/util.go)."""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Set
+
+from ..structs import Node, enums
+from ..structs.alloc import Allocation, alloc_name
+
+
+def tainted_nodes(snapshot, allocs: Iterable[Allocation]) -> Dict[str, Node]:
+    """Map of node id -> node for nodes that are draining, down, or
+    disconnected — any alloc on them needs attention
+    (reference scheduler/util.go:130 taintedNodes)."""
+    out: Dict[str, Node] = {}
+    seen = set()
+    for alloc in allocs:
+        if alloc.node_id in seen:
+            continue
+        seen.add(alloc.node_id)
+        node = snapshot.node_by_id(alloc.node_id)
+        if node is None:
+            # node no longer exists: treat as tainted-down via a synthetic row
+            out[alloc.node_id] = Node(id=alloc.node_id, status=enums.NODE_STATUS_DOWN)
+            continue
+        if node.drain or node.status in (enums.NODE_STATUS_DOWN, enums.NODE_STATUS_DISCONNECTED):
+            out[node.id] = node
+        elif node.scheduling_eligibility == enums.NODE_SCHED_INELIGIBLE:
+            # ineligible nodes don't taint running allocs; skip
+            continue
+    return out
+
+
+class AllocNameIndex:
+    """Bitmap of in-use alloc name indexes for a task group, so new
+    placements reuse the lowest free "<job>.<group>[i]" names
+    (reference scheduler/reconcile_util.go:625 allocNameIndex)."""
+
+    def __init__(self, job_id: str, group: str, count: int,
+                 in_use: Iterable[Allocation] = ()):
+        self.job_id = job_id
+        self.group = group
+        self.count = count
+        self.used: Set[int] = set()
+        for a in in_use:
+            idx = a.index()
+            if idx >= 0:
+                self.used.add(idx)
+
+    def next_batch(self, n: int) -> List[str]:
+        """Hand out n names, preferring unused indexes < count, then
+        unused beyond count."""
+        out = []
+        i = 0
+        while len(out) < n:
+            if i not in self.used:
+                self.used.add(i)
+                out.append(alloc_name(self.job_id, self.group, i))
+            i += 1
+        return out
+
+    def release(self, name_index: int) -> None:
+        self.used.discard(name_index)
+
+
+def update_non_terminal_allocs_to_lost(plan, tainted: Dict[str, Node],
+                                       allocs: Iterable[Allocation]) -> None:
+    """Mark non-terminal allocs on down nodes as lost in the plan
+    (reference scheduler/util.go:915 updateNonTerminalAllocsToLost)."""
+    for alloc in allocs:
+        node = tainted.get(alloc.node_id)
+        if node is None:
+            continue
+        if node.status != enums.NODE_STATUS_DOWN:
+            continue
+        if alloc.server_terminal() or alloc.client_terminal():
+            continue
+        plan.append_stopped_alloc(alloc, "alloc lost since node is down",
+                                  client_status=enums.ALLOC_CLIENT_LOST)

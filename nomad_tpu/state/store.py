@@ -456,6 +456,7 @@ class StateStore:
                 merged.client_status = upd.client_status
                 merged.client_description = upd.client_description
                 merged.task_states = upd.task_states or merged.task_states
+                merged.task_finished_at = upd.task_finished_at or merged.task_finished_at
                 merged.deployment_status = upd.deployment_status or merged.deployment_status
                 merged.modify_index = gen
                 merged.modify_time = time.time()

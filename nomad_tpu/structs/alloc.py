@@ -121,6 +121,9 @@ class Allocation:
     preempted_by_allocation: str = ""
     metrics: Optional[AllocMetric] = None
     allocated_at: float = 0.0
+    # when the (last) task finished — drives reschedule eligibility
+    # (reference: TaskStates[].FinishedAt consumed by NextRescheduleTime)
+    task_finished_at: float = 0.0
     modify_time: float = 0.0
     create_index: int = 0
     modify_index: int = 0

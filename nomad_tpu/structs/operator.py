@@ -1,0 +1,42 @@
+"""Cluster-wide runtime scheduler configuration
+(reference nomad/structs/operator.go:199-255 SchedulerConfiguration).
+
+Stored in replicated state and settable at runtime via the operator API;
+`scheduler_algorithm` selects "binpack" | "spread" | "tpu-binpack" — the
+last being this framework's batched JAX backend (the north-star plug
+point, reference rank.go:192-203 SetSchedulerConfiguration).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+from . import enums
+
+
+@dataclass(slots=True)
+class PreemptionConfig:
+    system_scheduler_enabled: bool = True
+    sysbatch_scheduler_enabled: bool = False
+    batch_scheduler_enabled: bool = False
+    service_scheduler_enabled: bool = False
+
+
+@dataclass(slots=True)
+class SchedulerConfiguration:
+    scheduler_algorithm: str = enums.SCHED_ALG_BINPACK
+    preemption_config: PreemptionConfig = field(default_factory=PreemptionConfig)
+    memory_oversubscription_enabled: bool = False
+    reject_job_registration: bool = False
+    pause_eval_broker: bool = False
+    create_index: int = 0
+    modify_index: int = 0
+
+    def preemption_enabled_for(self, sched_type: str) -> bool:
+        return {
+            enums.JOB_TYPE_SERVICE: self.preemption_config.service_scheduler_enabled,
+            enums.JOB_TYPE_BATCH: self.preemption_config.batch_scheduler_enabled,
+            enums.JOB_TYPE_SYSTEM: self.preemption_config.system_scheduler_enabled,
+            enums.JOB_TYPE_SYSBATCH: self.preemption_config.sysbatch_scheduler_enabled,
+        }.get(sched_type, False)

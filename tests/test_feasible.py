@@ -1,0 +1,180 @@
+"""Feasibility checker tests (modeled on reference scheduler/feasible_test.go)."""
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.feasible import (
+    check_constraint,
+    check_version_constraint,
+    constraint_mask,
+    distinct_hosts_mask,
+    distinct_property_mask,
+    driver_mask,
+    feasible_mask,
+    resolve_target,
+)
+from nomad_tpu.structs import Constraint, enums
+
+
+class TestResolveTarget:
+    def test_literal(self):
+        n = mock.node()
+        assert resolve_target("linux", n) == ("linux", True)
+
+    def test_node_fields(self):
+        n = mock.node()
+        assert resolve_target("${node.unique.id}", n) == (n.id, True)
+        assert resolve_target("${node.datacenter}", n) == ("dc1", True)
+        assert resolve_target("${node.unique.name}", n) == (n.name, True)
+        assert resolve_target("${node.class}", n) == ("", True)
+        assert resolve_target("${node.pool}", n) == ("default", True)
+
+    def test_attr_and_meta(self):
+        n = mock.node()
+        n.meta["rack"] = "r1"
+        assert resolve_target("${attr.kernel.name}", n) == ("linux", True)
+        assert resolve_target("${meta.rack}", n) == ("r1", True)
+        val, found = resolve_target("${attr.nope}", n)
+        assert not found
+
+    def test_unknown_interpolation(self):
+        n = mock.node()
+        assert resolve_target("${weird.thing}", n) == ("", False)
+
+
+class TestCheckConstraint:
+    """Pin the 15-operator semantics (reference feasible.go:833)."""
+
+    def test_equality(self):
+        assert check_constraint("=", "a", "a", True, True)
+        assert check_constraint("==", "a", "a", True, True)
+        assert check_constraint("is", "a", "a", True, True)
+        assert not check_constraint("=", "a", "b", True, True)
+        assert not check_constraint("=", "a", "a", False, True)
+
+    def test_inequality_with_missing(self):
+        # reference: nil != nil is false; nil != some is true
+        assert not check_constraint("!=", "", "", False, False)
+        assert check_constraint("!=", "", "b", False, True)
+        assert check_constraint("!=", "a", "", True, False)
+        assert check_constraint("!=", "a", "b", True, True)
+        assert not check_constraint("!=", "a", "a", True, True)
+
+    def test_order_integral_vs_lexical(self):
+        # integers compare numerically: "9" < "10"
+        assert check_constraint("<", "9", "10", True, True)
+        # non-numeric falls back to lexical: "9" > "10" lexically
+        assert check_constraint(">", "9a", "10a", True, True)
+        # float comparison
+        assert check_constraint(">=", "1.5", "1.25", True, True)
+
+    def test_is_set(self):
+        assert check_constraint("is_set", "x", "", True, False)
+        assert not check_constraint("is_set", "", "", False, False)
+        assert check_constraint("is_not_set", "", "", False, False)
+
+    def test_regexp(self):
+        cache = {}
+        assert check_constraint("regexp", "linux-4.15", r"^linux", True, True, regex_cache=cache)
+        assert not check_constraint("regexp", "darwin", r"^linux", True, True, regex_cache=cache)
+        # invalid regex is simply false
+        assert not check_constraint("regexp", "x", r"(", True, True, regex_cache=cache)
+
+    def test_set_contains(self):
+        assert check_constraint("set_contains", "a,b , c", "a,c", True, True)
+        assert not check_constraint("set_contains", "a,b", "a,d", True, True)
+        assert check_constraint("set_contains_any", "a,b", "d,b", True, True)
+        assert not check_constraint("set_contains_any", "a,b", "d,e", True, True)
+
+    def test_version(self):
+        assert check_constraint("version", "1.2.3", ">= 1.0, < 2.0", True, True)
+        assert not check_constraint("version", "2.1.0", ">= 1.0, < 2.0", True, True)
+        assert check_constraint("version", "4.15", "> 3.2", True, True)
+
+    def test_distinct_passthrough(self):
+        # distinct_hosts/property always pass through the generic checker
+        assert check_constraint("distinct_hosts", "", "", False, False)
+
+
+class TestVersionConstraint:
+    def test_pessimistic(self):
+        assert check_version_constraint("1.2.5", "~> 1.2.3")
+        assert not check_version_constraint("1.3.0", "~> 1.2.3")
+        assert check_version_constraint("1.2.3", "~> 1.2")
+
+    def test_prerelease_ordering(self):
+        assert check_version_constraint("1.2.3", "> 1.2.3-beta1")
+        assert not check_version_constraint("1.2.3-alpha", ">= 1.2.3")
+
+    def test_bad_version(self):
+        assert not check_version_constraint("not-a-version", ">= 1.0")
+        assert not check_version_constraint("1.0", "garbage >=")
+
+    def test_cache_hit(self):
+        cache = {}
+        assert check_version_constraint("1.5.0", ">= 1.0", cache)
+        assert check_version_constraint("0.5.0", ">= 1.0", cache) is False
+        assert ">= 1.0" in cache
+
+
+class TestMasks:
+    def test_constraint_mask_memoizes_by_value(self):
+        nodes = [mock.node() for _ in range(50)]
+        c = Constraint(ltarget="${attr.kernel.name}", rtarget="linux", operand="=")
+        mask = constraint_mask(c, nodes)
+        assert mask.all()
+        nodes[3].attributes["kernel.name"] = "darwin"
+        mask = constraint_mask(c, nodes)
+        assert not mask[3] and mask.sum() == 49
+
+    def test_driver_mask(self):
+        j = mock.job()
+        nodes = [mock.node(), mock.node()]
+        nodes[1].drivers = {}
+        nodes[1].attributes = {k: v for k, v in nodes[1].attributes.items()
+                               if not k.startswith("driver.")}
+        mask = driver_mask(j.task_groups[0], nodes)
+        assert mask.tolist() == [True, False]
+
+    def test_feasible_mask_full(self):
+        j = mock.job()
+        good = mock.node()
+        bad_kernel = mock.node()
+        bad_kernel.attributes["kernel.name"] = "windows"
+        mask = feasible_mask(j, j.task_groups[0], [good, bad_kernel])
+        assert mask.tolist() == [True, False]
+
+    def test_distinct_hosts(self):
+        j = mock.job()
+        j.constraints.append(Constraint(operand="distinct_hosts"))
+        n1, n2 = mock.node(), mock.node()
+        a = mock.alloc(j, n1, 0)
+
+        def proposed(node_id):
+            return [a] if node_id == n1.id else []
+
+        mask = distinct_hosts_mask(j, j.task_groups[0], [n1, n2], proposed)
+        assert mask.tolist() == [False, True]
+
+    def test_distinct_property(self):
+        j = mock.job()
+        j.constraints.append(
+            Constraint(ltarget="${meta.rack}", operand="distinct_property", rtarget="1"))
+        n1, n2 = mock.node(), mock.node()
+        n1.meta["rack"] = "r1"
+        n2.meta["rack"] = "r2"
+        a = mock.alloc(j, n1, 0)
+        nodes = {n1.id: n1, n2.id: n2}
+        mask = distinct_property_mask(j, j.task_groups[0], [n1, n2], [a], nodes.get)
+        assert mask.tolist() == [False, True]
+
+    def test_device_mask(self):
+        from nomad_tpu.structs.resources import RequestedDevice
+
+        j = mock.job()
+        tg = j.task_groups[0]
+        tg.tasks[0].resources.devices = [RequestedDevice(name="nvidia/gpu", count=2)]
+        plain, gpu = mock.node(), mock.gpu_node()
+        mask = feasible_mask(j, tg, [plain, gpu])
+        assert mask.tolist() == [False, True]

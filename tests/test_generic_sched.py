@@ -1,0 +1,343 @@
+"""Service/batch scheduler tests (modeled on reference generic_sched_test.go)."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.testing import Harness
+from nomad_tpu.structs import enums
+from nomad_tpu.structs.operator import SchedulerConfiguration
+
+
+@pytest.fixture
+def h():
+    return Harness()
+
+
+def register(h, n_nodes=10, job=None):
+    nodes = [mock.node() for _ in range(n_nodes)]
+    for n in nodes:
+        h.store.upsert_node(n)
+    if job is None:
+        job = mock.job()
+    h.store.upsert_job(job)
+    ev = mock.eval_for(job)
+    h.store.upsert_evals([ev])
+    return nodes, job, ev
+
+
+class TestServiceScheduling:
+    def test_basic_placement(self, h):
+        nodes, job, ev = register(h)
+        h.process(ev)
+        h.assert_eval_status(enums.EVAL_STATUS_COMPLETE)
+        allocs = h.snapshot().allocs_by_job(job.id)
+        assert len(allocs) == 10
+        names = sorted(a.name for a in allocs)
+        assert names[0] == f"{job.id}.web[0]"
+        # all placements fit: no node oversubscribed
+        for n in nodes:
+            from nomad_tpu.structs import allocs_fit
+
+            fit, dim, _ = allocs_fit(n, h.snapshot().allocs_by_node(n.id))
+            assert fit, dim
+
+    def test_no_nodes_blocks(self, h):
+        _, job, ev = register(h, n_nodes=0)
+        h.process(ev)
+        last = h.assert_eval_status(enums.EVAL_STATUS_COMPLETE)
+        assert "web" in last.failed_tg_allocs
+        # a blocked eval was created for the unplaced allocs
+        assert h.created_evals
+        assert h.created_evals[-1].status == enums.EVAL_STATUS_BLOCKED
+
+    def test_infeasible_constraint_blocks(self, h):
+        job = mock.job()
+        from nomad_tpu.structs import Constraint
+
+        job.constraints.append(
+            Constraint(ltarget="${attr.kernel.name}", rtarget="windows", operand="="))
+        _, job, ev = register(h, job=job)
+        h.process(ev)
+        last = h.assert_eval_status(enums.EVAL_STATUS_COMPLETE)
+        assert last.failed_tg_allocs["web"].nodes_filtered > 0
+
+    def test_scale_down_stops_highest_indexes(self, h):
+        nodes, job, ev = register(h)
+        h.process(ev)
+        job2 = mock.job(id=job.id)
+        job2.task_groups[0].count = 4
+        h.store.upsert_job(job2)
+        # avoid destructive-update path interfering: same version semantics
+        for a in h.snapshot().allocs_by_job(job.id):
+            a.job_version = job2.version
+        ev2 = mock.eval_for(job2)
+        h.process(ev2)
+        live = [a for a in h.snapshot().allocs_by_job(job.id)
+                if not a.terminal_status()]
+        assert len(live) == 4
+        assert {a.index() for a in live} == {0, 1, 2, 3}
+
+    def test_stop_job_stops_all(self, h):
+        nodes, job, ev = register(h)
+        h.process(ev)
+        h.store.delete_job(job.id, purge=False)
+        ev2 = mock.eval_for(job, triggered_by=enums.TRIGGER_JOB_DEREGISTER)
+        h.process(ev2)
+        live = [a for a in h.snapshot().allocs_by_job(job.id)
+                if not a.server_terminal()]
+        assert live == []
+
+    def test_binpack_prefers_fewer_nodes(self, h):
+        job = mock.job()
+        job.task_groups[0].count = 4
+        nodes, job, ev = register(h, n_nodes=8, job=job)
+        h.process(ev)
+        used_nodes = {a.node_id for a in h.snapshot().allocs_by_job(job.id)}
+        # binpack should consolidate rather than use all 8 nodes
+        assert len(used_nodes) < 8
+
+    def test_failed_alloc_reschedules_now(self, h):
+        import time
+
+        nodes, job, ev = register(h, n_nodes=5)
+        h.process(ev)
+        victim = h.snapshot().allocs_by_job(job.id)[0]
+        upd = victim.copy_for_update()
+        upd.client_status = enums.ALLOC_CLIENT_FAILED
+        upd.task_finished_at = time.time() - 3600  # failed long ago -> delay elapsed
+        h.store.update_allocs_from_client([upd])
+        # mock job reschedule policy: constant 5s delay, 2 attempts
+        ev2 = mock.eval_for(job, triggered_by=enums.TRIGGER_RETRY_FAILED_ALLOC)
+        h.process(ev2)
+        allocs = h.snapshot().allocs_by_job(job.id)
+        replacement = [a for a in allocs if a.previous_allocation == victim.id]
+        assert len(replacement) == 1
+        assert replacement[0].reschedule_tracker is not None
+        assert h.snapshot().alloc_by_id(victim.id).next_allocation == replacement[0].id
+        # penalty: replacement avoids the failed node when alternatives exist
+        assert replacement[0].node_id != victim.node_id
+
+    def test_node_down_reschedules_as_lost(self, h):
+        nodes, job, ev = register(h, n_nodes=3)
+        h.process(ev)
+        by_node = {n.id: n for n in nodes}
+        down = by_node[h.snapshot().allocs_by_job(job.id)[0].node_id]
+        on_down = [a for a in h.snapshot().allocs_by_job(job.id)
+                   if a.node_id == down.id]
+        assert on_down, "expected allocs on the down node"
+        h.store.update_node_status(down.id, enums.NODE_STATUS_DOWN)
+        ev2 = mock.eval_for(job, triggered_by=enums.TRIGGER_NODE_UPDATE)
+        h.process(ev2)
+        snap = h.snapshot()
+        for a in on_down:
+            got = snap.alloc_by_id(a.id)
+            assert got.desired_status == enums.ALLOC_DESIRED_STOP
+            assert got.client_status == enums.ALLOC_CLIENT_LOST
+        live = [a for a in snap.allocs_by_job(job.id) if not a.terminal_status()]
+        assert len(live) == 10
+        assert all(a.node_id != down.id for a in live)
+
+    def test_drain_migrates(self, h):
+        nodes, job, ev = register(h, n_nodes=3)
+        h.process(ev)
+        from nomad_tpu.structs import DrainStrategy
+
+        by_node = {n.id: n for n in nodes}
+        drained = by_node[h.snapshot().allocs_by_job(job.id)[0].node_id]
+        on_drained = [a for a in h.snapshot().allocs_by_job(job.id)
+                      if a.node_id == drained.id]
+        assert on_drained
+        h.store.update_node_drain(drained.id, DrainStrategy(deadline_s=600))
+        ev2 = mock.eval_for(job, triggered_by=enums.TRIGGER_NODE_DRAIN)
+        h.process(ev2)
+        snap = h.snapshot()
+        live = [a for a in snap.allocs_by_job(job.id) if not a.terminal_status()]
+        assert len(live) == 10
+        assert all(a.node_id != drained.id for a in live)
+        for a in on_drained:
+            assert snap.alloc_by_id(a.id).desired_status == enums.ALLOC_DESIRED_STOP
+
+    def test_destructive_update_replaces(self, h):
+        nodes, job, ev = register(h, n_nodes=5)
+        h.process(ev)
+        v0_allocs = {a.id for a in h.snapshot().allocs_by_job(job.id)}
+        job2 = mock.job(id=job.id)
+        job2.task_groups[0].count = 10
+        job2.task_groups[0].tasks[0].resources.cpu = 600
+        job2.task_groups[0].update = None  # no rolling limit: replace all
+        h.store.upsert_job(job2)
+        stored = h.snapshot().job_by_id(job.id)
+        ev2 = mock.eval_for(stored)
+        h.process(ev2)
+        snap = h.snapshot()
+        live = [a for a in snap.allocs_by_job(job.id) if not a.terminal_status()]
+        assert len(live) == 10
+        assert all(a.job_version == stored.version for a in live)
+        assert all(a.id not in v0_allocs for a in live)
+
+    def test_partial_commit_retries(self, h):
+        nodes, job, ev = register(h)
+        h.reject_plan = True
+        h.reject_once = True
+        h.process(ev)
+        h.assert_eval_status(enums.EVAL_STATUS_COMPLETE)
+        # two plans: rejected + retried
+        assert len(h.plans) == 2
+        assert len(h.snapshot().allocs_by_job(job.id)) == 10
+
+    def test_always_rejected_fails_with_blocked(self, h):
+        nodes, job, ev = register(h)
+        h.reject_plan = True
+        h.process(ev)
+        h.assert_eval_status(enums.EVAL_STATUS_FAILED)
+        assert len(h.plans) == 5  # MAX_SERVICE_ATTEMPTS
+        assert h.created_evals and h.created_evals[-1].status == enums.EVAL_STATUS_BLOCKED
+
+
+class TestBatchScheduling:
+    def test_complete_allocs_not_replaced(self, h):
+        job = mock.batch_job()
+        nodes, job, ev = register(h, n_nodes=5, job=job)
+        h.process(ev)
+        allocs = h.snapshot().allocs_by_job(job.id)
+        assert len(allocs) == 10
+        # complete them all
+        upds = []
+        for a in allocs:
+            u = a.copy_for_update()
+            u.client_status = enums.ALLOC_CLIENT_COMPLETE
+            upds.append(u)
+        h.store.update_allocs_from_client(upds)
+        ev2 = mock.eval_for(job)
+        h.process(ev2)
+        after = h.snapshot().allocs_by_job(job.id)
+        assert len(after) == 10  # nothing new placed
+
+    def test_batch_uses_two_candidates(self, h):
+        job = mock.batch_job()
+        nodes, job, ev = register(h, n_nodes=50, job=job)
+        h.process(ev)
+        last = h.assert_eval_status(enums.EVAL_STATUS_COMPLETE)
+        assert len(h.snapshot().allocs_by_job(job.id)) == 10
+
+
+class TestSystemScheduling:
+    def test_place_on_every_node(self, h):
+        job = mock.system_job()
+        nodes, job, ev = register(h, n_nodes=7, job=job)
+        h.process(ev)
+        h.assert_eval_status(enums.EVAL_STATUS_COMPLETE)
+        allocs = h.snapshot().allocs_by_job(job.id)
+        assert len(allocs) == 7
+        assert {a.node_id for a in allocs} == {n.id for n in nodes}
+
+    def test_new_node_gets_system_alloc(self, h):
+        job = mock.system_job()
+        nodes, job, ev = register(h, n_nodes=3, job=job)
+        h.process(ev)
+        newn = mock.node()
+        h.store.upsert_node(newn)
+        ev2 = mock.eval_for(job, triggered_by=enums.TRIGGER_NODE_UPDATE)
+        h.process(ev2)
+        allocs = [a for a in h.snapshot().allocs_by_job(job.id)
+                  if not a.terminal_status()]
+        assert len(allocs) == 4
+        assert newn.id in {a.node_id for a in allocs}
+
+    def test_sysbatch_does_not_rerun_complete(self, h):
+        job = mock.sysbatch_job()
+        nodes, job, ev = register(h, n_nodes=3, job=job)
+        h.process(ev)
+        allocs = h.snapshot().allocs_by_job(job.id)
+        upds = []
+        for a in allocs:
+            u = a.copy_for_update()
+            u.client_status = enums.ALLOC_CLIENT_COMPLETE
+            upds.append(u)
+        h.store.update_allocs_from_client(upds)
+        ev2 = mock.eval_for(job)
+        h.process(ev2)
+        after = [a for a in h.snapshot().allocs_by_job(job.id)
+                 if not a.terminal_status() or a.client_terminal()]
+        assert len(h.snapshot().allocs_by_job(job.id)) == 3
+
+
+class TestPreemption:
+    def test_preempts_lower_priority(self, h):
+        cfg = SchedulerConfiguration()
+        cfg.preemption_config.service_scheduler_enabled = True
+        # one small node fully occupied by a low-priority job
+        node = mock.node()
+        h.store.upsert_node(node)
+        low = mock.job(priority=10)
+        low.task_groups[0].count = 1
+        low.task_groups[0].tasks[0].resources.cpu = 3200
+        low.task_groups[0].tasks[0].resources.memory_mb = 6000
+        h.store.upsert_job(low)
+        ev1 = mock.eval_for(low)
+        h.process(ev1, sched_config=cfg)
+        assert len(h.snapshot().allocs_by_job(low.id)) == 1
+
+        high = mock.job(priority=90)
+        high.task_groups[0].count = 1
+        high.task_groups[0].tasks[0].resources.cpu = 3000
+        high.task_groups[0].tasks[0].resources.memory_mb = 6000
+        h.store.upsert_job(high)
+        ev2 = mock.eval_for(high)
+        h.process(ev2, sched_config=cfg)
+        h.assert_eval_status(enums.EVAL_STATUS_COMPLETE)
+        snap = h.snapshot()
+        assert len([a for a in snap.allocs_by_job(high.id)
+                    if not a.terminal_status()]) == 1
+        victim = snap.allocs_by_job(low.id)[0]
+        assert victim.desired_status == enums.ALLOC_DESIRED_EVICT
+        assert victim.preempted_by_allocation
+
+
+class TestReviewRegressions:
+    def test_exhausted_reschedule_policy_does_not_crash_loop(self, h):
+        from nomad_tpu.structs.job import ReschedulePolicy
+
+        job = mock.job()
+        job.task_groups[0].count = 2
+        job.task_groups[0].reschedule_policy = ReschedulePolicy(
+            attempts=0, unlimited=False)  # rescheduling disabled
+        nodes, job, ev = register(h, n_nodes=3, job=job)
+        h.process(ev)
+        victim = h.snapshot().allocs_by_job(job.id)[0]
+        upd = victim.copy_for_update()
+        upd.client_status = enums.ALLOC_CLIENT_FAILED
+        h.store.update_allocs_from_client([upd])
+        h.process(mock.eval_for(job, triggered_by=enums.TRIGGER_RETRY_FAILED_ALLOC))
+        allocs = h.snapshot().allocs_by_job(job.id)
+        # no fresh replacement placed: the failed alloc keeps its slot
+        assert len(allocs) == 2
+
+    def test_scale_down_during_migration(self, h):
+        from nomad_tpu.structs import DrainStrategy
+
+        job = mock.job()
+        job.task_groups[0].count = 2
+        nodes, job, ev = register(h, n_nodes=2, job=job)
+        h.process(ev)
+        # drain every node carrying allocs, then scale to 1
+        for nid in {a.node_id for a in h.snapshot().allocs_by_job(job.id)}:
+            h.store.update_node_drain(nid, DrainStrategy(deadline_s=600))
+        fresh = mock.node()
+        h.store.upsert_node(fresh)
+        job2 = mock.job(id=job.id)
+        job2.task_groups[0].count = 1
+        h.store.upsert_job(job2)
+        h.process(mock.eval_for(h.snapshot().job_by_id(job.id),
+                                triggered_by=enums.TRIGGER_NODE_DRAIN))
+        live = [a for a in h.snapshot().allocs_by_job(job.id)
+                if not a.terminal_status()]
+        assert len(live) <= 1
+
+    def test_version_pessimistic_two_segments(self):
+        from nomad_tpu.scheduler.feasible import check_version_constraint
+
+        assert check_version_constraint("1.4.0", "~> 1.2")
+        assert not check_version_constraint("2.0.0", "~> 1.2")
+        assert not check_version_constraint("1.4.0", "~> 1.2.3")
+        assert check_version_constraint("1.5", "~> 1")
