@@ -382,17 +382,24 @@ def distinct_property_constraints(job: Job, tg: TaskGroup) -> List[Constraint]:
     ]
 
 
-def distinct_hosts_mask(job: Job, tg: TaskGroup, nodes: Sequence[Node],
-                        proposed_by_node) -> np.ndarray:
-    """Mask out nodes already carrying an alloc of this job (job-level) or
-    this task group (group-level) (reference feasible.go:542
-    DistinctHostsIterator)."""
+def distinct_hosts_flags(job: Job, tg: TaskGroup) -> Tuple[bool, bool]:
+    """(job_level, tg_level) distinct_hosts enablement — the single source
+    of truth shared by the host iterator and the tensor lowering."""
     job_level = any(
         c.operand == enums.CONSTRAINT_DISTINCT_HOSTS and _truthy(c.rtarget)
         for c in job.constraints)
     tg_level = any(
         c.operand == enums.CONSTRAINT_DISTINCT_HOSTS and _truthy(c.rtarget)
         for c in tg.constraints)
+    return job_level, tg_level
+
+
+def distinct_hosts_mask(job: Job, tg: TaskGroup, nodes: Sequence[Node],
+                        proposed_by_node) -> np.ndarray:
+    """Mask out nodes already carrying an alloc of this job (job-level) or
+    this task group (group-level) (reference feasible.go:542
+    DistinctHostsIterator)."""
+    job_level, tg_level = distinct_hosts_flags(job, tg)
     if not job_level and not tg_level:
         return np.ones(len(nodes), dtype=bool)
     out = np.ones(len(nodes), dtype=bool)

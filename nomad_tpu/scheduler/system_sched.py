@@ -18,6 +18,16 @@ from .rank import NodeScorer, _class_feasible
 from .util import tainted_nodes, update_non_terminal_allocs_to_lost
 
 
+def _node_in_pool(node, job) -> bool:
+    """Whether a node is in the job's datacenter/pool universe (the
+    readiness-independent half of readyNodesInDCsAndPool,
+    reference scheduler/util.go:50)."""
+    dcs = set(job.datacenters)
+    if "*" not in dcs and node.datacenter not in dcs:
+        return False
+    return job.node_pool == enums.NODE_POOL_ALL or node.node_pool == job.node_pool
+
+
 class SystemScheduler:
     def __init__(self, state, planner, *, sysbatch: bool = False,
                  sched_config=None, logger=None, placer=None):
@@ -70,8 +80,20 @@ class SystemScheduler:
         for (node_id, tg_name), a in live.items():
             if node_id in tainted:
                 continue  # handled via lost/migrate path
-            if stopped or node_id not in node_ids or tg_name not in valid_groups:
+            if stopped or tg_name not in valid_groups:
                 self.plan.append_stopped_alloc(a, "alloc not needed")
+                continue
+            if node_id in node_ids:
+                continue
+            node = self.state.node_by_id(node_id)
+            if node is not None and _node_in_pool(node, job):
+                # node exists in the job's DC/pool but is not ready (e.g.
+                # marked scheduling-ineligible pre-maintenance):
+                # ineligibility only blocks new placements, running allocs
+                # stay (reference system_util.go:200 ignores allocs on
+                # notReadyNodes instead of stopping them)
+                continue
+            self.plan.append_stopped_alloc(a, "alloc not needed")
 
         if not stopped:
             ctx.eligibility.set_job(job)

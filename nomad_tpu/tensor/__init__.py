@@ -1,0 +1,18 @@
+"""Tensor layer: snapshot -> dense tensors -> JAX placement kernels.
+
+The TPU-native core of the framework (SURVEY.md §7 stages 2-4). The host
+scheduler path evaluates one (eval x node) at a time through an iterator
+chain (reference scheduler/stack.go); this layer lowers a whole batch of
+placements x all nodes to dense arrays and solves placement as one fused,
+jittable program:
+
+- cluster.py  — tensorization: nodes/usage/constraints/spreads -> arrays
+- kernels.py  — the jitted score + sequential-argmax assignment kernels
+- placer.py   — TPUPlacer: the Placer implementation behind
+                SchedulerAlgorithm="tpu-binpack"
+- sharding.py — multi-chip mesh layouts for the node axis
+"""
+
+from .placer import TPUPlacer
+
+__all__ = ["TPUPlacer"]

@@ -1,0 +1,246 @@
+"""Tensorization: snapshot + in-progress plan -> dense arrays.
+
+The piece with no reference analog (SURVEY.md §7 stage 2): lowers the
+object-graph view the host scheduler walks (nodes, proposed allocs,
+constraints, spreads) into the padded arrays kernels.py consumes.
+
+Constraint semantics stay host-side — regex/version/semver operators are
+evaluated once per *unique attribute value* by the vectorized masks in
+scheduler.feasible (the tensor-era form of the reference's computed-node-
+class memoization, context.go:261) — and only the resulting boolean masks
+and interned value-id tables ship to the device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..structs import Job, Node, TaskGroup, enums
+from ..structs.resources import RESOURCE_DIMS
+from ..scheduler.context import EvalContext
+from ..scheduler.feasible import (
+    check_constraint,
+    distinct_hosts_flags,
+    feasible_mask,
+    resolve_target,
+)
+from ..scheduler.spread import IMPLICIT_TARGET, combined_spreads
+
+
+def _pad_pow2(n: int, floor: int = 8) -> int:
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+@dataclass
+class ClusterTensors:
+    """Per-(eval, node-list) arrays shared by every task group's solve."""
+
+    nodes: List[Node]
+    n_pad: int
+    available: np.ndarray          # (Np, D)
+    used: np.ndarray               # (Np, D) proposed usage
+    node_index: Dict[str, int]
+
+    @classmethod
+    def build(cls, ctx: EvalContext, nodes: Sequence[Node]) -> "ClusterTensors":
+        n = len(nodes)
+        n_pad = _pad_pow2(n)
+        available = np.zeros((n_pad, RESOURCE_DIMS))
+        used = np.zeros((n_pad, RESOURCE_DIMS))
+        index: Dict[str, int] = {}
+        for i, node in enumerate(nodes):
+            available[i] = node.available_vec()
+            index[node.id] = i
+        # padding rows have zero capacity and are masked infeasible anyway
+        t = cls(nodes=list(nodes), n_pad=n_pad, available=available,
+                used=used, node_index=index)
+        t.refresh_usage(ctx)
+        return t
+
+    def refresh_usage(self, ctx: EvalContext) -> None:
+        """Recompute proposed usage (state - evictions + placements) from
+        the context. Called between task groups so group B sees group A's
+        in-plan placements (reference context.go:176 ProposedAllocs)."""
+        self.used[:] = 0.0
+        self._proposed_cache: Dict[int, list] = {}
+        for i, node in enumerate(self.nodes):
+            allocs = ctx.proposed_allocs(node.id)
+            self._proposed_cache[i] = allocs
+            for a in allocs:
+                if a.should_count_for_usage():
+                    self.used[i] += a.allocated_vec
+
+    def placement_counts(self, job: Job, tg: TaskGroup) -> Tuple[np.ndarray, np.ndarray]:
+        """(placed_tg, placed_job) int32 vectors counting this job's
+        proposed allocs per node (anti-affinity + distinct_hosts inputs)."""
+        ptg = np.zeros(self.n_pad, dtype=np.int32)
+        pjob = np.zeros(self.n_pad, dtype=np.int32)
+        for i in range(len(self.nodes)):
+            for a in self._proposed_cache.get(i, ()):
+                if a.job_id != job.id or a.namespace != job.namespace:
+                    continue
+                pjob[i] += 1
+                if a.task_group == tg.name:
+                    ptg[i] += 1
+        return ptg, pjob
+
+
+@dataclass
+class TaskGroupTensors:
+    """Everything kernels.solve_task_group needs for one task group."""
+
+    ask: np.ndarray                 # (D,)
+    feasible: np.ndarray            # (Np,) bool
+    affinity_boost: np.ndarray      # (Np,)
+    placed_tg: np.ndarray           # (Np,) int32
+    placed_job: np.ndarray          # (Np,) int32
+    spread_val_id: np.ndarray       # (S, Np) int32
+    spread_val_ok: np.ndarray       # (S, Np) bool
+    spread_counts: np.ndarray       # (S, V) int32
+    spread_desired: np.ndarray      # (S, V) float (NaN = no target)
+    spread_has_targets: np.ndarray  # (S,) bool
+    spread_weight: np.ndarray       # (S,)
+    tg_count: float
+    dh_job: bool
+    dh_tg: bool
+    spread_alg: bool
+
+
+def _affinity_vector(ctx: EvalContext, job: Job, tg: TaskGroup,
+                     nodes: Sequence[Node], n_pad: int) -> np.ndarray:
+    """Precompute the node-affinity boost per node
+    (reference rank.go:710 NodeAffinityIterator, sum(weight)/sum|weight|)."""
+    affinities = (list(job.affinities) + list(tg.affinities)
+                  + [a for t in tg.tasks for a in t.affinities])
+    out = np.zeros(n_pad)
+    if not affinities:
+        return out
+    total_weight = sum(abs(a.weight) for a in affinities) or 1.0
+    for i, node in enumerate(nodes):
+        total = 0.0
+        for aff in affinities:
+            lval, lok = resolve_target(aff.ltarget, node)
+            rval, rok = resolve_target(aff.rtarget, node)
+            if check_constraint(aff.operand, lval, rval, lok, rok,
+                                ctx.regex_cache, ctx.version_cache):
+                total += aff.weight
+        out[i] = total / total_weight
+    return out
+
+
+def _spread_tensors(ctx: EvalContext, job: Job, tg: TaskGroup,
+                    nodes: Sequence[Node], n_pad: int):
+    """Intern spread-attribute values and lower desired/existing counts
+    (reference spread.go computeSpreadInfo + propertyset.go)."""
+    spreads = combined_spreads(job, tg)
+    s = len(spreads)
+    if s == 0:
+        z = np.zeros((0, n_pad), dtype=np.int32)
+        return (z, np.zeros((0, n_pad), dtype=bool),
+                np.zeros((0, 1), dtype=np.int32), np.full((0, 1), np.nan),
+                np.zeros(0, dtype=bool), np.zeros(0))
+
+    sum_weights = sum(abs(sp.weight) for sp in spreads) or 1.0
+    existing = [a for a in ctx.snapshot.allocs_by_job(job.id, job.namespace)
+                if not a.terminal_status() and a.task_group == tg.name]
+
+    vocabs: List[Dict[str, int]] = []
+    val_ids = np.zeros((s, n_pad), dtype=np.int32)
+    val_ok = np.zeros((s, n_pad), dtype=bool)
+    counts_list: List[Dict[int, int]] = []
+
+    for si, sp in enumerate(spreads):
+        vocab: Dict[str, int] = {}
+
+        def intern(v: str) -> int:
+            if v not in vocab:
+                vocab[v] = len(vocab)
+            return vocab[v]
+
+        for i, node in enumerate(nodes):
+            v, ok = resolve_target(sp.attribute, node)
+            if ok:
+                val_ids[si, i] = intern(v)
+                val_ok[si, i] = True
+        counts: Dict[int, int] = {}
+        for a in existing:
+            anode = ctx.snapshot.node_by_id(a.node_id)
+            if anode is None:
+                continue
+            v, ok = resolve_target(sp.attribute, anode)
+            if ok:
+                vid = intern(v)
+                counts[vid] = counts.get(vid, 0) + 1
+        vocabs.append(vocab)
+        counts_list.append(counts)
+
+    v_pad = _pad_pow2(max(max(len(v) for v in vocabs), 1), floor=1)
+    spread_counts = np.zeros((s, v_pad), dtype=np.int32)
+    spread_desired = np.full((s, v_pad), np.nan)
+    has_targets = np.zeros(s, dtype=bool)
+    weights = np.zeros(s)
+
+    for si, sp in enumerate(spreads):
+        weights[si] = sp.weight / sum_weights
+        for vid, c in counts_list[si].items():
+            spread_counts[si, vid] = c
+        if not sp.targets:
+            continue
+        has_targets[si] = True
+        desired: Dict[str, float] = {}
+        total = 0.0
+        for st in sp.targets:
+            want = (st.percent / 100.0) * tg.count
+            desired[st.value] = want
+            total += want
+        implicit = (tg.count - total) if 0 < total < tg.count else None
+        for val, vid in vocabs[si].items():
+            if val in desired:
+                spread_desired[si, vid] = desired[val]
+            elif implicit is not None:
+                spread_desired[si, vid] = implicit
+    return val_ids, val_ok, spread_counts, spread_desired, has_targets, weights
+
+
+def build_task_group_tensors(
+    ctx: EvalContext,
+    job: Job,
+    tg: TaskGroup,
+    cluster: ClusterTensors,
+    *,
+    algorithm: str = enums.SCHED_ALG_BINPACK,
+) -> TaskGroupTensors:
+    nodes = cluster.nodes
+    n_pad = cluster.n_pad
+
+    feas = np.zeros(n_pad, dtype=bool)
+    feas[: len(nodes)] = feasible_mask(job, tg, nodes,
+                                       ctx.regex_cache, ctx.version_cache)
+    placed_tg, placed_job = cluster.placement_counts(job, tg)
+    (val_id, val_ok, counts, desired,
+     has_targets, weights) = _spread_tensors(ctx, job, tg, nodes, n_pad)
+    dh_job, dh_tg = distinct_hosts_flags(job, tg)
+
+    return TaskGroupTensors(
+        ask=tg.combined_resources().vec(),
+        feasible=feas,
+        affinity_boost=_affinity_vector(ctx, job, tg, nodes, n_pad),
+        placed_tg=placed_tg,
+        placed_job=placed_job,
+        spread_val_id=val_id,
+        spread_val_ok=val_ok,
+        spread_counts=counts,
+        spread_desired=desired,
+        spread_has_targets=has_targets,
+        spread_weight=weights,
+        tg_count=float(max(tg.count, 1)),
+        dh_job=dh_job,
+        dh_tg=dh_tg,
+        spread_alg=(algorithm == enums.SCHED_ALG_SPREAD),
+    )

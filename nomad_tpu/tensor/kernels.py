@@ -1,0 +1,270 @@
+"""JAX placement kernels.
+
+Reproduces the reference scoring pipeline (scheduler/rank.go:205-835,
+nomad/structs/funcs.go:236-278, scheduler/spread.go) as dense vector math
+over all nodes at once, and the greedy placement loop
+(generic_sched.go:511 computePlacements) as a `lax.scan` whose carry is
+the cluster usage state — so each placement sees every earlier one, the
+same commit-visibility contract the host path gets via
+ctx.proposed_allocs.
+
+Where the host path subsamples candidates (limit = max(2, ceil(log2 N)),
+reference stack.go:82-95), the kernel scores *all* nodes and argmaxes —
+strictly better placements at the same asymptotic cost, because the MXU
+eats the (K x N) score matrix whole.
+
+Shapes (padded to powers of two by the caller for compile-cache reuse):
+  N nodes, D=3 resource dims, K placements, S spread attrs, V interned
+  attribute-value vocabulary.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+NEG = -1.0e30  # "infeasible" score sentinel
+BINPACK_MAX_FIT_SCORE = 18.0  # reference scheduler/rank.go:18
+
+
+def _free_fractions(available: jnp.ndarray, used: jnp.ndarray) -> jnp.ndarray:
+    """Free fraction per (node, dim) after `used` is placed
+    (reference funcs.go:213 computeFreePercentage).
+
+    x/0 capacity -> -inf free (its 10^free term vanishes); 0/0 -> 0.0.
+    """
+    safe = jnp.where(available > 0, available, 1.0)
+    ratio = jnp.where(
+        available > 0,
+        used / safe,
+        jnp.where(used > 0, jnp.inf, 0.0),
+    )
+    return 1.0 - ratio
+
+
+def fit_scores(available: jnp.ndarray, used: jnp.ndarray,
+               spread_alg: jnp.ndarray) -> jnp.ndarray:
+    """Normalized fit score per node in [0, 1].
+
+    binpack (BestFit-v3): clip(20 - (10^freeCpu + 10^freeMem), 0, 18)/18
+    spread  (WorstFit):   clip((10^freeCpu + 10^freeMem) - 2, 0, 18)/18
+    (reference funcs.go:236 ScoreFitBinPack / :263 ScoreFitSpread)
+    """
+    free = _free_fractions(available, used)
+    total = 10.0 ** free[:, 0] + 10.0 ** free[:, 1]
+    binpack = jnp.clip(20.0 - total, 0.0, BINPACK_MAX_FIT_SCORE)
+    spread = jnp.clip(total - 2.0, 0.0, BINPACK_MAX_FIT_SCORE)
+    return jnp.where(spread_alg, spread, binpack) / BINPACK_MAX_FIT_SCORE
+
+
+def score_nodes(
+    *,
+    available,        # (N, D) node capacity minus reserved
+    used,             # (N, D) current proposed usage
+    ask,              # (D,)   task-group resource ask
+    feasible,         # (N,)   bool: constraints+drivers+devices mask
+    placed_tg,        # (N,)   proposed allocs of this job+tg per node
+    placed_job,       # (N,)   proposed allocs of this job per node
+    affinity_boost,   # (N,)   precomputed sum(weight)/sum|weight| per node
+    penalty_idx,      # ()     node index to penalize (-1 = none)
+    spread_val_id,    # (S, N) interned spread-attr value per node
+    spread_val_ok,    # (S, N) bool: node has the attribute
+    spread_counts,    # (S, V) combined existing+proposed counts per value
+    spread_desired,   # (S, V) desired count per value (NaN = no target)
+    spread_has_targets,  # (S,) bool: explicit targets vs even-spread
+    spread_weight,    # (S,)  weight / sum|weights|
+    lowest_boost,     # ()    running minimum explicit boost (spread.go)
+    tg_count,         # ()    task group desired count
+    dh_job,           # ()    bool: job-level distinct_hosts
+    dh_tg,            # ()    bool: group-level distinct_hosts
+    spread_alg,       # ()    bool: WorstFit instead of BestFit
+):
+    """Score every node for one placement. Returns (score, fitness) each
+    (N,); infeasible nodes score NEG.
+
+    Mirrors the host oracle NodeScorer.rank (scheduler/rank.py): the final
+    score is the *mean of the sub-scores that apply* (reference
+    rank.go:800 ScoreNormalizationIterator) — each sub-score carries a
+    presence flag and the divisor is the number of present sub-scores.
+    """
+    n = available.shape[0]
+    new_used = used + ask[None, :]
+
+    ok = feasible & jnp.all(new_used <= available, axis=1)
+    ok &= jnp.where(dh_job, placed_job == 0, True)
+    ok &= jnp.where(dh_tg, placed_tg == 0, True)
+
+    fitness = fit_scores(available, new_used, spread_alg)
+
+    # job anti-affinity (reference rank.go:596)
+    anti_present = placed_tg > 0
+    anti = -(placed_tg.astype(fitness.dtype) + 1.0) / jnp.maximum(tg_count, 1.0)
+
+    # node rescheduling penalty (reference rank.go:666)
+    resched_present = jnp.arange(n) == penalty_idx
+
+    # node affinity (reference rank.go:710); boost precomputed host-side
+    aff_present = affinity_boost != 0.0
+
+    # spread (reference spread.go:128 + propertyset.go)
+    counts_at = jnp.take_along_axis(spread_counts, spread_val_id, axis=1)  # (S, N)
+    used_cnt = counts_at.astype(fitness.dtype) + 1.0  # incl. this placement
+    desired = jnp.take_along_axis(spread_desired, spread_val_id, axis=1)   # (S, N)
+
+    explicit = jnp.where(
+        jnp.isnan(desired),
+        -1.0,
+        jnp.where(
+            desired == 0.0,
+            lowest_boost,
+            (desired - used_cnt) / jnp.where(desired == 0.0, 1.0, desired)
+            * spread_weight[:, None],
+        ),
+    )
+    explicit = jnp.where(spread_val_ok, explicit, -1.0)
+
+    # even-spread boost (reference spread.go evenSpreadScoreBoost): uses
+    # combined counts *without* the current placement
+    present_v = spread_counts > 0                                   # (S, V)
+    any_present = jnp.any(present_v, axis=1)                        # (S,)
+    minc = jnp.min(jnp.where(present_v, spread_counts, jnp.iinfo(jnp.int32).max),
+                   axis=1).astype(fitness.dtype)                    # (S,)
+    maxc = jnp.max(jnp.where(present_v, spread_counts, 0),
+                   axis=1).astype(fitness.dtype)                    # (S,)
+    cur = counts_at.astype(fitness.dtype)                           # (S, N)
+    minc_b = minc[:, None]
+    maxc_b = maxc[:, None]
+    even = jnp.where(
+        cur != minc_b,
+        jnp.where(minc_b == 0.0, -1.0,
+                  (minc_b - cur) / jnp.where(minc_b == 0.0, 1.0, minc_b)),
+        jnp.where(minc_b == maxc_b, -1.0,
+                  jnp.where(minc_b == 0.0, 1.0,
+                            (maxc_b - minc_b) / jnp.where(minc_b == 0.0, 1.0, minc_b))),
+    )
+    # empty property set -> boost 0 (spread.go evenSpreadScoreBoost early
+    # return), but the missing-attribute -1.0 penalty applies regardless
+    # (SpreadScorer.score checks `ok` before consulting the property set)
+    even = jnp.where(any_present[:, None], even, 0.0)
+    even = jnp.where(spread_val_ok, even, -1.0)
+
+    boost = jnp.where(spread_has_targets[:, None], explicit, even)  # (S, N)
+    spread_total = jnp.sum(boost, axis=0)                           # (N,)
+    spread_present = spread_total != 0.0
+
+    divisor = (
+        1.0
+        + anti_present.astype(fitness.dtype)
+        + resched_present.astype(fitness.dtype)
+        + aff_present.astype(fitness.dtype)
+        + spread_present.astype(fitness.dtype)
+    )
+    total = (
+        fitness
+        + jnp.where(anti_present, anti, 0.0)
+        + jnp.where(resched_present, -1.0, 0.0)
+        + jnp.where(aff_present, affinity_boost, 0.0)
+        + jnp.where(spread_present, spread_total, 0.0)
+    )
+    final = total / divisor
+    return jnp.where(ok, final, NEG), fitness, boost
+
+
+@partial(jax.jit, donate_argnums=())
+def solve_task_group(
+    available,         # (N, D)
+    used0,             # (N, D)
+    placed_tg0,        # (N,)  int32
+    placed_job0,       # (N,)  int32
+    ask,               # (D,)
+    feasible,          # (N,)  bool
+    affinity_boost,    # (N,)
+    penalty_idx,       # (K,)  int32, -1 = none
+    active,            # (K,)  bool (False = padding step)
+    spread_val_id,     # (S, N) int32
+    spread_val_ok,     # (S, N) bool
+    spread_counts0,    # (S, V) int32
+    spread_desired,    # (S, V)
+    spread_has_targets,  # (S,) bool
+    spread_weight,     # (S,)
+    lowest_boost0,     # ()
+    tg_count,          # ()
+    dh_job,            # () bool
+    dh_tg,             # () bool
+    spread_alg,        # () bool
+):
+    """Place K allocations of one task group. Returns per-step
+    (choice, found, score): the chosen node index, whether any node fit,
+    and the winning normalized score.
+
+    The scan carry is the proposed cluster state — usage, per-node
+    placement counts, spread value counts — exactly the state the host
+    path threads through ctx.proposed_allocs + SpreadScorer between
+    placements (generic_sched.go:511-600 commit loop).
+    """
+    s = spread_val_id.shape[0]
+    n = available.shape[0]
+
+    def step(carry, xs):
+        used, ptg, pjob, scnt, lowest = carry
+        pen_idx, is_active = xs
+
+        score, fitness, boost = score_nodes(
+            available=available, used=used, ask=ask, feasible=feasible,
+            placed_tg=ptg, placed_job=pjob, affinity_boost=affinity_boost,
+            penalty_idx=pen_idx,
+            spread_val_id=spread_val_id, spread_val_ok=spread_val_ok,
+            spread_counts=scnt, spread_desired=spread_desired,
+            spread_has_targets=spread_has_targets, spread_weight=spread_weight,
+            lowest_boost=lowest, tg_count=tg_count,
+            dh_job=dh_job, dh_tg=dh_tg, spread_alg=spread_alg,
+        )
+        choice = jnp.argmax(score)
+        found = is_active & (score[choice] > NEG)
+
+        onehot = (jnp.arange(n) == choice) & found
+        used = used + ask[None, :] * onehot[:, None]
+        ptg = ptg + onehot.astype(ptg.dtype)
+        pjob = pjob + onehot.astype(pjob.dtype)
+
+        sel_ok = spread_val_ok[:, choice] & found                  # (S,)
+        sel_val = spread_val_id[:, choice]                          # (S,)
+        scnt = scnt.at[jnp.arange(s), sel_val].add(sel_ok.astype(scnt.dtype))
+
+        # SpreadIterator tracks the lowest explicit boost it has handed
+        # out (spread.go lowestBoost); we update it with the chosen
+        # node's explicit boosts
+        chosen_boost = jnp.where(spread_has_targets & sel_ok,
+                                 boost[:, choice], jnp.inf)
+        lowest = jnp.minimum(lowest, jnp.min(chosen_boost, initial=jnp.inf))
+
+        return (used, ptg, pjob, scnt, lowest), (choice, found, score[choice])
+
+    init = (used0, placed_tg0, placed_job0, spread_counts0, lowest_boost0)
+    (_, _, _, _, _), (choices, founds, scores) = jax.lax.scan(
+        init=init, f=step, xs=(penalty_idx, active))
+    return choices, founds, scores
+
+
+@jax.jit
+def score_nodes_once(
+    available, used, ask, feasible, placed_tg, placed_job, affinity_boost,
+    penalty_idx, spread_val_id, spread_val_ok, spread_counts, spread_desired,
+    spread_has_targets, spread_weight, lowest_boost, tg_count, dh_job, dh_tg,
+    spread_alg,
+):
+    """Single-placement score vector — the differential-test surface
+    pinned against the host oracle scheduler.rank.score_nodes."""
+    score, _, _ = score_nodes(
+        available=available, used=used, ask=ask, feasible=feasible,
+        placed_tg=placed_tg, placed_job=placed_job,
+        affinity_boost=affinity_boost, penalty_idx=penalty_idx,
+        spread_val_id=spread_val_id, spread_val_ok=spread_val_ok,
+        spread_counts=spread_counts, spread_desired=spread_desired,
+        spread_has_targets=spread_has_targets, spread_weight=spread_weight,
+        lowest_boost=lowest_boost, tg_count=tg_count,
+        dh_job=dh_job, dh_tg=dh_tg, spread_alg=spread_alg,
+    )
+    return score

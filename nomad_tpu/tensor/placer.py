@@ -1,0 +1,167 @@
+"""TPUPlacer: batched placement behind SchedulerAlgorithm="tpu-binpack"
+(the new algorithm value plugging into the reference's enum,
+nomad/structs/operator.go:199-255).
+
+Lowering strategy per evaluation:
+  1. one ClusterTensors build (nodes + proposed usage),
+  2. per task group: host-precompiled feasibility/affinity/spread arrays,
+  3. one jitted solve_task_group scan placing all of the group's
+     requests with full cross-placement visibility,
+  4. commits mapped back through the scheduler's commit callback so the
+     plan object and ctx.proposed_allocs stay authoritative.
+
+Preemption stays host-side: when the kernel finds no fit and preemption
+is enabled, the per-request fallback runs the host NodeScorer preemption
+path (reference rank.go:205-587's preemption fallback arm). Task groups
+asking for devices or reserved cores also fall back — their per-instance
+fit logic lands with the device kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..structs import Job, Node, enums
+from ..scheduler.context import EvalContext
+from ..scheduler.feasible import distinct_property_constraints
+from ..scheduler.rank import NodeScorer, RankedNode, select_best_node
+from ..scheduler.reconcile import PlacementRequest
+from .cluster import ClusterTensors, build_task_group_tensors, _pad_pow2
+
+
+def _needs_host_path(job: Job, tg) -> bool:
+    if any(t.resources.devices for t in tg.tasks):
+        return True
+    if any(t.resources.cores for t in tg.tasks):
+        return True
+    if distinct_property_constraints(job, tg):
+        return True
+    return False
+
+
+class TPUPlacer:
+    """Placer implementation: dense-tensor batch solve on the device."""
+
+    def __init__(self, algorithm: str = enums.SCHED_ALG_BINPACK):
+        # fit formula to use on the device; "tpu-binpack" keeps BestFit
+        self.algorithm = algorithm
+
+    def place(
+        self,
+        ctx: EvalContext,
+        job: Job,
+        requests: Sequence[PlacementRequest],
+        nodes: Sequence[Node],
+        commit,
+        *,
+        batch: bool = False,
+        preemption_enabled: bool = False,
+        attempt: int = 0,
+    ) -> None:
+        import jax.numpy as jnp
+
+        from .kernels import solve_task_group
+
+        if not nodes:
+            for req in requests:
+                m = ctx.new_metrics()
+                m.nodes_in_pool = 0
+                commit(req, None)
+            return
+
+        cluster = ClusterTensors.build(ctx, nodes)
+
+        # group requests per task group, preserving intra-group order
+        groups: Dict[str, List[PlacementRequest]] = {}
+        order: List[str] = []
+        for req in requests:
+            name = req.task_group.name
+            if name not in groups:
+                groups[name] = []
+                order.append(name)
+            groups[name].append(req)
+
+        host_fallback = None
+        for name in order:
+            reqs = groups[name]
+            tg = reqs[0].task_group
+            cluster.refresh_usage(ctx)
+
+            if _needs_host_path(job, tg):
+                if host_fallback is None:
+                    from ..scheduler.placer import HostPlacer
+
+                    host_fallback = HostPlacer(algorithm=self.algorithm)
+                host_fallback.place(ctx, job, reqs, nodes, commit,
+                                    batch=batch,
+                                    preemption_enabled=preemption_enabled,
+                                    attempt=attempt)
+                continue
+
+            tgt = build_task_group_tensors(ctx, job, tg, cluster,
+                                           algorithm=self.algorithm)
+
+            k = len(reqs)
+            k_pad = _pad_pow2(k, floor=1)
+            penalty_idx = np.full(k_pad, -1, dtype=np.int32)
+            active = np.zeros(k_pad, dtype=bool)
+            active[:k] = True
+            for i, req in enumerate(reqs):
+                if req.ignore_node:
+                    penalty_idx[i] = cluster.node_index.get(req.ignore_node, -1)
+
+            choices, founds, scores = solve_task_group(
+                jnp.asarray(cluster.available), jnp.asarray(cluster.used),
+                jnp.asarray(tgt.placed_tg), jnp.asarray(tgt.placed_job),
+                jnp.asarray(tgt.ask), jnp.asarray(tgt.feasible),
+                jnp.asarray(tgt.affinity_boost), jnp.asarray(penalty_idx),
+                jnp.asarray(active), jnp.asarray(tgt.spread_val_id),
+                jnp.asarray(tgt.spread_val_ok), jnp.asarray(tgt.spread_counts),
+                jnp.asarray(tgt.spread_desired),
+                jnp.asarray(tgt.spread_has_targets),
+                jnp.asarray(tgt.spread_weight),
+                jnp.asarray(-1.0), jnp.asarray(tgt.tg_count),
+                jnp.asarray(tgt.dh_job), jnp.asarray(tgt.dh_tg),
+                jnp.asarray(tgt.spread_alg),
+            )
+            choices = np.asarray(choices)
+            founds = np.asarray(founds)
+            scores = np.asarray(scores)
+
+            for i, req in enumerate(reqs):
+                metrics = ctx.new_metrics()
+                metrics.nodes_in_pool = len(nodes)
+                metrics.nodes_evaluated = len(nodes)
+                if founds[i]:
+                    node = cluster.nodes[int(choices[i])]
+                    option = RankedNode(node=node)
+                    option.final_score = float(scores[i])
+                    option.score_meta["normalized-score"] = option.final_score
+                    metrics.scores[f"{node.id}.normalized-score"] = option.final_score
+                    commit(req, option)
+                    continue
+                if preemption_enabled:
+                    option = self._preempt_fallback(ctx, job, tg, nodes, req,
+                                                    attempt)
+                    if option is not None:
+                        commit(req, option)
+                        continue
+                    metrics = ctx.metrics or metrics
+                metrics.exhaust_node("resources")
+                commit(req, None)
+
+    def _preempt_fallback(self, ctx, job, tg, nodes, req,
+                          attempt: int) -> Optional[RankedNode]:
+        penalty = frozenset({req.ignore_node}) if req.ignore_node else frozenset()
+        option = select_best_node(
+            ctx, job, tg, nodes,
+            algorithm=(enums.SCHED_ALG_BINPACK
+                       if self.algorithm == enums.SCHED_ALG_TPU_BINPACK
+                       else self.algorithm),
+            preemption_enabled=True,
+            penalty_nodes=penalty,
+            attempt=attempt,
+        )
+        return option

@@ -2,8 +2,12 @@
 
 Multi-chip TPU hardware is not available in CI; all sharding/pjit tests
 run against xla_force_host_platform_device_count=8 (the same mechanism
-the driver uses for dryrun_multichip). Must run before jax is imported
-anywhere.
+the driver uses for dryrun_multichip).
+
+The environment may pre-import jax with a TPU platform selected, so env
+vars alone are not enough — jax.config.update after import is what
+sticks. XLA_FLAGS is still read lazily at backend initialization, so
+setting it here (before any device is touched) works.
 """
 
 import os
@@ -14,13 +18,16 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "1")
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def eight_devices():
-    import jax
-
     devs = jax.devices()
     assert len(devs) >= 8, f"expected >=8 virtual devices, got {devs}"
     return devs[:8]

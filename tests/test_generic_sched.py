@@ -244,6 +244,37 @@ class TestSystemScheduling:
         assert len(allocs) == 4
         assert newn.id in {a.node_id for a in allocs}
 
+    def test_ineligible_node_keeps_running_system_alloc(self, h):
+        """Marking a node scheduling-ineligible blocks new placements but
+        must not stop its running system alloc (reference
+        system_util.go:200 ignores allocs on notReadyNodes)."""
+        job = mock.system_job()
+        nodes, job, ev = register(h, n_nodes=3, job=job)
+        h.process(ev)
+        assert len(h.snapshot().allocs_by_job(job.id)) == 3
+        h.store.update_node_eligibility(nodes[0].id, enums.NODE_SCHED_INELIGIBLE)
+        ev2 = mock.eval_for(job, triggered_by=enums.TRIGGER_NODE_UPDATE)
+        h.process(ev2)
+        live = [a for a in h.snapshot().allocs_by_job(job.id)
+                if not a.terminal_status() and not a.server_terminal()]
+        assert len(live) == 3
+        assert nodes[0].id in {a.node_id for a in live}
+
+    def test_node_outside_datacenters_stops_system_alloc(self, h):
+        """A node moved out of the job's datacenters is not merely
+        not-ready: its system alloc stops."""
+        job = mock.system_job()
+        nodes, job, ev = register(h, n_nodes=2, job=job)
+        h.process(ev)
+        moved = h.store.snapshot().node_by_id(nodes[0].id)
+        moved.datacenter = "dc-elsewhere"
+        h.store.upsert_node(moved)
+        ev2 = mock.eval_for(job, triggered_by=enums.TRIGGER_NODE_UPDATE)
+        h.process(ev2)
+        live = [a for a in h.snapshot().allocs_by_job(job.id)
+                if not a.server_terminal()]
+        assert {a.node_id for a in live} == {nodes[1].id}
+
     def test_sysbatch_does_not_rerun_complete(self, h):
         job = mock.sysbatch_job()
         nodes, job, ev = register(h, n_nodes=3, job=job)

@@ -1,0 +1,284 @@
+"""Differential tests: TPU tensor kernels vs the host oracle.
+
+The host path (scheduler.rank) reproduces reference semantics exactly;
+these tests pin the JAX kernels to it over randomized clusters
+(SURVEY.md §7 stage 3/4 test oracles).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.rank import score_nodes
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import Affinity, Constraint, Spread, SpreadTarget, enums
+from nomad_tpu.structs.operator import SchedulerConfiguration
+from nomad_tpu.structs.resources import Resources
+from nomad_tpu.tensor.cluster import ClusterTensors, build_task_group_tensors
+from nomad_tpu.tensor.placer import TPUPlacer
+from nomad_tpu.testing import Harness
+
+
+def _rand_cluster(store, rng, n_nodes=24, n_allocs=40, dcs=("dc1",)):
+    nodes = []
+    for _ in range(n_nodes):
+        n = mock.node(datacenter=rng.choice(list(dcs)))
+        n.resources.cpu = rng.choice([2000, 4000, 8000])
+        n.resources.memory_mb = rng.choice([4096, 8192, 16384])
+        n.compute_class()
+        store.upsert_node(n)
+        nodes.append(n)
+    filler = mock.job()
+    filler.task_groups[0].count = n_allocs
+    store.upsert_job(filler)
+    for i in range(n_allocs):
+        node = rng.choice(nodes)
+        a = mock.alloc(filler, node, index=i)
+        a.allocated_vec = Resources(
+            cpu=rng.choice([100, 250, 500]),
+            memory_mb=rng.choice([64, 128, 512])).vec()
+        store.upsert_allocs([a])
+    return nodes
+
+
+def _kernel_scores(ctx, job, tg, nodes, algorithm=enums.SCHED_ALG_BINPACK):
+    import jax.numpy as jnp
+
+    from nomad_tpu.tensor.kernels import NEG, score_nodes_once
+
+    cluster = ClusterTensors.build(ctx, nodes)
+    tgt = build_task_group_tensors(ctx, job, tg, cluster, algorithm=algorithm)
+    out = score_nodes_once(
+        jnp.asarray(cluster.available), jnp.asarray(cluster.used),
+        jnp.asarray(tgt.ask), jnp.asarray(tgt.feasible),
+        jnp.asarray(tgt.placed_tg), jnp.asarray(tgt.placed_job),
+        jnp.asarray(tgt.affinity_boost), jnp.asarray(np.int32(-1)),
+        jnp.asarray(tgt.spread_val_id), jnp.asarray(tgt.spread_val_ok),
+        jnp.asarray(tgt.spread_counts), jnp.asarray(tgt.spread_desired),
+        jnp.asarray(tgt.spread_has_targets), jnp.asarray(tgt.spread_weight),
+        jnp.asarray(-1.0), jnp.asarray(tgt.tg_count),
+        jnp.asarray(tgt.dh_job), jnp.asarray(tgt.dh_tg),
+        jnp.asarray(tgt.spread_alg),
+    )
+    scores = np.asarray(out)[: len(nodes)]
+    return {nodes[i].id: scores[i] for i in range(len(nodes))
+            if scores[i] > NEG / 2}
+
+
+def _host_scores(ctx, job, tg, nodes, algorithm=enums.SCHED_ALG_BINPACK):
+    options = score_nodes(ctx, job, tg, nodes, algorithm=algorithm)
+    return {o.node.id: o.final_score for o in options}
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_score_parity_randomized(seed):
+    rng = random.Random(seed)
+    store = StateStore()
+    nodes = _rand_cluster(store, rng)
+    job = mock.job()
+    job.task_groups[0].tasks[0].resources = Resources(
+        cpu=rng.choice([200, 500, 900]), memory_mb=rng.choice([128, 256, 700]))
+
+    snap = store.snapshot()
+    host = _host_scores(EvalContext(snap, eval_id="e1"), job,
+                        job.task_groups[0], nodes)
+    kern = _kernel_scores(EvalContext(snap, eval_id="e1"), job,
+                          job.task_groups[0], nodes)
+    assert set(host) == set(kern)
+    for nid, hscore in host.items():
+        assert kern[nid] == pytest.approx(hscore, abs=1e-6), nid
+
+
+def test_score_parity_with_affinities_and_constraints():
+    rng = random.Random(7)
+    store = StateStore()
+    nodes = _rand_cluster(store, rng, n_nodes=16)
+    # give half the nodes a rack attribute
+    for i, n in enumerate(nodes):
+        if i % 2 == 0:
+            n.attributes["rack"] = f"r{i % 4}"
+            n.compute_class()
+            store.upsert_node(n)
+    job = mock.job(
+        constraints=[Constraint("${attr.kernel.name}", "linux", "="),
+                     Constraint("${attr.rack}", "", enums.CONSTRAINT_IS_SET)],
+        affinities=[Affinity("${attr.rack}", "r0", "=", weight=50),
+                    Affinity("${attr.rack}", "r2", "=", weight=-30)],
+    )
+    snap = store.snapshot()
+    host = _host_scores(EvalContext(snap, eval_id="e2"), job, job.task_groups[0], nodes)
+    kern = _kernel_scores(EvalContext(snap, eval_id="e2"), job, job.task_groups[0], nodes)
+    assert host and set(host) == set(kern)
+    for nid in host:
+        assert kern[nid] == pytest.approx(host[nid], abs=1e-6)
+
+
+@pytest.mark.parametrize("targets", [
+    [],
+    [SpreadTarget("d1", 70), SpreadTarget("d2", 30)],
+    [SpreadTarget("d1", 50)],
+])
+def test_score_parity_spread(targets):
+    rng = random.Random(11)
+    store = StateStore()
+    nodes = _rand_cluster(store, rng, n_nodes=12, dcs=("d1", "d2", "d3"))
+    job = mock.job(datacenters=["d1", "d2", "d3"])
+    job.task_groups[0].spreads = [
+        Spread(attribute="${node.datacenter}", weight=60, targets=targets)]
+    # seed some existing allocs of THIS job so property sets are non-empty
+    for i in range(5):
+        a = mock.alloc(job, rng.choice(nodes), index=i)
+        store.upsert_allocs([a])
+    store.upsert_job(job)
+
+    snap = store.snapshot()
+    host = _host_scores(EvalContext(snap, eval_id="e3"), job, job.task_groups[0], nodes)
+    kern = _kernel_scores(EvalContext(snap, eval_id="e3"), job, job.task_groups[0], nodes)
+    assert host and set(host) == set(kern)
+    for nid in host:
+        assert kern[nid] == pytest.approx(host[nid], abs=1e-6)
+
+
+def test_score_parity_even_spread_missing_attribute():
+    """Nodes missing the spread attribute take the -1.0 penalty even when
+    no allocs exist yet (SpreadScorer.score checks `ok` before the
+    property set; regression for the kernel masking order)."""
+    store = StateStore()
+    nodes = []
+    for i in range(8):
+        n = mock.node()
+        if i % 2 == 0:
+            n.attributes["rack"] = f"r{i % 4}"
+            n.compute_class()
+        store.upsert_node(n)
+        nodes.append(n)
+    job = mock.job()
+    job.task_groups[0].spreads = [Spread(attribute="${attr.rack}", weight=50)]
+    store.upsert_job(job)
+
+    snap = store.snapshot()
+    host = _host_scores(EvalContext(snap, eval_id="e5"), job, job.task_groups[0], nodes)
+    kern = _kernel_scores(EvalContext(snap, eval_id="e5"), job, job.task_groups[0], nodes)
+    assert host and set(host) == set(kern)
+    for nid in host:
+        assert kern[nid] == pytest.approx(host[nid], abs=1e-6)
+    # and the rack-less nodes really do score worse
+    rackless = [n.id for n in nodes if "rack" not in n.attributes]
+    racked = [n.id for n in nodes if "rack" in n.attributes]
+    assert max(host[n] for n in rackless) < min(host[n] for n in racked)
+
+
+def test_score_parity_spread_algorithm():
+    rng = random.Random(13)
+    store = StateStore()
+    nodes = _rand_cluster(store, rng, n_nodes=10)
+    job = mock.job()
+    snap = store.snapshot()
+    host = _host_scores(EvalContext(snap, eval_id="e4"), job, job.task_groups[0],
+                        nodes, algorithm=enums.SCHED_ALG_SPREAD)
+    kern = _kernel_scores(EvalContext(snap, eval_id="e4"), job, job.task_groups[0],
+                          nodes, algorithm=enums.SCHED_ALG_SPREAD)
+    assert host and set(host) == set(kern)
+    for nid in host:
+        assert kern[nid] == pytest.approx(host[nid], abs=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the scheduler
+# ---------------------------------------------------------------------------
+
+
+def _tpu_config():
+    return SchedulerConfiguration(scheduler_algorithm=enums.SCHED_ALG_TPU_BINPACK)
+
+
+def test_tpu_placer_places_all():
+    h = Harness()
+    for _ in range(8):
+        h.store.upsert_node(mock.node())
+    job = mock.job()
+    h.store.upsert_job(job)
+    h.process(mock.eval_for(job), sched_config=_tpu_config())
+
+    ev = h.assert_eval_status(enums.EVAL_STATUS_COMPLETE)
+    assert not ev.failed_tg_allocs
+    allocs = [a for a in h.store.snapshot().allocs()]
+    assert len(allocs) == 10
+    # no oversubscription
+    by_node = {}
+    for a in allocs:
+        by_node.setdefault(a.node_id, []).append(a)
+    for nid, node_allocs in by_node.items():
+        node = h.store.snapshot().node_by_id(nid)
+        used = sum(a.allocated_vec for a in node_allocs)
+        assert (used <= node.available_vec()).all()
+
+
+def test_tpu_placer_respects_capacity_and_blocks():
+    h = Harness()
+    n = mock.node()
+    n.resources.cpu = 1000
+    n.resources.memory_mb = 1000
+    n.compute_class()
+    h.store.upsert_node(n)
+    job = mock.job()  # 10 x 500MHz/256MB -> only 2 fit
+    h.store.upsert_job(job)
+    h.process(mock.eval_for(job), sched_config=_tpu_config())
+
+    allocs = h.store.snapshot().allocs_by_job(job.id)
+    assert len(allocs) == 2
+    # failed placements produce a blocked eval
+    assert h.created_evals
+    assert h.created_evals[-1].status == enums.EVAL_STATUS_BLOCKED
+
+
+def test_tpu_placer_distinct_hosts():
+    h = Harness()
+    for _ in range(6):
+        h.store.upsert_node(mock.node())
+    job = mock.job(constraints=[
+        Constraint(operand=enums.CONSTRAINT_DISTINCT_HOSTS)])
+    job.task_groups[0].count = 6
+    h.store.upsert_job(job)
+    h.process(mock.eval_for(job), sched_config=_tpu_config())
+
+    allocs = h.store.snapshot().allocs_by_job(job.id)
+    assert len(allocs) == 6
+    assert len({a.node_id for a in allocs}) == 6
+
+
+def test_tpu_beats_or_matches_host_binpack_score():
+    """The kernel scores all nodes where the host samples a shuffled
+    log2(N) subset (reference stack.go:82-95), so the per-placement
+    normalized scores it achieves must be at least as good on average
+    (SURVEY §7: assignment must dominate greedy on score parity)."""
+    def run(config):
+        h = Harness()
+        rng = random.Random(42)
+        for _ in range(32):
+            n = mock.node()
+            n.resources.cpu = rng.choice([2000, 4000])
+            n.resources.memory_mb = rng.choice([4096, 8192])
+            n.compute_class()
+            h.store.upsert_node(n)
+        job = mock.job()
+        job.task_groups[0].count = 20
+        h.store.upsert_job(job)
+        h.process(mock.eval_for(job), sched_config=config)
+        allocs = h.store.snapshot().allocs_by_job(job.id)
+        assert len(allocs) == 20
+        scores = []
+        for a in allocs:
+            key = f"{a.node_id}.normalized-score"
+            if a.metrics is not None and key in a.metrics.scores:
+                scores.append(a.metrics.scores[key])
+        assert scores
+        return sum(scores) / len(scores)
+
+    tpu_score = run(_tpu_config())
+    host_score = run(SchedulerConfiguration(
+        scheduler_algorithm=enums.SCHED_ALG_BINPACK))
+    assert tpu_score >= host_score - 1e-9
