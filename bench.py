@@ -1,0 +1,111 @@
+"""Benchmark: end-to-end scheduling throughput, TPU path vs host greedy.
+
+BASELINE.md staged config 3: spread scheduling over a rack attribute on a
+1K-node cluster (the reference's documented perf cliff — spread/affinity
+widens the candidate limit to >=100 and scoring goes quadratic,
+reference scheduler/stack.go:176-185). 1,024 allocations across 4 jobs.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+value       = allocations placed per second through the full scheduler
+              (reconcile -> batched JAX solve -> plan -> commit),
+              steady-state (one warmup eval excluded so one-time jit
+              compilation is not billed to the per-eval number)
+vs_baseline = speedup over the host greedy path (exact reference
+              semantics, same process, same cluster, same workload).
+
+Runs on whatever JAX platform the environment provides (real TPU chip
+under the driver; CPU elsewhere).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+
+N_NODES = 1024
+N_RACKS = 20
+N_JOBS = 4
+GROUP_COUNT = 256  # 4 jobs x 256 allocs
+
+
+def build_cluster(store, seed: int = 0):
+    from nomad_tpu import mock
+
+    rng = random.Random(seed)
+    for i in range(N_NODES):
+        n = mock.node()
+        n.attributes["rack"] = f"r{i % N_RACKS}"
+        n.resources.cpu = rng.choice([8000, 16000, 32000])
+        n.resources.memory_mb = rng.choice([16384, 32768, 65536])
+        n.compute_class()
+        store.upsert_node(n)
+
+
+def make_jobs(store, seed: int = 1):
+    from nomad_tpu import mock
+    from nomad_tpu.structs import Spread
+
+    rng = random.Random(seed)
+    jobs = []
+    for _ in range(N_JOBS):
+        j = mock.job()
+        tg = j.task_groups[0]
+        tg.count = GROUP_COUNT
+        tg.tasks[0].resources.cpu = rng.choice([100, 250])
+        tg.tasks[0].resources.memory_mb = rng.choice([64, 128])
+        tg.spreads = [Spread(attribute="${attr.rack}", weight=50)]
+        store.upsert_job(j)
+        jobs.append(j)
+    return jobs
+
+
+def run_once(algorithm: str, seed: int = 0) -> tuple:
+    """-> (wall_seconds, allocs_placed) scheduling every job once."""
+    from nomad_tpu import mock
+    from nomad_tpu.structs import Spread
+    from nomad_tpu.structs.operator import SchedulerConfiguration
+    from nomad_tpu.testing import Harness
+
+    h = Harness()
+    build_cluster(h.store, seed)
+    jobs = make_jobs(h.store, seed + 1)
+    cfg = SchedulerConfiguration(scheduler_algorithm=algorithm)
+
+    # warmup: compile the kernels / prime caches on a throwaway job
+    warm = mock.job()
+    warm.task_groups[0].count = GROUP_COUNT
+    warm.task_groups[0].spreads = [Spread(attribute="${attr.rack}", weight=50)]
+    h.store.upsert_job(warm)
+    h.process(mock.eval_for(warm), sched_config=cfg)
+    h.store.delete_job(warm.id)
+
+    t0 = time.perf_counter()
+    for j in jobs:
+        h.process(mock.eval_for(j), sched_config=cfg)
+    dt = time.perf_counter() - t0
+
+    placed = sum(len(h.store.snapshot().allocs_by_job(j.id)) for j in jobs)
+    return dt, placed
+
+
+def main() -> None:
+    from nomad_tpu.structs import enums
+
+    tpu_dt, tpu_placed = run_once(enums.SCHED_ALG_TPU_BINPACK)
+    host_dt, host_placed = run_once(enums.SCHED_ALG_BINPACK)
+    assert tpu_placed == N_JOBS * GROUP_COUNT, tpu_placed
+    assert host_placed == N_JOBS * GROUP_COUNT, host_placed
+
+    allocs_per_s = tpu_placed / tpu_dt
+    print(json.dumps({
+        "metric": "spread_sched_throughput_1k_allocs_1k_nodes",
+        "value": round(allocs_per_s, 1),
+        "unit": "allocs/s",
+        "vs_baseline": round(host_dt / tpu_dt, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

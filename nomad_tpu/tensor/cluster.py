@@ -27,7 +27,7 @@ from ..scheduler.feasible import (
     feasible_mask,
     resolve_target,
 )
-from ..scheduler.spread import IMPLICIT_TARGET, combined_spreads
+from ..scheduler.spread import IMPLICIT_TARGET, SpreadInfo, combined_spreads
 
 
 def _pad_pow2(n: int, floor: int = 8) -> int:
@@ -193,13 +193,10 @@ def _spread_tensors(ctx: EvalContext, job: Job, tg: TaskGroup,
         if not sp.targets:
             continue
         has_targets[si] = True
-        desired: Dict[str, float] = {}
-        total = 0.0
-        for st in sp.targets:
-            want = (st.percent / 100.0) * tg.count
-            desired[st.value] = want
-            total += want
-        implicit = (tg.count - total) if 0 < total < tg.count else None
+        # desired-count semantics live in SpreadInfo (reference
+        # spread.go:268 computeSpreadInfo) — reuse, don't re-derive
+        desired = SpreadInfo(sp, tg.count).desired_counts
+        implicit = desired.get(IMPLICIT_TARGET)
         for val, vid in vocabs[si].items():
             if val in desired:
                 spread_desired[si, vid] = desired[val]

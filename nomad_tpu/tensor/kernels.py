@@ -248,6 +248,73 @@ def solve_task_group(
     return choices, founds, scores
 
 
+# ---------------------------------------------------------------------------
+# fused transfer layout
+# ---------------------------------------------------------------------------
+#
+# Device round trips, not FLOPs, bound small solves (the real chip sits
+# behind a tunnel; each host<->device hop costs ~10-150 ms). The fused
+# entry point packs the 20 logical arguments into 6 arrays and returns
+# one packed output so a whole task-group solve costs one upload batch
+# and one readback.
+#
+# node_mat (N, 10): avail[3] | used[3] | placed_tg | placed_job | feasible | affinity
+# step_mat (K, 2):  penalty_idx | active
+# spread_node (2S, N): val_id rows then val_ok rows
+# spread_tab (2S, V):  counts rows then desired rows
+# spread_meta (S, 2):  has_targets | weight
+# scalars (8,): lowest_boost | tg_count | dh_job | dh_tg | spread_alg | ask[3]
+
+
+def pack_solve_args(available, used0, placed_tg0, placed_job0, ask, feasible,
+                    affinity_boost, penalty_idx, active, spread_val_id,
+                    spread_val_ok, spread_counts0, spread_desired,
+                    spread_has_targets, spread_weight, lowest_boost0,
+                    tg_count, dh_job, dh_tg, spread_alg):
+    """Host-side packing (numpy) for solve_task_group_fused."""
+    import numpy as np
+
+    f = np.float32
+    node_mat = np.concatenate([
+        np.asarray(available, f), np.asarray(used0, f),
+        np.asarray(placed_tg0, f)[:, None], np.asarray(placed_job0, f)[:, None],
+        np.asarray(feasible, f)[:, None], np.asarray(affinity_boost, f)[:, None],
+    ], axis=1)
+    step_mat = np.stack([np.asarray(penalty_idx, f),
+                         np.asarray(active, f)], axis=1)
+    spread_node = np.concatenate([np.asarray(spread_val_id, f),
+                                  np.asarray(spread_val_ok, f)], axis=0)
+    spread_tab = np.concatenate([np.asarray(spread_counts0, f),
+                                 np.asarray(spread_desired, f)], axis=0)
+    spread_meta = np.stack([np.asarray(spread_has_targets, f),
+                            np.asarray(spread_weight, f)], axis=1) \
+        if len(spread_weight) else np.zeros((0, 2), f)
+    scalars = np.array([lowest_boost0, tg_count, dh_job, dh_tg, spread_alg,
+                        ask[0], ask[1], ask[2]], f)
+    return node_mat, step_mat, spread_node, spread_tab, spread_meta, scalars
+
+
+@jax.jit
+def solve_task_group_fused(node_mat, step_mat, spread_node, spread_tab,
+                           spread_meta, scalars):
+    """Transfer-fused solve: unpack on device, run the same scan, return
+    one (3, K) array of [choice, found, score] rows."""
+    s = spread_meta.shape[0]
+    choices, founds, scores = solve_task_group(
+        node_mat[:, 0:3], node_mat[:, 3:6],
+        node_mat[:, 6].astype(jnp.int32), node_mat[:, 7].astype(jnp.int32),
+        scalars[5:8], node_mat[:, 8] > 0.5, node_mat[:, 9],
+        step_mat[:, 0].astype(jnp.int32), step_mat[:, 1] > 0.5,
+        spread_node[:s].astype(jnp.int32), spread_node[s:] > 0.5,
+        spread_tab[:s].astype(jnp.int32), spread_tab[s:],
+        spread_meta[:, 0] > 0.5, spread_meta[:, 1],
+        scalars[0], scalars[1], scalars[2] > 0.5, scalars[3] > 0.5,
+        scalars[4] > 0.5,
+    )
+    return jnp.stack([choices.astype(scores.dtype),
+                      founds.astype(scores.dtype), scores])
+
+
 @jax.jit
 def score_nodes_once(
     available, used, ask, feasible, placed_tg, placed_job, affinity_boost,

@@ -60,9 +60,7 @@ class TPUPlacer:
         preemption_enabled: bool = False,
         attempt: int = 0,
     ) -> None:
-        import jax.numpy as jnp
-
-        from .kernels import solve_task_group
+        from .kernels import pack_solve_args, solve_task_group_fused
 
         if not nodes:
             for req in requests:
@@ -84,10 +82,11 @@ class TPUPlacer:
             groups[name].append(req)
 
         host_fallback = None
-        for name in order:
+        for gi, name in enumerate(order):
             reqs = groups[name]
             tg = reqs[0].task_group
-            cluster.refresh_usage(ctx)
+            if gi > 0:  # build() already computed usage for the first group
+                cluster.refresh_usage(ctx)
 
             if _needs_host_path(job, tg):
                 if host_fallback is None:
@@ -112,24 +111,18 @@ class TPUPlacer:
                 if req.ignore_node:
                     penalty_idx[i] = cluster.node_index.get(req.ignore_node, -1)
 
-            choices, founds, scores = solve_task_group(
-                jnp.asarray(cluster.available), jnp.asarray(cluster.used),
-                jnp.asarray(tgt.placed_tg), jnp.asarray(tgt.placed_job),
-                jnp.asarray(tgt.ask), jnp.asarray(tgt.feasible),
-                jnp.asarray(tgt.affinity_boost), jnp.asarray(penalty_idx),
-                jnp.asarray(active), jnp.asarray(tgt.spread_val_id),
-                jnp.asarray(tgt.spread_val_ok), jnp.asarray(tgt.spread_counts),
-                jnp.asarray(tgt.spread_desired),
-                jnp.asarray(tgt.spread_has_targets),
-                jnp.asarray(tgt.spread_weight),
-                jnp.asarray(-1.0), jnp.asarray(tgt.tg_count),
-                jnp.asarray(tgt.dh_job), jnp.asarray(tgt.dh_tg),
-                jnp.asarray(tgt.spread_alg),
-            )
-            choices = np.asarray(choices)
-            founds = np.asarray(founds)
-            scores = np.asarray(scores)
+            packed = pack_solve_args(
+                cluster.available, cluster.used, tgt.placed_tg, tgt.placed_job,
+                tgt.ask, tgt.feasible, tgt.affinity_boost, penalty_idx, active,
+                tgt.spread_val_id, tgt.spread_val_ok, tgt.spread_counts,
+                tgt.spread_desired, tgt.spread_has_targets, tgt.spread_weight,
+                -1.0, tgt.tg_count, tgt.dh_job, tgt.dh_tg, tgt.spread_alg)
+            out = np.asarray(solve_task_group_fused(*packed))  # one readback
+            choices = out[0].astype(np.int64)
+            founds = out[1] > 0.5
+            scores = out[2]
 
+            n_feasible = int(tgt.feasible[: len(nodes)].sum())
             for i, req in enumerate(reqs):
                 metrics = ctx.new_metrics()
                 metrics.nodes_in_pool = len(nodes)
@@ -149,7 +142,18 @@ class TPUPlacer:
                         commit(req, option)
                         continue
                     metrics = ctx.metrics or metrics
-                metrics.exhaust_node("resources")
+                # attribute the failure the way the host path would: nodes
+                # masked by constraints/drivers are "filtered", nodes that
+                # passed feasibility but didn't fit are "exhausted"
+                # (reference feasible.go filter vs rank.go exhaust metrics)
+                masked = len(nodes) - n_feasible
+                if masked:
+                    metrics.nodes_filtered += masked
+                    metrics.constraint_filtered["task group constraints"] = (
+                        metrics.constraint_filtered.get("task group constraints", 0)
+                        + masked)
+                if n_feasible > 0:
+                    metrics.exhaust_node("resources")
                 commit(req, None)
 
     def _preempt_fallback(self, ctx, job, tg, nodes, req,

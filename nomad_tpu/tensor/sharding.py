@@ -1,0 +1,73 @@
+"""Multi-chip sharding for the placement solve.
+
+The long axis of this workload is nodes (SURVEY.md §5: the (jobs x nodes)
+matrix is our "long context"). The solve is embarrassingly parallel over
+nodes except for one global reduction per placement step (the argmax over
+node scores) and one scatter (the usage update on the winner) — exactly
+the shape of ring-reduce workloads, so it rides ICI:
+
+    mesh = Mesh(devices, ("nodes",))
+    available, used, feasible, ...  sharded P("nodes")   [row-sharded]
+    spread tables, ask, flags       replicated P()
+    per-step: local scores -> global argmax (XLA all-reduce over ICI)
+              -> one-hot usage update (local on the owning shard)
+
+With jit + NamedSharding constraints XLA inserts the collectives; there
+is no hand-written NCCL/MPI analog to port (the reference's comm backend
+is msgpack-RPC/Serf/Raft, SURVEY.md §2.5 — control-plane replication
+stays host-side, this module only distributes the math).
+
+Used by __graft_entry__.dryrun_multichip and the multi-chip benchmarks.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def node_mesh(devices: Sequence = None, axis: str = "nodes") -> Mesh:
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devs, (axis,))
+
+
+def shard_solve_args(mesh: Mesh, args: tuple, axis: str = "nodes"):
+    """Device_put the solve_task_group argument tuple with node-axis rows
+    sharded and everything else replicated.
+
+    Argument order mirrors kernels.solve_task_group:
+      0 available (N,D)   sharded    8 active (K,)          repl
+      1 used0 (N,D)       sharded    9 spread_val_id (S,N)  sharded ax1
+      2 placed_tg0 (N,)   sharded   10 spread_val_ok (S,N)  sharded ax1
+      3 placed_job0 (N,)  sharded   11 spread_counts0 (S,V) repl
+      4 ask (D,)          repl      12 spread_desired (S,V) repl
+      5 feasible (N,)     sharded   13 spread_has_targets   repl
+      6 affinity (N,)     sharded   14 spread_weight (S,)   repl
+      7 penalty_idx (K,)  repl      15.. scalars            repl
+    """
+    specs = [
+        P(axis, None), P(axis, None), P(axis), P(axis),
+        P(), P(axis), P(axis), P(), P(),
+        P(None, axis), P(None, axis), P(), P(), P(), P(),
+    ]
+    specs += [P()] * (len(args) - len(specs))
+    out = []
+    for a, spec in zip(args, specs):
+        out.append(jax.device_put(a, NamedSharding(mesh, spec)))
+    return tuple(out)
+
+
+def solve_task_group_sharded(mesh: Mesh, args: tuple, axis: str = "nodes"):
+    """Run the placement solve with the node axis sharded over `mesh`.
+
+    The same jitted kernel as the single-chip path: XLA propagates the
+    input shardings through the scan and inserts ICI collectives for the
+    global argmax each step.
+    """
+    from .kernels import solve_task_group
+
+    sharded = shard_solve_args(mesh, args, axis)
+    return solve_task_group(*sharded)

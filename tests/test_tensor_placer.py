@@ -281,4 +281,5 @@ def test_tpu_beats_or_matches_host_binpack_score():
     tpu_score = run(_tpu_config())
     host_score = run(SchedulerConfiguration(
         scheduler_algorithm=enums.SCHED_ALG_BINPACK))
-    assert tpu_score >= host_score - 1e-9
+    # production solve runs float32 (pack_solve_args); allow its rounding
+    assert tpu_score >= host_score - 1e-5
