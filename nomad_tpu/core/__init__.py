@@ -1,0 +1,18 @@
+"""Control plane (reference nomad/ server core, SURVEY.md §2.2).
+
+Single-process composition of the leader-side subsystems around the MVCC
+state store and the scheduler/tensor layers:
+
+- broker.py      — EvalBroker: priority queues, per-job serialization,
+                   ack/nack redelivery, delayed evals
+- blocked.py     — BlockedEvals: unplaceable evals, class-keyed unblock
+- plan_apply.py  — PlanQueue + serialized plan applier (the optimistic-
+                   concurrency commit point, partial commits)
+- worker.py      — scheduler workers: dequeue -> snapshot -> process
+- heartbeat.py   — node TTL heartbeats -> down -> reschedule evals
+- server.py      — Server: wiring + the RPC-endpoint-shaped API surface
+"""
+
+from .server import Server, ServerConfig
+
+__all__ = ["Server", "ServerConfig"]

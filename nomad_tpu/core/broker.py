@@ -1,0 +1,284 @@
+"""Evaluation broker (reference nomad/eval_broker.go, 1,117 LoC).
+
+Leader-only in-memory dispatch queue for evaluations:
+
+- one ready queue per scheduler type, priority-ordered FIFO
+  (eval_broker.go:53 ready heaps);
+- per-job serialization: at most one eval per job is ready/unacked at a
+  time, the rest wait in a per-job pending heap and are promoted on ack
+  (eval_broker.go:214 enqueueLocked / :599 Ack);
+- dequeue hands out a delivery token; ack/nack must present it
+  (eval_broker.go:385,599);
+- un-acked evals are redelivered after nack_timeout; each delivery
+  increments a counter and past delivery_limit the eval lands in the
+  "_failed" queue for the leader to reap (eval_broker.go:28,678,728);
+- evals with wait_until in the future sit in a delay heap and enter the
+  ready queue when due (eval_broker.go:873 delayed evals).
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+import heapq
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..structs import enums
+from ..structs.evaluation import Evaluation
+from ..utils import generate_uuid
+
+FAILED_QUEUE = "_failed"
+DEFAULT_NACK_TIMEOUT = 5.0
+DEFAULT_DELIVERY_LIMIT = 3
+
+
+class EvalBroker:
+    def __init__(self, nack_timeout: float = DEFAULT_NACK_TIMEOUT,
+                 delivery_limit: int = DEFAULT_DELIVERY_LIMIT):
+        self.nack_timeout = nack_timeout
+        self.delivery_limit = delivery_limit
+
+        self._lock = threading.Condition()
+        self._enabled = False
+        self._seq = itertools.count()
+
+        # sched type -> heap of (-priority, seq, eval_id)
+        self._ready: Dict[str, List[Tuple[int, int, str]]] = {}
+        self._evals: Dict[str, Evaluation] = {}          # eval id -> eval (ready or unacked)
+        self._job_tracked: Dict[Tuple[str, str], str] = {}  # (ns, job) -> ready/unacked eval id
+        # (ns, job) -> heap of (-modify_index, seq, eval) waiting their turn
+        self._pending: Dict[Tuple[str, str], List[Tuple[int, int, Evaluation]]] = {}
+        self._unacked: Dict[str, dict] = {}              # eval id -> {token, deliveries, timer}
+        self._delay: List[Tuple[float, int, Evaluation]] = []  # (wait_until, seq, eval)
+        self._delivery_counts: Dict[str, int] = {}
+        self._failed: List[Evaluation] = []
+        self._cancelled: List[Evaluation] = []           # superseded pending evals
+        self._delay_thread: Optional[threading.Thread] = None
+        self.stats = {"enqueued": 0, "dequeued": 0, "acked": 0, "nacked": 0}
+
+    # -- lifecycle --
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            if enabled and not self._enabled:
+                self._enabled = True
+                self._delay_thread = threading.Thread(
+                    target=self._run_delay, daemon=True, name="broker-delay")
+                self._delay_thread.start()
+            elif not enabled and self._enabled:
+                self._enabled = False
+                self._flush_locked()
+                self._lock.notify_all()
+
+    def _flush_locked(self) -> None:
+        for info in self._unacked.values():
+            t = info.get("timer")
+            if t is not None:
+                t.cancel()
+        self._ready.clear()
+        self._evals.clear()
+        self._job_tracked.clear()
+        self._pending.clear()
+        self._unacked.clear()
+        self._delay.clear()
+        self._failed.clear()
+        self._cancelled.clear()
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    # -- enqueue --
+
+    def enqueue(self, ev: Evaluation) -> None:
+        with self._lock:
+            if not self._enabled:
+                return
+            self._enqueue_locked(ev)
+            self._lock.notify_all()
+
+    def enqueue_all(self, evals: List[Evaluation]) -> None:
+        with self._lock:
+            if not self._enabled:
+                return
+            for ev in evals:
+                self._enqueue_locked(ev)
+            self._lock.notify_all()
+
+    def _enqueue_locked(self, ev: Evaluation) -> None:
+        if ev.id in self._evals or ev.id in self._unacked:
+            return
+        self.stats["enqueued"] += 1
+        now = time.time()
+        if ev.wait_until and ev.wait_until > now:
+            heapq.heappush(self._delay, (ev.wait_until, next(self._seq), ev))
+            self._lock.notify_all()  # delay loop re-sleeps
+            return
+        key = (ev.namespace, ev.job_id)
+        if ev.job_id and key in self._job_tracked:
+            # a sibling eval for this job is in flight: park in pending
+            # (one ready eval per job, eval_broker.go:214)
+            heapq.heappush(self._pending.setdefault(key, []),
+                           (-ev.modify_index, next(self._seq), ev))
+            return
+        if ev.job_id:
+            self._job_tracked[key] = ev.id
+        self._evals[ev.id] = ev
+        queue = FAILED_QUEUE if ev.status == enums.EVAL_STATUS_FAILED else ev.type
+        heapq.heappush(self._ready.setdefault(queue, []),
+                       (-ev.priority, next(self._seq), ev.id))
+
+    # -- dequeue --
+
+    def dequeue(self, sched_types: List[str], timeout: Optional[float] = None
+                ) -> Tuple[Optional[Evaluation], str]:
+        """Blocking dequeue across the given queues. -> (eval, token) or
+        (None, "") on timeout/disable."""
+        deadline = None if timeout is None else time.time() + timeout
+        with self._lock:
+            while True:
+                if not self._enabled:
+                    return None, ""
+                best = None
+                for st in sched_types:
+                    heap = self._ready.get(st)
+                    while heap and heap[0][2] not in self._evals:
+                        heapq.heappop(heap)  # stale entry
+                    if heap and (best is None or heap[0] < best[1][0]):
+                        best = (st, heap[0])
+                if best is not None:
+                    st, (negp, seq, eval_id) = best
+                    heapq.heappop(self._ready[st])
+                    ev = self._evals.pop(eval_id)
+                    token = generate_uuid()
+                    timer = threading.Timer(self.nack_timeout,
+                                            self._nack_timeout, (eval_id, token))
+                    timer.daemon = True
+                    info = {"token": token, "eval": ev, "timer": timer,
+                            "deliveries": self._delivery_count(eval_id) + 1}
+                    self._unacked[eval_id] = info
+                    timer.start()
+                    self.stats["dequeued"] += 1
+                    return ev, token
+                remaining = None if deadline is None else deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    return None, ""
+                self._lock.wait(remaining if remaining is not None else 1.0)
+
+    def _delivery_count(self, eval_id: str) -> int:
+        return self._delivery_counts.get(eval_id, 0)
+
+    # -- ack / nack --
+
+    def ack(self, eval_id: str, token: str) -> None:
+        with self._lock:
+            info = self._unacked.get(eval_id)
+            if info is None or info["token"] != token:
+                raise ValueError(f"token mismatch for eval {eval_id}")
+            info["timer"].cancel()
+            del self._unacked[eval_id]
+            self._delivery_counts.pop(eval_id, None)
+            self.stats["acked"] += 1
+            ev = info["eval"]
+            key = (ev.namespace, ev.job_id)
+            if self._job_tracked.get(key) == eval_id:
+                del self._job_tracked[key]
+            # promote the *latest* pending eval for the job; older ones
+            # are superseded -> cancelled (reference eval dedup)
+            pending = self._pending.pop(key, None)
+            if pending:
+                _, _, nxt = heapq.heappop(pending)
+                for _, _, stale in pending:
+                    # record the cancellation on a copy — evals are shared
+                    # with MVCC store snapshots and must not mutate in
+                    # place; the server reaper persists these
+                    upd = _copy.copy(stale)
+                    upd.status = enums.EVAL_STATUS_CANCELLED
+                    upd.status_description = "cancelled after more recent eval was processed"
+                    self._cancelled.append(upd)
+                self._enqueue_locked(nxt)
+                self._lock.notify_all()
+
+    def nack(self, eval_id: str, token: str) -> None:
+        with self._lock:
+            info = self._unacked.get(eval_id)
+            if info is None or info["token"] != token:
+                raise ValueError(f"token mismatch for eval {eval_id}")
+            info["timer"].cancel()
+            del self._unacked[eval_id]
+            self.stats["nacked"] += 1
+            self._redeliver_locked(info)
+
+    def _nack_timeout(self, eval_id: str, token: str) -> None:
+        with self._lock:
+            info = self._unacked.get(eval_id)
+            if info is None or info["token"] != token:
+                return
+            del self._unacked[eval_id]
+            self._redeliver_locked(info)
+
+    def _redeliver_locked(self, info: dict) -> None:
+        ev = info["eval"]
+        key = (ev.namespace, ev.job_id)
+        if self._job_tracked.get(key) == ev.id:
+            del self._job_tracked[key]
+        self._delivery_counts[ev.id] = info["deliveries"]
+        if info["deliveries"] >= self.delivery_limit:
+            # too many failed deliveries: route to the failed queue
+            # (eval_broker.go:28 failedQueue)
+            self._evals[ev.id] = ev
+            if ev.job_id:
+                self._job_tracked[key] = ev.id
+            heapq.heappush(self._ready.setdefault(FAILED_QUEUE, []),
+                           (-ev.priority, next(self._seq), ev.id))
+        else:
+            self._enqueue_locked(ev)
+        self._lock.notify_all()
+
+    # -- delayed evals --
+
+    def _run_delay(self) -> None:
+        while True:
+            with self._lock:
+                if not self._enabled:
+                    return
+                now = time.time()
+                while self._delay and self._delay[0][0] <= now:
+                    _, _, ev = heapq.heappop(self._delay)
+                    ev = _copy.copy(ev)  # store snapshots share the original
+                    ev.wait_until = 0.0
+                    self._enqueue_locked(ev)
+                    self._lock.notify_all()
+                sleep_for = (self._delay[0][0] - now) if self._delay else 0.2
+                self._lock.wait(min(max(sleep_for, 0.01), 0.2))
+
+    # -- introspection --
+
+    def inflight(self) -> int:
+        with self._lock:
+            return len(self._unacked)
+
+    def ready_count(self) -> int:
+        with self._lock:
+            return len(self._evals)
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return sum(len(h) for h in self._pending.values())
+
+    def delayed_count(self) -> int:
+        with self._lock:
+            return len(self._delay)
+
+    def failed_evals(self) -> List[Evaluation]:
+        """Evals parked in the failed queue (leader reaps these)."""
+        with self._lock:
+            heap = self._ready.get(FAILED_QUEUE, [])
+            return [self._evals[eid] for _, _, eid in heap if eid in self._evals]
+
+    def drain_cancelled(self) -> List[Evaluation]:
+        with self._lock:
+            out, self._cancelled = self._cancelled, []
+            return out

@@ -1,0 +1,71 @@
+"""Node heartbeats (reference nomad/heartbeat.go, 264 LoC).
+
+Server-side TTL timer per node. A client that misses its TTL is marked
+down and one evaluation per affected job is created so the schedulers
+move its work (heartbeat.go:117 invalidateHeartbeat ->
+node_endpoint.go:1645 createNodeEvals).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..structs import enums
+from ..structs.evaluation import Evaluation
+from ..utils import generate_uuid
+
+DEFAULT_TTL = 10.0
+
+
+class HeartbeatManager:
+    def __init__(self, server, ttl: float = DEFAULT_TTL):
+        self.server = server
+        self.ttl = ttl
+        self._lock = threading.Lock()
+        self._timers: Dict[str, threading.Timer] = {}
+        self._enabled = False
+        self.stats = {"invalidated": 0}
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+            if not enabled:
+                for t in self._timers.values():
+                    t.cancel()
+                self._timers.clear()
+
+    def reset(self, node_id: str) -> float:
+        """(Re)arm the TTL for a node; returns the TTL the client should
+        heartbeat within (node register / UpdateStatus path)."""
+        with self._lock:
+            if not self._enabled:
+                return self.ttl
+            prev = self._timers.get(node_id)
+            if prev is not None:
+                prev.cancel()
+            t = threading.Timer(self.ttl, self._invalidate, (node_id,))
+            t.daemon = True
+            self._timers[node_id] = t
+            t.start()
+            return self.ttl
+
+    def remove(self, node_id: str) -> None:
+        with self._lock:
+            t = self._timers.pop(node_id, None)
+            if t is not None:
+                t.cancel()
+
+    def _invalidate(self, node_id: str) -> None:
+        with self._lock:
+            if not self._enabled or node_id not in self._timers:
+                return
+            del self._timers[node_id]
+            self.stats["invalidated"] += 1
+        # mark down + create per-job evals (node_endpoint.go:541,1645)
+        self.server.mark_node_down(node_id, reason="heartbeat missed")
+
+    def active(self) -> int:
+        with self._lock:
+            return len(self._timers)

@@ -1,0 +1,228 @@
+"""Plan queue + serialized plan applier
+(reference nomad/plan_queue.go + nomad/plan_apply.go — the
+optimistic-concurrency linchpin).
+
+Scheduler workers race against stale snapshots and submit plans; this
+single applier thread is the only writer of placement results. Per plan:
+
+  1. wait until the store has caught up to the plan's snapshot index
+     (plan_apply.go:217 snapshotMinIndex);
+  2. re-verify every touched node against the *latest* state with the
+     same AllocsFit predicate the scheduler used (plan_apply.go:468,717
+     evaluateNodePlan) — a node whose plan no longer fits (a concurrent
+     plan won the race) is rejected wholesale;
+  3. commit what survived (partial commit) and hand the scheduler a
+     refresh index so it reschedules the remainder against fresher state
+     (plan_apply.go:96-211).
+
+The reference pipelines Raft-apply of plan N with verification of plan
+N+1; with the in-process store the commit is a memory write, so the
+pipelining win is deferred until the replicated log lands.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..structs import allocs_fit, enums
+from ..structs.plan import Plan, PlanResult
+
+
+class PendingPlan:
+    """A submitted plan awaiting the applier (reference plan_queue.go:33)."""
+
+    __slots__ = ("plan", "_event", "result", "error")
+
+    def __init__(self, plan: Plan):
+        self.plan = plan
+        self._event = threading.Event()
+        self.result: Optional[PlanResult] = None
+        self.error: Optional[Exception] = None
+
+    def respond(self, result: Optional[PlanResult], error: Optional[Exception]) -> None:
+        self.result = result
+        self.error = error
+        self._event.set()
+
+    def wait(self, timeout: Optional[float] = None) -> PlanResult:
+        if not self._event.wait(timeout):
+            raise TimeoutError("plan apply timed out")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class PlanQueue:
+    """Priority queue of pending plans (reference plan_queue.go)."""
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._enabled = False
+        self._heap: List[Tuple[int, int, PendingPlan]] = []
+        self._seq = itertools.count()
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+            if not enabled:
+                for _, _, p in self._heap:
+                    p.respond(None, RuntimeError("plan queue disabled"))
+                self._heap.clear()
+            self._lock.notify_all()
+
+    def enqueue(self, plan: Plan) -> PendingPlan:
+        pending = PendingPlan(plan)
+        with self._lock:
+            if not self._enabled:
+                pending.respond(None, RuntimeError("plan queue disabled"))
+                return pending
+            heapq.heappush(self._heap, (-plan.priority, next(self._seq), pending))
+            self._lock.notify_all()
+        return pending
+
+    def dequeue(self, timeout: Optional[float] = None) -> Optional[PendingPlan]:
+        with self._lock:
+            while True:
+                if self._heap:
+                    return heapq.heappop(self._heap)[2]
+                if not self._enabled:
+                    return None
+                if not self._lock.wait(timeout):
+                    return None
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+
+class PlanApplier:
+    """The serialized applier goroutine (reference plan_apply.go:96 planApply)."""
+
+    def __init__(self, store, queue: PlanQueue, logger=None):
+        self.store = store
+        self.queue = queue
+        self.logger = logger
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.stats = {"applied": 0, "nodes_rejected": 0, "partial_commits": 0}
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="plan-applier")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.set_enabled(False)
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            pending = self.queue.dequeue(timeout=0.2)
+            if pending is None:
+                continue
+            try:
+                result = self.apply(pending.plan)
+                pending.respond(result, None)
+            except Exception as e:  # surface to the submitting worker
+                if self.logger:
+                    self.logger.exception("plan apply failed")
+                pending.respond(None, e)
+
+    # -- the serialized verify + commit --
+
+    def apply(self, plan: Plan) -> PlanResult:
+        # catch up to the snapshot the scheduler planned against
+        if plan.snapshot_index:
+            snap = self.store.snapshot_min_index(plan.snapshot_index)
+        else:
+            snap = self.store.snapshot()
+
+        result, rejected = self._evaluate(snap, plan)
+
+        placements, stops, preemptions = [], [], []
+        for allocs in result.node_allocation.values():
+            placements.extend(allocs)
+        for allocs in result.node_update.values():
+            stops.extend(allocs)
+        for allocs in result.node_preemptions.values():
+            preemptions.extend(allocs)
+
+        if placements or stops or preemptions or result.deployment is not None \
+                or result.deployment_updates or plan.eval_updates:
+            index = self.store.upsert_plan_results(
+                placements, stopped_allocs=stops, preempted_allocs=preemptions,
+                deployment=result.deployment,
+                deployment_updates=result.deployment_updates,
+                evals=list(plan.eval_updates),
+            )
+            result.alloc_index = index
+
+        self.stats["applied"] += 1
+        if rejected:
+            self.stats["nodes_rejected"] += len(rejected)
+            self.stats["partial_commits"] += 1
+            result.refresh_index = self.store.latest_index
+            result.rejected_nodes = rejected
+        return result
+
+    def _evaluate(self, snap, plan: Plan) -> Tuple[PlanResult, List[str]]:
+        """Per-node re-verification (reference plan_apply.go:468
+        evaluatePlan + :717 evaluateNodePlan). all_at_once plans commit
+        fully or not at all (structs Plan.AllAtOnce)."""
+        result = PlanResult()
+        rejected: List[str] = []
+        nodes = set(plan.node_allocation) | set(plan.node_update) | set(plan.node_preemptions)
+        for node_id in nodes:
+            if self._node_plan_valid(snap, plan, node_id):
+                if node_id in plan.node_allocation:
+                    result.node_allocation[node_id] = plan.node_allocation[node_id]
+                if node_id in plan.node_update:
+                    result.node_update[node_id] = plan.node_update[node_id]
+                if node_id in plan.node_preemptions:
+                    result.node_preemptions[node_id] = plan.node_preemptions[node_id]
+            else:
+                rejected.append(node_id)
+        if rejected and plan.all_at_once:
+            # all-or-nothing plan: reject everything
+            result.node_allocation.clear()
+            result.node_update.clear()
+            result.node_preemptions.clear()
+            rejected = sorted(nodes)
+            return result, rejected
+        result.deployment = plan.deployment
+        result.deployment_updates = plan.deployment_updates
+        return result, rejected
+
+    def _node_plan_valid(self, snap, plan: Plan, node_id: str) -> bool:
+        node = snap.node_by_id(node_id)
+        placements = plan.node_allocation.get(node_id, [])
+        if node is None:
+            # stops/preemptions against a vanished node are fine; new
+            # placements are not
+            return not placements
+        # placements are only valid on ready, non-draining nodes;
+        # evictions are always allowed (plan_apply.go:789-812 validity
+        # gates). A node that started draining after the scheduler's
+        # snapshot must not receive the stale placement.
+        if placements and (node.status != enums.NODE_STATUS_READY or node.drain):
+            return False
+        if not placements:
+            return True
+
+        existing = snap.allocs_by_node_terminal(node_id, False)
+        removed = {a.id for a in plan.node_update.get(node_id, ())}
+        removed |= {a.id for a in plan.node_preemptions.get(node_id, ())}
+        proposed = [a for a in existing if a.id not in removed]
+        placed_ids = {a.id for a in placements}
+        proposed = [a for a in proposed if a.id not in placed_ids]
+        proposed.extend(placements)
+
+        check_devices = any(a.allocated_devices for a in proposed)
+        fit, _, _ = allocs_fit(node, proposed, check_devices=check_devices)
+        return fit

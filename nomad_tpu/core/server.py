@@ -1,0 +1,350 @@
+"""Server: single-process control-plane composition
+(reference nomad/server.go + leader.go establishLeadership).
+
+Wires the MVCC state store to the eval broker, blocked-evals tracker,
+plan queue/applier, scheduler worker pool, and heartbeat manager, and
+exposes the RPC-endpoint-shaped API (Job.Register, Node.Register,
+Node.UpdateStatus, Node.UpdateAlloc, Eval.*) that the HTTP layer and CLI
+sit on. Leadership is implicit (single server); the replicated-log
+boundary is the store's commit path, so a Raft transport can slot in
+beneath without touching this layer.
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..state import StateStore
+from ..structs import enums
+from ..structs.evaluation import Evaluation
+from ..structs.job import Job
+from ..structs.node import Node
+from ..structs.operator import SchedulerConfiguration
+from ..utils import generate_uuid
+from .blocked import BlockedEvals
+from .broker import EvalBroker
+from .heartbeat import HeartbeatManager
+from .plan_apply import PlanApplier, PlanQueue
+from .worker import Worker
+
+
+@dataclass
+class ServerConfig:
+    num_workers: int = 2
+    heartbeat_ttl: float = 10.0
+    nack_timeout: float = 5.0
+    eval_delivery_limit: int = 3
+    # backoff before a delivery-limited eval is retried
+    # (reference leader.go failedEvalUnblockInterval)
+    failed_eval_followup_delay: float = 60.0
+    sched_config: SchedulerConfiguration = field(default_factory=SchedulerConfiguration)
+
+
+class Server:
+    def __init__(self, config: Optional[ServerConfig] = None,
+                 store: Optional[StateStore] = None, logger=None):
+        self.config = config or ServerConfig()
+        self.store = store or StateStore()
+        self.logger = logger or logging.getLogger("nomad_tpu.server")
+        self.sched_config = self.config.sched_config
+
+        self.broker = EvalBroker(nack_timeout=self.config.nack_timeout,
+                                 delivery_limit=self.config.eval_delivery_limit)
+        self.blocked = BlockedEvals(self._requeue_unblocked)
+        self.plan_queue = PlanQueue()
+        self.plan_applier = PlanApplier(self.store, self.plan_queue, self.logger)
+        self.heartbeats = HeartbeatManager(self, ttl=self.config.heartbeat_ttl)
+        self.workers: List[Worker] = [
+            Worker(self, i) for i in range(self.config.num_workers)]
+        self._running = False
+        self.store.add_commit_listener(self._on_commit)
+
+    # -- lifecycle (leader.go:357 establishLeadership) --
+
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self.plan_queue.set_enabled(True)
+        self.plan_applier.start()
+        self.broker.set_enabled(True)
+        self.blocked.set_enabled(True)
+        self.heartbeats.set_enabled(True)
+        self._restore_evals()
+        for w in self.workers:
+            w.start()
+        self._reaper = threading.Thread(target=self._run_reaper, daemon=True,
+                                        name="eval-reaper")
+        self._reaper.start()
+
+    def stop(self) -> None:
+        if not self._running:
+            return
+        self._running = False
+        for w in self.workers:
+            w.stop()
+        for w in self.workers:
+            w.join()
+        self.heartbeats.set_enabled(False)
+        self.blocked.set_enabled(False)
+        self.broker.set_enabled(False)
+        self.plan_applier.stop()
+        self._reaper.join(timeout=2.0)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _restore_evals(self) -> None:
+        """Re-enqueue non-terminal evals after (re)start
+        (leader.go:389-403 restoreEvals)."""
+        snap = self.store.snapshot()
+        for ev in snap.evals():
+            if ev.should_enqueue():
+                self.broker.enqueue(ev)
+            elif ev.should_block():
+                self.blocked.block(ev)
+
+    # -- commit listener: unblock blocked evals on cluster changes --
+
+    def _on_commit(self, index: int, events: list) -> None:
+        for kind, payload in events:
+            if kind in ("node-upsert", "node-status", "node-eligibility", "node-drain"):
+                if payload is not None and payload.ready():
+                    self.blocked.unblock(payload.computed_class)
+            elif kind in ("alloc-stop", "alloc-preempt", "alloc-client-update",
+                          "alloc-transition"):
+                # capacity freed by a terminal alloc can unblock evals
+                # (reference fsm.go:412,470 Unblock on alloc updates)
+                a = payload
+                if a is not None and (a.terminal_status() or a.server_terminal()):
+                    self.blocked.unblock("")
+
+    def _requeue_unblocked(self, ev: Evaluation) -> None:
+        """An unblocked eval re-enters the broker as pending; persist the
+        transition on a copy (store snapshots share the object)."""
+        upd = _copy.copy(ev)
+        upd.status = enums.EVAL_STATUS_PENDING
+        upd.wait_until = 0.0
+        index = self.store.upsert_evals([upd])
+        upd.modify_index = index
+        self.broker.enqueue(upd)
+
+    # -- failed-eval reaper (leader.go:1162 reapFailedEvaluations) --
+
+    def _run_reaper(self) -> None:
+        while self._running:
+            # persist cancellations of superseded pending evals
+            cancelled = self.broker.drain_cancelled()
+            if cancelled:
+                self.store.upsert_evals(cancelled)
+            # delivery-limited evals: mark failed, schedule a follow-up
+            from .broker import FAILED_QUEUE
+
+            ev, token = self.broker.dequeue([FAILED_QUEUE], timeout=0.1)
+            if ev is None:
+                continue
+            failed = _copy.copy(ev)
+            failed.status = enums.EVAL_STATUS_FAILED
+            failed.status_description = "evaluation reached delivery limit"
+            followup = Evaluation(
+                id=generate_uuid(),
+                namespace=ev.namespace,
+                priority=ev.priority,
+                type=ev.type,
+                triggered_by=enums.TRIGGER_FAILED_FOLLOW_UP,
+                job_id=ev.job_id,
+                status=enums.EVAL_STATUS_PENDING,
+                wait_until=time.time() + self.config.failed_eval_followup_delay,
+                previous_eval=ev.id,
+                create_time=time.time(),
+            )
+            index = self.store.upsert_evals([failed, followup])
+            followup.modify_index = index
+            try:
+                self.broker.ack(ev.id, token)
+            except ValueError:
+                pass
+            self.broker.enqueue(followup)
+
+    # -- Job endpoints (nomad/job_endpoint.go) --
+
+    def register_job(self, job: Job) -> str:
+        """Job.Register: upsert + create an eval. Returns the eval id."""
+        if self.sched_config.reject_job_registration:
+            raise PermissionError("job registration disabled")
+        self.store.upsert_job(job)
+        return self._create_job_eval(job, enums.TRIGGER_JOB_REGISTER)
+
+    def deregister_job(self, job_id: str, namespace: str = "default",
+                       purge: bool = False) -> str:
+        snap = self.store.snapshot()
+        job = snap.job_by_id(job_id, namespace)
+        self.store.delete_job(job_id, namespace, purge=purge)
+        self.blocked.untrack_job(namespace, job_id)
+        if job is None:
+            return ""
+        return self._create_job_eval(job, enums.TRIGGER_JOB_DEREGISTER,
+                                     namespace=namespace)
+
+    def _create_job_eval(self, job: Job, trigger: str,
+                         namespace: Optional[str] = None) -> str:
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=namespace or job.namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=trigger,
+            job_id=job.id,
+            status=enums.EVAL_STATUS_PENDING,
+            create_time=time.time(),
+        )
+        index = self.store.upsert_evals([ev])
+        ev.modify_index = index
+        self.broker.enqueue(ev)
+        return ev.id
+
+    # -- Node endpoints (nomad/node_endpoint.go) --
+
+    def register_node(self, node: Node) -> float:
+        """Node.Register -> heartbeat TTL. A ready node triggers evals so
+        system jobs land on it (node_endpoint.go createNodeEvals on
+        node-up)."""
+        if not node.computed_class:
+            node.compute_class()
+        self.store.upsert_node(node)
+        if node.ready():
+            self._create_node_evals(node.id)
+        return self.heartbeats.reset(node.id)
+
+    def heartbeat(self, node_id: str) -> float:
+        """Node.UpdateStatus(ready) from a live client."""
+        return self.heartbeats.reset(node_id)
+
+    def update_node_status(self, node_id: str, status: str) -> None:
+        self.store.update_node_status(node_id, status, ts=time.time())
+        if status == enums.NODE_STATUS_DOWN:
+            self.heartbeats.remove(node_id)
+            self._create_node_evals(node_id)
+        elif status == enums.NODE_STATUS_READY:
+            self.heartbeats.reset(node_id)
+            self._create_node_evals(node_id)
+
+    def mark_node_down(self, node_id: str, reason: str = "") -> None:
+        self.update_node_status(node_id, enums.NODE_STATUS_DOWN)
+
+    def update_node_drain(self, node_id: str, drain_strategy,
+                          mark_eligible: bool = False) -> None:
+        self.store.update_node_drain(node_id, drain_strategy, mark_eligible)
+        self._create_node_evals(node_id)
+
+    def update_node_eligibility(self, node_id: str, eligibility: str) -> None:
+        self.store.update_node_eligibility(node_id, eligibility)
+
+    def _create_node_evals(self, node_id: str) -> List[str]:
+        """One eval per job with allocs on the node
+        (node_endpoint.go:1645 createNodeEvals)."""
+        snap = self.store.snapshot()
+        node = snap.node_by_id(node_id)
+        jobs: Dict[tuple, Job] = {}
+        for alloc in snap.allocs_by_node(node_id):
+            if alloc.terminal_status():
+                continue
+            job = snap.job_by_id(alloc.job_id, alloc.namespace)
+            if job is not None:
+                jobs[(alloc.namespace, alloc.job_id)] = job
+        # system jobs must also re-evaluate when a node comes up
+        if node is not None and node.ready():
+            for job in snap.jobs():
+                if job.type in (enums.JOB_TYPE_SYSTEM, enums.JOB_TYPE_SYSBATCH):
+                    jobs[(job.namespace, job.id)] = job
+        out = []
+        evals = []
+        for job in jobs.values():
+            ev = Evaluation(
+                id=generate_uuid(),
+                namespace=job.namespace,
+                priority=job.priority,
+                type=job.type,
+                triggered_by=enums.TRIGGER_NODE_UPDATE,
+                job_id=job.id,
+                node_id=node_id,
+                status=enums.EVAL_STATUS_PENDING,
+                create_time=time.time(),
+            )
+            evals.append(ev)
+            out.append(ev.id)
+        if evals:
+            index = self.store.upsert_evals(evals)
+            for ev in evals:
+                ev.modify_index = index
+            self.broker.enqueue_all(evals)
+        return out
+
+    def update_allocs_from_client(self, updates: List) -> None:
+        """Node.UpdateAlloc: batched client -> server alloc status sync;
+        failed allocs trigger reschedule evals (node_endpoint.go
+        UpdateAlloc -> createRescheduleEvals)."""
+        self.store.update_allocs_from_client(updates)
+        snap = self.store.snapshot()
+        seen = set()
+        evals = []
+        for upd in updates:
+            if upd.client_status not in (enums.ALLOC_CLIENT_FAILED,):
+                continue
+            key = (upd.namespace, upd.job_id)
+            if key in seen:
+                continue
+            seen.add(key)
+            job = snap.job_by_id(upd.job_id, upd.namespace)
+            if job is None:
+                continue
+            evals.append(Evaluation(
+                id=generate_uuid(),
+                namespace=job.namespace,
+                priority=job.priority,
+                type=job.type,
+                triggered_by=enums.TRIGGER_RETRY_FAILED_ALLOC,
+                job_id=job.id,
+                status=enums.EVAL_STATUS_PENDING,
+                create_time=time.time(),
+            ))
+        if evals:
+            index = self.store.upsert_evals(evals)
+            for ev in evals:
+                ev.modify_index = index
+            self.broker.enqueue_all(evals)
+
+    # -- Eval endpoints --
+
+    def create_eval(self, ev: Evaluation) -> str:
+        index = self.store.upsert_evals([ev])
+        ev.modify_index = index
+        if ev.should_enqueue():
+            self.broker.enqueue(ev)
+        return ev.id
+
+    # -- test/ops helpers --
+
+    def wait_for_idle(self, timeout: float = 10.0,
+                      include_delayed: bool = True) -> bool:
+        """Block until no evals are ready, in flight, or (by default)
+        parked in the delay heap (tests/ops)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if (self.broker.ready_count() == 0
+                    and self.broker.inflight() == 0
+                    and self.broker.pending_count() == 0
+                    and (not include_delayed or self.broker.delayed_count() == 0)
+                    and self.plan_queue.depth() == 0):
+                return True
+            time.sleep(0.01)
+        return False

@@ -1,0 +1,116 @@
+"""Scheduler workers (reference nomad/worker.go, 905 LoC).
+
+Each worker loops: dequeue an eval from the broker, wait for the state
+store to reach the eval's modify index (worker.go:591 snapshotMinIndex),
+instantiate the right scheduler against that immutable snapshot, run it,
+and ack/nack. The worker is also the scheduler's Planner: plan submission
+routes through the leader plan queue and blocks on the applier's verdict
+(worker.go:650 SubmitPlan); partial commits hand back a fresher snapshot
+so the scheduler retries in-process.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from ..scheduler.scheduler import NewScheduler
+from ..structs import enums
+from ..structs.evaluation import Evaluation
+from ..structs.plan import Plan
+
+ALL_SCHED_TYPES = [
+    enums.JOB_TYPE_SERVICE, enums.JOB_TYPE_BATCH,
+    enums.JOB_TYPE_SYSTEM, enums.JOB_TYPE_SYSBATCH,
+]
+
+
+class Worker:
+    def __init__(self, server, worker_id: int = 0,
+                 sched_types: Optional[List[str]] = None):
+        self.server = server
+        self.id = worker_id
+        self.sched_types = sched_types or list(ALL_SCHED_TYPES)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stats = {"processed": 0, "nacked": 0}
+        # set per-eval; consulted by the Planner interface
+        self._snapshot = None
+        self._eval: Optional[Evaluation] = None
+        self._token: str = ""
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name=f"worker-{self.id}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def join(self, timeout: float = 2.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- the loop (worker.go:397 run) --
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            ev, token = self.server.broker.dequeue(self.sched_types, timeout=0.2)
+            if ev is None:
+                continue
+            self.process_one(ev, token)
+
+    def process_one(self, ev: Evaluation, token: str) -> None:
+        self._eval, self._token = ev, token
+        try:
+            snap = self.server.store.snapshot_min_index(ev.modify_index)
+            self._snapshot = snap
+            sched = NewScheduler(ev.type, snap, self,
+                                 sched_config=self.server.sched_config,
+                                 logger=self.server.logger)
+            sched.process(ev)
+            self.server.broker.ack(ev.id, token)
+            self.stats["processed"] += 1
+        except Exception:
+            if self.server.logger:
+                self.server.logger.exception("eval %s failed", ev.id)
+            self.stats["nacked"] += 1
+            try:
+                self.server.broker.nack(ev.id, token)
+            except ValueError:
+                pass  # nack timer already fired
+        finally:
+            self._eval = self._token = None
+            self._snapshot = None
+
+    # -- Planner interface (worker.go:650-802) --
+
+    def submit_plan(self, plan: Plan):
+        plan.snapshot_index = getattr(self._snapshot, "index", 0) or 0
+        pending = self.server.plan_queue.enqueue(plan)
+        result = pending.wait(timeout=10.0)
+        if result.refresh_index:
+            # partial commit: hand the scheduler a fresher snapshot
+            new_snap = self.server.store.snapshot_min_index(result.refresh_index)
+            self._snapshot = new_snap
+            return result, new_snap
+        return result, None
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.server.store.upsert_evals([ev])
+        if ev.should_block():
+            self.server.blocked.block(ev)
+
+    def create_eval(self, ev: Evaluation) -> None:
+        self.server.store.upsert_evals([ev])
+        if ev.should_block():
+            self.server.blocked.block(ev)
+        elif ev.should_enqueue():
+            self.server.broker.enqueue(ev)
+
+    def reblock_eval(self, ev: Evaluation) -> None:
+        self.server.store.upsert_evals([ev])
+        self.server.blocked.block(ev)

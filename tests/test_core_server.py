@@ -1,0 +1,429 @@
+"""Control-plane tests: broker, blocked evals, plan applier, workers,
+heartbeats, and the in-process Server end to end
+(the reference's testing insight: every distributed behavior testable
+single-process, SURVEY.md §4).
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core import Server, ServerConfig
+from nomad_tpu.core.broker import EvalBroker
+from nomad_tpu.core.plan_apply import PlanApplier, PlanQueue
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import Constraint, enums
+from nomad_tpu.structs.operator import SchedulerConfiguration
+from nomad_tpu.structs.plan import Plan
+
+
+# ---------------------------------------------------------------------------
+# EvalBroker
+# ---------------------------------------------------------------------------
+
+
+class TestBroker:
+    def test_enqueue_dequeue_ack(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        ev = mock.eval_for(mock.job())
+        b.enqueue(ev)
+        got, token = b.dequeue([ev.type], timeout=1.0)
+        assert got.id == ev.id
+        assert b.inflight() == 1
+        b.ack(ev.id, token)
+        assert b.inflight() == 0
+
+    def test_priority_order(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        j1, j2 = mock.job(), mock.job()
+        lo = mock.eval_for(j1, priority=10)
+        hi = mock.eval_for(j2, priority=90)
+        b.enqueue(lo)
+        b.enqueue(hi)
+        got, tok = b.dequeue([enums.JOB_TYPE_SERVICE], timeout=1.0)
+        assert got.id == hi.id
+        b.ack(got.id, tok)
+
+    def test_per_job_serialization(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        j = mock.job()
+        e1 = mock.eval_for(j)
+        e2 = mock.eval_for(j)
+        e2.modify_index = 99
+        b.enqueue(e1)
+        b.enqueue(e2)
+        got1, tok1 = b.dequeue([enums.JOB_TYPE_SERVICE], timeout=1.0)
+        # second eval for the same job must wait
+        got2, _ = b.dequeue([enums.JOB_TYPE_SERVICE], timeout=0.05)
+        assert got2 is None
+        b.ack(got1.id, tok1)
+        got3, tok3 = b.dequeue([enums.JOB_TYPE_SERVICE], timeout=1.0)
+        assert got3.id == e2.id
+        b.ack(got3.id, tok3)
+
+    def test_pending_promotes_latest_and_cancels_stale(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        j = mock.job()
+        first = mock.eval_for(j)
+        old = mock.eval_for(j)
+        old.modify_index = 5
+        new = mock.eval_for(j)
+        new.modify_index = 10
+        for e in (first, old, new):
+            b.enqueue(e)
+        got, tok = b.dequeue([enums.JOB_TYPE_SERVICE], timeout=1.0)
+        b.ack(got.id, tok)
+        got2, tok2 = b.dequeue([enums.JOB_TYPE_SERVICE], timeout=1.0)
+        assert got2.id == new.id  # latest modify index wins
+        b.ack(got2.id, tok2)
+        cancelled = b.drain_cancelled()
+        assert [e.id for e in cancelled] == [old.id]
+        assert cancelled[0].status == enums.EVAL_STATUS_CANCELLED
+
+    def test_nack_redelivers_then_fails(self):
+        b = EvalBroker(delivery_limit=2)
+        b.set_enabled(True)
+        ev = mock.eval_for(mock.job())
+        b.enqueue(ev)
+        got, tok = b.dequeue([ev.type], timeout=1.0)
+        b.nack(got.id, tok)
+        got2, tok2 = b.dequeue([ev.type], timeout=1.0)  # redelivery 2
+        assert got2.id == ev.id
+        b.nack(got2.id, tok2)
+        # delivery limit hit -> failed queue, not the regular one
+        got3, _ = b.dequeue([ev.type], timeout=0.05)
+        assert got3 is None
+        assert [e.id for e in b.failed_evals()] == [ev.id]
+
+    def test_nack_timeout_redelivery(self):
+        b = EvalBroker(nack_timeout=0.1)
+        b.set_enabled(True)
+        ev = mock.eval_for(mock.job())
+        b.enqueue(ev)
+        got, tok = b.dequeue([ev.type], timeout=1.0)
+        # don't ack: the timeout should put it back
+        got2, tok2 = b.dequeue([ev.type], timeout=1.0)
+        assert got2.id == ev.id
+        b.ack(got2.id, tok2)
+        with pytest.raises(ValueError):
+            b.ack(ev.id, tok)  # stale token rejected
+
+    def test_delayed_eval(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        ev = mock.eval_for(mock.job())
+        ev.wait_until = time.time() + 0.15
+        b.enqueue(ev)
+        got, _ = b.dequeue([ev.type], timeout=0.05)
+        assert got is None
+        got, tok = b.dequeue([ev.type], timeout=1.0)
+        assert got.id == ev.id
+        b.ack(got.id, tok)
+
+
+# ---------------------------------------------------------------------------
+# Plan applier
+# ---------------------------------------------------------------------------
+
+
+class TestPlanApplier:
+    def _applier(self, store):
+        q = PlanQueue()
+        q.set_enabled(True)
+        return PlanApplier(store, q), q
+
+    def test_commit_and_partial_commit(self):
+        store = StateStore()
+        node = mock.node()
+        node.resources.cpu = 1000
+        node.resources.memory_mb = 1024
+        node.compute_class()
+        store.upsert_node(node)
+        job = mock.job()
+        store.upsert_job(job)
+        applier, _ = self._applier(store)
+
+        # plan 1: fits
+        a1 = mock.alloc(job, node, index=0)
+        a1.allocated_vec = mock.Resources(cpu=600, memory_mb=512).vec() \
+            if hasattr(mock, "Resources") else a1.allocated_vec
+        p1 = Plan(eval_id="e1", snapshot_index=store.latest_index)
+        p1.append_alloc(a1)
+        r1 = applier.apply(p1)
+        assert r1.refresh_index == 0
+        assert store.snapshot().alloc_by_id(a1.id) is not None
+
+        # plan 2 from a stale snapshot: collides -> whole node rejected
+        a2 = mock.alloc(job, node, index=1)
+        a2.allocated_vec = a1.allocated_vec
+        p2 = Plan(eval_id="e2", snapshot_index=0)
+        p2.append_alloc(a2)
+        r2 = applier.apply(p2)
+        assert r2.refresh_index > 0
+        assert r2.rejected_nodes == [node.id]
+        assert store.snapshot().alloc_by_id(a2.id) is None
+
+    def test_all_at_once_rejects_everything(self):
+        store = StateStore()
+        n1, n2 = mock.node(), mock.node()
+        n1.resources.cpu = 500
+        n1.resources.memory_mb = 256
+        n1.compute_class()
+        for n in (n1, n2):
+            store.upsert_node(n)
+        job = mock.job()
+        store.upsert_job(job)
+        applier, _ = self._applier(store)
+
+        p = Plan(eval_id="e1", all_at_once=True)
+        big = mock.alloc(job, n1, index=0)  # 500MHz/256MB just fits n1...
+        # make it not fit
+        big.allocated_vec = big.allocated_vec * 10
+        ok = mock.alloc(job, n2, index=1)
+        p.append_alloc(big)
+        p.append_alloc(ok)
+        r = applier.apply(p)
+        assert not r.node_allocation  # nothing committed
+        assert set(r.rejected_nodes) == {n1.id, n2.id}
+
+    def test_stops_apply_even_on_down_node(self):
+        store = StateStore()
+        node = mock.node()
+        store.upsert_node(node)
+        job = mock.job()
+        store.upsert_job(job)
+        a = mock.alloc(job, node, index=0)
+        store.upsert_allocs([a])
+        store.update_node_status(node.id, enums.NODE_STATUS_DOWN)
+        applier, _ = self._applier(store)
+        p = Plan(eval_id="e1")
+        p.append_stopped_alloc(a, "node down", client_status=enums.ALLOC_CLIENT_LOST)
+        r = applier.apply(p)
+        assert r.refresh_index == 0
+        got = store.snapshot().alloc_by_id(a.id)
+        assert got.desired_status == enums.ALLOC_DESIRED_STOP
+
+
+# ---------------------------------------------------------------------------
+# Server end-to-end
+# ---------------------------------------------------------------------------
+
+
+def _server(algorithm=enums.SCHED_ALG_BINPACK, **kw):
+    cfg = ServerConfig(
+        sched_config=SchedulerConfiguration(scheduler_algorithm=algorithm), **kw)
+    return Server(cfg)
+
+
+class TestServerE2E:
+    def test_register_job_places_allocs(self):
+        with _server() as s:
+            for _ in range(5):
+                s.register_node(mock.node())
+            job = mock.job()
+            s.register_job(job)
+            assert s.wait_for_idle()
+            allocs = s.store.snapshot().allocs_by_job(job.id)
+            assert len(allocs) == 10
+
+    def test_tpu_algorithm_end_to_end(self):
+        with _server(algorithm=enums.SCHED_ALG_TPU_BINPACK) as s:
+            for _ in range(5):
+                s.register_node(mock.node())
+            job = mock.job()
+            s.register_job(job)
+            assert s.wait_for_idle(30.0)
+            allocs = s.store.snapshot().allocs_by_job(job.id)
+            assert len(allocs) == 10
+
+    def test_concurrent_jobs_parallel_workers(self):
+        with _server(num_workers=4) as s:
+            for _ in range(10):
+                s.register_node(mock.node())
+            jobs = [mock.job() for _ in range(8)]
+            for j in jobs:
+                s.register_job(j)
+            assert s.wait_for_idle(30.0)
+            snap = s.store.snapshot()
+            for j in jobs:
+                assert len(snap.allocs_by_job(j.id)) == 10, j.id
+            # optimistic concurrency: whatever raced, nothing oversubscribed
+            for n in snap.nodes():
+                used = sum(a.allocated_vec for a in snap.allocs_by_node(n.id)
+                           if a.should_count_for_usage())
+                assert (used <= n.available_vec()).all()
+
+    def test_blocked_eval_unblocks_on_new_node(self):
+        with _server() as s:
+            small = mock.node()
+            small.resources.cpu = 600
+            small.resources.memory_mb = 512
+            small.compute_class()
+            s.register_node(small)
+            job = mock.job()  # 10 x 500MHz/256MB: only 1 fits
+            s.register_job(job)
+            assert s.wait_for_idle(10.0)
+            placed = s.store.snapshot().allocs_by_job(job.id)
+            assert len(placed) == 1
+            assert s.blocked.blocked_count() == 1
+            # capacity arrives: blocked eval is released and placements finish
+            big = mock.node()
+            big.resources.cpu = 32000
+            big.resources.memory_mb = 65536
+            big.compute_class()
+            s.register_node(big)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                allocs = [a for a in s.store.snapshot().allocs_by_job(job.id)
+                          if not a.terminal_status()]
+                if len(allocs) == 10:
+                    break
+                time.sleep(0.05)
+            assert len(allocs) == 10
+
+    def test_heartbeat_expiry_reschedules(self):
+        with Server(ServerConfig(heartbeat_ttl=0.2)) as s:
+            n1, n2 = mock.node(), mock.node()
+            s.register_node(n1)
+            s.register_node(n2)
+            job = mock.job()
+            job.task_groups[0].count = 2
+            s.register_job(job)
+            assert s.wait_for_idle(10.0)
+            victims = s.store.snapshot().allocs_by_node(n1.id)
+            # keep n2 alive, let n1 miss its TTL
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                s.heartbeat(n2.id)
+                node = s.store.snapshot().node_by_id(n1.id)
+                if node.status == enums.NODE_STATUS_DOWN:
+                    break
+                time.sleep(0.05)
+            assert s.store.snapshot().node_by_id(n1.id).status == enums.NODE_STATUS_DOWN
+            s.wait_for_idle(10.0)
+            live = [a for a in s.store.snapshot().allocs_by_job(job.id)
+                    if not a.terminal_status() and not a.server_terminal()]
+            assert len(live) == 2
+            assert all(a.node_id == n2.id for a in live)
+
+    def test_failed_alloc_triggers_reschedule_eval(self):
+        with _server() as s:
+            for _ in range(3):
+                s.register_node(mock.node())
+            job = mock.job()
+            job.task_groups[0].count = 1
+            job.task_groups[0].reschedule_policy.delay_s = 0  # immediate retry
+            s.register_job(job)
+            assert s.wait_for_idle(10.0)
+            a = s.store.snapshot().allocs_by_job(job.id)[0]
+            upd = a.copy_for_update()
+            upd.client_status = enums.ALLOC_CLIENT_FAILED
+            s.update_allocs_from_client([upd])
+            assert s.wait_for_idle(10.0)
+            live = [x for x in s.store.snapshot().allocs_by_job(job.id)
+                    if not x.terminal_status()]
+            assert len(live) == 1
+            assert live[0].id != a.id  # replacement chained in
+
+    def test_new_node_gets_system_alloc_via_server(self):
+        """Registering a ready node triggers evals so system jobs land on
+        it without any manual evaluation."""
+        with _server() as s:
+            s.register_node(mock.node())
+            job = mock.system_job()
+            s.register_job(job)
+            assert s.wait_for_idle(10.0)
+            assert len(s.store.snapshot().allocs_by_job(job.id)) == 1
+            late = mock.node()
+            s.register_node(late)
+            assert s.wait_for_idle(10.0)
+            allocs = [a for a in s.store.snapshot().allocs_by_job(job.id)
+                      if not a.terminal_status()]
+            assert len(allocs) == 2
+            assert late.id in {a.node_id for a in allocs}
+
+    def test_blocked_eval_unblocks_when_alloc_frees_capacity(self):
+        with _server() as s:
+            node = mock.node()
+            node.resources.cpu = 1200
+            node.resources.memory_mb = 1024
+            node.compute_class()
+            s.register_node(node)
+            filler = mock.job()
+            filler.task_groups[0].count = 2  # 1000MHz/512MB: fills the node
+            s.register_job(filler)
+            assert s.wait_for_idle(10.0)
+            blocked_job = mock.job()
+            blocked_job.task_groups[0].count = 1
+            s.register_job(blocked_job)
+            assert s.wait_for_idle(10.0)
+            assert s.store.snapshot().allocs_by_job(blocked_job.id) == []
+            assert s.blocked.blocked_count() == 1
+            # stop the filler: freed capacity must release the blocked eval
+            s.deregister_job(filler.id)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                live = [a for a in s.store.snapshot().allocs_by_job(blocked_job.id)
+                        if not a.terminal_status()]
+                if live:
+                    break
+                time.sleep(0.05)
+            assert len(live) == 1
+
+    def test_delivery_limited_eval_reaped_and_job_unwedged(self):
+        """An eval that exhausts its delivery limit is marked failed by
+        the reaper, a follow-up eval is scheduled, and the job's pending
+        evals keep flowing (leader.go:1162 reapFailedEvaluations)."""
+        cfg = ServerConfig(
+            num_workers=0,  # drive the broker by hand
+            eval_delivery_limit=2, failed_eval_followup_delay=0.1)
+        with Server(cfg) as s:
+            job = mock.job()
+            ev = mock.eval_for(job)
+            s.store.upsert_evals([ev])
+            s.broker.enqueue(ev)
+            # a sibling eval for the same job parks in pending
+            sibling = mock.eval_for(job, modify_index=7)
+            s.store.upsert_evals([sibling])
+            s.broker.enqueue(sibling)
+            # nack to the delivery limit
+            for _ in range(2):
+                got, tok = s.broker.dequeue([ev.type], timeout=1.0)
+                assert got.id == ev.id
+                s.broker.nack(got.id, tok)
+            # reaper: failed status persisted + follow-up eval created
+            deadline = time.time() + 5
+            reaped = False
+            while time.time() < deadline:
+                stored = s.store.snapshot().eval_by_id(ev.id)
+                evs = s.store.snapshot().evals_by_job(job.id)
+                if (stored is not None
+                        and stored.status == enums.EVAL_STATUS_FAILED
+                        and any(e.triggered_by == enums.TRIGGER_FAILED_FOLLOW_UP
+                                for e in evs)):
+                    reaped = True
+                    break
+                time.sleep(0.05)
+            assert reaped
+            # and the sibling pending eval is promoted (job not wedged)
+            got2, tok2 = s.broker.dequeue([ev.type], timeout=2.0)
+            assert got2.id == sibling.id
+            s.broker.ack(got2.id, tok2)
+
+    def test_deregister_stops_allocs(self):
+        with _server() as s:
+            s.register_node(mock.node())
+            job = mock.job()
+            job.task_groups[0].count = 3
+            s.register_job(job)
+            assert s.wait_for_idle(10.0)
+            s.deregister_job(job.id)
+            assert s.wait_for_idle(10.0)
+            live = [a for a in s.store.snapshot().allocs_by_job(job.id)
+                    if not a.server_terminal()]
+            assert live == []
