@@ -1,0 +1,18 @@
+"""Client / execution plane (reference client/, SURVEY.md §2.3).
+
+The agent that runs on every node: fingerprints the host into a Node,
+registers with the server, heartbeats, watches for assigned allocations,
+and drives them through alloc/task runners onto pluggable task drivers.
+
+- fingerprint.py — host discovery -> Node attributes/resources
+- drivers.py     — driver plugin interface + mock/raw_exec/exec drivers
+- allocdir.py    — on-disk alloc/<id>/{alloc,task/{local,secrets,tmp}}
+- taskenv.py     — NOMAD_* env construction + ${...} interpolation
+- task_runner.py — per-task lifecycle with restart policy
+- alloc_runner.py— per-allocation task orchestration + health rollup
+- client.py      — the agent loop: register/heartbeat/watch/sync
+"""
+
+from .client import Client, ClientConfig
+
+__all__ = ["Client", "ClientConfig"]

@@ -1,0 +1,162 @@
+"""Allocation runner (reference client/allocrunner/alloc_runner.go:222).
+
+Owns one allocation on the client: builds the alloc dir, starts task
+runners honoring lifecycle ordering (prestart tasks run before main
+tasks; sidecars keep running), rolls task states up into the alloc's
+client status, and reports changes upward for the batched server sync.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..structs import enums
+from ..structs.alloc import Allocation, TaskState
+from .allocdir import AllocDir
+from .task_runner import TaskRunner
+
+LIFECYCLE_PRESTART = "prestart"
+LIFECYCLE_POSTSTART = "poststart"
+LIFECYCLE_POSTSTOP = "poststop"
+
+
+class AllocRunner:
+    def __init__(self, alloc: Allocation, node, data_dir: str,
+                 on_update: Optional[Callable] = None):
+        self.alloc = alloc
+        self.node = node
+        self.on_update = on_update
+        self.allocdir = AllocDir(data_dir, alloc.id)
+        self.task_runners: Dict[str, TaskRunner] = {}
+        self.client_status = enums.ALLOC_CLIENT_PENDING
+        self.client_description = ""
+        self.task_states: Dict[str, TaskState] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._destroyed = False
+
+        job = alloc.job
+        self.tg = job.lookup_task_group(alloc.task_group) if job is not None else None
+
+    # -- lifecycle --
+
+    def run(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"alloc-{self.alloc.id[:8]}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        if self.tg is None or not self.tg.tasks:
+            self._set_status(enums.ALLOC_CLIENT_FAILED, "no task group")
+            return
+        self.allocdir.build()
+
+        def make_runner(task) -> TaskRunner:
+            td = self.allocdir.build_task_dir(task.name)
+            tr = TaskRunner(self.alloc, task, self.node, td,
+                            shared_dir=self.allocdir.shared,
+                            on_state_change=self._on_task_state,
+                            restart_policy=self.tg.restart_policy)
+            self.task_runners[task.name] = tr
+            return tr
+
+        prestart = [t for t in self.tg.tasks if t.lifecycle_hook == LIFECYCLE_PRESTART]
+        mains = [t for t in self.tg.tasks if t.lifecycle_hook in ("", LIFECYCLE_POSTSTART)]
+        poststop = [t for t in self.tg.tasks if t.lifecycle_hook == LIFECYCLE_POSTSTOP]
+
+        # prestart tasks: non-sidecars must complete before main tasks
+        # (reference tasklifecycle coordinator)
+        pre_runners = [make_runner(t) for t in prestart]
+        for r in pre_runners:
+            r.start()
+        for t, r in zip(prestart, pre_runners):
+            if not t.lifecycle_sidecar:
+                r.wait_dead(timeout=300.0)
+                if r.state.failed:
+                    self._set_status(enums.ALLOC_CLIENT_FAILED,
+                                     f"prestart task {t.name} failed")
+                    self._kill_all()
+                    return
+
+        main_runners = [make_runner(t) for t in mains]
+        for r in main_runners:
+            r.start()
+        self._recompute_status()
+
+        # wait for all main tasks to finish (sidecar prestarts are
+        # stopped when the mains are done)
+        for r in main_runners:
+            while not r.wait_dead(timeout=0.5):
+                if self._destroyed:
+                    return
+        for t, r in zip(prestart, pre_runners):
+            if t.lifecycle_sidecar:
+                r.kill()
+
+        # poststop tasks run after the mains (reference poststop hooks)
+        post_runners = [make_runner(t) for t in poststop]
+        for r in post_runners:
+            r.start()
+        for r in post_runners:
+            r.wait_dead(timeout=300.0)
+        self._recompute_status()
+
+    def stop(self) -> None:
+        """Server asked for a stop (desired_status=stop/evict)."""
+        self._destroyed = True
+        self._kill_all()
+
+    def destroy(self) -> None:
+        self.stop()
+        self.allocdir.destroy()
+
+    def _kill_all(self) -> None:
+        for r in self.task_runners.values():
+            r.kill()
+        for r in self.task_runners.values():
+            r.join(timeout=5.0)
+        self._recompute_status()
+
+    def _set_status(self, status: str, desc: str = "") -> None:
+        with self._lock:
+            self.client_status = status
+            self.client_description = desc
+        if self.on_update is not None:
+            self.on_update(self)
+
+    # -- status rollup (reference alloc_runner.go clientAlloc) --
+
+    def _on_task_state(self, task_name: str, state: TaskState) -> None:
+        with self._lock:
+            self.task_states[task_name] = state
+        self._recompute_status()
+
+    def _recompute_status(self) -> None:
+        with self._lock:
+            main_names = [t.name for t in (self.tg.tasks if self.tg else [])
+                          if t.lifecycle_hook in ("", LIFECYCLE_POSTSTART)]
+            states = [self.task_states.get(n) for n in main_names]
+            if any(s is not None and s.failed for s in self.task_states.values()):
+                status = enums.ALLOC_CLIENT_FAILED
+            elif any(s is None or s.state == "pending" for s in states):
+                status = enums.ALLOC_CLIENT_PENDING
+            elif any(s.state == "running" for s in states):
+                status = enums.ALLOC_CLIENT_RUNNING
+            elif all(s is not None and s.state == "dead" for s in states):
+                status = enums.ALLOC_CLIENT_COMPLETE
+            else:
+                status = self.client_status
+            changed = status != self.client_status
+            self.client_status = status
+        if self.on_update is not None:
+            self.on_update(self)
+
+    def finished_at(self) -> float:
+        times = [s.finished_at for s in self.task_states.values() if s.finished_at]
+        return max(times) if times else 0.0
+
+    def is_terminal(self) -> bool:
+        return self.client_status in (enums.ALLOC_CLIENT_COMPLETE,
+                                      enums.ALLOC_CLIENT_FAILED)

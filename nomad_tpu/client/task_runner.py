@@ -1,0 +1,167 @@
+"""Task runner (reference client/allocrunner/taskrunner/task_runner.go).
+
+Drives one task through its lifecycle on one thread:
+
+  prestart hooks (task dir, env build, config interpolation)
+  -> driver.start_task -> wait -> restart policy decision -> loop/dead
+
+Restart semantics mirror the reference restart tracker
+(client/allocrunner/taskrunner/restarts/): `attempts` restarts within
+`interval_s`; exceeding them either fails the task (mode=fail) or waits
+out the interval (mode=delay).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..structs import enums
+from ..structs.alloc import TaskEvent, TaskState
+from ..structs.job import RestartPolicy, Task
+from . import taskenv
+from .drivers import DriverError, ExitResult, get_driver
+
+
+class TaskRunner:
+    def __init__(self, alloc, task: Task, node, task_dir: str,
+                 shared_dir: str = "",
+                 on_state_change: Optional[Callable] = None,
+                 restart_policy: Optional[RestartPolicy] = None):
+        self.alloc = alloc
+        self.task = task
+        self.node = node
+        self.task_dir = task_dir
+        self.shared_dir = shared_dir
+        self.on_state_change = on_state_change
+        self.policy = restart_policy or RestartPolicy()
+
+        self.state = TaskState()
+        self._handle = None
+        self._killed = threading.Event()
+        self._dead = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._restart_times: list = []  # timestamps inside current interval
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name=f"task-{self.alloc.id[:8]}-{self.task.name}")
+        self._thread.start()
+
+    def run(self) -> None:
+        self._event("Received", "task received by client")
+        try:
+            driver = get_driver(self.task.driver)
+        except DriverError as e:
+            self._fail(f"driver error: {e}")
+            return
+
+        while not self._killed.is_set():
+            env = taskenv.build_env(self.alloc, self.task, self.node,
+                                    self.task_dir, self.shared_dir)
+            config = taskenv.interpolate_config(self.task.config or {},
+                                                self.node, env)
+            run_task = _interpolated_task(self.task, config)
+
+            try:
+                self._handle = driver.start_task(run_task, env, self.task_dir)
+            except DriverError as e:
+                self._event("Driver Failure", str(e))
+                if not self._should_restart(failed_start=True):
+                    self._fail(f"failed to start task: {e}")
+                    return
+                continue
+
+            self.state.state = "running"
+            self.state.started_at = self.state.started_at or time.time()
+            self._event("Started", "task started by client")
+            self._notify()
+
+            result = None
+            while result is None and not self._killed.is_set():
+                result = self._handle.wait(timeout=0.2)
+            if self._killed.is_set():
+                break
+            self._event("Terminated", f"exit code {result.exit_code}",
+                        exit_code=result.exit_code)
+            if result.successful():
+                self._die(failed=False)
+                return
+            if not self._should_restart():
+                self._event("Not Restarting", "exceeded restart policy")
+                self._die(failed=True)
+                return
+
+        # killed
+        if self._handle is not None:
+            self._handle.kill(self.task.kill_timeout_s)
+        self._event("Killed", "task killed by client")
+        self._die(failed=False)
+
+    def kill(self) -> None:
+        self._killed.set()
+
+    def join(self, timeout: float = 10.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def wait_dead(self, timeout: float = 10.0) -> bool:
+        return self._dead.wait(timeout)
+
+    # -- restart policy (reference restarts/restarts.go) --
+
+    def _should_restart(self, failed_start: bool = False) -> bool:
+        now = time.time()
+        window_start = now - self.policy.interval_s
+        self._restart_times = [t for t in self._restart_times if t >= window_start]
+        if len(self._restart_times) >= self.policy.attempts:
+            if self.policy.mode == "delay":
+                # wait out the interval, then the window clears
+                oldest = self._restart_times[0]
+                delay = max(0.0, oldest + self.policy.interval_s - now)
+                if self._killed.wait(delay):
+                    return False
+            else:
+                return False
+        self._restart_times.append(time.time())
+        self.state.restarts += 1
+        self.state.last_restart = time.time()
+        self._event("Restarting", "task restarting",
+                    restart_reason="restart policy")
+        self._notify()
+        if self._killed.wait(self.policy.delay_s):
+            return False
+        return True
+
+    # -- state plumbing --
+
+    def _event(self, etype: str, message: str, **kw) -> None:
+        self.state.events.append(TaskEvent(type=etype, time=time.time(),
+                                           message=message, **kw))
+
+    def _die(self, failed: bool) -> None:
+        self.state.state = "dead"
+        self.state.failed = failed
+        self.state.finished_at = time.time()
+        self._dead.set()
+        self._notify()
+
+    def _fail(self, message: str) -> None:
+        self._event("Driver Failure", message)
+        self._die(failed=True)
+
+    def _notify(self) -> None:
+        if self.on_state_change is not None:
+            self.on_state_change(self.task.name, self.state)
+
+
+def _interpolated_task(task: Task, config: dict) -> Task:
+    """Copy of the task carrying the interpolated driver config."""
+    return Task(
+        name=task.name, driver=task.driver, config=config, env=task.env,
+        resources=task.resources, kill_timeout_s=task.kill_timeout_s,
+        user=task.user, meta=task.meta,
+    )

@@ -9,6 +9,7 @@ and cancels the older (blocked_evals.go:37 dedup).
 
 from __future__ import annotations
 
+import copy as _copy
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -18,9 +19,13 @@ from ..structs.evaluation import Evaluation
 
 
 class BlockedEvals:
-    def __init__(self, enqueue_fn: Callable[[Evaluation], None]):
-        """enqueue_fn re-queues an unblocked eval into the broker."""
+    def __init__(self, enqueue_fn: Callable[[Evaluation], None],
+                 persist_fn: Optional[Callable[[List[Evaluation]], None]] = None):
+        """enqueue_fn re-queues an unblocked eval into the broker;
+        persist_fn commits eval-status transitions (cancellations) to the
+        state store."""
         self._enqueue = enqueue_fn
+        self._persist = persist_fn
         self._lock = threading.Lock()
         self._enabled = False
         # (ns, job_id) -> blocked eval
@@ -45,12 +50,16 @@ class BlockedEvals:
                 return
             key = (ev.namespace, ev.job_id)
             prev = self._by_job.get(key)
+            cancelled = None
             if prev is not None:
                 if prev.id == ev.id:
                     return
-                # newer blocked eval supersedes: cancel the old one
-                prev.status = enums.EVAL_STATUS_CANCELLED
-                prev.status_description = "superseded by newer blocked eval"
+                # newer blocked eval supersedes: cancel the old one on a
+                # copy (the object is shared with store snapshots) and
+                # persist the transition
+                cancelled = _copy.copy(prev)
+                cancelled.status = enums.EVAL_STATUS_CANCELLED
+                cancelled.status_description = "superseded by newer blocked eval"
                 self._escaped.pop(prev.id, None)
                 self._captured.pop(prev.id, None)
                 self.stats["cancelled"] += 1
@@ -60,6 +69,8 @@ class BlockedEvals:
             else:
                 self._captured[ev.id] = ev
             self.stats["blocked"] += 1
+        if cancelled is not None and self._persist is not None:
+            self._persist([cancelled])
 
     def untrack_job(self, namespace: str, job_id: str) -> None:
         with self._lock:

@@ -55,7 +55,8 @@ class Server:
 
         self.broker = EvalBroker(nack_timeout=self.config.nack_timeout,
                                  delivery_limit=self.config.eval_delivery_limit)
-        self.blocked = BlockedEvals(self._requeue_unblocked)
+        self.blocked = BlockedEvals(self._requeue_unblocked,
+                                    persist_fn=self.store.upsert_evals)
         self.plan_queue = PlanQueue()
         self.plan_applier = PlanApplier(self.store, self.plan_queue, self.logger)
         self.heartbeats = HeartbeatManager(self, ttl=self.config.heartbeat_ttl)
@@ -226,7 +227,14 @@ class Server:
         return self.heartbeats.reset(node.id)
 
     def heartbeat(self, node_id: str) -> float:
-        """Node.UpdateStatus(ready) from a live client."""
+        """Node.UpdateStatus(ready) from a live client. A node that was
+        marked down by a missed TTL comes back to ready here (the
+        reference heartbeat is literally an UpdateStatus(ready) RPC)."""
+        snap = self.store.snapshot()
+        node = snap.node_by_id(node_id)
+        if node is not None and node.status != enums.NODE_STATUS_READY:
+            self.update_node_status(node_id, enums.NODE_STATUS_READY)
+            return self.config.heartbeat_ttl
         return self.heartbeats.reset(node_id)
 
     def update_node_status(self, node_id: str, status: str) -> None:
@@ -239,7 +247,17 @@ class Server:
             self._create_node_evals(node_id)
 
     def mark_node_down(self, node_id: str, reason: str = "") -> None:
-        self.update_node_status(node_id, enums.NODE_STATUS_DOWN)
+        try:
+            self.update_node_status(node_id, enums.NODE_STATUS_DOWN)
+        except KeyError:
+            # node was deleted while its TTL timer was in flight
+            self.heartbeats.remove(node_id)
+
+    def deregister_node(self, node_id: str) -> None:
+        """Node.Deregister: drop the node and reschedule its work."""
+        self.heartbeats.remove(node_id)
+        self.store.delete_node(node_id)
+        self._create_node_evals(node_id)
 
     def update_node_drain(self, node_id: str, drain_strategy,
                           mark_eligible: bool = False) -> None:

@@ -69,6 +69,35 @@ class AllocMetric:
 
 
 @dataclass(slots=True)
+class TaskEvent:
+    """One event in a task's lifecycle timeline
+    (reference structs.go TaskEvent)."""
+
+    type: str = ""           # Received|Task Setup|Started|Terminated|Restarting|Killed|Driver Failure|Not Restarting
+    time: float = 0.0
+    message: str = ""
+    details: Dict[str, str] = field(default_factory=dict)
+    exit_code: Optional[int] = None
+    restart_reason: str = ""
+
+
+@dataclass(slots=True)
+class TaskState:
+    """Client-observed state of one task (reference structs.go TaskState)."""
+
+    state: str = "pending"   # pending | running | dead
+    failed: bool = False
+    restarts: int = 0
+    last_restart: float = 0.0
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    events: List[TaskEvent] = field(default_factory=list)
+
+    def successful(self) -> bool:
+        return self.state == "dead" and not self.failed
+
+
+@dataclass(slots=True)
 class NetworkStatus:
     interface_name: str = ""
     address: str = ""

@@ -1,0 +1,255 @@
+"""Client/execution plane tests: drivers, task/alloc runners, and the
+full agent loop against an in-process server (reference client tests use
+the same single-process shape, client/testing.go + drivers/mock).
+"""
+
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.client.drivers import DriverError, MockDriver, RawExecDriver
+from nomad_tpu.client.fingerprint import fingerprint
+from nomad_tpu.core import Server, ServerConfig
+from nomad_tpu.structs import enums
+from nomad_tpu.structs.job import RestartPolicy, Task
+
+
+# ---------------------------------------------------------------------------
+# fingerprint + drivers
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_builds_ready_node():
+    n = fingerprint()
+    assert n.ready()
+    assert n.resources.cpu > 0 and n.resources.memory_mb > 0
+    assert n.attributes["kernel.name"]
+    assert n.drivers.get("mock") and n.drivers.get("raw_exec")
+    assert n.computed_class
+
+
+def test_mock_driver_run_and_exit():
+    d = MockDriver()
+    t = Task(driver="mock", config={"run_for": 0.05, "exit_code": 0})
+    h = d.start_task(t, {}, "")
+    res = h.wait(timeout=2.0)
+    assert res.successful()
+
+    t2 = Task(driver="mock", config={"run_for": 0.0, "exit_code": 3})
+    res2 = d.start_task(t2, {}, "").wait(timeout=2.0)
+    assert not res2.successful() and res2.exit_code == 3
+
+    with pytest.raises(DriverError):
+        d.start_task(Task(driver="mock", config={"start_error": "boom"}), {}, "")
+
+
+def test_raw_exec_driver_runs_real_process(tmp_path):
+    d = RawExecDriver()
+    td = tmp_path / "task"
+    td.mkdir()
+    t = Task(driver="raw_exec",
+             config={"command": "/bin/sh", "args": ["-c", "echo hello > out.txt"]})
+    h = d.start_task(t, {}, str(td))
+    res = h.wait(timeout=5.0)
+    assert res.successful()
+    assert (td / "out.txt").read_text().strip() == "hello"
+
+
+def test_raw_exec_kill(tmp_path):
+    d = RawExecDriver()
+    t = Task(driver="raw_exec", config={"command": "/bin/sleep", "args": ["60"]})
+    h = d.start_task(t, {}, str(tmp_path))
+    assert h.is_running()
+    t0 = time.time()
+    h.kill(grace_s=1.0)
+    assert time.time() - t0 < 10
+    assert not h.is_running()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end agent loop
+# ---------------------------------------------------------------------------
+
+
+def _cluster(tmp_path, n_clients=1, **server_kw):
+    s = Server(ServerConfig(heartbeat_ttl=30.0, **server_kw))
+    s.start()
+    clients = []
+    for i in range(n_clients):
+        c = Client(s, ClientConfig(data_dir=str(tmp_path / f"c{i}"),
+                                   heartbeat_interval=0.5))
+        c.start()
+        clients.append(c)
+    return s, clients
+
+
+def _teardown(s, clients):
+    for c in clients:
+        c.stop()
+    s.stop()
+
+
+def test_service_job_runs_on_client(tmp_path):
+    s, clients = _cluster(tmp_path)
+    try:
+        job = mock.job()
+        job.task_groups[0].count = 3
+        job.task_groups[0].tasks[0] = Task(
+            name="web", driver="mock", config={"run_for": 60.0})
+        s.register_job(job)
+        assert s.wait_for_idle(10.0)
+
+        c = clients[0]
+        assert c.wait_until(lambda: all(
+            a.client_status == enums.ALLOC_CLIENT_RUNNING
+            for a in s.store.snapshot().allocs_by_job(job.id)) and
+            len(s.store.snapshot().allocs_by_job(job.id)) == 3)
+        # task states synced to the server
+        a = s.store.snapshot().allocs_by_job(job.id)[0]
+        assert a.task_states["web"].state == "running"
+    finally:
+        _teardown(s, clients)
+
+
+def test_batch_job_completes(tmp_path):
+    s, clients = _cluster(tmp_path)
+    try:
+        job = mock.batch_job()
+        job.task_groups[0].count = 2
+        job.task_groups[0].tasks[0] = Task(
+            name="work", driver="mock", config={"run_for": 0.1})
+        s.register_job(job)
+        c = clients[0]
+        assert c.wait_until(lambda: (
+            len(s.store.snapshot().allocs_by_job(job.id)) == 2 and all(
+                a.client_status == enums.ALLOC_CLIENT_COMPLETE
+                for a in s.store.snapshot().allocs_by_job(job.id))))
+        # completed batch allocs are not replaced
+        time.sleep(0.5)
+        assert len(s.store.snapshot().allocs_by_job(job.id)) == 2
+    finally:
+        _teardown(s, clients)
+
+
+def test_real_process_job_end_to_end(tmp_path):
+    """A raw_exec job writes a file via the full control loop."""
+    s, clients = _cluster(tmp_path)
+    try:
+        out = tmp_path / "proof.txt"
+        job = mock.batch_job()
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0] = Task(
+            name="writer", driver="raw_exec",
+            config={"command": "/bin/sh",
+                    "args": ["-c", f"echo $NOMAD_ALLOC_ID > {out}"]})
+        s.register_job(job)
+        c = clients[0]
+        assert c.wait_until(lambda: out.exists() and out.read_text().strip())
+        alloc = s.store.snapshot().allocs_by_job(job.id)[0]
+        assert c.wait_until(
+            lambda: s.store.snapshot().alloc_by_id(alloc.id).client_status
+            == enums.ALLOC_CLIENT_COMPLETE)
+        assert out.read_text().strip() == alloc.id
+    finally:
+        _teardown(s, clients)
+
+
+def test_failed_task_restarts_then_fails_and_reschedules(tmp_path):
+    s, clients = _cluster(tmp_path, num_workers=1)
+    try:
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.restart_policy = RestartPolicy(attempts=1, interval_s=60,
+                                          delay_s=0.05, mode="fail")
+        tg.reschedule_policy.delay_s = 0
+        tg.reschedule_policy.attempts = 1
+        tg.tasks[0] = Task(name="crash", driver="mock",
+                           config={"run_for": 0.05, "exit_code": 1})
+        s.register_job(job)
+        c = clients[0]
+        # restart once, then dead+failed; server reschedules a replacement
+        assert c.wait_until(lambda: any(
+            a.client_status == enums.ALLOC_CLIENT_FAILED
+            for a in s.store.snapshot().allocs_by_job(job.id)), 15.0)
+        failed = [a for a in s.store.snapshot().allocs_by_job(job.id)
+                  if a.client_status == enums.ALLOC_CLIENT_FAILED][0]
+        assert failed.task_states["crash"].restarts == 1
+        assert c.wait_until(lambda: any(
+            a.previous_allocation == failed.id
+            for a in s.store.snapshot().allocs_by_job(job.id)), 15.0)
+    finally:
+        _teardown(s, clients)
+
+
+def test_stop_job_kills_tasks(tmp_path):
+    s, clients = _cluster(tmp_path)
+    try:
+        job = mock.job()
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0] = Task(
+            name="web", driver="raw_exec",
+            config={"command": "/bin/sleep", "args": ["300"]})
+        s.register_job(job)
+        c = clients[0]
+        assert c.wait_until(lambda: any(
+            a.client_status == enums.ALLOC_CLIENT_RUNNING
+            for a in s.store.snapshot().allocs_by_job(job.id)))
+        runner = list(c.runners.values())[0]
+        handle = runner.task_runners["web"]._handle
+        assert handle.is_running()
+        s.deregister_job(job.id)
+        assert c.wait_until(lambda: not handle.is_running(), 15.0)
+    finally:
+        _teardown(s, clients)
+
+
+def test_node_recovers_after_missed_ttl(tmp_path):
+    """A node marked down by a missed TTL returns to ready when its
+    heartbeats resume (the reference heartbeat is UpdateStatus(ready))."""
+    s = Server(ServerConfig(heartbeat_ttl=0.2))
+    s.start()
+    try:
+        c = Client(s, ClientConfig(data_dir=str(tmp_path / "c"),
+                                   heartbeat_interval=10.0))  # too slow
+        c.start()
+        nid = c.node.id
+        assert c.wait_until(
+            lambda: s.store.snapshot().node_by_id(nid).status
+            == enums.NODE_STATUS_DOWN, 5.0)
+        # resume heartbeats manually (fast)
+        s.heartbeat(nid)
+        assert c.wait_until(
+            lambda: s.store.snapshot().node_by_id(nid).status
+            == enums.NODE_STATUS_READY, 5.0)
+        c.stop()
+    finally:
+        s.stop()
+
+
+def test_prestart_lifecycle_ordering(tmp_path):
+    s, clients = _cluster(tmp_path)
+    try:
+        marker = tmp_path / "order.txt"
+        job = mock.batch_job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.tasks = [
+            Task(name="init", driver="raw_exec", lifecycle_hook="prestart",
+                 config={"command": "/bin/sh",
+                         "args": ["-c", f"echo init >> {marker}"]}),
+            Task(name="main", driver="raw_exec",
+                 config={"command": "/bin/sh",
+                         "args": ["-c", f"echo main >> {marker}"]}),
+        ]
+        s.register_job(job)
+        c = clients[0]
+        assert c.wait_until(lambda: (
+            allocs := s.store.snapshot().allocs_by_job(job.id)) and all(
+            a.client_status == enums.ALLOC_CLIENT_COMPLETE for a in allocs))
+        assert marker.read_text().splitlines() == ["init", "main"]
+    finally:
+        _teardown(s, clients)
