@@ -1,0 +1,12 @@
+"""API surface (reference api/ Go client + command/agent HTTP layer).
+
+- codec.py   — struct <-> JSON-safe dict conversion
+- jobspec.py — job specification parsing (JSON jobspec -> structs.Job)
+- http.py    — the /v1/* HTTP agent API over the in-process Server
+- client.py  — Python API client mirroring the reference api package
+"""
+
+from .client import ApiClient
+from .http import HTTPAgent
+
+__all__ = ["ApiClient", "HTTPAgent"]

@@ -1,0 +1,151 @@
+"""Python API client (reference api/ package, 26.8k LoC Go client).
+
+Talks to the /v1 HTTP agent. Supports blocking queries via
+(index, wait) the same way the reference QueryOptions do.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..structs.job import Job
+from .codec import to_dict
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"{status}: {message}")
+        self.status = status
+
+
+class ApiClient:
+    def __init__(self, address: str = "http://127.0.0.1:4646",
+                 namespace: str = "default", timeout: float = 35.0):
+        self.address = address.rstrip("/")
+        self.namespace = namespace
+        self.timeout = timeout
+
+    # -- transport --
+
+    def _request(self, method: str, path: str, body: Any = None,
+                 params: Optional[Dict[str, str]] = None) -> Tuple[Any, int]:
+        url = f"{self.address}{path}"
+        params = dict(params or {})
+        params.setdefault("namespace", self.namespace)
+        if params:
+            url += "?" + "&".join(f"{k}={v}" for k, v in params.items())
+        data = None
+        if body is not None:
+            data = json.dumps(to_dict(body)).encode()
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = json.loads(resp.read() or b"null")
+                index = int(resp.headers.get("X-Nomad-Index") or 0)
+                return payload, index
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("error", str(e))
+            except Exception:
+                msg = str(e)
+            raise ApiError(e.code, msg) from e
+
+    def get(self, path: str, **params):
+        return self._request("GET", path, params=params)
+
+    # -- jobs (reference api/jobs.go) --
+
+    def register_job(self, job) -> str:
+        payload = {"job": to_dict(job) if isinstance(job, Job) else job}
+        out, _ = self._request("POST", "/v1/jobs", payload)
+        return out["eval_id"]
+
+    def list_jobs(self, prefix: str = "") -> List[dict]:
+        out, _ = self.get("/v1/jobs", prefix=prefix)
+        return out
+
+    def job(self, job_id: str) -> dict:
+        out, _ = self.get(f"/v1/job/{job_id}")
+        return out
+
+    def deregister_job(self, job_id: str, purge: bool = False) -> str:
+        out, _ = self._request("DELETE", f"/v1/job/{job_id}",
+                               params={"purge": str(purge).lower()})
+        return out.get("eval_id", "")
+
+    def evaluate_job(self, job_id: str) -> str:
+        out, _ = self._request("POST", f"/v1/job/{job_id}/evaluate")
+        return out["eval_id"]
+
+    def job_allocations(self, job_id: str) -> List[dict]:
+        out, _ = self.get(f"/v1/job/{job_id}/allocations")
+        return out
+
+    def job_evaluations(self, job_id: str) -> List[dict]:
+        out, _ = self.get(f"/v1/job/{job_id}/evaluations")
+        return out
+
+    # -- nodes (reference api/nodes.go) --
+
+    def list_nodes(self) -> List[dict]:
+        out, _ = self.get("/v1/nodes")
+        return out
+
+    def node(self, node_id: str) -> dict:
+        out, _ = self.get(f"/v1/node/{node_id}")
+        return out
+
+    def node_allocations(self, node_id: str) -> List[dict]:
+        out, _ = self.get(f"/v1/node/{node_id}/allocations")
+        return out
+
+    def drain_node(self, node_id: str, drain_spec: Optional[dict] = None,
+                   mark_eligible: bool = False) -> None:
+        self._request("POST", f"/v1/node/{node_id}/drain",
+                      {"drain_spec": drain_spec, "mark_eligible": mark_eligible})
+
+    def set_node_eligibility(self, node_id: str, eligible: bool) -> None:
+        self._request("POST", f"/v1/node/{node_id}/eligibility",
+                      {"eligibility": "eligible" if eligible else "ineligible"})
+
+    # -- allocations / evaluations --
+
+    def list_allocations(self, prefix: str = "") -> List[dict]:
+        out, _ = self.get("/v1/allocations", prefix=prefix)
+        return out
+
+    def allocation(self, alloc_id: str) -> dict:
+        out, _ = self.get(f"/v1/allocation/{alloc_id}")
+        return out
+
+    def list_evaluations(self) -> List[dict]:
+        out, _ = self.get("/v1/evaluations")
+        return out
+
+    def evaluation(self, eval_id: str) -> dict:
+        out, _ = self.get(f"/v1/evaluation/{eval_id}")
+        return out
+
+    # -- operator --
+
+    def scheduler_configuration(self) -> dict:
+        out, _ = self.get("/v1/operator/scheduler/configuration")
+        return out
+
+    def set_scheduler_configuration(self, cfg) -> None:
+        self._request("PUT", "/v1/operator/scheduler/configuration", cfg)
+
+    def agent_self(self) -> dict:
+        out, _ = self.get("/v1/agent/self")
+        return out
+
+    # -- blocking query helper (reference QueryOptions WaitIndex) --
+
+    def blocking(self, path: str, index: int, wait_s: float = 5.0):
+        """GET that parks server-side until the store passes `index`."""
+        return self._request("GET", path,
+                             params={"index": str(index), "wait": str(wait_s)})

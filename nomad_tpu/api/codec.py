@@ -1,0 +1,91 @@
+"""Struct <-> JSON-safe dict codec.
+
+The reference relies on Go's reflection-based msgpack/JSON marshaling of
+the 13.5k-line structs.go; here dataclasses make the same generic walk a
+few dozen lines. Dense numpy vectors serialize as lists; objects carry
+no type tags because every API payload's shape is known from its route.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Type, get_args, get_origin
+
+import numpy as np
+
+
+def to_dict(obj: Any) -> Any:
+    """Recursively lower structs/containers to JSON-safe values."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, dict):
+        return {str(k): to_dict(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return [to_dict(v) for v in obj]
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            out[f.name] = to_dict(getattr(obj, f.name))
+        return out
+    # objects with slots-based dataclasses already handled; fall back to str
+    return str(obj)
+
+
+def from_dict(cls: Type, data: Any) -> Any:
+    """Inflate a dataclass (recursively) from a dict, tolerating missing
+    and unknown keys — the API stays forward/backward compatible the way
+    the reference's msgpack codec is."""
+    if data is None:
+        return None
+    if not dataclasses.is_dataclass(cls):
+        return data
+    kwargs = {}
+    hints = {f.name: f.type for f in dataclasses.fields(cls)}
+    for f in dataclasses.fields(cls):
+        if f.name not in data:
+            continue
+        val = data[f.name]
+        kwargs[f.name] = _inflate(hints[f.name], val, cls)
+    return cls(**kwargs)
+
+
+def _resolve(hint, owner_cls):
+    """Resolve a string annotation to a runtime type."""
+    if isinstance(hint, str):
+        import sys
+        import typing
+
+        mod = sys.modules.get(owner_cls.__module__)
+        ns = dict(vars(typing))
+        ns.update(vars(mod) if mod else {})
+        try:
+            return eval(hint, ns)  # annotations are repo-controlled
+        except Exception:
+            return Any
+    return hint
+
+
+def _inflate(hint, val, owner_cls):
+    hint = _resolve(hint, owner_cls)
+    origin = get_origin(hint)
+    if origin in (list, List):
+        (item_t,) = get_args(hint) or (Any,)
+        return [_inflate(item_t, v, owner_cls) for v in (val or [])]
+    if origin in (dict, Dict):
+        args = get_args(hint)
+        item_t = args[1] if len(args) == 2 else Any
+        return {k: _inflate(item_t, v, owner_cls) for k, v in (val or {}).items()}
+    if origin is not None and str(origin).endswith("Union"):  # Optional[...]
+        inner = [a for a in get_args(hint) if a is not type(None)]
+        if len(inner) == 1:
+            return _inflate(inner[0], val, owner_cls)
+        return val
+    if hint is np.ndarray or hint == "np.ndarray":
+        return np.asarray(val, dtype=np.float64)
+    if dataclasses.is_dataclass(hint):
+        return from_dict(hint, val)
+    return val

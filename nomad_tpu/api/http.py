@@ -1,0 +1,270 @@
+"""HTTP agent API (reference command/agent/http.go:382-528).
+
+Serves the /v1/* surface over an in-process core.Server. Implements the
+reference's blocking-query protocol: pass ?index=N&wait=SECONDS and the
+GET parks until the state store passes index N (or the wait expires),
+responses carry X-Nomad-Index (command/agent/http.go blocking queries).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..structs import enums
+from ..structs.job import Job
+from ..structs.node import DrainStrategy
+from .codec import from_dict, to_dict
+from .jobspec import _validate
+
+MAX_BLOCK_S = 30.0
+
+
+class HTTPAgent:
+    """The agent HTTP server. Start with port=0 for an ephemeral port."""
+
+    def __init__(self, server, host: str = "127.0.0.1", port: int = 4646):
+        self.server = server
+        agent = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                if agent.server.logger:
+                    agent.server.logger.debug("http: " + fmt, *args)
+
+            def _reply(self, code: int, payload, index: Optional[int] = None):
+                body = json.dumps(to_dict(payload)).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("X-Nomad-Index",
+                                 str(index if index is not None
+                                     else agent.server.store.latest_index))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _error(self, code: int, msg: str):
+                self._reply(code, {"error": msg})
+
+            def _body(self) -> dict:
+                length = int(self.headers.get("Content-Length") or 0)
+                if not length:
+                    return {}
+                return json.loads(self.rfile.read(length))
+
+            def _block(self, q: dict) -> None:
+                """Blocking query: wait for the store to move past index."""
+                want = int(q.get("index", ["0"])[0] or 0)
+                if want <= 0:
+                    return
+                wait = min(float(q.get("wait", ["5"])[0] or 5), MAX_BLOCK_S)
+                deadline = time.time() + wait
+                store = agent.server.store
+                while store.latest_index <= want and time.time() < deadline:
+                    time.sleep(0.02)
+
+            def do_GET(self):
+                try:
+                    url = urlparse(self.path)
+                    q = parse_qs(url.query)
+                    self._block(q)
+                    agent._route_get(self, url.path, q)
+                except Exception as e:
+                    self._error(500, str(e))
+
+            def do_POST(self):
+                try:
+                    url = urlparse(self.path)
+                    agent._route_post(self, url.path, parse_qs(url.query),
+                                      self._body())
+                except Exception as e:
+                    self._error(500, str(e))
+
+            do_PUT = do_POST
+
+            def do_DELETE(self):
+                try:
+                    url = urlparse(self.path)
+                    agent._route_delete(self, url.path, parse_qs(url.query))
+                except Exception as e:
+                    self._error(500, str(e))
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.address = f"http://{host}:{self._httpd.server_port}"
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --
+
+    def start(self) -> "HTTPAgent":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="http-agent")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- routing (reference http.go registerHandlers) --
+
+    def _route_get(self, h, path: str, q: dict) -> None:
+        snap = self.server.store.snapshot()
+        ns = q.get("namespace", ["default"])[0]
+        prefix = q.get("prefix", [""])[0]
+
+        if path == "/v1/jobs":
+            jobs = [j for j in snap.jobs() if j.id.startswith(prefix)]
+            return h._reply(200, [self._job_stub(j, snap) for j in jobs])
+        if m := re.fullmatch(r"/v1/job/([^/]+)", path):
+            job = snap.job_by_id(m.group(1), ns)
+            if job is None:
+                return h._error(404, "job not found")
+            return h._reply(200, job)
+        if m := re.fullmatch(r"/v1/job/([^/]+)/allocations", path):
+            return h._reply(200, [self._alloc_stub(a) for a in
+                                  snap.allocs_by_job(m.group(1), ns)])
+        if m := re.fullmatch(r"/v1/job/([^/]+)/evaluations", path):
+            return h._reply(200, snap.evals_by_job(m.group(1), ns))
+        if m := re.fullmatch(r"/v1/job/([^/]+)/deployments", path):
+            return h._reply(200, snap.deployments_by_job(m.group(1), ns))
+
+        if path == "/v1/nodes":
+            return h._reply(200, [self._node_stub(n) for n in snap.nodes()])
+        if m := re.fullmatch(r"/v1/node/([^/]+)", path):
+            node = snap.node_by_id(m.group(1))
+            if node is None:
+                return h._error(404, "node not found")
+            return h._reply(200, node)
+        if m := re.fullmatch(r"/v1/node/([^/]+)/allocations", path):
+            return h._reply(200, [self._alloc_stub(a) for a in
+                                  snap.allocs_by_node(m.group(1))])
+
+        if path == "/v1/allocations":
+            allocs = [a for a in snap.allocs() if a.id.startswith(prefix)]
+            return h._reply(200, [self._alloc_stub(a) for a in allocs])
+        if m := re.fullmatch(r"/v1/allocation/([^/]+)", path):
+            alloc = snap.alloc_by_id(m.group(1))
+            if alloc is None:
+                return h._error(404, "alloc not found")
+            return h._reply(200, alloc)
+
+        if path == "/v1/evaluations":
+            return h._reply(200, list(snap.evals()))
+        if m := re.fullmatch(r"/v1/evaluation/([^/]+)", path):
+            ev = snap.eval_by_id(m.group(1))
+            if ev is None:
+                return h._error(404, "eval not found")
+            return h._reply(200, ev)
+
+        if path == "/v1/status/leader":
+            return h._reply(200, "local")
+        if path == "/v1/agent/self":
+            return h._reply(200, {
+                "stats": {
+                    "broker": self.server.broker.stats,
+                    "plan_applier": self.server.plan_applier.stats,
+                    "blocked_evals": self.server.blocked.blocked_count(),
+                },
+                "version": "0.1.0",
+            })
+        if path == "/v1/operator/scheduler/configuration":
+            return h._reply(200, self.server.sched_config)
+        if path == "/v1/metrics":
+            return h._reply(200, {
+                "broker": self.server.broker.stats,
+                "plan": self.server.plan_applier.stats,
+                "heartbeats_active": self.server.heartbeats.active(),
+            })
+        h._error(404, f"no such route {path}")
+
+    def _route_post(self, h, path: str, q: dict, body: dict) -> None:
+        if path == "/v1/jobs":
+            data = body.get("job") or body.get("Job") or body
+            job = from_dict(Job, data)
+            _validate(job)
+            eval_id = self.server.register_job(job)
+            return h._reply(200, {"eval_id": eval_id, "job_id": job.id})
+        if m := re.fullmatch(r"/v1/job/([^/]+)/evaluate", path):
+            ns = q.get("namespace", ["default"])[0]
+            snap = self.server.store.snapshot()
+            job = snap.job_by_id(m.group(1), ns)
+            if job is None:
+                return h._error(404, "job not found")
+            eval_id = self.server._create_job_eval(job, enums.TRIGGER_JOB_REGISTER)
+            return h._reply(200, {"eval_id": eval_id})
+        if m := re.fullmatch(r"/v1/node/([^/]+)/drain", path):
+            spec = body.get("drain_spec")
+            strategy = None
+            if spec is not None:
+                strategy = from_dict(DrainStrategy, spec)
+            self.server.update_node_drain(m.group(1), strategy,
+                                          bool(body.get("mark_eligible")))
+            return h._reply(200, {"ok": True})
+        if m := re.fullmatch(r"/v1/node/([^/]+)/eligibility", path):
+            self.server.update_node_eligibility(m.group(1),
+                                                body.get("eligibility", ""))
+            return h._reply(200, {"ok": True})
+        if path == "/v1/operator/scheduler/configuration":
+            from ..structs.operator import SchedulerConfiguration
+
+            cfg = from_dict(SchedulerConfiguration, body)
+            self.server.sched_config = cfg
+            self.server.config.sched_config = cfg
+            return h._reply(200, {"updated": True})
+        h._error(404, f"no such route {path}")
+
+    def _route_delete(self, h, path: str, q: dict) -> None:
+        if m := re.fullmatch(r"/v1/job/([^/]+)", path):
+            ns = q.get("namespace", ["default"])[0]
+            purge = q.get("purge", ["false"])[0] in ("true", "1")
+            eval_id = self.server.deregister_job(m.group(1), ns, purge=purge)
+            return h._reply(200, {"eval_id": eval_id})
+        h._error(404, f"no such route {path}")
+
+    # -- stubs (reference api list endpoints return trimmed rows) --
+
+    def _job_stub(self, job, snap) -> dict:
+        summary: Dict[str, int] = {}
+        for a in snap.allocs_by_job(job.id, job.namespace):
+            if not a.terminal_status():
+                summary[a.client_status] = summary.get(a.client_status, 0) + 1
+        return {
+            "id": job.id, "name": job.name, "type": job.type,
+            "priority": job.priority, "status": job.status,
+            "namespace": job.namespace, "stop": job.stop,
+            "alloc_summary": summary,
+        }
+
+    def _node_stub(self, node) -> dict:
+        return {
+            "id": node.id, "name": node.name, "datacenter": node.datacenter,
+            "node_class": node.node_class, "node_pool": node.node_pool,
+            "status": node.status,
+            "scheduling_eligibility": node.scheduling_eligibility,
+            "drain": node.drain,
+        }
+
+    def _alloc_stub(self, a) -> dict:
+        return {
+            "id": a.id, "name": a.name, "job_id": a.job_id,
+            "task_group": a.task_group, "node_id": a.node_id,
+            "desired_status": a.desired_status,
+            "client_status": a.client_status,
+            "create_index": a.create_index, "modify_index": a.modify_index,
+        }

@@ -1,0 +1,281 @@
+"""Job specification parsing (reference jobspec2/parse.go:21).
+
+The reference parses HCL2 job files into api.Job. The native format here
+is JSON with snake_case keys mirroring the dataclass fields:
+
+    {
+      "job": {
+        "id": "web", "type": "service", "datacenters": ["dc1"],
+        "task_groups": [{
+          "name": "web", "count": 3,
+          "tasks": [{"name": "srv", "driver": "raw_exec",
+                     "config": {"command": "/bin/sleep", "args": ["60"]},
+                     "resources": {"cpu": 500, "memory_mb": 256}}],
+          "constraints": [{"ltarget": "${attr.kernel.name}",
+                           "rtarget": "linux", "operand": "="}]
+        }]
+      }
+    }
+
+A top-level "job" wrapper is optional. parse_hcl_like() additionally
+accepts a minimal HCL-shaped surface (block syntax with = assignments)
+so hand-written specs stay ergonomic without an HCL dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Optional
+
+from ..structs.job import Job
+from .codec import from_dict
+
+
+def parse_json(text: str) -> Job:
+    data = json.loads(text)
+    if "job" in data:
+        data = data["job"]
+    elif "Job" in data:
+        data = data["Job"]
+    job = from_dict(Job, data)
+    _validate(job)
+    return job
+
+
+def parse_file(path: str) -> Job:
+    with open(path) as f:
+        text = f.read()
+    if path.endswith(".json"):
+        return parse_json(text)
+    return parse_hcl_like(text)
+
+
+def _validate(job: Job) -> None:
+    if not job.id:
+        raise ValueError("job id is required")
+    if not job.task_groups:
+        raise ValueError(f"job {job.id} has no task groups")
+    names = set()
+    for tg in job.task_groups:
+        if tg.name in names:
+            raise ValueError(f"duplicate task group {tg.name!r}")
+        names.add(tg.name)
+        if not tg.tasks:
+            raise ValueError(f"task group {tg.name!r} has no tasks")
+        if tg.count < 0:
+            raise ValueError(f"task group {tg.name!r} has negative count")
+
+
+# ---------------------------------------------------------------------------
+# minimal HCL-shaped parser
+# ---------------------------------------------------------------------------
+#
+# Supports the common jobspec shape:
+#   job "web" {
+#     datacenters = ["dc1"]
+#     group "api" {
+#       count = 3
+#       task "server" {
+#         driver = "raw_exec"
+#         config { command = "/bin/sleep" \n args = ["60"] }
+#         resources { cpu = 500 \n memory = 256 }
+#       }
+#       constraint { attribute = "${attr.kernel.name}" \n value = "linux" }
+#     }
+#   }
+
+_TOKEN = re.compile(r"""
+    (?P<comment>\#[^\n]*|//[^\n]*)
+  | (?P<lbrace>\{) | (?P<rbrace>\})
+  | (?P<lbrack>\[) | (?P<rbrack>\])
+  | (?P<eq>=) | (?P<comma>,)
+  | (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<number>-?\d+(?:\.\d+)?)
+  | (?P<bool>\btrue\b|\bfalse\b)
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_.-]*)
+  | (?P<ws>\s+)
+""", re.VERBOSE)
+
+
+def _tokenize(text: str):
+    out = []
+    i = 0
+    while i < len(text):
+        m = _TOKEN.match(text, i)
+        if m is None:
+            raise ValueError(f"jobspec parse error at offset {i}: {text[i:i+20]!r}")
+        i = m.end()
+        kind = m.lastgroup
+        if kind in ("ws", "comment"):
+            continue
+        out.append((kind, m.group()))
+    return out
+
+
+class _Parser:
+    def __init__(self, tokens):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i] if self.i < len(self.toks) else (None, None)
+
+    def next(self):
+        t = self.peek()
+        self.i += 1
+        return t
+
+    def expect(self, kind):
+        k, v = self.next()
+        if k != kind:
+            raise ValueError(f"expected {kind}, got {k} {v!r}")
+        return v
+
+    def parse_body(self) -> dict:
+        """Parse until rbrace/EOF: assignments and nested blocks.
+        Repeated blocks accumulate into lists."""
+        body: dict = {}
+        while True:
+            k, v = self.peek()
+            if k is None or k == "rbrace":
+                return body
+            if k != "ident":
+                raise ValueError(f"unexpected {k} {v!r}")
+            self.next()
+            name = v
+            k2, v2 = self.peek()
+            if k2 == "eq":
+                self.next()
+                body[name] = self.parse_value()
+            else:
+                # block: optional string label(s), then { body }
+                labels = []
+                while self.peek()[0] == "string":
+                    labels.append(json.loads(self.next()[1]))
+                self.expect("lbrace")
+                inner = self.parse_body()
+                self.expect("rbrace")
+                entry = {"__label__": labels[0]} if labels else {}
+                entry.update(inner)
+                body.setdefault(name, []).append(entry)
+
+    def parse_value(self):
+        k, v = self.next()
+        if k == "string":
+            return json.loads(v)
+        if k == "number":
+            return float(v) if "." in v else int(v)
+        if k == "bool":
+            return v == "true"
+        if k == "lbrack":
+            items = []
+            while True:
+                if self.peek()[0] == "rbrack":
+                    self.next()
+                    return items
+                items.append(self.parse_value())
+                if self.peek()[0] == "comma":
+                    self.next()
+        raise ValueError(f"unexpected value token {k} {v!r}")
+
+
+def _constraint_dict(block: dict) -> dict:
+    # HCL constraint {attribute, operator, value} -> struct fields
+    out = {
+        "ltarget": block.get("attribute", block.get("ltarget", "")),
+        "operand": block.get("operator", block.get("operand", "=")),
+        "rtarget": str(block.get("value", block.get("rtarget", ""))),
+    }
+    return out
+
+
+def _task_dict(block: dict) -> dict:
+    out = {"name": block.get("__label__", block.get("name", "task"))}
+    for key in ("driver", "user", "leader", "kill_timeout_s"):
+        if key in block:
+            out[key] = block[key]
+    if "env" in block and isinstance(block["env"], list):
+        env = {}
+        for e in block["env"]:
+            env.update({k: str(v) for k, v in e.items() if k != "__label__"})
+        out["env"] = env
+    if "meta" in block and isinstance(block["meta"], list):
+        meta = {}
+        for m in block["meta"]:
+            meta.update({k: str(v) for k, v in m.items() if k != "__label__"})
+        out["meta"] = meta
+    if "config" in block:
+        cfg = block["config"][0] if isinstance(block["config"], list) else block["config"]
+        out["config"] = {k: v for k, v in cfg.items() if k != "__label__"}
+    if "resources" in block:
+        res = block["resources"][0]
+        r = {}
+        if "cpu" in res:
+            r["cpu"] = float(res["cpu"])
+        if "memory" in res:
+            r["memory_mb"] = float(res["memory"])
+        if "memory_mb" in res:
+            r["memory_mb"] = float(res["memory_mb"])
+        if "disk" in res:
+            r["disk_mb"] = float(res["disk"])
+        if "cores" in res:
+            r["cores"] = int(res["cores"])
+        out["resources"] = r
+    out["constraints"] = [_constraint_dict(c) for c in block.get("constraint", [])]
+    return out
+
+
+def _group_dict(block: dict) -> dict:
+    out = {"name": block.get("__label__", block.get("name", "group"))}
+    if "count" in block:
+        out["count"] = int(block["count"])
+    out["tasks"] = [_task_dict(t) for t in block.get("task", [])]
+    out["constraints"] = [_constraint_dict(c) for c in block.get("constraint", [])]
+    spreads = []
+    for sp in block.get("spread", []):
+        spreads.append({
+            "attribute": sp.get("attribute", ""),
+            "weight": int(sp.get("weight", 50)),
+            "targets": [
+                {"value": t.get("__label__", t.get("value", "")),
+                 "percent": int(t.get("percent", 0))}
+                for t in sp.get("target", [])],
+        })
+    out["spreads"] = spreads
+    if "restart" in block:
+        rp = block["restart"][0]
+        out["restart_policy"] = {
+            "attempts": int(rp.get("attempts", 2)),
+            "interval_s": float(rp.get("interval", 1800)),
+            "delay_s": float(rp.get("delay", 15)),
+            "mode": rp.get("mode", "fail"),
+        }
+    return out
+
+
+def parse_hcl_like(text: str) -> Job:
+    """Parse the minimal HCL-shaped jobspec surface into a Job."""
+    body = _Parser(_tokenize(text)).parse_body()
+    jobs = body.get("job")
+    if not jobs:
+        raise ValueError("no job block found")
+    jb = jobs[0]
+    data = {
+        "id": jb.get("__label__", jb.get("id", "")),
+        "name": jb.get("name", jb.get("__label__", "")),
+        "type": jb.get("type", "service"),
+        "priority": int(jb.get("priority", 50)),
+        "datacenters": jb.get("datacenters", ["dc1"]),
+        "namespace": jb.get("namespace", "default"),
+        "node_pool": jb.get("node_pool", "default"),
+        "all_at_once": bool(jb.get("all_at_once", False)),
+        "constraints": [_constraint_dict(c) for c in jb.get("constraint", [])],
+        "task_groups": [_group_dict(g) for g in jb.get("group", [])],
+        "meta": {},
+    }
+    for m in jb.get("meta", []):
+        data["meta"].update({k: str(v) for k, v in m.items() if k != "__label__"})
+    job = from_dict(Job, data)
+    _validate(job)
+    return job

@@ -1,0 +1,267 @@
+"""CLI (reference command/, 221 command files — the operational core).
+
+  nomad-tpu agent -dev [--clients N] [--port P] [--algorithm A]
+  nomad-tpu job run <spec.{json,hcl,nomad}>
+  nomad-tpu job status [<job_id>]
+  nomad-tpu job stop [-purge] <job_id>
+  nomad-tpu node status [<node_id>]
+  nomad-tpu node drain -enable|-disable <node_id>
+  nomad-tpu node eligibility -enable|-disable <node_id>
+  nomad-tpu alloc status <alloc_id>
+  nomad-tpu eval status <eval_id>
+  nomad-tpu operator scheduler get-config
+  nomad-tpu operator scheduler set-config -scheduler-algorithm <alg>
+
+Run via `python -m nomad_tpu ...`. Talks HTTP to the agent like the
+reference CLI does (NOMAD_ADDR / --address).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+
+def _client(args):
+    from .api.client import ApiClient
+
+    return ApiClient(address=args.address, namespace=args.namespace)
+
+
+def _p(obj) -> None:
+    print(json.dumps(obj, indent=2, default=str))
+
+
+# -- agent -------------------------------------------------------------------
+
+
+def cmd_agent(args) -> int:
+    from .api.http import HTTPAgent
+    from .client import Client, ClientConfig
+    from .core import Server, ServerConfig
+    from .structs.operator import SchedulerConfiguration
+
+    cfg = ServerConfig(
+        num_workers=args.workers,
+        sched_config=SchedulerConfiguration(scheduler_algorithm=args.algorithm))
+    server = Server(cfg)
+    server.start()
+    clients = []
+    for i in range(args.clients):
+        c = Client(server, ClientConfig(
+            data_dir=os.path.join(args.data_dir, f"client{i}")
+            if args.data_dir else ""))
+        c.start()
+        clients.append(c)
+    http_agent = HTTPAgent(server, port=args.port).start()
+    print(f"agent started: {http_agent.address} "
+          f"(workers={args.workers} clients={args.clients} "
+          f"algorithm={args.algorithm})")
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        http_agent.stop()
+        for c in clients:
+            c.stop()
+        server.stop()
+    return 0
+
+
+# -- job ---------------------------------------------------------------------
+
+
+def cmd_job_run(args) -> int:
+    from .api.jobspec import parse_file
+
+    job = parse_file(args.spec)
+    eval_id = _client(args).register_job(job)
+    print(f"job {job.id!r} registered, evaluation {eval_id}")
+    if args.detach:
+        return 0
+    return _monitor_eval(args, eval_id)
+
+
+def _monitor_eval(args, eval_id: str) -> int:
+    api = _client(args)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        ev = api.evaluation(eval_id)
+        if ev["status"] in ("complete", "failed", "canceled"):
+            print(f"evaluation {eval_id} -> {ev['status']} "
+                  f"{ev.get('status_description', '')}".strip())
+            if ev.get("blocked_eval"):
+                print(f"  blocked eval created: {ev['blocked_eval']}")
+            for tg, m in (ev.get("failed_tg_allocs") or {}).items():
+                print(f"  group {tg!r}: {m.get('coalesced_failures', 0) + 1} "
+                      f"unplaced (filtered {m.get('nodes_filtered')}, "
+                      f"exhausted {m.get('nodes_exhausted')})")
+            return 0 if ev["status"] == "complete" else 1
+        time.sleep(0.2)
+    print(f"evaluation {eval_id} still in progress")
+    return 1
+
+
+def cmd_job_status(args) -> int:
+    api = _client(args)
+    if not args.job_id:
+        _p(api.list_jobs())
+        return 0
+    job = api.job(args.job_id)
+    allocs = api.job_allocations(args.job_id)
+    print(f"ID       = {job['id']}\nType     = {job['type']}\n"
+          f"Priority = {job['priority']}\nStatus   = {job['status']}")
+    print("\nAllocations")
+    for a in allocs:
+        print(f"{a['id'][:8]}  {a['task_group']:12} {a['node_id'][:8]}  "
+              f"{a['desired_status']:6} {a['client_status']}")
+    return 0
+
+
+def cmd_job_stop(args) -> int:
+    eval_id = _client(args).deregister_job(args.job_id, purge=args.purge)
+    print(f"job {args.job_id!r} stopped, evaluation {eval_id}")
+    return 0
+
+
+# -- node --------------------------------------------------------------------
+
+
+def cmd_node_status(args) -> int:
+    api = _client(args)
+    if not args.node_id:
+        _p(api.list_nodes())
+        return 0
+    _p(api.node(args.node_id))
+    return 0
+
+
+def cmd_node_drain(args) -> int:
+    api = _client(args)
+    if args.enable:
+        api.drain_node(args.node_id, drain_spec={"deadline_s": args.deadline})
+        print(f"node {args.node_id} draining")
+    else:
+        api.drain_node(args.node_id, drain_spec=None, mark_eligible=True)
+        print(f"node {args.node_id} drain disabled")
+    return 0
+
+
+def cmd_node_eligibility(args) -> int:
+    _client(args).set_node_eligibility(args.node_id, args.enable)
+    print(f"node {args.node_id} "
+          f"{'eligible' if args.enable else 'ineligible'}")
+    return 0
+
+
+# -- alloc / eval / operator -------------------------------------------------
+
+
+def cmd_alloc_status(args) -> int:
+    _p(_client(args).allocation(args.alloc_id))
+    return 0
+
+
+def cmd_eval_status(args) -> int:
+    _p(_client(args).evaluation(args.eval_id))
+    return 0
+
+
+def cmd_operator_scheduler(args) -> int:
+    api = _client(args)
+    if args.op == "get-config":
+        _p(api.scheduler_configuration())
+        return 0
+    cfg = api.scheduler_configuration()
+    if args.scheduler_algorithm:
+        cfg["scheduler_algorithm"] = args.scheduler_algorithm
+    api.set_scheduler_configuration(cfg)
+    print("scheduler configuration updated")
+    return 0
+
+
+# -- parser ------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="nomad-tpu")
+    p.add_argument("--address", default=os.environ.get("NOMAD_ADDR",
+                                                       "http://127.0.0.1:4646"))
+    p.add_argument("--namespace", default=os.environ.get("NOMAD_NAMESPACE",
+                                                         "default"))
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ag = sub.add_parser("agent", help="run an agent (server+clients+http)")
+    ag.add_argument("-dev", action="store_true", dest="dev")
+    ag.add_argument("--clients", type=int, default=1)
+    ag.add_argument("--workers", type=int, default=2)
+    ag.add_argument("--port", type=int, default=4646)
+    ag.add_argument("--algorithm", default="binpack")
+    ag.add_argument("--data-dir", default="")
+    ag.set_defaults(fn=cmd_agent)
+
+    job = sub.add_parser("job").add_subparsers(dest="job_cmd", required=True)
+    jr = job.add_parser("run")
+    jr.add_argument("spec")
+    jr.add_argument("-detach", action="store_true")
+    jr.set_defaults(fn=cmd_job_run)
+    js = job.add_parser("status")
+    js.add_argument("job_id", nargs="?", default="")
+    js.set_defaults(fn=cmd_job_status)
+    jst = job.add_parser("stop")
+    jst.add_argument("job_id")
+    jst.add_argument("-purge", action="store_true")
+    jst.set_defaults(fn=cmd_job_stop)
+
+    node = sub.add_parser("node").add_subparsers(dest="node_cmd", required=True)
+    ns = node.add_parser("status")
+    ns.add_argument("node_id", nargs="?", default="")
+    ns.set_defaults(fn=cmd_node_status)
+    nd = node.add_parser("drain")
+    nd.add_argument("node_id")
+    g = nd.add_mutually_exclusive_group(required=True)
+    g.add_argument("-enable", action="store_true", dest="enable")
+    g.add_argument("-disable", action="store_false", dest="enable")
+    nd.add_argument("--deadline", type=float, default=3600.0)
+    nd.set_defaults(fn=cmd_node_drain)
+    ne = node.add_parser("eligibility")
+    ne.add_argument("node_id")
+    g2 = ne.add_mutually_exclusive_group(required=True)
+    g2.add_argument("-enable", action="store_true", dest="enable")
+    g2.add_argument("-disable", action="store_false", dest="enable")
+    ne.set_defaults(fn=cmd_node_eligibility)
+
+    al = sub.add_parser("alloc").add_subparsers(dest="alloc_cmd", required=True)
+    als = al.add_parser("status")
+    als.add_argument("alloc_id")
+    als.set_defaults(fn=cmd_alloc_status)
+
+    ev = sub.add_parser("eval").add_subparsers(dest="eval_cmd", required=True)
+    evs = ev.add_parser("status")
+    evs.add_argument("eval_id")
+    evs.set_defaults(fn=cmd_eval_status)
+
+    op = sub.add_parser("operator").add_subparsers(dest="op_cmd", required=True)
+    osched = op.add_parser("scheduler")
+    osched.add_argument("op", choices=["get-config", "set-config"])
+    osched.add_argument("-scheduler-algorithm", dest="scheduler_algorithm",
+                        default="")
+    osched.set_defaults(fn=cmd_operator_scheduler)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,8 @@ from ..structs.alloc import Allocation, TaskState
 from .allocdir import AllocDir
 from .task_runner import TaskRunner
 
+PRESTART_DEADLINE_S = 300.0  # healthy_deadline analog for lifecycle hooks
+
 LIFECYCLE_PRESTART = "prestart"
 LIFECYCLE_POSTSTART = "poststart"
 LIFECYCLE_POSTSTOP = "poststop"
@@ -73,10 +75,12 @@ class AllocRunner:
             r.start()
         for t, r in zip(prestart, pre_runners):
             if not t.lifecycle_sidecar:
-                r.wait_dead(timeout=300.0)
-                if r.state.failed:
-                    self._set_status(enums.ALLOC_CLIENT_FAILED,
-                                     f"prestart task {t.name} failed")
+                finished = r.wait_dead(timeout=PRESTART_DEADLINE_S)
+                if not finished or r.state.failed:
+                    self._set_status(
+                        enums.ALLOC_CLIENT_FAILED,
+                        f"prestart task {t.name} "
+                        f"{'failed' if finished else 'deadline exceeded'}")
                     self._kill_all()
                     return
 
@@ -95,12 +99,14 @@ class AllocRunner:
             if t.lifecycle_sidecar:
                 r.kill()
 
-        # poststop tasks run after the mains (reference poststop hooks)
+        # poststop tasks run after the mains (reference poststop hooks);
+        # one that overruns its deadline is killed, not waited on forever
         post_runners = [make_runner(t) for t in poststop]
         for r in post_runners:
             r.start()
         for r in post_runners:
-            r.wait_dead(timeout=300.0)
+            if not r.wait_dead(timeout=PRESTART_DEADLINE_S):
+                r.kill()
         self._recompute_status()
 
     def stop(self) -> None:

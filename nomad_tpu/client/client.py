@@ -150,7 +150,8 @@ class Client:
             upd = runner.alloc.copy_for_update()
             upd.client_status = runner.client_status
             upd.client_description = runner.client_description
-            upd.task_states = dict(runner.task_states)
+            upd.task_states = {name: st.copy()
+                               for name, st in runner.task_states.items()}
             fin = runner.finished_at()
             if fin:
                 upd.task_finished_at = fin

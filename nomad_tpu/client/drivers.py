@@ -158,11 +158,14 @@ class RawExecDriver:
 
     name = "raw_exec"
 
+    def _build_env(self, env: Dict[str, str]) -> Dict[str, str]:
+        return {**os.environ, **env}
+
     def start_task(self, task, env: Dict[str, str], task_dir: str) -> TaskHandle:
         cfg = task.config or {}
         command = cfg.get("command")
         if not command:
-            raise DriverError("raw_exec requires config.command")
+            raise DriverError(f"{self.name} requires config.command")
         argv = [str(command)] + [str(a) for a in cfg.get("args", [])]
         stdout = open(os.path.join(task_dir, "stdout.log"), "ab") \
             if os.path.isdir(task_dir) else subprocess.DEVNULL
@@ -172,7 +175,7 @@ class RawExecDriver:
             proc = subprocess.Popen(
                 argv,
                 cwd=task_dir if os.path.isdir(task_dir) else None,
-                env={**os.environ, **env},
+                env=self._build_env(env),
                 stdout=stdout, stderr=stderr,
                 start_new_session=True,  # own process group for kill
             )
@@ -187,35 +190,15 @@ class RawExecDriver:
 class ExecDriver(RawExecDriver):
     """Isolated subprocess driver (reference drivers/exec uses
     libcontainer namespaces/cgroups, executor_linux.go:36-42). The
-    portable core here is session isolation + a scrubbed environment;
-    cgroup/namespace enforcement hooks in where the platform allows."""
+    portable core here is session isolation + a scrubbed environment
+    (task env only, plus a usable PATH — the reference injects a default
+    task PATH the same way); cgroup/namespace enforcement hooks in where
+    the platform allows."""
 
     name = "exec"
 
-    def start_task(self, task, env: Dict[str, str], task_dir: str) -> TaskHandle:
-        cfg = task.config or {}
-        command = cfg.get("command")
-        if not command:
-            raise DriverError("exec requires config.command")
-        argv = [str(command)] + [str(a) for a in cfg.get("args", [])]
-        stdout = open(os.path.join(task_dir, "stdout.log"), "ab") \
-            if os.path.isdir(task_dir) else subprocess.DEVNULL
-        stderr = open(os.path.join(task_dir, "stderr.log"), "ab") \
-            if os.path.isdir(task_dir) else subprocess.DEVNULL
-        # scrubbed env: task env only, no host env leak — but tasks still
-        # need a usable PATH (the reference injects a default task PATH)
-        run_env = {"PATH": os.environ.get("PATH", os.defpath), **env}
-        try:
-            proc = subprocess.Popen(
-                argv,
-                cwd=task_dir if os.path.isdir(task_dir) else None,
-                env=run_env,
-                stdout=stdout, stderr=stderr,
-                start_new_session=True,
-            )
-        except OSError as e:
-            raise DriverError(f"failed to start {command}: {e}") from e
-        return _ProcHandle(proc)
+    def _build_env(self, env: Dict[str, str]) -> Dict[str, str]:
+        return {"PATH": os.environ.get("PATH", os.defpath), **env}
 
 
 # ---------------------------------------------------------------------------

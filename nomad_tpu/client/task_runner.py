@@ -52,6 +52,12 @@ class TaskRunner:
         self._thread.start()
 
     def run(self) -> None:
+        try:
+            self._run()
+        except Exception as e:  # never strand the alloc non-terminal
+            self._fail(f"task runner crashed: {e!r}")
+
+    def _run(self) -> None:
         self._event("Received", "task received by client")
         try:
             driver = get_driver(self.task.driver)
@@ -117,10 +123,11 @@ class TaskRunner:
         now = time.time()
         window_start = now - self.policy.interval_s
         self._restart_times = [t for t in self._restart_times if t >= window_start]
-        if len(self._restart_times) >= self.policy.attempts:
+        if len(self._restart_times) >= max(self.policy.attempts, 0):
             if self.policy.mode == "delay":
                 # wait out the interval, then the window clears
-                oldest = self._restart_times[0]
+                # (attempts=0 delay-mode waits a full interval each time)
+                oldest = self._restart_times[0] if self._restart_times else now
                 delay = max(0.0, oldest + self.policy.interval_s - now)
                 if self._killed.wait(delay):
                     return False

@@ -30,7 +30,10 @@ from ..structs.evaluation import Evaluation
 from ..utils import generate_uuid
 
 FAILED_QUEUE = "_failed"
-DEFAULT_NACK_TIMEOUT = 5.0
+# long enough that a slow eval (first jit compile, wide spread jobs) is
+# never redelivered mid-flight — duplicate in-flight evals mean duplicate
+# placements (the reference also uses 60s, eval_broker.go)
+DEFAULT_NACK_TIMEOUT = 60.0
 DEFAULT_DELIVERY_LIMIT = 3
 
 

@@ -37,7 +37,7 @@ from .worker import Worker
 class ServerConfig:
     num_workers: int = 2
     heartbeat_ttl: float = 10.0
-    nack_timeout: float = 5.0
+    nack_timeout: float = 60.0
     eval_delivery_limit: int = 3
     # backoff before a delivery-limited eval is retried
     # (reference leader.go failedEvalUnblockInterval)

@@ -18,16 +18,6 @@ from .rank import NodeScorer, _class_feasible
 from .util import tainted_nodes, update_non_terminal_allocs_to_lost
 
 
-def _node_in_pool(node, job) -> bool:
-    """Whether a node is in the job's datacenter/pool universe (the
-    readiness-independent half of readyNodesInDCsAndPool,
-    reference scheduler/util.go:50)."""
-    dcs = set(job.datacenters)
-    if "*" not in dcs and node.datacenter not in dcs:
-        return False
-    return job.node_pool == enums.NODE_POOL_ALL or node.node_pool == job.node_pool
-
-
 class SystemScheduler:
     def __init__(self, state, planner, *, sysbatch: bool = False,
                  sched_config=None, logger=None, placer=None):
@@ -86,7 +76,7 @@ class SystemScheduler:
             if node_id in node_ids:
                 continue
             node = self.state.node_by_id(node_id)
-            if node is not None and _node_in_pool(node, job):
+            if node is not None and node.in_pool(job.datacenters, job.node_pool):
                 # node exists in the job's DC/pool but is not ready (e.g.
                 # marked scheduling-ineligible pre-maintenance):
                 # ineligibility only blocks new placements, running allocs

@@ -66,18 +66,9 @@ class StateSnapshot:
 
     def ready_nodes_in_pool(self, datacenters: Iterable[str], node_pool: str) -> List[Node]:
         """Reference scheduler/util.go:50 readyNodesInDCsAndPool."""
-        dcs = set(datacenters)
-        any_dc = "*" in dcs
-        out = []
-        for n in self.nodes():
-            if not n.ready():
-                continue
-            if not any_dc and n.datacenter not in dcs:
-                continue
-            if node_pool != enums.NODE_POOL_ALL and n.node_pool != node_pool:
-                continue
-            out.append(n)
-        return out
+        dcs = list(datacenters)
+        return [n for n in self.nodes()
+                if n.ready() and n.in_pool(dcs, node_pool)]
 
     # --- jobs ---
 

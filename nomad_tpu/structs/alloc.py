@@ -96,6 +96,15 @@ class TaskState:
     def successful(self) -> bool:
         return self.state == "dead" and not self.failed
 
+    def copy(self) -> "TaskState":
+        """Snapshot copy — runner threads keep mutating the live object,
+        so anything handed to the MVCC store must be detached."""
+        return TaskState(
+            state=self.state, failed=self.failed, restarts=self.restarts,
+            last_restart=self.last_restart, started_at=self.started_at,
+            finished_at=self.finished_at, events=list(self.events),
+        )
+
 
 @dataclass(slots=True)
 class NetworkStatus:

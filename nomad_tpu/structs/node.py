@@ -64,6 +64,16 @@ class Node:
             and self.scheduling_eligibility == enums.NODE_SCHED_ELIGIBLE
         )
 
+    def in_pool(self, datacenters, node_pool: str) -> bool:
+        """Membership in a job's datacenter/pool universe — the
+        readiness-independent half of readyNodesInDCsAndPool (reference
+        scheduler/util.go:50). Single source of truth for the store's
+        ready-node filter and the system scheduler's keep/stop decision."""
+        dcs = set(datacenters)
+        if "*" not in dcs and self.datacenter not in dcs:
+            return False
+        return node_pool == enums.NODE_POOL_ALL or self.node_pool == node_pool
+
     def available_vec(self) -> np.ndarray:
         """Total minus agent-reserved resources — the denominator for fit
         scoring (reference nomad/structs/funcs.go:213 computeFreePercentage)."""

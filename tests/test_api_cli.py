@@ -1,0 +1,276 @@
+"""HTTP API + Python client + jobspec + CLI tests
+(reference command/agent http tests + api package tests).
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api import ApiClient, HTTPAgent
+from nomad_tpu.api.codec import from_dict, to_dict
+from nomad_tpu.api.jobspec import parse_hcl_like, parse_json
+from nomad_tpu.core import Server, ServerConfig
+from nomad_tpu.structs import enums
+from nomad_tpu.structs.job import Job
+
+
+@pytest.fixture()
+def stack():
+    server = Server(ServerConfig(heartbeat_ttl=30.0))
+    server.start()
+    for _ in range(4):
+        server.register_node(mock.node())
+    agent = HTTPAgent(server, port=0).start()
+    api = ApiClient(address=agent.address)
+    yield server, agent, api
+    agent.stop()
+    server.stop()
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+
+def test_codec_job_roundtrip():
+    job = mock.job()
+    d = to_dict(job)
+    back = from_dict(Job, d)
+    assert back.id == job.id
+    assert back.task_groups[0].count == job.task_groups[0].count
+    assert back.task_groups[0].tasks[0].resources.cpu == 500
+    assert back.constraints[0].ltarget == "${attr.kernel.name}"
+    json.dumps(d)  # JSON-safe
+
+
+# ---------------------------------------------------------------------------
+# jobspec
+# ---------------------------------------------------------------------------
+
+
+def test_parse_json_jobspec():
+    spec = {
+        "job": {
+            "id": "api", "type": "service", "datacenters": ["dc1"],
+            "task_groups": [{
+                "name": "api", "count": 2,
+                "tasks": [{"name": "srv", "driver": "mock",
+                           "config": {"run_for": 1},
+                           "resources": {"cpu": 100, "memory_mb": 64}}],
+            }],
+        }
+    }
+    job = parse_json(json.dumps(spec))
+    assert job.id == "api" and job.task_groups[0].count == 2
+    assert job.task_groups[0].tasks[0].resources.memory_mb == 64
+
+
+def test_parse_json_rejects_bad_spec():
+    with pytest.raises(ValueError):
+        parse_json(json.dumps({"job": {"id": "x", "task_groups": []}}))
+
+
+def test_parse_hcl_like_jobspec():
+    spec = '''
+    # demo service
+    job "web" {
+      datacenters = ["dc1", "dc2"]
+      type = "service"
+      priority = 70
+      constraint {
+        attribute = "${attr.kernel.name}"
+        value     = "linux"
+      }
+      group "frontend" {
+        count = 3
+        spread {
+          attribute = "${node.datacenter}"
+          weight    = 60
+          target "dc1" { percent = 70 }
+          target "dc2" { percent = 30 }
+        }
+        restart {
+          attempts = 3
+          delay    = 1
+        }
+        task "server" {
+          driver = "raw_exec"
+          config {
+            command = "/bin/sleep"
+            args    = ["60"]
+          }
+          env {
+            PORT = "8080"
+          }
+          resources {
+            cpu    = 250
+            memory = 128
+          }
+        }
+      }
+    }
+    '''
+    job = parse_hcl_like(spec)
+    assert job.id == "web" and job.priority == 70
+    assert job.datacenters == ["dc1", "dc2"]
+    assert job.constraints[0].rtarget == "linux"
+    tg = job.task_groups[0]
+    assert tg.name == "frontend" and tg.count == 3
+    assert tg.spreads[0].targets[0].value == "dc1"
+    assert tg.spreads[0].targets[0].percent == 70
+    assert tg.restart_policy.attempts == 3
+    t = tg.tasks[0]
+    assert t.driver == "raw_exec" and t.config["command"] == "/bin/sleep"
+    assert t.env["PORT"] == "8080"
+    assert t.resources.cpu == 250 and t.resources.memory_mb == 128
+
+
+# ---------------------------------------------------------------------------
+# HTTP API + client
+# ---------------------------------------------------------------------------
+
+
+def test_register_and_query_job_over_http(stack):
+    server, agent, api = stack
+    job = mock.job()
+    eval_id = api.register_job(job)
+    assert eval_id
+    assert server.wait_for_idle(10.0)
+
+    got = api.job(job.id)
+    assert got["id"] == job.id
+    allocs = api.job_allocations(job.id)
+    assert len(allocs) == 10
+    evs = api.job_evaluations(job.id)
+    assert any(e["id"] == eval_id for e in evs)
+    stubs = api.list_jobs()
+    assert any(s["id"] == job.id for s in stubs)
+    # allocation detail
+    detail = api.allocation(allocs[0]["id"])
+    assert detail["job_id"] == job.id
+
+    ev = api.evaluation(eval_id)
+    assert ev["status"] == "complete"
+
+
+def test_node_endpoints_and_drain(stack):
+    server, agent, api = stack
+    nodes = api.list_nodes()
+    assert len(nodes) == 4
+    nid = nodes[0]["id"]
+    assert api.node(nid)["id"] == nid
+    api.drain_node(nid, drain_spec={"deadline_s": 60.0})
+    assert api.node(nid)["drain_strategy"] is not None
+    assert api.node(nid)["scheduling_eligibility"] == "ineligible"
+    api.drain_node(nid, drain_spec=None, mark_eligible=True)
+    assert api.node(nid)["drain_strategy"] is None
+    api.set_node_eligibility(nid, False)
+    assert api.node(nid)["scheduling_eligibility"] == "ineligible"
+
+
+def test_deregister_over_http(stack):
+    server, agent, api = stack
+    job = mock.job()
+    api.register_job(job)
+    server.wait_for_idle(10.0)
+    api.deregister_job(job.id)
+    server.wait_for_idle(10.0)
+    live = [a for a in api.job_allocations(job.id)
+            if a["desired_status"] == enums.ALLOC_DESIRED_RUN]
+    assert live == []
+
+
+def test_scheduler_configuration_endpoint(stack):
+    server, agent, api = stack
+    cfg = api.scheduler_configuration()
+    assert cfg["scheduler_algorithm"] == "binpack"
+    cfg["scheduler_algorithm"] = enums.SCHED_ALG_TPU_BINPACK
+    api.set_scheduler_configuration(cfg)
+    assert server.sched_config.scheduler_algorithm == enums.SCHED_ALG_TPU_BINPACK
+    # and it takes effect for new evals
+    job = mock.job()
+    api.register_job(job)
+    assert server.wait_for_idle(30.0)
+    assert len(api.job_allocations(job.id)) == 10
+
+
+def test_blocking_query_unblocks_on_write(stack):
+    server, agent, api = stack
+    _, index = api.get("/v1/jobs")
+    results = {}
+
+    def blocker():
+        t0 = time.time()
+        payload, new_index = api.blocking("/v1/jobs", index, wait_s=10.0)
+        results["dt"] = time.time() - t0
+        results["index"] = new_index
+        results["jobs"] = payload
+
+    t = threading.Thread(target=blocker)
+    t.start()
+    time.sleep(0.3)
+    job = mock.job()
+    api.register_job(job)
+    t.join(timeout=12.0)
+    assert not t.is_alive()
+    assert results["index"] > index
+    assert results["dt"] < 9.0  # unblocked by the write, not the timeout
+
+
+def test_agent_self_and_404(stack):
+    server, agent, api = stack
+    info = api.agent_self()
+    assert "stats" in info
+    from nomad_tpu.api.client import ApiError
+
+    with pytest.raises(ApiError) as e:
+        api.job("nope")
+    assert e.value.status == 404
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_job_flow(stack, tmp_path, capsys):
+    server, agent, api = stack
+    from nomad_tpu.cli import main
+
+    spec = tmp_path / "demo.nomad"
+    spec.write_text('''
+    job "cli-demo" {
+      datacenters = ["dc1"]
+      group "g" {
+        count = 2
+        task "t" {
+          driver = "mock"
+          config { run_for = 60 }
+          resources { cpu = 100 \n memory = 64 }
+        }
+      }
+    }
+    ''')
+    rc = main(["--address", agent.address, "job", "run", str(spec)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "registered" in out and "complete" in out
+
+    rc = main(["--address", agent.address, "job", "status", "cli-demo"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "cli-demo" in out and out.count("run") >= 2
+
+    rc = main(["--address", agent.address, "node", "status"])
+    assert rc == 0
+
+    rc = main(["--address", agent.address, "operator", "scheduler",
+               "set-config", "-scheduler-algorithm", "tpu-binpack"])
+    assert rc == 0
+    assert server.sched_config.scheduler_algorithm == "tpu-binpack"
+
+    rc = main(["--address", agent.address, "job", "stop", "cli-demo"])
+    assert rc == 0
