@@ -1,0 +1,145 @@
+"""Core garbage collection (reference nomad/core_sched.go, ~1,000 LoC).
+
+The reference enqueues internal JobTypeCore evals on a leader timer;
+here a GC thread runs the same collectors directly against the store:
+
+- eval GC: terminal evals (and their terminal allocs) past threshold
+- alloc GC: terminal allocs of live jobs past threshold
+- job GC: dead/stopped jobs with nothing running left
+- deployment GC: terminal deployments
+- node GC: down nodes with no allocs
+- version-chain compaction of the MVCC store
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..structs import enums
+
+
+class CoreScheduler:
+    def __init__(self, server, interval: float = 60.0,
+                 eval_gc_threshold: float = 3600.0,
+                 job_gc_threshold: float = 4 * 3600.0,
+                 node_gc_threshold: float = 24 * 3600.0):
+        self.server = server
+        self.interval = interval
+        self.eval_gc_threshold = eval_gc_threshold
+        self.job_gc_threshold = job_gc_threshold
+        self.node_gc_threshold = node_gc_threshold
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stats = {"evals": 0, "allocs": 0, "jobs": 0, "deployments": 0,
+                      "nodes": 0, "rows_compacted": 0}
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="core-gc")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.force_gc()
+            except Exception:
+                if self.server.logger:
+                    self.server.logger.exception("core gc failed")
+
+    def force_gc(self, threshold_override: Optional[float] = None) -> dict:
+        """Run every collector now (reference `nomad system gc` /
+        CoreJobForceGC). threshold_override=0 collects everything
+        terminal regardless of age."""
+        now = time.time()
+        et = self.eval_gc_threshold if threshold_override is None else threshold_override
+        jt = self.job_gc_threshold if threshold_override is None else threshold_override
+        nt = self.node_gc_threshold if threshold_override is None else threshold_override
+        store = self.server.store
+        snap = store.snapshot()
+
+        # --- eval GC (core_sched.go:111 evalGC) ---
+        gc_evals = []
+        for ev in snap.evals():
+            if not ev.terminal_status():
+                continue
+            if now - (ev.modify_time or 0) < et:
+                continue
+            allocs = snap.allocs_by_eval(ev.id)
+            if all(a.terminal_status() or a.server_terminal() for a in allocs):
+                gc_evals.append(ev.id)
+        if gc_evals:
+            store.delete_evals(gc_evals)
+            self.stats["evals"] += len(gc_evals)
+
+        # --- alloc GC: orphans + stopped-and-finished allocs ---
+        n = store.gc_terminal_allocs(before_index=store.latest_index,
+                                     before_time=now - et)
+        self.stats["allocs"] += n
+
+        # --- job GC (core_sched.go:44 jobGC) ---
+        snap = store.snapshot()
+        for job in list(snap.jobs()):
+            dead = job.stopped() or job.status == enums.JOB_STATUS_DEAD
+            if not dead:
+                continue
+            allocs = snap.allocs_by_job(job.id, job.namespace)
+            if any(not a.terminal_status() and not a.server_terminal()
+                   for a in allocs):
+                continue
+            evals = snap.evals_by_job(job.id, job.namespace)
+            if any(not e.terminal_status() for e in evals):
+                continue
+            newest = max((e.modify_time or 0 for e in evals), default=0.0)
+            if now - newest < jt:
+                continue  # retain recently-finished history
+            store.delete_job(job.id, job.namespace, purge=True)
+            if evals:
+                store.delete_evals([e.id for e in evals])
+            self.stats["jobs"] += 1
+            self.server.blocked.untrack_job(job.namespace, job.id)
+
+        # --- deployment GC (core_sched.go:236 deploymentGC): drop
+        # orphans, and for live jobs keep only the newest terminal
+        # deployment (status/auto-revert reference) per job ---
+        snap = store.snapshot()
+        newest_terminal: dict = {}
+        for dep in list(snap.deployments()):
+            if dep.active():
+                continue
+            if snap.job_by_id(dep.job_id, dep.namespace) is None:
+                store.delete_deployment(dep.id)
+                self.stats["deployments"] += 1
+                continue
+            key = (dep.namespace, dep.job_id)
+            prev = newest_terminal.get(key)
+            if prev is None:
+                newest_terminal[key] = dep
+            else:
+                older = dep if dep.modify_index < prev.modify_index else prev
+                newest_terminal[key] = dep if older is prev else prev
+                store.delete_deployment(older.id)
+                self.stats["deployments"] += 1
+
+        # --- node GC (core_sched.go:423 nodeGC) ---
+        snap = store.snapshot()
+        for node in list(snap.nodes()):
+            if node.status != enums.NODE_STATUS_DOWN:
+                continue
+            if now - (node.status_updated_at or 0) < nt:
+                continue
+            if snap.allocs_by_node(node.id):
+                continue
+            store.delete_node(node.id)
+            self.stats["nodes"] += 1
+
+        # --- MVCC compaction ---
+        self.stats["rows_compacted"] += store.compact()
+        return dict(self.stats)

@@ -1,0 +1,177 @@
+"""Deployment watcher (reference nomad/deploymentwatcher/, ~2,000 LoC).
+
+Watches active deployments and drives the rollout state machine:
+
+- an alloc counts healthy once all its tasks have been running for the
+  group's min_healthy_time (reference client/allochealth aggregated
+  here server-side);
+- a failed deployment alloc fails the deployment; auto_revert re-submits
+  the last known-good job version;
+- healthy >= desired for every group -> successful;
+- progress deadline exceeded -> failed (+ auto-revert);
+- while healthy count grows, follow-up evals keep the rolling update
+  moving (the reconciler replaces at most max_parallel per eval).
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+import threading
+import time
+from typing import Dict, Optional
+
+from ..structs import enums
+from ..structs.evaluation import Evaluation
+from ..utils import generate_uuid
+
+
+class DeploymentWatcher:
+    def __init__(self, server, interval: float = 0.2):
+        self.server = server
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread = None
+        # deployment id -> healthy count at last follow-up eval
+        self._progress: Dict[str, int] = {}
+        self.stats = {"succeeded": 0, "failed": 0, "reverted": 0}
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="deployment-watcher")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self._tick()
+            except Exception:
+                if self.server.logger:
+                    self.server.logger.exception("deployment watcher tick failed")
+
+    def _tick(self) -> None:
+        snap = self.server.store.snapshot()
+        now = time.time()
+        for dep in list(snap.deployments()):
+            if not dep.active():
+                self._progress.pop(dep.id, None)
+                continue
+            job = snap.job_by_id(dep.job_id, dep.namespace)
+            if job is None or job.version != dep.job_version:
+                self._update_status(dep, enums.DEPLOYMENT_STATUS_CANCELLED,
+                                    "superseded by a newer job version")
+                continue
+
+            allocs = [a for a in snap.allocs_by_job(dep.job_id, dep.namespace)
+                      if a.deployment_id == dep.id]
+            healthy = 0
+            failed = False
+            for a in allocs:
+                if a.client_status == enums.ALLOC_CLIENT_FAILED:
+                    failed = True
+                elif self._alloc_healthy(a, job, now):
+                    healthy += 1
+
+            if failed:
+                self._fail(snap, dep, job, "allocations failed")
+                continue
+            deadline = min((s.require_progress_by
+                            for s in dep.task_groups.values()
+                            if s.require_progress_by), default=0.0)
+            desired = sum(s.desired_total for s in dep.task_groups.values())
+            if healthy >= desired and len(allocs) >= desired:
+                upd = _copy.copy(dep)
+                upd.task_groups = dict(dep.task_groups)
+                self._set_counts(upd, allocs, healthy)
+                upd.status = enums.DEPLOYMENT_STATUS_SUCCESSFUL
+                upd.status_description = "Deployment completed successfully"
+                self.server.store.upsert_deployment(upd)
+                self.stats["succeeded"] += 1
+                self._progress.pop(dep.id, None)
+                continue
+            if deadline and now > deadline and healthy < desired:
+                self._fail(snap, dep, job, "progress deadline exceeded")
+                continue
+
+            # rollout continuation: when new allocs turn healthy, let the
+            # scheduler replace the next max_parallel batch
+            last = self._progress.get(dep.id, -1)
+            if healthy > last:
+                self._progress[dep.id] = healthy
+                old_version_live = any(
+                    a.job_version != dep.job_version and not a.terminal_status()
+                    and not a.server_terminal()
+                    for a in snap.allocs_by_job(dep.job_id, dep.namespace))
+                if old_version_live and last >= 0:
+                    self._create_eval(job)
+
+    def _alloc_healthy(self, alloc, job, now: float) -> bool:
+        if alloc.client_status != enums.ALLOC_CLIENT_RUNNING:
+            return False
+        tg = job.lookup_task_group(alloc.task_group)
+        min_healthy = (tg.update.min_healthy_time_s
+                       if tg is not None and tg.update is not None else 10.0)
+        if not alloc.task_states:
+            return False
+        for st in alloc.task_states.values():
+            if st.state != "running" or not st.started_at:
+                return False
+            if now - st.started_at < min_healthy:
+                return False
+        return True
+
+    def _set_counts(self, dep, allocs, healthy: int) -> None:
+        by_group: Dict[str, int] = {}
+        for a in allocs:
+            by_group[a.task_group] = by_group.get(a.task_group, 0) + 1
+        for name, state in list(dep.task_groups.items()):
+            s = _copy.copy(state)
+            s.placed_allocs = by_group.get(name, 0)
+            s.healthy_allocs = healthy  # aggregate; per-group split refined later
+            dep.task_groups[name] = s
+
+    def _fail(self, snap, dep, job, reason: str) -> None:
+        self._update_status(dep, enums.DEPLOYMENT_STATUS_FAILED,
+                            f"Deployment failed: {reason}")
+        self.stats["failed"] += 1
+        self._progress.pop(dep.id, None)
+        auto_revert = any(s.auto_revert for s in dep.task_groups.values())
+        if not auto_revert:
+            return
+        # revert to the previous job version (reference auto-revert picks
+        # the latest stable version)
+        prior = self.server.store.snapshot().job_version(
+            dep.job_id, dep.job_version - 1, dep.namespace)
+        if prior is None:
+            return
+        reverted = _copy.copy(prior)
+        reverted.stop = False
+        self.server.store.upsert_job(reverted)  # becomes the next version
+        self._create_eval(reverted)
+        self.stats["reverted"] += 1
+
+    def _update_status(self, dep, status: str, desc: str) -> None:
+        upd = _copy.copy(dep)
+        upd.status = status
+        upd.status_description = desc
+        self.server.store.upsert_deployment(upd)
+
+    def _create_eval(self, job) -> None:
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=job.namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=enums.TRIGGER_DEPLOYMENT_WATCHER,
+            job_id=job.id,
+            status=enums.EVAL_STATUS_PENDING,
+            create_time=time.time(),
+        )
+        index = self.server.store.upsert_evals([ev])
+        ev.modify_index = index
+        self.server.broker.enqueue(ev)

@@ -1,0 +1,138 @@
+"""Node drainer (reference nomad/drainer/, ~1,500 LoC).
+
+Migrates allocations off draining nodes at a controlled pace: per job
+task group, at most `migrate.max_parallel` allocs carry the migrate
+transition at a time; as replacements become healthy elsewhere the next
+batch is marked. When a node's drain deadline passes, everything left is
+force-migrated. A node with no more migratable allocs has its drain
+cleared (it stays ineligible until explicitly re-enabled — reference
+drainer/watch_nodes.go).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List
+
+from ..structs import enums
+from ..structs.alloc import DesiredTransition
+
+
+class NodeDrainer:
+    def __init__(self, server, interval: float = 0.2):
+        self.server = server
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread = None
+        # node id -> absolute deadline
+        self._deadlines: Dict[str, float] = {}
+        self.stats = {"migrations_marked": 0, "drains_completed": 0}
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="node-drainer")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self._tick()
+            except Exception:
+                if self.server.logger:
+                    self.server.logger.exception("drainer tick failed")
+
+    def _tick(self) -> None:
+        snap = self.server.store.snapshot()
+        now = time.time()
+        for node in list(snap.nodes()):
+            if not node.drain:
+                self._deadlines.pop(node.id, None)
+                continue
+            strat = node.drain_strategy
+            if node.id not in self._deadlines:
+                self._deadlines[node.id] = (
+                    now + strat.deadline_s if strat.deadline_s > 0 else float("inf"))
+            deadline = self._deadlines[node.id]
+
+            # anything not yet finished client-side is still occupying the
+            # node: stopped-but-running allocs count against max_parallel
+            # (availability is only restored once the task actually exits)
+            allocs = [a for a in snap.allocs_by_node(node.id)
+                      if not a.client_terminal()]
+            if strat.ignore_system_jobs:
+                allocs = [a for a in allocs
+                          if a.job is None
+                          or a.job.type not in (enums.JOB_TYPE_SYSTEM,
+                                                enums.JOB_TYPE_SYSBATCH)]
+            if not allocs:
+                # drain complete: clear the strategy, stay ineligible
+                self.server.store.update_node_drain(node.id, None)
+                self._deadlines.pop(node.id, None)
+                self.stats["drains_completed"] += 1
+                continue
+
+            force = now >= deadline
+            to_mark: List[str] = []
+            # pace per (job, task group): max_parallel in flight at once
+            by_group: Dict[tuple, List] = {}
+            for a in allocs:
+                by_group.setdefault((a.namespace, a.job_id, a.task_group), []).append(a)
+            for key, group_allocs in by_group.items():
+                inflight = sum(1 for a in group_allocs
+                               if a.desired_transition.migrate or a.server_terminal())
+                tg = None
+                if group_allocs[0].job is not None:
+                    tg = group_allocs[0].job.lookup_task_group(key[2])
+                max_parallel = 1
+                if tg is not None and tg.migrate is not None:
+                    max_parallel = max(1, tg.migrate.max_parallel)
+                budget = len(group_allocs) if force else max(0, max_parallel - inflight)
+                for a in group_allocs:
+                    if budget <= 0:
+                        break
+                    if not a.desired_transition.migrate and not a.server_terminal():
+                        to_mark.append(a.id)
+                        budget -= 1
+            if to_mark:
+                self.stats["migrations_marked"] += len(to_mark)
+                self._mark(snap, to_mark)
+
+    def _mark(self, snap, alloc_ids: List[str]) -> None:
+        """Set the migrate transition + create evals for affected jobs
+        (reference drainer batches desired-transition raft updates)."""
+        from ..structs.evaluation import Evaluation
+        from ..utils import generate_uuid
+
+        transition = DesiredTransition(migrate=True)
+        jobs = {}
+        for aid in alloc_ids:
+            a = snap.alloc_by_id(aid)
+            if a is None:
+                continue
+            job = snap.job_by_id(a.job_id, a.namespace)
+            if job is not None:
+                jobs[(a.namespace, a.job_id)] = job
+        evals = []
+        for job in jobs.values():
+            evals.append(Evaluation(
+                id=generate_uuid(),
+                namespace=job.namespace,
+                priority=job.priority,
+                type=job.type,
+                triggered_by=enums.TRIGGER_NODE_DRAIN,
+                job_id=job.id,
+                status=enums.EVAL_STATUS_PENDING,
+                create_time=time.time(),
+            ))
+        index = self.server.store.update_alloc_desired_transitions(
+            {aid: transition for aid in alloc_ids}, evals)
+        for ev in evals:
+            ev.modify_index = index
+        self.server.broker.enqueue_all(evals)

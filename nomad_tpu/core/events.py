@@ -1,0 +1,120 @@
+"""Event broker (reference nomad/stream/event_broker.go:40-70).
+
+Change-stream pub/sub fed by the state store's commit listener: every
+commit becomes a batch of topic-tagged events in a bounded ring buffer;
+subscribers consume from their own cursor and can filter by topic/key.
+Slow subscribers that fall off the ring see a truncation marker instead
+of blocking writers (the reference's ring semantics).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Iterator, List, Optional, Tuple
+
+TOPIC_FOR_KIND = {
+    "node-upsert": "Node", "node-status": "Node", "node-eligibility": "Node",
+    "node-drain": "Node", "node-delete": "Node",
+    "job-upsert": "Job", "job-delete": "Job", "job-status": "Job",
+    "eval-upsert": "Evaluation", "eval-delete": "Evaluation",
+    "alloc-upsert": "Allocation", "alloc-stop": "Allocation",
+    "alloc-preempt": "Allocation", "alloc-client-update": "Allocation",
+    "alloc-transition": "Allocation",
+    "deployment-upsert": "Deployment", "deployment-update": "Deployment",
+    "deployment-delete": "Deployment",
+}
+
+
+class Event:
+    __slots__ = ("seq", "index", "topic", "type", "key", "payload")
+
+    def __init__(self, seq: int, index: int, topic: str, etype: str, key: str,
+                 payload):
+        self.seq = seq      # dense per-event cursor (ring bookkeeping)
+        self.index = index  # state-store index (external meaning)
+        self.topic = topic
+        self.type = etype
+        self.key = key
+        self.payload = payload
+
+
+class Subscription:
+    def __init__(self, broker: "EventBroker",
+                 topics: Optional[Dict[str, List[str]]] = None):
+        self._broker = broker
+        # topic -> keys ("*" = all); empty dict = all topics
+        self.topics = topics or {}
+        self.cursor = broker.last_seq()
+        self.truncated = False
+
+    def _wants(self, ev: Event) -> bool:
+        if not self.topics:
+            return True
+        keys = self.topics.get(ev.topic)
+        if keys is None:
+            keys = self.topics.get("*")
+        if keys is None:
+            return False
+        return "*" in keys or ev.key in keys
+
+    def next_events(self, timeout: Optional[float] = 1.0) -> List[Event]:
+        """Events past this subscription's cursor (blocking)."""
+        evs, truncated = self._broker.events_after(self.cursor, timeout)
+        if truncated:
+            self.truncated = True
+        if evs:
+            self.cursor = evs[-1].seq
+        return [e for e in evs if self._wants(e)]
+
+    def close(self) -> None:
+        self._broker.unsubscribe(self)
+
+
+class EventBroker:
+    def __init__(self, store, ring_size: int = 4096):
+        self._ring: deque = deque(maxlen=ring_size)
+        self._lock = threading.Condition()
+        self._subs: List[Subscription] = []
+        self._seq = 0  # dense event counter: truncation detection needs
+        #                gap-free numbering, which store indexes are not
+        store.add_commit_listener(self._on_commit)
+
+    def _on_commit(self, index: int, events: list) -> None:
+        with self._lock:
+            for kind, payload in events:
+                topic = TOPIC_FOR_KIND.get(kind)
+                if topic is None:
+                    continue
+                key = getattr(payload, "id", "") if payload is not None else ""
+                self._seq += 1
+                self._ring.append(Event(self._seq, index, topic, kind, key,
+                                        payload))
+            self._lock.notify_all()
+
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def subscribe(self, topics: Optional[Dict[str, List[str]]] = None) -> Subscription:
+        sub = Subscription(self, topics)
+        with self._lock:
+            self._subs.append(sub)
+        return sub
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        with self._lock:
+            if sub in self._subs:
+                self._subs.remove(sub)
+
+    def events_after(self, cursor: int, timeout: Optional[float]
+                     ) -> Tuple[List[Event], bool]:
+        """-> (events with seq > cursor, truncated?). Blocks up to
+        timeout for new events. seq is dense, so a gap between the
+        cursor and the ring head means events were evicted."""
+        with self._lock:
+            if not self._ring or self._ring[-1].seq <= cursor:
+                self._lock.wait(timeout)
+            truncated = bool(self._ring) and self._ring[0].seq > cursor + 1
+            out = [e for e in self._ring if e.seq > cursor]
+            return out, truncated

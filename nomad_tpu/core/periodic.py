@@ -1,0 +1,152 @@
+"""Periodic job dispatcher (reference nomad/periodic.go:153-375).
+
+Cron-style launcher: periodic parent jobs never run directly; at each
+cron tick a child job `<parent>/periodic-<unix>` is registered and
+evaluated. prohibit_overlap skips a tick while a previous child still
+has non-terminal allocs.
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..structs import enums
+from ..structs.job import Job
+
+PERIODIC_LAUNCH_SUFFIX = "/periodic-"
+
+
+class CronSpec:
+    """Five-field cron: minute hour day-of-month month day-of-week.
+    Supports *, */n, a-b, and comma lists (the subset the reference's
+    cronexpr dependency sees in practice)."""
+
+    FIELDS = [(0, 59), (0, 23), (1, 31), (1, 12), (0, 6)]
+
+    def __init__(self, spec: str):
+        parts = spec.split()
+        if len(parts) != 5:
+            raise ValueError(f"cron spec needs 5 fields: {spec!r}")
+        self.sets: List[set] = []
+        for part, (lo, hi) in zip(parts, self.FIELDS):
+            self.sets.append(self._parse_field(part, lo, hi))
+
+    @staticmethod
+    def _parse_field(part: str, lo: int, hi: int) -> set:
+        out: set = set()
+        for piece in part.split(","):
+            step = 1
+            if "/" in piece:
+                piece, step_s = piece.split("/", 1)
+                step = int(step_s)
+            if piece in ("*", ""):
+                rng = range(lo, hi + 1)
+            elif "-" in piece:
+                a, b = piece.split("-", 1)
+                rng = range(int(a), int(b) + 1)
+            else:
+                rng = range(int(piece), int(piece) + 1)
+            out.update(v for v in rng if (v - lo) % step == 0 and lo <= v <= hi)
+        if not out:
+            raise ValueError(f"empty cron field {part!r}")
+        return out
+
+    def matches(self, t: time.struct_time) -> bool:
+        mins, hrs, dom, mon, dow = self.sets
+        return (t.tm_min in mins and t.tm_hour in hrs and t.tm_mday in dom
+                and t.tm_mon in mon and (t.tm_wday + 1) % 7 in dow)
+
+    def next_after(self, after: float, horizon_s: float = 366 * 86400.0) -> Optional[float]:
+        """Next matching minute strictly after `after` (UTC)."""
+        t = int(after // 60 + 1) * 60
+        end = after + horizon_s
+        while t <= end:
+            if self.matches(time.gmtime(t)):
+                return float(t)
+            t += 60
+        return None
+
+
+class PeriodicDispatcher:
+    def __init__(self, server, interval: float = 1.0):
+        self.server = server
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread = None
+        self._lock = threading.Lock()
+        # (ns, job id) -> (job, next launch time)
+        self._tracked: Dict[Tuple[str, str], Tuple[Job, Optional[float]]] = {}
+        self.stats = {"launched": 0, "skipped_overlap": 0}
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="periodic-dispatcher")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def add(self, job: Job) -> None:
+        """Track a periodic parent (called from Job.Register)."""
+        spec = CronSpec(job.periodic.spec)
+        with self._lock:
+            self._tracked[(job.namespace, job.id)] = (
+                job, spec.next_after(time.time()))
+
+    def remove(self, namespace: str, job_id: str) -> None:
+        with self._lock:
+            self._tracked.pop((namespace, job_id), None)
+
+    def tracked_count(self) -> int:
+        with self._lock:
+            return len(self._tracked)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self._tick()
+            except Exception:
+                if self.server.logger:
+                    self.server.logger.exception("periodic tick failed")
+
+    def _tick(self) -> None:
+        now = time.time()
+        due: List[Job] = []
+        with self._lock:
+            for key, (job, nxt) in list(self._tracked.items()):
+                if nxt is not None and now >= nxt:
+                    due.append(job)
+                    spec = CronSpec(job.periodic.spec)
+                    self._tracked[key] = (job, spec.next_after(now))
+        for job in due:
+            self.force_launch(job, launch_time=now)
+
+    def force_launch(self, job: Job, launch_time: Optional[float] = None) -> Optional[str]:
+        """Launch a child now (reference: `nomad job periodic force`).
+        Returns the child job id, or None when overlap-prohibited."""
+        launch_time = launch_time or time.time()
+        snap = self.server.store.snapshot()
+        if job.periodic is not None and job.periodic.prohibit_overlap:
+            prefix = job.id + PERIODIC_LAUNCH_SUFFIX
+            for other in snap.jobs():
+                if not other.id.startswith(prefix):
+                    continue
+                live = [a for a in snap.allocs_by_job(other.id, other.namespace)
+                        if not a.terminal_status() and not a.server_terminal()]
+                if live:
+                    self.stats["skipped_overlap"] += 1
+                    return None
+        child = _copy.copy(job)
+        child.id = f"{job.id}{PERIODIC_LAUNCH_SUFFIX}{int(launch_time)}"
+        child.name = child.id
+        child.periodic = None
+        child.parent_id = job.id
+        self.stats["launched"] += 1
+        self.server.register_job(child)
+        return child.id

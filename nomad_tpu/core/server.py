@@ -28,7 +28,12 @@ from ..structs.operator import SchedulerConfiguration
 from ..utils import generate_uuid
 from .blocked import BlockedEvals
 from .broker import EvalBroker
+from .core_sched import CoreScheduler
+from .deployments import DeploymentWatcher
+from .drainer import NodeDrainer
+from .events import EventBroker
 from .heartbeat import HeartbeatManager
+from .periodic import PeriodicDispatcher
 from .plan_apply import PlanApplier, PlanQueue
 from .worker import Worker
 
@@ -42,6 +47,7 @@ class ServerConfig:
     # backoff before a delivery-limited eval is retried
     # (reference leader.go failedEvalUnblockInterval)
     failed_eval_followup_delay: float = 60.0
+    gc_interval: float = 60.0
     sched_config: SchedulerConfiguration = field(default_factory=SchedulerConfiguration)
 
 
@@ -62,6 +68,11 @@ class Server:
         self.heartbeats = HeartbeatManager(self, ttl=self.config.heartbeat_ttl)
         self.workers: List[Worker] = [
             Worker(self, i) for i in range(self.config.num_workers)]
+        self.deployment_watcher = DeploymentWatcher(self)
+        self.drainer = NodeDrainer(self)
+        self.periodic = PeriodicDispatcher(self)
+        self.core_gc = CoreScheduler(self, interval=self.config.gc_interval)
+        self.events = EventBroker(self.store)
         self._running = False
         self.store.add_commit_listener(self._on_commit)
 
@@ -79,6 +90,10 @@ class Server:
         self._restore_evals()
         for w in self.workers:
             w.start()
+        self.deployment_watcher.start()
+        self.drainer.start()
+        self.periodic.start()
+        self.core_gc.start()
         self._reaper = threading.Thread(target=self._run_reaper, daemon=True,
                                         name="eval-reaper")
         self._reaper.start()
@@ -91,6 +106,10 @@ class Server:
             w.stop()
         for w in self.workers:
             w.join()
+        self.core_gc.stop()
+        self.periodic.stop()
+        self.drainer.stop()
+        self.deployment_watcher.stop()
         self.heartbeats.set_enabled(False)
         self.blocked.set_enabled(False)
         self.broker.set_enabled(False)
@@ -105,14 +124,18 @@ class Server:
         self.stop()
 
     def _restore_evals(self) -> None:
-        """Re-enqueue non-terminal evals after (re)start
-        (leader.go:389-403 restoreEvals)."""
+        """Re-enqueue non-terminal evals and re-track periodic parents
+        after (re)start (leader.go:389-403 restoreEvals + :412 periodic
+        restore)."""
         snap = self.store.snapshot()
         for ev in snap.evals():
             if ev.should_enqueue():
                 self.broker.enqueue(ev)
             elif ev.should_block():
                 self.blocked.block(ev)
+        for job in snap.jobs():
+            if job.is_periodic and job.periodic.enabled and not job.stopped():
+                self.periodic.add(job)
 
     # -- commit listener: unblock blocked evals on cluster changes --
 
@@ -183,6 +206,15 @@ class Server:
         if self.sched_config.reject_job_registration:
             raise PermissionError("job registration disabled")
         self.store.upsert_job(job)
+        if job.is_periodic:
+            # periodic parents don't run; the dispatcher launches children
+            # on the cron schedule (nomad/periodic.go); disabled configs
+            # register but stay parked
+            if job.periodic.enabled:
+                self.periodic.add(job)
+            else:
+                self.periodic.remove(job.namespace, job.id)
+            return ""
         return self._create_job_eval(job, enums.TRIGGER_JOB_REGISTER)
 
     def deregister_job(self, job_id: str, namespace: str = "default",
@@ -191,6 +223,7 @@ class Server:
         job = snap.job_by_id(job_id, namespace)
         self.store.delete_job(job_id, namespace, purge=purge)
         self.blocked.untrack_job(namespace, job_id)
+        self.periodic.remove(namespace, job_id)
         if job is None:
             return ""
         return self._create_job_eval(job, enums.TRIGGER_JOB_DEREGISTER,

@@ -42,6 +42,7 @@ class GenericScheduler:
 
         self.eval: Optional[Evaluation] = None
         self.plan = None
+        self.deployment = None
         self.failed_tg_allocs = {}
         self.queued_allocs = {}
         self.blocked: Optional[Evaluation] = None
@@ -90,6 +91,48 @@ class GenericScheduler:
             job if (job is not None and not job.stopped()) else None,
             ev.job_id, all_allocs, tainted, batch=self.batch, eval_id=ev.id)
         results = reconciler.compute()
+
+        # deployments track service-job rollouts (reference reconcile.go
+        # computeDeployments; watched by nomad/deploymentwatcher). A new
+        # job version with an update stanza opens a new deployment.
+        self.deployment = None
+        if not self.batch and job is not None and not job.stopped():
+            latest = self.state.latest_deployment_by_job(ev.job_id, ev.namespace)
+            has_update = any(tg.update is not None for tg in job.task_groups)
+            changes = results.total_places() > 0
+            # a new deployment only for a job version that never had one —
+            # a terminal deployment for the current version must NOT be
+            # re-opened by later placements (drains, reschedules), or a
+            # plain node drain could stall-fail-and-revert the job
+            if has_update and changes and (
+                    latest is None or latest.job_version != job.version):
+                from ..structs.deployment import Deployment, DeploymentState
+
+                dep = Deployment(
+                    id=generate_uuid(),
+                    namespace=job.namespace,
+                    job_id=job.id,
+                    job_version=job.version,
+                    eval_priority=ev.priority,
+                )
+                now0 = time.time()
+                for tg in job.task_groups:
+                    if tg.update is None:
+                        continue
+                    dep.task_groups[tg.name] = DeploymentState(
+                        auto_revert=tg.update.auto_revert,
+                        auto_promote=tg.update.auto_promote,
+                        desired_canaries=tg.update.canary,
+                        desired_total=tg.count,
+                        progress_deadline_s=tg.update.progress_deadline_s,
+                        require_progress_by=now0 + tg.update.progress_deadline_s,
+                    )
+                if dep.task_groups:
+                    self.deployment = dep
+                    self.plan.deployment = dep
+            elif latest is not None and latest.active() \
+                    and latest.job_version == job.version:
+                self.deployment = latest
 
         # plan stops
         for tg_name, g in results.groups.items():
@@ -162,6 +205,9 @@ class GenericScheduler:
             alloc = Allocation(
                 id=generate_uuid(),
                 eval_id=ev.id,
+                deployment_id=(self.deployment.id
+                               if self.deployment is not None
+                               and tg.update is not None else ""),
                 name=req.name,
                 namespace=job.namespace,
                 node_id=option.node.id,

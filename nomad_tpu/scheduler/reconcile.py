@@ -171,8 +171,18 @@ class AllocReconciler:
                         g.lost.append(a)
                     continue
                 if node.drain:
-                    if not a.client_terminal():
+                    # the drainer paces migrations by setting the migrate
+                    # transition on max_parallel allocs at a time
+                    # (reference reconcile_util filterByTainted checks
+                    # DesiredTransition.ShouldMigrate); unmarked allocs
+                    # keep running (and keep counting toward desired)
+                    # until their turn
+                    if a.client_terminal():
+                        continue
+                    if a.desired_transition.migrate:
                         g.migrate.append(a)
+                        continue
+                    live.append(a)
                     continue
             if a.client_status == enums.ALLOC_CLIENT_FAILED:
                 self._handle_failed(tg, a, g)

@@ -139,6 +139,9 @@ class StateSnapshot:
 
     # --- deployments ---
 
+    def deployments(self) -> Iterator[Deployment]:
+        return (d for _, d in self._store._deployments.iterate(self.index))
+
     def deployment_by_id(self, dep_id: str) -> Optional[Deployment]:
         return self._store._deployments.get(dep_id, self.index)
 
@@ -374,6 +377,9 @@ class StateStore:
         prev = self._evals.get_latest(ev.id)
         ev.create_index = prev.create_index if prev is not None else gen
         ev.modify_index = gen
+        ev.modify_time = time.time()
+        if not ev.create_time:
+            ev.create_time = ev.modify_time
         self._evals.put(ev.id, ev, gen, live)
         if prev is None:
             key = (ev.namespace, ev.job_id)
@@ -537,6 +543,15 @@ class StateStore:
             self._commit(gen, [("deployment-upsert", dep)])
             return gen
 
+    def delete_deployment(self, dep_id: str) -> int:
+        """GC a terminal deployment (reference core_sched.go deploymentGC)."""
+        with self._write_lock:
+            gen, live = self._begin()
+            dep = self._deployments.get_latest(dep_id)
+            self._deployments.delete(dep_id, gen, live)
+            self._commit(gen, [("deployment-delete", dep)])
+            return gen
+
     def update_deployment_status(self, dep_id: str, status: str, description: str = "") -> int:
         with self._write_lock:
             dep = self._deployments.get_latest(dep_id)
@@ -554,14 +569,28 @@ class StateStore:
 
     # --- GC (reference nomad/core_sched.go) ---
 
-    def gc_terminal_allocs(self, before_index: int) -> int:
-        """Drop client-terminal allocs older than before_index and compact
-        the cons-list indexes (reference core_sched.go allocation GC)."""
+    def gc_terminal_allocs(self, before_index: int,
+                           before_time: float = float("inf")) -> int:
+        """Drop allocs with no remaining purpose: orphans of purged jobs,
+        and explicitly-stopped (server-terminal) allocs that have also
+        finished client-side. Failed allocs with desired=run are KEPT —
+        they hold reschedule lineage for pending follow-up evals — and
+        completed batch allocs are kept so finished work isn't re-run;
+        both go with their job (reference core_sched.go ties alloc GC to
+        eval/job GC for exactly these reasons)."""
         with self._write_lock:
             gen, live = self._begin()
-            dead = [a.id for _, a in self._allocs.iterate(gen)
-                    if a.terminal_status() and a.client_terminal()
-                    and a.modify_index < before_index]
+
+            def gcable(a) -> bool:
+                if a.modify_index >= before_index:
+                    return False
+                if (a.modify_time or 0) > before_time:
+                    return False
+                if self._jobs.get_latest((a.namespace, a.job_id)) is None:
+                    return a.terminal_status() or a.server_terminal()
+                return a.server_terminal() and a.client_terminal()
+
+            dead = [a.id for _, a in self._allocs.iterate(gen) if gcable(a)]
             dead_set = set(dead)
             for aid in dead:
                 self._allocs.delete(aid, gen, live)

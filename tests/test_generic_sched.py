@@ -148,6 +148,12 @@ class TestServiceScheduling:
                       if a.node_id == drained.id]
         assert on_drained
         h.store.update_node_drain(drained.id, DrainStrategy(deadline_s=600))
+        # the drainer paces migrations by flagging allocs (core/drainer.py);
+        # here the harness plays drainer and marks them all at once
+        from nomad_tpu.structs.alloc import DesiredTransition
+
+        h.store.update_alloc_desired_transitions(
+            {a.id: DesiredTransition(migrate=True) for a in on_drained})
         ev2 = mock.eval_for(job, triggered_by=enums.TRIGGER_NODE_DRAIN)
         h.process(ev2)
         snap = h.snapshot()

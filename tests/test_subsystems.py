@@ -1,0 +1,326 @@
+"""Leader subsystem tests: periodic dispatch, core GC, node drainer,
+deployment watcher, event broker (reference nomad/ subsystem tests).
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.core import Server, ServerConfig
+from nomad_tpu.core.periodic import CronSpec
+from nomad_tpu.structs import enums
+from nomad_tpu.structs.job import PeriodicConfig, Task, UpdateStrategy
+from nomad_tpu.structs.node import DrainStrategy
+
+
+# ---------------------------------------------------------------------------
+# cron / periodic
+# ---------------------------------------------------------------------------
+
+
+class TestCron:
+    def test_every_minute(self):
+        spec = CronSpec("* * * * *")
+        nxt = spec.next_after(0.0)
+        assert nxt == 60.0
+
+    def test_specific_time(self):
+        spec = CronSpec("30 14 * * *")
+        nxt = spec.next_after(0.0)
+        t = time.gmtime(nxt)
+        assert (t.tm_hour, t.tm_min) == (14, 30)
+
+    def test_step_and_range(self):
+        spec = CronSpec("*/15 9-17 * * 1-5")
+        t = time.gmtime(spec.next_after(0.0))
+        assert t.tm_min in (0, 15, 30, 45)
+        assert 9 <= t.tm_hour <= 17
+        assert t.tm_wday < 5  # Mon-Fri
+
+    def test_bad_spec(self):
+        with pytest.raises(ValueError):
+            CronSpec("* * *")
+        with pytest.raises(ValueError):
+            CronSpec("61 * * * *")
+
+
+class TestPeriodic:
+    def test_periodic_job_tracked_not_run(self):
+        with Server(ServerConfig()) as s:
+            s.register_node(mock.node())
+            job = mock.batch_job()
+            job.periodic = PeriodicConfig(spec="0 0 1 1 *")  # far future
+            s.register_job(job)
+            assert s.periodic.tracked_count() == 1
+            time.sleep(0.2)
+            assert s.store.snapshot().allocs_by_job(job.id) == []
+
+    def test_force_launch_creates_child(self):
+        with Server(ServerConfig()) as s:
+            s.register_node(mock.node())
+            job = mock.batch_job()
+            job.task_groups[0].count = 1
+            job.periodic = PeriodicConfig(spec="0 0 1 1 *")
+            s.register_job(job)
+            child_id = s.periodic.force_launch(job)
+            assert child_id.startswith(job.id + "/periodic-")
+            assert s.wait_for_idle(10.0)
+            assert len(s.store.snapshot().allocs_by_job(child_id)) == 1
+
+    def test_prohibit_overlap_skips(self):
+        with Server(ServerConfig()) as s:
+            s.register_node(mock.node())
+            job = mock.batch_job()
+            job.task_groups[0].count = 1
+            job.periodic = PeriodicConfig(spec="0 0 1 1 *", prohibit_overlap=True)
+            s.register_job(job)
+            first = s.periodic.force_launch(job, launch_time=1000)
+            assert s.wait_for_idle(10.0)
+            # first child's alloc is still pending (no client) -> overlap
+            second = s.periodic.force_launch(job, launch_time=2000)
+            assert first is not None and second is None
+            assert s.periodic.stats["skipped_overlap"] == 1
+
+
+# ---------------------------------------------------------------------------
+# core GC
+# ---------------------------------------------------------------------------
+
+
+class TestCoreGC:
+    def test_gc_dead_job_and_evals(self):
+        with Server(ServerConfig()) as s:
+            s.register_node(mock.node())
+            job = mock.job()
+            job.task_groups[0].count = 2
+            s.register_job(job)
+            assert s.wait_for_idle(10.0)
+            s.deregister_job(job.id)  # stop (not purge)
+            assert s.wait_for_idle(10.0)
+            stats = s.core_gc.force_gc(threshold_override=0.0)
+            snap = s.store.snapshot()
+            assert snap.job_by_id(job.id) is None
+            assert snap.evals_by_job(job.id) == []
+            assert stats["jobs"] >= 1
+
+    def test_gc_down_node(self):
+        with Server(ServerConfig()) as s:
+            n = mock.node()
+            s.register_node(n)
+            s.update_node_status(n.id, enums.NODE_STATUS_DOWN)
+            s.core_gc.force_gc(threshold_override=0.0)
+            assert s.store.snapshot().node_by_id(n.id) is None
+
+    def test_gc_keeps_live_jobs(self):
+        with Server(ServerConfig()) as s:
+            s.register_node(mock.node())
+            s.register_node(mock.node())
+            job = mock.job()
+            s.register_job(job)
+            assert s.wait_for_idle(10.0)
+            s.core_gc.force_gc(threshold_override=0.0)
+            assert s.store.snapshot().job_by_id(job.id) is not None
+            assert len(s.store.snapshot().allocs_by_job(job.id)) == 10
+
+
+# ---------------------------------------------------------------------------
+# drainer
+# ---------------------------------------------------------------------------
+
+
+class TestDrainer:
+    def test_drain_migrates_all_allocs(self, tmp_path):
+        with Server(ServerConfig()) as s:
+            c1 = Client(s, ClientConfig(data_dir=str(tmp_path / "c1")))
+            c2 = Client(s, ClientConfig(data_dir=str(tmp_path / "c2")))
+            c1.start()
+            c2.start()
+            try:
+                job = mock.job()
+                job.task_groups[0].count = 4
+                job.task_groups[0].tasks[0] = Task(
+                    name="web", driver="mock", config={"run_for": 600})
+                s.register_job(job)
+                assert s.wait_for_idle(10.0)
+                n1 = c1.node if s.store.snapshot().allocs_by_node(c1.node.id) \
+                    else c2.node
+                survivor = c2 if n1 is c1.node else c1
+
+                s.update_node_drain(n1.id, DrainStrategy(deadline_s=60.0))
+                assert survivor.wait_until(lambda: (
+                    not [a for a in s.store.snapshot().allocs_by_node(n1.id)
+                         if not a.client_terminal()]
+                    and sum(1 for a in
+                            s.store.snapshot().allocs_by_job(job.id)
+                            if a.client_status == enums.ALLOC_CLIENT_RUNNING
+                            and a.node_id == survivor.node.id) == 4), 30.0)
+                # drain completes and clears the strategy
+                assert survivor.wait_until(
+                    lambda: not s.store.snapshot().node_by_id(n1.id).drain, 10.0)
+                node = s.store.snapshot().node_by_id(n1.id)
+                assert node.scheduling_eligibility == enums.NODE_SCHED_INELIGIBLE
+            finally:
+                c1.stop()
+                c2.stop()
+
+    def test_drain_paces_by_max_parallel(self):
+        """With max_parallel=1 the drainer never marks more than one
+        in-flight migration per task group."""
+        with Server(ServerConfig()) as s:
+            n1 = mock.node()
+            s.register_node(n1)
+            job = mock.job()
+            job.task_groups[0].count = 3
+            s.register_job(job)
+            assert s.wait_for_idle(10.0)
+            # no second node: migrations can't complete, so marks stay
+            s.update_node_drain(n1.id, DrainStrategy(deadline_s=3600.0))
+            time.sleep(1.0)
+            marked = [a for a in s.store.snapshot().allocs_by_node(n1.id)
+                      if a.desired_transition.migrate
+                      and not a.server_terminal()]
+            assert len(marked) <= 1
+
+
+# ---------------------------------------------------------------------------
+# deployments
+# ---------------------------------------------------------------------------
+
+
+def _update_job(count=2):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.update = UpdateStrategy(max_parallel=1, min_healthy_time_s=0.0,
+                               auto_revert=False)
+    tg.tasks[0] = Task(name="web", driver="mock", config={"run_for": 600})
+    return job
+
+
+class TestDeployments:
+    def test_deployment_succeeds_when_healthy(self, tmp_path):
+        with Server(ServerConfig()) as s:
+            c = Client(s, ClientConfig(data_dir=str(tmp_path / "c")))
+            c.start()
+            try:
+                job = _update_job()
+                s.register_job(job)
+                assert s.wait_for_idle(10.0)
+                dep = s.store.snapshot().latest_deployment_by_job(job.id)
+                assert dep is not None
+                assert c.wait_until(
+                    lambda: (d := s.store.snapshot().latest_deployment_by_job(job.id))
+                    and d.status == enums.DEPLOYMENT_STATUS_SUCCESSFUL, 20.0)
+            finally:
+                c.stop()
+
+    def test_rolling_update_and_new_deployment(self, tmp_path):
+        with Server(ServerConfig()) as s:
+            c = Client(s, ClientConfig(data_dir=str(tmp_path / "c")))
+            c.start()
+            try:
+                job = _update_job(count=3)
+                s.register_job(job)
+                assert c.wait_until(
+                    lambda: (d := s.store.snapshot().latest_deployment_by_job(job.id))
+                    and d.status == enums.DEPLOYMENT_STATUS_SUCCESSFUL, 20.0)
+                # update the job: new version rolls 1 at a time
+                job2 = _update_job(count=3)
+                job2.id = job.id
+                job2.name = job.id
+                job2.task_groups[0].tasks[0].config = {"run_for": 601}
+                s.register_job(job2)
+                assert c.wait_until(
+                    lambda: all(
+                        a.job_version == 1 for a in
+                        s.store.snapshot().allocs_by_job(job.id)
+                        if not a.server_terminal()) and len([
+                            a for a in s.store.snapshot().allocs_by_job(job.id)
+                            if not a.server_terminal()]) == 3, 30.0)
+                assert c.wait_until(
+                    lambda: any(d.job_version == 1 and
+                                d.status == enums.DEPLOYMENT_STATUS_SUCCESSFUL
+                                for d in
+                                s.store.snapshot().deployments_by_job(job.id)),
+                    20.0)
+            finally:
+                c.stop()
+
+    def test_failed_deployment_auto_reverts(self, tmp_path):
+        with Server(ServerConfig()) as s:
+            c = Client(s, ClientConfig(data_dir=str(tmp_path / "c")))
+            c.start()
+            try:
+                job = _update_job(count=1)
+                job.task_groups[0].update.auto_revert = True
+                # disable restarts/reschedules so failure is immediate
+                job.task_groups[0].restart_policy.attempts = 0
+                job.task_groups[0].reschedule_policy.attempts = 0
+                job.task_groups[0].reschedule_policy.unlimited = False
+                s.register_job(job)
+                assert c.wait_until(
+                    lambda: (d := s.store.snapshot().latest_deployment_by_job(job.id))
+                    and d.status == enums.DEPLOYMENT_STATUS_SUCCESSFUL, 20.0)
+                # v1: crashes on start
+                bad = _update_job(count=1)
+                bad.id = job.id
+                bad.name = job.id
+                bad.task_groups[0].update.auto_revert = True
+                bad.task_groups[0].restart_policy.attempts = 0
+                bad.task_groups[0].reschedule_policy.attempts = 0
+                bad.task_groups[0].reschedule_policy.unlimited = False
+                bad.task_groups[0].tasks[0].config = {"run_for": 0.05,
+                                                      "exit_code": 1}
+                s.register_job(bad)
+                # watcher fails the v1 deployment and re-submits v0's spec
+                assert c.wait_until(
+                    lambda: any(d.job_version == 1 and
+                                d.status == enums.DEPLOYMENT_STATUS_FAILED
+                                for d in
+                                s.store.snapshot().deployments_by_job(job.id)),
+                    30.0)
+                assert c.wait_until(
+                    lambda: (j := s.store.snapshot().job_by_id(job.id))
+                    and j.version == 2
+                    and j.task_groups[0].tasks[0].config.get("run_for") == 600,
+                    30.0)
+                assert s.deployment_watcher.stats["reverted"] == 1
+            finally:
+                c.stop()
+
+
+# ---------------------------------------------------------------------------
+# events
+# ---------------------------------------------------------------------------
+
+
+class TestEvents:
+    def test_subscribe_and_filter(self):
+        with Server(ServerConfig()) as s:
+            sub = s.events.subscribe({"Node": ["*"]})
+            n = mock.node()
+            s.register_node(n)
+            job = mock.job()
+            s.register_job(job)
+            evs = sub.next_events(timeout=2.0)
+            assert evs
+            assert all(e.topic == "Node" for e in evs)
+            assert any(e.key == n.id for e in evs)
+            sub.close()
+
+    def test_all_topics_stream(self):
+        with Server(ServerConfig()) as s:
+            sub = s.events.subscribe()
+            s.register_node(mock.node())
+            job = mock.job()
+            s.register_job(job)
+            s.wait_for_idle(10.0)
+            seen = set()
+            deadline = time.time() + 5
+            while time.time() < deadline and not {"Node", "Job", "Evaluation",
+                                                  "Allocation"} <= seen:
+                for e in sub.next_events(timeout=0.5):
+                    seen.add(e.topic)
+            assert {"Node", "Job", "Evaluation", "Allocation"} <= seen
