@@ -98,16 +98,27 @@ class DeploymentWatcher:
                 self._fail(snap, dep, job, "progress deadline exceeded")
                 continue
 
-            # rollout continuation: when new allocs turn healthy, let the
-            # scheduler replace the next max_parallel batch
+            # rollout continuation: when new allocs turn healthy, extend
+            # the progress deadline (reference: the deadline resets per
+            # healthy alloc, so steady long rollouts never time out) and
+            # let the scheduler replace the next max_parallel batch
             last = self._progress.get(dep.id, -1)
             if healthy > last:
                 self._progress[dep.id] = healthy
+                if healthy > 0:
+                    upd = _copy.copy(dep)
+                    upd.task_groups = {}
+                    for name, state in dep.task_groups.items():
+                        s = _copy.copy(state)
+                        if s.progress_deadline_s:
+                            s.require_progress_by = now + s.progress_deadline_s
+                        upd.task_groups[name] = s
+                    self.server.store.upsert_deployment(upd)
                 old_version_live = any(
                     a.job_version != dep.job_version and not a.terminal_status()
                     and not a.server_terminal()
                     for a in snap.allocs_by_job(dep.job_id, dep.namespace))
-                if old_version_live and last >= 0:
+                if old_version_live and healthy > 0:
                     self._create_eval(job)
 
     def _alloc_healthy(self, alloc, job, now: float) -> bool:

@@ -68,14 +68,14 @@ class Subscription:
         return [e for e in evs if self._wants(e)]
 
     def close(self) -> None:
-        self._broker.unsubscribe(self)
+        """Nothing to release: delivery is pull-based off the shared
+        ring, a subscription is just a cursor."""
 
 
 class EventBroker:
     def __init__(self, store, ring_size: int = 4096):
         self._ring: deque = deque(maxlen=ring_size)
         self._lock = threading.Condition()
-        self._subs: List[Subscription] = []
         self._seq = 0  # dense event counter: truncation detection needs
         #                gap-free numbering, which store indexes are not
         store.add_commit_listener(self._on_commit)
@@ -97,15 +97,7 @@ class EventBroker:
             return self._seq
 
     def subscribe(self, topics: Optional[Dict[str, List[str]]] = None) -> Subscription:
-        sub = Subscription(self, topics)
-        with self._lock:
-            self._subs.append(sub)
-        return sub
-
-    def unsubscribe(self, sub: Subscription) -> None:
-        with self._lock:
-            if sub in self._subs:
-                self._subs.remove(sub)
+        return Subscription(self, topics)
 
     def events_after(self, cursor: int, timeout: Optional[float]
                      ) -> Tuple[List[Event], bool]:

@@ -43,13 +43,15 @@ class CronSpec:
                 piece, step_s = piece.split("/", 1)
                 step = int(step_s)
             if piece in ("*", ""):
-                rng = range(lo, hi + 1)
+                start, rng = lo, range(lo, hi + 1)
             elif "-" in piece:
                 a, b = piece.split("-", 1)
-                rng = range(int(a), int(b) + 1)
+                start, rng = int(a), range(int(a), int(b) + 1)
             else:
-                rng = range(int(piece), int(piece) + 1)
-            out.update(v for v in rng if (v - lo) % step == 0 and lo <= v <= hi)
+                start, rng = int(piece), range(int(piece), int(piece) + 1)
+            # the step offset anchors at the range start: "5-59/15" means
+            # {5, 20, 35, 50}, not multiples of 15
+            out.update(v for v in rng if (v - start) % step == 0 and lo <= v <= hi)
         if not out:
             raise ValueError(f"empty cron field {part!r}")
         return out
@@ -133,9 +135,8 @@ class PeriodicDispatcher:
         launch_time = launch_time or time.time()
         snap = self.server.store.snapshot()
         if job.periodic is not None and job.periodic.prohibit_overlap:
-            prefix = job.id + PERIODIC_LAUNCH_SUFFIX
             for other in snap.jobs():
-                if not other.id.startswith(prefix):
+                if other.parent_id != job.id or other.namespace != job.namespace:
                     continue
                 live = [a for a in snap.allocs_by_job(other.id, other.namespace)
                         if not a.terminal_status() and not a.server_terminal()]

@@ -215,6 +215,8 @@ class Server:
             else:
                 self.periodic.remove(job.namespace, job.id)
             return ""
+        # a re-registered job may have dropped its periodic stanza
+        self.periodic.remove(job.namespace, job.id)
         return self._create_job_eval(job, enums.TRIGGER_JOB_REGISTER)
 
     def deregister_job(self, job_id: str, namespace: str = "default",

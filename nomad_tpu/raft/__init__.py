@@ -1,0 +1,22 @@
+"""Replicated log (reference: hashicorp/raft wired in nomad/server.go:1365,
+FSM nomad/fsm.go:228).
+
+A compact Raft implementation — leader election with randomized
+timeouts, log replication with commit-index advancement, follower
+catch-up, and term-based safety — over a pluggable transport (in-process
+for tests, the same shape a TCP transport plugs into). Committed entries
+feed an FSM that applies state-store mutations, so every server holds an
+identical MVCC store and any server's scheduler workers can plan against
+local snapshots (the reference's architecture, SURVEY.md §2.5).
+
+- log.py       — entries + in-memory log with term/index invariants
+- node.py      — the Raft state machine (follower/candidate/leader)
+- transport.py — in-process message bus between nodes
+- fsm.py       — command codec: store mutations as replicated entries
+- cluster.py   — ReplicatedServer: core.Server on top of the raft log
+"""
+
+from .cluster import RaftCluster, ReplicatedServer
+from .node import RaftNode
+
+__all__ = ["RaftNode", "RaftCluster", "ReplicatedServer"]

@@ -1,0 +1,142 @@
+"""Replicated server composition (reference nomad/server.go multi-server
++ leader.go establishLeadership/revokeLeadership).
+
+Each ReplicatedServer owns a local MVCC store replicated via its raft
+node; the embedded core.Server's leader-only subsystems (broker, plan
+applier, workers, watchers) run only while this node holds leadership —
+exactly the reference's establish/revoke cycle. Requests landing on a
+follower are forwarded to the leader (reference nomad/rpc.go forward).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..core.server import Server, ServerConfig
+from ..state import StateStore
+from .fsm import FSM, RaftStore
+from .node import NotLeaderError, RaftNode
+from .transport import InProcTransport
+
+FORWARD = ("register_job", "deregister_job", "register_node", "heartbeat",
+           "update_node_status", "update_node_drain",
+           "update_node_eligibility", "deregister_node",
+           "update_allocs_from_client", "create_eval")
+
+
+class ReplicatedServer:
+    def __init__(self, node_id: str, peers: List[str], transport,
+                 config: Optional[ServerConfig] = None,
+                 peer_lookup: Optional[Callable[[str], "ReplicatedServer"]] = None):
+        self.id = node_id
+        self.local_store = StateStore()
+        self.fsm = FSM(self.local_store)
+        self.raft = RaftNode(node_id, peers, transport, self.fsm.apply,
+                             on_leadership=self._on_leadership)
+        self.store = RaftStore(self.local_store, self.raft)
+        self.server = Server(config, store=self.store)
+        self._peer_lookup = peer_lookup
+        self._lock = threading.Lock()
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        self.raft.start()
+
+    def stop(self) -> None:
+        if self.server._running:
+            self.server.stop()
+        self.raft.stop()
+
+    def _on_leadership(self, is_leader: bool) -> None:
+        # runs on raft threads; establish/revoke the leader subsystems
+        # (leader.go:357/1488)
+        def flip():
+            with self._lock:
+                if is_leader and not self.server._running:
+                    self.server.start()
+                elif not is_leader and self.server._running:
+                    self.server.stop()
+
+        threading.Thread(target=flip, daemon=True,
+                         name=f"leadership-{self.id}").start()
+
+    # -- forwarded endpoint surface --
+
+    def is_leader(self) -> bool:
+        return self.raft.is_leader() and self.server._running
+
+    def _leader(self) -> "ReplicatedServer":
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if self.is_leader():
+                return self
+            lid = self.raft.leader_id
+            if lid and self._peer_lookup is not None:
+                peer = self._peer_lookup(lid)
+                if peer is not None and peer.is_leader():
+                    return peer
+            time.sleep(0.02)
+        raise NotLeaderError(self.raft.leader_id)
+
+    def __getattr__(self, name: str):
+        if name in FORWARD:
+            def call(*args, **kwargs):
+                target = self._leader()
+                return getattr(target.server, name)(*args, **kwargs)
+
+            return call
+        raise AttributeError(name)
+
+
+class RaftCluster:
+    """N in-process replicated servers on one transport (the reference's
+    in-process multi-server test topology, nomad/testing.go)."""
+
+    def __init__(self, n: int = 3, config_fn: Optional[Callable[[int], ServerConfig]] = None):
+        self.transport = InProcTransport()
+        ids = [f"server-{i}" for i in range(n)]
+        self.servers: Dict[str, ReplicatedServer] = {}
+        for i, node_id in enumerate(ids):
+            cfg = config_fn(i) if config_fn else ServerConfig(heartbeat_ttl=30.0)
+            self.servers[node_id] = ReplicatedServer(
+                node_id, ids, self.transport, cfg,
+                peer_lookup=self.servers.get)
+
+    def start(self) -> "RaftCluster":
+        for s in self.servers.values():
+            s.start()
+        return self
+
+    def stop(self) -> None:
+        for s in self.servers.values():
+            s.stop()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def wait_for_leader(self, timeout: float = 10.0) -> Optional[ReplicatedServer]:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for s in self.servers.values():
+                if s.is_leader():
+                    return s
+            time.sleep(0.02)
+        return None
+
+    def leader(self) -> Optional[ReplicatedServer]:
+        for s in self.servers.values():
+            if s.is_leader():
+                return s
+        return None
+
+    def followers(self) -> List[ReplicatedServer]:
+        return [s for s in self.servers.values() if not s.raft.is_leader()]
+
+    def any_server(self) -> ReplicatedServer:
+        return next(iter(self.servers.values()))

@@ -1,0 +1,66 @@
+"""FSM: replicated commands -> state-store mutations
+(reference nomad/fsm.go:228 applying ~60 raft message types).
+
+A command is ("op", args) where op names a StateStore mutation method.
+Payloads are deep-copied before apply so replicas never share mutable
+objects, and because every replica applies the identical command
+sequence, store generation numbers (indexes) agree across the cluster.
+
+RaftStore presents the StateStore surface: reads hit the local store,
+mutations propose through the raft node and block until committed and
+applied locally — the write path every core.Server subsystem already
+uses, so replication slots in without touching them.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, List
+
+MUTATIONS = {
+    "upsert_node", "update_node_status", "update_node_eligibility",
+    "update_node_drain", "delete_node",
+    "upsert_job", "delete_job", "update_job_status",
+    "upsert_evals", "delete_evals",
+    "upsert_allocs", "update_allocs_from_client",
+    "update_alloc_desired_transitions",
+    "upsert_plan_results",
+    "upsert_deployment", "update_deployment_status", "delete_deployment",
+    "gc_terminal_allocs", "compact",
+}
+
+
+class FSM:
+    def __init__(self, store):
+        self.store = store
+
+    def apply(self, command: tuple) -> Any:
+        op, args, kwargs = command
+        if op not in MUTATIONS:
+            raise ValueError(f"unknown FSM op {op!r}")
+        fn = getattr(self.store, op)
+        # each replica must own its objects
+        args = copy.deepcopy(args)
+        kwargs = copy.deepcopy(kwargs)
+        return fn(*args, **kwargs)
+
+
+class RaftStore:
+    """StateStore facade: local reads, replicated writes."""
+
+    def __init__(self, store, raft_node):
+        self._store = store
+        self._raft = raft_node
+
+    def __getattr__(self, name: str):
+        if name in MUTATIONS:
+            def propose(*args, **kwargs):
+                return self._raft.apply((name, args, kwargs))
+
+            return propose
+        return getattr(self._store, name)
+
+    # explicit read-path passthroughs used as attributes (not calls)
+    @property
+    def latest_index(self) -> int:
+        return self._store.latest_index

@@ -1,0 +1,74 @@
+"""Raft log entries and storage (reference hashicorp/raft log +
+boltdb log store; in-memory here, with the same term/index invariants).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+
+@dataclass(slots=True)
+class Entry:
+    index: int
+    term: int
+    command: tuple  # (op, payload) — see fsm.py
+
+
+class RaftLog:
+    """1-indexed append-only log guarded by a lock."""
+
+    def __init__(self):
+        self._entries: List[Entry] = []
+        self._lock = threading.Lock()
+
+    def last(self) -> Tuple[int, int]:
+        """-> (last_index, last_term)."""
+        with self._lock:
+            if not self._entries:
+                return 0, 0
+            e = self._entries[-1]
+            return e.index, e.term
+
+    def term_at(self, index: int) -> int:
+        if index == 0:
+            return 0
+        with self._lock:
+            if index > len(self._entries):
+                return -1
+            return self._entries[index - 1].term
+
+    def get(self, index: int) -> Optional[Entry]:
+        with self._lock:
+            if 1 <= index <= len(self._entries):
+                return self._entries[index - 1]
+            return None
+
+    def slice_from(self, index: int, limit: int = 64) -> List[Entry]:
+        with self._lock:
+            return list(self._entries[index - 1: index - 1 + limit])
+
+    def append(self, term: int, command: tuple) -> Entry:
+        with self._lock:
+            e = Entry(index=len(self._entries) + 1, term=term, command=command)
+            self._entries.append(e)
+            return e
+
+    def append_entries(self, prev_index: int, entries: List[Entry]) -> None:
+        """Follower-side: truncate conflicts after prev_index, then
+        append (the AppendEntries receiver rules)."""
+        with self._lock:
+            for e in entries:
+                pos = e.index - 1
+                if pos < len(self._entries):
+                    if self._entries[pos].term != e.term:
+                        del self._entries[pos:]
+                        self._entries.append(e)
+                    # else: already have it
+                else:
+                    self._entries.append(e)
+
+    def length(self) -> int:
+        with self._lock:
+            return len(self._entries)

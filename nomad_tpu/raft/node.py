@@ -1,0 +1,302 @@
+"""The Raft state machine (leader election + log replication).
+
+Follows the Raft paper's receiver/sender rules: randomized election
+timeouts, term-based vote safety with the up-to-date log check, leader
+append-entries with per-peer next/match indexes, and commit advancement
+restricted to current-term entries. Committed commands are applied to
+the FSM in log order on a dedicated apply thread; leader-side apply()
+blocks until the entry is both committed and locally applied, giving
+the linearizable write the plan applier needs.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .log import Entry, RaftLog
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+class RaftNode:
+    def __init__(self, node_id: str, peers: List[str], transport,
+                 fsm_apply: Callable[[tuple], object],
+                 election_timeout: float = 0.3,
+                 heartbeat_interval: float = 0.05,
+                 on_leadership: Optional[Callable[[bool], None]] = None):
+        self.id = node_id
+        self.peers = [p for p in peers if p != node_id]
+        self.transport = transport
+        self.fsm_apply = fsm_apply
+        self.on_leadership = on_leadership
+        self.election_timeout = election_timeout
+        self.heartbeat_interval = heartbeat_interval
+
+        self.state = FOLLOWER
+        self.current_term = 0
+        self.voted_for: Optional[str] = None
+        self.log = RaftLog()
+        self.commit_index = 0
+        self.last_applied = 0
+        self.leader_id: Optional[str] = None
+
+        self._next_index: Dict[str, int] = {}
+        self._match_index: Dict[str, int] = {}
+        self._lock = threading.RLock()
+        self._apply_cond = threading.Condition(self._lock)
+        self._deadline = self._new_deadline()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        # apply results by index for leader-side waiters
+        self._results: Dict[int, object] = {}
+
+        transport.register(node_id, self.handle)
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        for name, fn in (("tick", self._run_tick), ("apply", self._run_apply)):
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"raft-{self.id}-{name}")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._apply_cond:
+            self._apply_cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    def _new_deadline(self) -> float:
+        return time.time() + self.election_timeout * (1.0 + random.random())
+
+    # -- public API --
+
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.state == LEADER
+
+    def apply(self, command: tuple, timeout: float = 5.0):
+        """Leader-only: replicate a command, wait for commit + local
+        apply, return the FSM result. Raises NotLeaderError otherwise."""
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            entry = self.log.append(self.current_term, command)
+            index = entry.index
+        # single-node cluster commits immediately; otherwise replication
+        # advances commit on acks
+        self._maybe_advance_commit()
+        deadline = time.time() + timeout
+        with self._apply_cond:
+            while self.last_applied < index:
+                remaining = deadline - time.time()
+                if remaining <= 0 or self._stop.is_set():
+                    raise TimeoutError(f"apply of index {index} timed out")
+                self._apply_cond.wait(min(remaining, 0.1))
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            return self._results.pop(index, None)
+
+    # -- message handling (the RPC receiver rules) --
+
+    def handle(self, msg: dict) -> dict:
+        kind = msg["kind"]
+        if kind == "request_vote":
+            return self._on_request_vote(msg)
+        if kind == "append_entries":
+            return self._on_append_entries(msg)
+        raise ValueError(f"unknown raft message {kind}")
+
+    def _on_request_vote(self, msg: dict) -> dict:
+        with self._lock:
+            term = msg["term"]
+            if term > self.current_term:
+                self._become_follower(term)
+            granted = False
+            if term == self.current_term and self.voted_for in (None, msg["candidate"]):
+                last_index, last_term = self.log.last()
+                up_to_date = (msg["last_log_term"], msg["last_log_index"]) >= \
+                    (last_term, last_index)
+                if up_to_date:
+                    granted = True
+                    self.voted_for = msg["candidate"]
+                    self._deadline = self._new_deadline()
+            return {"term": self.current_term, "granted": granted}
+
+    def _on_append_entries(self, msg: dict) -> dict:
+        with self._lock:
+            term = msg["term"]
+            if term < self.current_term:
+                return {"term": self.current_term, "success": False}
+            if term > self.current_term or self.state != FOLLOWER:
+                self._become_follower(term)
+            self.leader_id = msg["leader"]
+            self._deadline = self._new_deadline()
+
+            prev_index = msg["prev_log_index"]
+            prev_term = msg["prev_log_term"]
+            if prev_index > 0 and self.log.term_at(prev_index) != prev_term:
+                return {"term": self.current_term, "success": False}
+            entries = [Entry(**e) if isinstance(e, dict) else e
+                       for e in msg["entries"]]
+            if entries:
+                self.log.append_entries(prev_index, entries)
+            leader_commit = msg["leader_commit"]
+            if leader_commit > self.commit_index:
+                last_index, _ = self.log.last()
+                self.commit_index = min(leader_commit, last_index)
+                self._apply_cond.notify_all()
+            return {"term": self.current_term,
+                    "success": True,
+                    "match_index": prev_index + len(entries)}
+
+    # -- roles --
+
+    def _become_follower(self, term: int) -> None:
+        was_leader = self.state == LEADER
+        self.state = FOLLOWER
+        self.current_term = term
+        self.voted_for = None
+        self._deadline = self._new_deadline()
+        if was_leader and self.on_leadership:
+            self.on_leadership(False)
+
+    def _become_leader(self) -> None:
+        self.state = LEADER
+        self.leader_id = self.id
+        last_index, _ = self.log.last()
+        for p in self.peers:
+            self._next_index[p] = last_index + 1
+            self._match_index[p] = 0
+        if self.on_leadership:
+            self.on_leadership(True)
+
+    def _start_election(self) -> None:
+        with self._lock:
+            self.state = CANDIDATE
+            self.current_term += 1
+            self.voted_for = self.id
+            term = self.current_term
+            self._deadline = self._new_deadline()
+            last_index, last_term = self.log.last()
+        votes = 1
+        for p in self.peers:
+            reply = self.transport.send(self.id, p, {
+                "kind": "request_vote", "term": term, "candidate": self.id,
+                "last_log_index": last_index, "last_log_term": last_term,
+            })
+            if reply is None:
+                continue
+            with self._lock:
+                if reply["term"] > self.current_term:
+                    self._become_follower(reply["term"])
+                    return
+            if reply.get("granted"):
+                votes += 1
+        with self._lock:
+            if self.state == CANDIDATE and self.current_term == term \
+                    and votes * 2 > len(self.peers) + 1:
+                self._become_leader()
+
+    # -- ticker --
+
+    def _run_tick(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval / 2):
+            with self._lock:
+                state = self.state
+                expired = time.time() >= self._deadline
+            if state == LEADER:
+                self._replicate_all()
+            elif expired:
+                self._start_election()
+
+    def _replicate_all(self) -> None:
+        for p in self.peers:
+            self._replicate(p)
+        self._maybe_advance_commit()
+
+    def _replicate(self, peer: str) -> None:
+        with self._lock:
+            if self.state != LEADER:
+                return
+            term = self.current_term
+            next_idx = self._next_index.get(peer, 1)
+            prev_index = next_idx - 1
+            prev_term = self.log.term_at(prev_index)
+            entries = self.log.slice_from(next_idx)
+            commit = self.commit_index
+        reply = self.transport.send(self.id, peer, {
+            "kind": "append_entries", "term": term, "leader": self.id,
+            "prev_log_index": prev_index, "prev_log_term": prev_term,
+            "entries": [{"index": e.index, "term": e.term, "command": e.command}
+                        for e in entries],
+            "leader_commit": commit,
+        })
+        if reply is None:
+            return
+        with self._lock:
+            if reply["term"] > self.current_term:
+                self._become_follower(reply["term"])
+                return
+            if self.state != LEADER or reply["term"] != self.current_term:
+                return
+            if reply["success"]:
+                self._match_index[peer] = max(self._match_index.get(peer, 0),
+                                              reply["match_index"])
+                self._next_index[peer] = self._match_index[peer] + 1
+            else:
+                self._next_index[peer] = max(1, next_idx - 1)
+
+    def _maybe_advance_commit(self) -> None:
+        with self._lock:
+            if self.state != LEADER:
+                return
+            last_index, _ = self.log.last()
+            for n in range(last_index, self.commit_index, -1):
+                if self.log.term_at(n) != self.current_term:
+                    break  # only current-term entries commit by counting
+                acks = 1 + sum(1 for p in self.peers
+                               if self._match_index.get(p, 0) >= n)
+                if acks * 2 > len(self.peers) + 1:
+                    self.commit_index = n
+                    self._apply_cond.notify_all()
+                    break
+
+    # -- apply loop --
+
+    def _run_apply(self) -> None:
+        while not self._stop.is_set():
+            with self._apply_cond:
+                while self.last_applied >= self.commit_index:
+                    self._apply_cond.wait(0.1)
+                    if self._stop.is_set():
+                        return
+                start = self.last_applied + 1
+                end = self.commit_index
+            for idx in range(start, end + 1):
+                entry = self.log.get(idx)
+                if entry is None:
+                    break
+                try:
+                    result = self.fsm_apply(tuple(entry.command))
+                except Exception as e:
+                    result = e
+                with self._apply_cond:
+                    self._results[idx] = result
+                    if len(self._results) > 4096:
+                        # drop results nobody waited for
+                        for k in sorted(self._results)[:-1024]:
+                            self._results.pop(k, None)
+                    self.last_applied = idx
+                    self._apply_cond.notify_all()
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader_id: Optional[str]):
+        super().__init__(f"not the leader (leader: {leader_id})")
+        self.leader_id = leader_id

@@ -1,0 +1,46 @@
+"""Raft transport (reference nomad/raft_rpc.go over yamux TCP).
+
+The node logic only needs `send(peer, message) -> reply`. The in-process
+transport used by tests and single-host multi-server setups dispatches
+directly; a socket transport carrying the same dict messages slots in
+for multi-host (message schema is JSON-safe by construction).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+
+class InProcTransport:
+    """A registry of node handlers; send() is a function call with a
+    configurable failure set for partition tests."""
+
+    def __init__(self):
+        self._handlers: Dict[str, Callable[[dict], dict]] = {}
+        self._lock = threading.Lock()
+        self._partitioned: set = set()  # node ids cut off from everyone
+
+    def register(self, node_id: str, handler: Callable[[dict], dict]) -> None:
+        with self._lock:
+            self._handlers[node_id] = handler
+
+    def partition(self, node_id: str) -> None:
+        with self._lock:
+            self._partitioned.add(node_id)
+
+    def heal(self, node_id: str) -> None:
+        with self._lock:
+            self._partitioned.discard(node_id)
+
+    def send(self, from_id: str, to_id: str, msg: dict) -> Optional[dict]:
+        with self._lock:
+            if from_id in self._partitioned or to_id in self._partitioned:
+                return None
+            handler = self._handlers.get(to_id)
+        if handler is None:
+            return None
+        try:
+            return handler(msg)
+        except Exception:
+            return None

@@ -419,6 +419,7 @@ class StateStore:
             return gen
 
     def _put_alloc(self, alloc: Allocation, gen: int, live: int) -> None:
+        alloc.modify_time = time.time()
         prev = self._allocs.get_latest(alloc.id)
         if prev is not None:
             alloc.create_index = prev.create_index

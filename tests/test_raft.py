@@ -1,0 +1,173 @@
+"""Raft replication tests: election, log replication, failover, and the
+replicated control plane scheduling end to end — all in-process
+(the reference's multi-server test topology, SURVEY.md §4.3).
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.raft import RaftCluster, RaftNode
+from nomad_tpu.raft.node import NotLeaderError
+from nomad_tpu.raft.transport import InProcTransport
+from nomad_tpu.structs import enums
+
+
+# ---------------------------------------------------------------------------
+# raw raft
+# ---------------------------------------------------------------------------
+
+
+def _mini_cluster(n=3, applied=None):
+    transport = InProcTransport()
+    ids = [f"n{i}" for i in range(n)]
+    applied = applied if applied is not None else {i: [] for i in ids}
+    nodes = {}
+    for node_id in ids:
+        log = applied.setdefault(node_id, [])
+
+        def make_apply(l):
+            def apply(cmd):
+                l.append(cmd)
+                return len(l)
+            return apply
+
+        nodes[node_id] = RaftNode(node_id, ids, transport, make_apply(log),
+                                  election_timeout=0.15,
+                                  heartbeat_interval=0.03)
+    for nd in nodes.values():
+        nd.start()
+    return transport, nodes, applied
+
+
+def _wait_leader(nodes, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        leaders = [n for n in nodes.values() if n.is_leader()]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.02)
+    raise AssertionError("no single leader elected")
+
+
+class TestRaftCore:
+    def test_election_and_replication(self):
+        transport, nodes, applied = _mini_cluster()
+        try:
+            leader = _wait_leader(nodes)
+            for i in range(5):
+                leader.apply(("compact", (i,), {}))
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if all(len(l) == 5 for l in applied.values()):
+                    break
+                time.sleep(0.02)
+            assert all(len(l) == 5 for l in applied.values())
+            assert all(l == applied[leader.id] for l in applied.values())
+        finally:
+            for n in nodes.values():
+                n.stop()
+
+    def test_follower_rejects_apply(self):
+        transport, nodes, _ = _mini_cluster()
+        try:
+            leader = _wait_leader(nodes)
+            follower = next(n for n in nodes.values() if n is not leader)
+            with pytest.raises(NotLeaderError):
+                follower.apply(("compact", (), {}))
+        finally:
+            for n in nodes.values():
+                n.stop()
+
+    def test_leader_failover_and_catchup(self):
+        transport, nodes, applied = _mini_cluster()
+        try:
+            leader = _wait_leader(nodes)
+            leader.apply(("compact", ("a",), {}))
+            transport.partition(leader.id)
+            remaining = {k: v for k, v in nodes.items() if k != leader.id}
+            new_leader = _wait_leader(remaining)
+            assert new_leader.id != leader.id
+            new_leader.apply(("compact", ("b",), {}))
+            # heal: the old leader steps down and catches up
+            transport.heal(leader.id)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if len(applied[leader.id]) == 2 and not leader.is_leader():
+                    break
+                time.sleep(0.02)
+            assert applied[leader.id] == applied[new_leader.id]
+            assert not leader.is_leader()
+        finally:
+            for n in nodes.values():
+                n.stop()
+
+
+# ---------------------------------------------------------------------------
+# replicated control plane
+# ---------------------------------------------------------------------------
+
+
+class TestReplicatedServer:
+    def test_schedules_through_replicated_log(self):
+        with RaftCluster(3) as cluster:
+            leader = cluster.wait_for_leader()
+            assert leader is not None
+            # any server accepts the request (forwarding)
+            entry = cluster.any_server()
+            entry.register_node(mock.node())
+            entry.register_node(mock.node())
+            job = mock.job()
+            entry.register_job(job)
+            assert leader.server.wait_for_idle(15.0)
+            # every replica converges to the same placements
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                counts = [len(s.local_store.snapshot().allocs_by_job(job.id))
+                          for s in cluster.servers.values()]
+                if counts == [10, 10, 10]:
+                    break
+                time.sleep(0.05)
+            assert counts == [10, 10, 10]
+            # replicas agree on indexes too (determinism); allow the last
+            # entries to finish replicating
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                idxs = {s.local_store.latest_index
+                        for s in cluster.servers.values()}
+                if len(idxs) == 1:
+                    break
+                time.sleep(0.05)
+            assert len(idxs) == 1, idxs
+
+    def test_leader_failover_cluster_keeps_scheduling(self):
+        with RaftCluster(3) as cluster:
+            leader = cluster.wait_for_leader()
+            entry = cluster.any_server()
+            entry.register_node(mock.node())
+            job1 = mock.job()
+            job1.task_groups[0].count = 2  # leave headroom for job2
+            entry.register_job(job1)
+            assert leader.server.wait_for_idle(15.0)
+
+            # kill the leader (partition it away)
+            cluster.transport.partition(leader.raft.id)
+            deadline = time.time() + 10
+            new_leader = None
+            while time.time() < deadline:
+                cands = [s for s in cluster.servers.values()
+                         if s is not leader and s.is_leader()]
+                if cands:
+                    new_leader = cands[0]
+                    break
+                time.sleep(0.05)
+            assert new_leader is not None
+
+            # the cluster still schedules new jobs
+            job2 = mock.job()
+            job2.task_groups[0].count = 2
+            new_leader.register_job(job2)
+            assert new_leader.server.wait_for_idle(15.0)
+            allocs = new_leader.local_store.snapshot().allocs_by_job(job2.id)
+            assert len(allocs) == 2

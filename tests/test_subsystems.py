@@ -39,6 +39,11 @@ class TestCron:
         assert 9 <= t.tm_hour <= 17
         assert t.tm_wday < 5  # Mon-Fri
 
+    def test_range_step_anchors_at_range_start(self):
+        # "5-59/15" means {5, 20, 35, 50}, not multiples of 15
+        spec = CronSpec("5-59/15 * * * *")
+        assert spec.sets[0] == {5, 20, 35, 50}
+
     def test_bad_spec(self):
         with pytest.raises(ValueError):
             CronSpec("* * *")
