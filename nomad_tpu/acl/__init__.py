@@ -1,0 +1,12 @@
+"""ACL system (reference acl/ + nomad/acl_endpoint.go, 3.5k+ LoC).
+
+- policy.py — policy documents (namespace/node/agent/operator rules,
+  capability expansion) and the compiled ACL capability checker
+- tokens.py — token structs + server-side resolution/bootstrap
+"""
+
+from .policy import ACL, AclPolicy, CAPABILITIES, compile_acl, parse_policy
+from .tokens import AclToken
+
+__all__ = ["ACL", "AclPolicy", "AclToken", "CAPABILITIES", "compile_acl",
+           "parse_policy"]

@@ -1,0 +1,190 @@
+"""ACL policies and the compiled capability checker
+(reference acl/policy.go:350 Parse + acl/acl.go:49 ACL).
+
+A policy document is JSON (or the HCL-shaped equivalent through
+api.jobspec's parser) with the reference's rule shape:
+
+    {
+      "namespace": {"default": {"policy": "write"},
+                     "batch-*": {"capabilities": ["submit-job", "read-job"]}},
+      "node": {"policy": "read"},
+      "agent": {"policy": "write"},
+      "operator": {"policy": "read"}
+    }
+
+Coarse policies expand to capability sets (policy.go dispositions);
+namespace selectors support glob suffixes; the most specific matching
+selector wins (reference acl.go longest-prefix namespace matching).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# namespace capabilities (reference acl/policy.go)
+CAP_DENY = "deny"
+CAP_LIST_JOBS = "list-jobs"
+CAP_READ_JOB = "read-job"
+CAP_SUBMIT_JOB = "submit-job"
+CAP_DISPATCH_JOB = "dispatch-job"
+CAP_READ_LOGS = "read-logs"
+CAP_READ_FS = "read-fs"
+CAP_ALLOC_EXEC = "alloc-exec"
+CAP_ALLOC_LIFECYCLE = "alloc-lifecycle"
+CAP_SCALE_JOB = "scale-job"
+CAP_VARIABLES_READ = "variables-read"
+CAP_VARIABLES_WRITE = "variables-write"
+
+CAPABILITIES = [
+    CAP_LIST_JOBS, CAP_READ_JOB, CAP_SUBMIT_JOB, CAP_DISPATCH_JOB,
+    CAP_READ_LOGS, CAP_READ_FS, CAP_ALLOC_EXEC, CAP_ALLOC_LIFECYCLE,
+    CAP_SCALE_JOB, CAP_VARIABLES_READ, CAP_VARIABLES_WRITE,
+]
+
+_READ_CAPS = {CAP_LIST_JOBS, CAP_READ_JOB, CAP_READ_LOGS, CAP_READ_FS,
+              CAP_VARIABLES_READ}
+_WRITE_CAPS = set(CAPABILITIES)
+
+POLICY_DENY = "deny"
+POLICY_READ = "read"
+POLICY_WRITE = "write"
+POLICY_SCALE = "scale"
+
+
+def expand_policy(policy: str) -> set:
+    """Coarse disposition -> capability set (policy.go expandNamespacePolicy)."""
+    if policy == POLICY_READ:
+        return set(_READ_CAPS)
+    if policy == POLICY_WRITE:
+        return set(_WRITE_CAPS)
+    if policy == POLICY_SCALE:
+        return {CAP_SCALE_JOB, CAP_READ_JOB, CAP_LIST_JOBS}
+    return {CAP_DENY}
+
+
+@dataclass
+class NamespaceRule:
+    selector: str = "default"
+    capabilities: set = field(default_factory=set)
+
+
+@dataclass
+class AclPolicy:
+    """A named, stored policy (reference structs ACLPolicy)."""
+
+    name: str = ""
+    description: str = ""
+    rules: str = ""          # the raw JSON document
+    modify_index: int = 0
+
+    def parsed(self) -> "ParsedPolicy":
+        return parse_policy(self.rules)
+
+
+@dataclass
+class ParsedPolicy:
+    namespaces: List[NamespaceRule] = field(default_factory=list)
+    node_policy: str = ""
+    agent_policy: str = ""
+    operator_policy: str = ""
+
+
+def parse_policy(rules: str) -> ParsedPolicy:
+    doc = json.loads(rules) if isinstance(rules, str) else rules
+    out = ParsedPolicy()
+    for selector, body in (doc.get("namespace") or {}).items():
+        caps = set(body.get("capabilities") or [])
+        if body.get("policy"):
+            caps |= expand_policy(body["policy"])
+        bad = caps - set(CAPABILITIES) - {CAP_DENY}
+        if bad:
+            raise ValueError(f"unknown capabilities {sorted(bad)}")
+        out.namespaces.append(NamespaceRule(selector, caps))
+    for key in ("node", "agent", "operator"):
+        body = doc.get(key)
+        if body is not None:
+            pol = body.get("policy", "")
+            if pol not in ("", POLICY_DENY, POLICY_READ, POLICY_WRITE):
+                raise ValueError(f"bad {key} policy {pol!r}")
+            setattr(out, f"{key}_policy", pol)
+    return out
+
+
+def _match(selector: str, namespace: str) -> int:
+    """-> match specificity (-1 no match; higher wins).
+    Exact match beats glob; longer glob prefix beats shorter."""
+    if selector == namespace:
+        return 1_000_000
+    if selector.endswith("*") and namespace.startswith(selector[:-1]):
+        return len(selector)
+    return -1
+
+
+class ACL:
+    """Compiled capability checker (reference acl/acl.go ACL)."""
+
+    def __init__(self, management: bool = False,
+                 policies: Optional[List[ParsedPolicy]] = None):
+        self.management = management
+        self._namespaces: List[NamespaceRule] = []
+        self.node_policy = ""
+        self.agent_policy = ""
+        self.operator_policy = ""
+        for p in policies or []:
+            self._namespaces.extend(p.namespaces)
+            for key in ("node_policy", "agent_policy", "operator_policy"):
+                val = getattr(p, key)
+                current = getattr(self, key)
+                # write > read > deny-by-absence; explicit deny wins
+                order = {POLICY_DENY: 3, POLICY_WRITE: 2, POLICY_READ: 1, "": 0}
+                if order[val] > order[current]:
+                    setattr(self, key, val)
+
+    def allow_namespace_operation(self, namespace: str, capability: str) -> bool:
+        if self.management:
+            return True
+        best, best_score = None, -1
+        for rule in self._namespaces:
+            score = _match(rule.selector, namespace)
+            if score > best_score:
+                best, best_score = rule, score
+        if best is None or CAP_DENY in best.capabilities:
+            return False
+        return capability in best.capabilities
+
+    def _coarse(self, policy: str, write: bool) -> bool:
+        if self.management:
+            return True
+        if policy == POLICY_WRITE:
+            return True
+        if policy == POLICY_READ:
+            return not write
+        return False
+
+    def allow_node_read(self) -> bool:
+        return self._coarse(self.node_policy, write=False)
+
+    def allow_node_write(self) -> bool:
+        return self._coarse(self.node_policy, write=True)
+
+    def allow_agent_read(self) -> bool:
+        return self._coarse(self.agent_policy, write=False)
+
+    def allow_agent_write(self) -> bool:
+        return self._coarse(self.agent_policy, write=True)
+
+    def allow_operator_read(self) -> bool:
+        return self._coarse(self.operator_policy, write=False)
+
+    def allow_operator_write(self) -> bool:
+        return self._coarse(self.operator_policy, write=True)
+
+
+MANAGEMENT_ACL = ACL(management=True)
+DENY_ALL_ACL = ACL()
+
+
+def compile_acl(policies: List[AclPolicy]) -> ACL:
+    return ACL(policies=[p.parsed() for p in policies])

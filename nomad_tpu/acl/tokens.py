@@ -1,0 +1,43 @@
+"""ACL tokens (reference structs ACLToken + nomad/acl_endpoint.go).
+
+Tokens pair a public accessor id (safe to log/list) with a secret id
+(the bearer credential). Management tokens bypass policy checks; client
+tokens resolve to the union of their named policies.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+from ..utils import generate_uuid
+
+TOKEN_TYPE_CLIENT = "client"
+TOKEN_TYPE_MANAGEMENT = "management"
+
+
+@dataclass
+class AclToken:
+    accessor_id: str = ""
+    secret_id: str = ""
+    name: str = ""
+    type: str = TOKEN_TYPE_CLIENT
+    policies: List[str] = field(default_factory=list)
+    global_: bool = False
+    create_time: float = 0.0
+    modify_index: int = 0
+
+    @classmethod
+    def new(cls, name: str, token_type: str = TOKEN_TYPE_CLIENT,
+            policies: List[str] = ()) -> "AclToken":
+        return cls(
+            accessor_id=generate_uuid(),
+            secret_id=generate_uuid(),
+            name=name,
+            type=token_type,
+            policies=list(policies),
+        )
+
+    @property
+    def is_management(self) -> bool:
+        return self.type == TOKEN_TYPE_MANAGEMENT

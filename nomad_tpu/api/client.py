@@ -23,10 +23,12 @@ class ApiError(Exception):
 
 class ApiClient:
     def __init__(self, address: str = "http://127.0.0.1:4646",
-                 namespace: str = "default", timeout: float = 35.0):
+                 namespace: str = "default", timeout: float = 35.0,
+                 token: str = ""):
         self.address = address.rstrip("/")
         self.namespace = namespace
         self.timeout = timeout
+        self.token = token  # X-Nomad-Token (reference SecretID auth)
 
     # -- transport --
 
@@ -40,8 +42,11 @@ class ApiClient:
         data = None
         if body is not None:
             data = json.dumps(to_dict(body)).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["X-Nomad-Token"] = self.token
         req = urllib.request.Request(url, data=data, method=method,
-                                     headers={"Content-Type": "application/json"})
+                                     headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 payload = json.loads(resp.read() or b"null")
@@ -142,6 +147,62 @@ class ApiClient:
     def agent_self(self) -> dict:
         out, _ = self.get("/v1/agent/self")
         return out
+
+    # -- ACL (reference api/acl.go) --
+
+    def acl_bootstrap(self) -> dict:
+        out, _ = self._request("POST", "/v1/acl/bootstrap")
+        return out
+
+    def upsert_acl_policy(self, name: str, rules, description: str = "") -> None:
+        self._request("POST", f"/v1/acl/policy/{name}",
+                      {"rules": rules, "description": description})
+
+    def create_acl_token(self, name: str, policies: List[str],
+                         token_type: str = "client") -> dict:
+        out, _ = self._request("POST", "/v1/acl/token",
+                               {"name": name, "policies": policies,
+                                "type": token_type})
+        return out
+
+    def list_acl_policies(self) -> List[dict]:
+        out, _ = self.get("/v1/acl/policies")
+        return out
+
+    # -- variables (reference api/variables.go) --
+
+    def put_variable(self, path: str, items: Dict[str, str]) -> None:
+        self._request("PUT", f"/v1/var/{path}", {"items": items})
+
+    def get_variable(self, path: str) -> dict:
+        out, _ = self.get(f"/v1/var/{path}")
+        return out
+
+    def list_variables(self, prefix: str = "") -> List[str]:
+        out, _ = self.get("/v1/vars", prefix=prefix)
+        return out
+
+    def delete_variable(self, path: str) -> None:
+        self._request("DELETE", f"/v1/var/{path}")
+
+    # -- event stream (reference api/event.go) --
+
+    def stream_events(self, topics: Optional[List[str]] = None,
+                      wait_s: float = 2.0):
+        """Yield event dicts from /v1/event/stream until the server's
+        wait window closes."""
+        params = [("wait", str(wait_s))]
+        for t in topics or []:
+            params.append(("topic", t))
+        qs = "&".join(f"{k}={v}" for k, v in params)
+        url = f"{self.address}/v1/event/stream?{qs}&namespace={self.namespace}"
+        headers = {"X-Nomad-Token": self.token} if self.token else {}
+        req = urllib.request.Request(url, headers=headers)
+        with urllib.request.urlopen(req, timeout=wait_s + 10) as resp:
+            for line in resp:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
 
     # -- blocking query helper (reference QueryOptions WaitIndex) --
 

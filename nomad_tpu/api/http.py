@@ -70,12 +70,37 @@ class HTTPAgent:
                 while store.latest_index <= want and time.time() < deadline:
                     time.sleep(0.02)
 
+            def _acl(self):
+                """Resolve X-Nomad-Token -> ACL (None when ACLs are off;
+                reference command/agent/http.go token extraction)."""
+                if not agent.server.acl_enabled:
+                    return None
+                secret = self.headers.get("X-Nomad-Token", "")
+                try:
+                    acl = agent.server.resolve_token(secret)
+                except PermissionError:
+                    acl = None
+                if acl is None:
+                    from ..acl.policy import DENY_ALL_ACL
+
+                    return DENY_ALL_ACL
+                return acl
+
             def do_GET(self):
                 try:
                     url = urlparse(self.path)
                     q = parse_qs(url.query)
+                    acl = self._acl()
+                    if url.path == "/v1/event/stream":
+                        # the stream carries payloads from every
+                        # namespace; management-only under ACLs
+                        if acl is not None and not acl.management:
+                            return self._error(403, "Permission denied")
+                        return agent._route_event_stream(self, q)
                     self._block(q)
-                    agent._route_get(self, url.path, q)
+                    agent._route_get(self, url.path, q, acl)
+                except PermissionError as e:
+                    self._error(403, str(e))
                 except Exception as e:
                     self._error(500, str(e))
 
@@ -83,7 +108,9 @@ class HTTPAgent:
                 try:
                     url = urlparse(self.path)
                     agent._route_post(self, url.path, parse_qs(url.query),
-                                      self._body())
+                                      self._body(), self._acl())
+                except PermissionError as e:
+                    self._error(403, str(e))
                 except Exception as e:
                     self._error(500, str(e))
 
@@ -92,7 +119,10 @@ class HTTPAgent:
             def do_DELETE(self):
                 try:
                     url = urlparse(self.path)
-                    agent._route_delete(self, url.path, parse_qs(url.query))
+                    agent._route_delete(self, url.path, parse_qs(url.query),
+                                        self._acl())
+                except PermissionError as e:
+                    self._error(403, str(e))
                 except Exception as e:
                     self._error(500, str(e))
 
@@ -123,10 +153,60 @@ class HTTPAgent:
 
     # -- routing (reference http.go registerHandlers) --
 
-    def _route_get(self, h, path: str, q: dict) -> None:
+    @staticmethod
+    def _ns_allowed(acl, ns: str, cap: str) -> bool:
+        return acl is None or acl.allow_namespace_operation(ns, cap)
+
+    def _route_get(self, h, path: str, q: dict, acl=None) -> None:
+        from ..acl import policy as aclp
+
         snap = self.server.store.snapshot()
         ns = q.get("namespace", ["default"])[0]
         prefix = q.get("prefix", [""])[0]
+
+        # coarse read gating per route family (job_endpoint/node_endpoint
+        # authorization in the reference)
+        if path.startswith(("/v1/jobs", "/v1/job/", "/v1/allocation",
+                            "/v1/evaluation")):
+            if not self._ns_allowed(acl, ns, aclp.CAP_READ_JOB):
+                return h._error(403, "Permission denied")
+        elif path.startswith(("/v1/nodes", "/v1/node/")):
+            if acl is not None and not acl.allow_node_read():
+                return h._error(403, "Permission denied")
+        elif path.startswith("/v1/agent") or path == "/v1/metrics":
+            if acl is not None and not acl.allow_agent_read():
+                return h._error(403, "Permission denied")
+        elif path.startswith("/v1/operator"):
+            if acl is not None and not acl.allow_operator_read():
+                return h._error(403, "Permission denied")
+        elif path.startswith(("/v1/var", "/v1/vars")):
+            if not self._ns_allowed(acl, ns, aclp.CAP_VARIABLES_READ):
+                return h._error(403, "Permission denied")
+        elif path.startswith("/v1/acl"):
+            if acl is not None and not acl.management:
+                return h._error(403, "Permission denied")
+
+        if path == "/v1/vars":
+            return h._reply(200, self.server.list_variables(ns, prefix))
+        if m := re.fullmatch(r"/v1/var/(.+)", path):
+            items = self.server.get_variable(m.group(1), ns)
+            if items is None:
+                return h._error(404, "variable not found")
+            return h._reply(200, {"path": m.group(1), "items": items})
+        if path == "/v1/acl/policies":
+            return h._reply(200, [
+                {"name": p.name, "description": p.description}
+                for p in snap.acl_policies()])
+        if m := re.fullmatch(r"/v1/acl/policy/([^/]+)", path):
+            pol = snap.acl_policy(m.group(1))
+            if pol is None:
+                return h._error(404, "policy not found")
+            return h._reply(200, pol)
+        if path == "/v1/acl/tokens":
+            return h._reply(200, [
+                {"accessor_id": t.accessor_id, "name": t.name,
+                 "type": t.type, "policies": t.policies}
+                for t in snap.acl_tokens()])
 
         if path == "/v1/jobs":
             jobs = [j for j in snap.jobs() if j.id.startswith(prefix)]
@@ -193,7 +273,46 @@ class HTTPAgent:
             })
         h._error(404, f"no such route {path}")
 
-    def _route_post(self, h, path: str, q: dict, body: dict) -> None:
+    def _route_post(self, h, path: str, q: dict, body: dict, acl=None) -> None:
+        from ..acl import policy as aclp
+
+        ns = q.get("namespace", ["default"])[0]
+        if path.startswith(("/v1/jobs", "/v1/job/")):
+            if not self._ns_allowed(acl, ns, aclp.CAP_SUBMIT_JOB):
+                return h._error(403, "Permission denied")
+        elif path.startswith(("/v1/nodes", "/v1/node/")):
+            if acl is not None and not acl.allow_node_write():
+                return h._error(403, "Permission denied")
+        elif path.startswith("/v1/operator"):
+            if acl is not None and not acl.allow_operator_write():
+                return h._error(403, "Permission denied")
+        elif path.startswith("/v1/var"):
+            if not self._ns_allowed(acl, ns, aclp.CAP_VARIABLES_WRITE):
+                return h._error(403, "Permission denied")
+        elif path.startswith("/v1/acl") and path != "/v1/acl/bootstrap":
+            if acl is not None and not acl.management:
+                return h._error(403, "Permission denied")
+
+        if path == "/v1/acl/bootstrap":
+            token = self.server.acl_bootstrap()
+            return h._reply(200, {"accessor_id": token.accessor_id,
+                                  "secret_id": token.secret_id,
+                                  "type": token.type})
+        if m := re.fullmatch(r"/v1/acl/policy/([^/]+)", path):
+            self.server.upsert_acl_policy(
+                m.group(1), body.get("rules", body.get("Rules", "{}")),
+                body.get("description", ""))
+            return h._reply(200, {"ok": True})
+        if path == "/v1/acl/token":
+            token = self.server.create_acl_token(
+                body.get("name", ""), body.get("policies", []),
+                body.get("type", "client"))
+            return h._reply(200, {"accessor_id": token.accessor_id,
+                                  "secret_id": token.secret_id})
+        if m := re.fullmatch(r"/v1/var/(.+)", path):
+            self.server.put_variable(m.group(1), body.get("items", {}), ns)
+            return h._reply(200, {"ok": True})
+
         if path == "/v1/jobs":
             data = body.get("job") or body.get("Job") or body
             job = from_dict(Job, data)
@@ -229,13 +348,65 @@ class HTTPAgent:
             return h._reply(200, {"updated": True})
         h._error(404, f"no such route {path}")
 
-    def _route_delete(self, h, path: str, q: dict) -> None:
+    def _route_delete(self, h, path: str, q: dict, acl=None) -> None:
+        from ..acl import policy as aclp
+
+        ns = q.get("namespace", ["default"])[0]
         if m := re.fullmatch(r"/v1/job/([^/]+)", path):
-            ns = q.get("namespace", ["default"])[0]
+            if not self._ns_allowed(acl, ns, aclp.CAP_SUBMIT_JOB):
+                return h._error(403, "Permission denied")
             purge = q.get("purge", ["false"])[0] in ("true", "1")
             eval_id = self.server.deregister_job(m.group(1), ns, purge=purge)
             return h._reply(200, {"eval_id": eval_id})
+        if m := re.fullmatch(r"/v1/var/(.+)", path):
+            if not self._ns_allowed(acl, ns, aclp.CAP_VARIABLES_WRITE):
+                return h._error(403, "Permission denied")
+            self.server.delete_variable(m.group(1), ns)
+            return h._reply(200, {"ok": True})
         h._error(404, f"no such route {path}")
+
+    # -- event stream (reference /v1/event/stream, nomad/stream/) --
+
+    def _route_event_stream(self, h, q: dict) -> None:
+        """ndjson event stream with topic filters:
+        ?topic=Node&topic=Job:job-id (reference event_endpoint.go)."""
+        topics: Dict[str, list] = {}
+        for t in q.get("topic", []):
+            if ":" in t:
+                topic, key = t.split(":", 1)
+            else:
+                topic, key = t, "*"
+            topics.setdefault(topic, []).append(key)
+        sub = self.server.events.subscribe(topics or None)
+        h.send_response(200)
+        h.send_header("Content-Type", "application/json")
+        h.send_header("Transfer-Encoding", "chunked")
+        h.end_headers()
+
+        def write_chunk(payload: bytes) -> None:
+            h.wfile.write(f"{len(payload):x}\r\n".encode() + payload + b"\r\n")
+            h.wfile.flush()
+
+        try:
+            deadline = time.time() + min(
+                float(q.get("wait", ["60"])[0] or 60), 600.0)
+            while time.time() < deadline:
+                events = sub.next_events(timeout=0.5)
+                for e in events:
+                    line = json.dumps({
+                        "Topic": e.topic, "Type": e.type, "Key": e.key,
+                        "Index": e.index,
+                        "Payload": to_dict(e.payload),
+                    }).encode() + b"\n"
+                    write_chunk(line)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            sub.close()
+            try:
+                write_chunk(b"")  # terminating chunk
+            except OSError:
+                pass
 
     # -- stubs (reference api list endpoints return trimmed rows) --
 

@@ -1,0 +1,182 @@
+"""Keyring + encrypter (reference nomad/encrypter.go:34-40 — AEAD root
+keys stored as .nks.json, used for variables and workload identities).
+
+The runtime has no AES primitive in the stdlib, so the cipher is an
+HMAC-SHA256-based stream construction in encrypt-then-MAC form:
+
+  keystream[i] = HMAC(enc_key, key_id || nonce || counter_i)
+  ciphertext   = plaintext XOR keystream
+  tag          = HMAC(mac_key, key_id || nonce || ciphertext)
+
+enc_key/mac_key are derived from the 32-byte root key by HKDF-style
+expansion. Same operational surface as the reference: multiple root
+keys by id, an active key for new writes, old keys retained for reads
+(rotation), and JSON keystore export/import for restarts.
+
+Workload identities are signed (HMAC-JWT, HS256) with the active key —
+the reference signs RS256 JWTs at plan-apply time (plan_apply.go:411).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import secrets
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from ..utils import generate_uuid
+
+NONCE_LEN = 16
+
+
+def _derive(root: bytes, label: bytes) -> bytes:
+    return hmac.new(root, b"nomad-tpu/" + label, hashlib.sha256).digest()
+
+
+class RootKey:
+    def __init__(self, key_id: Optional[str] = None,
+                 material: Optional[bytes] = None):
+        self.key_id = key_id or generate_uuid()
+        self.material = material or secrets.token_bytes(32)
+        self.create_time = time.time()
+        self._enc = _derive(self.material, b"encrypt")
+        self._mac = _derive(self.material, b"mac")
+
+    def _keystream(self, nonce: bytes, n: int) -> bytes:
+        out = bytearray()
+        counter = 0
+        kid = self.key_id.encode()
+        while len(out) < n:
+            block = hmac.new(self._enc,
+                             kid + nonce + counter.to_bytes(8, "big"),
+                             hashlib.sha256).digest()
+            out.extend(block)
+            counter += 1
+        return bytes(out[:n])
+
+    def encrypt(self, plaintext: bytes) -> Tuple[bytes, bytes, bytes]:
+        """-> (nonce, ciphertext, tag)."""
+        nonce = secrets.token_bytes(NONCE_LEN)
+        ks = self._keystream(nonce, len(plaintext))
+        ct = bytes(a ^ b for a, b in zip(plaintext, ks))
+        tag = hmac.new(self._mac, self.key_id.encode() + nonce + ct,
+                       hashlib.sha256).digest()
+        return nonce, ct, tag
+
+    def decrypt(self, nonce: bytes, ciphertext: bytes, tag: bytes) -> bytes:
+        want = hmac.new(self._mac, self.key_id.encode() + nonce + ciphertext,
+                        hashlib.sha256).digest()
+        if not hmac.compare_digest(want, tag):
+            raise ValueError("ciphertext authentication failed")
+        ks = self._keystream(nonce, len(ciphertext))
+        return bytes(a ^ b for a, b in zip(ciphertext, ks))
+
+
+class Encrypter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._keys: Dict[str, RootKey] = {}
+        self._active: Optional[str] = None
+        self.rotate()  # always start with a usable key
+
+    # -- keyring ops (reference keyring_endpoint.go) --
+
+    def rotate(self) -> str:
+        with self._lock:
+            key = RootKey()
+            self._keys[key.key_id] = key
+            self._active = key.key_id
+            return key.key_id
+
+    def active_key_id(self) -> str:
+        with self._lock:
+            return self._active
+
+    def key_ids(self) -> list:
+        with self._lock:
+            return list(self._keys)
+
+    def remove_key(self, key_id: str) -> None:
+        with self._lock:
+            if key_id == self._active:
+                raise ValueError("cannot remove the active key")
+            self._keys.pop(key_id, None)
+
+    def export_keystore(self) -> str:
+        """Serialized keystore (reference .nks.json files)."""
+        with self._lock:
+            return json.dumps({
+                "active": self._active,
+                "keys": {kid: base64.b64encode(k.material).decode()
+                         for kid, k in self._keys.items()},
+            })
+
+    @classmethod
+    def from_keystore(cls, blob: str) -> "Encrypter":
+        doc = json.loads(blob)
+        enc = cls.__new__(cls)
+        enc._lock = threading.Lock()
+        enc._keys = {kid: RootKey(kid, base64.b64decode(mat))
+                     for kid, mat in doc["keys"].items()}
+        enc._active = doc["active"]
+        return enc
+
+    # -- payload encryption (variables) --
+
+    def encrypt(self, plaintext: bytes) -> dict:
+        with self._lock:
+            key = self._keys[self._active]
+        nonce, ct, tag = key.encrypt(plaintext)
+        return {
+            "key_id": key.key_id,
+            "nonce": base64.b64encode(nonce).decode(),
+            "data": base64.b64encode(ct).decode(),
+            "tag": base64.b64encode(tag).decode(),
+        }
+
+    def decrypt(self, blob: dict) -> bytes:
+        with self._lock:
+            key = self._keys.get(blob["key_id"])
+        if key is None:
+            raise KeyError(f"unknown root key {blob['key_id']}")
+        return key.decrypt(base64.b64decode(blob["nonce"]),
+                           base64.b64decode(blob["data"]),
+                           base64.b64decode(blob["tag"]))
+
+    # -- workload identity JWTs (reference encrypter SignClaims) --
+
+    def sign_identity(self, claims: dict) -> str:
+        with self._lock:
+            key = self._keys[self._active]
+        header = {"alg": "HS256", "typ": "JWT", "kid": key.key_id}
+
+        def b64(obj) -> str:
+            raw = json.dumps(obj, separators=(",", ":")).encode()
+            return base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
+
+        signing_input = f"{b64(header)}.{b64(claims)}"
+        sig = hmac.new(key._mac, signing_input.encode(), hashlib.sha256).digest()
+        return signing_input + "." + \
+            base64.urlsafe_b64encode(sig).rstrip(b"=").decode()
+
+    def verify_identity(self, token: str) -> dict:
+        head_b64, claims_b64, sig_b64 = token.split(".")
+
+        def unb64(s: str) -> bytes:
+            return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+        header = json.loads(unb64(head_b64))
+        with self._lock:
+            key = self._keys.get(header.get("kid", ""))
+        if key is None:
+            raise ValueError("unknown signing key")
+        want = hmac.new(key._mac, f"{head_b64}.{claims_b64}".encode(),
+                        hashlib.sha256).digest()
+        if not hmac.compare_digest(want, unb64(sig_b64)):
+            raise ValueError("signature mismatch")
+        return json.loads(unb64(claims_b64))

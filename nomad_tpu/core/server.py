@@ -48,6 +48,7 @@ class ServerConfig:
     # (reference leader.go failedEvalUnblockInterval)
     failed_eval_followup_delay: float = 60.0
     gc_interval: float = 60.0
+    acl_enabled: bool = False
     sched_config: SchedulerConfiguration = field(default_factory=SchedulerConfiguration)
 
 
@@ -68,6 +69,10 @@ class Server:
         self.heartbeats = HeartbeatManager(self, ttl=self.config.heartbeat_ttl)
         self.workers: List[Worker] = [
             Worker(self, i) for i in range(self.config.num_workers)]
+        from .encrypter import Encrypter
+
+        self.encrypter = Encrypter()
+        self.acl_enabled = self.config.acl_enabled
         self.deployment_watcher = DeploymentWatcher(self)
         self.drainer = NodeDrainer(self)
         self.periodic = PeriodicDispatcher(self)
@@ -384,6 +389,86 @@ class Server:
         if ev.should_enqueue():
             self.broker.enqueue(ev)
         return ev.id
+
+    # -- ACL endpoints (nomad/acl_endpoint.go) --
+
+    def acl_bootstrap(self):
+        """One-time bootstrap: mint the initial management token."""
+        from ..acl.tokens import TOKEN_TYPE_MANAGEMENT, AclToken
+
+        snap = self.store.snapshot()
+        if any(True for _ in snap.acl_tokens()):
+            raise PermissionError("ACL already bootstrapped")
+        token = AclToken.new("Bootstrap Token", TOKEN_TYPE_MANAGEMENT)
+        token.create_time = time.time()
+        self.store.upsert_acl_token(token)
+        return token
+
+    def upsert_acl_policy(self, name: str, rules, description: str = ""):
+        from ..acl.policy import AclPolicy, parse_policy
+
+        if not isinstance(rules, str):
+            import json as _json
+
+            rules = _json.dumps(rules)
+        parse_policy(rules)  # validate before storing
+        policy = AclPolicy(name=name, description=description, rules=rules)
+        self.store.upsert_acl_policy(policy)
+        return policy
+
+    def create_acl_token(self, name: str, policies, token_type: str = "client"):
+        from ..acl.tokens import AclToken
+
+        snap = self.store.snapshot()
+        for p in policies:
+            if snap.acl_policy(p) is None:
+                raise ValueError(f"unknown policy {p!r}")
+        token = AclToken.new(name, token_type, policies)
+        token.create_time = time.time()
+        self.store.upsert_acl_token(token)
+        return token
+
+    def resolve_token(self, secret_id: str):
+        """secret -> compiled ACL (reference nomad/auth/auth.go)."""
+        from ..acl.policy import ACL, compile_acl
+
+        if not secret_id:
+            return None
+        snap = self.store.snapshot()
+        token = snap.acl_token_by_secret(secret_id)
+        if token is None:
+            raise PermissionError("token not found")
+        if token.is_management:
+            return ACL(management=True)
+        policies = [snap.acl_policy(p) for p in token.policies]
+        return compile_acl([p for p in policies if p is not None])
+
+    # -- variables endpoints (nomad/variables_endpoint.go) --
+
+    def put_variable(self, path: str, items: Dict[str, str],
+                     namespace: str = "default") -> None:
+        import json as _json
+
+        from ..structs.variables import Variable
+
+        blob = self.encrypter.encrypt(_json.dumps(items).encode())
+        self.store.upsert_variable(Variable(namespace=namespace, path=path,
+                                            encrypted=blob))
+
+    def get_variable(self, path: str, namespace: str = "default"):
+        import json as _json
+
+        var = self.store.snapshot().variable(path, namespace)
+        if var is None:
+            return None
+        return _json.loads(self.encrypter.decrypt(var.encrypted))
+
+    def list_variables(self, namespace: str = "default", prefix: str = ""):
+        return [v.path for v in
+                self.store.snapshot().variables(namespace, prefix)]
+
+    def delete_variable(self, path: str, namespace: str = "default") -> None:
+        self.store.delete_variable(path, namespace)
 
     # -- test/ops helpers --
 

@@ -26,6 +26,9 @@ MUTATIONS = {
     "update_alloc_desired_transitions",
     "upsert_plan_results",
     "upsert_deployment", "update_deployment_status", "delete_deployment",
+    "upsert_acl_policy", "delete_acl_policy",
+    "upsert_acl_token", "delete_acl_token",
+    "upsert_variable", "delete_variable",
     "gc_terminal_allocs", "compact",
 }
 

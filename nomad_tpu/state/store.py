@@ -142,6 +142,34 @@ class StateSnapshot:
     def deployments(self) -> Iterator[Deployment]:
         return (d for _, d in self._store._deployments.iterate(self.index))
 
+    # --- ACL + variables reads ---
+
+    def acl_policy(self, name: str):
+        return self._store._acl_policies.get(name, self.index)
+
+    def acl_policies(self):
+        return (p for _, p in self._store._acl_policies.iterate(self.index))
+
+    def acl_token_by_accessor(self, accessor_id: str):
+        return self._store._acl_tokens.get(accessor_id, self.index)
+
+    def acl_token_by_secret(self, secret_id: str):
+        accessor = self._store._acl_secret_idx.get(secret_id, self.index)
+        if accessor is None:
+            return None
+        return self._store._acl_tokens.get(accessor, self.index)
+
+    def acl_tokens(self):
+        return (t for _, t in self._store._acl_tokens.iterate(self.index))
+
+    def variable(self, path: str, namespace: str = "default"):
+        return self._store._variables.get((namespace, path), self.index)
+
+    def variables(self, namespace: str = "default", prefix: str = ""):
+        for (ns, path), v in self._store._variables.iterate(self.index):
+            if ns == namespace and path.startswith(prefix):
+                yield v
+
     def deployment_by_id(self, dep_id: str) -> Optional[Deployment]:
         return self._store._deployments.get(dep_id, self.index)
 
@@ -187,11 +215,18 @@ class StateStore:
         self._allocs_by_eval = VersionedTable("allocs_by_eval")
         self._evals_by_job = VersionedTable("evals_by_job")
         self._deployments_by_job = VersionedTable("deployments_by_job")
+        # ACL + variables (reference schema.go acl_* and variables tables)
+        self._acl_policies = VersionedTable("acl_policies")     # key name
+        self._acl_tokens = VersionedTable("acl_tokens")         # key accessor id
+        self._acl_secret_idx = VersionedTable("acl_secret_idx")  # secret -> accessor
+        self._variables = VersionedTable("variables")           # key (ns, path)
 
         self._all_tables = [
             self._nodes, self._jobs, self._job_versions, self._evals, self._allocs,
             self._deployments, self._allocs_by_node, self._allocs_by_job,
             self._allocs_by_eval, self._evals_by_job, self._deployments_by_job,
+            self._acl_policies, self._acl_tokens, self._acl_secret_idx,
+            self._variables,
         ]
         self._listeners: List[Callable[[int, list], None]] = []
 
@@ -566,6 +601,65 @@ class StateStore:
             dep.modify_index = gen
             self._deployments.put(dep_id, dep, gen, live)
             self._commit(gen, [("deployment-update", dep)])
+            return gen
+
+    # --- ACL (reference nomad/state/state_store acl tables) ---
+
+    def upsert_acl_policy(self, policy) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            policy.modify_index = gen
+            self._acl_policies.put(policy.name, policy, gen, live)
+            self._commit(gen, [("acl-policy-upsert", policy)])
+            return gen
+
+    def delete_acl_policy(self, name: str) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            pol = self._acl_policies.get_latest(name)
+            self._acl_policies.delete(name, gen, live)
+            self._commit(gen, [("acl-policy-delete", pol)])
+            return gen
+
+    def upsert_acl_token(self, token) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            token.modify_index = gen
+            self._acl_tokens.put(token.accessor_id, token, gen, live)
+            self._acl_secret_idx.put(token.secret_id, token.accessor_id, gen, live)
+            self._commit(gen, [("acl-token-upsert", token)])
+            return gen
+
+    def delete_acl_token(self, accessor_id: str) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            tok = self._acl_tokens.get_latest(accessor_id)
+            self._acl_tokens.delete(accessor_id, gen, live)
+            if tok is not None:
+                self._acl_secret_idx.delete(tok.secret_id, gen, live)
+            self._commit(gen, [("acl-token-delete", tok)])
+            return gen
+
+    # --- variables (reference nomad/state/state_store_variables.go) ---
+
+    def upsert_variable(self, var) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            key = (var.namespace, var.path)
+            prev = self._variables.get_latest(key)
+            var.create_index = prev.create_index if prev is not None else gen
+            var.modify_index = gen
+            self._variables.put(key, var, gen, live)
+            self._commit(gen, [("variable-upsert", var)])
+            return gen
+
+    def delete_variable(self, path: str, namespace: str = "default") -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            key = (namespace, path)
+            var = self._variables.get_latest(key)
+            self._variables.delete(key, gen, live)
+            self._commit(gen, [("variable-delete", var)])
             return gen
 
     # --- GC (reference nomad/core_sched.go) ---

@@ -1,0 +1,17 @@
+"""Variables: encrypted KV (reference nomad/structs/variables.go +
+state_store_variables.go). Items are encrypted at rest by the server's
+keyring; only the ciphertext blob lands in the replicated store."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass(slots=True)
+class Variable:
+    namespace: str = "default"
+    path: str = ""
+    encrypted: Optional[dict] = None      # encrypter blob (key_id/nonce/data/tag)
+    create_index: int = 0
+    modify_index: int = 0

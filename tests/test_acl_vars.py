@@ -1,0 +1,210 @@
+"""ACL, variables/keyring, workload identity, and event stream tests
+(reference acl/, nomad/acl_endpoint.go, nomad/encrypter.go,
+nomad/variables_endpoint.go, nomad/stream/).
+"""
+
+import json
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.acl.policy import (
+    ACL,
+    CAP_READ_JOB,
+    CAP_SUBMIT_JOB,
+    CAP_VARIABLES_READ,
+    compile_acl,
+    parse_policy,
+)
+from nomad_tpu.acl.tokens import TOKEN_TYPE_MANAGEMENT
+from nomad_tpu.api import ApiClient, HTTPAgent
+from nomad_tpu.api.client import ApiError
+from nomad_tpu.core import Server, ServerConfig
+from nomad_tpu.core.encrypter import Encrypter
+
+
+# ---------------------------------------------------------------------------
+# policy engine
+# ---------------------------------------------------------------------------
+
+
+class TestPolicy:
+    def test_parse_and_expand(self):
+        p = parse_policy(json.dumps({
+            "namespace": {"default": {"policy": "write"},
+                          "ro": {"policy": "read"}},
+            "node": {"policy": "read"},
+        }))
+        acl = ACL(policies=[p])
+        assert acl.allow_namespace_operation("default", CAP_SUBMIT_JOB)
+        assert acl.allow_namespace_operation("ro", CAP_READ_JOB)
+        assert not acl.allow_namespace_operation("ro", CAP_SUBMIT_JOB)
+        assert not acl.allow_namespace_operation("other", CAP_READ_JOB)
+        assert acl.allow_node_read() and not acl.allow_node_write()
+
+    def test_glob_selector_most_specific_wins(self):
+        p = parse_policy(json.dumps({
+            "namespace": {"*": {"policy": "read"},
+                          "prod-*": {"policy": "deny"},
+                          "prod-web": {"policy": "write"}},
+        }))
+        acl = ACL(policies=[p])
+        assert acl.allow_namespace_operation("anything", CAP_READ_JOB)
+        assert not acl.allow_namespace_operation("prod-db", CAP_READ_JOB)
+        assert acl.allow_namespace_operation("prod-web", CAP_SUBMIT_JOB)
+
+    def test_management_allows_all(self):
+        acl = ACL(management=True)
+        assert acl.allow_namespace_operation("x", CAP_SUBMIT_JOB)
+        assert acl.allow_operator_write()
+
+    def test_bad_capability_rejected(self):
+        with pytest.raises(ValueError):
+            parse_policy(json.dumps({
+                "namespace": {"default": {"capabilities": ["launch-missiles"]}}}))
+
+
+# ---------------------------------------------------------------------------
+# keyring / encrypter
+# ---------------------------------------------------------------------------
+
+
+class TestEncrypter:
+    def test_roundtrip_and_tamper(self):
+        enc = Encrypter()
+        blob = enc.encrypt(b"secret payload")
+        assert enc.decrypt(blob) == b"secret payload"
+        bad = dict(blob)
+        bad["data"] = blob["data"][:-4] + "AAAA"
+        with pytest.raises(ValueError):
+            enc.decrypt(bad)
+
+    def test_rotation_keeps_old_keys_readable(self):
+        enc = Encrypter()
+        blob = enc.encrypt(b"old-key data")
+        old_key = enc.active_key_id()
+        new_key = enc.rotate()
+        assert new_key != old_key
+        assert enc.decrypt(blob) == b"old-key data"
+        blob2 = enc.encrypt(b"new")
+        assert blob2["key_id"] == new_key
+
+    def test_keystore_export_import(self):
+        enc = Encrypter()
+        blob = enc.encrypt(b"survives restart")
+        enc2 = Encrypter.from_keystore(enc.export_keystore())
+        assert enc2.decrypt(blob) == b"survives restart"
+
+    def test_workload_identity_jwt(self):
+        enc = Encrypter()
+        claims = {"sub": "job/web/task", "nomad_namespace": "default"}
+        token = enc.sign_identity(claims)
+        assert enc.verify_identity(token) == claims
+        with pytest.raises(ValueError):
+            enc.verify_identity(token[:-3] + "xxx")
+
+
+# ---------------------------------------------------------------------------
+# server endpoints + HTTP enforcement
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def acl_stack():
+    server = Server(ServerConfig(acl_enabled=True))
+    server.start()
+    agent = HTTPAgent(server, port=0).start()
+    boot = server.acl_bootstrap()
+    yield server, agent, boot
+    agent.stop()
+    server.stop()
+
+
+class TestAclEndpoints:
+    def test_bootstrap_once(self, acl_stack):
+        server, agent, boot = acl_stack
+        assert boot.type == TOKEN_TYPE_MANAGEMENT
+        with pytest.raises(PermissionError):
+            server.acl_bootstrap()
+
+    def test_anonymous_denied_token_allowed(self, acl_stack):
+        server, agent, boot = acl_stack
+        anon = ApiClient(address=agent.address)
+        with pytest.raises(ApiError) as e:
+            anon.list_jobs()
+        assert e.value.status == 403
+
+        mgmt = ApiClient(address=agent.address, token=boot.secret_id)
+        assert mgmt.list_jobs() == []
+
+    def test_scoped_token(self, acl_stack):
+        server, agent, boot = acl_stack
+        mgmt = ApiClient(address=agent.address, token=boot.secret_id)
+        mgmt.upsert_acl_policy("readonly", {
+            "namespace": {"default": {"policy": "read"}}})
+        tok = mgmt.create_acl_token("ro", ["readonly"])
+        ro = ApiClient(address=agent.address, token=tok["secret_id"])
+        assert ro.list_jobs() == []
+        with pytest.raises(ApiError) as e:
+            ro.register_job(mock.job())
+        assert e.value.status == 403
+        # management can register
+        mgmt.register_job(mock.job())
+
+    def test_variables_capability(self, acl_stack):
+        server, agent, boot = acl_stack
+        mgmt = ApiClient(address=agent.address, token=boot.secret_id)
+        mgmt.upsert_acl_policy("varread", {
+            "namespace": {"default": {"capabilities": ["variables-read"]}}})
+        tok = mgmt.create_acl_token("v", ["varread"])
+        mgmt.put_variable("app/config", {"db": "postgres://"})
+        reader = ApiClient(address=agent.address, token=tok["secret_id"])
+        assert reader.get_variable("app/config")["items"]["db"] == "postgres://"
+        with pytest.raises(ApiError):
+            reader.put_variable("app/config", {"x": "y"})
+
+
+class TestVariables:
+    def test_roundtrip_encrypted_at_rest(self):
+        with Server(ServerConfig()) as s:
+            s.put_variable("app/creds", {"user": "u", "pass": "hunter2"})
+            assert s.get_variable("app/creds") == {"user": "u", "pass": "hunter2"}
+            # ciphertext at rest: the stored row has no plaintext
+            var = s.store.snapshot().variable("app/creds")
+            raw = json.dumps(var.encrypted)
+            assert "hunter2" not in raw
+            assert s.list_variables(prefix="app/") == ["app/creds"]
+            s.delete_variable("app/creds")
+            assert s.get_variable("app/creds") is None
+
+    def test_namespace_isolation(self):
+        with Server(ServerConfig()) as s:
+            s.put_variable("p", {"a": "1"}, namespace="ns1")
+            s.put_variable("p", {"a": "2"}, namespace="ns2")
+            assert s.get_variable("p", "ns1") == {"a": "1"}
+            assert s.get_variable("p", "ns2") == {"a": "2"}
+
+
+class TestEventStreamHTTP:
+    def test_stream_over_http(self):
+        import threading
+        import time
+
+        with Server(ServerConfig()) as s:
+            with HTTPAgent(s, port=0) as agent:
+                api = ApiClient(address=agent.address)
+                got = []
+
+                def consume():
+                    for e in api.stream_events(topics=["Node"], wait_s=3.0):
+                        got.append(e)
+                        if len(got) >= 1:
+                            break
+
+                t = threading.Thread(target=consume)
+                t.start()
+                time.sleep(0.3)
+                s.register_node(mock.node())
+                t.join(timeout=10.0)
+                assert got and got[0]["Topic"] == "Node"
+                assert got[0]["Payload"]["id"]
