@@ -145,14 +145,28 @@ class ACL:
     def allow_namespace_operation(self, namespace: str, capability: str) -> bool:
         if self.management:
             return True
-        best, best_score = None, -1
-        for rule in self._namespaces:
-            score = _match(rule.selector, namespace)
-            if score > best_score:
-                best, best_score = rule, score
-        if best is None or CAP_DENY in best.capabilities:
+        # A token's policies each contribute rules; all rules at the winning
+        # specificity merge (the reference merges capability sets across a
+        # token's policies per namespace pattern). Explicit deny wins.
+        best_score = max((_match(r.selector, namespace) for r in self._namespaces),
+                         default=-1)
+        if best_score < 0:
             return False
-        return capability in best.capabilities
+        caps = set()
+        for rule in self._namespaces:
+            if _match(rule.selector, namespace) == best_score:
+                caps |= rule.capabilities
+        if CAP_DENY in caps:
+            return False
+        return capability in caps
+
+    def allow_namespace_any(self, capability: str) -> bool:
+        """True if any namespace rule grants the capability — gates
+        cross-namespace list endpoints (which then filter per row)."""
+        if self.management:
+            return True
+        return any(capability in r.capabilities and CAP_DENY not in r.capabilities
+                   for r in self._namespaces)
 
     def _coarse(self, policy: str, write: bool) -> bool:
         if self.management:

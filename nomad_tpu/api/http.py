@@ -166,8 +166,16 @@ class HTTPAgent:
 
         # coarse read gating per route family (job_endpoint/node_endpoint
         # authorization in the reference)
-        if path.startswith(("/v1/jobs", "/v1/job/", "/v1/allocation",
-                            "/v1/evaluation")):
+        if path.startswith(("/v1/jobs", "/v1/allocation", "/v1/evaluation")) \
+                and not path.startswith("/v1/jobs/"):
+            # cross-namespace lists and by-id fetches: the query-param ns is
+            # not the object's ns, so reject only tokens that can read
+            # nowhere; rows/objects are authorized below against their own
+            # namespace (the reference does the same post-lookup check)
+            if acl is not None and not acl.allow_namespace_any(aclp.CAP_READ_JOB):
+                return h._error(403, "Permission denied")
+        elif path.startswith("/v1/job/"):
+            # job routes look up by (query ns, id): gate on that ns
             if not self._ns_allowed(acl, ns, aclp.CAP_READ_JOB):
                 return h._error(403, "Permission denied")
         elif path.startswith(("/v1/nodes", "/v1/node/")):
@@ -208,8 +216,23 @@ class HTTPAgent:
                  "type": t.type, "policies": t.policies}
                 for t in snap.acl_tokens()])
 
+        # list endpoints span namespaces, so the coarse per-route gate above
+        # is not enough: filter rows to namespaces the token can read, and
+        # authorize single-object fetches against the object's own namespace
+        # (the reference job/alloc endpoints do the same post-lookup check)
+        _ns_cache: dict = {}
+
+        def ns_ok(obj_ns: str) -> bool:
+            # memoized: called once per row on list endpoints
+            hit = _ns_cache.get(obj_ns)
+            if hit is None:
+                hit = _ns_cache[obj_ns] = \
+                    self._ns_allowed(acl, obj_ns, aclp.CAP_READ_JOB)
+            return hit
+
         if path == "/v1/jobs":
-            jobs = [j for j in snap.jobs() if j.id.startswith(prefix)]
+            jobs = [j for j in snap.jobs()
+                    if j.id.startswith(prefix) and ns_ok(j.namespace)]
             return h._reply(200, [self._job_stub(j, snap) for j in jobs])
         if m := re.fullmatch(r"/v1/job/([^/]+)", path):
             job = snap.job_by_id(m.group(1), ns)
@@ -233,23 +256,29 @@ class HTTPAgent:
             return h._reply(200, node)
         if m := re.fullmatch(r"/v1/node/([^/]+)/allocations", path):
             return h._reply(200, [self._alloc_stub(a) for a in
-                                  snap.allocs_by_node(m.group(1))])
+                                  snap.allocs_by_node(m.group(1))
+                                  if ns_ok(a.namespace)])
 
         if path == "/v1/allocations":
-            allocs = [a for a in snap.allocs() if a.id.startswith(prefix)]
+            allocs = [a for a in snap.allocs()
+                      if a.id.startswith(prefix) and ns_ok(a.namespace)]
             return h._reply(200, [self._alloc_stub(a) for a in allocs])
         if m := re.fullmatch(r"/v1/allocation/([^/]+)", path):
             alloc = snap.alloc_by_id(m.group(1))
             if alloc is None:
                 return h._error(404, "alloc not found")
+            if not ns_ok(alloc.namespace):
+                return h._error(403, "Permission denied")
             return h._reply(200, alloc)
 
         if path == "/v1/evaluations":
-            return h._reply(200, list(snap.evals()))
+            return h._reply(200, [e for e in snap.evals() if ns_ok(e.namespace)])
         if m := re.fullmatch(r"/v1/evaluation/([^/]+)", path):
             ev = snap.eval_by_id(m.group(1))
             if ev is None:
                 return h._error(404, "eval not found")
+            if not ns_ok(ev.namespace):
+                return h._error(403, "Permission denied")
             return h._reply(200, ev)
 
         if path == "/v1/status/leader":

@@ -15,6 +15,7 @@ uses, so replication slots in without touching them.
 from __future__ import annotations
 
 import copy
+import time
 from typing import Any, List
 
 MUTATIONS = {
@@ -39,6 +40,8 @@ class FSM:
 
     def apply(self, command: tuple) -> Any:
         op, args, kwargs = command
+        if op == "noop":
+            return None  # leader barrier entry (raft/node.py _become_leader)
         if op not in MUTATIONS:
             raise ValueError(f"unknown FSM op {op!r}")
         fn = getattr(self.store, op)
@@ -46,6 +49,18 @@ class FSM:
         args = copy.deepcopy(args)
         kwargs = copy.deepcopy(kwargs)
         return fn(*args, **kwargs)
+
+
+# Mutations that stamp wall-clock times must receive the time from the
+# proposer inside the replicated command: a follower replaying the log at
+# catch-up time would otherwise stamp replay-time and diverge from the
+# leader on time-gated decisions (gc_terminal_allocs cutoffs). The
+# reference embeds times in the raft request structs for the same reason.
+TIMESTAMPED = {
+    "upsert_evals", "upsert_allocs", "update_allocs_from_client",
+    "upsert_plan_results", "update_node_status",
+    "update_alloc_desired_transitions",
+}
 
 
 class RaftStore:
@@ -58,6 +73,8 @@ class RaftStore:
     def __getattr__(self, name: str):
         if name in MUTATIONS:
             def propose(*args, **kwargs):
+                if name in TIMESTAMPED and kwargs.get("ts") is None:
+                    kwargs["ts"] = time.time()
                 return self._raft.apply((name, args, kwargs))
 
             return propose

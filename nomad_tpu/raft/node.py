@@ -160,8 +160,13 @@ class RaftNode:
     def _become_follower(self, term: int) -> None:
         was_leader = self.state == LEADER
         self.state = FOLLOWER
-        self.current_term = term
-        self.voted_for = None
+        # Vote safety: voted_for is per-term state, so it only resets when
+        # the term advances. A same-term step-down (e.g. a candidate seeing
+        # the elected leader's heartbeat) must keep its recorded vote, or it
+        # could grant a second vote in the same term.
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
         self._deadline = self._new_deadline()
         if was_leader and self.on_leadership:
             self.on_leadership(False)
@@ -173,6 +178,12 @@ class RaftNode:
         for p in self.peers:
             self._next_index[p] = last_index + 1
             self._match_index[p] = 0
+        # Barrier entry: commit counting skips prior-term entries, so without
+        # a fresh current-term entry, anything replicated under the old
+        # leader stays uncommitted until the next client write. The no-op
+        # commits promptly and drags predecessors with it (hashicorp/raft
+        # does the same).
+        self.log.append(self.current_term, ("noop", (), {}))
         if self.on_leadership:
             self.on_leadership(True)
 
@@ -282,10 +293,13 @@ class RaftNode:
                 entry = self.log.get(idx)
                 if entry is None:
                     break
-                try:
-                    result = self.fsm_apply(tuple(entry.command))
-                except Exception as e:
-                    result = e
+                if tuple(entry.command)[:1] == ("noop",):
+                    result = None  # leader barrier entry, internal to raft
+                else:
+                    try:
+                        result = self.fsm_apply(tuple(entry.command))
+                    except Exception as e:
+                        result = e
                 with self._apply_cond:
                     self._results[idx] = result
                     if len(self._results) > 4096:

@@ -314,10 +314,12 @@ class StateStore:
             self._commit(gen, [(event, node)])
             return gen
 
-    def update_node_status(self, node_id: str, status: str, ts: float = 0.0) -> int:
+    def update_node_status(self, node_id: str, status: str, ts: float = None) -> int:
+        ts = ts if ts is not None else time.time()
+
         def mut(n):
             n.status = status
-            n.status_updated_at = ts or time.time()
+            n.status_updated_at = ts
         return self._update_node(node_id, "node-status", mut)
 
     def update_node_eligibility(self, node_id: str, eligibility: str) -> int:
@@ -398,21 +400,24 @@ class StateStore:
 
     # --- eval mutations (reference FSM ApplyUpdateEval) ---
 
-    def upsert_evals(self, evals: List[Evaluation]) -> int:
+    def upsert_evals(self, evals: List[Evaluation], ts: float = None) -> int:
         with self._write_lock:
             gen, live = self._begin()
+            ts = ts if ts is not None else time.time()
             events = []
             for ev in evals:
-                self._put_eval(ev, gen, live)
+                self._put_eval(ev, gen, live, ts)
                 events.append(("eval-upsert", ev))
             self._commit(gen, events)
             return gen
 
-    def _put_eval(self, ev: Evaluation, gen: int, live: int) -> None:
+    def _put_eval(self, ev: Evaluation, gen: int, live: int, ts: float = None) -> None:
         prev = self._evals.get_latest(ev.id)
         ev.create_index = prev.create_index if prev is not None else gen
         ev.modify_index = gen
-        ev.modify_time = time.time()
+        # ts flows from the proposer via the raft command so replicas stamp
+        # identical times (replay-time stamping would fork GC decisions)
+        ev.modify_time = ts if ts is not None else time.time()
         if not ev.create_time:
             ev.create_time = ev.modify_time
         self._evals.put(ev.id, ev, gen, live)
@@ -442,19 +447,20 @@ class StateStore:
 
     # --- alloc mutations ---
 
-    def upsert_allocs(self, allocs: List[Allocation]) -> int:
+    def upsert_allocs(self, allocs: List[Allocation], ts: float = None) -> int:
         """Server-side alloc upsert (placements, desired-status changes)."""
         with self._write_lock:
             gen, live = self._begin()
+            ts = ts if ts is not None else time.time()
             events = []
             for alloc in allocs:
-                self._put_alloc(alloc, gen, live)
+                self._put_alloc(alloc, gen, live, ts)
                 events.append(("alloc-upsert", alloc))
             self._commit(gen, events)
             return gen
 
-    def _put_alloc(self, alloc: Allocation, gen: int, live: int) -> None:
-        alloc.modify_time = time.time()
+    def _put_alloc(self, alloc: Allocation, gen: int, live: int, ts: float = None) -> None:
+        alloc.modify_time = ts if ts is not None else time.time()
         prev = self._allocs.get_latest(alloc.id)
         if prev is not None:
             alloc.create_index = prev.create_index
@@ -475,11 +481,12 @@ class StateStore:
             ecell = self._allocs_by_eval.get_latest(alloc.eval_id)
             self._allocs_by_eval.put(alloc.eval_id, cons(alloc.id, ecell), gen, live)
 
-    def update_allocs_from_client(self, updates: List[Allocation]) -> int:
+    def update_allocs_from_client(self, updates: List[Allocation], ts: float = None) -> int:
         """Client status sync (reference FSM ApplyAllocClientUpdate;
         client batches at client/client.go:2198)."""
         with self._write_lock:
             gen, live = self._begin()
+            ts = ts if ts is not None else time.time()
             events = []
             for upd in updates:
                 existing = self._allocs.get_latest(upd.id)
@@ -492,14 +499,15 @@ class StateStore:
                 merged.task_finished_at = upd.task_finished_at or merged.task_finished_at
                 merged.deployment_status = upd.deployment_status or merged.deployment_status
                 merged.modify_index = gen
-                merged.modify_time = time.time()
+                merged.modify_time = ts
                 self._allocs.put(merged.id, merged, gen, live)
                 events.append(("alloc-client-update", merged))
             self._commit(gen, events)
             return gen
 
     def update_alloc_desired_transitions(
-            self, transitions: Dict[str, object], evals: List[Evaluation] = ()) -> int:
+            self, transitions: Dict[str, object], evals: List[Evaluation] = (),
+            ts: float = None) -> int:
         """Reference FSM ApplyAllocUpdateDesiredTransition (used by drainer)."""
         with self._write_lock:
             gen, live = self._begin()
@@ -514,7 +522,7 @@ class StateStore:
                 self._allocs.put(alloc_id, merged, gen, live)
                 events.append(("alloc-transition", merged))
             for ev in evals:
-                self._put_eval(ev, gen, live)
+                self._put_eval(ev, gen, live, ts)
                 events.append(("eval-upsert", ev))
             self._commit(gen, events)
             return gen
@@ -529,18 +537,20 @@ class StateStore:
         deployment: Optional[Deployment] = None,
         deployment_updates: List = (),
         evals: List[Evaluation] = (),
+        ts: float = None,
     ) -> int:
         with self._write_lock:
             gen, live = self._begin()
+            ts = ts if ts is not None else time.time()
             events = []
             for alloc in stopped_allocs:
-                self._put_alloc(alloc, gen, live)
+                self._put_alloc(alloc, gen, live, ts)
                 events.append(("alloc-stop", alloc))
             for alloc in preempted_allocs:
-                self._put_alloc(alloc, gen, live)
+                self._put_alloc(alloc, gen, live, ts)
                 events.append(("alloc-preempt", alloc))
             for alloc in result_allocs:
-                self._put_alloc(alloc, gen, live)
+                self._put_alloc(alloc, gen, live, ts)
                 events.append(("alloc-upsert", alloc))
             if deployment is not None:
                 self._put_deployment(deployment, gen, live)
@@ -555,7 +565,7 @@ class StateStore:
                     self._deployments.put(dep.id, dep, gen, live)
                     events.append(("deployment-update", dep))
             for ev in evals:
-                self._put_eval(ev, gen, live)
+                self._put_eval(ev, gen, live, ts)
                 events.append(("eval-upsert", ev))
             self._commit(gen, events)
             return gen

@@ -10,6 +10,7 @@ import pytest
 from nomad_tpu import mock
 from nomad_tpu.acl.policy import (
     ACL,
+    AclPolicy,
     CAP_READ_JOB,
     CAP_SUBMIT_JOB,
     CAP_VARIABLES_READ,
@@ -208,3 +209,67 @@ class TestEventStreamHTTP:
                 t.join(timeout=10.0)
                 assert got and got[0]["Topic"] == "Node"
                 assert got[0]["Payload"]["id"]
+
+
+class TestAdviceRegressions:
+    """Round-2 ACL fixes from ADVICE.md."""
+
+    def test_capabilities_union_across_policies(self):
+        """Two policies granting different caps on the same namespace
+        selector merge (the reference unions per-pattern capability sets
+        across a token's policies)."""
+        p1 = AclPolicy(name="a", rules=json.dumps(
+            {"namespace": {"default": {"capabilities": ["read-job"]}}}))
+        p2 = AclPolicy(name="b", rules=json.dumps(
+            {"namespace": {"default": {"capabilities": ["submit-job"]}}}))
+        acl = compile_acl([p1, p2])
+        assert acl.allow_namespace_operation("default", CAP_READ_JOB)
+        assert acl.allow_namespace_operation("default", CAP_SUBMIT_JOB)
+
+    def test_deny_wins_in_union(self):
+        p1 = AclPolicy(name="a", rules=json.dumps(
+            {"namespace": {"default": {"capabilities": ["read-job"]}}}))
+        p2 = AclPolicy(name="b", rules=json.dumps(
+            {"namespace": {"default": {"capabilities": ["deny"]}}}))
+        acl = compile_acl([p1, p2])
+        assert not acl.allow_namespace_operation("default", CAP_READ_JOB)
+
+    def test_list_endpoints_filter_by_namespace(self, acl_stack):
+        """A token scoped to one namespace must not see other namespaces'
+        jobs/allocs/evals through list or by-id endpoints."""
+        server, agent, boot = acl_stack
+        mgmt = ApiClient(address=agent.address, token=boot.secret_id)
+        mgmt.upsert_acl_policy("devonly", {
+            "namespace": {"dev": {"policy": "read"}}})
+        tok = mgmt.create_acl_token("dev", ["devonly"])
+
+        jd = mock.job()
+        jd.namespace = "dev"
+        js = mock.job()
+        js.namespace = "secret"
+        server.register_job(jd)
+        server.register_job(js)
+
+        dev = ApiClient(address=agent.address, token=tok["secret_id"])
+        seen = {j["id"] if isinstance(j, dict) else j.id
+                for j in dev.list_jobs()}
+        assert jd.id in seen and js.id not in seen
+
+        # evals for the secret job are invisible too
+        evs, _ = dev.get("/v1/evaluations")
+        assert all(e.get("namespace") != "secret" for e in evs)
+
+        all_evs, _ = mgmt.get("/v1/evaluations")
+        secret_evs = [e for e in all_evs if e.get("namespace") == "secret"]
+        assert secret_evs, "mgmt token should see the secret namespace evals"
+        with pytest.raises(ApiError) as err:
+            dev.get(f"/v1/evaluation/{secret_evs[0]['id']}")
+        assert err.value.status == 403
+
+        # but its own namespace's eval IS fetchable by id even though the
+        # client's default ?namespace= param says "default" (post-lookup
+        # authorization against the object's own namespace)
+        dev_evs = [e for e in all_evs if e.get("namespace") == "dev"]
+        assert dev_evs
+        got, _ = dev.get(f"/v1/evaluation/{dev_evs[0]['id']}")
+        assert got["id"] == dev_evs[0]["id"]

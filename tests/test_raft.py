@@ -171,3 +171,98 @@ class TestReplicatedServer:
             assert new_leader.server.wait_for_idle(15.0)
             allocs = new_leader.local_store.snapshot().allocs_by_job(job2.id)
             assert len(allocs) == 2
+
+
+class TestAdviceRegressions:
+    """Round-2 fixes from ADVICE.md: vote safety + leader barrier."""
+
+    def test_same_term_stepdown_keeps_vote(self):
+        """A candidate stepping down on a same-term AppendEntries must not
+        erase its self-vote (it could otherwise grant a second vote in the
+        same term, electing two leaders)."""
+        transport = InProcTransport()
+        node = RaftNode("a", ["a", "b", "c"], transport, lambda c: None,
+                        election_timeout=999, heartbeat_interval=999)
+        node.current_term = 5
+        node.state = "candidate"
+        node.voted_for = "a"
+        # same-term heartbeat from the elected leader
+        reply = node.handle({"kind": "append_entries", "term": 5,
+                             "leader": "b", "prev_log_index": 0,
+                             "prev_log_term": 0, "entries": [],
+                             "leader_commit": 0})
+        assert reply["success"]
+        assert node.state == "follower"
+        assert node.voted_for == "a"  # vote retained for term 5
+        # so a competing candidate in the same term is refused
+        reply = node.handle({"kind": "request_vote", "term": 5,
+                             "candidate": "c", "last_log_index": 0,
+                             "last_log_term": 0})
+        assert not reply["granted"]
+
+    def test_vote_cleared_on_term_increase(self):
+        transport = InProcTransport()
+        node = RaftNode("a", ["a", "b"], transport, lambda c: None,
+                        election_timeout=999, heartbeat_interval=999)
+        node.current_term = 5
+        node.voted_for = "a"
+        reply = node.handle({"kind": "request_vote", "term": 6,
+                             "candidate": "b", "last_log_index": 0,
+                             "last_log_term": 0})
+        assert reply["granted"] and node.voted_for == "b"
+
+    def test_leader_barrier_commits_prior_term_entries(self):
+        """Entries replicated but uncommitted under a dead leader commit
+        promptly once the new leader's no-op barrier lands (no client
+        write needed)."""
+        transport, nodes, applied = _mini_cluster()
+        try:
+            leader = _wait_leader(nodes)
+            leader.apply(("compact", (0,), {}))
+            # partition the leader so its next append replicates nowhere
+            transport.partition(leader.id)
+            followers = [n for n in nodes.values() if n is not leader]
+            new_leader = _wait_leader({n.id: n for n in followers})
+            # the new leader commits its barrier without any client write
+            deadline = time.time() + 3
+            while time.time() < deadline:
+                if all(len(applied[f.id]) >= 1 for f in followers):
+                    break
+                time.sleep(0.02)
+            assert new_leader.commit_index >= new_leader.log.last()[0] - 0
+            # and a write through the new leader still works
+            new_leader.apply(("compact", (1,), {}))
+            assert any(c[1] == (1,) for c in applied[new_leader.id])
+        finally:
+            for n in nodes.values():
+                n.stop()
+
+    def test_proposer_stamps_timestamps(self):
+        """Timestamped mutations must carry the proposer's clock inside the
+        replicated command, so a replica replaying the log later applies
+        identical modify_times (ADVICE: GC-cutoff divergence)."""
+        from nomad_tpu.raft.fsm import RaftStore, TIMESTAMPED
+        from nomad_tpu.state.store import StateStore
+
+        captured = {}
+
+        class FakeRaft:
+            def apply(self, cmd):
+                captured["cmd"] = cmd
+                return 1
+
+        rs = RaftStore(StateStore(), FakeRaft())
+        a = mock.alloc()
+        rs.upsert_allocs([a])
+        name, args, kwargs = captured["cmd"]
+        assert name == "upsert_allocs"
+        assert kwargs.get("ts") is not None
+        # replay on two stores -> identical stamps
+        s1, s2 = StateStore(), StateStore()
+        import copy as _copy
+        s1.upsert_allocs(_copy.deepcopy(list(args[0])), **kwargs)
+        time.sleep(0.01)
+        s2.upsert_allocs(_copy.deepcopy(list(args[0])), **kwargs)
+        assert (s1.snapshot().alloc_by_id(a.id).modify_time ==
+                s2.snapshot().alloc_by_id(a.id).modify_time)
+        assert "upsert_plan_results" in TIMESTAMPED
