@@ -34,9 +34,39 @@ def node_mesh(devices: Sequence = None, axis: str = "nodes") -> Mesh:
     return Mesh(devs, (axis,))
 
 
+def pad_node_axis(args: tuple, multiple: int) -> tuple:
+    """Pad the node axis up to a multiple of the mesh size with infeasible
+    dummy rows (available=0, feasible=False, spread_val_ok=False). The
+    solve's argmax can never pick them, so choices stay valid indices into
+    the real rows and scores are untouched — real clusters are rarely
+    divisible by the device count."""
+    n = args[0].shape[0]
+    pad = (-n) % multiple
+    if pad == 0:
+        return args
+    args = list(args)
+
+    def _pad(x, axis, value):
+        x = np.asarray(x)
+        widths = [(0, 0)] * x.ndim
+        widths[axis] = (0, pad)
+        return np.pad(x, widths, constant_values=value)
+
+    args[0] = _pad(args[0], 0, 0)          # available
+    args[1] = _pad(args[1], 0, 0)          # used0
+    args[2] = _pad(args[2], 0, 0)          # placed_tg0
+    args[3] = _pad(args[3], 0, 0)          # placed_job0
+    args[5] = _pad(args[5], 0, False)      # feasible
+    args[6] = _pad(args[6], 0, 0.0)        # affinity_boost
+    args[9] = _pad(args[9], 1, 0)          # spread_val_id
+    args[10] = _pad(args[10], 1, False)    # spread_val_ok
+    return tuple(args)
+
+
 def shard_solve_args(mesh: Mesh, args: tuple, axis: str = "nodes"):
     """Device_put the solve_task_group argument tuple with node-axis rows
-    sharded and everything else replicated.
+    sharded and everything else replicated. Pads the node axis to the
+    mesh size first (see pad_node_axis).
 
     Argument order mirrors kernels.solve_task_group:
       0 available (N,D)   sharded    8 active (K,)          repl
@@ -48,6 +78,7 @@ def shard_solve_args(mesh: Mesh, args: tuple, axis: str = "nodes"):
       6 affinity (N,)     sharded   14 spread_weight (S,)   repl
       7 penalty_idx (K,)  repl      15.. scalars            repl
     """
+    args = pad_node_axis(args, int(np.prod(mesh.devices.shape)))
     specs = [
         P(axis, None), P(axis, None), P(axis), P(axis),
         P(), P(axis), P(axis), P(), P(),

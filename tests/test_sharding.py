@@ -1,0 +1,84 @@
+"""Multi-chip sharding tests on the virtual 8-device CPU mesh
+(conftest forces xla_force_host_platform_device_count=8 — the same
+mechanism the driver uses for the dryrun artifact).
+
+The solve's node axis shards over the mesh; each placement step does a
+global argmax (XLA all-reduce). Sharded and single-device runs must
+agree to the bit on choices and 1e-6 on scores.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+
+import __graft_entry__ as graft
+from nomad_tpu.tensor.sharding import node_mesh, shard_solve_args, solve_task_group_sharded
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+class TestShardedSolve:
+    def test_sharded_vs_single_parity(self, eight_devices):
+        args = graft._example_solve_args(n_nodes=96, k=16, s=2, v=4)
+        mesh8 = node_mesh(eight_devices)
+        mesh1 = node_mesh(eight_devices[:1])
+        c8, f8, s8 = map(np.asarray, solve_task_group_sharded(mesh8, args))
+        c1, f1, s1 = map(np.asarray, solve_task_group_sharded(mesh1, args))
+        assert (c8 == c1).all()
+        assert (f8 == f1).all()
+        np.testing.assert_allclose(s8, s1, atol=1e-6)
+
+    def test_sharded_no_oversubscription(self, eight_devices):
+        args = graft._example_solve_args(n_nodes=64, k=32)
+        mesh = node_mesh(eight_devices)
+        choices, founds, _ = map(np.asarray, solve_task_group_sharded(mesh, args))
+        placed = choices[founds]
+        avail, used, ask = args[0], args[1], args[4]
+        per_node = np.bincount(placed, minlength=avail.shape[0])
+        assert ((used + per_node[:, None] * ask[None, :]) <= avail + 1e-3).all()
+
+    def test_input_shardings_land_on_mesh(self, eight_devices):
+        args = graft._example_solve_args(n_nodes=64)
+        mesh = node_mesh(eight_devices)
+        sharded = shard_solve_args(mesh, args)
+        # the node-axis tensors really live across 8 devices
+        assert len(sharded[0].sharding.device_set) == 8
+        assert len(sharded[4].sharding.device_set) == 8  # replicated ask too
+        shard_rows = {s.data.shape[0] for s in sharded[0].addressable_shards}
+        assert shard_rows == {64 // 8}
+
+    def test_odd_node_count_not_divisible_by_mesh(self, eight_devices):
+        # 100 nodes over 8 devices: XLA pads/handles uneven sharding
+        args = graft._example_solve_args(n_nodes=100, k=8)
+        mesh = node_mesh(eight_devices)
+        c, f, s = map(np.asarray, solve_task_group_sharded(mesh, args))
+        c1, f1, s1 = map(np.asarray,
+                         solve_task_group_sharded(node_mesh(eight_devices[:1]), args))
+        assert (c == c1).all() and (f == f1).all()
+        np.testing.assert_allclose(s, s1, atol=1e-6)
+
+
+class TestDryrunArtifact:
+    def test_dryrun_multichip_in_process(self):
+        # conftest already gives this process 8 CPU devices, so the
+        # subprocess fallback is not taken — the body runs here
+        graft.dryrun_multichip(8)
+
+    def test_dryrun_multichip_subprocess_fallback(self):
+        """The driver's environment has one real chip: dryrun_multichip
+        must succeed by re-execing onto a virtual CPU mesh. Simulate by
+        running a fresh interpreter restricted to 1 device."""
+        code = (
+            "import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "import __graft_entry__ as g; "
+            "assert len(jax.devices()) == 1, jax.devices(); "
+            "g.dryrun_multichip(8); print('fallback ok')"
+        )
+        env = {"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin:/usr/local/bin",
+               "XLA_FLAGS": "", "JAX_PLATFORMS": "cpu"}
+        proc = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                              capture_output=True, text=True, timeout=900)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "fallback ok" in proc.stdout
