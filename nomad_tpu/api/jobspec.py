@@ -243,6 +243,19 @@ def _group_dict(block: dict) -> dict:
                 for t in sp.get("target", [])],
         })
     out["spreads"] = spreads
+    networks = []
+    for nb in block.get("network", []):
+        net = {"mode": nb.get("mode", "host"),
+               "reserved_ports": [], "dynamic_ports": []}
+        for pb in nb.get("port", []):
+            label = pb.get("__label__", pb.get("label", ""))
+            if "static" in pb:
+                net["reserved_ports"].append([label, int(pb["static"])])
+            else:
+                net["dynamic_ports"].append(label)
+        networks.append(net)
+    if networks:
+        out["networks"] = networks
     if "restart" in block:
         rp = block["restart"][0]
         out["restart_policy"] = {

@@ -92,6 +92,16 @@ class EventBroker:
                                         payload))
             self._lock.notify_all()
 
+    def publish(self, topic: str, kind: str, payload) -> None:
+        """Direct publish for non-store events (scheduler sanitizer
+        signals like port collisions — reference server.go:1883
+        listenWorkerEvents)."""
+        with self._lock:
+            self._seq += 1
+            key = payload.get("node_id", "") if isinstance(payload, dict) else ""
+            self._ring.append(Event(self._seq, 0, topic, kind, key, payload))
+            self._lock.notify_all()
+
     def last_seq(self) -> int:
         with self._lock:
             return self._seq

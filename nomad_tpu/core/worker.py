@@ -68,9 +68,12 @@ class Worker:
         try:
             snap = self.server.store.snapshot_min_index(ev.modify_index)
             self._snapshot = snap
-            sched = NewScheduler(ev.type, snap, self,
-                                 sched_config=self.server.sched_config,
-                                 logger=self.server.logger)
+            sched = NewScheduler(
+                ev.type, snap, self,
+                sched_config=self.server.sched_config,
+                logger=self.server.logger,
+                on_event=lambda e: self.server.events.publish(
+                    "Scheduler", e.get("type", "scheduler-event"), e))
             sched.process(ev)
             self.server.broker.ack(ev.id, token)
             self.stats["processed"] += 1

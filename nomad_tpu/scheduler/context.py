@@ -65,7 +65,7 @@ class EvalContext:
     """Reference scheduler/context.go EvalContext."""
 
     def __init__(self, snapshot, plan: Optional[Plan] = None, eval_id: str = "",
-                 logger=None):
+                 logger=None, on_event=None):
         self.snapshot = snapshot
         self.plan = plan
         self.eval_id = eval_id
@@ -74,6 +74,22 @@ class EvalContext:
         self.eligibility = EvalEligibility()
         self.metrics: Optional[AllocMetric] = None
         self.logger = logger
+        # domain-sanitizer sink, e.g. port collisions among committed
+        # allocs (reference context.go:84 PortCollisionEvent via
+        # SendEvent -> Server.listenWorkerEvents); the worker wires this
+        # to the server's event broker
+        self.on_event = on_event
+        self._sent_events: set = set()
+
+    def send_event(self, event: dict) -> None:
+        key = repr(sorted(event.items()))
+        if key in self._sent_events:
+            return  # one emission per distinct event per eval
+        self._sent_events.add(key)
+        if self.logger:
+            self.logger.warning("scheduler event: %s", event)
+        if self.on_event is not None:
+            self.on_event(dict(event, eval_id=self.eval_id))
 
     def new_metrics(self) -> AllocMetric:
         self.metrics = AllocMetric()

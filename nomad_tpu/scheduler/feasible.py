@@ -333,6 +333,59 @@ def device_mask(tg: TaskGroup, nodes: Sequence[Node]) -> np.ndarray:
     return out
 
 
+def network_mask(tg: TaskGroup, nodes: Sequence[Node]) -> np.ndarray:
+    """NetworkChecker (reference feasible.go:373): the requested network
+    mode must be available on the node. "host" mode (and "" = default) is
+    always available; "bridge" requires the bridge fingerprint; "cni/*"
+    modes must be fingerprinted by name."""
+    modes = set()
+    for net in tg.networks:
+        modes.add(net.mode or "host")
+    for t in tg.tasks:
+        for net in t.resources.networks:
+            modes.add(net.mode or "host")
+    modes.discard("host")
+    if not modes:
+        return np.ones(len(nodes), dtype=bool)
+    out = np.empty(len(nodes), dtype=bool)
+    for i, node in enumerate(nodes):
+        have = {n.mode for n in node.resources.networks}
+        ok = True
+        for m in modes:
+            if m in have:
+                continue
+            if m == "bridge" and str(node.attributes.get(
+                    "network.bridge", "")).lower() in ("1", "true"):
+                continue
+            if m.startswith("cni/") and str(node.attributes.get(
+                    f"plugins.cni.version.{m[4:]}", "")):
+                continue
+            ok = False
+            break
+        out[i] = ok
+    return out
+
+
+def reserved_ports_mask(tg: TaskGroup, nodes: Sequence[Node],
+                        proposed_allocs_fn) -> np.ndarray:
+    """Static-port feasibility: every reserved port the group asks for
+    must be free on the node given its proposed allocs (reference does
+    this inside BinPackIterator via NetworkIndex; host-side here so the
+    tensor path can fold it into the feasibility mask)."""
+    asks = tg.combined_resources().reserved_port_asks()
+    if not asks:
+        return np.ones(len(nodes), dtype=bool)
+    from ..structs.network import NetworkIndex
+
+    want = [p for _, p in asks]
+    out = np.empty(len(nodes), dtype=bool)
+    for i, node in enumerate(nodes):
+        idx = NetworkIndex(node)
+        idx.add_allocs(proposed_allocs_fn(node.id))
+        out[i] = not any(p in idx.used for p in want)
+    return out
+
+
 def job_constraints(job: Job, tg: TaskGroup) -> List[Constraint]:
     """Merged constraint set: job-level + group-level + every task's
     (reference stack pushes job then tg constraints through the chain)."""
@@ -352,6 +405,7 @@ def feasible_mask(job: Job, tg: TaskGroup, nodes: Sequence[Node],
     if not mask.any():
         return mask
     mask &= device_mask(tg, nodes)
+    mask &= network_mask(tg, nodes)
     for c in job_constraints(job, tg):
         if not mask.any():
             break

@@ -29,12 +29,13 @@ BLOCKED_EVAL_FAILED_PLACEMENT_DESC = "created to place remaining allocations"
 
 class GenericScheduler:
     def __init__(self, state, planner, *, batch: bool = False,
-                 sched_config=None, logger=None, placer=None):
+                 sched_config=None, logger=None, placer=None, on_event=None):
         self.state = state            # a StateSnapshot-like view
         self.planner = planner
         self.batch = batch
         self.sched_config = sched_config
         self.logger = logger
+        self.on_event = on_event
         algorithm = (sched_config.scheduler_algorithm
                      if sched_config is not None else enums.SCHED_ALG_BINPACK)
         self.placer = placer if placer is not None else placer_for_algorithm(algorithm)
@@ -79,7 +80,8 @@ class GenericScheduler:
         self.followups = []
         job = self.state.job_by_id(ev.job_id, ev.namespace)
         self.plan = ev.make_plan(job)
-        ctx = EvalContext(self.state, self.plan, eval_id=ev.id, logger=self.logger)
+        ctx = EvalContext(self.state, self.plan, eval_id=ev.id, logger=self.logger,
+                          on_event=self.on_event)
         if job is not None:
             ctx.eligibility.set_job(job)
 
@@ -217,6 +219,7 @@ class GenericScheduler:
                 job_version=job.version,
                 task_group=tg.name,
                 allocated_vec=tg.combined_resources().vec(),
+                allocated_ports=list(option.allocated_ports),
                 desired_status=enums.ALLOC_DESIRED_RUN,
                 client_status=enums.ALLOC_CLIENT_PENDING,
                 metrics=ctx.metrics,

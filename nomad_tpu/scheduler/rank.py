@@ -57,6 +57,7 @@ class RankedNode:
     score_meta: Dict[str, float] = field(default_factory=dict)
     final_score: float = 0.0
     preempted_allocs: Optional[List[Allocation]] = None
+    allocated_ports: List = field(default_factory=list)
 
     def add_score(self, name: str, value: float) -> None:
         self.scores.append(value)
@@ -104,6 +105,8 @@ class NodeScorer:
         self.current_priority = current_priority or job.priority
         self.ask = tg.combined_resources()
         self.ask_vec = self.ask.vec()
+        self.wants_ports = bool(
+            self.ask.reserved_port_asks() or self.ask.dynamic_port_count())
         self.affinities = (
             list(job.affinities) + list(tg.affinities)
             + [a for t in tg.tasks for a in t.affinities]
@@ -131,6 +134,15 @@ class NodeScorer:
         check_devices = bool(self.ask.devices)
         fit, dim, used = allocs_fit(node, proposed + [placement], check_devices=check_devices)
         if not fit:
+            if dim.startswith("port collision"):
+                # committed state already double-books a port: sanitizer
+                # signal (reference context.go:84 PortCollisionEvent from
+                # rank.go:226-249)
+                from ..structs.network import check_port_collisions
+
+                self.ctx.send_event({
+                    "type": "port_collision", "node_id": node.id,
+                    "ports": check_port_collisions(node, proposed)})
             if not self.preemption_enabled:
                 if self.ctx.metrics is not None:
                     self.ctx.metrics.exhaust_node(dim)
@@ -152,6 +164,23 @@ class NodeScorer:
                 if self.ctx.metrics is not None:
                     self.ctx.metrics.exhaust_node(dim)
                 return None
+
+        # --- port assignment (reference rank.go:226-249: NetworkIndex
+        # SetAllocs + AssignPorts inside BinPackIterator.Next) ---
+        if self.wants_ports:
+            from ..structs.network import NetworkIndex
+
+            idx = NetworkIndex(node)
+            counted = proposed if option.preempted_allocs is None else [
+                a for a in proposed
+                if a.id not in {v.id for v in option.preempted_allocs}]
+            idx.add_allocs(counted)
+            ports, err = idx.assign_ports(self.ask)
+            if err:
+                if self.ctx.metrics is not None:
+                    self.ctx.metrics.exhaust_node("ports")
+                return None
+            option.allocated_ports = ports
 
         available = node.available_vec()
         if self.algorithm == enums.SCHED_ALG_SPREAD:
@@ -205,7 +234,7 @@ class NodeScorer:
 def _class_feasible(ctx: EvalContext, job: Job, tg: TaskGroup, node: Node) -> bool:
     """Class-memoized job+tg feasibility for one node (reference
     feasible.go:1115 FeasibilityWrapper + context.go EvalEligibility)."""
-    from .feasible import device_mask, driver_mask
+    from .feasible import device_mask, driver_mask, network_mask
 
     klass = node.computed_class
     elig = ctx.eligibility
@@ -228,6 +257,7 @@ def _class_feasible(ctx: EvalContext, job: Job, tg: TaskGroup, node: Node) -> bo
         ok = (
             bool(driver_mask(tg, [node])[0])
             and bool(device_mask(tg, [node])[0])
+            and bool(network_mask(tg, [node])[0])
             and all(
                 node_meets_constraint(c, node, ctx.regex_cache, ctx.version_cache)
                 for c in tg_cons

@@ -39,13 +39,14 @@ class Scheduler(Protocol):
 
 
 def NewScheduler(sched_type: str, state, planner: Planner, *,
-                 sched_config=None, logger=None, placer=None) -> "Scheduler":
+                 sched_config=None, logger=None, placer=None,
+                 on_event=None) -> "Scheduler":
     """Factory (reference scheduler/scheduler.go:36 NewScheduler)."""
     factory = BUILTIN_SCHEDULERS.get(sched_type)
     if factory is None:
         raise ValueError(f"unknown scheduler type {sched_type!r}")
     return factory(state, planner, sched_config=sched_config, logger=logger,
-                   placer=placer)
+                   placer=placer, on_event=on_event)
 
 
 def _make_registry():

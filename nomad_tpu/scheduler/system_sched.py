@@ -20,12 +20,13 @@ from .util import tainted_nodes, update_non_terminal_allocs_to_lost
 
 class SystemScheduler:
     def __init__(self, state, planner, *, sysbatch: bool = False,
-                 sched_config=None, logger=None, placer=None):
+                 sched_config=None, logger=None, placer=None, on_event=None):
         self.state = state
         self.planner = planner
         self.sysbatch = sysbatch
         self.sched_config = sched_config
         self.logger = logger
+        self.on_event = on_event
         self.eval: Optional[Evaluation] = None
         self.plan = None
         self.failed_tg_allocs = {}
@@ -43,7 +44,8 @@ class SystemScheduler:
         self.failed_tg_allocs = {}
         job = self.state.job_by_id(ev.job_id, ev.namespace)
         self.plan = ev.make_plan(job)
-        ctx = EvalContext(self.state, self.plan, eval_id=ev.id, logger=self.logger)
+        ctx = EvalContext(self.state, self.plan, eval_id=ev.id, logger=self.logger,
+                          on_event=self.on_event)
 
         all_allocs = self.state.allocs_by_job(ev.job_id, ev.namespace)
         tainted = tainted_nodes(self.state, all_allocs)
@@ -133,6 +135,7 @@ class SystemScheduler:
                         job_version=job.version,
                         task_group=tg.name,
                         allocated_vec=tg.combined_resources().vec(),
+                        allocated_ports=list(option.allocated_ports),
                         desired_status=enums.ALLOC_DESIRED_RUN,
                         client_status=enums.ALLOC_CLIENT_PENDING,
                         metrics=metrics,

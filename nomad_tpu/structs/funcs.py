@@ -60,20 +60,24 @@ def allocs_fit(node, allocs: Iterable, check_devices: bool = False):
     """Do these allocs fit on the node? -> (fit, failing_dimension, used_vec)
 
     Mirrors reference funcs.go:141 AllocsFit: client-terminal allocs are
-    free; reserved cores must not overlap; used must be a subset of
-    available (total - reserved); optional device oversubscription check.
-    Port-collision checking is a separate concern (a network-index module
-    will own it once port scheduling lands) — not part of this predicate.
-    """
+    free; reserved cores must not overlap; assigned ports must not
+    collide (with each other or the node's agent-reserved ports); used
+    must be a subset of available (total - reserved); optional device
+    oversubscription check. The port check is what lets the serialized
+    plan applier catch two concurrent plans double-booking a port
+    (reference plan_apply.go evaluateNodePlan -> AllocsFit)."""
+    allocs = list(allocs)
     used = np.zeros(RESOURCE_DIMS, dtype=np.float64)
     seen_cores: set = set()
     core_overlap = False
     dev_used: dict = {}
+    any_ports = False
 
     for alloc in allocs:
         if not alloc.should_count_for_usage():
             continue
         used += alloc.allocated_vec
+        any_ports = any_ports or bool(alloc.allocated_ports)
         for core in alloc.allocated_cores:
             if core in seen_cores:
                 core_overlap = True
@@ -84,6 +88,13 @@ def allocs_fit(node, allocs: Iterable, check_devices: bool = False):
 
     if core_overlap:
         return False, "cores", used
+
+    if any_ports:
+        from .network import check_port_collisions
+
+        colliding = check_port_collisions(node, allocs)
+        if colliding:
+            return False, f"port collision {colliding[0]}", used
 
     available = node.available_vec()
     over = used > available

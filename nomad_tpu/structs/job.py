@@ -7,7 +7,7 @@ from typing import Dict, List, Optional
 
 from . import enums
 from .constraint import Affinity, Constraint, Spread
-from .resources import Resources
+from .resources import NetworkResource, Resources
 
 
 @dataclass(slots=True)
@@ -123,7 +123,7 @@ class TaskGroup:
     update: Optional[UpdateStrategy] = None
     migrate: Optional[MigrateStrategy] = None
     ephemeral_disk: EphemeralDisk = field(default_factory=EphemeralDisk)
-    networks: List = field(default_factory=list)
+    networks: List[NetworkResource] = field(default_factory=list)
     services: List[Service] = field(default_factory=list)
     max_client_disconnect_s: Optional[float] = None
     stop_after_client_disconnect_s: Optional[float] = None

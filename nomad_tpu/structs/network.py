@@ -1,0 +1,95 @@
+"""Port accounting and assignment — the NetworkIndex equivalent
+(reference nomad/structs/network.go, 830 LoC NetworkIndex; consumed by
+scheduler/rank.go:226-249 and structs/funcs.go:141 AllocsFit).
+
+Design differences from the reference, TPU-first rationale:
+
+- Exhaustion ("are there enough free dynamic port slots?") is a dense
+  count that lives in the comparable-resources vector (resources.R_PORTS)
+  so the device kernels see it as just another fit dimension — no
+  per-node host loop at solve time.
+- Exact port *numbers* (reserved-port collisions, dynamic assignment)
+  are host-side and only touched for task groups that actually ask for
+  ports: at rank/commit time for the placement's node, and again by the
+  serialized plan applier via allocs_fit, which is what makes concurrent
+  double-bookings a partial-commit reject instead of a client crash.
+- Dynamic assignment is deterministic (lowest free port first) so a
+  replayed plan or a replica applying the same log picks identical
+  ports.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Set, Tuple
+
+from .alloc import AllocatedPort
+
+
+class NetworkIndex:
+    """Used-port view of one node (reference network.go NetworkIndex)."""
+
+    def __init__(self, node):
+        res = node.resources
+        self.min_dyn = res.min_dynamic_port
+        self.max_dyn = res.max_dynamic_port
+        self.used: Set[int] = set(node.reserved.reserved_ports)
+        self.collision = False           # reference: SetAllocs collision flag
+        self.colliding_ports: List[int] = []
+
+    # -- building up usage --
+
+    def add_ports(self, ports: Iterable[int]) -> None:
+        for p in ports:
+            if p in self.used:
+                self.collision = True
+                self.colliding_ports.append(p)
+            self.used.add(p)
+
+    def add_allocs(self, allocs: Sequence) -> None:
+        """Register ports of non-terminal allocs (reference network.go
+        SetAllocs: client-terminal allocs free their ports)."""
+        for a in allocs:
+            if not a.should_count_for_usage():
+                continue
+            self.add_ports(p.value for p in a.allocated_ports)
+
+    # -- assignment (reference network.go AssignPorts) --
+
+    def assign_ports(self, ask) -> Tuple[List[AllocatedPort], str]:
+        """Assign the resource ask's reserved + dynamic ports against this
+        index. Returns (ports, "") on success or ([], reason) on failure;
+        on success the assigned ports are recorded as used."""
+        out: List[AllocatedPort] = []
+        taken: Set[int] = set()
+
+        for label, port in ask.reserved_port_asks():
+            if port in self.used or port in taken:
+                return [], f"reserved port collision {label}={port}"
+            taken.add(port)
+            out.append(AllocatedPort(label=label, value=port))
+
+        for net in ask.networks:
+            for label in net.dynamic_ports:
+                port = self._next_free(taken)
+                if port is None:
+                    return [], "dynamic port selection failed"
+                taken.add(port)
+                out.append(AllocatedPort(label=label, value=port))
+
+        self.used |= taken
+        return out, ""
+
+    def _next_free(self, taken: Set[int]) -> Optional[int]:
+        for p in range(self.min_dyn, self.max_dyn + 1):
+            if p not in self.used and p not in taken:
+                return p
+        return None
+
+
+def check_port_collisions(node, allocs: Sequence) -> List[int]:
+    """Collisions among the given allocs' assigned ports on this node
+    (the AllocsFit port check, reference funcs.go:155-170). Returns the
+    colliding port numbers (empty = fine)."""
+    idx = NetworkIndex(node)
+    idx.add_allocs(allocs)
+    return idx.colliding_ports

@@ -76,8 +76,17 @@ class Node:
 
     def available_vec(self) -> np.ndarray:
         """Total minus agent-reserved resources — the denominator for fit
-        scoring (reference nomad/structs/funcs.go:213 computeFreePercentage)."""
-        return self.resources.vec() - self.reserved.vec()
+        scoring (reference nomad/structs/funcs.go:213 computeFreePercentage).
+
+        The ports dimension is the dynamic-range slot count minus any
+        agent-reserved ports that fall inside the range (a reserved port
+        outside the range costs no slot)."""
+        from .resources import R_PORTS
+
+        v = self.resources.vec() - self.reserved.vec()
+        lo, hi = self.resources.min_dynamic_port, self.resources.max_dynamic_port
+        v[R_PORTS] -= sum(1 for p in self.reserved.reserved_ports if lo <= p <= hi)
+        return v
 
     def compute_class(self) -> str:
         """Hash scheduling-relevant fields into an equivalence class.
@@ -112,6 +121,11 @@ class Node:
         put(repr(self.resources.vec().tolist()), repr(self.reserved.vec().tolist()))
         put(str(self.resources.total_cores),
             str(self.resources.min_dynamic_port), str(self.resources.max_dynamic_port))
+        # fingerprinted network modes are class-relevant: network_mask is
+        # memoized per class, so two nodes differing only in (say) bridge
+        # support must land in different classes
+        for mode in sorted({n.mode for n in self.resources.networks}):
+            put("net", mode)
         for numa in self.resources.numa:
             put(str(numa.id), repr(numa.cores))
         for d in self.resources.devices:

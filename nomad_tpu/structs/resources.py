@@ -18,24 +18,28 @@ import numpy as np
 
 # Dense resource dimensions. Order is load-bearing: tensorization and the
 # JAX kernels index by these constants.
-R_CPU = 0   # MHz of cpu shares
-R_MEM = 1   # MB of memory
-R_DISK = 2  # MB of ephemeral disk
-RESOURCE_DIMS = 3
+R_CPU = 0    # MHz of cpu shares
+R_MEM = 1    # MB of memory
+R_DISK = 2   # MB of ephemeral disk
+R_PORTS = 3  # count of dynamic-range port slots (network.py owns exact
+             # port numbers; this dimension makes exhaustion tensor-visible)
+RESOURCE_DIMS = 4
 
-_DIM_NAMES = ("cpu", "memory", "disk")
+_DIM_NAMES = ("cpu", "memory", "disk", "ports")
 
 
 def dim_name(i: int) -> str:
     return _DIM_NAMES[i]
 
 
-def comparable(cpu: float = 0, memory_mb: float = 0, disk_mb: float = 0) -> np.ndarray:
+def comparable(cpu: float = 0, memory_mb: float = 0, disk_mb: float = 0,
+               ports: float = 0) -> np.ndarray:
     """Build a dense comparable-resources vector."""
     v = np.zeros(RESOURCE_DIMS, dtype=np.float64)
     v[R_CPU] = cpu
     v[R_MEM] = memory_mb
     v[R_DISK] = disk_mb
+    v[R_PORTS] = ports
     return v
 
 
@@ -105,8 +109,18 @@ class Resources:
     devices: List[RequestedDevice] = field(default_factory=list)
     numa_affinity: str = "none"   # none | prefer | require
 
+    def dynamic_port_count(self) -> int:
+        return sum(len(n.dynamic_ports) for n in self.networks)
+
+    def reserved_port_asks(self) -> List[Tuple[str, int]]:
+        out: List[Tuple[str, int]] = []
+        for n in self.networks:
+            out.extend(n.reserved_ports)
+        return out
+
     def vec(self) -> np.ndarray:
-        return comparable(self.cpu, self.memory_mb, self.disk_mb)
+        return comparable(self.cpu, self.memory_mb, self.disk_mb,
+                          self.dynamic_port_count())
 
     def copy(self) -> "Resources":
         return Resources(
@@ -160,5 +174,9 @@ class NodeResources:
     min_dynamic_port: int = 20000
     max_dynamic_port: int = 32000
 
+    def dynamic_port_capacity(self) -> int:
+        return max(0, self.max_dynamic_port - self.min_dynamic_port + 1)
+
     def vec(self) -> np.ndarray:
-        return comparable(self.cpu, self.memory_mb, self.disk_mb)
+        return comparable(self.cpu, self.memory_mb, self.disk_mb,
+                          self.dynamic_port_capacity())

@@ -25,6 +25,7 @@ from ..scheduler.feasible import (
     check_constraint,
     distinct_hosts_flags,
     feasible_mask,
+    reserved_ports_mask,
     resolve_target,
 )
 from ..scheduler.spread import IMPLICIT_TARGET, SpreadInfo, combined_spreads
@@ -223,6 +224,15 @@ def build_task_group_tensors(
     (val_id, val_ok, counts, desired,
      has_targets, weights) = _spread_tensors(ctx, job, tg, nodes, n_pad)
     dh_job, dh_tg = distinct_hosts_flags(job, tg)
+
+    # Reserved ports: conflict-free nodes only, and at most one alloc of
+    # this group per node (the group's second alloc would collide with
+    # the first's static ports) — which is exactly the dh_tg constraint
+    # the kernel already enforces. Dynamic-port exhaustion is the R_PORTS
+    # dimension of ask/available; exact numbers assigned post-solve.
+    if tg.combined_resources().reserved_port_asks():
+        feas[: len(nodes)] &= reserved_ports_mask(tg, nodes, ctx.proposed_allocs)
+        dh_tg = True
 
     return TaskGroupTensors(
         ask=tg.combined_resources().vec(),

@@ -258,12 +258,12 @@ def solve_task_group(
 # one packed output so a whole task-group solve costs one upload batch
 # and one readback.
 #
-# node_mat (N, 10): avail[3] | used[3] | placed_tg | placed_job | feasible | affinity
+# node_mat (N, 2D+4): avail[D] | used[D] | placed_tg | placed_job | feasible | affinity
 # step_mat (K, 2):  penalty_idx | active
 # spread_node (2S, N): val_id rows then val_ok rows
 # spread_tab (2S, V):  counts rows then desired rows
 # spread_meta (S, 2):  has_targets | weight
-# scalars (8,): lowest_boost | tg_count | dh_job | dh_tg | spread_alg | ask[3]
+# scalars (5+D,): lowest_boost | tg_count | dh_job | dh_tg | spread_alg | ask[D]
 
 
 def pack_solve_args(available, used0, placed_tg0, placed_job0, ask, feasible,
@@ -289,8 +289,9 @@ def pack_solve_args(available, used0, placed_tg0, placed_job0, ask, feasible,
     spread_meta = np.stack([np.asarray(spread_has_targets, f),
                             np.asarray(spread_weight, f)], axis=1) \
         if len(spread_weight) else np.zeros((0, 2), f)
-    scalars = np.array([lowest_boost0, tg_count, dh_job, dh_tg, spread_alg,
-                        ask[0], ask[1], ask[2]], f)
+    scalars = np.concatenate([
+        np.array([lowest_boost0, tg_count, dh_job, dh_tg, spread_alg], f),
+        np.asarray(ask, f)])
     return node_mat, step_mat, spread_node, spread_tab, spread_meta, scalars
 
 
@@ -300,10 +301,12 @@ def solve_task_group_fused(node_mat, step_mat, spread_node, spread_tab,
     """Transfer-fused solve: unpack on device, run the same scan, return
     one (3, K) array of [choice, found, score] rows."""
     s = spread_meta.shape[0]
+    d = (node_mat.shape[1] - 4) // 2
     choices, founds, scores = solve_task_group(
-        node_mat[:, 0:3], node_mat[:, 3:6],
-        node_mat[:, 6].astype(jnp.int32), node_mat[:, 7].astype(jnp.int32),
-        scalars[5:8], node_mat[:, 8] > 0.5, node_mat[:, 9],
+        node_mat[:, 0:d], node_mat[:, d:2 * d],
+        node_mat[:, 2 * d].astype(jnp.int32),
+        node_mat[:, 2 * d + 1].astype(jnp.int32),
+        scalars[5:5 + d], node_mat[:, 2 * d + 2] > 0.5, node_mat[:, 2 * d + 3],
         step_mat[:, 0].astype(jnp.int32), step_mat[:, 1] > 0.5,
         spread_node[:s].astype(jnp.int32), spread_node[s:] > 0.5,
         spread_tab[:s].astype(jnp.int32), spread_tab[s:],

@@ -122,17 +122,40 @@ class TPUPlacer:
             founds = out[1] > 0.5
             scores = out[2]
 
+            # exact port numbers are host-side, per node, after the solve
+            # (the kernel only fit-checked the counts); one NetworkIndex
+            # per chosen node carries assignments across this group's
+            # placements so they don't double-book
+            ask_res = tg.combined_resources()
+            wants_ports = bool(ask_res.reserved_port_asks()
+                               or ask_res.dynamic_port_count())
+            net_idx: Dict[int, object] = {}
+
             n_feasible = int(tgt.feasible[: len(nodes)].sum())
             for i, req in enumerate(reqs):
                 metrics = ctx.new_metrics()
                 metrics.nodes_in_pool = len(nodes)
                 metrics.nodes_evaluated = len(nodes)
                 if founds[i]:
-                    node = cluster.nodes[int(choices[i])]
+                    ni = int(choices[i])
+                    node = cluster.nodes[ni]
                     option = RankedNode(node=node)
                     option.final_score = float(scores[i])
                     option.score_meta["normalized-score"] = option.final_score
                     metrics.scores[f"{node.id}.normalized-score"] = option.final_score
+                    if wants_ports:
+                        from ..structs.network import NetworkIndex
+
+                        idx = net_idx.get(ni)
+                        if idx is None:
+                            idx = net_idx[ni] = NetworkIndex(node)
+                            idx.add_allocs(ctx.proposed_allocs(node.id))
+                        ports, err = idx.assign_ports(ask_res)
+                        if err:
+                            metrics.exhaust_node("ports")
+                            commit(req, None)
+                            continue
+                        option.allocated_ports = ports
                     commit(req, option)
                     continue
                 if preemption_enabled:

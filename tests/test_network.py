@@ -1,0 +1,338 @@
+"""Port/network scheduling tests (reference nomad/structs/network.go,
+scheduler/feasible.go:373 NetworkChecker, rank.go:226-249 port fit,
+funcs.go AllocsFit port collisions, plan_apply.go re-verify)."""
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.feasible import network_mask, reserved_ports_mask
+from nomad_tpu.structs import allocs_fit, enums
+from nomad_tpu.structs.network import NetworkIndex, check_port_collisions
+from nomad_tpu.structs.resources import (
+    R_PORTS,
+    NetworkResource,
+    Resources,
+)
+from nomad_tpu.testing import Harness
+from nomad_tpu.utils.ids import generate_uuid
+
+
+def ports_job(static=None, dynamic=(), count=2, **overrides):
+    """A service job whose group asks for ports."""
+    j = mock.job(**overrides)
+    tg = j.task_groups[0]
+    tg.count = count
+    net = NetworkResource(mode="host")
+    if static:
+        net.reserved_ports = [(lbl, p) for lbl, p in static]
+    net.dynamic_ports = list(dynamic)
+    tg.networks = [net]
+    return j
+
+
+class TestNetworkIndex:
+    def test_reserved_collision_with_node_reserved(self):
+        n = mock.node()
+        n.reserved.reserved_ports = [8080]
+        idx = NetworkIndex(n)
+        ask = Resources(networks=[NetworkResource(
+            reserved_ports=[("http", 8080)])])
+        ports, err = idx.assign_ports(ask)
+        assert "collision" in err and not ports
+
+    def test_dynamic_assignment_deterministic(self):
+        n = mock.node()
+        ask = Resources(networks=[NetworkResource(dynamic_ports=["a", "b"])])
+        p1, err1 = NetworkIndex(n).assign_ports(ask)
+        p2, err2 = NetworkIndex(n).assign_ports(ask)
+        assert err1 == err2 == ""
+        assert [p.value for p in p1] == [p.value for p in p2]
+        lo = n.resources.min_dynamic_port
+        assert [p.value for p in p1] == [lo, lo + 1]
+        assert [p.label for p in p1] == ["a", "b"]
+
+    def test_dynamic_skips_used(self):
+        n = mock.node()
+        lo = n.resources.min_dynamic_port
+        idx = NetworkIndex(n)
+        idx.add_ports([lo, lo + 1])
+        ports, err = idx.assign_ports(
+            Resources(networks=[NetworkResource(dynamic_ports=["x"])]))
+        assert err == "" and ports[0].value == lo + 2
+
+    def test_dynamic_exhaustion(self):
+        n = mock.node()
+        n.resources.min_dynamic_port = 20000
+        n.resources.max_dynamic_port = 20001
+        idx = NetworkIndex(n)
+        ask = Resources(networks=[NetworkResource(dynamic_ports=["a", "b", "c"])])
+        ports, err = idx.assign_ports(ask)
+        assert err and not ports
+
+    def test_terminal_allocs_free_ports(self):
+        n = mock.node()
+        a = mock.alloc(n=n)
+        from nomad_tpu.structs.alloc import AllocatedPort
+
+        a.allocated_ports = [AllocatedPort(label="http", value=8080)]
+        a.client_status = enums.ALLOC_CLIENT_COMPLETE
+        assert check_port_collisions(n, [a, a]) == []  # terminal: no conflict
+        a.client_status = enums.ALLOC_CLIENT_RUNNING
+        assert check_port_collisions(n, [a, a]) == [8080]
+
+
+class TestAllocsFitPorts:
+    def test_port_double_booking_fails(self):
+        from nomad_tpu.structs.alloc import AllocatedPort
+
+        n = mock.node()
+        a1, a2 = mock.alloc(n=n), mock.alloc(n=n)
+        for a in (a1, a2):
+            a.allocated_ports = [AllocatedPort(label="http", value=9090)]
+        fit, dim, _ = allocs_fit(n, [a1, a2])
+        assert not fit and "port" in dim
+
+    def test_distinct_ports_fit(self):
+        from nomad_tpu.structs.alloc import AllocatedPort
+
+        n = mock.node()
+        a1, a2 = mock.alloc(n=n), mock.alloc(n=n)
+        a1.allocated_ports = [AllocatedPort(label="http", value=9090)]
+        a2.allocated_ports = [AllocatedPort(label="http", value=9091)]
+        fit, dim, _ = allocs_fit(n, [a1, a2])
+        assert fit, dim
+
+    def test_ports_dimension_exhaustion(self):
+        n = mock.node()
+        n.resources.min_dynamic_port = 20000
+        n.resources.max_dynamic_port = 20004   # 5 slots
+        a = mock.alloc(n=n)
+        a.allocated_vec = Resources(
+            cpu=100, memory_mb=64,
+            networks=[NetworkResource(dynamic_ports=["a"] * 6)]).vec()
+        assert a.allocated_vec[R_PORTS] == 6
+        fit, dim, _ = allocs_fit(n, [a])
+        assert not fit and dim == "ports"
+
+
+class TestFeasibility:
+    def test_network_mode_mask(self):
+        j = ports_job(dynamic=["http"])
+        tg = j.task_groups[0]
+        n_host, n_bridge = mock.node(), mock.node()
+        n_bridge.attributes["network.bridge"] = "true"
+        assert network_mask(tg, [n_host, n_bridge]).tolist() == [True, True]
+        tg.networks[0].mode = "bridge"
+        assert network_mask(tg, [n_host, n_bridge]).tolist() == [False, True]
+
+    def test_reserved_ports_mask(self):
+        j = ports_job(static=[("http", 8080)])
+        tg = j.task_groups[0]
+        n1, n2 = mock.node(), mock.node()
+        n2.reserved.reserved_ports = [8080]
+        mask = reserved_ports_mask(tg, [n1, n2], lambda nid: [])
+        assert mask.tolist() == [True, False]
+
+
+class TestSchedulingWithPorts:
+    def _run(self, h, job):
+        h.store.upsert_job(job)
+        ev = mock.eval_for(job)
+        h.store.upsert_evals([ev])
+        h.process(ev)
+        return ev
+
+    def test_static_port_forces_distinct_nodes(self):
+        h = Harness()
+        nodes = [mock.node() for _ in range(2)]
+        for n in nodes:
+            h.store.upsert_node(n)
+        job = ports_job(static=[("http", 8080)], count=2)
+        self._run(h, job)
+        allocs = list(h.store.snapshot().allocs_by_job(job.id))
+        assert len(allocs) == 2
+        assert len({a.node_id for a in allocs}) == 2
+        for a in allocs:
+            assert [p.value for p in a.allocated_ports] == [8080]
+
+    def test_static_port_one_node_partial(self):
+        h = Harness()
+        h.store.upsert_node(mock.node())
+        job = ports_job(static=[("http", 8080)], count=2)
+        self._run(h, job)
+        allocs = list(h.store.snapshot().allocs_by_job(job.id))
+        assert len(allocs) == 1
+        # the second placement is blocked, not silently dropped
+        assert h.created_evals and \
+            h.created_evals[-1].status == enums.EVAL_STATUS_BLOCKED
+
+    def test_dynamic_ports_unique_per_node(self):
+        h = Harness()
+        node = mock.node()
+        h.store.upsert_node(node)
+        job = ports_job(dynamic=["http", "rpc"], count=4)
+        self._run(h, job)
+        allocs = list(h.store.snapshot().allocs_by_job(job.id))
+        assert len(allocs) == 4
+        values = [p.value for a in allocs for p in a.allocated_ports]
+        assert len(values) == 8 and len(set(values)) == 8
+        lo, hi = node.resources.min_dynamic_port, node.resources.max_dynamic_port
+        assert all(lo <= v <= hi for v in values)
+
+    def test_tpu_placer_parity(self):
+        from nomad_tpu.tensor.placer import TPUPlacer
+
+        h = Harness()
+        node = mock.node()
+        h.store.upsert_node(node)
+        job = ports_job(dynamic=["http"], count=4)
+        h.store.upsert_job(job)
+        ev = mock.eval_for(job)
+        h.store.upsert_evals([ev])
+        h.process(ev, placer=TPUPlacer())
+        allocs = list(h.store.snapshot().allocs_by_job(job.id))
+        assert len(allocs) == 4
+        values = [p.value for a in allocs for p in a.allocated_ports]
+        assert len(set(values)) == 4
+        fit, dim, _ = allocs_fit(node, allocs)
+        assert fit, dim
+
+    def test_tpu_placer_static_ports_distinct_nodes(self):
+        from nomad_tpu.tensor.placer import TPUPlacer
+
+        h = Harness()
+        nodes = [mock.node() for _ in range(3)]
+        for n in nodes:
+            h.store.upsert_node(n)
+        job = ports_job(static=[("http", 8080)], count=3)
+        h.store.upsert_job(job)
+        ev = mock.eval_for(job)
+        h.store.upsert_evals([ev])
+        h.process(ev, placer=TPUPlacer())
+        allocs = list(h.store.snapshot().allocs_by_job(job.id))
+        assert len(allocs) == 3
+        assert len({a.node_id for a in allocs}) == 3
+
+
+class TestPlanApplierCollisions:
+    def test_concurrent_double_booking_rejected(self):
+        """Two plans booking the same static port on the same node: the
+        serialized applier commits the first and partially rejects the
+        second (reference plan_apply.go evaluateNodePlan -> AllocsFit)."""
+        from nomad_tpu.core.plan_apply import PlanApplier
+        from nomad_tpu.state import StateStore
+        from nomad_tpu.structs.alloc import AllocatedPort
+        from nomad_tpu.structs.plan import Plan
+
+        store = StateStore()
+        node = mock.node()
+        store.upsert_node(node)
+        job = ports_job(static=[("http", 8080)], count=1)
+        store.upsert_job(job)
+
+        from nomad_tpu.core.plan_apply import PlanQueue
+
+        applier = PlanApplier(store, PlanQueue())
+
+        def make_plan():
+            a = mock.alloc(j=job, n=node)
+            a.allocated_ports = [AllocatedPort(label="http", value=8080)]
+            p = Plan(eval_id=generate_uuid(), priority=50,
+                     snapshot_index=store.latest_index)
+            p.node_allocation[node.id] = [a]
+            return p
+
+        r1 = applier.apply(make_plan())
+        assert r1.node_allocation and not r1.rejected_nodes
+        r2 = applier.apply(make_plan())
+        assert r2.rejected_nodes == [node.id]
+        assert not r2.node_allocation
+
+
+class TestJobspecNetworks:
+    def test_hcl_network_block_roundtrip(self):
+        """Network blocks must inflate to NetworkResource through the
+        jobspec -> codec path (regression: bare `List` annotation left
+        raw dicts that crashed combined_resources)."""
+        from nomad_tpu.api.codec import from_dict, to_dict
+        from nomad_tpu.api.jobspec import parse_hcl_like
+        from nomad_tpu.structs.job import Job
+
+        spec = '''
+        job "web" {
+          group "api" {
+            count = 2
+            network {
+              port "http" {}
+              port "admin" { static = 9090 }
+            }
+            task "server" {
+              driver = "mock"
+              resources { cpu = 100
+                          memory = 64 }
+            }
+          }
+        }
+        '''
+        job = parse_hcl_like(spec)
+        tg = job.task_groups[0]
+        assert isinstance(tg.networks[0], NetworkResource)
+        res = tg.combined_resources()
+        assert res.dynamic_port_count() == 1
+        assert [(l, p) for l, p in res.reserved_port_asks()] == [("admin", 9090)]
+        # JSON round-trip preserves the network ask
+        job2 = from_dict(Job, to_dict(job))
+        assert isinstance(job2.task_groups[0].networks[0], NetworkResource)
+        assert job2.task_groups[0].combined_resources().dynamic_port_count() == 1
+
+
+class TestClassAndEvents:
+    def test_network_modes_in_computed_class(self):
+        """Nodes differing only in fingerprinted network modes must land
+        in different computed classes, or the memoized network_mask
+        verdict poisons cross-node feasibility."""
+        n1, n2 = mock.node(), mock.node()
+        n1.name = n2.name = "same"
+        n1.attributes = dict(n2.attributes)
+        n1.attributes.pop("unique.hostname", None)
+        n2.attributes.pop("unique.hostname", None)
+        n2.resources.networks = [NetworkResource(mode="bridge")]
+        assert n1.compute_class() != n2.compute_class()
+
+    def test_port_collision_event_reaches_broker(self):
+        """A double-booked port in committed state surfaces as a
+        scheduler event on the server's event broker (reference
+        PortCollisionEvent -> listenWorkerEvents)."""
+        import time as _t
+
+        from nomad_tpu.core import Server, ServerConfig
+        from nomad_tpu.structs.alloc import AllocatedPort
+
+        server = Server(ServerConfig())
+        server.start()
+        try:
+            node = mock.node()
+            server.register_node(node)
+            # force bad committed state: two allocs on one port
+            job = ports_job(static=[("http", 7777)], count=1)
+            server.store.upsert_job(job)
+            bad1, bad2 = mock.alloc(j=job, n=node), mock.alloc(j=job, n=node)
+            for b in (bad1, bad2):
+                b.allocated_ports = [AllocatedPort(label="http", value=7777)]
+            server.store.upsert_allocs([bad1, bad2])
+            sub_cursor = server.events.last_seq()
+            # schedule another ports job onto the node: rank sees the
+            # committed collision and emits the sanitizer event
+            job2 = ports_job(dynamic=["web"], count=1)
+            server.register_job(job2)
+            deadline = _t.time() + 10
+            seen = []
+            while _t.time() < deadline and not seen:
+                evs, _ = server.events.events_after(sub_cursor, timeout=0.5)
+                seen = [e for e in evs if e.type == "port_collision"]
+            assert seen, "no port_collision event published"
+            assert seen[0].payload["ports"] == [7777]
+        finally:
+            server.stop()
