@@ -86,6 +86,28 @@ class ApiClient:
         out, _ = self._request("POST", f"/v1/job/{job_id}/evaluate")
         return out["eval_id"]
 
+    # -- deployments (reference api/deployments.go) --
+
+    def list_deployments(self) -> List[dict]:
+        out, _ = self.get("/v1/deployments")
+        return out
+
+    def deployment(self, dep_id: str) -> dict:
+        out, _ = self.get(f"/v1/deployment/{dep_id}")
+        return out
+
+    def job_deployments(self, job_id: str) -> List[dict]:
+        out, _ = self.get(f"/v1/job/{job_id}/deployments")
+        return out
+
+    def promote_deployment(self, dep_id: str, groups: Optional[List[str]] = None) -> str:
+        body = {"groups": groups} if groups is not None else {}
+        out, _ = self._request("POST", f"/v1/deployment/promote/{dep_id}", body)
+        return out.get("eval_id", "")
+
+    def fail_deployment(self, dep_id: str) -> None:
+        self._request("POST", f"/v1/deployment/fail/{dep_id}", {})
+
     def job_allocations(self, job_id: str) -> List[dict]:
         out, _ = self.get(f"/v1/job/{job_id}/allocations")
         return out

@@ -247,6 +247,17 @@ class HTTPAgent:
         if m := re.fullmatch(r"/v1/job/([^/]+)/deployments", path):
             return h._reply(200, snap.deployments_by_job(m.group(1), ns))
 
+        if path == "/v1/deployments":
+            return h._reply(200, [d for d in snap.deployments()
+                                  if ns_ok(d.namespace)])
+        if m := re.fullmatch(r"/v1/deployment/([^/]+)", path):
+            dep = snap.deployment_by_id(m.group(1))
+            if dep is None:
+                return h._error(404, "deployment not found")
+            if not ns_ok(dep.namespace):
+                return h._error(403, "Permission denied")
+            return h._reply(200, dep)
+
         if path == "/v1/nodes":
             return h._reply(200, [self._node_stub(n) for n in snap.nodes()])
         if m := re.fullmatch(r"/v1/node/([^/]+)", path):
@@ -318,6 +329,9 @@ class HTTPAgent:
         elif path.startswith("/v1/var"):
             if not self._ns_allowed(acl, ns, aclp.CAP_VARIABLES_WRITE):
                 return h._error(403, "Permission denied")
+        elif path.startswith("/v1/deployment"):
+            if not self._ns_allowed(acl, ns, aclp.CAP_SUBMIT_JOB):
+                return h._error(403, "Permission denied")
         elif path.startswith("/v1/acl") and path != "/v1/acl/bootstrap":
             if acl is not None and not acl.management:
                 return h._error(403, "Permission denied")
@@ -375,6 +389,23 @@ class HTTPAgent:
             self.server.sched_config = cfg
             self.server.config.sched_config = cfg
             return h._reply(200, {"updated": True})
+        if m := re.fullmatch(r"/v1/deployment/promote/([^/]+)", path):
+            try:
+                eval_id = self.server.promote_deployment(
+                    m.group(1), groups=body.get("groups"))
+            except KeyError as e:
+                return h._error(404, str(e))
+            except ValueError as e:
+                return h._error(400, str(e))
+            return h._reply(200, {"eval_id": eval_id})
+        if m := re.fullmatch(r"/v1/deployment/fail/([^/]+)", path):
+            try:
+                self.server.fail_deployment(m.group(1))
+            except KeyError as e:
+                return h._error(404, str(e))
+            except ValueError as e:
+                return h._error(400, str(e))
+            return h._reply(200, {"ok": True})
         h._error(404, f"no such route {path}")
 
     def _route_delete(self, h, path: str, q: dict, acl=None) -> None:

@@ -25,6 +25,29 @@ from ..structs.evaluation import Evaluation
 from ..utils import generate_uuid
 
 
+def alloc_healthy(alloc, job, now: float) -> bool:
+    """Server-side health aggregation for one alloc (reference
+    client/allochealth + deployment_watcher health rules): every task
+    running for the group's min_healthy_time. An explicit
+    deployment_status healthy verdict from the client wins."""
+    ds = alloc.deployment_status
+    if isinstance(ds, dict) and ds.get("healthy") is not None:
+        return bool(ds.get("healthy"))
+    if alloc.client_status != enums.ALLOC_CLIENT_RUNNING:
+        return False
+    tg = job.lookup_task_group(alloc.task_group)
+    min_healthy = (tg.update.min_healthy_time_s
+                   if tg is not None and tg.update is not None else 10.0)
+    if not alloc.task_states:
+        return False
+    for st in alloc.task_states.values():
+        if st.state != "running" or not st.started_at:
+            return False
+        if now - st.started_at < min_healthy:
+            return False
+    return True
+
+
 class DeploymentWatcher:
     def __init__(self, server, interval: float = 0.2):
         self.server = server
@@ -33,7 +56,8 @@ class DeploymentWatcher:
         self._thread = None
         # deployment id -> healthy count at last follow-up eval
         self._progress: Dict[str, int] = {}
-        self.stats = {"succeeded": 0, "failed": 0, "reverted": 0}
+        self.stats = {"succeeded": 0, "failed": 0, "reverted": 0,
+                      "auto_promoted": 0}
 
     def start(self) -> None:
         self._stop.clear()
@@ -83,6 +107,22 @@ class DeploymentWatcher:
             deadline = min((s.require_progress_by
                             for s in dep.task_groups.values()
                             if s.require_progress_by), default=0.0)
+
+            # canary promotion gate (reference deployment_watcher.go:416
+            # autoPromoteDeployment): rollout pauses until every canary
+            # group has desired healthy canaries and is promoted
+            if dep.requires_promotion():
+                if dep.has_auto_promote() and self._canaries_healthy(
+                        dep, job, allocs, now):
+                    try:
+                        self.server.promote_deployment(dep.id)
+                        self.stats["auto_promoted"] += 1
+                    except (ValueError, PermissionError):
+                        pass
+                if deadline and now > deadline:
+                    self._fail(snap, dep, job, "progress deadline exceeded")
+                continue
+
             desired = sum(s.desired_total for s in dep.task_groups.values())
             if healthy >= desired and len(allocs) >= desired:
                 upd = _copy.copy(dep)
@@ -122,17 +162,17 @@ class DeploymentWatcher:
                     self._create_eval(job)
 
     def _alloc_healthy(self, alloc, job, now: float) -> bool:
-        if alloc.client_status != enums.ALLOC_CLIENT_RUNNING:
-            return False
-        tg = job.lookup_task_group(alloc.task_group)
-        min_healthy = (tg.update.min_healthy_time_s
-                       if tg is not None and tg.update is not None else 10.0)
-        if not alloc.task_states:
-            return False
-        for st in alloc.task_states.values():
-            if st.state != "running" or not st.started_at:
-                return False
-            if now - st.started_at < min_healthy:
+        return alloc_healthy(alloc, job, now)
+
+    def _canaries_healthy(self, dep, job, allocs, now: float) -> bool:
+        for name, state in dep.task_groups.items():
+            if state.desired_canaries <= 0 or state.promoted:
+                continue
+            healthy = sum(
+                1 for a in allocs
+                if a.task_group == name and a.canary
+                and alloc_healthy(a, job, now))
+            if healthy < state.desired_canaries:
                 return False
         return True
 

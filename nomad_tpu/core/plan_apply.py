@@ -201,27 +201,29 @@ class PlanApplier:
 
     def _node_plan_valid(self, snap, plan: Plan, node_id: str) -> bool:
         node = snap.node_by_id(node_id)
-        placements = plan.node_allocation.get(node_id, [])
+        all_allocation = plan.node_allocation.get(node_id, [])
+        existing = snap.allocs_by_node_terminal(node_id, False)
+        existing_ids = {a.id for a in existing}
+        # node_allocation carries both NEW placements and updates to
+        # existing allocs (unknown-marking, follow-up annotations); only
+        # new placements require a ready node — updates must land even on
+        # down/disconnected/draining nodes (plan_apply.go:789-812)
+        placements = [a for a in all_allocation if a.id not in existing_ids]
         if node is None:
-            # stops/preemptions against a vanished node are fine; new
-            # placements are not
+            # stops/preemptions/updates against a vanished node are fine;
+            # new placements are not
             return not placements
-        # placements are only valid on ready, non-draining nodes;
-        # evictions are always allowed (plan_apply.go:789-812 validity
-        # gates). A node that started draining after the scheduler's
-        # snapshot must not receive the stale placement.
         if placements and (node.status != enums.NODE_STATUS_READY or node.drain):
             return False
         if not placements:
             return True
 
-        existing = snap.allocs_by_node_terminal(node_id, False)
         removed = {a.id for a in plan.node_update.get(node_id, ())}
         removed |= {a.id for a in plan.node_preemptions.get(node_id, ())}
         proposed = [a for a in existing if a.id not in removed]
-        placed_ids = {a.id for a in placements}
-        proposed = [a for a in proposed if a.id not in placed_ids]
-        proposed.extend(placements)
+        updated_ids = {a.id for a in all_allocation}
+        proposed = [a for a in proposed if a.id not in updated_ids]
+        proposed.extend(all_allocation)
 
         check_devices = any(a.allocated_devices for a in proposed)
         fit, _, _ = allocs_fit(node, proposed, check_devices=check_devices)

@@ -279,7 +279,7 @@ class Server:
 
     def update_node_status(self, node_id: str, status: str) -> None:
         self.store.update_node_status(node_id, status, ts=time.time())
-        if status == enums.NODE_STATUS_DOWN:
+        if status in (enums.NODE_STATUS_DOWN, enums.NODE_STATUS_DISCONNECTED):
             self.heartbeats.remove(node_id)
             self._create_node_evals(node_id)
         elif status == enums.NODE_STATUS_READY:
@@ -287,8 +287,22 @@ class Server:
             self._create_node_evals(node_id)
 
     def mark_node_down(self, node_id: str, reason: str = "") -> None:
+        """Missed-TTL handler. If any alloc on the node tolerates client
+        disconnects (max_client_disconnect), the node goes `disconnected`
+        — its allocs turn unknown rather than lost — otherwise `down`
+        (reference node_endpoint.go disconnect handling)."""
         try:
-            self.update_node_status(node_id, enums.NODE_STATUS_DOWN)
+            status = enums.NODE_STATUS_DOWN
+            snap = self.store.snapshot()
+            for alloc in snap.allocs_by_node(node_id):
+                if alloc.terminal_status():
+                    continue
+                job = snap.job_by_id(alloc.job_id, alloc.namespace)
+                tg = job.lookup_task_group(alloc.task_group) if job else None
+                if tg is not None and tg.max_client_disconnect_s is not None:
+                    status = enums.NODE_STATUS_DISCONNECTED
+                    break
+            self.update_node_status(node_id, status)
         except KeyError:
             # node was deleted while its TTL timer was in flight
             self.heartbeats.remove(node_id)
@@ -380,6 +394,77 @@ class Server:
             for ev in evals:
                 ev.modify_index = index
             self.broker.enqueue_all(evals)
+
+    # -- Deployment endpoints (nomad/deployment_endpoint.go) --
+
+    def promote_deployment(self, dep_id: str, groups: Optional[List[str]] = None) -> str:
+        """Deployment.Promote: requires every (selected) canary group to
+        have >= desired healthy canaries; flips promoted so the next eval
+        rolls the remaining old-version allocs
+        (reference deployment_endpoint.go Promote +
+        deploymentwatcher PromoteDeployment)."""
+        import copy as _copy
+
+        from .deployments import alloc_healthy
+
+        snap = self.store.snapshot()
+        dep = snap.deployment_by_id(dep_id)
+        if dep is None:
+            raise KeyError(f"deployment {dep_id} not found")
+        if not dep.active():
+            raise ValueError(f"deployment {dep_id} is {dep.status}, not promotable")
+        if not dep.requires_promotion():
+            raise ValueError(f"deployment {dep_id} has no canaries awaiting promotion")
+        job = snap.job_by_id(dep.job_id, dep.namespace)
+        if job is None:
+            raise ValueError(f"job {dep.job_id} not found")
+        allocs = [a for a in snap.allocs_by_job(dep.job_id, dep.namespace)
+                  if a.deployment_id == dep.id]
+        now = time.time()
+        upd = _copy.deepcopy(dep)
+        for name, state in upd.task_groups.items():
+            if state.desired_canaries <= 0 or state.promoted:
+                continue
+            if groups is not None and name not in groups:
+                continue
+            healthy = sum(1 for a in allocs
+                          if a.task_group == name and a.canary
+                          and alloc_healthy(a, job, now))
+            if healthy < state.desired_canaries:
+                raise ValueError(
+                    f"group {name!r} has {healthy}/{state.desired_canaries} "
+                    "healthy canaries; promotion refused")
+            state.promoted = True
+        upd.status_description = "Deployment is promoted"
+        self.store.upsert_deployment(upd)
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=job.namespace,
+            priority=dep.eval_priority,
+            type=job.type,
+            triggered_by=enums.TRIGGER_DEPLOYMENT_WATCHER,
+            job_id=job.id,
+            deployment_id=dep.id,
+            status=enums.EVAL_STATUS_PENDING,
+            create_time=time.time(),
+        )
+        return self.create_eval(ev)
+
+    def fail_deployment(self, dep_id: str) -> None:
+        """Deployment.Fail: operator-forced failure (auto-revert still
+        applies via the watcher's failed handling)."""
+        import copy as _copy
+
+        snap = self.store.snapshot()
+        dep = snap.deployment_by_id(dep_id)
+        if dep is None:
+            raise KeyError(f"deployment {dep_id} not found")
+        if not dep.active():
+            raise ValueError(f"deployment {dep_id} is already {dep.status}")
+        upd = _copy.copy(dep)
+        upd.status = enums.DEPLOYMENT_STATUS_FAILED
+        upd.status_description = "Deployment marked as failed by operator"
+        self.store.upsert_deployment(upd)
 
     # -- Eval endpoints --
 

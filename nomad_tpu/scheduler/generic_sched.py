@@ -89,9 +89,12 @@ class GenericScheduler:
         tainted = tainted_nodes(self.state, all_allocs)
         update_non_terminal_allocs_to_lost(self.plan, tainted, all_allocs)
 
+        latest_dep = (self.state.latest_deployment_by_job(ev.job_id, ev.namespace)
+                      if not self.batch else None)
         reconciler = AllocReconciler(
             job if (job is not None and not job.stopped()) else None,
-            ev.job_id, all_allocs, tainted, batch=self.batch, eval_id=ev.id)
+            ev.job_id, all_allocs, tainted, batch=self.batch, eval_id=ev.id,
+            deployment=latest_dep)
         results = reconciler.compute()
 
         # deployments track service-job rollouts (reference reconcile.go
@@ -151,6 +154,15 @@ class GenericScheduler:
                     upd = orig.copy_for_update()
                     upd.follow_up_eval_id = feval_id
                     self.plan.node_allocation.setdefault(upd.node_id, []).append(upd)
+            # disconnecting allocs go client=unknown in the plan, tagged
+            # with their max-disconnect-timeout eval (reference
+            # plan AppendUnknownAlloc; reconcile.go disconnect updates)
+            for alloc in g.disconnecting:
+                upd = alloc.copy_for_update()
+                upd.client_status = enums.ALLOC_CLIENT_UNKNOWN
+                upd.client_description = "client disconnected"
+                upd.follow_up_eval_id = g.disconnect_updates.get(alloc.id, "")
+                self.plan.node_allocation.setdefault(upd.node_id, []).append(upd)
 
         # build placement request list (destructive updates also re-place)
         requests: List[PlacementRequest] = []
@@ -225,6 +237,17 @@ class GenericScheduler:
                 metrics=ctx.metrics,
                 allocated_at=now,
             )
+            if req.canary:
+                alloc.canary = True
+                if self.deployment is not None:
+                    # record the placement on a plan-local deployment copy
+                    # (the store row is shared MVCC state)
+                    if self.plan.deployment is not self.deployment:
+                        self.deployment = _copy.deepcopy(self.deployment)
+                        self.plan.deployment = self.deployment
+                    ds = self.deployment.task_groups.get(tg.name)
+                    if ds is not None:
+                        ds.placed_canaries = list(ds.placed_canaries) + [alloc.id]
             if req.previous_alloc is not None:
                 prev = req.previous_alloc
                 alloc.previous_allocation = prev.id

@@ -41,6 +41,14 @@ class GroupResult:
     inplace_update: List[Allocation] = field(default_factory=list)
     migrate: List[Allocation] = field(default_factory=list)
     lost: List[Allocation] = field(default_factory=list)
+    # allocs on a freshly-disconnected node within their group's
+    # max_client_disconnect window: the plan marks them client=unknown
+    # and a follow-up eval fires at window expiry
+    # (reference reconcile.go computeGroup disconnecting set)
+    disconnecting: List[Allocation] = field(default_factory=list)
+    # unknown allocs whose node is back: reconciled keep-or-replace
+    # (reference reconcile.go:1157 reconcileReconnecting)
+    reconnecting: List[Allocation] = field(default_factory=list)
     ignore: int = 0
     # failed allocs whose reschedule policy is exhausted/disabled: they
     # still occupy their slot (the group runs degraded, not crash-looping)
@@ -48,6 +56,8 @@ class GroupResult:
     followup_evals: List[Evaluation] = field(default_factory=list)
     # rescheduled-later allocs -> their followup eval id
     delayed_reschedule: Dict[str, str] = field(default_factory=dict)
+    # disconnecting alloc ids -> their max-disconnect-timeout eval id
+    disconnect_updates: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -110,7 +120,8 @@ class AllocReconciler:
 
     def __init__(self, job: Optional[Job], job_id: str, existing: List[Allocation],
                  tainted: Dict[str, Node], *, batch: bool = False,
-                 now: Optional[float] = None, eval_id: str = ""):
+                 now: Optional[float] = None, eval_id: str = "",
+                 deployment=None):
         self.job = job
         self.job_id = job_id
         self.existing = existing
@@ -118,6 +129,12 @@ class AllocReconciler:
         self.batch = batch
         self.now = now if now is not None else _time.time()
         self.eval_id = eval_id
+        # the active deployment for this job version, if any — canary
+        # accounting reads desired_canaries/promoted from it
+        self.deployment = deployment
+        if (deployment is not None and self.job is not None
+                and deployment.job_version != self.job.version):
+            self.deployment = None
 
     def compute(self) -> ReconcileResults:
         results = ReconcileResults()
@@ -161,11 +178,15 @@ class AllocReconciler:
         # partition current allocs (reference reconcile_util.go filterByTainted)
         live: List[Allocation] = []          # running/pending on healthy nodes
         batch_done = 0                       # completed batch allocs: work is done
+        expired_unknown: List[Allocation] = []  # unknown past the window
         for a in allocs:
             if a.server_terminal():
                 continue  # already being stopped
             node = self.tainted.get(a.node_id)
             if node is not None:
+                if node.status == enums.NODE_STATUS_DISCONNECTED:
+                    self._handle_disconnected(tg, a, node, g, expired_unknown)
+                    continue
                 if node.status == enums.NODE_STATUS_DOWN:
                     if not a.client_terminal():
                         g.lost.append(a)
@@ -184,6 +205,11 @@ class AllocReconciler:
                         continue
                     live.append(a)
                     continue
+            if a.client_status == enums.ALLOC_CLIENT_UNKNOWN:
+                # node is healthy again: the client reconnected while this
+                # alloc was written off (reference reconcileReconnecting)
+                g.reconnecting.append(a)
+                continue
             if a.client_status == enums.ALLOC_CLIENT_FAILED:
                 self._handle_failed(tg, a, g)
                 continue
@@ -197,6 +223,62 @@ class AllocReconciler:
                 # replacement is placed below by the count math
                 continue
             live.append(a)
+
+        # expired unknowns become lost; their replacement was placed when
+        # they disconnected, so no new placement request here
+        for a in expired_unknown:
+            g.stop.append((a, "alloc lost: client disconnection exceeded "
+                           "max_client_disconnect", enums.ALLOC_CLIENT_LOST))
+
+        # reconnect reconciliation: keep the reconnected alloc and stop its
+        # replacement when the job version still matches; a reconnected
+        # alloc of an old version loses to its replacement
+        # (reference scheduler/reconnecting_picker: original-first default)
+        live = self._reconcile_reconnecting(tg, g, live)
+
+        # canary gate (reference reconcile.go:434 computeGroup): while an
+        # unpromoted deployment wants canaries, old-version allocs hold
+        # steady and only canary placements happen
+        canary_target = tg.update.canary if tg.update is not None else 0
+        dstate = (self.deployment.task_groups.get(tg.name)
+                  if self.deployment is not None else None)
+        promoted = bool(dstate.promoted) if dstate is not None else False
+        canaries = []
+        if self.job is not None and canary_target:
+            canaries = [a for a in live
+                        if a.canary and a.job_version == self.job.version]
+        updated_old = ([a for a in live if a.job_version != self.job.version]
+                       if self.job is not None else [])
+
+        dep_halted = (self.deployment is not None
+                      and not self.deployment.active()
+                      and self.deployment.status
+                      != enums.DEPLOYMENT_STATUS_SUCCESSFUL)
+
+        if canary_target and updated_old and (not promoted or dep_halted):
+            # canaries are surplus: they never enter the count math
+            live = [a for a in live if a.id not in {c.id for c in canaries}]
+            g.ignore += len(canaries) + len(updated_old)
+            if not dep_halted:
+                # a failed/cancelled deployment stops the rollout cold
+                # (reference: deploymentFailed gates placements); only a
+                # live unpromoted one keeps asking for canaries
+                name_index = AllocNameIndex(
+                    self.job_id, tg.name, desired,
+                    in_use=[a for a in allocs if not a.terminal_status()])
+                for name in name_index.next_batch(
+                        max(0, canary_target - len(canaries))):
+                    g.place.append(PlacementRequest(
+                        name=name, task_group=tg, canary=True))
+            # migrations/lost still need replacements even mid-canary
+            for a in g.migrate:
+                g.stop.append((a, "alloc is being migrated", ""))
+                g.place.append(PlacementRequest(
+                    name=a.name, task_group=tg, previous_alloc=a))
+            for a in g.lost:
+                g.place.append(PlacementRequest(
+                    name=a.name, task_group=tg, previous_alloc=a))
+            return g
 
         # destructive updates: job version changed (reference: in-place vs
         # destructive via tasksUpdated; spec diffing lands with deployments,
@@ -243,14 +325,81 @@ class AllocReconciler:
             g.place.append(PlacementRequest(
                 name=a.name, task_group=tg, previous_alloc=a))
 
-        # net new placements to reach desired count
+        # net new placements to reach desired count (disconnecting allocs
+        # already queued their replacements in _handle_disconnected)
         have = (len(keep) + len(g.migrate) + len(g.lost)
                 + len(g.destructive_update) + batch_done
-                + g.failed_no_reschedule)
+                + g.failed_no_reschedule + len(g.disconnecting))
         missing = max(0, desired - have - self._pending_reschedules(g))
         for name in name_index.next_batch(missing):
             g.place.append(PlacementRequest(name=name, task_group=tg))
         return g
+
+    def _handle_disconnected(self, tg: TaskGroup, a: Allocation, node: Node,
+                             g: GroupResult,
+                             expired_unknown: List[Allocation]) -> None:
+        """An alloc on a disconnected node: within max_client_disconnect it
+        goes unknown (with a replacement and an expiry follow-up eval);
+        without the stanza, or past the window, it is lost
+        (reference reconcile.go computeGroup disconnecting/lost split)."""
+        if a.client_terminal():
+            return
+        window = tg.max_client_disconnect_s
+        disconnect_time = node.status_updated_at or self.now
+        expired = window is None or self.now >= disconnect_time + window
+        if a.client_status == enums.ALLOC_CLIENT_UNKNOWN:
+            if expired:
+                expired_unknown.append(a)
+            # else: already unknown, follow-up eval pending; nothing to do
+            return
+        if expired:
+            # lost: replacement + count via g.lost, but the lost marking
+            # must ride g.stop — update_non_terminal_allocs_to_lost only
+            # covers DOWN nodes, not DISCONNECTED ones
+            g.lost.append(a)
+            g.stop.append((a, "alloc lost: client disconnection exceeded "
+                           "max_client_disconnect", enums.ALLOC_CLIENT_LOST))
+            return
+        g.disconnecting.append(a)
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=a.namespace,
+            priority=self.job.priority if self.job else 50,
+            type=self.job.type if self.job else enums.JOB_TYPE_SERVICE,
+            triggered_by=enums.TRIGGER_MAX_DISCONNECT_TIMEOUT,
+            job_id=self.job_id,
+            status=enums.EVAL_STATUS_PENDING,
+            wait_until=disconnect_time + window,
+        )
+        g.followup_evals.append(ev)
+        g.disconnect_updates[a.id] = ev.id
+        # replacement keeps the workload running while the client is gone
+        g.place.append(PlacementRequest(
+            name=a.name, task_group=tg, previous_alloc=a,
+            ignore_node=a.node_id))
+
+    def _reconcile_reconnecting(self, tg: TaskGroup, g: GroupResult,
+                                live: List[Allocation]) -> List[Allocation]:
+        """Pick keep-or-replace for each reconnected (unknown on a healthy
+        node) alloc; winners join `live`. Original wins when its job
+        version is current; its replacement (same name, younger) stops.
+        (reference reconcile.go:1157 + reconnecting_picker)"""
+        if not g.reconnecting:
+            return live
+        out = list(live)
+        for a in g.reconnecting:
+            current = self.job is not None and a.job_version == self.job.version
+            if not current:
+                g.stop.append((a, "reconnecting alloc is outdated", ""))
+                continue
+            replacements = [x for x in out
+                            if x.name == a.name and x.id != a.id]
+            for r in replacements:
+                g.stop.append(
+                    (r, "replacement no longer needed: alloc reconnected", ""))
+            out = [x for x in out if x.id not in {r.id for r in replacements}]
+            out.append(a)
+        return out
 
     def _pending_reschedules(self, g: GroupResult) -> int:
         """Replacements already queued via the failed-alloc path."""
