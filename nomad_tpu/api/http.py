@@ -330,7 +330,16 @@ class HTTPAgent:
             if not self._ns_allowed(acl, ns, aclp.CAP_VARIABLES_WRITE):
                 return h._error(403, "Permission denied")
         elif path.startswith("/v1/deployment"):
-            if not self._ns_allowed(acl, ns, aclp.CAP_SUBMIT_JOB):
+            # Authorize against the deployment's OWN namespace, not the
+            # query param — otherwise submit-job in any one namespace
+            # grants promote/fail everywhere (ref deployment_endpoint.go:134).
+            if m := re.fullmatch(r"/v1/deployment/(?:promote|fail)/([^/]+)", path):
+                dep = self.server.store.snapshot().deployment_by_id(m.group(1))
+                if dep is None:
+                    return h._error(404, "deployment not found")
+                if not self._ns_allowed(acl, dep.namespace, aclp.CAP_SUBMIT_JOB):
+                    return h._error(403, "Permission denied")
+            elif not self._ns_allowed(acl, ns, aclp.CAP_SUBMIT_JOB):
                 return h._error(403, "Permission denied")
         elif path.startswith("/v1/acl") and path != "/v1/acl/bootstrap":
             if acl is not None and not acl.management:

@@ -202,8 +202,12 @@ class PlanApplier:
     def _node_plan_valid(self, snap, plan: Plan, node_id: str) -> bool:
         node = snap.node_by_id(node_id)
         all_allocation = plan.node_allocation.get(node_id, [])
-        existing = snap.allocs_by_node_terminal(node_id, False)
-        existing_ids = {a.id for a in existing}
+        # classify placement-vs-update by id-existence on the node including
+        # client-terminal allocs: a follow_up_eval_id annotation on a failed
+        # alloc is an update, not a new placement
+        all_node = snap.allocs_by_node(node_id)
+        existing = [a for a in all_node if not a.terminal_status()]
+        existing_ids = {a.id for a in all_node}
         # node_allocation carries both NEW placements and updates to
         # existing allocs (unknown-marking, follow-up annotations); only
         # new placements require a ready node — updates must land even on

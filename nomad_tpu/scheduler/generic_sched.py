@@ -124,10 +124,19 @@ class GenericScheduler:
                 for tg in job.task_groups:
                     if tg.update is None:
                         continue
+                    # canaries only apply to UPDATE rollouts: an initial
+                    # (no prior-version allocs) deployment must not demand
+                    # canaries, or the reconciler's canary hold would fire
+                    # on every later eval of a stable fresh job (reference
+                    # reconcile.go sets DesiredCanaries via requireCanary)
+                    has_old = any(a.task_group == tg.name
+                                  and not a.terminal_status()
+                                  and a.job_version != job.version
+                                  for a in all_allocs)
                     dep.task_groups[tg.name] = DeploymentState(
                         auto_revert=tg.update.auto_revert,
                         auto_promote=tg.update.auto_promote,
-                        desired_canaries=tg.update.canary,
+                        desired_canaries=tg.update.canary if has_old else 0,
                         desired_total=tg.count,
                         progress_deadline_s=tg.update.progress_deadline_s,
                         require_progress_by=now0 + tg.update.progress_deadline_s,

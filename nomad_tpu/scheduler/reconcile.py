@@ -255,7 +255,14 @@ class AllocReconciler:
                       and self.deployment.status
                       != enums.DEPLOYMENT_STATUS_SUCCESSFUL)
 
-        if canary_target and updated_old and (not promoted or dep_halted):
+        # the hold must key off the deployment state, not just live
+        # old-version allocs: if every old alloc vanished mid-canary (node
+        # death + GC) the unpromoted deployment still caps placements at
+        # canary_target (reference reconcile.go deploymentPlaceReady)
+        wants_canaries = (canary_target > 0 and dstate is not None
+                          and dstate.desired_canaries > 0 and not promoted)
+        if canary_target and (updated_old or wants_canaries) \
+                and (not promoted or dep_halted):
             # canaries are surplus: they never enter the count math
             live = [a for a in live if a.id not in {c.id for c in canaries}]
             g.ignore += len(canaries) + len(updated_old)

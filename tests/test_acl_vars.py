@@ -273,3 +273,36 @@ class TestAdviceRegressions:
         assert dev_evs
         got, _ = dev.get(f"/v1/evaluation/{dev_evs[0]['id']}")
         assert got["id"] == dev_evs[0]["id"]
+
+    def test_deployment_promote_authorizes_deployment_namespace(self, acl_stack):
+        """Round-3 ADVICE high: promote/fail must authorize CAP_SUBMIT_JOB
+        against the deployment's OWN namespace — a token with submit-job
+        only in "default" must not promote a deployment living in "secret"
+        by pointing ?namespace= at its own grant
+        (reference deployment_endpoint.go:134/181)."""
+        from nomad_tpu.structs.deployment import Deployment, DeploymentState
+        from nomad_tpu.utils import generate_uuid
+
+        server, agent, boot = acl_stack
+        mgmt = ApiClient(address=agent.address, token=boot.secret_id)
+        mgmt.upsert_acl_policy("defsubmit", {
+            "namespace": {"default": {"capabilities": ["submit-job"]}}})
+        tok = mgmt.create_acl_token("d", ["defsubmit"])
+
+        dep = Deployment(
+            id=generate_uuid(), namespace="secret", job_id="secret-job",
+            task_groups={"web": DeploymentState(desired_canaries=1,
+                                                desired_total=3)})
+        server.store.upsert_deployment(dep)
+
+        attacker = ApiClient(address=agent.address, token=tok["secret_id"])
+        with pytest.raises(ApiError) as err:
+            attacker._request("POST",
+                              f"/v1/deployment/promote/{dep.id}?namespace=default",
+                              {"all": True})
+        assert err.value.status == 403
+        with pytest.raises(ApiError) as err:
+            attacker._request("POST",
+                              f"/v1/deployment/fail/{dep.id}?namespace=default",
+                              {})
+        assert err.value.status == 403
