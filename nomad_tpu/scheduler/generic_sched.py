@@ -124,19 +124,20 @@ class GenericScheduler:
                 for tg in job.task_groups:
                     if tg.update is None:
                         continue
-                    # canaries only apply to UPDATE rollouts: an initial
-                    # (no prior-version allocs) deployment must not demand
-                    # canaries, or the reconciler's canary hold would fire
-                    # on every later eval of a stable fresh job (reference
-                    # reconcile.go sets DesiredCanaries via requireCanary)
-                    has_old = any(a.task_group == tg.name
-                                  and not a.terminal_status()
-                                  and a.job_version != job.version
-                                  for a in all_allocs)
+                    # canaries only apply to UPDATE rollouts: the deployment
+                    # demands canaries iff the reconciler actually asked for
+                    # canary placements this eval. Initial versions and
+                    # rollouts whose old allocs are all lost (replaced
+                    # outright) must not, or the canary hold would fire on
+                    # every later eval and stall a fully-placed rollout
+                    # (reference reconcile.go requireCanary)
+                    tg_result = results.groups.get(tg.name)
+                    wants_canaries = (tg_result is not None
+                                      and any(p.canary for p in tg_result.place))
                     dep.task_groups[tg.name] = DeploymentState(
                         auto_revert=tg.update.auto_revert,
                         auto_promote=tg.update.auto_promote,
-                        desired_canaries=tg.update.canary if has_old else 0,
+                        desired_canaries=tg.update.canary if wants_canaries else 0,
                         desired_total=tg.count,
                         progress_deadline_s=tg.update.progress_deadline_s,
                         require_progress_by=now0 + tg.update.progress_deadline_s,

@@ -1,0 +1,353 @@
+"""Deployment watcher + promotion endpoint tests through the real Server
+(reference nomad/deploymentwatcher/deployments_watcher_test.go and
+deployment_endpoint.go suites — the round-2 paths that shipped untested).
+"""
+
+import copy
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core import Server, ServerConfig
+from nomad_tpu.structs import enums
+from nomad_tpu.structs.job import UpdateStrategy
+
+
+def wait_until(pred, timeout=10.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out = pred()
+        if out:
+            return out
+        time.sleep(interval)
+    return pred()
+
+
+def live_allocs(s, job_id):
+    return [a for a in s.store.snapshot().allocs_by_job(job_id)
+            if not a.terminal_status() and not a.server_terminal()]
+
+
+def mark_healthy(s, alloc):
+    """Client reports the alloc running + deployment-healthy."""
+    upd = alloc.copy_for_update()
+    upd.client_status = enums.ALLOC_CLIENT_RUNNING
+    upd.deployment_status = {"healthy": True}
+    s.update_allocs_from_client([upd])
+
+
+def mark_failed(s, alloc):
+    upd = alloc.copy_for_update()
+    upd.client_status = enums.ALLOC_CLIENT_FAILED
+    upd.task_finished_at = time.time()
+    s.update_allocs_from_client([upd])
+
+
+@pytest.fixture
+def s():
+    server = Server(ServerConfig())
+    server.deployment_watcher.interval = 0.05
+    server.start()
+    for _ in range(8):
+        server.register_node(mock.node())
+    yield server
+    server.stop()
+
+
+def start_job(s, count=3, canary=0, max_parallel=1, auto_promote=False,
+              auto_revert=False, progress_deadline=600.0):
+    job = mock.job()
+    job.task_groups[0].count = count
+    job.task_groups[0].update = UpdateStrategy(
+        canary=canary, max_parallel=max_parallel, auto_promote=auto_promote,
+        auto_revert=auto_revert, progress_deadline_s=progress_deadline,
+        min_healthy_time_s=0.0)
+    s.register_job(job)
+    assert s.wait_for_idle(10.0)
+    allocs = wait_until(lambda: (lambda xs: xs if len(xs) == count else None)(
+        live_allocs(s, job.id)))
+    assert allocs and len(allocs) == count
+    for a in allocs:
+        mark_healthy(s, a)
+    return s.store.snapshot().job_by_id(job.id)
+
+
+def bump(s, job, canary=1, max_parallel=1, auto_promote=False,
+         auto_revert=False, progress_deadline=600.0):
+    j2 = copy.deepcopy(job)
+    j2.task_groups[0].tasks[0].config = {"command": "/bin/new"}
+    j2.task_groups[0].update = UpdateStrategy(
+        canary=canary, max_parallel=max_parallel, auto_promote=auto_promote,
+        auto_revert=auto_revert, progress_deadline_s=progress_deadline,
+        min_healthy_time_s=0.0)
+    s.register_job(j2)
+    assert s.wait_for_idle(10.0)
+    return s.store.snapshot().job_by_id(job.id)
+
+
+def active_deployment(s, job):
+    dep = s.store.snapshot().latest_deployment_by_job(job.id, job.namespace)
+    assert dep is not None and dep.job_version == job.version
+    return dep
+
+
+class TestPromotionEndpoint:
+    def test_promotion_refused_with_unhealthy_canary(self, s):
+        job = start_job(s, count=3, canary=1)
+        job = bump(s, job, canary=1)
+        dep = active_deployment(s, job)
+        # canary placed but never reported healthy
+        canaries = wait_until(
+            lambda: [a for a in live_allocs(s, job.id) if a.canary])
+        assert len(canaries) == 1
+        with pytest.raises(ValueError, match="healthy canaries"):
+            s.promote_deployment(dep.id)
+        dep = s.store.snapshot().deployment_by_id(dep.id)
+        assert not dep.task_groups["web"].promoted
+
+    def test_manual_promote_rolls_out(self, s):
+        job = start_job(s, count=3, canary=1)
+        job = bump(s, job, canary=1)
+        dep = active_deployment(s, job)
+        canaries = wait_until(
+            lambda: [a for a in live_allocs(s, job.id) if a.canary])
+        mark_healthy(s, canaries[0])
+        s.promote_deployment(dep.id)
+        assert s.store.snapshot().deployment_by_id(dep.id).task_groups["web"].promoted
+
+        # keep marking fresh allocs healthy so the rollout advances
+        def done():
+            allocs = live_allocs(s, job.id)
+            for a in allocs:
+                if (a.job_version == job.version
+                        and a.client_status == enums.ALLOC_CLIENT_PENDING):
+                    mark_healthy(s, a)
+            return (len(allocs) == 3
+                    and all(a.job_version == job.version for a in allocs))
+        assert wait_until(done, timeout=15.0)
+        dep = wait_until(lambda: (lambda d: d if not d.active() else None)(
+            s.store.snapshot().deployment_by_id(dep.id)), timeout=15.0)
+        assert dep.status == enums.DEPLOYMENT_STATUS_SUCCESSFUL
+
+    def test_promote_unknown_deployment_raises(self, s):
+        with pytest.raises(KeyError):
+            s.promote_deployment("nope")
+
+    def test_promote_without_canaries_raises(self, s):
+        job = start_job(s, count=2, canary=0)
+        dep = s.store.snapshot().latest_deployment_by_job(job.id, job.namespace)
+        with pytest.raises(ValueError, match="no canaries"):
+            s.promote_deployment(dep.id)
+
+    def test_promote_terminal_deployment_raises(self, s):
+        job = start_job(s, count=2, canary=0)
+        dep = wait_until(lambda: (lambda d: d if not d.active() else None)(
+            s.store.snapshot().latest_deployment_by_job(job.id, job.namespace)))
+        assert dep.status == enums.DEPLOYMENT_STATUS_SUCCESSFUL
+        with pytest.raises(ValueError, match="not promotable"):
+            s.promote_deployment(dep.id)
+
+    def test_group_scoped_promote_skips_other_groups(self, s):
+        job = start_job(s, count=2, canary=1)
+        job = bump(s, job, canary=1)
+        dep = active_deployment(s, job)
+        canaries = wait_until(
+            lambda: [a for a in live_allocs(s, job.id) if a.canary])
+        mark_healthy(s, canaries[0])
+        # promote a non-matching group selection: web stays unpromoted
+        s.promote_deployment(dep.id, groups=["other"])
+        assert not (s.store.snapshot().deployment_by_id(dep.id)
+                    .task_groups["web"].promoted)
+
+    def test_operator_fail_deployment(self, s):
+        job = start_job(s, count=2, canary=1)
+        job = bump(s, job, canary=1)
+        dep = active_deployment(s, job)
+        s.fail_deployment(dep.id)
+        got = s.store.snapshot().deployment_by_id(dep.id)
+        assert got.status == enums.DEPLOYMENT_STATUS_FAILED
+        with pytest.raises(ValueError):
+            s.fail_deployment(dep.id)  # already terminal
+
+
+class TestWatcher:
+    def test_initial_deployment_succeeds_when_healthy(self, s):
+        job = start_job(s, count=3)
+        dep = wait_until(lambda: (lambda d: d if not d.active() else None)(
+            s.store.snapshot().latest_deployment_by_job(job.id, job.namespace)))
+        assert dep.status == enums.DEPLOYMENT_STATUS_SUCCESSFUL
+
+    def test_auto_promote_when_canaries_healthy(self, s):
+        job = start_job(s, count=3, canary=1, auto_promote=True)
+        job = bump(s, job, canary=1, auto_promote=True)
+        dep = active_deployment(s, job)
+        canaries = wait_until(
+            lambda: [a for a in live_allocs(s, job.id) if a.canary])
+        mark_healthy(s, canaries[0])
+        got = wait_until(lambda: (lambda d: d if d.task_groups["web"].promoted
+                                  else None)(
+            s.store.snapshot().deployment_by_id(dep.id)), timeout=10.0)
+        assert got, "watcher should auto-promote once canaries are healthy"
+        assert s.deployment_watcher.stats["auto_promoted"] >= 1
+
+    def test_failed_alloc_fails_deployment(self, s):
+        job = start_job(s, count=2, canary=1)
+        job = bump(s, job, canary=1)
+        dep = active_deployment(s, job)
+        canaries = wait_until(
+            lambda: [a for a in live_allocs(s, job.id) if a.canary])
+        mark_failed(s, canaries[0])
+        got = wait_until(lambda: (lambda d: d if not d.active() else None)(
+            s.store.snapshot().deployment_by_id(dep.id)), timeout=10.0)
+        assert got.status == enums.DEPLOYMENT_STATUS_FAILED
+
+    def test_auto_revert_restores_prior_version(self, s):
+        job = start_job(s, count=2, canary=1, auto_revert=True)
+        v0 = job.version
+        job = bump(s, job, canary=1, auto_revert=True)
+        dep = active_deployment(s, job)
+        canaries = wait_until(
+            lambda: [a for a in live_allocs(s, job.id) if a.canary])
+        mark_failed(s, canaries[0])
+        wait_until(lambda: not s.store.snapshot()
+                   .deployment_by_id(dep.id).active(), timeout=10.0)
+        # the reverted job is a NEW version carrying the v0 spec
+        reverted = wait_until(lambda: (lambda j: j if j.version > job.version
+                                       else None)(
+            s.store.snapshot().job_by_id(job.id)), timeout=10.0)
+        assert reverted, "auto-revert should submit a new job version"
+        assert (reverted.task_groups[0].tasks[0].config
+                == {"command": "/bin/date"}), "reverted spec = v0 spec"
+        assert s.deployment_watcher.stats["reverted"] >= 1
+        _ = v0
+
+    def test_progress_deadline_fails_deployment(self, s):
+        job = start_job(s, count=2, canary=1)
+        job = bump(s, job, canary=1, progress_deadline=0.2)
+        dep = active_deployment(s, job)
+        # canary never becomes healthy; the deadline trips
+        got = wait_until(lambda: (lambda d: d if not d.active() else None)(
+            s.store.snapshot().deployment_by_id(dep.id)), timeout=10.0)
+        assert got.status == enums.DEPLOYMENT_STATUS_FAILED
+        assert "deadline" in got.status_description
+
+    def test_superseded_deployment_cancelled(self, s):
+        job = start_job(s, count=2, canary=1)
+        job = bump(s, job, canary=1)
+        dep1 = active_deployment(s, job)
+        job = bump(s, job, canary=1)  # another version on top
+        got = wait_until(lambda: (lambda d: d if not d.active() else None)(
+            s.store.snapshot().deployment_by_id(dep1.id)), timeout=10.0)
+        assert got.status == enums.DEPLOYMENT_STATUS_CANCELLED
+
+
+class TestDisconnectE2E:
+    """SURVEY §5 failure detection: disconnect -> unknown -> replacement ->
+    reconnect, end to end through heartbeats, broker, worker, applier."""
+
+    def test_disconnect_unknown_replace_reconnect(self):
+        with Server(ServerConfig(heartbeat_ttl=0.3)) as s:
+            n1, n2 = mock.node(), mock.node()
+            s.register_node(n1)
+            s.register_node(n2)
+            job = mock.job()
+            job.task_groups[0].count = 2
+            job.task_groups[0].max_client_disconnect_s = 30.0
+            s.register_job(job)
+            assert s.wait_for_idle(10.0)
+            victims = wait_until(
+                lambda: s.store.snapshot().allocs_by_node(n1.id))
+            assert victims, "expected at least one alloc on n1"
+
+            # n1 stops heartbeating; n2 stays alive
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                s.heartbeat(n2.id)
+                node = s.store.snapshot().node_by_id(n1.id)
+                if node.status == enums.NODE_STATUS_DISCONNECTED:
+                    break
+                time.sleep(0.05)
+            assert (s.store.snapshot().node_by_id(n1.id).status
+                    == enums.NODE_STATUS_DISCONNECTED), \
+                "max_client_disconnect must yield disconnected, not down"
+
+            def unknown_and_replaced():
+                snap = s.store.snapshot()
+                vs = [snap.alloc_by_id(v.id) for v in victims]
+                if not all(v.client_status == enums.ALLOC_CLIENT_UNKNOWN
+                           for v in vs):
+                    return False
+                repl = [a for a in snap.allocs_by_job(job.id)
+                        if a.previous_allocation in {v.id for v in victims}
+                        and not a.terminal_status()]
+                return len(repl) == len(victims)
+            assert wait_until(unknown_and_replaced, timeout=10.0), \
+                "allocs should go unknown with replacements placed"
+            # the expiry follow-up eval is parked in the delay heap
+            assert s.broker.delayed_count() >= 1
+
+            # client returns: re-register + heartbeat + alloc sync
+            s.update_node_status(n1.id, enums.NODE_STATUS_READY)
+            snap = s.store.snapshot()
+            for v in victims:
+                got = snap.alloc_by_id(v.id)
+                upd = got.copy_for_update()
+                upd.client_status = enums.ALLOC_CLIENT_RUNNING
+                s.update_allocs_from_client([upd])
+            s.wait_for_idle(10.0, include_delayed=False)
+
+            def settled():
+                snap = s.store.snapshot()
+                vs = [snap.alloc_by_id(v.id) for v in victims]
+                if not all(v.desired_status == enums.ALLOC_DESIRED_RUN
+                           for v in vs):
+                    return False
+                live = [a for a in snap.allocs_by_job(job.id)
+                        if not a.terminal_status() and not a.server_terminal()]
+                return len(live) == 2 and {v.id for v in victims} <= {
+                    a.id for a in live}
+            assert wait_until(settled, timeout=10.0), \
+                "reconnected originals win; replacements stop"
+
+    def test_expiry_without_reconnect_goes_lost(self):
+        with Server(ServerConfig(heartbeat_ttl=0.3)) as s:
+            n1, n2 = mock.node(), mock.node()
+            s.register_node(n1)
+            s.register_node(n2)
+            job = mock.job()
+            job.task_groups[0].count = 1
+            job.task_groups[0].max_client_disconnect_s = 1.0
+            s.register_job(job)
+            assert s.wait_for_idle(10.0)
+            victims = wait_until(
+                lambda: [a for a in s.store.snapshot().allocs_by_job(job.id)])
+            victim = victims[0]
+
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                s.heartbeat(n2.id)
+                if (s.store.snapshot().node_by_id(n1.id).status
+                        != enums.NODE_STATUS_READY):
+                    break
+                time.sleep(0.05)
+
+            if victim.node_id == n2.id:
+                # alloc landed on the surviving node; nothing to verify
+                return
+
+            # window (1s) expires with no reconnect: unknown -> lost via the
+            # delayed follow-up eval
+            def lost():
+                got = s.store.snapshot().alloc_by_id(victim.id)
+                return got.client_status == enums.ALLOC_CLIENT_LOST
+            while not lost() and time.time() < deadline + 10:
+                s.heartbeat(n2.id)
+                time.sleep(0.05)
+            got = s.store.snapshot().alloc_by_id(victim.id)
+            assert got.client_status == enums.ALLOC_CLIENT_LOST
+            assert got.desired_status == enums.ALLOC_DESIRED_STOP
+            live = [a for a in s.store.snapshot().allocs_by_job(job.id)
+                    if not a.terminal_status()]
+            assert len(live) == 1
+            assert live[0].node_id == n2.id

@@ -1,0 +1,505 @@
+"""Scenario tables for canary rollouts and disconnect/reconnect
+reconciliation (modeled on reference reconcile_test.go:434,1157 tables and
+deploymentwatcher suites — the round-2 semantics that shipped untested).
+
+All harness-level: real state store + real scheduler, fake planner.
+"""
+
+import copy
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.testing import Harness
+from nomad_tpu.structs import enums
+from nomad_tpu.structs.job import UpdateStrategy
+
+
+@pytest.fixture
+def h():
+    return Harness()
+
+
+def live_allocs(h, job_id):
+    return [a for a in h.snapshot().allocs_by_job(job_id)
+            if not a.terminal_status() and not a.server_terminal()
+            and a.client_status != enums.ALLOC_CLIENT_UNKNOWN]
+
+
+def unknown_allocs(h, job_id):
+    return [a for a in h.snapshot().allocs_by_job(job_id)
+            if a.client_status == enums.ALLOC_CLIENT_UNKNOWN
+            and not a.server_terminal()]
+
+
+def erase_alloc(h, alloc):
+    """Server-terminate an alloc out-of-band (simulates loss + GC)."""
+    gone = alloc.copy_for_update()
+    gone.desired_status = enums.ALLOC_DESIRED_STOP
+    gone.client_status = enums.ALLOC_CLIENT_LOST
+    h.store.upsert_plan_results([gone])
+
+
+def setup_job(h, count=3, n_nodes=6, canary=0, max_parallel=1,
+              max_client_disconnect=None):
+    """Register nodes + a v0 service job and run the initial eval."""
+    nodes = [mock.node() for _ in range(n_nodes)]
+    for n in nodes:
+        h.store.upsert_node(n)
+    job = mock.job()
+    job.task_groups[0].count = count
+    job.task_groups[0].update = UpdateStrategy(
+        canary=canary, max_parallel=max_parallel)
+    job.task_groups[0].max_client_disconnect_s = max_client_disconnect
+    h.store.upsert_job(job)
+    job = h.snapshot().job_by_id(job.id)
+    h.process(mock.eval_for(job))
+    assert len(live_allocs(h, job.id)) == count
+    return nodes, job
+
+
+def bump_version(h, job, canary=None, max_parallel=1):
+    """Submit an updated spec (new version) for the same job."""
+    j2 = copy.deepcopy(job)
+    j2.task_groups[0].tasks[0].config = {"command": "/bin/new"}
+    if canary is not None:
+        j2.task_groups[0].update = UpdateStrategy(
+            canary=canary, max_parallel=max_parallel)
+    h.store.upsert_job(j2)
+    return h.snapshot().job_by_id(job.id)
+
+
+def promote(h, job):
+    """Flip every canary group to promoted (harness stand-in for the
+    server's Deployment.Promote endpoint)."""
+    dep = h.store.snapshot().latest_deployment_by_job(job.id, job.namespace)
+    upd = copy.deepcopy(dep)
+    for s in upd.task_groups.values():
+        s.promoted = True
+    h.store.upsert_deployment(upd)
+    return upd
+
+
+def run_until_stable(h, job, max_evals=20):
+    """Re-eval until a no-op eval (rolling updates advance one
+    max_parallel batch per eval; the deployment watcher drives this
+    server-side, the harness drives it by hand)."""
+    for _ in range(max_evals):
+        before = h.store.latest_index
+        h.process(mock.eval_for(job, triggered_by=enums.TRIGGER_DEPLOYMENT_WATCHER))
+        if h.store.latest_index == before:
+            return
+    raise AssertionError(f"no fixpoint after {max_evals} evals")
+
+
+# ---------------------------------------------------------------------------
+# canary placement counts (reference reconcile_test.go canary tables)
+# ---------------------------------------------------------------------------
+
+
+class TestCanaryPlacement:
+    @pytest.mark.parametrize("count,canary", [(3, 1), (5, 2), (10, 3), (2, 2)])
+    def test_version_bump_places_exactly_n_canaries(self, h, count, canary):
+        nodes, job = setup_job(h, count=count, canary=canary)
+        job = bump_version(h, job, canary=canary)
+        h.process(mock.eval_for(job))
+        allocs = live_allocs(h, job.id)
+        canaries = [a for a in allocs if a.canary]
+        old = [a for a in allocs if a.job_version != job.version]
+        assert len(canaries) == canary
+        assert len(old) == count, "old-version allocs must hold during canary"
+        assert all(a.job_version == job.version for a in canaries)
+        assert all(a.deployment_id for a in canaries)
+
+    @pytest.mark.parametrize("extra_evals", [1, 3])
+    def test_repeat_evals_do_not_add_canaries(self, h, extra_evals):
+        nodes, job = setup_job(h, count=3, canary=1)
+        job = bump_version(h, job, canary=1)
+        h.process(mock.eval_for(job))
+        for _ in range(extra_evals):
+            h.process(mock.eval_for(
+                job, triggered_by=enums.TRIGGER_DEPLOYMENT_WATCHER))
+        allocs = live_allocs(h, job.id)
+        assert sum(1 for a in allocs if a.canary) == 1
+        assert len(allocs) == 4  # 3 old + 1 canary, stable
+
+    def test_initial_version_places_no_canaries(self, h):
+        """A job's FIRST version never uses canaries even with a canary
+        stanza (canaries gate updates, not initial placement)."""
+        nodes, job = setup_job(h, count=3, canary=2)
+        allocs = live_allocs(h, job.id)
+        assert len(allocs) == 3
+        assert not any(a.canary for a in allocs)
+        # follow-up evals stay stable (round-3 review regression)
+        for _ in range(2):
+            h.process(mock.eval_for(
+                job, triggered_by=enums.TRIGGER_DEPLOYMENT_WATCHER))
+        assert len(live_allocs(h, job.id)) == 3
+
+    def test_deployment_records_desired_canaries(self, h):
+        nodes, job = setup_job(h, count=3, canary=1)
+        job = bump_version(h, job, canary=1)
+        h.process(mock.eval_for(job))
+        dep = h.snapshot().latest_deployment_by_job(job.id, job.namespace)
+        assert dep.job_version == job.version
+        ds = dep.task_groups["web"]
+        assert ds.desired_canaries == 1
+        assert ds.desired_total == 3
+        assert not ds.promoted
+        assert len(ds.placed_canaries) == 1
+        canary_ids = {a.id for a in live_allocs(h, job.id) if a.canary}
+        assert set(ds.placed_canaries) == canary_ids
+
+    def test_canary_zero_rolls_destructively(self, h):
+        nodes, job = setup_job(h, count=3, canary=0, max_parallel=1)
+        job = bump_version(h, job, canary=0, max_parallel=1)
+        run_until_stable(h, job)
+        allocs = live_allocs(h, job.id)
+        assert len(allocs) == 3
+        assert all(a.job_version == job.version for a in allocs)
+        assert not any(a.canary for a in allocs)
+
+    def test_canary_hold_survives_losing_all_old_allocs(self, h):
+        """ADVICE low: if every old-version alloc is gone mid-canary the
+        unpromoted deployment still caps new-version placements."""
+        nodes, job = setup_job(h, count=3, canary=1)
+        job = bump_version(h, job, canary=1)
+        h.process(mock.eval_for(job))
+        # erase the old allocs entirely (simulates GC after node death)
+        for a in list(live_allocs(h, job.id)):
+            if a.job_version != job.version:
+                erase_alloc(h, a)
+        h.process(mock.eval_for(
+            job, triggered_by=enums.TRIGGER_DEPLOYMENT_WATCHER))
+        allocs = live_allocs(h, job.id)
+        # only the canary — NOT the full count at the new version
+        assert sum(1 for a in allocs if a.job_version == job.version) == 1
+
+    def test_all_old_on_down_nodes_skips_canaries(self, h):
+        """Version bump while every old alloc sits on a down node: the
+        lost allocs are replaced outright at the new version — the
+        deployment must not demand canaries it never placed, or a
+        surplus canary appears and the rollout stalls unpromoted."""
+        nodes, job = setup_job(h, count=3, canary=1, n_nodes=8)
+        for node_id in {a.node_id for a in live_allocs(h, job.id)}:
+            h.store.update_node_status(node_id, enums.NODE_STATUS_DOWN)
+        job = bump_version(h, job, canary=1)
+        h.process(mock.eval_for(job))
+        h.process(mock.eval_for(
+            job, triggered_by=enums.TRIGGER_DEPLOYMENT_WATCHER))
+        allocs = live_allocs(h, job.id)
+        assert len(allocs) == 3, "no surplus canary above desired_total"
+        assert all(a.job_version == job.version for a in allocs)
+        dep = h.snapshot().latest_deployment_by_job(job.id, job.namespace)
+        assert dep.task_groups["web"].desired_canaries == 0
+
+    def test_lost_old_alloc_replaced_during_canary(self, h):
+        """Node death mid-canary: the lost old alloc gets a replacement
+        (reference: lost allocs place even when deployment not place-ready)."""
+        nodes, job = setup_job(h, count=3, canary=1)
+        job = bump_version(h, job, canary=1)
+        h.process(mock.eval_for(job))
+        victim = next(a for a in live_allocs(h, job.id)
+                      if a.job_version != job.version)
+        h.store.update_node_status(victim.node_id, enums.NODE_STATUS_DOWN)
+        h.process(mock.eval_for(job, triggered_by=enums.TRIGGER_NODE_UPDATE))
+        allocs = live_allocs(h, job.id)
+        assert sum(1 for a in allocs if a.canary) == 1
+        assert len(allocs) == 4  # 2 old survivors + 1 replacement + 1 canary
+
+
+# ---------------------------------------------------------------------------
+# promotion / halt / revert at the reconciler boundary
+# ---------------------------------------------------------------------------
+
+
+class TestPromotionRollout:
+    @pytest.mark.parametrize("count,canary,max_parallel",
+                             [(3, 1, 1), (5, 2, 2), (4, 1, 3)])
+    def test_promotion_completes_rollout(self, h, count, canary, max_parallel):
+        nodes, job = setup_job(h, count=count, canary=canary,
+                               max_parallel=max_parallel, n_nodes=10)
+        job = bump_version(h, job, canary=canary, max_parallel=max_parallel)
+        h.process(mock.eval_for(job))
+        promote(h, job)
+        run_until_stable(h, job)
+        allocs = live_allocs(h, job.id)
+        assert len(allocs) == count
+        assert all(a.job_version == job.version for a in allocs)
+
+    def test_unpromoted_never_rolls_old(self, h):
+        nodes, job = setup_job(h, count=3, canary=1)
+        job = bump_version(h, job, canary=1)
+        h.process(mock.eval_for(job))
+        old_ids = {a.id for a in live_allocs(h, job.id)
+                   if a.job_version != job.version}
+        for _ in range(5):
+            h.process(mock.eval_for(
+                job, triggered_by=enums.TRIGGER_DEPLOYMENT_WATCHER))
+        still = {a.id for a in live_allocs(h, job.id)}
+        assert old_ids <= still, "old allocs must survive until promotion"
+
+    def test_max_parallel_paces_post_promotion_rollout(self, h):
+        nodes, job = setup_job(h, count=4, canary=1, max_parallel=1, n_nodes=10)
+        job = bump_version(h, job, canary=1, max_parallel=1)
+        h.process(mock.eval_for(job))
+        promote(h, job)
+        # one eval advances at most max_parallel destructive updates
+        h.process(mock.eval_for(
+            job, triggered_by=enums.TRIGGER_DEPLOYMENT_WATCHER))
+        old_after_one = [a for a in live_allocs(h, job.id)
+                         if a.job_version != job.version]
+        assert len(old_after_one) >= 2, \
+            "max_parallel=1 must not replace more than one old alloc per eval"
+
+    def test_failed_deployment_halts_canary_placement(self, h):
+        nodes, job = setup_job(h, count=3, canary=2)
+        job = bump_version(h, job, canary=2)
+        h.process(mock.eval_for(job))
+        dep = h.snapshot().latest_deployment_by_job(job.id, job.namespace)
+        upd = copy.deepcopy(dep)
+        upd.status = enums.DEPLOYMENT_STATUS_FAILED
+        h.store.upsert_deployment(upd)
+        # kill one canary: a halted deployment must NOT replace it
+        canary_allocs = [a for a in live_allocs(h, job.id) if a.canary]
+        erase_alloc(h, canary_allocs[0])
+        h.process(mock.eval_for(
+            job, triggered_by=enums.TRIGGER_DEPLOYMENT_WATCHER))
+        allocs = live_allocs(h, job.id)
+        assert sum(1 for a in allocs if a.canary) == 1, \
+            "failed deployment must stop placing canaries"
+        assert sum(1 for a in allocs if a.job_version != job.version) == 3
+
+    def test_old_allocs_hold_while_deployment_failed(self, h):
+        nodes, job = setup_job(h, count=3, canary=1)
+        job = bump_version(h, job, canary=1)
+        h.process(mock.eval_for(job))
+        dep = h.snapshot().latest_deployment_by_job(job.id, job.namespace)
+        upd = copy.deepcopy(dep)
+        upd.status = enums.DEPLOYMENT_STATUS_FAILED
+        h.store.upsert_deployment(upd)
+        for _ in range(3):
+            h.process(mock.eval_for(
+                job, triggered_by=enums.TRIGGER_DEPLOYMENT_WATCHER))
+        old = [a for a in live_allocs(h, job.id)
+               if a.job_version != job.version]
+        assert len(old) == 3
+
+
+# ---------------------------------------------------------------------------
+# disconnect -> unknown -> replacement -> expiry / reconnect
+# (reference reconcile.go disconnecting/reconnecting sets + reconnecting_picker)
+# ---------------------------------------------------------------------------
+
+
+WINDOW = 60.0
+
+
+class TestDisconnect:
+    def _disconnect(self, h, job, node_id, ts=None):
+        h.store.update_node_status(
+            node_id, enums.NODE_STATUS_DISCONNECTED,
+            ts=ts if ts is not None else time.time())
+        h.process(mock.eval_for(job, triggered_by=enums.TRIGGER_NODE_UPDATE))
+
+    def test_within_window_goes_unknown_with_replacement(self, h):
+        nodes, job = setup_job(h, count=2, max_client_disconnect=WINDOW)
+        victim = live_allocs(h, job.id)[0]
+        t0 = time.time()
+        self._disconnect(h, job, victim.node_id, ts=t0)
+        snap = h.snapshot()
+        got = snap.alloc_by_id(victim.id)
+        assert got.client_status == enums.ALLOC_CLIENT_UNKNOWN
+        assert got.desired_status == enums.ALLOC_DESIRED_RUN, \
+            "unknown allocs are not stopped server-side"
+        repl = [a for a in live_allocs(h, job.id)
+                if a.previous_allocation == victim.id]
+        assert len(repl) == 1
+        assert repl[0].node_id != victim.node_id
+        # expiry follow-up eval scheduled at window end
+        fups = [e for e in h.created_evals
+                if e.triggered_by == enums.TRIGGER_MAX_DISCONNECT_TIMEOUT]
+        assert len(fups) == 1
+        assert abs(fups[0].wait_until - (t0 + WINDOW)) < 1.0
+        assert got.follow_up_eval_id == fups[0].id
+
+    def test_repeat_evals_no_duplicate_replacement_or_followup(self, h):
+        nodes, job = setup_job(h, count=2, max_client_disconnect=WINDOW)
+        victim = live_allocs(h, job.id)[0]
+        self._disconnect(h, job, victim.node_id)
+        for _ in range(3):
+            h.process(mock.eval_for(
+                job, triggered_by=enums.TRIGGER_DEPLOYMENT_WATCHER))
+        repl = [a for a in live_allocs(h, job.id)
+                if a.previous_allocation == victim.id]
+        assert len(repl) == 1
+        fups = [e for e in h.created_evals
+                if e.triggered_by == enums.TRIGGER_MAX_DISCONNECT_TIMEOUT]
+        assert len(fups) == 1, "expiry follow-up eval must not be duplicated"
+        assert len(live_allocs(h, job.id)) == 2
+        assert len(unknown_allocs(h, job.id)) == 1
+
+    def test_no_disconnect_stanza_means_lost(self, h):
+        nodes, job = setup_job(h, count=2, max_client_disconnect=None)
+        victim = live_allocs(h, job.id)[0]
+        self._disconnect(h, job, victim.node_id)
+        got = h.snapshot().alloc_by_id(victim.id)
+        assert got.client_status == enums.ALLOC_CLIENT_LOST
+        assert got.desired_status == enums.ALLOC_DESIRED_STOP
+        assert len(live_allocs(h, job.id)) == 2
+
+    def test_disconnect_past_window_is_lost_immediately(self, h):
+        nodes, job = setup_job(h, count=2, max_client_disconnect=WINDOW)
+        victim = live_allocs(h, job.id)[0]
+        self._disconnect(h, job, victim.node_id, ts=time.time() - WINDOW - 5)
+        got = h.snapshot().alloc_by_id(victim.id)
+        assert got.client_status == enums.ALLOC_CLIENT_LOST
+        assert got.desired_status == enums.ALLOC_DESIRED_STOP
+        assert len(live_allocs(h, job.id)) == 2
+
+    def test_unknown_expires_to_lost_without_second_replacement(self, h):
+        nodes, job = setup_job(h, count=2, max_client_disconnect=WINDOW)
+        victim = live_allocs(h, job.id)[0]
+        self._disconnect(h, job, victim.node_id)
+        assert (h.snapshot().alloc_by_id(victim.id).client_status
+                == enums.ALLOC_CLIENT_UNKNOWN)
+        # window elapses while still disconnected: the follow-up eval fires
+        h.store.update_node_status(
+            victim.node_id, enums.NODE_STATUS_DISCONNECTED,
+            ts=time.time() - WINDOW - 5)
+        h.process(mock.eval_for(
+            job, triggered_by=enums.TRIGGER_MAX_DISCONNECT_TIMEOUT))
+        got = h.snapshot().alloc_by_id(victim.id)
+        assert got.client_status == enums.ALLOC_CLIENT_LOST
+        assert got.desired_status == enums.ALLOC_DESIRED_STOP
+        live = live_allocs(h, job.id)
+        assert len(live) == 2
+        assert sum(1 for a in live if a.previous_allocation == victim.id) == 1
+
+    def test_multiple_allocs_on_disconnected_node(self, h):
+        nodes, job = setup_job(h, count=4, n_nodes=2,
+                               max_client_disconnect=WINDOW)
+        by_node = {}
+        for a in live_allocs(h, job.id):
+            by_node.setdefault(a.node_id, []).append(a)
+        node_id, victims = max(by_node.items(), key=lambda kv: len(kv[1]))
+        assert len(victims) >= 2
+        self._disconnect(h, job, node_id)
+        snap = h.snapshot()
+        for v in victims:
+            assert snap.alloc_by_id(v.id).client_status == enums.ALLOC_CLIENT_UNKNOWN
+        assert len(live_allocs(h, job.id)) == 4
+        assert len(unknown_allocs(h, job.id)) == len(victims)
+
+
+class TestReconnect:
+    def _unknown_with_replacement(self, h, job):
+        victim = live_allocs(h, job.id)[0]
+        h.store.update_node_status(
+            victim.node_id, enums.NODE_STATUS_DISCONNECTED, ts=time.time())
+        h.process(mock.eval_for(job, triggered_by=enums.TRIGGER_NODE_UPDATE))
+        repl = next(a for a in live_allocs(h, job.id)
+                    if a.previous_allocation == victim.id)
+        return victim, repl
+
+    def _client_sync_running(self, h, alloc):
+        """The reconnected client re-syncs its still-running alloc."""
+        upd = alloc.copy_for_update()
+        upd.client_status = enums.ALLOC_CLIENT_RUNNING
+        h.store.update_allocs_from_client([upd])
+
+    def test_reconnect_current_version_keeps_original(self, h):
+        nodes, job = setup_job(h, count=2, max_client_disconnect=WINDOW)
+        victim, repl = self._unknown_with_replacement(h, job)
+        h.store.update_node_status(victim.node_id, enums.NODE_STATUS_READY,
+                                   ts=time.time())
+        h.process(mock.eval_for(job, triggered_by=enums.TRIGGER_NODE_UPDATE))
+        snap = h.snapshot()
+        assert snap.alloc_by_id(victim.id).desired_status == enums.ALLOC_DESIRED_RUN
+        assert snap.alloc_by_id(repl.id).desired_status == enums.ALLOC_DESIRED_STOP
+        # client re-syncs running; the cluster settles at desired count
+        self._client_sync_running(h, victim)
+        h.process(mock.eval_for(job, triggered_by=enums.TRIGGER_NODE_UPDATE))
+        live = live_allocs(h, job.id)
+        assert len(live) == 2
+        assert victim.id in {a.id for a in live}
+
+    def test_reconnect_outdated_version_stops_original(self, h):
+        nodes, job = setup_job(h, count=2, max_client_disconnect=WINDOW)
+        victim, repl = self._unknown_with_replacement(h, job)
+        # job moves on while the node is away (destructive update)
+        j2 = copy.deepcopy(job)
+        j2.task_groups[0].tasks[0].config = {"command": "/bin/new"}
+        j2.task_groups[0].update = None
+        h.store.upsert_job(j2)
+        job = h.snapshot().job_by_id(job.id)
+        h.process(mock.eval_for(job))
+        h.store.update_node_status(victim.node_id, enums.NODE_STATUS_READY,
+                                   ts=time.time())
+        h.process(mock.eval_for(job, triggered_by=enums.TRIGGER_NODE_UPDATE))
+        run_until_stable(h, job)
+        snap = h.snapshot()
+        assert snap.alloc_by_id(victim.id).desired_status == enums.ALLOC_DESIRED_STOP
+        live = live_allocs(h, job.id)
+        assert len(live) == 2
+        assert all(a.job_version == job.version for a in live)
+        assert victim.id not in {a.id for a in live}
+
+    def test_reconnect_before_replacement_placed(self, h):
+        """Racing reconnect: the client returns before any replacement
+        could be placed (cluster full) — the original simply resumes."""
+        nodes = [mock.node()]
+        for n in nodes:
+            h.store.upsert_node(n)
+        job = mock.job()
+        job.task_groups[0].count = 1
+        job.task_groups[0].update = UpdateStrategy(max_parallel=1)
+        job.task_groups[0].max_client_disconnect_s = WINDOW
+        h.store.upsert_job(job)
+        job = h.snapshot().job_by_id(job.id)
+        h.process(mock.eval_for(job))
+        victim = live_allocs(h, job.id)[0]
+        h.store.update_node_status(
+            victim.node_id, enums.NODE_STATUS_DISCONNECTED, ts=time.time())
+        h.process(mock.eval_for(job, triggered_by=enums.TRIGGER_NODE_UPDATE))
+        # only node is disconnected: no replacement possible
+        assert [a for a in live_allocs(h, job.id)
+                if a.previous_allocation == victim.id] == []
+        h.store.update_node_status(victim.node_id, enums.NODE_STATUS_READY,
+                                   ts=time.time())
+        h.process(mock.eval_for(job, triggered_by=enums.TRIGGER_NODE_UPDATE))
+        assert (h.snapshot().alloc_by_id(victim.id).desired_status
+                == enums.ALLOC_DESIRED_RUN)
+        self._client_sync_running(h, victim)
+        run_until_stable(h, job)
+        live = live_allocs(h, job.id)
+        assert len(live) == 1
+        assert live[0].id == victim.id
+        assert live[0].desired_status == enums.ALLOC_DESIRED_RUN
+
+    def test_reconnect_with_two_unknowns_stops_both_replacements(self, h):
+        nodes, job = setup_job(h, count=3, n_nodes=2,
+                               max_client_disconnect=WINDOW)
+        by_node = {}
+        for a in live_allocs(h, job.id):
+            by_node.setdefault(a.node_id, []).append(a)
+        node_id, victims = max(by_node.items(), key=lambda kv: len(kv[1]))
+        assert len(victims) >= 2
+        h.store.update_node_status(
+            node_id, enums.NODE_STATUS_DISCONNECTED, ts=time.time())
+        h.process(mock.eval_for(job, triggered_by=enums.TRIGGER_NODE_UPDATE))
+        repl_ids = {a.id for a in live_allocs(h, job.id)
+                    if a.previous_allocation in {v.id for v in victims}}
+        h.store.update_node_status(node_id, enums.NODE_STATUS_READY,
+                                   ts=time.time())
+        h.process(mock.eval_for(job, triggered_by=enums.TRIGGER_NODE_UPDATE))
+        snap = h.snapshot()
+        for v in victims:
+            assert snap.alloc_by_id(v.id).desired_status == enums.ALLOC_DESIRED_RUN
+        for rid in repl_ids:
+            assert snap.alloc_by_id(rid).desired_status == enums.ALLOC_DESIRED_STOP
+        for v in victims:
+            self._client_sync_running(h, snap.alloc_by_id(v.id))
+        h.process(mock.eval_for(job, triggered_by=enums.TRIGGER_NODE_UPDATE))
+        assert len(live_allocs(h, job.id)) == 3
