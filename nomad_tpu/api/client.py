@@ -166,6 +166,15 @@ class ApiClient:
     def set_scheduler_configuration(self, cfg) -> None:
         self._request("PUT", "/v1/operator/scheduler/configuration", cfg)
 
+    def snapshot_save(self) -> dict:
+        """Whole-cluster state dump (reference operator snapshot save)."""
+        out, _ = self.get("/v1/operator/snapshot")
+        return out
+
+    def snapshot_restore(self, data: dict) -> int:
+        out, _ = self._request("POST", "/v1/operator/snapshot", data)
+        return out.get("index", 0)
+
     def agent_self(self) -> dict:
         out, _ = self.get("/v1/agent/self")
         return out

@@ -258,6 +258,12 @@ class HTTPAgent:
                 return h._error(403, "Permission denied")
             return h._reply(200, dep)
 
+        if path == "/v1/operator/snapshot":
+            # the dump holds token secrets: management only
+            if acl is not None and not acl.management:
+                return h._error(403, "Permission denied")
+            return h._reply(200, self.server.store.dump())
+
         if path == "/v1/nodes":
             return h._reply(200, [self._node_stub(n) for n in snap.nodes()])
         if m := re.fullmatch(r"/v1/node/([^/]+)", path):
@@ -398,6 +404,14 @@ class HTTPAgent:
             self.server.sched_config = cfg
             self.server.config.sched_config = cfg
             return h._reply(200, {"updated": True})
+        if path == "/v1/operator/snapshot":
+            # whole-state restore (reference operator_snapshot_restore);
+            # the dump holds token secrets: management only
+            if acl is not None and not acl.management:
+                return h._error(403, "Permission denied")
+            self.server.store.restore_dump(body)
+            return h._reply(200, {"restored": True,
+                                  "index": self.server.store.latest_index})
         if m := re.fullmatch(r"/v1/deployment/promote/([^/]+)", path):
             try:
                 eval_id = self.server.promote_deployment(

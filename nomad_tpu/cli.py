@@ -174,6 +174,21 @@ def cmd_eval_status(args) -> int:
     return 0
 
 
+def cmd_operator_snapshot(args) -> int:
+    api = _client(args)
+    if args.op == "save":
+        data = api.snapshot_save()
+        with open(args.file, "w") as f:
+            json.dump(data, f)
+        print(f"snapshot saved to {args.file} (index {data.get('index')})")
+        return 0
+    with open(args.file) as f:
+        data = json.load(f)
+    index = api.snapshot_restore(data)
+    print(f"snapshot restored at index {index}")
+    return 0
+
+
 def cmd_operator_scheduler(args) -> int:
     api = _client(args)
     if args.op == "get-config":
@@ -254,6 +269,10 @@ def build_parser() -> argparse.ArgumentParser:
     osched.add_argument("-scheduler-algorithm", dest="scheduler_algorithm",
                         default="")
     osched.set_defaults(fn=cmd_operator_scheduler)
+    osnap = op.add_parser("snapshot")
+    osnap.add_argument("op", choices=["save", "restore"])
+    osnap.add_argument("file")
+    osnap.set_defaults(fn=cmd_operator_snapshot)
 
     return p
 

@@ -26,10 +26,16 @@ LIFECYCLE_POSTSTOP = "poststop"
 
 class AllocRunner:
     def __init__(self, alloc: Allocation, node, data_dir: str,
-                 on_update: Optional[Callable] = None):
+                 on_update: Optional[Callable] = None,
+                 state_db=None, restored_handles: Optional[Dict] = None):
         self.alloc = alloc
         self.node = node
         self.on_update = on_update
+        # persistence (client/state_db.py): task handles write through so
+        # a restarted client can re-attach; restored_handles carries the
+        # live handles recovered on restore
+        self.state_db = state_db
+        self.restored_handles = restored_handles or {}
         self.allocdir = AllocDir(data_dir, alloc.id)
         self.task_runners: Dict[str, TaskRunner] = {}
         self.client_status = enums.ALLOC_CLIENT_PENDING
@@ -60,16 +66,25 @@ class AllocRunner:
             tr = TaskRunner(self.alloc, task, self.node, td,
                             shared_dir=self.allocdir.shared,
                             on_state_change=self._on_task_state,
-                            restart_policy=self.tg.restart_policy)
+                            restart_policy=self.tg.restart_policy,
+                            on_handle=self._on_task_handle,
+                            recovered_handle=self.restored_handles.get(task.name))
             self.task_runners[task.name] = tr
             return tr
 
+        restoring = bool(self.restored_handles)
         prestart = [t for t in self.tg.tasks if t.lifecycle_hook == LIFECYCLE_PRESTART]
         mains = [t for t in self.tg.tasks if t.lifecycle_hook in ("", LIFECYCLE_POSTSTART)]
         poststop = [t for t in self.tg.tasks if t.lifecycle_hook == LIFECYCLE_POSTSTOP]
 
         # prestart tasks: non-sidecars must complete before main tasks
-        # (reference tasklifecycle coordinator)
+        # (reference tasklifecycle coordinator). On restore, completed
+        # prestarts don't re-run; recovered ones re-attach — and a
+        # recovered NON-sidecar still gates the mains below, preserving
+        # the ordering invariant across the restart.
+        if restoring:
+            prestart = [t for t in prestart
+                        if t.name in self.restored_handles]
         pre_runners = [make_runner(t) for t in prestart]
         for r in pre_runners:
             r.start()
@@ -133,6 +148,10 @@ class AllocRunner:
             self.on_update(self)
 
     # -- status rollup (reference alloc_runner.go clientAlloc) --
+
+    def _on_task_handle(self, task_name: str, handle_data) -> None:
+        if self.state_db is not None:
+            self.state_db.put_task_handle(self.alloc.id, task_name, handle_data)
 
     def _on_task_state(self, task_name: str, state: TaskState) -> None:
         with self._lock:

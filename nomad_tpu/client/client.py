@@ -26,6 +26,7 @@ from ..structs.alloc import Allocation
 from ..structs.node import Node
 from .alloc_runner import AllocRunner
 from .fingerprint import fingerprint
+from .state_db import ClientStateDB
 
 
 @dataclass
@@ -48,6 +49,13 @@ class Client:
         self.node = node or fingerprint(datacenter=self.config.datacenter,
                                         node_class=self.config.node_class,
                                         data_dir=self.config.data_dir)
+        # persistent identity + alloc/handle state (client/state/db_bolt
+        # equivalent): a restarted client keeps its node id, so the server
+        # sees a re-registration, not a new node
+        self.state_db = ClientStateDB(self.config.data_dir)
+        if node is None and self.state_db.node_id:
+            self.node.id = self.state_db.node_id
+        self.state_db.set_node_id(self.node.id)
         self.runners: Dict[str, AllocRunner] = {}
         self._dirty: Dict[str, AllocRunner] = {}   # pending status syncs
         self._lock = threading.Lock()              # guards self.runners
@@ -58,6 +66,7 @@ class Client:
     # -- lifecycle --
 
     def start(self) -> None:
+        self._restore()
         self.server.register_node(self.node)
         for name, fn in (("heartbeat", self._run_heartbeat),
                          ("watch", self._run_watch),
@@ -74,12 +83,54 @@ class Client:
         for r in list(self.runners.values()):
             r.stop()
 
+    def shutdown(self) -> None:
+        """Stop the agent threads but LEAVE TASKS RUNNING (the reference
+        agent shutdown: tasks survive the restart and the next start
+        re-attaches via the state DB, client/client.go:1216)."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
     def __enter__(self):
         self.start()
         return self
 
     def __exit__(self, *exc):
         self.stop()
+
+    def _restore(self) -> None:
+        """Re-adopt allocs from the state DB (client.go:1216
+        restoreState): live tasks re-attach by pid, dead ones roll
+        through the normal restart/fail paths."""
+        from .drivers import get_driver
+
+        for alloc, handles in self.state_db.restore_allocs():
+            if alloc.server_terminal() or alloc.client_terminal():
+                self.state_db.remove_alloc(alloc.id)
+                continue
+            recovered = {}
+            for task_name, data in handles.items():
+                tg = (alloc.job.lookup_task_group(alloc.task_group)
+                      if alloc.job else None)
+                task = next((t for t in (tg.tasks if tg else [])
+                             if t.name == task_name), None)
+                if task is None:
+                    continue
+                try:
+                    driver = get_driver(task.driver)
+                except Exception:
+                    continue
+                recover = getattr(driver, "recover_task", None)
+                handle = recover(data) if recover is not None else None
+                if handle is not None:
+                    recovered[task_name] = handle
+            runner = AllocRunner(alloc, self.node, self.config.data_dir,
+                                 on_update=self._mark_dirty,
+                                 state_db=self.state_db,
+                                 restored_handles=recovered)
+            with self._lock:
+                self.runners[alloc.id] = runner
+            runner.run()
 
     # -- heartbeats (client.go:1735 registerAndHeartbeat) --
 
@@ -111,6 +162,7 @@ class Client:
                 if server_alloc is None or server_alloc.server_terminal():
                     stops.append(runner)
                     del self.runners[alloc_id]
+                    self.state_db.remove_alloc(alloc_id)
             # adds: new non-terminal allocs assigned to us
             for alloc_id, alloc in by_id.items():
                 if alloc_id in self.runners:
@@ -118,8 +170,10 @@ class Client:
                 if alloc.server_terminal() or alloc.client_terminal():
                     continue
                 runner = AllocRunner(alloc, self.node, self.config.data_dir,
-                                     on_update=self._mark_dirty)
+                                     on_update=self._mark_dirty,
+                                     state_db=self.state_db)
                 self.runners[alloc_id] = runner
+                self.state_db.put_alloc(alloc)
                 starts.append(runner)
         # stop() joins task threads (up to kill_timeout each) — must run
         # outside the lock or the watch/sync loops stall behind it
@@ -147,6 +201,8 @@ class Client:
             return
         updates = []
         for runner in dirty.values():
+            self.state_db.update_client_status(runner.alloc.id,
+                                               runner.client_status)
             upd = runner.alloc.copy_for_update()
             upd.client_status = runner.client_status
             upd.client_description = runner.client_description

@@ -51,6 +51,25 @@ class TaskHandle:
     def is_running(self) -> bool:
         raise NotImplementedError
 
+    def handle_data(self) -> Optional[dict]:
+        """JSON-safe re-attach token persisted in the client state DB
+        (reference TaskHandle serialization, plugins/drivers). None =
+        this task cannot survive a client restart."""
+        return None
+
+
+def _proc_starttime(pid: int) -> Optional[int]:
+    """Kernel start time of a pid (jiffies since boot, /proc/<pid>/stat
+    field 22) — guards re-attach against pid reuse."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read().decode("ascii", errors="replace")
+        # field 2 (comm) may contain spaces/parens: split after the last ')'
+        fields = stat[stat.rindex(")") + 2:].split()
+        return int(fields[19])  # starttime is field 22 overall
+    except (OSError, ValueError, IndexError):
+        return None
+
 
 class DriverError(Exception):
     pass
@@ -151,6 +170,64 @@ class _ProcHandle(TaskHandle):
     def is_running(self) -> bool:
         return self._proc.poll() is None
 
+    def handle_data(self) -> Optional[dict]:
+        return {"pid": self._proc.pid,
+                "starttime": _proc_starttime(self._proc.pid)}
+
+
+class _RecoveredProcHandle(TaskHandle):
+    """Re-attached subprocess from a previous client process. The task
+    is no longer our child, so the exit *code* is unobservable — only
+    liveness is (the reference re-attaches through its executor
+    subprocess and has the same constraint for orphaned tasks)."""
+
+    def __init__(self, pid: int):
+        self._pid = pid
+        self._gone = threading.Event()
+
+    def _alive(self) -> bool:
+        try:
+            os.kill(self._pid, 0)
+            return True
+        except ProcessLookupError:
+            return False
+        except PermissionError:
+            return True
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[ExitResult]:
+        deadline = None if timeout is None else time.time() + timeout
+        while self._alive():
+            if deadline is not None and time.time() >= deadline:
+                return None
+            time.sleep(0.1)
+        self._gone.set()
+        # the exit status is unobservable: reporting success would turn
+        # post-restart crashes into silent data loss, so surface it as a
+        # failure and let the restart/reschedule policy decide
+        return ExitResult(
+            exit_code=0,
+            err="task exited while re-attached; exit status unobservable")
+
+    def kill(self, grace_s: float = 5.0) -> None:
+        try:
+            os.killpg(os.getpgid(self._pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        deadline = time.time() + grace_s
+        while self._alive() and time.time() < deadline:
+            time.sleep(0.05)
+        if self._alive():
+            try:
+                os.killpg(os.getpgid(self._pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def is_running(self) -> bool:
+        return self._alive()
+
+    def handle_data(self) -> Optional[dict]:
+        return {"pid": self._pid, "starttime": _proc_starttime(self._pid)}
+
 
 class RawExecDriver:
     """No-isolation subprocess driver (reference drivers/rawexec).
@@ -182,6 +259,21 @@ class RawExecDriver:
         except OSError as e:
             raise DriverError(f"failed to start {command}: {e}") from e
         return _ProcHandle(proc)
+
+    def recover_task(self, handle_data: Optional[dict]) -> Optional[TaskHandle]:
+        """Re-attach to a task started by a previous client process
+        (reference client/state re-attach, task_runner.go:1212). None if
+        the process is gone or the pid was recycled."""
+        if not handle_data or not handle_data.get("pid"):
+            return None
+        pid = int(handle_data["pid"])
+        handle = _RecoveredProcHandle(pid)
+        if not handle.is_running():
+            return None
+        recorded = handle_data.get("starttime")
+        if recorded is not None and _proc_starttime(pid) != recorded:
+            return None  # pid reuse: a different process lives here now
+        return handle
 
     def healthy(self) -> bool:
         return True

@@ -1,0 +1,99 @@
+"""Client state persistence (reference client/state/db_bolt.go:165).
+
+A write-through JSON state file under the client's data_dir recording
+the node identity, each assigned alloc, and each started task's driver
+handle (pid + process start time for subprocess drivers). On restart the
+client reloads it, re-attaches to still-running tasks, and resumes
+status sync — tasks survive agent restarts exactly as the reference's
+boltdb store + handle re-attach provide (client/client.go:1216,
+task_runner.go:1212).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..structs.alloc import Allocation
+from ..structs.wire import wire_decode, wire_encode
+from ..utils.files import atomic_write_text
+
+
+class ClientStateDB:
+    def __init__(self, data_dir: str):
+        os.makedirs(data_dir, exist_ok=True)
+        self._path = os.path.join(data_dir, "client_state.json")
+        self._lock = threading.Lock()
+        self._data: dict = {"node_id": "", "allocs": {}}
+        if os.path.exists(self._path):
+            try:
+                with open(self._path) as f:
+                    self._data = json.load(f)
+            except (json.JSONDecodeError, OSError):
+                pass  # corrupt state file: start fresh (never wedge startup)
+
+    def _save(self) -> None:
+        atomic_write_text(self._path, json.dumps(self._data))
+
+    # -- node identity --
+
+    @property
+    def node_id(self) -> str:
+        return self._data.get("node_id", "")
+
+    def set_node_id(self, node_id: str) -> None:
+        with self._lock:
+            self._data["node_id"] = node_id
+            self._save()
+
+    # -- allocs + task handles --
+
+    def put_alloc(self, alloc: Allocation) -> None:
+        with self._lock:
+            rec = self._data["allocs"].setdefault(alloc.id, {})
+            rec["alloc"] = wire_encode(alloc)
+            rec.setdefault("handles", {})
+            self._save()
+
+    def put_task_handle(self, alloc_id: str, task_name: str,
+                        handle_data: Optional[dict]) -> None:
+        with self._lock:
+            rec = self._data["allocs"].get(alloc_id)
+            if rec is None:
+                return
+            rec.setdefault("handles", {})[task_name] = handle_data
+            self._save()
+
+    def update_client_status(self, alloc_id: str, client_status: str) -> None:
+        """Track the latest client status so restore can tell a completed
+        batch alloc from one still owed execution (re-running finished
+        work would duplicate side effects)."""
+        with self._lock:
+            rec = self._data["allocs"].get(alloc_id)
+            if rec is None or rec.get("client_status") == client_status:
+                return
+            rec["client_status"] = client_status
+            self._save()
+
+    def remove_alloc(self, alloc_id: str) -> None:
+        with self._lock:
+            if self._data["allocs"].pop(alloc_id, None) is not None:
+                self._save()
+
+    def restore_allocs(self) -> List[Tuple[Allocation, Dict[str, dict]]]:
+        """-> [(alloc, {task_name: handle_data})] for every stored alloc.
+        The alloc carries the last synced client_status, not the
+        assignment-time one."""
+        out = []
+        with self._lock:
+            for rec in self._data["allocs"].values():
+                try:
+                    alloc = wire_decode(rec["alloc"])
+                except Exception:
+                    continue
+                if rec.get("client_status"):
+                    alloc.client_status = rec["client_status"]
+                out.append((alloc, dict(rec.get("handles") or {})))
+        return out

@@ -28,7 +28,9 @@ class TaskRunner:
     def __init__(self, alloc, task: Task, node, task_dir: str,
                  shared_dir: str = "",
                  on_state_change: Optional[Callable] = None,
-                 restart_policy: Optional[RestartPolicy] = None):
+                 restart_policy: Optional[RestartPolicy] = None,
+                 on_handle: Optional[Callable] = None,
+                 recovered_handle=None):
         self.alloc = alloc
         self.task = task
         self.node = node
@@ -36,6 +38,11 @@ class TaskRunner:
         self.shared_dir = shared_dir
         self.on_state_change = on_state_change
         self.policy = restart_policy or RestartPolicy()
+        # persistence: on_handle(task_name, handle_data) records the
+        # driver handle for restart re-attach (client/state_db.py);
+        # recovered_handle is a live handle from a previous client process
+        self.on_handle = on_handle
+        self.recovered_handle = recovered_handle
 
         self.state = TaskState()
         self._handle = None
@@ -66,20 +73,31 @@ class TaskRunner:
             return
 
         while not self._killed.is_set():
-            env = taskenv.build_env(self.alloc, self.task, self.node,
-                                    self.task_dir, self.shared_dir)
-            config = taskenv.interpolate_config(self.task.config or {},
-                                                self.node, env)
-            run_task = _interpolated_task(self.task, config)
+            if self.recovered_handle is not None:
+                # restart re-attach: the task is already running from a
+                # previous client process; skip straight to the wait loop
+                self._handle = self.recovered_handle
+                self.recovered_handle = None
+                self._event("Restored", "re-attached to running task")
+            else:
+                env = taskenv.build_env(self.alloc, self.task, self.node,
+                                        self.task_dir, self.shared_dir)
+                config = taskenv.interpolate_config(self.task.config or {},
+                                                    self.node, env)
+                run_task = _interpolated_task(self.task, config)
 
-            try:
-                self._handle = driver.start_task(run_task, env, self.task_dir)
-            except DriverError as e:
-                self._event("Driver Failure", str(e))
-                if not self._should_restart(failed_start=True):
-                    self._fail(f"failed to start task: {e}")
-                    return
-                continue
+                try:
+                    self._handle = driver.start_task(run_task, env,
+                                                     self.task_dir)
+                except DriverError as e:
+                    self._event("Driver Failure", str(e))
+                    if not self._should_restart(failed_start=True):
+                        self._fail(f"failed to start task: {e}")
+                        return
+                    continue
+
+            if self.on_handle is not None:
+                self.on_handle(self.task.name, self._handle.handle_data())
 
             self.state.state = "running"
             self.state.started_at = self.state.started_at or time.time()

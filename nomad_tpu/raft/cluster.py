@@ -29,12 +29,36 @@ FORWARD = ("register_job", "deregister_job", "register_node", "heartbeat",
 class ReplicatedServer:
     def __init__(self, node_id: str, peers: List[str], transport,
                  config: Optional[ServerConfig] = None,
-                 peer_lookup: Optional[Callable[[str], "ReplicatedServer"]] = None):
+                 peer_lookup: Optional[Callable[[str], "ReplicatedServer"]] = None,
+                 data_dir: Optional[str] = None,
+                 snapshot_threshold: int = 1024):
         self.id = node_id
         self.local_store = StateStore()
         self.fsm = FSM(self.local_store)
+        self.data_dir = data_dir
+        log = stable = snapshots = None
+        fsm_snapshot = fsm_restore = None
+        if data_dir is not None:
+            # durable mode: boltdb-equivalent log + stable + snapshot
+            # files under <data_dir>/raft (reference server.go:1365)
+            import os
+
+            from ..state.persist import dump_store, restore_store
+            from .durable import DurableLog, SnapshotStore, StableStore
+
+            raft_dir = os.path.join(data_dir, "raft")
+            os.makedirs(raft_dir, exist_ok=True)
+            stable = StableStore(raft_dir)
+            snapshots = SnapshotStore(raft_dir)
+            log = DurableLog(raft_dir)
+            fsm_snapshot = lambda: dump_store(self.local_store)  # noqa: E731
+            fsm_restore = lambda data: restore_store(self.local_store, data)  # noqa: E731
         self.raft = RaftNode(node_id, peers, transport, self.fsm.apply,
-                             on_leadership=self._on_leadership)
+                             on_leadership=self._on_leadership,
+                             log=log, stable=stable, snapshots=snapshots,
+                             fsm_snapshot=fsm_snapshot,
+                             fsm_restore=fsm_restore,
+                             snapshot_threshold=snapshot_threshold)
         self.store = RaftStore(self.local_store, self.raft)
         self.server = Server(config, store=self.store)
         self._peer_lookup = peer_lookup
@@ -95,15 +119,22 @@ class RaftCluster:
     """N in-process replicated servers on one transport (the reference's
     in-process multi-server test topology, nomad/testing.go)."""
 
-    def __init__(self, n: int = 3, config_fn: Optional[Callable[[int], ServerConfig]] = None):
+    def __init__(self, n: int = 3, config_fn: Optional[Callable[[int], ServerConfig]] = None,
+                 data_dir: Optional[str] = None, snapshot_threshold: int = 1024):
         self.transport = InProcTransport()
         ids = [f"server-{i}" for i in range(n)]
         self.servers: Dict[str, ReplicatedServer] = {}
         for i, node_id in enumerate(ids):
             cfg = config_fn(i) if config_fn else ServerConfig(heartbeat_ttl=30.0)
+            node_dir = None
+            if data_dir is not None:
+                import os
+                node_dir = os.path.join(data_dir, node_id)
+                os.makedirs(node_dir, exist_ok=True)
             self.servers[node_id] = ReplicatedServer(
                 node_id, ids, self.transport, cfg,
-                peer_lookup=self.servers.get)
+                peer_lookup=self.servers.get, data_dir=node_dir,
+                snapshot_threshold=snapshot_threshold)
 
     def start(self) -> "RaftCluster":
         for s in self.servers.values():

@@ -1,0 +1,248 @@
+"""Durable raft storage: on-disk log, stable term/vote store, and FSM
+snapshot files.
+
+Reference: hashicorp/raft's boltdb LogStore/StableStore
+(nomad/server.go:1365 setupRaft) and FileSnapshotStore. Here the log is
+an append-only JSONL file (commands are wire-encoded, structs/wire.py),
+term/vote is an atomically-replaced JSON file, and snapshots are whole
+state dumps (state/persist.py) with index/term metadata. Compaction
+rewrites the log keeping only entries past the snapshot.
+
+Layout under <dir>/:
+    log.jsonl       one entry per line: {"index","term","command"}
+    stable.json     {"term": N, "voted_for": id}
+    snapshot.json   {"index","term","data"}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import List, Optional, Tuple
+
+from ..structs.wire import wire_decode, wire_encode
+from ..utils.files import atomic_write_text as _atomic_write
+from .log import Entry
+
+
+class StableStore:
+    """current_term + voted_for survive restarts (Raft's persistent
+    per-server state; losing it can double-vote in one term)."""
+
+    def __init__(self, dir_path: str):
+        self._path = os.path.join(dir_path, "stable.json")
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        if os.path.exists(self._path):
+            with open(self._path) as f:
+                data = json.load(f)
+            self.term = int(data.get("term", 0))
+            self.voted_for = data.get("voted_for")
+
+    def save(self, term: int, voted_for: Optional[str]) -> None:
+        self.term = term
+        self.voted_for = voted_for
+        _atomic_write(self._path,
+                      json.dumps({"term": term, "voted_for": voted_for}))
+
+
+class SnapshotStore:
+    def __init__(self, dir_path: str):
+        self._path = os.path.join(dir_path, "snapshot.json")
+
+    def save(self, index: int, term: int, data: dict) -> None:
+        _atomic_write(self._path, json.dumps(
+            {"index": index, "term": term, "data": data}))
+
+    def load(self) -> Optional[dict]:
+        if not os.path.exists(self._path):
+            return None
+        with open(self._path) as f:
+            return json.load(f)
+
+
+class DurableLog:
+    """RaftLog-compatible append-only disk log with a compaction base.
+
+    Indexes are 1-based and global; after compaction the log physically
+    starts at base_index+1 (base_index/base_term describe the snapshot
+    boundary, like hashicorp/raft's firstIndex after log truncation).
+    """
+
+    def __init__(self, dir_path: str, fsync: bool = True):
+        self._dir = dir_path
+        self._path = os.path.join(dir_path, "log.jsonl")
+        self._fsync = fsync
+        self._lock = threading.Lock()
+        self.base_index = 0
+        self.base_term = 0
+        self._entries: List[Entry] = []  # entries base_index+1 .. last
+        self._fh = None
+        self._load()
+
+    # -- persistence internals --
+
+    def _load(self) -> None:
+        snap_meta = os.path.join(self._dir, "snapshot.json")
+        if os.path.exists(snap_meta):
+            with open(snap_meta) as f:
+                meta = json.load(f)
+            self.base_index = int(meta.get("index", 0))
+            self.base_term = int(meta.get("term", 0))
+        if os.path.exists(self._path):
+            good_offset = 0
+            torn = False
+            with open(self._path, "rb") as f:
+                for raw in f:
+                    line = raw.decode("utf-8", errors="replace").strip()
+                    if line:
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            # torn tail write (crash mid-append): drop it
+                            torn = True
+                            break
+                        e = Entry(index=rec["index"], term=rec["term"],
+                                  command=tuple(wire_decode(rec["command"])))
+                        if e.index > self.base_index:
+                            # conflict-truncated entries may linger
+                            # physically; keep the last write per index
+                            pos = e.index - self.base_index - 1
+                            if pos < len(self._entries):
+                                del self._entries[pos:]
+                            elif pos > len(self._entries):
+                                good_offset += len(raw)
+                                continue  # stale pre-compaction line
+                            self._entries.append(e)
+                    good_offset += len(raw)
+            if torn:
+                # truncate the garbage so the next append starts clean
+                with open(self._path, "r+b") as f:
+                    f.truncate(good_offset)
+        self._fh = open(self._path, "a")
+
+    def _write(self, entries: List[Entry]) -> None:
+        for e in entries:
+            self._fh.write(json.dumps({
+                "index": e.index, "term": e.term,
+                "command": wire_encode(list(e.command))}) + "\n")
+        self._fh.flush()
+        if self._fsync:
+            os.fsync(self._fh.fileno())
+
+    def _rewrite(self) -> None:
+        """Rewrite the whole file from the logical view (truncation or
+        compaction — both rare)."""
+        self._fh.close()
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            for e in self._entries:
+                f.write(json.dumps({
+                    "index": e.index, "term": e.term,
+                    "command": wire_encode(list(e.command))}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path)
+        self._fh = open(self._path, "a")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    # -- RaftLog interface --
+
+    def last(self) -> Tuple[int, int]:
+        with self._lock:
+            if not self._entries:
+                return self.base_index, self.base_term
+            e = self._entries[-1]
+            return e.index, e.term
+
+    def first_index(self) -> int:
+        """Lowest index physically present (0 = log empty)."""
+        with self._lock:
+            return self.base_index + 1 if self._entries else 0
+
+    def term_at(self, index: int) -> int:
+        if index == 0:
+            return 0
+        with self._lock:
+            if index == self.base_index:
+                return self.base_term
+            pos = index - self.base_index - 1
+            if pos < 0 or pos >= len(self._entries):
+                return -1
+            return self._entries[pos].term
+
+    def get(self, index: int) -> Optional[Entry]:
+        with self._lock:
+            pos = index - self.base_index - 1
+            if 0 <= pos < len(self._entries):
+                return self._entries[pos]
+            return None
+
+    def slice_from(self, index: int, limit: int = 64) -> List[Entry]:
+        with self._lock:
+            pos = max(0, index - self.base_index - 1)
+            return list(self._entries[pos: pos + limit])
+
+    def append(self, term: int, command: tuple) -> Entry:
+        with self._lock:
+            last = (self._entries[-1].index if self._entries
+                    else self.base_index)
+            e = Entry(index=last + 1, term=term, command=command)
+            self._entries.append(e)
+            self._write([e])
+            return e
+
+    def append_entries(self, prev_index: int, entries: List[Entry]) -> None:
+        with self._lock:
+            appended: List[Entry] = []
+            truncated = False
+            for e in entries:
+                if e.index <= self.base_index:
+                    continue  # snapshot already covers it
+                pos = e.index - self.base_index - 1
+                if pos < len(self._entries):
+                    if self._entries[pos].term != e.term:
+                        del self._entries[pos:]
+                        self._entries.append(e)
+                        truncated = True
+                        appended = [e]
+                    # else: already have it
+                else:
+                    self._entries.append(e)
+                    appended.append(e)
+            if truncated:
+                self._rewrite()
+            elif appended:
+                self._write(appended)
+
+    def length(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # -- compaction --
+
+    def compact(self, upto_index: int, upto_term: int) -> None:
+        """Drop entries <= upto_index (now covered by a snapshot)."""
+        with self._lock:
+            keep = upto_index - self.base_index
+            if keep <= 0:
+                return
+            del self._entries[:keep]
+            self.base_index = upto_index
+            self.base_term = upto_term
+            self._rewrite()
+
+    def reset_to(self, index: int, term: int) -> None:
+        """Install-snapshot on a follower: discard everything, restart
+        the log at the snapshot boundary."""
+        with self._lock:
+            self._entries.clear()
+            self.base_index = index
+            self.base_term = term
+            self._rewrite()

@@ -30,7 +30,7 @@ MUTATIONS = {
     "upsert_acl_policy", "delete_acl_policy",
     "upsert_acl_token", "delete_acl_token",
     "upsert_variable", "delete_variable",
-    "gc_terminal_allocs", "compact",
+    "gc_terminal_allocs", "compact", "restore_dump",
 }
 
 

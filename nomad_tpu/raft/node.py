@@ -26,7 +26,11 @@ class RaftNode:
                  fsm_apply: Callable[[tuple], object],
                  election_timeout: float = 0.3,
                  heartbeat_interval: float = 0.05,
-                 on_leadership: Optional[Callable[[bool], None]] = None):
+                 on_leadership: Optional[Callable[[bool], None]] = None,
+                 log=None, stable=None, snapshots=None,
+                 fsm_snapshot: Optional[Callable[[], dict]] = None,
+                 fsm_restore: Optional[Callable[[dict], None]] = None,
+                 snapshot_threshold: int = 1024):
         self.id = node_id
         self.peers = [p for p in peers if p != node_id]
         self.transport = transport
@@ -38,13 +42,30 @@ class RaftNode:
         self.state = FOLLOWER
         self.current_term = 0
         self.voted_for: Optional[str] = None
-        self.log = RaftLog()
+        self.log = log if log is not None else RaftLog()
         self.commit_index = 0
         self.last_applied = 0
         self.leader_id: Optional[str] = None
 
+        # durability (raft/durable.py); all optional — in-memory otherwise
+        self.stable = stable
+        self.snapshots = snapshots
+        self.fsm_snapshot = fsm_snapshot
+        self.fsm_restore = fsm_restore
+        self.snapshot_threshold = snapshot_threshold
+        if stable is not None:
+            self.current_term = stable.term
+            self.voted_for = stable.voted_for
+        if snapshots is not None and fsm_restore is not None:
+            snap = snapshots.load()
+            if snap is not None:
+                fsm_restore(snap["data"])
+                self.commit_index = snap["index"]
+                self.last_applied = snap["index"]
+
         self._next_index: Dict[str, int] = {}
         self._match_index: Dict[str, int] = {}
+        self._snap_inflight: set = set()  # peers mid-install-snapshot
         self._lock = threading.RLock()
         self._apply_cond = threading.Condition(self._lock)
         self._deadline = self._new_deadline()
@@ -110,7 +131,15 @@ class RaftNode:
             return self._on_request_vote(msg)
         if kind == "append_entries":
             return self._on_append_entries(msg)
+        if kind == "install_snapshot":
+            return self._on_install_snapshot(msg)
         raise ValueError(f"unknown raft message {kind}")
+
+    def _persist_vote(self) -> None:
+        """Term and vote must hit disk before any reply leaves this node
+        (the Raft persistent-state rule)."""
+        if self.stable is not None:
+            self.stable.save(self.current_term, self.voted_for)
 
     def _on_request_vote(self, msg: dict) -> dict:
         with self._lock:
@@ -125,6 +154,7 @@ class RaftNode:
                 if up_to_date:
                     granted = True
                     self.voted_for = msg["candidate"]
+                    self._persist_vote()
                     self._deadline = self._new_deadline()
             return {"term": self.current_term, "granted": granted}
 
@@ -155,6 +185,58 @@ class RaftNode:
                     "success": True,
                     "match_index": prev_index + len(entries)}
 
+    def _on_install_snapshot(self, msg: dict) -> dict:
+        """Follower-side snapshot install: the leader compacted past the
+        entries this node needs (Raft §7 / hashicorp/raft InstallSnapshot)."""
+        with self._lock:
+            term = msg["term"]
+            if term < self.current_term:
+                return {"term": self.current_term, "success": False}
+            if term > self.current_term or self.state != FOLLOWER:
+                self._become_follower(term)
+            self.leader_id = msg["leader"]
+            self._deadline = self._new_deadline()
+            index, snap_term = msg["index"], msg["snap_term"]
+            if index <= self.last_applied:
+                return {"term": self.current_term, "success": True,
+                        "match_index": self.last_applied}
+            if self.fsm_restore is None:
+                return {"term": self.current_term, "success": False}
+            self.fsm_restore(msg["data"])
+            if hasattr(self.log, "reset_to"):
+                self.log.reset_to(index, snap_term)
+            if self.snapshots is not None:
+                self.snapshots.save(index, snap_term, msg["data"])
+            self.commit_index = max(self.commit_index, index)
+            self.last_applied = index
+            self._apply_cond.notify_all()
+            return {"term": self.current_term, "success": True,
+                    "match_index": index}
+
+    def _maybe_snapshot(self) -> None:
+        """Apply-thread only: snapshot the FSM and compact the log once
+        enough entries accumulated past the last snapshot boundary. Runs
+        under the node lock so a concurrent install_snapshot (RPC thread)
+        can't interleave and leave an older-labeled snapshot covering
+        newer state."""
+        if self.snapshots is None or self.fsm_snapshot is None:
+            return
+        if not hasattr(self.log, "compact"):
+            return
+        with self._lock:
+            base = getattr(self.log, "base_index", 0)
+            applied = self.last_applied
+            if applied - base < self.snapshot_threshold:
+                return
+            term = self.log.term_at(applied)
+            if term < 0:
+                return
+            # only this thread mutates the FSM, and holding the lock
+            # blocks install_snapshot, so the dump matches `applied`
+            data = self.fsm_snapshot()
+            self.snapshots.save(applied, term, data)
+            self.log.compact(applied, term)
+
     # -- roles --
 
     def _become_follower(self, term: int) -> None:
@@ -167,6 +249,7 @@ class RaftNode:
         if term > self.current_term:
             self.current_term = term
             self.voted_for = None
+            self._persist_vote()
         self._deadline = self._new_deadline()
         if was_leader and self.on_leadership:
             self.on_leadership(False)
@@ -192,6 +275,7 @@ class RaftNode:
             self.state = CANDIDATE
             self.current_term += 1
             self.voted_for = self.id
+            self._persist_vote()
             term = self.current_term
             self._deadline = self._new_deadline()
             last_index, last_term = self.log.last()
@@ -237,6 +321,9 @@ class RaftNode:
                 return
             term = self.current_term
             next_idx = self._next_index.get(peer, 1)
+            base = getattr(self.log, "base_index", 0)
+            if next_idx <= base:
+                return self._send_snapshot(peer, term, base)
             prev_index = next_idx - 1
             prev_term = self.log.term_at(prev_index)
             entries = self.log.slice_from(next_idx)
@@ -262,6 +349,45 @@ class RaftNode:
                 self._next_index[peer] = self._match_index[peer] + 1
             else:
                 self._next_index[peer] = max(1, next_idx - 1)
+
+    def _send_snapshot(self, peer: str, term: int, base: int) -> None:
+        """The peer needs entries the log compacted away: ship the whole
+        snapshot instead (called with the lock held; sends outside it).
+        At most one install per peer in flight — replication ticks fire
+        every heartbeat and a full-state transfer outlives them."""
+        if self.snapshots is None or peer in self._snap_inflight:
+            return
+        self._snap_inflight.add(peer)
+
+        def send():
+            try:
+                snap = self.snapshots.load()
+                if snap is None:
+                    return
+                reply = self.transport.send(self.id, peer, {
+                    "kind": "install_snapshot", "term": term,
+                    "leader": self.id, "index": snap["index"],
+                    "snap_term": snap["term"], "data": snap["data"],
+                })
+                if reply is None:
+                    return
+                with self._lock:
+                    if reply["term"] > self.current_term:
+                        self._become_follower(reply["term"])
+                        return
+                    if self.state != LEADER:
+                        return
+                    if reply.get("success"):
+                        self._match_index[peer] = max(
+                            self._match_index.get(peer, 0),
+                            reply["match_index"])
+                        self._next_index[peer] = self._match_index[peer] + 1
+            finally:
+                with self._lock:
+                    self._snap_inflight.discard(peer)
+
+        threading.Thread(target=send, daemon=True,
+                         name=f"raft-{self.id}-snap-{peer}").start()
 
     def _maybe_advance_commit(self) -> None:
         with self._lock:
@@ -290,6 +416,9 @@ class RaftNode:
                 start = self.last_applied + 1
                 end = self.commit_index
             for idx in range(start, end + 1):
+                with self._lock:
+                    if idx <= self.last_applied:
+                        continue  # an install_snapshot leapfrogged us
                 entry = self.log.get(idx)
                 if entry is None:
                     break
@@ -306,8 +435,9 @@ class RaftNode:
                         # drop results nobody waited for
                         for k in sorted(self._results)[:-1024]:
                             self._results.pop(k, None)
-                    self.last_applied = idx
+                    self.last_applied = max(self.last_applied, idx)
                     self._apply_cond.notify_all()
+            self._maybe_snapshot()
 
 
 class NotLeaderError(Exception):

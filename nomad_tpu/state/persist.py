@@ -1,0 +1,118 @@
+"""FSM snapshot serialization: StateStore <-> JSON-safe dump.
+
+Reference: nomad/fsm.go Snapshot/Restore + helper/snapshot. The dump is
+the latest committed value of every primary table; secondary indexes
+(allocs-by-node/job/eval, evals-by-job, deployments-by-job, token
+secret index) are derivable and rebuilt on restore, so they never ride
+the wire or disk.
+"""
+
+from __future__ import annotations
+
+from ..structs.wire import wire_decode, wire_encode
+from .mvcc import cons
+
+FORMAT = 1
+
+
+def dump_store(store) -> dict:
+    """Serialize the latest committed state. Takes its own snapshot."""
+    with store.snapshot() as snap:
+        job_versions = []
+        for (ns, jid, _ver), row in store._job_versions.iterate(snap.index):
+            job_versions.append(row)
+        return {
+            "format": FORMAT,
+            "index": snap.index,
+            "nodes": [wire_encode(n) for n in snap.nodes()],
+            "jobs": [wire_encode(j) for j in snap.jobs()],
+            "job_versions": [wire_encode(j) for j in job_versions],
+            "evals": [wire_encode(e) for e in snap.evals()],
+            "allocs": [wire_encode(a) for a in snap.allocs()],
+            "deployments": [wire_encode(d) for d in snap.deployments()],
+            "acl_policies": [wire_encode(p) for p in snap.acl_policies()],
+            "acl_tokens": [wire_encode(t) for t in snap.acl_tokens()],
+            "variables": [wire_encode(v)
+                          for _, v in store._variables.iterate(snap.index)],
+        }
+
+
+def restore_store(store, data: dict) -> None:
+    """Replace the store's contents with a dump (restore-on-start and
+    follower install-snapshot). Publishes at the dump's index."""
+    if data.get("format") != FORMAT:
+        raise ValueError(f"unsupported snapshot format {data.get('format')}")
+    index = int(data["index"])
+    nodes = [wire_decode(x) for x in data.get("nodes", [])]
+    jobs = [wire_decode(x) for x in data.get("jobs", [])]
+    job_versions = [wire_decode(x) for x in data.get("job_versions", [])]
+    evals = [wire_decode(x) for x in data.get("evals", [])]
+    allocs = [wire_decode(x) for x in data.get("allocs", [])]
+    deployments = [wire_decode(x) for x in data.get("deployments", [])]
+    policies = [wire_decode(x) for x in data.get("acl_policies", [])]
+    tokens = [wire_decode(x) for x in data.get("acl_tokens", [])]
+    variables = [wire_decode(x) for x in data.get("variables", [])]
+
+    with store._write_lock:
+        # Generation choice must be deterministic across replicas AND
+        # MVCC-safe for concurrent snapshot readers:
+        # - store behind the dump (raft install / restart replay): land
+        #   exactly at the dump index so replay stays deterministic;
+        # - store ahead (operator restore of an older dump): take the
+        #   next generation like any other mutation.
+        gen = index if store._index < index else store._index + 1
+        live = store._tracker.min_live(store._index)
+        # never clear chains — live snapshots still read old versions;
+        # keys absent from the dump get tombstones at the new generation
+        new_keys = {
+            id(store._nodes): {n.id for n in nodes},
+            id(store._jobs): {(j.namespace, j.id) for j in jobs},
+            id(store._job_versions): {(j.namespace, j.id, j.version)
+                                      for j in job_versions},
+            id(store._evals): {e.id for e in evals},
+            id(store._allocs): {a.id for a in allocs},
+            id(store._deployments): {d.id for d in deployments},
+            id(store._acl_policies): {p.name for p in policies},
+            id(store._acl_tokens): {t.accessor_id for t in tokens},
+            id(store._acl_secret_idx): {t.secret_id for t in tokens},
+            id(store._variables): {(v.namespace, v.path) for v in variables},
+        }
+        for t in store._all_tables:
+            keep = new_keys.get(id(t), set())
+            for key in list(t._rows):
+                if key not in keep:
+                    t.delete(key, gen, live)
+        for n in nodes:
+            store._nodes.put(n.id, n, gen, live)
+        for j in jobs:
+            store._jobs.put((j.namespace, j.id), j, gen, live)
+        for j in job_versions:
+            store._job_versions.put((j.namespace, j.id, j.version), j, gen, live)
+        for e in evals:
+            store._evals.put(e.id, e, gen, live)
+            _index_prepend(store._evals_by_job, (e.namespace, e.job_id),
+                           e.id, gen)
+        for a in allocs:
+            store._allocs.put(a.id, a, gen, live)
+            _index_prepend(store._allocs_by_node, a.node_id, a.id, gen)
+            _index_prepend(store._allocs_by_job, (a.namespace, a.job_id),
+                           a.id, gen)
+            _index_prepend(store._allocs_by_eval, a.eval_id, a.id, gen)
+        for d in deployments:
+            store._deployments.put(d.id, d, gen, live)
+            _index_prepend(store._deployments_by_job,
+                           (d.namespace, d.job_id), d.id, gen)
+        for p in policies:
+            store._acl_policies.put(p.name, p, gen, live)
+        for t in tokens:
+            store._acl_tokens.put(t.accessor_id, t, gen, live)
+            store._acl_secret_idx.put(t.secret_id, t.accessor_id, gen, live)
+        for v in variables:
+            store._variables.put((v.namespace, v.path), v, gen, live)
+        store._next_gen = gen
+        store._commit(gen, [("restore", None)])
+
+
+def _index_prepend(table, key, value, gen: int) -> None:
+    cell = table.get_latest(key)
+    table.put(key, cons(value, cell), gen, 0)

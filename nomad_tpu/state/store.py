@@ -280,6 +280,19 @@ class StateStore:
             floor = self._tracker.min_live(self._index)
             return sum(t.sweep(floor) for t in self._all_tables)
 
+    def dump(self) -> dict:
+        """Whole-state serialization (operator snapshot save + FSM
+        snapshots; reference helper/snapshot + fsm.go Snapshot)."""
+        from .persist import dump_store
+        return dump_store(self)
+
+    def restore_dump(self, data: dict) -> int:
+        """Replace contents from a dump (operator snapshot restore;
+        replicates through raft as a regular FSM mutation)."""
+        from .persist import restore_store
+        restore_store(self, data)
+        return self._index
+
     # --- node mutations (reference FSM ApplyNode*) ---
 
     def upsert_node(self, node: Node) -> int:

@@ -1,0 +1,17 @@
+"""Atomic file persistence shared by the raft stores and the client
+state DB (reference helper/ file utilities)."""
+
+from __future__ import annotations
+
+import os
+
+
+def atomic_write_text(path: str, payload: str) -> None:
+    """Write-temp + fsync + rename so readers see the old or the new
+    file, never a torn one."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
