@@ -29,6 +29,8 @@ def to_dict(obj: Any) -> Any:
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         out = {}
         for f in dataclasses.fields(obj):
+            if f.name.startswith("_"):
+                continue  # derived caches (Node._avail_vec) stay internal
             out[f.name] = to_dict(getattr(obj, f.name))
         return out
     # objects with slots-based dataclasses already handled; fall back to str

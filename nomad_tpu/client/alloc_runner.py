@@ -79,12 +79,15 @@ class AllocRunner:
 
         # prestart tasks: non-sidecars must complete before main tasks
         # (reference tasklifecycle coordinator). On restore, completed
-        # prestarts don't re-run; recovered ones re-attach — and a
-        # recovered NON-sidecar still gates the mains below, preserving
-        # the ordering invariant across the restart.
+        # non-sidecar prestarts don't re-run; recovered tasks re-attach —
+        # and a recovered NON-sidecar still gates the mains below,
+        # preserving the ordering invariant across the restart. Sidecar
+        # prestarts always get a runner: one whose process died while the
+        # agent was down must restart fresh, not silently vanish.
         if restoring:
             prestart = [t for t in prestart
-                        if t.name in self.restored_handles]
+                        if t.lifecycle_sidecar
+                        or t.name in self.restored_handles]
         pre_runners = [make_runner(t) for t in prestart]
         for r in pre_runners:
             r.start()

@@ -107,6 +107,26 @@ class BlockedEvals:
     def unblock_all(self) -> int:
         return self.unblock(computed_class="")
 
+    def unblock_failed(self) -> int:
+        """Release evals blocked by plan-attempt exhaustion (optimistic-
+        concurrency livelock, not capacity): the conflict storm they lost
+        is over shortly after it started, so the leader retries them on a
+        timer (reference blocked_evals.go UnblockFailed, driven by
+        leader.go:443 periodicUnblockFailedEvals)."""
+        with self._lock:
+            if not self._enabled:
+                return 0
+            release = [ev for ev in self._by_job.values()
+                       if ev.triggered_by == enums.TRIGGER_MAX_PLANS]
+            for ev in release:
+                self._by_job.pop((ev.namespace, ev.job_id), None)
+                self._escaped.pop(ev.id, None)
+                self._captured.pop(ev.id, None)
+            self.stats["unblocked"] += len(release)
+        for ev in release:
+            self._enqueue(ev)
+        return len(release)
+
     def blocked_count(self) -> int:
         with self._lock:
             return len(self._by_job)

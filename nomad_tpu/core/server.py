@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import copy as _copy
 import logging
+import queue
 import threading
 import time
 from dataclasses import dataclass, field
@@ -47,6 +48,9 @@ class ServerConfig:
     # backoff before a delivery-limited eval is retried
     # (reference leader.go failedEvalUnblockInterval)
     failed_eval_followup_delay: float = 60.0
+    # cadence for retrying evals blocked by plan-attempt exhaustion
+    # (reference leader.go:443 periodicUnblockFailedEvals)
+    failed_eval_unblock_interval: float = 60.0
     gc_interval: float = 60.0
     acl_enabled: bool = False
     sched_config: SchedulerConfiguration = field(default_factory=SchedulerConfiguration)
@@ -79,7 +83,18 @@ class Server:
         self.core_gc = CoreScheduler(self, interval=self.config.gc_interval)
         self.events = EventBroker(self.store)
         self._running = False
-        self.store.add_commit_listener(self._on_commit)
+        # Commit listeners fire inline on the store's write path — which
+        # under raft is the apply thread. The unblock path re-proposes
+        # through the store (RaftStore), so running it inline would
+        # deadlock the apply loop on itself; pump events through a queue
+        # to a dedicated thread instead (the reference's Unblock() is a
+        # channel send consumed by the blocked-evals watcher goroutine).
+        self._commit_q: "queue.Queue" = queue.Queue()
+        self.store.add_commit_listener(
+            lambda index, events: self._commit_q.put((index, events)))
+        self._commit_pump = threading.Thread(
+            target=self._run_commit_pump, daemon=True, name="commit-pump")
+        self._commit_pump.start()
 
     # -- lifecycle (leader.go:357 establishLeadership) --
 
@@ -144,6 +159,15 @@ class Server:
 
     # -- commit listener: unblock blocked evals on cluster changes --
 
+    def _run_commit_pump(self) -> None:
+        while True:
+            index, events = self._commit_q.get()
+            try:
+                self._on_commit(index, events)
+            except Exception:
+                if self.logger:
+                    self.logger.exception("commit listener failed")
+
     def _on_commit(self, index: int, events: list) -> None:
         for kind, payload in events:
             if kind in ("node-upsert", "node-status", "node-eligibility", "node-drain"):
@@ -170,11 +194,17 @@ class Server:
     # -- failed-eval reaper (leader.go:1162 reapFailedEvaluations) --
 
     def _run_reaper(self) -> None:
+        next_unblock_failed = time.time() + self.config.failed_eval_unblock_interval
         while self._running:
             # persist cancellations of superseded pending evals
             cancelled = self.broker.drain_cancelled()
             if cancelled:
                 self.store.upsert_evals(cancelled)
+            # retry conflict-stranded (max-plan) blocked evals on a timer
+            if time.time() >= next_unblock_failed:
+                self.blocked.unblock_failed()
+                next_unblock_failed = (time.time()
+                                       + self.config.failed_eval_unblock_interval)
             # delivery-limited evals: mark failed, schedule a follow-up
             from .broker import FAILED_QUEUE
 

@@ -94,7 +94,12 @@ class Worker:
     def submit_plan(self, plan: Plan):
         plan.snapshot_index = getattr(self._snapshot, "index", 0) or 0
         pending = self.server.plan_queue.enqueue(plan)
-        result = pending.wait(timeout=10.0)
+        # Generous (queue depth spikes when every worker submits a large
+        # plan at once) but bounded well inside the broker's nack timer —
+        # waiting the full nack window guarantees redelivery of an eval
+        # that is still being processed.
+        result = pending.wait(
+            timeout=max(10.0, self.server.config.nack_timeout / 2.0))
         if result.refresh_index:
             # partial commit: hand the scheduler a fresher snapshot
             new_snap = self.server.store.snapshot_min_index(result.refresh_index)

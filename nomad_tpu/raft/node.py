@@ -416,19 +416,25 @@ class RaftNode:
                 start = self.last_applied + 1
                 end = self.commit_index
             for idx in range(start, end + 1):
+                # The re-check, fetch, and FSM mutation must be one
+                # critical section with _on_install_snapshot (RPC thread):
+                # releasing the lock between the last_applied check and
+                # fsm_apply would let a snapshot restore land in between,
+                # after which applying the stale entry regresses the
+                # restored store. Same discipline _maybe_snapshot uses.
                 with self._lock:
                     if idx <= self.last_applied:
                         continue  # an install_snapshot leapfrogged us
-                entry = self.log.get(idx)
-                if entry is None:
-                    break
-                if tuple(entry.command)[:1] == ("noop",):
-                    result = None  # leader barrier entry, internal to raft
-                else:
-                    try:
-                        result = self.fsm_apply(tuple(entry.command))
-                    except Exception as e:
-                        result = e
+                    entry = self.log.get(idx)
+                    if entry is None:
+                        break
+                    if tuple(entry.command)[:1] == ("noop",):
+                        result = None  # leader barrier entry, internal to raft
+                    else:
+                        try:
+                            result = self.fsm_apply(tuple(entry.command))
+                        except Exception as e:
+                            result = e
                 with self._apply_cond:
                     self._results[idx] = result
                     if len(self._results) > 4096:

@@ -64,12 +64,21 @@ class GenericScheduler:
     # -- core loop --
 
     def _process_with_retries(self) -> None:
-        for attempt in range(self.max_attempts):
-            done = self._attempt(attempt)
-            if done:
+        # the attempt budget only counts *zero-progress* retries: a partial
+        # commit resets it (reference scheduler/util.go retryMax's
+        # progressMade callback, generic_sched.go:149) — under worker
+        # contention every plan can be partially rejected many times in a
+        # row while still converging, and that must not exhaust the eval
+        attempt = 0
+        fruitless = 0
+        while fruitless < self.max_attempts:
+            self._progress = False
+            if self._attempt(attempt):
                 return
+            attempt += 1
+            fruitless = 0 if self._progress else fruitless + 1
         # exceeded plan attempts: fail this eval but queue a blocked eval
-    # so the work is not lost (reference generic_sched.go:151-170)
+        # so the work is not lost (reference generic_sched.go:151-170)
         self._create_blocked_eval(max_plan=True)
         self._set_status(enums.EVAL_STATUS_FAILED, "maximum attempts reached")
 
@@ -194,6 +203,9 @@ class GenericScheduler:
 
         # submit
         result, new_state = self.planner.submit_plan(self.plan)
+        self._progress = bool(result.node_allocation or result.node_update
+                              or result.node_preemptions
+                              or result.deployment is not None)
         if new_state is not None:
             # partial commit: retry against fresher state
             self.state = new_state
@@ -242,6 +254,8 @@ class GenericScheduler:
                 task_group=tg.name,
                 allocated_vec=tg.combined_resources().vec(),
                 allocated_ports=list(option.allocated_ports),
+                allocated_devices=dict(option.allocated_devices),
+                allocated_cores=list(option.allocated_cores),
                 desired_status=enums.ALLOC_DESIRED_RUN,
                 client_status=enums.ALLOC_CLIENT_PENDING,
                 metrics=ctx.metrics,

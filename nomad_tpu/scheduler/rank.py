@@ -58,6 +58,8 @@ class RankedNode:
     final_score: float = 0.0
     preempted_allocs: Optional[List[Allocation]] = None
     allocated_ports: List = field(default_factory=list)
+    allocated_devices: Dict[str, List[str]] = field(default_factory=dict)
+    allocated_cores: List[int] = field(default_factory=list)
 
     def add_score(self, name: str, value: float) -> None:
         self.scores.append(value)
@@ -181,6 +183,40 @@ class NodeScorer:
                     self.ctx.metrics.exhaust_node("ports")
                 return None
             option.allocated_ports = ports
+
+        # --- device instance assignment + core selection (reference
+        # rank.go:510-525: deviceAllocator offers + coreSelector) ---
+        if self.ask.devices or self.ask.cores:
+            if option.preempted_allocs is None:
+                counted_for_ids = proposed
+            else:
+                victim_ids = {v.id for v in option.preempted_allocs}
+                counted_for_ids = [a for a in proposed if a.id not in victim_ids]
+        if self.ask.devices:
+            from .devices import DeviceIndex, device_affinity_boost
+
+            didx = DeviceIndex(node, counted_for_ids)
+            assignment = didx.assign(self.ask.devices,
+                                     self.ctx.regex_cache, self.ctx.version_cache)
+            if assignment is None:
+                if self.ctx.metrics is not None:
+                    self.ctx.metrics.exhaust_node("devices")
+                return None
+            option.allocated_devices = assignment
+            dev_boost = device_affinity_boost(
+                node, self.ask.devices, self.ctx.regex_cache, self.ctx.version_cache)
+            if dev_boost != 0.0:
+                option.add_score("device-affinity", dev_boost)
+        if self.ask.cores:
+            from .devices import combined_numa_affinity, select_cores
+
+            cores = select_cores(node, counted_for_ids, int(self.ask.cores),
+                                 combined_numa_affinity(self.tg))
+            if cores is None:
+                if self.ctx.metrics is not None:
+                    self.ctx.metrics.exhaust_node("cores")
+                return None
+            option.allocated_cores = cores
 
         available = node.available_vec()
         if self.algorithm == enums.SCHED_ALG_SPREAD:

@@ -136,6 +136,8 @@ class SystemScheduler:
                         task_group=tg.name,
                         allocated_vec=tg.combined_resources().vec(),
                         allocated_ports=list(option.allocated_ports),
+                        allocated_devices=dict(option.allocated_devices),
+                        allocated_cores=list(option.allocated_cores),
                         desired_status=enums.ALLOC_DESIRED_RUN,
                         client_status=enums.ALLOC_CLIENT_PENDING,
                         metrics=metrics,

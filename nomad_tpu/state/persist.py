@@ -92,12 +92,18 @@ def restore_store(store, data: dict) -> None:
             store._evals.put(e.id, e, gen, live)
             _index_prepend(store._evals_by_job, (e.namespace, e.job_id),
                            e.id, gen)
+        usage = {}
         for a in allocs:
             store._allocs.put(a.id, a, gen, live)
             _index_prepend(store._allocs_by_node, a.node_id, a.id, gen)
             _index_prepend(store._allocs_by_job, (a.namespace, a.job_id),
                            a.id, gen)
             _index_prepend(store._allocs_by_eval, a.eval_id, a.id, gen)
+            if not a.terminal_status():
+                prev = usage.get(a.node_id)
+                usage[a.node_id] = a.allocated_vec if prev is None else prev + a.allocated_vec
+        for node_id, vec in usage.items():
+            store._node_usage.put(node_id, vec, gen, live)
         for d in deployments:
             store._deployments.put(d.id, d, gen, live)
             _index_prepend(store._deployments_by_job,

@@ -220,13 +220,18 @@ class StateStore:
         self._acl_tokens = VersionedTable("acl_tokens")         # key accessor id
         self._acl_secret_idx = VersionedTable("acl_secret_idx")  # secret -> accessor
         self._variables = VersionedTable("variables")           # key (ns, path)
+        # derived: per-node summed allocated_vec of usage-counting allocs,
+        # maintained on every alloc write so tensorization reads one row
+        # per node instead of walking every alloc (the tensor-era form of
+        # the O(allocs) proposed-usage rescan)
+        self._node_usage = VersionedTable("node_usage")
 
         self._all_tables = [
             self._nodes, self._jobs, self._job_versions, self._evals, self._allocs,
             self._deployments, self._allocs_by_node, self._allocs_by_job,
             self._allocs_by_eval, self._evals_by_job, self._deployments_by_job,
             self._acl_policies, self._acl_tokens, self._acl_secret_idx,
-            self._variables,
+            self._variables, self._node_usage,
         ]
         self._listeners: List[Callable[[int, list], None]] = []
 
@@ -308,6 +313,7 @@ class StateStore:
             else:
                 node.create_index = gen
             node.modify_index = gen
+            node._avail_vec = None  # caller may have mutated resources
             if not node.computed_class:
                 node.compute_class()
             self._nodes.put(node.id, node, gen, live)
@@ -354,6 +360,7 @@ class StateStore:
             gen, live = self._begin()
             node = self._nodes.get_latest(node_id)
             self._nodes.delete(node_id, gen, live)
+            self._node_usage.delete(node_id, gen, live)
             self._commit(gen, [("node-delete", node)])
             return gen
 
@@ -472,6 +479,32 @@ class StateStore:
             self._commit(gen, events)
             return gen
 
+    def _usage_add(self, node_id: str, delta, gen: int, live: int) -> None:
+        cur = self._node_usage.get_latest(node_id)
+        new = delta if cur is None else cur + delta
+        self._node_usage.put(node_id, new, gen, live)
+
+    def _usage_apply(self, prev: Optional[Allocation], new: Optional[Allocation],
+                     gen: int, live: int) -> None:
+        """Fold one alloc transition into the per-node usage rows.
+
+        Counting predicate is `not terminal_status()` — the scheduler's
+        proposed-usage view (reference context.go:176 filters terminal
+        allocs before the fit math ever sees them). The plan applier's
+        stricter client-terminal-only accounting (funcs.go:150) stays in
+        allocs_fit, which walks per-node allocs directly."""
+        import numpy as np
+
+        pc = prev is not None and not prev.terminal_status()
+        nc = new is not None and not new.terminal_status()
+        if (pc and nc and prev.node_id == new.node_id
+                and np.array_equal(prev.allocated_vec, new.allocated_vec)):
+            return  # annotation-only rewrite; no resource movement
+        if pc:
+            self._usage_add(prev.node_id, -prev.allocated_vec, gen, live)
+        if nc:
+            self._usage_add(new.node_id, new.allocated_vec, gen, live)
+
     def _put_alloc(self, alloc: Allocation, gen: int, live: int, ts: float = None) -> None:
         alloc.modify_time = ts if ts is not None else time.time()
         prev = self._allocs.get_latest(alloc.id)
@@ -485,6 +518,7 @@ class StateStore:
             alloc.create_index = gen
         alloc.modify_index = gen
         self._allocs.put(alloc.id, alloc, gen, live)
+        self._usage_apply(prev, alloc, gen, live)
         if prev is None:
             cell = self._allocs_by_node.get_latest(alloc.node_id)
             self._allocs_by_node.put(alloc.node_id, cons(alloc.id, cell), gen, live)
@@ -514,6 +548,7 @@ class StateStore:
                 merged.modify_index = gen
                 merged.modify_time = ts
                 self._allocs.put(merged.id, merged, gen, live)
+                self._usage_apply(existing, merged, gen, live)
                 events.append(("alloc-client-update", merged))
             self._commit(gen, events)
             return gen
@@ -532,6 +567,8 @@ class StateStore:
                 merged = copy.copy(existing)
                 merged.desired_transition = transition
                 merged.modify_index = gen
+                # desired_transition never flips should_count_for_usage
+                # (that's client_terminal-only), so no usage row change
                 self._allocs.put(alloc_id, merged, gen, live)
                 events.append(("alloc-transition", merged))
             for ev in evals:
@@ -708,10 +745,14 @@ class StateStore:
                     return a.terminal_status() or a.server_terminal()
                 return a.server_terminal() and a.client_terminal()
 
-            dead = [a.id for _, a in self._allocs.iterate(gen) if gcable(a)]
+            dead_allocs = [a for _, a in self._allocs.iterate(gen) if gcable(a)]
+            dead = [a.id for a in dead_allocs]
             dead_set = set(dead)
-            for aid in dead:
-                self._allocs.delete(aid, gen, live)
+            for a in dead_allocs:
+                self._allocs.delete(a.id, gen, live)
+                # orphans of purged jobs can still be usage-counting
+                # (server-terminal but client-side running)
+                self._usage_apply(a, None, gen, live)
             # rebuild secondary indexes without the dead ids
             for table in (self._allocs_by_node, self._allocs_by_job, self._allocs_by_eval):
                 for key, cell in list(table.iterate(gen)):

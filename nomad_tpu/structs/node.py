@@ -51,6 +51,10 @@ class Node:
     # feasibility key (reference structs/node_class.go ComputeClass,
     # scheduler/context.go:261 EvalEligibility).
     computed_class: str = ""
+    # memoized available_vec(); valid because rows are immutable by
+    # convention — resource changes arrive as fresh Node objects via
+    # upsert_node, and status-only copies keep the same resources
+    _avail_vec: Optional[np.ndarray] = field(default=None, repr=False, compare=False)
 
     @property
     def drain(self) -> bool:
@@ -80,12 +84,19 @@ class Node:
 
         The ports dimension is the dynamic-range slot count minus any
         agent-reserved ports that fall inside the range (a reserved port
-        outside the range costs no slot)."""
+        outside the range costs no slot).
+
+        Memoized per row (callers treat the result as read-only); the
+        tensorizer reads this once per node per eval, so the recompute
+        would otherwise dominate host time at 10K nodes."""
         from .resources import R_PORTS
 
+        if self._avail_vec is not None:
+            return self._avail_vec
         v = self.resources.vec() - self.reserved.vec()
         lo, hi = self.resources.min_dynamic_port, self.resources.max_dynamic_port
         v[R_PORTS] -= sum(1 for p in self.reserved.reserved_ports if lo <= p <= hi)
+        self._avail_vec = v
         return v
 
     def compute_class(self) -> str:

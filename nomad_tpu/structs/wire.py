@@ -71,8 +71,11 @@ def wire_encode(obj: Any) -> Any:
         cls = type(obj)
         if cls.__name__ not in _REGISTRY:
             raise TypeError(f"unregistered wire type {cls.__name__}")
+        # "_"-prefixed fields are derived caches (e.g. Node._avail_vec);
+        # they never ride the wire and decode falls back to the default
         fields = {f.name: wire_encode(getattr(obj, f.name))
-                  for f in dataclasses.fields(obj)}
+                  for f in dataclasses.fields(obj)
+                  if not f.name.startswith("_")}
         return {"__t": cls.__name__, "__f": fields}
     raise TypeError(f"cannot wire-encode {type(obj).__name__}")
 

@@ -65,30 +65,72 @@ class ClusterTensors:
         return t
 
     def refresh_usage(self, ctx: EvalContext) -> None:
-        """Recompute proposed usage (state - evictions + placements) from
-        the context. Called between task groups so group B sees group A's
-        in-plan placements (reference context.go:176 ProposedAllocs)."""
-        self.used[:] = 0.0
-        self._proposed_cache: Dict[int, list] = {}
+        """Proposed usage (state - evictions + placements). Base usage
+        comes from the store's per-node usage rows — O(nodes) reads, not
+        an O(allocs) rescan — and only nodes the in-progress plan touches
+        are recomputed from ctx.proposed_allocs (reference context.go:176
+        ProposedAllocs). Called between task groups so group B sees group
+        A's in-plan placements."""
+        snap = ctx.snapshot
+        usage_tbl = snap._store._node_usage
+        gen = snap.index
+        used = self.used
+        used[:] = 0.0
         for i, node in enumerate(self.nodes):
-            allocs = ctx.proposed_allocs(node.id)
-            self._proposed_cache[i] = allocs
-            for a in allocs:
+            u = usage_tbl.get(node.id, gen)
+            if u is not None:
+                used[i] = u
+        plan = ctx.plan
+        if plan is None:
+            return
+        touched = (set(plan.node_update) | set(plan.node_preemptions)
+                   | set(plan.node_allocation))
+        for node_id in touched:
+            i = self.node_index.get(node_id)
+            if i is None:
+                continue
+            used[i] = 0.0
+            for a in ctx.proposed_allocs(node_id):
                 if a.should_count_for_usage():
-                    self.used[i] += a.allocated_vec
+                    used[i] += a.allocated_vec
 
-    def placement_counts(self, job: Job, tg: TaskGroup) -> Tuple[np.ndarray, np.ndarray]:
+    def placement_counts(self, job: Job, tg: TaskGroup,
+                         ctx: EvalContext) -> Tuple[np.ndarray, np.ndarray]:
         """(placed_tg, placed_job) int32 vectors counting this job's
-        proposed allocs per node (anti-affinity + distinct_hosts inputs)."""
+        proposed allocs per node (anti-affinity + distinct_hosts inputs).
+        Walks only this job's allocs plus the plan — not every alloc."""
         ptg = np.zeros(self.n_pad, dtype=np.int32)
         pjob = np.zeros(self.n_pad, dtype=np.int32)
-        for i in range(len(self.nodes)):
-            for a in self._proposed_cache.get(i, ()):
-                if a.job_id != job.id or a.namespace != job.namespace:
+        plan = ctx.plan
+        removed: set = set()
+        placed_ids: set = set()
+        if plan is not None:
+            for allocs in plan.node_update.values():
+                removed.update(a.id for a in allocs)
+            for allocs in plan.node_preemptions.values():
+                removed.update(a.id for a in allocs)
+            for allocs in plan.node_allocation.values():
+                placed_ids.update(a.id for a in allocs)
+        for a in ctx.snapshot.allocs_by_job(job.id, job.namespace):
+            if a.terminal_status() or a.id in removed or a.id in placed_ids:
+                continue
+            i = self.node_index.get(a.node_id)
+            if i is None:
+                continue
+            pjob[i] += 1
+            if a.task_group == tg.name:
+                ptg[i] += 1
+        if plan is not None:
+            for node_id, allocs in plan.node_allocation.items():
+                i = self.node_index.get(node_id)
+                if i is None:
                     continue
-                pjob[i] += 1
-                if a.task_group == tg.name:
-                    ptg[i] += 1
+                for a in allocs:
+                    if a.job_id != job.id or a.namespace != job.namespace:
+                        continue
+                    pjob[i] += 1
+                    if a.task_group == tg.name:
+                        ptg[i] += 1
         return ptg, pjob
 
 
@@ -220,7 +262,7 @@ def build_task_group_tensors(
     feas = np.zeros(n_pad, dtype=bool)
     feas[: len(nodes)] = feasible_mask(job, tg, nodes,
                                        ctx.regex_cache, ctx.version_cache)
-    placed_tg, placed_job = cluster.placement_counts(job, tg)
+    placed_tg, placed_job = cluster.placement_counts(job, tg, ctx)
     (val_id, val_ok, counts, desired,
      has_targets, weights) = _spread_tensors(ctx, job, tg, nodes, n_pad)
     dh_job, dh_tg = distinct_hosts_flags(job, tg)

@@ -69,6 +69,13 @@ class TPUPlacer:
                 commit(req, None)
             return
 
+        # Per-eval node shuffle, same seed discipline as the host path
+        # (reference scheduler/util.go:167 shuffleNodes): scores are
+        # order-invariant, but the kernel's argmax tie-breaks by index —
+        # without the shuffle every concurrently-racing worker picks the
+        # same winners among equal-scoring nodes and the plan applier
+        # rejects all but one (optimistic-concurrency livelock).
+        nodes = ctx.shuffled_nodes(list(nodes), attempt)
         cluster = ClusterTensors.build(ctx, nodes)
 
         # group requests per task group, preserving intra-group order

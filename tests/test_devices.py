@@ -1,0 +1,248 @@
+"""Device instance allocation + NUMA core selection
+(reference scheduler/device.go deviceAllocator, scheduler/numa_ce.go)."""
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.devices import (
+    DeviceIndex,
+    combined_numa_affinity,
+    device_affinity_boost,
+    device_capacity,
+    group_affinity_score,
+    matching_groups,
+    select_cores,
+)
+from nomad_tpu.structs import Affinity, Constraint, enums
+from nomad_tpu.structs.alloc import Allocation
+from nomad_tpu.structs.operator import SchedulerConfiguration
+from nomad_tpu.structs.resources import (
+    NodeDeviceResource,
+    NumaNode,
+    RequestedDevice,
+)
+from nomad_tpu.testing import Harness
+
+
+def gpu_node(n_gpus=4, vendor="nvidia", name="a100", mem="40000", **overrides):
+    n = mock.node(**overrides)
+    n.resources.devices = [NodeDeviceResource(
+        vendor=vendor, type="gpu", name=name,
+        instance_ids=[f"{name}-{k}" for k in range(n_gpus)],
+        attributes={"memory": mem})]
+    n.compute_class()
+    return n
+
+
+class TestMatching:
+    def test_selector_forms(self):
+        node = gpu_node()
+        for sel in ("gpu", "nvidia/gpu", "nvidia/gpu/a100"):
+            assert matching_groups(node, RequestedDevice(name=sel)), sel
+        for sel in ("tpu", "amd/gpu", "nvidia/gpu/h100"):
+            assert not matching_groups(node, RequestedDevice(name=sel)), sel
+
+    def test_device_constraints_filter_groups(self):
+        node = gpu_node(mem="16000")
+        ask = RequestedDevice(name="gpu", constraints=[
+            Constraint(ltarget="${device.attr.memory}", rtarget="32000", operand=">=")])
+        assert matching_groups(node, ask) == []
+        assert device_capacity(node, ask) == 0
+        rich = gpu_node(mem="40000")
+        assert device_capacity(rich, ask) == 4
+
+    def test_affinity_score(self):
+        g = gpu_node(name="a100").resources.devices[0]
+        ask = RequestedDevice(name="gpu", affinities=[
+            Affinity(ltarget="${device.model}", rtarget="a100", operand="=", weight=50)])
+        assert group_affinity_score(g, ask) == 1.0
+        ask_miss = RequestedDevice(name="gpu", affinities=[
+            Affinity(ltarget="${device.model}", rtarget="h100", operand="=", weight=50)])
+        assert group_affinity_score(g, ask_miss) == 0.0
+
+
+class TestDeviceIndex:
+    def test_assignment_unique_instances(self):
+        node = gpu_node(n_gpus=4)
+        idx = DeviceIndex(node)
+        a1 = idx.assign([RequestedDevice(name="gpu", count=2)])
+        a2 = idx.assign([RequestedDevice(name="gpu", count=2)])
+        got = [i for a in (a1, a2) for v in a.values() for i in v]
+        assert len(got) == 4 and len(set(got)) == 4
+        assert idx.assign([RequestedDevice(name="gpu", count=1)]) is None
+
+    def test_existing_allocs_count(self):
+        node = gpu_node(n_gpus=2)
+        gid = node.resources.devices[0].id
+        existing = Allocation(id="a", allocated_devices={gid: ["a100-0"]})
+        idx = DeviceIndex(node, [existing])
+        got = idx.assign([RequestedDevice(name="gpu", count=1)])
+        assert got == {gid: ["a100-1"]}
+        assert idx.assign([RequestedDevice(name="gpu", count=1)]) is None
+
+    def test_affinity_prefers_matching_group(self):
+        node = mock.node()
+        node.resources.devices = [
+            NodeDeviceResource(vendor="nvidia", type="gpu", name="k80",
+                               instance_ids=["k80-0"]),
+            NodeDeviceResource(vendor="nvidia", type="gpu", name="a100",
+                               instance_ids=["a100-0"]),
+        ]
+        ask = RequestedDevice(name="gpu", count=1, affinities=[
+            Affinity(ltarget="${device.model}", rtarget="a100", operand="=", weight=1)])
+        got = DeviceIndex(node).assign([ask])
+        assert got == {"nvidia/gpu/a100": ["a100-0"]}
+        assert device_affinity_boost(node, [ask]) == 1.0
+
+
+class TestCoreSelection:
+    def numa_node(self):
+        n = mock.node()
+        n.resources.total_cores = 8
+        n.resources.numa = [NumaNode(id=0, cores=[0, 1, 2, 3]),
+                            NumaNode(id=1, cores=[4, 5, 6, 7])]
+        return n
+
+    def test_no_topology_lowest_free(self):
+        n = mock.node()
+        n.resources.total_cores = 4
+        used = Allocation(id="a", allocated_cores=[0, 2])
+        assert select_cores(n, [used], 2) == [1, 3]
+        assert select_cores(n, [used], 3) is None
+
+    def test_require_single_domain(self):
+        n = self.numa_node()
+        got = select_cores(n, [], 3, "require")
+        assert set(got) <= {0, 1, 2, 3} or set(got) <= {4, 5, 6, 7}
+        # 3 cores of domain 0 taken: require 3 must use domain 1 wholly
+        used = Allocation(id="a", allocated_cores=[0, 1, 2])
+        assert set(select_cores(n, [used], 3, "require")) <= {4, 5, 6, 7}
+        # no single domain has 5 free
+        assert select_cores(n, [], 5, "require") is None
+
+    def test_require_packs_tightest_domain(self):
+        n = self.numa_node()
+        used = Allocation(id="a", allocated_cores=[0, 1])
+        # domain 0 has 2 free, domain 1 has 4: a 2-core require packs into 0
+        assert select_cores(n, [used], 2, "require") == [2, 3]
+
+    def test_prefer_spills_across_domains(self):
+        n = self.numa_node()
+        got = select_cores(n, [], 5, "prefer")
+        assert len(got) == 5 and len(set(got)) == 5
+
+    def test_combined_numa_affinity_strictest_wins(self):
+        j = mock.job()
+        tg = j.task_groups[0]
+        assert combined_numa_affinity(tg) == "none"
+        tg.tasks[0].resources.numa_affinity = "require"
+        assert combined_numa_affinity(tg) == "require"
+
+
+class TestSchedulerIntegration:
+    @pytest.mark.parametrize("algorithm", [enums.SCHED_ALG_BINPACK,
+                                           enums.SCHED_ALG_TPU_BINPACK])
+    def test_device_and_core_placement(self, algorithm):
+        h = Harness()
+        for i in range(4):
+            n = gpu_node(n_gpus=2)
+            n.resources.total_cores = 8
+            n.resources.numa = [NumaNode(id=0, cores=[0, 1, 2, 3]),
+                                NumaNode(id=1, cores=[4, 5, 6, 7])]
+            n.compute_class()
+            h.store.upsert_node(n)
+        j = mock.job()
+        tg = j.task_groups[0]
+        tg.count = 4
+        tg.tasks[0].resources.devices = [RequestedDevice(name="nvidia/gpu", count=1)]
+        tg.tasks[0].resources.cores = 2
+        tg.tasks[0].resources.numa_affinity = "require"
+        h.store.upsert_job(j)
+        h.process(mock.eval_for(j), sched_config=SchedulerConfiguration(
+            scheduler_algorithm=algorithm))
+        allocs = [a for a in h.store.snapshot().allocs_by_job(j.id)
+                  if not a.terminal_status()]
+        assert len(allocs) == 4
+        per_node = {}
+        for a in allocs:
+            assert sum(len(v) for v in a.allocated_devices.values()) == 1
+            assert len(a.allocated_cores) == 2
+            assert (set(a.allocated_cores) <= {0, 1, 2, 3}
+                    or set(a.allocated_cores) <= {4, 5, 6, 7})
+            per_node.setdefault(a.node_id, []).append(a)
+        for allocs_on_node in per_node.values():
+            insts = [i for a in allocs_on_node
+                     for v in a.allocated_devices.values() for i in v]
+            assert len(insts) == len(set(insts))
+            cores = [c for a in allocs_on_node for c in a.allocated_cores]
+            assert len(cores) == len(set(cores))
+
+    def test_device_exhaustion_blocks(self):
+        h = Harness()
+        h.store.upsert_node(gpu_node(n_gpus=1))
+        j = mock.job()
+        tg = j.task_groups[0]
+        tg.count = 2
+        tg.tasks[0].resources.devices = [RequestedDevice(name="gpu", count=1)]
+        h.store.upsert_job(j)
+        h.process(mock.eval_for(j))
+        allocs = [a for a in h.store.snapshot().allocs_by_job(j.id)
+                  if not a.terminal_status()]
+        assert len(allocs) == 1  # second alloc has no free instance
+
+
+class TestUsageIndex:
+    def test_usage_rows_match_brute_force(self):
+        h = Harness()
+        nodes = [mock.node() for _ in range(6)]
+        for n in nodes:
+            h.store.upsert_node(n)
+        j = mock.job()
+        j.task_groups[0].count = 9
+        h.store.upsert_job(j)
+        h.process(mock.eval_for(j))
+
+        def check():
+            snap = h.store.snapshot()
+            for n in snap.nodes():
+                brute = np.zeros(4)
+                for a in snap.allocs_by_node(n.id):
+                    if not a.terminal_status():
+                        brute += a.allocated_vec
+                row = h.store._node_usage.get(n.id, snap.index)
+                row = np.zeros(4) if row is None else row
+                assert np.allclose(row, brute), (n.id, row, brute)
+            return snap
+
+        snap = check()
+        # client status transitions flip counting
+        allocs = [a for a in snap.allocs() if not a.terminal_status()]
+        upd = allocs[0].copy_for_update()
+        upd.client_status = enums.ALLOC_CLIENT_FAILED
+        h.store.update_allocs_from_client([upd])
+        check()
+        # server-side stop
+        upd2 = allocs[1].copy_for_update()
+        upd2.desired_status = enums.ALLOC_DESIRED_STOP
+        h.store.upsert_allocs([upd2])
+        check()
+        # dump/restore rebuilds rows
+        data = h.store.dump()
+        from nomad_tpu.state import StateStore
+
+        fresh = StateStore()
+        fresh.restore_dump(data)
+        snap2 = fresh.snapshot()
+        for n in snap2.nodes():
+            brute = np.zeros(4)
+            for a in snap2.allocs_by_node(n.id):
+                if not a.terminal_status():
+                    brute += a.allocated_vec
+            row = fresh._node_usage.get(n.id, snap2.index)
+            row = np.zeros(4) if row is None else row
+            assert np.allclose(row, brute)
+        # GC keeps rows consistent
+        h.store.delete_job(j.id)
+        h.store.gc_terminal_allocs(before_index=h.store.latest_index + 1)
+        check()
