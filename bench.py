@@ -11,7 +11,8 @@ scheduler/benchmarks/benchmarks_test.go:74-90 sweeps sizes the same way):
   1. service binpack, CPU+mem only       — 1K allocs /   100 nodes
   2. batch + constraints + affinities    — 10K allocs / 1K nodes (racing workers)
   3. spread + anti-affinity              — 50K allocs / 5K nodes (racing workers)
-  4. system + preemption, mixed priority — 1K nodes
+  4. system + preemption, mixed priority — 256 nodes, exact-fill
+  5. devices + NUMA cores (kernel path)  — 8K allocs / 2K GPU nodes
   H. headline spread config              — 1K allocs / 1K nodes
 
 Per config:
@@ -358,6 +359,74 @@ def cfg4_system_preemption() -> None:
          placed=tplaced, preempted=tpre, host_preempted=hpre)
 
 
+def cfg5_devices_numa() -> None:
+    """BASELINE config 5 (scaled): device asks + NUMA-aware reserved
+    cores through the kernel's extended resource columns. 8K allocs /
+    2K GPU nodes; every placement assigns concrete instances + cores."""
+    from nomad_tpu import mock
+    from nomad_tpu.structs import enums
+    from nomad_tpu.structs.resources import (NodeDeviceResource, NumaNode,
+                                             RequestedDevice)
+
+    def jobs():
+        out = []
+        for _ in range(16):
+            j = service_job(512, cpu=200, mem=256)
+            t = j.task_groups[0].tasks[0]
+            t.resources.devices = [RequestedDevice(name="nvidia/gpu", count=1)]
+            t.resources.cores = 2
+            t.resources.numa_affinity = "prefer"
+            out.append(j)
+        return out
+
+    def build_gpu_nodes(store, n_nodes, seed=0):
+        rng = random.Random(seed)
+        for i in range(n_nodes):
+            n = mock.node()
+            n.resources.cpu = rng.choice([16000, 32000])
+            n.resources.memory_mb = 65536
+            n.resources.total_cores = 16
+            n.resources.numa = [NumaNode(id=0, cores=list(range(8))),
+                                NumaNode(id=1, cores=list(range(8, 16)))]
+            n.resources.devices = [NodeDeviceResource(
+                vendor="nvidia", type="gpu", name="a100",
+                instance_ids=[f"g{i}-{k}" for k in range(8)])]
+            n.compute_class()
+            store.upsert_node(n)
+
+    def run(algorithm):
+        from nomad_tpu.structs.operator import SchedulerConfiguration
+        from nomad_tpu.testing import Harness
+
+        h = Harness()
+        build_gpu_nodes(h.store, 2048)
+        js = jobs()
+        for j in js:
+            h.store.upsert_job(j)
+        cfg = SchedulerConfiguration(scheduler_algorithm=algorithm)
+        warm = jobs()[0]
+        h.store.upsert_job(warm)
+        h.process(mock.eval_for(warm), sched_config=cfg)
+        h.store.delete_job(warm.id)
+        t0 = time.perf_counter()
+        for j in js:
+            h.process(mock.eval_for(j), sched_config=cfg)
+        dt = time.perf_counter() - t0
+        snap = h.store.snapshot()
+        allocs = [a for j in js for a in snap.allocs_by_job(j.id)
+                  if not a.terminal_status()]
+        assert all(a.allocated_devices and len(a.allocated_cores) == 2
+                   for a in allocs)
+        return dt, len(allocs), mean_score(snap, js)
+
+    tdt, tplaced, tscore = run(enums.SCHED_ALG_TPU_BINPACK)
+    hdt, hplaced, hscore = run(enums.SCHED_ALG_BINPACK)
+    assert tplaced == hplaced == 16 * 512, (tplaced, hplaced)
+    emit("device_numa_sched_throughput_8k_allocs_2k_nodes",
+         tplaced / tdt, "allocs/s", hdt / tdt,
+         score_parity_pp=tscore - hscore)
+
+
 def headline_spread_1k() -> None:
     """The round-over-round headline (unchanged since round 1): spread
     scheduling, 4 jobs x 256 allocs, 1K nodes, serial, full host
@@ -388,6 +457,7 @@ CONFIGS = [
     ("cfg2", cfg2_batch_constraints),
     ("cfg3", cfg3_spread_50k),
     ("cfg4", cfg4_system_preemption),
+    ("cfg5", cfg5_devices_numa),
     ("headline", headline_spread_1k),
 ]
 

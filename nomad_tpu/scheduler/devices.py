@@ -74,12 +74,17 @@ def group_affinity_score(group: NodeDeviceResource, ask: RequestedDevice,
     return total / weights if weights else 0.0
 
 
+def groups_capacity(groups: Sequence[NodeDeviceResource]) -> int:
+    """Single definition of a device-group set's instance capacity — the
+    kernel's count columns and the host DeviceIndex must agree on it."""
+    return sum(len(g.instance_ids) for g in groups)
+
+
 def device_capacity(node: Node, ask: RequestedDevice,
                     regex_cache=None, version_cache=None) -> int:
     """Total instances on the node that could serve this ask (usage-blind;
     usage rides the dense used column / DeviceIndex)."""
-    return sum(len(g.instance_ids)
-               for g in matching_groups(node, ask, regex_cache, version_cache))
+    return groups_capacity(matching_groups(node, ask, regex_cache, version_cache))
 
 
 class DeviceIndex:
@@ -175,17 +180,20 @@ def used_cores(proposed_allocs: Sequence) -> set:
 
 
 def select_cores(node: Node, proposed_allocs: Sequence, k: int,
-                 numa_affinity: str = "none") -> Optional[List[int]]:
+                 numa_affinity: str = "none",
+                 taken: Optional[set] = None) -> Optional[List[int]]:
     """Pick k free core ids. With NUMA topology: "require" means all k
     from a single domain (fail otherwise), "prefer" packs into as few
     domains as possible, "none" takes the lowest free ids. Packing picks
     the fullest-fitting domain first — binpack for cores, keeping big
     contiguous domains free (reference numa_ce.go is a CE stub that
     randomizes; the enterprise selector packs, and packing is strictly
-    better for future require-asks)."""
+    better for future require-asks). Callers tracking their own used-core
+    set pass `taken` directly instead of the alloc list."""
     if k <= 0:
         return []
-    taken = used_cores(proposed_allocs)
+    if taken is None:
+        taken = used_cores(proposed_allocs)
     domains = node.resources.numa
     if not domains:
         free = [c for c in range(int(node.resources.total_cores)) if c not in taken]

@@ -93,6 +93,7 @@ def restore_store(store, data: dict) -> None:
             _index_prepend(store._evals_by_job, (e.namespace, e.job_id),
                            e.id, gen)
         usage = {}
+        dev_usage = {}
         for a in allocs:
             store._allocs.put(a.id, a, gen, live)
             _index_prepend(store._allocs_by_node, a.node_id, a.id, gen)
@@ -102,8 +103,16 @@ def restore_store(store, data: dict) -> None:
             if not a.terminal_status():
                 prev = usage.get(a.node_id)
                 usage[a.node_id] = a.allocated_vec if prev is None else prev + a.allocated_vec
+                if a.allocated_devices or a.allocated_cores:
+                    row = dev_usage.setdefault(a.node_id, {})
+                    for gid, instances in (a.allocated_devices or {}).items():
+                        row[gid] = row.get(gid, 0) + len(instances)
+                    if a.allocated_cores:
+                        row["cores"] = row.get("cores", 0) + len(a.allocated_cores)
         for node_id, vec in usage.items():
             store._node_usage.put(node_id, vec, gen, live)
+        for node_id, row in dev_usage.items():
+            store._node_dev_usage.put(node_id, row, gen, live)
         for d in deployments:
             store._deployments.put(d.id, d, gen, live)
             _index_prepend(store._deployments_by_job,

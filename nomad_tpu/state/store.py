@@ -170,6 +170,17 @@ class StateSnapshot:
             if ns == namespace and path.startswith(prefix):
                 yield v
 
+    # --- derived usage rows (consumed by the tensor layer) ---
+
+    def node_usage(self, node_id: str):
+        """Summed allocated_vec of the node's non-terminal allocs, or
+        None (maintained incrementally on every alloc write)."""
+        return self._store._node_usage.get(node_id, self.index)
+
+    def node_dev_usage(self, node_id: str) -> Optional[dict]:
+        """{device_group_id: instances_used, "cores": n} or None."""
+        return self._store._node_dev_usage.get(node_id, self.index)
+
     def deployment_by_id(self, dep_id: str) -> Optional[Deployment]:
         return self._store._deployments.get(dep_id, self.index)
 
@@ -225,13 +236,18 @@ class StateStore:
         # per node instead of walking every alloc (the tensor-era form of
         # the O(allocs) proposed-usage rescan)
         self._node_usage = VersionedTable("node_usage")
+        # derived: per-node device-instance + reserved-core usage counts
+        # ({device_group_id: n, "cores": n}) for the device/core columns
+        # the tensor layer appends; only allocs that carry devices/cores
+        # ever touch it
+        self._node_dev_usage = VersionedTable("node_dev_usage")
 
         self._all_tables = [
             self._nodes, self._jobs, self._job_versions, self._evals, self._allocs,
             self._deployments, self._allocs_by_node, self._allocs_by_job,
             self._allocs_by_eval, self._evals_by_job, self._deployments_by_job,
             self._acl_policies, self._acl_tokens, self._acl_secret_idx,
-            self._variables, self._node_usage,
+            self._variables, self._node_usage, self._node_dev_usage,
         ]
         self._listeners: List[Callable[[int, list], None]] = []
 
@@ -361,6 +377,7 @@ class StateStore:
             node = self._nodes.get_latest(node_id)
             self._nodes.delete(node_id, gen, live)
             self._node_usage.delete(node_id, gen, live)
+            self._node_dev_usage.delete(node_id, gen, live)
             self._commit(gen, [("node-delete", node)])
             return gen
 
@@ -502,8 +519,21 @@ class StateStore:
             return  # annotation-only rewrite; no resource movement
         if pc:
             self._usage_add(prev.node_id, -prev.allocated_vec, gen, live)
+            self._dev_usage_add(prev, -1, gen, live)
         if nc:
             self._usage_add(new.node_id, new.allocated_vec, gen, live)
+            self._dev_usage_add(new, +1, gen, live)
+
+    def _dev_usage_add(self, alloc: Allocation, sign: int, gen: int, live: int) -> None:
+        if not alloc.allocated_devices and not alloc.allocated_cores:
+            return
+        cur = self._node_dev_usage.get_latest(alloc.node_id)
+        row = dict(cur) if cur else {}
+        for gid, instances in (alloc.allocated_devices or {}).items():
+            row[gid] = row.get(gid, 0) + sign * len(instances)
+        if alloc.allocated_cores:
+            row["cores"] = row.get("cores", 0) + sign * len(alloc.allocated_cores)
+        self._node_dev_usage.put(alloc.node_id, row, gen, live)
 
     def _put_alloc(self, alloc: Allocation, gen: int, live: int, ts: float = None) -> None:
         alloc.modify_time = ts if ts is not None else time.time()
@@ -748,11 +778,10 @@ class StateStore:
             dead_allocs = [a for _, a in self._allocs.iterate(gen) if gcable(a)]
             dead = [a.id for a in dead_allocs]
             dead_set = set(dead)
+            # every gcable alloc is terminal, so none is usage-counting —
+            # the usage rows never need adjusting here
             for a in dead_allocs:
                 self._allocs.delete(a.id, gen, live)
-                # orphans of purged jobs can still be usage-counting
-                # (server-terminal but client-side running)
-                self._usage_apply(a, None, gen, live)
             # rebuild secondary indexes without the dead ids
             for table in (self._allocs_by_node, self._allocs_by_job, self._allocs_by_eval):
                 for key, cell in list(table.iterate(gen)):

@@ -72,12 +72,10 @@ class ClusterTensors:
         ProposedAllocs). Called between task groups so group B sees group
         A's in-plan placements."""
         snap = ctx.snapshot
-        usage_tbl = snap._store._node_usage
-        gen = snap.index
         used = self.used
         used[:] = 0.0
         for i, node in enumerate(self.nodes):
-            u = usage_tbl.get(node.id, gen)
+            u = snap.node_usage(node.id)
             if u is not None:
                 used[i] = u
         plan = ctx.plan
@@ -153,6 +151,17 @@ class TaskGroupTensors:
     dh_job: bool
     dh_tg: bool
     spread_alg: bool
+    # device/core count columns appended to the dense resource dims
+    # (E = n device asks + 1 if reserved cores are requested)
+    extra_cap: np.ndarray = None    # (Np, E)
+    extra_used: np.ndarray = None   # (Np, E)
+    extra_ask: np.ndarray = None    # (E,)
+    dev_affinity: np.ndarray = None  # (Np,) device-affinity sub-score
+    # distinct_property cap tables (reference propertyset.go)
+    dp_val_id: np.ndarray = None    # (P, Np) int32
+    dp_val_ok: np.ndarray = None    # (P, Np) bool
+    dp_counts: np.ndarray = None    # (P, Vd) int32
+    dp_limit: np.ndarray = None     # (P,)
 
 
 def _affinity_vector(ctx: EvalContext, job: Job, tg: TaskGroup,
@@ -248,6 +257,125 @@ def _spread_tensors(ctx: EvalContext, job: Job, tg: TaskGroup,
     return val_ids, val_ok, spread_counts, spread_desired, has_targets, weights
 
 
+def _device_core_tensors(ctx: EvalContext, tg: TaskGroup,
+                         cluster: ClusterTensors):
+    """Per-ask device capacity/usage columns + a reserved-cores column +
+    the device-affinity sub-score vector. Capacity is constraint-filtered
+    per ask (reference feasible.go:1259 DeviceChecker + device.go); usage
+    comes from the store's device-usage rows plus plan deltas.
+
+    Count-fit on the device is intentionally slightly optimistic when
+    several asks share one group's instances or NUMA "require" constrains
+    core identity: the post-solve host assignment catches those and falls
+    back per request (same contract as exact port numbers)."""
+    from ..scheduler.devices import (combined_numa_affinity,
+                                     device_affinity_boost, groups_capacity,
+                                     matching_groups)
+
+    ask_res = tg.combined_resources()
+    asks = ask_res.devices
+    cores = int(ask_res.cores)
+    e = len(asks) + (1 if cores else 0)
+    nodes = cluster.nodes
+    n_pad = cluster.n_pad
+    if e == 0:
+        z = np.zeros((n_pad, 0))
+        return z, z, np.zeros(0), np.zeros(n_pad), "none"
+
+    snap = ctx.snapshot
+    cap = np.zeros((n_pad, e))
+    used = np.zeros((n_pad, e))
+    dev_aff = np.zeros(n_pad)
+    any_affinities = any(a.affinities for a in asks)
+    plan = ctx.plan
+    touched = set()
+    if plan is not None:
+        touched = (set(plan.node_update) | set(plan.node_preemptions)
+                   | set(plan.node_allocation))
+    for i, node in enumerate(nodes):
+        if node.id in touched:
+            row = {}
+            for a in ctx.proposed_allocs(node.id):
+                for gid, instances in (a.allocated_devices or {}).items():
+                    row[gid] = row.get(gid, 0) + len(instances)
+                if a.allocated_cores:
+                    row["cores"] = row.get("cores", 0) + len(a.allocated_cores)
+        else:
+            row = snap.node_dev_usage(node.id) or {}
+        for ei, ask in enumerate(asks):
+            groups = matching_groups(node, ask, ctx.regex_cache,
+                                     ctx.version_cache)
+            cap[i, ei] = groups_capacity(groups)
+            used[i, ei] = sum(row.get(g.id, 0) for g in groups)
+        if cores:
+            cap[i, -1] = node.resources.total_cores
+            used[i, -1] = row.get("cores", 0)
+        if any_affinities:
+            dev_aff[i] = device_affinity_boost(node, asks, ctx.regex_cache,
+                                               ctx.version_cache)
+    extra_ask = np.array([float(a.count) for a in asks]
+                         + ([float(cores)] if cores else []))
+    return cap, used, extra_ask, dev_aff, combined_numa_affinity(tg)
+
+
+def _distinct_property_tensors(ctx: EvalContext, job: Job, tg: TaskGroup,
+                               nodes, n_pad: int):
+    """Interned distinct_property values + proposed counts + limits.
+    Counts mirror the host mask's inputs (scheduler/rank.py
+    _plan_aware_job_allocs -> feasible.distinct_property_mask): the job's
+    live allocs as the in-progress plan would leave them."""
+    from ..scheduler.feasible import distinct_property_constraints
+    from ..scheduler.rank import _plan_aware_job_allocs
+
+    constraints = distinct_property_constraints(job, tg)
+    p = len(constraints)
+    if p == 0:
+        z = np.zeros((0, n_pad), dtype=np.int32)
+        return (z, np.zeros((0, n_pad), dtype=bool),
+                np.zeros((0, 1), dtype=np.int32), np.zeros(0))
+
+    live = [a for a in _plan_aware_job_allocs(ctx, job)
+            if not a.terminal_status()]
+    val_ids = np.zeros((p, n_pad), dtype=np.int32)
+    val_ok = np.zeros((p, n_pad), dtype=bool)
+    limits = np.zeros(p)
+    counts_list = []
+    vocabs = []
+    for pi, c in enumerate(constraints):
+        try:
+            limits[pi] = int(c.rtarget) if c.rtarget else 1
+        except ValueError:
+            limits[pi] = 1
+        vocab: Dict[str, int] = {}
+
+        def intern(v: str) -> int:
+            if v not in vocab:
+                vocab[v] = len(vocab)
+            return vocab[v]
+
+        for i, node in enumerate(nodes):
+            v, ok = resolve_target(c.ltarget, node)
+            if ok:
+                val_ids[pi, i] = intern(v)
+                val_ok[pi, i] = True
+        counts: Dict[int, int] = {}
+        for a in live:
+            anode = ctx.snapshot.node_by_id(a.node_id)
+            if anode is None:
+                continue
+            v, ok = resolve_target(c.ltarget, anode)
+            if ok and v in vocab:
+                counts[vocab[v]] = counts.get(vocab[v], 0) + 1
+        vocabs.append(vocab)
+        counts_list.append(counts)
+    v_pad = _pad_pow2(max(max(len(v) for v in vocabs), 1), floor=1)
+    dp_counts = np.zeros((p, v_pad), dtype=np.int32)
+    for pi, counts in enumerate(counts_list):
+        for vid, cnt in counts.items():
+            dp_counts[pi, vid] = cnt
+    return val_ids, val_ok, dp_counts, limits
+
+
 def build_task_group_tensors(
     ctx: EvalContext,
     job: Job,
@@ -276,6 +404,11 @@ def build_task_group_tensors(
         feas[: len(nodes)] &= reserved_ports_mask(tg, nodes, ctx.proposed_allocs)
         dh_tg = True
 
+    extra_cap, extra_used, extra_ask, dev_aff, _ = _device_core_tensors(
+        ctx, tg, cluster)
+    dp_val_id, dp_val_ok, dp_counts, dp_limit = _distinct_property_tensors(
+        ctx, job, tg, nodes, n_pad)
+
     return TaskGroupTensors(
         ask=tg.combined_resources().vec(),
         feasible=feas,
@@ -292,4 +425,12 @@ def build_task_group_tensors(
         dh_job=dh_job,
         dh_tg=dh_tg,
         spread_alg=(algorithm == enums.SCHED_ALG_SPREAD),
+        extra_cap=extra_cap,
+        extra_used=extra_used,
+        extra_ask=extra_ask,
+        dev_affinity=dev_aff,
+        dp_val_id=dp_val_id,
+        dp_val_ok=dp_val_ok,
+        dp_counts=dp_counts,
+        dp_limit=dp_limit,
     )

@@ -61,13 +61,16 @@ def fit_scores(available: jnp.ndarray, used: jnp.ndarray,
 
 def score_nodes(
     *,
-    available,        # (N, D) node capacity minus reserved
+    available,        # (N, D) node capacity minus reserved; D = 4 base
+                      #        dims + one column per device ask + one for
+                      #        reserved cores when the group asks for them
     used,             # (N, D) current proposed usage
     ask,              # (D,)   task-group resource ask
     feasible,         # (N,)   bool: constraints+drivers+devices mask
     placed_tg,        # (N,)   proposed allocs of this job+tg per node
     placed_job,       # (N,)   proposed allocs of this job per node
     affinity_boost,   # (N,)   precomputed sum(weight)/sum|weight| per node
+    dev_affinity,     # (N,)   device-affinity sub-score per node (0 = absent)
     penalty_idx,      # ()     node index to penalize (-1 = none)
     spread_val_id,    # (S, N) interned spread-attr value per node
     spread_val_ok,    # (S, N) bool: node has the attribute
@@ -75,6 +78,10 @@ def score_nodes(
     spread_desired,   # (S, V) desired count per value (NaN = no target)
     spread_has_targets,  # (S,) bool: explicit targets vs even-spread
     spread_weight,    # (S,)  weight / sum|weights|
+    dp_val_id,        # (P, N) interned distinct_property value per node
+    dp_val_ok,        # (P, N) bool: node has the property
+    dp_counts,        # (P, Vd) proposed alloc count per property value
+    dp_limit,         # (P,)   max allocs per value (propertyset rtarget)
     lowest_boost,     # ()    running minimum explicit boost (spread.go)
     tg_count,         # ()    task group desired count
     dh_job,           # ()    bool: job-level distinct_hosts
@@ -88,6 +95,9 @@ def score_nodes(
     score is the *mean of the sub-scores that apply* (reference
     rank.go:800 ScoreNormalizationIterator) — each sub-score carries a
     presence flag and the divisor is the number of present sub-scores.
+    Fit scoring only reads the first two columns (cpu, mem — reference
+    funcs.go:213), so the appended device/core columns participate in
+    feasibility without perturbing the score.
     """
     n = available.shape[0]
     new_used = used + ask[None, :]
@@ -95,6 +105,14 @@ def score_nodes(
     ok = feasible & jnp.all(new_used <= available, axis=1)
     ok &= jnp.where(dh_job, placed_job == 0, True)
     ok &= jnp.where(dh_tg, placed_tg == 0, True)
+
+    # distinct_property cap (reference scheduler/propertyset.go via
+    # feasible.go:649 DistinctPropertyIterator): a node is infeasible if
+    # it lacks the property or its value's proposed count is at the limit
+    if dp_val_id.shape[0]:
+        dp_at = jnp.take_along_axis(dp_counts, dp_val_id, axis=1)  # (P, N)
+        dp_ok = dp_val_ok & (dp_at < dp_limit[:, None])
+        ok &= jnp.all(dp_ok, axis=0)
 
     fitness = fit_scores(available, new_used, spread_alg)
 
@@ -107,6 +125,10 @@ def score_nodes(
 
     # node affinity (reference rank.go:710); boost precomputed host-side
     aff_present = affinity_boost != 0.0
+
+    # device affinity (host oracle's separate "device-affinity" sub-score;
+    # reference rank.go folds the deviceAllocator offer score in)
+    dev_present = dev_affinity != 0.0
 
     # spread (reference spread.go:128 + propertyset.go)
     counts_at = jnp.take_along_axis(spread_counts, spread_val_id, axis=1)  # (S, N)
@@ -159,6 +181,7 @@ def score_nodes(
         + anti_present.astype(fitness.dtype)
         + resched_present.astype(fitness.dtype)
         + aff_present.astype(fitness.dtype)
+        + dev_present.astype(fitness.dtype)
         + spread_present.astype(fitness.dtype)
     )
     total = (
@@ -166,6 +189,7 @@ def score_nodes(
         + jnp.where(anti_present, anti, 0.0)
         + jnp.where(resched_present, -1.0, 0.0)
         + jnp.where(aff_present, affinity_boost, 0.0)
+        + jnp.where(dev_present, dev_affinity, 0.0)
         + jnp.where(spread_present, spread_total, 0.0)
     )
     final = total / divisor
@@ -181,6 +205,7 @@ def solve_task_group(
     ask,               # (D,)
     feasible,          # (N,)  bool
     affinity_boost,    # (N,)
+    dev_affinity,      # (N,)
     penalty_idx,       # (K,)  int32, -1 = none
     active,            # (K,)  bool (False = padding step)
     spread_val_id,     # (S, N) int32
@@ -189,6 +214,10 @@ def solve_task_group(
     spread_desired,    # (S, V)
     spread_has_targets,  # (S,) bool
     spread_weight,     # (S,)
+    dp_val_id,         # (P, N) int32
+    dp_val_ok,         # (P, N) bool
+    dp_counts0,        # (P, Vd) int32
+    dp_limit,          # (P,)
     lowest_boost0,     # ()
     tg_count,          # ()
     dh_job,            # () bool
@@ -200,24 +229,28 @@ def solve_task_group(
     and the winning normalized score.
 
     The scan carry is the proposed cluster state — usage, per-node
-    placement counts, spread value counts — exactly the state the host
-    path threads through ctx.proposed_allocs + SpreadScorer between
-    placements (generic_sched.go:511-600 commit loop).
+    placement counts, spread value counts, distinct_property value
+    counts — exactly the state the host path threads through
+    ctx.proposed_allocs + SpreadScorer + propertyset between placements
+    (generic_sched.go:511-600 commit loop).
     """
     s = spread_val_id.shape[0]
+    p = dp_val_id.shape[0]
     n = available.shape[0]
 
     def step(carry, xs):
-        used, ptg, pjob, scnt, lowest = carry
+        used, ptg, pjob, scnt, dpcnt, lowest = carry
         pen_idx, is_active = xs
 
         score, fitness, boost = score_nodes(
             available=available, used=used, ask=ask, feasible=feasible,
             placed_tg=ptg, placed_job=pjob, affinity_boost=affinity_boost,
-            penalty_idx=pen_idx,
+            dev_affinity=dev_affinity, penalty_idx=pen_idx,
             spread_val_id=spread_val_id, spread_val_ok=spread_val_ok,
             spread_counts=scnt, spread_desired=spread_desired,
             spread_has_targets=spread_has_targets, spread_weight=spread_weight,
+            dp_val_id=dp_val_id, dp_val_ok=dp_val_ok, dp_counts=dpcnt,
+            dp_limit=dp_limit,
             lowest_boost=lowest, tg_count=tg_count,
             dh_job=dh_job, dh_tg=dh_tg, spread_alg=spread_alg,
         )
@@ -233,6 +266,12 @@ def solve_task_group(
         sel_val = spread_val_id[:, choice]                          # (S,)
         scnt = scnt.at[jnp.arange(s), sel_val].add(sel_ok.astype(scnt.dtype))
 
+        if p:
+            dsel_ok = dp_val_ok[:, choice] & found                 # (P,)
+            dsel_val = dp_val_id[:, choice]                        # (P,)
+            dpcnt = dpcnt.at[jnp.arange(p), dsel_val].add(
+                dsel_ok.astype(dpcnt.dtype))
+
         # SpreadIterator tracks the lowest explicit boost it has handed
         # out (spread.go lowestBoost); we update it with the chosen
         # node's explicit boosts
@@ -240,10 +279,11 @@ def solve_task_group(
                                  boost[:, choice], jnp.inf)
         lowest = jnp.minimum(lowest, jnp.min(chosen_boost, initial=jnp.inf))
 
-        return (used, ptg, pjob, scnt, lowest), (choice, found, score[choice])
+        return (used, ptg, pjob, scnt, dpcnt, lowest), (choice, found, score[choice])
 
-    init = (used0, placed_tg0, placed_job0, spread_counts0, lowest_boost0)
-    (_, _, _, _, _), (choices, founds, scores) = jax.lax.scan(
+    init = (used0, placed_tg0, placed_job0, spread_counts0, dp_counts0,
+            lowest_boost0)
+    _, (choices, founds, scores) = jax.lax.scan(
         init=init, f=step, xs=(penalty_idx, active))
     return choices, founds, scores
 
@@ -258,11 +298,14 @@ def solve_task_group(
 # one packed output so a whole task-group solve costs one upload batch
 # and one readback.
 #
-# node_mat (N, 2D+4): avail[D] | used[D] | placed_tg | placed_job | feasible | affinity
+# node_mat (N, 2D+5): avail[D] | used[D] | placed_tg | placed_job | feasible
+#                     | affinity | dev_affinity
 # step_mat (K, 2):  penalty_idx | active
 # spread_node (2S, N): val_id rows then val_ok rows
 # spread_tab (2S, V):  counts rows then desired rows
 # spread_meta (S, 2):  has_targets | weight
+# dp_node (2P, N): val_id rows then val_ok rows
+# dp_tab (P, Vd+1): counts columns | limit column
 # scalars (5+D,): lowest_boost | tg_count | dh_job | dh_tg | spread_alg | ask[D]
 
 
@@ -270,15 +313,21 @@ def pack_solve_args(available, used0, placed_tg0, placed_job0, ask, feasible,
                     affinity_boost, penalty_idx, active, spread_val_id,
                     spread_val_ok, spread_counts0, spread_desired,
                     spread_has_targets, spread_weight, lowest_boost0,
-                    tg_count, dh_job, dh_tg, spread_alg):
+                    tg_count, dh_job, dh_tg, spread_alg,
+                    dev_affinity=None, dp_val_id=None, dp_val_ok=None,
+                    dp_counts0=None, dp_limit=None):
     """Host-side packing (numpy) for solve_task_group_fused."""
     import numpy as np
 
     f = np.float32
+    n = np.asarray(available).shape[0]
+    if dev_affinity is None:
+        dev_affinity = np.zeros(n, f)
     node_mat = np.concatenate([
         np.asarray(available, f), np.asarray(used0, f),
         np.asarray(placed_tg0, f)[:, None], np.asarray(placed_job0, f)[:, None],
         np.asarray(feasible, f)[:, None], np.asarray(affinity_boost, f)[:, None],
+        np.asarray(dev_affinity, f)[:, None],
     ], axis=1)
     step_mat = np.stack([np.asarray(penalty_idx, f),
                          np.asarray(active, f)], axis=1)
@@ -289,28 +338,41 @@ def pack_solve_args(available, used0, placed_tg0, placed_job0, ask, feasible,
     spread_meta = np.stack([np.asarray(spread_has_targets, f),
                             np.asarray(spread_weight, f)], axis=1) \
         if len(spread_weight) else np.zeros((0, 2), f)
+    if dp_val_id is None or not len(dp_val_id):
+        dp_node = np.zeros((0, n), f)
+        dp_tab = np.zeros((0, 2), f)
+    else:
+        dp_node = np.concatenate([np.asarray(dp_val_id, f),
+                                  np.asarray(dp_val_ok, f)], axis=0)
+        dp_tab = np.concatenate([np.asarray(dp_counts0, f),
+                                 np.asarray(dp_limit, f)[:, None]], axis=1)
     scalars = np.concatenate([
         np.array([lowest_boost0, tg_count, dh_job, dh_tg, spread_alg], f),
         np.asarray(ask, f)])
-    return node_mat, step_mat, spread_node, spread_tab, spread_meta, scalars
+    return (node_mat, step_mat, spread_node, spread_tab, spread_meta,
+            dp_node, dp_tab, scalars)
 
 
 @jax.jit
 def solve_task_group_fused(node_mat, step_mat, spread_node, spread_tab,
-                           spread_meta, scalars):
+                           spread_meta, dp_node, dp_tab, scalars):
     """Transfer-fused solve: unpack on device, run the same scan, return
     one (3, K) array of [choice, found, score] rows."""
     s = spread_meta.shape[0]
-    d = (node_mat.shape[1] - 4) // 2
+    p = dp_node.shape[0] // 2
+    d = (node_mat.shape[1] - 5) // 2
     choices, founds, scores = solve_task_group(
         node_mat[:, 0:d], node_mat[:, d:2 * d],
         node_mat[:, 2 * d].astype(jnp.int32),
         node_mat[:, 2 * d + 1].astype(jnp.int32),
         scalars[5:5 + d], node_mat[:, 2 * d + 2] > 0.5, node_mat[:, 2 * d + 3],
+        node_mat[:, 2 * d + 4],
         step_mat[:, 0].astype(jnp.int32), step_mat[:, 1] > 0.5,
         spread_node[:s].astype(jnp.int32), spread_node[s:] > 0.5,
         spread_tab[:s].astype(jnp.int32), spread_tab[s:],
         spread_meta[:, 0] > 0.5, spread_meta[:, 1],
+        dp_node[:p].astype(jnp.int32), dp_node[p:] > 0.5,
+        dp_tab[:, :-1].astype(jnp.int32), dp_tab[:, -1],
         scalars[0], scalars[1], scalars[2] > 0.5, scalars[3] > 0.5,
         scalars[4] > 0.5,
     )
@@ -318,22 +380,34 @@ def solve_task_group_fused(node_mat, step_mat, spread_node, spread_tab,
                       founds.astype(scores.dtype), scores])
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=())
 def score_nodes_once(
     available, used, ask, feasible, placed_tg, placed_job, affinity_boost,
     penalty_idx, spread_val_id, spread_val_ok, spread_counts, spread_desired,
     spread_has_targets, spread_weight, lowest_boost, tg_count, dh_job, dh_tg,
-    spread_alg,
+    spread_alg, dev_affinity=None, dp_val_id=None, dp_val_ok=None,
+    dp_counts=None, dp_limit=None,
 ):
     """Single-placement score vector — the differential-test surface
     pinned against the host oracle scheduler.rank.score_nodes."""
+    n = available.shape[0]
+    if dev_affinity is None:
+        dev_affinity = jnp.zeros(n)
+    if dp_val_id is None:
+        dp_val_id = jnp.zeros((0, n), jnp.int32)
+        dp_val_ok = jnp.zeros((0, n), bool)
+        dp_counts = jnp.zeros((0, 1), jnp.int32)
+        dp_limit = jnp.zeros(0)
     score, _, _ = score_nodes(
         available=available, used=used, ask=ask, feasible=feasible,
         placed_tg=placed_tg, placed_job=placed_job,
-        affinity_boost=affinity_boost, penalty_idx=penalty_idx,
+        affinity_boost=affinity_boost, dev_affinity=dev_affinity,
+        penalty_idx=penalty_idx,
         spread_val_id=spread_val_id, spread_val_ok=spread_val_ok,
         spread_counts=spread_counts, spread_desired=spread_desired,
         spread_has_targets=spread_has_targets, spread_weight=spread_weight,
+        dp_val_id=dp_val_id, dp_val_ok=dp_val_ok, dp_counts=dp_counts,
+        dp_limit=dp_limit,
         lowest_boost=lowest_boost, tg_count=tg_count,
         dh_job=dh_job, dh_tg=dh_tg, spread_alg=spread_alg,
     )

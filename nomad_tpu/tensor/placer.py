@@ -5,16 +5,20 @@ nomad/structs/operator.go:199-255).
 Lowering strategy per evaluation:
   1. one ClusterTensors build (nodes + proposed usage),
   2. per task group: host-precompiled feasibility/affinity/spread arrays,
+     device/core count columns, and distinct_property cap tables,
   3. one jitted solve_task_group scan placing all of the group's
      requests with full cross-placement visibility,
   4. commits mapped back through the scheduler's commit callback so the
-     plan object and ctx.proposed_allocs stay authoritative.
+     plan object and ctx.proposed_allocs stay authoritative. Exact port
+     numbers, device instance ids, and core ids are assigned host-side
+     per chosen node after the solve (counts were fit on-device).
 
 Preemption stays host-side: when the kernel finds no fit and preemption
 is enabled, the per-request fallback runs the host NodeScorer preemption
-path (reference rank.go:205-587's preemption fallback arm). Task groups
-asking for devices or reserved cores also fall back — their per-instance
-fit logic lands with the device kernels.
+path (reference rank.go:205-587's preemption fallback arm). A request
+whose post-solve id assignment fails (NUMA "require" mispredicted by
+count-fit, overlapping device asks) falls back to the host selector for
+that request alone.
 """
 
 from __future__ import annotations
@@ -25,20 +29,9 @@ import numpy as np
 
 from ..structs import Job, Node, enums
 from ..scheduler.context import EvalContext
-from ..scheduler.feasible import distinct_property_constraints
 from ..scheduler.rank import NodeScorer, RankedNode, select_best_node
 from ..scheduler.reconcile import PlacementRequest
 from .cluster import ClusterTensors, build_task_group_tensors, _pad_pow2
-
-
-def _needs_host_path(job: Job, tg) -> bool:
-    if any(t.resources.devices for t in tg.tasks):
-        return True
-    if any(t.resources.cores for t in tg.tasks):
-        return True
-    if distinct_property_constraints(job, tg):
-        return True
-    return False
 
 
 class TPUPlacer:
@@ -88,23 +81,11 @@ class TPUPlacer:
                 order.append(name)
             groups[name].append(req)
 
-        host_fallback = None
         for gi, name in enumerate(order):
             reqs = groups[name]
             tg = reqs[0].task_group
             if gi > 0:  # build() already computed usage for the first group
                 cluster.refresh_usage(ctx)
-
-            if _needs_host_path(job, tg):
-                if host_fallback is None:
-                    from ..scheduler.placer import HostPlacer
-
-                    host_fallback = HostPlacer(algorithm=self.algorithm)
-                host_fallback.place(ctx, job, reqs, nodes, commit,
-                                    batch=batch,
-                                    preemption_enabled=preemption_enabled,
-                                    attempt=attempt)
-                continue
 
             tgt = build_task_group_tensors(ctx, job, tg, cluster,
                                            algorithm=self.algorithm)
@@ -118,25 +99,46 @@ class TPUPlacer:
                 if req.ignore_node:
                     penalty_idx[i] = cluster.node_index.get(req.ignore_node, -1)
 
+            # device/core count columns extend the dense dims per group
+            has_extra = tgt.extra_ask is not None and len(tgt.extra_ask)
+            if has_extra:
+                avail = np.concatenate([cluster.available, tgt.extra_cap], axis=1)
+                used = np.concatenate([cluster.used, tgt.extra_used], axis=1)
+                ask = np.concatenate([tgt.ask, tgt.extra_ask])
+            else:
+                avail, used, ask = cluster.available, cluster.used, tgt.ask
+
             packed = pack_solve_args(
-                cluster.available, cluster.used, tgt.placed_tg, tgt.placed_job,
-                tgt.ask, tgt.feasible, tgt.affinity_boost, penalty_idx, active,
+                avail, used, tgt.placed_tg, tgt.placed_job,
+                ask, tgt.feasible, tgt.affinity_boost, penalty_idx, active,
                 tgt.spread_val_id, tgt.spread_val_ok, tgt.spread_counts,
                 tgt.spread_desired, tgt.spread_has_targets, tgt.spread_weight,
-                -1.0, tgt.tg_count, tgt.dh_job, tgt.dh_tg, tgt.spread_alg)
+                -1.0, tgt.tg_count, tgt.dh_job, tgt.dh_tg, tgt.spread_alg,
+                dev_affinity=tgt.dev_affinity,
+                dp_val_id=tgt.dp_val_id, dp_val_ok=tgt.dp_val_ok,
+                dp_counts0=tgt.dp_counts, dp_limit=tgt.dp_limit)
             out = np.asarray(solve_task_group_fused(*packed))  # one readback
             choices = out[0].astype(np.int64)
             founds = out[1] > 0.5
             scores = out[2]
 
-            # exact port numbers are host-side, per node, after the solve
-            # (the kernel only fit-checked the counts); one NetworkIndex
-            # per chosen node carries assignments across this group's
-            # placements so they don't double-book
+            # exact port numbers / device instances / core ids are
+            # host-side, per chosen node, after the solve (the kernel only
+            # fit-checked the counts); per-node indexes carry assignments
+            # across this group's placements so they don't double-book
             ask_res = tg.combined_resources()
             wants_ports = bool(ask_res.reserved_port_asks()
                                or ask_res.dynamic_port_count())
+            wants_devices = bool(ask_res.devices)
+            wants_cores = bool(ask_res.cores)
+            numa_pol = "none"
+            if wants_cores:
+                from ..scheduler.devices import combined_numa_affinity
+
+                numa_pol = combined_numa_affinity(tg)
             net_idx: Dict[int, object] = {}
+            dev_idx: Dict[int, object] = {}
+            core_used: Dict[int, set] = {}
 
             n_feasible = int(tgt.feasible[: len(nodes)].sum())
             for i, req in enumerate(reqs):
@@ -163,13 +165,38 @@ class TPUPlacer:
                             commit(req, None)
                             continue
                         option.allocated_ports = ports
+                    if wants_devices or wants_cores:
+                        ok = self._assign_ids(ctx, ask_res, numa_pol, ni, node,
+                                              option, dev_idx, core_used)
+                        if not ok:
+                            # count-fit admitted a node the exact id
+                            # assignment can't satisfy (NUMA require /
+                            # overlapping asks): host selector for this
+                            # request alone
+                            option = self._host_one(ctx, job, tg, nodes, req,
+                                                    batch, preemption_enabled,
+                                                    attempt)
+                            commit(req, option)
+                            if option is not None:
+                                # the fallback assigned ids on its own
+                                # node; drop that node's caches so later
+                                # kernel placements rebuild them from the
+                                # committed plan instead of double-booking
+                                self._invalidate_node(
+                                    cluster, option.node.id,
+                                    net_idx, dev_idx, core_used)
+                            continue
                     commit(req, option)
                     continue
                 if preemption_enabled:
                     option = self._preempt_fallback(ctx, job, tg, nodes, req,
-                                                    attempt)
+                                                    batch, attempt)
                     if option is not None:
                         commit(req, option)
+                        # evictions + the fallback's own id assignments
+                        # invalidate this node's port/device/core caches
+                        self._invalidate_node(cluster, option.node.id,
+                                              net_idx, dev_idx, core_used)
                         continue
                     metrics = ctx.metrics or metrics
                 # attribute the failure the way the host path would: nodes
@@ -186,16 +213,73 @@ class TPUPlacer:
                     metrics.exhaust_node("resources")
                 commit(req, None)
 
-    def _preempt_fallback(self, ctx, job, tg, nodes, req,
+    def _assign_ids(self, ctx, ask_res, numa_pol: str, ni: int, node,
+                    option: RankedNode, dev_idx: Dict[int, object],
+                    core_used: Dict[int, set]) -> bool:
+        """Post-solve concrete id assignment for one placement on the
+        chosen node. Per-node indexes live for the group's whole pass so
+        sibling placements never double-book. A False return leaves any
+        staged device instances reserved — conservative, and only
+        reachable on count-fit mispredictions."""
+        from ..scheduler.devices import DeviceIndex, select_cores, used_cores
+
+        proposed = None
+        if ask_res.devices:
+            idx = dev_idx.get(ni)
+            if idx is None:
+                proposed = ctx.proposed_allocs(node.id)
+                idx = dev_idx[ni] = DeviceIndex(node, proposed)
+            assignment = idx.assign(ask_res.devices, ctx.regex_cache,
+                                    ctx.version_cache)
+            if assignment is None:
+                return False
+            option.allocated_devices = assignment
+        if ask_res.cores:
+            taken = core_used.get(ni)
+            if taken is None:
+                if proposed is None:
+                    proposed = ctx.proposed_allocs(node.id)
+                taken = core_used[ni] = used_cores(proposed)
+            cores = select_cores(node, (), int(ask_res.cores), numa_pol,
+                                 taken=taken)
+            if cores is None:
+                return False
+            taken.update(cores)
+            option.allocated_cores = cores
+        return True
+
+    @staticmethod
+    def _invalidate_node(cluster, node_id: str, *caches: Dict[int, object]) -> None:
+        ni = cluster.node_index.get(node_id)
+        if ni is not None:
+            for cache in caches:
+                cache.pop(ni, None)
+
+    def _host_algorithm(self) -> str:
+        return (enums.SCHED_ALG_BINPACK
+                if self.algorithm == enums.SCHED_ALG_TPU_BINPACK
+                else self.algorithm)
+
+    def _host_one(self, ctx, job, tg, nodes, req, batch: bool,
+                  preemption_enabled: bool, attempt: int) -> Optional[RankedNode]:
+        penalty = frozenset({req.ignore_node}) if req.ignore_node else frozenset()
+        return select_best_node(
+            ctx, job, tg, nodes,
+            batch=batch,
+            algorithm=self._host_algorithm(),
+            preemption_enabled=preemption_enabled,
+            penalty_nodes=penalty,
+            attempt=attempt,
+        )
+
+    def _preempt_fallback(self, ctx, job, tg, nodes, req, batch: bool,
                           attempt: int) -> Optional[RankedNode]:
         penalty = frozenset({req.ignore_node}) if req.ignore_node else frozenset()
-        option = select_best_node(
+        return select_best_node(
             ctx, job, tg, nodes,
-            algorithm=(enums.SCHED_ALG_BINPACK
-                       if self.algorithm == enums.SCHED_ALG_TPU_BINPACK
-                       else self.algorithm),
+            batch=batch,
+            algorithm=self._host_algorithm(),
             preemption_enabled=True,
             penalty_nodes=penalty,
             attempt=attempt,
         )
-        return option

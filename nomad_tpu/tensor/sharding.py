@@ -58,8 +58,11 @@ def pad_node_axis(args: tuple, multiple: int) -> tuple:
     args[3] = _pad(args[3], 0, 0)          # placed_job0
     args[5] = _pad(args[5], 0, False)      # feasible
     args[6] = _pad(args[6], 0, 0.0)        # affinity_boost
-    args[9] = _pad(args[9], 1, 0)          # spread_val_id
-    args[10] = _pad(args[10], 1, False)    # spread_val_ok
+    args[7] = _pad(args[7], 0, 0.0)        # dev_affinity
+    args[10] = _pad(args[10], 1, 0)        # spread_val_id
+    args[11] = _pad(args[11], 1, False)    # spread_val_ok
+    args[16] = _pad(args[16], 1, 0)        # dp_val_id
+    args[17] = _pad(args[17], 1, False)    # dp_val_ok
     return tuple(args)
 
 
@@ -69,20 +72,24 @@ def shard_solve_args(mesh: Mesh, args: tuple, axis: str = "nodes"):
     mesh size first (see pad_node_axis).
 
     Argument order mirrors kernels.solve_task_group:
-      0 available (N,D)   sharded    8 active (K,)          repl
-      1 used0 (N,D)       sharded    9 spread_val_id (S,N)  sharded ax1
-      2 placed_tg0 (N,)   sharded   10 spread_val_ok (S,N)  sharded ax1
-      3 placed_job0 (N,)  sharded   11 spread_counts0 (S,V) repl
-      4 ask (D,)          repl      12 spread_desired (S,V) repl
-      5 feasible (N,)     sharded   13 spread_has_targets   repl
-      6 affinity (N,)     sharded   14 spread_weight (S,)   repl
-      7 penalty_idx (K,)  repl      15.. scalars            repl
+      0 available (N,D)   sharded   10 spread_val_id (S,N)  sharded ax1
+      1 used0 (N,D)       sharded   11 spread_val_ok (S,N)  sharded ax1
+      2 placed_tg0 (N,)   sharded   12 spread_counts0 (S,V) repl
+      3 placed_job0 (N,)  sharded   13 spread_desired (S,V) repl
+      4 ask (D,)          repl      14 spread_has_targets   repl
+      5 feasible (N,)     sharded   15 spread_weight (S,)   repl
+      6 affinity (N,)     sharded   16 dp_val_id (P,N)      sharded ax1
+      7 dev_affinity (N,) sharded   17 dp_val_ok (P,N)      sharded ax1
+      8 penalty_idx (K,)  repl      18 dp_counts0 (P,Vd)    repl
+      9 active (K,)       repl      19 dp_limit (P,)        repl
+                                    20.. scalars            repl
     """
     args = pad_node_axis(args, int(np.prod(mesh.devices.shape)))
     specs = [
         P(axis, None), P(axis, None), P(axis), P(axis),
-        P(), P(axis), P(axis), P(), P(),
+        P(), P(axis), P(axis), P(axis), P(), P(),
         P(None, axis), P(None, axis), P(), P(), P(), P(),
+        P(None, axis), P(None, axis), P(), P(),
     ]
     specs += [P()] * (len(args) - len(specs))
     out = []

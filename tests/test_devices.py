@@ -178,6 +178,82 @@ class TestSchedulerIntegration:
             cores = [c for a in allocs_on_node for c in a.allocated_cores]
             assert len(cores) == len(set(cores))
 
+    @pytest.mark.parametrize("algorithm", [enums.SCHED_ALG_BINPACK,
+                                           enums.SCHED_ALG_TPU_BINPACK])
+    def test_distinct_property_limit(self, algorithm):
+        """distinct_property caps allocs per attribute value on both the
+        host iterator and the kernel's dp-count carry
+        (reference scheduler/propertyset.go)."""
+        h = Harness()
+        for i in range(6):
+            n = mock.node()
+            n.attributes["rack"] = f"r{i % 3}"  # 3 racks x 2 nodes
+            n.compute_class()
+            h.store.upsert_node(n)
+        j = mock.job()
+        j.constraints.append(Constraint(
+            ltarget="${attr.rack}", rtarget="1",
+            operand=enums.CONSTRAINT_DISTINCT_PROPERTY))
+        tg = j.task_groups[0]
+        tg.count = 5  # only 3 can place: one per rack
+        h.store.upsert_job(j)
+        h.process(mock.eval_for(j), sched_config=SchedulerConfiguration(
+            scheduler_algorithm=algorithm))
+        allocs = [a for a in h.store.snapshot().allocs_by_job(j.id)
+                  if not a.terminal_status()]
+        assert len(allocs) == 3, len(allocs)
+        snap = h.store.snapshot()
+        racks = [snap.node_by_id(a.node_id).attributes["rack"] for a in allocs]
+        assert sorted(racks) == ["r0", "r1", "r2"]
+
+    @pytest.mark.parametrize("algorithm", [enums.SCHED_ALG_BINPACK,
+                                           enums.SCHED_ALG_TPU_BINPACK])
+    def test_distinct_property_limit_two(self, algorithm):
+        h = Harness()
+        for i in range(4):
+            n = mock.node()
+            n.attributes["zone"] = f"z{i % 2}"
+            n.compute_class()
+            h.store.upsert_node(n)
+        j = mock.job()
+        j.constraints.append(Constraint(
+            ltarget="${attr.zone}", rtarget="2",
+            operand=enums.CONSTRAINT_DISTINCT_PROPERTY))
+        j.task_groups[0].count = 6  # cap: 2 per zone -> 4 place
+        h.store.upsert_job(j)
+        h.process(mock.eval_for(j), sched_config=SchedulerConfiguration(
+            scheduler_algorithm=algorithm))
+        allocs = [a for a in h.store.snapshot().allocs_by_job(j.id)
+                  if not a.terminal_status()]
+        assert len(allocs) == 4
+        snap = h.store.snapshot()
+        zones = [snap.node_by_id(a.node_id).attributes["zone"] for a in allocs]
+        assert sorted(zones) == ["z0", "z0", "z1", "z1"]
+
+    def test_device_job_respects_existing_usage_kernel(self):
+        """Kernel path: device columns see instances held by committed
+        allocs of a previous eval."""
+        h = Harness()
+        node = gpu_node(n_gpus=2)
+        h.store.upsert_node(node)
+        cfg = SchedulerConfiguration(
+            scheduler_algorithm=enums.SCHED_ALG_TPU_BINPACK)
+        j1 = mock.job()
+        j1.task_groups[0].count = 1
+        j1.task_groups[0].tasks[0].resources.devices = [
+            RequestedDevice(name="gpu", count=2)]
+        h.store.upsert_job(j1)
+        h.process(mock.eval_for(j1), sched_config=cfg)
+        assert len(h.store.snapshot().allocs_by_job(j1.id)) == 1
+        j2 = mock.job()
+        j2.task_groups[0].count = 1
+        j2.task_groups[0].tasks[0].resources.devices = [
+            RequestedDevice(name="gpu", count=1)]
+        h.store.upsert_job(j2)
+        h.process(mock.eval_for(j2), sched_config=cfg)
+        assert len([a for a in h.store.snapshot().allocs_by_job(j2.id)
+                    if not a.terminal_status()]) == 0  # no free instance
+
     def test_device_exhaustion_blocks(self):
         h = Harness()
         h.store.upsert_node(gpu_node(n_gpus=1))
