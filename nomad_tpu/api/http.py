@@ -28,8 +28,14 @@ MAX_BLOCK_S = 30.0
 class HTTPAgent:
     """The agent HTTP server. Start with port=0 for an ephemeral port."""
 
-    def __init__(self, server, host: str = "127.0.0.1", port: int = 4646):
+    def __init__(self, server, host: str = "127.0.0.1", port: int = 4646,
+                 writer=None):
         self.server = server
+        # In a replicated deployment `writer` is the ReplicatedServer
+        # facade: mutating verbs route to the raft leader (local or over
+        # the socket transport) while reads stay on the local replica's
+        # store — the reference's HTTP-agent -> RPC forward split.
+        self.writer = writer if writer is not None else server
         agent = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -299,6 +305,11 @@ class HTTPAgent:
             return h._reply(200, ev)
 
         if path == "/v1/status/leader":
+            raft = getattr(self.writer, "raft", None)
+            if raft is not None:
+                return h._reply(200, {
+                    "leader": raft.leader_id or "",
+                    "is_leader": self.writer.is_leader()})
             return h._reply(200, "local")
         if path == "/v1/agent/self":
             return h._reply(200, {
@@ -352,30 +363,30 @@ class HTTPAgent:
                 return h._error(403, "Permission denied")
 
         if path == "/v1/acl/bootstrap":
-            token = self.server.acl_bootstrap()
+            token = self.writer.acl_bootstrap()
             return h._reply(200, {"accessor_id": token.accessor_id,
                                   "secret_id": token.secret_id,
                                   "type": token.type})
         if m := re.fullmatch(r"/v1/acl/policy/([^/]+)", path):
-            self.server.upsert_acl_policy(
+            self.writer.upsert_acl_policy(
                 m.group(1), body.get("rules", body.get("Rules", "{}")),
                 body.get("description", ""))
             return h._reply(200, {"ok": True})
         if path == "/v1/acl/token":
-            token = self.server.create_acl_token(
+            token = self.writer.create_acl_token(
                 body.get("name", ""), body.get("policies", []),
                 body.get("type", "client"))
             return h._reply(200, {"accessor_id": token.accessor_id,
                                   "secret_id": token.secret_id})
         if m := re.fullmatch(r"/v1/var/(.+)", path):
-            self.server.put_variable(m.group(1), body.get("items", {}), ns)
+            self.writer.put_variable(m.group(1), body.get("items", {}), ns)
             return h._reply(200, {"ok": True})
 
         if path == "/v1/jobs":
             data = body.get("job") or body.get("Job") or body
             job = from_dict(Job, data)
             _validate(job)
-            eval_id = self.server.register_job(job)
+            eval_id = self.writer.register_job(job)
             return h._reply(200, {"eval_id": eval_id, "job_id": job.id})
         if m := re.fullmatch(r"/v1/job/([^/]+)/evaluate", path):
             ns = q.get("namespace", ["default"])[0]
@@ -383,26 +394,25 @@ class HTTPAgent:
             job = snap.job_by_id(m.group(1), ns)
             if job is None:
                 return h._error(404, "job not found")
-            eval_id = self.server._create_job_eval(job, enums.TRIGGER_JOB_REGISTER)
+            eval_id = self.writer.create_job_eval(job, enums.TRIGGER_JOB_REGISTER)
             return h._reply(200, {"eval_id": eval_id})
         if m := re.fullmatch(r"/v1/node/([^/]+)/drain", path):
             spec = body.get("drain_spec")
             strategy = None
             if spec is not None:
                 strategy = from_dict(DrainStrategy, spec)
-            self.server.update_node_drain(m.group(1), strategy,
+            self.writer.update_node_drain(m.group(1), strategy,
                                           bool(body.get("mark_eligible")))
             return h._reply(200, {"ok": True})
         if m := re.fullmatch(r"/v1/node/([^/]+)/eligibility", path):
-            self.server.update_node_eligibility(m.group(1),
+            self.writer.update_node_eligibility(m.group(1),
                                                 body.get("eligibility", ""))
             return h._reply(200, {"ok": True})
         if path == "/v1/operator/scheduler/configuration":
             from ..structs.operator import SchedulerConfiguration
 
             cfg = from_dict(SchedulerConfiguration, body)
-            self.server.sched_config = cfg
-            self.server.config.sched_config = cfg
+            self.writer.set_scheduler_config(cfg)
             return h._reply(200, {"updated": True})
         if path == "/v1/operator/snapshot":
             # whole-state restore (reference operator_snapshot_restore);
@@ -414,7 +424,7 @@ class HTTPAgent:
                                   "index": self.server.store.latest_index})
         if m := re.fullmatch(r"/v1/deployment/promote/([^/]+)", path):
             try:
-                eval_id = self.server.promote_deployment(
+                eval_id = self.writer.promote_deployment(
                     m.group(1), groups=body.get("groups"))
             except KeyError as e:
                 return h._error(404, str(e))
@@ -423,7 +433,7 @@ class HTTPAgent:
             return h._reply(200, {"eval_id": eval_id})
         if m := re.fullmatch(r"/v1/deployment/fail/([^/]+)", path):
             try:
-                self.server.fail_deployment(m.group(1))
+                self.writer.fail_deployment(m.group(1))
             except KeyError as e:
                 return h._error(404, str(e))
             except ValueError as e:
@@ -439,12 +449,12 @@ class HTTPAgent:
             if not self._ns_allowed(acl, ns, aclp.CAP_SUBMIT_JOB):
                 return h._error(403, "Permission denied")
             purge = q.get("purge", ["false"])[0] in ("true", "1")
-            eval_id = self.server.deregister_job(m.group(1), ns, purge=purge)
+            eval_id = self.writer.deregister_job(m.group(1), ns, purge=purge)
             return h._reply(200, {"eval_id": eval_id})
         if m := re.fullmatch(r"/v1/var/(.+)", path):
             if not self._ns_allowed(acl, ns, aclp.CAP_VARIABLES_WRITE):
                 return h._error(403, "Permission denied")
-            self.server.delete_variable(m.group(1), ns)
+            self.writer.delete_variable(m.group(1), ns)
             return h._reply(200, {"ok": True})
         h._error(404, f"no such route {path}")
 

@@ -48,19 +48,45 @@ def cmd_agent(args) -> int:
     cfg = ServerConfig(
         num_workers=args.workers,
         sched_config=SchedulerConfiguration(scheduler_algorithm=args.algorithm))
-    server = Server(cfg)
-    server.start()
+
+    replicated = transport = None
+    if args.peers:
+        # multi-server mode: raft over the socket transport (reference
+        # `nomad agent -server -bootstrap-expect N`)
+        from .raft.cluster import ReplicatedServer
+        from .raft.transport import SocketTransport
+
+        peers = dict(p.split("=", 1) for p in args.peers.split(","))
+        if args.server_id not in peers:
+            print(f"--server-id {args.server_id!r} not in --peers", file=sys.stderr)
+            return 1
+        transport = SocketTransport(args.server_id, peers[args.server_id],
+                                    peers).start()
+        replicated = ReplicatedServer(
+            args.server_id, list(peers), transport, cfg,
+            data_dir=args.data_dir or None)
+        replicated.start()
+        server = replicated.server
+        endpoint = replicated
+    else:
+        server = Server(cfg)
+        server.start()
+        endpoint = server
+
     clients = []
     for i in range(args.clients):
-        c = Client(server, ClientConfig(
+        c = Client(endpoint, ClientConfig(
             data_dir=os.path.join(args.data_dir, f"client{i}")
             if args.data_dir else ""))
         c.start()
         clients.append(c)
-    http_agent = HTTPAgent(server, port=args.port).start()
+    http_agent = HTTPAgent(server, port=args.port,
+                           writer=replicated).start()
     print(f"agent started: {http_agent.address} "
           f"(workers={args.workers} clients={args.clients} "
-          f"algorithm={args.algorithm})")
+          f"algorithm={args.algorithm}"
+          + (f" server-id={args.server_id}" if replicated else "") + ")",
+          flush=True)
     stop = []
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
@@ -71,7 +97,11 @@ def cmd_agent(args) -> int:
         http_agent.stop()
         for c in clients:
             c.stop()
-        server.stop()
+        if replicated is not None:
+            replicated.stop()
+            transport.stop()
+        else:
+            server.stop()
     return 0
 
 
@@ -220,6 +250,11 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("--port", type=int, default=4646)
     ag.add_argument("--algorithm", default="binpack")
     ag.add_argument("--data-dir", default="")
+    ag.add_argument("--server-id", default="server-0",
+                    help="this server's id in a multi-server cluster")
+    ag.add_argument("--peers", default="",
+                    help="raft peer set 'id=host:port,id=host:port,...' "
+                         "(enables multi-server mode)")
     ag.set_defaults(fn=cmd_agent)
 
     job = sub.add_parser("job").add_subparsers(dest="job_cmd", required=True)

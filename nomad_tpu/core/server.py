@@ -266,6 +266,19 @@ class Server:
         return self._create_job_eval(job, enums.TRIGGER_JOB_DEREGISTER,
                                      namespace=namespace)
 
+    def create_job_eval(self, job: Job, trigger: str = enums.TRIGGER_JOB_REGISTER) -> str:
+        """Public force-evaluation endpoint (reference Job.Evaluate);
+        forwardable to the leader in a replicated deployment."""
+        return self._create_job_eval(job, trigger)
+
+    def set_scheduler_config(self, cfg: SchedulerConfiguration) -> None:
+        """Operator scheduler-config update. Applied on the leader via
+        forwarding; not yet raft-replicated, so a failover reverts to the
+        boot-time config (the reference stores this in raft state,
+        operator_endpoint.go — replication TODO)."""
+        self.sched_config = cfg
+        self.config.sched_config = cfg
+
     def _create_job_eval(self, job: Job, trigger: str,
                          namespace: Optional[str] = None) -> str:
         ev = Evaluation(

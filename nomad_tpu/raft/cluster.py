@@ -18,12 +18,16 @@ from ..core.server import Server, ServerConfig
 from ..state import StateStore
 from .fsm import FSM, RaftStore
 from .node import NotLeaderError, RaftNode
-from .transport import InProcTransport
+from .transport import InProcTransport, RemoteCallError, TransportError
 
 FORWARD = ("register_job", "deregister_job", "register_node", "heartbeat",
            "update_node_status", "update_node_drain",
            "update_node_eligibility", "deregister_node",
-           "update_allocs_from_client", "create_eval")
+           "update_allocs_from_client", "create_eval", "create_job_eval",
+           "set_scheduler_config",
+           "promote_deployment", "fail_deployment",
+           "put_variable", "delete_variable",
+           "upsert_acl_policy", "create_acl_token", "acl_bootstrap")
 
 
 class ReplicatedServer:
@@ -62,7 +66,19 @@ class ReplicatedServer:
         self.store = RaftStore(self.local_store, self.raft)
         self.server = Server(config, store=self.store)
         self._peer_lookup = peer_lookup
+        self.transport = transport
         self._lock = threading.Lock()
+        # cross-process forwarding: a SocketTransport dispatches incoming
+        # "call" frames here (reference nomad/rpc.go forwardLeader)
+        if hasattr(transport, "register_call_handler"):
+            transport.register_call_handler(self._handle_remote_call)
+
+    def _handle_remote_call(self, method: str, args: tuple, kwargs: dict):
+        if method not in FORWARD:
+            raise ValueError(f"method {method!r} is not forwardable")
+        if not self.is_leader():
+            raise NotLeaderError(self.raft.leader_id)
+        return getattr(self.server, method)(*args, **kwargs)
 
     # -- lifecycle --
 
@@ -92,24 +108,53 @@ class ReplicatedServer:
     def is_leader(self) -> bool:
         return self.raft.is_leader() and self.server._running
 
-    def _leader(self) -> "ReplicatedServer":
+    # forwarded endpoints raise these; the HTTP layer maps them to status
+    # codes, so they must survive the socket hop as their concrete types
+    _WIRE_ERRORS = {"KeyError": KeyError, "ValueError": ValueError,
+                    "PermissionError": PermissionError,
+                    "TimeoutError": TimeoutError, "RuntimeError": RuntimeError}
+
+    def _forward(self, name: str, args: tuple, kwargs: dict):
+        """Run the endpoint on the leader: locally if this node leads,
+        in-process via peer_lookup, or over the socket transport
+        (reference nomad/rpc.go:445 forward)."""
         deadline = time.time() + 5.0
         while time.time() < deadline:
             if self.is_leader():
-                return self
+                return getattr(self.server, name)(*args, **kwargs)
             lid = self.raft.leader_id
-            if lid and self._peer_lookup is not None:
-                peer = self._peer_lookup(lid)
-                if peer is not None and peer.is_leader():
-                    return peer
+            if lid and lid != self.id:
+                if self._peer_lookup is not None:
+                    peer = self._peer_lookup(lid)
+                    if peer is not None and peer.is_leader():
+                        return getattr(peer.server, name)(*args, **kwargs)
+                elif hasattr(self.transport, "call"):
+                    try:
+                        return self.transport.call(lid, name, args, kwargs)
+                    except RemoteCallError as e:
+                        if e.error_type == "NotLeaderError":
+                            # stale leader hint: wait for the next election
+                            time.sleep(0.02)
+                            continue
+                        cls = self._WIRE_ERRORS.get(e.error_type)
+                        if cls is not None:
+                            raise cls(str(e)) from e
+                        raise
+                    except TransportError as e:
+                        # "connection died after the frame left" is NOT
+                        # retriable: the leader may have applied the
+                        # mutation, and these endpoints are not idempotent
+                        # (create_acl_token, register_job evals)
+                        if getattr(e, "maybe_delivered", False):
+                            raise
+                        # connect failure: definitely not delivered; retry
             time.sleep(0.02)
         raise NotLeaderError(self.raft.leader_id)
 
     def __getattr__(self, name: str):
         if name in FORWARD:
             def call(*args, **kwargs):
-                target = self._leader()
-                return getattr(target.server, name)(*args, **kwargs)
+                return self._forward(name, args, kwargs)
 
             return call
         raise AttributeError(name)

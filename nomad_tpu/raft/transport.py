@@ -1,15 +1,27 @@
-"""Raft transport (reference nomad/raft_rpc.go over yamux TCP).
+"""Raft + server-RPC transport (reference nomad/raft_rpc.go and
+nomad/rpc.go:31,445 — msgpack-RPC over yamux TCP).
 
-The node logic only needs `send(peer, message) -> reply`. The in-process
-transport used by tests and single-host multi-server setups dispatches
-directly; a socket transport carrying the same dict messages slots in
-for multi-host (message schema is JSON-safe by construction).
+The node logic only needs `send(peer, message) -> reply`. Two
+implementations:
+
+- InProcTransport: direct dispatch, used by tests and single-process
+  multi-server topologies, with a partitionable failure set.
+- SocketTransport: length-prefixed wire-codec frames over TCP, one
+  listener per server, persistent client connections per peer. Carries
+  two frame kinds on the same connection: "raft" (the consensus
+  messages) and "call" (server-to-server endpoint forwarding — the
+  reference's forwardLeader). Payloads go through structs.wire so raft
+  log commands containing domain structs survive the trip.
 """
 
 from __future__ import annotations
 
+import json
+import socket
+import socketserver
+import struct
 import threading
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 
 class InProcTransport:
@@ -44,3 +56,268 @@ class InProcTransport:
             return handler(msg)
         except Exception:
             return None
+
+
+# ---------------------------------------------------------------------------
+# TCP sockets
+# ---------------------------------------------------------------------------
+
+
+def _send_frame(sock: socket.socket, payload: dict) -> None:
+    data = json.dumps(payload).encode()
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Optional[dict]:
+    head = _recv_exact(sock, 4)
+    if head is None:
+        return None
+    (length,) = struct.unpack(">I", head)
+    if length > 256 * 1024 * 1024:
+        raise ValueError(f"frame too large: {length}")
+    body = _recv_exact(sock, length)
+    if body is None:
+        return None
+    return json.loads(body)
+
+
+class SocketTransport:
+    """TCP transport for one server process.
+
+    bind_addr/peer_addrs are "host:port" strings; peers maps server id ->
+    address. Incoming frames dispatch to the registered raft handler or
+    the call handler; outgoing sends hold one persistent connection per
+    peer and treat any socket error as message loss (raft tolerates it).
+    """
+
+    def __init__(self, node_id: str, bind_addr: str,
+                 peer_addrs: Dict[str, str], timeout: float = 5.0,
+                 connect_timeout: float = 0.3, retry_cooldown: float = 0.5):
+        self.node_id = node_id
+        self.bind_addr = bind_addr
+        self.peer_addrs = dict(peer_addrs)
+        self.timeout = timeout
+        # Raft ticks send to every peer serially: connecting to a dead
+        # peer must fail fast and then back off, or one crashed server
+        # stalls heartbeats to the live ones and triggers elections.
+        self.connect_timeout = connect_timeout
+        self.retry_cooldown = retry_cooldown
+        self._raft_handler: Optional[Callable[[dict], dict]] = None
+        self._call_handler: Optional[Callable[[str, tuple, dict], object]] = None
+        self._conns: Dict[Tuple[str, str], socket.socket] = {}
+        self._conn_locks: Dict[Tuple[str, str], threading.Lock] = {}
+        self._down_until: Dict[Tuple[str, str], float] = {}
+        self._lock = threading.Lock()
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+
+    # -- registration (transport interface) --
+
+    def register(self, node_id: str, handler: Callable[[dict], dict]) -> None:
+        assert node_id == self.node_id, "socket transport serves one node"
+        self._raft_handler = handler
+
+    def register_call_handler(
+            self, handler: Callable[[str, tuple, dict], object]) -> None:
+        """handler(method, args, kwargs) -> result; exceptions propagate
+        back to the caller as typed error replies."""
+        self._call_handler = handler
+
+    # -- server side --
+
+    def start(self) -> "SocketTransport":
+        host, port = self._split(self.bind_addr)
+        transport = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        frame = _recv_frame(self.request)
+                    except Exception:
+                        return
+                    if frame is None:
+                        return
+                    try:
+                        reply = transport._dispatch(frame)
+                    except Exception as e:  # typed error back to caller
+                        reply = {"ok": False, "error": str(e),
+                                 "error_type": type(e).__name__,
+                                 "leader_id": getattr(e, "leader_id", None)}
+                    try:
+                        _send_frame(self.request, reply)
+                    except Exception:
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        t = threading.Thread(target=self._server.serve_forever, daemon=True,
+                             name=f"rpc-{self.node_id}")
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+        with self._lock:
+            for s in self._conns.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+
+    def _dispatch(self, frame: dict) -> dict:
+        from ..structs.wire import wire_decode, wire_encode
+
+        kind = frame.get("t")
+        if kind == "raft":
+            if self._raft_handler is None:
+                return {"ok": False, "error": "no raft handler"}
+            reply = self._raft_handler(wire_decode(frame["m"]))
+            return {"ok": True, "m": wire_encode(reply)}
+        if kind == "call":
+            if self._call_handler is None:
+                return {"ok": False, "error": "no call handler"}
+            result = self._call_handler(
+                frame["method"],
+                tuple(wire_decode(frame.get("args", []))),
+                wire_decode(frame.get("kwargs", {})))
+            return {"ok": True, "result": wire_encode(result)}
+        return {"ok": False, "error": f"unknown frame kind {kind!r}"}
+
+    # -- client side --
+
+    @staticmethod
+    def _split(addr: str) -> Tuple[str, int]:
+        host, _, port = addr.rpartition(":")
+        return host or "127.0.0.1", int(port)
+
+    def _conn(self, key: Tuple[str, str]) -> Tuple[socket.socket, threading.Lock]:
+        import time as _time
+
+        with self._lock:
+            lock = self._conn_locks.setdefault(key, threading.Lock())
+            sock = self._conns.get(key)
+            if sock is None and _time.monotonic() < self._down_until.get(key, 0):
+                raise TransportError(f"{key[0]} in reconnect cooldown")
+        if sock is not None:
+            return sock, lock
+        host, port = self._split(self.peer_addrs[key[0]])
+        try:
+            sock = socket.create_connection((host, port),
+                                            timeout=self.connect_timeout)
+        except OSError:
+            with self._lock:
+                self._down_until[key] = _time.monotonic() + self.retry_cooldown
+            raise
+        with self._lock:
+            self._down_until.pop(key, None)
+        sock.settimeout(self.timeout)
+        with self._lock:
+            # lost a race? keep the first connection
+            existing = self._conns.get(key)
+            if existing is not None:
+                sock.close()
+                return existing, lock
+            self._conns[key] = sock
+        return sock, lock
+
+    def _drop(self, key: Tuple[str, str]) -> None:
+        with self._lock:
+            sock = self._conns.pop(key, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _roundtrip(self, to_id: str, frame: dict) -> Optional[dict]:
+        if to_id not in self.peer_addrs:
+            return None
+        # separate connections per frame kind so a large forwarded call
+        # can't stall raft heartbeats behind it (the reference gets this
+        # from yamux stream multiplexing)
+        key = (to_id, frame["t"])
+        try:
+            sock, lock = self._conn(key)
+            with lock:  # one in-flight request per connection
+                _send_frame(sock, frame)
+                return _recv_frame(sock)
+        except Exception:
+            self._drop(key)
+            return None
+
+    def send(self, from_id: str, to_id: str, msg: dict) -> Optional[dict]:
+        """Raft message send (transport interface)."""
+        from ..structs.wire import wire_decode, wire_encode
+
+        reply = self._roundtrip(to_id, {"t": "raft", "m": wire_encode(msg)})
+        if reply is None or not reply.get("ok"):
+            return None
+        return wire_decode(reply["m"])
+
+    def call(self, to_id: str, method: str, args: tuple = (),
+             kwargs: Optional[dict] = None):
+        """Forwarded server call; raises RemoteCallError on typed errors
+        and TransportError on connectivity loss. TransportError carries
+        maybe_delivered=True when the frame left this host before the
+        connection died — the peer may have executed the call, so the
+        caller must not blindly retry non-idempotent methods."""
+        from ..structs.wire import wire_decode, wire_encode
+
+        if to_id not in self.peer_addrs:
+            raise TransportError(f"unknown peer {to_id}")
+        frame = {"t": "call", "method": method,
+                 "args": wire_encode(list(args)),
+                 "kwargs": wire_encode(kwargs or {})}
+        key = (to_id, "call")
+        try:
+            sock, lock = self._conn(key)
+        except TransportError:
+            raise
+        except Exception as e:  # connect failed: definitely not delivered
+            raise TransportError(f"cannot reach {to_id}: {e}") from e
+        try:
+            with lock:
+                _send_frame(sock, frame)
+                reply = _recv_frame(sock)
+        except Exception as e:
+            self._drop(key)
+            err = TransportError(f"connection to {to_id} lost mid-call: {e}")
+            err.maybe_delivered = True
+            raise err from e
+        if reply is None:
+            self._drop(key)
+            err = TransportError(f"{to_id} closed the connection before replying")
+            err.maybe_delivered = True
+            raise err
+        if not reply.get("ok"):
+            raise RemoteCallError(reply.get("error_type", "Exception"),
+                                  reply.get("error", ""),
+                                  reply.get("leader_id"))
+        return wire_decode(reply["result"])
+
+
+class TransportError(Exception):
+    maybe_delivered = False
+
+
+class RemoteCallError(Exception):
+    def __init__(self, error_type: str, message: str, leader_id=None):
+        super().__init__(f"{error_type}: {message}")
+        self.error_type = error_type
+        self.leader_id = leader_id

@@ -87,6 +87,17 @@ def device_capacity(node: Node, ask: RequestedDevice,
     return groups_capacity(matching_groups(node, ask, regex_cache, version_cache))
 
 
+def accumulate_dev_usage(row: Dict[str, int], alloc, sign: int = 1) -> None:
+    """Fold one alloc's device instances + reserved cores into a usage
+    row ({device_group_id: n, "cores": n}) — the single definition of the
+    row schema shared by the store's derived rows, snapshot restore, and
+    the tensor layer's touched-node recompute."""
+    for gid, instances in (alloc.allocated_devices or {}).items():
+        row[gid] = row.get(gid, 0) + sign * len(instances)
+    if alloc.allocated_cores:
+        row["cores"] = row.get("cores", 0) + sign * len(alloc.allocated_cores)
+
+
 class DeviceIndex:
     """Per-node instance bookkeeping for one placement pass: which
     concrete instances are taken by proposed allocs plus this group's

@@ -104,11 +104,9 @@ def restore_store(store, data: dict) -> None:
                 prev = usage.get(a.node_id)
                 usage[a.node_id] = a.allocated_vec if prev is None else prev + a.allocated_vec
                 if a.allocated_devices or a.allocated_cores:
-                    row = dev_usage.setdefault(a.node_id, {})
-                    for gid, instances in (a.allocated_devices or {}).items():
-                        row[gid] = row.get(gid, 0) + len(instances)
-                    if a.allocated_cores:
-                        row["cores"] = row.get("cores", 0) + len(a.allocated_cores)
+                    from ..scheduler.devices import accumulate_dev_usage
+
+                    accumulate_dev_usage(dev_usage.setdefault(a.node_id, {}), a)
         for node_id, vec in usage.items():
             store._node_usage.put(node_id, vec, gen, live)
         for node_id, row in dev_usage.items():

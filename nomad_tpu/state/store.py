@@ -527,12 +527,11 @@ class StateStore:
     def _dev_usage_add(self, alloc: Allocation, sign: int, gen: int, live: int) -> None:
         if not alloc.allocated_devices and not alloc.allocated_cores:
             return
+        from ..scheduler.devices import accumulate_dev_usage
+
         cur = self._node_dev_usage.get_latest(alloc.node_id)
         row = dict(cur) if cur else {}
-        for gid, instances in (alloc.allocated_devices or {}).items():
-            row[gid] = row.get(gid, 0) + sign * len(instances)
-        if alloc.allocated_cores:
-            row["cores"] = row.get("cores", 0) + sign * len(alloc.allocated_cores)
+        accumulate_dev_usage(row, alloc, sign)
         self._node_dev_usage.put(alloc.node_id, row, gen, live)
 
     def _put_alloc(self, alloc: Allocation, gen: int, live: int, ts: float = None) -> None:

@@ -268,7 +268,8 @@ def _device_core_tensors(ctx: EvalContext, tg: TaskGroup,
     several asks share one group's instances or NUMA "require" constrains
     core identity: the post-solve host assignment catches those and falls
     back per request (same contract as exact port numbers)."""
-    from ..scheduler.devices import (combined_numa_affinity,
+    from ..scheduler.devices import (accumulate_dev_usage,
+                                     combined_numa_affinity,
                                      device_affinity_boost, groups_capacity,
                                      matching_groups)
 
@@ -296,10 +297,7 @@ def _device_core_tensors(ctx: EvalContext, tg: TaskGroup,
         if node.id in touched:
             row = {}
             for a in ctx.proposed_allocs(node.id):
-                for gid, instances in (a.allocated_devices or {}).items():
-                    row[gid] = row.get(gid, 0) + len(instances)
-                if a.allocated_cores:
-                    row["cores"] = row.get("cores", 0) + len(a.allocated_cores)
+                accumulate_dev_usage(row, a)
         else:
             row = snap.node_dev_usage(node.id) or {}
         for ei, ask in enumerate(asks):

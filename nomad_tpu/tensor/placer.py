@@ -274,12 +274,5 @@ class TPUPlacer:
 
     def _preempt_fallback(self, ctx, job, tg, nodes, req, batch: bool,
                           attempt: int) -> Optional[RankedNode]:
-        penalty = frozenset({req.ignore_node}) if req.ignore_node else frozenset()
-        return select_best_node(
-            ctx, job, tg, nodes,
-            batch=batch,
-            algorithm=self._host_algorithm(),
-            preemption_enabled=True,
-            penalty_nodes=penalty,
-            attempt=attempt,
-        )
+        return self._host_one(ctx, job, tg, nodes, req, batch,
+                              preemption_enabled=True, attempt=attempt)
