@@ -427,6 +427,47 @@ def cfg5_devices_numa() -> None:
          score_parity_pp=tscore - hscore)
 
 
+def cfg6_applier_5k() -> None:
+    """Plan-applier verification at scale: one system-style plan touching
+    5,120 nodes re-verified by the applier. Reports the production
+    (serial) path; `thread_pool_speedup` documents why the reference's
+    EvaluatePool shape stays off by default here (GIL-bound per-node
+    checks run slower under the pool — see PlanApplier.PARALLEL_THRESHOLD)."""
+    from nomad_tpu import mock
+    from nomad_tpu.core.plan_apply import PlanApplier, PlanQueue
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.structs.plan import Plan
+
+    store = StateStore()
+    build_nodes(store, 5120)
+    job = mock.job()
+    store.upsert_job(job)
+    snap = store.snapshot()
+    nodes = list(snap.nodes())
+    plan = Plan(eval_id="bench", snapshot_index=store.latest_index)
+    for i, n in enumerate(nodes):
+        plan.append_alloc(mock.alloc(job, n, index=i))
+
+    serial = PlanApplier(store, PlanQueue())  # unstarted: no pool
+    t0 = time.perf_counter()
+    _, rej_s = serial._verify(plan, None)
+    serial_dt = time.perf_counter() - t0
+
+    par = PlanApplier(store, PlanQueue())
+    par.PARALLEL_THRESHOLD = 16
+    par.start()
+    try:
+        t0 = time.perf_counter()
+        _, rej_p = par._verify(plan, None)
+        par_dt = time.perf_counter() - t0
+    finally:
+        par.stop()
+    assert rej_s == rej_p
+    emit("plan_applier_verify_5k_touched_nodes",
+         len(nodes) / serial_dt, "nodes/s", None,
+         thread_pool_speedup=serial_dt / par_dt)
+
+
 def headline_spread_1k() -> None:
     """The round-over-round headline (unchanged since round 1): spread
     scheduling, 4 jobs x 256 allocs, 1K nodes, serial, full host
@@ -458,6 +499,7 @@ CONFIGS = [
     ("cfg3", cfg3_spread_50k),
     ("cfg4", cfg4_system_preemption),
     ("cfg5", cfg5_devices_numa),
+    ("cfg6", cfg6_applier_5k),
     ("headline", headline_spread_1k),
 ]
 

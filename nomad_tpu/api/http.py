@@ -326,6 +326,7 @@ class HTTPAgent:
             return h._reply(200, {
                 "broker": self.server.broker.stats,
                 "plan": self.server.plan_applier.stats,
+                "plan_bad_nodes": self.server.plan_applier.bad_nodes.stats,
                 "heartbeats_active": self.server.heartbeats.active(),
             })
         h._error(404, f"no such route {path}")

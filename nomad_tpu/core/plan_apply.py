@@ -10,14 +10,20 @@ single applier thread is the only writer of placement results. Per plan:
   2. re-verify every touched node against the *latest* state with the
      same AllocsFit predicate the scheduler used (plan_apply.go:468,717
      evaluateNodePlan) — a node whose plan no longer fits (a concurrent
-     plan won the race) is rejected wholesale;
+     plan won the race) is rejected wholesale. Verification fans out
+     over a thread pool for plans touching many nodes (reference
+     plan_apply_pool.go:21 EvaluatePool, half the cores);
   3. commit what survived (partial commit) and hand the scheduler a
      refresh index so it reschedules the remainder against fresher state
-     (plan_apply.go:96-211).
+     (plan_apply.go:96-211). The commit (a raft round under a durable
+     log) runs async while the next plan verifies against an optimistic
+     overlay of the in-flight result (plan_apply.go:70-95 pipelining +
+     :355-363 snapshot overlay).
 
-The reference pipelines Raft-apply of plan N with verification of plan
-N+1; with the in-process store the commit is a memory write, so the
-pipelining win is deferred until the replicated log lands.
+Nodes that repeatedly reject plans feed a windowed BadNodeTracker
+(reference plan_apply_node_tracker.go:17): a node whose rejection score
+crosses the threshold is marked ineligible so broken kernels / stale
+fingerprints stop eating scheduler retries cluster-wide.
 """
 
 from __future__ import annotations
@@ -25,6 +31,8 @@ from __future__ import annotations
 import heapq
 import itertools
 import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
 from ..structs import allocs_fit, enums
@@ -98,19 +106,112 @@ class PlanQueue:
             return len(self._heap)
 
 
+class BadNodeTracker:
+    """Windowed per-node plan-rejection scoring (reference
+    plan_apply_node_tracker.go:17,40 + the CachedBadNodeTracker docs at
+    monitoring-nomad.mdx:130-178). A node collecting `threshold`
+    rejections inside `window` seconds is reported once per window; the
+    server wires the report to mark the node ineligible."""
+
+    def __init__(self, threshold: int = 15, window: float = 300.0,
+                 on_bad_node=None):
+        self.threshold = threshold
+        self.window = window
+        self.on_bad_node = on_bad_node
+        self._lock = threading.Lock()
+        self._events: Dict[str, List[float]] = {}
+        self.stats = {"bad_nodes": 0}
+
+    def add(self, node_id: str, now: Optional[float] = None) -> bool:
+        now = now if now is not None else time.time()
+        fire = False
+        with self._lock:
+            events = self._events.setdefault(node_id, [])
+            events.append(now)
+            cutoff = now - self.window
+            while events and events[0] < cutoff:
+                events.pop(0)
+            if len(events) >= self.threshold:
+                events.clear()  # report once, then start a fresh window
+                fire = True
+                self.stats["bad_nodes"] += 1
+        if fire and self.on_bad_node is not None:
+            try:
+                self.on_bad_node(node_id)
+            except Exception:
+                pass
+        return fire
+
+
+class _OverlaySnapshot:
+    """In-flight plan results layered over a snapshot (oldest first),
+    exposing just the reads _node_plan_valid performs — so a new plan
+    verifies against "state as of every pending commit" while those raft
+    rounds are still in the air (reference plan_apply.go:355-363
+    optimistic snapshot). More than one result can be pending at once:
+    commit N can be running while commit N+1 waits behind it."""
+
+    def __init__(self, snap, results: List[PlanResult]):
+        self._snap = snap
+        self._replaced: Dict[str, dict] = {}
+        for result in results:  # later results override earlier ones
+            for node_id in (set(result.node_allocation)
+                            | set(result.node_update)
+                            | set(result.node_preemptions)):
+                by_id = self._replaced.setdefault(node_id, {})
+                for bucket in (result.node_update, result.node_preemptions,
+                               result.node_allocation):
+                    for a in bucket.get(node_id, ()):
+                        by_id[a.id] = a
+
+    def node_by_id(self, node_id):
+        return self._snap.node_by_id(node_id)
+
+    def allocs_by_node(self, node_id):
+        overlay = self._replaced.get(node_id)
+        base = self._snap.allocs_by_node(node_id)
+        if not overlay:
+            return base
+        out = [overlay.get(a.id, a) for a in base]
+        have = {a.id for a in base}
+        out.extend(a for aid, a in overlay.items() if aid not in have)
+        return out
+
+
 class PlanApplier:
     """The serialized applier goroutine (reference plan_apply.go:96 planApply)."""
 
-    def __init__(self, store, queue: PlanQueue, logger=None):
+    # Per-node verification CAN fan out over the pool (set this lower),
+    # but _node_plan_valid is pure-Python and GIL-bound: measured at 5K
+    # touched nodes the pool runs ~3x SLOWER than the serial loop
+    # (bench.py cfg6), unlike the reference's Go EvaluatePool. Serial is
+    # therefore the default; the pool pays off only if the per-node check
+    # grows GIL-releasing work (native fit kernels, IO).
+    PARALLEL_THRESHOLD = 1 << 30
+
+    def __init__(self, store, queue: PlanQueue, logger=None,
+                 pool_workers: Optional[int] = None,
+                 bad_node_tracker: Optional[BadNodeTracker] = None):
+        import os
+
         self.store = store
         self.queue = queue
         self.logger = logger
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.stats = {"applied": 0, "nodes_rejected": 0, "partial_commits": 0}
+        # reference plan_apply_pool.go: half the cores
+        self.pool_workers = pool_workers or max(2, (os.cpu_count() or 2) // 2)
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._commit_pool: Optional[ThreadPoolExecutor] = None
+        self.bad_nodes = bad_node_tracker or BadNodeTracker()
 
     def start(self) -> None:
         self._stop.clear()
+        self._pool = ThreadPoolExecutor(max_workers=self.pool_workers,
+                                        thread_name_prefix="plan-verify")
+        self._commit_pool = ThreadPoolExecutor(max_workers=1,
+                                               thread_name_prefix="plan-commit")
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="plan-applier")
         self._thread.start()
@@ -120,31 +221,77 @@ class PlanApplier:
         self.queue.set_enabled(False)
         if self._thread is not None:
             self._thread.join(timeout=2.0)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+        if self._commit_pool is not None:
+            self._commit_pool.shutdown(wait=True)
 
     def _run(self) -> None:
+        # pipeline state: every submitted-but-unlanded commit, oldest
+        # first; their results overlay the verification snapshot
+        inflight: List[Tuple[Future, PlanResult]] = []
         while not self._stop.is_set():
             pending = self.queue.dequeue(timeout=0.2)
             if pending is None:
                 continue
             try:
-                result = self.apply(pending.plan)
-                pending.respond(result, None)
+                inflight = [(f, r) for f, r in inflight if not f.done()]
+                overlays = [r for _, r in inflight]
+                result, rejected = self._verify(pending.plan, overlays)
+                # the single-worker commit pool serializes commits in
+                # submission order; the submitter is answered from the
+                # future's callback the moment its commit lands
+                prev_fut = inflight[-1][0] if inflight else None
+                fut = self._commit_pool.submit(
+                    self._commit_task, pending.plan, result, rejected,
+                    prev_fut)
+                fut.add_done_callback(self._responder(pending))
+                inflight.append((fut, result))
             except Exception as e:  # surface to the submitting worker
                 if self.logger:
                     self.logger.exception("plan apply failed")
                 pending.respond(None, e)
 
-    # -- the serialized verify + commit --
+    @staticmethod
+    def _responder(pending: "PendingPlan"):
+        def done(fut: Future) -> None:
+            err = fut.exception()
+            if err is not None:
+                pending.respond(None, err)
+            else:
+                pending.respond(fut.result(), None)
+        return done
 
-    def apply(self, plan: Plan) -> PlanResult:
+    # -- verify (parallel) --
+
+    def _verify(self, plan: Plan,
+                overlay_results: Optional[List[PlanResult]] = None,
+                ) -> Tuple[PlanResult, List[str]]:
         # catch up to the snapshot the scheduler planned against
         if plan.snapshot_index:
             snap = self.store.snapshot_min_index(plan.snapshot_index)
         else:
             snap = self.store.snapshot()
+        if overlay_results:
+            snap = _OverlaySnapshot(snap, overlay_results)
+        return self._evaluate(snap, plan)
 
-        result, rejected = self._evaluate(snap, plan)
+    # -- the serialized commit --
 
+    def _commit_task(self, plan: Plan, result: PlanResult,
+                     rejected: List[str],
+                     prev_fut: Optional[Future]) -> PlanResult:
+        """Pipelined commit entry: if the predecessor commit FAILED, this
+        plan was verified against an overlay whose state never landed, so
+        re-verify against the real store before writing (the reference
+        treats a failed plan apply as fatal; re-verification is the
+        non-fatal equivalent)."""
+        if prev_fut is not None and prev_fut.exception() is not None:
+            result, rejected = self._verify(plan, None)
+        return self._commit(plan, result, rejected)
+
+    def _commit(self, plan: Plan, result: PlanResult,
+                rejected: List[str]) -> PlanResult:
         placements, stops, preemptions = [], [], []
         for allocs in result.node_allocation.values():
             placements.extend(allocs)
@@ -171,15 +318,27 @@ class PlanApplier:
             result.rejected_nodes = rejected
         return result
 
+    def apply(self, plan: Plan) -> PlanResult:
+        """Synchronous verify+commit (tests and direct callers; the
+        applier loop pipelines the same two halves)."""
+        result, rejected = self._verify(plan, None)
+        return self._commit(plan, result, rejected)
+
     def _evaluate(self, snap, plan: Plan) -> Tuple[PlanResult, List[str]]:
         """Per-node re-verification (reference plan_apply.go:468
         evaluatePlan + :717 evaluateNodePlan). all_at_once plans commit
         fully or not at all (structs Plan.AllAtOnce)."""
         result = PlanResult()
         rejected: List[str] = []
-        nodes = set(plan.node_allocation) | set(plan.node_update) | set(plan.node_preemptions)
-        for node_id in nodes:
-            if self._node_plan_valid(snap, plan, node_id):
+        nodes = sorted(set(plan.node_allocation) | set(plan.node_update)
+                       | set(plan.node_preemptions))
+        if len(nodes) >= self.PARALLEL_THRESHOLD and self._pool is not None:
+            verdicts = list(self._pool.map(
+                lambda nid: self._node_plan_valid(snap, plan, nid), nodes))
+        else:
+            verdicts = [self._node_plan_valid(snap, plan, nid) for nid in nodes]
+        for node_id, ok in zip(nodes, verdicts):
+            if ok:
                 if node_id in plan.node_allocation:
                     result.node_allocation[node_id] = plan.node_allocation[node_id]
                 if node_id in plan.node_update:
@@ -188,6 +347,7 @@ class PlanApplier:
                     result.node_preemptions[node_id] = plan.node_preemptions[node_id]
             else:
                 rejected.append(node_id)
+                self.bad_nodes.add(node_id)
         if rejected and plan.all_at_once:
             # all-or-nothing plan: reject everything
             result.node_allocation.clear()

@@ -51,6 +51,14 @@ class ServerConfig:
     # cadence for retrying evals blocked by plan-attempt exhaustion
     # (reference leader.go:443 periodicUnblockFailedEvals)
     failed_eval_unblock_interval: float = 60.0
+    # Bad-node quarantine: a node rejecting this many plans inside the
+    # window is marked ineligible. Off by default with a high threshold,
+    # like the reference (plan_rejection_tracker is opt-in, node_threshold
+    # 100): ordinary optimistic-concurrency losses on hot binpack nodes
+    # also count as rejections, and quarantine is not auto-reverted.
+    plan_rejection_tracker_enabled: bool = False
+    plan_rejection_threshold: int = 100
+    plan_rejection_window: float = 300.0
     gc_interval: float = 60.0
     acl_enabled: bool = False
     sched_config: SchedulerConfiguration = field(default_factory=SchedulerConfiguration)
@@ -69,7 +77,14 @@ class Server:
         self.blocked = BlockedEvals(self._requeue_unblocked,
                                     persist_fn=self.store.upsert_evals)
         self.plan_queue = PlanQueue()
-        self.plan_applier = PlanApplier(self.store, self.plan_queue, self.logger)
+        from .plan_apply import BadNodeTracker
+
+        self.plan_applier = PlanApplier(
+            self.store, self.plan_queue, self.logger,
+            bad_node_tracker=BadNodeTracker(
+                threshold=self.config.plan_rejection_threshold,
+                window=self.config.plan_rejection_window,
+                on_bad_node=self._on_bad_node))
         self.heartbeats = HeartbeatManager(self, ttl=self.config.heartbeat_ttl)
         self.workers: List[Worker] = [
             Worker(self, i) for i in range(self.config.num_workers)]
@@ -180,6 +195,24 @@ class Server:
                 a = payload
                 if a is not None and (a.terminal_status() or a.server_terminal()):
                     self.blocked.unblock("")
+
+    def _on_bad_node(self, node_id: str) -> None:
+        """A node crossed the plan-rejection threshold: quarantine it so
+        schedulers stop wasting retries on it (reference
+        plan_apply_node_tracker.go -> Node.UpdateEligibility)."""
+        if not self.config.plan_rejection_tracker_enabled:
+            return
+        if self.logger:
+            self.logger.warning(
+                "node %s exceeded the plan rejection threshold; "
+                "marking ineligible", node_id)
+        self.events.publish("Node", "node-quarantined",
+                            {"node_id": node_id,
+                             "reason": "plan rejection threshold exceeded"})
+        try:
+            self.update_node_eligibility(node_id, enums.NODE_SCHED_INELIGIBLE)
+        except KeyError:
+            pass  # node vanished; nothing to quarantine
 
     def _requeue_unblocked(self, ev: Evaluation) -> None:
         """An unblocked eval re-enters the broker as pending; persist the

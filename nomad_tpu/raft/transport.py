@@ -102,16 +102,22 @@ class SocketTransport:
 
     def __init__(self, node_id: str, bind_addr: str,
                  peer_addrs: Dict[str, str], timeout: float = 5.0,
-                 connect_timeout: float = 0.3, retry_cooldown: float = 0.5):
+                 connect_timeout: float = 0.3, retry_cooldown: float = 0.5,
+                 raft_timeout: float = 0.5):
         self.node_id = node_id
         self.bind_addr = bind_addr
         self.peer_addrs = dict(peer_addrs)
         self.timeout = timeout
         # Raft ticks send to every peer serially: connecting to a dead
         # peer must fail fast and then back off, or one crashed server
-        # stalls heartbeats to the live ones and triggers elections.
+        # stalls heartbeats to the live ones and triggers elections. The
+        # same goes for a HUNG peer (SIGSTOP, IO stall): raft frames get
+        # their own short recv timeout, and any raft-channel failure puts
+        # the peer in the cooldown so subsequent ticks skip it instead of
+        # blocking the heartbeat fan-out.
         self.connect_timeout = connect_timeout
         self.retry_cooldown = retry_cooldown
+        self.raft_timeout = raft_timeout
         self._raft_handler: Optional[Callable[[dict], dict]] = None
         self._call_handler: Optional[Callable[[str, tuple, dict], object]] = None
         self._conns: Dict[Tuple[str, str], socket.socket] = {}
@@ -184,7 +190,7 @@ class SocketTransport:
         from ..structs.wire import wire_decode, wire_encode
 
         kind = frame.get("t")
-        if kind == "raft":
+        if kind in ("raft", "snap"):
             if self._raft_handler is None:
                 return {"ok": False, "error": "no raft handler"}
             reply = self._raft_handler(wire_decode(frame["m"]))
@@ -226,7 +232,7 @@ class SocketTransport:
             raise
         with self._lock:
             self._down_until.pop(key, None)
-        sock.settimeout(self.timeout)
+        sock.settimeout(self.raft_timeout if key[1] == "raft" else self.timeout)
         with self._lock:
             # lost a race? keep the first connection
             existing = self._conns.get(key)
@@ -251,6 +257,8 @@ class SocketTransport:
         # separate connections per frame kind so a large forwarded call
         # can't stall raft heartbeats behind it (the reference gets this
         # from yamux stream multiplexing)
+        import time as _time
+
         key = (to_id, frame["t"])
         try:
             sock, lock = self._conn(key)
@@ -259,13 +267,21 @@ class SocketTransport:
                 return _recv_frame(sock)
         except Exception:
             self._drop(key)
+            with self._lock:
+                # hung or dead peer: skip it for a cooldown so serial
+                # raft fan-outs keep heartbeating the healthy peers
+                self._down_until[key] = _time.monotonic() + self.retry_cooldown
             return None
 
     def send(self, from_id: str, to_id: str, msg: dict) -> Optional[dict]:
-        """Raft message send (transport interface)."""
+        """Raft message send (transport interface). Snapshot installs get
+        their own channel: multi-MB frames need the long timeout, and the
+        short raft timeout exists precisely so heartbeats never wait on a
+        transfer like that."""
         from ..structs.wire import wire_decode, wire_encode
 
-        reply = self._roundtrip(to_id, {"t": "raft", "m": wire_encode(msg)})
+        channel = "snap" if msg.get("kind") == "install_snapshot" else "raft"
+        reply = self._roundtrip(to_id, {"t": channel, "m": wire_encode(msg)})
         if reply is None or not reply.get("ok"):
             return None
         return wire_decode(reply["m"])
@@ -285,21 +301,40 @@ class SocketTransport:
                  "args": wire_encode(list(args)),
                  "kwargs": wire_encode(kwargs or {})}
         key = (to_id, "call")
-        try:
-            sock, lock = self._conn(key)
-        except TransportError:
-            raise
-        except Exception as e:  # connect failed: definitely not delivered
-            raise TransportError(f"cannot reach {to_id}: {e}") from e
-        try:
-            with lock:
-                _send_frame(sock, frame)
-                reply = _recv_frame(sock)
-        except Exception as e:
-            self._drop(key)
-            err = TransportError(f"connection to {to_id} lost mid-call: {e}")
-            err.maybe_delivered = True
-            raise err from e
+        for attempt in (0, 1):
+            try:
+                sock, lock = self._conn(key)
+            except TransportError:
+                raise
+            except Exception as e:  # connect failed: definitely not delivered
+                raise TransportError(f"cannot reach {to_id}: {e}") from e
+            try:
+                with lock:
+                    try:
+                        _send_frame(sock, frame)
+                    except OSError as e:
+                        # another thread dropped this shared socket before
+                        # we sent a byte (EBADF/ENOTCONN): provably not
+                        # delivered, so one fresh-connection retry is safe
+                        self._drop(key)
+                        import errno
+
+                        if attempt == 0 and e.errno in (errno.EBADF,
+                                                        errno.ENOTCONN):
+                            continue
+                        err = TransportError(
+                            f"send to {to_id} failed mid-call: {e}")
+                        err.maybe_delivered = True
+                        raise err from e
+                    reply = _recv_frame(sock)
+            except TransportError:
+                raise
+            except Exception as e:
+                self._drop(key)
+                err = TransportError(f"connection to {to_id} lost mid-call: {e}")
+                err.maybe_delivered = True
+                raise err from e
+            break
         if reply is None:
             self._drop(key)
             err = TransportError(f"{to_id} closed the connection before replying")

@@ -215,6 +215,8 @@ class TestPlanApplier:
 
 
 def _server(algorithm=enums.SCHED_ALG_BINPACK, **kw):
+    # conflict-stranded (max-plan) evals retry promptly in tests
+    kw.setdefault("failed_eval_unblock_interval", 0.3)
     cfg = ServerConfig(
         sched_config=SchedulerConfiguration(scheduler_algorithm=algorithm), **kw)
     return Server(cfg)
@@ -248,7 +250,16 @@ class TestServerE2E:
             jobs = [mock.job() for _ in range(8)]
             for j in jobs:
                 s.register_job(j)
-            assert s.wait_for_idle(30.0)
+            # exact-capacity workload: racing workers can strand a
+            # conflict-blocked eval briefly; idle must include the
+            # unblock-timer retry draining it
+            deadline = time.time() + 30.0
+            while True:
+                assert s.wait_for_idle(max(1.0, deadline - time.time()))
+                if s.blocked.blocked_count() == 0:
+                    break
+                assert time.time() < deadline, "blocked evals did not drain"
+                time.sleep(0.1)
             snap = s.store.snapshot()
             for j in jobs:
                 assert len(snap.allocs_by_job(j.id)) == 10, j.id
