@@ -196,10 +196,23 @@ class HTTPAgent:
         elif path.startswith(("/v1/var", "/v1/vars")):
             if not self._ns_allowed(acl, ns, aclp.CAP_VARIABLES_READ):
                 return h._error(403, "Permission denied")
+        elif path.startswith("/v1/volume"):
+            if not self._ns_allowed(acl, ns, aclp.CAP_READ_JOB):
+                return h._error(403, "Permission denied")
         elif path.startswith("/v1/acl"):
             if acl is not None and not acl.management:
                 return h._error(403, "Permission denied")
 
+        if path == "/v1/volumes":
+            return h._reply(200, [
+                {"id": v.id, "namespace": v.namespace, "name": v.name,
+                 "access_mode": v.access_mode, "claims": len(v.claims)}
+                for v in snap.volumes(ns)])
+        if m := re.fullmatch(r"/v1/volume/csi/([^/]+)", path):
+            vol = snap.volume_by_id(m.group(1), ns)
+            if vol is None:
+                return h._error(404, "volume not found")
+            return h._reply(200, vol)
         if path == "/v1/vars":
             return h._reply(200, self.server.list_variables(ns, prefix))
         if m := re.fullmatch(r"/v1/var/(.+)", path):
@@ -347,6 +360,9 @@ class HTTPAgent:
         elif path.startswith("/v1/var"):
             if not self._ns_allowed(acl, ns, aclp.CAP_VARIABLES_WRITE):
                 return h._error(403, "Permission denied")
+        elif path.startswith("/v1/volume"):
+            if not self._ns_allowed(acl, ns, aclp.CAP_SUBMIT_JOB):
+                return h._error(403, "Permission denied")
         elif path.startswith("/v1/deployment"):
             # Authorize against the deployment's OWN namespace, not the
             # query param — otherwise submit-job in any one namespace
@@ -381,6 +397,15 @@ class HTTPAgent:
                                   "secret_id": token.secret_id})
         if m := re.fullmatch(r"/v1/var/(.+)", path):
             self.writer.put_variable(m.group(1), body.get("items", {}), ns)
+            return h._reply(200, {"ok": True})
+        if m := re.fullmatch(r"/v1/volume/csi/([^/]+)", path):
+            from ..structs.volumes import Volume
+
+            vol = from_dict(Volume, body.get("volume") or body)
+            vol.id = m.group(1)
+            vol.namespace = ns
+            vol.claims = {}  # store-owned; never accepted from clients
+            self.writer.register_volume(vol)
             return h._reply(200, {"ok": True})
 
         if path == "/v1/jobs":
@@ -456,6 +481,15 @@ class HTTPAgent:
             if not self._ns_allowed(acl, ns, aclp.CAP_VARIABLES_WRITE):
                 return h._error(403, "Permission denied")
             self.writer.delete_variable(m.group(1), ns)
+            return h._reply(200, {"ok": True})
+        if m := re.fullmatch(r"/v1/volume/csi/([^/]+)", path):
+            if not self._ns_allowed(acl, ns, aclp.CAP_SUBMIT_JOB):
+                return h._error(403, "Permission denied")
+            force = q.get("force", ["false"])[0] in ("true", "1")
+            try:
+                self.writer.deregister_volume(m.group(1), ns, force=force)
+            except ValueError as e:
+                return h._error(409, str(e))
             return h._reply(200, {"ok": True})
         h._error(404, f"no such route {path}")
 

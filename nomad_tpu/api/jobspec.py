@@ -65,6 +65,23 @@ def _validate(job: Job) -> None:
             raise ValueError(f"task group {tg.name!r} has no tasks")
         if tg.count < 0:
             raise ValueError(f"task group {tg.name!r} has negative count")
+        for vname, req in tg.volumes.items():
+            if req.per_alloc:
+                # indexed per-alloc sources aren't implemented yet; a
+                # silent shared-volume fallback would be data loss bait
+                raise ValueError(
+                    f"volume {vname!r} in group {tg.name!r}: "
+                    "per_alloc volumes are not supported yet")
+            if req.type not in ("host", "csi"):
+                raise ValueError(
+                    f"volume {vname!r} in group {tg.name!r}: "
+                    f"unknown type {req.type!r}")
+        for t in tg.tasks:
+            for vm in t.volume_mounts:
+                if vm.volume not in tg.volumes:
+                    raise ValueError(
+                        f"task {t.name!r} mounts undeclared volume "
+                        f"{vm.volume!r}")
 
 
 # ---------------------------------------------------------------------------
@@ -223,6 +240,15 @@ def _task_dict(block: dict) -> dict:
             r["cores"] = int(res["cores"])
         out["resources"] = r
     out["constraints"] = [_constraint_dict(c) for c in block.get("constraint", [])]
+    mounts = []
+    for vm in block.get("volume_mount", []):
+        mounts.append({
+            "volume": vm.get("volume", vm.get("__label__", "")),
+            "destination": vm.get("destination", ""),
+            "read_only": bool(vm.get("read_only", False)),
+        })
+    if mounts:
+        out["volume_mounts"] = mounts
     return out
 
 
@@ -264,6 +290,19 @@ def _group_dict(block: dict) -> dict:
             "delay_s": float(rp.get("delay", 15)),
             "mode": rp.get("mode", "fail"),
         }
+    volumes = {}
+    for vb in block.get("volume", []):
+        name = vb.get("__label__", vb.get("name", ""))
+        volumes[name] = {
+            "name": name,
+            "type": vb.get("type", "host"),
+            "source": vb.get("source", ""),
+            "read_only": bool(vb.get("read_only", False)),
+            "access_mode": vb.get("access_mode", "single-node-writer"),
+            "per_alloc": bool(vb.get("per_alloc", False)),
+        }
+    if volumes:
+        out["volumes"] = volumes
     return out
 
 

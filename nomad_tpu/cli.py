@@ -73,6 +73,10 @@ def cmd_agent(args) -> int:
         server.start()
         endpoint = server
 
+    # HTTP first: the status/leader endpoint must be observable while the
+    # clients wait out the initial leader election to register
+    http_agent = HTTPAgent(server, port=args.port,
+                           writer=replicated).start()
     clients = []
     for i in range(args.clients):
         c = Client(endpoint, ClientConfig(
@@ -80,8 +84,6 @@ def cmd_agent(args) -> int:
             if args.data_dir else ""))
         c.start()
         clients.append(c)
-    http_agent = HTTPAgent(server, port=args.port,
-                           writer=replicated).start()
     print(f"agent started: {http_agent.address} "
           f"(workers={args.workers} clients={args.clients} "
           f"algorithm={args.algorithm}"

@@ -67,7 +67,7 @@ class Client:
 
     def start(self) -> None:
         self._restore()
-        self.server.register_node(self.node)
+        self._register_with_retry()
         for name, fn in (("heartbeat", self._run_heartbeat),
                          ("watch", self._run_watch),
                          ("sync", self._run_sync)):
@@ -75,6 +75,24 @@ class Client:
                                  name=f"client-{self.node.id[:8]}-{name}")
             t.start()
             self._threads.append(t)
+
+    def _register_with_retry(self, deadline_s: float = 120.0) -> None:
+        """Registration must outlast server-side unavailability — at boot
+        the cluster may still be electing its first leader (reference
+        client/client.go:1735 registerAndHeartbeat retries with backoff;
+        a client crashing because it raced the election would take the
+        whole agent process down with it)."""
+        deadline = time.time() + deadline_s
+        delay = 0.2
+        while True:
+            try:
+                self.server.register_node(self.node)
+                return
+            except Exception:
+                if self._stop.is_set() or time.time() >= deadline:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 5.0)
 
     def stop(self) -> None:
         self._stop.set()

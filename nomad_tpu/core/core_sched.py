@@ -84,6 +84,11 @@ class CoreScheduler:
                                      before_time=now - et)
         self.stats["allocs"] += n
 
+        # --- volume claim reaping (reference nomad/volumewatcher/):
+        # claims of terminal/vanished allocs release so writers free up ---
+        released = store.reap_volume_claims()
+        self.stats["volume_claims"] = self.stats.get("volume_claims", 0) + released
+
         # --- job GC (core_sched.go:44 jobGC) ---
         snap = store.snapshot()
         for job in list(snap.jobs()):

@@ -177,6 +177,29 @@ class _OverlaySnapshot:
         out.extend(a for aid, a in overlay.items() if aid not in have)
         return out
 
+    def alloc_by_id(self, alloc_id):
+        for by_id in self._replaced.values():
+            if alloc_id in by_id:
+                return by_id[alloc_id]
+        return self._snap.alloc_by_id(alloc_id)
+
+    def volume_by_id(self, vol_id, namespace="default"):
+        return self._snap.volume_by_id(vol_id, namespace)
+
+    def overlay_writer_volumes(self) -> set:
+        """(namespace, source) pairs the in-flight placements will claim
+        for write at commit — claims land inside the store transaction,
+        so the overlay must surface them or back-to-back pipelined plans
+        could each think a single-writer volume is free. Slightly
+        conservative: updates that already hold the claim also count."""
+        from ..structs.volumes import csi_writer_sources
+
+        out = set()
+        for by_id in self._replaced.values():
+            for a in by_id.values():
+                out.update(csi_writer_sources(a))
+        return out
+
 
 class PlanApplier:
     """The serialized applier goroutine (reference plan_apply.go:96 planApply)."""
@@ -337,8 +360,9 @@ class PlanApplier:
                 lambda nid: self._node_plan_valid(snap, plan, nid), nodes))
         else:
             verdicts = [self._node_plan_valid(snap, plan, nid) for nid in nodes]
+        vol_bad = self._volume_rejections(snap, plan)
         for node_id, ok in zip(nodes, verdicts):
-            if ok:
+            if ok and node_id not in vol_bad:
                 if node_id in plan.node_allocation:
                     result.node_allocation[node_id] = plan.node_allocation[node_id]
                 if node_id in plan.node_update:
@@ -358,6 +382,50 @@ class PlanApplier:
         result.deployment = plan.deployment
         result.deployment_updates = plan.deployment_updates
         return result, rejected
+
+    def _volume_rejections(self, snap, plan: Plan) -> set:
+        """Cross-node claim re-verification for csi-volume placements:
+        writer exclusivity is a per-VOLUME invariant, so it can't live in
+        the per-node check. Counts each volume's existing writers plus
+        the plan's new writer claims (racing plans may have claimed
+        since the scheduler's snapshot) and rejects the nodes whose
+        placements would overcommit (reference volume claim transaction,
+        nomad/csi_endpoint.go claim path)."""
+        from ..structs.volumes import (MULTI_WRITER_MODES, csi_writer_sources,
+                                       live_foreign_writers)
+
+        # (ns, source) -> [(node_id, job_id)] of NEW write placements
+        writers_wanted: Dict[tuple, List[tuple]] = {}
+        for node_id, allocs in plan.node_allocation.items():
+            for a in allocs:
+                if snap.alloc_by_id(a.id) is not None:
+                    continue  # updates keep their claims
+                for key in csi_writer_sources(a):
+                    writers_wanted.setdefault(key, []).append(
+                        (node_id, a.job_id))
+        bad: set = set()
+        pending = (snap.overlay_writer_volumes()
+                   if hasattr(snap, "overlay_writer_volumes") else set())
+        for (ns, source), wants in writers_wanted.items():
+            vol = (snap.volume_by_id(source, ns)
+                   if hasattr(snap, "volume_by_id") else None)
+            if vol is None:
+                bad.update(n for n, _ in wants)  # volume vanished
+                continue
+            if vol.access_mode in MULTI_WRITER_MODES:
+                continue
+            # one plan serves one job's eval: same-job existing claims
+            # belong to allocs this plan is replacing and don't block
+            job_id = wants[0][1]
+            taken = (bool(live_foreign_writers(vol, job_id, ns, snap))
+                     or (ns, source) in pending)
+            free = 0 if taken else 1
+            for node_id, _ in sorted(wants):  # deterministic winner
+                if free > 0:
+                    free -= 1
+                else:
+                    bad.add(node_id)
+        return bad
 
     def _node_plan_valid(self, snap, plan: Plan, node_id: str) -> bool:
         node = snap.node_by_id(node_id)

@@ -544,6 +544,15 @@ class Server:
 
     # -- Eval endpoints --
 
+    # -- Volume endpoints (reference nomad/csi_endpoint.go register/deregister) --
+
+    def register_volume(self, vol) -> None:
+        self.store.upsert_volume(vol)
+
+    def deregister_volume(self, vol_id: str, namespace: str = "default",
+                          force: bool = False) -> None:
+        self.store.delete_volume(vol_id, namespace, force=force)
+
     def create_eval(self, ev: Evaluation) -> str:
         index = self.store.upsert_evals([ev])
         ev.modify_index = index

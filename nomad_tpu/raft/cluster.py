@@ -27,6 +27,7 @@ FORWARD = ("register_job", "deregister_job", "register_node", "heartbeat",
            "set_scheduler_config",
            "promote_deployment", "fail_deployment",
            "put_variable", "delete_variable",
+           "register_volume", "deregister_volume",
            "upsert_acl_policy", "create_acl_token", "acl_bootstrap")
 
 

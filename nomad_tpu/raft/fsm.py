@@ -30,6 +30,7 @@ MUTATIONS = {
     "upsert_acl_policy", "delete_acl_policy",
     "upsert_acl_token", "delete_acl_token",
     "upsert_variable", "delete_variable",
+    "upsert_volume", "delete_volume", "reap_volume_claims",
     "gc_terminal_allocs", "compact", "restore_dump",
 }
 

@@ -366,6 +366,59 @@ def network_mask(tg: TaskGroup, nodes: Sequence[Node]) -> np.ndarray:
     return out
 
 
+def host_volume_mask(tg: TaskGroup, nodes: Sequence[Node]) -> np.ndarray:
+    """HostVolumeChecker (reference feasible.go:139): every host-type
+    volume request must name a volume the node exposes; a read-write
+    request needs a non-read-only host volume. Class-memoizable: host
+    volumes ride the computed-class hash."""
+    asks = [v for v in tg.volumes.values() if v.type == "host"]
+    if not asks:
+        return np.ones(len(nodes), dtype=bool)
+    out = np.empty(len(nodes), dtype=bool)
+    for i, node in enumerate(nodes):
+        ok = True
+        for req in asks:
+            hv = node.host_volumes.get(req.source)
+            if hv is None or (getattr(hv, "read_only", False)
+                              and not req.read_only):
+                ok = False
+                break
+        out[i] = ok
+    return out
+
+
+def csi_volume_mask(tg: TaskGroup, nodes: Sequence[Node],
+                    snapshot, namespace: str = "default",
+                    job_id: str = "") -> np.ndarray:
+    """CSIVolumeChecker (reference feasible.go:223): every csi-type
+    request must name a registered volume whose topology admits the node
+    and whose access mode has room for our claim. Writer exclusivity only
+    counts LIVE claims from OTHER jobs (volumes.live_foreign_writers) so
+    destructive updates and reschedules of the claiming job don't
+    deadlock on their own claim. NOT class-memoized — claims change
+    independently of node classes."""
+    from ..structs.volumes import MULTI_WRITER_MODES, live_foreign_writers
+
+    asks = [v for v in tg.volumes.values() if v.type == "csi"]
+    if not asks:
+        return np.ones(len(nodes), dtype=bool)
+    if snapshot is None:
+        return np.zeros(len(nodes), dtype=bool)
+    vols = []
+    for req in asks:
+        vol = snapshot.volume_by_id(req.source, namespace)
+        if vol is None:
+            return np.zeros(len(nodes), dtype=bool)
+        if (not req.read_only and vol.access_mode not in MULTI_WRITER_MODES
+                and live_foreign_writers(vol, job_id, namespace, snapshot)):
+            return np.zeros(len(nodes), dtype=bool)
+        vols.append(vol)
+    out = np.empty(len(nodes), dtype=bool)
+    for i, node in enumerate(nodes):
+        out[i] = all(v.schedulable_on(node.id) for v in vols)
+    return out
+
+
 def reserved_ports_mask(tg: TaskGroup, nodes: Sequence[Node],
                         proposed_allocs_fn) -> np.ndarray:
     """Static-port feasibility: every reserved port the group asks for
@@ -397,15 +450,21 @@ def job_constraints(job: Job, tg: TaskGroup) -> List[Constraint]:
 
 def feasible_mask(job: Job, tg: TaskGroup, nodes: Sequence[Node],
                   regex_cache: Optional[dict] = None,
-                  version_cache: Optional[dict] = None) -> np.ndarray:
+                  version_cache: Optional[dict] = None,
+                  snapshot=None) -> np.ndarray:
     """Full boolean feasibility mask for one task group over a node list:
-    constraints + drivers + devices. Datacenter/pool/readiness filtering
-    is assumed done upstream (reference readyNodesInDCsAndPool)."""
+    constraints + drivers + devices + volumes. Datacenter/pool/readiness
+    filtering is assumed done upstream (reference readyNodesInDCsAndPool).
+    `snapshot` powers the csi-volume claim check; without it csi-volume
+    groups mask everything out."""
     mask = driver_mask(tg, nodes)
     if not mask.any():
         return mask
     mask &= device_mask(tg, nodes)
     mask &= network_mask(tg, nodes)
+    mask &= host_volume_mask(tg, nodes)
+    if any(v.type == "csi" for v in tg.volumes.values()):
+        mask &= csi_volume_mask(tg, nodes, snapshot, job.namespace, job.id)
     for c in job_constraints(job, tg):
         if not mask.any():
             break

@@ -289,20 +289,35 @@ def _class_feasible(ctx: EvalContext, job: Job, tg: TaskGroup, node: Node) -> bo
 
     ok = elig.tg_status(tg.name, klass)
     if ok is None:
+        from .feasible import host_volume_mask
+
         tg_cons = list(tg.constraints) + [c for t in tg.tasks for c in t.constraints]
         ok = (
             bool(driver_mask(tg, [node])[0])
             and bool(device_mask(tg, [node])[0])
             and bool(network_mask(tg, [node])[0])
+            and bool(host_volume_mask(tg, [node])[0])
             and all(
                 node_meets_constraint(c, node, ctx.regex_cache, ctx.version_cache)
                 for c in tg_cons
             )
         )
         elig.set_tg_status(tg.name, klass, ok)
-    if not ok and ctx.metrics is not None:
-        ctx.metrics.filter_node("task group constraints")
-    return ok
+    if not ok:
+        if ctx.metrics is not None:
+            ctx.metrics.filter_node("task group constraints")
+        return False
+    # csi-volume claims change independently of node classes: checked per
+    # node, never memoized (reference feasible.go:223 CSIVolumeChecker)
+    if any(v.type == "csi" for v in tg.volumes.values()):
+        from .feasible import csi_volume_mask
+
+        if not bool(csi_volume_mask(tg, [node], ctx.snapshot,
+                                    job.namespace, job.id)[0]):
+            if ctx.metrics is not None:
+                ctx.metrics.filter_node("csi volumes")
+            return False
+    return True
 
 
 def _plan_aware_job_allocs(ctx: EvalContext, job: Job) -> List[Allocation]:

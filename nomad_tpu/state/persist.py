@@ -34,6 +34,8 @@ def dump_store(store) -> dict:
             "acl_tokens": [wire_encode(t) for t in snap.acl_tokens()],
             "variables": [wire_encode(v)
                           for _, v in store._variables.iterate(snap.index)],
+            "volumes": [wire_encode(v)
+                        for _, v in store._volumes.iterate(snap.index)],
         }
 
 
@@ -52,6 +54,7 @@ def restore_store(store, data: dict) -> None:
     policies = [wire_decode(x) for x in data.get("acl_policies", [])]
     tokens = [wire_decode(x) for x in data.get("acl_tokens", [])]
     variables = [wire_decode(x) for x in data.get("variables", [])]
+    volumes = [wire_decode(x) for x in data.get("volumes", [])]
 
     with store._write_lock:
         # Generation choice must be deterministic across replicas AND
@@ -76,6 +79,7 @@ def restore_store(store, data: dict) -> None:
             id(store._acl_tokens): {t.accessor_id for t in tokens},
             id(store._acl_secret_idx): {t.secret_id for t in tokens},
             id(store._variables): {(v.namespace, v.path) for v in variables},
+            id(store._volumes): {(v.namespace, v.id) for v in volumes},
         }
         for t in store._all_tables:
             keep = new_keys.get(id(t), set())
@@ -122,6 +126,8 @@ def restore_store(store, data: dict) -> None:
             store._acl_secret_idx.put(t.secret_id, t.accessor_id, gen, live)
         for v in variables:
             store._variables.put((v.namespace, v.path), v, gen, live)
+        for v in volumes:
+            store._volumes.put((v.namespace, v.id), v, gen, live)
         store._next_gen = gen
         store._commit(gen, [("restore", None)])
 

@@ -181,6 +181,16 @@ class StateSnapshot:
         """{device_group_id: instances_used, "cores": n} or None."""
         return self._store._node_dev_usage.get(node_id, self.index)
 
+    # --- volumes ---
+
+    def volume_by_id(self, vol_id: str, namespace: str = "default"):
+        return self._store._volumes.get((namespace, vol_id), self.index)
+
+    def volumes(self, namespace: Optional[str] = None):
+        for (ns, _vid), v in self._store._volumes.iterate(self.index):
+            if namespace is None or ns == namespace:
+                yield v
+
     def deployment_by_id(self, dep_id: str) -> Optional[Deployment]:
         return self._store._deployments.get(dep_id, self.index)
 
@@ -231,6 +241,7 @@ class StateStore:
         self._acl_tokens = VersionedTable("acl_tokens")         # key accessor id
         self._acl_secret_idx = VersionedTable("acl_secret_idx")  # secret -> accessor
         self._variables = VersionedTable("variables")           # key (ns, path)
+        self._volumes = VersionedTable("volumes")               # key (ns, id)
         # derived: per-node summed allocated_vec of usage-counting allocs,
         # maintained on every alloc write so tensorization reads one row
         # per node instead of walking every alloc (the tensor-era form of
@@ -247,7 +258,8 @@ class StateStore:
             self._deployments, self._allocs_by_node, self._allocs_by_job,
             self._allocs_by_eval, self._evals_by_job, self._deployments_by_job,
             self._acl_policies, self._acl_tokens, self._acl_secret_idx,
-            self._variables, self._node_usage, self._node_dev_usage,
+            self._variables, self._volumes,
+            self._node_usage, self._node_dev_usage,
         ]
         self._listeners: List[Callable[[int, list], None]] = []
 
@@ -629,8 +641,11 @@ class StateStore:
                 self._put_alloc(alloc, gen, live, ts)
                 events.append(("alloc-preempt", alloc))
             for alloc in result_allocs:
+                prev_row = self._allocs.get_latest(alloc.id)
                 self._put_alloc(alloc, gen, live, ts)
                 events.append(("alloc-upsert", alloc))
+                if prev_row is None:  # new placements claim their volumes
+                    self._claim_volumes_for(alloc, gen, live, events)
             if deployment is not None:
                 self._put_deployment(deployment, gen, live)
                 events.append(("deployment-upsert", deployment))
@@ -691,6 +706,96 @@ class StateStore:
             self._deployments.put(dep_id, dep, gen, live)
             self._commit(gen, [("deployment-update", dep)])
             return gen
+
+    # --- volumes (reference state_store_csi + volumewatcher semantics) ---
+
+    def upsert_volume(self, vol) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            key = (vol.namespace, vol.id)
+            prev = self._volumes.get_latest(key)
+            if prev is not None:
+                vol.create_index = prev.create_index
+                # claims are store-owned state: a re-register must not wipe
+                # live claims (reference CSIVolumeRegister merges)
+                if not vol.claims and prev.claims:
+                    vol.claims = dict(prev.claims)
+            else:
+                vol.create_index = gen
+            vol.modify_index = gen
+            self._volumes.put(key, vol, gen, live)
+            self._commit(gen, [("volume-upsert", vol)])
+            return gen
+
+    def delete_volume(self, vol_id: str, namespace: str = "default",
+                      force: bool = False) -> int:
+        with self._write_lock:
+            key = (namespace, vol_id)
+            vol = self._volumes.get_latest(key)
+            if vol is not None and vol.claims and not force:
+                raise ValueError(
+                    f"volume {vol_id} has {len(vol.claims)} live claims")
+            gen, live = self._begin()
+            self._volumes.delete(key, gen, live)
+            self._commit(gen, [("volume-delete", vol)])
+            return gen
+
+    def _claim_volumes_for(self, alloc: Allocation, gen: int, live: int,
+                           events: list) -> None:
+        """Record this placement's csi-volume claims (called inside the
+        plan-apply transaction; the applier pre-verified claimability).
+        Readers claim too — the watcher tracks every attachment."""
+        job = alloc.job
+        if job is None:
+            return
+        tg = job.lookup_task_group(alloc.task_group)
+        if tg is None or not tg.volumes:
+            return
+        from ..structs.volumes import VolumeClaim
+
+        for req in tg.volumes.values():
+            if req.type != "csi":
+                continue
+            key = (alloc.namespace, req.source)
+            vol = self._volumes.get_latest(key)
+            if vol is None:
+                continue
+            vol = copy.copy(vol)
+            vol.claims = dict(vol.claims)
+            vol.claims[alloc.id] = VolumeClaim(
+                alloc_id=alloc.id, node_id=alloc.node_id,
+                read_only=req.read_only)
+            vol.modify_index = gen
+            self._volumes.put(key, vol, gen, live)
+            events.append(("volume-claim", vol))
+
+    def reap_volume_claims(self) -> int:
+        """Release claims whose allocs are terminal or gone (the volume
+        watcher's reaping pass, reference nomad/volumewatcher/). Returns
+        claims released."""
+        with self._write_lock:
+            changes = []
+            for key, vol in list(self._volumes.iterate(self._index)):
+                dead = [aid for aid in vol.claims
+                        if (a := self._allocs.get_latest(aid)) is None
+                        or a.terminal_status()]
+                if dead:
+                    changes.append((key, vol, dead))
+            if not changes:
+                return 0  # no generation churn on idle reaping passes
+            gen, live = self._begin()
+            events = []
+            released = 0
+            for key, vol, dead in changes:
+                vol = copy.copy(vol)
+                vol.claims = {k: v for k, v in vol.claims.items()
+                              if k not in dead}
+                vol.modify_index = gen
+                self._volumes.put(key, vol, gen, live)
+                events.append(("volume-claim-release", vol))
+                released += len(dead)
+            self._commit(gen, events)
+            return released
 
     # --- ACL (reference nomad/state/state_store acl tables) ---
 

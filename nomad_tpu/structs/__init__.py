@@ -25,6 +25,13 @@ from .alloc import Allocation, AllocMetric, RescheduleTracker, RescheduleEvent, 
 from .evaluation import Evaluation  # noqa: F401
 from .plan import Plan, PlanResult  # noqa: F401
 from .deployment import Deployment, DeploymentState  # noqa: F401
+from .volumes import (  # noqa: F401
+    ClientHostVolumeConfig,
+    Volume,
+    VolumeClaim,
+    VolumeMount,
+    VolumeRequest,
+)
 from .funcs import (  # noqa: F401
     score_fit_binpack,
     score_fit_spread,

@@ -8,6 +8,7 @@ from typing import Dict, List, Optional
 from . import enums
 from .constraint import Affinity, Constraint, Spread
 from .resources import NetworkResource, Resources
+from .volumes import VolumeMount, VolumeRequest
 
 
 @dataclass(slots=True)
@@ -96,6 +97,7 @@ class Task:
     constraints: List[Constraint] = field(default_factory=list)
     affinities: List[Affinity] = field(default_factory=list)
     services: List[Service] = field(default_factory=list)
+    volume_mounts: List[VolumeMount] = field(default_factory=list)
     leader: bool = False
     lifecycle_hook: str = ""      # "" (main) | prestart | poststart | poststop
     lifecycle_sidecar: bool = False
@@ -125,6 +127,8 @@ class TaskGroup:
     ephemeral_disk: EphemeralDisk = field(default_factory=EphemeralDisk)
     networks: List[NetworkResource] = field(default_factory=list)
     services: List[Service] = field(default_factory=list)
+    # group volume stanzas by name (reference TaskGroup.Volumes)
+    volumes: Dict[str, VolumeRequest] = field(default_factory=dict)
     max_client_disconnect_s: Optional[float] = None
     stop_after_client_disconnect_s: Optional[float] = None
     meta: Dict[str, str] = field(default_factory=dict)

@@ -39,6 +39,10 @@ class Node:
     meta: Dict[str, str] = field(default_factory=dict)
     resources: NodeResources = field(default_factory=NodeResources)
     reserved: NodeReservedResources = field(default_factory=NodeReservedResources)
+    # volumes this node exposes, by name (reference Node.HostVolumes;
+    # class-relevant: included in compute_class so the host-volume
+    # feasibility check memoizes per class)
+    host_volumes: Dict[str, object] = field(default_factory=dict)
     links: Dict[str, str] = field(default_factory=dict)
     drivers: Dict[str, bool] = field(default_factory=dict)  # driver name -> healthy
     status: str = enums.NODE_STATUS_READY
@@ -137,6 +141,9 @@ class Node:
         # support must land in different classes
         for mode in sorted({n.mode for n in self.resources.networks}):
             put("net", mode)
+        for name in sorted(self.host_volumes):
+            hv = self.host_volumes[name]
+            put("vol", name, "ro" if getattr(hv, "read_only", False) else "rw")
         for numa in self.resources.numa:
             put(str(numa.id), repr(numa.cores))
         for d in self.resources.devices:

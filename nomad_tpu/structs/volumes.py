@@ -1,0 +1,133 @@
+"""Volumes: node-exposed host volumes and registered (CSI-lite) volumes.
+
+Reference: ClientHostVolumeConfig (structs.go host volume stanza),
+VolumeRequest/VolumeMount (structs/volumes.go), CSIVolume + claims
+(structs/csi.go:1587, claim state machine reaped by
+nomad/volumewatcher/). The CSI gRPC plugin boundary itself is out of
+scope; what this module keeps is the scheduling and accounting model:
+feasibility masks over node-exposed volumes, and per-volume claim
+accounting with writer exclusivity for registered volumes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass(slots=True)
+class ClientHostVolumeConfig:
+    """A volume a node exposes (reference ClientHostVolumeConfig:
+    client host_volume stanza, fingerprinted onto the node)."""
+
+    name: str = ""
+    path: str = ""
+    read_only: bool = False
+
+
+@dataclass(slots=True)
+class VolumeRequest:
+    """A task group's volume stanza (reference structs/volumes.go
+    VolumeRequest). type "host" matches node host_volumes by source
+    name; type "csi" matches a registered volume id."""
+
+    name: str = ""
+    type: str = "host"            # host | csi
+    source: str = ""
+    read_only: bool = False
+    # csi-only: how the volume may be shared (reference CSIVolume
+    # AccessMode); writers are exclusive unless multi-node-multi-writer
+    access_mode: str = "single-node-writer"
+    per_alloc: bool = False       # source becomes "<source>[<alloc index>]"
+
+
+@dataclass(slots=True)
+class VolumeMount:
+    """Task-level mount of a group volume (reference structs/volumes.go
+    VolumeMount)."""
+
+    volume: str = ""
+    destination: str = ""
+    read_only: bool = False
+
+
+# access modes that allow more than one concurrent writer claim
+MULTI_WRITER_MODES = ("multi-node-multi-writer",)
+
+
+@dataclass(slots=True)
+class VolumeClaim:
+    """One alloc's claim on a registered volume (reference structs/csi.go
+    CSIVolumeClaim)."""
+
+    alloc_id: str = ""
+    node_id: str = ""
+    read_only: bool = False
+
+
+@dataclass(slots=True)
+class Volume:
+    """A registered cluster volume (CSI-lite; reference structs/csi.go
+    CSIVolume). Claims are updated transactionally at plan apply and
+    released by the volume watcher when their allocs go terminal."""
+
+    id: str = ""
+    namespace: str = "default"
+    name: str = ""
+    plugin_id: str = "host"
+    access_mode: str = "single-node-writer"
+    # node ids that can mount this volume; empty = any node
+    topology_node_ids: List[str] = field(default_factory=list)
+    claims: Dict[str, VolumeClaim] = field(default_factory=dict)  # alloc id ->
+    create_index: int = 0
+    modify_index: int = 0
+
+    def writers(self) -> List[VolumeClaim]:
+        return [c for c in self.claims.values() if not c.read_only]
+
+    def claimable(self, read_only: bool) -> bool:
+        """Whether one more claim of the given mode fits the access mode
+        (reference csi.go WriteFreeClaims)."""
+        if read_only:
+            return True
+        if self.access_mode in MULTI_WRITER_MODES:
+            return True
+        return not self.writers()
+
+    def schedulable_on(self, node_id: str) -> bool:
+        return not self.topology_node_ids or node_id in self.topology_node_ids
+
+
+def csi_writer_sources(alloc) -> List[tuple]:
+    """(namespace, source) for every csi volume this alloc's task group
+    claims for WRITE — the single definition of the claim-extraction walk
+    shared by the store's claim transaction, the plan applier's claim
+    re-verification, and the pipeline overlay."""
+    job = alloc.job
+    if job is None:
+        return []
+    tg = job.lookup_task_group(alloc.task_group)
+    if tg is None or not tg.volumes:
+        return []
+    return [(alloc.namespace, req.source) for req in tg.volumes.values()
+            if req.type == "csi" and not req.read_only]
+
+
+def live_foreign_writers(vol: "Volume", job_id: str, namespace: str,
+                         snapshot) -> List[VolumeClaim]:
+    """Write claims that actually block a new writer from `job_id`:
+    claims whose alloc is live AND belongs to a different job. Claims of
+    terminal or vanished allocs are stale (the watcher will reap them),
+    and the job's own claims belong to allocs its update/reschedule is
+    replacing — blocking on those would deadlock every destructive
+    update of a single-writer-volume job (reference CSIVolumeChecker
+    tolerates same-job claims for exactly this reason)."""
+    out = []
+    for c in vol.writers():
+        a = snapshot.alloc_by_id(c.alloc_id)
+        if a is None or a.terminal_status():
+            continue
+        if a.job_id == job_id and a.namespace == namespace:
+            continue
+        out.append(c)
+    return out

@@ -1,0 +1,288 @@
+"""Volumes: host-volume feasibility, CSI-lite claims, watcher reaping
+(reference scheduler/feasible.go:139 HostVolumeChecker, :223
+CSIVolumeChecker, structs/csi.go claims, nomad/volumewatcher/)."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.plan_apply import PlanApplier, PlanQueue
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (ClientHostVolumeConfig, Volume, VolumeRequest,
+                               enums)
+from nomad_tpu.structs.operator import SchedulerConfiguration
+from nomad_tpu.structs.plan import Plan
+from nomad_tpu.testing import Harness
+
+
+def vol_node(vol_name="data", read_only=False, **overrides):
+    n = mock.node(**overrides)
+    n.host_volumes = {vol_name: ClientHostVolumeConfig(
+        name=vol_name, path=f"/srv/{vol_name}", read_only=read_only)}
+    n.compute_class()
+    return n
+
+
+def vol_job(name="data", vtype="host", source="data", read_only=False,
+            count=2, access_mode="single-node-writer"):
+    j = mock.job()
+    tg = j.task_groups[0]
+    tg.count = count
+    tg.volumes = {name: VolumeRequest(
+        name=name, type=vtype, source=source, read_only=read_only,
+        access_mode=access_mode)}
+    return j
+
+
+class TestHostVolumes:
+    def test_class_hash_includes_host_volumes(self):
+        plain = mock.node(id="a", name="n")
+        withvol = vol_node(id="a", name="n")
+        assert plain.compute_class() != withvol.computed_class
+        ro = vol_node(id="a", name="n", read_only=True)
+        assert ro.computed_class != withvol.computed_class
+
+    @pytest.mark.parametrize("algorithm", [enums.SCHED_ALG_BINPACK,
+                                           enums.SCHED_ALG_TPU_BINPACK])
+    def test_only_exposing_nodes_get_allocs(self, algorithm):
+        h = Harness()
+        exposing = [vol_node() for _ in range(2)]
+        for n in exposing:
+            h.store.upsert_node(n)
+        for _ in range(3):
+            h.store.upsert_node(mock.node())
+        j = vol_job(count=4)
+        h.store.upsert_job(j)
+        h.process(mock.eval_for(j), sched_config=SchedulerConfiguration(
+            scheduler_algorithm=algorithm))
+        allocs = [a for a in h.store.snapshot().allocs_by_job(j.id)
+                  if not a.terminal_status()]
+        assert len(allocs) == 4
+        ok_nodes = {n.id for n in exposing}
+        assert all(a.node_id in ok_nodes for a in allocs)
+
+    @pytest.mark.parametrize("algorithm", [enums.SCHED_ALG_BINPACK,
+                                           enums.SCHED_ALG_TPU_BINPACK])
+    def test_readonly_host_volume_rejects_writers(self, algorithm):
+        h = Harness()
+        h.store.upsert_node(vol_node(read_only=True))
+        j = vol_job(count=1, read_only=False)  # wants to write
+        h.store.upsert_job(j)
+        h.process(mock.eval_for(j), sched_config=SchedulerConfiguration(
+            scheduler_algorithm=algorithm))
+        assert not [a for a in h.store.snapshot().allocs_by_job(j.id)
+                    if not a.terminal_status()]
+        # a read-only request is fine
+        j2 = vol_job(count=1, read_only=True)
+        h.store.upsert_job(j2)
+        h.process(mock.eval_for(j2), sched_config=SchedulerConfiguration(
+            scheduler_algorithm=algorithm))
+        assert len(h.store.snapshot().allocs_by_job(j2.id)) == 1
+
+
+class TestCSIVolumes:
+    def register(self, store, node_ids=(), access="single-node-writer"):
+        v = Volume(id="pgdata", name="pgdata", access_mode=access,
+                   topology_node_ids=list(node_ids))
+        store.upsert_volume(v)
+        return v
+
+    def test_topology_restricts_nodes(self):
+        h = Harness()
+        nodes = [mock.node() for _ in range(4)]
+        for n in nodes:
+            h.store.upsert_node(n)
+        self.register(h.store, node_ids=[nodes[0].id, nodes[1].id])
+        j = vol_job(vtype="csi", source="pgdata", count=2, read_only=True)
+        h.store.upsert_job(j)
+        h.process(mock.eval_for(j))
+        allocs = [a for a in h.store.snapshot().allocs_by_job(j.id)
+                  if not a.terminal_status()]
+        assert len(allocs) == 2
+        assert {a.node_id for a in allocs} <= {nodes[0].id, nodes[1].id}
+
+    def test_single_writer_exclusivity_and_reaping(self):
+        h = Harness()
+        for _ in range(3):
+            h.store.upsert_node(mock.node())
+        self.register(h.store)
+        j1 = vol_job(vtype="csi", source="pgdata", count=1)
+        h.store.upsert_job(j1)
+        h.process(mock.eval_for(j1))
+        a1 = h.store.snapshot().allocs_by_job(j1.id)
+        assert len(a1) == 1
+        vol = h.store.snapshot().volume_by_id("pgdata")
+        assert len(vol.writers()) == 1
+
+        # second writer job: no feasible node anywhere
+        j2 = vol_job(vtype="csi", source="pgdata", count=1)
+        h.store.upsert_job(j2)
+        h.process(mock.eval_for(j2))
+        assert not [a for a in h.store.snapshot().allocs_by_job(j2.id)
+                    if not a.terminal_status()]
+
+        # readers are always fine
+        j3 = vol_job(vtype="csi", source="pgdata", count=1, read_only=True)
+        h.store.upsert_job(j3)
+        h.process(mock.eval_for(j3))
+        assert len(h.store.snapshot().allocs_by_job(j3.id)) == 1
+
+        # writer's alloc dies -> watcher reaps -> volume claimable again
+        dead = a1[0].copy_for_update()
+        dead.client_status = enums.ALLOC_CLIENT_FAILED
+        h.store.update_allocs_from_client([dead])
+        released = h.store.reap_volume_claims()
+        assert released == 1
+        vol = h.store.snapshot().volume_by_id("pgdata")
+        assert not vol.writers()
+        assert vol.claimable(read_only=False)
+
+    def test_update_of_single_writer_job_does_not_deadlock(self):
+        """A new version of the claiming job must be able to place even
+        though its own old alloc still holds the write claim — blocking
+        on it would deadlock every destructive update (reference
+        CSIVolumeChecker tolerates same-job claims)."""
+        h = Harness()
+        for _ in range(2):
+            h.store.upsert_node(mock.node())
+        self.register(h.store)
+        j = vol_job(vtype="csi", source="pgdata", count=1)
+        h.store.upsert_job(j)
+        h.process(mock.eval_for(j))
+        old = [a for a in h.store.snapshot().allocs_by_job(j.id)
+               if not a.terminal_status()]
+        assert len(old) == 1
+
+        # destructive update: bump the task resources
+        j2 = vol_job(vtype="csi", source="pgdata", count=1)
+        j2.id = j.id
+        j2.name = j.name
+        j2.task_groups[0].tasks[0].resources.cpu = 600
+        h.store.upsert_job(j2)
+        h.process(mock.eval_for(j2))
+        live = [a for a in h.store.snapshot().allocs_by_job(j.id)
+                if not a.terminal_status() and not a.server_terminal()]
+        assert len(live) == 1, "replacement must place"
+        assert live[0].id != old[0].id
+
+    def test_per_alloc_volumes_rejected_at_validation(self):
+        from nomad_tpu.api.jobspec import _validate
+
+        j = vol_job(vtype="csi", source="pgdata", count=2)
+        j.task_groups[0].volumes["data"].per_alloc = True
+        with pytest.raises(ValueError, match="per_alloc"):
+            _validate(j)
+
+    def test_deregister_refuses_live_claims(self):
+        h = Harness()
+        h.store.upsert_node(mock.node())
+        self.register(h.store)
+        j = vol_job(vtype="csi", source="pgdata", count=1)
+        h.store.upsert_job(j)
+        h.process(mock.eval_for(j))
+        with pytest.raises(ValueError):
+            h.store.delete_volume("pgdata")
+        h.store.delete_volume("pgdata", force=True)
+        assert h.store.snapshot().volume_by_id("pgdata") is None
+
+    def test_applier_rejects_racing_writers(self):
+        """Two plans from stale snapshots both claiming the single-writer
+        volume: the applier's cross-node claim check commits exactly one
+        (the reference's claim transaction)."""
+        store = StateStore()
+        n1, n2 = mock.node(), mock.node()
+        store.upsert_node(n1)
+        store.upsert_node(n2)
+        job1 = vol_job(vtype="csi", source="pgdata", count=1)
+        job2 = vol_job(vtype="csi", source="pgdata", count=1)
+        store.upsert_job(job1)
+        store.upsert_job(job2)
+        v = Volume(id="pgdata", name="pgdata")
+        store.upsert_volume(v)
+        q = PlanQueue()
+        q.set_enabled(True)
+        ap = PlanApplier(store, q)
+
+        snap_index = store.latest_index
+        p1 = Plan(eval_id="e1", snapshot_index=snap_index)
+        p1.append_alloc(mock.alloc(job1, n1, index=0))
+        p2 = Plan(eval_id="e2", snapshot_index=snap_index)
+        p2.append_alloc(mock.alloc(job2, n2, index=0))
+        r1 = ap.apply(p1)
+        r2 = ap.apply(p2)
+        assert not r1.rejected_nodes
+        assert r2.rejected_nodes == [n2.id]
+        vol = store.snapshot().volume_by_id("pgdata")
+        assert len(vol.writers()) == 1
+
+    def test_dump_restore_keeps_claims(self):
+        h = Harness()
+        h.store.upsert_node(mock.node())
+        self.register(h.store)
+        j = vol_job(vtype="csi", source="pgdata", count=1)
+        h.store.upsert_job(j)
+        h.process(mock.eval_for(j))
+        data = h.store.dump()
+        fresh = StateStore()
+        fresh.restore_dump(data)
+        vol = fresh.snapshot().volume_by_id("pgdata")
+        assert vol is not None and len(vol.writers()) == 1
+
+
+class TestVolumeAPI:
+    def test_http_register_get_list_deregister(self):
+        from nomad_tpu.api.http import HTTPAgent
+        import json
+        import urllib.request
+
+        srv = Server(ServerConfig(num_workers=0, heartbeat_ttl=3600,
+                                  gc_interval=3600))
+        with srv, HTTPAgent(srv, port=0) as agent:
+            def req(path, body=None, method=None):
+                r = urllib.request.Request(
+                    f"{agent.address}{path}",
+                    method=method or ("POST" if body is not None else "GET"),
+                    data=json.dumps(body).encode() if body is not None else None)
+                with urllib.request.urlopen(r, timeout=5) as resp:
+                    return json.loads(resp.read())
+
+            req("/v1/volume/csi/pgdata", {"volume": {
+                "name": "pgdata", "access_mode": "single-node-writer"}})
+            vols = req("/v1/volumes")
+            assert [v["id"] for v in vols] == ["pgdata"]
+            got = req("/v1/volume/csi/pgdata")
+            assert got["access_mode"] == "single-node-writer"
+            req("/v1/volume/csi/pgdata", method="DELETE")
+            assert req("/v1/volumes") == []
+
+    def test_jobspec_volume_blocks(self):
+        from nomad_tpu.api.jobspec import parse_hcl_like
+
+        job = parse_hcl_like('''
+job "db" {
+  datacenters = ["dc1"]
+  group "pg" {
+    count = 1
+    volume "data" {
+      type = "host"
+      source = "pgdata"
+      read_only = false
+    }
+    task "postgres" {
+      driver = "mock"
+      volume_mount {
+        volume = "data"
+        destination = "/var/lib/postgresql"
+      }
+      resources { cpu = 100 memory = 128 }
+    }
+  }
+}
+''')
+        tg = job.task_groups[0]
+        assert "data" in tg.volumes
+        assert tg.volumes["data"].source == "pgdata"
+        assert tg.volumes["data"].type == "host"
+        vm = tg.tasks[0].volume_mounts[0]
+        assert vm.volume == "data"
+        assert vm.destination == "/var/lib/postgresql"
