@@ -69,6 +69,14 @@ class ApiClient:
         out, _ = self._request("POST", "/v1/jobs", payload)
         return out["eval_id"]
 
+    def plan_job(self, job) -> dict:
+        """Dry-run an update (reference api/jobs.go Plan)."""
+        payload = {"job": to_dict(job) if isinstance(job, Job) else job}
+        job_id = payload["job"].get("id") if isinstance(payload["job"], dict) \
+            else job.id
+        out, _ = self._request("POST", f"/v1/job/{job_id}/plan", payload)
+        return out
+
     def list_jobs(self, prefix: str = "") -> List[dict]:
         out, _ = self.get("/v1/jobs", prefix=prefix)
         return out

@@ -18,6 +18,10 @@ def to_dict(obj: Any) -> Any:
     """Recursively lower structs/containers to JSON-safe values."""
     if obj is None or isinstance(obj, (bool, int, float, str)):
         return obj
+    if isinstance(obj, bytes):
+        import base64
+
+        return base64.b64encode(obj).decode("ascii")
     if isinstance(obj, np.ndarray):
         return obj.tolist()
     if isinstance(obj, np.generic):
@@ -88,6 +92,10 @@ def _inflate(hint, val, owner_cls):
         return val
     if hint is np.ndarray or hint == "np.ndarray":
         return np.asarray(val, dtype=np.float64)
+    if hint is bytes:
+        import base64
+
+        return base64.b64decode(val) if isinstance(val, str) else val
     if dataclasses.is_dataclass(hint):
         return from_dict(hint, val)
     return val

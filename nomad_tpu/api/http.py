@@ -414,6 +414,16 @@ class HTTPAgent:
             _validate(job)
             eval_id = self.writer.register_job(job)
             return h._reply(200, {"eval_id": eval_id, "job_id": job.id})
+        if m := re.fullmatch(r"/v1/job/([^/]+)/plan", path):
+            data = body.get("job") or body.get("Job") or body
+            job = from_dict(Job, data)
+            job.id = m.group(1)
+            # the gate above authorized the query-param namespace; a
+            # body-supplied one would let a token probe other namespaces
+            job.namespace = ns
+            _validate(job)
+            # dry-run: local snapshot state is enough on any replica
+            return h._reply(200, self.server.plan_job(job))
         if m := re.fullmatch(r"/v1/job/([^/]+)/evaluate", path):
             ns = q.get("namespace", ["default"])[0]
             snap = self.server.store.snapshot()

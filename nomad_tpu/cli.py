@@ -110,6 +110,30 @@ def cmd_agent(args) -> int:
 # -- job ---------------------------------------------------------------------
 
 
+def cmd_job_plan(args) -> int:
+    """Dry-run the update and print per-group desired changes
+    (reference command/job_plan.go)."""
+    from .api.jobspec import parse_file
+
+    job = parse_file(args.spec)
+    out = _client(args).plan_job(job)
+    diff = out.get("diff", {})
+    print(f"Job: {out.get('job_id')!r} (version {out.get('job_version')}, "
+          f"{diff.get('type', '?')})")
+    for f in diff.get("fields", [])[:40]:
+        print(f"  ~ {f}")
+    print("\nScheduler dry-run:")
+    for tg, ann in sorted((out.get("annotations") or {}).items()):
+        parts = [f"{k}: {v}" for k, v in sorted(ann.items()) if v]
+        print(f"  group {tg!r}: " + (", ".join(parts) if parts else "no changes"))
+    failed = out.get("failed_tg_allocs") or {}
+    for tg, m in failed.items():
+        print(f"  group {tg!r}: {m.get('coalesced_failures', 0) + 1} "
+              f"WOULD FAIL to place (filtered {m.get('nodes_filtered')}, "
+              f"exhausted {m.get('nodes_exhausted')})")
+    return 1 if failed else 0
+
+
 def cmd_job_run(args) -> int:
     from .api.jobspec import parse_file
 
@@ -264,6 +288,9 @@ def build_parser() -> argparse.ArgumentParser:
     jr.add_argument("spec")
     jr.add_argument("-detach", action="store_true")
     jr.set_defaults(fn=cmd_job_run)
+    jp = job.add_parser("plan")
+    jp.add_argument("spec")
+    jp.set_defaults(fn=cmd_job_plan)
     js = job.add_parser("status")
     js.add_argument("job_id", nargs="?", default="")
     js.set_defaults(fn=cmd_job_status)

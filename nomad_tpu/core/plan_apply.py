@@ -392,7 +392,7 @@ class PlanApplier:
         placements would overcommit (reference volume claim transaction,
         nomad/csi_endpoint.go claim path)."""
         from ..structs.volumes import (MULTI_WRITER_MODES, csi_writer_sources,
-                                       live_foreign_writers)
+                                       live_blocking_writers)
 
         # (ns, source) -> [(node_id, job_id)] of NEW write placements
         writers_wanted: Dict[tuple, List[tuple]] = {}
@@ -414,10 +414,9 @@ class PlanApplier:
                 continue
             if vol.access_mode in MULTI_WRITER_MODES:
                 continue
-            # one plan serves one job's eval: same-job existing claims
-            # belong to allocs this plan is replacing and don't block
-            job_id = wants[0][1]
-            taken = (bool(live_foreign_writers(vol, job_id, ns, snap))
+            # claims of allocs this plan stops are being released; any
+            # other live claim (a racing job or a live sibling) blocks
+            taken = (bool(live_blocking_writers(vol, snap, plan))
                      or (ns, source) in pending)
             free = 0 if taken else 1
             for node_id, _ in sorted(wants):  # deterministic winner

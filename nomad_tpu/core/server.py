@@ -544,6 +544,87 @@ class Server:
 
     # -- Eval endpoints --
 
+    def plan_job(self, job: Job) -> Dict:
+        """Dry-run scheduling of a job update (reference Job.Plan,
+        nomad/job_endpoint.go + scheduler/annotate.go): run the real
+        scheduler against the current snapshot with a planner that
+        commits nothing, and report per-TG desired-update annotations, a
+        spec diff against the running version, and failed placements."""
+        import copy as _c
+
+        from ..scheduler.generic_sched import GenericScheduler
+        from ..structs.job import spec_diff
+
+        snap = self.store.snapshot()
+        prev = snap.job_by_id(job.id, job.namespace)
+        planned = _c.copy(job)
+        planned.version = (prev.version + 1) if prev is not None else 0
+        planned.create_index = prev.create_index if prev is not None else 0
+
+        class _PlanSnapshot:
+            """The store snapshot with the planned job overlaid."""
+
+            def __init__(self, base):
+                self._base = base
+
+            def job_by_id(self, job_id, namespace="default"):
+                if job_id == planned.id and namespace == planned.namespace:
+                    return planned
+                return self._base.job_by_id(job_id, namespace)
+
+            def __getattr__(self, name):
+                return getattr(self._base, name)
+
+        class _DryRunPlanner:
+            """Planner that records the plan and commits nothing
+            (the annotate-mode Harness, reference scheduler/testing.go)."""
+
+            def __init__(self):
+                self.plans = []
+                self.evals = []
+
+            def submit_plan(self, plan):
+                from ..structs.plan import PlanResult
+
+                self.plans.append(plan)
+                return PlanResult(
+                    node_allocation=plan.node_allocation,
+                    node_update=plan.node_update,
+                    node_preemptions=plan.node_preemptions,
+                    alloc_index=snap.index), None
+
+            def update_eval(self, ev):
+                self.evals.append(ev)
+
+            def create_eval(self, ev):
+                self.evals.append(ev)
+
+            def reblock_eval(self, ev):
+                self.evals.append(ev)
+
+        planner = _DryRunPlanner()
+        sched = GenericScheduler(
+            _PlanSnapshot(snap), planner,
+            batch=(planned.type == enums.JOB_TYPE_BATCH),
+            sched_config=self.sched_config, logger=self.logger)
+        ev = Evaluation(
+            id=generate_uuid(), namespace=planned.namespace,
+            priority=planned.priority, type=planned.type,
+            triggered_by=enums.TRIGGER_JOB_REGISTER, job_id=planned.id,
+            status=enums.EVAL_STATUS_PENDING)
+        sched.process(ev)
+        return {
+            "job_id": planned.id,
+            "job_version": planned.version,
+            "annotations": getattr(sched, "annotations", {}),
+            "diff": spec_diff(prev, planned),
+            "failed_tg_allocs": {
+                name: {"nodes_filtered": m.nodes_filtered,
+                       "nodes_exhausted": m.nodes_exhausted,
+                       "coalesced_failures": m.coalesced_failures}
+                for name, m in sched.failed_tg_allocs.items()},
+        }
+
     # -- Volume endpoints (reference nomad/csi_endpoint.go register/deregister) --
 
     def register_volume(self, vol) -> None:

@@ -389,15 +389,16 @@ def host_volume_mask(tg: TaskGroup, nodes: Sequence[Node]) -> np.ndarray:
 
 def csi_volume_mask(tg: TaskGroup, nodes: Sequence[Node],
                     snapshot, namespace: str = "default",
-                    job_id: str = "") -> np.ndarray:
+                    plan=None) -> np.ndarray:
     """CSIVolumeChecker (reference feasible.go:223): every csi-type
     request must name a registered volume whose topology admits the node
     and whose access mode has room for our claim. Writer exclusivity only
-    counts LIVE claims from OTHER jobs (volumes.live_foreign_writers) so
-    destructive updates and reschedules of the claiming job don't
-    deadlock on their own claim. NOT class-memoized — claims change
+    counts LIVE claims not being stopped by the in-progress plan
+    (volumes.live_blocking_writers) so updates/reschedules of the
+    claiming job don't deadlock on their own claim while a scale-up's
+    live sibling still blocks. NOT class-memoized — claims change
     independently of node classes."""
-    from ..structs.volumes import MULTI_WRITER_MODES, live_foreign_writers
+    from ..structs.volumes import MULTI_WRITER_MODES, live_blocking_writers
 
     asks = [v for v in tg.volumes.values() if v.type == "csi"]
     if not asks:
@@ -410,7 +411,7 @@ def csi_volume_mask(tg: TaskGroup, nodes: Sequence[Node],
         if vol is None:
             return np.zeros(len(nodes), dtype=bool)
         if (not req.read_only and vol.access_mode not in MULTI_WRITER_MODES
-                and live_foreign_writers(vol, job_id, namespace, snapshot)):
+                and live_blocking_writers(vol, snapshot, plan)):
             return np.zeros(len(nodes), dtype=bool)
         vols.append(vol)
     out = np.empty(len(nodes), dtype=bool)
@@ -451,7 +452,7 @@ def job_constraints(job: Job, tg: TaskGroup) -> List[Constraint]:
 def feasible_mask(job: Job, tg: TaskGroup, nodes: Sequence[Node],
                   regex_cache: Optional[dict] = None,
                   version_cache: Optional[dict] = None,
-                  snapshot=None) -> np.ndarray:
+                  snapshot=None, plan=None) -> np.ndarray:
     """Full boolean feasibility mask for one task group over a node list:
     constraints + drivers + devices + volumes. Datacenter/pool/readiness
     filtering is assumed done upstream (reference readyNodesInDCsAndPool).
@@ -464,7 +465,7 @@ def feasible_mask(job: Job, tg: TaskGroup, nodes: Sequence[Node],
     mask &= network_mask(tg, nodes)
     mask &= host_volume_mask(tg, nodes)
     if any(v.type == "csi" for v in tg.volumes.values()):
-        mask &= csi_volume_mask(tg, nodes, snapshot, job.namespace, job.id)
+        mask &= csi_volume_mask(tg, nodes, snapshot, job.namespace, plan)
     for c in job_constraints(job, tg):
         if not mask.any():
             break

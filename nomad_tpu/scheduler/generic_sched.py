@@ -105,6 +105,9 @@ class GenericScheduler:
             ev.job_id, all_allocs, tainted, batch=self.batch, eval_id=ev.id,
             deployment=latest_dep)
         results = reconciler.compute()
+        # per-TG desired-update annotations, surfaced by the dry-run plan
+        # endpoint (reference scheduler/annotate.go:42 Annotate)
+        self.annotations = dict(results.desired_tg_updates)
 
         # deployments track service-job rollouts (reference reconcile.go
         # computeDeployments; watched by nomad/deploymentwatcher). A new
@@ -132,6 +135,13 @@ class GenericScheduler:
                 now0 = time.time()
                 for tg in job.task_groups:
                     if tg.update is None:
+                        continue
+                    # groups whose update is entirely in-place (or a
+                    # no-op) have nothing to health-track; a deployment
+                    # state for them would sit at 0 placements until the
+                    # progress deadline failed it
+                    tgr = results.groups.get(tg.name)
+                    if tgr is None or not (tgr.place or tgr.destructive_update):
                         continue
                     # canaries only apply to UPDATE rollouts: the deployment
                     # demands canaries iff the reconciler actually asked for
@@ -165,6 +175,24 @@ class GenericScheduler:
             for alloc in g.destructive_update:
                 self.plan.append_stopped_alloc(
                     alloc, "alloc is being updated due to job update")
+            # in-place updates: same alloc, same node, same resources —
+            # only the job definition it runs under advances (reference
+            # scheduler/util.go genericAllocUpdateFn's in-place arm).
+            # They join the active deployment so a mixed in-place/
+            # destructive rollout can still reach the watcher's
+            # "desired_total tracked allocs" completion bar; their
+            # carried health keeps counting.
+            tg_obj = job.lookup_task_group(tg_name) if job else None
+            for alloc in g.inplace_update:
+                upd = alloc.copy_for_update()
+                upd.job = job
+                upd.job_version = job.version
+                if (self.deployment is not None and tg_obj is not None
+                        and tg_obj.update is not None
+                        and tg_name in self.deployment.task_groups):
+                    upd.deployment_id = self.deployment.id
+                self.plan.node_allocation.setdefault(
+                    upd.node_id, []).append(upd)
             self.followups.extend(g.followup_evals)
             # annotate failed-then-delayed allocs with their followup eval
             for alloc_id, feval_id in g.delayed_reschedule.items():

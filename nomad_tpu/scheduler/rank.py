@@ -313,7 +313,7 @@ def _class_feasible(ctx: EvalContext, job: Job, tg: TaskGroup, node: Node) -> bo
         from .feasible import csi_volume_mask
 
         if not bool(csi_volume_mask(tg, [node], ctx.snapshot,
-                                    job.namespace, job.id)[0]):
+                                    job.namespace, ctx.plan)[0]):
             if ctx.metrics is not None:
                 ctx.metrics.filter_node("csi volumes")
             return False

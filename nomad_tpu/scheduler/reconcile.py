@@ -287,37 +287,66 @@ class AllocReconciler:
                     name=a.name, task_group=tg, previous_alloc=a))
             return g
 
-        # destructive updates: job version changed (reference: in-place vs
-        # destructive via tasksUpdated; spec diffing lands with deployments,
-        # so any version bump is destructive here)
-        if self.job is not None:
-            updated = [a for a in live if a.job_version != self.job.version]
-            if updated:
-                # honor update.max_parallel per pass when configured
-                mp = max(1, tg.update.max_parallel) if tg.update else len(updated)
-                g.destructive_update.extend(updated[:mp])
-                live = [a for a in live if a.id not in
-                        {x.id for x in g.destructive_update}]
-                live.extend(updated[mp:])  # remaining old-version stay for now
-                g.ignore += len(updated[mp:])
-
-        # scale down: too many live + migrating allocs (reference computeStop)
-        keep = live
+        # scale down FIRST (reference computeGroup runs computeStop before
+        # computeUpdates): updating before stopping lets a destructive
+        # replacement re-place an alloc the count math was about to
+        # retire, growing the group past `desired` with no eval left to
+        # shrink it (seen post-canary-promotion: old alloc + promoted
+        # canary = surplus). Old-version allocs stop first — they are
+        # doomed anyway — then highest name-index.
         if len(live) + len(g.migrate) > desired:
             excess = len(live) + len(g.migrate) - desired
-            # stop live allocs first, highest name-index first
-            by_index = sorted(live, key=lambda a: a.index(), reverse=True)
-            stop_live = by_index[:excess]
+
+            def stop_key(a: Allocation):
+                current = (self.job is not None
+                           and a.job_version == self.job.version)
+                return (0 if not current else 1, -a.index())
+
+            by_pref = sorted(live, key=stop_key)
+            stop_live = by_pref[:excess]
             for a in stop_live:
                 g.stop.append((a, "alloc not needed due to job update", ""))
-            keep = by_index[len(stop_live):]
+            live = by_pref[len(stop_live):]
             excess -= len(stop_live)
             # still over: cancel migrations (stop without replacement)
             while excess > 0 and g.migrate:
                 a = g.migrate.pop()
                 g.stop.append((a, "alloc not needed due to job update", ""))
                 excess -= 1
-        g.ignore += len(keep)
+
+        # updates: job version changed. Spec-diff decides in-place vs
+        # destructive (reference scheduler/util.go tasksUpdated consumed
+        # at reconcile.go computeUpdates): a change the client can apply
+        # to the running alloc — meta, count, policies — updates in
+        # place; changes to what runs or what it holds destroy+replace.
+        inplace_ids: set = set()
+        if self.job is not None:
+            from .util import tasks_updated
+
+            updated = [a for a in live if a.job_version != self.job.version]
+            if updated:
+                destructive = []
+                for a in updated:
+                    old_tg = (a.job.lookup_task_group(tg.name)
+                              if a.job is not None else None)
+                    if tasks_updated(old_tg, tg):
+                        destructive.append(a)
+                    else:
+                        g.inplace_update.append(a)
+                        inplace_ids.add(a.id)
+                # honor update.max_parallel per pass for the destructive
+                # side only; in-place updates are non-disruptive and land
+                # all at once. destructive[mp:] stay live (and are counted
+                # with `keep` below) until their turn in a later eval.
+                mp = (max(1, tg.update.max_parallel) if tg.update
+                      else len(destructive))
+                g.destructive_update.extend(destructive[:mp])
+                live = [a for a in live if a.id not in
+                        {x.id for x in g.destructive_update}]
+
+        keep = live
+        # in-place updated allocs are annotated as updates, not ignores
+        g.ignore += sum(1 for a in keep if a.id not in inplace_ids)
 
         # placements: migrations and lost get replacements with chains
         name_index = AllocNameIndex(self.job_id, tg.name, desired,

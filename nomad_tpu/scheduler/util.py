@@ -77,3 +77,65 @@ def update_non_terminal_allocs_to_lost(plan, tainted: Dict[str, Node],
             continue
         plan.append_stopped_alloc(alloc, "alloc lost since node is down",
                                   client_status=enums.ALLOC_CLIENT_LOST)
+
+
+def _network_sig(networks) -> list:
+    return sorted(
+        (n.mode or "host", tuple(sorted(n.reserved_ports)),
+         tuple(sorted(n.dynamic_ports)))
+        for n in networks)
+
+
+def _device_sig(devices) -> list:
+    from ..structs.wire import wire_encode
+
+    return sorted(
+        (d.name, d.count, repr(wire_encode(list(d.constraints))),
+         repr(wire_encode(list(d.affinities))))
+        for d in devices)
+
+
+def tasks_updated(old_tg, new_tg) -> bool:
+    """Whether a task-group spec change requires destroying and replacing
+    its allocations (reference scheduler/util.go tasksUpdated). Changes
+    that the client can apply to a running alloc — count, meta, update
+    strategy, reschedule/restart policy, kill timeouts, service tags —
+    are NOT destructive; anything touching what actually runs or what
+    resources it holds is."""
+    from ..structs.wire import wire_encode
+
+    if old_tg is None or new_tg is None:
+        return True
+    # group-level: networks/ports, volumes, ephemeral disk
+    if _network_sig(old_tg.networks) != _network_sig(new_tg.networks):
+        return True
+    if wire_encode(old_tg.volumes) != wire_encode(new_tg.volumes):
+        return True
+    if (old_tg.ephemeral_disk.size_mb != new_tg.ephemeral_disk.size_mb
+            or old_tg.ephemeral_disk.migrate != new_tg.ephemeral_disk.migrate):
+        return True
+    olds = {t.name: t for t in old_tg.tasks}
+    news = {t.name: t for t in new_tg.tasks}
+    if set(olds) != set(news):
+        return True
+    for name, o in olds.items():
+        n = news[name]
+        if (o.driver != n.driver or o.user != n.user
+                or o.config != n.config or o.env != n.env
+                or o.artifacts != n.artifacts or o.templates != n.templates
+                or o.lifecycle_hook != n.lifecycle_hook
+                or o.lifecycle_sidecar != n.lifecycle_sidecar):
+            return True
+        orr, nrr = o.resources, n.resources
+        if (orr.cpu != nrr.cpu or orr.memory_mb != nrr.memory_mb
+                or orr.memory_max_mb != nrr.memory_max_mb
+                or orr.disk_mb != nrr.disk_mb or orr.cores != nrr.cores
+                or orr.numa_affinity != nrr.numa_affinity):
+            return True
+        if _network_sig(orr.networks) != _network_sig(nrr.networks):
+            return True
+        if _device_sig(orr.devices) != _device_sig(nrr.devices):
+            return True
+        if wire_encode(list(o.volume_mounts)) != wire_encode(list(n.volume_mounts)):
+            return True
+    return False

@@ -219,3 +219,51 @@ class Job:
     def stopped(self) -> bool:
         """Reference structs.go Job.Stopped: purely the user-set stop flag."""
         return self.stop
+
+
+def spec_diff(old: Optional[Job], new: Job) -> Dict[str, object]:
+    """Field-level job diff summary for `job plan` (a compact stand-in
+    for the reference's structs/diff.go, 3,252 LoC): the changed field
+    paths, with list elements labelled by their name/id where present."""
+    if old is None:
+        return {"type": "added", "fields": []}
+    from .wire import wire_encode
+
+    SKIP = {"version", "create_index", "modify_index", "job_modify_index",
+            "submit_time", "status", "_avail_vec"}
+    changed: List[str] = []
+
+    def label(item, idx):
+        if isinstance(item, dict) and "__f" in item:
+            f = item["__f"]
+            return f.get("name") or f.get("id") or str(idx)
+        return str(idx)
+
+    def walk(a, b, path):
+        if isinstance(a, dict) and isinstance(b, dict):
+            if "__f" in a and "__f" in b:
+                a, b = a["__f"], b["__f"]
+            for k in sorted(set(a) | set(b)):
+                if k in SKIP:
+                    continue
+                sub = f"{path}.{k}" if path else k
+                if k not in a or k not in b:
+                    changed.append(sub)
+                else:
+                    walk(a[k], b[k], sub)
+            return
+        if isinstance(a, list) and isinstance(b, list):
+            amap = {label(x, i): x for i, x in enumerate(a)}
+            bmap = {label(x, i): x for i, x in enumerate(b)}
+            for k in sorted(set(amap) | set(bmap)):
+                sub = f"{path}[{k}]"
+                if k not in amap or k not in bmap:
+                    changed.append(sub)
+                else:
+                    walk(amap[k], bmap[k], sub)
+            return
+        if a != b:
+            changed.append(path)
+
+    walk(wire_encode(old), wire_encode(new), "")
+    return {"type": "edited" if changed else "none", "fields": changed}

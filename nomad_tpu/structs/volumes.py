@@ -113,21 +113,27 @@ def csi_writer_sources(alloc) -> List[tuple]:
             if req.type == "csi" and not req.read_only]
 
 
-def live_foreign_writers(vol: "Volume", job_id: str, namespace: str,
-                         snapshot) -> List[VolumeClaim]:
-    """Write claims that actually block a new writer from `job_id`:
-    claims whose alloc is live AND belongs to a different job. Claims of
-    terminal or vanished allocs are stale (the watcher will reap them),
-    and the job's own claims belong to allocs its update/reschedule is
-    replacing — blocking on those would deadlock every destructive
-    update of a single-writer-volume job (reference CSIVolumeChecker
-    tolerates same-job claims for exactly this reason)."""
+def live_blocking_writers(vol: "Volume", snapshot, plan=None) -> List[VolumeClaim]:
+    """Write claims that block a new writer: claims whose alloc is live
+    and is NOT being stopped by the in-progress plan. Claims of terminal
+    or vanished allocs are stale (the watcher will reap them); claims of
+    allocs the current plan stops/preempts belong to allocs this very
+    update is replacing — blocking on those would deadlock every
+    destructive update of a single-writer-volume job. A LIVE sibling of
+    the same job still blocks (a count scale-up must not mint a second
+    concurrent writer)."""
+    stopped: set = set()
+    if plan is not None:
+        for allocs in plan.node_update.values():
+            stopped.update(a.id for a in allocs)
+        for allocs in plan.node_preemptions.values():
+            stopped.update(a.id for a in allocs)
     out = []
     for c in vol.writers():
+        if c.alloc_id in stopped:
+            continue
         a = snapshot.alloc_by_id(c.alloc_id)
         if a is None or a.terminal_status():
-            continue
-        if a.job_id == job_id and a.namespace == namespace:
             continue
         out.append(c)
     return out

@@ -388,7 +388,7 @@ def build_task_group_tensors(
     feas = np.zeros(n_pad, dtype=bool)
     feas[: len(nodes)] = feasible_mask(job, tg, nodes,
                                        ctx.regex_cache, ctx.version_cache,
-                                       snapshot=ctx.snapshot)
+                                       snapshot=ctx.snapshot, plan=ctx.plan)
     placed_tg, placed_job = cluster.placement_counts(job, tg, ctx)
     (val_id, val_ok, counts, desired,
      has_targets, weights) = _spread_tensors(ctx, job, tg, nodes, n_pad)

@@ -1,0 +1,177 @@
+"""Spec-diffed in-place updates + `job plan` dry-run annotations
+(reference scheduler/util.go tasksUpdated, scheduler/annotate.go:42,
+nomad/job_endpoint.go Plan)."""
+
+import copy
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.scheduler.util import tasks_updated
+from nomad_tpu.structs import enums
+from nomad_tpu.structs.job import spec_diff
+from nomad_tpu.structs.operator import SchedulerConfiguration
+from nomad_tpu.structs.resources import NetworkResource
+from nomad_tpu.testing import Harness
+
+
+class TestTasksUpdated:
+    def tg(self):
+        return mock.job().task_groups[0]
+
+    def test_identical_not_updated(self):
+        a, b = self.tg(), self.tg()
+        assert not tasks_updated(a, b)
+
+    def test_meta_count_policy_changes_are_in_place(self):
+        a, b = self.tg(), self.tg()
+        b.count = 20
+        b.meta = {"team": "infra"}
+        b.tasks[0].meta = {"x": "y"}
+        b.restart_policy.attempts = 9
+        b.tasks[0].kill_timeout_s = 60.0
+        assert not tasks_updated(a, b)
+
+    @pytest.mark.parametrize("mutate", [
+        lambda tg: setattr(tg.tasks[0], "driver", "raw_exec"),
+        lambda tg: tg.tasks[0].config.update(command="/bin/other"),
+        lambda tg: tg.tasks[0].env.update(MODE="prod"),
+        lambda tg: setattr(tg.tasks[0].resources, "cpu", 999.0),
+        lambda tg: setattr(tg.tasks[0].resources, "memory_mb", 999.0),
+        lambda tg: setattr(tg.tasks[0].resources, "cores", 2),
+        lambda tg: tg.networks.append(NetworkResource(
+            mode="host", reserved_ports=[("http", 8080)])),
+        lambda tg: setattr(tg.ephemeral_disk, "size_mb", 999),
+        lambda tg: tg.tasks.append(
+            copy.deepcopy(tg.tasks[0]).__class__(name="sidecar")),
+    ])
+    def test_destructive_changes(self, mutate):
+        a, b = self.tg(), self.tg()
+        mutate(b)
+        assert tasks_updated(a, b)
+
+
+class TestInPlaceUpdates:
+    @pytest.mark.parametrize("algorithm", [enums.SCHED_ALG_BINPACK,
+                                           enums.SCHED_ALG_TPU_BINPACK])
+    def test_meta_only_edit_updates_in_place(self, algorithm):
+        h = Harness()
+        for _ in range(5):
+            h.store.upsert_node(mock.node())
+        j = mock.job()
+        h.store.upsert_job(j)
+        cfg = SchedulerConfiguration(scheduler_algorithm=algorithm)
+        h.process(mock.eval_for(j), sched_config=cfg)
+        before = {a.id for a in h.store.snapshot().allocs_by_job(j.id)
+                  if not a.terminal_status()}
+        assert len(before) == 10
+
+        j2 = copy.deepcopy(j)
+        j2.meta = {"rev": "2"}
+        h.store.upsert_job(j2)  # version bump
+        h.process(mock.eval_for(j2), sched_config=cfg)
+        snap = h.store.snapshot()
+        after = [a for a in snap.allocs_by_job(j.id)
+                 if not a.terminal_status()]
+        assert {a.id for a in after} == before, "allocs must not be replaced"
+        assert all(a.job_version == j2.version for a in after), \
+            "allocs must carry the new version"
+        assert all(a.job.meta == {"rev": "2"} for a in after)
+
+    def test_resource_edit_is_destructive(self):
+        h = Harness()
+        for _ in range(5):
+            h.store.upsert_node(mock.node())
+        j = mock.job()
+        j.task_groups[0].count = 4
+        j.task_groups[0].update = None  # no rolling strategy: all at once
+        h.store.upsert_job(j)
+        h.process(mock.eval_for(j))
+        before = {a.id for a in h.store.snapshot().allocs_by_job(j.id)
+                  if not a.terminal_status()}
+
+        j2 = copy.deepcopy(j)
+        j2.task_groups[0].tasks[0].resources.cpu = 600
+        h.store.upsert_job(j2)
+        h.process(mock.eval_for(j2))
+        live = [a for a in h.store.snapshot().allocs_by_job(j.id)
+                if not a.terminal_status() and not a.server_terminal()]
+        assert len(live) == 4
+        assert not ({a.id for a in live} & before), "all allocs replaced"
+
+
+class TestPlanEndpoint:
+    def _server(self):
+        return Server(ServerConfig(num_workers=2, heartbeat_ttl=3600,
+                                   gc_interval=3600))
+
+    def test_plan_annotations_and_diff(self):
+        srv = self._server()
+        for _ in range(5):
+            srv.store.upsert_node(mock.node())
+        with srv:
+            j = mock.job()
+            srv.register_job(j)
+            assert srv.wait_for_idle(30.0)
+
+            # metadata edit: all in-place, nothing placed or stopped
+            j_meta = copy.deepcopy(j)
+            j_meta.meta = {"rev": "2"}
+            out = srv.plan_job(j_meta)
+            ann = out["annotations"]["web"]
+            assert ann["in_place_update"] == 10
+            assert ann["destructive_update"] == 0
+            assert ann["place"] == 0
+            assert any("meta" in f for f in out["diff"]["fields"])
+
+            # resource edit: destructive
+            j_cpu = copy.deepcopy(j)
+            j_cpu.task_groups[0].tasks[0].resources.cpu = 600
+            out2 = srv.plan_job(j_cpu)
+            ann2 = out2["annotations"]["web"]
+            assert ann2["destructive_update"] > 0
+            assert any("resources.cpu" in f for f in out2["diff"]["fields"])
+
+            # the dry run committed nothing
+            live = [a for a in srv.store.snapshot().allocs_by_job(j.id)
+                    if not a.terminal_status() and not a.server_terminal()]
+            assert len(live) == 10
+            assert all(a.job_version == j.version for a in live)
+
+    def test_plan_new_job_reports_added(self):
+        srv = self._server()
+        for _ in range(3):
+            srv.store.upsert_node(mock.node())
+        with srv:
+            j = mock.job()
+            out = srv.plan_job(j)
+            assert out["diff"]["type"] == "added"
+            assert out["annotations"]["web"]["place"] == 10
+            assert srv.store.snapshot().job_by_id(j.id) is None
+
+    def test_plan_reports_placement_failures(self):
+        srv = self._server()
+        with srv:  # zero nodes
+            j = mock.job()
+            out = srv.plan_job(j)
+            assert "web" in out["failed_tg_allocs"]
+
+    def test_plan_http_roundtrip(self):
+        import json
+        import urllib.request
+
+        from nomad_tpu.api.http import HTTPAgent
+        from nomad_tpu.api.codec import to_dict
+
+        srv = self._server()
+        for _ in range(3):
+            srv.store.upsert_node(mock.node())
+        with srv, HTTPAgent(srv, port=0) as agent:
+            j = mock.job()
+            r = urllib.request.Request(
+                f"{agent.address}/v1/job/{j.id}/plan",
+                method="POST", data=json.dumps({"job": to_dict(j)}).encode())
+            with urllib.request.urlopen(r, timeout=10) as resp:
+                out = json.loads(resp.read())
+            assert out["annotations"]["web"]["place"] == 10

@@ -165,6 +165,31 @@ class TestCSIVolumes:
         assert len(live) == 1, "replacement must place"
         assert live[0].id != old[0].id
 
+    def test_scale_up_cannot_mint_second_writer(self):
+        """count 1 -> 2 on a single-writer volume: the live sibling's
+        claim blocks the new placement (same-job is NOT a free pass;
+        only claims of allocs the plan itself stops are exempt)."""
+        h = Harness()
+        for _ in range(3):
+            h.store.upsert_node(mock.node())
+        self.register(h.store)
+        j = vol_job(vtype="csi", source="pgdata", count=1)
+        h.store.upsert_job(j)
+        h.process(mock.eval_for(j))
+        assert len(h.store.snapshot().volume_by_id("pgdata").writers()) == 1
+
+        import copy
+
+        j2 = copy.deepcopy(j)
+        j2.task_groups[0].count = 2
+        h.store.upsert_job(j2)
+        h.process(mock.eval_for(j2))
+        vol = h.store.snapshot().volume_by_id("pgdata")
+        assert len(vol.writers()) == 1, "second concurrent writer minted"
+        live = [a for a in h.store.snapshot().allocs_by_job(j.id)
+                if not a.terminal_status() and not a.server_terminal()]
+        assert len(live) == 1
+
     def test_per_alloc_volumes_rejected_at_validation(self):
         from nomad_tpu.api.jobspec import _validate
 
