@@ -69,6 +69,16 @@ class ApiClient:
         out, _ = self._request("POST", "/v1/jobs", payload)
         return out["eval_id"]
 
+    def dispatch_job(self, job_id: str, payload: bytes = b"",
+                     meta: dict = None) -> dict:
+        """Dispatch a parameterized job (reference api/jobs.go Dispatch)."""
+        import base64
+
+        out, _ = self._request("POST", f"/v1/job/{job_id}/dispatch", {
+            "payload": base64.b64encode(payload).decode("ascii"),
+            "meta": meta or {}})
+        return out
+
     def plan_job(self, job) -> dict:
         """Dry-run an update (reference api/jobs.go Plan)."""
         payload = {"job": to_dict(job) if isinstance(job, Job) else job}

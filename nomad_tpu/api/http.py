@@ -253,18 +253,21 @@ class HTTPAgent:
             jobs = [j for j in snap.jobs()
                     if j.id.startswith(prefix) and ns_ok(j.namespace)]
             return h._reply(200, [self._job_stub(j, snap) for j in jobs])
-        if m := re.fullmatch(r"/v1/job/([^/]+)", path):
+        # job ids may contain '/' (dispatched children are
+        # "<parent>/dispatch-<ts>-<id>"): suffixed routes match first,
+        # then the greedy plain route takes whatever remains
+        if m := re.fullmatch(r"/v1/job/(.+)/allocations", path):
+            return h._reply(200, [self._alloc_stub(a) for a in
+                                  snap.allocs_by_job(m.group(1), ns)])
+        if m := re.fullmatch(r"/v1/job/(.+)/evaluations", path):
+            return h._reply(200, snap.evals_by_job(m.group(1), ns))
+        if m := re.fullmatch(r"/v1/job/(.+)/deployments", path):
+            return h._reply(200, snap.deployments_by_job(m.group(1), ns))
+        if m := re.fullmatch(r"/v1/job/(.+)", path):
             job = snap.job_by_id(m.group(1), ns)
             if job is None:
                 return h._error(404, "job not found")
             return h._reply(200, job)
-        if m := re.fullmatch(r"/v1/job/([^/]+)/allocations", path):
-            return h._reply(200, [self._alloc_stub(a) for a in
-                                  snap.allocs_by_job(m.group(1), ns)])
-        if m := re.fullmatch(r"/v1/job/([^/]+)/evaluations", path):
-            return h._reply(200, snap.evals_by_job(m.group(1), ns))
-        if m := re.fullmatch(r"/v1/job/([^/]+)/deployments", path):
-            return h._reply(200, snap.deployments_by_job(m.group(1), ns))
 
         if path == "/v1/deployments":
             return h._reply(200, [d for d in snap.deployments()
@@ -349,7 +352,11 @@ class HTTPAgent:
 
         ns = q.get("namespace", ["default"])[0]
         if path.startswith(("/v1/jobs", "/v1/job/")):
-            if not self._ns_allowed(acl, ns, aclp.CAP_SUBMIT_JOB):
+            # dispatch has its own capability (reference acl: dispatch-job
+            # grants dispatch without general submit rights)
+            cap = (aclp.CAP_DISPATCH_JOB if path.endswith("/dispatch")
+                   else aclp.CAP_SUBMIT_JOB)
+            if not self._ns_allowed(acl, ns, cap):
                 return h._error(403, "Permission denied")
         elif path.startswith(("/v1/nodes", "/v1/node/")):
             if acl is not None and not acl.allow_node_write():
@@ -414,7 +421,22 @@ class HTTPAgent:
             _validate(job)
             eval_id = self.writer.register_job(job)
             return h._reply(200, {"eval_id": eval_id, "job_id": job.id})
-        if m := re.fullmatch(r"/v1/job/([^/]+)/plan", path):
+        if m := re.fullmatch(r"/v1/job/(.+)/dispatch", path):
+            import base64
+            import binascii
+
+            try:
+                payload = base64.b64decode(body.get("payload", "") or "",
+                                           validate=True)
+                out = self.writer.dispatch_job(
+                    m.group(1), payload=payload,
+                    meta=body.get("meta") or {}, namespace=ns)
+            except KeyError:
+                return h._error(404, "job not found")
+            except (ValueError, binascii.Error) as e:
+                return h._error(400, str(e))
+            return h._reply(200, out)
+        if m := re.fullmatch(r"/v1/job/(.+)/plan", path):
             data = body.get("job") or body.get("Job") or body
             job = from_dict(Job, data)
             job.id = m.group(1)
@@ -424,7 +446,7 @@ class HTTPAgent:
             _validate(job)
             # dry-run: local snapshot state is enough on any replica
             return h._reply(200, self.server.plan_job(job))
-        if m := re.fullmatch(r"/v1/job/([^/]+)/evaluate", path):
+        if m := re.fullmatch(r"/v1/job/(.+)/evaluate", path):
             ns = q.get("namespace", ["default"])[0]
             snap = self.server.store.snapshot()
             job = snap.job_by_id(m.group(1), ns)
@@ -481,7 +503,7 @@ class HTTPAgent:
         from ..acl import policy as aclp
 
         ns = q.get("namespace", ["default"])[0]
-        if m := re.fullmatch(r"/v1/job/([^/]+)", path):
+        if m := re.fullmatch(r"/v1/job/(.+)", path):
             if not self._ns_allowed(acl, ns, aclp.CAP_SUBMIT_JOB):
                 return h._error(403, "Permission denied")
             purge = q.get("purge", ["false"])[0] in ("true", "1")

@@ -328,6 +328,13 @@ def parse_hcl_like(text: str) -> Job:
     }
     for m in jb.get("meta", []):
         data["meta"].update({k: str(v) for k, v in m.items() if k != "__label__"})
+    if "parameterized" in jb:
+        pb = jb["parameterized"][0]
+        data["parameterized"] = {
+            "payload": pb.get("payload", "optional"),
+            "meta_required": pb.get("meta_required", []),
+            "meta_optional": pb.get("meta_optional", []),
+        }
     job = from_dict(Job, data)
     _validate(job)
     return job

@@ -165,6 +165,21 @@ def _monitor_eval(args, eval_id: str) -> int:
     return 1
 
 
+def cmd_job_dispatch(args) -> int:
+    """Dispatch a parameterized job (reference command/job_dispatch.go)."""
+    payload = b""
+    if args.payload_file:
+        with open(args.payload_file, "rb") as f:
+            payload = f.read()
+    meta = dict(kv.split("=", 1) for kv in args.meta or [])
+    out = _client(args).dispatch_job(args.job_id, payload=payload, meta=meta)
+    print(f"dispatched {out['dispatched_job_id']!r}, "
+          f"evaluation {out['eval_id']}")
+    if args.detach:
+        return 0
+    return _monitor_eval(args, out["eval_id"])
+
+
 def cmd_job_status(args) -> int:
     api = _client(args)
     if not args.job_id:
@@ -291,6 +306,13 @@ def build_parser() -> argparse.ArgumentParser:
     jp = job.add_parser("plan")
     jp.add_argument("spec")
     jp.set_defaults(fn=cmd_job_plan)
+    jd = job.add_parser("dispatch")
+    jd.add_argument("job_id")
+    jd.add_argument("--payload-file", default="")
+    jd.add_argument("--meta", action="append",
+                    help="key=value dispatch metadata (repeatable)")
+    jd.add_argument("-detach", action="store_true")
+    jd.set_defaults(fn=cmd_job_dispatch)
     js = job.add_parser("status")
     js.add_argument("job_id", nargs="?", default="")
     js.set_defaults(fn=cmd_job_status)

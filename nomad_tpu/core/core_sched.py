@@ -89,6 +89,25 @@ class CoreScheduler:
         released = store.reap_volume_claims()
         self.stats["volume_claims"] = self.stats.get("volume_claims", 0) + released
 
+        # --- derived job status (reference fsm.go setJobStatus): batch
+        # work that finished goes dead so jobGC below can collect it —
+        # dispatched children would otherwise accumulate forever ---
+        snap = store.snapshot()
+        for job in list(snap.jobs()):
+            if job.type not in (enums.JOB_TYPE_BATCH, enums.JOB_TYPE_SYSBATCH):
+                continue
+            if job.status == enums.JOB_STATUS_DEAD or job.stopped():
+                continue
+            allocs = snap.allocs_by_job(job.id, job.namespace)
+            if not allocs:
+                continue  # nothing placed yet; leave pending
+            evals = snap.evals_by_job(job.id, job.namespace)
+            if any(not e.terminal_status() for e in evals):
+                continue  # reschedules/blocked work still pending
+            if all(a.client_terminal() or a.server_terminal() for a in allocs):
+                store.update_job_status(job.id, enums.JOB_STATUS_DEAD,
+                                        job.namespace)
+
         # --- job GC (core_sched.go:44 jobGC) ---
         snap = store.snapshot()
         for job in list(snap.jobs()):

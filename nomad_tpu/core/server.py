@@ -285,7 +285,58 @@ class Server:
             return ""
         # a re-registered job may have dropped its periodic stanza
         self.periodic.remove(job.namespace, job.id)
+        if job.is_parameterized:
+            # parameterized parents are templates: they never schedule;
+            # dispatch mints runnable children (nomad/job_endpoint.go
+            # Job.Dispatch)
+            return ""
         return self._create_job_eval(job, enums.TRIGGER_JOB_REGISTER)
+
+    def dispatch_job(self, job_id: str, payload: bytes = b"",
+                     meta: Optional[Dict[str, str]] = None,
+                     namespace: str = "default") -> Dict[str, str]:
+        """Job.Dispatch (reference nomad/job_endpoint.go dispatch path):
+        validate payload/meta against the parent's parameterized config,
+        mint a dispatched child job, register it, and return
+        {dispatched_job_id, eval_id}."""
+        meta = dict(meta or {})
+        snap = self.store.snapshot()
+        parent = snap.job_by_id(job_id, namespace)
+        if parent is None or parent.stopped():
+            # a stopped template is gone as far as dispatch is concerned
+            raise KeyError(f"job {job_id} not found")
+        if parent.parameterized is None or parent.dispatched:
+            raise ValueError(f"job {job_id} is not parameterized")
+        cfg = parent.parameterized
+        if cfg.payload == "required" and not payload:
+            raise ValueError("payload is required")
+        if cfg.payload == "forbidden" and payload:
+            raise ValueError("payload is forbidden")
+        allowed = set(cfg.meta_required) | set(cfg.meta_optional)
+        missing = [k for k in cfg.meta_required if k not in meta]
+        if missing:
+            raise ValueError(f"missing required dispatch meta: {missing}")
+        unknown = [k for k in meta if k not in allowed]
+        if unknown:
+            raise ValueError(f"dispatch meta not allowed: {unknown}")
+
+        child = _copy.deepcopy(parent)
+        # reference DispatchedID: <parent>/dispatch-<unix>-<uuid-prefix>
+        child.id = (f"{parent.id}/dispatch-{int(time.time())}-"
+                    f"{generate_uuid()[:8]}")
+        child.name = child.id
+        child.parent_id = parent.id
+        child.dispatched = True
+        child.payload = payload
+        child.meta = dict(parent.meta)
+        child.meta.update(meta)
+        child.status = enums.JOB_STATUS_PENDING
+        child.version = 0
+        child.create_index = 0
+        child.modify_index = 0
+        self.store.upsert_job(child)
+        eval_id = self._create_job_eval(child, enums.TRIGGER_JOB_REGISTER)
+        return {"dispatched_job_id": child.id, "eval_id": eval_id}
 
     def deregister_job(self, job_id: str, namespace: str = "default",
                        purge: bool = False) -> str:
@@ -552,7 +603,6 @@ class Server:
         spec diff against the running version, and failed placements."""
         import copy as _c
 
-        from ..scheduler.generic_sched import GenericScheduler
         from ..structs.job import spec_diff
 
         snap = self.store.snapshot()
@@ -603,9 +653,10 @@ class Server:
                 self.evals.append(ev)
 
         planner = _DryRunPlanner()
-        sched = GenericScheduler(
-            _PlanSnapshot(snap), planner,
-            batch=(planned.type == enums.JOB_TYPE_BATCH),
+        from ..scheduler.scheduler import NewScheduler
+
+        sched = NewScheduler(
+            planned.type, _PlanSnapshot(snap), planner,
             sched_config=self.sched_config, logger=self.logger)
         ev = Evaluation(
             id=generate_uuid(), namespace=planned.namespace,

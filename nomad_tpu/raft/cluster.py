@@ -20,7 +20,8 @@ from .fsm import FSM, RaftStore
 from .node import NotLeaderError, RaftNode
 from .transport import InProcTransport, RemoteCallError, TransportError
 
-FORWARD = ("register_job", "deregister_job", "register_node", "heartbeat",
+FORWARD = ("register_job", "deregister_job", "dispatch_job",
+           "register_node", "heartbeat",
            "update_node_status", "update_node_drain",
            "update_node_eligibility", "deregister_node",
            "update_allocs_from_client", "create_eval", "create_job_eval",

@@ -150,9 +150,7 @@ class GenericScheduler:
                     # outright) must not, or the canary hold would fire on
                     # every later eval and stall a fully-placed rollout
                     # (reference reconcile.go requireCanary)
-                    tg_result = results.groups.get(tg.name)
-                    wants_canaries = (tg_result is not None
-                                      and any(p.canary for p in tg_result.place))
+                    wants_canaries = any(p.canary for p in tgr.place)
                     dep.task_groups[tg.name] = DeploymentState(
                         auto_revert=tg.update.auto_revert,
                         auto_promote=tg.update.auto_promote,

@@ -173,6 +173,12 @@ class SystemScheduler:
         else:
             prev.coalesced_failures += 1
 
+    @property
+    def annotations(self):
+        """Per-TG desired-update counts for the dry-run plan endpoint
+        (system jobs: one placement per eligible node)."""
+        return {tg: {"place": n} for tg, n in self.queued_allocs.items()}
+
     def _set_status(self, status: str, desc: str) -> None:
         ev = _copy.copy(self.eval)
         ev.status = status

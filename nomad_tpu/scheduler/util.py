@@ -114,6 +114,16 @@ def tasks_updated(old_tg, new_tg) -> bool:
     if (old_tg.ephemeral_disk.size_mb != new_tg.ephemeral_disk.size_mb
             or old_tg.ephemeral_disk.migrate != new_tg.ephemeral_disk.migrate):
         return True
+    # placement-shaping changes: the in-place path keeps the alloc on its
+    # node WITHOUT re-running feasibility, so anything that could make
+    # the current node infeasible (or badly scored) must be destructive.
+    # (The reference instead re-checks feasibility in inplaceUpdate and
+    # demotes to destructive on failure; forcing destructive here is the
+    # conservative equivalent.)
+    if (wire_encode(list(old_tg.constraints)) != wire_encode(list(new_tg.constraints))
+            or wire_encode(list(old_tg.affinities)) != wire_encode(list(new_tg.affinities))
+            or wire_encode(list(old_tg.spreads)) != wire_encode(list(new_tg.spreads))):
+        return True
     olds = {t.name: t for t in old_tg.tasks}
     news = {t.name: t for t in new_tg.tasks}
     if set(olds) != set(news):
@@ -124,7 +134,11 @@ def tasks_updated(old_tg, new_tg) -> bool:
                 or o.config != n.config or o.env != n.env
                 or o.artifacts != n.artifacts or o.templates != n.templates
                 or o.lifecycle_hook != n.lifecycle_hook
-                or o.lifecycle_sidecar != n.lifecycle_sidecar):
+                or o.lifecycle_sidecar != n.lifecycle_sidecar
+                or o.leader != n.leader):
+            return True
+        if (wire_encode(list(o.constraints)) != wire_encode(list(n.constraints))
+                or wire_encode(list(o.affinities)) != wire_encode(list(n.affinities))):
             return True
         orr, nrr = o.resources, n.resources
         if (orr.cpu != nrr.cpu or orr.memory_mb != nrr.memory_mb

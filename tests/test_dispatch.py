@@ -1,0 +1,134 @@
+"""Parameterized job dispatch (reference nomad/job_endpoint.go Dispatch,
+structs ParameterizedJobConfig)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.structs import enums
+from nomad_tpu.structs.job import ParameterizedJobConfig
+
+
+def param_job(payload="optional", required=(), optional=(), count=1):
+    j = mock.batch_job()
+    j.task_groups[0].count = count
+    j.parameterized = ParameterizedJobConfig(
+        payload=payload, meta_required=list(required),
+        meta_optional=list(optional))
+    return j
+
+
+@pytest.fixture
+def s():
+    srv = Server(ServerConfig(num_workers=2, heartbeat_ttl=3600,
+                              gc_interval=3600))
+    srv.start()
+    for _ in range(4):
+        srv.register_node(mock.node())
+    yield srv
+    srv.stop()
+
+
+class TestDispatch:
+    def test_parent_never_schedules(self, s):
+        j = param_job()
+        eval_id = s.register_job(j)
+        assert eval_id == ""
+        assert s.wait_for_idle(5.0)
+        assert s.store.snapshot().allocs_by_job(j.id) == []
+
+    def test_dispatch_creates_running_child(self, s):
+        j = param_job(required=["input"], optional=["mode"])
+        s.register_job(j)
+        out = s.dispatch_job(j.id, payload=b"hello",
+                             meta={"input": "s3://x", "mode": "fast"})
+        child_id = out["dispatched_job_id"]
+        assert child_id.startswith(f"{j.id}/dispatch-")
+        assert s.wait_for_idle(10.0)
+        snap = s.store.snapshot()
+        child = snap.job_by_id(child_id)
+        assert child.dispatched and child.parent_id == j.id
+        assert child.payload == b"hello"
+        assert child.meta["input"] == "s3://x"
+        allocs = [a for a in snap.allocs_by_job(child_id)
+                  if not a.terminal_status()]
+        assert len(allocs) == 1
+        # parent untouched
+        assert snap.allocs_by_job(j.id) == []
+
+    def test_dispatch_validation(self, s):
+        j = param_job(payload="required", required=["input"])
+        s.register_job(j)
+        with pytest.raises(ValueError, match="payload is required"):
+            s.dispatch_job(j.id, payload=b"", meta={"input": "x"})
+        with pytest.raises(ValueError, match="missing required"):
+            s.dispatch_job(j.id, payload=b"p")
+        with pytest.raises(ValueError, match="not allowed"):
+            s.dispatch_job(j.id, payload=b"p",
+                           meta={"input": "x", "bogus": "y"})
+        jf = param_job(payload="forbidden")
+        s.register_job(jf)
+        with pytest.raises(ValueError, match="forbidden"):
+            s.dispatch_job(jf.id, payload=b"nope")
+        with pytest.raises(ValueError, match="not parameterized"):
+            plain = mock.job()
+            s.register_job(plain)
+            s.dispatch_job(plain.id)
+        with pytest.raises(KeyError):
+            s.dispatch_job("missing-job")
+
+    def test_children_are_gcd_when_done(self, s):
+        j = param_job()
+        s.register_job(j)
+        out = s.dispatch_job(j.id, payload=b"x")
+        child_id = out["dispatched_job_id"]
+        assert s.wait_for_idle(10.0)
+        # batch work completes client-side
+        snap = s.store.snapshot()
+        for a in snap.allocs_by_job(child_id):
+            upd = a.copy_for_update()
+            upd.client_status = enums.ALLOC_CLIENT_COMPLETE
+            s.update_allocs_from_client([upd])
+        assert s.wait_for_idle(10.0)
+        s.core_gc.force_gc(threshold_override=0)
+        s.core_gc.force_gc(threshold_override=0)  # status pass, then sweep
+        snap = s.store.snapshot()
+        assert snap.job_by_id(child_id) is None, "child job not collected"
+        # the parent template survives
+        assert snap.job_by_id(j.id) is not None
+
+    def test_http_dispatch_roundtrip(self, s):
+        import base64
+
+        from nomad_tpu.api.http import HTTPAgent
+
+        j = param_job(required=["input"])
+        s.register_job(j)
+        with HTTPAgent(s, port=0) as agent:
+            r = urllib.request.Request(
+                f"{agent.address}/v1/job/{j.id}/dispatch", method="POST",
+                data=json.dumps({
+                    "payload": base64.b64encode(b"data").decode(),
+                    "meta": {"input": "x"}}).encode())
+            with urllib.request.urlopen(r, timeout=10) as resp:
+                out = json.loads(resp.read())
+            assert out["dispatched_job_id"].startswith(j.id)
+            # child ids contain '/': the job routes must still serve them
+            child_id = out["dispatched_job_id"]
+            got = json.loads(urllib.request.urlopen(
+                f"{agent.address}/v1/job/{child_id}", timeout=10).read())
+            assert got["id"] == child_id
+            allocs = json.loads(urllib.request.urlopen(
+                f"{agent.address}/v1/job/{child_id}/allocations",
+                timeout=10).read())
+            assert isinstance(allocs, list)
+            # bad dispatch -> 400
+            r2 = urllib.request.Request(
+                f"{agent.address}/v1/job/{j.id}/dispatch", method="POST",
+                data=json.dumps({"meta": {"nope": "x"}}).encode())
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(r2, timeout=10)
+            assert e.value.code == 400
