@@ -69,6 +69,17 @@ class ApiClient:
         out, _ = self._request("POST", "/v1/jobs", payload)
         return out["eval_id"]
 
+    def alloc_logs(self, alloc_id: str, task: str = "",
+                   log_type: str = "stdout", offset: int = 0,
+                   limit: int = 65536) -> dict:
+        """Read a task's captured logs (reference api/fs.go Logs)."""
+        import base64
+
+        out, _ = self.get(f"/v1/client/fs/logs/{alloc_id}", task=task,
+                          type=log_type, offset=offset, limit=limit)
+        out["data"] = base64.b64decode(out.get("data", "") or "")
+        return out
+
     def dispatch_job(self, job_id: str, payload: bytes = b"",
                      meta: dict = None) -> dict:
         """Dispatch a parameterized job (reference api/jobs.go Dispatch)."""

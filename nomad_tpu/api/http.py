@@ -29,13 +29,17 @@ class HTTPAgent:
     """The agent HTTP server. Start with port=0 for an ephemeral port."""
 
     def __init__(self, server, host: str = "127.0.0.1", port: int = 4646,
-                 writer=None):
+                 writer=None, clients=None):
         self.server = server
         # In a replicated deployment `writer` is the ReplicatedServer
         # facade: mutating verbs route to the raft leader (local or over
         # the socket transport) while reads stay on the local replica's
         # store — the reference's HTTP-agent -> RPC forward split.
         self.writer = writer if writer is not None else server
+        # co-located client agents (dev/agent mode): serve their log
+        # files and host stats directly (the reference forwards these
+        # routes over server->client RPC instead)
+        self.clients = list(clients or [])
         agent = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -320,6 +324,13 @@ class HTTPAgent:
                 return h._error(403, "Permission denied")
             return h._reply(200, ev)
 
+        if path == "/v1/client/stats":
+            if acl is not None and not acl.allow_node_read():
+                return h._error(403, "Permission denied")
+            return h._reply(200, [c.hoststats.latest() for c in self.clients])
+        if m := re.fullmatch(r"/v1/client/fs/logs/([^/]+)", path):
+            # authorized post-lookup against the alloc's own namespace
+            return self._route_logs(h, m.group(1), q, snap, acl)
         if path == "/v1/status/leader":
             raft = getattr(self.writer, "raft", None)
             if raft is not None:
@@ -346,6 +357,43 @@ class HTTPAgent:
                 "heartbeats_active": self.server.heartbeats.active(),
             })
         h._error(404, f"no such route {path}")
+
+    def _route_logs(self, h, alloc_id: str, q: dict, snap, acl=None) -> None:
+        """Task log read across the rotated files (reference
+        /v1/client/fs/logs/<alloc>; CLI `alloc logs`)."""
+        import base64
+
+        from ..acl import policy as aclp
+        from ..client.allocdir import AllocDir
+        from ..client.logmon import read_log
+
+        alloc = snap.alloc_by_id(alloc_id)
+        if alloc is None:
+            return h._error(404, "alloc not found")
+        if not self._ns_allowed(acl, alloc.namespace, aclp.CAP_READ_LOGS):
+            return h._error(403, "Permission denied")
+        task = q.get("task", [""])[0]
+        if not task and alloc.job is not None:
+            tg = alloc.job.lookup_task_group(alloc.task_group)
+            if tg is not None and tg.tasks:
+                task = tg.tasks[0].name
+        kind = q.get("type", ["stdout"])[0]
+        offset = int(q.get("offset", ["0"])[0] or 0)
+        limit = min(int(q.get("limit", ["65536"])[0] or 65536), 1 << 20)
+        import os
+
+        for client in self.clients:
+            runner = client.runners.get(alloc_id)
+            log_dir = (runner.allocdir.logs if runner is not None
+                       else AllocDir(client.config.data_dir, alloc_id).logs)
+            if runner is None and not os.path.isdir(log_dir):
+                continue
+            out = read_log(log_dir, task, kind, offset=offset, limit=limit)
+            return h._reply(200, {
+                "task": task, "type": kind, "offset": out["offset"],
+                "size": out["size"],
+                "data": base64.b64encode(out["data"]).decode("ascii")})
+        return h._error(404, "alloc logs not on this agent")
 
     def _route_post(self, h, path: str, q: dict, body: dict, acl=None) -> None:
         from ..acl import policy as aclp

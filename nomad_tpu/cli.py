@@ -84,6 +84,7 @@ def cmd_agent(args) -> int:
             if args.data_dir else ""))
         c.start()
         clients.append(c)
+    http_agent.clients = clients  # serve /v1/client/* for local clients
     print(f"agent started: {http_agent.address} "
           f"(workers={args.workers} clients={args.clients} "
           f"algorithm={args.algorithm}"
@@ -240,6 +241,16 @@ def cmd_alloc_status(args) -> int:
     return 0
 
 
+def cmd_alloc_logs(args) -> int:
+    """Print a task's captured output (reference command/alloc_logs.go)."""
+    out = _client(args).alloc_logs(
+        args.alloc_id, task=args.task,
+        log_type="stderr" if args.stderr else "stdout",
+        offset=args.offset)
+    sys.stdout.write(out["data"].decode(errors="replace"))
+    return 0
+
+
 def cmd_eval_status(args) -> int:
     _p(_client(args).evaluation(args.eval_id))
     return 0
@@ -343,6 +354,12 @@ def build_parser() -> argparse.ArgumentParser:
     als = al.add_parser("status")
     als.add_argument("alloc_id")
     als.set_defaults(fn=cmd_alloc_status)
+    allog = al.add_parser("logs")
+    allog.add_argument("alloc_id")
+    allog.add_argument("task", nargs="?", default="")
+    allog.add_argument("-stderr", action="store_true")
+    allog.add_argument("--offset", type=int, default=0)
+    allog.set_defaults(fn=cmd_alloc_logs)
 
     ev = sub.add_parser("eval").add_subparsers(dest="eval_cmd", required=True)
     evs = ev.add_parser("status")

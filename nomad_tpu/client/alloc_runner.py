@@ -27,10 +27,16 @@ LIFECYCLE_POSTSTOP = "poststop"
 class AllocRunner:
     def __init__(self, alloc: Allocation, node, data_dir: str,
                  on_update: Optional[Callable] = None,
-                 state_db=None, restored_handles: Optional[Dict] = None):
+                 state_db=None, restored_handles: Optional[Dict] = None,
+                 prev_runner_lookup: Optional[Callable] = None):
         self.alloc = alloc
         self.node = node
+        self.data_dir = data_dir
         self.on_update = on_update
+        # allocwatcher (reference client/allocwatcher): lets this runner
+        # wait on the previous alloc (upgrades/migrations) and pull its
+        # ephemeral disk before starting tasks
+        self.prev_runner_lookup = prev_runner_lookup
         # persistence (client/state_db.py): task handles write through so
         # a restarted client can re-attach; restored_handles carries the
         # live handles recovered on restore
@@ -60,6 +66,7 @@ class AllocRunner:
             self._set_status(enums.ALLOC_CLIENT_FAILED, "no task group")
             return
         self.allocdir.build()
+        self._await_previous()
 
         def make_runner(task) -> TaskRunner:
             td = self.allocdir.build_task_dir(task.name)
@@ -68,7 +75,8 @@ class AllocRunner:
                             on_state_change=self._on_task_state,
                             restart_policy=self.tg.restart_policy,
                             on_handle=self._on_task_handle,
-                            recovered_handle=self.restored_handles.get(task.name))
+                            recovered_handle=self.restored_handles.get(task.name),
+                            logs_dir=self.allocdir.logs)
             self.task_runners[task.name] = tr
             return tr
 
@@ -126,6 +134,26 @@ class AllocRunner:
             if not r.wait_dead(timeout=PRESTART_DEADLINE_S):
                 r.kill()
         self._recompute_status()
+
+    def _await_previous(self) -> None:
+        """Block until the local previous alloc finishes, then migrate
+        its ephemeral disk when the group asks for it (reference
+        client/allocwatcher: prevAllocWatcher + local disk migration;
+        restore passes no lookup, so re-adopted allocs skip the wait)."""
+        prev_id = self.alloc.previous_allocation
+        if not prev_id or self.prev_runner_lookup is None:
+            return
+        prev = self.prev_runner_lookup(prev_id)
+        if prev is not None:
+            deadline = time.time() + PRESTART_DEADLINE_S
+            while (not prev.is_terminal() and prev.client_status
+                    != enums.ALLOC_CLIENT_LOST and time.time() < deadline):
+                if self._destroyed:
+                    return
+                time.sleep(0.1)
+        if self.tg is not None and (self.tg.ephemeral_disk.migrate
+                                    or self.tg.ephemeral_disk.sticky):
+            self.allocdir.migrate_from(AllocDir(self.data_dir, prev_id))
 
     def stop(self) -> None:
         """Server asked for a stop (desired_status=stop/evict)."""

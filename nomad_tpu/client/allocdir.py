@@ -18,9 +18,20 @@ class AllocDir:
     def __init__(self, data_dir: str, alloc_id: str):
         self.root = os.path.join(data_dir, "alloc", alloc_id)
         self.shared = os.path.join(self.root, "alloc")
+        self.logs = os.path.join(self.root, "logs")
 
     def build(self) -> None:
         os.makedirs(self.shared, exist_ok=True)
+        os.makedirs(self.logs, exist_ok=True)
+
+    def migrate_from(self, prev: "AllocDir") -> bool:
+        """Copy the previous alloc's shared dir into ours (ephemeral disk
+        migrate/sticky; reference client/allocwatcher local migration)."""
+        if not os.path.isdir(prev.shared):
+            return False
+        self.build()
+        shutil.copytree(prev.shared, self.shared, dirs_exist_ok=True)
+        return True
 
     def task_dir(self, task_name: str) -> str:
         return os.path.join(self.root, task_name)

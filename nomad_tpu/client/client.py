@@ -37,6 +37,10 @@ class ClientConfig:
     heartbeat_interval: float = 3.0
     sync_interval: float = 0.2     # allocSync batching (client.go:2198)
     watch_interval: float = 0.1
+    # periodic re-fingerprint (reference fingerprint_manager periodics)
+    fingerprint_interval: float = 60.0
+    # host stats sampling (reference client/hoststats)
+    hoststats_interval: float = 10.0
 
 
 class Client:
@@ -62,15 +66,25 @@ class Client:
         self._dirty_lock = threading.Lock()        # guards self._dirty
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        from .hoststats import HostStatsCollector
+
+        self.hoststats = HostStatsCollector(
+            self.config.data_dir, interval=self.config.hoststats_interval)
+        # heartbeatstop (reference client/heartbeatstop.go): while the
+        # server is unreachable, allocs opting into
+        # stop_after_client_disconnect are stopped locally at expiry
+        self._last_heartbeat_ok = time.time()
 
     # -- lifecycle --
 
     def start(self) -> None:
         self._restore()
         self._register_with_retry()
+        self.hoststats.start()
         for name, fn in (("heartbeat", self._run_heartbeat),
                          ("watch", self._run_watch),
-                         ("sync", self._run_sync)):
+                         ("sync", self._run_sync),
+                         ("fingerprint", self._run_fingerprint)):
             t = threading.Thread(target=fn, daemon=True,
                                  name=f"client-{self.node.id[:8]}-{name}")
             t.start()
@@ -96,6 +110,7 @@ class Client:
 
     def stop(self) -> None:
         self._stop.set()
+        self.hoststats.stop()
         for t in self._threads:
             t.join(timeout=2.0)
         for r in list(self.runners.values()):
@@ -156,8 +171,67 @@ class Client:
         while not self._stop.wait(self.config.heartbeat_interval):
             try:
                 self.server.heartbeat(self.node.id)
+                self._last_heartbeat_ok = time.time()
             except Exception:
-                pass  # server unreachable: the TTL will mark us down
+                # server unreachable: the TTL will mark us down; local
+                # stop_after_client_disconnect timers start running
+                self._check_heartbeat_stop()
+
+    def _check_heartbeat_stop(self) -> None:
+        """Stop allocs whose stop_after_client_disconnect window expired
+        while the server is unreachable (reference client/heartbeatstop.go:
+        a partitioned client must not keep singleton workloads alive
+        after the server has rescheduled them elsewhere)."""
+        disconnected_for = time.time() - self._last_heartbeat_ok
+        with self._lock:
+            runners = list(self.runners.values())
+        for r in runners:
+            tg = r.tg
+            if tg is None or tg.stop_after_client_disconnect_s is None:
+                continue
+            if disconnected_for >= tg.stop_after_client_disconnect_s \
+                    and not r.is_terminal():
+                r.client_description = ("stopped locally: client "
+                                        "disconnected past "
+                                        "stop_after_client_disconnect")
+                r.stop()
+                self._mark_dirty(r)
+
+    # -- periodic re-fingerprint (reference client/fingerprint_manager) --
+
+    def _run_fingerprint(self) -> None:
+        while not self._stop.wait(self.config.fingerprint_interval):
+            try:
+                fresh = fingerprint(node_id=self.node.id,
+                                    datacenter=self.config.datacenter,
+                                    node_class=self.config.node_class,
+                                    data_dir=self.config.data_dir)
+            except Exception:
+                continue
+            changed = (fresh.attributes != self.node.attributes
+                       or fresh.drivers != self.node.drivers
+                       or fresh.resources.vec().tolist()
+                       != self.node.resources.vec().tolist())
+            if not changed:
+                continue
+            # re-register a FRESH node object: in-proc mode the current
+            # object is aliased into the MVCC store (rows are immutable
+            # by convention), so mutating it in place would tear the
+            # snapshots concurrent schedulers hold
+            import copy as _copy
+
+            updated = _copy.copy(self.node)
+            updated.attributes = fresh.attributes
+            updated.drivers = fresh.drivers
+            updated.resources = fresh.resources
+            updated._avail_vec = None
+            updated.computed_class = ""
+            updated.compute_class()
+            try:
+                self.server.register_node(updated)
+            except Exception:
+                continue  # retried on the next tick
+            self.node = updated
 
     # -- alloc watching (client.go:2281 watchAllocations -> :2539 runAllocs) --
 
@@ -189,7 +263,8 @@ class Client:
                     continue
                 runner = AllocRunner(alloc, self.node, self.config.data_dir,
                                      on_update=self._mark_dirty,
-                                     state_db=self.state_db)
+                                     state_db=self.state_db,
+                                     prev_runner_lookup=self.runners.get)
                 self.runners[alloc_id] = runner
                 self.state_db.put_alloc(alloc)
                 starts.append(runner)

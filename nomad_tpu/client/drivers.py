@@ -110,8 +110,15 @@ class MockDriver:
 
     name = "mock"
 
-    def start_task(self, task, env: Dict[str, str], task_dir: str) -> TaskHandle:
+    def start_task(self, task, env: Dict[str, str], task_dir: str,
+                   io=None) -> TaskHandle:
         cfg = task.config or {}
+        if io is not None:  # exercise the log path like a real driver
+            fd = io.stream_fd("stdout")
+            try:
+                os.write(fd, str(cfg.get("stdout_string", "")).encode())
+            finally:
+                io.close_parent_fds()
         if cfg.get("start_error"):
             raise DriverError(str(cfg["start_error"]))
         if cfg.get("start_block_for"):
@@ -238,16 +245,22 @@ class RawExecDriver:
     def _build_env(self, env: Dict[str, str]) -> Dict[str, str]:
         return {**os.environ, **env}
 
-    def start_task(self, task, env: Dict[str, str], task_dir: str) -> TaskHandle:
+    def start_task(self, task, env: Dict[str, str], task_dir: str,
+                   io=None) -> TaskHandle:
         cfg = task.config or {}
         command = cfg.get("command")
         if not command:
             raise DriverError(f"{self.name} requires config.command")
         argv = [str(command)] + [str(a) for a in cfg.get("args", [])]
-        stdout = open(os.path.join(task_dir, "stdout.log"), "ab") \
-            if os.path.isdir(task_dir) else subprocess.DEVNULL
-        stderr = open(os.path.join(task_dir, "stderr.log"), "ab") \
-            if os.path.isdir(task_dir) else subprocess.DEVNULL
+        if io is not None:
+            # rotated capture through logmon pipes
+            stdout = io.stream_fd("stdout")
+            stderr = io.stream_fd("stderr")
+        else:
+            stdout = open(os.path.join(task_dir, "stdout.log"), "ab") \
+                if os.path.isdir(task_dir) else subprocess.DEVNULL
+            stderr = open(os.path.join(task_dir, "stderr.log"), "ab") \
+                if os.path.isdir(task_dir) else subprocess.DEVNULL
         try:
             proc = subprocess.Popen(
                 argv,
@@ -258,6 +271,9 @@ class RawExecDriver:
             )
         except OSError as e:
             raise DriverError(f"failed to start {command}: {e}") from e
+        finally:
+            if io is not None:
+                io.close_parent_fds()
         return _ProcHandle(proc)
 
     def recover_task(self, handle_data: Optional[dict]) -> Optional[TaskHandle]:

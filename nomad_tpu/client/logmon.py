@@ -1,0 +1,173 @@
+"""Per-task log capture with rotation (reference client/logmon/, ~800
+LoC: a re-exec'd subprocess shipping task stdout/stderr fifos into
+rotated files).
+
+Here the capture is a pipe drained by an in-process reader thread into
+`<alloc>/logs/<task>.{stdout,stderr}.<n>` files rotated by size with a
+bounded file count (Task.LogConfig max_files/max_file_size_mb — the
+same knobs the reference honors). Rotation state is derived from the
+files on disk, so a restarted agent appends to the newest file instead
+of clobbering history.
+
+Known delta vs the reference: because the reference logmon is its own
+PROCESS, capture survives client restarts; an in-process reader dies
+with the agent, so output of a re-attached task between restart and
+re-exec is not captured. The out-of-process executor boundary owns
+closing that gap.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Dict, Optional
+
+
+class _Rotator:
+    """Append bytes into <prefix>.<n>, advancing n at max_bytes and
+    pruning to max_files (reference logmon/logging/rotator.go)."""
+
+    def __init__(self, prefix: str, max_files: int, max_bytes: int):
+        self.prefix = prefix
+        self.max_files = max(1, max_files)
+        self.max_bytes = max(1, max_bytes)
+        self._idx = self._newest_index()
+        self._file = open(self._path(self._idx), "ab")
+
+    def _path(self, n: int) -> str:
+        return f"{self.prefix}.{n}"
+
+    def _newest_index(self) -> int:
+        base = os.path.basename(self.prefix)
+        rx = re.compile(re.escape(base) + r"\.(\d+)$")
+        best = 0
+        try:
+            for name in os.listdir(os.path.dirname(self.prefix)):
+                m = rx.fullmatch(name)
+                if m:
+                    best = max(best, int(m.group(1)))
+        except OSError:
+            pass
+        return best
+
+    def write(self, data: bytes) -> None:
+        self._file.write(data)
+        if self._file.tell() >= self.max_bytes:
+            self._file.close()
+            self._idx += 1
+            self._file = open(self._path(self._idx), "ab")
+            drop = self._idx - self.max_files
+            if drop >= 0:
+                try:
+                    os.unlink(self._path(drop))
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        try:
+            self._file.close()
+        except OSError:
+            pass
+
+
+class LogMon:
+    """One task's stdout/stderr capture. `stream_fd(kind)` hands back a
+    pipe write-end for the child process; a reader thread drains it into
+    the rotator until EOF (child exit)."""
+
+    def __init__(self, log_dir: str, task_name: str,
+                 max_files: int = 10, max_file_size_mb: int = 10):
+        os.makedirs(log_dir, exist_ok=True)
+        self.log_dir = log_dir
+        self.task_name = task_name
+        self.max_files = max_files
+        self.max_bytes = max_file_size_mb * 1024 * 1024
+        self._write_fds: Dict[str, int] = {}
+        self._threads: list = []
+
+    def stream_fd(self, kind: str) -> int:
+        """-> write fd to wire into Popen(stdout=/stderr=). Call
+        close_parent_fds() after the child is spawned."""
+        rfd, wfd = os.pipe()
+        self._write_fds[kind] = wfd
+        rot = _Rotator(os.path.join(self.log_dir, f"{self.task_name}.{kind}"),
+                       self.max_files, self.max_bytes)
+
+        def drain():
+            try:
+                while True:
+                    chunk = os.read(rfd, 65536)
+                    if not chunk:
+                        return
+                    rot.write(chunk)
+            except OSError:
+                pass
+            finally:
+                rot.close()
+                try:
+                    os.close(rfd)
+                except OSError:
+                    pass
+
+        t = threading.Thread(target=drain, daemon=True,
+                             name=f"logmon-{self.task_name}-{kind}")
+        t.start()
+        self._threads.append(t)
+        return wfd
+
+    def close_parent_fds(self) -> None:
+        """Drop the parent's write-ends so readers see EOF when the
+        child's copies close on exit."""
+        for fd in self._write_fds.values():
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._write_fds.clear()
+
+
+def read_log(log_dir: str, task_name: str, kind: str = "stdout",
+             offset: int = 0, limit: int = 64 * 1024) -> Dict:
+    """Read across the rotated file sequence as one logical stream
+    (the `nomad alloc logs` read path; reference client fs API).
+    Negative offset = from the end."""
+    prefix = os.path.join(log_dir, f"{task_name}.{kind}")
+    rx = re.compile(re.escape(f"{task_name}.{kind}") + r"\.(\d+)$")
+    pieces = []
+    try:
+        names = os.listdir(log_dir)
+    except OSError:
+        names = []
+    for name in names:
+        m = rx.fullmatch(name)
+        if m:
+            pieces.append(int(m.group(1)))
+    pieces.sort()
+    sizes = []
+    for n in pieces:
+        try:
+            sizes.append((n, os.path.getsize(f"{prefix}.{n}")))
+        except OSError:
+            sizes.append((n, 0))
+    total = sum(s for _, s in sizes)
+    if offset < 0:
+        offset = max(0, total + offset)
+    out = bytearray()
+    pos = 0
+    for n, size in sizes:
+        if len(out) >= limit:
+            break
+        file_start, file_end = pos, pos + size
+        pos = file_end
+        if file_end <= offset:
+            continue
+        start_in_file = max(0, offset - file_start)
+        want = limit - len(out)
+        try:
+            with open(f"{prefix}.{n}", "rb") as f:
+                f.seek(start_in_file)
+                out.extend(f.read(want))
+        except OSError:
+            continue
+    return {"data": bytes(out), "offset": offset, "size": total}

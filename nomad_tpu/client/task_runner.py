@@ -30,12 +30,14 @@ class TaskRunner:
                  on_state_change: Optional[Callable] = None,
                  restart_policy: Optional[RestartPolicy] = None,
                  on_handle: Optional[Callable] = None,
-                 recovered_handle=None):
+                 recovered_handle=None,
+                 logs_dir: str = ""):
         self.alloc = alloc
         self.task = task
         self.node = node
         self.task_dir = task_dir
         self.shared_dir = shared_dir
+        self.logs_dir = logs_dir
         self.on_state_change = on_state_change
         self.policy = restart_policy or RestartPolicy()
         # persistence: on_handle(task_name, handle_data) records the
@@ -87,8 +89,11 @@ class TaskRunner:
                 run_task = _interpolated_task(self.task, config)
 
                 try:
+                    # io= is part of the driver interface (drivers.py):
+                    # every driver takes it, logmon-less ones ignore it
                     self._handle = driver.start_task(run_task, env,
-                                                     self.task_dir)
+                                                     self.task_dir,
+                                                     io=self._logmon())
                 except DriverError as e:
                     self._event("Driver Failure", str(e))
                     if not self._should_restart(failed_start=True):
@@ -124,6 +129,18 @@ class TaskRunner:
             self._handle.kill(self.task.kill_timeout_s)
         self._event("Killed", "task killed by client")
         self._die(failed=False)
+
+    def _logmon(self):
+        """Rotated stdout/stderr capture per start attempt (reference
+        client/logmon; LogConfig knobs ride the task)."""
+        if not self.logs_dir:
+            return None
+        from .logmon import LogMon
+
+        lc = self.task.log_config
+        return LogMon(self.logs_dir, self.task.name,
+                      max_files=lc.max_files,
+                      max_file_size_mb=lc.max_file_size_mb)
 
     def kill(self) -> None:
         self._killed.set()

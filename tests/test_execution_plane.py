@@ -1,0 +1,206 @@
+"""Execution-plane breadth: logmon rotation, host stats, heartbeatstop,
+allocwatcher disk migration, and the logs API (reference client/logmon/,
+client/hoststats/, client/heartbeatstop.go, client/allocwatcher/)."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client.client import Client, ClientConfig
+from nomad_tpu.client.logmon import LogMon, read_log
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.structs import enums
+
+
+class TestLogMon:
+    def test_rotation_and_pruning(self, tmp_path):
+        lm = LogMon(str(tmp_path), "web", max_files=3, max_file_size_mb=1)
+        lm.max_bytes = 100  # shrink for the test
+        fd = lm.stream_fd("stdout")
+        for i in range(20):
+            os.write(fd, (f"line-{i:03d} " * 5 + "\n").encode())
+        os.close(fd)
+        lm.close_parent_fds()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            files = sorted(p.name for p in tmp_path.iterdir())
+            if files and not any("line-019" in read_log(
+                    str(tmp_path), "web", "stdout",
+                    offset=-4096)["data"].decode() for _ in [0]) is False:
+                break
+            time.sleep(0.05)
+        files = sorted(p.name for p in tmp_path.iterdir())
+        assert all(f.startswith("web.stdout.") for f in files)
+        assert len(files) <= 4  # max_files + the active file
+        # newest content survived, oldest was pruned
+        tail = read_log(str(tmp_path), "web", "stdout", offset=-4096)
+        assert b"line-019" in tail["data"]
+
+    def test_read_log_spans_files_and_offsets(self, tmp_path):
+        (tmp_path / "t.stdout.0").write_bytes(b"aaaa")
+        (tmp_path / "t.stdout.1").write_bytes(b"bbbb")
+        (tmp_path / "t.stdout.2").write_bytes(b"cc")
+        out = read_log(str(tmp_path), "t", "stdout")
+        assert out["data"] == b"aaaabbbbcc" and out["size"] == 10
+        assert read_log(str(tmp_path), "t", "stdout", offset=3)["data"] == \
+            b"abbbbcc"
+        assert read_log(str(tmp_path), "t", "stdout", offset=-3)["data"] == \
+            b"bcc"
+        assert read_log(str(tmp_path), "t", "stdout", offset=2,
+                        limit=4)["data"] == b"aabb"
+
+    def test_restart_appends_to_newest(self, tmp_path):
+        (tmp_path / "t.stdout.4").write_bytes(b"old")
+        lm = LogMon(str(tmp_path), "t")
+        fd = lm.stream_fd("stdout")
+        os.write(fd, b"new")
+        os.close(fd)
+        lm.close_parent_fds()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if (tmp_path / "t.stdout.4").read_bytes() == b"oldnew":
+                break
+            time.sleep(0.05)
+        assert (tmp_path / "t.stdout.4").read_bytes() == b"oldnew"
+
+
+class TestHostStats:
+    def test_sample_shape(self, tmp_path):
+        from nomad_tpu.client.hoststats import HostStatsCollector
+
+        c = HostStatsCollector(str(tmp_path))
+        c.sample()
+        time.sleep(0.05)
+        s = c.sample()
+        assert s["memory"]["total_mb"] > 0
+        assert s["disk"]["total_mb"] > 0
+        assert 0.0 <= s["cpu_percent"] <= 100.0
+        assert c.latest()["timestamp"] == s["timestamp"]
+
+
+def _server_with_client(tmp_path, **ccfg):
+    srv = Server(ServerConfig(num_workers=2, heartbeat_ttl=3600,
+                              gc_interval=3600))
+    srv.start()
+    client = Client(srv, ClientConfig(data_dir=str(tmp_path / "client"),
+                                      **ccfg))
+    client.start()
+    return srv, client
+
+
+class TestLogsEndToEnd:
+    def test_raw_exec_logs_via_http(self, tmp_path):
+        from nomad_tpu.api.http import HTTPAgent
+
+        srv, client = _server_with_client(tmp_path)
+        try:
+            j = mock.job()
+            tg = j.task_groups[0]
+            tg.count = 1
+            tg.tasks[0].driver = "raw_exec"
+            tg.tasks[0].config = {"command": "/bin/sh",
+                                  "args": ["-c", "echo hello-from-task"]}
+            srv.register_job(j)
+            assert srv.wait_for_idle(15.0)
+            assert client.wait_until(lambda: any(
+                r.is_terminal() or r.client_status == enums.ALLOC_CLIENT_RUNNING
+                for r in client.runners.values()), timeout=15.0)
+            alloc_id = next(iter(client.runners))
+            client.wait_until(
+                lambda: b"hello" in read_log(
+                    client.runners[alloc_id].allocdir.logs,
+                    tg.tasks[0].name)["data"], timeout=10.0)
+            with HTTPAgent(srv, port=0, clients=[client]) as agent:
+                out = json.loads(urllib.request.urlopen(
+                    f"{agent.address}/v1/client/fs/logs/{alloc_id}",
+                    timeout=10).read())
+                import base64
+
+                assert b"hello-from-task" in base64.b64decode(out["data"])
+                stats = json.loads(urllib.request.urlopen(
+                    f"{agent.address}/v1/client/stats", timeout=10).read())
+                assert stats and stats[0]["memory"]["total_mb"] > 0
+        finally:
+            client.stop()
+            srv.stop()
+
+
+class TestHeartbeatStop:
+    def test_disconnected_client_stops_opted_in_allocs(self, tmp_path):
+        srv, client = _server_with_client(tmp_path, heartbeat_interval=0.1)
+        try:
+            j = mock.job()
+            tg = j.task_groups[0]
+            tg.count = 1
+            tg.stop_after_client_disconnect_s = 0.3
+            tg.tasks[0].driver = "mock"
+            tg.tasks[0].config = {"run_for": 3600}
+            srv.register_job(j)
+            assert srv.wait_for_idle(15.0)
+            assert client.wait_until(lambda: any(
+                r.client_status == enums.ALLOC_CLIENT_RUNNING
+                for r in client.runners.values()), timeout=15.0)
+
+            # sever the client<->server link
+            client.server = _Partitioned(srv)
+            assert client.wait_until(lambda: all(
+                not r.task_runners or not any(
+                    h.is_running() for h in (
+                        tr._handle for tr in r.task_runners.values()
+                        if tr._handle is not None))
+                for r in client.runners.values()), timeout=10.0), \
+                "tasks kept running past stop_after_client_disconnect"
+        finally:
+            client.stop()
+            srv.stop()
+
+
+class _Partitioned:
+    """Server proxy that drops heartbeats but keeps reads working."""
+
+    def __init__(self, srv):
+        self._srv = srv
+
+    def heartbeat(self, node_id):
+        raise ConnectionError("partitioned")
+
+    def __getattr__(self, name):
+        return getattr(self._srv, name)
+
+
+class TestAllocWatcher:
+    def test_ephemeral_disk_migration(self, tmp_path):
+        from nomad_tpu.client.alloc_runner import AllocRunner
+
+        node = mock.node()
+        j = mock.job()
+        tg = j.task_groups[0]
+        tg.count = 1
+        tg.ephemeral_disk.migrate = True
+        tg.tasks[0].driver = "mock"
+        tg.tasks[0].config = {"run_for": 0}
+
+        prev = mock.alloc(j, node, index=0)
+        prev_runner = AllocRunner(prev, node, str(tmp_path))
+        prev_runner.allocdir.build()
+        with open(os.path.join(prev_runner.allocdir.shared, "state.txt"),
+                  "w") as f:
+            f.write("precious")
+        prev_runner.client_status = enums.ALLOC_CLIENT_COMPLETE
+
+        nxt = mock.alloc(j, node, index=0)
+        nxt.previous_allocation = prev.id
+        runner = AllocRunner(nxt, node, str(tmp_path),
+                             prev_runner_lookup={prev.id: prev_runner}.get)
+        runner.run()
+        deadline = time.time() + 10
+        target = os.path.join(runner.allocdir.shared, "state.txt")
+        while time.time() < deadline and not os.path.exists(target):
+            time.sleep(0.05)
+        assert os.path.exists(target)
+        with open(target) as f:
+            assert f.read() == "precious"
