@@ -69,6 +69,20 @@ class ApiClient:
         out, _ = self._request("POST", "/v1/jobs", payload)
         return out["eval_id"]
 
+    def scale_job(self, job_id: str, task_group: str, count: int) -> str:
+        out, _ = self._request("POST", f"/v1/job/{job_id}/scale",
+                               {"task_group": task_group, "count": count})
+        return out["eval_id"]
+
+    def revert_job(self, job_id: str, job_version: int) -> str:
+        out, _ = self._request("POST", f"/v1/job/{job_id}/revert",
+                               {"job_version": job_version})
+        return out["eval_id"]
+
+    def job_versions(self, job_id: str) -> List[dict]:
+        out, _ = self.get(f"/v1/job/{job_id}/versions")
+        return out
+
     def alloc_logs(self, alloc_id: str, task: str = "",
                    log_type: str = "stdout", offset: int = 0,
                    limit: int = 65536) -> dict:

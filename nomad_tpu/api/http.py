@@ -260,6 +260,14 @@ class HTTPAgent:
         # job ids may contain '/' (dispatched children are
         # "<parent>/dispatch-<ts>-<id>"): suffixed routes match first,
         # then the greedy plain route takes whatever remains
+        if m := re.fullmatch(r"/v1/job/(.+)/versions", path):
+            if snap.job_by_id(m.group(1), ns) is None:
+                return h._error(404, "job not found")
+            return h._reply(200, [
+                {"version": j.version, "stable": j.stable,
+                 "submit_time": j.submit_time,
+                 "job_modify_index": j.job_modify_index}
+                for j in snap.job_versions(m.group(1), ns)])
         if m := re.fullmatch(r"/v1/job/(.+)/allocations", path):
             return h._reply(200, [self._alloc_stub(a) for a in
                                   snap.allocs_by_job(m.group(1), ns)])
@@ -484,6 +492,26 @@ class HTTPAgent:
             except (ValueError, binascii.Error) as e:
                 return h._error(400, str(e))
             return h._reply(200, out)
+        if m := re.fullmatch(r"/v1/job/(.+)/scale", path):
+            try:
+                eval_id = self.writer.scale_job(
+                    m.group(1), body.get("task_group", ""),
+                    int(body.get("count", -1)), namespace=ns)
+            except KeyError:
+                return h._error(404, "job not found")
+            except ValueError as e:
+                return h._error(400, str(e))
+            return h._reply(200, {"eval_id": eval_id})
+        if m := re.fullmatch(r"/v1/job/(.+)/revert", path):
+            try:
+                eval_id = self.writer.revert_job(
+                    m.group(1), int(body.get("job_version", -1)),
+                    namespace=ns)
+            except KeyError as e:
+                return h._error(404, str(e))
+            except ValueError as e:
+                return h._error(400, str(e))
+            return h._reply(200, {"eval_id": eval_id})
         if m := re.fullmatch(r"/v1/job/(.+)/plan", path):
             data = body.get("job") or body.get("Job") or body
             job = from_dict(Job, data)

@@ -181,6 +181,27 @@ def cmd_job_dispatch(args) -> int:
     return _monitor_eval(args, out["eval_id"])
 
 
+def cmd_job_scale(args) -> int:
+    eval_id = _client(args).scale_job(args.job_id, args.group, args.count)
+    print(f"job {args.job_id!r} group {args.group!r} scaled to "
+          f"{args.count}, evaluation {eval_id}")
+    return _monitor_eval(args, eval_id) if not args.detach else 0
+
+
+def cmd_job_revert(args) -> int:
+    eval_id = _client(args).revert_job(args.job_id, args.version)
+    print(f"job {args.job_id!r} reverted to version {args.version}, "
+          f"evaluation {eval_id}")
+    return _monitor_eval(args, eval_id) if not args.detach else 0
+
+
+def cmd_job_history(args) -> int:
+    for v in _client(args).job_versions(args.job_id):
+        print(f"version {v['version']:4d}  stable={v['stable']}  "
+              f"index={v['job_modify_index']}")
+    return 0
+
+
 def cmd_job_status(args) -> int:
     api = _client(args)
     if not args.job_id:
@@ -324,6 +345,20 @@ def build_parser() -> argparse.ArgumentParser:
                     help="key=value dispatch metadata (repeatable)")
     jd.add_argument("-detach", action="store_true")
     jd.set_defaults(fn=cmd_job_dispatch)
+    jsc = job.add_parser("scale")
+    jsc.add_argument("job_id")
+    jsc.add_argument("group")
+    jsc.add_argument("count", type=int)
+    jsc.add_argument("-detach", action="store_true")
+    jsc.set_defaults(fn=cmd_job_scale)
+    jrv = job.add_parser("revert")
+    jrv.add_argument("job_id")
+    jrv.add_argument("version", type=int)
+    jrv.add_argument("-detach", action="store_true")
+    jrv.set_defaults(fn=cmd_job_revert)
+    jh = job.add_parser("history")
+    jh.add_argument("job_id")
+    jh.set_defaults(fn=cmd_job_history)
     js = job.add_parser("status")
     js.add_argument("job_id", nargs="?", default="")
     js.set_defaults(fn=cmd_job_status)

@@ -19,9 +19,11 @@ through the identical interface too).
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import subprocess
+import tempfile
 import threading
 import time
 from dataclasses import dataclass, field
@@ -236,9 +238,135 @@ class _RecoveredProcHandle(TaskHandle):
         return {"pid": self._pid, "starttime": _proc_starttime(self._pid)}
 
 
+def _read_status_file(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _status_to_result(path: str, missing_err: str) -> ExitResult:
+    """Final exit status from the executor's status file — the single
+    reader the live, recovered, and restore paths all share."""
+    st = _read_status_file(path)
+    if st is None or "exit_code" not in st:
+        return ExitResult(exit_code=1, err=missing_err)
+    return ExitResult(exit_code=int(st.get("exit_code", 1)),
+                      signal=int(st.get("signal", 0)),
+                      oom_killed=bool(st.get("oom_killed", False)),
+                      err=st.get("err", ""))
+
+
+def _kill_task_group(status_file: str) -> None:
+    """Backstop: SIGKILL the task's own process group (pgid == task pid,
+    recorded in the status file at start) for the case where the
+    executor was killed before it could escalate."""
+    st = _read_status_file(status_file)
+    pid = int(st.get("task_pid", 0)) if st else 0
+    if pid > 0:
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+class _ExecutorHandle(TaskHandle):
+    """A task supervised by the out-of-process executor
+    (client/executor.py; reference drivers/shared/executor). The driver
+    tracks the EXECUTOR process; the real exit status comes from the
+    status file the executor writes."""
+
+    def __init__(self, proc: subprocess.Popen, status_file: str):
+        self._proc = proc
+        self.status_file = status_file
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[ExitResult]:
+        try:
+            self._proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            return None
+        return _status_to_result(self.status_file,
+                                 "executor died without writing status")
+
+    def kill(self, grace_s: float = 5.0) -> None:
+        if self._proc.poll() is not None:
+            return
+        try:
+            os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        try:
+            self._proc.wait(grace_s + 2.0)  # executor grace + margin
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(self._proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            _kill_task_group(self.status_file)
+            self._proc.wait(5.0)
+
+    def is_running(self) -> bool:
+        return self._proc.poll() is None
+
+    def handle_data(self) -> Optional[dict]:
+        return {"executor_pid": self._proc.pid,
+                "starttime": _proc_starttime(self._proc.pid),
+                "status_file": self.status_file}
+
+
+class _RecoveredExecutorHandle(_RecoveredProcHandle):
+    """Re-attached executor from a previous agent process: liveness by
+    pid, REAL exit status from the status file once it lands — the gap
+    plain pid re-attach can't close."""
+
+    def __init__(self, pid: int, status_file: str):
+        super().__init__(pid)
+        self.status_file = status_file
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[ExitResult]:
+        deadline = None if timeout is None else time.time() + timeout
+        while self._alive():
+            if deadline is not None and time.time() >= deadline:
+                return None
+            time.sleep(0.1)
+        return _status_to_result(self.status_file,
+                                 "executor gone without writing status")
+
+    def kill(self, grace_s: float = 5.0) -> None:
+        super().kill(grace_s)
+        if not self._alive():
+            _kill_task_group(self.status_file)
+
+    def handle_data(self) -> Optional[dict]:
+        return {"executor_pid": self._pid,
+                "starttime": _proc_starttime(self._pid),
+                "status_file": self.status_file}
+
+
+class _FinishedHandle(TaskHandle):
+    """A task that finished while the agent was down: the recorded exit
+    status replays immediately on wait()."""
+
+    def __init__(self, result: ExitResult):
+        self._result = result
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[ExitResult]:
+        return self._result
+
+    def kill(self, grace_s: float = 5.0) -> None:
+        pass
+
+    def is_running(self) -> bool:
+        return False
+
+
 class RawExecDriver:
     """No-isolation subprocess driver (reference drivers/rawexec).
-    config: command (str), args (list)."""
+    config: command (str), args (list). Tasks run under the
+    out-of-process executor (client/executor.py), so they and their log
+    capture survive agent restarts and report real exit codes across
+    them."""
 
     name = "raw_exec"
 
@@ -247,49 +375,85 @@ class RawExecDriver:
 
     def start_task(self, task, env: Dict[str, str], task_dir: str,
                    io=None) -> TaskHandle:
+        import sys
+
         cfg = task.config or {}
         command = cfg.get("command")
         if not command:
             raise DriverError(f"{self.name} requires config.command")
         argv = [str(command)] + [str(a) for a in cfg.get("args", [])]
-        if io is not None:
-            # rotated capture through logmon pipes
-            stdout = io.stream_fd("stdout")
-            stderr = io.stream_fd("stderr")
-        else:
-            stdout = open(os.path.join(task_dir, "stdout.log"), "ab") \
-                if os.path.isdir(task_dir) else subprocess.DEVNULL
-            stderr = open(os.path.join(task_dir, "stderr.log"), "ab") \
-                if os.path.isdir(task_dir) else subprocess.DEVNULL
+        have_dir = os.path.isdir(task_dir)
+        logs_dir = (io.log_dir if io is not None
+                    else (os.path.join(task_dir, "logs") if have_dir
+                          else tempfile.mkdtemp(prefix="nomad_tpu_logs_")))
+        spec_dir = task_dir if have_dir else logs_dir
+        spec = {
+            "argv": argv,
+            "env": self._build_env(env),
+            "cwd": task_dir if have_dir else None,
+            "task_name": task.name,
+            "logs_dir": logs_dir,
+            "max_files": io.max_files if io is not None else 10,
+            "max_file_size_mb": (io.max_bytes // (1024 * 1024)
+                                 if io is not None else 10),
+            "grace_s": task.kill_timeout_s,
+            "status_file": os.path.join(spec_dir, ".executor_status.json"),
+        }
+        try:
+            os.unlink(spec["status_file"])  # stale status from a prior run
+        except OSError:
+            pass
+        # the executor must import nomad_tpu regardless of the agent's cwd
+        exec_env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        exec_env["PYTHONPATH"] = (pkg_root + os.pathsep
+                                  + exec_env.get("PYTHONPATH", "")).rstrip(os.pathsep)
         try:
             proc = subprocess.Popen(
-                argv,
-                cwd=task_dir if os.path.isdir(task_dir) else None,
-                env=self._build_env(env),
-                stdout=stdout, stderr=stderr,
-                start_new_session=True,  # own process group for kill
+                [sys.executable, "-m", "nomad_tpu.client.executor", "-"],
+                env=exec_env,
+                stdin=subprocess.PIPE,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                start_new_session=True,  # its own group: killpg stops all
             )
+            # spec over stdin: the task env (which inherits the agent's,
+            # secrets included) never touches disk
+            proc.stdin.write(json.dumps(spec).encode())
+            proc.stdin.close()
         except OSError as e:
-            raise DriverError(f"failed to start {command}: {e}") from e
-        finally:
-            if io is not None:
-                io.close_parent_fds()
-        return _ProcHandle(proc)
+            raise DriverError(f"failed to start executor: {e}") from e
+        return _ExecutorHandle(proc, spec["status_file"])
 
     def recover_task(self, handle_data: Optional[dict]) -> Optional[TaskHandle]:
         """Re-attach to a task started by a previous client process
-        (reference client/state re-attach, task_runner.go:1212). None if
-        the process is gone or the pid was recycled."""
-        if not handle_data or not handle_data.get("pid"):
+        (reference client/state re-attach, task_runner.go:1212). The
+        executor boundary makes finished-while-away tasks report their
+        recorded exit status instead of vanishing."""
+        if not handle_data:
             return None
-        pid = int(handle_data["pid"])
-        handle = _RecoveredProcHandle(pid)
-        if not handle.is_running():
-            return None
-        recorded = handle_data.get("starttime")
-        if recorded is not None and _proc_starttime(pid) != recorded:
-            return None  # pid reuse: a different process lives here now
-        return handle
+        status_file = handle_data.get("status_file", "")
+        pid = int(handle_data.get("executor_pid")
+                  or handle_data.get("pid") or 0)
+        if pid:
+            alive = _RecoveredExecutorHandle(pid, status_file)
+            recorded = handle_data.get("starttime")
+            if alive.is_running() and (
+                    recorded is None or _proc_starttime(pid) == recorded):
+                return alive
+        # executor gone: replay the recorded exit status if it landed
+        if status_file:
+            try:
+                with open(status_file) as f:
+                    st = json.load(f)
+            except (OSError, ValueError):
+                return None
+            if "exit_code" in st:
+                return _FinishedHandle(ExitResult(
+                    exit_code=int(st.get("exit_code", 1)),
+                    signal=int(st.get("signal", 0)),
+                    err=st.get("err", "")))
+        return None
 
     def healthy(self) -> bool:
         return True

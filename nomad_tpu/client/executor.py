@@ -1,0 +1,137 @@
+"""Out-of-process task executor (reference drivers/shared/executor/:
+the re-exec'd `nomad executor` subprocess supervising every exec/rawexec
+task, executor.go + grpc control plane).
+
+Run as `python -m nomad_tpu.client.executor <spec.json>`. The executor
+is its own session leader; the task runs as its child in the same
+process group. It owns the task's rotated log capture and writes the
+task's exit status to a status file — so, unlike in-agent supervision:
+
+- the task AND its log capture survive client-agent restarts, and
+- a re-attaching agent reads the REAL exit code of a task that finished
+  while the agent was down (the in-process re-attach path can only
+  observe liveness).
+
+Control surface is the filesystem (spec in, status out, signals), not
+gRPC — one supervisor per task needs nothing richer, and the driver
+side stays transport-free.
+
+spec.json: {argv, env, cwd, task_name, logs_dir, max_files,
+            max_file_size_mb, grace_s, status_file}
+status file (atomic rename): {exit_code, signal, oom_killed, err,
+                              task_pid, finished_at}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _write_status(path: str, payload: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def run(spec_path: str) -> int:
+    if spec_path == "-":
+        # spec over stdin: agent secrets in the env never touch disk
+        spec = json.load(sys.stdin)
+    else:
+        with open(spec_path) as f:
+            spec = json.load(f)
+
+    from .logmon import LogMon
+
+    lm = LogMon(spec["logs_dir"], spec["task_name"],
+                max_files=int(spec.get("max_files", 10)),
+                max_file_size_mb=int(spec.get("max_file_size_mb", 10)))
+    stdout_fd = lm.stream_fd("stdout")
+    stderr_fd = lm.stream_fd("stderr")
+    status_file = spec["status_file"]
+    grace = float(spec.get("grace_s", 5.0))
+
+    try:
+        proc = subprocess.Popen(
+            spec["argv"],
+            env=spec.get("env") or None,
+            cwd=spec.get("cwd") or None,
+            stdout=stdout_fd, stderr=stderr_fd,
+            # the task gets ITS OWN process group (pgid == task pid) so
+            # escalation can killpg the whole task tree — including
+            # TERM-trapping grandchildren — without nuking the executor
+            # before it records the exit status. process_group (3.11+)
+            # rather than a preexec_fn: the logmon reader threads are
+            # already running and fork+preexec with live threads can
+            # deadlock
+            process_group=0,
+        )
+    except OSError as e:
+        lm.close_parent_fds()
+        _write_status(status_file, {"exit_code": 127, "signal": 0,
+                                    "err": f"failed to start: {e}",
+                                    "task_pid": 0,
+                                    "finished_at": time.time()})
+        return 1
+    lm.close_parent_fds()
+    _write_status(status_file, {"task_pid": proc.pid})
+
+    stopping = {"flag": False}
+
+    def on_term(_sig, _frm):
+        stopping["flag"] = True
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)  # forward to the task tree
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+
+    code = None
+    deadline = None
+    while code is None:
+        try:
+            code = proc.wait(timeout=0.2)
+        except subprocess.TimeoutExpired:
+            if stopping["flag"]:
+                if deadline is None:
+                    deadline = time.monotonic() + grace
+                elif time.monotonic() >= deadline:
+                    try:  # escalate on the whole task group
+                        os.killpg(proc.pid, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        proc.kill()
+    # the task group may still hold TERM-trapping descendants even after
+    # the leader exited; sweep them so nothing leaks
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    status = {"task_pid": proc.pid, "finished_at": time.time()}
+    if code < 0:
+        status.update(exit_code=128 - code, signal=-code)
+    else:
+        status.update(exit_code=code, signal=0)
+    _write_status(status_file, status)
+    return 0
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print("usage: python -m nomad_tpu.client.executor <spec.json>",
+              file=sys.stderr)
+        return 2
+    return run(sys.argv[1])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -595,6 +595,46 @@ class Server:
 
     # -- Eval endpoints --
 
+    def scale_job(self, job_id: str, task_group: str, count: int,
+                  namespace: str = "default") -> str:
+        """Job.Scale (reference job_endpoint.go Scale): registers a new
+        version with the group count changed — a count-only change, so
+        the scheduler applies it without touching running allocs beyond
+        the count math."""
+        snap = self.store.snapshot()
+        job = snap.job_by_id(job_id, namespace)
+        if job is None or job.stopped():
+            raise KeyError(f"job {job_id} not found")
+        if job.is_periodic or job.is_parameterized:
+            raise ValueError("cannot scale periodic or parameterized jobs")
+        tg = job.lookup_task_group(task_group)
+        if tg is None:
+            raise ValueError(f"task group {task_group!r} not found")
+        if count < 0:
+            raise ValueError("count must be >= 0")
+        updated = _copy.deepcopy(job)
+        updated.lookup_task_group(task_group).count = count
+        return self.register_job(updated)
+
+    def revert_job(self, job_id: str, job_version: int,
+                   namespace: str = "default") -> str:
+        """Job.Revert (reference job_endpoint.go Revert): re-register a
+        prior version's spec as the newest version."""
+        snap = self.store.snapshot()
+        current = snap.job_by_id(job_id, namespace)
+        if current is None:
+            raise KeyError(f"job {job_id} not found")
+        if current.is_periodic or current.is_parameterized:
+            raise ValueError("cannot revert periodic or parameterized jobs")
+        if job_version == current.version:
+            raise ValueError("cannot revert to the current version")
+        old = snap.job_version(job_id, job_version, namespace)
+        if old is None:
+            raise KeyError(f"job {job_id} has no version {job_version}")
+        revived = _copy.deepcopy(old)
+        revived.stop = False
+        return self.register_job(revived)
+
     def plan_job(self, job: Job) -> Dict:
         """Dry-run scheduling of a job update (reference Job.Plan,
         nomad/job_endpoint.go + scheduler/annotate.go): run the real

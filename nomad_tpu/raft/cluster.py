@@ -21,6 +21,7 @@ from .node import NotLeaderError, RaftNode
 from .transport import InProcTransport, RemoteCallError, TransportError
 
 FORWARD = ("register_job", "deregister_job", "dispatch_job",
+           "scale_job", "revert_job",
            "register_node", "heartbeat",
            "update_node_status", "update_node_drain",
            "update_node_eligibility", "deregister_node",

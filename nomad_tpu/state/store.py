@@ -81,6 +81,20 @@ class StateSnapshot:
     def job_version(self, job_id: str, version: int, namespace: str = "default") -> Optional[Job]:
         return self._store._job_versions.get((namespace, job_id, version), self.index)
 
+    def job_versions(self, job_id: str, namespace: str = "default") -> List[Job]:
+        """All retained versions, newest first (reference
+        state_store JobVersionsByID). Keyed lookups from the current
+        version downward — O(versions of THIS job), never a table scan."""
+        current = self.job_by_id(job_id, namespace)
+        if current is None:
+            return []
+        out = []
+        for v in range(current.version, -1, -1):
+            row = self.job_version(job_id, v, namespace)
+            if row is not None:
+                out.append(row)
+        return out
+
     # --- evals ---
 
     def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:

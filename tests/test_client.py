@@ -297,7 +297,7 @@ def test_client_restart_reattaches_running_task(tmp_path):
                 is not None and c2.runners[alloc.id].task_runners["long"]
                 ._handle is not None)
             tr = c2.runners[alloc.id].task_runners["long"]
-            assert tr._handle.handle_data()["pid"] == pid
+            assert tr._handle.handle_data()["executor_pid"] == pid
             os.kill(pid, 0)  # never restarted
             # status still syncs as running through the new agent
             assert c2.wait_until(
@@ -328,8 +328,9 @@ def test_client_restart_dead_task_not_readopted(tmp_path):
         alloc = s.store.snapshot().allocs_by_job(job.id)[0]
         pid = c.runners[alloc.id].task_runners["short"]._handle._proc.pid
         c.shutdown()
-        # the task dies while the agent is down
-        os.kill(pid, 9)
+        # the task (and its executor) die while the agent is down,
+        # without a chance to record an exit status
+        os.killpg(os.getpgid(pid), 9)
         deadline = time.time() + 5
         while time.time() < deadline:
             try:
@@ -352,7 +353,7 @@ def test_client_restart_dead_task_not_readopted(tmp_path):
             if tr is None or tr._handle is None:
                 return False
             data = tr._handle.handle_data()
-            return data and data["pid"] != pid
+            return data and data.get("executor_pid") != pid
         assert c2.wait_until(new_pid, 10.0)
     finally:
         _teardown(s, clients)
@@ -376,3 +377,47 @@ def test_state_db_roundtrip(tmp_path):
     assert handles == {"web": {"pid": 42, "starttime": 99}}
     db2.remove_alloc(a.id)
     assert ClientStateDB(str(tmp_path / "db")).restore_allocs() == []
+
+
+def test_client_restart_reads_exit_status_of_finished_task(tmp_path):
+    """A task that FINISHES while the agent is down: the executor wrote
+    its real exit status, so the restarted agent replays it instead of
+    guessing (the gap plain pid re-attach can't close)."""
+    s, clients = _cluster(tmp_path)
+    try:
+        job = mock.batch_job()
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0] = Task(
+            name="quick", driver="raw_exec",
+            config={"command": "/bin/sh", "args": ["-c", "sleep 0.5; exit 0"]})
+        s.register_job(job)
+        c = clients[0]
+        assert c.wait_until(lambda: (
+            len(s.store.snapshot().allocs_by_job(job.id)) == 1
+            and s.store.snapshot().allocs_by_job(job.id)[0].client_status
+            == enums.ALLOC_CLIENT_RUNNING))
+        alloc = s.store.snapshot().allocs_by_job(job.id)[0]
+        pid = c.runners[alloc.id].task_runners["quick"]._handle._proc.pid
+        c.shutdown()
+        # task completes (exit 0) while no agent is watching
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                os.kill(pid, 0)
+                time.sleep(0.05)
+            except ProcessLookupError:
+                break
+
+        c2 = Client(s, ClientConfig(data_dir=c.config.data_dir,
+                                    heartbeat_interval=0.5))
+        c2.start()
+        clients[0] = c2
+        # the batch alloc completes successfully from the recorded status
+        assert c2.wait_until(
+            lambda: s.store.snapshot().alloc_by_id(alloc.id).client_status
+            == enums.ALLOC_CLIENT_COMPLETE, 15.0)
+        tr_states = s.store.snapshot().alloc_by_id(alloc.id).task_states
+        assert tr_states["quick"].state == "dead"
+        assert not tr_states["quick"].failed
+    finally:
+        _teardown(s, clients)

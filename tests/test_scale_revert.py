@@ -1,0 +1,108 @@
+"""Job scale / revert / history (reference nomad/job_endpoint.go Scale,
+Revert + state JobVersionsByID)."""
+
+import copy
+import json
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.structs import enums
+
+
+@pytest.fixture
+def s():
+    srv = Server(ServerConfig(num_workers=2, heartbeat_ttl=3600,
+                              gc_interval=3600))
+    srv.start()
+    for _ in range(6):
+        srv.register_node(mock.node())
+    yield srv
+    srv.stop()
+
+
+def live(s, job_id):
+    return [a for a in s.store.snapshot().allocs_by_job(job_id)
+            if not a.terminal_status() and not a.server_terminal()]
+
+
+class TestScale:
+    def test_scale_up_and_down(self, s):
+        j = mock.job()
+        j.task_groups[0].count = 2
+        s.register_job(j)
+        assert s.wait_for_idle(10.0)
+        assert len(live(s, j.id)) == 2
+
+        s.scale_job(j.id, "web", 5)
+        assert s.wait_for_idle(10.0)
+        allocs = live(s, j.id)
+        assert len(allocs) == 5
+        # count-only change: original allocs survive (in-place semantics)
+        assert all(a.job_version == 1 for a in allocs)
+
+        s.scale_job(j.id, "web", 1)
+        assert s.wait_for_idle(10.0)
+        assert len(live(s, j.id)) == 1
+
+    def test_scale_validation(self, s):
+        j = mock.job()
+        s.register_job(j)
+        with pytest.raises(ValueError):
+            s.scale_job(j.id, "nope", 3)
+        with pytest.raises(ValueError):
+            s.scale_job(j.id, "web", -1)
+        with pytest.raises(KeyError):
+            s.scale_job("missing", "web", 3)
+
+
+class TestRevert:
+    def test_revert_restores_prior_spec(self, s):
+        j = mock.job()
+        j.task_groups[0].count = 2
+        j.task_groups[0].update = None  # no rolling pacing: no client
+        s.register_job(j)
+        assert s.wait_for_idle(10.0)
+
+        j2 = copy.deepcopy(j)
+        j2.task_groups[0].tasks[0].config = {"command": "/bin/new"}
+        s.register_job(j2)
+        assert s.wait_for_idle(10.0)
+        assert all(a.job_version == 1 for a in live(s, j.id))
+
+        s.revert_job(j.id, 0)
+        assert s.wait_for_idle(10.0)
+        allocs = live(s, j.id)
+        # the revert registers v0's spec as v2
+        assert all(a.job_version == 2 for a in allocs)
+        assert all(a.job.task_groups[0].tasks[0].config
+                   == {"command": "/bin/date"} for a in allocs)
+        with pytest.raises(ValueError):
+            s.revert_job(j.id, 2)  # current version
+        with pytest.raises(KeyError):
+            s.revert_job(j.id, 99)
+
+    def test_history_http(self, s):
+        from nomad_tpu.api.http import HTTPAgent
+
+        j = mock.job()
+        s.register_job(j)
+        j2 = copy.deepcopy(j)
+        j2.meta = {"rev": "2"}
+        s.register_job(j2)
+        with HTTPAgent(s, port=0) as agent:
+            out = json.loads(urllib.request.urlopen(
+                f"{agent.address}/v1/job/{j.id}/versions", timeout=10).read())
+            assert [v["version"] for v in out] == [1, 0]
+            r = urllib.request.Request(
+                f"{agent.address}/v1/job/{j.id}/revert", method="POST",
+                data=json.dumps({"job_version": 0}).encode())
+            got = json.loads(urllib.request.urlopen(r, timeout=10).read())
+            assert got["eval_id"]
+            r2 = urllib.request.Request(
+                f"{agent.address}/v1/job/{j.id}/scale", method="POST",
+                data=json.dumps({"task_group": "web", "count": 3}).encode())
+            got2 = json.loads(urllib.request.urlopen(r2, timeout=10).read())
+            assert got2["eval_id"]
