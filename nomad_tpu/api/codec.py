@@ -60,7 +60,17 @@ def from_dict(cls: Type, data: Any) -> Any:
 
 
 def _resolve(hint, owner_cls):
-    """Resolve a string annotation to a runtime type."""
+    """Resolve a string annotation to a runtime type. Doubly-quoted
+    annotations ('"X | None"' under future-annotations) eval to a string
+    once, so resolve until a non-string lands."""
+    for _ in range(3):
+        if not isinstance(hint, str):
+            return hint
+        hint = _resolve_once(hint, owner_cls)
+    return hint
+
+
+def _resolve_once(hint, owner_cls):
     if isinstance(hint, str):
         import sys
         import typing
@@ -85,7 +95,11 @@ def _inflate(hint, val, owner_cls):
         args = get_args(hint)
         item_t = args[1] if len(args) == 2 else Any
         return {k: _inflate(item_t, v, owner_cls) for k, v in (val or {}).items()}
-    if origin is not None and str(origin).endswith("Union"):  # Optional[...]
+    import types
+
+    if origin is not None and (origin is types.UnionType
+                               or str(origin).endswith("Union")):
+        # Optional[...] and PEP 604 "X | None" both land here
         inner = [a for a in get_args(hint) if a is not type(None)]
         if len(inner) == 1:
             return _inflate(inner[0], val, owner_cls)

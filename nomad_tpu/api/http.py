@@ -207,6 +207,13 @@ class HTTPAgent:
             if acl is not None and not acl.management:
                 return h._error(403, "Permission denied")
 
+        if path == "/v1/node/pools":
+            return h._reply(200, list(snap.node_pools()))
+        if m := re.fullmatch(r"/v1/node/pool/([^/]+)", path):
+            pool = snap.node_pool(m.group(1))
+            if pool is None:
+                return h._error(404, "node pool not found")
+            return h._reply(200, pool)
         if path == "/v1/volumes":
             return h._reply(200, [
                 {"id": v.id, "namespace": v.namespace, "name": v.name,
@@ -414,6 +421,11 @@ class HTTPAgent:
                    else aclp.CAP_SUBMIT_JOB)
             if not self._ns_allowed(acl, ns, cap):
                 return h._error(403, "Permission denied")
+        elif path.startswith("/v1/node/pool"):
+            # pool definitions steer scheduling cluster-wide: operator
+            # write, matching the DELETE side
+            if acl is not None and not acl.allow_operator_write():
+                return h._error(403, "Permission denied")
         elif path.startswith(("/v1/nodes", "/v1/node/")):
             if acl is not None and not acl.allow_node_write():
                 return h._error(403, "Permission denied")
@@ -461,6 +473,16 @@ class HTTPAgent:
         if m := re.fullmatch(r"/v1/var/(.+)", path):
             self.writer.put_variable(m.group(1), body.get("items", {}), ns)
             return h._reply(200, {"ok": True})
+        if m := re.fullmatch(r"/v1/node/pool/([^/]+)", path):
+            from ..structs.operator import NodePool
+
+            pool = from_dict(NodePool, body.get("node_pool") or body)
+            pool.name = m.group(1)
+            try:
+                self.writer.upsert_node_pool(pool)
+            except ValueError as e:
+                return h._error(400, str(e))
+            return h._reply(200, {"ok": True})
         if m := re.fullmatch(r"/v1/volume/csi/([^/]+)", path):
             from ..structs.volumes import Volume
 
@@ -496,20 +518,21 @@ class HTTPAgent:
             try:
                 eval_id = self.writer.scale_job(
                     m.group(1), body.get("task_group", ""),
-                    int(body.get("count", -1)), namespace=ns)
+                    int(body.get("count") or -1), namespace=ns)
             except KeyError:
                 return h._error(404, "job not found")
-            except ValueError as e:
+            except (ValueError, TypeError) as e:
                 return h._error(400, str(e))
             return h._reply(200, {"eval_id": eval_id})
         if m := re.fullmatch(r"/v1/job/(.+)/revert", path):
             try:
                 eval_id = self.writer.revert_job(
-                    m.group(1), int(body.get("job_version", -1)),
-                    namespace=ns)
+                    m.group(1), int(body.get("job_version", -1)
+                                    if body.get("job_version") is not None
+                                    else -1), namespace=ns)
             except KeyError as e:
                 return h._error(404, str(e))
-            except ValueError as e:
+            except (ValueError, TypeError) as e:
                 return h._error(400, str(e))
             return h._reply(200, {"eval_id": eval_id})
         if m := re.fullmatch(r"/v1/job/(.+)/plan", path):
@@ -589,6 +612,14 @@ class HTTPAgent:
             if not self._ns_allowed(acl, ns, aclp.CAP_VARIABLES_WRITE):
                 return h._error(403, "Permission denied")
             self.writer.delete_variable(m.group(1), ns)
+            return h._reply(200, {"ok": True})
+        if m := re.fullmatch(r"/v1/node/pool/([^/]+)", path):
+            if acl is not None and not acl.allow_operator_write():
+                return h._error(403, "Permission denied")
+            try:
+                self.writer.delete_node_pool(m.group(1))
+            except ValueError as e:
+                return h._error(409, str(e))
             return h._reply(200, {"ok": True})
         if m := re.fullmatch(r"/v1/volume/csi/([^/]+)", path):
             if not self._ns_allowed(acl, ns, aclp.CAP_SUBMIT_JOB):

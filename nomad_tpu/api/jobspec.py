@@ -43,12 +43,12 @@ def parse_json(text: str) -> Job:
     return job
 
 
-def parse_file(path: str) -> Job:
+def parse_file(path: str, variables: Optional[dict] = None) -> Job:
     with open(path) as f:
         text = f.read()
     if path.endswith(".json"):
         return parse_json(text)
-    return parse_hcl_like(text)
+    return parse_hcl_like(text, variables=variables)
 
 
 def _validate(job: Job) -> None:
@@ -107,12 +107,30 @@ _TOKEN = re.compile(r"""
   | (?P<lbrace>\{) | (?P<rbrace>\})
   | (?P<lbrack>\[) | (?P<rbrack>\])
   | (?P<eq>=) | (?P<comma>,)
-  | (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<string>"(?:[^"\\$]|\\.|\$(?!\{)|\$\{[^{}]*\})*")
   | (?P<number>-?\d+(?:\.\d+)?)
   | (?P<bool>\btrue\b|\bfalse\b)
   | (?P<ident>[A-Za-z_][A-Za-z0-9_.-]*)
   | (?P<ws>\s+)
 """, re.VERBOSE)
+
+
+def _unquote(raw: str) -> str:
+    """Unescape a tokenized string literal. Not json.loads: interpolation
+    segments (${format("x", ...)}) legally carry raw inner quotes."""
+    body = raw[1:-1]
+    out = []
+    i = 0
+    esc = {"n": "\n", "t": "\t", "r": "\r", '"': '"', "\\": "\\"}
+    while i < len(body):
+        c = body[i]
+        if c == "\\" and i + 1 < len(body):
+            out.append(esc.get(body[i + 1], body[i + 1]))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
 
 
 def _tokenize(text: str):
@@ -169,7 +187,7 @@ class _Parser:
                 # block: optional string label(s), then { body }
                 labels = []
                 while self.peek()[0] == "string":
-                    labels.append(json.loads(self.next()[1]))
+                    labels.append(_unquote(self.next()[1]))
                 self.expect("lbrace")
                 inner = self.parse_body()
                 self.expect("rbrace")
@@ -180,11 +198,15 @@ class _Parser:
     def parse_value(self):
         k, v = self.next()
         if k == "string":
-            return json.loads(v)
+            return _unquote(v)
         if k == "number":
             return float(v) if "." in v else int(v)
         if k == "bool":
             return v == "true"
+        if k == "ident" and v.startswith(("var.", "local.")):
+            # bare HCL2 reference (count = var.replicas): normalize to
+            # the interpolation form and resolve later
+            return "${" + v + "}"
         if k == "lbrack":
             items = []
             while True:
@@ -306,9 +328,173 @@ def _group_dict(block: dict) -> dict:
     return out
 
 
-def parse_hcl_like(text: str) -> Job:
-    """Parse the minimal HCL-shaped jobspec surface into a Job."""
+# ---------------------------------------------------------------------------
+# HCL2-style variables / locals / functions (reference jobspec2:
+# variable blocks, locals, go-cty stdlib functions, NOMAD_VAR_* env and
+# -var flag overrides)
+# ---------------------------------------------------------------------------
+
+_INTERP = re.compile(r"\$\{([^{}]+)\}")
+
+# the function subset jobs actually lean on (reference jobspec2 exposes
+# the cty stdlib; these cover the common spec-shaping cases)
+_FUNCTIONS = {
+    "upper": lambda s: str(s).upper(),
+    "lower": lambda s: str(s).lower(),
+    "trimspace": lambda s: str(s).strip(),
+    "join": lambda sep, items: str(sep).join(str(i) for i in items),
+    "split": lambda sep, s: str(s).split(str(sep)),
+    "replace": lambda s, old, new: str(s).replace(str(old), str(new)),
+    "format": lambda fmt, *a: _go_format(str(fmt), a),
+    "coalesce": lambda *a: next((x for x in a if x not in (None, "")), ""),
+    "length": lambda x: len(x),
+    "min": lambda *a: min(a),
+    "max": lambda *a: max(a),
+}
+
+
+def _go_format(fmt: str, args) -> str:
+    """Tiny %v-style formatter (the jobspec2 format() surface): each
+    argument binds to the LEFTMOST remaining verb, whatever its kind."""
+    out = fmt
+    for a in args:
+        hits = [i for i in (out.find(s) for s in ("%v", "%s", "%d"))
+                if i >= 0]
+        if not hits:
+            break
+        idx = min(hits)
+        out = out[:idx] + str(a) + out[idx + 2:]
+    return out
+
+
+def _collect_variables(body: dict, overrides: Optional[dict]) -> dict:
+    """Resolve variable bindings: -var overrides > NOMAD_VAR_<name> env
+    > block default (reference jobspec2 ParseWithConfig)."""
+    import os
+
+    out: dict = {}
+    for vb in body.get("variable", []):
+        name = vb.get("__label__", "")
+        if not name:
+            continue
+        out[name] = vb.get("default")
+    for key, val in os.environ.items():
+        if key.startswith("NOMAD_VAR_"):
+            out[key[len("NOMAD_VAR_"):]] = val
+    for key, val in (overrides or {}).items():
+        out[key] = val
+    missing = [k for k, v in out.items() if v is None]
+    if missing:
+        raise ValueError(f"variables without a value: {missing}")
+    return out
+
+
+def _eval_expr(expr: str, variables: dict, local_vals: dict):
+    """Evaluate one ${...} expression: var./local. refs, literals, and
+    one-level function calls. Unknown forms return None so runtime
+    interpolations (${attr.*}, ${NOMAD_*}) pass through untouched."""
+    expr = expr.strip()
+    if expr.startswith("var."):
+        name = expr[4:]
+        if name not in variables:
+            raise ValueError(f"undefined variable {name!r}")
+        return variables[name]
+    if expr.startswith("local."):
+        name = expr[6:]
+        if name not in local_vals:
+            raise ValueError(f"undefined local {name!r}")
+        return local_vals[name]
+    m = re.fullmatch(r"([a-z_]+)\((.*)\)", expr, re.DOTALL)
+    if m and m.group(1) in _FUNCTIONS:
+        args = []
+        for raw in _split_args(m.group(2)):
+            raw = raw.strip()
+            if not raw:
+                continue
+            if raw.startswith('"') and raw.endswith('"'):
+                args.append(json.loads(raw))
+            elif re.fullmatch(r"-?\d+", raw):
+                args.append(int(raw))
+            elif re.fullmatch(r"-?\d+\.\d+", raw):
+                args.append(float(raw))
+            else:
+                val = _eval_expr(raw, variables, local_vals)
+                if val is None:
+                    raise ValueError(f"cannot evaluate argument {raw!r}")
+                args.append(val)
+        return _FUNCTIONS[m.group(1)](*args)
+    return None  # runtime interpolation: not ours to resolve
+
+
+def _split_args(s: str):
+    """Split a call's arguments on top-level commas (quotes and nested
+    parens respected)."""
+    out, depth, in_str, cur = [], 0, False, []
+    i = 0
+    while i < len(s):
+        ch = s[i]
+        if in_str:
+            cur.append(ch)
+            if ch == "\\":
+                i += 1
+                if i < len(s):
+                    cur.append(s[i])
+            elif ch == '"':
+                in_str = False
+        elif ch == '"':
+            in_str = True
+            cur.append(ch)
+        elif ch == "(":
+            depth += 1
+            cur.append(ch)
+        elif ch == ")":
+            depth -= 1
+            cur.append(ch)
+        elif ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+        i += 1
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+def _resolve_strings(value, variables: dict, local_vals: dict):
+    """Walk the parsed body resolving spec-time interpolations in place;
+    a string that is exactly one interpolation keeps the expression's
+    native type (count = "${var.n}" stays an int)."""
+    if isinstance(value, str):
+        whole = _INTERP.fullmatch(value)
+        if whole:
+            out = _eval_expr(whole.group(1), variables, local_vals)
+            return value if out is None else out
+
+        def sub(m):
+            out = _eval_expr(m.group(1), variables, local_vals)
+            return m.group(0) if out is None else str(out)
+
+        return _INTERP.sub(sub, value)
+    if isinstance(value, list):
+        return [_resolve_strings(v, variables, local_vals) for v in value]
+    if isinstance(value, dict):
+        return {k: _resolve_strings(v, variables, local_vals)
+                for k, v in value.items()}
+    return value
+
+
+def parse_hcl_like(text: str, variables: Optional[dict] = None) -> Job:
+    """Parse the minimal HCL-shaped jobspec surface into a Job, with
+    jobspec2-style variable/locals/function resolution."""
     body = _Parser(_tokenize(text)).parse_body()
+    bindings = _collect_variables(body, variables)
+    local_vals: dict = {}
+    for lb in body.get("locals", []):
+        for k, v in lb.items():
+            if k != "__label__":
+                local_vals[k] = _resolve_strings(v, bindings, local_vals)
+    body = _resolve_strings(body, bindings, local_vals)
     jobs = body.get("job")
     if not jobs:
         raise ValueError("no job block found")

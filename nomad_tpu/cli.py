@@ -116,7 +116,7 @@ def cmd_job_plan(args) -> int:
     (reference command/job_plan.go)."""
     from .api.jobspec import parse_file
 
-    job = parse_file(args.spec)
+    job = parse_file(args.spec, variables=_spec_vars(args))
     out = _client(args).plan_job(job)
     diff = out.get("diff", {})
     print(f"Job: {out.get('job_id')!r} (version {out.get('job_version')}, "
@@ -135,10 +135,21 @@ def cmd_job_plan(args) -> int:
     return 1 if failed else 0
 
 
+def _spec_vars(args) -> dict:
+    out = {}
+    for kv in getattr(args, "var", None) or []:
+        if "=" not in kv:
+            print(f"invalid -var {kv!r}: expected key=value", file=sys.stderr)
+            raise SystemExit(2)
+        k, v = kv.split("=", 1)
+        out[k] = v
+    return out
+
+
 def cmd_job_run(args) -> int:
     from .api.jobspec import parse_file
 
-    job = parse_file(args.spec)
+    job = parse_file(args.spec, variables=_spec_vars(args))
     eval_id = _client(args).register_job(job)
     print(f"job {job.id!r} registered, evaluation {eval_id}")
     if args.detach:
@@ -334,9 +345,12 @@ def build_parser() -> argparse.ArgumentParser:
     jr = job.add_parser("run")
     jr.add_argument("spec")
     jr.add_argument("-detach", action="store_true")
+    jr.add_argument("-var", action="append", dest="var",
+                    help="key=value jobspec variable (repeatable)")
     jr.set_defaults(fn=cmd_job_run)
     jp = job.add_parser("plan")
     jp.add_argument("spec")
+    jp.add_argument("-var", action="append", dest="var")
     jp.set_defaults(fn=cmd_job_plan)
     jd = job.add_parser("dispatch")
     jd.add_argument("job_id")

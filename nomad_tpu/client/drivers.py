@@ -443,16 +443,9 @@ class RawExecDriver:
                 return alive
         # executor gone: replay the recorded exit status if it landed
         if status_file:
-            try:
-                with open(status_file) as f:
-                    st = json.load(f)
-            except (OSError, ValueError):
-                return None
-            if "exit_code" in st:
-                return _FinishedHandle(ExitResult(
-                    exit_code=int(st.get("exit_code", 1)),
-                    signal=int(st.get("signal", 0)),
-                    err=st.get("err", "")))
+            st = _read_status_file(status_file)
+            if st is not None and "exit_code" in st:
+                return _FinishedHandle(_status_to_result(status_file, ""))
         return None
 
     def healthy(self) -> bool:

@@ -716,6 +716,20 @@ class Server:
                 for name, m in sched.failed_tg_allocs.items()},
         }
 
+    # -- Node-pool endpoints (reference nomad/node_pool_endpoint.go) --
+
+    def upsert_node_pool(self, pool) -> None:
+        from ..structs.operator import BUILTIN_NODE_POOLS
+
+        if pool.name in BUILTIN_NODE_POOLS:
+            raise ValueError(f"cannot modify built-in node pool {pool.name!r}")
+        if not pool.name:
+            raise ValueError("node pool name is required")
+        self.store.upsert_node_pool(pool)
+
+    def delete_node_pool(self, name: str) -> None:
+        self.store.delete_node_pool(name)
+
     # -- Volume endpoints (reference nomad/csi_endpoint.go register/deregister) --
 
     def register_volume(self, vol) -> None:

@@ -30,6 +30,7 @@ FORWARD = ("register_job", "deregister_job", "dispatch_job",
            "promote_deployment", "fail_deployment",
            "put_variable", "delete_variable",
            "register_volume", "deregister_volume",
+           "upsert_node_pool", "delete_node_pool",
            "upsert_acl_policy", "create_acl_token", "acl_bootstrap")
 
 

@@ -31,6 +31,7 @@ MUTATIONS = {
     "upsert_acl_token", "delete_acl_token",
     "upsert_variable", "delete_variable",
     "upsert_volume", "delete_volume", "reap_volume_claims",
+    "upsert_node_pool", "delete_node_pool",
     "gc_terminal_allocs", "compact", "restore_dump",
 }
 

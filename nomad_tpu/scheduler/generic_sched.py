@@ -38,6 +38,8 @@ class GenericScheduler:
         self.on_event = on_event
         algorithm = (sched_config.scheduler_algorithm
                      if sched_config is not None else enums.SCHED_ALG_BINPACK)
+        self._placer_injected = placer is not None
+        self._base_algorithm = algorithm
         self.placer = placer if placer is not None else placer_for_algorithm(algorithm)
         self.max_attempts = MAX_BATCH_ATTEMPTS if batch else MAX_SERVICE_ATTEMPTS
 
@@ -245,9 +247,20 @@ class GenericScheduler:
     def _compute_placements(self, ctx: EvalContext, job, requests, attempt: int) -> None:
         ev = self.eval
         nodes = self.state.ready_nodes_in_pool(job.datacenters, job.node_pool)
+        # per-node-pool scheduler-config overrides (reference
+        # generic_sched.go:737-752 applying SchedulerConfig.WithNodePool)
+        effective = self.sched_config
+        placer = self.placer
+        if effective is not None:
+            pool_fn = getattr(self.state, "node_pool", None)
+            pool = pool_fn(job.node_pool) if pool_fn is not None else None
+            effective = effective.with_node_pool(pool)
+            if (not self._placer_injected
+                    and effective.scheduler_algorithm != self._base_algorithm):
+                placer = placer_for_algorithm(effective.scheduler_algorithm)
         preemption_enabled = (
-            self.sched_config.preemption_enabled_for(job.type)
-            if self.sched_config is not None else False)
+            effective.preemption_enabled_for(job.type)
+            if effective is not None else False)
 
         now = time.time()
 
@@ -319,7 +332,7 @@ class GenericScheduler:
             self.plan.append_alloc(alloc)
             self.queued_allocs[tg.name] = self.queued_allocs.get(tg.name, 0) + 1
 
-        self.placer.place(
+        placer.place(
             ctx, job, requests, nodes, commit,
             batch=self.batch, preemption_enabled=preemption_enabled,
             attempt=attempt)

@@ -36,6 +36,8 @@ def dump_store(store) -> dict:
                           for _, v in store._variables.iterate(snap.index)],
             "volumes": [wire_encode(v)
                         for _, v in store._volumes.iterate(snap.index)],
+            "node_pools": [wire_encode(p)
+                           for _, p in store._node_pools.iterate(snap.index)],
         }
 
 
@@ -55,6 +57,7 @@ def restore_store(store, data: dict) -> None:
     tokens = [wire_decode(x) for x in data.get("acl_tokens", [])]
     variables = [wire_decode(x) for x in data.get("variables", [])]
     volumes = [wire_decode(x) for x in data.get("volumes", [])]
+    node_pools = [wire_decode(x) for x in data.get("node_pools", [])]
 
     with store._write_lock:
         # Generation choice must be deterministic across replicas AND
@@ -80,6 +83,7 @@ def restore_store(store, data: dict) -> None:
             id(store._acl_secret_idx): {t.secret_id for t in tokens},
             id(store._variables): {(v.namespace, v.path) for v in variables},
             id(store._volumes): {(v.namespace, v.id) for v in volumes},
+            id(store._node_pools): {p.name for p in node_pools},
         }
         for t in store._all_tables:
             keep = new_keys.get(id(t), set())
@@ -128,6 +132,8 @@ def restore_store(store, data: dict) -> None:
             store._variables.put((v.namespace, v.path), v, gen, live)
         for v in volumes:
             store._volumes.put((v.namespace, v.id), v, gen, live)
+        for p in node_pools:
+            store._node_pools.put(p.name, p, gen, live)
         store._next_gen = gen
         store._commit(gen, [("restore", None)])
 

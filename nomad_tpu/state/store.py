@@ -195,6 +195,31 @@ class StateSnapshot:
         """{device_group_id: instances_used, "cores": n} or None."""
         return self._store._node_dev_usage.get(node_id, self.index)
 
+    # --- node pools ---
+
+    def node_pool(self, name: str):
+        """Built-in pools exist implicitly with no overrides
+        (reference structs/node_pool.go built-in pools)."""
+        pool = self._store._node_pools.get(name, self.index)
+        if pool is not None:
+            return pool
+        from ..structs.operator import BUILTIN_NODE_POOLS, NodePool
+
+        if name in BUILTIN_NODE_POOLS:
+            return NodePool(name=name, description="built-in")
+        return None
+
+    def node_pools(self):
+        from ..structs.operator import BUILTIN_NODE_POOLS, NodePool
+
+        seen = set()
+        for name, p in self._store._node_pools.iterate(self.index):
+            seen.add(name)
+            yield p
+        for name in BUILTIN_NODE_POOLS:
+            if name not in seen:
+                yield NodePool(name=name, description="built-in")
+
     # --- volumes ---
 
     def volume_by_id(self, vol_id: str, namespace: str = "default"):
@@ -256,6 +281,7 @@ class StateStore:
         self._acl_secret_idx = VersionedTable("acl_secret_idx")  # secret -> accessor
         self._variables = VersionedTable("variables")           # key (ns, path)
         self._volumes = VersionedTable("volumes")               # key (ns, id)
+        self._node_pools = VersionedTable("node_pools")         # key name
         # derived: per-node summed allocated_vec of usage-counting allocs,
         # maintained on every alloc write so tensorization reads one row
         # per node instead of walking every alloc (the tensor-era form of
@@ -272,7 +298,7 @@ class StateStore:
             self._deployments, self._allocs_by_node, self._allocs_by_job,
             self._allocs_by_eval, self._evals_by_job, self._deployments_by_job,
             self._acl_policies, self._acl_tokens, self._acl_secret_idx,
-            self._variables, self._volumes,
+            self._variables, self._volumes, self._node_pools,
             self._node_usage, self._node_dev_usage,
         ]
         self._listeners: List[Callable[[int, list], None]] = []
@@ -810,6 +836,39 @@ class StateStore:
                 released += len(dead)
             self._commit(gen, events)
             return released
+
+    # --- node pools (reference state_store_node_pools) ---
+
+    def upsert_node_pool(self, pool) -> int:
+        from ..structs.operator import BUILTIN_NODE_POOLS
+
+        with self._write_lock:
+            gen, live = self._begin()
+            prev = self._node_pools.get_latest(pool.name)
+            pool.create_index = prev.create_index if prev is not None else gen
+            pool.modify_index = gen
+            self._node_pools.put(pool.name, pool, gen, live)
+            self._commit(gen, [("node-pool-upsert", pool)])
+            return gen
+
+    def delete_node_pool(self, name: str) -> int:
+        from ..structs.operator import BUILTIN_NODE_POOLS
+
+        if name in BUILTIN_NODE_POOLS:
+            raise ValueError(f"cannot delete built-in node pool {name!r}")
+        with self._write_lock:
+            # a pool with member nodes or jobs must not vanish under them
+            for _, n in self._nodes.iterate(self._index):
+                if n.node_pool == name:
+                    raise ValueError(f"node pool {name!r} has nodes")
+            for _, j in self._jobs.iterate(self._index):
+                if j.node_pool == name and not j.stopped():
+                    raise ValueError(f"node pool {name!r} has jobs")
+            gen, live = self._begin()
+            pool = self._node_pools.get_latest(name)
+            self._node_pools.delete(name, gen, live)
+            self._commit(gen, [("node-pool-delete", pool)])
+            return gen
 
     # --- ACL (reference nomad/state/state_store acl tables) ---
 

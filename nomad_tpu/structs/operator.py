@@ -40,3 +40,49 @@ class SchedulerConfiguration:
             enums.JOB_TYPE_SYSTEM: self.preemption_config.system_scheduler_enabled,
             enums.JOB_TYPE_SYSBATCH: self.preemption_config.sysbatch_scheduler_enabled,
         }.get(sched_type, False)
+
+    def with_node_pool(self, pool: "NodePool" | None) -> "SchedulerConfiguration":
+        """Effective configuration for a job in `pool` (reference
+        structs/operator.go SchedulerConfig.WithNodePool, applied at
+        generic_sched.go:737-752): the pool's overrides win where set."""
+        if pool is None or pool.scheduler_configuration is None:
+            return self
+        ov = pool.scheduler_configuration
+        out = SchedulerConfiguration(
+            scheduler_algorithm=(ov.scheduler_algorithm
+                                 or self.scheduler_algorithm),
+            preemption_config=self.preemption_config,
+            memory_oversubscription_enabled=(
+                self.memory_oversubscription_enabled
+                if ov.memory_oversubscription_enabled is None
+                else ov.memory_oversubscription_enabled),
+            reject_job_registration=self.reject_job_registration,
+            pause_eval_broker=self.pause_eval_broker,
+        )
+        return out
+
+
+@dataclass(slots=True)
+class NodePoolSchedulerConfiguration:
+    """Per-pool overrides; None = inherit the cluster value
+    (reference structs/node_pool.go NodePoolSchedulerConfiguration)."""
+
+    scheduler_algorithm: str = ""
+    memory_oversubscription_enabled: bool | None = None
+
+
+@dataclass(slots=True)
+class NodePool:
+    """A named partition of nodes with optional scheduling overrides
+    (reference structs/node_pool.go NodePool). The built-in pools
+    "default" and "all" always exist and carry no overrides."""
+
+    name: str = ""
+    description: str = ""
+    meta: Dict[str, str] = field(default_factory=dict)
+    scheduler_configuration: NodePoolSchedulerConfiguration | None = None
+    create_index: int = 0
+    modify_index: int = 0
+
+
+BUILTIN_NODE_POOLS = (enums.NODE_POOL_DEFAULT, enums.NODE_POOL_ALL)

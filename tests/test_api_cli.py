@@ -274,3 +274,83 @@ def test_cli_job_flow(stack, tmp_path, capsys):
 
     rc = main(["--address", agent.address, "job", "stop", "cli-demo"])
     assert rc == 0
+
+
+class TestJobspecVariables:
+    """jobspec2-style variables/locals/functions (reference jobspec2/)."""
+
+    SPEC = '''
+variable "replicas" { default = 3 }
+variable "image_cmd" { default = "/bin/date" }
+variable "team" {}
+locals {
+  full_name = "${var.team}-web"
+  shout = "${upper(var.team)}"
+}
+job "templated" {
+  datacenters = ["dc1"]
+  meta {
+    owner = local.full_name
+    loud = "${local.shout}"
+    banner = "${format("run by %v on %v", var.team, "dc1")}"
+  }
+  group "web" {
+    count = var.replicas
+    task "srv" {
+      driver = "mock"
+      config { command = var.image_cmd }
+      resources { cpu = 100 memory = 64 }
+    }
+  }
+}
+'''
+
+    def test_variables_locals_functions(self):
+        from nomad_tpu.api.jobspec import parse_hcl_like
+
+        job = parse_hcl_like(self.SPEC, variables={"team": "infra"})
+        assert job.task_groups[0].count == 3
+        assert job.meta["owner"] == "infra-web"
+        assert job.meta["loud"] == "INFRA"
+        assert job.meta["banner"] == "run by infra on dc1"
+        assert job.task_groups[0].tasks[0].config["command"] == "/bin/date"
+
+    def test_override_and_env(self, monkeypatch):
+        from nomad_tpu.api.jobspec import parse_hcl_like
+
+        monkeypatch.setenv("NOMAD_VAR_team", "ops")
+        job = parse_hcl_like(self.SPEC)
+        assert job.meta["owner"] == "ops-web"
+        # explicit -var beats the environment
+        job2 = parse_hcl_like(self.SPEC, variables={"team": "x",
+                                                    "replicas": 5})
+        assert job2.meta["owner"] == "x-web"
+        assert job2.task_groups[0].count == 5
+
+    def test_missing_variable_errors(self):
+        import pytest
+
+        from nomad_tpu.api.jobspec import parse_hcl_like
+
+        with pytest.raises(ValueError, match="without a value"):
+            parse_hcl_like(self.SPEC)
+
+    def test_runtime_interpolations_pass_through(self):
+        from nomad_tpu.api.jobspec import parse_hcl_like
+
+        spec = '''
+job "rt" {
+  datacenters = ["dc1"]
+  group "g" {
+    constraint { attribute = "${attr.kernel.name}" value = "linux" }
+    task "t" {
+      driver = "mock"
+      env { NODE = "${node.unique.name}" }
+      resources { cpu = 100 memory = 64 }
+    }
+  }
+}
+'''
+        job = parse_hcl_like(spec)
+        assert job.task_groups[0].constraints[0].ltarget == "${attr.kernel.name}"
+        assert job.task_groups[0].tasks[0].env["NODE"] == "${node.unique.name}"

@@ -1,0 +1,159 @@
+"""Node pools + per-pool scheduler-config overrides (reference
+structs/node_pool.go, nomad/node_pool_endpoint.go, and
+SchedulerConfig.WithNodePool applied at generic_sched.go:737-752)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.structs import enums
+from nomad_tpu.structs.operator import (NodePool,
+                                        NodePoolSchedulerConfiguration,
+                                        SchedulerConfiguration)
+from nomad_tpu.testing import Harness
+
+
+class TestWithNodePool:
+    def test_overrides_win_where_set(self):
+        base = SchedulerConfiguration(
+            scheduler_algorithm=enums.SCHED_ALG_BINPACK)
+        pool = NodePool(name="gpu", scheduler_configuration=
+                        NodePoolSchedulerConfiguration(
+                            scheduler_algorithm=enums.SCHED_ALG_SPREAD,
+                            memory_oversubscription_enabled=True))
+        eff = base.with_node_pool(pool)
+        assert eff.scheduler_algorithm == enums.SCHED_ALG_SPREAD
+        assert eff.memory_oversubscription_enabled is True
+        # unset overrides inherit
+        plain = NodePool(name="plain", scheduler_configuration=
+                         NodePoolSchedulerConfiguration())
+        eff2 = base.with_node_pool(plain)
+        assert eff2.scheduler_algorithm == enums.SCHED_ALG_BINPACK
+        # no overrides at all -> same object
+        assert base.with_node_pool(NodePool(name="x")) is base
+        assert base.with_node_pool(None) is base
+
+
+class TestStore:
+    def test_builtin_pools_implicit(self):
+        h = Harness()
+        snap = h.store.snapshot()
+        assert snap.node_pool("default") is not None
+        assert snap.node_pool("all") is not None
+        assert snap.node_pool("nope") is None
+        names = {p.name for p in snap.node_pools()}
+        assert {"default", "all"} <= names
+
+    def test_delete_guards(self):
+        h = Harness()
+        pool = NodePool(name="gpu")
+        h.store.upsert_node_pool(pool)
+        n = mock.node()
+        n.node_pool = "gpu"
+        h.store.upsert_node(n)
+        with pytest.raises(ValueError, match="has nodes"):
+            h.store.delete_node_pool("gpu")
+        h.store.delete_node(n.id)
+        h.store.delete_node_pool("gpu")
+        assert h.store.snapshot().node_pool("gpu") is None
+        with pytest.raises(ValueError, match="built-in"):
+            h.store.delete_node_pool("default")
+
+
+class TestSchedulerOverride:
+    def _cluster(self, pool_name):
+        """Two nodes, one carrying load: BestFit picks the loaded one,
+        WorstFit the empty one — a deterministic algorithm probe."""
+        h = Harness()
+        loaded, empty = mock.node(), mock.node()
+        for n in (loaded, empty):
+            n.node_pool = pool_name
+            n.compute_class()
+            h.store.upsert_node(n)
+        filler = mock.job()
+        h.store.upsert_job(filler)
+        a = mock.alloc(filler, loaded, index=0)
+        h.store.upsert_allocs([a])
+        return h, loaded, empty
+
+    def _place_one(self, h, pool_name):
+        j = mock.job()
+        j.node_pool = pool_name
+        j.task_groups[0].count = 1
+        h.store.upsert_job(j)
+        h.process(mock.eval_for(j), sched_config=SchedulerConfiguration(
+            scheduler_algorithm=enums.SCHED_ALG_BINPACK))
+        allocs = [x for x in h.store.snapshot().allocs_by_job(j.id)
+                  if not x.terminal_status()]
+        assert len(allocs) == 1
+        return allocs[0].node_id
+
+    def test_pool_algorithm_override_applies(self):
+        h, loaded, empty = self._cluster("spready")
+        h.store.upsert_node_pool(NodePool(
+            name="spready", scheduler_configuration=
+            NodePoolSchedulerConfiguration(
+                scheduler_algorithm=enums.SCHED_ALG_SPREAD)))
+        # cluster config says binpack; the pool override flips to spread
+        assert self._place_one(h, "spready") == empty.id
+
+    def test_default_pool_binpacks(self):
+        h, loaded, empty = self._cluster("default")
+        assert self._place_one(h, "default") == loaded.id
+
+
+class TestHTTP:
+    def test_pool_crud_roundtrip(self):
+        from nomad_tpu.api.http import HTTPAgent
+
+        srv = Server(ServerConfig(num_workers=0, heartbeat_ttl=3600,
+                                  gc_interval=3600))
+        with srv, HTTPAgent(srv, port=0) as agent:
+            r = urllib.request.Request(
+                f"{agent.address}/v1/node/pool/gpu", method="POST",
+                data=json.dumps({"description": "gpu nodes",
+                                 "scheduler_configuration": {
+                                     "scheduler_algorithm": "spread"}}).encode())
+            urllib.request.urlopen(r, timeout=10)
+            pools = json.loads(urllib.request.urlopen(
+                f"{agent.address}/v1/node/pools", timeout=10).read())
+            assert {p["name"] for p in pools} >= {"default", "all", "gpu"}
+            got = json.loads(urllib.request.urlopen(
+                f"{agent.address}/v1/node/pool/gpu", timeout=10).read())
+            assert got["scheduler_configuration"]["scheduler_algorithm"] \
+                == "spread"
+            r2 = urllib.request.Request(
+                f"{agent.address}/v1/node/pool/gpu", method="DELETE")
+            urllib.request.urlopen(r2, timeout=10)
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"{agent.address}/v1/node/pool/gpu", timeout=10)
+
+    def test_http_registered_pool_schedules(self):
+        """Regression: a pool registered over HTTP (from_dict inflation
+        of the nested override dataclass) must not crash evaluation."""
+        from nomad_tpu.api.http import HTTPAgent
+
+        srv = Server(ServerConfig(num_workers=2, heartbeat_ttl=3600,
+                                  gc_interval=3600))
+        with srv, HTTPAgent(srv, port=0) as agent:
+            r = urllib.request.Request(
+                f"{agent.address}/v1/node/pool/gpu", method="POST",
+                data=json.dumps({"scheduler_configuration": {
+                    "scheduler_algorithm": "spread"}}).encode())
+            urllib.request.urlopen(r, timeout=10)
+            n = mock.node()
+            n.node_pool = "gpu"
+            n.compute_class()
+            srv.register_node(n)
+            j = mock.job()
+            j.node_pool = "gpu"
+            j.task_groups[0].count = 1
+            srv.register_job(j)
+            assert srv.wait_for_idle(15.0)
+            allocs = [a for a in srv.store.snapshot().allocs_by_job(j.id)
+                      if not a.terminal_status()]
+            assert len(allocs) == 1
