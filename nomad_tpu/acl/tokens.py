@@ -23,21 +23,38 @@ class AclToken:
     name: str = ""
     type: str = TOKEN_TYPE_CLIENT
     policies: List[str] = field(default_factory=list)
+    # named roles: each expands to its policy set at resolution time
+    # (reference structs ACLRole + ACLToken.Roles)
+    roles: List[str] = field(default_factory=list)
     global_: bool = False
     create_time: float = 0.0
     modify_index: int = 0
 
     @classmethod
     def new(cls, name: str, token_type: str = TOKEN_TYPE_CLIENT,
-            policies: List[str] = ()) -> "AclToken":
+            policies: List[str] = (), roles: List[str] = ()) -> "AclToken":
         return cls(
             accessor_id=generate_uuid(),
             secret_id=generate_uuid(),
             name=name,
             type=token_type,
             policies=list(policies),
+            roles=list(roles),
         )
 
     @property
     def is_management(self) -> bool:
         return self.type == TOKEN_TYPE_MANAGEMENT
+
+
+@dataclass
+class AclRole:
+    """A named bundle of policies tokens can reference (reference
+    structs ACLRole, nomad/acl_endpoint.go UpsertRoles). Editing the
+    role re-scopes every token holding it on their next resolution."""
+
+    name: str = ""
+    description: str = ""
+    policies: List[str] = field(default_factory=list)
+    create_index: int = 0
+    modify_index: int = 0

@@ -243,8 +243,16 @@ class HTTPAgent:
         if path == "/v1/acl/tokens":
             return h._reply(200, [
                 {"accessor_id": t.accessor_id, "name": t.name,
-                 "type": t.type, "policies": t.policies}
+                 "type": t.type, "policies": t.policies,
+                 "roles": getattr(t, "roles", [])}
                 for t in snap.acl_tokens()])
+        if path == "/v1/acl/roles":
+            return h._reply(200, list(snap.acl_roles()))
+        if m := re.fullmatch(r"/v1/acl/role/([^/]+)", path):
+            role = snap.acl_role(m.group(1))
+            if role is None:
+                return h._error(404, "role not found")
+            return h._reply(200, role)
 
         # list endpoints span namespaces, so the coarse per-route gate above
         # is not enough: filter rows to namespaces the token can read, and
@@ -465,11 +473,23 @@ class HTTPAgent:
                 body.get("description", ""))
             return h._reply(200, {"ok": True})
         if path == "/v1/acl/token":
-            token = self.writer.create_acl_token(
-                body.get("name", ""), body.get("policies", []),
-                body.get("type", "client"))
+            try:
+                token = self.writer.create_acl_token(
+                    body.get("name", ""), body.get("policies", []),
+                    body.get("type", "client"),
+                    roles=body.get("roles", []))
+            except ValueError as e:
+                return h._error(400, str(e))
             return h._reply(200, {"accessor_id": token.accessor_id,
                                   "secret_id": token.secret_id})
+        if m := re.fullmatch(r"/v1/acl/role/([^/]+)", path):
+            try:
+                self.writer.upsert_acl_role(
+                    m.group(1), body.get("policies", []),
+                    body.get("description", ""))
+            except ValueError as e:
+                return h._error(400, str(e))
+            return h._reply(200, {"ok": True})
         if m := re.fullmatch(r"/v1/var/(.+)", path):
             self.writer.put_variable(m.group(1), body.get("items", {}), ns)
             return h._reply(200, {"ok": True})
@@ -518,7 +538,9 @@ class HTTPAgent:
             try:
                 eval_id = self.writer.scale_job(
                     m.group(1), body.get("task_group", ""),
-                    int(body.get("count") or -1), namespace=ns)
+                    int(body.get("count")
+                        if body.get("count") is not None else -1),
+                    namespace=ns)
             except KeyError:
                 return h._error(404, "job not found")
             except (ValueError, TypeError) as e:
@@ -620,6 +642,11 @@ class HTTPAgent:
                 self.writer.delete_node_pool(m.group(1))
             except ValueError as e:
                 return h._error(409, str(e))
+            return h._reply(200, {"ok": True})
+        if m := re.fullmatch(r"/v1/acl/role/([^/]+)", path):
+            if acl is not None and not acl.management:
+                return h._error(403, "Permission denied")
+            self.writer.delete_acl_role(m.group(1))
             return h._reply(200, {"ok": True})
         if m := re.fullmatch(r"/v1/volume/csi/([^/]+)", path):
             if not self._ns_allowed(acl, ns, aclp.CAP_SUBMIT_JOB):

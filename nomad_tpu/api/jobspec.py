@@ -125,7 +125,15 @@ def _unquote(raw: str) -> str:
     while i < len(body):
         c = body[i]
         if c == "\\" and i + 1 < len(body):
-            out.append(esc.get(body[i + 1], body[i + 1]))
+            nxt = body[i + 1]
+            if nxt == "u" and i + 5 < len(body):
+                try:
+                    out.append(chr(int(body[i + 2:i + 6], 16)))
+                    i += 6
+                    continue
+                except ValueError:
+                    pass
+            out.append(esc.get(nxt, nxt))
             i += 2
         else:
             out.append(c)
@@ -355,15 +363,19 @@ _FUNCTIONS = {
 
 def _go_format(fmt: str, args) -> str:
     """Tiny %v-style formatter (the jobspec2 format() surface): each
-    argument binds to the LEFTMOST remaining verb, whatever its kind."""
+    argument binds to the leftmost remaining verb; substituted text is
+    never rescanned, so argument values containing %v/%s/%d are safe."""
     out = fmt
+    pos = 0
     for a in args:
-        hits = [i for i in (out.find(s) for s in ("%v", "%s", "%d"))
+        hits = [i for i in (out.find(s, pos) for s in ("%v", "%s", "%d"))
                 if i >= 0]
         if not hits:
             break
         idx = min(hits)
-        out = out[:idx] + str(a) + out[idx + 2:]
+        rep = str(a)
+        out = out[:idx] + rep + out[idx + 2:]
+        pos = idx + len(rep)
     return out
 
 

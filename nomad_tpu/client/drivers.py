@@ -246,10 +246,13 @@ def _read_status_file(path: str) -> Optional[dict]:
         return None
 
 
-def _status_to_result(path: str, missing_err: str) -> ExitResult:
+def _status_to_result(path: str, missing_err: str,
+                      st: Optional[dict] = None) -> ExitResult:
     """Final exit status from the executor's status file — the single
-    reader the live, recovered, and restore paths all share."""
-    st = _read_status_file(path)
+    reader the live, recovered, and restore paths all share. Callers
+    that already read the file pass the dict to avoid a re-read race."""
+    if st is None:
+        st = _read_status_file(path)
     if st is None or "exit_code" not in st:
         return ExitResult(exit_code=1, err=missing_err)
     return ExitResult(exit_code=int(st.get("exit_code", 1)),
@@ -445,7 +448,7 @@ class RawExecDriver:
         if status_file:
             st = _read_status_file(status_file)
             if st is not None and "exit_code" in st:
-                return _FinishedHandle(_status_to_result(status_file, ""))
+                return _FinishedHandle(_status_to_result(status_file, "", st))
         return None
 
     def healthy(self) -> bool:

@@ -362,6 +362,15 @@ class Server:
         operator_endpoint.go — replication TODO)."""
         self.sched_config = cfg
         self.config.sched_config = cfg
+        # pause/resume the broker (reference operator.go PauseEvalBroker):
+        # disabling flushes the in-memory queues, so resuming restores
+        # pending evals from replicated state exactly like a leadership
+        # transition does (leader.go:389-403)
+        if self._running:
+            was = self.broker.enabled
+            self.broker.set_enabled(not cfg.pause_eval_broker)
+            if not was and not cfg.pause_eval_broker:
+                self._restore_evals()
 
     def _create_job_eval(self, job: Job, trigger: str,
                          namespace: Optional[str] = None) -> str:
@@ -772,17 +781,38 @@ class Server:
         self.store.upsert_acl_policy(policy)
         return policy
 
-    def create_acl_token(self, name: str, policies, token_type: str = "client"):
+    def create_acl_token(self, name: str, policies, token_type: str = "client",
+                         roles=()):
         from ..acl.tokens import AclToken
 
         snap = self.store.snapshot()
         for p in policies:
             if snap.acl_policy(p) is None:
                 raise ValueError(f"unknown policy {p!r}")
-        token = AclToken.new(name, token_type, policies)
+        for r in roles:
+            if snap.acl_role(r) is None:
+                raise ValueError(f"unknown role {r!r}")
+        token = AclToken.new(name, token_type, policies, roles)
         token.create_time = time.time()
         self.store.upsert_acl_token(token)
         return token
+
+    def upsert_acl_role(self, name: str, policies, description: str = ""):
+        """ACL.UpsertRoles (reference nomad/acl_endpoint.go): a role
+        bundles policies; tokens referencing it re-scope live."""
+        from ..acl.tokens import AclRole
+
+        snap = self.store.snapshot()
+        for p in policies:
+            if snap.acl_policy(p) is None:
+                raise ValueError(f"unknown policy {p!r}")
+        role = AclRole(name=name, policies=list(policies),
+                       description=description)
+        self.store.upsert_acl_role(role)
+        return role
+
+    def delete_acl_role(self, name: str) -> None:
+        self.store.delete_acl_role(name)
 
     def resolve_token(self, secret_id: str):
         """secret -> compiled ACL (reference nomad/auth/auth.go)."""
@@ -796,7 +826,12 @@ class Server:
             raise PermissionError("token not found")
         if token.is_management:
             return ACL(management=True)
-        policies = [snap.acl_policy(p) for p in token.policies]
+        names = list(token.policies)
+        for role_name in getattr(token, "roles", ()):
+            role = snap.acl_role(role_name)
+            if role is not None:
+                names.extend(role.policies)
+        policies = [snap.acl_policy(p) for p in dict.fromkeys(names)]
         return compile_acl([p for p in policies if p is not None])
 
     # -- variables endpoints (nomad/variables_endpoint.go) --

@@ -29,6 +29,7 @@ MUTATIONS = {
     "upsert_deployment", "update_deployment_status", "delete_deployment",
     "upsert_acl_policy", "delete_acl_policy",
     "upsert_acl_token", "delete_acl_token",
+    "upsert_acl_role", "delete_acl_role",
     "upsert_variable", "delete_variable",
     "upsert_volume", "delete_volume", "reap_volume_claims",
     "upsert_node_pool", "delete_node_pool",

@@ -32,6 +32,7 @@ def dump_store(store) -> dict:
             "deployments": [wire_encode(d) for d in snap.deployments()],
             "acl_policies": [wire_encode(p) for p in snap.acl_policies()],
             "acl_tokens": [wire_encode(t) for t in snap.acl_tokens()],
+            "acl_roles": [wire_encode(r) for r in snap.acl_roles()],
             "variables": [wire_encode(v)
                           for _, v in store._variables.iterate(snap.index)],
             "volumes": [wire_encode(v)
@@ -55,6 +56,7 @@ def restore_store(store, data: dict) -> None:
     deployments = [wire_decode(x) for x in data.get("deployments", [])]
     policies = [wire_decode(x) for x in data.get("acl_policies", [])]
     tokens = [wire_decode(x) for x in data.get("acl_tokens", [])]
+    roles = [wire_decode(x) for x in data.get("acl_roles", [])]
     variables = [wire_decode(x) for x in data.get("variables", [])]
     volumes = [wire_decode(x) for x in data.get("volumes", [])]
     node_pools = [wire_decode(x) for x in data.get("node_pools", [])]
@@ -81,6 +83,7 @@ def restore_store(store, data: dict) -> None:
             id(store._acl_policies): {p.name for p in policies},
             id(store._acl_tokens): {t.accessor_id for t in tokens},
             id(store._acl_secret_idx): {t.secret_id for t in tokens},
+            id(store._acl_roles): {r.name for r in roles},
             id(store._variables): {(v.namespace, v.path) for v in variables},
             id(store._volumes): {(v.namespace, v.id) for v in volumes},
             id(store._node_pools): {p.name for p in node_pools},
@@ -128,6 +131,8 @@ def restore_store(store, data: dict) -> None:
         for t in tokens:
             store._acl_tokens.put(t.accessor_id, t, gen, live)
             store._acl_secret_idx.put(t.secret_id, t.accessor_id, gen, live)
+        for r in roles:
+            store._acl_roles.put(r.name, r, gen, live)
         for v in variables:
             store._variables.put((v.namespace, v.path), v, gen, live)
         for v in volumes:

@@ -176,6 +176,12 @@ class StateSnapshot:
     def acl_tokens(self):
         return (t for _, t in self._store._acl_tokens.iterate(self.index))
 
+    def acl_role(self, name: str):
+        return self._store._acl_roles.get(name, self.index)
+
+    def acl_roles(self):
+        return (r for _, r in self._store._acl_roles.iterate(self.index))
+
     def variable(self, path: str, namespace: str = "default"):
         return self._store._variables.get((namespace, path), self.index)
 
@@ -279,6 +285,7 @@ class StateStore:
         self._acl_policies = VersionedTable("acl_policies")     # key name
         self._acl_tokens = VersionedTable("acl_tokens")         # key accessor id
         self._acl_secret_idx = VersionedTable("acl_secret_idx")  # secret -> accessor
+        self._acl_roles = VersionedTable("acl_roles")           # key name
         self._variables = VersionedTable("variables")           # key (ns, path)
         self._volumes = VersionedTable("volumes")               # key (ns, id)
         self._node_pools = VersionedTable("node_pools")         # key name
@@ -298,6 +305,7 @@ class StateStore:
             self._deployments, self._allocs_by_node, self._allocs_by_job,
             self._allocs_by_eval, self._evals_by_job, self._deployments_by_job,
             self._acl_policies, self._acl_tokens, self._acl_secret_idx,
+            self._acl_roles,
             self._variables, self._volumes, self._node_pools,
             self._node_usage, self._node_dev_usage,
         ]
@@ -842,6 +850,10 @@ class StateStore:
     def upsert_node_pool(self, pool) -> int:
         from ..structs.operator import BUILTIN_NODE_POOLS
 
+        if pool.name in BUILTIN_NODE_POOLS:
+            # enforced here as well as at the endpoint so the FSM apply
+            # path can't rewrite the implicit pools either
+            raise ValueError(f"cannot modify built-in node pool {pool.name!r}")
         with self._write_lock:
             gen, live = self._begin()
             prev = self._node_pools.get_latest(pool.name)
@@ -886,6 +898,24 @@ class StateStore:
             pol = self._acl_policies.get_latest(name)
             self._acl_policies.delete(name, gen, live)
             self._commit(gen, [("acl-policy-delete", pol)])
+            return gen
+
+    def upsert_acl_role(self, role) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            prev = self._acl_roles.get_latest(role.name)
+            role.create_index = prev.create_index if prev is not None else gen
+            role.modify_index = gen
+            self._acl_roles.put(role.name, role, gen, live)
+            self._commit(gen, [("acl-role-upsert", role)])
+            return gen
+
+    def delete_acl_role(self, name: str) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            role = self._acl_roles.get_latest(name)
+            self._acl_roles.delete(name, gen, live)
+            self._commit(gen, [("acl-role-delete", role)])
             return gen
 
     def upsert_acl_token(self, token) -> int:

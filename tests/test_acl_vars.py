@@ -306,3 +306,74 @@ class TestAdviceRegressions:
                               f"/v1/deployment/fail/{dep.id}?namespace=default",
                               {})
         assert err.value.status == 403
+
+
+class TestAclRoles:
+    """ACL roles: named policy bundles (reference structs ACLRole +
+    acl_endpoint.go UpsertRoles)."""
+
+    def _server(self):
+        from nomad_tpu.core import Server, ServerConfig
+
+        srv = Server(ServerConfig(num_workers=0, acl_enabled=True,
+                                  heartbeat_ttl=3600, gc_interval=3600))
+        srv.start()
+        return srv
+
+    def test_role_expands_to_policies(self):
+        srv = self._server()
+        try:
+            srv.acl_bootstrap()
+            srv.upsert_acl_policy("readers", {
+                "namespace": {"default": {
+                    "capabilities": ["read-job", "list-jobs"]}}})
+            srv.upsert_acl_policy("writers", {
+                "namespace": {"default": {"capabilities": ["submit-job"]}}})
+            srv.upsert_acl_role("dev", ["readers", "writers"],
+                                "developer bundle")
+            token = srv.create_acl_token("d", [], roles=["dev"])
+            acl = srv.resolve_token(token.secret_id)
+            from nomad_tpu.acl import policy as aclp
+
+            assert acl.allow_namespace_operation("default", aclp.CAP_READ_JOB)
+            assert acl.allow_namespace_operation("default", aclp.CAP_SUBMIT_JOB)
+            assert not acl.allow_namespace_operation("other", aclp.CAP_READ_JOB)
+
+            # editing the role re-scopes the token live
+            srv.upsert_acl_role("dev", ["readers"])
+            acl2 = srv.resolve_token(token.secret_id)
+            assert not acl2.allow_namespace_operation("default",
+                                                      aclp.CAP_SUBMIT_JOB)
+            assert acl2.allow_namespace_operation("default", aclp.CAP_READ_JOB)
+        finally:
+            srv.stop()
+
+    def test_unknown_role_and_policy_rejected(self):
+        import pytest
+
+        srv = self._server()
+        try:
+            srv.acl_bootstrap()
+            with pytest.raises(ValueError, match="unknown role"):
+                srv.create_acl_token("x", [], roles=["nope"])
+            with pytest.raises(ValueError, match="unknown policy"):
+                srv.upsert_acl_role("r", ["nope"])
+        finally:
+            srv.stop()
+
+    def test_roles_survive_dump_restore(self):
+        srv = self._server()
+        try:
+            srv.acl_bootstrap()
+            srv.upsert_acl_policy("readers", {
+                "namespace": {"default": {"capabilities": ["read-job"]}}})
+            srv.upsert_acl_role("dev", ["readers"])
+            data = srv.store.dump()
+            from nomad_tpu.state import StateStore
+
+            fresh = StateStore()
+            fresh.restore_dump(data)
+            role = fresh.snapshot().acl_role("dev")
+            assert role is not None and role.policies == ["readers"]
+        finally:
+            srv.stop()
