@@ -39,11 +39,22 @@ def _p(obj) -> None:
 # -- agent -------------------------------------------------------------------
 
 
+AGENT_FLAG_DEFAULTS = {"data_dir": "", "port": 4646, "workers": 2,
+                       "algorithm": "binpack", "server_id": "server-0",
+                       "peers": "", "clients": 1}
+
+
 def cmd_agent(args) -> int:
     from .api.http import HTTPAgent
     from .client import Client, ClientConfig
     from .core import Server, ServerConfig
     from .structs.operator import SchedulerConfiguration
+
+    if args.config:
+        from .agent_config import apply_to_args, load_agent_config
+
+        file_cfg = load_agent_config(args.config)
+        apply_to_args(file_cfg, args, AGENT_FLAG_DEFAULTS)
 
     cfg = ServerConfig(
         num_workers=args.workers,
@@ -91,10 +102,31 @@ def cmd_agent(args) -> int:
           + (f" server-id={args.server_id}" if replicated else "") + ")",
           flush=True)
     stop = []
+    reload_req = []
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    if args.config and hasattr(signal, "SIGHUP"):
+        signal.signal(signal.SIGHUP, lambda *a: reload_req.append(1))
     try:
         while not stop:
+            if reload_req:
+                reload_req.clear()
+                # live reload (reference agent.go:1360): the scheduler
+                # configuration is the hot-swappable subset
+                try:
+                    from .agent_config import load_agent_config
+
+                    fc = load_agent_config(args.config)
+                    if fc.algorithm:
+                        from .structs.operator import SchedulerConfiguration
+
+                        target = replicated if replicated is not None else server
+                        target.set_scheduler_config(SchedulerConfiguration(
+                            scheduler_algorithm=fc.algorithm))
+                        print(f"config reloaded: algorithm={fc.algorithm}",
+                              flush=True)
+                except Exception as e:
+                    print(f"config reload failed: {e}", flush=True)
             time.sleep(0.2)
     finally:
         http_agent.stop()
@@ -329,6 +361,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     ag = sub.add_parser("agent", help="run an agent (server+clients+http)")
     ag.add_argument("-dev", action="store_true", dest="dev")
+    ag.add_argument("-config", "--config", default="",
+                    help="agent config file (HCL-shaped or .json); "
+                         "flags override file values; SIGHUP reloads")
     ag.add_argument("--clients", type=int, default=1)
     ag.add_argument("--workers", type=int, default=2)
     ag.add_argument("--port", type=int, default=4646)

@@ -1,0 +1,123 @@
+"""Agent config files + SIGHUP reload (reference command/agent/config.go
++ agent.go:1360 Reload)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from nomad_tpu.agent_config import (AgentFileConfig, apply_to_args,
+                                    load_agent_config, parse_agent_config)
+
+REPO = Path(__file__).resolve().parent.parent
+
+HCL = '''
+data_dir  = "/tmp/agent-x"
+http_port = 14747
+
+server {
+  enabled   = true
+  workers   = 3
+  algorithm = "spread"
+}
+
+client {
+  enabled = true
+  count   = 2
+}
+'''
+
+
+class TestParse:
+    def test_hcl_shape(self):
+        cfg = parse_agent_config(HCL)
+        assert cfg.data_dir == "/tmp/agent-x"
+        assert cfg.http_port == 14747
+        assert cfg.workers == 3
+        assert cfg.algorithm == "spread"
+        assert cfg.client_count == 2
+
+    def test_json_shape(self):
+        cfg = parse_agent_config(json.dumps({
+            "http_port": 1, "server": {"workers": 9},
+            "client": {"enabled": False}}), "agent.json")
+        assert cfg.http_port == 1 and cfg.workers == 9
+        assert cfg.client_enabled is False
+
+    def test_flags_override_file(self):
+        import argparse
+
+        defaults = {"data_dir": "", "port": 4646, "workers": 2,
+                    "algorithm": "binpack", "server_id": "server-0",
+                    "peers": "", "clients": 1}
+        args = argparse.Namespace(**{k: v for k, v in defaults.items()})
+        args.workers = 8  # user passed --workers 8
+        cfg = parse_agent_config(HCL)
+        apply_to_args(cfg, args, defaults)
+        assert args.workers == 8          # flag wins
+        assert args.port == 14747         # file beats built-in default
+        assert args.algorithm == "spread"
+        assert args.clients == 2
+
+
+@pytest.mark.slow
+class TestReload:
+    def test_agent_boots_from_file_and_reloads_on_sighup(self, tmp_path):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        conf = tmp_path / "agent.hcl"
+        conf.write_text(f'''
+data_dir = "{tmp_path}/data"
+http_port = {port}
+server {{ workers = 1 algorithm = "binpack" }}
+client {{ count = 0 }}
+''')
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+        log = open(tmp_path / "agent.log", "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "nomad_tpu", "agent",
+             "-config", str(conf)],
+            env=env, cwd=str(REPO), stdout=log, stderr=subprocess.STDOUT)
+        try:
+            deadline = time.time() + 60
+            addr = f"http://127.0.0.1:{port}"
+            cfg = None
+            while time.time() < deadline:
+                try:
+                    cfg = json.loads(urllib.request.urlopen(
+                        f"{addr}/v1/operator/scheduler/configuration",
+                        timeout=2).read())
+                    break
+                except Exception:
+                    time.sleep(0.3)
+            assert cfg is not None, "agent never served HTTP on the file port"
+            assert cfg["scheduler_algorithm"] == "binpack"
+
+            conf.write_text(conf.read_text().replace('"binpack"', '"spread"'))
+            proc.send_signal(signal.SIGHUP)
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                cfg = json.loads(urllib.request.urlopen(
+                    f"{addr}/v1/operator/scheduler/configuration",
+                    timeout=2).read())
+                if cfg["scheduler_algorithm"] == "spread":
+                    break
+                time.sleep(0.3)
+            assert cfg["scheduler_algorithm"] == "spread", \
+                "SIGHUP did not apply the new algorithm"
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            log.close()
