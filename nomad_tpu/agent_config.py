@@ -98,7 +98,15 @@ def apply_to_args(cfg: AgentFileConfig, args, parser_defaults: Dict) -> None:
     maybe("algorithm", cfg.algorithm)
     maybe("server_id", cfg.server_id)
     maybe("peers", cfg.peers)
-    if cfg.client_count is not None:
-        maybe("clients", cfg.client_count)
     if not cfg.client_enabled:
-        args.clients = 0
+        # still subject to "flags win": an explicit --clients N beats it
+        maybe("clients", 0)
+    elif cfg.client_count is not None:
+        maybe("clients", cfg.client_count)
+    if not cfg.server_enabled:
+        # a client-only agent needs a remote-server transport the client
+        # doesn't speak yet; fail loudly instead of ignoring the stanza
+        raise ValueError(
+            "server { enabled = false } is not supported yet: every agent "
+            "runs an embedded server (client-only agents need the RPC "
+            "client transport)")

@@ -39,9 +39,8 @@ def _p(obj) -> None:
 # -- agent -------------------------------------------------------------------
 
 
-AGENT_FLAG_DEFAULTS = {"data_dir": "", "port": 4646, "workers": 2,
-                       "algorithm": "binpack", "server_id": "server-0",
-                       "peers": "", "clients": 1}
+AGENT_FLAG_KEYS = ("data_dir", "port", "workers", "algorithm",
+                   "server_id", "peers", "clients")
 
 
 def cmd_agent(args) -> int:
@@ -54,7 +53,13 @@ def cmd_agent(args) -> int:
         from .agent_config import apply_to_args, load_agent_config
 
         file_cfg = load_agent_config(args.config)
-        apply_to_args(file_cfg, args, AGENT_FLAG_DEFAULTS)
+        # defaults come from the parser itself (by parsing a bare
+        # `agent` invocation — subparser defaults are invisible to the
+        # top-level get_default) so the merge can't drift from the
+        # declared flag defaults
+        defaults_ns = build_parser().parse_args(["agent"])
+        defaults = {k: getattr(defaults_ns, k) for k in AGENT_FLAG_KEYS}
+        apply_to_args(file_cfg, args, defaults)
 
     cfg = ServerConfig(
         num_workers=args.workers,
@@ -114,15 +119,19 @@ def cmd_agent(args) -> int:
                 # live reload (reference agent.go:1360): the scheduler
                 # configuration is the hot-swappable subset
                 try:
+                    import copy as _copy
+
                     from .agent_config import load_agent_config
 
                     fc = load_agent_config(args.config)
                     if fc.algorithm:
-                        from .structs.operator import SchedulerConfiguration
-
+                        # mutate only the algorithm on a copy of the
+                        # CURRENT config: a reload must not reset
+                        # operator-set fields (pause, preemption, ...)
+                        new_cfg = _copy.deepcopy(server.sched_config)
+                        new_cfg.scheduler_algorithm = fc.algorithm
                         target = replicated if replicated is not None else server
-                        target.set_scheduler_config(SchedulerConfiguration(
-                            scheduler_algorithm=fc.algorithm))
+                        target.set_scheduler_config(new_cfg)
                         print(f"config reloaded: algorithm={fc.algorithm}",
                               flush=True)
                 except Exception as e:

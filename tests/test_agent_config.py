@@ -54,9 +54,10 @@ class TestParse:
     def test_flags_override_file(self):
         import argparse
 
-        defaults = {"data_dir": "", "port": 4646, "workers": 2,
-                    "algorithm": "binpack", "server_id": "server-0",
-                    "peers": "", "clients": 1}
+        from nomad_tpu.cli import AGENT_FLAG_KEYS, build_parser
+
+        defaults_ns = build_parser().parse_args(["agent"])
+        defaults = {k: getattr(defaults_ns, k) for k in AGENT_FLAG_KEYS}
         args = argparse.Namespace(**{k: v for k, v in defaults.items()})
         args.workers = 8  # user passed --workers 8
         cfg = parse_agent_config(HCL)
