@@ -57,6 +57,45 @@ def _disk_mb(path: str = "/") -> float:
         return 10 * 1024.0
 
 
+def _accelerators():
+    """Fingerprint attached accelerators as schedulable device groups
+    (reference client/devicemanager + the nvidia device plugin; here the
+    detector is JAX, so TPU/GPU chips visible to the agent become
+    device asks jobs can target with `device "google/tpu" {}`).
+
+    Only consults JAX when it is ALREADY imported: the client agent must
+    not pay a multi-second import (or grab an accelerator lease) just to
+    fingerprint a CPU-only box."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return []
+    try:
+        devices = jax.devices()
+    except Exception:
+        return []
+    from ..structs.resources import NodeDeviceResource
+
+    groups: Dict[str, NodeDeviceResource] = {}
+    for d in devices:
+        platform_name = getattr(d, "platform", "") or "unknown"
+        if platform_name == "cpu":
+            continue
+        kind = (getattr(d, "device_kind", "") or platform_name).lower()
+        name = kind.replace(" ", "-")
+        vendor = "google" if platform_name in ("tpu", "axon") else platform_name
+        dtype = "tpu" if platform_name in ("tpu", "axon") else "gpu"
+        key = f"{vendor}/{dtype}/{name}"
+        grp = groups.get(key)
+        if grp is None:
+            grp = groups[key] = NodeDeviceResource(
+                vendor=vendor, type=dtype, name=name,
+                attributes={"platform": platform_name})
+        grp.instance_ids.append(f"{dtype}-{d.id}")
+    return list(groups.values())
+
+
 def fingerprint(node_id: Optional[str] = None,
                 datacenter: str = "dc1",
                 node_class: str = "",
@@ -83,6 +122,10 @@ def fingerprint(node_id: Optional[str] = None,
     for name, healthy in drivers.items():
         attrs[f"driver.{name}"] = "1" if healthy else "0"
 
+    accelerators = _accelerators()
+    for grp in accelerators:
+        attrs[f"device.{grp.id}.count"] = str(len(grp.instance_ids))
+
     node = Node(
         id=node_id or generate_uuid(),
         name=socket.gethostname(),
@@ -94,6 +137,7 @@ def fingerprint(node_id: Optional[str] = None,
             memory_mb=_memory_mb(),
             disk_mb=_disk_mb(data_dir),
             total_cores=cores,
+            devices=accelerators,
         ),
         drivers=dict(drivers),
     )

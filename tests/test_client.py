@@ -421,3 +421,54 @@ def test_client_restart_reads_exit_status_of_finished_task(tmp_path):
         assert not tr_states["quick"].failed
     finally:
         _teardown(s, clients)
+
+
+def test_fingerprint_detects_accelerators_and_schedules_them(tmp_path):
+    """Accelerators visible to JAX fingerprint as device groups, and a
+    job asking for one schedules onto the node end-to-end (the conftest
+    CPU mesh yields none, so inject a fake jax module)."""
+    import sys
+    import types
+
+    class _Dev:
+        def __init__(self, i):
+            self.id = i
+            self.platform = "tpu"
+            self.device_kind = "TPU v5 lite"
+
+    fake = types.SimpleNamespace(devices=lambda: [_Dev(0), _Dev(1)])
+    real = sys.modules.get("jax")
+    sys.modules["jax"] = fake
+    try:
+        from nomad_tpu.client.fingerprint import fingerprint
+
+        node = fingerprint(data_dir=str(tmp_path))
+    finally:
+        if real is not None:
+            sys.modules["jax"] = real
+    groups = node.resources.devices
+    assert len(groups) == 1
+    g = groups[0]
+    assert g.vendor == "google" and g.type == "tpu"
+    assert len(g.instance_ids) == 2
+    assert node.attributes[f"device.{g.id}.count"] == "2"
+
+    # a tpu device ask schedules onto this node and gets instances
+    from nomad_tpu.structs.resources import RequestedDevice
+    from nomad_tpu.testing import Harness
+
+    h = Harness()
+    h.store.upsert_node(node)
+    plain = mock.node()
+    h.store.upsert_node(plain)
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0].resources.devices = [
+        RequestedDevice(name="google/tpu", count=2)]
+    h.store.upsert_job(job)
+    h.process(mock.eval_for(job))
+    allocs = [a for a in h.store.snapshot().allocs_by_job(job.id)
+              if not a.terminal_status()]
+    assert len(allocs) == 1
+    assert allocs[0].node_id == node.id
+    assert sum(len(v) for v in allocs[0].allocated_devices.values()) == 2
