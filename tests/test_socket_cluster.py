@@ -144,7 +144,7 @@ class TestThreeProcessCluster:
             except Exception:
                 return "", False
 
-        def wait_leader(live, timeout=60.0):
+        def wait_leader(live, timeout=180.0):
             deadline = time.time() + timeout
             while time.time() < deadline:
                 for i in live:
@@ -166,7 +166,7 @@ class TestThreeProcessCluster:
                 }],
             }}
 
-        def wait_allocs(i, job_id, want, timeout=60.0):
+        def wait_allocs(i, job_id, want, timeout=120.0):
             deadline = time.time() + timeout
             while time.time() < deadline:
                 try:
