@@ -207,6 +207,20 @@ class HTTPAgent:
             if acl is not None and not acl.management:
                 return h._error(403, "Permission denied")
 
+        if path == "/v1/namespaces":
+            # filtered to namespaces the token can read (reference
+            # namespace_endpoint.go list filtering)
+            return h._reply(200, [
+                n for n in snap.namespaces()
+                if acl is None or acl.management
+                or acl.allow_namespace_operation(n.name, aclp.CAP_READ_JOB)])
+        if m := re.fullmatch(r"/v1/namespace/([^/]+)", path):
+            if not self._ns_allowed(acl, m.group(1), aclp.CAP_READ_JOB):
+                return h._error(403, "Permission denied")
+            nsp = snap.namespace(m.group(1))
+            if nsp is None:
+                return h._error(404, "namespace not found")
+            return h._reply(200, nsp)
         if path == "/v1/node/pools":
             return h._reply(200, list(snap.node_pools()))
         if m := re.fullmatch(r"/v1/node/pool/([^/]+)", path):
@@ -491,7 +505,22 @@ class HTTPAgent:
                 return h._error(400, str(e))
             return h._reply(200, {"ok": True})
         if m := re.fullmatch(r"/v1/var/(.+)", path):
-            self.writer.put_variable(m.group(1), body.get("items", {}), ns)
+            try:
+                self.writer.put_variable(m.group(1), body.get("items", {}), ns)
+            except ValueError as e:  # e.g. unknown namespace
+                return h._error(400, str(e))
+            return h._reply(200, {"ok": True})
+        if m := re.fullmatch(r"/v1/namespace/([^/]+)", path):
+            from ..structs.operator import Namespace
+
+            if acl is not None and not acl.allow_operator_write():
+                return h._error(403, "Permission denied")
+            nsp = from_dict(Namespace, body.get("namespace") or body)
+            nsp.name = m.group(1)
+            try:
+                self.writer.upsert_namespace(nsp)
+            except ValueError as e:
+                return h._error(400, str(e))
             return h._reply(200, {"ok": True})
         if m := re.fullmatch(r"/v1/node/pool/([^/]+)", path):
             from ..structs.operator import NodePool
@@ -510,14 +539,20 @@ class HTTPAgent:
             vol.id = m.group(1)
             vol.namespace = ns
             vol.claims = {}  # store-owned; never accepted from clients
-            self.writer.register_volume(vol)
+            try:
+                self.writer.register_volume(vol)
+            except ValueError as e:  # e.g. unknown namespace
+                return h._error(400, str(e))
             return h._reply(200, {"ok": True})
 
         if path == "/v1/jobs":
             data = body.get("job") or body.get("Job") or body
             job = from_dict(Job, data)
             _validate(job)
-            eval_id = self.writer.register_job(job)
+            try:
+                eval_id = self.writer.register_job(job)
+            except ValueError as e:  # e.g. unknown namespace
+                return h._error(400, str(e))
             return h._reply(200, {"eval_id": eval_id, "job_id": job.id})
         if m := re.fullmatch(r"/v1/job/(.+)/dispatch", path):
             import base64
@@ -587,6 +622,12 @@ class HTTPAgent:
             self.writer.update_node_eligibility(m.group(1),
                                                 body.get("eligibility", ""))
             return h._reply(200, {"ok": True})
+        if path == "/v1/system/gc":
+            # force a GC pass (reference /v1/system/gc -> CoreJobForceGC);
+            # via the writer: GC mutates state, so a follower forwards
+            if acl is not None and not acl.allow_operator_write():
+                return h._error(403, "Permission denied")
+            return h._reply(200, self.writer.force_gc())
         if path == "/v1/operator/scheduler/configuration":
             from ..structs.operator import SchedulerConfiguration
 
@@ -647,6 +688,14 @@ class HTTPAgent:
             if acl is not None and not acl.management:
                 return h._error(403, "Permission denied")
             self.writer.delete_acl_role(m.group(1))
+            return h._reply(200, {"ok": True})
+        if m := re.fullmatch(r"/v1/namespace/([^/]+)", path):
+            if acl is not None and not acl.allow_operator_write():
+                return h._error(403, "Permission denied")
+            try:
+                self.writer.delete_namespace(m.group(1))
+            except ValueError as e:
+                return h._error(409, str(e))
             return h._reply(200, {"ok": True})
         if m := re.fullmatch(r"/v1/volume/csi/([^/]+)", path):
             if not self._ns_allowed(acl, ns, aclp.CAP_SUBMIT_JOB):

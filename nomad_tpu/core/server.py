@@ -273,6 +273,7 @@ class Server:
         """Job.Register: upsert + create an eval. Returns the eval id."""
         if self.sched_config.reject_job_registration:
             raise PermissionError("job registration disabled")
+        self._check_namespace(job.namespace)
         self.store.upsert_job(job)
         if job.is_periodic:
             # periodic parents don't run; the dispatcher launches children
@@ -725,6 +726,27 @@ class Server:
                 for name, m in sched.failed_tg_allocs.items()},
         }
 
+    # -- Namespace endpoints (reference nomad/namespace_endpoint.go) --
+
+    def upsert_namespace(self, ns) -> None:
+        if not ns.name:
+            raise ValueError("namespace name is required")
+        self.store.upsert_namespace(ns)
+
+    def delete_namespace(self, name: str) -> None:
+        self.store.delete_namespace(name)
+
+    def force_gc(self) -> Dict:
+        """`nomad system gc` (reference CoreJobForceGC); forwardable so
+        followers route it to the leader."""
+        return self.core_gc.force_gc(threshold_override=0)
+
+    def _check_namespace(self, namespace: str) -> None:
+        """Registrations into unregistered namespaces are rejected
+        (reference Job.Register namespace validation)."""
+        if self.store.snapshot().namespace(namespace) is None:
+            raise ValueError(f"namespace {namespace!r} does not exist")
+
     # -- Node-pool endpoints (reference nomad/node_pool_endpoint.go) --
 
     def upsert_node_pool(self, pool) -> None:
@@ -742,6 +764,7 @@ class Server:
     # -- Volume endpoints (reference nomad/csi_endpoint.go register/deregister) --
 
     def register_volume(self, vol) -> None:
+        self._check_namespace(vol.namespace)
         self.store.upsert_volume(vol)
 
     def deregister_volume(self, vol_id: str, namespace: str = "default",
@@ -842,6 +865,7 @@ class Server:
 
         from ..structs.variables import Variable
 
+        self._check_namespace(namespace)
         blob = self.encrypter.encrypt(_json.dumps(items).encode())
         self.store.upsert_variable(Variable(namespace=namespace, path=path,
                                             encrypted=blob))

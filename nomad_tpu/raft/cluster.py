@@ -31,6 +31,7 @@ FORWARD = ("register_job", "deregister_job", "dispatch_job",
            "put_variable", "delete_variable",
            "register_volume", "deregister_volume",
            "upsert_node_pool", "delete_node_pool",
+           "upsert_namespace", "delete_namespace", "force_gc",
            "upsert_acl_policy", "create_acl_token", "acl_bootstrap",
            "upsert_acl_role", "delete_acl_role")
 

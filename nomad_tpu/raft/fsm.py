@@ -33,6 +33,7 @@ MUTATIONS = {
     "upsert_variable", "delete_variable",
     "upsert_volume", "delete_volume", "reap_volume_claims",
     "upsert_node_pool", "delete_node_pool",
+    "upsert_namespace", "delete_namespace",
     "gc_terminal_allocs", "compact", "restore_dump",
 }
 

@@ -39,6 +39,8 @@ def dump_store(store) -> dict:
                         for _, v in store._volumes.iterate(snap.index)],
             "node_pools": [wire_encode(p)
                            for _, p in store._node_pools.iterate(snap.index)],
+            "namespaces": [wire_encode(x) for _, x in
+                           store._namespaces.iterate(snap.index)],
         }
 
 
@@ -60,6 +62,7 @@ def restore_store(store, data: dict) -> None:
     variables = [wire_decode(x) for x in data.get("variables", [])]
     volumes = [wire_decode(x) for x in data.get("volumes", [])]
     node_pools = [wire_decode(x) for x in data.get("node_pools", [])]
+    namespaces = [wire_decode(x) for x in data.get("namespaces", [])]
 
     with store._write_lock:
         # Generation choice must be deterministic across replicas AND
@@ -87,6 +90,7 @@ def restore_store(store, data: dict) -> None:
             id(store._variables): {(v.namespace, v.path) for v in variables},
             id(store._volumes): {(v.namespace, v.id) for v in volumes},
             id(store._node_pools): {p.name for p in node_pools},
+            id(store._namespaces): {x.name for x in namespaces},
         }
         for t in store._all_tables:
             keep = new_keys.get(id(t), set())
@@ -139,6 +143,8 @@ def restore_store(store, data: dict) -> None:
             store._volumes.put((v.namespace, v.id), v, gen, live)
         for p in node_pools:
             store._node_pools.put(p.name, p, gen, live)
+        for x in namespaces:
+            store._namespaces.put(x.name, x, gen, live)
         store._next_gen = gen
         store._commit(gen, [("restore", None)])
 

@@ -201,6 +201,28 @@ class StateSnapshot:
         """{device_group_id: instances_used, "cores": n} or None."""
         return self._store._node_dev_usage.get(node_id, self.index)
 
+    # --- namespaces ---
+
+    def namespace(self, name: str):
+        ns = self._store._namespaces.get(name, self.index)
+        if ns is not None:
+            return ns
+        from ..structs.operator import DEFAULT_NAMESPACE, Namespace
+
+        if name == DEFAULT_NAMESPACE:
+            return Namespace(name=name, description="built-in")
+        return None
+
+    def namespaces(self):
+        from ..structs.operator import DEFAULT_NAMESPACE, Namespace
+
+        seen = set()
+        for name, ns in self._store._namespaces.iterate(self.index):
+            seen.add(name)
+            yield ns
+        if DEFAULT_NAMESPACE not in seen:
+            yield Namespace(name=DEFAULT_NAMESPACE, description="built-in")
+
     # --- node pools ---
 
     def node_pool(self, name: str):
@@ -289,6 +311,7 @@ class StateStore:
         self._variables = VersionedTable("variables")           # key (ns, path)
         self._volumes = VersionedTable("volumes")               # key (ns, id)
         self._node_pools = VersionedTable("node_pools")         # key name
+        self._namespaces = VersionedTable("namespaces")         # key name
         # derived: per-node summed allocated_vec of usage-counting allocs,
         # maintained on every alloc write so tensorization reads one row
         # per node instead of walking every alloc (the tensor-era form of
@@ -307,6 +330,7 @@ class StateStore:
             self._acl_policies, self._acl_tokens, self._acl_secret_idx,
             self._acl_roles,
             self._variables, self._volumes, self._node_pools,
+            self._namespaces,
             self._node_usage, self._node_dev_usage,
         ]
         self._listeners: List[Callable[[int, list], None]] = []
@@ -844,6 +868,41 @@ class StateStore:
                 released += len(dead)
             self._commit(gen, events)
             return released
+
+    # --- namespaces (reference state_store namespaces table) ---
+
+    def upsert_namespace(self, ns) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            prev = self._namespaces.get_latest(ns.name)
+            ns.create_index = prev.create_index if prev is not None else gen
+            ns.modify_index = gen
+            self._namespaces.put(ns.name, ns, gen, live)
+            self._commit(gen, [("namespace-upsert", ns)])
+            return gen
+
+    def delete_namespace(self, name: str) -> int:
+        from ..structs.operator import DEFAULT_NAMESPACE
+
+        if name == DEFAULT_NAMESPACE:
+            raise ValueError("cannot delete the default namespace")
+        with self._write_lock:
+            # non-empty namespaces must not vanish under their objects
+            # (stopped jobs awaiting GC don't count)
+            for (jns, _), j in self._jobs.iterate(self._index):
+                if jns == name and not j.stopped():
+                    raise ValueError(f"namespace {name!r} has jobs")
+            for (vns, _), _v in self._volumes.iterate(self._index):
+                if vns == name:
+                    raise ValueError(f"namespace {name!r} has volumes")
+            for (wns, _), _w in self._variables.iterate(self._index):
+                if wns == name:
+                    raise ValueError(f"namespace {name!r} has variables")
+            gen, live = self._begin()
+            ns = self._namespaces.get_latest(name)
+            self._namespaces.delete(name, gen, live)
+            self._commit(gen, [("namespace-delete", ns)])
+            return gen
 
     # --- node pools (reference state_store_node_pools) ---
 

@@ -86,3 +86,19 @@ class NodePool:
 
 
 BUILTIN_NODE_POOLS = (enums.NODE_POOL_DEFAULT, enums.NODE_POOL_ALL)
+
+
+@dataclass(slots=True)
+class Namespace:
+    """A tenancy boundary for jobs/volumes/variables (reference
+    nomad/structs Namespace + namespace_endpoint.go). "default" always
+    exists; registrations into unregistered namespaces are rejected."""
+
+    name: str = ""
+    description: str = ""
+    meta: Dict[str, str] = field(default_factory=dict)
+    create_index: int = 0
+    modify_index: int = 0
+
+
+DEFAULT_NAMESPACE = "default"

@@ -179,7 +179,11 @@ class TestVariables:
             assert s.get_variable("app/creds") is None
 
     def test_namespace_isolation(self):
+        from nomad_tpu.structs.operator import Namespace
+
         with Server(ServerConfig()) as s:
+            s.upsert_namespace(Namespace(name="ns1"))
+            s.upsert_namespace(Namespace(name="ns2"))
             s.put_variable("p", {"a": "1"}, namespace="ns1")
             s.put_variable("p", {"a": "2"}, namespace="ns2")
             assert s.get_variable("p", "ns1") == {"a": "1"}
@@ -243,6 +247,10 @@ class TestAdviceRegressions:
             "namespace": {"dev": {"policy": "read"}}})
         tok = mgmt.create_acl_token("dev", ["devonly"])
 
+        from nomad_tpu.structs.operator import Namespace
+
+        server.upsert_namespace(Namespace(name="dev"))
+        server.upsert_namespace(Namespace(name="secret"))
         jd = mock.job()
         jd.namespace = "dev"
         js = mock.job()

@@ -1,0 +1,80 @@
+"""Namespaces: CRUD + registration enforcement (reference
+nomad/structs Namespace + namespace_endpoint.go)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.structs.operator import Namespace
+
+
+@pytest.fixture
+def s():
+    srv = Server(ServerConfig(num_workers=2, heartbeat_ttl=3600,
+                              gc_interval=3600))
+    srv.start()
+    for _ in range(3):
+        srv.register_node(mock.node())
+    yield srv
+    srv.stop()
+
+
+class TestNamespaces:
+    def test_default_exists_implicitly(self, s):
+        snap = s.store.snapshot()
+        assert snap.namespace("default") is not None
+        assert {n.name for n in snap.namespaces()} >= {"default"}
+
+    def test_register_rejected_without_namespace(self, s):
+        j = mock.job()
+        j.namespace = "prod"
+        with pytest.raises(ValueError, match="does not exist"):
+            s.register_job(j)
+        s.upsert_namespace(Namespace(name="prod", description="prod apps"))
+        eval_id = s.register_job(j)
+        assert eval_id
+        assert s.wait_for_idle(15.0)
+        allocs = s.store.snapshot().allocs_by_job(j.id, "prod")
+        assert len(allocs) == 10
+
+    def test_delete_guards_and_builtin(self, s):
+        s.upsert_namespace(Namespace(name="prod"))
+        j = mock.job()
+        j.namespace = "prod"
+        s.register_job(j)
+        with pytest.raises(ValueError, match="has jobs"):
+            s.delete_namespace("prod")
+        with pytest.raises(ValueError, match="default"):
+            s.delete_namespace("default")
+
+    def test_http_crud(self, s):
+        from nomad_tpu.api.http import HTTPAgent
+
+        with HTTPAgent(s, port=0) as agent:
+            r = urllib.request.Request(
+                f"{agent.address}/v1/namespace/team-a", method="POST",
+                data=json.dumps({"description": "team a"}).encode())
+            urllib.request.urlopen(r, timeout=10)
+            out = json.loads(urllib.request.urlopen(
+                f"{agent.address}/v1/namespaces", timeout=10).read())
+            assert {n["name"] for n in out} >= {"default", "team-a"}
+            got = json.loads(urllib.request.urlopen(
+                f"{agent.address}/v1/namespace/team-a", timeout=10).read())
+            assert got["description"] == "team a"
+            r2 = urllib.request.Request(
+                f"{agent.address}/v1/namespace/team-a", method="DELETE")
+            urllib.request.urlopen(r2, timeout=10)
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"{agent.address}/v1/namespace/team-a", timeout=10)
+
+    def test_dump_restore(self, s):
+        s.upsert_namespace(Namespace(name="prod", description="x"))
+        from nomad_tpu.state import StateStore
+
+        fresh = StateStore()
+        fresh.restore_dump(s.store.dump())
+        assert fresh.snapshot().namespace("prod").description == "x"
