@@ -160,6 +160,22 @@ class ACL:
             return False
         return capability in caps
 
+    def allow_namespace(self, namespace: str) -> bool:
+        """True if the token holds ANY capability in the namespace —
+        visibility checks (namespace list/get) key off this, not a
+        specific capability (reference namespace_endpoint.go filtering)."""
+        if self.management:
+            return True
+        best_score = max((_match(r.selector, namespace) for r in self._namespaces),
+                         default=-1)
+        if best_score < 0:
+            return False
+        caps = set()
+        for rule in self._namespaces:
+            if _match(rule.selector, namespace) == best_score:
+                caps |= rule.capabilities
+        return bool(caps - {CAP_DENY}) and CAP_DENY not in caps
+
     def allow_namespace_any(self, capability: str) -> bool:
         """True if any namespace rule grants the capability — gates
         cross-namespace list endpoints (which then filter per row)."""

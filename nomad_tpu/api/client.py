@@ -69,6 +69,44 @@ class ApiClient:
         out, _ = self._request("POST", "/v1/jobs", payload)
         return out["eval_id"]
 
+    # -- namespaces / node pools / volumes / system --
+
+    def list_namespaces(self) -> List[dict]:
+        out, _ = self.get("/v1/namespaces")
+        return out
+
+    def apply_namespace(self, name: str, description: str = "") -> None:
+        self._request("POST", f"/v1/namespace/{name}",
+                      {"description": description})
+
+    def delete_namespace(self, name: str) -> None:
+        self._request("DELETE", f"/v1/namespace/{name}")
+
+    def list_node_pools(self) -> List[dict]:
+        out, _ = self.get("/v1/node/pools")
+        return out
+
+    def apply_node_pool(self, name: str, body: dict) -> None:
+        self._request("POST", f"/v1/node/pool/{name}", body)
+
+    def delete_node_pool(self, name: str) -> None:
+        self._request("DELETE", f"/v1/node/pool/{name}")
+
+    def list_volumes(self) -> List[dict]:
+        out, _ = self.get("/v1/volumes")
+        return out
+
+    def register_volume(self, vol_id: str, body: dict) -> None:
+        self._request("POST", f"/v1/volume/csi/{vol_id}", body)
+
+    def deregister_volume(self, vol_id: str, force: bool = False) -> None:
+        self._request("DELETE", f"/v1/volume/csi/{vol_id}",
+                      params={"force": str(force).lower()})
+
+    def system_gc(self) -> dict:
+        out, _ = self._request("PUT", "/v1/system/gc", {})
+        return out
+
     def scale_job(self, job_id: str, task_group: str, count: int) -> str:
         out, _ = self._request("POST", f"/v1/job/{job_id}/scale",
                                {"task_group": task_group, "count": count})

@@ -208,14 +208,13 @@ class HTTPAgent:
                 return h._error(403, "Permission denied")
 
         if path == "/v1/namespaces":
-            # filtered to namespaces the token can read (reference
-            # namespace_endpoint.go list filtering)
+            # filtered to namespaces where the token holds ANY capability
+            # (reference namespace_endpoint.go list filtering)
             return h._reply(200, [
                 n for n in snap.namespaces()
-                if acl is None or acl.management
-                or acl.allow_namespace_operation(n.name, aclp.CAP_READ_JOB)])
+                if acl is None or acl.allow_namespace(n.name)])
         if m := re.fullmatch(r"/v1/namespace/([^/]+)", path):
-            if not self._ns_allowed(acl, m.group(1), aclp.CAP_READ_JOB):
+            if acl is not None and not acl.allow_namespace(m.group(1)):
                 return h._error(403, "Permission denied")
             nsp = snap.namespace(m.group(1))
             if nsp is None:
@@ -694,6 +693,8 @@ class HTTPAgent:
                 return h._error(403, "Permission denied")
             try:
                 self.writer.delete_namespace(m.group(1))
+            except KeyError as e:
+                return h._error(404, str(e))
             except ValueError as e:
                 return h._error(409, str(e))
             return h._reply(200, {"ok": True})

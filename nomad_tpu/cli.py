@@ -357,6 +357,80 @@ def cmd_operator_scheduler(args) -> int:
     return 0
 
 
+# -- namespaces / pools / vars / system --------------------------------------
+
+
+def cmd_namespace(args) -> int:
+    api = _client(args)
+    if args.op == "list":
+        for n in api.list_namespaces():
+            print(f"{n['name']:20} {n.get('description', '')}")
+    elif args.op == "apply":
+        api.apply_namespace(args.name, args.description)
+        print(f"namespace {args.name!r} applied")
+    else:
+        api.delete_namespace(args.name)
+        print(f"namespace {args.name!r} deleted")
+    return 0
+
+
+def cmd_node_pool(args) -> int:
+    api = _client(args)
+    if args.op == "list":
+        for p in api.list_node_pools():
+            sc = p.get("scheduler_configuration") or {}
+            print(f"{p['name']:20} {p.get('description', '')} "
+                  f"{('alg=' + sc['scheduler_algorithm']) if sc.get('scheduler_algorithm') else ''}")
+    elif args.op == "apply":
+        body = {"description": args.description}
+        if args.scheduler_algorithm:
+            body["scheduler_configuration"] = {
+                "scheduler_algorithm": args.scheduler_algorithm}
+        api.apply_node_pool(args.name, body)
+        print(f"node pool {args.name!r} applied")
+    else:
+        api.delete_node_pool(args.name)
+        print(f"node pool {args.name!r} deleted")
+    return 0
+
+
+def cmd_var(args) -> int:
+    api = _client(args)
+    if args.op == "list":
+        for v in api.list_variables():
+            print(v)
+    elif args.op == "get":
+        _p(api.get_variable(args.path))
+    elif args.op == "put":
+        items = dict(kv.split("=", 1) for kv in args.items)
+        api.put_variable(args.path, items)
+        print(f"var {args.path!r} written")
+    else:
+        api.delete_variable(args.path)
+        print(f"var {args.path!r} deleted")
+    return 0
+
+
+def cmd_volume(args) -> int:
+    api = _client(args)
+    if args.op == "list":
+        for v in api.list_volumes():
+            print(f"{v['id']:24} {v['access_mode']:24} claims={v['claims']}")
+    elif args.op == "register":
+        body = {"name": args.vol_id, "access_mode": args.access_mode}
+        api.register_volume(args.vol_id, body)
+        print(f"volume {args.vol_id!r} registered")
+    else:
+        api.deregister_volume(args.vol_id, force=args.force)
+        print(f"volume {args.vol_id!r} deregistered")
+    return 0
+
+
+def cmd_system_gc(args) -> int:
+    _p(_client(args).system_gc())
+    return 0
+
+
 # -- parser ------------------------------------------------------------------
 
 
@@ -458,6 +532,39 @@ def build_parser() -> argparse.ArgumentParser:
     evs = ev.add_parser("status")
     evs.add_argument("eval_id")
     evs.set_defaults(fn=cmd_eval_status)
+
+    nsp = sub.add_parser("namespace")
+    nsp.add_argument("op", choices=["list", "apply", "delete"])
+    nsp.add_argument("name", nargs="?", default="")
+    nsp.add_argument("-description", default="")
+    nsp.set_defaults(fn=cmd_namespace)
+
+    npool = sub.add_parser("node-pool")
+    npool.add_argument("op", choices=["list", "apply", "delete"])
+    npool.add_argument("name", nargs="?", default="")
+    npool.add_argument("-description", default="")
+    npool.add_argument("-scheduler-algorithm", dest="scheduler_algorithm",
+                       default="")
+    npool.set_defaults(fn=cmd_node_pool)
+
+    var = sub.add_parser("var")
+    var.add_argument("op", choices=["list", "get", "put", "delete"])
+    var.add_argument("path", nargs="?", default="")
+    var.add_argument("items", nargs="*", help="key=value (for put)")
+    var.set_defaults(fn=cmd_var)
+
+    vol = sub.add_parser("volume")
+    vol.add_argument("op", choices=["list", "register", "deregister"])
+    vol.add_argument("vol_id", nargs="?", default="")
+    vol.add_argument("-access-mode", dest="access_mode",
+                     default="single-node-writer")
+    vol.add_argument("-force", action="store_true")
+    vol.set_defaults(fn=cmd_volume)
+
+    system = sub.add_parser("system").add_subparsers(dest="system_cmd",
+                                                     required=True)
+    sgc = system.add_parser("gc")
+    sgc.set_defaults(fn=cmd_system_gc)
 
     op = sub.add_parser("operator").add_subparsers(dest="op_cmd", required=True)
     osched = op.add_parser("scheduler")

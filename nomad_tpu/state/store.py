@@ -469,6 +469,7 @@ class StateStore:
 
     def upsert_job(self, job: Job) -> int:
         with self._write_lock:
+            self._require_namespace(job.namespace)
             gen, live = self._begin()
             key = (job.namespace, job.id)
             prev = self._jobs.get_latest(key)
@@ -783,6 +784,7 @@ class StateStore:
 
     def upsert_volume(self, vol) -> int:
         with self._write_lock:
+            self._require_namespace(vol.namespace)
             gen, live = self._begin()
             key = (vol.namespace, vol.id)
             prev = self._volumes.get_latest(key)
@@ -871,6 +873,18 @@ class StateStore:
 
     # --- namespaces (reference state_store namespaces table) ---
 
+    def _require_namespace(self, name: str) -> None:
+        """Authoritative existence check, called INSIDE mutations under
+        _write_lock — the server-layer check is a fast-fail courtesy, but
+        only this one closes the check-then-act window against a
+        concurrent delete_namespace."""
+        from ..structs.operator import DEFAULT_NAMESPACE
+
+        if name == DEFAULT_NAMESPACE:
+            return
+        if self._namespaces.get_latest(name) is None:
+            raise ValueError(f"namespace {name!r} does not exist")
+
     def upsert_namespace(self, ns) -> int:
         with self._write_lock:
             gen, live = self._begin()
@@ -887,6 +901,8 @@ class StateStore:
         if name == DEFAULT_NAMESPACE:
             raise ValueError("cannot delete the default namespace")
         with self._write_lock:
+            if self._namespaces.get_latest(name) is None:
+                raise KeyError(f"namespace {name!r} does not exist")
             # non-empty namespaces must not vanish under their objects
             # (stopped jobs awaiting GC don't count)
             for (jns, _), j in self._jobs.iterate(self._index):
@@ -1000,6 +1016,7 @@ class StateStore:
 
     def upsert_variable(self, var) -> int:
         with self._write_lock:
+            self._require_namespace(var.namespace)
             gen, live = self._begin()
             key = (var.namespace, var.path)
             prev = self._variables.get_latest(key)

@@ -354,3 +354,42 @@ job "rt" {
         job = parse_hcl_like(spec)
         assert job.task_groups[0].constraints[0].ltarget == "${attr.kernel.name}"
         assert job.task_groups[0].tasks[0].env["NODE"] == "${node.unique.name}"
+
+
+def test_cli_namespace_pool_var_volume_system(tmp_path, capsys):
+    """The operational CLI verbs drive the live HTTP surface end to end."""
+    from nomad_tpu import cli as cli_mod
+    from nomad_tpu.api.http import HTTPAgent
+    from nomad_tpu.core import Server, ServerConfig
+
+    srv = Server(ServerConfig(num_workers=0, heartbeat_ttl=3600,
+                              gc_interval=3600))
+    with srv, HTTPAgent(srv, port=0) as agent:
+        def run(*argv):
+            rc = cli_mod.main(["--address", agent.address, *argv])
+            out = capsys.readouterr().out
+            return rc, out
+
+        rc, out = run("namespace", "apply", "team-a", "-description", "a")
+        assert rc == 0
+        rc, out = run("namespace", "list")
+        assert "team-a" in out and "default" in out
+        rc, out = run("node-pool", "apply", "gpu",
+                      "-scheduler-algorithm", "spread")
+        assert rc == 0
+        rc, out = run("node-pool", "list")
+        assert "gpu" in out and "alg=spread" in out
+        rc, out = run("var", "put", "app/config", "k=v", "x=y")
+        assert rc == 0
+        rc, out = run("var", "get", "app/config")
+        assert '"k": "v"' in out
+        rc, out = run("volume", "register", "pgdata")
+        assert rc == 0
+        rc, out = run("volume", "list")
+        assert "pgdata" in out
+        rc, out = run("volume", "deregister", "pgdata")
+        assert rc == 0
+        rc, out = run("system", "gc")
+        assert rc == 0 and '"rows_compacted"' in out
+        rc, out = run("namespace", "delete", "team-a")
+        assert rc == 0
