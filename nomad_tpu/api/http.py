@@ -367,6 +367,59 @@ class HTTPAgent:
         if m := re.fullmatch(r"/v1/client/fs/logs/([^/]+)", path):
             # authorized post-lookup against the alloc's own namespace
             return self._route_logs(h, m.group(1), q, snap, acl)
+        if path == "/v1/search":
+            # prefix search across object types, scoped to the request
+            # namespace (reference nomad/search_endpoint.go; POST there,
+            # GET here rides the blocking-query plumbing)
+            context = q.get("context", ["all"])[0]
+            contexts = ("all", "jobs", "nodes", "allocs", "evals",
+                        "deployments")
+            if context not in contexts:
+                return h._error(400, f"invalid context {context!r}; "
+                                     f"one of {contexts}")
+            limit = 20  # reference truncates at 20 per context
+
+            def take(it):
+                out, truncated = [], False
+                for x in it:
+                    if len(out) >= limit:
+                        truncated = True
+                        break
+                    out.append(x)
+                return out, truncated
+
+            def visible(obj_ns: str) -> bool:
+                return obj_ns == ns and ns_ok(obj_ns)
+
+            results: Dict[str, list] = {}
+            trunc: Dict[str, bool] = {}
+            if context in ("all", "jobs"):
+                results["jobs"], trunc["jobs"] = take(
+                    j.id for j in snap.jobs()
+                    if j.id.startswith(prefix) and visible(j.namespace))
+            if context in ("all", "nodes"):
+                if acl is not None and not acl.allow_node_read():
+                    if context == "nodes":
+                        return h._error(403, "Permission denied")
+                    results["nodes"], trunc["nodes"] = [], False
+                else:
+                    results["nodes"], trunc["nodes"] = take(
+                        n.id for n in snap.nodes()
+                        if n.id.startswith(prefix)
+                        or n.name.startswith(prefix))
+            if context in ("all", "allocs"):
+                results["allocs"], trunc["allocs"] = take(
+                    a.id for a in snap.allocs()
+                    if a.id.startswith(prefix) and visible(a.namespace))
+            if context in ("all", "evals"):
+                results["evals"], trunc["evals"] = take(
+                    e.id for e in snap.evals()
+                    if e.id.startswith(prefix) and visible(e.namespace))
+            if context in ("all", "deployments"):
+                results["deployments"], trunc["deployments"] = take(
+                    d.id for d in snap.deployments()
+                    if d.id.startswith(prefix) and visible(d.namespace))
+            return h._reply(200, {"matches": results, "truncations": trunc})
         if path == "/v1/status/leader":
             raft = getattr(self.writer, "raft", None)
             if raft is not None:

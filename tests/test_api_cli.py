@@ -393,3 +393,42 @@ def test_cli_namespace_pool_var_volume_system(tmp_path, capsys):
         assert rc == 0 and '"rows_compacted"' in out
         rc, out = run("namespace", "delete", "team-a")
         assert rc == 0
+
+
+def test_search_endpoint():
+    """Prefix search across object types (reference search_endpoint.go)."""
+    import json
+    import urllib.request
+
+    from nomad_tpu.api.http import HTTPAgent
+    from nomad_tpu.core import Server, ServerConfig
+
+    srv = Server(ServerConfig(num_workers=2, heartbeat_ttl=3600,
+                              gc_interval=3600))
+    with srv, HTTPAgent(srv, port=0) as agent:
+        for _ in range(3):
+            srv.register_node(mock.node())
+        j = mock.job()
+        j.id = "web-frontend"
+        j.name = j.id
+        srv.register_job(j)
+        assert srv.wait_for_idle(15.0)
+
+        out = json.loads(urllib.request.urlopen(
+            f"{agent.address}/v1/search?prefix=web-", timeout=10).read())
+        assert out["matches"]["jobs"] == ["web-frontend"]
+        assert out["matches"]["allocs"] == []  # alloc ids are uuids
+        assert out["matches"]["nodes"] == []
+
+        alloc_id = srv.store.snapshot().allocs_by_job("web-frontend")[0].id
+        out2 = json.loads(urllib.request.urlopen(
+            f"{agent.address}/v1/search?prefix={alloc_id[:8]}&context=allocs",
+            timeout=10).read())
+        assert alloc_id in out2["matches"]["allocs"]
+        assert "jobs" not in out2["matches"]
+
+        # node search by name prefix
+        out3 = json.loads(urllib.request.urlopen(
+            f"{agent.address}/v1/search?prefix=node-&context=nodes",
+            timeout=10).read())
+        assert len(out3["matches"]["nodes"]) == 3
