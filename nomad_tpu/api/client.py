@@ -121,6 +121,12 @@ class ApiClient:
         out, _ = self.get(f"/v1/job/{job_id}/versions")
         return out
 
+    def stop_alloc(self, alloc_id: str) -> str:
+        """Stop and reschedule one allocation (reference api Allocations
+        Stop). Returns the eval id."""
+        out, _ = self._request("POST", f"/v1/allocation/{alloc_id}/stop", {})
+        return out["eval_id"]
+
     def alloc_logs(self, alloc_id: str, task: str = "",
                    log_type: str = "stdout", offset: int = 0,
                    limit: int = 65536) -> dict:

@@ -606,6 +606,21 @@ class HTTPAgent:
             except ValueError as e:  # e.g. unknown namespace
                 return h._error(400, str(e))
             return h._reply(200, {"eval_id": eval_id, "job_id": job.id})
+        if m := re.fullmatch(r"/v1/allocation/([^/]+)/stop", path):
+            snap0 = self.server.store.snapshot()
+            alloc = snap0.alloc_by_id(m.group(1))
+            if alloc is None:
+                return h._error(404, "alloc not found")
+            if not self._ns_allowed(acl, alloc.namespace,
+                                    aclp.CAP_ALLOC_LIFECYCLE):
+                return h._error(403, "Permission denied")
+            try:
+                eval_id = self.writer.stop_alloc(m.group(1))
+            except KeyError:
+                return h._error(404, "alloc not found")
+            except ValueError as e:
+                return h._error(400, str(e))
+            return h._reply(200, {"eval_id": eval_id})
         if m := re.fullmatch(r"/v1/job/(.+)/dispatch", path):
             import base64
             import binascii

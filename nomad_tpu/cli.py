@@ -314,6 +314,13 @@ def cmd_alloc_status(args) -> int:
     return 0
 
 
+def cmd_alloc_stop(args) -> int:
+    """Stop and reschedule one allocation (reference command/alloc_stop.go)."""
+    eval_id = _client(args).stop_alloc(args.alloc_id)
+    print(f"alloc {args.alloc_id} stopping, evaluation {eval_id}")
+    return _monitor_eval(args, eval_id) if not args.detach else 0
+
+
 def cmd_alloc_logs(args) -> int:
     """Print a task's captured output (reference command/alloc_logs.go)."""
     out = _client(args).alloc_logs(
@@ -521,6 +528,10 @@ def build_parser() -> argparse.ArgumentParser:
     als = al.add_parser("status")
     als.add_argument("alloc_id")
     als.set_defaults(fn=cmd_alloc_status)
+    alstop = al.add_parser("stop")
+    alstop.add_argument("alloc_id")
+    alstop.add_argument("-detach", action="store_true")
+    alstop.set_defaults(fn=cmd_alloc_stop)
     allog = al.add_parser("logs")
     allog.add_argument("alloc_id")
     allog.add_argument("task", nargs="?", default="")

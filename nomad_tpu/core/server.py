@@ -498,6 +498,34 @@ class Server:
             self.broker.enqueue_all(evals)
         return out
 
+    def stop_alloc(self, alloc_id: str) -> str:
+        """Alloc.Stop (reference nomad/alloc_endpoint.go Stop): mark the
+        alloc for reschedule and evaluate — it stops in place and a
+        replacement lands elsewhere. Returns the eval id."""
+        from ..structs.alloc import DesiredTransition
+
+        snap = self.store.snapshot()
+        alloc = snap.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise KeyError(f"alloc {alloc_id} not found")
+        if alloc.terminal_status():
+            raise ValueError(f"alloc {alloc_id} is already terminal")
+        job = snap.job_by_id(alloc.job_id, alloc.namespace)
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=alloc.namespace,
+            priority=job.priority if job else 50,
+            type=job.type if job else enums.JOB_TYPE_SERVICE,
+            triggered_by=enums.TRIGGER_ALLOC_STOP,
+            job_id=alloc.job_id,
+            status=enums.EVAL_STATUS_PENDING,
+        )
+        index = self.store.update_alloc_desired_transitions(
+            {alloc_id: DesiredTransition(reschedule=True)}, evals=[ev])
+        ev.modify_index = index
+        self.broker.enqueue(ev)
+        return ev.id
+
     def update_allocs_from_client(self, updates: List) -> None:
         """Node.UpdateAlloc: batched client -> server alloc status sync;
         failed allocs trigger reschedule evals (node_endpoint.go

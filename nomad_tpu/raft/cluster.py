@@ -25,7 +25,8 @@ FORWARD = ("register_job", "deregister_job", "dispatch_job",
            "register_node", "heartbeat",
            "update_node_status", "update_node_drain",
            "update_node_eligibility", "deregister_node",
-           "update_allocs_from_client", "create_eval", "create_job_eval",
+           "update_allocs_from_client", "stop_alloc",
+           "create_eval", "create_job_eval",
            "set_scheduler_config",
            "promote_deployment", "fail_deployment",
            "put_variable", "delete_variable",

@@ -200,7 +200,11 @@ class AllocReconciler:
                     # until their turn
                     if a.client_terminal():
                         continue
-                    if a.desired_transition.migrate:
+                    if (a.desired_transition.migrate
+                            or a.desired_transition.reschedule
+                            or a.desired_transition.force_reschedule):
+                        # drainer pacing marked it — or the user asked
+                        # for a stop, which must not wait its drain turn
                         g.migrate.append(a)
                         continue
                     live.append(a)
@@ -209,6 +213,16 @@ class AllocReconciler:
                 # node is healthy again: the client reconnected while this
                 # alloc was written off (reference reconcileReconnecting)
                 g.reconnecting.append(a)
+                continue
+            if ((a.desired_transition.reschedule
+                    or a.desired_transition.force_reschedule)
+                    and not a.client_terminal()):
+                # user-initiated `alloc stop`: stop here, replace
+                # elsewhere (reference Alloc.Stop sets the transition and
+                # the reconciler treats it like a migration). A
+                # client-terminal alloc falls through to the normal
+                # complete/failed accounting instead.
+                g.migrate.append(a)
                 continue
             if a.client_status == enums.ALLOC_CLIENT_FAILED:
                 self._handle_failed(tg, a, g)

@@ -438,3 +438,38 @@ class TestServerE2E:
             live = [a for a in s.store.snapshot().allocs_by_job(job.id)
                     if not a.server_terminal()]
             assert live == []
+
+
+class TestAllocStop:
+    def test_alloc_stop_reschedules_elsewhere(self):
+        """`alloc stop`: the alloc stops in place and a replacement with
+        the same name lands (reference Alloc.Stop -> DesiredTransition
+        reschedule -> migrate-style stop+place)."""
+        with _server() as s:
+            for _ in range(4):
+                s.register_node(mock.node())
+            job = mock.job()
+            job.task_groups[0].count = 3
+            s.register_job(job)
+            assert s.wait_for_idle(10.0)
+            victim = s.store.snapshot().allocs_by_job(job.id)[0]
+
+            eval_id = s.stop_alloc(victim.id)
+            assert eval_id
+            assert s.wait_for_idle(10.0)
+            snap = s.store.snapshot()
+            stopped = snap.alloc_by_id(victim.id)
+            assert stopped.server_terminal()
+            live = [a for a in snap.allocs_by_job(job.id)
+                    if not a.terminal_status() and not a.server_terminal()]
+            assert len(live) == 3
+            assert victim.id not in {a.id for a in live}
+            replacement = next(a for a in live if a.name == victim.name)
+            assert replacement.previous_allocation == victim.id
+
+            import pytest
+
+            with pytest.raises(KeyError):
+                s.stop_alloc("nope")
+            with pytest.raises(ValueError):
+                s.stop_alloc(victim.id)  # already terminal
