@@ -364,6 +364,30 @@ def cmd_operator_scheduler(args) -> int:
     return 0
 
 
+def cmd_deployment(args) -> int:
+    """Deployment operations (reference command/deployment_*.go)."""
+    api = _client(args)
+    if args.op != "list" and not args.dep_id:
+        print(f"deployment {args.op} requires a deployment id",
+              file=sys.stderr)
+        return 2
+    if args.op == "list":
+        for d in api.list_deployments():
+            print(f"{d['id'][:8]}  {d['job_id']:24} v{d['job_version']}  "
+                  f"{d['status']}")
+        return 0
+    if args.op == "status":
+        _p(api.deployment(args.dep_id))
+        return 0
+    if args.op == "promote":
+        eval_id = api.promote_deployment(args.dep_id)
+        print(f"deployment {args.dep_id} promoted, evaluation {eval_id}")
+        return 0
+    api.fail_deployment(args.dep_id)
+    print(f"deployment {args.dep_id} failed")
+    return 0
+
+
 # -- namespaces / pools / vars / system --------------------------------------
 
 
@@ -543,6 +567,11 @@ def build_parser() -> argparse.ArgumentParser:
     evs = ev.add_parser("status")
     evs.add_argument("eval_id")
     evs.set_defaults(fn=cmd_eval_status)
+
+    dep = sub.add_parser("deployment")
+    dep.add_argument("op", choices=["list", "status", "promote", "fail"])
+    dep.add_argument("dep_id", nargs="?", default="")
+    dep.set_defaults(fn=cmd_deployment)
 
     nsp = sub.add_parser("namespace")
     nsp.add_argument("op", choices=["list", "apply", "delete"])

@@ -432,3 +432,63 @@ def test_search_endpoint():
             f"{agent.address}/v1/search?prefix=node-&context=nodes",
             timeout=10).read())
         assert len(out3["matches"]["nodes"]) == 3
+
+
+def test_cli_deployment_flow(capsys):
+    """deployment list/status/promote through the CLI."""
+    import copy
+
+    from nomad_tpu import cli as cli_mod
+    from nomad_tpu.api.http import HTTPAgent
+    from nomad_tpu.core import Server, ServerConfig
+    from nomad_tpu.structs.job import UpdateStrategy
+
+    srv = Server(ServerConfig(num_workers=2, heartbeat_ttl=3600,
+                              gc_interval=3600))
+    srv.deployment_watcher.interval = 0.05
+    with srv, HTTPAgent(srv, port=0) as agent:
+        for _ in range(4):
+            srv.register_node(mock.node())
+        j = mock.job()
+        j.task_groups[0].count = 2
+        j.task_groups[0].update = UpdateStrategy(canary=1,
+                                                 min_healthy_time_s=0.0)
+        srv.register_job(j)
+        assert srv.wait_for_idle(15.0)
+        for a in srv.store.snapshot().allocs_by_job(j.id):
+            upd = a.copy_for_update()
+            upd.client_status = enums.ALLOC_CLIENT_RUNNING
+            upd.deployment_status = {"healthy": True}
+            srv.update_allocs_from_client([upd])
+        j2 = copy.deepcopy(j)
+        j2.task_groups[0].tasks[0].config = {"command": "/bin/new"}
+        srv.register_job(j2)
+        assert srv.wait_for_idle(15.0)
+
+        def run(*argv):
+            rc = cli_mod.main(["--address", agent.address, *argv])
+            return rc, capsys.readouterr().out
+
+        rc, out = run("deployment", "list")
+        assert rc == 0 and j.id in out and "running" in out
+        dep_id = srv.store.snapshot().latest_deployment_by_job(j.id).id
+        rc, out = run("deployment", "status", dep_id)
+        assert rc == 0 and dep_id in out
+        # canary up + healthy, then promote via CLI
+        canaries = [a for a in srv.store.snapshot().allocs_by_job(j.id)
+                    if a.canary and not a.terminal_status()]
+        assert canaries
+        upd = canaries[0].copy_for_update()
+        upd.client_status = enums.ALLOC_CLIENT_RUNNING
+        upd.deployment_status = {"healthy": True}
+        srv.update_allocs_from_client([upd])
+        rc, out = run("deployment", "promote", dep_id)
+        assert rc == 0 and "promoted" in out
+        assert srv.store.snapshot().deployment_by_id(
+            dep_id).task_groups["web"].promoted
+        # missing id is a usage error, and fail works end to end
+        assert run("deployment", "promote")[0] == 2
+        rc, out = run("deployment", "fail", dep_id)
+        assert rc == 0 and "failed" in out
+        assert (srv.store.snapshot().deployment_by_id(dep_id).status
+                == enums.DEPLOYMENT_STATUS_FAILED)
