@@ -436,6 +436,28 @@ class HTTPAgent:
                 },
                 "version": "0.1.0",
             })
+        if path == "/v1/operator/raft/configuration":
+            # peer set + leadership (reference operator_endpoint.go
+            # RaftGetConfiguration); authorization rides the coarse
+            # /v1/operator gate above like its sibling routes
+            raft = getattr(self.writer, "raft", None)
+            if raft is None:
+                return h._reply(200, {"servers": [], "leader": "",
+                                      "term": 0, "commit_index": 0,
+                                      "last_applied": 0, "mode": "single"})
+            transport = getattr(self.writer, "transport", None)
+            addrs = getattr(transport, "peer_addrs", None) or {}
+            servers = [{"id": raft.id, "address": addrs.get(raft.id, "local"),
+                        "leader": raft.is_leader(), "self": True}]
+            for p in raft.peers:
+                servers.append({"id": p, "address": addrs.get(p, "local"),
+                                "leader": p == raft.leader_id, "self": False})
+            return h._reply(200, {"servers": servers,
+                                  "leader": raft.leader_id or "",
+                                  "term": raft.current_term,
+                                  "commit_index": raft.commit_index,
+                                  "last_applied": raft.last_applied,
+                                  "mode": "raft"})
         if path == "/v1/operator/scheduler/configuration":
             return h._reply(200, self.server.sched_config)
         if path == "/v1/metrics":

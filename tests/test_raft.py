@@ -266,3 +266,45 @@ class TestAdviceRegressions:
         assert (s1.snapshot().alloc_by_id(a.id).modify_time ==
                 s2.snapshot().alloc_by_id(a.id).modify_time)
         assert "upsert_plan_results" in TIMESTAMPED
+
+
+class TestRaftConfigurationEndpoint:
+    def test_single_server_reports_single_mode(self):
+        import json
+        import urllib.request
+
+        from nomad_tpu.api.http import HTTPAgent
+        from nomad_tpu.core import Server, ServerConfig
+
+        srv = Server(ServerConfig(num_workers=0, heartbeat_ttl=3600,
+                                  gc_interval=3600))
+        with srv, HTTPAgent(srv, port=0) as agent:
+            out = json.loads(urllib.request.urlopen(
+                f"{agent.address}/v1/operator/raft/configuration",
+                timeout=10).read())
+            assert out["mode"] == "single"
+
+    def test_replicated_reports_peers_and_leader(self):
+        import json
+        import urllib.request
+
+        from nomad_tpu.api.http import HTTPAgent
+        from nomad_tpu.core.server import ServerConfig
+        from nomad_tpu.raft.cluster import RaftCluster
+
+        with RaftCluster(3, config_fn=lambda i: ServerConfig(
+                num_workers=0, heartbeat_ttl=3600, gc_interval=3600)) as c:
+            leader = c.wait_for_leader(15.0)
+            assert leader is not None
+            agent = HTTPAgent(leader.server, port=0, writer=leader).start()
+            try:
+                out = json.loads(urllib.request.urlopen(
+                    f"{agent.address}/v1/operator/raft/configuration",
+                    timeout=10).read())
+                assert out["mode"] == "raft"
+                assert out["leader"] == leader.id
+                assert len(out["servers"]) == 3
+                me = next(s for s in out["servers"] if s["self"])
+                assert me["leader"] is True
+            finally:
+                agent.stop()
