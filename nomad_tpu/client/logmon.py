@@ -34,6 +34,14 @@ class _Rotator:
         self.max_bytes = max(1, max_bytes)
         self._idx = self._newest_index()
         self._file = open(self._path(self._idx), "ab")
+        # finish any prune a crash interrupted: files at or below the
+        # persisted through_index are already counted in the pruned base
+        _, through = _read_pruned(prefix)
+        for n in range(max(0, self._idx - self.max_files), through + 1):
+            try:
+                os.unlink(self._path(n))
+            except OSError:
+                pass
 
     def _path(self, n: int) -> str:
         return f"{self.prefix}.{n}"
@@ -59,7 +67,15 @@ class _Rotator:
             self._file = open(self._path(self._idx), "ab")
             drop = self._idx - self.max_files
             if drop >= 0:
+                # account the dropped bytes BEFORE unlinking so logical
+                # offsets stay stable across pruning (readers paging with
+                # a returned offset must not see positions shift down).
+                # A crash between the persist and the unlink leaves a
+                # counted-but-present file; readers skip indexes <=
+                # through_index and __init__ retries the unlink.
                 try:
+                    dropped = os.path.getsize(self._path(drop))
+                    _bump_pruned(self.prefix, dropped, drop)
                     os.unlink(self._path(drop))
                 except OSError:
                     pass
@@ -69,6 +85,36 @@ class _Rotator:
             self._file.close()
         except OSError:
             pass
+
+
+def _pruned_path(prefix: str) -> str:
+    return f"{prefix}.pruned"
+
+
+def _read_pruned(prefix: str) -> tuple:
+    """-> (bytes, through_index): cumulative bytes removed by pruning —
+    the logical offset of the oldest surviving byte — and the highest
+    file index those bytes cover. Persisted (atomically, fsync'd) so
+    pagination survives rotation AND agent restarts. Readers must treat
+    any surviving file with index <= through_index as already counted:
+    the counter is persisted BEFORE the unlink, so a crash between the
+    two leaves a counted-but-present file behind."""
+    try:
+        with open(_pruned_path(prefix), "r") as f:
+            parts = f.read().split()
+            return int(parts[0]), int(parts[1]) if len(parts) > 1 else -1
+    except (OSError, ValueError, IndexError):
+        return 0, -1
+
+
+def _bump_pruned(prefix: str, n: int, through_index: int) -> None:
+    from ..utils.files import atomic_write_text
+
+    total, _ = _read_pruned(prefix)
+    try:
+        atomic_write_text(_pruned_path(prefix), f"{total + n} {through_index}")
+    except OSError:
+        pass
 
 
 class LogMon:
@@ -134,27 +180,37 @@ def read_log(log_dir: str, task_name: str, kind: str = "stdout",
     Negative offset = from the end."""
     prefix = os.path.join(log_dir, f"{task_name}.{kind}")
     rx = re.compile(re.escape(f"{task_name}.{kind}") + r"\.(\d+)$")
-    pieces = []
-    try:
-        names = os.listdir(log_dir)
-    except OSError:
-        names = []
-    for name in names:
-        m = rx.fullmatch(name)
-        if m:
-            pieces.append(int(m.group(1)))
-    pieces.sort()
-    sizes = []
-    for n in pieces:
+    # snapshot base -> sizes -> base again; a prune racing the listing
+    # would otherwise double-count the dropped file (counted in the new
+    # base AND present in the stale size list)
+    for _ in range(3):
+        base, through = _read_pruned(prefix)
+        pieces = []
         try:
-            sizes.append((n, os.path.getsize(f"{prefix}.{n}")))
+            names = os.listdir(log_dir)
         except OSError:
-            sizes.append((n, 0))
-    total = sum(s for _, s in sizes)
+            names = []
+        for name in names:
+            m = rx.fullmatch(name)
+            if m and int(m.group(1)) > through:
+                pieces.append(int(m.group(1)))
+        pieces.sort()
+        sizes = []
+        for n in pieces:
+            try:
+                sizes.append((n, os.path.getsize(f"{prefix}.{n}")))
+            except OSError:
+                sizes.append((n, 0))
+        if _read_pruned(prefix)[0] == base:
+            break
+    total = base + sum(s for _, s in sizes)
     if offset < 0:
         offset = max(0, total + offset)
+    # positions below `base` were pruned away; clamp forward so a reader
+    # paging from an old offset resumes at the oldest surviving byte
+    offset = max(offset, base)
     out = bytearray()
-    pos = 0
+    pos = base
     for n, size in sizes:
         if len(out) >= limit:
             break

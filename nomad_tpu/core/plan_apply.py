@@ -228,6 +228,13 @@ class PlanApplier:
         self._pool: Optional[ThreadPoolExecutor] = None
         self._commit_pool: Optional[ThreadPoolExecutor] = None
         self.bad_nodes = bad_node_tracker or BadNodeTracker()
+        # Poison generation for the pipelined overlay: bumped whenever a
+        # commit fails OR a commit-time re-verification rewrites a result
+        # that later plans' overlays already included. A plan whose
+        # verify-time generation is stale re-verifies against the real
+        # store before committing (commits are serialized, so by then
+        # every predecessor has landed or failed).
+        self._poison_gen = 0
 
     def start(self) -> None:
         self._stop.clear()
@@ -251,25 +258,30 @@ class PlanApplier:
 
     def _run(self) -> None:
         # pipeline state: every submitted-but-unlanded commit, oldest
-        # first; their results overlay the verification snapshot
-        inflight: List[Tuple[Future, PlanResult]] = []
+        # first. Each entry's CELL holds the result its overlay readers
+        # should see; commit-time re-verification rewrites the cell.
+        # Seqlock discipline with _poison_gen: writers update the cell
+        # THEN bump the generation; readers read the generation THEN the
+        # cells, and re-verify at commit if the generation moved.
+        inflight: List[Tuple[Future, dict]] = []
         while not self._stop.is_set():
             pending = self.queue.dequeue(timeout=0.2)
             if pending is None:
                 continue
             try:
-                inflight = [(f, r) for f, r in inflight if not f.done()]
-                overlays = [r for _, r in inflight]
+                inflight = [(f, c) for f, c in inflight if not f.done()]
+                verify_gen = self._poison_gen
+                overlays = [c["result"] for _, c in inflight]
                 result, rejected = self._verify(pending.plan, overlays)
                 # the single-worker commit pool serializes commits in
                 # submission order; the submitter is answered from the
                 # future's callback the moment its commit lands
-                prev_fut = inflight[-1][0] if inflight else None
+                cell = {"result": result}
                 fut = self._commit_pool.submit(
                     self._commit_task, pending.plan, result, rejected,
-                    prev_fut)
+                    verify_gen, cell)
                 fut.add_done_callback(self._responder(pending))
-                inflight.append((fut, result))
+                inflight.append((fut, cell))
             except Exception as e:  # surface to the submitting worker
                 if self.logger:
                     self.logger.exception("plan apply failed")
@@ -302,16 +314,48 @@ class PlanApplier:
     # -- the serialized commit --
 
     def _commit_task(self, plan: Plan, result: PlanResult,
-                     rejected: List[str],
-                     prev_fut: Optional[Future]) -> PlanResult:
-        """Pipelined commit entry: if the predecessor commit FAILED, this
-        plan was verified against an overlay whose state never landed, so
-        re-verify against the real store before writing (the reference
-        treats a failed plan apply as fatal; re-verification is the
-        non-fatal equivalent)."""
-        if prev_fut is not None and prev_fut.exception() is not None:
-            result, rejected = self._verify(plan, None)
-        return self._commit(plan, result, rejected)
+                     rejected: List[str], verify_gen: int,
+                     cell: dict) -> PlanResult:
+        """Pipelined commit entry: if ANY commit failed — or was itself
+        rewritten by a commit-time re-verification — while this plan's
+        overlay was assembled, the overlay may contain state that never
+        landed, so re-verify against the real store before writing (the
+        reference treats a failed plan apply as fatal; re-verification is
+        the non-fatal equivalent). Commits are serialized, so by the time
+        this runs every predecessor has landed, been rewritten (its cell
+        updated), or failed (its cell emptied) — re-verifying against the
+        bare store is exact. The generation only moves when an overlayed
+        result actually changed, so one transient failure does not cascade
+        into re-verifying the whole pipeline forever."""
+        if self._poison_gen != verify_gen:
+            new_result, new_rejected = self._verify(plan, None)
+            if not self._result_equal(result, rejected,
+                                      new_result, new_rejected):
+                cell["result"] = new_result   # data first...
+                self._poison_gen += 1         # ...then the version bump
+            result, rejected = new_result, new_rejected
+        try:
+            return self._commit(plan, result, rejected)
+        except Exception:
+            # nothing landed: empty the overlay cell before bumping so a
+            # reader that sees the new generation also sees the new cell
+            cell["result"] = PlanResult()
+            self._poison_gen += 1
+            raise
+
+    @staticmethod
+    def _result_equal(r1: PlanResult, rej1: List[str],
+                      r2: PlanResult, rej2: List[str]) -> bool:
+        if sorted(rej1) != sorted(rej2):
+            return False
+        for attr in ("node_allocation", "node_update", "node_preemptions"):
+            d1, d2 = getattr(r1, attr), getattr(r2, attr)
+            if set(d1) != set(d2):
+                return False
+            for k in d1:
+                if [a.id for a in d1[k]] != [a.id for a in d2[k]]:
+                    return False
+        return True
 
     def _commit(self, plan: Plan, result: PlanResult,
                 rejected: List[str]) -> PlanResult:
@@ -371,7 +415,12 @@ class PlanApplier:
                     result.node_preemptions[node_id] = plan.node_preemptions[node_id]
             else:
                 rejected.append(node_id)
-                self.bad_nodes.add(node_id)
+                # only per-node plan invalidity feeds the tracker — losing
+                # a cross-node single-writer-volume race says nothing about
+                # the node's health (reference evaluateNodePlan-only
+                # accounting, plan_apply_node_tracker.go)
+                if not ok:
+                    self.bad_nodes.add(node_id)
         if rejected and plan.all_at_once:
             # all-or-nothing plan: reject everything
             result.node_allocation.clear()

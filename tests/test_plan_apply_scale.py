@@ -114,6 +114,96 @@ class TestOverlayPipeline:
         assert new.id in got
         assert ov.node_by_id(node.id) is not None
 
+    def test_commit_failure_poisons_overlay_descendants(self):
+        """If plan A's commit FAILS after later plans were verified
+        against an overlay containing A's never-landed result, those
+        plans must re-verify at commit time — even when they are not A's
+        immediate successor (the advisor's round-3 finding)."""
+        store = StateStore()
+        node = mock.node()
+        node.resources.cpu = 1000
+        node.resources.memory_mb = 1024
+        node.compute_class()
+        store.upsert_node(node)
+        job = mock.job()
+        store.upsert_job(job)
+        big = mock.alloc(job, node, index=0)
+        big.allocated_vec = big.allocated_vec * 0 + [900, 900, 0, 0]
+        store.upsert_allocs([big])
+        ap, _ = applier(store)
+
+        # plan A stops the 900-unit alloc, freeing the node
+        pa = Plan(eval_id="ea", snapshot_index=store.latest_index)
+        pa.append_stopped_alloc(big, "test stop")
+        gen_a = ap._poison_gen
+        result_a, rej_a = ap._verify(pa, None)
+        assert not rej_a
+
+        # plan C, verified while A's result is in the overlay, fills the
+        # capacity A's stop would free
+        new = mock.alloc(job, node, index=1)
+        new.allocated_vec = new.allocated_vec * 0 + [900, 900, 0, 0]
+        pc = Plan(eval_id="ec", snapshot_index=store.latest_index)
+        pc.append_alloc(new)
+        gen_c = ap._poison_gen
+        result_c, rej_c = ap._verify(pc, [result_a])
+        assert not rej_c
+
+        # A's commit fails (transient raft failure): the stop never lands
+        real_upsert = store.upsert_plan_results
+
+        def boom(*a, **kw):
+            raise RuntimeError("leadership lost")
+
+        store.upsert_plan_results = boom
+        cell_a = {"result": result_a}
+        with pytest.raises(RuntimeError):
+            ap._commit_task(pa, result_a, rej_a, gen_a, cell_a)
+        store.upsert_plan_results = real_upsert
+        assert ap._poison_gen != gen_c
+        # the failed entry's overlay cell was emptied: readers that catch
+        # the new generation must not see the never-landed stop either
+        assert not cell_a["result"].node_update
+
+        # C's commit must re-verify against the real store (big still
+        # live) and reject the node instead of overcommitting
+        out = ap._commit_task(pc, result_c, rej_c, gen_c, {"result": result_c})
+        assert out.rejected_nodes == [node.id]
+        live = [a for a in store.snapshot().allocs_by_node(node.id)
+                if not a.terminal_status()]
+        from nomad_tpu.structs import allocs_fit
+
+        fit, dim, _ = allocs_fit(node, live)
+        assert fit, dim
+
+    def test_volume_race_rejection_does_not_feed_bad_node_tracker(self):
+        """Cross-node volume-claim races say nothing about node health;
+        only per-node plan invalidity may quarantine a node."""
+        from nomad_tpu.structs.volumes import Volume, VolumeRequest
+
+        store = StateStore()
+        n1, n2 = mock.node(), mock.node()
+        for n in (n1, n2):
+            n.compute_class()
+            store.upsert_node(n)
+        vol = Volume(id="v1", namespace="default",
+                     access_mode="single-node-writer")
+        store.upsert_volume(vol)
+        job = mock.job()
+        job.task_groups[0].volumes = {
+            "data": VolumeRequest(name="data", type="csi", source="v1")}
+        store.upsert_job(job)
+        ap, _ = applier(store)
+        plan = Plan(eval_id="e1", snapshot_index=store.latest_index)
+        for i, n in enumerate((n1, n2)):
+            a = mock.alloc(job, n, index=i)
+            plan.append_alloc(a)
+        _, rejected = ap._verify(plan, None)
+        # one side loses the single-writer race...
+        assert len(rejected) == 1
+        # ...but the tracker holds no events for either node
+        assert not ap.bad_nodes._events
+
     def test_pipelined_loop_end_to_end(self):
         """Plans streamed through the applier thread commit in order and
         answer their submitters."""
