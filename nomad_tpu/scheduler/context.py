@@ -80,6 +80,23 @@ class EvalContext:
         # to the server's event broker
         self.on_event = on_event
         self._sent_events: set = set()
+        self._tg_res: dict = {}
+        self._tg_vec: dict = {}
+
+    def tg_resources(self, tg: TaskGroup):
+        """Per-eval memo of tg.combined_resources() — the combine walks
+        every task and deep-copies networks, and the commit loop would
+        otherwise pay it once per allocation."""
+        r = self._tg_res.get(id(tg))
+        if r is None:
+            r = self._tg_res[id(tg)] = tg.combined_resources()
+        return r
+
+    def tg_vec(self, tg: TaskGroup):
+        v = self._tg_vec.get(id(tg))
+        if v is None:
+            v = self._tg_vec[id(tg)] = self.tg_resources(tg).vec()
+        return v
 
     def send_event(self, event: dict) -> None:
         key = repr(sorted(event.items()))

@@ -449,6 +449,45 @@ def job_constraints(job: Job, tg: TaskGroup) -> List[Constraint]:
     return out
 
 
+def feasible_mask_static(job: Job, tg: TaskGroup, nodes: Sequence[Node],
+                         regex_cache: Optional[dict] = None,
+                         version_cache: Optional[dict] = None) -> np.ndarray:
+    """The node-attribute-only part of the feasibility mask: constraints
+    + drivers + devices + network modes + host volumes. Depends only on
+    node identity/attributes — cacheable per (task-group signature,
+    node-set version) by the tensor layer (tg_mask_signature)."""
+    mask = driver_mask(tg, nodes)
+    if not mask.any():
+        return mask
+    mask &= device_mask(tg, nodes)
+    mask &= network_mask(tg, nodes)
+    mask &= host_volume_mask(tg, nodes)
+    for c in job_constraints(job, tg):
+        if not mask.any():
+            break
+        mask &= constraint_mask(c, nodes, regex_cache, version_cache)
+    return mask
+
+
+def tg_mask_signature(job: Job, tg: TaskGroup) -> tuple:
+    """Cache key capturing every input of feasible_mask_static other than
+    the node set itself."""
+    drivers = tuple(sorted({t.driver for t in tg.tasks}))
+    devs = tuple(sorted((d.name, d.count)
+                        for t in tg.tasks for d in t.resources.devices))
+    modes = set()
+    for net in tg.networks:
+        modes.add(net.mode or "host")
+    for t in tg.tasks:
+        for net in t.resources.networks:
+            modes.add(net.mode or "host")
+    hvols = tuple(sorted((v.source, v.read_only)
+                         for v in tg.volumes.values() if v.type == "host"))
+    cons = tuple((c.ltarget, c.operand, c.rtarget)
+                 for c in job_constraints(job, tg))
+    return (drivers, devs, tuple(sorted(modes)), hvols, cons)
+
+
 def feasible_mask(job: Job, tg: TaskGroup, nodes: Sequence[Node],
                   regex_cache: Optional[dict] = None,
                   version_cache: Optional[dict] = None,
@@ -458,18 +497,9 @@ def feasible_mask(job: Job, tg: TaskGroup, nodes: Sequence[Node],
     filtering is assumed done upstream (reference readyNodesInDCsAndPool).
     `snapshot` powers the csi-volume claim check; without it csi-volume
     groups mask everything out."""
-    mask = driver_mask(tg, nodes)
-    if not mask.any():
-        return mask
-    mask &= device_mask(tg, nodes)
-    mask &= network_mask(tg, nodes)
-    mask &= host_volume_mask(tg, nodes)
+    mask = feasible_mask_static(job, tg, nodes, regex_cache, version_cache)
     if any(v.type == "csi" for v in tg.volumes.values()):
-        mask &= csi_volume_mask(tg, nodes, snapshot, job.namespace, plan)
-    for c in job_constraints(job, tg):
-        if not mask.any():
-            break
-        mask &= constraint_mask(c, nodes, regex_cache, version_cache)
+        mask = mask & csi_volume_mask(tg, nodes, snapshot, job.namespace, plan)
     return mask
 
 

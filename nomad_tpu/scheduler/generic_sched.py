@@ -291,7 +291,7 @@ class GenericScheduler:
                 job=job,
                 job_version=job.version,
                 task_group=tg.name,
-                allocated_vec=tg.combined_resources().vec(),
+                allocated_vec=ctx.tg_vec(tg),
                 allocated_ports=list(option.allocated_ports),
                 allocated_devices=dict(option.allocated_devices),
                 allocated_cores=list(option.allocated_cores),

@@ -146,6 +146,8 @@ def restore_store(store, data: dict) -> None:
         for x in namespaces:
             store._namespaces.put(x.name, x, gen, live)
         store._next_gen = gen
+        store._bump_node_set(gen)
+        store._rebuild_usage_matrix()
         store._commit(gen, [("restore", None)])
 
 

@@ -25,8 +25,11 @@ import time
 import weakref
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 
+import numpy as np
+
 from ..structs import enums
 from ..structs.alloc import Allocation
+from ..structs.resources import RESOURCE_DIMS
 from ..structs.deployment import Deployment
 from ..structs.evaluation import Evaluation
 from ..structs.job import Job
@@ -65,8 +68,35 @@ class StateSnapshot:
         return (n for _, n in self._store._nodes.iterate(self.index))
 
     def ready_nodes_in_pool(self, datacenters: Iterable[str], node_pool: str) -> List[Node]:
-        """Reference scheduler/util.go:50 readyNodesInDCsAndPool."""
+        """Reference scheduler/util.go:50 readyNodesInDCsAndPool.
+
+        Cached per (node-set version, dcs, pool) when this snapshot's
+        node view matches the latest one — the common case for scheduler
+        workers, which snapshot right before evaluating. The returned
+        list is shared: callers must not mutate it. Its order is the
+        CANONICAL node order the tensor caches key their per-node arrays
+        to (tie-breaking among equal scores is a kernel-side permutation,
+        not a host-side shuffle)."""
         dcs = list(datacenters)
+        store = self._store
+        key = (tuple(sorted(dcs)), node_pool)
+        if self.index >= store.node_set_index:
+            hit = store._ready_nodes_cache.get(key)
+            if hit is not None and hit[0] == store.node_set_version:
+                return hit[1]
+            version = store.node_set_version
+            out = CanonicalNodeList(
+                n for n in self.nodes()
+                if n.ready() and n.in_pool(dcs, node_pool))
+            # only tag as canonical (and publish) if no node write raced
+            # the scan — a stale list tagged with the current version
+            # would poison the shared ClusterStatic caches
+            if (store.node_set_version == version
+                    and self.index >= store.node_set_index):
+                out.canonical_version = version
+                out.canonical_key = key
+                store._ready_nodes_cache[key] = (version, out)
+            return out
         return [n for n in self.nodes()
                 if n.ready() and n.in_pool(dcs, node_pool)]
 
@@ -277,6 +307,16 @@ class StateSnapshot:
         return best
 
 
+class CanonicalNodeList(list):
+    """A ready-node list in CANONICAL order, tagged with the node-set
+    version it was computed at — the tensor layer keys its shared
+    per-node arrays (capacity, masks, interning) to it. Shared between
+    callers: never mutate."""
+
+    canonical_version = None
+    canonical_key = None
+
+
 class StateStore:
     """MVCC tables + serialized write path (reference nomad/state/state_store.go).
 
@@ -322,6 +362,23 @@ class StateStore:
         # the tensor layer appends; only allocs that carry devices/cores
         # ever touch it
         self._node_dev_usage = VersionedTable("node_dev_usage")
+
+        # Node-set version: bumped (with the index it happened at) on any
+        # node-table write. The tensor layer's canonical-node-set caches
+        # key on it; a snapshot may only consume those caches when its
+        # index has caught up to node_set_index (same node view).
+        self.node_set_version = 0
+        self.node_set_index = 0
+        self._ready_nodes_cache: Dict[tuple, tuple] = {}
+        # Dense LATEST-state usage matrix: one row per node, summed
+        # allocated_vec of usage-counting allocs, maintained in lockstep
+        # with the MVCC _node_usage rows. The TPU placer reads it with one
+        # fancy-index gather instead of 10K dict lookups per eval; it sees
+        # freshest-committed usage (not snapshot usage) by design — newer
+        # usage only makes the optimistic solve MORE accurate, and the
+        # serialized plan applier still owns correctness.
+        self._usage_rows: Dict[str, int] = {}
+        self._usage_mat = np.zeros((256, RESOURCE_DIMS))
 
         self._all_tables = [
             self._nodes, self._jobs, self._job_versions, self._evals, self._allocs,
@@ -417,6 +474,8 @@ class StateStore:
             if not node.computed_class:
                 node.compute_class()
             self._nodes.put(node.id, node, gen, live)
+            self._usage_row(node.id)  # matrix row exists for every node
+            self._bump_node_set(gen)
             self._commit(gen, [("node-upsert", node)])
             return gen
 
@@ -430,6 +489,7 @@ class StateStore:
             mutate(node)
             node.modify_index = gen
             self._nodes.put(node_id, node, gen, live)
+            self._bump_node_set(gen)
             self._commit(gen, [(event, node)])
             return gen
 
@@ -462,6 +522,10 @@ class StateStore:
             self._nodes.delete(node_id, gen, live)
             self._node_usage.delete(node_id, gen, live)
             self._node_dev_usage.delete(node_id, gen, live)
+            row = self._usage_rows.get(node_id)
+            if row is not None:
+                self._usage_mat[row] = 0.0
+            self._bump_node_set(gen)
             self._commit(gen, [("node-delete", node)])
             return gen
 
@@ -581,10 +645,52 @@ class StateStore:
             self._commit(gen, events)
             return gen
 
+    def _bump_node_set(self, gen: int) -> None:
+        """Must hold _write_lock. Invalidate canonical node-set caches."""
+        self.node_set_version += 1
+        self.node_set_index = gen
+        self._ready_nodes_cache.clear()
+
+    def _usage_row(self, node_id: str) -> int:
+        """Must hold _write_lock when the row may need creating."""
+        row = self._usage_rows.get(node_id)
+        if row is None:
+            row = len(self._usage_rows)
+            self._usage_rows[node_id] = row
+            if row >= self._usage_mat.shape[0]:
+                grown = np.zeros((self._usage_mat.shape[0] * 2, RESOURCE_DIMS))
+                grown[: self._usage_mat.shape[0]] = self._usage_mat
+                self._usage_mat = grown
+        return row
+
+    def usage_rows_for(self, node_ids: List[str]) -> np.ndarray:
+        """Matrix row index per node id (for the tensor layer's one-gather
+        usage read)."""
+        rows = self._usage_rows
+        try:
+            return np.fromiter((rows[n] for n in node_ids), dtype=np.int64,
+                               count=len(node_ids))
+        except KeyError:
+            with self._write_lock:
+                return np.fromiter((self._usage_row(n) for n in node_ids),
+                                   dtype=np.int64, count=len(node_ids))
+
+    def _rebuild_usage_matrix(self) -> None:
+        """Must hold _write_lock. Re-derive the dense matrix from the
+        MVCC usage rows (restore/install-snapshot path)."""
+        self._usage_rows = {}
+        self._usage_mat = np.zeros((256, RESOURCE_DIMS))
+        for node_id, _ in self._nodes.iterate(self._next_gen):
+            self._usage_row(node_id)
+        for node_id, vec in self._node_usage.iterate(self._next_gen):
+            if vec is not None:
+                self._usage_mat[self._usage_row(node_id)] = vec
+
     def _usage_add(self, node_id: str, delta, gen: int, live: int) -> None:
         cur = self._node_usage.get_latest(node_id)
         new = delta if cur is None else cur + delta
         self._node_usage.put(node_id, new, gen, live)
+        self._usage_mat[self._usage_row(node_id)] += delta
 
     def _usage_apply(self, prev: Optional[Allocation], new: Optional[Allocation],
                      gen: int, live: int) -> None:

@@ -25,8 +25,11 @@ from ..scheduler.feasible import (
     check_constraint,
     distinct_hosts_flags,
     feasible_mask,
+    feasible_mask_static,
+    csi_volume_mask,
     reserved_ports_mask,
     resolve_target,
+    tg_mask_signature,
 )
 from ..scheduler.spread import IMPLICIT_TARGET, SpreadInfo, combined_spreads
 
@@ -38,46 +41,111 @@ def _pad_pow2(n: int, floor: int = 8) -> int:
     return out
 
 
+class ClusterStatic:
+    """Canonical per-(node-set version, node list) arrays shared across
+    evals AND scheduler workers: everything here depends only on node
+    identity/attributes — capacity, index maps, feasibility masks,
+    affinity vectors, attribute-value interning — never on usage or
+    plans. Keyed by the store's node_set_version; one node write anywhere
+    invalidates the whole set.
+
+    This is the round-4 resident layer: round 3 rebuilt every one of
+    these O(nodes) Python-side arrays once per eval, which dominated the
+    eval hot path at 10K nodes."""
+
+    __slots__ = ("nodes", "n_pad", "available", "node_index", "usage_rows",
+                 "version", "mask_cache", "aff_cache", "intern_cache",
+                 "dev_cache")
+
+    def __init__(self, nodes: Sequence[Node], store=None, version=None):
+        n = len(nodes)
+        self.nodes = list(nodes)
+        self.n_pad = _pad_pow2(n)
+        self.version = version
+        self.available = np.zeros((self.n_pad, RESOURCE_DIMS))
+        self.node_index: Dict[str, int] = {}
+        for i, node in enumerate(nodes):
+            self.available[i] = node.available_vec()
+            self.node_index[node.id] = i
+        self.usage_rows = (store.usage_rows_for([n.id for n in nodes])
+                           if store is not None and n else None)
+        self.mask_cache: Dict[tuple, np.ndarray] = {}
+        self.aff_cache: Dict[tuple, np.ndarray] = {}
+        self.intern_cache: Dict[tuple, tuple] = {}
+        self.dev_cache: Dict[tuple, tuple] = {}
+
+
+def _static_for(ctx: EvalContext, nodes: Sequence[Node]):
+    """Cached ClusterStatic when `nodes` is the canonical ready-node list
+    (see StateSnapshot.ready_nodes_in_pool); None otherwise."""
+    store = getattr(ctx.snapshot, "_store", None)
+    if store is None:
+        return None
+    version = getattr(nodes, "canonical_version", None)
+    if version is None or version != store.node_set_version:
+        return None
+    statics = getattr(store, "_tensor_statics", None)
+    if statics is None:
+        statics = store._tensor_statics = {}
+    key = (version, getattr(nodes, "canonical_key", None))
+    static = statics.get(key)
+    if static is None:
+        # drop stale versions; benign races just rebuild (iterate a
+        # keys copy — concurrent workers insert into this dict)
+        for k in [k for k in list(statics) if k[0] != version]:
+            statics.pop(k, None)
+        static = ClusterStatic(nodes, store=store, version=version)
+        statics[key] = static
+    return static
+
+
 @dataclass
 class ClusterTensors:
-    """Per-(eval, node-list) arrays shared by every task group's solve."""
+    """Per-eval view: shared ClusterStatic + this eval's usage state."""
 
     nodes: List[Node]
     n_pad: int
-    available: np.ndarray          # (Np, D)
-    used: np.ndarray               # (Np, D) proposed usage
+    available: np.ndarray          # (Np, D) shared with the static — read-only
+    used: np.ndarray               # (Np, D) proposed usage, per-eval
     node_index: Dict[str, int]
+    static: "ClusterStatic" = None
+    _store: object = None
 
     @classmethod
     def build(cls, ctx: EvalContext, nodes: Sequence[Node]) -> "ClusterTensors":
-        n = len(nodes)
-        n_pad = _pad_pow2(n)
-        available = np.zeros((n_pad, RESOURCE_DIMS))
-        used = np.zeros((n_pad, RESOURCE_DIMS))
-        index: Dict[str, int] = {}
-        for i, node in enumerate(nodes):
-            available[i] = node.available_vec()
-            index[node.id] = i
-        # padding rows have zero capacity and are masked infeasible anyway
-        t = cls(nodes=list(nodes), n_pad=n_pad, available=available,
-                used=used, node_index=index)
+        static = _static_for(ctx, nodes)
+        if static is None:
+            static = ClusterStatic(nodes)  # per-eval, uncached
+        used = np.zeros((static.n_pad, RESOURCE_DIMS))
+        t = cls(nodes=static.nodes, n_pad=static.n_pad,
+                available=static.available, used=used,
+                node_index=static.node_index, static=static,
+                _store=getattr(ctx.snapshot, "_store", None))
         t.refresh_usage(ctx)
         return t
 
     def refresh_usage(self, ctx: EvalContext) -> None:
-        """Proposed usage (state - evictions + placements). Base usage
-        comes from the store's per-node usage rows — O(nodes) reads, not
-        an O(allocs) rescan — and only nodes the in-progress plan touches
-        are recomputed from ctx.proposed_allocs (reference context.go:176
-        ProposedAllocs). Called between task groups so group B sees group
-        A's in-plan placements."""
+        """Proposed usage (state - evictions + placements). Base usage is
+        one fancy-index gather from the store's dense usage matrix when
+        available (latest-committed state: fresher than the snapshot,
+        which only helps an optimistic solve — the serialized applier
+        re-verifies), else O(nodes) snapshot rows. Only nodes the
+        in-progress plan touches are recomputed from ctx.proposed_allocs
+        (reference context.go:176 ProposedAllocs). Called between task
+        groups so group B sees group A's in-plan placements."""
         snap = ctx.snapshot
         used = self.used
-        used[:] = 0.0
-        for i, node in enumerate(self.nodes):
-            u = snap.node_usage(node.id)
-            if u is not None:
-                used[i] = u
+        n = len(self.nodes)
+        rows = self.static.usage_rows if self.static is not None else None
+        if rows is not None and self._store is not None:
+            used[:n] = self._store._usage_mat[rows]
+            used[n:] = 0.0
+        else:
+            used[:] = 0.0
+            for i, node in enumerate(self.nodes):
+                u = snap.node_usage(node.id)
+                if u is not None:
+                    used[i] = u
         plan = ctx.plan
         if plan is None:
             return
@@ -165,15 +233,25 @@ class TaskGroupTensors:
 
 
 def _affinity_vector(ctx: EvalContext, job: Job, tg: TaskGroup,
-                     nodes: Sequence[Node], n_pad: int) -> np.ndarray:
+                     cluster: ClusterTensors) -> np.ndarray:
     """Precompute the node-affinity boost per node
-    (reference rank.go:710 NodeAffinityIterator, sum(weight)/sum|weight|)."""
+    (reference rank.go:710 NodeAffinityIterator, sum(weight)/sum|weight|).
+    Depends only on node attributes — cached on the ClusterStatic by
+    affinity signature."""
+    nodes, n_pad = cluster.nodes, cluster.n_pad
     affinities = (list(job.affinities) + list(tg.affinities)
                   + [a for t in tg.tasks for a in t.affinities])
-    out = np.zeros(n_pad)
     if not affinities:
-        return out
+        return np.zeros(n_pad)
+    static = cluster.static
+    sig = tuple((a.ltarget, a.operand, a.rtarget, a.weight)
+                for a in affinities)
+    if static is not None:
+        hit = static.aff_cache.get(sig)
+        if hit is not None:
+            return hit
     total_weight = sum(abs(a.weight) for a in affinities) or 1.0
+    out = np.zeros(n_pad)
     for i, node in enumerate(nodes):
         total = 0.0
         for aff in affinities:
@@ -183,13 +261,62 @@ def _affinity_vector(ctx: EvalContext, job: Job, tg: TaskGroup,
                                 ctx.regex_cache, ctx.version_cache):
                 total += aff.weight
         out[i] = total / total_weight
+    if static is not None:
+        static.aff_cache[sig] = out
     return out
 
 
+def _interned_attr(ctx: EvalContext, cluster: ClusterTensors,
+                   attribute: str):
+    """-> (vocab, val_id (Np,), val_ok (Np,)) for one node attribute,
+    cached on the ClusterStatic. The vocab keeps growing as off-pool
+    nodes' values get interned by callers (append-only, so cached val_id
+    arrays stay valid)."""
+    static = cluster.static
+    key = ("attr", attribute)
+    if static is not None:
+        hit = static.intern_cache.get(key)
+        if hit is not None:
+            return hit
+    vocab: Dict[str, int] = {}
+    val_id = np.zeros(cluster.n_pad, dtype=np.int32)
+    val_ok = np.zeros(cluster.n_pad, dtype=bool)
+    for i, node in enumerate(cluster.nodes):
+        v, ok = resolve_target(attribute, node)
+        if ok:
+            vid = vocab.setdefault(v, len(vocab))
+            val_id[i] = vid
+            val_ok[i] = True
+    out = (vocab, val_id, val_ok)
+    if static is not None:
+        static.intern_cache[key] = out
+    return out
+
+
+_intern_lock = __import__("threading").Lock()
+
+
+def _intern(vocab: Dict[str, int], v: str) -> int:
+    """Append-only interning safe under concurrent workers sharing a
+    cached vocab (double-checked under a lock so two threads can never
+    mint the same id for different values)."""
+    vid = vocab.get(v)
+    if vid is None:
+        with _intern_lock:
+            vid = vocab.get(v)
+            if vid is None:
+                vid = len(vocab)
+                vocab[v] = vid
+    return vid
+
+
 def _spread_tensors(ctx: EvalContext, job: Job, tg: TaskGroup,
-                    nodes: Sequence[Node], n_pad: int):
+                    cluster: ClusterTensors):
     """Intern spread-attribute values and lower desired/existing counts
-    (reference spread.go computeSpreadInfo + propertyset.go)."""
+    (reference spread.go computeSpreadInfo + propertyset.go). The
+    per-node interning tables come from the ClusterStatic cache; only the
+    existing-alloc counts (O(job allocs)) are computed per eval."""
+    n_pad = cluster.n_pad
     spreads = combined_spreads(job, tg)
     s = len(spreads)
     if s == 0:
@@ -208,18 +335,9 @@ def _spread_tensors(ctx: EvalContext, job: Job, tg: TaskGroup,
     counts_list: List[Dict[int, int]] = []
 
     for si, sp in enumerate(spreads):
-        vocab: Dict[str, int] = {}
-
-        def intern(v: str) -> int:
-            if v not in vocab:
-                vocab[v] = len(vocab)
-            return vocab[v]
-
-        for i, node in enumerate(nodes):
-            v, ok = resolve_target(sp.attribute, node)
-            if ok:
-                val_ids[si, i] = intern(v)
-                val_ok[si, i] = True
+        vocab, vid_row, vok_row = _interned_attr(ctx, cluster, sp.attribute)
+        val_ids[si] = vid_row
+        val_ok[si] = vok_row
         counts: Dict[int, int] = {}
         for a in existing:
             anode = ctx.snapshot.node_by_id(a.node_id)
@@ -227,12 +345,16 @@ def _spread_tensors(ctx: EvalContext, job: Job, tg: TaskGroup,
                 continue
             v, ok = resolve_target(sp.attribute, anode)
             if ok:
-                vid = intern(v)
+                vid = _intern(vocab, v)
                 counts[vid] = counts.get(vid, 0) + 1
         vocabs.append(vocab)
         counts_list.append(counts)
 
-    v_pad = _pad_pow2(max(max(len(v) for v in vocabs), 1), floor=1)
+    # snapshot the (shared, concurrently-growing) vocabs ONCE: every vid
+    # this eval references was interned above, so a stable items() copy
+    # taken here bounds v_pad and survives other workers' later inserts
+    vocab_items = [list(v.items()) for v in vocabs]
+    v_pad = _pad_pow2(max(max(len(v) for v in vocab_items), 1), floor=1)
     spread_counts = np.zeros((s, v_pad), dtype=np.int32)
     spread_desired = np.full((s, v_pad), np.nan)
     has_targets = np.zeros(s, dtype=bool)
@@ -249,7 +371,7 @@ def _spread_tensors(ctx: EvalContext, job: Job, tg: TaskGroup,
         # spread.go:268 computeSpreadInfo) — reuse, don't re-derive
         desired = SpreadInfo(sp, tg.count).desired_counts
         implicit = desired.get(IMPLICIT_TARGET)
-        for val, vid in vocabs[si].items():
+        for val, vid in vocab_items[si]:
             if val in desired:
                 spread_desired[si, vid] = desired[val]
             elif implicit is not None:
@@ -273,7 +395,7 @@ def _device_core_tensors(ctx: EvalContext, tg: TaskGroup,
                                      device_affinity_boost, groups_capacity,
                                      matching_groups)
 
-    ask_res = tg.combined_resources()
+    ask_res = ctx.tg_resources(tg)
     asks = ask_res.devices
     cores = int(ask_res.cores)
     e = len(asks) + (1 if cores else 0)
@@ -284,10 +406,40 @@ def _device_core_tensors(ctx: EvalContext, tg: TaskGroup,
         return z, z, np.zeros(0), np.zeros(n_pad), "none"
 
     snap = ctx.snapshot
-    cap = np.zeros((n_pad, e))
     used = np.zeros((n_pad, e))
-    dev_aff = np.zeros(n_pad)
     any_affinities = any(a.affinities for a in asks)
+
+    # capacity columns + device-affinity boost depend only on node
+    # hardware and the ask — cached on the ClusterStatic by ask signature
+    static = cluster.static
+    sig = (tuple((a.name, a.count,
+                  tuple((c.ltarget, c.operand, c.rtarget)
+                        for c in a.constraints),
+                  tuple((f.ltarget, f.operand, f.rtarget, f.weight)
+                        for f in a.affinities))
+                 for a in asks), bool(cores))
+    cached = static.dev_cache.get(sig) if static is not None else None
+    if cached is not None:
+        cap, dev_aff, match_lists = cached
+    else:
+        cap = np.zeros((n_pad, e))
+        dev_aff = np.zeros(n_pad)
+        # per (node, ask) matched group ids, reused by the usage fill
+        match_lists = [[()] * len(asks) for _ in range(len(nodes))]
+        for i, node in enumerate(nodes):
+            for ei, ask in enumerate(asks):
+                groups = matching_groups(node, ask, ctx.regex_cache,
+                                         ctx.version_cache)
+                cap[i, ei] = groups_capacity(groups)
+                match_lists[i][ei] = tuple(g.id for g in groups)
+            if cores:
+                cap[i, -1] = node.resources.total_cores
+            if any_affinities:
+                dev_aff[i] = device_affinity_boost(
+                    node, asks, ctx.regex_cache, ctx.version_cache)
+        if static is not None:
+            static.dev_cache[sig] = (cap, dev_aff, match_lists)
+
     plan = ctx.plan
     touched = set()
     if plan is not None:
@@ -299,25 +451,20 @@ def _device_core_tensors(ctx: EvalContext, tg: TaskGroup,
             for a in ctx.proposed_allocs(node.id):
                 accumulate_dev_usage(row, a)
         else:
-            row = snap.node_dev_usage(node.id) or {}
-        for ei, ask in enumerate(asks):
-            groups = matching_groups(node, ask, ctx.regex_cache,
-                                     ctx.version_cache)
-            cap[i, ei] = groups_capacity(groups)
-            used[i, ei] = sum(row.get(g.id, 0) for g in groups)
+            row = snap.node_dev_usage(node.id)
+        if not row:
+            continue
+        for ei in range(len(asks)):
+            used[i, ei] = sum(row.get(gid, 0) for gid in match_lists[i][ei])
         if cores:
-            cap[i, -1] = node.resources.total_cores
             used[i, -1] = row.get("cores", 0)
-        if any_affinities:
-            dev_aff[i] = device_affinity_boost(node, asks, ctx.regex_cache,
-                                               ctx.version_cache)
     extra_ask = np.array([float(a.count) for a in asks]
                          + ([float(cores)] if cores else []))
     return cap, used, extra_ask, dev_aff, combined_numa_affinity(tg)
 
 
 def _distinct_property_tensors(ctx: EvalContext, job: Job, tg: TaskGroup,
-                               nodes, n_pad: int):
+                               cluster: ClusterTensors):
     """Interned distinct_property values + proposed counts + limits.
     Counts mirror the host mask's inputs (scheduler/rank.py
     _plan_aware_job_allocs -> feasible.distinct_property_mask): the job's
@@ -325,6 +472,7 @@ def _distinct_property_tensors(ctx: EvalContext, job: Job, tg: TaskGroup,
     from ..scheduler.feasible import distinct_property_constraints
     from ..scheduler.rank import _plan_aware_job_allocs
 
+    n_pad = cluster.n_pad
     constraints = distinct_property_constraints(job, tg)
     p = len(constraints)
     if p == 0:
@@ -344,18 +492,9 @@ def _distinct_property_tensors(ctx: EvalContext, job: Job, tg: TaskGroup,
             limits[pi] = int(c.rtarget) if c.rtarget else 1
         except ValueError:
             limits[pi] = 1
-        vocab: Dict[str, int] = {}
-
-        def intern(v: str) -> int:
-            if v not in vocab:
-                vocab[v] = len(vocab)
-            return vocab[v]
-
-        for i, node in enumerate(nodes):
-            v, ok = resolve_target(c.ltarget, node)
-            if ok:
-                val_ids[pi, i] = intern(v)
-                val_ok[pi, i] = True
+        vocab, vid_row, vok_row = _interned_attr(ctx, cluster, c.ltarget)
+        val_ids[pi] = vid_row
+        val_ok[pi] = vok_row
         counts: Dict[int, int] = {}
         for a in live:
             anode = ctx.snapshot.node_by_id(a.node_id)
@@ -386,12 +525,25 @@ def build_task_group_tensors(
     n_pad = cluster.n_pad
 
     feas = np.zeros(n_pad, dtype=bool)
-    feas[: len(nodes)] = feasible_mask(job, tg, nodes,
-                                       ctx.regex_cache, ctx.version_cache,
-                                       snapshot=ctx.snapshot, plan=ctx.plan)
+    static = cluster.static
+    if static is not None:
+        sig = tg_mask_signature(job, tg)
+        base = static.mask_cache.get(sig)
+        if base is None:
+            base = feasible_mask_static(job, tg, nodes,
+                                        ctx.regex_cache, ctx.version_cache)
+            static.mask_cache[sig] = base
+        feas[: len(nodes)] = base
+        if any(v.type == "csi" for v in tg.volumes.values()):
+            feas[: len(nodes)] &= csi_volume_mask(
+                tg, nodes, ctx.snapshot, job.namespace, ctx.plan)
+    else:
+        feas[: len(nodes)] = feasible_mask(
+            job, tg, nodes, ctx.regex_cache, ctx.version_cache,
+            snapshot=ctx.snapshot, plan=ctx.plan)
     placed_tg, placed_job = cluster.placement_counts(job, tg, ctx)
     (val_id, val_ok, counts, desired,
-     has_targets, weights) = _spread_tensors(ctx, job, tg, nodes, n_pad)
+     has_targets, weights) = _spread_tensors(ctx, job, tg, cluster)
     dh_job, dh_tg = distinct_hosts_flags(job, tg)
 
     # Reserved ports: conflict-free nodes only, and at most one alloc of
@@ -399,19 +551,19 @@ def build_task_group_tensors(
     # the first's static ports) — which is exactly the dh_tg constraint
     # the kernel already enforces. Dynamic-port exhaustion is the R_PORTS
     # dimension of ask/available; exact numbers assigned post-solve.
-    if tg.combined_resources().reserved_port_asks():
+    if ctx.tg_resources(tg).reserved_port_asks():
         feas[: len(nodes)] &= reserved_ports_mask(tg, nodes, ctx.proposed_allocs)
         dh_tg = True
 
     extra_cap, extra_used, extra_ask, dev_aff, _ = _device_core_tensors(
         ctx, tg, cluster)
     dp_val_id, dp_val_ok, dp_counts, dp_limit = _distinct_property_tensors(
-        ctx, job, tg, nodes, n_pad)
+        ctx, job, tg, cluster)
 
     return TaskGroupTensors(
-        ask=tg.combined_resources().vec(),
+        ask=ctx.tg_vec(tg),
         feasible=feas,
-        affinity_boost=_affinity_vector(ctx, job, tg, nodes, n_pad),
+        affinity_boost=_affinity_vector(ctx, job, tg, cluster),
         placed_tg=placed_tg,
         placed_job=placed_job,
         spread_val_id=val_id,

@@ -223,6 +223,7 @@ def solve_task_group(
     dh_job,            # () bool
     dh_tg,             # () bool
     spread_alg,        # () bool
+    tie_perm=None,     # (N,) int32 permutation: tie-break priority order
 ):
     """Place K allocations of one task group. Returns per-step
     (choice, found, score): the chosen node index, whether any node fit,
@@ -233,10 +234,35 @@ def solve_task_group(
     counts — exactly the state the host path threads through
     ctx.proposed_allocs + SpreadScorer + propertyset between placements
     (generic_sched.go:511-600 commit loop).
+
+    tie_perm replaces the host path's per-eval node shuffle (reference
+    scheduler/util.go:167 shuffleNodes): the whole solve runs in
+    PERMUTED node space (one up-front gather of every per-node array, so
+    the scan body stays a plain argmax) and choices map back through the
+    permutation at the end. Equal-scoring winners follow the
+    permutation's priority order — racing workers diverge on ties
+    without reordering the (cached, canonical) per-node arrays
+    host-side.
     """
     s = spread_val_id.shape[0]
     p = dp_val_id.shape[0]
     n = available.shape[0]
+    if tie_perm is not None:
+        available = available[tie_perm]
+        used0 = used0[tie_perm]
+        placed_tg0 = placed_tg0[tie_perm]
+        placed_job0 = placed_job0[tie_perm]
+        feasible = feasible[tie_perm]
+        affinity_boost = affinity_boost[tie_perm]
+        dev_affinity = dev_affinity[tie_perm]
+        spread_val_id = spread_val_id[:, tie_perm]
+        spread_val_ok = spread_val_ok[:, tie_perm]
+        if p:
+            dp_val_id = dp_val_id[:, tie_perm]
+            dp_val_ok = dp_val_ok[:, tie_perm]
+        inv = jnp.zeros(n, jnp.int32).at[tie_perm].set(
+            jnp.arange(n, dtype=jnp.int32))
+        penalty_idx = jnp.where(penalty_idx >= 0, inv[penalty_idx], -1)
 
     def step(carry, xs):
         used, ptg, pjob, scnt, dpcnt, lowest = carry
@@ -285,6 +311,8 @@ def solve_task_group(
             lowest_boost0)
     _, (choices, founds, scores) = jax.lax.scan(
         init=init, f=step, xs=(penalty_idx, active))
+    if tie_perm is not None:
+        choices = tie_perm[choices]
     return choices, founds, scores
 
 
@@ -298,8 +326,8 @@ def solve_task_group(
 # one packed output so a whole task-group solve costs one upload batch
 # and one readback.
 #
-# node_mat (N, 2D+5): avail[D] | used[D] | placed_tg | placed_job | feasible
-#                     | affinity | dev_affinity
+# node_mat (N, 2D+6): avail[D] | used[D] | placed_tg | placed_job | feasible
+#                     | affinity | dev_affinity | tie_perm
 # step_mat (K, 2):  penalty_idx | active
 # spread_node (2S, N): val_id rows then val_ok rows
 # spread_tab (2S, V):  counts rows then desired rows
@@ -315,7 +343,7 @@ def pack_solve_args(available, used0, placed_tg0, placed_job0, ask, feasible,
                     spread_has_targets, spread_weight, lowest_boost0,
                     tg_count, dh_job, dh_tg, spread_alg,
                     dev_affinity=None, dp_val_id=None, dp_val_ok=None,
-                    dp_counts0=None, dp_limit=None):
+                    dp_counts0=None, dp_limit=None, tie_perm=None):
     """Host-side packing (numpy) for solve_task_group_fused."""
     import numpy as np
 
@@ -323,11 +351,13 @@ def pack_solve_args(available, used0, placed_tg0, placed_job0, ask, feasible,
     n = np.asarray(available).shape[0]
     if dev_affinity is None:
         dev_affinity = np.zeros(n, f)
+    if tie_perm is None:
+        tie_perm = np.arange(n)
     node_mat = np.concatenate([
         np.asarray(available, f), np.asarray(used0, f),
         np.asarray(placed_tg0, f)[:, None], np.asarray(placed_job0, f)[:, None],
         np.asarray(feasible, f)[:, None], np.asarray(affinity_boost, f)[:, None],
-        np.asarray(dev_affinity, f)[:, None],
+        np.asarray(dev_affinity, f)[:, None], np.asarray(tie_perm, f)[:, None],
     ], axis=1)
     step_mat = np.stack([np.asarray(penalty_idx, f),
                          np.asarray(active, f)], axis=1)
@@ -360,7 +390,7 @@ def solve_task_group_fused(node_mat, step_mat, spread_node, spread_tab,
     one (3, K) array of [choice, found, score] rows."""
     s = spread_meta.shape[0]
     p = dp_node.shape[0] // 2
-    d = (node_mat.shape[1] - 5) // 2
+    d = (node_mat.shape[1] - 6) // 2
     choices, founds, scores = solve_task_group(
         node_mat[:, 0:d], node_mat[:, d:2 * d],
         node_mat[:, 2 * d].astype(jnp.int32),
@@ -375,6 +405,7 @@ def solve_task_group_fused(node_mat, step_mat, spread_node, spread_tab,
         dp_tab[:, :-1].astype(jnp.int32), dp_tab[:, -1],
         scalars[0], scalars[1], scalars[2] > 0.5, scalars[3] > 0.5,
         scalars[4] > 0.5,
+        node_mat[:, 2 * d + 5].astype(jnp.int32),
     )
     return jnp.stack([choices.astype(scores.dtype),
                       founds.astype(scores.dtype), scores])

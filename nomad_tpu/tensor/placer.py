@@ -23,6 +23,7 @@ that request alone.
 
 from __future__ import annotations
 
+import zlib
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -62,14 +63,23 @@ class TPUPlacer:
                 commit(req, None)
             return
 
-        # Per-eval node shuffle, same seed discipline as the host path
-        # (reference scheduler/util.go:167 shuffleNodes): scores are
-        # order-invariant, but the kernel's argmax tie-breaks by index —
-        # without the shuffle every concurrently-racing worker picks the
-        # same winners among equal-scoring nodes and the plan applier
-        # rejects all but one (optimistic-concurrency livelock).
-        nodes = ctx.shuffled_nodes(list(nodes), attempt)
+        # Per-eval tie-break permutation, same seed discipline as the
+        # host path's node shuffle (reference scheduler/util.go:167
+        # shuffleNodes): scores are order-invariant, but the kernel's
+        # argmax tie-breaks by priority order — without it every
+        # concurrently-racing worker picks the same winners among
+        # equal-scoring nodes and the plan applier rejects all but one
+        # (optimistic-concurrency livelock). The permutation rides INTO
+        # the kernel so the host-side node order stays canonical and the
+        # per-node arrays stay cacheable across evals (ClusterStatic).
         cluster = ClusterTensors.build(ctx, nodes)
+        nodes = cluster.nodes
+        # crc32, not hash(): the seed must be deterministic ACROSS
+        # processes (leader failover replaying an eval must explore the
+        # same permutation), and hash() is salted per process
+        seed = zlib.crc32(f"{ctx.eval_id}:{attempt}".encode())
+        tie_perm = np.random.default_rng(seed).permutation(
+            cluster.n_pad).astype(np.int32)
 
         # group requests per task group, preserving intra-group order
         groups: Dict[str, List[PlacementRequest]] = {}
@@ -116,7 +126,8 @@ class TPUPlacer:
                 -1.0, tgt.tg_count, tgt.dh_job, tgt.dh_tg, tgt.spread_alg,
                 dev_affinity=tgt.dev_affinity,
                 dp_val_id=tgt.dp_val_id, dp_val_ok=tgt.dp_val_ok,
-                dp_counts0=tgt.dp_counts, dp_limit=tgt.dp_limit)
+                dp_counts0=tgt.dp_counts, dp_limit=tgt.dp_limit,
+                tie_perm=tie_perm)
             out = np.asarray(solve_task_group_fused(*packed))  # one readback
             choices = out[0].astype(np.int64)
             founds = out[1] > 0.5
@@ -126,7 +137,7 @@ class TPUPlacer:
             # host-side, per chosen node, after the solve (the kernel only
             # fit-checked the counts); per-node indexes carry assignments
             # across this group's placements so they don't double-book
-            ask_res = tg.combined_resources()
+            ask_res = ctx.tg_resources(tg)
             wants_ports = bool(ask_res.reserved_port_asks()
                                or ask_res.dynamic_port_count())
             wants_devices = bool(ask_res.devices)

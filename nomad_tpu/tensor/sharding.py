@@ -63,6 +63,10 @@ def pad_node_axis(args: tuple, multiple: int) -> tuple:
     args[11] = _pad(args[11], 1, False)    # spread_val_ok
     args[16] = _pad(args[16], 1, 0)        # dp_val_id
     args[17] = _pad(args[17], 1, False)    # dp_val_ok
+    if len(args) > 25 and args[25] is not None:
+        # tie_perm: dummy rows get the lowest priority, appended at the end
+        args[25] = np.concatenate([
+            np.asarray(args[25], np.int32), np.arange(n, n + pad, dtype=np.int32)])
     return tuple(args)
 
 
@@ -82,7 +86,8 @@ def shard_solve_args(mesh: Mesh, args: tuple, axis: str = "nodes"):
       7 dev_affinity (N,) sharded   17 dp_val_ok (P,N)      sharded ax1
       8 penalty_idx (K,)  repl      18 dp_counts0 (P,Vd)    repl
       9 active (K,)       repl      19 dp_limit (P,)        repl
-                                    20.. scalars            repl
+                                    20..24 scalars          repl
+                                    25 tie_perm (N,)        repl
     """
     args = pad_node_axis(args, int(np.prod(mesh.devices.shape)))
     specs = [
@@ -94,7 +99,8 @@ def shard_solve_args(mesh: Mesh, args: tuple, axis: str = "nodes"):
     specs += [P()] * (len(args) - len(specs))
     out = []
     for a, spec in zip(args, specs):
-        out.append(jax.device_put(a, NamedSharding(mesh, spec)))
+        out.append(a if a is None
+                   else jax.device_put(a, NamedSharding(mesh, spec)))
     return tuple(out)
 
 
