@@ -154,6 +154,7 @@ class _OverlaySnapshot:
     def __init__(self, snap, results: List[PlanResult]):
         self._snap = snap
         self._replaced: Dict[str, dict] = {}
+        self._usage_deltas: Dict[str, object] = {}
         for result in results:  # later results override earlier ones
             for node_id in (set(result.node_allocation)
                             | set(result.node_update)
@@ -166,6 +167,28 @@ class _OverlaySnapshot:
 
     def node_by_id(self, node_id):
         return self._snap.node_by_id(node_id)
+
+    def node_usage(self, node_id):
+        """Usage row (the scheduler's `not terminal_status()` predicate)
+        with the in-flight results' net effect folded in — powers the
+        applier's vectorized fit pass through overlays too."""
+        base = self._snap.node_usage(node_id)
+        by_id = self._replaced.get(node_id)
+        if not by_id:
+            return base
+        delta = self._usage_deltas.get(node_id)
+        if delta is None:
+            delta = 0.0
+            for aid, a in by_id.items():
+                if not a.terminal_status():
+                    delta = delta + a.allocated_vec
+                base_a = self._snap.alloc_by_id(aid)
+                if base_a is not None and not base_a.terminal_status():
+                    delta = delta - base_a.allocated_vec
+            self._usage_deltas[node_id] = delta
+        if base is None:
+            return delta if by_id else None
+        return base + delta
 
     def allocs_by_node(self, node_id):
         overlay = self._replaced.get(node_id)
@@ -391,19 +414,54 @@ class PlanApplier:
         result, rejected = self._verify(plan, None)
         return self._commit(plan, result, rejected)
 
+    # Nodes whose plan entries are all NEW, port/device/core-free
+    # placements verify as one vectorized numpy fit pass when at least
+    # this many qualify (below it the python loop wins on set-up cost).
+    VECTOR_THRESHOLD = 16
+
     def _evaluate(self, snap, plan: Plan) -> Tuple[PlanResult, List[str]]:
         """Per-node re-verification (reference plan_apply.go:468
         evaluatePlan + :717 evaluateNodePlan). all_at_once plans commit
-        fully or not at all (structs Plan.AllAtOnce)."""
+        fully or not at all (structs Plan.AllAtOnce).
+
+        The GIL-free scale path (reference plan_apply_pool.go:21
+        EvaluatePool's role): nodes touched ONLY by new placements that
+        carry no ports/devices/cores — the entire bulk-placement shape —
+        skip the per-node alloc walk entirely. Their fit check is
+        usage_row + sum(new vecs) <= available, batched into one numpy
+        comparison; the accounting is exactly _node_plan_valid's
+        (existing filters `not terminal_status()`, the usage rows'
+        predicate, and no new ports/cores means no new collision is
+        possible). Everything else keeps the exact python check."""
         result = PlanResult()
         rejected: List[str] = []
         nodes = sorted(set(plan.node_allocation) | set(plan.node_update)
                        | set(plan.node_preemptions))
-        if len(nodes) >= self.PARALLEL_THRESHOLD and self._pool is not None:
-            verdicts = list(self._pool.map(
-                lambda nid: self._node_plan_valid(snap, plan, nid), nodes))
+        fast: List[str] = []
+        exact: List[str] = []
+        for nid in nodes:
+            if nid in plan.node_update or nid in plan.node_preemptions:
+                exact.append(nid)
+                continue
+            if all(a.create_index == 0 and not a.allocated_ports
+                   and not a.allocated_devices and not a.allocated_cores
+                   for a in plan.node_allocation.get(nid, ())):
+                fast.append(nid)
+            else:
+                exact.append(nid)
+        if len(fast) < self.VECTOR_THRESHOLD:
+            exact.extend(fast)
+            fast = []
+        verdict: Dict[str, bool] = {}
+        if fast:
+            verdict.update(self._vector_verdicts(snap, plan, fast))
+        if len(exact) >= self.PARALLEL_THRESHOLD and self._pool is not None:
+            verdict.update(zip(exact, self._pool.map(
+                lambda nid: self._node_plan_valid(snap, plan, nid), exact)))
         else:
-            verdicts = [self._node_plan_valid(snap, plan, nid) for nid in nodes]
+            for nid in exact:
+                verdict[nid] = self._node_plan_valid(snap, plan, nid)
+        verdicts = [verdict[nid] for nid in nodes]
         vol_bad = self._volume_rejections(snap, plan)
         for node_id, ok in zip(nodes, verdicts):
             if ok and node_id not in vol_bad:
@@ -474,6 +532,33 @@ class PlanApplier:
                 else:
                     bad.add(node_id)
         return bad
+
+    def _vector_verdicts(self, snap, plan: Plan,
+                         node_ids: List[str]) -> Dict[str, bool]:
+        """Batched fit re-check for new-placements-only nodes: one
+        (M, D) numpy comparison instead of M python alloc walks."""
+        import numpy as np
+
+        from ..structs.resources import RESOURCE_DIMS
+
+        m = len(node_ids)
+        used = np.zeros((m, RESOURCE_DIMS))
+        avail = np.zeros((m, RESOURCE_DIMS))
+        ok = np.ones(m, dtype=bool)
+        for i, nid in enumerate(node_ids):
+            node = snap.node_by_id(nid)
+            if node is None or node.status != enums.NODE_STATUS_READY \
+                    or node.drain:
+                ok[i] = False
+                continue
+            base = snap.node_usage(nid)
+            if base is not None:
+                used[i] = base
+            for a in plan.node_allocation[nid]:
+                used[i] += a.allocated_vec
+            avail[i] = node.available_vec()
+        ok &= (used <= avail).all(axis=1)
+        return dict(zip(node_ids, ok.tolist()))
 
     def _node_plan_valid(self, snap, plan: Plan, node_id: str) -> bool:
         node = snap.node_by_id(node_id)

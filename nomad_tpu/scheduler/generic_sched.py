@@ -332,6 +332,45 @@ class GenericScheduler:
             self.plan.append_alloc(alloc)
             self.queued_allocs[tg.name] = self.queued_allocs.get(tg.name, 0) + 1
 
+        def commit_many(tg, node, reqs, mean_score):
+            """Bulk fast path: semantically the `commit(req, option)`
+            success arm specialized to fresh placements (no canary, no
+            previous_alloc, no ports/devices/cores — the placer's bulk
+            eligibility), with the per-request constants hoisted out of
+            the loop."""
+            dep_id = (self.deployment.id
+                      if self.deployment is not None
+                      and tg.update is not None else "")
+            vec = ctx.tg_vec(tg)
+            bucket = self.plan.node_allocation.setdefault(node.id, [])
+            tg_name = tg.name
+            node_id, node_name = node.id, node.name
+            metrics = ctx.metrics
+            if metrics is not None:
+                metrics.scores.setdefault("bulk.normalized-score", mean_score)
+            for req in reqs:
+                bucket.append(Allocation(
+                    id=generate_uuid(),
+                    eval_id=ev.id,
+                    deployment_id=dep_id,
+                    name=req.name,
+                    namespace=job.namespace,
+                    node_id=node_id,
+                    node_name=node_name,
+                    job_id=job.id,
+                    job=job,
+                    job_version=job.version,
+                    task_group=tg_name,
+                    allocated_vec=vec,
+                    desired_status=enums.ALLOC_DESIRED_RUN,
+                    client_status=enums.ALLOC_CLIENT_PENDING,
+                    metrics=metrics,
+                    allocated_at=now,
+                ))
+            self.queued_allocs[tg_name] = (
+                self.queued_allocs.get(tg_name, 0) + len(reqs))
+
+        commit.commit_many = commit_many
         placer.place(
             ctx, job, requests, nodes, commit,
             batch=self.batch, preemption_enabled=preemption_enabled,

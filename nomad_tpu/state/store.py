@@ -819,12 +819,16 @@ class StateStore:
             for alloc in preempted_allocs:
                 self._put_alloc(alloc, gen, live, ts)
                 events.append(("alloc-preempt", alloc))
+            new_allocs: List[Allocation] = []
             for alloc in result_allocs:
-                prev_row = self._allocs.get_latest(alloc.id)
+                if (alloc.create_index == 0
+                        and self._allocs.get_latest(alloc.id) is None):
+                    new_allocs.append(alloc)
+                    continue
                 self._put_alloc(alloc, gen, live, ts)
                 events.append(("alloc-upsert", alloc))
-                if prev_row is None:  # new placements claim their volumes
-                    self._claim_volumes_for(alloc, gen, live, events)
+            if new_allocs:
+                self._put_new_allocs_bulk(new_allocs, gen, live, ts, events)
             if deployment is not None:
                 self._put_deployment(deployment, gen, live)
                 events.append(("deployment-upsert", deployment))
@@ -842,6 +846,52 @@ class StateStore:
                 events.append(("eval-upsert", ev))
             self._commit(gen, events)
             return gen
+
+    def _put_new_allocs_bulk(self, allocs: List[Allocation], gen: int,
+                             live: int, ts: float, events: list) -> None:
+        """First-insert fast path for plan placements (the 2M-alloc
+        shape): per-node usage deltas accumulate before touching the
+        MVCC rows, and each secondary index key gets ONE put with all
+        its new ids consed on — instead of five table round-trips per
+        allocation. Semantically identical to _put_alloc for rows that
+        don't exist yet (the caller checked)."""
+        by_node: Dict[str, list] = {}
+        by_job: Dict[tuple, list] = {}
+        by_eval: Dict[str, list] = {}
+        usage: Dict[str, object] = {}
+        vol_memo: Dict[tuple, bool] = {}
+        for a in allocs:
+            a.modify_time = ts
+            a.create_index = gen
+            a.modify_index = gen
+            self._allocs.put(a.id, a, gen, live)
+            by_node.setdefault(a.node_id, []).append(a.id)
+            by_job.setdefault((a.namespace, a.job_id), []).append(a.id)
+            by_eval.setdefault(a.eval_id, []).append(a.id)
+            if not a.terminal_status():
+                u = usage.get(a.node_id)
+                usage[a.node_id] = (a.allocated_vec if u is None
+                                    else u + a.allocated_vec)
+                if a.allocated_devices or a.allocated_cores:
+                    self._dev_usage_add(a, +1, gen, live)
+            key = (a.namespace, a.job_id, a.task_group)
+            has_vols = vol_memo.get(key)
+            if has_vols is None:
+                tg = a.job.lookup_task_group(a.task_group) if a.job else None
+                has_vols = vol_memo[key] = bool(tg is not None and tg.volumes)
+            if has_vols:
+                self._claim_volumes_for(a, gen, live, events)
+            events.append(("alloc-upsert", a))
+        for node_id, delta in usage.items():
+            self._usage_add(node_id, delta, gen, live)
+        for table, groups in ((self._allocs_by_node, by_node),
+                              (self._allocs_by_job, by_job),
+                              (self._allocs_by_eval, by_eval)):
+            for key, ids in groups.items():
+                cell = table.get_latest(key)
+                for _id in ids:
+                    cell = cons(_id, cell)
+                table.put(key, cell, gen, live)
 
     # --- deployments ---
 

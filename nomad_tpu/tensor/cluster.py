@@ -55,7 +55,7 @@ class ClusterStatic:
 
     __slots__ = ("nodes", "n_pad", "available", "node_index", "usage_rows",
                  "version", "mask_cache", "aff_cache", "intern_cache",
-                 "dev_cache")
+                 "dev_cache", "device_arrays")
 
     def __init__(self, nodes: Sequence[Node], store=None, version=None):
         n = len(nodes)
@@ -73,6 +73,11 @@ class ClusterStatic:
         self.aff_cache: Dict[tuple, np.ndarray] = {}
         self.intern_cache: Dict[tuple, tuple] = {}
         self.dev_cache: Dict[tuple, tuple] = {}
+        # device-RESIDENT copies of static arrays (capacity, masks,
+        # affinity vectors), uploaded once per node-set version so the
+        # bulk solve ships only its per-eval dynamic matrix over the
+        # tunnel (tensor/kernels.py solve_bulk_fused)
+        self.device_arrays: Dict = {}
 
 
 def _static_for(ctx: EvalContext, nodes: Sequence[Node]):
@@ -230,6 +235,10 @@ class TaskGroupTensors:
     dp_val_ok: np.ndarray = None    # (P, Np) bool
     dp_counts: np.ndarray = None    # (P, Vd) int32
     dp_limit: np.ndarray = None     # (P,)
+    # the SHARED cached mask instance when `feasible` is exactly the
+    # static mask (no per-eval csi/ports adjustments): its identity keys
+    # the device-resident copy for the bulk solve
+    feas_base: np.ndarray = None
 
 
 def _affinity_vector(ctx: EvalContext, job: Job, tg: TaskGroup,
@@ -241,9 +250,16 @@ def _affinity_vector(ctx: EvalContext, job: Job, tg: TaskGroup,
     nodes, n_pad = cluster.nodes, cluster.n_pad
     affinities = (list(job.affinities) + list(tg.affinities)
                   + [a for t in tg.tasks for a in t.affinities])
-    if not affinities:
-        return np.zeros(n_pad)
     static = cluster.static
+    if not affinities:
+        if static is not None:
+            # a stable zero instance so the device-resident cache can
+            # key on identity
+            hit = static.aff_cache.get(())
+            if hit is None:
+                hit = static.aff_cache[()] = np.zeros(n_pad)
+            return hit
+        return np.zeros(n_pad)
     sig = tuple((a.ltarget, a.operand, a.rtarget, a.weight)
                 for a in affinities)
     if static is not None:
@@ -524,20 +540,29 @@ def build_task_group_tensors(
     nodes = cluster.nodes
     n_pad = cluster.n_pad
 
-    feas = np.zeros(n_pad, dtype=bool)
     static = cluster.static
+    feas_base = None
     if static is not None:
         sig = tg_mask_signature(job, tg)
         base = static.mask_cache.get(sig)
         if base is None:
-            base = feasible_mask_static(job, tg, nodes,
-                                        ctx.regex_cache, ctx.version_cache)
+            base = np.zeros(n_pad, dtype=bool)
+            base[: len(nodes)] = feasible_mask_static(
+                job, tg, nodes, ctx.regex_cache, ctx.version_cache)
+            base.setflags(write=False)
             static.mask_cache[sig] = base
-        feas[: len(nodes)] = base
         if any(v.type == "csi" for v in tg.volumes.values()):
+            feas = base.copy()
             feas[: len(nodes)] &= csi_volume_mask(
                 tg, nodes, ctx.snapshot, job.namespace, ctx.plan)
+        else:
+            # the cached padded mask itself: stable identity keys the
+            # device-resident copy (placer bulk path). Copied before any
+            # per-eval mutation (reserved-ports AND below).
+            feas = base
+            feas_base = base
     else:
+        feas = np.zeros(n_pad, dtype=bool)
         feas[: len(nodes)] = feasible_mask(
             job, tg, nodes, ctx.regex_cache, ctx.version_cache,
             snapshot=ctx.snapshot, plan=ctx.plan)
@@ -552,6 +577,8 @@ def build_task_group_tensors(
     # the kernel already enforces. Dynamic-port exhaustion is the R_PORTS
     # dimension of ask/available; exact numbers assigned post-solve.
     if ctx.tg_resources(tg).reserved_port_asks():
+        feas = feas.copy()  # may be the shared read-only cached mask
+        feas_base = None
         feas[: len(nodes)] &= reserved_ports_mask(tg, nodes, ctx.proposed_allocs)
         dh_tg = True
 
@@ -584,4 +611,5 @@ def build_task_group_tensors(
         dp_val_ok=dp_val_ok,
         dp_counts=dp_counts,
         dp_limit=dp_limit,
+        feas_base=feas_base,
     )

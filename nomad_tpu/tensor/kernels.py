@@ -196,6 +196,21 @@ def score_nodes(
     return jnp.where(ok, final, NEG), fitness, boost
 
 
+def _permute_node_axis(tie_perm, available, used0, placed_tg0, placed_job0,
+                       feasible, affinity_boost, dev_affinity,
+                       spread_val_id, spread_val_ok, dp_val_id, dp_val_ok):
+    """Gather every per-node array into tie-break-permuted space — the
+    single definition shared by the per-placement scan and the bulk
+    solver, so a new per-node input can't be permuted in one and
+    forgotten in the other."""
+    return (available[tie_perm], used0[tie_perm], placed_tg0[tie_perm],
+            placed_job0[tie_perm], feasible[tie_perm],
+            affinity_boost[tie_perm], dev_affinity[tie_perm],
+            spread_val_id[:, tie_perm], spread_val_ok[:, tie_perm],
+            dp_val_id[:, tie_perm] if dp_val_id.shape[0] else dp_val_id,
+            dp_val_ok[:, tie_perm] if dp_val_ok.shape[0] else dp_val_ok)
+
+
 @partial(jax.jit, donate_argnums=())
 def solve_task_group(
     available,         # (N, D)
@@ -248,18 +263,12 @@ def solve_task_group(
     p = dp_val_id.shape[0]
     n = available.shape[0]
     if tie_perm is not None:
-        available = available[tie_perm]
-        used0 = used0[tie_perm]
-        placed_tg0 = placed_tg0[tie_perm]
-        placed_job0 = placed_job0[tie_perm]
-        feasible = feasible[tie_perm]
-        affinity_boost = affinity_boost[tie_perm]
-        dev_affinity = dev_affinity[tie_perm]
-        spread_val_id = spread_val_id[:, tie_perm]
-        spread_val_ok = spread_val_ok[:, tie_perm]
-        if p:
-            dp_val_id = dp_val_id[:, tie_perm]
-            dp_val_ok = dp_val_ok[:, tie_perm]
+        (available, used0, placed_tg0, placed_job0, feasible,
+         affinity_boost, dev_affinity, spread_val_id, spread_val_ok,
+         dp_val_id, dp_val_ok) = _permute_node_axis(
+            tie_perm, available, used0, placed_tg0, placed_job0, feasible,
+            affinity_boost, dev_affinity, spread_val_id, spread_val_ok,
+            dp_val_id, dp_val_ok)
         inv = jnp.zeros(n, jnp.int32).at[tie_perm].set(
             jnp.arange(n, dtype=jnp.int32))
         penalty_idx = jnp.where(penalty_idx >= 0, inv[penalty_idx], -1)
@@ -409,6 +418,168 @@ def solve_task_group_fused(node_mat, step_mat, spread_node, spread_tab,
     )
     return jnp.stack([choices.astype(scores.dtype),
                       founds.astype(scores.dtype), scores])
+
+
+# ---------------------------------------------------------------------------
+# bulk solve: K identical placements as counts, O(K/B) sequential steps
+# ---------------------------------------------------------------------------
+#
+# The C2M engine. A fresh job's task group asks for K identical
+# placements; the per-placement scan costs K sequential steps (the
+# sequential chain is the latency floor at K=4096). This solver instead
+# assigns a BATCH of B placements per step: score all nodes once
+# (identical math to score_nodes), then give the best-scoring nodes
+# their fill in score order — per-node capacity for binpack (the greedy
+# winner keeps winning until full, so fill-to-capacity IS the greedy
+# trajectory), one per node per step for spread (approximating the
+# round-robin; parity is measured, not assumed). Counts, not choices,
+# come back: one (N,) readback regardless of K. This is the
+# "batched feasibility masking + scoring + assignment" shape BASELINE.md
+# names as the north-star design.
+
+
+def _bulk_scan(
+    available,         # (N, D)
+    used0,             # (N, D)
+    ask,               # (D,)
+    feasible,          # (N,) bool
+    placed_tg0,        # (N,) int32
+    placed_job0,       # (N,) int32
+    affinity_boost,    # (N,)
+    dev_affinity,      # (N,)
+    spread_val_id,     # (S, N) int32
+    spread_val_ok,     # (S, N) bool
+    spread_counts0,    # (S, V) int32
+    spread_desired,    # (S, V)
+    spread_has_targets,  # (S,) bool
+    spread_weight,     # (S,)
+    k_total,           # () int32 placements wanted
+    tg_count,          # ()
+    dh_job,            # () bool
+    dh_tg,             # () bool
+    spread_alg,        # () bool
+    tie_perm,          # (N,) int32
+    *,
+    batch: int,        # placements per step
+    n_steps: int,      # static scan length >= ceil(k_total / batch)
+):
+    """-> packed (N+2,) float array: per-node placement counts in
+    canonical order, then [placed_total, score_sum] — ONE readback
+    regardless of K. Runs in permuted node space like solve_task_group;
+    counts map back at the end. (Counts stay exact in float32 up to
+    2^24, far beyond any single task group.)"""
+    n = available.shape[0]
+    s = spread_val_id.shape[0]
+    dp_val_id = jnp.zeros((0, n), jnp.int32)
+    dp_val_ok = jnp.zeros((0, n), bool)
+    dp_counts = jnp.zeros((0, 1), jnp.int32)
+    dp_limit = jnp.zeros(0)
+    (available, used0, placed_tg0, placed_job0, feasible,
+     affinity_boost, dev_affinity, spread_val_id, spread_val_ok,
+     dp_val_id, dp_val_ok) = _permute_node_axis(
+        tie_perm, available, used0, placed_tg0, placed_job0, feasible,
+        affinity_boost, dev_affinity, spread_val_id, spread_val_ok,
+        dp_val_id, dp_val_ok)
+
+    # per-node max one placement under distinct_hosts; else fill for
+    # binpack, one-per-step for spread (WorstFit drops a node's score
+    # after each placement, so greedy round-robins)
+    single = dh_job | dh_tg | spread_alg
+
+    ask_pos = ask > 0
+
+    def step(carry, _):
+        used, ptg, pjob, scnt, taken, remaining, score_sum = carry
+        score, _, _ = score_nodes(
+            available=available, used=used, ask=ask, feasible=feasible,
+            placed_tg=ptg, placed_job=pjob, affinity_boost=affinity_boost,
+            dev_affinity=dev_affinity, penalty_idx=jnp.int32(-1),
+            spread_val_id=spread_val_id, spread_val_ok=spread_val_ok,
+            spread_counts=scnt, spread_desired=spread_desired,
+            spread_has_targets=spread_has_targets, spread_weight=spread_weight,
+            dp_val_id=dp_val_id, dp_val_ok=dp_val_ok, dp_counts=dp_counts,
+            dp_limit=dp_limit,
+            lowest_boost=-1.0, tg_count=tg_count,
+            dh_job=dh_job, dh_tg=dh_tg, spread_alg=spread_alg,
+        )
+        budget = jnp.minimum(remaining, batch)
+        # how many MORE fit on each node; a zero ask in every dimension
+        # means infinite per-node capacity, so clamp to the step budget
+        # BEFORE the int32 cast (inf -> INT32_MAX would overflow the
+        # cumsum below)
+        free = available - used
+        per_dim = jnp.where(ask_pos[None, :], jnp.floor(free / jnp.where(
+            ask_pos, ask, 1.0)[None, :]), jnp.inf)
+        cap = jnp.min(per_dim, axis=1)
+        cap = jnp.clip(cap, 0, None)
+        cap = jnp.where(score > NEG, cap, 0.0)
+        cap = jnp.where(single, jnp.minimum(cap, 1.0), cap)
+        cap = jnp.minimum(cap, budget.astype(cap.dtype)).astype(jnp.int32)
+        order = jnp.argsort(-score)               # stable: ties by index
+        cap_sorted = cap[order]
+        cum = jnp.cumsum(cap_sorted)
+        take_sorted = jnp.clip(budget - (cum - cap_sorted), 0, cap_sorted)
+        take = jnp.zeros(n, jnp.int32).at[order].set(take_sorted)
+
+        used = used + ask[None, :] * take[:, None].astype(used.dtype)
+        ptg = ptg + take
+        pjob = pjob + take
+        if s:
+            scnt = scnt.at[jnp.arange(s)[:, None], spread_val_id].add(
+                jnp.where(spread_val_ok, take[None, :], 0))
+        placed_now = jnp.sum(take).astype(jnp.int32)
+        score_sum = score_sum + jnp.sum(score * take)
+        return (used, ptg, pjob, scnt, taken + take,
+                remaining - placed_now, score_sum), None
+
+    init = (used0, placed_tg0, placed_job0, spread_counts0,
+            jnp.zeros(n, jnp.int32), jnp.int32(k_total),
+            jnp.zeros((), dtype=available.dtype))
+    (used, ptg, pjob, scnt, taken, remaining, score_sum), _ = jax.lax.scan(
+        init=init, f=step, xs=None, length=n_steps)
+    counts = jnp.zeros(n, jnp.int32).at[tie_perm].set(taken)
+    f = available.dtype
+    return jnp.concatenate([
+        counts.astype(f),
+        jnp.stack([(k_total - remaining).astype(f), score_sum.astype(f)])])
+
+
+solve_bulk = partial(jax.jit, static_argnames=("batch", "n_steps"))(_bulk_scan)
+
+
+@partial(jax.jit, static_argnames=("batch", "n_steps"))
+def solve_bulk_fused(
+    available,   # (N, D) — device-RESIDENT per node-set version
+    feasible,    # (N,) bool — resident per task-group mask signature
+    aff,         # (N,) — resident per affinity signature
+    dyn,         # (N, D+2) float32: used | placed_tg | placed_job (per eval)
+    ask,         # (D,)
+    k_total,     # () int32
+    tg_count,    # () float
+    seed,        # () uint32: tie-break permutation PRNG seed
+    *,
+    batch: int,
+    n_steps: int,
+):
+    """Transfer-minimal bulk solve: the big static arrays live on the
+    device across evals (the tunnel moves ~100ms per synchronous hop —
+    see the fused-transfer note above); each eval ships one (N, D+2)
+    f32 matrix + a handful of scalars, and the tie-break permutation is
+    generated ON DEVICE from the seed. No spread/dh/dp tables by bulk
+    eligibility (placer._bulk_eligible)."""
+    n, d = available.shape
+    tie_perm = jax.random.permutation(
+        jax.random.PRNGKey(seed), n).astype(jnp.int32)
+    f = available.dtype
+    return _bulk_scan(
+        available, dyn[:, :d].astype(f), ask.astype(f), feasible,
+        dyn[:, d].astype(jnp.int32), dyn[:, d + 1].astype(jnp.int32),
+        aff.astype(f), jnp.zeros(n, f),
+        jnp.zeros((0, n), jnp.int32), jnp.zeros((0, n), bool),
+        jnp.zeros((0, 1), jnp.int32), jnp.zeros((0, 1), f),
+        jnp.zeros(0, bool), jnp.zeros(0, f),
+        k_total, tg_count, False, False, False, tie_perm,
+        batch=batch, n_steps=n_steps)
 
 
 @partial(jax.jit, static_argnames=())

@@ -100,6 +100,13 @@ class TPUPlacer:
             tgt = build_task_group_tensors(ctx, job, tg, cluster,
                                            algorithm=self.algorithm)
 
+            if self._bulk_eligible(ctx, tg, reqs, tgt):
+                self._place_bulk(ctx, job, tg, reqs, cluster, tgt, commit,
+                                 tie_perm, seed, sched_batch=batch,
+                                 preemption_enabled=preemption_enabled,
+                                 attempt=attempt)
+                continue
+
             k = len(reqs)
             k_pad = _pad_pow2(k, floor=1)
             penalty_idx = np.full(k_pad, -1, dtype=np.int32)
@@ -210,19 +217,187 @@ class TPUPlacer:
                                               net_idx, dev_idx, core_used)
                         continue
                     metrics = ctx.metrics or metrics
-                # attribute the failure the way the host path would: nodes
-                # masked by constraints/drivers are "filtered", nodes that
-                # passed feasibility but didn't fit are "exhausted"
-                # (reference feasible.go filter vs rank.go exhaust metrics)
-                masked = len(nodes) - n_feasible
-                if masked:
-                    metrics.nodes_filtered += masked
-                    metrics.constraint_filtered["task group constraints"] = (
-                        metrics.constraint_filtered.get("task group constraints", 0)
-                        + masked)
-                if n_feasible > 0:
-                    metrics.exhaust_node("resources")
+                self._attribute_failure(ctx, metrics, len(nodes), n_feasible)
                 commit(req, None)
+
+    # -- bulk (count-based) solve: the C2M path --
+
+    BULK_MIN = 256     # below this the per-placement scan is fine
+    BULK_STEP = 256    # placements assigned per scan step
+
+    def _bulk_eligible(self, ctx, tg, reqs, tgt) -> bool:
+        """K large, every request a fresh placement, BestFit binpack with
+        no spread/distinct-hosts semantics (fill-to-capacity is only the
+        exact greedy trajectory for BestFit: the winner keeps winning
+        until full; WorstFit/spread round-robin per placement, which a
+        batched step would mis-place — measured, not guessed), and
+        nothing that needs per-alloc host-side id assignment (exact
+        ports, device instances, cores) or distinct_property tables."""
+        if len(reqs) < self.BULK_MIN:
+            return False
+        if tgt.spread_alg or tgt.dh_job or tgt.dh_tg:
+            return False
+        if tgt.spread_val_id.shape[0]:
+            return False
+        if tgt.extra_ask is not None and len(tgt.extra_ask):
+            return False
+        if tgt.dp_val_id is not None and tgt.dp_val_id.shape[0]:
+            return False
+        ask_res = ctx.tg_resources(tg)
+        if ask_res.reserved_port_asks() or ask_res.dynamic_port_count():
+            return False
+        return all(req.previous_alloc is None and not req.ignore_node
+                   and not req.canary for req in reqs)
+
+    def _place_bulk(self, ctx, job, tg, reqs, cluster, tgt, commit,
+                    tie_perm, seed, *, sched_batch: bool,
+                    preemption_enabled: bool, attempt: int) -> None:
+        """Place K identical requests as per-node COUNTS from one
+        solve_bulk launch (one readback regardless of K), then commit
+        through the scheduler's normal commit callback so plan assembly
+        stays authoritative. With a cached ClusterStatic the fused entry
+        runs against device-resident capacity/mask/affinity arrays and
+        ships only the (N, D+2) dynamic matrix + scalars per eval."""
+        from .kernels import solve_bulk, solve_bulk_fused
+
+        k = len(reqs)
+        k_pad = _pad_pow2(k, floor=self.BULK_STEP)
+        n_steps = k_pad // self.BULK_STEP
+        static = cluster.static
+        if static is not None and tgt.feas_base is not None:
+            import jax
+
+            f32 = np.float32
+            da = static.device_arrays
+            avail_dev = da.get("avail")
+            if avail_dev is None:
+                avail_dev = da["avail"] = jax.device_put(
+                    cluster.available.astype(f32))
+            mkey = ("m", id(tgt.feas_base))
+            feas_dev = da.get(mkey)
+            if feas_dev is None:
+                feas_dev = da[mkey] = jax.device_put(tgt.feas_base)
+            akey = ("a", id(tgt.affinity_boost))
+            aff_dev = da.get(akey)
+            if aff_dev is None:
+                aff_dev = da[akey] = jax.device_put(
+                    tgt.affinity_boost.astype(f32))
+            dyn = np.concatenate(
+                [cluster.used, tgt.placed_tg[:, None],
+                 tgt.placed_job[:, None]], axis=1).astype(f32)
+            out = np.asarray(solve_bulk_fused(
+                avail_dev, feas_dev, aff_dev, dyn, tgt.ask.astype(f32),
+                np.int32(k), f32(tgt.tg_count), np.uint32(seed),
+                batch=self.BULK_STEP, n_steps=n_steps))
+        else:
+            out = np.asarray(solve_bulk(
+                cluster.available, cluster.used, tgt.ask, tgt.feasible,
+                tgt.placed_tg, tgt.placed_job, tgt.affinity_boost,
+                np.zeros(cluster.n_pad), tgt.spread_val_id, tgt.spread_val_ok,
+                tgt.spread_counts, tgt.spread_desired, tgt.spread_has_targets,
+                tgt.spread_weight, np.int32(k), tgt.tg_count, tgt.dh_job,
+                tgt.dh_tg, tgt.spread_alg, tie_perm,
+                batch=self.BULK_STEP, n_steps=n_steps))
+        counts = out[:-2].astype(np.int64)
+        placed = int(out[-2])
+        mean_score = self._bulk_trajectory_mean(counts, cluster, tgt)
+
+        # one shared metrics object for the whole group: per-alloc
+        # AllocMetric at bulk scale is pure overhead (the mean normalized
+        # score is what the benches and the eval summary consume)
+        metrics = ctx.new_metrics()
+        metrics.nodes_in_pool = len(cluster.nodes)
+        metrics.nodes_evaluated = len(cluster.nodes)
+        metrics.scores["bulk.normalized-score"] = mean_score
+
+        commit_many = getattr(commit, "commit_many", None)
+        pos = 0
+        if commit_many is not None:
+            for ni in np.nonzero(counts)[0]:
+                c = int(counts[ni])
+                commit_many(tg, cluster.nodes[ni], reqs[pos:pos + c],
+                            mean_score)
+                pos += c
+        else:
+            for ni in np.nonzero(counts)[0]:
+                node = cluster.nodes[ni]
+                for _ in range(int(counts[ni])):
+                    req = reqs[pos]
+                    pos += 1
+                    option = RankedNode(node=node)
+                    option.final_score = mean_score
+                    commit(req, option)
+        unplaced = reqs[pos:]
+        if not unplaced:
+            return
+        n_feasible = int(tgt.feasible[: len(cluster.nodes)].sum())
+        for req in unplaced:
+            if preemption_enabled:
+                option = self._preempt_fallback(ctx, job, tg, cluster.nodes,
+                                                req, sched_batch, attempt)
+                if option is not None:
+                    commit(req, option)
+                    continue
+            metrics = ctx.new_metrics()
+            metrics.nodes_in_pool = len(cluster.nodes)
+            metrics.nodes_evaluated = len(cluster.nodes)
+            self._attribute_failure(ctx, metrics, len(cluster.nodes),
+                                    n_feasible)
+            commit(req, None)
+
+    @staticmethod
+    def _bulk_trajectory_mean(counts: np.ndarray, cluster, tgt) -> float:
+        """Exact mean normalized score over the greedy trajectory the
+        bulk counts correspond to, computed host-side (the kernel scores
+        a whole step at its start, which under-reports BestFit's rising
+        fill scores). No spread/dp terms by bulk eligibility; mirrors
+        kernels.score_nodes for the fit + anti-affinity + node-affinity
+        sub-scores (reference funcs.go:236 ScoreFitBinPack,
+        rank.go:596,710,800)."""
+        nz = np.nonzero(counts)[0]
+        if not len(nz):
+            return 0.0
+        c = counts[nz]
+        total = int(c.sum())
+        idx = np.repeat(nz, c)
+        starts = np.concatenate([[0], np.cumsum(c)[:-1]])
+        t = np.arange(total) - np.repeat(starts, c) + 1.0  # 1..c per node
+        ask = np.asarray(tgt.ask, dtype=np.float64)
+        avail = cluster.available[idx]
+        used = cluster.used[idx] + t[:, None] * ask[None, :]
+        safe = np.where(avail > 0, avail, 1.0)
+        ratio = np.where(avail > 0, used / safe,
+                         np.where(used > 0, np.inf, 0.0))
+        free = 1.0 - ratio
+        total10 = 10.0 ** free[:, 0] + 10.0 ** free[:, 1]
+        fit = np.clip(20.0 - total10, 0.0, 18.0) / 18.0
+        ptg_before = tgt.placed_tg[idx] + t - 1.0
+        anti_present = ptg_before > 0
+        anti = -(ptg_before + 1.0) / max(tgt.tg_count, 1.0)
+        aff = tgt.affinity_boost[idx]
+        aff_present = aff != 0.0
+        dev = tgt.dev_affinity[idx] if tgt.dev_affinity is not None else 0.0
+        dev_present = dev != 0.0 if tgt.dev_affinity is not None else False
+        div = (1.0 + anti_present.astype(float) + aff_present.astype(float)
+               + np.asarray(dev_present, dtype=float))
+        score = (fit + np.where(anti_present, anti, 0.0) + aff
+                 + np.where(dev_present, dev, 0.0)) / div
+        return float(score.mean())
+
+    @staticmethod
+    def _attribute_failure(ctx, metrics, n_nodes: int, n_feasible: int) -> None:
+        """Failure attribution the way the host path would do it: nodes
+        masked by constraints/drivers are "filtered", nodes that passed
+        feasibility but didn't fit are "exhausted" (reference feasible.go
+        filter vs rank.go exhaust metrics)."""
+        masked = n_nodes - n_feasible
+        if masked:
+            metrics.nodes_filtered += masked
+            metrics.constraint_filtered["task group constraints"] = (
+                metrics.constraint_filtered.get("task group constraints", 0)
+                + masked)
+        if n_feasible > 0:
+            metrics.exhaust_node("resources")
 
     def _assign_ids(self, ctx, ask_res, numa_pol: str, ni: int, node,
                     option: RankedNode, dev_idx: Dict[int, object],

@@ -283,3 +283,81 @@ def test_tpu_beats_or_matches_host_binpack_score():
         scheduler_algorithm=enums.SCHED_ALG_BINPACK))
     # production solve runs float32 (pack_solve_args); allow its rounding
     assert tpu_score >= host_score - 1e-5
+
+
+class TestBulkSolve:
+    """The count-based bulk path (tensor/placer.py _place_bulk +
+    kernels.solve_bulk): engaged for large fresh BestFit groups, must
+    place everything the exact per-placement scan would, respect
+    capacity, fail the remainder into a blocked eval, and score on par
+    with the exact path's trajectory."""
+
+    def _run(self, bulk_min, count=600, n_nodes=64, cpu=100, mem=64):
+        from nomad_tpu.tensor.placer import TPUPlacer
+
+        old = TPUPlacer.BULK_MIN
+        TPUPlacer.BULK_MIN = bulk_min
+        try:
+            h = Harness()
+            rng = random.Random(7)
+            for _ in range(n_nodes):
+                n = mock.node()
+                n.resources.cpu = rng.choice([2000, 4000, 8000])
+                n.resources.memory_mb = rng.choice([4096, 8192])
+                n.compute_class()
+                h.store.upsert_node(n)
+            job = mock.batch_job()
+            job.task_groups[0].count = count
+            job.task_groups[0].tasks[0].resources.cpu = cpu
+            job.task_groups[0].tasks[0].resources.memory_mb = mem
+            h.store.upsert_job(job)
+            h.process(mock.eval_for(job), sched_config=_tpu_config())
+            snap = h.store.snapshot()
+            allocs = [a for a in snap.allocs_by_job(job.id)
+                      if not a.terminal_status()]
+            return h, job, snap, allocs
+        finally:
+            TPUPlacer.BULK_MIN = old
+
+    def test_bulk_places_all_and_respects_capacity(self):
+        h, job, snap, allocs = self._run(bulk_min=256)
+        assert len(allocs) == 600
+        from nomad_tpu.structs import allocs_fit
+
+        for n in snap.nodes():
+            live = [a for a in snap.allocs_by_node(n.id)
+                    if not a.terminal_status()]
+            fit, dim, _ = allocs_fit(n, live)
+            assert fit, (n.id, dim)
+        # bulk allocs carry the shared trajectory-mean score
+        scored = [a for a in allocs if a.metrics is not None
+                  and "bulk.normalized-score" in a.metrics.scores]
+        assert scored
+
+    def test_bulk_score_parity_with_exact_scan(self):
+        _, _, _, bulk = self._run(bulk_min=256)
+        _, _, _, exact = self._run(bulk_min=1 << 30)
+
+        def mean(allocs):
+            out = []
+            for a in allocs:
+                if a.metrics is None:
+                    continue
+                for key, v in a.metrics.scores.items():
+                    if key.endswith("normalized-score"):
+                        out.append(v)
+                        break
+            return sum(out) / len(out)
+
+        assert len(bulk) == len(exact) == 600
+        assert mean(bulk) >= mean(exact) - 5e-3
+
+    def test_bulk_overflow_blocks(self):
+        """More asks than the cluster fits: bulk places what fits and
+        the rest lands in a blocked eval, same as the exact path."""
+        h, job, snap, allocs = self._run(bulk_min=256, count=600,
+                                         n_nodes=4, cpu=500, mem=256)
+        assert 0 < len(allocs) < 600
+        ev = h.assert_eval_status(enums.EVAL_STATUS_COMPLETE)
+        assert ev.failed_tg_allocs
+        assert ev.blocked_eval
