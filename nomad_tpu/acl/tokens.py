@@ -10,7 +10,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import List
 
-from ..utils import generate_uuid
+from ..utils import generate_secret_uuid, generate_uuid
 
 TOKEN_TYPE_CLIENT = "client"
 TOKEN_TYPE_MANAGEMENT = "management"
@@ -35,7 +35,7 @@ class AclToken:
             policies: List[str] = (), roles: List[str] = ()) -> "AclToken":
         return cls(
             accessor_id=generate_uuid(),
-            secret_id=generate_uuid(),
+            secret_id=generate_secret_uuid(),
             name=name,
             type=token_type,
             policies=list(policies),

@@ -406,16 +406,15 @@ class RawExecDriver:
             os.unlink(spec["status_file"])  # stale status from a prior run
         except OSError:
             pass
-        # the executor must import nomad_tpu regardless of the agent's cwd
-        exec_env = dict(os.environ)
-        pkg_root = os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))))
-        exec_env["PYTHONPATH"] = (pkg_root + os.pathsep
-                                  + exec_env.get("PYTHONPATH", "")).rstrip(os.pathsep)
         try:
+            # run the executor as a plain script under -S (skip
+            # site/sitecustomize): it is stdlib-only, and accelerator-runtime
+            # hooks in sitecustomize can add seconds of import latency per
+            # task launch
+            executor_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "executor.py")
             proc = subprocess.Popen(
-                [sys.executable, "-m", "nomad_tpu.client.executor", "-"],
-                env=exec_env,
+                [sys.executable, "-S", executor_path, "-"],
                 stdin=subprocess.PIPE,
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
                 start_new_session=True,  # its own group: killpg stops all

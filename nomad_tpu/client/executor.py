@@ -49,7 +49,12 @@ def run(spec_path: str) -> int:
         with open(spec_path) as f:
             spec = json.load(f)
 
-    from .logmon import LogMon
+    try:
+        from .logmon import LogMon
+    except ImportError:
+        # spawned as a plain script (python -S executor.py): the script
+        # dir is on sys.path, the package is not
+        from logmon import LogMon
 
     lm = LogMon(spec["logs_dir"], spec["task_name"],
                 max_files=int(spec.get("max_files", 10)),

@@ -27,7 +27,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..structs import enums
 from ..structs.evaluation import Evaluation
-from ..utils import generate_uuid
+from ..utils import generate_secret_uuid
 
 FAILED_QUEUE = "_failed"
 # long enough that a slow eval (first jit compile, wide spread jobs) is
@@ -155,7 +155,7 @@ class EvalBroker:
                     st, (negp, seq, eval_id) = best
                     heapq.heappop(self._ready[st])
                     ev = self._evals.pop(eval_id)
-                    token = generate_uuid()
+                    token = generate_secret_uuid()
                     timer = threading.Timer(self.nack_timeout,
                                             self._nack_timeout, (eval_id, token))
                     timer.daemon = True

@@ -396,6 +396,13 @@ class Server:
         """Node.Register -> heartbeat TTL. A ready node triggers evals so
         system jobs land on it (node_endpoint.go createNodeEvals on
         node-up)."""
+        if not node.id:
+            # clients self-assign ids before registering (reference
+            # node_endpoint.go Register: "missing node ID"); a
+            # server-minted id would be lost across call forwarding,
+            # and accepting "" silently collapses every id-less node
+            # onto one row
+            raise ValueError("node registration requires node.id")
         if not node.computed_class:
             node.compute_class()
         self.store.upsert_node(node)

@@ -725,9 +725,13 @@ class StateStore:
         accumulate_dev_usage(row, alloc, sign)
         self._node_dev_usage.put(alloc.node_id, row, gen, live)
 
-    def _put_alloc(self, alloc: Allocation, gen: int, live: int, ts: float = None) -> None:
+    _MISS = object()  # "caller did not look up prev" sentinel
+
+    def _put_alloc(self, alloc: Allocation, gen: int, live: int, ts: float = None,
+                   prev=_MISS) -> None:
         alloc.modify_time = ts if ts is not None else time.time()
-        prev = self._allocs.get_latest(alloc.id)
+        if prev is StateStore._MISS:
+            prev = self._allocs.get_latest(alloc.id)
         if prev is not None:
             alloc.create_index = prev.create_index
             # client status is owned by the client update path; preserve it
@@ -821,11 +825,16 @@ class StateStore:
                 events.append(("alloc-preempt", alloc))
             new_allocs: List[Allocation] = []
             for alloc in result_allocs:
-                if (alloc.create_index == 0
-                        and self._allocs.get_latest(alloc.id) is None):
+                # ANY alloc without an existing row is a first insert and
+                # must go through the bulk path, which records volume
+                # claims — not just fresh placements (create_index == 0):
+                # a re-upsert whose row was GC'd mid-flight still needs
+                # its claims tracked
+                prev = self._allocs.get_latest(alloc.id)
+                if prev is None:
                     new_allocs.append(alloc)
                     continue
-                self._put_alloc(alloc, gen, live, ts)
+                self._put_alloc(alloc, gen, live, ts, prev=prev)
                 events.append(("alloc-upsert", alloc))
             if new_allocs:
                 self._put_new_allocs_bulk(new_allocs, gen, live, ts, events)

@@ -463,11 +463,11 @@ def _bulk_scan(
     batch: int,        # placements per step
     n_steps: int,      # static scan length >= ceil(k_total / batch)
 ):
-    """-> packed (N+2,) float array: per-node placement counts in
-    canonical order, then [placed_total, score_sum] — ONE readback
-    regardless of K. Runs in permuted node space like solve_task_group;
-    counts map back at the end. (Counts stay exact in float32 up to
-    2^24, far beyond any single task group.)"""
+    """-> (N,) int32 per-node placement counts in canonical order —
+    ONE readback regardless of K. Runs in permuted node space like
+    solve_task_group; counts map back at the end. (The trajectory's
+    mean score is recomputed host-side by _bulk_trajectory_mean — the
+    step-start scores here under-report a fill-to-capacity batch.)"""
     n = available.shape[0]
     s = spread_val_id.shape[0]
     dp_val_id = jnp.zeros((0, n), jnp.int32)
@@ -489,7 +489,7 @@ def _bulk_scan(
     ask_pos = ask > 0
 
     def step(carry, _):
-        used, ptg, pjob, scnt, taken, remaining, score_sum = carry
+        used, ptg, pjob, scnt, taken, remaining = carry
         score, _, _ = score_nodes(
             available=available, used=used, ask=ask, feasible=feasible,
             placed_tg=ptg, placed_job=pjob, affinity_boost=affinity_boost,
@@ -528,20 +528,14 @@ def _bulk_scan(
             scnt = scnt.at[jnp.arange(s)[:, None], spread_val_id].add(
                 jnp.where(spread_val_ok, take[None, :], 0))
         placed_now = jnp.sum(take).astype(jnp.int32)
-        score_sum = score_sum + jnp.sum(score * take)
         return (used, ptg, pjob, scnt, taken + take,
-                remaining - placed_now, score_sum), None
+                remaining - placed_now), None
 
     init = (used0, placed_tg0, placed_job0, spread_counts0,
-            jnp.zeros(n, jnp.int32), jnp.int32(k_total),
-            jnp.zeros((), dtype=available.dtype))
-    (used, ptg, pjob, scnt, taken, remaining, score_sum), _ = jax.lax.scan(
+            jnp.zeros(n, jnp.int32), jnp.int32(k_total))
+    (used, ptg, pjob, scnt, taken, remaining), _ = jax.lax.scan(
         init=init, f=step, xs=None, length=n_steps)
-    counts = jnp.zeros(n, jnp.int32).at[tie_perm].set(taken)
-    f = available.dtype
-    return jnp.concatenate([
-        counts.astype(f),
-        jnp.stack([(k_total - remaining).astype(f), score_sum.astype(f)])])
+    return jnp.zeros(n, jnp.int32).at[tie_perm].set(taken)
 
 
 solve_bulk = partial(jax.jit, static_argnames=("batch", "n_steps"))(_bulk_scan)

@@ -298,8 +298,7 @@ class TPUPlacer:
                 tgt.spread_weight, np.int32(k), tgt.tg_count, tgt.dh_job,
                 tgt.dh_tg, tgt.spread_alg, tie_perm,
                 batch=self.BULK_STEP, n_steps=n_steps))
-        counts = out[:-2].astype(np.int64)
-        placed = int(out[-2])
+        counts = out.astype(np.int64)
         mean_score = self._bulk_trajectory_mean(counts, cluster, tgt)
 
         # one shared metrics object for the whole group: per-alloc
