@@ -14,7 +14,7 @@ from typing import List, Optional
 from ..structs import enums
 from ..structs.alloc import Allocation, RescheduleEvent, RescheduleTracker
 from ..structs.evaluation import Evaluation
-from ..utils import generate_uuid
+from ..utils import generate_uuid, generate_uuids
 from .context import EvalContext
 from .placer import HostPlacer, placer_for_algorithm
 from .reconcile import AllocReconciler, PlacementRequest
@@ -231,6 +231,12 @@ class GenericScheduler:
 
         # submit
         result, new_state = self.planner.submit_plan(self.plan)
+        for hook in self.plan.post_apply_hooks:
+            try:
+                hook(result)
+            except Exception:
+                if self.logger:
+                    self.logger.exception("post-apply hook failed")
         self._progress = bool(result.node_allocation or result.node_update
                               or result.node_preemptions
                               or result.deployment is not None)
@@ -348,9 +354,10 @@ class GenericScheduler:
             metrics = ctx.metrics
             if metrics is not None:
                 metrics.scores.setdefault("bulk.normalized-score", mean_score)
-            for req in reqs:
+            ids = generate_uuids(len(reqs))
+            for req, aid in zip(reqs, ids):
                 bucket.append(Allocation(
-                    id=generate_uuid(),
+                    id=aid,
                     eval_id=ev.id,
                     deployment_id=dep_id,
                     name=req.name,

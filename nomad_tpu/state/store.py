@@ -878,9 +878,15 @@ class StateStore:
             by_job.setdefault((a.namespace, a.job_id), []).append(a.id)
             by_eval.setdefault(a.eval_id, []).append(a.id)
             if not a.terminal_status():
-                u = usage.get(a.node_id)
-                usage[a.node_id] = (a.allocated_vec if u is None
-                                    else u + a.allocated_vec)
+                # count per (node, vec identity): bulk placements share
+                # one allocated_vec object per task group, so the numpy
+                # adds collapse to one multiply per node
+                ukey = (a.node_id, id(a.allocated_vec))
+                e = usage.get(ukey)
+                if e is None:
+                    usage[ukey] = [a.allocated_vec, 1]
+                else:
+                    e[1] += 1
                 if a.allocated_devices or a.allocated_cores:
                     self._dev_usage_add(a, +1, gen, live)
             key = (a.namespace, a.job_id, a.task_group)
@@ -891,15 +897,16 @@ class StateStore:
             if has_vols:
                 self._claim_volumes_for(a, gen, live, events)
             events.append(("alloc-upsert", a))
-        for node_id, delta in usage.items():
-            self._usage_add(node_id, delta, gen, live)
+        for (node_id, _), (vec, count) in usage.items():
+            self._usage_add(node_id, vec if count == 1 else vec * count,
+                            gen, live)
         for table, groups in ((self._allocs_by_node, by_node),
                               (self._allocs_by_job, by_job),
                               (self._allocs_by_eval, by_eval)):
             for key, ids in groups.items():
-                cell = table.get_latest(key)
-                for _id in ids:
-                    cell = cons(_id, cell)
+                # one chunk cell per key per transaction (cons_iter
+                # flattens tuple heads)
+                cell = cons(tuple(ids), table.get_latest(key))
                 table.put(key, cell, gen, live)
 
     # --- deployments ---

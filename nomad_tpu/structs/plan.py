@@ -29,6 +29,11 @@ class Plan:
     eval_updates: List[object] = field(default_factory=list)   # e.g. blocked eval created atomically
     annotations: Optional[dict] = None
     snapshot_index: int = 0
+    # callbacks invoked with the PlanResult right after the planner
+    # applies this plan (never serialized; process-local). The bulk
+    # solver service uses these to confirm or correct its
+    # device-resident usage overlay (tensor/solver.py ledger).
+    post_apply_hooks: List[object] = field(default_factory=list)
 
     def append_alloc(self, alloc) -> None:
         self.node_allocation.setdefault(alloc.node_id, []).append(alloc)

@@ -608,3 +608,96 @@ def score_nodes_once(
         dh_job=dh_job, dh_tg=dh_tg, spread_alg=spread_alg,
     )
     return score
+
+
+@partial(jax.jit, static_argnames=("g",), donate_argnums=(0,))
+def solve_bulk_multi(
+    used0,       # (N, D) f32 usage carry — device-RESIDENT, donated back
+    available,   # (N, D) f32 resident capacity
+    feas,        # (G, N) bool stacked per-eval feasibility masks
+    aff,         # (G, N) f32 stacked per-eval affinity boosts
+    ask,         # (G, D) f32 per-eval resource asks
+    k,           # (G,) int32 placements wanted per eval
+    tg_count,    # (G,) f32 (kept for signature parity; scores are
+                 #          recomputed host-side for the trajectory mean)
+    seeds,       # (G,) uint32 per-eval tie-break seeds
+    cidx,        # (C,) int32 usage-correction node rows (0 = no-op slot)
+    cdelta,      # (C, D) f32 usage-correction deltas added to used0
+                 #        before solving (rejected-placement phantoms
+                 #        arrive negative; see tensor/solver.py ledger)
+    *,
+    g: int,
+):
+    """Chained bulk solves for G independent fresh-placement evals in ONE
+    launch -> ((N, D) new usage carry staying on device, (G, N) int16
+    per-node counts — the only readback).
+
+    The tunnel to the device charges ~100ms of fixed latency per
+    synchronous hop (measured in-round), so per-eval launches cap the
+    whole pipeline; here the usage state never leaves the device between
+    launches and the round trip amortizes over G evals. Eval i places
+    k[i] allocations of ask[i] by BestFit fill-to-capacity against the
+    usage state left by eval i-1, with tie-breaks from a per-eval
+    on-device permutation of seeds[i] (same PRNG as solve_bulk_fused).
+
+    ONE fill pass per eval, not a scan of score-refresh steps: a node's
+    BestFit score depends only on its own usage, so filling the best
+    node to capacity never re-orders the remaining nodes — the one-pass
+    sorted fill IS the re-scored greedy trajectory (the refresh steps of
+    _bulk_scan only repeat the score + full-sort work, ~12ms of device
+    time per step at 10K nodes). The in-eval anti-affinity term is
+    dropped for the same reason the trajectory tolerates it in
+    _bulk_scan: under fill-to-capacity every chosen node saturates its
+    capacity regardless of score magnitude, so the anti term can only
+    affect reported scores (recomputed host-side), not choices, except
+    through order among non-equal nodes — bounded by the same score
+    parity the bulk path is benched against. No statics besides G, so
+    the jit cache holds exactly two graph variants (G=1, G=G_PAD)."""
+    n, d = available.shape
+    f = available.dtype
+    # fold queued usage corrections into the carry (scatter-add; the
+    # clamp guards against a correction racing a concurrent resync)
+    used0 = jnp.maximum(used0.at[cidx].add(cdelta), 0.0)
+    perms = jax.vmap(
+        lambda s: jax.random.permutation(jax.random.PRNGKey(s), n)
+    )(seeds).astype(jnp.int32)                                     # (G, N)
+
+    def one_eval(used, gi):
+        perm = perms[gi]
+        ask_g = ask[gi]
+        ask_pos = ask_g > 0
+        new_used = used + ask_g[None, :]
+        ok = feas[gi] & jnp.all(new_used <= available, axis=1)
+        fitness = fit_scores(available, new_used, False)
+        aff_g = aff[gi]
+        aff_present = aff_g != 0.0
+        divisor = 1.0 + aff_present.astype(f)
+        score = (fitness + jnp.where(aff_present, aff_g, 0.0)) / divisor
+        score = jnp.where(ok, score, NEG)
+
+        free = available - used
+        per_dim = jnp.where(
+            ask_pos[None, :],
+            jnp.floor(free / jnp.where(ask_pos, ask_g, 1.0)[None, :]),
+            jnp.inf)
+        cap = jnp.clip(jnp.min(per_dim, axis=1), 0, None)
+        cap = jnp.where(score > NEG, cap, 0.0)
+        budget = k[gi]
+        cap = jnp.minimum(cap, budget.astype(cap.dtype)).astype(jnp.int32)
+        # tie-break in permuted node space: identical trajectory to
+        # _bulk_scan's upfront permutation, expressed as gathers so the
+        # shared `used` carry stays canonical across evals with
+        # different permutations
+        sp = score[perm]
+        cp = cap[perm]
+        order_p = jnp.argsort(-sp)                # ties: permuted index
+        cap_sorted = cp[order_p]
+        cum = jnp.cumsum(cap_sorted)
+        take_sorted = jnp.clip(budget - (cum - cap_sorted), 0, cap_sorted)
+        take_p = jnp.zeros(n, jnp.int32).at[order_p].set(take_sorted)
+        take = jnp.zeros(n, jnp.int32).at[perm].set(take_p)
+        used = used + ask_g[None, :] * take[:, None].astype(used.dtype)
+        return used, take.astype(jnp.int16)
+
+    used, counts = jax.lax.scan(one_eval, used0, jnp.arange(g))
+    return used, counts

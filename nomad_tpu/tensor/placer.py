@@ -97,6 +97,18 @@ class TPUPlacer:
             if gi > 0:  # build() already computed usage for the first group
                 cluster.refresh_usage(ctx)
 
+            if len(reqs) <= self.HOST_CUTOVER:
+                # tiny groups (mostly partial-commit remainders): a
+                # device launch costs ~100ms of tunnel latency while the
+                # host oracle scores the same nodes in a few ms per
+                # placement — same math, parity-tested
+                for req in reqs:
+                    option = self._host_one(ctx, job, tg, nodes, req,
+                                            batch, preemption_enabled,
+                                            attempt)
+                    commit(req, option)
+                continue
+
             tgt = build_task_group_tensors(ctx, job, tg, cluster,
                                            algorithm=self.algorithm)
 
@@ -224,6 +236,7 @@ class TPUPlacer:
 
     BULK_MIN = 256     # below this the per-placement scan is fine
     BULK_STEP = 256    # placements assigned per scan step
+    HOST_CUTOVER = 16  # at/below this the host oracle beats a launch
 
     def _bulk_eligible(self, ctx, tg, reqs, tgt) -> bool:
         """K large, every request a fresh placement, BestFit binpack with
@@ -264,41 +277,48 @@ class TPUPlacer:
         k_pad = _pad_pow2(k, floor=self.BULK_STEP)
         n_steps = k_pad // self.BULK_STEP
         static = cluster.static
-        if static is not None and tgt.feas_base is not None:
-            import jax
+        if (static is not None and tgt.feas_base is not None
+                and k <= 32767
+                and not tgt.placed_tg.any() and not tgt.placed_job.any()):
+            # fresh-placement fast path: the batched solver service owns
+            # a device-resident usage carry and amortizes the tunnel
+            # round trip across every eval racing right now
+            from .solver import get_service
+
+            service = get_service()
+            counts, solve_token = service.solve(
+                static=static, feas_base=tgt.feas_base,
+                aff=tgt.affinity_boost, ask=tgt.ask, k=k,
+                tg_count=tgt.tg_count, seed=seed, used_host=cluster.used)
+            if ctx.plan is not None:
+                # close the solve's overlay ledger entry with the plan
+                # outcome (solver.py: confirmed placements stay in the
+                # carry; rejected ones get corrected out)
+                ctx.plan.post_apply_hooks.append(
+                    lambda result, _t=solve_token: service.confirm(
+                        _t, getattr(result, "rejected_nodes", None) or ()))
+        elif static is not None and tgt.feas_base is not None:
+            from .solver import ensure_resident
 
             f32 = np.float32
-            da = static.device_arrays
-            avail_dev = da.get("avail")
-            if avail_dev is None:
-                avail_dev = da["avail"] = jax.device_put(
-                    cluster.available.astype(f32))
-            mkey = ("m", id(tgt.feas_base))
-            feas_dev = da.get(mkey)
-            if feas_dev is None:
-                feas_dev = da[mkey] = jax.device_put(tgt.feas_base)
-            akey = ("a", id(tgt.affinity_boost))
-            aff_dev = da.get(akey)
-            if aff_dev is None:
-                aff_dev = da[akey] = jax.device_put(
-                    tgt.affinity_boost.astype(f32))
+            avail_dev, feas_dev, aff_dev = ensure_resident(
+                static, tgt.feas_base, tgt.affinity_boost)
             dyn = np.concatenate(
                 [cluster.used, tgt.placed_tg[:, None],
                  tgt.placed_job[:, None]], axis=1).astype(f32)
-            out = np.asarray(solve_bulk_fused(
+            counts = np.asarray(solve_bulk_fused(
                 avail_dev, feas_dev, aff_dev, dyn, tgt.ask.astype(f32),
                 np.int32(k), f32(tgt.tg_count), np.uint32(seed),
-                batch=self.BULK_STEP, n_steps=n_steps))
+                batch=self.BULK_STEP, n_steps=n_steps)).astype(np.int64)
         else:
-            out = np.asarray(solve_bulk(
+            counts = np.asarray(solve_bulk(
                 cluster.available, cluster.used, tgt.ask, tgt.feasible,
                 tgt.placed_tg, tgt.placed_job, tgt.affinity_boost,
                 np.zeros(cluster.n_pad), tgt.spread_val_id, tgt.spread_val_ok,
                 tgt.spread_counts, tgt.spread_desired, tgt.spread_has_targets,
                 tgt.spread_weight, np.int32(k), tgt.tg_count, tgt.dh_job,
                 tgt.dh_tg, tgt.spread_alg, tie_perm,
-                batch=self.BULK_STEP, n_steps=n_steps))
-        counts = out.astype(np.int64)
+                batch=self.BULK_STEP, n_steps=n_steps)).astype(np.int64)
         mean_score = self._bulk_trajectory_mean(counts, cluster, tgt)
 
         # one shared metrics object for the whole group: per-alloc

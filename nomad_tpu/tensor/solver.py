@@ -1,0 +1,344 @@
+"""Batched bulk-solve service: one device launch for many evals.
+
+The device tunnel charges ~100ms of fixed latency per synchronous
+readback at ~3.5MB/s (measured in-round); at C2M scale (500 evals x
+4,000 allocs) per-eval round trips alone would be ~1 minute of wall
+clock. Racing scheduler workers therefore don't talk to the device
+directly on the bulk path: they enqueue solve requests here and block
+on a future, while ONE service thread batches compatible requests into
+a single kernels.solve_bulk_multi launch whose usage carry never
+leaves the device between launches. Per eval, the wire moves one ask
+row + scalars in and one (N,) int16 counts row out; the fixed latency
+amortizes across the batch. Batching is demand-driven: while a launch
+is in flight, newly arriving requests queue up and form the next
+batch (backpressure, not timers, sets the batch size).
+
+This is the "solver service" split SURVEY.md §2.5 calls for: cheap
+local control-plane work on the host, batched dense solves on the
+accelerator, one serialized commit point (the plan applier) unchanged.
+
+Correctness contract: the device usage carry is an optimistic overlay
+(base = store usage at the last resync, plus every solve since), and
+the serialized plan applier remains the gate — it re-verifies every
+placement against real state (core/plan_apply.py) exactly as for
+host-solved plans, so drift can only cost throughput, never
+correctness. Drift is then actively repaired instead of tolerated:
+
+- every solve opens an in-flight LEDGER entry (per-node counts + ask);
+- the scheduler invokes a plan post-apply hook (structs/plan.py
+  post_apply_hooks) -> confirm(): a fully-committed solve just closes
+  its entry (its usage is now in the store), while rejected nodes
+  queue NEGATIVE usage corrections that the next launch scatter-adds
+  into the carry — phantom usage from rejected placements never
+  outlives one launch;
+- resync (every RESYNC_SOLVES solves, on node-set change, or when the
+  correction queue overflows) rebuilds the carry as committed store
+  usage PLUS the still-open ledger entries, so in-flight work is never
+  dropped from the overlay.
+
+Without the ledger the carry both leaks rejected-placement phantoms
+(solve shortfalls -> blocked-eval retry storms as the cluster fills)
+and forgets in-flight solves at resync (double-booking -> rejection
+bursts); measured in-round, that fed a tail where the last 10% of a
+2M-alloc run took longer than the first 90%.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import List, Optional
+
+import numpy as np
+
+_STOP = object()
+
+
+def ensure_resident(static, feas_base, aff):
+    """Device-resident (capacity, mask, affinity) arrays for one
+    ClusterStatic, uploaded once and cached in static.device_arrays —
+    masks/boosts keyed by host-array identity (the static's mask_cache /
+    aff_cache hold the strong refs, so ids can't be recycled). The ONE
+    place the cache-key protocol lives; used by both the service and
+    the placer's single-eval fused path."""
+    import jax
+
+    da = static.device_arrays
+    avail = da.get("avail")
+    if avail is None:
+        avail = da["avail"] = jax.device_put(
+            static.available.astype(np.float32))
+    mkey = ("m", id(feas_base))
+    m = da.get(mkey)
+    if m is None:
+        m = da[mkey] = jax.device_put(feas_base)
+    akey = ("a", id(aff))
+    a = da.get(akey)
+    if a is None:
+        a = da[akey] = jax.device_put(aff.astype(np.float32))
+    return avail, m, a
+
+
+class _Request:
+    __slots__ = ("static", "feas_base", "aff", "ask", "k", "tg_count",
+                 "seed", "used_host", "future", "token")
+
+    def __init__(self, static, feas_base, aff, ask, k, tg_count, seed,
+                 used_host):
+        self.static = static
+        self.feas_base = feas_base
+        self.aff = aff
+        self.ask = ask
+        self.k = k
+        self.tg_count = tg_count
+        self.seed = seed
+        self.used_host = used_host
+        self.future = Future()
+        self.token = 0
+
+
+class _LedgerEntry:
+    """One in-flight solve: where its placements went, awaiting the
+    plan outcome."""
+
+    __slots__ = ("static", "idx", "counts", "ask", "born")
+
+    def __init__(self, static, idx, counts, ask, born):
+        self.static = static
+        self.idx = idx        # (M,) node rows with placements
+        self.counts = counts  # (M,) placement counts per row
+        self.ask = ask        # (D,) per-placement usage
+        self.born = born
+
+
+class BulkSolverService:
+    G_PAD = 8           # evals per launch (padded; k=0 rows are no-ops)
+    MAX_K = 32767       # int16 counts ceiling per eval
+    RESYNC_SOLVES = 64  # overlay refresh cadence (external usage churn)
+    CORRECTIONS = 64    # sparse correction slots per launch
+    LEDGER_TTL = 60.0   # s before an unconfirmed solve is presumed dead
+
+    def __init__(self):
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        # single-entry device state: (static, used_dev, solves_since_sync).
+        # One entry only — a new node-set version replaces it, and the
+        # strong static ref keeps id()-keyed device_arrays coherent.
+        self._state = None
+        self._token = 0
+        self._ledger: Dict[int, _LedgerEntry] = {}
+        self._corrections: List[tuple] = []  # (node_row, delta_vec)
+        # launch telemetry
+        self.stats = {"launches": 0, "solves": 0, "resyncs": 0,
+                      "launch_s": 0.0, "corrections": 0}
+
+    # -- caller side (scheduler worker threads) --
+
+    def solve(self, *, static, feas_base, aff, ask, k, tg_count, seed,
+              used_host):
+        """Blocking solve of one fresh-placement bulk eval ->
+        ((N_pad,) int64 per-node counts in canonical order, token).
+        The caller must arrange for confirm(token, rejected_node_ids)
+        to run once the plan containing these placements is applied
+        (plan.post_apply_hooks)."""
+        req = _Request(static, feas_base, aff,
+                       np.asarray(ask, dtype=np.float32), int(k),
+                       float(tg_count), np.uint32(seed), used_host)
+        self._ensure_thread()
+        self._q.put(req)
+        return req.future.result(), req.token
+
+    def confirm(self, token: int, rejected_node_ids) -> None:
+        """Plan outcome for one solve: close its ledger entry; queue
+        negative usage corrections for placements the applier rejected
+        (the whole node's placement list drops on a node rejection)."""
+        with self._lock:
+            entry = self._ledger.pop(token, None)
+            if entry is None:
+                return
+            if not rejected_node_ids:
+                return
+            node_index = entry.static.node_index
+            rows = {node_index.get(nid) for nid in rejected_node_ids}
+            for i, row in enumerate(entry.idx):
+                if row in rows:
+                    self._corrections.append(
+                        (row, -float(entry.counts[i]) * entry.ask))
+                    self.stats["corrections"] += 1
+
+    def _ensure_thread(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="bulk-solver", daemon=True)
+                self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            self._q.put(_STOP)
+            self._thread.join(timeout=10.0)
+
+    # -- service thread --
+
+    def _run(self) -> None:
+        while True:
+            req = self._q.get()
+            if req is _STOP:
+                return
+            batch = [req]
+            # drain whatever queued while the previous launch ran
+            while len(batch) < self.G_PAD:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    self._flush(batch)
+                    return
+                batch.append(nxt)
+            self._flush(batch)
+
+    def _flush(self, batch: List[_Request]) -> None:
+        # one launch per distinct static (mixed batches happen only
+        # across a node-set version change)
+        groups = {}
+        for r in batch:
+            groups.setdefault(id(r.static), []).append(r)
+        for rs in groups.values():
+            try:
+                self._solve_group(rs)
+            except Exception as e:  # propagate to every blocked worker
+                # the launch may have consumed (donated) the usage carry
+                # before failing — drop the state so the next solve
+                # resyncs instead of feeding a deleted buffer back in
+                self._state = None
+                for r in rs:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+
+    def _device_arrays(self, static, rs):
+        """Resident capacity + stacked per-eval mask/affinity arrays;
+        the stacked (G, N) combinations are cached by the tuple of the
+        underlying host-array ids — repeated batches of the same
+        task-group shapes ship nothing."""
+        import jax.numpy as jnp
+
+        da = static.device_arrays
+        rows_m, rows_a = [], []
+        for r in rs:
+            avail, m, a = ensure_resident(static, r.feas_base, r.aff)
+            rows_m.append((id(r.feas_base), m))
+            rows_a.append((id(r.aff), a))
+        g_pad = 1 if len(rs) == 1 else self.G_PAD
+        while len(rows_m) < g_pad:
+            rows_m.append(rows_m[0])
+            rows_a.append(rows_a[0])
+        skey = ("stack", tuple(i for i, _ in rows_m),
+                tuple(i for i, _ in rows_a))
+        stacked = da.get(skey)
+        if stacked is None:
+            # on-device stack: no host transfer, one cached buffer per
+            # recurring mask/affinity combination
+            stacked = da[skey] = (jnp.stack([m for _, m in rows_m]),
+                                  jnp.stack([a for _, a in rows_a]))
+        return avail, stacked[0], stacked[1], g_pad
+
+    def _solve_group(self, rs: List[_Request]) -> None:
+        from .kernels import solve_bulk_multi
+
+        import jax
+        import time as _time
+
+        t0 = _time.perf_counter()
+        static = rs[0].static
+        d = static.available.shape[1]
+        state = self._state
+        used_dev, since = None, 0
+        if state is not None and state[0] is static:
+            used_dev, since = state[1], state[2]
+
+        now = _time.time()
+        with self._lock:
+            # unconfirmed solves past the TTL belong to evals that died
+            # between solve and submit; presume their placements never
+            # committed and stop re-applying them at resync
+            dead = [t for t, e in self._ledger.items()
+                    if now - e.born > self.LEDGER_TTL]
+            for t in dead:
+                del self._ledger[t]
+            need_resync = (used_dev is None
+                           or since >= self.RESYNC_SOLVES
+                           or len(self._corrections) > self.CORRECTIONS)
+            if need_resync:
+                # exact rebuild: committed usage + still-in-flight solves
+                # (queued corrections target phantoms in the old carry —
+                # the rebuild has none, so drop them)
+                self._corrections.clear()
+                base = rs[0].used_host.astype(np.float32).copy()
+                for e in self._ledger.values():
+                    if e.static is static:
+                        base[e.idx] += (e.counts[:, None].astype(np.float32)
+                                        * e.ask[None, :])
+                corrections = []
+            else:
+                corrections = self._corrections
+                self._corrections = []
+        if need_resync:
+            used_dev = jax.device_put(base)
+            since = 0
+            self.stats["resyncs"] += 1
+
+        cidx = np.zeros(self.CORRECTIONS, dtype=np.int32)
+        cdelta = np.zeros((self.CORRECTIONS, d), dtype=np.float32)
+        for i, (row, delta) in enumerate(corrections[:self.CORRECTIONS]):
+            cidx[i] = row
+            cdelta[i] = delta
+
+        avail, feas, aff, g_pad = self._device_arrays(static, rs)
+        g = len(rs)
+        ask = np.zeros((g_pad, d), dtype=np.float32)
+        k = np.zeros(g_pad, dtype=np.int32)
+        tgc = np.ones(g_pad, dtype=np.float32)
+        seeds = np.zeros(g_pad, dtype=np.uint32)
+        for i, r in enumerate(rs):
+            ask[i] = r.ask
+            k[i] = r.k
+            tgc[i] = r.tg_count
+            seeds[i] = r.seed
+
+        new_used, counts = solve_bulk_multi(
+            used_dev, avail, feas, aff, ask, k, tgc, seeds, cidx, cdelta,
+            g=g_pad)
+        counts_np = np.asarray(counts)  # ONE readback for the whole batch
+        self._state = (static, new_used, since + g)
+        self.stats["launches"] += 1
+        self.stats["solves"] += g
+        self.stats["launch_s"] += _time.perf_counter() - t0
+        born = _time.time()
+        with self._lock:
+            for i, r in enumerate(rs):
+                row = counts_np[i]
+                idx = np.nonzero(row)[0]
+                self._token += 1
+                r.token = self._token
+                self._ledger[r.token] = _LedgerEntry(
+                    static, idx, row[idx].astype(np.int64), r.ask, born)
+        for i, r in enumerate(rs):
+            r.future.set_result(counts_np[i].astype(np.int64))
+
+
+_service: Optional[BulkSolverService] = None
+_service_lock = threading.Lock()
+
+
+def get_service() -> BulkSolverService:
+    global _service
+    if _service is None:
+        with _service_lock:
+            if _service is None:
+                _service = BulkSolverService()
+    return _service

@@ -242,3 +242,32 @@ class TestReviewRegressions:
         snap = store.snapshot()
         assert {d.id for d in snap.deployments_by_job("j1")} == {"d1", "d2"}
         assert snap.latest_deployment_by_job("j1").id == "d2"
+
+
+class TestVersionedTableRowLayouts:
+    """The single-version tuple fast row vs promoted chains
+    (state/mvcc.py): live snapshots must keep seeing the old version
+    of a once-written row across a rewrite (regression: the tuple fast
+    path used to drop the old version when its gen < min_live_gen,
+    blinding concurrently-held snapshots)."""
+
+    def test_rewrite_keeps_version_visible_to_live_snapshot(self):
+        from nomad_tpu.state.mvcc import VersionedTable
+
+        t = VersionedTable("x")
+        t.put("a1", "v1", 5, 5)
+        # a snapshot at gen 100 is live; min_live therefore 100
+        t.put("a1", "v2", 101, 100)
+        assert t.get("a1", 100) == "v1"
+        assert t.get("a1", 101) == "v2"
+        assert t.get_latest("a1") == "v2"
+        # once min_live passes the rewrite, the old version is reclaimed
+        t.put("a1", "v3", 102, 102)
+        assert t.get("a1", 102) == "v3"
+
+    def test_chunked_index_cells_flatten(self):
+        from nomad_tpu.state.mvcc import cons, cons_iter
+
+        cell = cons(("a", "b", "c"), cons("z", None))
+        assert list(cons_iter(cell)) == ["a", "b", "c", "z"]
+        assert cell.length == 4
