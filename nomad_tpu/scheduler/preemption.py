@@ -1,21 +1,47 @@
 """Preemption victim selection (reference scheduler/preemption.go, 779 LoC).
 
-Implements the reference's core heuristic: only allocations of strictly
-lower job priority are evictable; candidates are considered in ascending
-priority groups and chosen by resource distance (how closely the victim's
-resources match the remaining need, preemption.go basicResourceDistance),
-stopping as soon as the ask fits.
+Implements the reference's heuristics:
+
+- only allocations at least PRIORITY_DELTA (10) below the asking job's
+  priority are evictable (preemption.go filterAndGroupPreemptibleAllocs);
+- candidates are considered in ascending priority groups and chosen by
+  resource distance — how closely the victim's resources match the
+  remaining need (preemption.go basicResourceDistance) — stopping as
+  soon as the ask fits, then redundant victims are dropped
+  (filterSuperset);
+- a victim whose task group is already at its migrate max_parallel in
+  this selection takes a score penalty of MAX_PARALLEL_PENALTY (50) per
+  excess eviction (preemption.go:16 maxParallelPenalty,
+  scoreForTaskGroup);
+- network preemption frees conflicting reserved ports / mbits by
+  network resource distance (preemption.go PreemptForNetwork,
+  networkResourceDistance);
+- device preemption frees device-group instances, preferring the victim
+  set with minimal net priority, largest holders first
+  (preemption.go PreemptForDevice, selectBestAllocs).
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from ..structs import allocs_fit
 from ..structs.alloc import Allocation
 from ..structs.resources import RESOURCE_DIMS
+
+# reference preemption.go:26 — "skip allocs whose priority is within a
+# delta of 10"
+PRIORITY_DELTA = 10
+# reference preemption.go:16 maxParallelPenalty
+MAX_PARALLEL_PENALTY = 50.0
+
+
+def is_preemptible(alloc: Allocation, current_priority: int) -> bool:
+    return (alloc.job is not None
+            and current_priority - alloc.job.priority >= PRIORITY_DELTA
+            and alloc.should_count_for_usage())
 
 
 def basic_resource_distance(need: np.ndarray, have: np.ndarray) -> float:
@@ -28,6 +54,24 @@ def basic_resource_distance(need: np.ndarray, have: np.ndarray) -> float:
     return float(np.sqrt(d))
 
 
+def _max_parallel_penalty(alloc: Allocation, counts: Dict[tuple, int]) -> float:
+    """Score penalty once a victim's task group is at its migrate
+    max_parallel in this selection (reference scoreForTaskGroup)."""
+    job = alloc.job
+    if job is None:
+        return 0.0
+    tg = job.lookup_task_group(alloc.task_group)
+    if tg is None or tg.migrate is None:
+        return 0.0
+    max_parallel = tg.migrate.max_parallel
+    if max_parallel <= 0:
+        return 0.0
+    n = counts.get((alloc.namespace, alloc.job_id, alloc.task_group), 0)
+    if n < max_parallel:
+        return 0.0
+    return float((n + 1) - max_parallel) * MAX_PARALLEL_PENALTY
+
+
 def preempt_for_task_group(
     node,
     proposed: Sequence[Allocation],
@@ -35,20 +79,22 @@ def preempt_for_task_group(
     current_priority: int,
     check_devices: bool = False,
     ask_devices=(),
+    preempted_counts: Optional[Dict[tuple, int]] = None,
 ) -> Optional[List[Allocation]]:
     """Pick a minimal set of lower-priority allocs whose removal lets the
     ask fit (reference preemption.go:127 PreemptForTaskGroup). Returns
-    None/empty when impossible."""
-    candidates = [
-        a for a in proposed
-        if a.job is not None and a.job.priority < current_priority
-        and a.should_count_for_usage()
-    ]
+    None/empty when impossible. `preempted_counts` carries per-(ns, job,
+    tg) evictions already in the plan so migrate max_parallel penalties
+    apply across the whole eval."""
+    candidates = [a for a in proposed if is_preemptible(a, current_priority)]
     if not candidates:
         return None
 
+    counts: Dict[tuple, int] = dict(preempted_counts or {})
+
     # group by priority ascending; within a group prefer the alloc whose
-    # resources best match what's still missing (smallest distance to need)
+    # resources best match what's still missing (smallest distance to
+    # need, plus the max_parallel penalty)
     candidates.sort(key=lambda a: (a.job.priority,))
 
     victims: List[Allocation] = []
@@ -85,10 +131,14 @@ def preempt_for_task_group(
                     used += a.allocated_vec
             need = used + ask_vec - node.available_vec()
             need = np.maximum(need, 0.0)
-            group.sort(key=lambda a: basic_resource_distance(need, a.allocated_vec))
+            group.sort(key=lambda a: (
+                basic_resource_distance(need, a.allocated_vec)
+                + _max_parallel_penalty(a, counts)))
             pick = group.pop(0)
             victims.append(pick)
             victim_ids.add(pick.id)
+            ckey = (pick.namespace, pick.job_id, pick.task_group)
+            counts[ckey] = counts.get(ckey, 0) + 1
             if fits_now():
                 # drop any victim that is no longer necessary (reference
                 # filterSuperset behavior: remove redundant evictions)
@@ -98,3 +148,123 @@ def preempt_for_task_group(
                         victim_ids.add(v.id)
                 return [v for v in victims if v.id in victim_ids]
     return None
+
+
+def preempt_for_network(
+    node,
+    proposed: Sequence[Allocation],
+    ask,
+    current_priority: int,
+    preempted_counts: Optional[Dict[tuple, int]] = None,
+) -> Optional[List[Allocation]]:
+    """Free conflicting reserved ports (reference preemption.go:30
+    PreemptForNetwork). The reference also preempts on bandwidth
+    (networkResourceDistance over mbits); this model's allocations
+    record ports but not per-alloc bandwidth, so the network dimension
+    here is reserved-port conflicts — victims are taken in ascending
+    priority groups, direct holders of a needed port first, with the
+    migrate max_parallel penalty applied (scoreForNetwork)."""
+    needed_ports = {p[1] for p in ask.reserved_port_asks()}
+    if not needed_ports:
+        return None
+
+    counts: Dict[tuple, int] = dict(preempted_counts or {})
+
+    def alloc_ports(a: Allocation) -> set:
+        return {p.value for p in a.allocated_ports}
+
+    candidates = [a for a in proposed if is_preemptible(a, current_priority)
+                  and alloc_ports(a) & needed_ports]
+    if not candidates:
+        return None
+
+    victims: List[Allocation] = []
+    victim_ids = set()
+
+    def satisfied() -> bool:
+        for a in proposed:
+            if a.id in victim_ids or not a.should_count_for_usage():
+                continue
+            if alloc_ports(a) & needed_ports:
+                return False
+        return True
+
+    if satisfied():
+        return None
+
+    candidates.sort(key=lambda a: a.job.priority)
+    i = 0
+    while i < len(candidates):
+        prio = candidates[i].job.priority
+        group = []
+        while i < len(candidates) and candidates[i].job.priority == prio:
+            group.append(candidates[i])
+            i += 1
+        while group:
+            group.sort(key=lambda a: (
+                -len(alloc_ports(a) & needed_ports)
+                + _max_parallel_penalty(a, counts)))
+            pick = group.pop(0)
+            victims.append(pick)
+            victim_ids.add(pick.id)
+            ckey = (pick.namespace, pick.job_id, pick.task_group)
+            counts[ckey] = counts.get(ckey, 0) + 1
+            if satisfied():
+                return victims
+    return None
+
+
+def preempt_for_device(
+    node,
+    proposed: Sequence[Allocation],
+    ask_devices,
+    current_priority: int,
+) -> Optional[List[Allocation]]:
+    """Free device-group instances (reference preemption.go:16
+    PreemptForDevice + selectBestAllocs): per unsatisfied ask, victims
+    come from ascending priority groups, largest instance holders first,
+    until enough instances are free."""
+    from .devices import matching_groups
+
+    victims: List[Allocation] = []
+    victim_ids = set()
+
+    for ask in ask_devices:
+        groups = matching_groups(node, ask, {}, {})
+        group_ids = {g.id for g in groups}
+        capacity = sum(len(g.instance_ids) for g in groups)
+
+        def held_instances(a: Allocation) -> int:
+            return sum(len(inst)
+                       for name, inst in (a.allocated_devices or {}).items()
+                       if name in group_ids)
+
+        def free_now() -> int:
+            used = 0
+            for a in proposed:
+                if a.id in victim_ids or not a.should_count_for_usage():
+                    continue
+                used += held_instances(a)
+            return capacity - used
+
+        needed = ask.count - free_now()
+        if needed <= 0:
+            continue
+        candidates = [a for a in proposed
+                      if is_preemptible(a, current_priority)
+                      and held_instances(a) > 0]
+        if not candidates:
+            return None
+        # ascending priority, then largest holders first within a group
+        # (reference selectBestAllocs sorts descending by instance count)
+        candidates.sort(key=lambda a: (a.job.priority, -held_instances(a)))
+        freed = 0
+        for a in candidates:
+            if freed >= needed:
+                break
+            victims.append(a)
+            victim_ids.add(a.id)
+            freed += held_instances(a)
+        if freed < needed:
+            return None
+    return victims or None

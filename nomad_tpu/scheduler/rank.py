@@ -116,9 +116,32 @@ class NodeScorer:
         self.sum_affinity_weight = sum(abs(a.weight) for a in self.affinities)
         self.spread = SpreadScorer(job, tg, ctx.snapshot)
         self.penalty_nodes: FrozenSet[str] = frozenset()
+        self._ppc_cache = None
 
     def has_affinities_or_spreads(self) -> bool:
         return bool(self.affinities) or self.spread.has_spreads()
+
+    def _plan_preempted_counts(self) -> dict:
+        """Evictions already in the in-progress plan per (ns, job, tg),
+        so migrate max_parallel penalties span the whole eval
+        (reference preemption.go scoreForTaskGroup numPreemptedAllocs).
+        Cached against the plan's total preemption count — a full-cluster
+        scan calls rank() per node and must not rebuild an identical dict
+        every time."""
+        plan = self.ctx.plan
+        if plan is None:
+            return {}
+        total = sum(len(v) for v in plan.node_preemptions.values())
+        cached = self._ppc_cache
+        if cached is not None and cached[0] == total:
+            return cached[1]
+        counts: dict = {}
+        for allocs in plan.node_preemptions.values():
+            for a in allocs:
+                k = (a.namespace, a.job_id, a.task_group)
+                counts[k] = counts.get(k, 0) + 1
+        self._ppc_cache = (total, counts)
+        return counts
 
     # --- binpack fit (reference rank.go:205-587 BinPackIterator.Next) ---
 
@@ -153,7 +176,8 @@ class NodeScorer:
 
             victims = preempt_for_task_group(
                 node, proposed, self.ask_vec, self.current_priority,
-                check_devices=check_devices, ask_devices=self.ask.devices)
+                check_devices=check_devices, ask_devices=self.ask.devices,
+                preempted_counts=self._plan_preempted_counts())
             if not victims:
                 if self.ctx.metrics is not None:
                     self.ctx.metrics.exhaust_node(dim)
@@ -178,6 +202,22 @@ class NodeScorer:
                 if a.id not in {v.id for v in option.preempted_allocs}]
             idx.add_allocs(counted)
             ports, err = idx.assign_ports(self.ask)
+            if err and self.preemption_enabled:
+                # reserved-port conflict: free the holders (reference
+                # rank.go preemption fallback -> PreemptForNetwork)
+                from .preemption import preempt_for_network
+
+                net_victims = preempt_for_network(
+                    node, counted, self.ask, self.current_priority,
+                    preempted_counts=self._plan_preempted_counts())
+                if net_victims:
+                    option.preempted_allocs = (
+                        (option.preempted_allocs or []) + net_victims)
+                    victim_ids = {v.id for v in option.preempted_allocs}
+                    counted = [a for a in counted if a.id not in victim_ids]
+                    idx = NetworkIndex(node)
+                    idx.add_allocs(counted)
+                    ports, err = idx.assign_ports(self.ask)
             if err:
                 if self.ctx.metrics is not None:
                     self.ctx.metrics.exhaust_node("ports")
@@ -198,6 +238,24 @@ class NodeScorer:
             didx = DeviceIndex(node, counted_for_ids)
             assignment = didx.assign(self.ask.devices,
                                      self.ctx.regex_cache, self.ctx.version_cache)
+            if assignment is None and self.preemption_enabled:
+                # device instances exhausted: free holders (reference
+                # rank.go fallback -> PreemptForDevice)
+                from .preemption import preempt_for_device
+
+                dev_victims = preempt_for_device(
+                    node, counted_for_ids, self.ask.devices,
+                    self.current_priority)
+                if dev_victims:
+                    option.preempted_allocs = (
+                        (option.preempted_allocs or []) + dev_victims)
+                    victim_ids = {v.id for v in option.preempted_allocs}
+                    counted_for_ids = [a for a in counted_for_ids
+                                       if a.id not in victim_ids]
+                    didx = DeviceIndex(node, counted_for_ids)
+                    assignment = didx.assign(self.ask.devices,
+                                             self.ctx.regex_cache,
+                                             self.ctx.version_cache)
             if assignment is None:
                 if self.ctx.metrics is not None:
                     self.ctx.metrics.exhaust_node("devices")
@@ -217,6 +275,15 @@ class NodeScorer:
                     self.ctx.metrics.exhaust_node("cores")
                 return None
             option.allocated_cores = cores
+
+        if option.preempted_allocs is not None:
+            # network/device preemption may have added victims after the
+            # first fit pass: recompute usage so the binpack score sees
+            # the node as the evictions leave it
+            victim_ids = {v.id for v in option.preempted_allocs}
+            remaining = [a for a in proposed if a.id not in victim_ids]
+            _, _, used = allocs_fit(node, remaining + [placement],
+                                    check_devices=check_devices)
 
         available = node.available_vec()
         if self.algorithm == enums.SCHED_ALG_SPREAD:

@@ -701,3 +701,62 @@ def solve_bulk_multi(
 
     used, counts = jax.lax.scan(one_eval, used0, jnp.arange(g))
     return used, counts
+
+
+@jax.jit
+def preempt_pick(
+    available,   # (N, D) capacity
+    used0,       # (N, D) proposed usage
+    evictable0,  # (N, D) sum of preemptible lower-priority alloc usage
+    ask,         # (D,)
+    feasible,    # (N,) bool constraint/driver mask
+    net_prio,    # (N,) approximate netPriority of the node's preemptible
+                 #      set: max + sum/max (reference rank.go netPriority
+                 #      over the victim set; the per-node aggregate is an
+                 #      upper bound used only to ORDER candidate nodes —
+                 #      the host recomputes the exact score for the
+                 #      chosen node's actual victims)
+    active,      # (K,) bool request slots
+):
+    """Batched preemption node choice for K requests -> (K,) int32 node
+    index per request (-1 = no preemptible node). Mirrors the host
+    fallback's node ordering: fit score after eviction + the logistic
+    preemption penalty (rank.go:894 preemptionScore), averaged like
+    ScoreNormalizationIterator. The scan carries usage and remaining
+    evictable capacity so sibling requests don't pile onto one node;
+    exact victim selection stays host-side per chosen node
+    (scheduler/preemption.py)."""
+    n, d = available.shape
+    f = available.dtype
+    ask_pos = ask > 0
+    rate, origin = 0.0048, 2048.0
+    pscore_node = 1.0 / (1.0 + jnp.exp(rate * (net_prio - origin)))
+
+    def step(carry, i):
+        used, evictable = carry
+        new_used = used + ask[None, :]
+        deficit = jnp.maximum(new_used - available, 0.0)
+        can = feasible & jnp.all(deficit <= evictable, axis=1)
+        needs_evict = jnp.any(deficit > 0.0, axis=1)
+        fitness = fit_scores(available, jnp.minimum(new_used, available), False)
+        divisor = 1.0 + needs_evict.astype(f)
+        score = (fitness + jnp.where(needs_evict, pscore_node, 0.0)) / divisor
+        score = jnp.where(can, score, NEG)
+        best = jnp.argmax(score)
+        found = (score[best] > NEG) & active[i]
+
+        def apply(c):
+            used, evictable = c
+            used = used.at[best].set(
+                jnp.minimum(used[best] + ask, available[best]))
+            evictable = evictable.at[best].set(
+                jnp.maximum(evictable[best] - deficit[best], 0.0))
+            return used, evictable
+
+        used, evictable = jax.lax.cond(found, apply, lambda c: c,
+                                       (used, evictable))
+        return (used, evictable), jnp.where(found, best, -1)
+
+    _, picks = jax.lax.scan(step, (used0, evictable0),
+                            jnp.arange(active.shape[0]))
+    return picks.astype(jnp.int32)

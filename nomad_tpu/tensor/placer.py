@@ -35,6 +35,45 @@ from ..scheduler.reconcile import PlacementRequest
 from .cluster import ClusterTensors, build_task_group_tensors, _pad_pow2
 
 
+def _preempt_pick_host(available, used, evictable, ask, feasible, net_prio,
+                       active) -> np.ndarray:
+    """Numpy mirror of kernels.preempt_pick for small (nodes x requests)
+    shapes — identical node ordering, no device round trip."""
+    n = available.shape[0]
+    pscore = 1.0 / (1.0 + np.exp(0.0048 * (net_prio - 2048.0)))
+    evictable = evictable.copy()
+    picks = np.full(active.shape[0], -1, dtype=np.int32)
+    neg = -1.0e30
+    for i in range(active.shape[0]):
+        if not active[i]:
+            continue
+        new_used = used + ask[None, :]
+        deficit = np.maximum(new_used - available, 0.0)
+        can = feasible & (deficit <= evictable).all(axis=1)
+        if not can.any():
+            continue
+        needs_evict = (deficit > 0.0).any(axis=1)
+        capped = np.minimum(new_used, available)
+        safe = np.where(available > 0, available, 1.0)
+        ratio = np.where(available > 0, capped / safe,
+                         np.where(capped > 0, np.inf, 0.0))
+        free = 1.0 - ratio
+        total10 = 10.0 ** free[:, 0] + 10.0 ** free[:, 1]
+        fitness = np.clip(20.0 - total10, 0.0, 18.0) / 18.0
+        score = np.where(
+            can,
+            (fitness + np.where(needs_evict, pscore, 0.0))
+            / (1.0 + needs_evict.astype(float)),
+            neg)
+        best = int(np.argmax(score))
+        if score[best] <= neg:
+            continue
+        picks[i] = best
+        used[best] = np.minimum(used[best] + ask, available[best])
+        evictable[best] = np.maximum(evictable[best] - deficit[best], 0.0)
+    return picks
+
+
 class TPUPlacer:
     """Placer implementation: dense-tensor batch solve on the device."""
 
@@ -171,6 +210,7 @@ class TPUPlacer:
             core_used: Dict[int, set] = {}
 
             n_feasible = int(tgt.feasible[: len(nodes)].sum())
+            preempt_queue: List[PlacementRequest] = []
             for i, req in enumerate(reqs):
                 metrics = ctx.new_metrics()
                 metrics.nodes_in_pool = len(nodes)
@@ -219,24 +259,26 @@ class TPUPlacer:
                     commit(req, option)
                     continue
                 if preemption_enabled:
-                    option = self._preempt_fallback(ctx, job, tg, nodes, req,
-                                                    batch, attempt)
-                    if option is not None:
-                        commit(req, option)
-                        # evictions + the fallback's own id assignments
-                        # invalidate this node's port/device/core caches
-                        self._invalidate_node(cluster, option.node.id,
-                                              net_idx, dev_idx, core_used)
-                        continue
-                    metrics = ctx.metrics or metrics
+                    preempt_queue.append(req)
+                    continue
                 self._attribute_failure(ctx, metrics, len(nodes), n_feasible)
                 commit(req, None)
+            if preempt_queue:
+                self._preempt_batch(
+                    ctx, job, tg, preempt_queue, cluster, tgt, commit,
+                    sched_batch=batch, attempt=attempt,
+                    n_feasible=n_feasible,
+                    invalidate=lambda nid: self._invalidate_node(
+                        cluster, nid, net_idx, dev_idx, core_used))
 
     # -- bulk (count-based) solve: the C2M path --
 
     BULK_MIN = 256     # below this the per-placement scan is fine
     BULK_STEP = 256    # placements assigned per scan step
     HOST_CUTOVER = 16  # at/below this the host oracle beats a launch
+    # preemption node-choice runs on-device only when the (nodes x
+    # requests) matrix is big enough to beat the tunnel's fixed latency
+    PREEMPT_DEVICE_MIN = 1 << 20
 
     def _bulk_eligible(self, ctx, tg, reqs, tgt) -> bool:
         """K large, every request a fresh placement, BestFit binpack with
@@ -350,18 +392,100 @@ class TPUPlacer:
         if not unplaced:
             return
         n_feasible = int(tgt.feasible[: len(cluster.nodes)].sum())
+        if preemption_enabled:
+            self._preempt_batch(ctx, job, tg, unplaced, cluster, tgt,
+                                commit, sched_batch=sched_batch,
+                                attempt=attempt, n_feasible=n_feasible)
+            return
         for req in unplaced:
-            if preemption_enabled:
-                option = self._preempt_fallback(ctx, job, tg, cluster.nodes,
-                                                req, sched_batch, attempt)
-                if option is not None:
-                    commit(req, option)
-                    continue
             metrics = ctx.new_metrics()
             metrics.nodes_in_pool = len(cluster.nodes)
             metrics.nodes_evaluated = len(cluster.nodes)
             self._attribute_failure(ctx, metrics, len(cluster.nodes),
                                     n_feasible)
+            commit(req, None)
+
+    # -- batched preemption: kernel node choice + host victim selection --
+
+    def _preempt_batch(self, ctx, job, tg, reqs, cluster, tgt, commit, *,
+                       sched_batch: bool, attempt: int, n_feasible: int,
+                       invalidate=None) -> None:
+        """Preemption for K unplaced requests as ONE device pass + K
+        single-node host victim selections, replacing the per-request
+        full-cluster host scan (the round-3 fallback that ran cfg4 at
+        0.47x stock). The kernel (kernels.preempt_pick) orders candidate
+        nodes by fit-after-eviction + the logistic preemption penalty
+        over per-node preemptible aggregates; the host then runs the
+        exact reference victim selection (scheduler/preemption.py) only
+        on each chosen node, falling back to the full host scan for any
+        request whose chosen node can't actually be freed (aggregate
+        mispredictions: delta-10 groups, device/port holders)."""
+        from ..scheduler.rank import NodeScorer
+        from ..scheduler.preemption import PRIORITY_DELTA
+        from .kernels import preempt_pick
+
+        nodes = cluster.nodes
+        n_pad = cluster.n_pad
+        prio = job.priority
+        evictable = np.zeros((n_pad, cluster.available.shape[1]))
+        max_prio = np.zeros(n_pad)
+        sum_prio = np.zeros(n_pad)
+        for i, node in enumerate(nodes):
+            for a in ctx.proposed_allocs(node.id):
+                if (a.job is not None
+                        and prio - a.job.priority >= PRIORITY_DELTA
+                        and a.should_count_for_usage()):
+                    evictable[i] += a.allocated_vec[: evictable.shape[1]]
+                    p = float(a.job.priority)
+                    sum_prio[i] += p
+                    if p > max_prio[i]:
+                        max_prio[i] = p
+        net_prio = np.where(max_prio > 0,
+                            max_prio + sum_prio / np.maximum(max_prio, 1.0),
+                            0.0)
+        k_pad = _pad_pow2(len(reqs), floor=1)
+        active = np.zeros(k_pad, dtype=bool)
+        active[: len(reqs)] = True
+        if n_pad * k_pad >= self.PREEMPT_DEVICE_MIN:
+            picks = np.asarray(preempt_pick(
+                cluster.available, cluster.used, evictable, tgt.ask,
+                tgt.feasible, net_prio, active))
+        else:
+            # same math without a device launch: below this size the
+            # tunnel's fixed latency dwarfs the vector work
+            picks = _preempt_pick_host(
+                cluster.available, cluster.used.copy(), evictable, tgt.ask,
+                tgt.feasible, net_prio, active)
+
+        scorer = NodeScorer(ctx, job, tg, algorithm=self._host_algorithm(),
+                            preemption_enabled=True)
+        for i, req in enumerate(reqs):
+            metrics = ctx.new_metrics()
+            metrics.nodes_in_pool = len(nodes)
+            metrics.nodes_evaluated = len(nodes)
+            option = None
+            ni = int(picks[i])
+            if req.ignore_node:
+                # rescheduled alloc: the batched pick carries no
+                # node-reschedule penalty, so keep the full host scan
+                # (which weighs it) for these rare requests
+                ni = -1
+            if 0 <= ni < len(nodes):
+                # exact victim selection + scoring on the chosen node
+                # only (ports/devices/spread handled by the scorer)
+                option = scorer.rank(nodes[ni])
+            if option is None:
+                # aggregate misprediction: full host scan for this one
+                option = self._preempt_fallback(ctx, job, tg, nodes, req,
+                                                sched_batch, attempt)
+            if option is not None:
+                commit(req, option)
+                scorer.record_placement(option.node)
+                if invalidate is not None:
+                    invalidate(option.node.id)
+                continue
+            metrics = ctx.metrics or metrics
+            self._attribute_failure(ctx, metrics, len(nodes), n_feasible)
             commit(req, None)
 
     @staticmethod

@@ -188,6 +188,7 @@ class BulkSolverService:
         while True:
             req = self._q.get()
             if req is _STOP:
+                self._drain_failed()
                 return
             batch = [req]
             # drain whatever queued while the previous launch ran
@@ -198,9 +199,22 @@ class BulkSolverService:
                     break
                 if nxt is _STOP:
                     self._flush(batch)
+                    self._drain_failed()
                     return
                 batch.append(nxt)
             self._flush(batch)
+
+    def _drain_failed(self) -> None:
+        """Fail any request that raced the stop sentinel into the queue —
+        its worker is blocked on the future and must not hang."""
+        while True:
+            try:
+                nxt = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if nxt is not _STOP and not nxt.future.done():
+                nxt.future.set_exception(
+                    RuntimeError("bulk solver service stopped"))
 
     def _flush(self, batch: List[_Request]) -> None:
         # one launch per distinct static (mixed batches happen only
@@ -237,14 +251,20 @@ class BulkSolverService:
         while len(rows_m) < g_pad:
             rows_m.append(rows_m[0])
             rows_a.append(rows_a[0])
-        skey = ("stack", tuple(i for i, _ in rows_m),
-                tuple(i for i, _ in rows_a))
-        stacked = da.get(skey)
+        # cache the stacked buffers only for UNIFORM batches (every row
+        # the same mask/aff — the overwhelmingly common shape): mixed
+        # compositions vary by arrival order, and caching each
+        # permutation would pin unbounded device memory
+        uniform = (all(i == rows_m[0][0] for i, _ in rows_m)
+                   and all(i == rows_a[0][0] for i, _ in rows_a))
+        skey = ("stack", g_pad, rows_m[0][0], rows_a[0][0])
+        stacked = da.get(skey) if uniform else None
         if stacked is None:
-            # on-device stack: no host transfer, one cached buffer per
-            # recurring mask/affinity combination
-            stacked = da[skey] = (jnp.stack([m for _, m in rows_m]),
-                                  jnp.stack([a for _, a in rows_a]))
+            # on-device stack: no host transfer
+            stacked = (jnp.stack([m for _, m in rows_m]),
+                       jnp.stack([a for _, a in rows_a]))
+            if uniform:
+                da[skey] = stacked
         return avail, stacked[0], stacked[1], g_pad
 
     def _solve_group(self, rs: List[_Request]) -> None:
