@@ -259,6 +259,19 @@ def cfg2_batch_constraints() -> None:
     dt, placed, rej = run_server(1024, jobs, enums.SCHED_ALG_TPU_BINPACK)
     assert placed == 10240, placed
 
+    # stock binpack through the SAME racing-worker pipeline: the
+    # rejection-rate comparison finally has a baseline measured under
+    # identical contention (reference nomad.plan.node_rejected,
+    # plan_apply.go:470). Quarter volume: the rate comes from contention
+    # shape, and the full 10K through the host scanner is minutes of
+    # scaffolding
+    def stock_jobs():
+        return [service_job(256, batch=True, constraints=cons,
+                            affinities=affs) for _ in range(10)]
+
+    _, _, rej_stock = run_server(1024, stock_jobs, enums.SCHED_ALG_BINPACK,
+                                 timeout=600.0)
+
     # score parity + per-alloc speedup on a 512-alloc sample, serial.
     # The sample drops the zone affinity: every job preferring the same
     # zone makes the trajectory-mean comparison measure concentration
@@ -271,7 +284,8 @@ def cfg2_batch_constraints() -> None:
     hdt, hn, hscore, _ = run_harness(1024, sample, enums.SCHED_ALG_BINPACK)
     emit("constraint_sched_throughput_10k_allocs_1k_nodes",
          placed / dt, "allocs/s", (hdt / hn) / (tdt / tn),
-         score_parity_pp=tscore - hscore, plan_rejection_rate=rej)
+         score_parity_pp=tscore - hscore, plan_rejection_rate=rej,
+         plan_rejection_rate_stock=rej_stock)
 
 
 def cfg3_spread_50k() -> None:
@@ -288,6 +302,19 @@ def cfg3_spread_50k() -> None:
                                  timeout=600.0)
     assert placed == 50000, placed
 
+    # stock rejection baseline under the same racing contention, at a
+    # tenth of the alloc count: contention shape, not total volume,
+    # drives rejections, and the host scanner needs minutes per 10K
+    # allocs at 5K nodes. Non-fatal — the scored rung is the TPU run
+    def stock_jobs():
+        return [service_job(500, spreads=spreads) for _ in range(10)]
+
+    try:
+        _, _, rej_stock = run_server(5120, stock_jobs,
+                                     enums.SCHED_ALG_BINPACK, timeout=600.0)
+    except TimeoutError:
+        rej_stock = None
+
     def sample():
         return [service_job(128, spreads=spreads) for _ in range(2)]
 
@@ -295,7 +322,8 @@ def cfg3_spread_50k() -> None:
     hdt, hn, hscore, _ = run_harness(5120, sample, enums.SCHED_ALG_BINPACK)
     emit("spread_sched_throughput_50k_allocs_5k_nodes",
          placed / dt, "allocs/s", (hdt / hn) / (tdt / tn),
-         score_parity_pp=tscore - hscore, plan_rejection_rate=rej)
+         score_parity_pp=tscore - hscore, plan_rejection_rate=rej,
+         plan_rejection_rate_stock=rej_stock)
 
 
 def cfg_c2m() -> None:

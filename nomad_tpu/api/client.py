@@ -253,6 +253,19 @@ class ApiClient:
     def set_scheduler_configuration(self, cfg) -> None:
         self._request("PUT", "/v1/operator/scheduler/configuration", cfg)
 
+    def raft_configuration(self) -> dict:
+        out, _ = self.get("/v1/operator/raft/configuration")
+        return out
+
+    def raft_remove_peer(self, server_id: str) -> None:
+        self._request("DELETE", "/v1/operator/raft/peer",
+                      params={"id": server_id})
+
+    def agent_join(self, address: str) -> None:
+        """Tell this agent's server to join an existing cluster
+        (reference `nomad server join` -> /v1/agent/join)."""
+        self._request("PUT", "/v1/agent/join", {"address": address})
+
     def snapshot_save(self) -> dict:
         """Whole-cluster state dump (reference operator snapshot save)."""
         out, _ = self.get("/v1/operator/snapshot")

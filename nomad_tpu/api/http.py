@@ -446,7 +446,9 @@ class HTTPAgent:
                                       "term": 0, "commit_index": 0,
                                       "last_applied": 0, "mode": "single"})
             transport = getattr(self.writer, "transport", None)
-            addrs = getattr(transport, "peer_addrs", None) or {}
+            addrs = dict(getattr(transport, "peer_addrs", None) or {})
+            # live membership (dynamic config changes land here first)
+            addrs.update({k: v for k, v in raft.servers.items() if v})
             servers = [{"id": raft.id, "address": addrs.get(raft.id, "local"),
                         "leader": raft.is_leader(), "self": True}]
             for p in raft.peers:
@@ -723,6 +725,20 @@ class HTTPAgent:
             cfg = from_dict(SchedulerConfiguration, body)
             self.writer.set_scheduler_config(cfg)
             return h._reply(200, {"updated": True})
+        if path == "/v1/agent/join":
+            # tell this RUNNING agent to join an existing cluster
+            # (reference `nomad server join` -> /v1/agent/join, gated
+            # behind agent:write)
+            if acl is not None and not acl.allow_operator_write():
+                return h._error(403, "Permission denied")
+            addr = (body or {}).get("address", "")
+            join = getattr(self.writer, "join", None)
+            if join is None:
+                return h._error(400, "not a raft server")
+            if not addr:
+                return h._error(400, "missing address")
+            join(addr)
+            return h._reply(200, {"joined": addr})
         if path == "/v1/operator/snapshot":
             # whole-state restore (reference operator_snapshot_restore);
             # the dump holds token secrets: management only
@@ -754,6 +770,24 @@ class HTTPAgent:
         from ..acl import policy as aclp
 
         ns = q.get("namespace", ["default"])[0]
+        if path == "/v1/operator/raft/peer":
+            # remove a server from the raft configuration (reference
+            # `operator raft remove-peer`, operator_endpoint.go)
+            if acl is not None and not acl.allow_operator_write():
+                return h._error(403, "Permission denied")
+            sid = q.get("id", [""])[0]
+            remove = getattr(self.writer, "remove_peer", None)
+            if remove is None:
+                return h._error(400, "not a raft server")
+            if not sid:
+                return h._error(400, "missing id")
+            try:
+                remove(sid)
+            except ValueError as e:
+                return h._error(400, str(e))
+            except KeyError as e:
+                return h._error(404, str(e))
+            return h._reply(200, {"removed": sid})
         if m := re.fullmatch(r"/v1/job/(.+)", path):
             if not self._ns_allowed(acl, ns, aclp.CAP_SUBMIT_JOB):
                 return h._error(403, "Permission denied")

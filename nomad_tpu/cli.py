@@ -78,10 +78,15 @@ def cmd_agent(args) -> int:
             return 1
         transport = SocketTransport(args.server_id, peers[args.server_id],
                                     peers).start()
+        joining = bool(getattr(args, "join", ""))
+        cleanup = getattr(args, "dead_server_cleanup", 0.0) or None
         replicated = ReplicatedServer(
             args.server_id, list(peers), transport, cfg,
-            data_dir=args.data_dir or None)
+            data_dir=args.data_dir or None,
+            bootstrap=not joining, dead_server_cleanup_s=cleanup)
         replicated.start()
+        if joining:
+            replicated.join(args.join)
         server = replicated.server
         endpoint = replicated
     else:
@@ -364,6 +369,32 @@ def cmd_operator_scheduler(args) -> int:
     return 0
 
 
+def cmd_operator_raft(args) -> int:
+    """Raft membership operations (reference command/operator_raft_*.go)."""
+    api = _client(args)
+    if args.op == "list-peers":
+        cfg = api.raft_configuration()
+        for s in cfg.get("servers", []):
+            mark = " (leader)" if s.get("leader") else ""
+            print(f"{s['id']}\t{s['address']}{mark}")
+        return 0
+    if not args.peer_id:
+        print("remove-peer requires -peer-id", file=sys.stderr)
+        return 2
+    api.raft_remove_peer(args.peer_id)
+    print(f"peer {args.peer_id} removed")
+    return 0
+
+
+def cmd_server_join(args) -> int:
+    """Tell the local agent's server to join a cluster (reference
+    command/server_join.go)."""
+    api = _client(args)
+    api.agent_join(args.join_addr)
+    print(f"joined via {args.join_addr}")
+    return 0
+
+
 def cmd_deployment(args) -> int:
     """Deployment operations (reference command/deployment_*.go)."""
     api = _client(args)
@@ -488,6 +519,13 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("--peers", default="",
                     help="raft peer set 'id=host:port,id=host:port,...' "
                          "(enables multi-server mode)")
+    ag.add_argument("--join", default="",
+                    help="address of any live cluster member; this server "
+                         "joins that cluster instead of bootstrapping "
+                         "(use with --peers listing only itself)")
+    ag.add_argument("--dead-server-cleanup", type=float, default=0.0,
+                    help="autopilot: remove a server unreachable this many "
+                         "seconds (0 = disabled; reference nomad/autopilot.go)")
     ag.set_defaults(fn=cmd_agent)
 
     job = sub.add_parser("job").add_subparsers(dest="job_cmd", required=True)
@@ -616,6 +654,16 @@ def build_parser() -> argparse.ArgumentParser:
     osnap.add_argument("op", choices=["save", "restore"])
     osnap.add_argument("file")
     osnap.set_defaults(fn=cmd_operator_snapshot)
+    oraft = op.add_parser("raft")
+    oraft.add_argument("op", choices=["list-peers", "remove-peer"])
+    oraft.add_argument("-peer-id", dest="peer_id", default="")
+    oraft.set_defaults(fn=cmd_operator_raft)
+
+    server = sub.add_parser("server").add_subparsers(dest="server_cmd",
+                                                     required=True)
+    sjoin = server.add_parser("join")
+    sjoin.add_argument("join_addr")
+    sjoin.set_defaults(fn=cmd_server_join)
 
     return p
 

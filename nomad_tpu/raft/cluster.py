@@ -42,7 +42,9 @@ class ReplicatedServer:
                  config: Optional[ServerConfig] = None,
                  peer_lookup: Optional[Callable[[str], "ReplicatedServer"]] = None,
                  data_dir: Optional[str] = None,
-                 snapshot_threshold: int = 1024):
+                 snapshot_threshold: int = 1024,
+                 bootstrap: bool = True,
+                 dead_server_cleanup_s: Optional[float] = None):
         self.id = node_id
         self.local_store = StateStore()
         self.fsm = FSM(self.local_store)
@@ -69,7 +71,11 @@ class ReplicatedServer:
                              log=log, stable=stable, snapshots=snapshots,
                              fsm_snapshot=fsm_snapshot,
                              fsm_restore=fsm_restore,
-                             snapshot_threshold=snapshot_threshold)
+                             snapshot_threshold=snapshot_threshold,
+                             peer_addrs=getattr(transport, "peer_addrs", None),
+                             on_config_change=self._on_config_change,
+                             bootstrap=bootstrap,
+                             dead_server_cleanup_s=dead_server_cleanup_s)
         self.store = RaftStore(self.local_store, self.raft)
         self.server = Server(config, store=self.store)
         self._peer_lookup = peer_lookup
@@ -80,12 +86,79 @@ class ReplicatedServer:
         if hasattr(transport, "register_call_handler"):
             transport.register_call_handler(self._handle_remote_call)
 
+    def _on_config_change(self, servers: Dict[str, str]) -> None:
+        """Membership changed (config entry applied): teach the socket
+        transport any new peer addresses so replication can reach them."""
+        transport = self.transport
+        addrs = getattr(transport, "peer_addrs", None)
+        if addrs is None:
+            return
+        for sid, addr in servers.items():
+            if addr and addrs.get(sid) != addr:
+                addrs[sid] = addr
+
     def _handle_remote_call(self, method: str, args: tuple, kwargs: dict):
+        if method == "raft_add_server":
+            return self._membership_change("add_server", *args)
+        if method == "raft_remove_server":
+            return self._membership_change("remove_server", *args)
         if method not in FORWARD:
             raise ValueError(f"method {method!r} is not forwardable")
         if not self.is_leader():
             raise NotLeaderError(self.raft.leader_id)
         return getattr(self.server, method)(*args, **kwargs)
+
+    def _membership_change(self, op: str, *args):
+        """Run a membership change on the leader: locally when this node
+        leads, else one forwarded hop (the joiner only knows the address
+        it contacted; this member knows the leader — reference
+        nomad/serf.go join forwarding)."""
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if self.raft.is_leader():
+                getattr(self.raft, op)(*args)
+                return {"ok": True}
+            lid = self.raft.leader_id
+            if lid and lid != self.id and hasattr(self.transport, "call"):
+                try:
+                    return self.transport.call(
+                        lid, f"raft_{op}", args, {})
+                except RemoteCallError as e:
+                    # real outcomes (unknown id, leader-removal refusal)
+                    # must surface, not retry until the deadline
+                    cls = self._WIRE_ERRORS.get(e.error_type)
+                    if cls is not None:
+                        raise cls(str(e)) from e
+                    if e.error_type != "NotLeaderError":
+                        raise
+                except TransportError:
+                    pass
+            time.sleep(0.05)
+        raise NotLeaderError(self.raft.leader_id)
+
+    def join(self, contact_addr: str, timeout: float = 15.0) -> None:
+        """Joiner-side: ask any live member at contact_addr to add this
+        server to the cluster (agent `server join` — reference
+        nomad/server.go:1602 Join via serf, here an explicit RPC)."""
+        transport = self.transport
+        if not hasattr(transport, "call"):
+            raise RuntimeError("join requires the socket transport")
+        contact_id = f"_join:{contact_addr}"
+        transport.peer_addrs[contact_id] = contact_addr
+        deadline = time.time() + timeout
+        last_err = None
+        try:
+            while time.time() < deadline:
+                try:
+                    transport.call(contact_id, "raft_add_server",
+                                   (self.id, transport.bind_addr), {})
+                    return
+                except (RemoteCallError, TransportError) as e:
+                    last_err = e
+                    time.sleep(0.2)
+        finally:
+            transport.peer_addrs.pop(contact_id, None)
+        raise TimeoutError(f"join via {contact_addr} failed: {last_err}")
 
     # -- lifecycle --
 
@@ -109,6 +182,11 @@ class ReplicatedServer:
 
         threading.Thread(target=flip, daemon=True,
                          name=f"leadership-{self.id}").start()
+
+    def remove_peer(self, server_id: str):
+        """Operator removal of a server (reference `operator raft
+        remove-peer`, nomad/operator_endpoint.go RaftRemovePeer)."""
+        return self._membership_change("remove_server", server_id)
 
     # -- forwarded endpoint surface --
 

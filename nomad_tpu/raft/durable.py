@@ -51,9 +51,12 @@ class SnapshotStore:
     def __init__(self, dir_path: str):
         self._path = os.path.join(dir_path, "snapshot.json")
 
-    def save(self, index: int, term: int, data: dict) -> None:
-        _atomic_write(self._path, json.dumps(
-            {"index": index, "term": term, "data": data}))
+    def save(self, index: int, term: int, data: dict,
+             servers: Optional[dict] = None) -> None:
+        payload = {"index": index, "term": term, "data": data}
+        if servers:
+            payload["servers"] = servers
+        _atomic_write(self._path, json.dumps(payload))
 
     def load(self) -> Optional[dict]:
         if not os.path.exists(self._path):
@@ -198,7 +201,7 @@ class DurableLog:
             self._write([e])
             return e
 
-    def append_entries(self, prev_index: int, entries: List[Entry]) -> None:
+    def append_entries(self, prev_index: int, entries: List[Entry]) -> bool:
         with self._lock:
             appended: List[Entry] = []
             truncated = False
@@ -220,6 +223,7 @@ class DurableLog:
                 self._rewrite()
             elif appended:
                 self._write(appended)
+            return truncated
 
     def length(self) -> int:
         with self._lock:

@@ -55,9 +55,12 @@ class RaftLog:
             self._entries.append(e)
             return e
 
-    def append_entries(self, prev_index: int, entries: List[Entry]) -> None:
+    def append_entries(self, prev_index: int, entries: List[Entry]) -> bool:
         """Follower-side: truncate conflicts after prev_index, then
-        append (the AppendEntries receiver rules)."""
+        append (the AppendEntries receiver rules). Returns True when a
+        conflicting suffix was truncated (membership must be
+        recomputed — a dropped entry may have been a config change)."""
+        truncated = False
         with self._lock:
             for e in entries:
                 pos = e.index - 1
@@ -65,9 +68,11 @@ class RaftLog:
                     if self._entries[pos].term != e.term:
                         del self._entries[pos:]
                         self._entries.append(e)
+                        truncated = True
                     # else: already have it
                 else:
                     self._entries.append(e)
+        return truncated
 
     def length(self) -> int:
         with self._lock:

@@ -30,9 +30,35 @@ class RaftNode:
                  log=None, stable=None, snapshots=None,
                  fsm_snapshot: Optional[Callable[[], dict]] = None,
                  fsm_restore: Optional[Callable[[dict], None]] = None,
-                 snapshot_threshold: int = 1024):
+                 snapshot_threshold: int = 1024,
+                 peer_addrs: Optional[Dict[str, str]] = None,
+                 on_config_change: Optional[Callable[[Dict[str, str]], None]] = None,
+                 bootstrap: bool = True,
+                 dead_server_cleanup_s: Optional[float] = None):
         self.id = node_id
-        self.peers = [p for p in peers if p != node_id]
+        # membership: server id -> address ("" when the transport
+        # resolves ids directly). Config-change log entries rewrite this
+        # at APPEND time (the standard single-server-change rule; see
+        # change_config) — reference nomad/server.go AddVoter/
+        # RemoveServer via hashicorp/raft.
+        self.servers: Dict[str, str] = {node_id: (peer_addrs or {}).get(node_id, "")}
+        for p in peers:
+            if p != node_id:
+                self.servers[p] = (peer_addrs or {}).get(p, "")
+        self.peers = [p for p in self.servers if p != node_id]
+        self.on_config_change = on_config_change
+        # a non-bootstrap node with no peers (a joiner) must NOT elect
+        # itself leader of a one-node cluster; it waits to learn the
+        # real membership from the leader's append_entries
+        self.bootstrap = bootstrap
+        self.dead_server_cleanup_s = dead_server_cleanup_s
+        self._last_contact: Dict[str, float] = {}
+        self._config_index = 0  # log index of the latest config entry
+        # replication state precedes the durability restore below:
+        # a recovered snapshot/log config calls _set_servers, which
+        # maintains these
+        self._next_index: Dict[str, int] = {}
+        self._match_index: Dict[str, int] = {}
         self.transport = transport
         self.fsm_apply = fsm_apply
         self.on_leadership = on_leadership
@@ -62,9 +88,16 @@ class RaftNode:
                 fsm_restore(snap["data"])
                 self.commit_index = snap["index"]
                 self.last_applied = snap["index"]
+                if snap.get("servers"):
+                    self._set_servers(dict(snap["servers"]))
+        # the config to fall back to if a log truncation drops the only
+        # config entry (snapshot membership, else the bootstrap peers)
+        self._fallback_servers = dict(self.servers)
+        # membership survives restarts: the latest config entry in the
+        # recovered log wins over the snapshot's
+        self._recover_config_from_log()
+        self._last_leader_contact = 0.0
 
-        self._next_index: Dict[str, int] = {}
-        self._match_index: Dict[str, int] = {}
         self._snap_inflight: set = set()  # peers mid-install-snapshot
         self._lock = threading.RLock()
         self._apply_cond = threading.Condition(self._lock)
@@ -123,6 +156,127 @@ class RaftNode:
                 raise NotLeaderError(self.leader_id)
             return self._results.pop(index, None)
 
+    # -- membership (reference nomad/server.go:1602 join,
+    #    nomad/autopilot.go dead-server cleanup) --
+
+    def _set_servers(self, servers: Dict[str, str]) -> None:
+        """Install a membership set (call with the lock held or from
+        __init__). Takes effect immediately — Raft's single-server
+        change rule applies configs at append, not commit."""
+        self.servers = dict(servers)
+        self.peers = [p for p in self.servers if p != self.id]
+        for p in self.peers:
+            self._next_index.setdefault(p, 1)
+            self._match_index.setdefault(p, 0)
+        for gone in [p for p in list(self._match_index) if p not in self.servers]:
+            self._match_index.pop(gone, None)
+            self._next_index.pop(gone, None)
+            self._last_contact.pop(gone, None)
+        if self.on_config_change is not None:
+            try:
+                self.on_config_change(dict(self.servers))
+            except Exception:
+                pass
+
+    def _recover_config_from_log(self, reset_on_missing: bool = False) -> None:
+        base = getattr(self.log, "base_index", 0)
+        last, _ = self.log.last()
+        idx = base + 1
+        latest = None
+        while idx <= last:
+            chunk = self.log.slice_from(idx)
+            if not chunk:
+                break
+            for e in chunk:
+                if tuple(e.command)[:1] == ("config",):
+                    latest = (e.index, e.command[1][0])
+            idx = chunk[-1].index + 1
+        if latest is not None:
+            self._config_index = latest[0]
+            self._set_servers(dict(latest[1]))
+        elif reset_on_missing:
+            # a truncation dropped the only config entry: the membership
+            # applied at append time must revert to the snapshot /
+            # bootstrap configuration, not linger
+            self._config_index = 0
+            self._set_servers(dict(self._fallback_servers))
+
+    def change_config(self, servers: Dict[str, str], timeout: float = 5.0):
+        """Leader-only single-server membership change: append a config
+        entry (effective immediately), replicate, wait for commit. One
+        change at a time — a second change while the first is
+        uncommitted is refused (the safety condition the one-at-a-time
+        rule depends on)."""
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            if self._config_index > self.commit_index:
+                raise ConfigInProgressError()
+            cur, new = set(self.servers), set(servers)
+            if len(cur.symmetric_difference(new)) > 1:
+                raise ValueError("membership changes must add or remove "
+                                 "one server at a time")
+            entry = self.log.append(self.current_term,
+                                    ("config", (dict(servers),), {}))
+            self._config_index = entry.index
+            self._set_servers(servers)
+            index = entry.index
+        self._maybe_advance_commit()
+        deadline = time.time() + timeout
+        with self._apply_cond:
+            while self.commit_index < index:
+                remaining = deadline - time.time()
+                if remaining <= 0 or self._stop.is_set():
+                    raise TimeoutError(f"config change {index} timed out")
+                self._apply_cond.wait(min(remaining, 0.1))
+
+    def add_server(self, server_id: str, addr: str = "",
+                   timeout: float = 5.0) -> None:
+        with self._lock:
+            if server_id in self.servers:
+                return
+            servers = dict(self.servers)
+        servers[server_id] = addr
+        self.change_config(servers, timeout=timeout)
+
+    def remove_server(self, server_id: str, timeout: float = 5.0) -> None:
+        if server_id == self.id:
+            raise ValueError("cannot remove the current leader; "
+                             "demote it by electing another first")
+        with self._lock:
+            if server_id not in self.servers:
+                raise KeyError(f"no such server {server_id!r}")
+            servers = {k: v for k, v in self.servers.items()
+                       if k != server_id}
+        self.change_config(servers, timeout=timeout)
+
+    def _dead_server_cleanup(self) -> None:
+        """Leader-side autopilot: remove ONE server that has been
+        unreachable past the threshold, but only while the healthy
+        majority stands without it (reference nomad/autopilot.go
+        CleanupDeadServers)."""
+        threshold = self.dead_server_cleanup_s
+        now = time.time()
+        with self._lock:
+            if self.state != LEADER or threshold is None:
+                return
+            if self._config_index > self.commit_index:
+                return
+            healthy = 1 + sum(
+                1 for p in self.peers
+                if now - self._last_contact.get(p, 0.0) < threshold)
+            dead = [p for p in self.peers
+                    if self._last_contact.get(p) is not None
+                    and now - self._last_contact[p] >= threshold]
+            if not dead or healthy * 2 <= len(self.servers):
+                return
+            victim = dead[0]
+        try:
+            self.remove_server(victim, timeout=2.0)
+        except (NotLeaderError, ConfigInProgressError, TimeoutError,
+                ValueError, KeyError):
+            pass
+
     # -- message handling (the RPC receiver rules) --
 
     def handle(self, msg: dict) -> dict:
@@ -143,6 +297,15 @@ class RaftNode:
 
     def _on_request_vote(self, msg: dict) -> dict:
         with self._lock:
+            # Leader stickiness (Raft thesis §4.2.3, hashicorp/raft's
+            # check): while we hear from a live leader, a campaigner's
+            # ever-growing term must not depose it — the canonical case
+            # is a REMOVED server that no longer receives heartbeats and
+            # campaigns forever. Non-members get no votes at all.
+            recent = time.time() - self._last_leader_contact < self.election_timeout
+            candidate = msg["candidate"]
+            if recent or candidate not in self.servers:
+                return {"term": self.current_term, "granted": False}
             term = msg["term"]
             if term > self.current_term:
                 self._become_follower(term)
@@ -167,6 +330,7 @@ class RaftNode:
                 self._become_follower(term)
             self.leader_id = msg["leader"]
             self._deadline = self._new_deadline()
+            self._last_leader_contact = time.time()
 
             prev_index = msg["prev_log_index"]
             prev_term = msg["prev_log_term"]
@@ -175,7 +339,17 @@ class RaftNode:
             entries = [Entry(**e) if isinstance(e, dict) else e
                        for e in msg["entries"]]
             if entries:
-                self.log.append_entries(prev_index, entries)
+                truncated = self.log.append_entries(prev_index, entries)
+                configs = [e for e in entries
+                           if tuple(e.command)[:1] == ("config",)]
+                if truncated and not configs:
+                    # a dropped conflicting suffix may have contained a
+                    # config entry: recompute membership from the log
+                    self._recover_config_from_log(reset_on_missing=True)
+                elif configs:
+                    last_cfg = configs[-1]
+                    self._config_index = last_cfg.index
+                    self._set_servers(dict(last_cfg.command[1][0]))
             leader_commit = msg["leader_commit"]
             if leader_commit > self.commit_index:
                 last_index, _ = self.log.last()
@@ -196,6 +370,7 @@ class RaftNode:
                 self._become_follower(term)
             self.leader_id = msg["leader"]
             self._deadline = self._new_deadline()
+            self._last_leader_contact = time.time()
             index, snap_term = msg["index"], msg["snap_term"]
             if index <= self.last_applied:
                 return {"term": self.current_term, "success": True,
@@ -205,8 +380,11 @@ class RaftNode:
             self.fsm_restore(msg["data"])
             if hasattr(self.log, "reset_to"):
                 self.log.reset_to(index, snap_term)
+            if msg.get("servers"):
+                self._set_servers(dict(msg["servers"]))
             if self.snapshots is not None:
-                self.snapshots.save(index, snap_term, msg["data"])
+                self.snapshots.save(index, snap_term, msg["data"],
+                                    servers=self.servers)
             self.commit_index = max(self.commit_index, index)
             self.last_applied = index
             self._apply_cond.notify_all()
@@ -234,7 +412,7 @@ class RaftNode:
             # only this thread mutates the FSM, and holding the lock
             # blocks install_snapshot, so the dump matches `applied`
             data = self.fsm_snapshot()
-            self.snapshots.save(applied, term, data)
+            self.snapshots.save(applied, term, data, servers=self.servers)
             self.log.compact(applied, term)
 
     # -- roles --
@@ -258,9 +436,15 @@ class RaftNode:
         self.state = LEADER
         self.leader_id = self.id
         last_index, _ = self.log.last()
+        now = time.time()
         for p in self.peers:
             self._next_index[p] = last_index + 1
             self._match_index[p] = 0
+            # autopilot clocks restart at tenure: a server that was
+            # already dead before this leadership still times out and
+            # gets cleaned up, and stale timestamps from an earlier
+            # tenure can't condemn a healthy peer instantly
+            self._last_contact[p] = now
         # Barrier entry: commit counting skips prior-term entries, so without
         # a fresh current-term entry, anything replicated under the old
         # leader stays uncommitted until the next client write. The no-op
@@ -301,13 +485,26 @@ class RaftNode:
     # -- ticker --
 
     def _run_tick(self) -> None:
+        last_cleanup = time.time()
         while not self._stop.wait(self.heartbeat_interval / 2):
             with self._lock:
                 state = self.state
                 expired = time.time() >= self._deadline
+                # a joiner (bootstrap=False) that still only knows
+                # itself must not elect itself leader of a one-node
+                # cluster; it waits for the real membership
+                can_elect = self.bootstrap or len(self.servers) > 1
             if state == LEADER:
                 self._replicate_all()
-            elif expired:
+                if (self.dead_server_cleanup_s is not None
+                        and time.time() - last_cleanup >= 1.0):
+                    last_cleanup = time.time()
+                    # off-thread: remove_server blocks on commit and
+                    # must not stall the heartbeat fan-out
+                    threading.Thread(target=self._dead_server_cleanup,
+                                     daemon=True,
+                                     name=f"raft-{self.id}-autopilot").start()
+            elif expired and can_elect:
                 self._start_election()
 
     def _replicate_all(self) -> None:
@@ -343,6 +540,7 @@ class RaftNode:
                 return
             if self.state != LEADER or reply["term"] != self.current_term:
                 return
+            self._last_contact[peer] = time.time()
             if reply["success"]:
                 self._match_index[peer] = max(self._match_index.get(peer, 0),
                                               reply["match_index"])
@@ -368,6 +566,7 @@ class RaftNode:
                     "kind": "install_snapshot", "term": term,
                     "leader": self.id, "index": snap["index"],
                     "snap_term": snap["term"], "data": snap["data"],
+                    "servers": dict(self.servers),
                 })
                 if reply is None:
                     return
@@ -428,8 +627,8 @@ class RaftNode:
                     entry = self.log.get(idx)
                     if entry is None:
                         break
-                    if tuple(entry.command)[:1] == ("noop",):
-                        result = None  # leader barrier entry, internal to raft
+                    if tuple(entry.command)[:1] in (("noop",), ("config",)):
+                        result = None  # raft-internal entries, not FSM ops
                     else:
                         try:
                             result = self.fsm_apply(tuple(entry.command))
@@ -450,3 +649,8 @@ class NotLeaderError(Exception):
     def __init__(self, leader_id: Optional[str]):
         super().__init__(f"not the leader (leader: {leader_id})")
         self.leader_id = leader_id
+
+
+class ConfigInProgressError(Exception):
+    def __init__(self):
+        super().__init__("a membership change is already in flight")

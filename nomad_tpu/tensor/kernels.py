@@ -726,9 +726,7 @@ def preempt_pick(
     evictable capacity so sibling requests don't pile onto one node;
     exact victim selection stays host-side per chosen node
     (scheduler/preemption.py)."""
-    n, d = available.shape
     f = available.dtype
-    ask_pos = ask > 0
     rate, origin = 0.0048, 2048.0
     pscore_node = 1.0 / (1.0 + jnp.exp(rate * (net_prio - origin)))
 

@@ -35,11 +35,23 @@ from ..scheduler.reconcile import PlacementRequest
 from .cluster import ClusterTensors, build_task_group_tensors, _pad_pow2
 
 
+def _binpack_fitness_np(available: np.ndarray, used: np.ndarray) -> np.ndarray:
+    """Vectorized BestFit-v3 fit score (numpy twin of
+    kernels.fit_scores; reference funcs.go:236 ScoreFitBinPack) —
+    the ONE host-side copy of the formula, shared by the preemption
+    pick mirror and the bulk trajectory mean."""
+    safe = np.where(available > 0, available, 1.0)
+    ratio = np.where(available > 0, used / safe,
+                     np.where(used > 0, np.inf, 0.0))
+    free = 1.0 - ratio
+    total10 = 10.0 ** free[:, 0] + 10.0 ** free[:, 1]
+    return np.clip(20.0 - total10, 0.0, 18.0) / 18.0
+
+
 def _preempt_pick_host(available, used, evictable, ask, feasible, net_prio,
                        active) -> np.ndarray:
     """Numpy mirror of kernels.preempt_pick for small (nodes x requests)
     shapes — identical node ordering, no device round trip."""
-    n = available.shape[0]
     pscore = 1.0 / (1.0 + np.exp(0.0048 * (net_prio - 2048.0)))
     evictable = evictable.copy()
     picks = np.full(active.shape[0], -1, dtype=np.int32)
@@ -53,13 +65,8 @@ def _preempt_pick_host(available, used, evictable, ask, feasible, net_prio,
         if not can.any():
             continue
         needs_evict = (deficit > 0.0).any(axis=1)
-        capped = np.minimum(new_used, available)
-        safe = np.where(available > 0, available, 1.0)
-        ratio = np.where(available > 0, capped / safe,
-                         np.where(capped > 0, np.inf, 0.0))
-        free = 1.0 - ratio
-        total10 = 10.0 ** free[:, 0] + 10.0 ** free[:, 1]
-        fitness = np.clip(20.0 - total10, 0.0, 18.0) / 18.0
+        fitness = _binpack_fitness_np(available,
+                                      np.minimum(new_used, available))
         score = np.where(
             can,
             (fitness + np.where(needs_evict, pscore, 0.0))
@@ -508,12 +515,7 @@ class TPUPlacer:
         ask = np.asarray(tgt.ask, dtype=np.float64)
         avail = cluster.available[idx]
         used = cluster.used[idx] + t[:, None] * ask[None, :]
-        safe = np.where(avail > 0, avail, 1.0)
-        ratio = np.where(avail > 0, used / safe,
-                         np.where(used > 0, np.inf, 0.0))
-        free = 1.0 - ratio
-        total10 = 10.0 ** free[:, 0] + 10.0 ** free[:, 1]
-        fit = np.clip(20.0 - total10, 0.0, 18.0) / 18.0
+        fit = _binpack_fitness_np(avail, used)
         ptg_before = tgt.placed_tg[idx] + t - 1.0
         anti_present = ptg_before > 0
         anti = -(ptg_before + 1.0) / max(tgt.tg_count, 1.0)

@@ -1,0 +1,158 @@
+"""Dynamic raft membership (reference nomad/server.go:1602 join,
+nomad/autopilot.go dead-server cleanup): AddServer/RemoveServer config
+entries, joiner bootstrap suppression, autopilot removal, and config
+recovery from snapshot/log."""
+
+import time
+
+import pytest
+
+from nomad_tpu.raft.node import ConfigInProgressError, RaftNode
+from nomad_tpu.raft.transport import InProcTransport
+
+
+def _apply_list(lst):
+    def apply(cmd):
+        lst.append(cmd)
+        return len(lst)
+    return apply
+
+
+def _mini(n=3, transport=None, **kw):
+    transport = transport or InProcTransport()
+    ids = [f"n{i}" for i in range(n)]
+    applied = {i: [] for i in ids}
+    nodes = {}
+    for node_id in ids:
+        nodes[node_id] = RaftNode(node_id, ids, transport,
+                                  _apply_list(applied[node_id]),
+                                  election_timeout=0.15,
+                                  heartbeat_interval=0.03, **kw)
+    for nd in nodes.values():
+        nd.start()
+    return transport, nodes, applied
+
+
+def _wait_leader(nodes, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        leaders = [n for n in nodes.values() if n.is_leader()]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.02)
+    raise AssertionError("no single leader elected")
+
+
+class TestMembershipChanges:
+    def test_add_server_replicates_and_votes(self):
+        transport, nodes, applied = _mini(3)
+        joiner_log = []
+        try:
+            leader = _wait_leader(nodes)
+            leader.apply(("x", (1,), {}))
+
+            # a joiner knows only itself and must not self-elect
+            joiner = RaftNode("n3", ["n3"], transport,
+                              _apply_list(joiner_log),
+                              election_timeout=0.15,
+                              heartbeat_interval=0.03, bootstrap=False)
+            joiner.start()
+            time.sleep(0.5)
+            assert not joiner.is_leader()
+
+            leader.add_server("n3")
+            assert set(leader.servers) == {"n0", "n1", "n2", "n3"}
+            # the joiner catches up and learns the membership
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                if set(joiner.servers) == set(leader.servers) and joiner_log:
+                    break
+                time.sleep(0.02)
+            assert set(joiner.servers) == {"n0", "n1", "n2", "n3"}
+            assert ("x", (1,), {}) in [tuple(c) for c in joiner_log] \
+                or ("x", [1], {}) in [tuple(c) for c in joiner_log]
+
+            # writes still commit with the grown quorum
+            leader.apply(("y", (2,), {}))
+            nodes["n3"] = joiner
+        finally:
+            for nd in nodes.values():
+                nd.stop()
+
+    def test_remove_server_shrinks_quorum(self):
+        transport, nodes, applied = _mini(3)
+        try:
+            leader = _wait_leader(nodes)
+            victim = next(i for i in nodes if i != leader.id)
+            leader.remove_server(victim)
+            assert victim not in leader.servers
+            nodes[victim].stop()
+            transport.partition(victim)
+            # 2-node cluster still commits (quorum 2 of 2)
+            leader.apply(("z", (3,), {}))
+        finally:
+            for nd in nodes.values():
+                nd.stop()
+
+    def test_remove_leader_refused(self):
+        transport, nodes, applied = _mini(3)
+        try:
+            leader = _wait_leader(nodes)
+            with pytest.raises(ValueError):
+                leader.remove_server(leader.id)
+        finally:
+            for nd in nodes.values():
+                nd.stop()
+
+    def test_one_change_at_a_time(self):
+        transport, nodes, applied = _mini(3)
+        try:
+            leader = _wait_leader(nodes)
+            # cut replication so the config entry cannot commit
+            for p in leader.peers:
+                transport.partition(p)
+            with pytest.raises(TimeoutError):
+                leader.add_server("n9", timeout=0.3)
+            with pytest.raises(ConfigInProgressError):
+                leader.add_server("n10", timeout=0.3)
+        finally:
+            for p in list(nodes):
+                transport.heal(p)
+            for nd in nodes.values():
+                nd.stop()
+
+    def test_batch_change_refused(self):
+        transport, nodes, applied = _mini(3)
+        try:
+            leader = _wait_leader(nodes)
+            servers = dict(leader.servers)
+            servers["a"] = ""
+            servers["b"] = ""
+            with pytest.raises(ValueError):
+                leader.change_config(servers)
+        finally:
+            for nd in nodes.values():
+                nd.stop()
+
+
+class TestAutopilot:
+    def test_dead_server_removed(self):
+        transport, nodes, applied = _mini(3, dead_server_cleanup_s=1.0)
+        try:
+            leader = _wait_leader(nodes)
+            victim = next(i for i in nodes if i != leader.id)
+            # let the leader record contact with everyone first
+            time.sleep(0.3)
+            nodes[victim].stop()
+            transport.partition(victim)
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                if victim not in leader.servers:
+                    break
+                time.sleep(0.1)
+            assert victim not in leader.servers
+            # scheduling never stopped: the 2-node cluster commits
+            leader.apply(("after", (), {}))
+        finally:
+            for nd in nodes.values():
+                nd.stop()

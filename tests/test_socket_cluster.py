@@ -216,3 +216,125 @@ class TestThreeProcessCluster:
                     proc.kill()
             for f in logs.values():
                 f.close()
+
+
+@pytest.mark.slow
+class TestDynamicMembership:
+    """Grow a live cluster with `--join`, lose a member, and watch
+    autopilot shrink the config — scheduling never stops (reference
+    nomad/serf.go join + nomad/autopilot.go CleanupDeadServers)."""
+
+    def test_grow_kill_converge(self, tmp_path):
+        raft_ports = free_ports(5)
+        http_ports = free_ports(5)
+        ids = [f"s{i}" for i in range(5)]
+        seed_peers = ",".join(f"{ids[i]}=127.0.0.1:{raft_ports[i]}"
+                              for i in range(3))
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+        procs, logs = {}, {}
+
+        def spawn(i, join=None):
+            logs[ids[i]] = open(tmp_path / f"agent-{ids[i]}.log", "w")
+            if join:
+                peers = f"{ids[i]}=127.0.0.1:{raft_ports[i]}"
+                clients = "0"
+            else:
+                peers = seed_peers
+                clients = "1"
+            argv = [sys.executable, "-m", "nomad_tpu", "agent",
+                    "--server-id", ids[i], "--peers", peers,
+                    "--port", str(http_ports[i]), "--clients", clients,
+                    "--workers", "1", "--dead-server-cleanup", "5",
+                    "--data-dir", str(tmp_path / ids[i])]
+            if join:
+                argv += ["--join", join]
+            procs[ids[i]] = subprocess.Popen(
+                argv, env=env, cwd=str(REPO),
+                stdout=logs[ids[i]], stderr=subprocess.STDOUT)
+
+        def addr(i):
+            return f"http://127.0.0.1:{http_ports[i]}"
+
+        def wait_leader(live, timeout=180.0):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                for i in live:
+                    try:
+                        out = _http(addr(i), "/v1/status/leader", timeout=2.0)
+                        if out.get("is_leader"):
+                            return i
+                    except Exception:
+                        pass
+                time.sleep(0.25)
+            raise AssertionError("no leader elected")
+
+        def config_ids(i):
+            cfg = _http(addr(i), "/v1/operator/raft/configuration")
+            return {s["id"] for s in cfg.get("servers", [])}
+
+        def wait_config(i, want, timeout=180.0):
+            deadline = time.time() + timeout
+            last = None
+            while time.time() < deadline:
+                try:
+                    last = config_ids(i)
+                    if last == want:
+                        return
+                except Exception:
+                    pass
+                time.sleep(0.5)
+            raise AssertionError(f"config never reached {want}: {last}")
+
+        def job_payload(job_id, count):
+            return {"job": {
+                "id": job_id, "name": job_id, "type": "service",
+                "datacenters": ["dc1"],
+                "task_groups": [{
+                    "name": "web", "count": count,
+                    "tasks": [{"name": "web", "driver": "mock",
+                               "config": {},
+                               "resources": {"cpu": 50, "memory_mb": 32}}],
+                }],
+            }}
+
+        try:
+            for i in range(3):
+                spawn(i)
+            leader_i = wait_leader(range(3))
+
+            # grow 3 -> 5: the new servers know only themselves + --join
+            spawn(3, join=f"127.0.0.1:{raft_ports[leader_i]}")
+            spawn(4, join=f"127.0.0.1:{raft_ports[0]}")  # via a member
+            wait_config(leader_i, set(ids))
+
+            # the joined servers answer reads and forward writes
+            _http(addr(3), "/v1/jobs", job_payload("web1", 2))
+
+            # SIGKILL a joined server: autopilot trims the config to 4
+            procs[ids[4]].send_signal(signal.SIGKILL)
+            procs[ids[4]].wait(timeout=10)
+            survivors = [0, 1, 2, 3]
+            new_leader = wait_leader(survivors)
+            wait_config(new_leader, {ids[i] for i in survivors})
+
+            # scheduling still works on the shrunken cluster
+            _http(addr(3), "/v1/jobs", job_payload("web2", 2))
+            deadline = time.time() + 120.0
+            ok = False
+            while time.time() < deadline and not ok:
+                try:
+                    allocs = _http(addr(new_leader),
+                                   "/v1/job/web2/allocations")
+                    ok = len([a for a in allocs
+                              if a["desired_status"] == "run"]) >= 2
+                except Exception:
+                    pass
+                time.sleep(0.5)
+            assert ok, "scheduling stopped after membership change"
+        finally:
+            for pid, proc in procs.items():
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=5)
+            for f in logs.values():
+                f.close()
