@@ -372,6 +372,9 @@ class RawExecDriver:
     them."""
 
     name = "raw_exec"
+    # raw_exec runs unconfined by contract (reference drivers/rawexec:
+    # "no isolation"); exec enforces the reservation
+    ENFORCE_RESOURCES = False
 
     def _build_env(self, env: Dict[str, str]) -> Dict[str, str]:
         return {**os.environ, **env}
@@ -402,6 +405,11 @@ class RawExecDriver:
             "grace_s": task.kill_timeout_s,
             "status_file": os.path.join(spec_dir, ".executor_status.json"),
         }
+        if self.ENFORCE_RESOURCES and task.resources is not None:
+            # the executor enforces what the scheduler fit: cgroup
+            # memory/cpu limits, or its polling watchdog (executor.py)
+            spec["memory_limit_mb"] = int(task.resources.memory_mb)
+            spec["cpu_shares"] = int(task.resources.cpu)
         try:
             os.unlink(spec["status_file"])  # stale status from a prior run
         except OSError:
@@ -457,12 +465,15 @@ class RawExecDriver:
 class ExecDriver(RawExecDriver):
     """Isolated subprocess driver (reference drivers/exec uses
     libcontainer namespaces/cgroups, executor_linux.go:36-42). The
-    portable core here is session isolation + a scrubbed environment
-    (task env only, plus a usable PATH — the reference injects a default
-    task PATH the same way); cgroup/namespace enforcement hooks in where
-    the platform allows."""
+    portable core is session isolation + a scrubbed environment (task
+    env only, plus a usable PATH — the reference injects a default task
+    PATH the same way). The scheduler's memory/cpu reservation is
+    ENFORCED by the executor: cgroup v2/v1 limits where the hierarchy
+    is writable, else a polling watchdog that evicts the task group
+    past its reservation (client/executor.py CgroupLimiter)."""
 
     name = "exec"
+    ENFORCE_RESOURCES = True
 
     def _build_env(self, env: Dict[str, str]) -> Dict[str, str]:
         return {"PATH": os.environ.get("PATH", os.defpath), **env}

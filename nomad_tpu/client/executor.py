@@ -16,8 +16,20 @@ Control surface is the filesystem (spec in, status out, signals), not
 gRPC — one supervisor per task needs nothing richer, and the driver
 side stays transport-free.
 
+Resource enforcement (reference drivers/shared/executor/
+executor_linux.go:36-42, which uses libcontainer cgroups): when the
+spec carries memory_limit_mb / cpu_shares, the executor places the task
+in its own cgroup — v2 (memory.max, cpu.weight) when the unified
+hierarchy is writable, else v1 (memory.limit_in_bytes, cpu.shares) —
+and reports kernel OOM kills in the status file. Where no cgroup
+hierarchy is writable, a polling watchdog sums the task process
+group's RSS and SIGKILLs the group past the memory reservation, so a
+placement's limits are enforced on every platform, not just ones that
+grant cgroup write access.
+
 spec.json: {argv, env, cwd, task_name, logs_dir, max_files,
-            max_file_size_mb, grace_s, status_file}
+            max_file_size_mb, grace_s, status_file,
+            memory_limit_mb, cpu_shares}
 status file (atomic rename): {exit_code, signal, oom_killed, err,
                               task_pid, finished_at}
 """
@@ -30,6 +42,161 @@ import signal
 import subprocess
 import sys
 import time
+
+_CG2_ROOT = "/sys/fs/cgroup"
+
+
+class CgroupLimiter:
+    """Best-effort cgroup memory/cpu enforcement for one task."""
+
+    def __init__(self, task_name: str, pid: int, memory_mb: int,
+                 cpu_shares: int):
+        self.active = False
+        self._dirs = []
+        self._v2 = False
+        safe = "".join(c if c.isalnum() or c in "_-" else "_"
+                       for c in task_name)[:64]
+        tag = f"nomadtpu-{safe}-{pid}"
+        try:
+            if os.path.exists(os.path.join(_CG2_ROOT, "cgroup.controllers")):
+                self._setup_v2(tag, pid, memory_mb, cpu_shares)
+            else:
+                self._setup_v1(tag, pid, memory_mb, cpu_shares)
+        except OSError:
+            self.cleanup()
+            self.active = False
+
+    @staticmethod
+    def _write(path: str, value: str) -> None:
+        with open(path, "w") as f:
+            f.write(value)
+
+    def _setup_v2(self, tag: str, pid: int, memory_mb: int,
+                  cpu_shares: int) -> None:
+        d = os.path.join(_CG2_ROOT, tag)
+        os.makedirs(d, exist_ok=True)
+        self._dirs.append(d)
+        if memory_mb:
+            self._write(os.path.join(d, "memory.max"),
+                        str(memory_mb * 1024 * 1024))
+            try:  # one OOM kills the whole task group, like the reference
+                self._write(os.path.join(d, "memory.oom.group"), "1")
+            except OSError:
+                pass
+        if cpu_shares:
+            # map cpu MHz shares onto cpu.weight's [1, 10000] like
+            # systemd maps shares: weight = shares/10240*10000 clamped
+            w = max(1, min(10000, cpu_shares * 10000 // 10240))
+            try:
+                self._write(os.path.join(d, "cpu.weight"), str(w))
+            except OSError:
+                pass
+        self._write(os.path.join(d, "cgroup.procs"), str(pid))
+        self._v2 = True
+        self.active = True
+
+    def _setup_v1(self, tag: str, pid: int, memory_mb: int,
+                  cpu_shares: int) -> None:
+        if memory_mb:
+            d = os.path.join(_CG2_ROOT, "memory", tag)
+            os.makedirs(d, exist_ok=True)
+            self._dirs.append(d)
+            self._write(os.path.join(d, "memory.limit_in_bytes"),
+                        str(memory_mb * 1024 * 1024))
+            self._write(os.path.join(d, "cgroup.procs"), str(pid))
+            self.active = True
+        if cpu_shares:
+            d = os.path.join(_CG2_ROOT, "cpu", tag)
+            try:
+                os.makedirs(d, exist_ok=True)
+                self._dirs.append(d)
+                self._write(os.path.join(d, "cpu.shares"), str(cpu_shares))
+                self._write(os.path.join(d, "cgroup.procs"), str(pid))
+                self.active = True
+            except OSError:
+                pass
+
+    def oom_killed(self, sigkilled: bool = True) -> bool:
+        """Did the kernel OOM-kill inside this cgroup? The v1 failcnt
+        fallback only counts when the task actually died by SIGKILL —
+        a nonzero failcnt alone can just mean reclaim pressure."""
+        for d in self._dirs:
+            try:
+                if self._v2:
+                    with open(os.path.join(d, "memory.events")) as f:
+                        for line in f:
+                            k, _, v = line.partition(" ")
+                            if k == "oom_kill" and int(v) > 0:
+                                return True
+                elif os.path.basename(os.path.dirname(d)) == "memory":
+                    saw_counter = False
+                    with open(os.path.join(d, "memory.oom_control")) as f:
+                        for line in f:
+                            k, _, v = line.partition(" ")
+                            if k == "oom_kill":
+                                saw_counter = True
+                                if int(v) > 0:
+                                    return True
+                    # only kernels too old to expose the oom_kill
+                    # counter fall back to failcnt — and only for a
+                    # SIGKILL death (a brushed limit that reclaim
+                    # satisfied is not an OOM kill)
+                    if not saw_counter and sigkilled:
+                        with open(os.path.join(d, "memory.failcnt")) as f:
+                            if int(f.read().strip() or 0) > 0:
+                                return True
+            except (OSError, ValueError):
+                continue
+        return False
+
+    def cleanup(self) -> None:
+        # SIGKILL delivery is asynchronous: dying members keep the
+        # cgroup busy briefly, so retry before giving up (a swallowed
+        # EBUSY would leak one cgroup per task run)
+        for d in self._dirs:
+            for _ in range(10):
+                try:
+                    os.rmdir(d)
+                    break
+                except FileNotFoundError:
+                    break
+                except OSError:
+                    time.sleep(0.05)
+        self._dirs = []
+
+
+def group_rss_bytes(pgid: int) -> int:
+    """Total resident memory of the task's process group (the polling
+    watchdog's view when no cgroup is writable). Prefers per-process
+    PSS (smaps_rollup) so shared/CoW pages sum correctly across a
+    forking task instead of being counted once per child; falls back
+    to stat RSS where smaps_rollup is unavailable."""
+    total = 0
+    try:
+        pids = [p for p in os.listdir("/proc") if p.isdigit()]
+    except OSError:
+        return 0
+    for p in pids:
+        try:
+            with open(f"/proc/{p}/stat", "rb") as f:
+                fields = f.read().split(b") ")[-1].split()
+            # after stripping "pid (comm)": field[2] is pgrp,
+            # field[21] is rss pages
+            if int(fields[2]) != pgid:
+                continue
+            rss = int(fields[21]) * os.sysconf("SC_PAGE_SIZE")
+        except (OSError, ValueError, IndexError):
+            continue
+        try:
+            with open(f"/proc/{p}/smaps_rollup", "rb") as f:
+                for line in f:
+                    if line.startswith(b"Pss:"):
+                        rss = int(line.split()[1]) * 1024
+                        break
+        except (OSError, ValueError, IndexError):
+            pass  # no smaps_rollup: stat RSS stands
+        total += rss
+    return total
 
 
 def _write_status(path: str, payload: dict) -> None:
@@ -89,6 +256,22 @@ def run(spec_path: str) -> int:
     lm.close_parent_fds()
     _write_status(status_file, {"task_pid": proc.pid})
 
+    mem_mb = int(spec.get("memory_limit_mb") or 0)
+    cpu_shares = int(spec.get("cpu_shares") or 0)
+    limiter = None
+    oom = {"killed": False}
+    if (mem_mb or cpu_shares) and not spec.get("disable_cgroups"):
+        # disable_cgroups exists so tests can exercise the polling
+        # watchdog on hosts where cgroups ARE writable
+        limiter = CgroupLimiter(spec["task_name"], proc.pid, mem_mb,
+                                cpu_shares)
+        if not limiter.active:
+            limiter = None
+    # watchdog margin: the polling path can't account as precisely as
+    # the kernel, so allow 10% + 16MB of slack before evicting
+    watchdog_limit = (mem_mb * 1024 * 1024 * 11 // 10 + (16 << 20)
+                      if mem_mb and limiter is None else 0)
+
     stopping = {"flag": False}
 
     def on_term(_sig, _frm):
@@ -103,10 +286,23 @@ def run(spec_path: str) -> int:
 
     code = None
     deadline = None
+    next_poll = 0.0
     while code is None:
         try:
             code = proc.wait(timeout=0.2)
         except subprocess.TimeoutExpired:
+            # memory polls on a 1s cadence — the 0.2s loop exists for
+            # stop/grace responsiveness, and a full /proc walk at 5Hz
+            # per task would tax busy nodes
+            if watchdog_limit and not stopping["flag"] \
+                    and time.monotonic() >= next_poll:
+                next_poll = time.monotonic() + 1.0
+                if group_rss_bytes(proc.pid) > watchdog_limit:
+                    oom["killed"] = True
+                    try:
+                        os.killpg(proc.pid, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        proc.kill()
             if stopping["flag"]:
                 if deadline is None:
                     deadline = time.monotonic() + grace
@@ -126,6 +322,16 @@ def run(spec_path: str) -> int:
         status.update(exit_code=128 - code, signal=-code)
     else:
         status.update(exit_code=code, signal=0)
+    if limiter is not None:
+        # an executor-initiated stop escalation is never an OOM, even
+        # if the task once brushed its limit
+        if not stopping["flag"] and \
+                limiter.oom_killed(code < 0 and -code == signal.SIGKILL):
+            oom["killed"] = True
+        limiter.cleanup()
+    if oom["killed"]:
+        status["oom_killed"] = True
+        status["err"] = "task exceeded its memory reservation"
     _write_status(status_file, status)
     return 0
 

@@ -114,6 +114,11 @@ class TaskRunner:
                 result = self._handle.wait(timeout=0.2)
             if self._killed.is_set():
                 break
+            if result.oom_killed:
+                # reference drivers emit TaskEventOOM ("OOM Killed")
+                self._event("OOM Killed",
+                            "task exceeded its memory reservation and "
+                            "was killed", exit_code=result.exit_code)
             self._event("Terminated", f"exit code {result.exit_code}",
                         exit_code=result.exit_code)
             if result.successful():

@@ -1,0 +1,97 @@
+"""Resource enforcement in the out-of-process executor (reference
+drivers/shared/executor/executor_linux.go:36-42): the scheduler's
+memory reservation is enforced — cgroup limits where writable, the
+polling watchdog otherwise — and OOM kills surface as task events."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from nomad_tpu.client.drivers import ExecDriver
+from nomad_tpu.structs import Resources, Task
+
+EXECUTOR = os.path.join(os.path.dirname(__file__), "..",
+                        "nomad_tpu", "client", "executor.py")
+
+HOG = ("import time\n"
+       "x = bytearray(100 * 1024 * 1024)\n"
+       "for i in range(0, len(x), 4096):\n"
+       "    x[i] = 1\n"
+       "time.sleep(30)\n")
+
+
+def _run_executor(tmp_path, spec_extra, code=HOG, timeout=25.0):
+    logs = tmp_path / "logs"
+    logs.mkdir(exist_ok=True)
+    status = tmp_path / "status.json"
+    spec = {
+        "argv": [sys.executable, "-S", "-c", code],
+        "env": {},
+        "cwd": str(tmp_path),
+        "task_name": "hog",
+        "logs_dir": str(logs),
+        "grace_s": 1.0,
+        "status_file": str(status),
+        **spec_extra,
+    }
+    proc = subprocess.Popen(
+        [sys.executable, "-S", os.path.abspath(EXECUTOR), "-"],
+        stdin=subprocess.PIPE, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL, start_new_session=True)
+    proc.stdin.write(json.dumps(spec).encode())
+    proc.stdin.close()
+    proc.wait(timeout=timeout)
+    with open(status) as f:
+        return json.load(f)
+
+
+def _cgroups_writable() -> bool:
+    for d in ("/sys/fs/cgroup", "/sys/fs/cgroup/memory"):
+        probe = os.path.join(d, "nomadtpu-probe")
+        try:
+            os.mkdir(probe)
+            os.rmdir(probe)
+            return True
+        except OSError:
+            continue
+    return False
+
+
+class TestWatchdogEnforcement:
+    def test_over_memory_task_is_killed(self, tmp_path):
+        st = _run_executor(tmp_path, {"memory_limit_mb": 32,
+                                      "disable_cgroups": True})
+        assert st.get("oom_killed") is True
+        assert st.get("signal") == 9 or st.get("exit_code") != 0
+
+    def test_within_limit_task_unharmed(self, tmp_path):
+        st = _run_executor(
+            tmp_path, {"memory_limit_mb": 512, "disable_cgroups": True},
+            code="x = bytearray(8 * 1024 * 1024)\nprint('ok')\n")
+        assert not st.get("oom_killed")
+        assert st.get("exit_code") == 0
+
+
+@pytest.mark.skipif(not _cgroups_writable(), reason="no writable cgroups")
+class TestCgroupEnforcement:
+    def test_kernel_oom_kill_reported(self, tmp_path):
+        st = _run_executor(tmp_path, {"memory_limit_mb": 32})
+        assert st.get("oom_killed") is True
+
+    def test_exec_driver_reports_oom(self, tmp_path):
+        d = ExecDriver()
+        td = tmp_path / "task"
+        td.mkdir()
+        t = Task(name="hog", driver="exec",
+                 resources=Resources(cpu=100, memory_mb=32),
+                 config={"command": sys.executable,
+                         "args": ["-S", "-c", HOG]})
+        h = d.start_task(t, {}, str(td))
+        res = h.wait(timeout=25.0)
+        assert res is not None
+        assert res.oom_killed
+        assert not res.successful()
