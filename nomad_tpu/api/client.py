@@ -253,6 +253,14 @@ class ApiClient:
     def set_scheduler_configuration(self, cfg) -> None:
         self._request("PUT", "/v1/operator/scheduler/configuration", cfg)
 
+    def list_services(self) -> list:
+        out, _ = self.get("/v1/services")
+        return out
+
+    def service(self, name: str) -> list:
+        out, _ = self.get(f"/v1/service/{name}")
+        return out
+
     def raft_configuration(self) -> dict:
         out, _ = self.get("/v1/operator/raft/configuration")
         return out

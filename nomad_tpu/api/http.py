@@ -203,6 +203,11 @@ class HTTPAgent:
         elif path.startswith("/v1/volume"):
             if not self._ns_allowed(acl, ns, aclp.CAP_READ_JOB):
                 return h._error(403, "Permission denied")
+        elif path.startswith(("/v1/services", "/v1/service/")):
+            # the catalog exposes addresses/ports: read-job in the ns
+            # (reference service registration list ACL)
+            if not self._ns_allowed(acl, ns, aclp.CAP_READ_JOB):
+                return h._error(403, "Permission denied")
         elif path.startswith("/v1/acl"):
             if acl is not None and not acl.management:
                 return h._error(403, "Permission denied")
@@ -227,6 +232,26 @@ class HTTPAgent:
             if pool is None:
                 return h._error(404, "node pool not found")
             return h._reply(200, pool)
+        if path == "/v1/services":
+            # service catalog summary (reference
+            # /v1/services ServiceRegistrationListRPC)
+            by_name = {}
+            for reg in snap.service_registrations(ns):
+                e = by_name.setdefault(reg.service_name,
+                                       {"service_name": reg.service_name,
+                                        "namespace": reg.namespace,
+                                        "tags": set(), "instances": 0})
+                e["instances"] += 1
+                e["tags"].update(reg.tags)
+            return h._reply(200, [
+                {**e, "tags": sorted(e["tags"])}
+                for e in sorted(by_name.values(),
+                                key=lambda x: x["service_name"])])
+        if m := re.fullmatch(r"/v1/service/([^/]+)", path):
+            regs = snap.service_by_name(m.group(1), ns)
+            if not regs:
+                return h._error(404, "service not found")
+            return h._reply(200, regs)
         if path == "/v1/volumes":
             return h._reply(200, [
                 {"id": v.id, "namespace": v.namespace, "name": v.name,

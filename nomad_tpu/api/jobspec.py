@@ -333,6 +333,24 @@ def _group_dict(block: dict) -> dict:
         }
     if volumes:
         out["volumes"] = volumes
+    services = []
+    for sb in block.get("service", []):
+        services.append({
+            "name": sb.get("__label__", sb.get("name", "")),
+            "port_label": sb.get("port", sb.get("port_label", "")),
+            "tags": list(sb.get("tags", [])),
+            "checks": [{
+                "name": cb.get("__label__", cb.get("name", "")),
+                "type": cb.get("type", "tcp"),
+                "path": cb.get("path", "/"),
+                "method": cb.get("method", "GET"),
+                "interval_s": float(cb.get("interval", 10)),
+                "timeout_s": float(cb.get("timeout", 3)),
+                "port_label": cb.get("port", ""),
+            } for cb in sb.get("check", [])],
+        })
+    if services:
+        out["services"] = services
     return out
 
 

@@ -369,6 +369,23 @@ def cmd_operator_scheduler(args) -> int:
     return 0
 
 
+def cmd_service(args) -> int:
+    """Service catalog (reference command/service_list.go / service_info.go)."""
+    api = _client(args)
+    if args.op == "list":
+        for s in api.list_services():
+            print(f"{s['service_name']}\t{s['instances']} instance(s)\t"
+                  f"tags={','.join(s['tags']) or '-'}")
+        return 0
+    if not args.name:
+        print("service info requires a name", file=sys.stderr)
+        return 2
+    for reg in api.service(args.name):
+        print(f"{reg['id']}\t{reg['address']}:{reg['port']}\t"
+              f"node={reg['node_id'][:8]}\talloc={reg['alloc_id'][:8]}")
+    return 0
+
+
 def cmd_operator_raft(args) -> int:
     """Raft membership operations (reference command/operator_raft_*.go)."""
     api = _client(args)
@@ -658,6 +675,11 @@ def build_parser() -> argparse.ArgumentParser:
     oraft.add_argument("op", choices=["list-peers", "remove-peer"])
     oraft.add_argument("-peer-id", dest="peer_id", default="")
     oraft.set_defaults(fn=cmd_operator_raft)
+
+    svc = sub.add_parser("service")
+    svc.add_argument("op", choices=["list", "info"])
+    svc.add_argument("name", nargs="?", default="")
+    svc.set_defaults(fn=cmd_service)
 
     server = sub.add_parser("server").add_subparsers(dest="server_cmd",
                                                      required=True)

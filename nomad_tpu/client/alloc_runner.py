@@ -28,11 +28,20 @@ class AllocRunner:
     def __init__(self, alloc: Allocation, node, data_dir: str,
                  on_update: Optional[Callable] = None,
                  state_db=None, restored_handles: Optional[Dict] = None,
-                 prev_runner_lookup: Optional[Callable] = None):
+                 prev_runner_lookup: Optional[Callable] = None,
+                 services_api=None):
         self.alloc = alloc
         self.node = node
         self.data_dir = data_dir
         self.on_update = on_update
+        # service registration endpoint surface (the server or an HTTP
+        # facade): upsert_service_registrations / delete_services_by_alloc
+        self.services_api = services_api
+        self.check_runner = None
+        # deployment health verdict: None until decided, else (bool, ts)
+        # — synced to the server as alloc.deployment_status (reference
+        # client/allochealth/tracker.go feeding the deployment watcher)
+        self.deployment_health = None
         # allocwatcher (reference client/allocwatcher): lets this runner
         # wait on the previous alloc (upgrades/migrations) and pull its
         # ephemeral disk before starting tasks
@@ -114,6 +123,8 @@ class AllocRunner:
         for r in main_runners:
             r.start()
         self._recompute_status()
+        self._register_services()
+        self._start_health_watch()
 
         # wait for all main tasks to finish (sidecar prestarts are
         # stopped when the mains are done)
@@ -124,6 +135,7 @@ class AllocRunner:
         for t, r in zip(prestart, pre_runners):
             if t.lifecycle_sidecar:
                 r.kill()
+        self._deregister_services()
 
         # poststop tasks run after the mains (reference poststop hooks);
         # one that overruns its deadline is killed, not waited on forever
@@ -155,9 +167,90 @@ class AllocRunner:
                                     or self.tg.ephemeral_disk.sticky):
             self.allocdir.migrate_from(AllocDir(self.data_dir, prev_id))
 
+    # -- services + check-based health (reference group/task service
+    #    hooks + client/allochealth/tracker.go) --
+
+    def _register_services(self) -> None:
+        if self.services_api is None or self.tg is None:
+            return
+        from ..structs.services import ServiceRegistration, collect_services
+        from .checks import CheckRunner, service_address
+
+        regs = []
+        for task_name, svc in collect_services(self.tg):
+            addr, port = service_address(self.alloc, self.node,
+                                         svc.port_label)
+            regs.append(ServiceRegistration(
+                id=f"{self.alloc.id}/{task_name or '_group'}/{svc.name}",
+                service_name=svc.name,
+                namespace=self.alloc.namespace,
+                node_id=self.alloc.node_id,
+                job_id=self.alloc.job_id,
+                alloc_id=self.alloc.id,
+                task_name=task_name,
+                address=addr, port=port, tags=list(svc.tags)))
+        if regs:
+            try:
+                self.services_api.upsert_service_registrations(regs)
+            except Exception:
+                pass  # registration retries ride the next alloc update
+        self.check_runner = CheckRunner(self.alloc, self.tg, self.node)
+        self.check_runner.start()
+
+    def _deregister_services(self) -> None:
+        if self.check_runner is not None:
+            self.check_runner.stop()
+        if self.services_api is None:
+            return
+        try:
+            self.services_api.delete_services_by_alloc(self.alloc.id)
+        except Exception:
+            pass
+
+    def _start_health_watch(self) -> None:
+        """Decide deployment health: every main task running AND every
+        check passing, continuously for min_healthy_time, before
+        healthy_deadline (reference client/allochealth/tracker.go)."""
+        if not self.alloc.deployment_id or self.tg is None:
+            return
+        upd = self.tg.update
+        min_healthy = upd.min_healthy_time_s if upd is not None else 10.0
+        deadline_s = upd.healthy_deadline_s if upd is not None else 300.0
+
+        def watch():
+            deadline = time.time() + deadline_s
+            streak_start = None
+            while not self._destroyed and self.deployment_health is None:
+                now = time.time()
+                running = self.client_status == enums.ALLOC_CLIENT_RUNNING
+                checks_ok = (self.check_runner is None
+                             or not self.check_runner.has_checks()
+                             or self.check_runner.all_passing())
+                if self.client_status == enums.ALLOC_CLIENT_FAILED:
+                    self.deployment_health = (False, now)
+                    break
+                if running and checks_ok:
+                    if streak_start is None:
+                        streak_start = now
+                    elif now - streak_start >= min_healthy:
+                        self.deployment_health = (True, now)
+                        break
+                else:
+                    streak_start = None
+                if now >= deadline:
+                    self.deployment_health = (False, now)
+                    break
+                time.sleep(0.2)
+            if self.deployment_health is not None and self.on_update:
+                self.on_update(self)
+
+        threading.Thread(target=watch, daemon=True,
+                         name=f"health-{self.alloc.id[:8]}").start()
+
     def stop(self) -> None:
         """Server asked for a stop (desired_status=stop/evict)."""
         self._destroyed = True
+        self._deregister_services()
         self._kill_all()
 
     def destroy(self) -> None:

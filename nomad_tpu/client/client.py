@@ -160,7 +160,8 @@ class Client:
             runner = AllocRunner(alloc, self.node, self.config.data_dir,
                                  on_update=self._mark_dirty,
                                  state_db=self.state_db,
-                                 restored_handles=recovered)
+                                 restored_handles=recovered,
+                                 services_api=self.server)
             with self._lock:
                 self.runners[alloc.id] = runner
             runner.run()
@@ -264,7 +265,8 @@ class Client:
                 runner = AllocRunner(alloc, self.node, self.config.data_dir,
                                      on_update=self._mark_dirty,
                                      state_db=self.state_db,
-                                     prev_runner_lookup=self.runners.get)
+                                     prev_runner_lookup=self.runners.get,
+                                     services_api=self.server)
                 self.runners[alloc_id] = runner
                 self.state_db.put_alloc(alloc)
                 starts.append(runner)
@@ -301,6 +303,9 @@ class Client:
             upd.client_description = runner.client_description
             upd.task_states = {name: st.copy()
                                for name, st in runner.task_states.items()}
+            if runner.deployment_health is not None:
+                ok, ts = runner.deployment_health
+                upd.deployment_status = {"healthy": ok, "timestamp": ts}
             fin = runner.finished_at()
             if fin:
                 upd.task_finished_at = fin

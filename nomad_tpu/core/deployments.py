@@ -25,6 +25,12 @@ from ..structs.evaluation import Evaluation
 from ..utils import generate_uuid
 
 
+def _group_has_checks(tg) -> bool:
+    from ..structs.services import collect_services
+
+    return any(svc.checks for _, svc in collect_services(tg))
+
+
 def alloc_healthy(alloc, job, now: float) -> bool:
     """Server-side health aggregation for one alloc (reference
     client/allochealth + deployment_watcher health rules): every task
@@ -36,6 +42,12 @@ def alloc_healthy(alloc, job, now: float) -> bool:
     if alloc.client_status != enums.ALLOC_CLIENT_RUNNING:
         return False
     tg = job.lookup_task_group(alloc.task_group)
+    if tg is not None and _group_has_checks(tg):
+        # the group gates health on service checks: only the client's
+        # explicit verdict counts — the liveness fallback would declare
+        # success before the check results arrive (reference: check
+        # health comes exclusively from client/allochealth)
+        return False
     min_healthy = (tg.update.min_healthy_time_s
                    if tg is not None and tg.update is not None else 10.0)
     if not alloc.task_states:
@@ -95,14 +107,23 @@ class DeploymentWatcher:
                       if a.deployment_id == dep.id]
             healthy = 0
             failed = False
+            unhealthy_verdict = False
             for a in allocs:
+                ds = a.deployment_status
                 if a.client_status == enums.ALLOC_CLIENT_FAILED:
                     failed = True
+                elif isinstance(ds, dict) and ds.get("healthy") is False:
+                    # explicit client verdict (failing checks / deadline
+                    # — client/allochealth): fail fast, don't wait out
+                    # the progress deadline
+                    unhealthy_verdict = True
                 elif self._alloc_healthy(a, job, now):
                     healthy += 1
 
-            if failed:
-                self._fail(snap, dep, job, "allocations failed")
+            if failed or unhealthy_verdict:
+                self._fail(snap, dep, job,
+                           "allocations failed" if failed
+                           else "allocations unhealthy")
                 continue
             deadline = min((s.require_progress_by
                             for s in dep.task_groups.values()

@@ -771,6 +771,21 @@ class Server:
     def delete_namespace(self, name: str) -> None:
         self.store.delete_namespace(name)
 
+    # -- Service registration endpoints (reference
+    #    nomad/service_registration_endpoint.go) --
+
+    def upsert_service_registrations(self, regs) -> None:
+        for reg in regs:
+            if not reg.service_name or not reg.id:
+                raise ValueError("service registrations require id and name")
+        self.store.upsert_service_registrations(regs)
+
+    def delete_service_registrations(self, ids) -> None:
+        self.store.delete_service_registrations(list(ids))
+
+    def delete_services_by_alloc(self, alloc_id: str) -> None:
+        self.store.delete_services_by_alloc(alloc_id)
+
     def force_gc(self) -> Dict:
         """`nomad system gc` (reference CoreJobForceGC); forwardable so
         followers route it to the leader."""

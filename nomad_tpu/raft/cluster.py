@@ -33,6 +33,8 @@ FORWARD = ("register_job", "deregister_job", "dispatch_job",
            "register_volume", "deregister_volume",
            "upsert_node_pool", "delete_node_pool",
            "upsert_namespace", "delete_namespace", "force_gc",
+           "upsert_service_registrations", "delete_service_registrations",
+           "delete_services_by_alloc",
            "upsert_acl_policy", "create_acl_token", "acl_bootstrap",
            "upsert_acl_role", "delete_acl_role")
 

@@ -34,6 +34,8 @@ MUTATIONS = {
     "upsert_volume", "delete_volume", "reap_volume_claims",
     "upsert_node_pool", "delete_node_pool",
     "upsert_namespace", "delete_namespace",
+    "upsert_service_registrations", "delete_service_registrations",
+    "delete_services_by_alloc",
     "gc_terminal_allocs", "compact", "restore_dump",
 }
 

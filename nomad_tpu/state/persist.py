@@ -41,6 +41,8 @@ def dump_store(store) -> dict:
                            for _, p in store._node_pools.iterate(snap.index)],
             "namespaces": [wire_encode(x) for _, x in
                            store._namespaces.iterate(snap.index)],
+            "services": [wire_encode(r) for _, r in
+                         store._services.iterate(snap.index)],
         }
 
 
@@ -63,6 +65,7 @@ def restore_store(store, data: dict) -> None:
     volumes = [wire_decode(x) for x in data.get("volumes", [])]
     node_pools = [wire_decode(x) for x in data.get("node_pools", [])]
     namespaces = [wire_decode(x) for x in data.get("namespaces", [])]
+    services = [wire_decode(x) for x in data.get("services", [])]
 
     with store._write_lock:
         # Generation choice must be deterministic across replicas AND
@@ -91,6 +94,9 @@ def restore_store(store, data: dict) -> None:
             id(store._volumes): {(v.namespace, v.id) for v in volumes},
             id(store._node_pools): {p.name for p in node_pools},
             id(store._namespaces): {x.name for x in namespaces},
+            id(store._services): {r.id for r in services},
+            id(store._services_by_name): {(r.namespace, r.service_name)
+                                          for r in services},
         }
         for t in store._all_tables:
             keep = new_keys.get(id(t), set())
@@ -145,6 +151,11 @@ def restore_store(store, data: dict) -> None:
             store._node_pools.put(p.name, p, gen, live)
         for x in namespaces:
             store._namespaces.put(x.name, x, gen, live)
+        for r in services:
+            store._services.put(r.id, r, gen, live)
+            _index_prepend(store._services_by_name,
+                           (r.namespace, r.service_name), r.id, gen)
+            _index_prepend(store._services_by_alloc, r.alloc_id, r.id, gen)
         store._next_gen = gen
         store._bump_node_set(gen)
         store._rebuild_usage_matrix()

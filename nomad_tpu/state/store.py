@@ -288,6 +288,21 @@ class StateSnapshot:
             if namespace is None or ns == namespace:
                 yield v
 
+    def service_registrations(self, namespace: Optional[str] = None):
+        """Every live registration (reference ServiceRegistrationListRPC)."""
+        for _, reg in self._store._services.iterate(self.index):
+            if namespace is None or reg.namespace == namespace:
+                yield reg
+
+    def service_by_name(self, name: str, namespace: str = "default"):
+        out = []
+        for rid in self._ids_from_index(self._store._services_by_name,
+                                        (namespace, name)):
+            reg = self._store._services.get(rid, self.index)
+            if reg is not None:
+                out.append(reg)
+        return out
+
     def deployment_by_id(self, dep_id: str) -> Optional[Deployment]:
         return self._store._deployments.get(dep_id, self.index)
 
@@ -352,6 +367,12 @@ class StateStore:
         self._volumes = VersionedTable("volumes")               # key (ns, id)
         self._node_pools = VersionedTable("node_pools")         # key name
         self._namespaces = VersionedTable("namespaces")         # key name
+        # builtin service catalog (reference schema.go services table):
+        # registration rows keyed by id, plus (ns, service_name) and
+        # alloc-id indexes (the latter feeds terminal-alloc reaping)
+        self._services = VersionedTable("services")             # key id
+        self._services_by_name = VersionedTable("services_by_name")
+        self._services_by_alloc = VersionedTable("services_by_alloc")
         # derived: per-node summed allocated_vec of usage-counting allocs,
         # maintained on every alloc write so tensorization reads one row
         # per node instead of walking every alloc (the tensor-era form of
@@ -387,7 +408,8 @@ class StateStore:
             self._acl_policies, self._acl_tokens, self._acl_secret_idx,
             self._acl_roles,
             self._variables, self._volumes, self._node_pools,
-            self._namespaces,
+            self._namespaces, self._services, self._services_by_name,
+            self._services_by_alloc,
             self._node_usage, self._node_dev_usage,
         ]
         self._listeners: List[Callable[[int, list], None]] = []
@@ -774,6 +796,9 @@ class StateStore:
                 self._allocs.put(merged.id, merged, gen, live)
                 self._usage_apply(existing, merged, gen, live)
                 events.append(("alloc-client-update", merged))
+                if merged.client_terminal():
+                    self._reap_services_for_terminal(merged, gen, live,
+                                                     events)
             self._commit(gen, events)
             return gen
 
@@ -818,6 +843,7 @@ class StateStore:
             ts = ts if ts is not None else time.time()
             events = []
             for alloc in stopped_allocs:
+                self._reap_services_for_terminal(alloc, gen, live, events)
                 self._put_alloc(alloc, gen, live, ts)
                 events.append(("alloc-stop", alloc))
             for alloc in preempted_allocs:
@@ -984,6 +1010,78 @@ class StateStore:
             gen, live = self._begin()
             self._volumes.delete(key, gen, live)
             self._commit(gen, [("volume-delete", vol)])
+            return gen
+
+    # --- service registrations (reference state_store_service_registration.go) ---
+
+    def upsert_service_registrations(self, regs) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            events = []
+            for reg in regs:
+                prev = self._services.get_latest(reg.id)
+                reg.create_index = prev.create_index if prev is not None else gen
+                reg.modify_index = gen
+                self._services.put(reg.id, reg, gen, live)
+                if prev is None:
+                    key = (reg.namespace, reg.service_name)
+                    cell = self._services_by_name.get_latest(key)
+                    self._services_by_name.put(key, cons(reg.id, cell),
+                                               gen, live)
+                    acell = self._services_by_alloc.get_latest(reg.alloc_id)
+                    self._services_by_alloc.put(
+                        reg.alloc_id, cons(reg.id, acell), gen, live)
+                events.append(("service-register", reg))
+            self._commit(gen, events)
+            return gen
+
+    def _delete_service_regs(self, ids, gen: int, live: int, events: list) -> None:
+        for rid in ids:
+            reg = self._services.get_latest(rid)
+            if reg is None:
+                continue
+            self._services.delete(rid, gen, live)
+            key = (reg.namespace, reg.service_name)
+            cell = self._services_by_name.get_latest(key)
+            left = [i for i in cons_iter(cell) if i != rid]
+            self._services_by_name.put(
+                key, cons_from_iter(reversed(left)), gen, live)
+            acell = self._services_by_alloc.get_latest(reg.alloc_id)
+            aleft = [i for i in cons_iter(acell) if i != rid]
+            self._services_by_alloc.put(
+                reg.alloc_id, cons_from_iter(reversed(aleft)) if aleft else None,
+                gen, live)
+            events.append(("service-deregister", reg))
+
+    def _reap_services_for_terminal(self, alloc, gen: int, live: int,
+                                    events: list) -> None:
+        """A terminal alloc's registrations must not outlive it: the
+        graceful client deregister never happens for crashed/lost nodes
+        (reference: server-side deletion when the alloc goes terminal)."""
+        cell = self._services_by_alloc.get_latest(alloc.id)
+        if cell is None:
+            return
+        ids = list(cons_iter(cell))
+        if ids:
+            self._delete_service_regs(ids, gen, live, events)
+
+    def delete_service_registrations(self, ids) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            events = []
+            self._delete_service_regs(list(ids), gen, live, events)
+            self._commit(gen, events)
+            return gen
+
+    def delete_services_by_alloc(self, alloc_id: str) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            cell = self._services_by_alloc.get_latest(alloc_id)
+            ids = list(cons_iter(cell)) if cell is not None else []
+            events = []
+            if ids:
+                self._delete_service_regs(ids, gen, live, events)
+            self._commit(gen, events)
             return gen
 
     def _claim_volumes_for(self, alloc: Allocation, gen: int, live: int,
@@ -1235,13 +1333,15 @@ class StateStore:
             dead_set = set(dead)
             # every gcable alloc is terminal, so none is usage-counting —
             # the usage rows never need adjusting here
+            gc_events: list = []
             for a in dead_allocs:
                 self._allocs.delete(a.id, gen, live)
+                self._reap_services_for_terminal(a, gen, live, gc_events)
             # rebuild secondary indexes without the dead ids
             for table in (self._allocs_by_node, self._allocs_by_job, self._allocs_by_eval):
                 for key, cell in list(table.iterate(gen)):
                     ids = [i for i in cons_iter(cell) if i not in dead_set]
                     if len(ids) != cell.length:
                         table.put(key, cons_from_iter(reversed(ids)), gen, live)
-            self._commit(gen, [("alloc-gc", dead)])
+            self._commit(gen, gc_events + [("alloc-gc", dead)])
             return len(dead)

@@ -19,12 +19,13 @@ from .resources import (  # noqa: F401
     comparable,
 )
 from .constraint import Constraint, Affinity, Spread, SpreadTarget  # noqa: F401
-from .job import Job, TaskGroup, Task, UpdateStrategy, RestartPolicy, ReschedulePolicy, EphemeralDisk  # noqa: F401
+from .job import Job, TaskGroup, Task, Service, UpdateStrategy, RestartPolicy, ReschedulePolicy, EphemeralDisk  # noqa: F401
 from .node import Node, DrainStrategy  # noqa: F401
 from .alloc import Allocation, AllocMetric, RescheduleTracker, RescheduleEvent, DesiredTransition  # noqa: F401
 from .evaluation import Evaluation  # noqa: F401
 from .plan import Plan, PlanResult  # noqa: F401
 from .deployment import Deployment, DeploymentState  # noqa: F401
+from .services import ServiceCheck, ServiceRegistration  # noqa: F401
 from .volumes import (  # noqa: F401
     ClientHostVolumeConfig,
     Volume,

@@ -1,0 +1,72 @@
+"""Service registrations + checks (reference nomad/structs/services.go,
+2,616 LoC, and service_registration.go).
+
+The builtin service catalog: tasks register named services at start and
+deregister at stop; HTTP/TCP checks run on the client (reference runs
+them via consul or the nomad provider's checks_hook) and their results
+fold into allocation health, which gates deployment promotion
+(reference client/allochealth/tracker.go)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass(slots=True)
+class ServiceCheck:
+    """One health check attached to a service (reference
+    structs/services.go ServiceCheck)."""
+
+    name: str = ""
+    type: str = "tcp"            # "http" | "tcp"
+    path: str = "/"              # http only
+    method: str = "GET"          # http only
+    interval_s: float = 10.0
+    timeout_s: float = 3.0
+    port_label: str = ""         # defaults to the service's port
+
+    @classmethod
+    def from_obj(cls, obj) -> "ServiceCheck":
+        if isinstance(obj, cls):
+            return obj
+        d = dict(obj or {})
+        return cls(
+            name=d.get("name", ""),
+            type=d.get("type", "tcp"),
+            path=d.get("path", "/"),
+            method=d.get("method", "GET"),
+            interval_s=float(d.get("interval_s", d.get("interval", 10.0))),
+            timeout_s=float(d.get("timeout_s", d.get("timeout", 3.0))),
+            port_label=d.get("port_label", d.get("port", "")),
+        )
+
+
+@dataclass(slots=True)
+class ServiceRegistration:
+    """A live instance of a service (reference
+    structs/service_registration.go ServiceRegistration)."""
+
+    id: str = ""                 # alloc_id + "/" + task + "/" + name
+    service_name: str = ""
+    namespace: str = "default"
+    node_id: str = ""
+    job_id: str = ""
+    alloc_id: str = ""
+    task_name: str = ""          # "" = group service
+    address: str = ""
+    port: int = 0
+    tags: List[str] = field(default_factory=list)
+    create_index: int = 0
+    modify_index: int = 0
+
+
+def collect_services(tg):
+    """Every (task_name, Service) pair of a task group — "" for group
+    services. The ONE place the group+task service layout is walked
+    (registration, the check runner, and the server-side health gate
+    must agree on which services exist)."""
+    out = [("", s) for s in (tg.services or [])]
+    for task in tg.tasks:
+        out.extend((task.name, s) for s in (task.services or []))
+    return out

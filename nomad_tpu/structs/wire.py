@@ -24,13 +24,14 @@ def _build_registry() -> None:
     if _REGISTRY:
         return
     from . import (alloc, constraint, deployment, evaluation, job, network,
-                   node, operator, plan, resources, variables, volumes)
+                   node, operator, plan, resources, services, variables,
+                   volumes)
     from ..acl import policy as acl_policy
     from ..acl import tokens as acl_tokens
 
     for mod in (alloc, constraint, deployment, evaluation, job, network,
-                node, operator, plan, resources, variables, volumes,
-                acl_policy, acl_tokens):
+                node, operator, plan, resources, services, variables,
+                volumes, acl_policy, acl_tokens):
         for name in dir(mod):
             obj = getattr(mod, name)
             if isinstance(obj, type) and dataclasses.is_dataclass(obj):

@@ -1,0 +1,243 @@
+"""Service registration + check-based health (reference
+nomad/structs/services.go, service_registration_endpoint.go,
+client/allochealth/tracker.go): the services table, the client check
+runner, and the deployment auto-revert gated on real health."""
+
+import copy
+import http.server
+import socket
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.client.checks import CheckRunner, run_check
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.structs import Service, ServiceCheck, ServiceRegistration, enums
+from nomad_tpu.structs.job import UpdateStrategy
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_until(fn, timeout=15.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(interval)
+    return None
+
+
+class TestServicesTable:
+    def test_register_list_delete(self):
+        s = Server(ServerConfig())
+        regs = [ServiceRegistration(id=f"a1/t/{n}", service_name=n,
+                                    alloc_id="a1", node_id="n1",
+                                    address="10.0.0.1", port=8080 + i,
+                                    tags=["v1"])
+                for i, n in enumerate(["web", "api"])]
+        s.upsert_service_registrations(regs)
+        snap = s.store.snapshot()
+        assert {r.service_name for r in snap.service_registrations()} == \
+            {"web", "api"}
+        web = snap.service_by_name("web")
+        assert len(web) == 1 and web[0].port == 8080
+        # deregister by alloc removes both
+        s.delete_services_by_alloc("a1")
+        snap = s.store.snapshot()
+        assert list(snap.service_registrations()) == []
+        assert snap.service_by_name("web") == []
+
+    def test_registration_requires_name(self):
+        s = Server(ServerConfig())
+        with pytest.raises(ValueError):
+            s.upsert_service_registrations([ServiceRegistration(id="x")])
+
+
+class TestCheckExecution:
+    def test_tcp_check(self):
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        try:
+            ok, _ = run_check(ServiceCheck(type="tcp", timeout_s=1.0),
+                              "127.0.0.1", port)
+            assert ok
+        finally:
+            srv.close()
+        ok, detail = run_check(ServiceCheck(type="tcp", timeout_s=0.5),
+                               "127.0.0.1", port)
+        assert not ok
+
+    def test_http_check(self):
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                code = 200 if self.path == "/health" else 500
+                self.send_response(code)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.HTTPServer(("127.0.0.1", 0), H)
+        port = httpd.server_port
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            ok, _ = run_check(ServiceCheck(type="http", path="/health",
+                                           timeout_s=1.0), "127.0.0.1", port)
+            assert ok
+            ok, _ = run_check(ServiceCheck(type="http", path="/boom",
+                                           timeout_s=1.0), "127.0.0.1", port)
+            assert not ok
+        finally:
+            httpd.shutdown()
+
+    def test_check_runner_aggregates(self):
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        try:
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.services = [Service(name="db", checks=[
+                {"type": "tcp", "port": str(port), "interval_s": 0.3}])]
+            node = mock.node()
+            alloc = mock.alloc(job, node)
+            cr = CheckRunner(alloc, tg, node)
+            assert cr.has_checks()
+            cr.start()
+            try:
+                assert wait_until(cr.all_passing, timeout=5.0)
+            finally:
+                cr.stop()
+        finally:
+            srv.close()
+
+
+class TestServiceLifecycleE2E:
+    def _server_client(self, tmp_path):
+        s = Server(ServerConfig(num_workers=1))
+        s.start()
+        c = Client(s, ClientConfig(data_dir=str(tmp_path / "c"),
+                                   sync_interval=0.05))
+        c.start()
+        return s, c
+
+    def test_services_register_and_deregister_with_alloc(self, tmp_path):
+        s, c = self._server_client(tmp_path)
+        try:
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.tasks[0].driver = "mock"
+            tg.tasks[0].config = {"run_for": 60.0}
+            tg.services = [Service(name="web", tags=["prod"])]
+            s.register_job(job)
+            regs = wait_until(
+                lambda: s.store.snapshot().service_by_name("web"))
+            assert regs and regs[0].alloc_id
+            assert regs[0].tags == ["prod"]
+            # stopping the job deregisters
+            s.deregister_job(job.id)
+            assert wait_until(
+                lambda: not s.store.snapshot().service_by_name("web"))
+        finally:
+            c.stop()
+            s.stop()
+
+    def test_failing_check_auto_reverts_deployment(self, tmp_path):
+        s, c = self._server_client(tmp_path)
+        s.deployment_watcher.interval = 0.1
+        closed = free_port()
+        try:
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.tasks[0].driver = "mock"
+            tg.tasks[0].config = {"run_for": 120.0}
+            tg.update = UpdateStrategy(
+                auto_revert=True, min_healthy_time_s=0.2,
+                healthy_deadline_s=3.0, progress_deadline_s=60.0)
+            s.register_job(job)
+            # v0 deploys healthy (no checks)
+            assert wait_until(lambda: (lambda d: d is not None and
+                              d.status == enums.DEPLOYMENT_STATUS_SUCCESSFUL)(
+                s.store.snapshot().latest_deployment_by_job(job.id)),
+                timeout=30.0)
+
+            # v1 adds a check against a closed port: never healthy
+            j2 = copy.deepcopy(job)
+            j2.task_groups[0].tasks[0].config = {"run_for": 120.0,
+                                                 "version": 2}
+            j2.task_groups[0].services = [Service(name="api", checks=[
+                {"type": "tcp", "port": str(closed), "interval_s": 0.3,
+                 "timeout_s": 0.3}])]
+            s.register_job(j2)
+
+            def reverted():
+                snap = s.store.snapshot()
+                cur = snap.job_by_id(job.id)
+                deps = snap.deployments_by_job(job.id)
+                failed = any(d.status == enums.DEPLOYMENT_STATUS_FAILED
+                             for d in deps)
+                # auto-revert registers a NEW version with v0's spec
+                return (failed and cur.version > j2.version
+                        and not cur.task_groups[0].services)
+            assert wait_until(reverted, timeout=60.0), [
+                (d.status, d.status_description)
+                for d in s.store.snapshot().deployments_by_job(job.id)]
+        finally:
+            c.stop()
+            s.stop()
+
+
+class TestStaleRegistrationReaping:
+    """Registrations must not outlive their alloc: crashed/lost clients
+    never send the graceful deregister (reference server-side deletion
+    on terminal allocs)."""
+
+    def test_terminal_client_update_reaps(self):
+        s = Server(ServerConfig())
+        job = mock.job()
+        node = mock.node()
+        s.store.upsert_node(node)
+        s.store.upsert_job(job)
+        a = mock.alloc(job, node)
+        s.store.upsert_allocs([a])
+        s.upsert_service_registrations([ServiceRegistration(
+            id=f"{a.id}/_group/web", service_name="web",
+            alloc_id=a.id, node_id=node.id, address="10.0.0.1", port=80)])
+        assert s.store.snapshot().service_by_name("web")
+        # the alloc dies without a graceful deregister
+        upd = a.copy_for_update()
+        upd.client_status = enums.ALLOC_CLIENT_FAILED
+        s.store.update_allocs_from_client([upd])
+        assert s.store.snapshot().service_by_name("web") == []
+
+    def test_plan_stop_reaps(self):
+        s = Server(ServerConfig())
+        job = mock.job()
+        node = mock.node()
+        s.store.upsert_node(node)
+        s.store.upsert_job(job)
+        a = mock.alloc(job, node)
+        s.store.upsert_allocs([a])
+        s.upsert_service_registrations([ServiceRegistration(
+            id=f"{a.id}/_group/web", service_name="web",
+            alloc_id=a.id, node_id=node.id, address="10.0.0.1", port=80)])
+        stopped = a.copy_for_update()
+        stopped.desired_status = enums.ALLOC_DESIRED_STOP
+        s.store.upsert_plan_results([], stopped_allocs=[stopped])
+        assert s.store.snapshot().service_by_name("web") == []
