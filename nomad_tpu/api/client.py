@@ -38,7 +38,9 @@ class ApiClient:
         params = dict(params or {})
         params.setdefault("namespace", self.namespace)
         if params:
-            url += "?" + "&".join(f"{k}={v}" for k, v in params.items())
+            from urllib.parse import urlencode
+
+            url += "?" + urlencode({k: str(v) for k, v in params.items()})
         data = None
         if body is not None:
             data = json.dumps(to_dict(body)).encode()
@@ -252,6 +254,54 @@ class ApiClient:
 
     def set_scheduler_configuration(self, cfg) -> None:
         self._request("PUT", "/v1/operator/scheduler/configuration", cfg)
+
+    # -- alloc exec / fs (reference api/allocations_exec.go, fs API) --
+
+    def alloc_exec_start(self, alloc_id: str, command, task: str = "",
+                         tty: bool = False) -> str:
+        out, _ = self._request(
+            "POST", f"/v1/client/allocation/{alloc_id}/exec",
+            {"command": list(command), "task": task, "tty": tty})
+        return out["session_id"]
+
+    def alloc_exec_stdin(self, session_id: str, data: bytes,
+                         close: bool = False) -> None:
+        import base64 as _b64
+
+        self._request("POST", f"/v1/client/exec/{session_id}/stdin",
+                      {"data": _b64.b64encode(data).decode("ascii"),
+                       "close": close})
+
+    def alloc_exec_output(self, session_id: str, offset: int = 0,
+                          wait_s: float = 10.0) -> dict:
+        import base64 as _b64
+
+        out, _ = self.get(f"/v1/client/exec/{session_id}/stdout",
+                          offset=offset, wait_s=wait_s)
+        out["data"] = _b64.b64decode(out.get("data", "") or "")
+        return out
+
+    def alloc_exec_close(self, session_id: str) -> None:
+        self._request("DELETE", f"/v1/client/exec/{session_id}")
+
+    def alloc_fs_ls(self, alloc_id: str, path: str = "/") -> list:
+        out, _ = self._request("GET", f"/v1/client/fs/ls/{alloc_id}",
+                               params={"path": path})
+        return out
+
+    def alloc_fs_stat(self, alloc_id: str, path: str) -> dict:
+        out, _ = self._request("GET", f"/v1/client/fs/stat/{alloc_id}",
+                               params={"path": path})
+        return out
+
+    def alloc_fs_cat(self, alloc_id: str, path: str, offset: int = 0,
+                     limit: int = 65536) -> bytes:
+        import base64 as _b64
+
+        out, _ = self._request("GET", f"/v1/client/fs/cat/{alloc_id}",
+                               params={"path": path, "offset": offset,
+                                       "limit": limit})
+        return _b64.b64decode(out.get("data", "") or "")
 
     def list_services(self) -> list:
         out, _ = self.get("/v1/services")

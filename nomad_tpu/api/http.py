@@ -8,7 +8,9 @@ responses carry X-Nomad-Index (command/agent/http.go blocking queries).
 
 from __future__ import annotations
 
+import base64
 import json
+import os
 import re
 import threading
 import time
@@ -389,6 +391,24 @@ class HTTPAgent:
             if acl is not None and not acl.allow_node_read():
                 return h._error(403, "Permission denied")
             return h._reply(200, [c.hoststats.latest() for c in self.clients])
+        if m := re.fullmatch(r"/v1/client/fs/(ls|cat|stat)/([^/]+)", path):
+            return self._route_fs(h, m.group(1), m.group(2), q, acl)
+        if m := re.fullmatch(r"/v1/client/exec/([^/]+)/stdout", path):
+            from ..acl import policy as aclp
+            from ..client.execstream import SESSIONS
+
+            sess = SESSIONS.get(m.group(1))
+            if sess is None:
+                return h._error(404, "no such exec session")
+            if not self._ns_allowed(acl, getattr(sess, "namespace", ns),
+                                    aclp.CAP_ALLOC_EXEC):
+                return h._error(403, "Permission denied")
+            offset = int(q.get("offset", ["0"])[0] or 0)
+            wait_s = min(float(q.get("wait_s", ["10"])[0] or 10), 30.0)
+            data, nxt, exited, code = sess.read_output(offset, wait_s)
+            return h._reply(200, {
+                "data": base64.b64encode(data).decode("ascii"),
+                "offset": nxt, "exited": exited, "exit_code": code})
         if m := re.fullmatch(r"/v1/client/fs/logs/([^/]+)", path):
             # authorized post-lookup against the alloc's own namespace
             return self._route_logs(h, m.group(1), q, snap, acl)
@@ -496,10 +516,51 @@ class HTTPAgent:
             })
         h._error(404, f"no such route {path}")
 
+    def _find_runner(self, alloc_id: str):
+        for client in self.clients:
+            runner = client.runners.get(alloc_id)
+            if runner is not None:
+                return runner
+        return None
+
+    def _route_fs(self, h, op: str, alloc_id: str, q: dict, acl=None) -> None:
+        """Alloc filesystem access (reference client/allocdir fs APIs,
+        CLI `alloc fs`; read-fs capability)."""
+        from ..acl import policy as aclp
+        from ..client import execstream
+
+        runner = self._find_runner(alloc_id)
+        if runner is None:
+            return h._error(404, "alloc not on this agent")
+        # authorize against the ALLOC's namespace, not a caller-chosen
+        # query param (reference post-lookup authorization; same shape
+        # as _route_logs)
+        if not self._ns_allowed(acl, runner.alloc.namespace,
+                                aclp.CAP_READ_FS):
+            return h._error(403, "Permission denied")
+        root = runner.allocdir.root
+        rel = q.get("path", ["/"])[0]
+        try:
+            if op == "ls":
+                return h._reply(200, execstream.fs_list(root, rel))
+            if op == "stat":
+                return h._reply(200, execstream.fs_stat(root, rel))
+            offset = int(q.get("offset", ["0"])[0] or 0)
+            limit = min(int(q.get("limit", ["65536"])[0] or 65536), 1 << 20)
+            data = execstream.fs_read(root, rel, offset, limit)
+            return h._reply(200, {
+                "data": base64.b64encode(data).decode("ascii"),
+                "offset": offset + len(data)})
+        except PermissionError as e:
+            return h._error(403, str(e))
+        except FileNotFoundError:
+            return h._error(404, f"no such path {rel!r}")
+        except (IsADirectoryError, NotADirectoryError, OSError) as e:
+            return h._error(400, str(e))
+
     def _route_logs(self, h, alloc_id: str, q: dict, snap, acl=None) -> None:
         """Task log read across the rotated files (reference
         /v1/client/fs/logs/<alloc>; CLI `alloc logs`)."""
-        import base64
 
         from ..acl import policy as aclp
         from ..client.allocdir import AllocDir
@@ -671,7 +732,6 @@ class HTTPAgent:
                 return h._error(400, str(e))
             return h._reply(200, {"eval_id": eval_id})
         if m := re.fullmatch(r"/v1/job/(.+)/dispatch", path):
-            import base64
             import binascii
 
             try:
@@ -750,6 +810,57 @@ class HTTPAgent:
             cfg = from_dict(SchedulerConfiguration, body)
             self.writer.set_scheduler_config(cfg)
             return h._reply(200, {"updated": True})
+        if m := re.fullmatch(r"/v1/client/allocation/([^/]+)/exec", path):
+            # interactive exec into a running alloc (reference
+            # api/allocations_exec.go websocket -> driver pty; here an
+            # exec session polled over HTTP — see client/execstream.py)
+            runner = self._find_runner(m.group(1))
+            if runner is None:
+                return h._error(404, "alloc not on this agent")
+            if not self._ns_allowed(acl, runner.alloc.namespace,
+                                    aclp.CAP_ALLOC_EXEC):
+                return h._error(403, "Permission denied")
+            command = list((body or {}).get("command") or [])
+            if not command:
+                return h._error(400, "missing command")
+            task = (body or {}).get("task", "")
+            if not task and runner.tg is not None and runner.tg.tasks:
+                task = runner.tg.tasks[0].name
+            from ..client import taskenv
+            from ..client.execstream import SESSIONS
+
+            task_obj = next((t for t in (runner.tg.tasks if runner.tg else [])
+                             if t.name == task), None)
+            if task_obj is None:
+                return h._error(404, f"no such task {task!r}")
+            task_dir = runner.allocdir.task_dir(task)
+            if not os.path.isdir(task_dir):
+                return h._error(409, f"task {task!r} has not started yet")
+            env = taskenv.build_env(runner.alloc, task_obj, runner.node,
+                                    task_dir, runner.allocdir.shared)
+            env = {**{"PATH": os.environ.get("PATH", os.defpath)}, **env}
+            try:
+                sess = SESSIONS.create(
+                    command, task_dir, env,
+                    tty=bool((body or {}).get("tty")))
+            except OSError as e:
+                return h._error(400, f"exec failed: {e}")
+            sess.namespace = runner.alloc.namespace
+            return h._reply(200, {"session_id": sess.id})
+        if m := re.fullmatch(r"/v1/client/exec/([^/]+)/stdin", path):
+            from ..client.execstream import SESSIONS
+
+            sess = SESSIONS.get(m.group(1))
+            if sess is None:
+                return h._error(404, "no such exec session")
+            if not self._ns_allowed(acl, getattr(sess, "namespace", ns),
+                                    aclp.CAP_ALLOC_EXEC):
+                return h._error(403, "Permission denied")
+            data = base64.b64decode((body or {}).get("data", "") or "")
+            written = sess.write_stdin(data) if data else 0
+            if (body or {}).get("close"):
+                sess.close_stdin()
+            return h._reply(200, {"written": written})
         if path == "/v1/agent/join":
             # tell this RUNNING agent to join an existing cluster
             # (reference `nomad server join` -> /v1/agent/join, gated
@@ -795,6 +906,17 @@ class HTTPAgent:
         from ..acl import policy as aclp
 
         ns = q.get("namespace", ["default"])[0]
+        if m := re.fullmatch(r"/v1/client/exec/([^/]+)", path):
+            from ..acl import policy as aclp2
+            from ..client.execstream import SESSIONS
+
+            sess = SESSIONS.get(m.group(1))
+            if sess is not None and not self._ns_allowed(
+                    acl, getattr(sess, "namespace", ns),
+                    aclp2.CAP_ALLOC_EXEC):
+                return h._error(403, "Permission denied")
+            SESSIONS.remove(m.group(1))
+            return h._reply(200, {"closed": True})
         if path == "/v1/operator/raft/peer":
             # remove a server from the raft configuration (reference
             # `operator raft remove-peer`, operator_endpoint.go)

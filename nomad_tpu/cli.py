@@ -336,6 +336,72 @@ def cmd_alloc_logs(args) -> int:
     return 0
 
 
+def cmd_alloc_exec(args) -> int:
+    """Interactive command in a running allocation (reference
+    command/alloc_exec.go over the exec-session HTTP surface)."""
+    import threading
+
+    api = _client(args)
+    sid = api.alloc_exec_start(args.alloc_id, args.command, task=args.task,
+                               tty=args.tty)
+    done = threading.Event()
+
+    def pump_stdin():
+        try:
+            while not done.is_set():
+                line = sys.stdin.readline()
+                if not line:
+                    api.alloc_exec_stdin(sid, b"", close=True)
+                    return
+                api.alloc_exec_stdin(sid, line.encode())
+        except Exception:
+            pass
+
+    t = threading.Thread(target=pump_stdin, daemon=True)
+    if not sys.stdin.isatty() or args.interactive:
+        t.start()
+    offset = 0
+    exit_code = 0
+    try:
+        while True:
+            out = api.alloc_exec_output(sid, offset=offset, wait_s=10.0)
+            if out["data"]:
+                sys.stdout.buffer.write(out["data"])
+                sys.stdout.buffer.flush()
+            offset = out["offset"]
+            if out.get("exited"):
+                exit_code = int(out.get("exit_code") or 0)
+                break
+    finally:
+        done.set()
+        try:
+            api.alloc_exec_close(sid)
+        except Exception:
+            pass
+    return exit_code
+
+
+def cmd_alloc_fs(args) -> int:
+    """Browse/read an allocation's filesystem (reference
+    command/alloc_fs.go)."""
+    api = _client(args)
+    st = api.alloc_fs_stat(args.alloc_id, args.path or "/")
+    if st["is_dir"]:
+        for e in api.alloc_fs_ls(args.alloc_id, args.path or "/"):
+            kind = "d" if e["is_dir"] else "-"
+            print(f"{kind} {e['size']:>10}  {e['name']}")
+        return 0
+    offset = 0
+    while True:
+        data = api.alloc_fs_cat(args.alloc_id, args.path, offset=offset)
+        if not data:
+            break
+        sys.stdout.buffer.write(data)
+        offset += len(data)
+    sys.stdout.buffer.flush()
+    return 0
+
+
 def cmd_eval_status(args) -> int:
     _p(_client(args).evaluation(args.eval_id))
     return 0
@@ -617,6 +683,18 @@ def build_parser() -> argparse.ArgumentParser:
     allog.add_argument("-stderr", action="store_true")
     allog.add_argument("--offset", type=int, default=0)
     allog.set_defaults(fn=cmd_alloc_logs)
+    alex = al.add_parser("exec")
+    alex.add_argument("-task", default="")
+    alex.add_argument("-tty", action="store_true")
+    alex.add_argument("-i", dest="interactive", action="store_true",
+                      help="forward stdin when attached to a terminal")
+    alex.add_argument("alloc_id")
+    alex.add_argument("command", nargs="+")
+    alex.set_defaults(fn=cmd_alloc_exec)
+    alfs = al.add_parser("fs")
+    alfs.add_argument("alloc_id")
+    alfs.add_argument("path", nargs="?", default="/")
+    alfs.set_defaults(fn=cmd_alloc_fs)
 
     ev = sub.add_parser("eval").add_subparsers(dest="eval_cmd", required=True)
     evs = ev.add_parser("status")

@@ -1,0 +1,241 @@
+"""Interactive exec sessions + alloc filesystem access (reference
+plugins/drivers/execstreaming.go, api/allocations_exec.go websocket
+path, and client/allocdir fs APIs).
+
+The reference streams exec I/O over a websocket through driver gRPC to
+a pty in the task's isolation context. Here a session is a process
+spawned in the task's directory/environment (same isolation level the
+exec driver provides — session + cgroup, no namespaces), with a pty
+when the caller asks for one; the HTTP layer exposes it as:
+
+  POST   /v1/client/allocation/<id>/exec      -> {session_id}
+  POST   /v1/client/exec/<sid>/stdin          {data: b64}
+  GET    /v1/client/exec/<sid>/stdout?offset= -> long-poll {data, ...}
+  DELETE /v1/client/exec/<sid>
+
+Output is an offset-addressed ring so a polling client never misses or
+re-reads bytes; sessions die with their process or after IDLE_TTL
+without a read."""
+
+from __future__ import annotations
+
+import base64
+import os
+import pty
+import subprocess
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..utils import generate_secret_uuid
+
+MAX_BUFFER = 1 << 20   # retained output window per session
+IDLE_TTL = 300.0       # s without a read before the reaper kills it
+
+
+class ExecSession:
+    def __init__(self, argv: List[str], cwd: str, env: Dict[str, str],
+                 tty: bool = False):
+        self.id = generate_secret_uuid()
+        self.tty = tty
+        self._buf = bytearray()
+        self._base = 0           # offset of _buf[0]
+        self._cond = threading.Condition()
+        self.exited = False
+        self.exit_code: Optional[int] = None
+        self.last_read = time.time()
+        if tty:
+            master, slave = pty.openpty()
+            self._master = master
+            self.proc = subprocess.Popen(
+                argv, cwd=cwd or None, env=env or None,
+                stdin=slave, stdout=slave, stderr=slave,
+                start_new_session=True, close_fds=True)
+            os.close(slave)
+            self._stdin_fd = master
+        else:
+            self._master = None
+            self.proc = subprocess.Popen(
+                argv, cwd=cwd or None, env=env or None,
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, start_new_session=True)
+            self._stdin_fd = None
+        t = threading.Thread(target=self._pump, daemon=True,
+                             name=f"exec-{self.id[:8]}")
+        t.start()
+
+    def _pump(self) -> None:
+        fd = self._master if self.tty else self.proc.stdout.fileno()
+        while True:
+            try:
+                chunk = os.read(fd, 65536)
+            except OSError:
+                chunk = b""
+            if not chunk:
+                break
+            with self._cond:
+                self._buf.extend(chunk)
+                overflow = len(self._buf) - MAX_BUFFER
+                if overflow > 0:
+                    del self._buf[:overflow]
+                    self._base += overflow
+                self._cond.notify_all()
+        code = self.proc.wait()
+        with self._cond:
+            self.exited = True
+            self.exit_code = code
+            self._cond.notify_all()
+            if self._master is not None:
+                try:
+                    os.close(self._master)
+                except OSError:
+                    pass
+                self._master = None
+                self._stdin_fd = None
+
+    def write_stdin(self, data: bytes) -> int:
+        """Best-effort write -> bytes accepted. Never blocks the caller
+        (an HTTP handler thread): a full pipe takes what fits and the
+        client retries the remainder."""
+        with self._cond:
+            if self.exited:
+                return 0
+            if self.tty:
+                if self._stdin_fd is None:
+                    return 0
+                try:
+                    return os.write(self._stdin_fd, data)
+                except OSError:
+                    return 0
+            if self.proc.stdin is None:
+                return 0
+            fd = self.proc.stdin.fileno()
+            os.set_blocking(fd, False)
+            try:
+                return os.write(fd, data) or 0
+            except BlockingIOError:
+                return 0
+            except OSError:
+                return 0
+
+    def close_stdin(self) -> None:
+        if not self.tty and self.proc.stdin is not None:
+            self.proc.stdin.close()
+
+    def read_output(self, offset: int, wait_s: float = 10.0):
+        """-> (data, next_offset, exited, exit_code); long-polls until
+        bytes past `offset` arrive, the process exits, or wait_s."""
+        self.last_read = time.time()
+        deadline = time.time() + wait_s
+        with self._cond:
+            while True:
+                end = self._base + len(self._buf)
+                if offset < self._base:
+                    offset = self._base  # fell out of the window
+                if offset < end or self.exited:
+                    data = bytes(self._buf[offset - self._base:])
+                    return data, end, self.exited, self.exit_code
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return b"", offset, self.exited, self.exit_code
+                self._cond.wait(min(remaining, 0.5))
+
+    def kill(self) -> None:
+        try:
+            os.killpg(os.getpgid(self.proc.pid), 15)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+class ExecSessionManager:
+    def __init__(self):
+        self._sessions: Dict[str, ExecSession] = {}
+        self._lock = threading.Lock()
+        self._reaper: Optional[threading.Thread] = None
+
+    def create(self, argv, cwd, env, tty=False) -> ExecSession:
+        s = ExecSession(argv, cwd, env, tty=tty)
+        with self._lock:
+            self._sessions[s.id] = s
+            if self._reaper is None or not self._reaper.is_alive():
+                self._reaper = threading.Thread(
+                    target=self._reap_loop, daemon=True, name="exec-reaper")
+                self._reaper.start()
+        return s
+
+    def get(self, sid: str) -> Optional[ExecSession]:
+        with self._lock:
+            return self._sessions.get(sid)
+
+    def remove(self, sid: str) -> None:
+        with self._lock:
+            s = self._sessions.pop(sid, None)
+        if s is not None:
+            s.kill()
+
+    def _reap_loop(self) -> None:
+        """Kill idle sessions and drop finished ones — on a timer, so
+        an abandoned session dies even if no exec is ever started
+        again. TERM at IDLE_TTL; SIGKILL for one that shrugged it off."""
+        while True:
+            time.sleep(10.0)
+            now = time.time()
+            with self._lock:
+                items = list(self._sessions.items())
+            for sid, s in items:
+                idle = now - s.last_read
+                if s.exited:
+                    if idle > 30.0:
+                        with self._lock:
+                            self._sessions.pop(sid, None)
+                elif idle > IDLE_TTL + 30.0:
+                    try:
+                        os.killpg(os.getpgid(s.proc.pid), 9)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+                elif idle > IDLE_TTL:
+                    s.kill()
+
+
+SESSIONS = ExecSessionManager()
+
+
+# -- alloc filesystem (reference client/allocdir fs APIs + escapingfs) --
+
+
+def safe_alloc_path(alloc_root: str, rel: str) -> str:
+    """Resolve `rel` inside the alloc dir, refusing escapes (reference
+    helper/escapingfs)."""
+    rel = (rel or "/").lstrip("/")
+    full = os.path.realpath(os.path.join(alloc_root, rel))
+    root = os.path.realpath(alloc_root)
+    if full != root and not full.startswith(root + os.sep):
+        raise PermissionError(f"path escapes the allocation directory: {rel}")
+    return full
+
+
+def fs_list(alloc_root: str, rel: str) -> List[dict]:
+    full = safe_alloc_path(alloc_root, rel)
+    out = []
+    for name in sorted(os.listdir(full)):
+        p = os.path.join(full, name)
+        st = os.stat(p, follow_symlinks=False)
+        out.append({"name": name, "is_dir": os.path.isdir(p),
+                    "size": st.st_size, "mtime": st.st_mtime})
+    return out
+
+
+def fs_stat(alloc_root: str, rel: str) -> dict:
+    full = safe_alloc_path(alloc_root, rel)
+    st = os.stat(full, follow_symlinks=False)
+    return {"name": os.path.basename(full) or "/",
+            "is_dir": os.path.isdir(full),
+            "size": st.st_size, "mtime": st.st_mtime}
+
+
+def fs_read(alloc_root: str, rel: str, offset: int = 0,
+            limit: int = 65536) -> bytes:
+    full = safe_alloc_path(alloc_root, rel)
+    with open(full, "rb") as f:
+        f.seek(offset)
+        return f.read(limit)

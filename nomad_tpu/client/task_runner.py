@@ -151,8 +151,16 @@ class TaskRunner:
         self._killed.set()
 
     def join(self, timeout: float = 10.0) -> None:
-        if self._thread is not None:
-            self._thread.join(timeout)
+        t = self._thread
+        if t is None:
+            return
+        try:
+            t.join(timeout)
+        except RuntimeError:
+            # created-but-not-yet-started: the alloc runner's stop()
+            # raced its own _run thread between make_runner() and
+            # r.start() — nothing to wait for
+            pass
 
     def wait_dead(self, timeout: float = 10.0) -> bool:
         return self._dead.wait(timeout)

@@ -1,0 +1,143 @@
+"""alloc exec sessions + alloc fs (reference
+plugins/drivers/execstreaming.go, api/allocations_exec.go,
+client/allocdir fs APIs)."""
+
+import json
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api.client import ApiClient
+from nomad_tpu.api.http import HTTPAgent
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.client.execstream import (ExecSession, fs_list, fs_read,
+                                         safe_alloc_path)
+from nomad_tpu.core.server import Server, ServerConfig
+
+
+def wait_until(fn, timeout=15.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(interval)
+    return None
+
+
+class TestExecSession:
+    def test_pipe_session_roundtrip(self):
+        s = ExecSession([sys.executable, "-S", "-c",
+                         "import sys\n"
+                         "for line in sys.stdin:\n"
+                         "    sys.stdout.write('echo:' + line)\n"
+                         "    sys.stdout.flush()\n"], None, None)
+        s.write_stdin(b"hello\n")
+        data, off, exited, _ = s.read_output(0, wait_s=5.0)
+        assert b"echo:hello" in data
+        s.close_stdin()
+        deadline = time.time() + 5
+        while not exited and time.time() < deadline:
+            _, off, exited, code = s.read_output(off, wait_s=1.0)
+        assert exited
+
+    def test_tty_session(self):
+        s = ExecSession([sys.executable, "-S", "-c",
+                         "print('istty', __import__('sys').stdout.isatty())"],
+                        None, None, tty=True)
+        out = b""
+        off = 0
+        deadline = time.time() + 5
+        exited = False
+        while not exited and time.time() < deadline:
+            data, off, exited, _ = s.read_output(off, wait_s=1.0)
+            out += data
+        assert b"istty True" in out
+
+    def test_exit_code_surfaces(self):
+        s = ExecSession([sys.executable, "-S", "-c", "raise SystemExit(3)"],
+                        None, None)
+        deadline = time.time() + 5
+        off, exited, code = 0, False, None
+        while not exited and time.time() < deadline:
+            _, off, exited, code = s.read_output(off, wait_s=1.0)
+        assert exited and code == 3
+
+
+class TestFsSafety:
+    def test_escape_refused(self, tmp_path):
+        root = tmp_path / "alloc"
+        root.mkdir()
+        (root / "ok.txt").write_text("fine")
+        with pytest.raises(PermissionError):
+            safe_alloc_path(str(root), "../secrets")
+        assert fs_read(str(root), "ok.txt") == b"fine"
+
+    def test_list(self, tmp_path):
+        root = tmp_path / "alloc"
+        (root / "sub").mkdir(parents=True)
+        (root / "a.txt").write_text("x")
+        names = {e["name"]: e for e in fs_list(str(root), "/")}
+        assert names["a.txt"]["size"] == 1
+        assert names["sub"]["is_dir"]
+
+
+class TestExecE2E:
+    def test_exec_and_fs_through_http(self, tmp_path):
+        s = Server(ServerConfig(num_workers=1))
+        s.start()
+        c = Client(s, ClientConfig(data_dir=str(tmp_path / "c")))
+        c.start()
+        agent = HTTPAgent(s, port=0)
+        agent.clients = [c]
+        agent.start()
+        api = ApiClient(agent.address)
+        try:
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.tasks[0].driver = "mock"
+            tg.tasks[0].config = {"run_for": 120.0}
+            s.register_job(job)
+            alloc = wait_until(lambda: next(
+                (a for a in s.store.snapshot().allocs_by_job(job.id)
+                 if c.runners.get(a.id)), None))
+            assert alloc is not None
+            # the task dir exists once the task is running
+            assert wait_until(lambda: c.runners[alloc.id].client_status
+                              == "running", timeout=30.0)
+            # interactive exec: a real shell in the task dir
+            sid = api.alloc_exec_start(
+                alloc.id, ["/bin/sh"], task=tg.tasks[0].name)
+            api.alloc_exec_stdin(sid, b"echo hi-$((20+22))\npwd\nexit 5\n")
+            out, code = b"", None
+            offset, exited = 0, False
+            deadline = time.time() + 15
+            while not exited and time.time() < deadline:
+                r = api.alloc_exec_output(sid, offset=offset, wait_s=2.0)
+                out += r["data"]
+                offset, exited, code = r["offset"], r["exited"], r["exit_code"]
+            assert b"hi-42" in out
+            assert code == 5
+            # the shell ran inside the task dir
+            runner = c.runners[alloc.id]
+            assert runner.allocdir.task_dir(tg.tasks[0].name).encode() in out
+
+            # fs: list the alloc dir, read a file
+            (tmp_path / "c").exists()
+            ls = api.alloc_fs_ls(alloc.id, "/")
+            assert {e["name"] for e in ls} >= {"alloc", "logs"}
+            import os
+            probe = os.path.join(runner.allocdir.shared, "probe.txt")
+            with open(probe, "w") as f:
+                f.write("fs-works")
+            assert api.alloc_fs_cat(alloc.id, "alloc/probe.txt") == b"fs-works"
+            st = api.alloc_fs_stat(alloc.id, "alloc/probe.txt")
+            assert st["size"] == 8
+        finally:
+            c.stop()
+            agent.stop()
+            s.stop()
