@@ -581,6 +581,69 @@ def headline_spread_1k() -> None:
                 score_parity_pp=tscore - hscore)
 
 
+def cfg7_sharded_5k() -> None:
+    """SURVEY §5 long-axis scaling, measured honestly: the 5K-node exact
+    placement solve through solve_task_group_sharded on the virtual
+    8-device CPU mesh vs the SAME kernel on one CPU device — the
+    sharded-vs-single comparison the multi-chip design claims must face.
+    Runs in a subprocess because the bench process owns the real
+    accelerator backend and the virtual mesh needs
+    xla_force_host_platform_device_count. vs_baseline is
+    single/sharded wall-clock: >1 means 8-way sharding with its
+    per-step global argmax collectives actually helps at this scale;
+    <1 means it loses (report either way — the collectives are latency,
+    not throughput, and 5K nodes may be below the crossover)."""
+    import os
+    import subprocess
+
+    script = r"""
+import json, time
+import numpy as np
+import __graft_entry__ as graft
+from nomad_tpu.tensor.sharding import node_mesh, solve_task_group_sharded
+import jax
+
+args = graft._example_solve_args(n_nodes=5120, k=512, s=1, v=8)
+devs = jax.devices()
+assert len(devs) == 8, devs
+mesh8 = node_mesh(devs)
+mesh1 = node_mesh(devs[:1])
+out = {}
+for name, mesh in (("sharded8", mesh8), ("single", mesh1)):
+    c, f, s = solve_task_group_sharded(mesh, args)  # compile
+    np.asarray(c)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        c, f, s = solve_task_group_sharded(mesh, args)
+        np.asarray(c)
+    out[name] = (time.perf_counter() - t0) / 3
+c8, _, s8 = map(np.asarray, solve_task_group_sharded(mesh8, args))
+c1, _, s1 = map(np.asarray, solve_task_group_sharded(mesh1, args))
+out["parity"] = bool((c8 == c1).all()
+                     and np.allclose(s8, s1, atol=1e-5))
+print(json.dumps(out))
+"""
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
+                          " --xla_force_host_platform_device_count=8"),
+               PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=900,
+                          cwd=os.path.dirname(os.path.abspath(__file__)))
+    lines = proc.stdout.strip().splitlines()
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"sharded bench subprocess failed (rc {proc.returncode}): "
+            f"{proc.stderr[-2000:]}")
+    out = json.loads(lines[-1])
+    emit("sharded_solve_512_allocs_5k_nodes_8dev",
+         512 / out["sharded8"], "allocs/s",
+         out["single"] / out["sharded8"],
+         sharded_s=out["sharded8"], single_s=out["single"],
+         parity=out["parity"])
+
+
 CONFIGS = [
     ("headline", headline_spread_1k),
     ("c2m", cfg_c2m),
@@ -590,6 +653,7 @@ CONFIGS = [
     ("cfg4", cfg4_system_preemption),
     ("cfg5", cfg5_devices_numa),
     ("cfg6", cfg6_applier_5k),
+    ("cfg7", cfg7_sharded_5k),
 ]
 
 

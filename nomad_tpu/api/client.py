@@ -266,11 +266,28 @@ class ApiClient:
 
     def alloc_exec_stdin(self, session_id: str, data: bytes,
                          close: bool = False) -> None:
+        """Writes ALL of data: the server accepts what the pipe takes
+        per call and reports it; the remainder retries here."""
         import base64 as _b64
+        import time as _time
 
-        self._request("POST", f"/v1/client/exec/{session_id}/stdin",
-                      {"data": _b64.b64encode(data).decode("ascii"),
-                       "close": close})
+        remaining = data
+        while True:
+            out, _ = self._request(
+                "POST", f"/v1/client/exec/{session_id}/stdin",
+                {"data": _b64.b64encode(remaining).decode("ascii"),
+                 "close": close and not remaining})
+            written = int(out.get("written", 0))
+            remaining = remaining[written:]
+            if not remaining:
+                if close and data:
+                    # the close flag rode a data-bearing call only if
+                    # everything fit; send it standalone otherwise
+                    self._request(
+                        "POST", f"/v1/client/exec/{session_id}/stdin",
+                        {"data": "", "close": True})
+                return
+            _time.sleep(0.05)
 
     def alloc_exec_output(self, session_id: str, offset: int = 0,
                           wait_s: float = 10.0) -> dict:

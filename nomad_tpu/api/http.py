@@ -545,8 +545,9 @@ class HTTPAgent:
                 return h._reply(200, execstream.fs_list(root, rel))
             if op == "stat":
                 return h._reply(200, execstream.fs_stat(root, rel))
-            offset = int(q.get("offset", ["0"])[0] or 0)
-            limit = min(int(q.get("limit", ["65536"])[0] or 65536), 1 << 20)
+            offset = max(int(q.get("offset", ["0"])[0] or 0), 0)
+            limit = max(min(int(q.get("limit", ["65536"])[0] or 65536),
+                            1 << 20), 0)
             data = execstream.fs_read(root, rel, offset, limit)
             return h._reply(200, {
                 "data": base64.b64encode(data).decode("ascii"),

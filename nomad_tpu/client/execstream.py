@@ -19,7 +19,6 @@ without a read."""
 
 from __future__ import annotations
 
-import base64
 import os
 import pty
 import subprocess
@@ -104,7 +103,10 @@ class ExecSession:
                 if self._stdin_fd is None:
                     return 0
                 try:
+                    os.set_blocking(self._stdin_fd, False)
                     return os.write(self._stdin_fd, data)
+                except BlockingIOError:
+                    return 0
                 except OSError:
                     return 0
             if self.proc.stdin is None:
@@ -119,8 +121,22 @@ class ExecSession:
                 return 0
 
     def close_stdin(self) -> None:
-        if not self.tty and self.proc.stdin is not None:
-            self.proc.stdin.close()
+        with self._cond:
+            if self.exited:
+                return
+            if self.tty:
+                # a pty has no half-close: EOT is how EOF reaches the
+                # foreground process
+                if self._stdin_fd is not None:
+                    try:
+                        os.write(self._stdin_fd, b"\x04")
+                    except OSError:
+                        pass
+            elif self.proc.stdin is not None:
+                try:
+                    self.proc.stdin.close()
+                except OSError:
+                    pass
 
     def read_output(self, offset: int, wait_s: float = 10.0):
         """-> (data, next_offset, exited, exit_code); long-polls until
@@ -215,13 +231,17 @@ def safe_alloc_path(alloc_root: str, rel: str) -> str:
 
 
 def fs_list(alloc_root: str, rel: str) -> List[dict]:
-    full = safe_alloc_path(alloc_root, rel)
+    fd = _open_confined(alloc_root, rel, os.O_DIRECTORY)
     out = []
-    for name in sorted(os.listdir(full)):
-        p = os.path.join(full, name)
-        st = os.stat(p, follow_symlinks=False)
-        out.append({"name": name, "is_dir": os.path.isdir(p),
-                    "size": st.st_size, "mtime": st.st_mtime})
+    try:
+        full = safe_alloc_path(alloc_root, rel)
+        for name in sorted(os.listdir(fd)):
+            p = os.path.join(full, name)
+            st = os.stat(p, follow_symlinks=False)
+            out.append({"name": name, "is_dir": os.path.isdir(p),
+                        "size": st.st_size, "mtime": st.st_mtime})
+    finally:
+        os.close(fd)
     return out
 
 
@@ -233,9 +253,30 @@ def fs_stat(alloc_root: str, rel: str) -> dict:
             "size": st.st_size, "mtime": st.st_mtime}
 
 
+def _open_confined(alloc_root: str, rel: str, extra_flags: int = 0) -> int:
+    """Open the resolved path refusing a symlink final component, then
+    re-verify the opened file really lives under the alloc root (closes
+    the realpath-check -> open TOCTOU window: a task swapping in a
+    symlink between the check and the open must not reach host files)."""
+    full = safe_alloc_path(alloc_root, rel)
+    fd = os.open(full, os.O_RDONLY | os.O_NOFOLLOW | extra_flags)
+    try:
+        actual = os.path.realpath(f"/proc/self/fd/{fd}")
+        root = os.path.realpath(alloc_root)
+        if actual != root and not actual.startswith(root + os.sep):
+            raise PermissionError(
+                f"path escapes the allocation directory: {rel}")
+    except PermissionError:
+        os.close(fd)
+        raise
+    except OSError:
+        pass  # no /proc: the O_NOFOLLOW final-component check stands
+    return fd
+
+
 def fs_read(alloc_root: str, rel: str, offset: int = 0,
             limit: int = 65536) -> bytes:
-    full = safe_alloc_path(alloc_root, rel)
-    with open(full, "rb") as f:
-        f.seek(offset)
-        return f.read(limit)
+    fd = _open_confined(alloc_root, rel)
+    with os.fdopen(fd, "rb") as f:
+        f.seek(max(offset, 0))
+        return f.read(max(limit, 0))
