@@ -1,0 +1,187 @@
+"""ACL auth methods + binding rules (reference nomad/structs ACLAuthMethod
+/ ACLBindingRule, nomad/acl_endpoint.go Login, acl/ auth-method structs).
+
+SSO-style login: an external identity provider issues a JWT; a
+configured auth method validates it (signature against the method's
+validation keys, issuer/audience bounds, expiry) and maps claims to
+variables; binding rules select which roles/policies the resulting
+EPHEMERAL ACL token carries (bind_name may interpolate ${claim.vars}).
+The reference validates RS256/JWKS via OIDC discovery; this
+implementation validates the HMAC-HS256 JWT shape the rest of the
+framework signs (core/encrypter.py), with keys supplied in the method
+config — the exchange-and-bind semantics are the same."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+AUTH_TYPE_JWT = "JWT"
+
+BIND_ROLE = "role"
+BIND_POLICY = "policy"
+BIND_MANAGEMENT = "management"
+
+
+@dataclass(slots=True)
+class AuthMethod:
+    """reference structs.ACLAuthMethod."""
+
+    name: str = ""
+    type: str = AUTH_TYPE_JWT
+    token_locality: str = "local"
+    max_token_ttl_s: float = 3600.0
+    default: bool = False
+    # JWT config (reference ACLAuthMethodConfig):
+    #   jwt_validation_keys: [base64 HMAC secrets] (any may verify)
+    #   bound_issuer: "" | required iss
+    #   bound_audiences: [] | at least one must appear in aud
+    #   claim_mappings: {jwt claim: variable name} for selectors/binds
+    config: Dict = field(default_factory=dict)
+    create_index: int = 0
+    modify_index: int = 0
+
+
+@dataclass(slots=True)
+class BindingRule:
+    """reference structs.ACLBindingRule."""
+
+    id: str = ""
+    auth_method: str = ""
+    description: str = ""
+    # selector over mapped claim variables: "" matches everything;
+    # otherwise 'var==value' / 'var!=value' terms joined by ' and '
+    # (a practical subset of the reference's go-bexpr selectors)
+    selector: str = ""
+    bind_type: str = BIND_ROLE       # role | policy | management
+    bind_name: str = ""              # may interpolate ${var}
+    create_index: int = 0
+    modify_index: int = 0
+
+
+def _unb64(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def verify_jwt(token: str, method: AuthMethod) -> dict:
+    """Validate an external JWT against the method's config -> claims.
+    Raises PermissionError on any validation failure."""
+    try:
+        head_b64, claims_b64, sig_b64 = token.split(".")
+        header = json.loads(_unb64(head_b64))
+        claims = json.loads(_unb64(claims_b64))
+        sig = _unb64(sig_b64)
+    except Exception as e:
+        raise PermissionError(f"malformed JWT: {e}") from e
+    if header.get("alg") != "HS256":
+        raise PermissionError(f"unsupported alg {header.get('alg')!r}")
+    keys = method.config.get("jwt_validation_keys", [])
+    signing_input = f"{head_b64}.{claims_b64}".encode()
+    for key_b64 in keys:
+        try:
+            key = base64.b64decode(key_b64)
+        except Exception:
+            continue
+        want = hmac.new(key, signing_input, hashlib.sha256).digest()
+        if hmac.compare_digest(want, sig):
+            break
+    else:
+        raise PermissionError("JWT signature does not match any "
+                              "validation key")
+    now = time.time()
+    if "exp" in claims and now >= float(claims["exp"]):
+        raise PermissionError("JWT expired")
+    if "nbf" in claims and now < float(claims["nbf"]):
+        raise PermissionError("JWT not yet valid")
+    issuer = method.config.get("bound_issuer", "")
+    if issuer and claims.get("iss") != issuer:
+        raise PermissionError(f"issuer {claims.get('iss')!r} not bound")
+    audiences = method.config.get("bound_audiences", [])
+    if audiences:
+        aud = claims.get("aud", [])
+        if isinstance(aud, str):
+            aud = [aud]
+        if not set(aud) & set(audiences):
+            raise PermissionError("audience not bound")
+    return claims
+
+
+def map_claims(claims: dict, method: AuthMethod) -> Dict[str, str]:
+    """claim_mappings -> selector/interpolation variables (reference
+    auth-method ClaimMappings producing value.<name> vars)."""
+    out: Dict[str, str] = {}
+    for claim, var in (method.config.get("claim_mappings") or {}).items():
+        v = claims
+        for part in claim.split("."):
+            if not isinstance(v, dict) or part not in v:
+                v = None
+                break
+            v = v[part]
+        if v is not None and not isinstance(v, (dict, list)):
+            out[var] = str(v)
+    return out
+
+
+def selector_matches(selector: str, variables: Dict[str, str]) -> bool:
+    if not selector.strip():
+        return True
+    for term in selector.split(" and "):
+        term = term.strip()
+        if "==" in term:
+            k, v = term.split("==", 1)
+            if variables.get(k.strip()) != v.strip().strip('"'):
+                return False
+        elif "!=" in term:
+            k, v = term.split("!=", 1)
+            if variables.get(k.strip()) == v.strip().strip('"'):
+                return False
+        else:
+            return False  # unknown term shape matches nothing
+    return True
+
+
+_INTERP = re.compile(r"\$\{([^}]+)\}")
+
+
+def interpolate_bind_name(name: str, variables: Dict[str, str]) -> Optional[str]:
+    """${var} interpolation; None when a referenced var is missing
+    (reference: such a rule simply doesn't bind)."""
+    missing = []
+
+    def sub(m):
+        v = variables.get(m.group(1).strip())
+        if v is None:
+            missing.append(m.group(1))
+            return ""
+        return v
+
+    out = _INTERP.sub(sub, name)
+    return None if missing else out
+
+
+def evaluate_binding_rules(rules: List[BindingRule],
+                           variables: Dict[str, str]):
+    """-> (management, roles, policies) bound for this login."""
+    management = False
+    roles: List[str] = []
+    policies: List[str] = []
+    for rule in rules:
+        if not selector_matches(rule.selector, variables):
+            continue
+        if rule.bind_type == BIND_MANAGEMENT:
+            management = True
+            continue
+        bound = interpolate_bind_name(rule.bind_name, variables)
+        if not bound:
+            continue
+        if rule.bind_type == BIND_ROLE:
+            roles.append(bound)
+        elif rule.bind_type == BIND_POLICY:
+            policies.append(bound)
+    return management, list(dict.fromkeys(roles)), list(dict.fromkeys(policies))

@@ -28,6 +28,9 @@ class AclToken:
     roles: List[str] = field(default_factory=list)
     global_: bool = False
     create_time: float = 0.0
+    # 0 = never expires; SSO login tokens are ephemeral (reference
+    # ACLToken.ExpirationTime from auth-method MaxTokenTTL)
+    expiration_time: float = 0.0
     modify_index: int = 0
 
     @classmethod

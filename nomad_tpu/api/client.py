@@ -255,6 +255,35 @@ class ApiClient:
     def set_scheduler_configuration(self, cfg) -> None:
         self._request("PUT", "/v1/operator/scheduler/configuration", cfg)
 
+    # -- ACL auth methods / SSO (reference api/acl.go Login) --
+
+    def acl_login(self, auth_method: str, login_token: str) -> dict:
+        out, _ = self._request("POST", "/v1/acl/login",
+                               {"auth_method": auth_method,
+                                "login_token": login_token})
+        return out
+
+    def upsert_auth_method(self, name: str, body: dict) -> None:
+        self._request("POST", f"/v1/acl/auth-method/{name}", body)
+
+    def list_auth_methods(self) -> list:
+        out, _ = self.get("/v1/acl/auth-methods")
+        return out
+
+    def delete_auth_method(self, name: str) -> None:
+        self._request("DELETE", f"/v1/acl/auth-method/{name}")
+
+    def upsert_binding_rule(self, body: dict) -> str:
+        out, _ = self._request("POST", "/v1/acl/binding-rule", body)
+        return out["id"]
+
+    def list_binding_rules(self) -> list:
+        out, _ = self.get("/v1/acl/binding-rules")
+        return out
+
+    def delete_binding_rule(self, rule_id: str) -> None:
+        self._request("DELETE", f"/v1/acl/binding-rule/{rule_id}")
+
     # -- alloc exec / fs (reference api/allocations_exec.go, fs API) --
 
     def alloc_exec_start(self, alloc_id: str, command, task: str = "",
@@ -265,29 +294,32 @@ class ApiClient:
         return out["session_id"]
 
     def alloc_exec_stdin(self, session_id: str, data: bytes,
-                         close: bool = False) -> None:
-        """Writes ALL of data: the server accepts what the pipe takes
-        per call and reports it; the remainder retries here."""
+                         close: bool = False,
+                         timeout_s: float = 60.0) -> None:
+        """Writes ALL of data (the server accepts what the pipe takes
+        per call), then delivers close as its own call. Stops early if
+        the remote process exits; raises TimeoutError when the pipe
+        stays full past timeout_s."""
         import base64 as _b64
         import time as _time
 
-        remaining = data
-        while True:
+        deadline = _time.time() + timeout_s
+        remaining = data or b""
+        while remaining:
             out, _ = self._request(
                 "POST", f"/v1/client/exec/{session_id}/stdin",
-                {"data": _b64.b64encode(remaining).decode("ascii"),
-                 "close": close and not remaining})
-            written = int(out.get("written", 0))
-            remaining = remaining[written:]
-            if not remaining:
-                if close and data:
-                    # the close flag rode a data-bearing call only if
-                    # everything fit; send it standalone otherwise
-                    self._request(
-                        "POST", f"/v1/client/exec/{session_id}/stdin",
-                        {"data": "", "close": True})
+                {"data": _b64.b64encode(remaining).decode("ascii")})
+            remaining = remaining[int(out.get("written", 0)):]
+            if out.get("exited"):
                 return
-            _time.sleep(0.05)
+            if remaining:
+                if _time.time() >= deadline:
+                    raise TimeoutError(
+                        "exec stdin not accepted (pipe full?)")
+                _time.sleep(0.05)
+        if close:
+            self._request("POST", f"/v1/client/exec/{session_id}/stdin",
+                          {"data": "", "close": True})
 
     def alloc_exec_output(self, session_id: str, offset: int = 0,
                           wait_s: float = 10.0) -> dict:

@@ -286,6 +286,10 @@ class HTTPAgent:
                  "type": t.type, "policies": t.policies,
                  "roles": getattr(t, "roles", [])}
                 for t in snap.acl_tokens()])
+        if path == "/v1/acl/auth-methods":
+            return h._reply(200, list(snap.auth_methods()))
+        if path == "/v1/acl/binding-rules":
+            return h._reply(200, list(snap.binding_rules()))
         if path == "/v1/acl/roles":
             return h._reply(200, list(snap.acl_roles()))
         if m := re.fullmatch(r"/v1/acl/role/([^/]+)", path):
@@ -635,10 +639,40 @@ class HTTPAgent:
                     return h._error(403, "Permission denied")
             elif not self._ns_allowed(acl, ns, aclp.CAP_SUBMIT_JOB):
                 return h._error(403, "Permission denied")
-        elif path.startswith("/v1/acl") and path != "/v1/acl/bootstrap":
+        elif path.startswith("/v1/acl") and path not in (
+                "/v1/acl/bootstrap", "/v1/acl/login"):
             if acl is not None and not acl.management:
                 return h._error(403, "Permission denied")
 
+        if path == "/v1/acl/login":
+            # SSO: exchange an external JWT for an ephemeral token —
+            # unauthenticated by design (reference acl_endpoint.go Login)
+            try:
+                token = self.writer.acl_login(
+                    body.get("auth_method", ""),
+                    body.get("login_token", ""))
+            except PermissionError as e:
+                return h._error(403, str(e))
+            return h._reply(200, {
+                "accessor_id": token.accessor_id,
+                "secret_id": token.secret_id,
+                "type": token.type,
+                "policies": token.policies, "roles": token.roles,
+                "expiration_time": token.expiration_time})
+        if m := re.fullmatch(r"/v1/acl/auth-method/([^/]+)", path):
+            try:
+                method = dict(body or {})
+                method["name"] = m.group(1)
+                self.writer.upsert_auth_method(method)
+            except (ValueError, TypeError) as e:
+                return h._error(400, str(e))
+            return h._reply(200, {"ok": True})
+        if path == "/v1/acl/binding-rule":
+            try:
+                rule = self.writer.upsert_binding_rule(dict(body or {}))
+            except (ValueError, TypeError) as e:
+                return h._error(400, str(e))
+            return h._reply(200, {"id": rule.id})
         if path == "/v1/acl/bootstrap":
             token = self.writer.acl_bootstrap()
             return h._reply(200, {"accessor_id": token.accessor_id,
@@ -861,7 +895,8 @@ class HTTPAgent:
             written = sess.write_stdin(data) if data else 0
             if (body or {}).get("close"):
                 sess.close_stdin()
-            return h._reply(200, {"written": written})
+            return h._reply(200, {"written": written,
+                                  "exited": sess.exited})
         if path == "/v1/agent/join":
             # tell this RUNNING agent to join an existing cluster
             # (reference `nomad server join` -> /v1/agent/join, gated
@@ -959,6 +994,16 @@ class HTTPAgent:
             if acl is not None and not acl.management:
                 return h._error(403, "Permission denied")
             self.writer.delete_acl_role(m.group(1))
+            return h._reply(200, {"ok": True})
+        if m := re.fullmatch(r"/v1/acl/auth-method/([^/]+)", path):
+            if acl is not None and not acl.management:
+                return h._error(403, "Permission denied")
+            self.writer.delete_auth_method(m.group(1))
+            return h._reply(200, {"ok": True})
+        if m := re.fullmatch(r"/v1/acl/binding-rule/([^/]+)", path):
+            if acl is not None and not acl.management:
+                return h._error(403, "Permission denied")
+            self.writer.delete_binding_rule(m.group(1))
             return h._reply(200, {"ok": True})
         if m := re.fullmatch(r"/v1/namespace/([^/]+)", path):
             if acl is not None and not acl.allow_operator_write():

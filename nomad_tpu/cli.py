@@ -452,6 +452,44 @@ def cmd_service(args) -> int:
     return 0
 
 
+def cmd_acl(args) -> int:
+    """ACL operations (reference command/acl_*.go): bootstrap, SSO
+    login, auth methods, binding rules."""
+    api = _client(args)
+    if args.acl_cmd == "bootstrap":
+        _p(api._request("POST", "/v1/acl/bootstrap")[0])
+        return 0
+    if args.acl_cmd == "login":
+        token = args.login_token
+        if token == "-":
+            token = sys.stdin.read().strip()
+        _p(api.acl_login(args.method, token))
+        return 0
+    if args.acl_cmd == "auth-method":
+        if args.op == "list":
+            _p(api.list_auth_methods())
+        elif args.op == "delete":
+            api.delete_auth_method(args.name)
+            print(f"auth method {args.name} deleted")
+        else:  # apply
+            body = json.load(open(args.spec)) if args.spec else {}
+            api.upsert_auth_method(args.name, body)
+            print(f"auth method {args.name} applied")
+        return 0
+    if args.acl_cmd == "binding-rule":
+        if args.op == "list":
+            _p(api.list_binding_rules())
+        elif args.op == "delete":
+            api.delete_binding_rule(args.name)
+            print(f"binding rule {args.name} deleted")
+        else:
+            body = json.load(open(args.spec)) if args.spec else {}
+            rid = api.upsert_binding_rule(body)
+            print(f"binding rule {rid} applied")
+        return 0
+    return 2
+
+
 def cmd_operator_raft(args) -> int:
     """Raft membership operations (reference command/operator_raft_*.go)."""
     api = _client(args)
@@ -753,6 +791,22 @@ def build_parser() -> argparse.ArgumentParser:
     oraft.add_argument("op", choices=["list-peers", "remove-peer"])
     oraft.add_argument("-peer-id", dest="peer_id", default="")
     oraft.set_defaults(fn=cmd_operator_raft)
+
+    aclp = sub.add_parser("acl").add_subparsers(dest="acl_cmd", required=True)
+    ab = aclp.add_parser("bootstrap")
+    ab.set_defaults(fn=cmd_acl)
+    alog = aclp.add_parser("login")
+    alog.add_argument("-method", required=True)
+    alog.add_argument("login_token",
+                      help="external JWT ('-' reads from stdin)")
+    alog.set_defaults(fn=cmd_acl)
+    for kind in ("auth-method", "binding-rule"):
+        ap = aclp.add_parser(kind)
+        ap.add_argument("op", choices=["apply", "list", "delete"])
+        ap.add_argument("name", nargs="?", default="")
+        ap.add_argument("-spec", default="",
+                        help="JSON config file for apply")
+        ap.set_defaults(fn=cmd_acl)
 
     svc = sub.add_parser("service")
     svc.add_argument("op", choices=["list", "info"])

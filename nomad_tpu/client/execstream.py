@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import os
 import pty
+import select
 import subprocess
 import threading
 import time
@@ -68,6 +69,11 @@ class ExecSession:
         while True:
             try:
                 chunk = os.read(fd, 65536)
+            except BlockingIOError:
+                # write_stdin flips the shared pty fd nonblocking; an
+                # empty moment is NOT EOF — wait for readability
+                select.select([fd], [], [], 0.5)
+                continue
             except OSError:
                 chunk = b""
             if not chunk:
@@ -126,12 +132,18 @@ class ExecSession:
                 return
             if self.tty:
                 # a pty has no half-close: EOT is how EOF reaches the
-                # foreground process
+                # foreground process. The fd may be nonblocking with a
+                # briefly-full input queue — retry a few times rather
+                # than silently dropping the EOF
                 if self._stdin_fd is not None:
-                    try:
-                        os.write(self._stdin_fd, b"\x04")
-                    except OSError:
-                        pass
+                    for _ in range(20):
+                        try:
+                            os.write(self._stdin_fd, b"\x04")
+                            break
+                        except BlockingIOError:
+                            time.sleep(0.05)
+                        except OSError:
+                            break
             elif self.proc.stdin is not None:
                 try:
                     self.proc.stdin.close()
@@ -231,14 +243,20 @@ def safe_alloc_path(alloc_root: str, rel: str) -> str:
 
 
 def fs_list(alloc_root: str, rel: str) -> List[dict]:
+    import stat as _stat
+
     fd = _open_confined(alloc_root, rel, os.O_DIRECTORY)
     out = []
     try:
-        full = safe_alloc_path(alloc_root, rel)
         for name in sorted(os.listdir(fd)):
-            p = os.path.join(full, name)
-            st = os.stat(p, follow_symlinks=False)
-            out.append({"name": name, "is_dir": os.path.isdir(p),
+            try:
+                # stat through the pinned dir fd, never following
+                # symlinks: a link to host paths must not be probed
+                st = os.stat(name, dir_fd=fd, follow_symlinks=False)
+            except OSError:
+                continue
+            out.append({"name": name,
+                        "is_dir": _stat.S_ISDIR(st.st_mode),
                         "size": st.st_size, "mtime": st.st_mtime})
     finally:
         os.close(fd)

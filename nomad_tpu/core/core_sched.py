@@ -84,6 +84,12 @@ class CoreScheduler:
                                      before_time=now - et)
         self.stats["allocs"] += n
 
+        # --- expired ACL token GC (reference core_sched.go
+        # expiredACLTokenGC): SSO login tokens are ephemeral and must
+        # not accumulate in the replicated store ---
+        reaped = store.gc_expired_acl_tokens(ts=now)
+        self.stats["acl_tokens"] = self.stats.get("acl_tokens", 0) + reaped
+
         # --- volume claim reaping (reference nomad/volumewatcher/):
         # claims of terminal/vanished allocs release so writers free up ---
         released = store.reap_volume_claims()

@@ -887,6 +887,80 @@ class Server:
     def delete_acl_role(self, name: str) -> None:
         self.store.delete_acl_role(name)
 
+    # -- ACL auth methods / SSO login (reference nomad/acl_endpoint.go
+    #    Login, acl/ auth-method structs) --
+
+    def upsert_auth_method(self, method) -> None:
+        from ..acl.auth import AUTH_TYPE_JWT, AuthMethod
+
+        if isinstance(method, dict):
+            method = AuthMethod(**method)
+        if not method.name:
+            raise ValueError("auth method name is required")
+        if method.type != AUTH_TYPE_JWT:
+            raise ValueError(f"unsupported auth method type {method.type!r}")
+        if method.max_token_ttl_s < 0:
+            raise ValueError("max_token_ttl_s must be >= 0")
+        self.store.upsert_auth_method(method)
+
+    def delete_auth_method(self, name: str) -> None:
+        self.store.delete_auth_method(name)
+
+    def upsert_binding_rule(self, rule) -> object:
+        from ..acl.auth import (BIND_MANAGEMENT, BIND_POLICY, BIND_ROLE,
+                                BindingRule)
+
+        if isinstance(rule, dict):
+            rule = BindingRule(**rule)
+        if not rule.id:
+            rule.id = generate_uuid()
+        if self.store.snapshot().auth_method(rule.auth_method) is None:
+            raise ValueError(f"unknown auth method {rule.auth_method!r}")
+        if rule.bind_type not in (BIND_ROLE, BIND_POLICY, BIND_MANAGEMENT):
+            raise ValueError(f"unknown bind_type {rule.bind_type!r}")
+        if rule.bind_type != BIND_MANAGEMENT and not rule.bind_name:
+            raise ValueError("bind_name is required")
+        self.store.upsert_binding_rule(rule)
+        return rule
+
+    def delete_binding_rule(self, rule_id: str) -> None:
+        self.store.delete_binding_rule(rule_id)
+
+    def acl_login(self, auth_method: str, login_token: str):
+        """Exchange an external JWT for an ephemeral ACL token
+        (reference acl_endpoint.go Login)."""
+        from ..acl import auth as a
+        from ..acl.tokens import TOKEN_TYPE_MANAGEMENT, AclToken
+
+        snap = self.store.snapshot()
+        method = snap.auth_method(auth_method)
+        if method is None:
+            raise PermissionError(f"unknown auth method {auth_method!r}")
+        claims = a.verify_jwt(login_token, method)
+        variables = a.map_claims(claims, method)
+        rules = list(snap.binding_rules(method.name))
+        management, roles, policies = a.evaluate_binding_rules(rules,
+                                                               variables)
+        if not management and not roles and not policies:
+            raise PermissionError("no binding rules matched this identity")
+        # bound names that don't exist simply don't grant (reference:
+        # dangling bindings resolve to nothing at authorization time),
+        # but a login that would grant nothing at all is refused
+        roles = [r for r in roles if snap.acl_role(r) is not None]
+        policies = [p for p in policies if snap.acl_policy(p) is not None]
+        if not management and not roles and not policies:
+            raise PermissionError("binding rules matched but none of the "
+                                  "bound roles/policies exist")
+        token = AclToken.new(
+            f"{method.name} login ({variables.get('name', claims.get('sub', ''))})",
+            TOKEN_TYPE_MANAGEMENT if management else "client",
+            policies, roles)
+        token.create_time = time.time()
+        if method.max_token_ttl_s > 0:
+            token.expiration_time = token.create_time + method.max_token_ttl_s
+        self.store.upsert_acl_token(token)
+        return token
+
     def resolve_token(self, secret_id: str):
         """secret -> compiled ACL (reference nomad/auth/auth.go)."""
         from ..acl.policy import ACL, compile_acl
@@ -897,6 +971,8 @@ class Server:
         token = snap.acl_token_by_secret(secret_id)
         if token is None:
             raise PermissionError("token not found")
+        if token.expiration_time and time.time() >= token.expiration_time:
+            raise PermissionError("token expired")
         if token.is_management:
             return ACL(management=True)
         names = list(token.policies)

@@ -30,6 +30,9 @@ MUTATIONS = {
     "upsert_acl_policy", "delete_acl_policy",
     "upsert_acl_token", "delete_acl_token",
     "upsert_acl_role", "delete_acl_role",
+    "upsert_auth_method", "delete_auth_method",
+    "upsert_binding_rule", "delete_binding_rule",
+    "gc_expired_acl_tokens",
     "upsert_variable", "delete_variable",
     "upsert_volume", "delete_volume", "reap_volume_claims",
     "upsert_node_pool", "delete_node_pool",
@@ -63,6 +66,7 @@ class FSM:
 # leader on time-gated decisions (gc_terminal_allocs cutoffs). The
 # reference embeds times in the raft request structs for the same reason.
 TIMESTAMPED = {
+    "gc_expired_acl_tokens",
     "upsert_evals", "upsert_allocs", "update_allocs_from_client",
     "upsert_plan_results", "update_node_status",
     "update_alloc_desired_transitions",

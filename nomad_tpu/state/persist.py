@@ -43,6 +43,10 @@ def dump_store(store) -> dict:
                            store._namespaces.iterate(snap.index)],
             "services": [wire_encode(r) for _, r in
                          store._services.iterate(snap.index)],
+            "auth_methods": [wire_encode(m) for _, m in
+                             store._auth_methods.iterate(snap.index)],
+            "binding_rules": [wire_encode(r) for _, r in
+                              store._binding_rules.iterate(snap.index)],
         }
 
 
@@ -66,6 +70,8 @@ def restore_store(store, data: dict) -> None:
     node_pools = [wire_decode(x) for x in data.get("node_pools", [])]
     namespaces = [wire_decode(x) for x in data.get("namespaces", [])]
     services = [wire_decode(x) for x in data.get("services", [])]
+    auth_methods = [wire_decode(x) for x in data.get("auth_methods", [])]
+    binding_rules = [wire_decode(x) for x in data.get("binding_rules", [])]
 
     with store._write_lock:
         # Generation choice must be deterministic across replicas AND
@@ -97,6 +103,8 @@ def restore_store(store, data: dict) -> None:
             id(store._services): {r.id for r in services},
             id(store._services_by_name): {(r.namespace, r.service_name)
                                           for r in services},
+            id(store._auth_methods): {m.name for m in auth_methods},
+            id(store._binding_rules): {r.id for r in binding_rules},
         }
         for t in store._all_tables:
             keep = new_keys.get(id(t), set())
@@ -156,6 +164,10 @@ def restore_store(store, data: dict) -> None:
             _index_prepend(store._services_by_name,
                            (r.namespace, r.service_name), r.id, gen)
             _index_prepend(store._services_by_alloc, r.alloc_id, r.id, gen)
+        for m in auth_methods:
+            store._auth_methods.put(m.name, m, gen, live)
+        for r in binding_rules:
+            store._binding_rules.put(r.id, r, gen, live)
         store._next_gen = gen
         store._bump_node_set(gen)
         store._rebuild_usage_matrix()

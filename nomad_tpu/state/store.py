@@ -206,6 +206,20 @@ class StateSnapshot:
     def acl_tokens(self):
         return (t for _, t in self._store._acl_tokens.iterate(self.index))
 
+    def auth_method(self, name: str):
+        return self._store._auth_methods.get(name, self.index)
+
+    def auth_methods(self):
+        return (m for _, m in self._store._auth_methods.iterate(self.index))
+
+    def binding_rules(self, auth_method: str = ""):
+        for _, r in self._store._binding_rules.iterate(self.index):
+            if not auth_method or r.auth_method == auth_method:
+                yield r
+
+    def binding_rule(self, rule_id: str):
+        return self._store._binding_rules.get(rule_id, self.index)
+
     def acl_role(self, name: str):
         return self._store._acl_roles.get(name, self.index)
 
@@ -363,6 +377,8 @@ class StateStore:
         self._acl_tokens = VersionedTable("acl_tokens")         # key accessor id
         self._acl_secret_idx = VersionedTable("acl_secret_idx")  # secret -> accessor
         self._acl_roles = VersionedTable("acl_roles")           # key name
+        self._auth_methods = VersionedTable("acl_auth_methods")  # key name
+        self._binding_rules = VersionedTable("acl_binding_rules")  # key id
         self._variables = VersionedTable("variables")           # key (ns, path)
         self._volumes = VersionedTable("volumes")               # key (ns, id)
         self._node_pools = VersionedTable("node_pools")         # key name
@@ -406,7 +422,7 @@ class StateStore:
             self._deployments, self._allocs_by_node, self._allocs_by_job,
             self._allocs_by_eval, self._evals_by_job, self._deployments_by_job,
             self._acl_policies, self._acl_tokens, self._acl_secret_idx,
-            self._acl_roles,
+            self._acl_roles, self._auth_methods, self._binding_rules,
             self._variables, self._volumes, self._node_pools,
             self._namespaces, self._services, self._services_by_name,
             self._services_by_alloc,
@@ -1263,6 +1279,46 @@ class StateStore:
             self._commit(gen, [("acl-role-delete", role)])
             return gen
 
+    def upsert_auth_method(self, method) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            prev = self._auth_methods.get_latest(method.name)
+            method.create_index = prev.create_index if prev is not None else gen
+            method.modify_index = gen
+            self._auth_methods.put(method.name, method, gen, live)
+            self._commit(gen, [("auth-method-upsert", method)])
+            return gen
+
+    def delete_auth_method(self, name: str) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            m = self._auth_methods.get_latest(name)
+            self._auth_methods.delete(name, gen, live)
+            # rules of a deleted method are dead weight: drop them
+            for rid, rule in list(self._binding_rules.iterate(gen)):
+                if rule.auth_method == name:
+                    self._binding_rules.delete(rid, gen, live)
+            self._commit(gen, [("auth-method-delete", m)])
+            return gen
+
+    def upsert_binding_rule(self, rule) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            prev = self._binding_rules.get_latest(rule.id)
+            rule.create_index = prev.create_index if prev is not None else gen
+            rule.modify_index = gen
+            self._binding_rules.put(rule.id, rule, gen, live)
+            self._commit(gen, [("binding-rule-upsert", rule)])
+            return gen
+
+    def delete_binding_rule(self, rule_id: str) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            r = self._binding_rules.get_latest(rule_id)
+            self._binding_rules.delete(rule_id, gen, live)
+            self._commit(gen, [("binding-rule-delete", r)])
+            return gen
+
     def upsert_acl_token(self, token) -> int:
         with self._write_lock:
             gen, live = self._begin()
@@ -1281,6 +1337,24 @@ class StateStore:
                 self._acl_secret_idx.delete(tok.secret_id, gen, live)
             self._commit(gen, [("acl-token-delete", tok)])
             return gen
+
+    def gc_expired_acl_tokens(self, ts: float = None) -> int:
+        """Drop tokens past their expiration (reference core_sched.go
+        expiredACLTokenGC). `ts` rides the replicated command so
+        followers replaying the log agree on what was expired."""
+        ts = ts if ts is not None else time.time()
+        with self._write_lock:
+            dead = [t for _, t in self._acl_tokens.iterate(self._index)
+                    if getattr(t, "expiration_time", 0.0)
+                    and ts >= t.expiration_time]
+            if not dead:
+                return 0
+            gen, live = self._begin()
+            for t in dead:
+                self._acl_tokens.delete(t.accessor_id, gen, live)
+                self._acl_secret_idx.delete(t.secret_id, gen, live)
+            self._commit(gen, [("acl-token-delete", t) for t in dead])
+            return len(dead)
 
     # --- variables (reference nomad/state/state_store_variables.go) ---
 

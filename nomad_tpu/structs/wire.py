@@ -26,12 +26,13 @@ def _build_registry() -> None:
     from . import (alloc, constraint, deployment, evaluation, job, network,
                    node, operator, plan, resources, services, variables,
                    volumes)
+    from ..acl import auth as acl_auth
     from ..acl import policy as acl_policy
     from ..acl import tokens as acl_tokens
 
     for mod in (alloc, constraint, deployment, evaluation, job, network,
                 node, operator, plan, resources, services, variables,
-                volumes, acl_policy, acl_tokens):
+                volumes, acl_auth, acl_policy, acl_tokens):
         for name in dir(mod):
             obj = getattr(mod, name)
             if isinstance(obj, type) and dataclasses.is_dataclass(obj):

@@ -1,0 +1,151 @@
+"""ACL auth methods + SSO login (reference nomad/acl_endpoint.go Login,
+acl/ auth-method + binding-rule structs): JWT validation against method
+config, claim mapping, binding-rule evaluation, ephemeral tokens."""
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+
+import pytest
+
+from nomad_tpu.acl.auth import (AuthMethod, BindingRule, evaluate_binding_rules,
+                                interpolate_bind_name, selector_matches,
+                                verify_jwt)
+from nomad_tpu.core.server import Server, ServerConfig
+
+KEY = b"sso-test-secret"
+KEY_B64 = base64.b64encode(KEY).decode()
+
+
+def make_jwt(claims: dict, key: bytes = KEY) -> str:
+    def b64(obj):
+        return base64.urlsafe_b64encode(
+            json.dumps(obj, separators=(",", ":")).encode()
+        ).rstrip(b"=").decode()
+
+    head = b64({"alg": "HS256", "typ": "JWT"})
+    body = b64(claims)
+    sig = hmac.new(key, f"{head}.{body}".encode(), hashlib.sha256).digest()
+    return f"{head}.{body}." + \
+        base64.urlsafe_b64encode(sig).rstrip(b"=").decode()
+
+
+def method(**cfg) -> AuthMethod:
+    base = {"jwt_validation_keys": [KEY_B64]}
+    base.update(cfg)
+    return AuthMethod(name="oidc", config=base, max_token_ttl_s=60.0)
+
+
+class TestJwtValidation:
+    def test_valid_token(self):
+        claims = verify_jwt(make_jwt({"sub": "alice"}), method())
+        assert claims["sub"] == "alice"
+
+    def test_bad_signature(self):
+        with pytest.raises(PermissionError):
+            verify_jwt(make_jwt({"sub": "x"}, key=b"wrong"), method())
+
+    def test_expired(self):
+        with pytest.raises(PermissionError, match="expired"):
+            verify_jwt(make_jwt({"exp": time.time() - 10}), method())
+
+    def test_bound_issuer_and_audience(self):
+        m = method(bound_issuer="https://idp", bound_audiences=["nomad"])
+        tok = make_jwt({"iss": "https://idp", "aud": ["nomad", "other"]})
+        assert verify_jwt(tok, m)
+        with pytest.raises(PermissionError, match="issuer"):
+            verify_jwt(make_jwt({"iss": "evil", "aud": "nomad"}), m)
+        with pytest.raises(PermissionError, match="audience"):
+            verify_jwt(make_jwt({"iss": "https://idp", "aud": "zzz"}), m)
+
+
+class TestBindingRules:
+    def test_selector_and_interpolation(self):
+        assert selector_matches("", {})
+        assert selector_matches("team==infra", {"team": "infra"})
+        assert not selector_matches("team==infra", {"team": "web"})
+        assert selector_matches("team==infra and env!=prod",
+                                {"team": "infra", "env": "dev"})
+        assert interpolate_bind_name("eng-${team}", {"team": "x"}) == "eng-x"
+        assert interpolate_bind_name("eng-${nope}", {}) is None
+
+    def test_evaluate(self):
+        rules = [
+            BindingRule(id="1", selector="team==infra",
+                        bind_type="role", bind_name="ops-${team}"),
+            BindingRule(id="2", selector="admin==true",
+                        bind_type="management"),
+            BindingRule(id="3", bind_type="policy", bind_name="readonly"),
+        ]
+        mgmt, roles, pols = evaluate_binding_rules(
+            rules, {"team": "infra"})
+        assert not mgmt and roles == ["ops-infra"] and pols == ["readonly"]
+        mgmt, _, _ = evaluate_binding_rules(rules, {"admin": "true"})
+        assert mgmt
+
+
+class TestLoginEndToEnd:
+    def _server(self):
+        s = Server(ServerConfig(acl_enabled=True))
+        s.acl_bootstrap()
+        s.upsert_acl_policy("readers", json.dumps(
+            {"namespace": {"default": {"policy": "read"}}}))
+        s.upsert_acl_role("ops-infra", ["readers"])
+        s.upsert_auth_method({
+            "name": "oidc",
+            "max_token_ttl_s": 60.0,
+            "config": {"jwt_validation_keys": [KEY_B64],
+                       "claim_mappings": {"team": "team", "sub": "name"}}})
+        s.upsert_binding_rule({
+            "auth_method": "oidc", "selector": "team==infra",
+            "bind_type": "role", "bind_name": "ops-${team}"})
+        return s
+
+    def test_login_grants_bound_role(self):
+        s = self._server()
+        token = s.acl_login("oidc", make_jwt({"sub": "alice",
+                                              "team": "infra"}))
+        assert token.roles == ["ops-infra"]
+        assert token.expiration_time > time.time()
+        acl = s.resolve_token(token.secret_id)
+        assert acl.allow_namespace_operation("default", "read-job")
+        assert not acl.management
+
+    def test_login_rejected_without_matching_rule(self):
+        s = self._server()
+        with pytest.raises(PermissionError):
+            s.acl_login("oidc", make_jwt({"sub": "bob", "team": "web"}))
+
+    def test_login_rejects_bad_signature(self):
+        s = self._server()
+        with pytest.raises(PermissionError):
+            s.acl_login("oidc", make_jwt({"team": "infra"}, key=b"evil"))
+
+    def test_ephemeral_token_expires(self):
+        s = self._server()
+        m = s.store.snapshot().auth_method("oidc")
+        m2 = AuthMethod(name="oidc", max_token_ttl_s=0.1, config=m.config)
+        s.store.upsert_auth_method(m2)
+        token = s.acl_login("oidc", make_jwt({"team": "infra"}))
+        assert s.resolve_token(token.secret_id) is not None
+        time.sleep(0.15)
+        with pytest.raises(PermissionError, match="expired"):
+            s.resolve_token(token.secret_id)
+
+
+class TestExpiredTokenGC:
+    def test_gc_reaps_expired_login_tokens(self):
+        s = TestLoginEndToEnd()._server()
+        m = s.store.snapshot().auth_method("oidc")
+        m2 = AuthMethod(name="oidc", max_token_ttl_s=0.05, config=m.config)
+        s.store.upsert_auth_method(m2)
+        token = s.acl_login("oidc", make_jwt({"team": "infra"}))
+        time.sleep(0.1)
+        reaped = s.store.gc_expired_acl_tokens()
+        assert reaped == 1
+        snap = s.store.snapshot()
+        assert snap.acl_token_by_secret(token.secret_id) is None
+        # the bootstrap token (no expiry) survives
+        assert any(True for _ in snap.acl_tokens())
