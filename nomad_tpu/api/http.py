@@ -26,6 +26,36 @@ from .jobspec import _validate
 
 MAX_BLOCK_S = 30.0
 
+# /v1/agent/monitor may lower the framework logger level while streams
+# are attached; overlapping streams refcount the original level so the
+# LAST one restores it (a plain save/restore pair leaves the process
+# stuck at the lowest level after interleaved streams)
+_monitor_lock = threading.Lock()
+_monitor_state: Dict[int, list] = {}  # id(logger) -> [count, orig_level]
+
+
+def _monitor_level_push(logger, level: int) -> None:
+    import logging as _logging
+
+    with _monitor_lock:
+        st = _monitor_state.get(id(logger))
+        if st is None:
+            st = _monitor_state[id(logger)] = [0, logger.level]
+        st[0] += 1
+        if logger.level == _logging.NOTSET or logger.level > level:
+            logger.setLevel(level)
+
+
+def _monitor_level_pop(logger) -> None:
+    with _monitor_lock:
+        st = _monitor_state.get(id(logger))
+        if st is None:
+            return
+        st[0] -= 1
+        if st[0] <= 0:
+            logger.setLevel(st[1])
+            del _monitor_state[id(logger)]
+
 
 class HTTPAgent:
     """The agent HTTP server. Start with port=0 for an ephemeral port."""
@@ -109,6 +139,10 @@ class HTTPAgent:
                         if acl is not None and not acl.management:
                             return self._error(403, "Permission denied")
                         return agent._route_event_stream(self, q)
+                    if url.path == "/v1/agent/monitor":
+                        if acl is not None and not acl.allow_agent_read():
+                            return self._error(403, "Permission denied")
+                        return agent._route_monitor(self, q)
                     self._block(q)
                     agent._route_get(self, url.path, q, acl)
                 except PermissionError as e:
@@ -287,7 +321,13 @@ class HTTPAgent:
                  "roles": getattr(t, "roles", [])}
                 for t in snap.acl_tokens()])
         if path == "/v1/acl/auth-methods":
-            return h._reply(200, list(snap.auth_methods()))
+            # trimmed stubs: config carries the JWT validation keys,
+            # which must never leave the server (reference returns
+            # ACLAuthMethodStub for the list)
+            return h._reply(200, [
+                {"name": m.name, "type": m.type, "default": m.default,
+                 "max_token_ttl_s": m.max_token_ttl_s}
+                for m in snap.auth_methods()])
         if path == "/v1/acl/binding-rules":
             return h._reply(200, list(snap.binding_rules()))
         if path == "/v1/acl/roles":
@@ -512,12 +552,31 @@ class HTTPAgent:
         if path == "/v1/operator/scheduler/configuration":
             return h._reply(200, self.server.sched_config)
         if path == "/v1/metrics":
-            return h._reply(200, {
+            from ..core.metrics import REGISTRY, prometheus_text
+
+            metrics = {
                 "broker": self.server.broker.stats,
                 "plan": self.server.plan_applier.stats,
                 "plan_bad_nodes": self.server.plan_applier.bad_nodes.stats,
                 "heartbeats_active": self.server.heartbeats.active(),
-            })
+                # live gauges under the reference's metric names
+                # (operations/metrics-reference.mdx)
+                "nomad.broker.total_unacked":
+                    self.server.broker.unacked_count(),
+                "nomad.blocked_evals.total_blocked":
+                    self.server.blocked.blocked_count(),
+                **REGISTRY.dump(),
+            }
+            if q.get("format", [""])[0] == "prometheus":
+                body = prometheus_text(metrics).encode()
+                h.send_response(200)
+                h.send_header("Content-Type",
+                              "text/plain; version=0.0.4")
+                h.send_header("Content-Length", str(len(body)))
+                h.end_headers()
+                h.wfile.write(body)
+                return
+            return h._reply(200, metrics)
         h._error(404, f"no such route {path}")
 
     def _find_runner(self, alloc_id: str):
@@ -1028,6 +1087,82 @@ class HTTPAgent:
 
     # -- event stream (reference /v1/event/stream, nomad/stream/) --
 
+    @staticmethod
+    def _start_chunked(h, q: dict):
+        """Parse stream params BEFORE committing the response (a bad
+        `wait` must be a clean 400, not a second response injected onto
+        a committed chunked connection), then send the chunked headers.
+        -> (write_chunk, deadline)."""
+        try:
+            wait = min(float(q.get("wait", ["60"])[0] or 60), 600.0)
+        except ValueError:
+            h._error(400, "invalid wait")
+            return None, None
+        deadline = time.time() + wait
+        h.send_response(200)
+        h.send_header("Content-Type", "application/json")
+        h.send_header("Transfer-Encoding", "chunked")
+        h.end_headers()
+
+        def write_chunk(payload: bytes) -> None:
+            h.wfile.write(f"{len(payload):x}\r\n".encode()
+                          + payload + b"\r\n")
+            h.wfile.flush()
+
+        return write_chunk, deadline
+
+    def _route_monitor(self, h, q: dict) -> None:
+        """Live agent log streaming (reference `nomad monitor`,
+        command/agent/monitor/): attaches a handler to the framework
+        loggers and streams ndjson records until the wait expires."""
+        import logging
+        import queue as _queue
+
+        level = getattr(logging,
+                        q.get("log_level", ["info"])[0].upper(),
+                        logging.INFO)
+        buf: "_queue.Queue" = _queue.Queue(maxsize=1024)
+
+        class _H(logging.Handler):
+            def emit(self, record):
+                try:
+                    buf.put_nowait({
+                        "ts": record.created,
+                        "level": record.levelname,
+                        "name": record.name,
+                        "message": record.getMessage(),
+                    })
+                except _queue.Full:
+                    pass  # a slow consumer drops lines, never blocks
+
+        # attach BEFORE the headers go out: the client treats the 200
+        # as "subscribed" and may log-and-assert immediately
+        handler = _H(level=level)
+        logger = logging.getLogger("nomad_tpu")
+        _monitor_level_push(logger, level)
+        logger.addHandler(handler)
+        write_chunk, deadline = self._start_chunked(h, q)
+        if write_chunk is None:
+            logger.removeHandler(handler)
+            _monitor_level_pop(logger)
+            return
+        try:
+            while time.time() < deadline:
+                try:
+                    rec = buf.get(timeout=0.5)
+                except _queue.Empty:
+                    continue
+                write_chunk(json.dumps(rec).encode() + b"\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            logger.removeHandler(handler)
+            _monitor_level_pop(logger)
+            try:
+                write_chunk(b"")
+            except OSError:
+                pass
+
     def _route_event_stream(self, h, q: dict) -> None:
         """ndjson event stream with topic filters:
         ?topic=Node&topic=Job:job-id (reference event_endpoint.go)."""
@@ -1038,19 +1173,11 @@ class HTTPAgent:
             else:
                 topic, key = t, "*"
             topics.setdefault(topic, []).append(key)
+        write_chunk, deadline = self._start_chunked(h, q)
+        if write_chunk is None:
+            return
         sub = self.server.events.subscribe(topics or None)
-        h.send_response(200)
-        h.send_header("Content-Type", "application/json")
-        h.send_header("Transfer-Encoding", "chunked")
-        h.end_headers()
-
-        def write_chunk(payload: bytes) -> None:
-            h.wfile.write(f"{len(payload):x}\r\n".encode() + payload + b"\r\n")
-            h.wfile.flush()
-
         try:
-            deadline = time.time() + min(
-                float(q.get("wait", ["60"])[0] or 60), 600.0)
             while time.time() < deadline:
                 events = sub.next_events(timeout=0.5)
                 for e in events:

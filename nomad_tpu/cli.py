@@ -452,6 +452,26 @@ def cmd_service(args) -> int:
     return 0
 
 
+def cmd_monitor(args) -> int:
+    """Stream agent logs (reference command/monitor.go)."""
+    import urllib.request
+
+    url = (f"{args.address}/v1/agent/monitor?wait={args.wait}"
+           f"&log_level={args.log_level}")
+    with urllib.request.urlopen(url, timeout=args.wait + 30) as resp:
+        while True:
+            line = resp.readline()
+            if not line:
+                return 0
+            try:
+                rec = json.loads(line)
+                ts = time.strftime("%H:%M:%S", time.localtime(rec["ts"]))
+                print(f"{ts} [{rec['level']}] {rec['name']}: "
+                      f"{rec['message']}", flush=True)
+            except (ValueError, KeyError):
+                continue
+
+
 def cmd_acl(args) -> int:
     """ACL operations (reference command/acl_*.go): bootstrap, SSO
     login, auth methods, binding rules."""
@@ -791,6 +811,11 @@ def build_parser() -> argparse.ArgumentParser:
     oraft.add_argument("op", choices=["list-peers", "remove-peer"])
     oraft.add_argument("-peer-id", dest="peer_id", default="")
     oraft.set_defaults(fn=cmd_operator_raft)
+
+    mon = sub.add_parser("monitor")
+    mon.add_argument("-log-level", dest="log_level", default="info")
+    mon.add_argument("-wait", type=int, default=600)
+    mon.set_defaults(fn=cmd_monitor)
 
     aclp = sub.add_parser("acl").add_subparsers(dest="acl_cmd", required=True)
     ab = aclp.add_parser("bootstrap")

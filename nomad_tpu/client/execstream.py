@@ -127,28 +127,32 @@ class ExecSession:
                 return 0
 
     def close_stdin(self) -> None:
-        with self._cond:
-            if self.exited:
-                return
-            if self.tty:
-                # a pty has no half-close: EOT is how EOF reaches the
-                # foreground process. The fd may be nonblocking with a
-                # briefly-full input queue — retry a few times rather
-                # than silently dropping the EOF
-                if self._stdin_fd is not None:
-                    for _ in range(20):
+        # a pty has no half-close: EOT is how EOF reaches the foreground
+        # process. The fd may be nonblocking with a briefly-full input
+        # queue — retry, sleeping OUTSIDE the lock (holding it would
+        # stall the pump that drains the very output keeping the child
+        # from reading stdin)
+        for _ in range(20):
+            with self._cond:
+                if self.exited:
+                    return
+                if not self.tty:
+                    if self.proc.stdin is not None:
                         try:
-                            os.write(self._stdin_fd, b"\x04")
-                            break
-                        except BlockingIOError:
-                            time.sleep(0.05)
+                            self.proc.stdin.close()
                         except OSError:
-                            break
-            elif self.proc.stdin is not None:
+                            pass
+                    return
+                if self._stdin_fd is None:
+                    return
                 try:
-                    self.proc.stdin.close()
-                except OSError:
+                    os.write(self._stdin_fd, b"\x04")
+                    return
+                except BlockingIOError:
                     pass
+                except OSError:
+                    return
+            time.sleep(0.05)
 
     def read_output(self, offset: int, wait_s: float = 10.0):
         """-> (data, next_offset, exited, exit_code); long-polls until

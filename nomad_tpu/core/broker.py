@@ -95,6 +95,11 @@ class EvalBroker:
 
     # -- enqueue --
 
+    def unacked_count(self) -> int:
+        """Live gauge (reference nomad.broker.total_unacked)."""
+        with self._lock:
+            return len(self._unacked)
+
     def enqueue(self, ev: Evaluation) -> None:
         with self._lock:
             if not self._enabled:

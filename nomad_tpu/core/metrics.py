@@ -1,0 +1,106 @@
+"""Named metrics registry (reference go-metrics + the sink wiring in
+command/agent/command.go:1188-1297 and the inventory documented in
+operations/metrics-reference.mdx).
+
+Process-wide counters and timing samples under the reference's metric
+names (nomad.plan.evaluate, nomad.plan.submit, nomad.plan.node_rejected,
+nomad.worker.invoke_scheduler_<type>, nomad.broker.total_unacked, ...).
+Gauges are computed by the HTTP layer from live subsystems at serve
+time; this module holds what must accumulate between scrapes. Exposed as
+JSON on /v1/metrics and prometheus text exposition with
+?format=prometheus."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+
+class _Sample:
+    __slots__ = ("count", "total_s", "max_s")
+
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._samples: Dict[str, _Sample] = {}
+
+    def incr(self, name: str, n: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + n
+
+    def sample(self, name: str, seconds: float) -> None:
+        with self._lock:
+            s = self._samples.get(name)
+            if s is None:
+                s = self._samples[name] = _Sample()
+            s.count += 1
+            s.total_s += seconds
+            if seconds > s.max_s:
+                s.max_s = seconds
+
+    def time(self, name: str):
+        """Context manager: times the block into `name`."""
+        reg = self
+
+        class _Timer:
+            def __enter__(self):
+                self._t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                reg.sample(name, time.perf_counter() - self._t0)
+
+        return _Timer()
+
+    def dump(self) -> dict:
+        with self._lock:
+            out = dict(self._counters)
+            for name, s in self._samples.items():
+                out[name] = {"count": s.count,
+                             "mean_ms": (1000.0 * s.total_s / s.count
+                                         if s.count else 0.0),
+                             "max_ms": 1000.0 * s.max_s}
+            return out
+
+
+def prometheus_text(metrics: dict, prefix: str = "") -> str:
+    """Flatten a metrics dict into prometheus text exposition
+    (reference: the prometheus sink). Dots and dashes become
+    underscores; sample dicts expand to _count/_mean_ms/_max_ms."""
+    lines = []
+
+    def name_of(*parts) -> str:
+        raw = "_".join(p for p in parts if p)
+        return "".join(c if c.isalnum() or c == "_" else "_" for c in raw)
+
+    def walk(prefix_parts, value):
+        if isinstance(value, dict):
+            if set(value) == {"count", "mean_ms", "max_ms"}:
+                for k, v in value.items():
+                    n = name_of(*prefix_parts, k)
+                    lines.append(f"# TYPE {n} gauge")
+                    lines.append(f"{n} {float(v)}")
+                return
+            for k, v in value.items():
+                walk(prefix_parts + [str(k)], v)
+            return
+        if isinstance(value, bool):
+            value = int(value)
+        if isinstance(value, (int, float)):
+            n = name_of(*prefix_parts)
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {float(value)}")
+
+    walk([prefix] if prefix else [], metrics)
+    return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()

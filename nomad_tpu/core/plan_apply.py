@@ -322,7 +322,13 @@ class PlanApplier:
 
     # -- verify (parallel) --
 
-    def _verify(self, plan: Plan,
+    def _verify(self, plan, overlay=None):
+        from .metrics import REGISTRY
+
+        with REGISTRY.time("nomad.plan.evaluate"):
+            return self._verify_inner(plan, overlay)
+
+    def _verify_inner(self, plan: Plan,
                 overlay_results: Optional[List[PlanResult]] = None,
                 ) -> Tuple[PlanResult, List[str]]:
         # catch up to the snapshot the scheduler planned against
@@ -400,9 +406,13 @@ class PlanApplier:
             )
             result.alloc_index = index
 
+        from .metrics import REGISTRY
+
         self.stats["applied"] += 1
+        REGISTRY.incr("nomad.plan.submit")
         if rejected:
             self.stats["nodes_rejected"] += len(rejected)
+            REGISTRY.incr("nomad.plan.node_rejected", len(rejected))
             self.stats["partial_commits"] += 1
             result.refresh_index = self.store.latest_index
             result.rejected_nodes = rejected

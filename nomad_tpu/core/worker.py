@@ -74,7 +74,10 @@ class Worker:
                 logger=self.server.logger,
                 on_event=lambda e: self.server.events.publish(
                     "Scheduler", e.get("type", "scheduler-event"), e))
-            sched.process(ev)
+            from .metrics import REGISTRY
+
+            with REGISTRY.time(f"nomad.worker.invoke_scheduler_{ev.type}"):
+                sched.process(ev)
             self.server.broker.ack(ev.id, token)
             self.stats["processed"] += 1
         except Exception:

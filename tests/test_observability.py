@@ -1,0 +1,94 @@
+"""Observability surface (reference command/agent/command.go metric
+sinks + command/agent/monitor/): named metrics, prometheus exposition,
+live log streaming."""
+
+import json
+import logging
+import time
+import urllib.request
+
+from nomad_tpu import mock
+from nomad_tpu.api.http import HTTPAgent
+from nomad_tpu.core.metrics import Registry, prometheus_text
+from nomad_tpu.core.server import Server, ServerConfig
+
+
+class TestRegistry:
+    def test_counters_and_samples(self):
+        r = Registry()
+        r.incr("nomad.plan.node_rejected", 3)
+        with r.time("nomad.plan.evaluate"):
+            time.sleep(0.01)
+        d = r.dump()
+        assert d["nomad.plan.node_rejected"] == 3
+        assert d["nomad.plan.evaluate"]["count"] == 1
+        assert d["nomad.plan.evaluate"]["mean_ms"] >= 5
+
+    def test_prometheus_text(self):
+        text = prometheus_text({
+            "nomad.plan.submit": 7,
+            "broker": {"acked": 2},
+            "nomad.plan.evaluate": {"count": 1, "mean_ms": 2.5,
+                                    "max_ms": 2.5},
+        })
+        assert "nomad_plan_submit 7.0" in text
+        assert "broker_acked 2.0" in text
+        assert "nomad_plan_evaluate_count 1.0" in text
+
+
+class TestMetricsEndpoint:
+    def test_named_metrics_and_prometheus(self):
+        s = Server(ServerConfig(num_workers=1))
+        s.start()
+        agent = HTTPAgent(s, port=0).start()
+        try:
+            s.register_node(mock.node())
+            job = mock.job()
+            job.task_groups[0].count = 2
+            s.register_job(job)
+            s.wait_for_idle(15.0)
+            with urllib.request.urlopen(
+                    f"{agent.address}/v1/metrics", timeout=5) as r:
+                m = json.loads(r.read())
+            assert m["nomad.plan.submit"] >= 1
+            assert "nomad.worker.invoke_scheduler_service" in m
+            assert "nomad.broker.total_unacked" in m
+            assert "nomad.blocked_evals.total_blocked" in m
+            with urllib.request.urlopen(
+                    f"{agent.address}/v1/metrics?format=prometheus",
+                    timeout=5) as r:
+                text = r.read().decode()
+                assert r.headers["Content-Type"].startswith("text/plain")
+            assert "nomad_plan_submit" in text
+            assert "nomad_worker_invoke_scheduler_service_mean_ms" in text
+        finally:
+            agent.stop()
+            s.stop()
+
+
+class TestMonitorStream:
+    def test_streams_log_lines(self):
+        s = Server(ServerConfig(num_workers=1))
+        s.start()
+        agent = HTTPAgent(s, port=0).start()
+        try:
+            req = urllib.request.Request(
+                f"{agent.address}/v1/agent/monitor?wait=3&log_level=info")
+            resp = urllib.request.urlopen(req, timeout=10)
+            logging.getLogger("nomad_tpu.test").info("monitor-probe-%d", 42)
+            deadline = time.time() + 5
+            seen = b""
+            while time.time() < deadline and b"monitor-probe-42" not in seen:
+                chunk = resp.read(256)
+                if not chunk:
+                    break
+                seen += chunk
+            assert b"monitor-probe-42" in seen
+            line = [ln for ln in seen.split(b"\n")
+                    if b"monitor-probe-42" in ln][0]
+            rec = json.loads(line)
+            assert rec["level"] == "INFO"
+            assert rec["name"] == "nomad_tpu.test"
+        finally:
+            agent.stop()
+            s.stop()
