@@ -1,0 +1,72 @@
+#!/usr/bin/env python3
+"""Example external driver plugin: runs python snippets as tasks
+(config: {"code": "..."}). Demonstrates the plugin SDK — the agent
+launches this executable from --plugin-dir and the driver appears as
+"python-exec" beside the builtins (see nomad_tpu/plugins/)."""
+
+import subprocess
+import sys
+import threading
+import uuid
+
+from nomad_tpu.plugins.sdk import serve
+
+
+class PythonExecDriver:
+    name = "python-exec"
+
+    def __init__(self):
+        self._procs = {}
+        self._lock = threading.Lock()
+
+    def fingerprint(self):
+        return {"healthy": True,
+                "attributes": {"driver.python-exec.version": "1"}}
+
+    def start_task(self, task, env, task_dir, io=None):
+        code = (task.get("config") or {}).get("code", "")
+        proc = subprocess.Popen([sys.executable, "-c", code],
+                                cwd=task_dir or None,
+                                env=env or None,
+                                start_new_session=True)
+        handle = str(uuid.uuid4())
+        with self._lock:
+            self._procs[handle] = proc
+        return {"handle": handle}
+
+    def _get(self, handle):
+        with self._lock:
+            return self._procs.get(handle)
+
+    def wait_task(self, handle, timeout_s=5.0):
+        proc = self._get(handle)
+        if proc is None:
+            return {"done": True, "exit_code": 1, "err": "unknown handle"}
+        try:
+            code = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            return {"done": False}
+        if code < 0:
+            return {"done": True, "exit_code": 128 - code, "signal": -code}
+        return {"done": True, "exit_code": code, "signal": 0}
+
+    def kill_task(self, handle, grace_s=5.0):
+        proc = self._get(handle)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=grace_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        return {}
+
+    def is_running(self, handle):
+        proc = self._get(handle)
+        return {"running": proc is not None and proc.poll() is None}
+
+    def handle_data(self, handle):
+        return {"data": None}
+
+
+if __name__ == "__main__":
+    serve(PythonExecDriver())

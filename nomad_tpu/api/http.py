@@ -35,14 +35,14 @@ _monitor_state: Dict[int, list] = {}  # id(logger) -> [count, orig_level]
 
 
 def _monitor_level_push(logger, level: int) -> None:
-    import logging as _logging
-
     with _monitor_lock:
         st = _monitor_state.get(id(logger))
         if st is None:
             st = _monitor_state[id(logger)] = [0, logger.level]
         st[0] += 1
-        if logger.level == _logging.NOTSET or logger.level > level:
+        # only ever LOWER the effective level: a coarse monitor stream
+        # must not suppress the agent's own warnings
+        if logger.getEffectiveLevel() > level:
             logger.setLevel(level)
 
 
@@ -1173,10 +1173,14 @@ class HTTPAgent:
             else:
                 topic, key = t, "*"
             topics.setdefault(topic, []).append(key)
+        # subscribe BEFORE the headers commit: events published in the
+        # header-to-subscribe window must not be lost (same ordering the
+        # monitor route uses for its log handler)
+        sub = self.server.events.subscribe(topics or None)
         write_chunk, deadline = self._start_chunked(h, q)
         if write_chunk is None:
+            sub.close()
             return
-        sub = self.server.events.subscribe(topics or None)
         try:
             while time.time() < deadline:
                 events = sub.next_events(timeout=0.5)

@@ -102,7 +102,8 @@ def cmd_agent(args) -> int:
     for i in range(args.clients):
         c = Client(endpoint, ClientConfig(
             data_dir=os.path.join(args.data_dir, f"client{i}")
-            if args.data_dir else ""))
+            if args.data_dir else "",
+            plugin_dir=getattr(args, "plugin_dir", "")))
         c.start()
         clients.append(c)
     http_agent.clients = clients  # serve /v1/client/* for local clients
@@ -454,22 +455,30 @@ def cmd_service(args) -> int:
 
 def cmd_monitor(args) -> int:
     """Stream agent logs (reference command/monitor.go)."""
+    import urllib.error
     import urllib.request
 
     url = (f"{args.address}/v1/agent/monitor?wait={args.wait}"
            f"&log_level={args.log_level}")
-    with urllib.request.urlopen(url, timeout=args.wait + 30) as resp:
-        while True:
-            line = resp.readline()
-            if not line:
-                return 0
-            try:
-                rec = json.loads(line)
-                ts = time.strftime("%H:%M:%S", time.localtime(rec["ts"]))
-                print(f"{ts} [{rec['level']}] {rec['name']}: "
-                      f"{rec['message']}", flush=True)
-            except (ValueError, KeyError):
-                continue
+    try:
+        with urllib.request.urlopen(url, timeout=args.wait + 30) as resp:
+            while True:
+                line = resp.readline()
+                if not line:
+                    return 0
+                try:
+                    rec = json.loads(line)
+                    ts = time.strftime("%H:%M:%S",
+                                       time.localtime(rec["ts"]))
+                    print(f"{ts} [{rec['level']}] {rec['name']}: "
+                          f"{rec['message']}", flush=True)
+                except (ValueError, KeyError):
+                    continue
+    except KeyboardInterrupt:
+        return 0
+    except urllib.error.URLError as e:
+        print(f"monitor failed: {e}", file=sys.stderr)
+        return 1
 
 
 def cmd_acl(args) -> int:
@@ -655,6 +664,8 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("--port", type=int, default=4646)
     ag.add_argument("--algorithm", default="binpack")
     ag.add_argument("--data-dir", default="")
+    ag.add_argument("--plugin-dir", default="",
+                    help="directory of external driver plugin executables")
     ag.add_argument("--server-id", default="server-0",
                     help="this server's id in a multi-server cluster")
     ag.add_argument("--peers", default="",

@@ -39,6 +39,8 @@ class ClientConfig:
     watch_interval: float = 0.1
     # periodic re-fingerprint (reference fingerprint_manager periodics)
     fingerprint_interval: float = 60.0
+    # external driver plugins (reference plugin_dir, plugins/serve.go)
+    plugin_dir: str = ""
     # host stats sampling (reference client/hoststats)
     hoststats_interval: float = 10.0
 
@@ -50,6 +52,14 @@ class Client:
         self.config = config or ClientConfig()
         if not self.config.data_dir:
             self.config.data_dir = tempfile.mkdtemp(prefix="nomad_tpu_client_")
+        # external driver plugins register BEFORE fingerprinting so their
+        # drivers land in the node attributes (reference: driver
+        # fingerprint channels feed the node registration)
+        self.plugins = None
+        if self.config.plugin_dir:
+            from ..plugins import PluginManager
+
+            self.plugins = PluginManager.shared(self.config.plugin_dir)
         self.node = node or fingerprint(datacenter=self.config.datacenter,
                                         node_class=self.config.node_class,
                                         data_dir=self.config.data_dir)
@@ -110,6 +120,9 @@ class Client:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.plugins is not None:
+            self.plugins.release()
+            self.plugins = None
         self.hoststats.stop()
         for t in self._threads:
             t.join(timeout=2.0)

@@ -26,6 +26,21 @@ class _Sample:
         self.max_s = 0.0
 
 
+class _Timer:
+    __slots__ = ("_reg", "_name", "_t0")
+
+    def __init__(self, reg, name):
+        self._reg = reg
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._reg.sample(self._name, time.perf_counter() - self._t0)
+
+
 class Registry:
     def __init__(self):
         self._lock = threading.Lock()
@@ -46,19 +61,9 @@ class Registry:
             if seconds > s.max_s:
                 s.max_s = seconds
 
-    def time(self, name: str):
+    def time(self, name: str) -> "_Timer":
         """Context manager: times the block into `name`."""
-        reg = self
-
-        class _Timer:
-            def __enter__(self):
-                self._t0 = time.perf_counter()
-                return self
-
-            def __exit__(self, *exc):
-                reg.sample(name, time.perf_counter() - self._t0)
-
-        return _Timer()
+        return _Timer(self, name)
 
     def dump(self) -> dict:
         with self._lock:

@@ -1,0 +1,17 @@
+"""External plugin framework (reference plugins/: base/drivers served
+over hashicorp/go-plugin gRPC subprocesses, plugins/serve.go).
+
+Task drivers can live OUTSIDE the agent binary: an executable in the
+agent's --plugin-dir is launched as a subprocess, handshakes over
+stdout, and serves the driver protocol over a unix socket. The agent
+registers it beside the builtin drivers; tasks using it run in the
+PLUGIN's process tree, and the plugin dying marks the driver unhealthy
+until the manager relaunches it.
+
+- protocol.py — framing + the method surface (fingerprint/start/wait/...)
+- sdk.py      — `serve(driver)` for plugin authors
+- manager.py  — agent-side discovery, launch, proxy driver, restart
+"""
+
+from .manager import PluginManager  # noqa: F401
+from .sdk import serve  # noqa: F401

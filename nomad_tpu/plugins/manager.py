@@ -1,0 +1,322 @@
+"""Agent-side plugin manager (reference
+client/pluginmanager/drivermanager + go-plugin's client side).
+
+Discovers executables in the plugin dir, launches each as a subprocess,
+handshakes, and registers an ExternalDriver proxy beside the builtin
+drivers. A plugin process dying flips its driver unhealthy; the
+manager relaunches it with backoff (reference drivermanager
+instance loops)."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..client.drivers import ExitResult, TaskHandle, register_driver
+from .protocol import SOCKET_ENV, recv_frame, send_frame
+
+HANDSHAKE_TIMEOUT = 15.0
+RESTART_BACKOFF = 2.0
+
+
+class PluginError(Exception):
+    pass
+
+
+class _Conn:
+    """One framed request/response connection to the plugin."""
+
+    def __init__(self, path: str):
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.connect(path)
+        self._lock = threading.Lock()
+        self._next_id = 0
+
+    def call(self, method: str, timeout: float = 30.0, **args):
+        try:
+            with self._lock:
+                self._next_id += 1
+                rid = self._next_id
+                self._sock.settimeout(timeout)
+                send_frame(self._sock, {"id": rid, "method": method,
+                                        "args": args})
+                reply = recv_frame(self._sock)
+        except OSError as e:
+            # every transport failure surfaces as PluginError — callers
+            # treat that as "driver unavailable", never a crash
+            raise PluginError(f"plugin connection failed during "
+                              f"{method}: {e}") from e
+        if reply is None:
+            raise PluginError(f"plugin closed during {method}")
+        if reply.get("error"):
+            raise PluginError(reply["error"])
+        return reply.get("result")
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _ExternalHandle(TaskHandle):
+    def __init__(self, plugin: "PluginInstance", handle):
+        self._plugin = plugin
+        self._handle = handle
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[ExitResult]:
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            step = 5.0 if deadline is None else min(5.0, deadline - time.time())
+            if step <= 0:
+                return None
+            try:
+                out = self._plugin.call("wait_task", timeout=step + 5.0,
+                                        handle=self._handle, timeout_s=step)
+            except PluginError:
+                return ExitResult(exit_code=1,
+                                  err="driver plugin died while waiting")
+            if out and out.get("done"):
+                return ExitResult(
+                    exit_code=int(out.get("exit_code", 0)),
+                    signal=int(out.get("signal", 0)),
+                    oom_killed=bool(out.get("oom_killed", False)),
+                    err=out.get("err", ""))
+            if deadline is not None and time.time() >= deadline:
+                return None
+
+    def kill(self, grace_s: float = 5.0) -> None:
+        try:
+            self._plugin.call("kill_task", timeout=grace_s + 10.0,
+                              handle=self._handle, grace_s=grace_s)
+        except PluginError:
+            pass
+
+    def is_running(self) -> bool:
+        try:
+            out = self._plugin.call("is_running", handle=self._handle)
+            return bool(out and out.get("running"))
+        except PluginError:
+            return False
+
+    def handle_data(self):
+        try:
+            out = self._plugin.call("handle_data", handle=self._handle)
+            return out.get("data") if out else None
+        except PluginError:
+            return None
+
+
+class ExternalDriver:
+    """The in-agent proxy registered in the driver registry."""
+
+    ENFORCE_RESOURCES = False  # enforcement is the plugin's business
+
+    def __init__(self, plugin: "PluginInstance"):
+        self.plugin = plugin
+        self.name = plugin.name
+
+    def healthy(self) -> bool:
+        return self.plugin.alive()
+
+    def fingerprint(self) -> dict:
+        try:
+            return self.plugin.call("fingerprint") or {}
+        except PluginError:
+            return {"healthy": False, "attributes": {}}
+
+    def start_task(self, task, env, task_dir: str, io=None) -> TaskHandle:
+        from ..client.drivers import DriverError
+
+        try:
+            out = self.plugin.call("start_task", timeout=60.0, task={
+                "name": task.name, "driver": task.driver,
+                "config": task.config or {},
+                "kill_timeout_s": task.kill_timeout_s,
+            }, env=dict(env or {}), task_dir=task_dir, io=None)
+        except PluginError as e:
+            raise DriverError(str(e)) from e
+        return _ExternalHandle(self.plugin, out.get("handle"))
+
+    def recover_task(self, data) -> Optional[TaskHandle]:
+        try:
+            out = self.plugin.call("recover_task", data=data)
+        except PluginError:
+            return None
+        if out and out.get("handle") is not None:
+            return _ExternalHandle(self.plugin, out["handle"])
+        return None
+
+
+class PluginInstance:
+    """One managed plugin subprocess."""
+
+    def __init__(self, path: str, logger=None):
+        self.path = path
+        self.name = ""
+        self.logger = logger
+        self._proc: Optional[subprocess.Popen] = None
+        self._conn: Optional[_Conn] = None
+        self._sock_path = ""
+        self._lock = threading.Lock()
+
+    def launch(self) -> None:
+        sock_dir = tempfile.mkdtemp(prefix="nomadtpu-plugin-")
+        self._sock_path = os.path.join(sock_dir, "plugin.sock")
+        env = dict(os.environ, **{SOCKET_ENV: self._sock_path})
+        argv = [self.path]
+        if self.path.endswith(".py"):
+            argv = [sys.executable, self.path]
+            # SDK plugins import nomad_tpu.plugins.sdk; make the package
+            # importable regardless of where the plugin file lives
+            pkg_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            env["PYTHONPATH"] = (pkg_root + os.pathsep
+                                 + env.get("PYTHONPATH", "")).rstrip(os.pathsep)
+        self._proc = subprocess.Popen(
+            argv, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, start_new_session=True)
+        deadline = time.time() + HANDSHAKE_TIMEOUT
+        line = b""
+        while time.time() < deadline:
+            line = self._proc.stdout.readline()
+            break
+        try:
+            hello = json.loads(line or b"{}")
+        except ValueError:
+            hello = {}
+        if hello.get("type") != "driver" or not hello.get("name"):
+            self.stop()
+            raise PluginError(
+                f"{self.path}: bad plugin handshake {line!r}")
+        self.name = hello["name"]
+        # the socket may land a beat after the handshake line
+        deadline = time.time() + HANDSHAKE_TIMEOUT
+        while not os.path.exists(self._sock_path):
+            if time.time() >= deadline:
+                self.stop()
+                raise PluginError(f"{self.path}: socket never appeared")
+            time.sleep(0.05)
+        with self._lock:
+            self._conn = _Conn(self._sock_path)
+
+    def call(self, method: str, timeout: float = 30.0, **args):
+        with self._lock:
+            conn = self._conn
+        if conn is None:
+            raise PluginError(f"plugin {self.name or self.path} not running")
+        return conn.call(method, timeout=timeout, **args)
+
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+
+
+class PluginManager:
+    """Discover + launch + register + supervise external driver
+    plugins.
+
+    One manager per plugin_dir PER PROCESS: the driver registry is
+    process-global, so two managers over the same dir would launch
+    duplicate subprocesses and clobber each other's registrations.
+    Use PluginManager.shared()/release() (the Client does) — the last
+    release stops the subprocesses."""
+
+    _shared: Dict[str, "PluginManager"] = {}
+    _shared_lock = threading.Lock()
+
+    @classmethod
+    def shared(cls, plugin_dir: str, logger=None) -> "PluginManager":
+        key = os.path.abspath(plugin_dir)
+        with cls._shared_lock:
+            pm = cls._shared.get(key)
+            if pm is None:
+                pm = cls._shared[key] = cls(plugin_dir, logger=logger)
+                pm.start()
+            pm._refs += 1
+            return pm
+
+    def release(self) -> None:
+        with PluginManager._shared_lock:
+            self._refs -= 1
+            if self._refs > 0:
+                return
+            PluginManager._shared.pop(os.path.abspath(self.plugin_dir),
+                                      None)
+        self.stop()
+
+    def __init__(self, plugin_dir: str, logger=None):
+        self.plugin_dir = plugin_dir
+        self.logger = logger
+        self.instances: List[PluginInstance] = []
+        self._refs = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> List[str]:
+        """Launch every plugin; returns the registered driver names."""
+        names = []
+        if not self.plugin_dir or not os.path.isdir(self.plugin_dir):
+            return names
+        for entry in sorted(os.listdir(self.plugin_dir)):
+            path = os.path.join(self.plugin_dir, entry)
+            if not os.path.isfile(path) or not os.access(path, os.X_OK):
+                continue
+            inst = PluginInstance(path, logger=self.logger)
+            try:
+                inst.launch()
+            except PluginError:
+                if self.logger:
+                    self.logger.exception("plugin %s failed to launch", path)
+                continue
+            self.instances.append(inst)
+            register_driver(ExternalDriver(inst))
+            names.append(inst.name)
+        if self.instances:
+            self._thread = threading.Thread(target=self._supervise,
+                                            daemon=True,
+                                            name="plugin-manager")
+            self._thread.start()
+        return names
+
+    def _supervise(self) -> None:
+        """Relaunch dead plugins with backoff (reference drivermanager
+        instance restart loops). The registry proxy keeps its identity:
+        re-registering swaps the PluginInstance under the same name."""
+        while not self._stop.wait(RESTART_BACKOFF):
+            for inst in self.instances:
+                if inst.alive():
+                    continue
+                try:
+                    inst.stop()
+                    inst.launch()
+                    register_driver(ExternalDriver(inst))
+                    if self.logger:
+                        self.logger.info("plugin %s relaunched", inst.name)
+                except PluginError:
+                    continue
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        for inst in self.instances:
+            inst.stop()

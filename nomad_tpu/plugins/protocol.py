@@ -1,0 +1,56 @@
+"""Plugin wire protocol (reference plugins/base + go-plugin handshake).
+
+Handshake: the agent launches the plugin executable with
+NOMAD_PLUGIN_SOCKET set to a unix-socket path; the plugin binds it,
+then prints ONE JSON line on stdout:
+
+    {"proto": 1, "type": "driver", "name": "<driver name>"}
+
+and serves length-prefixed JSON frames on the socket:
+
+    request:  {"id": n, "method": "...", "args": {...}}
+    response: {"id": n, "result": ...} | {"id": n, "error": "..."}
+
+Methods (the DriverPlugin surface, reference plugins/drivers/driver.go):
+    fingerprint() -> {"healthy": bool, "attributes": {...}}
+    start_task(task, env, task_dir, io) -> {"handle": opaque}
+    wait_task(handle, timeout) -> {"done": bool, exit_code, signal,
+                                   oom_killed, err}
+    kill_task(handle, grace_s) -> {}
+    is_running(handle) -> {"running": bool}
+    recover_task(data) -> {"handle": opaque} | {"handle": null}
+    handle_data(handle) -> {"data": {...}|null}
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+
+PROTO_VERSION = 1
+SOCKET_ENV = "NOMAD_PLUGIN_SOCKET"
+
+
+def send_frame(sock: socket.socket, payload: dict) -> None:
+    data = json.dumps(payload).encode()
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def recv_frame(sock: socket.socket):
+    head = b""
+    while len(head) < 4:
+        chunk = sock.recv(4 - len(head))
+        if not chunk:
+            return None
+        head += chunk
+    (length,) = struct.unpack(">I", head)
+    if length > 64 * 1024 * 1024:
+        raise ValueError(f"plugin frame too large: {length}")
+    body = b""
+    while len(body) < length:
+        chunk = sock.recv(length - len(body))
+        if not chunk:
+            return None
+        body += chunk
+    return json.loads(body)

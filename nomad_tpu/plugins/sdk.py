@@ -1,0 +1,83 @@
+"""Plugin author SDK (reference plugins/serve.go Serve()).
+
+A driver plugin is a Python executable:
+
+    from nomad_tpu.plugins.sdk import serve
+
+    class MyDriver:
+        name = "mydriver"
+        def fingerprint(self): return {"healthy": True, "attributes": {}}
+        def start_task(self, task, env, task_dir, io): -> handle token
+        def wait_task(self, handle, timeout): -> result dict or None
+        def kill_task(self, handle, grace_s): ...
+        def is_running(self, handle): -> bool
+        # optional: recover_task(data) -> handle|None,
+        #           handle_data(handle) -> dict|None
+
+    if __name__ == "__main__":
+        serve(MyDriver())
+
+serve() binds the unix socket the agent passed in NOMAD_PLUGIN_SOCKET,
+announces itself on stdout, and dispatches protocol frames to the
+driver object until the agent disconnects."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+
+from .protocol import PROTO_VERSION, SOCKET_ENV, recv_frame, send_frame
+
+
+def serve(driver) -> None:
+    path = os.environ.get(SOCKET_ENV, "")
+    if not path:
+        print(f"{SOCKET_ENV} not set; this executable is a plugin and "
+              "must be launched by the agent", file=sys.stderr)
+        raise SystemExit(2)
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+    srv.bind(path)
+    srv.listen(4)
+    # the handshake line: the agent reads exactly one stdout line
+    sys.stdout.write(json.dumps({"proto": PROTO_VERSION, "type": "driver",
+                                 "name": driver.name}) + "\n")
+    sys.stdout.flush()
+
+    def handle_conn(conn: socket.socket) -> None:
+        try:
+            while True:
+                req = recv_frame(conn)
+                if req is None:
+                    return
+                rid = req.get("id")
+                method = req.get("method", "")
+                args = req.get("args") or {}
+                fn = getattr(driver, method, None)
+                if fn is None or method.startswith("_"):
+                    send_frame(conn, {"id": rid,
+                                      "error": f"no method {method!r}"})
+                    continue
+                try:
+                    send_frame(conn, {"id": rid, "result": fn(**args)})
+                except Exception as e:  # surface, don't kill the plugin
+                    send_frame(conn, {"id": rid,
+                                      "error": f"{type(e).__name__}: {e}"})
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    while True:
+        try:
+            conn, _ = srv.accept()
+        except OSError:
+            return
+        t = threading.Thread(target=handle_conn, args=(conn,), daemon=True)
+        t.start()

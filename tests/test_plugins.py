@@ -1,0 +1,109 @@
+"""External driver plugin boundary (reference plugins/serve.go +
+client/pluginmanager/drivermanager): subprocess plugins handshake over
+stdout, serve the driver protocol on a unix socket, register beside
+builtins, and survive through the full client task path."""
+
+import os
+import shutil
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.client.drivers import _BUILTIN, get_driver
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.plugins.manager import PluginManager
+
+EXAMPLE = os.path.join(os.path.dirname(__file__), "..",
+                       "examples", "plugins", "python_exec.py")
+
+
+@pytest.fixture
+def plugin_dir(tmp_path):
+    d = tmp_path / "plugins"
+    d.mkdir()
+    dst = d / "python_exec.py"
+    shutil.copy(EXAMPLE, dst)
+    os.chmod(dst, 0o755)
+    # isolate the global registry across tests
+    before = dict(_BUILTIN)
+    yield str(d)
+    _BUILTIN.clear()
+    _BUILTIN.update(before)
+
+
+def wait_until(fn, timeout=20.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(interval)
+    return None
+
+
+class TestPluginManager:
+    def test_launch_register_run(self, plugin_dir, tmp_path):
+        pm = PluginManager(plugin_dir)
+        try:
+            names = pm.start()
+            assert names == ["python-exec"]
+            drv = get_driver("python-exec")
+            assert drv.healthy()
+            fp = drv.fingerprint()
+            assert fp["attributes"]["driver.python-exec.version"] == "1"
+
+            from nomad_tpu.structs import Task
+
+            t = Task(name="t", driver="python-exec",
+                     config={"code": "print('hi'); raise SystemExit(4)"})
+            h = drv.start_task(t, {}, str(tmp_path))
+            res = h.wait(timeout=15.0)
+            assert res is not None and res.exit_code == 4
+        finally:
+            pm.stop()
+
+    def test_dead_plugin_relaunches(self, plugin_dir):
+        pm = PluginManager(plugin_dir)
+        try:
+            pm.start()
+            inst = pm.instances[0]
+            inst._proc.kill()
+            assert wait_until(lambda: inst.alive(), timeout=15.0)
+            drv = get_driver("python-exec")
+            assert wait_until(lambda: drv.fingerprint().get("healthy"),
+                              timeout=15.0)
+        finally:
+            pm.stop()
+
+
+class TestPluginE2E:
+    def test_plugin_task_through_scheduler(self, plugin_dir, tmp_path):
+        s = Server(ServerConfig(num_workers=1))
+        s.start()
+        c = Client(s, ClientConfig(data_dir=str(tmp_path / "c"),
+                                   plugin_dir=plugin_dir))
+        c.start()
+        try:
+            # the plugin driver made it into the node fingerprint
+            node = s.store.snapshot().node_by_id(c.node.id)
+            assert node.attributes.get("driver.python-exec") == "1"
+
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.tasks[0].driver = "python-exec"
+            tg.tasks[0].config = {
+                "code": "import time; time.sleep(0.2)"}
+            s.register_job(job)
+            done = wait_until(lambda: any(
+                a.client_status == "complete"
+                for a in s.store.snapshot().allocs_by_job(job.id)),
+                timeout=60.0)
+            assert done, [
+                (a.client_status, a.task_states)
+                for a in s.store.snapshot().allocs_by_job(job.id)]
+        finally:
+            c.stop()
+            s.stop()
