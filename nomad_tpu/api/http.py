@@ -128,10 +128,88 @@ class HTTPAgent:
                     return DENY_ALL_ACL
                 return acl
 
+            def _maybe_forward_region(self, method, path, q, body=None):
+                """?region=X for a foreign region proxies the request to
+                that region's agent (reference nomad/rpc.go forwardRegion;
+                ours rides the HTTP surface). -> True when handled."""
+                region = q.get("region", [""])[0]
+                if not region or region == agent.server.config.region:
+                    return False
+                addr = agent.server.region_address(region)
+                if addr is None:
+                    self._error(404, f"unknown region {region!r}")
+                    return True
+                from urllib.parse import urlencode
+                import urllib.error
+                import urllib.request as _rq
+
+                fq = {k: v[0] for k, v in q.items() if k != "region"}
+                url = f"{addr}{path}"
+                if fq:
+                    url += "?" + urlencode(fq)
+                headers = {"Content-Type": "application/json"}
+                tok = self.headers.get("X-Nomad-Token", "")
+                if tok:
+                    headers["X-Nomad-Token"] = tok
+                req = _rq.Request(
+                    url, method=method,
+                    data=json.dumps(body).encode() if body is not None
+                    else None,
+                    headers=headers)
+                # the timeout must outlast a forwarded blocking query or
+                # stream wait, or healthy long-polls turn into 502s
+                try:
+                    wait = min(float(fq.get("wait", 60) or 60), 600.0)
+                except ValueError:
+                    wait = 60.0
+                try:
+                    with _rq.urlopen(req, timeout=wait + 30.0) as resp:
+                        self.send_response(resp.status)
+                        self.send_header("Content-Type", "application/json")
+                        idx = resp.headers.get("X-Nomad-Index")
+                        if idx:
+                            # blocking-query clients park on this
+                            self.send_header("X-Nomad-Index", idx)
+                        length = resp.headers.get("Content-Length")
+                        if length is not None:
+                            self.send_header("Content-Length", length)
+                            self.end_headers()
+                            self.wfile.write(resp.read())
+                        else:
+                            # streaming upstream (event stream/monitor):
+                            # relay chunks as they arrive
+                            self.send_header("Transfer-Encoding", "chunked")
+                            self.end_headers()
+                            while True:
+                                chunk = resp.read(65536)
+                                if not chunk:
+                                    break
+                                self.wfile.write(
+                                    f"{len(chunk):x}\r\n".encode()
+                                    + chunk + b"\r\n")
+                                self.wfile.flush()
+                            self.wfile.write(b"0\r\n\r\n")
+                except urllib.error.HTTPError as e:
+                    data = e.read()
+                    self.send_response(e.code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except OSError as e:
+                    try:
+                        self._error(502,
+                                    f"region {region!r} unreachable: {e}")
+                    except OSError:
+                        pass  # response already partially committed
+                return True
+
             def do_GET(self):
                 try:
                     url = urlparse(self.path)
                     q = parse_qs(url.query)
+                    if self._maybe_forward_region("GET", url.path, q):
+                        return
                     acl = self._acl()
                     if url.path == "/v1/event/stream":
                         # the stream carries payloads from every
@@ -153,8 +231,12 @@ class HTTPAgent:
             def do_POST(self):
                 try:
                     url = urlparse(self.path)
-                    agent._route_post(self, url.path, parse_qs(url.query),
-                                      self._body(), self._acl())
+                    q = parse_qs(url.query)
+                    body = self._body()
+                    if self._maybe_forward_region("POST", url.path, q,
+                                                  body):
+                        return
+                    agent._route_post(self, url.path, q, body, self._acl())
                 except PermissionError as e:
                     self._error(403, str(e))
                 except Exception as e:
@@ -165,8 +247,10 @@ class HTTPAgent:
             def do_DELETE(self):
                 try:
                     url = urlparse(self.path)
-                    agent._route_delete(self, url.path, parse_qs(url.query),
-                                        self._acl())
+                    q = parse_qs(url.query)
+                    if self._maybe_forward_region("DELETE", url.path, q):
+                        return
+                    agent._route_delete(self, url.path, q, self._acl())
                 except PermissionError as e:
                     self._error(403, str(e))
                 except Exception as e:
@@ -268,6 +352,17 @@ class HTTPAgent:
             if pool is None:
                 return h._error(404, "node pool not found")
             return h._reply(200, pool)
+        if path == "/v1/regions":
+            # known region names, own region first (reference
+            # /v1/regions via serf WAN members)
+            names = [self.server.config.region]
+            names += sorted(r.name for r in snap.regions()
+                            if r.name != self.server.config.region)
+            return h._reply(200, names)
+        if path == "/v1/operator/regions":
+            return h._reply(200, [
+                {"name": r.name, "address": r.address}
+                for r in snap.regions()])
         if path == "/v1/services":
             # service catalog summary (reference
             # /v1/services ServiceRegistrationListRPC)
@@ -956,6 +1051,14 @@ class HTTPAgent:
                 sess.close_stdin()
             return h._reply(200, {"written": written,
                                   "exited": sess.exited})
+        if m := re.fullmatch(r"/v1/operator/region/([^/]+)", path):
+            try:
+                self.writer.upsert_region({"name": m.group(1),
+                                           "address": (body or {}).get(
+                                               "address", "")})
+            except ValueError as e:
+                return h._error(400, str(e))
+            return h._reply(200, {"ok": True})
         if path == "/v1/agent/join":
             # tell this RUNNING agent to join an existing cluster
             # (reference `nomad server join` -> /v1/agent/join, gated
@@ -1058,6 +1161,11 @@ class HTTPAgent:
             if acl is not None and not acl.management:
                 return h._error(403, "Permission denied")
             self.writer.delete_auth_method(m.group(1))
+            return h._reply(200, {"ok": True})
+        if m := re.fullmatch(r"/v1/operator/region/([^/]+)", path):
+            if acl is not None and not acl.allow_operator_write():
+                return h._error(403, "Permission denied")
+            self.writer.delete_region(m.group(1))
             return h._reply(200, {"ok": True})
         if m := re.fullmatch(r"/v1/acl/binding-rule/([^/]+)", path):
             if acl is not None and not acl.management:

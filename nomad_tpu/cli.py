@@ -63,6 +63,8 @@ def cmd_agent(args) -> int:
 
     cfg = ServerConfig(
         num_workers=args.workers,
+        region=getattr(args, "region", "global"),
+        authoritative_region=getattr(args, "authoritative_region", ""),
         sched_config=SchedulerConfiguration(scheduler_algorithm=args.algorithm))
 
     replicated = transport = None
@@ -536,6 +538,23 @@ def cmd_operator_raft(args) -> int:
     return 0
 
 
+def cmd_region(args) -> int:
+    """Federated regions (reference command/regions.go + operator)."""
+    api = _client(args)
+    if args.op == "list":
+        for name in api.get("/v1/regions")[0]:
+            print(name)
+        return 0
+    if args.op == "delete":
+        api._request("DELETE", f"/v1/operator/region/{args.name}")
+        print(f"region {args.name} deleted")
+        return 0
+    api._request("POST", f"/v1/operator/region/{args.name}",
+                 {"address": args.region_address})
+    print(f"region {args.name} -> {args.region_address}")
+    return 0
+
+
 def cmd_server_join(args) -> int:
     """Tell the local agent's server to join a cluster (reference
     command/server_join.go)."""
@@ -664,6 +683,10 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("--port", type=int, default=4646)
     ag.add_argument("--algorithm", default="binpack")
     ag.add_argument("--data-dir", default="")
+    ag.add_argument("--region", default="global",
+                    help="this cluster's federation region name")
+    ag.add_argument("--authoritative-region", dest="authoritative_region",
+                    default="", help="region to replicate ACL metadata from")
     ag.add_argument("--plugin-dir", default="",
                     help="directory of external driver plugin executables")
     ag.add_argument("--server-id", default="server-0",
@@ -848,6 +871,14 @@ def build_parser() -> argparse.ArgumentParser:
     svc.add_argument("op", choices=["list", "info"])
     svc.add_argument("name", nargs="?", default="")
     svc.set_defaults(fn=cmd_service)
+
+    reg = sub.add_parser("region")
+    reg.add_argument("op", choices=["list", "apply", "delete"])
+    reg.add_argument("name", nargs="?", default="")
+    # dest must NOT collide with the global --address (the agent to
+    # talk to) or apply would target the region being registered
+    reg.add_argument("-region-address", dest="region_address", default="")
+    reg.set_defaults(fn=cmd_region)
 
     server = sub.add_parser("server").add_subparsers(dest="server_cmd",
                                                      required=True)

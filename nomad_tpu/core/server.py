@@ -61,6 +61,12 @@ class ServerConfig:
     plan_rejection_window: float = 300.0
     gc_interval: float = 60.0
     acl_enabled: bool = False
+    # multi-region federation (reference nomad/rpc.go region forwarding
+    # + leader.go replication loops)
+    region: str = "global"
+    authoritative_region: str = ""
+    acl_replication_interval: float = 30.0
+    replication_token: str = ""
     sched_config: SchedulerConfiguration = field(default_factory=SchedulerConfiguration)
 
 
@@ -132,11 +138,87 @@ class Server:
         self._reaper = threading.Thread(target=self._run_reaper, daemon=True,
                                         name="eval-reaper")
         self._reaper.start()
+        if (self.config.authoritative_region
+                and self.config.authoritative_region != self.config.region):
+            self._repl_stop = threading.Event()
+            t = threading.Thread(target=self._run_acl_replication,
+                                 daemon=True, name="acl-replication")
+            t.start()
+            self._repl_thread = t
+
+    def _run_acl_replication(self) -> None:
+        """Leader-only pull replication of ACL metadata from the
+        authoritative region (reference nomad/leader.go
+        replicateACLPolicies/Roles; ours pulls over the region's agent
+        HTTP with the replication token). Non-authoritative regions
+        converge to the authoritative region's policies/roles so a
+        token minted anywhere means the same thing everywhere."""
+        from ..api.client import ApiClient, ApiError
+
+        interval = self.config.acl_replication_interval
+        while not self._repl_stop.wait(interval):
+            addr = self.region_address(self.config.authoritative_region)
+            if not addr:
+                continue
+            api = ApiClient(addr, token=self.config.replication_token,
+                            timeout=10.0)
+            try:
+                upstream_p = api.get("/v1/acl/policies")[0] or []
+                upstream_r = api.get("/v1/acl/roles")[0] or []
+            except (ApiError, OSError, ValueError):
+                continue  # authoritative region unreachable: retry
+            snap = self.store.snapshot()
+            seen_p = set()
+            for p in upstream_p:
+                name = p.get("name", "")
+                seen_p.add(name)
+                # per-object isolation: one malformed policy must not
+                # stall convergence of everything after it
+                try:
+                    detail, _ = api.get(f"/v1/acl/policy/{name}")
+                    if not detail:
+                        continue
+                    local = snap.acl_policy(name)
+                    rules = detail.get("rules", "{}")
+                    desc = detail.get("description", "")
+                    # change detection: blind re-upserts would churn
+                    # the raft log and wake every blocking query each
+                    # interval
+                    if (local is not None and local.rules == rules
+                            and local.description == desc):
+                        continue
+                    self.upsert_acl_policy(name, rules, desc)
+                except (ApiError, OSError, ValueError):
+                    continue
+            seen_r = set()
+            for r in upstream_r:
+                name = r.get("name", "")
+                seen_r.add(name)
+                try:
+                    local = snap.acl_role(name)
+                    pols = list(r.get("policies", []))
+                    desc = r.get("description", "")
+                    if (local is not None and list(local.policies) == pols
+                            and local.description == desc):
+                        continue
+                    self.upsert_acl_role(name, pols, desc)
+                except (ApiError, OSError, ValueError):
+                    continue
+            # full mirror: names revoked upstream must stop granting
+            # here (reference replication deletes too)
+            for local_p in list(snap.acl_policies()):
+                if local_p.name not in seen_p:
+                    self.store.delete_acl_policy(local_p.name)
+            for local_r in list(snap.acl_roles()):
+                if local_r.name not in seen_r:
+                    self.store.delete_acl_role(local_r.name)
 
     def stop(self) -> None:
         if not self._running:
             return
         self._running = False
+        if getattr(self, "_repl_stop", None) is not None:
+            self._repl_stop.set()
         for w in self.workers:
             w.stop()
         for w in self.workers:
@@ -889,6 +971,24 @@ class Server:
 
     # -- ACL auth methods / SSO login (reference nomad/acl_endpoint.go
     #    Login, acl/ auth-method structs) --
+
+    # -- regions (reference operator regions + serf WAN membership) --
+
+    def upsert_region(self, region) -> None:
+        from ..structs.operator import Region
+
+        if isinstance(region, dict):
+            region = Region(**region)
+        if not region.name or not region.address:
+            raise ValueError("region name and address are required")
+        self.store.upsert_region(region)
+
+    def delete_region(self, name: str) -> None:
+        self.store.delete_region(name)
+
+    def region_address(self, name: str):
+        r = self.store.snapshot().region(name)
+        return r.address if r is not None else None
 
     def upsert_auth_method(self, method) -> None:
         from ..acl.auth import AUTH_TYPE_JWT, AuthMethod

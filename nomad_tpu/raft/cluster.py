@@ -38,7 +38,8 @@ FORWARD = ("register_job", "deregister_job", "dispatch_job",
            "upsert_acl_policy", "create_acl_token", "acl_bootstrap",
            "upsert_acl_role", "delete_acl_role",
            "upsert_auth_method", "delete_auth_method",
-           "upsert_binding_rule", "delete_binding_rule", "acl_login")
+           "upsert_binding_rule", "delete_binding_rule", "acl_login",
+           "upsert_region", "delete_region")
 
 
 class ReplicatedServer:

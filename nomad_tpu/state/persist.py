@@ -47,6 +47,8 @@ def dump_store(store) -> dict:
                              store._auth_methods.iterate(snap.index)],
             "binding_rules": [wire_encode(r) for _, r in
                               store._binding_rules.iterate(snap.index)],
+            "regions": [wire_encode(r) for _, r in
+                        store._regions.iterate(snap.index)],
         }
 
 
@@ -72,6 +74,7 @@ def restore_store(store, data: dict) -> None:
     services = [wire_decode(x) for x in data.get("services", [])]
     auth_methods = [wire_decode(x) for x in data.get("auth_methods", [])]
     binding_rules = [wire_decode(x) for x in data.get("binding_rules", [])]
+    regions = [wire_decode(x) for x in data.get("regions", [])]
 
     with store._write_lock:
         # Generation choice must be deterministic across replicas AND
@@ -105,6 +108,7 @@ def restore_store(store, data: dict) -> None:
                                           for r in services},
             id(store._auth_methods): {m.name for m in auth_methods},
             id(store._binding_rules): {r.id for r in binding_rules},
+            id(store._regions): {r.name for r in regions},
         }
         for t in store._all_tables:
             keep = new_keys.get(id(t), set())
@@ -168,6 +172,8 @@ def restore_store(store, data: dict) -> None:
             store._auth_methods.put(m.name, m, gen, live)
         for r in binding_rules:
             store._binding_rules.put(r.id, r, gen, live)
+        for r in regions:
+            store._regions.put(r.name, r, gen, live)
         store._next_gen = gen
         store._bump_node_set(gen)
         store._rebuild_usage_matrix()

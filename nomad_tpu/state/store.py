@@ -206,6 +206,12 @@ class StateSnapshot:
     def acl_tokens(self):
         return (t for _, t in self._store._acl_tokens.iterate(self.index))
 
+    def region(self, name: str):
+        return self._store._regions.get(name, self.index)
+
+    def regions(self):
+        return (r for _, r in self._store._regions.iterate(self.index))
+
     def auth_method(self, name: str):
         return self._store._auth_methods.get(name, self.index)
 
@@ -378,6 +384,7 @@ class StateStore:
         self._acl_secret_idx = VersionedTable("acl_secret_idx")  # secret -> accessor
         self._acl_roles = VersionedTable("acl_roles")           # key name
         self._auth_methods = VersionedTable("acl_auth_methods")  # key name
+        self._regions = VersionedTable("regions")               # key name
         self._binding_rules = VersionedTable("acl_binding_rules")  # key id
         self._variables = VersionedTable("variables")           # key (ns, path)
         self._volumes = VersionedTable("volumes")               # key (ns, id)
@@ -423,6 +430,7 @@ class StateStore:
             self._allocs_by_eval, self._evals_by_job, self._deployments_by_job,
             self._acl_policies, self._acl_tokens, self._acl_secret_idx,
             self._acl_roles, self._auth_methods, self._binding_rules,
+            self._regions,
             self._variables, self._volumes, self._node_pools,
             self._namespaces, self._services, self._services_by_name,
             self._services_by_alloc,
@@ -1277,6 +1285,24 @@ class StateStore:
             role = self._acl_roles.get_latest(name)
             self._acl_roles.delete(name, gen, live)
             self._commit(gen, [("acl-role-delete", role)])
+            return gen
+
+    def upsert_region(self, region) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            prev = self._regions.get_latest(region.name)
+            region.create_index = prev.create_index if prev is not None else gen
+            region.modify_index = gen
+            self._regions.put(region.name, region, gen, live)
+            self._commit(gen, [("region-upsert", region)])
+            return gen
+
+    def delete_region(self, name: str) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            r = self._regions.get_latest(name)
+            self._regions.delete(name, gen, live)
+            self._commit(gen, [("region-delete", r)])
             return gen
 
     def upsert_auth_method(self, method) -> int:

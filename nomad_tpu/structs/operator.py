@@ -24,6 +24,17 @@ class PreemptionConfig:
 
 
 @dataclass(slots=True)
+class Region:
+    """A federated peer region (reference nomad/rpc.go region
+    forwarding + serf WAN; here an operator-registered address)."""
+
+    name: str = ""
+    address: str = ""            # that region's agent HTTP address
+    create_index: int = 0
+    modify_index: int = 0
+
+
+@dataclass(slots=True)
 class SchedulerConfiguration:
     scheduler_algorithm: str = enums.SCHED_ALG_BINPACK
     preemption_config: PreemptionConfig = field(default_factory=PreemptionConfig)

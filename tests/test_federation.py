@@ -1,0 +1,138 @@
+"""Multi-region federation (reference nomad/rpc.go region forwarding +
+nomad/leader.go ACL replication): region registry, cross-region request
+proxying, and ACL metadata replication from the authoritative region."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api.http import HTTPAgent
+from nomad_tpu.core.server import Server, ServerConfig
+
+
+def http(addr, path, body=None, method=None, token=""):
+    req = urllib.request.Request(
+        f"{addr}{path}",
+        method=method or ("POST" if body is not None else "GET"),
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json",
+                 **({"X-Nomad-Token": token} if token else {})})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def wait_until(fn, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(0.1)
+    return None
+
+
+@pytest.fixture
+def two_regions():
+    east = Server(ServerConfig(num_workers=1, region="east"))
+    west = Server(ServerConfig(num_workers=1, region="west"))
+    east.start()
+    west.start()
+    a_east = HTTPAgent(east, port=0).start()
+    a_west = HTTPAgent(west, port=0).start()
+    # each region learns the other's address
+    east.upsert_region({"name": "west", "address": a_west.address})
+    west.upsert_region({"name": "east", "address": a_east.address})
+    yield east, west, a_east, a_west
+    a_east.stop()
+    a_west.stop()
+    east.stop()
+    west.stop()
+
+
+class TestRegionRegistry:
+    def test_region_list(self, two_regions):
+        east, west, a_east, a_west = two_regions
+        assert http(a_east.address, "/v1/regions") == ["east", "west"]
+        assert http(a_west.address, "/v1/regions") == ["west", "east"]
+
+    def test_unknown_region_404(self, two_regions):
+        _, _, a_east, _ = two_regions
+        with pytest.raises(urllib.error.HTTPError) as e:
+            http(a_east.address, "/v1/jobs?region=mars")
+        assert e.value.code == 404
+
+
+class TestCrossRegionForwarding:
+    def test_job_register_and_read_through_foreign_region(self, two_regions):
+        east, west, a_east, a_west = two_regions
+        # register a job in WEST via EAST's agent
+        http(a_east.address, "/v1/jobs?region=west", {"job": {
+            "id": "wj", "name": "wj", "type": "service",
+            "datacenters": ["dc1"],
+            "task_groups": [{"name": "g", "count": 1,
+                             "tasks": [{"name": "t", "driver": "mock",
+                                        "config": {},
+                                        "resources": {"cpu": 50,
+                                                      "memory_mb": 32}}]}],
+        }})
+        assert west.store.snapshot().job_by_id("wj") is not None
+        assert east.store.snapshot().job_by_id("wj") is None
+        # and read it back through east
+        out = http(a_east.address, "/v1/job/wj?region=west")
+        assert out["id"] == "wj"
+
+
+class TestAclReplication:
+    def test_policies_replicate_from_authoritative(self, tmp_path):
+        auth = Server(ServerConfig(num_workers=1, region="global"))
+        auth.start()
+        a_auth = HTTPAgent(auth, port=0).start()
+        follower = Server(ServerConfig(
+            num_workers=1, region="eu",
+            authoritative_region="global",
+            acl_replication_interval=0.2))
+        follower.start()
+        a_f = HTTPAgent(follower, port=0).start()
+        try:
+            follower.upsert_region({"name": "global",
+                                    "address": a_auth.address})
+            auth.upsert_acl_policy("readers", json.dumps(
+                {"namespace": {"default": {"policy": "read"}}}))
+            auth.upsert_acl_role("ops", ["readers"])
+            assert wait_until(lambda: follower.store.snapshot()
+                              .acl_policy("readers") is not None)
+            assert wait_until(lambda: follower.store.snapshot()
+                              .acl_role("ops") is not None)
+        finally:
+            a_f.stop()
+            a_auth.stop()
+            follower.stop()
+            auth.stop()
+
+    def test_revoked_policy_stops_granting_downstream(self):
+        auth = Server(ServerConfig(num_workers=1, region="global"))
+        auth.start()
+        a_auth = HTTPAgent(auth, port=0).start()
+        follower = Server(ServerConfig(
+            num_workers=1, region="eu",
+            authoritative_region="global",
+            acl_replication_interval=0.2))
+        follower.start()
+        try:
+            follower.upsert_region({"name": "global",
+                                    "address": a_auth.address})
+            auth.upsert_acl_policy("temp", json.dumps(
+                {"namespace": {"default": {"policy": "read"}}}))
+            assert wait_until(lambda: follower.store.snapshot()
+                              .acl_policy("temp") is not None)
+            auth.store.delete_acl_policy("temp")
+            # the full mirror removes it downstream too
+            assert wait_until(lambda: follower.store.snapshot()
+                              .acl_policy("temp") is None)
+        finally:
+            a_auth.stop()
+            follower.stop()
+            auth.stop()
