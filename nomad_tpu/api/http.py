@@ -143,10 +143,11 @@ class HTTPAgent:
                 import urllib.error
                 import urllib.request as _rq
 
-                fq = {k: v[0] for k, v in q.items() if k != "region"}
+                # keep repeated params (topic filters etc.): doseq
+                fq = {k: v for k, v in q.items() if k != "region"}
                 url = f"{addr}{path}"
                 if fq:
-                    url += "?" + urlencode(fq)
+                    url += "?" + urlencode(fq, doseq=True)
                 headers = {"Content-Type": "application/json"}
                 tok = self.headers.get("X-Nomad-Token", "")
                 if tok:
@@ -159,9 +160,11 @@ class HTTPAgent:
                 # the timeout must outlast a forwarded blocking query or
                 # stream wait, or healthy long-polls turn into 502s
                 try:
-                    wait = min(float(fq.get("wait", 60) or 60), 600.0)
-                except ValueError:
+                    wait = min(float(fq.get("wait", ["60"])[0] or 60),
+                               600.0)
+                except (ValueError, IndexError):
                     wait = 60.0
+                committed = False
                 try:
                     with _rq.urlopen(req, timeout=wait + 30.0) as resp:
                         self.send_response(resp.status)
@@ -173,12 +176,14 @@ class HTTPAgent:
                         length = resp.headers.get("Content-Length")
                         if length is not None:
                             self.send_header("Content-Length", length)
+                            committed = True
                             self.end_headers()
                             self.wfile.write(resp.read())
                         else:
                             # streaming upstream (event stream/monitor):
                             # relay chunks as they arrive
                             self.send_header("Transfer-Encoding", "chunked")
+                            committed = True
                             self.end_headers()
                             while True:
                                 chunk = resp.read(65536)
@@ -196,17 +201,40 @@ class HTTPAgent:
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)
-                except OSError as e:
-                    try:
-                        self._error(502,
-                                    f"region {region!r} unreachable: {e}")
-                    except OSError:
-                        pass  # response already partially committed
+                except (OSError, ValueError) as e:
+                    # ValueError: malformed registered address. A
+                    # mid-stream failure must NOT inject a second
+                    # response into a committed chunked body — just
+                    # drop the connection
+                    if not committed:
+                        try:
+                            self._error(502,
+                                        f"region {region!r} failed: {e}")
+                        except OSError:
+                            pass
+                except Exception:
+                    # e.g. http.client.IncompleteRead mid-relay: same
+                    # rule — never write a second response
+                    if not committed:
+                        raise
                 return True
 
             def do_GET(self):
                 try:
                     url = urlparse(self.path)
+                    if url.path in ("/", "/ui", "/ui/"):
+                        # the embedded dashboard (reference serves the
+                        # Ember app from bindata the same way)
+                        from .ui import UI_HTML
+
+                        body = UI_HTML.encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "text/html; charset=utf-8")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
                     q = parse_qs(url.query)
                     if self._maybe_forward_region("GET", url.path, q):
                         return
@@ -493,7 +521,8 @@ class HTTPAgent:
             return h._reply(200, self.server.store.dump())
 
         if path == "/v1/nodes":
-            return h._reply(200, [self._node_stub(n) for n in snap.nodes()])
+            return h._reply(200, [self._node_stub(n, snap)
+                                  for n in snap.nodes()])
         if m := re.fullmatch(r"/v1/node/([^/]+)", path):
             node = snap.node_by_id(m.group(1))
             if node is None:
@@ -1322,14 +1351,20 @@ class HTTPAgent:
             "alloc_summary": summary,
         }
 
-    def _node_stub(self, node) -> dict:
-        return {
+    def _node_stub(self, node, snap=None) -> dict:
+        out = {
             "id": node.id, "name": node.name, "datacenter": node.datacenter,
             "node_class": node.node_class, "node_pool": node.node_pool,
             "status": node.status,
             "scheduling_eligibility": node.scheduling_eligibility,
             "drain": node.drain,
         }
+        if snap is not None:
+            u = snap.node_usage(node.id)
+            cap = float(node.resources.cpu) or 1.0
+            out["cpu_frac"] = round(float(u[0]) / cap, 4) \
+                if u is not None else 0.0
+        return out
 
     def _alloc_stub(self, a) -> dict:
         return {

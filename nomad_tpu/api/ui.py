@@ -1,0 +1,136 @@
+"""Embedded web UI (reference ui/: a 4.7MB Ember app served from
+bindata; here a single-file dashboard the agent serves at /ui).
+
+Read-only operational view over the /v1 API: cluster summary, jobs
+with per-group allocation rollups, nodes with resource fill, recent
+deployments and evaluations. Auto-refreshes; zero external assets so
+it works in the air-gapped environments the reference targets."""
+
+UI_HTML = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>nomad-tpu</title>
+<style>
+  :root { --bg:#0d1117; --panel:#161b22; --border:#30363d; --text:#e6edf3;
+          --dim:#8b949e; --green:#3fb950; --red:#f85149; --amber:#d29922;
+          --blue:#58a6ff; }
+  * { box-sizing: border-box; }
+  body { margin:0; background:var(--bg); color:var(--text);
+         font:14px/1.45 -apple-system, "Segoe UI", Roboto, sans-serif; }
+  header { padding:14px 24px; border-bottom:1px solid var(--border);
+           display:flex; align-items:baseline; gap:16px; }
+  header h1 { font-size:18px; margin:0; }
+  header .sub { color:var(--dim); font-size:12px; }
+  main { padding:18px 24px; display:grid; gap:18px;
+         grid-template-columns:repeat(auto-fit,minmax(420px,1fr)); }
+  section { background:var(--panel); border:1px solid var(--border);
+            border-radius:8px; padding:14px 16px; }
+  section h2 { margin:0 0 10px; font-size:13px; text-transform:uppercase;
+               letter-spacing:.08em; color:var(--dim); }
+  table { width:100%; border-collapse:collapse; font-size:13px; }
+  th { text-align:left; color:var(--dim); font-weight:500;
+       border-bottom:1px solid var(--border); padding:4px 8px 4px 0; }
+  td { padding:4px 8px 4px 0; border-bottom:1px solid #21262d; }
+  .ok { color:var(--green); } .bad { color:var(--red); }
+  .warn { color:var(--amber); } .dim { color:var(--dim); }
+  .mono { font-family:ui-monospace, monospace; font-size:12px; }
+  .bar { background:#21262d; border-radius:3px; height:8px; width:120px;
+         display:inline-block; vertical-align:middle; overflow:hidden; }
+  .bar i { display:block; height:100%; background:var(--blue); }
+  .stats { display:flex; gap:24px; flex-wrap:wrap; }
+  .stat b { display:block; font-size:22px; }
+  .stat span { color:var(--dim); font-size:12px; }
+</style>
+</head>
+<body>
+<header>
+  <h1>nomad-tpu</h1>
+  <span class="sub" id="meta">loading…</span>
+</header>
+<main>
+  <section style="grid-column:1/-1"><h2>Cluster</h2>
+    <div class="stats" id="summary"></div></section>
+  <section><h2>Jobs</h2><table id="jobs"></table></section>
+  <section><h2>Nodes</h2><table id="nodes"></table></section>
+  <section><h2>Deployments</h2><table id="deps"></table></section>
+  <section><h2>Services</h2><table id="services"></table></section>
+</main>
+<script>
+async function j(path) {
+  const r = await fetch(path);
+  if (!r.ok) throw new Error(path + ": " + r.status);
+  return r.json();
+}
+function esc(v) {
+  return String(v).replace(/[&<>"']/g, c => ({"&":"&amp;","<":"&lt;",
+    ">":"&gt;","\"":"&quot;","'":"&#39;"}[c]));
+}
+function cls(s) {
+  if (["running","ready","successful","complete","eligible"].includes(s))
+    return "ok";
+  if (["failed","down","lost","error"].includes(s)) return "bad";
+  if (["pending","paused","blocked","initializing"].includes(s))
+    return "warn";
+  return "dim";
+}
+function row(cells) { return "<tr>" + cells.map(c => "<td>"+c+"</td>")
+  .join("") + "</tr>"; }
+function bar(frac) {
+  const pct = Math.min(100, Math.round(frac*100));
+  return `<span class="bar"><i style="width:${pct}%"></i></span>
+          <span class="dim"> ${pct}%</span>`;
+}
+async function refresh() {
+  try {
+    const [jobs, nodes, deps, svcs, self] = await Promise.all([
+      j("/v1/jobs"), j("/v1/nodes"), j("/v1/deployments"),
+      j("/v1/services"), j("/v1/agent/self")]);
+    document.getElementById("meta").textContent =
+      (self.version ? "v"+self.version : "") +
+      (self.leader !== undefined ? " · leader: "+(self.leader||"local") : "");
+    const running = jobs.filter(x => x.status === "running").length;
+    const ready = nodes.filter(n => n.status === "ready").length;
+    document.getElementById("summary").innerHTML = [
+      ["jobs", jobs.length], ["running", running],
+      ["nodes", nodes.length], ["ready", ready],
+      ["deployments", deps.length], ["services", svcs.length],
+    ].map(([k,v]) => `<div class="stat"><b>${v}</b><span>${k}</span></div>`)
+     .join("");
+    document.getElementById("jobs").innerHTML =
+      "<tr><th>id</th><th>type</th><th>status</th><th>allocs</th></tr>" +
+      jobs.slice(0, 40).map(x => row([
+        `<span class="mono">${esc(x.id)}</span>`, esc(x.type),
+        `<span class="${cls(x.status)}">${esc(x.status)}</span>`,
+        Object.entries(x.alloc_summary || {}).map(([k,v]) => esc(k)+":"+esc(v)).join(" ") ||
+          "—"])).join("");
+    document.getElementById("nodes").innerHTML =
+      "<tr><th>name</th><th>status</th><th>elig</th><th>cpu</th></tr>" +
+      nodes.slice(0, 40).map(n => row([
+        `<span class="mono">${esc(n.name || n.id.slice(0,8))}</span>`,
+        `<span class="${cls(n.status)}">${esc(n.status)}</span>`,
+        `<span class="${cls(n.scheduling_eligibility)}">` +
+          `${esc(n.scheduling_eligibility)}</span>`,
+        n.cpu_frac !== undefined ? bar(n.cpu_frac) : "—"])).join("");
+    document.getElementById("deps").innerHTML =
+      "<tr><th>job</th><th>status</th><th>detail</th></tr>" +
+      deps.slice(0, 20).map(d => row([
+        `<span class="mono">${esc(d.job_id)}</span>`,
+        `<span class="${cls(d.status)}">${esc(d.status)}</span>`,
+        `<span class="dim">${esc(d.status_description || "")}</span>`]))
+        .join("");
+    document.getElementById("services").innerHTML =
+      "<tr><th>name</th><th>instances</th><th>tags</th></tr>" +
+      svcs.slice(0, 20).map(s => row([
+        `<span class="mono">${esc(s.service_name)}</span>`, esc(s.instances),
+        `<span class="dim">${esc((s.tags||[]).join(", "))}</span>`])).join("");
+  } catch (e) {
+    document.getElementById("meta").textContent = "refresh failed: " + e;
+  }
+}
+refresh();
+setInterval(refresh, 3000);
+</script>
+</body>
+</html>
+"""

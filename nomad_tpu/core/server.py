@@ -981,6 +981,8 @@ class Server:
             region = Region(**region)
         if not region.name or not region.address:
             raise ValueError("region name and address are required")
+        if not region.address.startswith(("http://", "https://")):
+            raise ValueError("region address must be an http(s):// URL")
         self.store.upsert_region(region)
 
     def delete_region(self, name: str) -> None:
