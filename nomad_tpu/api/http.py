@@ -380,6 +380,36 @@ class HTTPAgent:
             if pool is None:
                 return h._error(404, "node pool not found")
             return h._reply(200, pool)
+        if path == "/v1/scaling/policies":
+            if not self._ns_allowed(acl, ns, aclp.CAP_READ_JOB):
+                return h._error(403, "Permission denied")
+            return h._reply(200, self.server.scaling_policies(ns))
+        if m := re.fullmatch(r"/v1/scaling/policy/(.+)", path):
+            for pol in self.server.scaling_policies(None):
+                if pol["id"] == m.group(1):
+                    # authorize against the POLICY's namespace, not a
+                    # caller-chosen query param
+                    if not self._ns_allowed(acl, pol["namespace"],
+                                            aclp.CAP_READ_JOB):
+                        return h._error(403, "Permission denied")
+                    return h._reply(200, pol)
+            return h._error(404, "scaling policy not found")
+        if m := re.fullmatch(r"/v1/job/([^/]+)/scale", path):
+            if not self._ns_allowed(acl, ns, aclp.CAP_READ_JOB):
+                return h._error(403, "Permission denied")
+            job = snap.job_by_id(m.group(1), ns)
+            if job is None:
+                return h._error(404, "job not found")
+            return h._reply(200, {
+                "job_id": job.id,
+                "task_groups": {tg.name: {
+                    "desired": tg.count,
+                    "scaling": ({"min": tg.scaling.min,
+                                 "max": tg.scaling.max,
+                                 "enabled": tg.scaling.enabled}
+                                if tg.scaling else None)}
+                    for tg in job.task_groups},
+                "events": snap.scaling_events(job.id, ns)})
         if path == "/v1/regions":
             # known region names, own region first (reference
             # /v1/regions via serf WAN members)

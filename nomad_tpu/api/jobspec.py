@@ -333,6 +333,15 @@ def _group_dict(block: dict) -> dict:
         }
     if volumes:
         out["volumes"] = volumes
+    if block.get("scaling"):
+        sc = block["scaling"][0]
+        out["scaling"] = {
+            "min": int(sc.get("min", 0)),
+            "max": int(sc.get("max", 0)),
+            "enabled": bool(sc.get("enabled", True)),
+            "policy": sc.get("policy", [{}])[0]
+            if isinstance(sc.get("policy"), list) else sc.get("policy", {}),
+        }
     services = []
     for sb in block.get("service", []):
         services.append({

@@ -739,9 +739,47 @@ class Server:
             raise ValueError(f"task group {task_group!r} not found")
         if count < 0:
             raise ValueError("count must be >= 0")
+        if tg.scaling is not None and tg.scaling.enabled:
+            # scaling stanza bounds gate every scale (reference
+            # Job.Scale validates against the policy's min/max)
+            if count < tg.scaling.min or (tg.scaling.max
+                                          and count > tg.scaling.max):
+                raise ValueError(
+                    f"count {count} outside scaling bounds "
+                    f"[{tg.scaling.min}, {tg.scaling.max}]")
         updated = _copy.deepcopy(job)
         updated.lookup_task_group(task_group).count = count
-        return self.register_job(updated)
+        eval_id = self.register_job(updated)
+        # scaling events ride the job row (reference scaling_event
+        # table; GET /v1/job/<id>/scale serves them)
+        self.store.append_scaling_event(job_id, namespace, {
+            "task_group": task_group, "count": count,
+            "previous_count": tg.count, "eval_id": eval_id,
+            "time": time.time()})
+        return eval_id
+
+    def scaling_policies(self, namespace=None):
+        """Every enabled scaling stanza as a policy row (reference
+        /v1/scaling/policies; policies live on the job spec, so the
+        listing is derived from the jobs table)."""
+        out = []
+        for job in self.store.snapshot().jobs():
+            if namespace is not None and job.namespace != namespace:
+                continue
+            if job.stopped():
+                continue
+            for tg in job.task_groups:
+                if tg.scaling is None:
+                    continue
+                out.append({
+                    "id": f"{job.namespace}/{job.id}/{tg.name}",
+                    "namespace": job.namespace,
+                    "target": {"job": job.id, "group": tg.name},
+                    "min": tg.scaling.min, "max": tg.scaling.max,
+                    "enabled": tg.scaling.enabled,
+                    "policy": tg.scaling.policy,
+                })
+        return out
 
     def revert_job(self, job_id: str, job_version: int,
                    namespace: str = "default") -> str:

@@ -33,6 +33,7 @@ MUTATIONS = {
     "upsert_auth_method", "delete_auth_method",
     "upsert_binding_rule", "delete_binding_rule",
     "gc_expired_acl_tokens", "upsert_region", "delete_region",
+    "append_scaling_event",
     "upsert_variable", "delete_variable",
     "upsert_volume", "delete_volume", "reap_volume_claims",
     "upsert_node_pool", "delete_node_pool",

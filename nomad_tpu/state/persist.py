@@ -49,6 +49,9 @@ def dump_store(store) -> dict:
                               store._binding_rules.iterate(snap.index)],
             "regions": [wire_encode(r) for _, r in
                         store._regions.iterate(snap.index)],
+            "scaling_events": [
+                {"key": list(k), "events": list(v)}
+                for k, v in store._scaling_events.iterate(snap.index)],
         }
 
 
@@ -75,6 +78,7 @@ def restore_store(store, data: dict) -> None:
     auth_methods = [wire_decode(x) for x in data.get("auth_methods", [])]
     binding_rules = [wire_decode(x) for x in data.get("binding_rules", [])]
     regions = [wire_decode(x) for x in data.get("regions", [])]
+    scaling_events = data.get("scaling_events", [])
 
     with store._write_lock:
         # Generation choice must be deterministic across replicas AND
@@ -109,6 +113,8 @@ def restore_store(store, data: dict) -> None:
             id(store._auth_methods): {m.name for m in auth_methods},
             id(store._binding_rules): {r.id for r in binding_rules},
             id(store._regions): {r.name for r in regions},
+            id(store._scaling_events): {tuple(e["key"])
+                                        for e in scaling_events},
         }
         for t in store._all_tables:
             keep = new_keys.get(id(t), set())
@@ -174,6 +180,9 @@ def restore_store(store, data: dict) -> None:
             store._binding_rules.put(r.id, r, gen, live)
         for r in regions:
             store._regions.put(r.name, r, gen, live)
+        for e in scaling_events:
+            store._scaling_events.put(tuple(e["key"]),
+                                      tuple(e["events"]), gen, live)
         store._next_gen = gen
         store._bump_node_set(gen)
         store._rebuild_usage_matrix()

@@ -206,6 +206,10 @@ class StateSnapshot:
     def acl_tokens(self):
         return (t for _, t in self._store._acl_tokens.iterate(self.index))
 
+    def scaling_events(self, job_id: str, namespace: str = "default"):
+        return list(self._store._scaling_events.get(
+            (namespace, job_id), self.index) or ())
+
     def region(self, name: str):
         return self._store._regions.get(name, self.index)
 
@@ -385,6 +389,8 @@ class StateStore:
         self._acl_roles = VersionedTable("acl_roles")           # key name
         self._auth_methods = VersionedTable("acl_auth_methods")  # key name
         self._regions = VersionedTable("regions")               # key name
+        # per-(ns, job) scaling event rings (reference scaling_event)
+        self._scaling_events = VersionedTable("scaling_events")
         self._binding_rules = VersionedTable("acl_binding_rules")  # key id
         self._variables = VersionedTable("variables")           # key (ns, path)
         self._volumes = VersionedTable("volumes")               # key (ns, id)
@@ -430,7 +436,7 @@ class StateStore:
             self._allocs_by_eval, self._evals_by_job, self._deployments_by_job,
             self._acl_policies, self._acl_tokens, self._acl_secret_idx,
             self._acl_roles, self._auth_methods, self._binding_rules,
-            self._regions,
+            self._regions, self._scaling_events,
             self._variables, self._volumes, self._node_pools,
             self._namespaces, self._services, self._services_by_name,
             self._services_by_alloc,
@@ -1285,6 +1291,17 @@ class StateStore:
             role = self._acl_roles.get_latest(name)
             self._acl_roles.delete(name, gen, live)
             self._commit(gen, [("acl-role-delete", role)])
+            return gen
+
+    def append_scaling_event(self, job_id: str, namespace: str,
+                             event: dict, keep: int = 20) -> int:
+        with self._write_lock:
+            gen, live = self._begin()
+            key = (namespace, job_id)
+            events = list(self._scaling_events.get_latest(key) or ())
+            events.append(dict(event))
+            self._scaling_events.put(key, tuple(events[-keep:]), gen, live)
+            self._commit(gen, [("scaling-event", event)])
             return gen
 
     def upsert_region(self, region) -> int:

@@ -19,7 +19,7 @@ from .resources import (  # noqa: F401
     comparable,
 )
 from .constraint import Constraint, Affinity, Spread, SpreadTarget  # noqa: F401
-from .job import Job, TaskGroup, Task, Service, UpdateStrategy, RestartPolicy, ReschedulePolicy, EphemeralDisk  # noqa: F401
+from .job import Job, TaskGroup, Task, Service, ScalingPolicy, UpdateStrategy, RestartPolicy, ReschedulePolicy, EphemeralDisk  # noqa: F401
 from .node import Node, DrainStrategy  # noqa: F401
 from .alloc import Allocation, AllocMetric, RescheduleTracker, RescheduleEvent, DesiredTransition  # noqa: F401
 from .evaluation import Evaluation  # noqa: F401

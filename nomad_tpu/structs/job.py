@@ -69,6 +69,18 @@ class MigrateStrategy:
 
 
 @dataclass(slots=True)
+class ScalingPolicy:
+    """Horizontal group scaling bounds (reference structs.go
+    ScalingPolicy + the jobspec scaling stanza). External autoscalers
+    read these via /v1/scaling/policies and act through Job.Scale."""
+
+    min: int = 0
+    max: int = 0
+    enabled: bool = True
+    policy: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass(slots=True)
 class Service:
     """Service registration attached to a group/task (reference structs/services.go)."""
 
@@ -131,6 +143,7 @@ class TaskGroup:
     volumes: Dict[str, VolumeRequest] = field(default_factory=dict)
     max_client_disconnect_s: Optional[float] = None
     stop_after_client_disconnect_s: Optional[float] = None
+    scaling: Optional[ScalingPolicy] = None
     meta: Dict[str, str] = field(default_factory=dict)
 
     def combined_resources(self) -> Resources:

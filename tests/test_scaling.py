@@ -1,0 +1,49 @@
+"""Scaling policies + events (reference structs.go ScalingPolicy,
+scaling_event table, /v1/scaling/policies, Job.Scale bounds)."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.structs.job import ScalingPolicy
+
+
+def _job_with_scaling():
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 2
+    tg.scaling = ScalingPolicy(min=1, max=5, policy={"cooldown": "1m"})
+    return job
+
+
+class TestScaling:
+    def test_policies_derived_from_jobs(self):
+        s = Server(ServerConfig())
+        job = _job_with_scaling()
+        s.register_job(job)
+        pols = s.scaling_policies("default")
+        assert len(pols) == 1
+        p = pols[0]
+        assert p["target"] == {"job": job.id, "group": "web"}
+        assert p["min"] == 1 and p["max"] == 5 and p["enabled"]
+
+    def test_scale_within_bounds_records_event(self):
+        s = Server(ServerConfig())
+        s.store.upsert_node(mock.node())
+        job = _job_with_scaling()
+        s.register_job(job)
+        s.scale_job(job.id, "web", 4)
+        snap = s.store.snapshot()
+        assert snap.job_by_id(job.id).task_groups[0].count == 4
+        events = snap.scaling_events(job.id)
+        assert len(events) == 1
+        assert events[0]["count"] == 4 and events[0]["previous_count"] == 2
+
+    def test_scale_outside_bounds_refused(self):
+        s = Server(ServerConfig())
+        job = _job_with_scaling()
+        s.register_job(job)
+        with pytest.raises(ValueError, match="outside scaling bounds"):
+            s.scale_job(job.id, "web", 9)
+        with pytest.raises(ValueError, match="outside scaling bounds"):
+            s.scale_job(job.id, "web", 0)
