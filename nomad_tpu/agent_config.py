@@ -45,6 +45,9 @@ class AgentFileConfig:
     peers: str = ""
     client_enabled: bool = True
     client_count: Optional[int] = None
+    region: str = ""
+    authoritative_region: str = ""
+    plugin_dir: str = ""
     raw: Dict = field(default_factory=dict)
 
 
@@ -71,10 +74,14 @@ def parse_agent_config(text: str, path: str = "<config>") -> AgentFileConfig:
     cfg.algorithm = str(server.get("algorithm", "") or "")
     cfg.server_id = str(server.get("server_id", "") or "")
     cfg.peers = str(server.get("peers", "") or "")
+    cfg.region = str(server.get("region", "") or "")
+    cfg.authoritative_region = str(
+        server.get("authoritative_region", "") or "")
     client = (body.get("client") or [{}])[0]
     cfg.client_enabled = bool(client.get("enabled", True))
     if client.get("count") is not None:
         cfg.client_count = int(client["count"])
+    cfg.plugin_dir = str(client.get("plugin_dir", "") or "")
     return cfg
 
 
@@ -98,6 +105,9 @@ def apply_to_args(cfg: AgentFileConfig, args, parser_defaults: Dict) -> None:
     maybe("algorithm", cfg.algorithm)
     maybe("server_id", cfg.server_id)
     maybe("peers", cfg.peers)
+    maybe("region", cfg.region)
+    maybe("authoritative_region", cfg.authoritative_region)
+    maybe("plugin_dir", cfg.plugin_dir)
     if not cfg.client_enabled:
         # still subject to "flags win": an explicit --clients N beats it
         maybe("clients", 0)

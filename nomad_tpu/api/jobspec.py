@@ -65,6 +65,15 @@ def _validate(job: Job) -> None:
             raise ValueError(f"task group {tg.name!r} has no tasks")
         if tg.count < 0:
             raise ValueError(f"task group {tg.name!r} has negative count")
+        if tg.scaling is not None and tg.scaling.enabled:
+            sc = tg.scaling
+            if sc.max and sc.min > sc.max:
+                raise ValueError(
+                    f"group {tg.name!r}: scaling min {sc.min} > max {sc.max}")
+            if tg.count < sc.min or (sc.max and tg.count > sc.max):
+                raise ValueError(
+                    f"group {tg.name!r}: count {tg.count} outside scaling "
+                    f"bounds [{sc.min}, {sc.max or 'unbounded'}]")
         for vname, req in tg.volumes.items():
             if req.per_alloc:
                 # indexed per-alloc sources aren't implemented yet; a

@@ -40,7 +40,8 @@ def _p(obj) -> None:
 
 
 AGENT_FLAG_KEYS = ("data_dir", "port", "workers", "algorithm",
-                   "server_id", "peers", "clients")
+                   "server_id", "peers", "clients", "region",
+                   "authoritative_region", "plugin_dir")
 
 
 def cmd_agent(args) -> int:

@@ -746,7 +746,8 @@ class Server:
                                           and count > tg.scaling.max):
                 raise ValueError(
                     f"count {count} outside scaling bounds "
-                    f"[{tg.scaling.min}, {tg.scaling.max}]")
+                    f"[{tg.scaling.min}, "
+                    f"{tg.scaling.max or 'unbounded'}]")
         updated = _copy.deepcopy(job)
         updated.lookup_task_group(task_group).count = count
         eval_id = self.register_job(updated)

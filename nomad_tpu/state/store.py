@@ -614,6 +614,10 @@ class StateStore:
             job = self._jobs.get_latest(key)
             if purge:
                 self._jobs.delete(key, gen, live)
+                # a later job re-using the id must not inherit this
+                # job's scaling history (reference DeleteJobTxn deletes
+                # scaling events with the job)
+                self._scaling_events.delete(key, gen, live)
             elif job is not None:
                 job = copy.copy(job)
                 job.stop = True

@@ -47,3 +47,25 @@ class TestScaling:
             s.scale_job(job.id, "web", 9)
         with pytest.raises(ValueError, match="outside scaling bounds"):
             s.scale_job(job.id, "web", 0)
+
+    def test_registration_validates_bounds(self):
+        from nomad_tpu.api.jobspec import _validate
+
+        job = _job_with_scaling()
+        job.task_groups[0].count = 9  # outside [1, 5]
+        with pytest.raises(ValueError, match="outside scaling bounds"):
+            _validate(job)
+        job.task_groups[0].count = 3
+        job.task_groups[0].scaling.min = 7  # min > max
+        with pytest.raises(ValueError, match="min 7 > max 5"):
+            _validate(job)
+
+    def test_purge_drops_scaling_history(self):
+        s = Server(ServerConfig())
+        s.store.upsert_node(mock.node())
+        job = _job_with_scaling()
+        s.register_job(job)
+        s.scale_job(job.id, "web", 3)
+        assert s.store.snapshot().scaling_events(job.id)
+        s.store.delete_job(job.id, purge=True)
+        assert s.store.snapshot().scaling_events(job.id) == []
