@@ -343,12 +343,14 @@ def cfg_c2m() -> None:
     measured on a same-cluster serial sample (a full 2M host run is
     ~days).
 
-    workers=2 is the measured optimum for this shape: the bulk solver
-    service (tensor/solver.py) serializes device launches anyway, so
-    two workers form a clean two-stage pipeline (one builds plans /
-    commits while the other's solve is in flight) — more workers only
-    add GIL convoy on the host phases (measured in-round: 2 workers
-    23.3K allocs/s, 4 workers 11.6K, 8 workers 6.9K)."""
+    workers=24: since round 5's columnar AllocBlock path, an eval's host
+    phases are O(touched nodes), not O(K) (~4ms/eval measured, was
+    ~110ms), so many workers can block on the solver service at once and
+    its demand-driven batching fills G_PAD=16 rows per launch — worker
+    count now sets the device batch width, not GIL convoy depth
+    (measured in-round at 200K allocs: 2 workers 23.3K allocs/s,
+    4 -> 52.8K, 8 -> 88.4K, 24 -> 135K; round 4 measured the INVERSE
+    before the columnar path: 2w 23.3K, 4w 11.6K, 8w 6.9K)."""
     from nomad_tpu.structs import enums
 
     n_nodes = 10240
@@ -359,7 +361,7 @@ def cfg_c2m() -> None:
                 for _ in range(total // 4000)]
 
     dt, placed, rej = run_server(n_nodes, jobs, enums.SCHED_ALG_TPU_BINPACK,
-                                 workers=2, timeout=1800.0)
+                                 workers=24, timeout=1800.0)
     assert placed == total, placed
 
     def sample():

@@ -21,6 +21,7 @@ TOPIC_FOR_KIND = {
     "alloc-upsert": "Allocation", "alloc-stop": "Allocation",
     "alloc-preempt": "Allocation", "alloc-client-update": "Allocation",
     "alloc-transition": "Allocation",
+    "alloc-block-upsert": "Allocation",  # one event per columnar batch
     "deployment-upsert": "Deployment", "deployment-update": "Deployment",
     "deployment-delete": "Deployment",
 }

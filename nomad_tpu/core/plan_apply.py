@@ -155,6 +155,8 @@ class _OverlaySnapshot:
         self._snap = snap
         self._replaced: Dict[str, dict] = {}
         self._usage_deltas: Dict[str, object] = {}
+        # node id -> [(block, row)] of in-flight columnar placements
+        self._block_rows: Dict[str, list] = {}
         for result in results:  # later results override earlier ones
             for node_id in (set(result.node_allocation)
                             | set(result.node_update)
@@ -164,6 +166,10 @@ class _OverlaySnapshot:
                                result.node_allocation):
                     for a in bucket.get(node_id, ()):
                         by_id[a.id] = a
+            for block in result.alloc_blocks:
+                for m in block.live_rows():
+                    self._block_rows.setdefault(
+                        block.node_ids[m], []).append((block, m))
 
     def node_by_id(self, node_id):
         return self._snap.node_by_id(node_id)
@@ -174,30 +180,38 @@ class _OverlaySnapshot:
         applier's vectorized fit pass through overlays too."""
         base = self._snap.node_usage(node_id)
         by_id = self._replaced.get(node_id)
-        if not by_id:
+        rows = self._block_rows.get(node_id)
+        if not by_id and not rows:
             return base
         delta = self._usage_deltas.get(node_id)
         if delta is None:
             delta = 0.0
-            for aid, a in by_id.items():
+            for aid, a in (by_id or {}).items():
                 if not a.terminal_status():
                     delta = delta + a.allocated_vec
                 base_a = self._snap.alloc_by_id(aid)
                 if base_a is not None and not base_a.terminal_status():
                     delta = delta - base_a.allocated_vec
+            for block, m in rows or ():
+                delta = delta + block.allocated_vec * int(block.counts[m])
             self._usage_deltas[node_id] = delta
         if base is None:
-            return delta if by_id else None
+            return delta
         return base + delta
 
     def allocs_by_node(self, node_id):
         overlay = self._replaced.get(node_id)
+        rows = self._block_rows.get(node_id)
         base = self._snap.allocs_by_node(node_id)
-        if not overlay:
+        if not overlay and not rows:
             return base
-        out = [overlay.get(a.id, a) for a in base]
-        have = {a.id for a in base}
-        out.extend(a for aid, a in overlay.items() if aid not in have)
+        out = ([overlay.get(a.id, a) for a in base] if overlay
+               else list(base))
+        if overlay:
+            have = {a.id for a in base}
+            out.extend(a for aid, a in overlay.items() if aid not in have)
+        for block, m in rows or ():
+            out.extend(block.allocs_for_row(m))
         return out
 
     def alloc_by_id(self, alloc_id):
@@ -384,7 +398,9 @@ class PlanApplier:
             for k in d1:
                 if [a.id for a in d1[k]] != [a.id for a in d2[k]]:
                     return False
-        return True
+        b1 = {(b.id, b.rejected_rows) for b in r1.alloc_blocks}
+        b2 = {(b.id, b.rejected_rows) for b in r2.alloc_blocks}
+        return b1 == b2
 
     def _commit(self, plan: Plan, result: PlanResult,
                 rejected: List[str]) -> PlanResult:
@@ -396,13 +412,15 @@ class PlanApplier:
         for allocs in result.node_preemptions.values():
             preemptions.extend(allocs)
 
-        if placements or stops or preemptions or result.deployment is not None \
+        if placements or stops or preemptions or result.alloc_blocks \
+                or result.deployment is not None \
                 or result.deployment_updates or plan.eval_updates:
             index = self.store.upsert_plan_results(
                 placements, stopped_allocs=stops, preempted_allocs=preemptions,
                 deployment=result.deployment,
                 deployment_updates=result.deployment_updates,
                 evals=list(plan.eval_updates),
+                alloc_blocks=list(result.alloc_blocks),
             )
             result.alloc_index = index
 
@@ -445,8 +463,20 @@ class PlanApplier:
         possible). Everything else keeps the exact python check."""
         result = PlanResult()
         rejected: List[str] = []
+        # columnar blocks contribute per-node usage deltas; a node row
+        # rejects wholesale exactly like a node_allocation bucket
+        block_delta: Dict[str, object] = {}
+        block_nodes: set = set()
+        for block in plan.alloc_blocks:
+            vec = block.allocated_vec
+            for m in block.live_rows():
+                nid = block.node_ids[m]
+                block_nodes.add(nid)
+                prev = block_delta.get(nid)
+                d = vec * int(block.counts[m])
+                block_delta[nid] = d if prev is None else prev + d
         nodes = sorted(set(plan.node_allocation) | set(plan.node_update)
-                       | set(plan.node_preemptions))
+                       | set(plan.node_preemptions) | block_nodes)
         fast: List[str] = []
         exact: List[str] = []
         for nid in nodes:
@@ -459,12 +489,13 @@ class PlanApplier:
                 fast.append(nid)
             else:
                 exact.append(nid)
-        if len(fast) < self.VECTOR_THRESHOLD:
+        if len(fast) < self.VECTOR_THRESHOLD and not block_nodes:
             exact.extend(fast)
             fast = []
         verdict: Dict[str, bool] = {}
         if fast:
-            verdict.update(self._vector_verdicts(snap, plan, fast))
+            verdict.update(self._vector_verdicts(snap, plan, fast,
+                                                 block_delta))
         if len(exact) >= self.PARALLEL_THRESHOLD and self._pool is not None:
             verdict.update(zip(exact, self._pool.map(
                 lambda nid: self._node_plan_valid(snap, plan, nid), exact)))
@@ -496,6 +527,12 @@ class PlanApplier:
             result.node_preemptions.clear()
             rejected = sorted(nodes)
             return result, rejected
+        if plan.alloc_blocks:
+            rej_set = set(rejected) & block_nodes
+            for block in plan.alloc_blocks:
+                sliced = (block.without_nodes(rej_set) if rej_set else block)
+                if any(True for _ in sliced.live_rows()):
+                    result.alloc_blocks.append(sliced)
         result.deployment = plan.deployment
         result.deployment_updates = plan.deployment_updates
         return result, rejected
@@ -543,10 +580,14 @@ class PlanApplier:
                     bad.add(node_id)
         return bad
 
-    def _vector_verdicts(self, snap, plan: Plan,
-                         node_ids: List[str]) -> Dict[str, bool]:
+    def _vector_verdicts(self, snap, plan: Plan, node_ids: List[str],
+                         block_delta: Optional[Dict[str, object]] = None,
+                         ) -> Dict[str, bool]:
         """Batched fit re-check for new-placements-only nodes: one
-        (M, D) numpy comparison instead of M python alloc walks."""
+        (M, D) numpy comparison instead of M python alloc walks.
+        `block_delta` carries the columnar plan's per-node usage sums
+        (blocks are resource-only fresh placements by construction, so
+        a summed vector is the exact fit input)."""
         import numpy as np
 
         from ..structs.resources import RESOURCE_DIMS
@@ -564,8 +605,12 @@ class PlanApplier:
             base = snap.node_usage(nid)
             if base is not None:
                 used[i] = base
-            for a in plan.node_allocation[nid]:
+            for a in plan.node_allocation.get(nid, ()):
                 used[i] += a.allocated_vec
+            if block_delta:
+                d = block_delta.get(nid)
+                if d is not None:
+                    used[i] += d
             avail[i] = node.available_vec()
         ok &= (used <= avail).all(axis=1)
         return dict(zip(node_ids, ok.tolist()))
@@ -573,6 +618,10 @@ class PlanApplier:
     def _node_plan_valid(self, snap, plan: Plan, node_id: str) -> bool:
         node = snap.node_by_id(node_id)
         all_allocation = plan.node_allocation.get(node_id, [])
+        if plan.alloc_blocks:
+            block_allocs = plan.block_allocs_for_node(node_id)
+            if block_allocs:
+                all_allocation = list(all_allocation) + block_allocs
         # classify placement-vs-update by id-existence on the node including
         # client-terminal allocs: a follow_up_eval_id annotation on a failed
         # alloc is an update, not a new placement

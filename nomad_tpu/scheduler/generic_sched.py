@@ -220,6 +220,8 @@ class GenericScheduler:
                 requests.append(PlacementRequest(
                     name=alloc.name, task_group=tg, previous_alloc=alloc))
             requests.extend(g.place)
+            if g.bulk_place is not None:
+                requests.append(g.bulk_place)
 
         if requests and job_obj is not None:
             self._compute_placements(ctx, job_obj, requests, attempt)
@@ -238,7 +240,7 @@ class GenericScheduler:
                 if self.logger:
                     self.logger.exception("post-apply hook failed")
         self._progress = bool(result.node_allocation or result.node_update
-                              or result.node_preemptions
+                              or result.node_preemptions or result.alloc_blocks
                               or result.deployment is not None)
         if new_state is not None:
             # partial commit: retry against fresher state
@@ -377,7 +379,58 @@ class GenericScheduler:
             self.queued_allocs[tg_name] = (
                 self.queued_allocs.get(tg_name, 0) + len(reqs))
 
+        def commit_block(tg, node_ids, node_names, counts, name_indices,
+                         mean_score):
+            """Columnar bulk commit: ONE AllocBlock rides the plan for K
+            placements (structs/alloc.py AllocBlock). Only reachable for
+            the fresh-placement shape commit_many covers, so the same
+            constants apply; per-alloc ids/names materialize lazily."""
+            from ..structs.alloc import AllocBlock
+
+            block = AllocBlock(
+                id=generate_uuid(),
+                eval_id=ev.id,
+                namespace=job.namespace,
+                job_id=job.id,
+                job=job,
+                job_version=job.version,
+                task_group=tg.name,
+                deployment_id=(self.deployment.id
+                               if self.deployment is not None
+                               and tg.update is not None else ""),
+                name_indices=name_indices,
+                node_ids=list(node_ids),
+                node_names=list(node_names),
+                counts=counts,
+                allocated_vec=ctx.tg_vec(tg),
+                mean_score=float(mean_score),
+                allocated_at=now,
+            )
+            metrics = ctx.metrics
+            if metrics is not None:
+                metrics.scores.setdefault("bulk.normalized-score",
+                                          float(mean_score))
+            self.plan.append_block(block)
+            self.queued_allocs[tg.name] = (
+                self.queued_allocs.get(tg.name, 0) + block.size)
+
+        def fail_bulk(tg, n):
+            """Coalesced failure accounting for n unplaced bulk requests
+            (reference generic_sched.go:563-567 CoalescedFailures)."""
+            if n <= 0:
+                return
+            m = ctx.metrics
+            prev = self.failed_tg_allocs.get(tg.name)
+            if prev is None:
+                m.coalesced_failures += n - 1
+                self.failed_tg_allocs[tg.name] = m
+            else:
+                prev.coalesced_failures += n
+            self.queued_allocs.setdefault(tg.name, 0)
+
         commit.commit_many = commit_many
+        commit.commit_block = commit_block
+        commit.fail_bulk = fail_bulk
         placer.place(
             ctx, job, requests, nodes, commit,
             batch=self.batch, preemption_enabled=preemption_enabled,

@@ -43,6 +43,16 @@ class HostPlacer:
         alloc to the in-progress plan, which is how subsequent selections
         see earlier ones via ctx.proposed_allocs (the reference appends in
         the computePlacements loop, generic_sched.go:511-600)."""
+        from .reconcile import BulkPlacementRequest
+
+        # the host path has no columnar shape: expand bulk requests into
+        # their per-alloc equivalents (exact reference semantics)
+        if any(isinstance(r, BulkPlacementRequest) for r in requests):
+            flat = []
+            for r in requests:
+                flat.extend(r.expand() if isinstance(r, BulkPlacementRequest)
+                            else [r])
+            requests = flat
         scorers: Dict[str, NodeScorer] = {}
         for req in requests:
             tg = req.task_group

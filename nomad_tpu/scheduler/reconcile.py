@@ -34,8 +34,38 @@ class PlacementRequest:
 
 
 @dataclass
+class BulkPlacementRequest:
+    """K identical fresh placements carried as one request (columnar
+    C2M path; no reference analog — reconcile.go emits one
+    placementResult per missing alloc). `name_indices[i]` is the alloc
+    name index of placement i; names/ids materialize lazily in the
+    AllocBlock the placer commits. The placer expands this into
+    individual PlacementRequests when the task group's features (spread,
+    ports, devices) rule out the count-based bulk solve."""
+
+    task_group: TaskGroup
+    name_indices: object = None  # (K,) int array
+    job_id: str = ""
+
+    @property
+    def count(self) -> int:
+        return len(self.name_indices)
+
+    def expand(self) -> List[PlacementRequest]:
+        from ..structs.alloc import alloc_name
+
+        tg = self.task_group
+        return [PlacementRequest(
+            name=alloc_name(self.job_id, tg.name, int(i)), task_group=tg)
+            for i in self.name_indices]
+
+
+@dataclass
 class GroupResult:
     place: List[PlacementRequest] = field(default_factory=list)
+    # columnar fresh-placement batch (set instead of K `place` entries
+    # when the group qualifies — see _compute_group's bulk gate)
+    bulk_place: Optional[BulkPlacementRequest] = None
     stop: List[Tuple[Allocation, str, str]] = field(default_factory=list)  # alloc, desc, client_status
     destructive_update: List[Allocation] = field(default_factory=list)
     inplace_update: List[Allocation] = field(default_factory=list)
@@ -66,7 +96,9 @@ class ReconcileResults:
     desired_tg_updates: Dict[str, Dict[str, int]] = field(default_factory=dict)
 
     def total_places(self) -> int:
-        return sum(len(g.place) + len(g.destructive_update) for g in self.groups.values())
+        return sum(len(g.place) + len(g.destructive_update)
+                   + (g.bulk_place.count if g.bulk_place is not None else 0)
+                   for g in self.groups.values())
 
 
 # --- reschedule policy (reference reconcile.go:1336 + structs RescheduleTracker) ---
@@ -112,6 +144,9 @@ def should_reschedule(alloc: Allocation, policy: Optional[ReschedulePolicy],
 
 
 # --- the reconciler ---
+
+
+BULK_PLACE_MIN = 256  # below this, per-request objects are cheap enough
 
 
 class AllocReconciler:
@@ -162,7 +197,8 @@ class AllocReconciler:
             g = self._compute_group(tg, matrix.get(tg_name, []))
             results.groups[tg_name] = g
             results.desired_tg_updates[tg_name] = {
-                "place": len(g.place),
+                "place": len(g.place) + (g.bulk_place.count
+                                         if g.bulk_place is not None else 0),
                 "stop": len(g.stop),
                 "destructive_update": len(g.destructive_update),
                 "in_place_update": len(g.inplace_update),
@@ -381,6 +417,17 @@ class AllocReconciler:
                 + len(g.destructive_update) + batch_done
                 + g.failed_no_reschedule + len(g.disconnecting))
         missing = max(0, desired - have - self._pending_reschedules(g))
+        if (missing >= BULK_PLACE_MIN and not g.place
+                and not g.destructive_update and not tg.volumes):
+            # columnar fast path: K identical fresh placements ride as
+            # ONE request; names/ids materialize lazily downstream. Only
+            # when nothing else is pending for the group (replacements
+            # carry per-alloc context the bulk shape can't) and the
+            # group claims no volumes (claim recording is per-alloc).
+            g.bulk_place = BulkPlacementRequest(
+                task_group=tg, job_id=self.job_id,
+                name_indices=name_index.next_batch_indices(missing))
+            return g
         for name in name_index.next_batch(missing):
             g.place.append(PlacementRequest(name=name, task_group=tg))
         return g

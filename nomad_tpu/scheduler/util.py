@@ -59,6 +59,28 @@ class AllocNameIndex:
             i += 1
         return out
 
+    def next_batch_indices(self, n: int):
+        """Hand out n name INDEXES as an array (the bulk/columnar path:
+        no per-alloc string formatting; AllocBlock materializes names
+        lazily)."""
+        import numpy as np
+
+        out = np.empty(n, dtype=np.int64)
+        filled = 0
+        if not self.used:
+            # fresh group: indexes are simply 0..n-1
+            out[:] = np.arange(n)
+            self.used.update(range(n))
+            return out
+        i = 0
+        while filled < n:
+            if i not in self.used:
+                self.used.add(i)
+                out[filled] = i
+                filled += 1
+            i += 1
+        return out
+
     def release(self, name_index: int) -> None:
         self.used.discard(name_index)
 

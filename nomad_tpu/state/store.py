@@ -28,13 +28,47 @@ from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 import numpy as np
 
 from ..structs import enums
-from ..structs.alloc import Allocation
+from ..structs.alloc import BLOCK_SEP, AllocBlock, Allocation
 from ..structs.resources import RESOURCE_DIMS
 from ..structs.deployment import Deployment
 from ..structs.evaluation import Evaluation
 from ..structs.job import Job
 from ..structs.node import Node
 from .mvcc import ConsList, SnapshotTracker, VersionedTable, cons, cons_from_iter, cons_iter
+
+
+def _block_alloc_fallback(alloc_id: str, lookup) -> Optional[Allocation]:
+    """Resolve a block-position alloc id ("<block uuid>#<pos>") to its
+    virtual row via `lookup(block_id)` — the ONE copy of the id-format /
+    visibility protocol, shared by snapshot reads (gen-bounded lookup)
+    and the writer's latest-row resolution."""
+    sep = alloc_id.rfind(BLOCK_SEP)
+    if sep < 0:
+        return None
+    block = lookup(alloc_id[:sep])
+    if block is None:
+        return None
+    try:
+        p = int(alloc_id[sep + 1:])
+    except ValueError:
+        return None
+    if p < 0 or p >= block.size or not block.visible(p):
+        return None
+    return block.alloc_at(p)
+
+
+class BlockRef:
+    """Secondary-index entry pointing into an AllocBlock: `row` is a
+    node row within the block, or -1 for "all rows" (job/eval indexes).
+    Rides in the same cons cells as alloc-id strings; resolution
+    materializes lazily and lets a promoted real row (same id in the
+    allocs table) override the block's virtual row."""
+
+    __slots__ = ("block_id", "row")
+
+    def __init__(self, block_id: str, row: int = -1):
+        self.block_id = block_id
+        self.row = row
 
 
 class StateSnapshot:
@@ -148,22 +182,53 @@ class StateSnapshot:
     # --- allocs ---
 
     def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
-        return self._store._allocs.get(alloc_id, self.index)
+        a = self._store._allocs.get(alloc_id, self.index)
+        if a is not None:
+            return a
+        return _block_alloc_fallback(
+            alloc_id, lambda bid: self._store._alloc_blocks.get(bid, self.index))
 
     def allocs(self) -> Iterator[Allocation]:
-        return (a for _, a in self._store._allocs.iterate(self.index))
+        yield from (a for _, a in self._store._allocs.iterate(self.index))
+        for _, block in self._store._alloc_blocks.iterate(self.index):
+            for a in block.iter_allocs():
+                # promoted rows already came out of the allocs table
+                if self._store._allocs.get(a.id, self.index) is None:
+                    yield a
+
+    def alloc_blocks(self) -> Iterator[AllocBlock]:
+        return (b for _, b in self._store._alloc_blocks.iterate(self.index))
 
     def _ids_from_index(self, table: VersionedTable, key) -> Iterator[str]:
         cell = table.get(key, self.index)
         seen = set()
         for _id in cons_iter(cell):
+            if type(_id) is BlockRef:
+                yield _id
+                continue
             if _id not in seen:
                 seen.add(_id)
                 yield _id
 
+    def _resolve_block_ref(self, ref: BlockRef, out: List[Allocation]) -> None:
+        block = self._store._alloc_blocks.get(ref.block_id, self.index)
+        if block is None:
+            return
+        rows = (block.live_rows() if ref.row < 0
+                else (ref.row,) if ref.row not in block.rejected_rows
+                else ())
+        allocs_tbl = self._store._allocs
+        for m in rows:
+            for a in block.allocs_for_row(m):
+                promoted = allocs_tbl.get(a.id, self.index)
+                out.append(promoted if promoted is not None else a)
+
     def _allocs_from_index(self, table: VersionedTable, key) -> List[Allocation]:
-        out = []
+        out: List[Allocation] = []
         for aid in self._ids_from_index(table, key):
+            if type(aid) is BlockRef:
+                self._resolve_block_ref(aid, out)
+                continue
             a = self._store._allocs.get(aid, self.index)
             if a is not None:
                 out.append(a)
@@ -375,6 +440,10 @@ class StateStore:
         self._job_versions = VersionedTable("job_versions")  # key (ns, job_id, version)
         self._evals = VersionedTable("evals")
         self._allocs = VersionedTable("allocs")
+        # columnar bulk placements (structs/alloc.py AllocBlock), keyed by
+        # block id; individual rows materialize lazily and promote into
+        # _allocs on first write
+        self._alloc_blocks = VersionedTable("alloc_blocks")
         self._deployments = VersionedTable("deployments")
         # secondary indexes: cons-lists of ids (append-only; compacted on GC)
         self._allocs_by_node = VersionedTable("allocs_by_node")
@@ -432,6 +501,7 @@ class StateStore:
 
         self._all_tables = [
             self._nodes, self._jobs, self._job_versions, self._evals, self._allocs,
+            self._alloc_blocks,
             self._deployments, self._allocs_by_node, self._allocs_by_job,
             self._allocs_by_eval, self._evals_by_job, self._deployments_by_job,
             self._acl_policies, self._acl_tokens, self._acl_secret_idx,
@@ -783,11 +853,20 @@ class StateStore:
 
     _MISS = object()  # "caller did not look up prev" sentinel
 
+    def _latest_alloc(self, alloc_id: str) -> Optional[Allocation]:
+        """Latest row for an alloc id, falling back to its block's
+        virtual row (first write to a block position "promotes" it: the
+        new real row shadows the block position everywhere)."""
+        a = self._allocs.get_latest(alloc_id)
+        if a is not None:
+            return a
+        return _block_alloc_fallback(alloc_id, self._alloc_blocks.get_latest)
+
     def _put_alloc(self, alloc: Allocation, gen: int, live: int, ts: float = None,
                    prev=_MISS) -> None:
         alloc.modify_time = ts if ts is not None else time.time()
         if prev is StateStore._MISS:
-            prev = self._allocs.get_latest(alloc.id)
+            prev = self._latest_alloc(alloc.id)
         if prev is not None:
             alloc.create_index = prev.create_index
             # client status is owned by the client update path; preserve it
@@ -816,7 +895,7 @@ class StateStore:
             ts = ts if ts is not None else time.time()
             events = []
             for upd in updates:
-                existing = self._allocs.get_latest(upd.id)
+                existing = self._latest_alloc(upd.id)
                 if existing is None:
                     continue
                 merged = copy.copy(existing)
@@ -844,7 +923,7 @@ class StateStore:
             gen, live = self._begin()
             events = []
             for alloc_id, transition in transitions.items():
-                existing = self._allocs.get_latest(alloc_id)
+                existing = self._latest_alloc(alloc_id)
                 if existing is None:
                     continue
                 merged = copy.copy(existing)
@@ -870,6 +949,7 @@ class StateStore:
         deployment: Optional[Deployment] = None,
         deployment_updates: List = (),
         evals: List[Evaluation] = (),
+        alloc_blocks: List[AllocBlock] = (),
         ts: float = None,
     ) -> int:
         with self._write_lock:
@@ -889,8 +969,10 @@ class StateStore:
                 # must go through the bulk path, which records volume
                 # claims — not just fresh placements (create_index == 0):
                 # a re-upsert whose row was GC'd mid-flight still needs
-                # its claims tracked
-                prev = self._allocs.get_latest(alloc.id)
+                # its claims tracked. Block positions resolve via
+                # _latest_alloc so a stop/annotation of a block alloc
+                # promotes instead of double-indexing.
+                prev = self._latest_alloc(alloc.id)
                 if prev is None:
                     new_allocs.append(alloc)
                     continue
@@ -898,6 +980,8 @@ class StateStore:
                 events.append(("alloc-upsert", alloc))
             if new_allocs:
                 self._put_new_allocs_bulk(new_allocs, gen, live, ts, events)
+            for block in alloc_blocks:
+                self._put_alloc_block(block, gen, live, ts, events)
             if deployment is not None:
                 self._put_deployment(deployment, gen, live)
                 events.append(("deployment-upsert", deployment))
@@ -968,6 +1052,35 @@ class StateStore:
                 # flattens tuple heads)
                 cell = cons(tuple(ids), table.get_latest(key))
                 table.put(key, cell, gen, live)
+
+    def _put_alloc_block(self, block: AllocBlock, gen: int, live: int,
+                         ts: float, events: list) -> None:
+        """Insert one columnar placement batch: O(touched nodes) host
+        work for K allocations — one block row, one BlockRef cons per
+        touched node, one vectorized usage add. This is the 2M-alloc
+        answer to _put_new_allocs_bulk's per-alloc loop; blocks carry no
+        ports/devices/cores/volumes by construction (the placer's bulk
+        eligibility gate)."""
+        block.modify_time = ts
+        block.create_index = gen
+        block.modify_index = gen
+        self._alloc_blocks.put(block.id, block, gen, live)
+        vec = block.allocated_vec
+        for m in block.live_rows():
+            nid = block.node_ids[m]
+            c = int(block.counts[m])
+            cell = self._allocs_by_node.get_latest(nid)
+            self._allocs_by_node.put(nid, cons(BlockRef(block.id, m), cell),
+                                     gen, live)
+            self._usage_add(nid, vec * c if c != 1 else vec, gen, live)
+        jkey = (block.namespace, block.job_id)
+        jcell = self._allocs_by_job.get_latest(jkey)
+        self._allocs_by_job.put(jkey, cons(BlockRef(block.id), jcell),
+                                gen, live)
+        ecell = self._allocs_by_eval.get_latest(block.eval_id)
+        self._allocs_by_eval.put(block.eval_id, cons(BlockRef(block.id), ecell),
+                                 gen, live)
+        events.append(("alloc-block-upsert", block))
 
     # --- deployments ---
 
@@ -1455,13 +1568,33 @@ class StateStore:
             # every gcable alloc is terminal, so none is usage-counting —
             # the usage rows never need adjusting here
             gc_events: list = []
+            block_drops: Dict[str, list] = {}
             for a in dead_allocs:
                 self._allocs.delete(a.id, gen, live)
                 self._reap_services_for_terminal(a, gen, live, gc_events)
-            # rebuild secondary indexes without the dead ids
+                # a deleted promoted row must not resurrect its block
+                # position: mark it dropped in a new block version
+                sep = a.id.rfind(BLOCK_SEP)
+                if sep > 0:
+                    block_drops.setdefault(a.id[:sep], []).append(
+                        int(a.id[sep + 1:]))
+            dead_blocks = set()
+            for bid, positions in block_drops.items():
+                block = self._alloc_blocks.get_latest(bid)
+                if block is None:
+                    continue
+                block = block.with_dropped(positions)
+                if block.live_size() <= 0:
+                    self._alloc_blocks.delete(bid, gen, live)
+                    dead_blocks.add(bid)
+                else:
+                    self._alloc_blocks.put(bid, block, gen, live)
+            # rebuild secondary indexes without the dead ids/blocks
             for table in (self._allocs_by_node, self._allocs_by_job, self._allocs_by_eval):
                 for key, cell in list(table.iterate(gen)):
-                    ids = [i for i in cons_iter(cell) if i not in dead_set]
+                    ids = [i for i in cons_iter(cell)
+                           if not (i in dead_set if type(i) is not BlockRef
+                                   else i.block_id in dead_blocks)]
                     if len(ids) != cell.length:
                         table.put(key, cons_from_iter(reversed(ids)), gen, live)
             self._commit(gen, gc_events + [("alloc-gc", dead)])

@@ -220,3 +220,202 @@ class Allocation:
 def alloc_name(job_id: str, group: str, index: int) -> str:
     """Reference structs.AllocName format "<job>.<group>[<index>]"."""
     return f"{job_id}.{group}[{index}]"
+
+
+# Block alloc id = "<block uuid>.<position>". The separator must be
+# URL-safe (ids ride in /v1/allocation/<id> paths — "#" would be eaten
+# as a fragment delimiter) and must not occur in uuids (hex + "-").
+BLOCK_SEP = "."
+
+
+@dataclass(slots=True)
+class AllocBlock:
+    """Columnar batch of K identical fresh placements of one task group
+    (the C2M bulk-placement shape).
+
+    The reference has no analog — its plan/state paths are one
+    `Allocation` struct per placement end to end (structs.go
+    Allocation:10694 flowing through plan_apply.go:96 and
+    state_store.go:369 UpsertPlanResults). At 2M allocations that
+    per-object host work dominates wall clock, so the bulk path carries
+    placements as ONE record batch: per-node counts + shared columns.
+    Individual `Allocation` rows materialize lazily (API reads, client
+    sync) and are "promoted" to real MVCC rows on first write (client
+    status update, stop) — the store overrides a block position with its
+    promoted row wherever both are visible.
+
+    Layout is frozen at plan time: `node_ids[m]` receives
+    `counts[m]` placements; global position p (0..K-1) maps to node row
+    via the counts prefix sums, alloc id `"{id}.{p}"`, and alloc name
+    index `name_indices[p]`. Applier rejection drops whole node rows
+    (`rejected_rows`) without renumbering; GC drops individual positions
+    (`dropped`). Both only ever shrink the visible set, so materialized
+    ids/names are stable for the block's lifetime.
+    """
+
+    id: str = ""
+    eval_id: str = ""
+    namespace: str = "default"
+    job_id: str = ""
+    job: object = None
+    job_version: int = 0
+    task_group: str = ""
+    deployment_id: str = ""
+    name_indices: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int64))
+    node_ids: List[str] = field(default_factory=list)
+    node_names: List[str] = field(default_factory=list)
+    counts: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int64))
+    allocated_vec: np.ndarray = field(default_factory=lambda: comparable())
+    mean_score: float = 0.0
+    allocated_at: float = 0.0
+    modify_time: float = 0.0
+    create_index: int = 0
+    modify_index: int = 0
+    # node rows the plan applier rejected (never committed)
+    rejected_rows: frozenset = frozenset()
+    # positions GC'd after their promoted rows went away
+    dropped: frozenset = frozenset()
+    # caches (never serialized; rebuilt lazily)
+    _offsets: object = field(default=None, repr=False, compare=False)
+    _mat: dict = field(default_factory=dict, repr=False, compare=False)
+    _metrics: object = field(default=None, repr=False, compare=False)
+
+    def __deepcopy__(self, memo):
+        import copy as _copy
+
+        new = AllocBlock(
+            id=self.id, eval_id=self.eval_id, namespace=self.namespace,
+            job_id=self.job_id, job=_copy.deepcopy(self.job, memo),
+            job_version=self.job_version, task_group=self.task_group,
+            deployment_id=self.deployment_id,
+            name_indices=self.name_indices.copy(),
+            node_ids=list(self.node_ids), node_names=list(self.node_names),
+            counts=self.counts.copy(),
+            allocated_vec=self.allocated_vec.copy(),
+            mean_score=self.mean_score, allocated_at=self.allocated_at,
+            modify_time=self.modify_time, create_index=self.create_index,
+            modify_index=self.modify_index,
+            rejected_rows=self.rejected_rows, dropped=self.dropped,
+        )
+        return new
+
+    # -- layout --
+
+    @property
+    def size(self) -> int:
+        """Plan-time placement count (includes rejected/dropped)."""
+        return len(self.name_indices)
+
+    def offsets(self) -> np.ndarray:
+        off = self._offsets
+        if off is None:
+            off = self._offsets = np.concatenate(
+                [[0], np.cumsum(self.counts)]).astype(np.int64)
+        return off
+
+    def live_size(self) -> int:
+        """Committed, un-GC'd placements."""
+        n = self.size - len(self.dropped)
+        if self.rejected_rows:
+            off = self.offsets()
+            for m in self.rejected_rows:
+                lo, hi = int(off[m]), int(off[m + 1])
+                n -= (hi - lo) - sum(1 for p in self.dropped if lo <= p < hi)
+        return n
+
+    def row_for_pos(self, p: int) -> int:
+        return int(np.searchsorted(self.offsets(), p, side="right")) - 1
+
+    def live_rows(self):
+        return (m for m in range(len(self.node_ids))
+                if m not in self.rejected_rows)
+
+    def positions_for_row(self, m: int) -> range:
+        off = self.offsets()
+        return range(int(off[m]), int(off[m + 1]))
+
+    def visible(self, p: int) -> bool:
+        if p in self.dropped:
+            return False
+        return self.row_for_pos(p) not in self.rejected_rows
+
+    # -- materialization --
+
+    def _shared_metrics(self):
+        metrics = self._metrics
+        if metrics is None:
+            metrics = self._metrics = AllocMetric(
+                scores={"bulk.normalized-score": self.mean_score})
+        return metrics
+
+    def alloc_at(self, p: int) -> "Allocation":
+        """Materialize position p (cached; the cache holds plain
+        snapshot-shaped rows — writers must copy_for_update like any
+        other MVCC row)."""
+        a = self._mat.get(p)
+        if a is None:
+            m = self.row_for_pos(p)
+            a = self._mat[p] = Allocation(
+                id=f"{self.id}{BLOCK_SEP}{p}",
+                eval_id=self.eval_id,
+                name=alloc_name(self.job_id, self.task_group,
+                                int(self.name_indices[p])),
+                namespace=self.namespace,
+                node_id=self.node_ids[m],
+                node_name=self.node_names[m] if self.node_names else "",
+                job_id=self.job_id,
+                job=self.job,
+                job_version=self.job_version,
+                task_group=self.task_group,
+                deployment_id=self.deployment_id,
+                allocated_vec=self.allocated_vec,
+                metrics=self._shared_metrics(),
+                allocated_at=self.allocated_at,
+                modify_time=self.modify_time,
+                create_index=self.create_index,
+                modify_index=self.modify_index,
+            )
+        return a
+
+    def allocs_for_row(self, m: int) -> List["Allocation"]:
+        if m in self.rejected_rows:
+            return []
+        return [self.alloc_at(p) for p in self.positions_for_row(m)
+                if p not in self.dropped]
+
+    def allocs_for_node(self, node_id: str) -> List["Allocation"]:
+        out: List[Allocation] = []
+        for m, nid in enumerate(self.node_ids):
+            if nid == node_id:
+                out.extend(self.allocs_for_row(m))
+        return out
+
+    def iter_allocs(self):
+        for m in self.live_rows():
+            yield from self.allocs_for_row(m)
+
+    # -- applier slicing / GC --
+
+    def without_nodes(self, bad_node_ids) -> "AllocBlock":
+        """Copy with the given nodes' rows marked rejected (plan applier
+        partial commit). Positions/ids stay stable."""
+        import copy as _copy
+
+        bad = set(bad_node_ids)
+        rows = {m for m, nid in enumerate(self.node_ids) if nid in bad}
+        new = _copy.copy(self)
+        new.rejected_rows = self.rejected_rows | rows
+        new._offsets = self._offsets
+        new._mat = {}
+        new._metrics = None
+        return new
+
+    def with_dropped(self, positions) -> "AllocBlock":
+        import copy as _copy
+
+        new = _copy.copy(self)
+        new.dropped = self.dropped | set(positions)
+        new._offsets = self._offsets
+        new._mat = {}
+        new._metrics = None
+        return new

@@ -29,6 +29,10 @@ class Plan:
     eval_updates: List[object] = field(default_factory=list)   # e.g. blocked eval created atomically
     annotations: Optional[dict] = None
     snapshot_index: int = 0
+    # columnar bulk placements (structs/alloc.py AllocBlock): the C2M
+    # path ships one record batch per (eval, task group) instead of K
+    # Allocation objects; the applier verifies/commits them per node row
+    alloc_blocks: List[object] = field(default_factory=list)
     # callbacks invoked with the PlanResult right after the planner
     # applies this plan (never serialized; process-local). The bulk
     # solver service uses these to confirm or correct its
@@ -37,6 +41,18 @@ class Plan:
 
     def append_alloc(self, alloc) -> None:
         self.node_allocation.setdefault(alloc.node_id, []).append(alloc)
+
+    def append_block(self, block) -> None:
+        self.alloc_blocks.append(block)
+
+    def block_allocs_for_node(self, node_id: str) -> list:
+        """Materialized block placements on one node (the applier's exact
+        per-node check path; rare — block nodes normally verify via the
+        vectorized pass)."""
+        out = []
+        for b in self.alloc_blocks:
+            out.extend(b.allocs_for_node(node_id))
+        return out
 
     def append_stopped_alloc(self, alloc, desired_desc: str, client_status: str = "") -> None:
         """Mark an alloc for stopping (reference structs.go Plan.AppendStoppedAlloc)."""
@@ -63,6 +79,7 @@ class Plan:
             not self.node_update
             and not self.node_allocation
             and not self.node_preemptions
+            and not self.alloc_blocks
             and self.deployment is None
             and not self.deployment_updates
         )
@@ -85,6 +102,8 @@ class PlanResult:
     node_update: Dict[str, list] = field(default_factory=dict)
     node_allocation: Dict[str, list] = field(default_factory=dict)
     node_preemptions: Dict[str, list] = field(default_factory=dict)
+    # committed AllocBlocks (possibly sliced: rejected node rows marked)
+    alloc_blocks: List[object] = field(default_factory=list)
     deployment: object = None
     deployment_updates: List[object] = field(default_factory=list)
     # If set, the plan was partially committed and the scheduler should
@@ -98,13 +117,16 @@ class PlanResult:
         """(fully_committed, num_expected, num_actual)
         (reference structs.go PlanResult.FullCommit)."""
         expected = sum(len(v) for v in plan.node_allocation.values())
+        expected += sum(b.size for b in plan.alloc_blocks)
         actual = sum(len(v) for v in self.node_allocation.values())
+        actual += sum(b.live_size() for b in self.alloc_blocks)
         return expected == actual, expected, actual
 
     def is_no_op(self) -> bool:
         return (
             not self.node_update
             and not self.node_allocation
+            and not self.alloc_blocks
             and not self.deployment_updates
             and self.deployment is None
         )

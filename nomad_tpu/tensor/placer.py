@@ -103,9 +103,19 @@ class TPUPlacer:
         from .kernels import pack_solve_args, solve_task_group_fused
 
         if not nodes:
+            from ..scheduler.reconcile import BulkPlacementRequest
+
             for req in requests:
                 m = ctx.new_metrics()
                 m.nodes_in_pool = 0
+                if isinstance(req, BulkPlacementRequest):
+                    fail_bulk = getattr(commit, "fail_bulk", None)
+                    if fail_bulk is not None:
+                        fail_bulk(req.task_group, req.count)
+                        continue
+                    for r in req.expand():
+                        commit(r, None)
+                    continue
                 commit(req, None)
             return
 
@@ -143,6 +153,31 @@ class TPUPlacer:
             if gi > 0:  # build() already computed usage for the first group
                 cluster.refresh_usage(ctx)
 
+            from ..scheduler.reconcile import BulkPlacementRequest
+
+            if len(reqs) == 1 and isinstance(reqs[0], BulkPlacementRequest):
+                # columnar fast path: K fresh placements as ONE request
+                # committed as ONE AllocBlock (the reconciler only emits
+                # this shape when nothing per-alloc is pending)
+                bulk = reqs[0]
+                tgt = build_task_group_tensors(ctx, job, tg, cluster,
+                                               algorithm=self.algorithm)
+                if (self._bulk_shape_ok(ctx, tg, tgt)
+                        and getattr(commit, "commit_block", None) is not None):
+                    self._place_bulk_columnar(
+                        ctx, job, tg, bulk, cluster, tgt, commit, seed,
+                        sched_batch=batch,
+                        preemption_enabled=preemption_enabled,
+                        attempt=attempt)
+                    continue
+                # group features (spread/ports/devices/...) need the
+                # per-placement machinery: expand and fall through
+                # (reusing the tensors just built)
+                reqs = bulk.expand()
+                prebuilt_tgt = tgt
+            else:
+                prebuilt_tgt = None
+
             if len(reqs) <= self.HOST_CUTOVER:
                 # tiny groups (mostly partial-commit remainders): a
                 # device launch costs ~100ms of tunnel latency while the
@@ -155,8 +190,9 @@ class TPUPlacer:
                     commit(req, option)
                 continue
 
-            tgt = build_task_group_tensors(ctx, job, tg, cluster,
-                                           algorithm=self.algorithm)
+            tgt = (prebuilt_tgt if prebuilt_tgt is not None
+                   else build_task_group_tensors(ctx, job, tg, cluster,
+                                                 algorithm=self.algorithm))
 
             if self._bulk_eligible(ctx, tg, reqs, tgt):
                 self._place_bulk(ctx, job, tg, reqs, cluster, tgt, commit,
@@ -310,6 +346,120 @@ class TPUPlacer:
             return False
         return all(req.previous_alloc is None and not req.ignore_node
                    and not req.canary for req in reqs)
+
+    def _bulk_shape_ok(self, ctx, tg, tgt) -> bool:
+        """Task-group-level bulk eligibility (the per-request conditions
+        of _bulk_eligible hold for a BulkPlacementRequest by
+        construction)."""
+        if tgt.spread_alg or tgt.dh_job or tgt.dh_tg:
+            return False
+        if tgt.spread_val_id.shape[0]:
+            return False
+        if tgt.extra_ask is not None and len(tgt.extra_ask):
+            return False
+        if tgt.dp_val_id is not None and tgt.dp_val_id.shape[0]:
+            return False
+        ask_res = ctx.tg_resources(tg)
+        if ask_res.reserved_port_asks() or ask_res.dynamic_port_count():
+            return False
+        return True
+
+    def _solve_bulk_counts(self, ctx, cluster, tgt, k: int, seed,
+                           tie_perm) -> np.ndarray:
+        """Run the count-based bulk solve through whichever backend fits
+        (solver service with device-resident carry > fused resident
+        arrays > generic kernel) -> (N_pad,) int64 per-node counts."""
+        from .kernels import solve_bulk, solve_bulk_fused
+
+        k_pad = _pad_pow2(k, floor=self.BULK_STEP)
+        n_steps = k_pad // self.BULK_STEP
+        static = cluster.static
+        if (static is not None and tgt.feas_base is not None
+                and k <= 32767
+                and not tgt.placed_tg.any() and not tgt.placed_job.any()):
+            from .solver import get_service
+
+            service = get_service()
+            counts, solve_token = service.solve(
+                static=static, feas_base=tgt.feas_base,
+                aff=tgt.affinity_boost, ask=tgt.ask, k=k,
+                tg_count=tgt.tg_count, seed=seed, used_host=cluster.used)
+            if ctx.plan is not None:
+                ctx.plan.post_apply_hooks.append(
+                    lambda result, _t=solve_token: service.confirm(
+                        _t, getattr(result, "rejected_nodes", None) or ()))
+            return counts
+        if static is not None and tgt.feas_base is not None:
+            from .solver import ensure_resident
+
+            f32 = np.float32
+            avail_dev, feas_dev, aff_dev = ensure_resident(
+                static, tgt.feas_base, tgt.affinity_boost)
+            dyn = np.concatenate(
+                [cluster.used, tgt.placed_tg[:, None],
+                 tgt.placed_job[:, None]], axis=1).astype(f32)
+            return np.asarray(solve_bulk_fused(
+                avail_dev, feas_dev, aff_dev, dyn, tgt.ask.astype(f32),
+                np.int32(k), f32(tgt.tg_count), np.uint32(seed),
+                batch=self.BULK_STEP, n_steps=n_steps)).astype(np.int64)
+        return np.asarray(solve_bulk(
+            cluster.available, cluster.used, tgt.ask, tgt.feasible,
+            tgt.placed_tg, tgt.placed_job, tgt.affinity_boost,
+            np.zeros(cluster.n_pad), tgt.spread_val_id, tgt.spread_val_ok,
+            tgt.spread_counts, tgt.spread_desired, tgt.spread_has_targets,
+            tgt.spread_weight, np.int32(k), tgt.tg_count, tgt.dh_job,
+            tgt.dh_tg, tgt.spread_alg, tie_perm,
+            batch=self.BULK_STEP, n_steps=n_steps)).astype(np.int64)
+
+    def _place_bulk_columnar(self, ctx, job, tg, bulk, cluster, tgt,
+                             commit, seed, *, sched_batch: bool,
+                             preemption_enabled: bool, attempt: int) -> None:
+        """The C2M commit shape: one solve -> one AllocBlock. Host work
+        is O(touched nodes), not O(K) — per-alloc ids/names materialize
+        lazily from the block (structs/alloc.py AllocBlock)."""
+        k = bulk.count
+        tie_perm = None  # only the generic kernel consumes it
+        if cluster.static is None or tgt.feas_base is None:
+            tie_perm = np.random.default_rng(seed).permutation(
+                cluster.n_pad).astype(np.int32)
+        counts = self._solve_bulk_counts(ctx, cluster, tgt, k, seed, tie_perm)
+        mean_score = self._bulk_trajectory_mean(counts, cluster, tgt)
+
+        metrics = ctx.new_metrics()
+        metrics.nodes_in_pool = len(cluster.nodes)
+        metrics.nodes_evaluated = len(cluster.nodes)
+        metrics.scores["bulk.normalized-score"] = mean_score
+
+        nz = np.nonzero(counts)[0]
+        placed_counts = counts[nz]
+        total = int(placed_counts.sum())
+        nodes = cluster.nodes
+        commit.commit_block(
+            tg,
+            [nodes[int(ni)].id for ni in nz],
+            [nodes[int(ni)].name for ni in nz],
+            placed_counts.astype(np.int64),
+            np.asarray(bulk.name_indices[:total], dtype=np.int64),
+            mean_score)
+
+        n_unplaced = k - total
+        if not n_unplaced:
+            return
+        n_feasible = int(tgt.feasible[: len(nodes)].sum())
+        if preemption_enabled:
+            # rare tail: expand ONLY the remainder for the per-request
+            # preemption machinery
+            from ..scheduler.reconcile import BulkPlacementRequest
+
+            remainder = BulkPlacementRequest(
+                task_group=tg, job_id=bulk.job_id,
+                name_indices=bulk.name_indices[total:]).expand()
+            self._preempt_batch(ctx, job, tg, remainder, cluster, tgt,
+                                commit, sched_batch=sched_batch,
+                                attempt=attempt, n_feasible=n_feasible)
+            return
+        self._attribute_failure(ctx, metrics, len(nodes), n_feasible)
+        commit.fail_bulk(tg, n_unplaced)
 
     def _place_bulk(self, ctx, job, tg, reqs, cluster, tgt, commit,
                     tie_perm, seed, *, sched_batch: bool,

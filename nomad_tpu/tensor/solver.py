@@ -113,7 +113,7 @@ class _LedgerEntry:
 
 
 class BulkSolverService:
-    G_PAD = 8           # evals per launch (padded; k=0 rows are no-ops)
+    G_PAD = 16          # evals per launch (padded; k=0 rows are no-ops)
     MAX_K = 32767       # int16 counts ceiling per eval
     RESYNC_SOLVES = 64  # overlay refresh cadence (external usage churn)
     CORRECTIONS = 64    # sparse correction slots per launch

@@ -51,11 +51,13 @@ class Harness:
                 deployment=plan.deployment,
                 deployment_updates=plan.deployment_updates,
                 evals=list(plan.eval_updates),
+                alloc_blocks=list(plan.alloc_blocks),
             )
             result = PlanResult(
                 node_allocation=plan.node_allocation,
                 node_update=plan.node_update,
                 node_preemptions=plan.node_preemptions,
+                alloc_blocks=list(plan.alloc_blocks),
                 alloc_index=index,
             )
             return result, None

@@ -1,0 +1,254 @@
+"""Columnar AllocBlock path (round 5): bulk placements ride the plan,
+store, and applier as record batches; individual allocs materialize
+lazily and promote to real MVCC rows on first write.
+
+No reference analog — the reference is one Allocation struct per
+placement end to end (structs.go Allocation:10694 through
+plan_apply.go:96 and state_store.go:369) — but every observable
+behavior here must match what the per-alloc path would have produced.
+"""
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import enums
+from nomad_tpu.structs.alloc import AllocBlock, Allocation
+from nomad_tpu.structs.operator import SchedulerConfiguration
+from nomad_tpu.testing import Harness
+
+TPU_CFG = SchedulerConfiguration(
+    scheduler_algorithm=enums.SCHED_ALG_TPU_BINPACK)
+
+
+def build_cluster(store, n=64, cpu=4000, mem=8192):
+    for _ in range(n):
+        node = mock.node()
+        node.resources.cpu = cpu
+        node.resources.memory_mb = mem
+        node.compute_class()
+        store.upsert_node(node)
+
+
+def bulk_job(count=512, cpu=50, mem=32):
+    j = mock.batch_job()
+    tg = j.task_groups[0]
+    tg.count = count
+    tg.tasks[0].resources.cpu = cpu
+    tg.tasks[0].resources.memory_mb = mem
+    return j
+
+
+def place_bulk(h, job):
+    h.store.upsert_job(job)
+    h.process(mock.eval_for(job), sched_config=TPU_CFG)
+    h.assert_eval_status(enums.EVAL_STATUS_COMPLETE)
+
+
+def test_bulk_placement_creates_block_and_materializes():
+    h = Harness()
+    build_cluster(h.store)
+    job = bulk_job(512)
+    place_bulk(h, job)
+    snap = h.store.snapshot()
+    blocks = list(snap.alloc_blocks())
+    assert len(blocks) == 1 and blocks[0].size == 512
+    allocs = snap.allocs_by_job(job.id)
+    assert len(allocs) == 512
+    assert len({a.id for a in allocs}) == 512
+    assert len({a.name for a in allocs}) == 512
+    # name indexes are exactly 0..511 (reference allocNameIndex semantics)
+    assert sorted(a.index() for a in allocs) == list(range(512))
+    # per-node index and usage rows agree with the materialized view
+    per_node = {}
+    for a in allocs:
+        per_node.setdefault(a.node_id, []).append(a)
+    for nid, node_allocs in per_node.items():
+        got = snap.allocs_by_node(nid)
+        assert {a.id for a in got} == {a.id for a in node_allocs}
+        assert np.allclose(snap.node_usage(nid),
+                           sum(a.allocated_vec for a in node_allocs))
+    # id round-trips, eval index works
+    a0 = allocs[0]
+    assert snap.alloc_by_id(a0.id) is a0 or snap.alloc_by_id(a0.id).id == a0.id
+    assert len(snap.allocs_by_eval(a0.eval_id)) == 512
+    # bulk score rides shared metrics
+    assert a0.metrics.scores["bulk.normalized-score"] > 0
+
+
+def test_small_groups_do_not_use_blocks():
+    h = Harness()
+    build_cluster(h.store)
+    job = bulk_job(32)
+    place_bulk(h, job)
+    snap = h.store.snapshot()
+    assert list(snap.alloc_blocks()) == []
+    assert len(snap.allocs_by_job(job.id)) == 32
+
+
+def test_promotion_on_client_update_preserves_mvcc():
+    h = Harness()
+    build_cluster(h.store)
+    job = bulk_job(512)
+    place_bulk(h, job)
+    snap_before = h.store.snapshot()
+    a0 = snap_before.allocs_by_job(job.id)[0]
+    h.store.update_allocs_from_client([Allocation(
+        id=a0.id, client_status=enums.ALLOC_CLIENT_COMPLETE)])
+    snap = h.store.snapshot()
+    got = snap.alloc_by_id(a0.id)
+    assert got.client_status == enums.ALLOC_CLIENT_COMPLETE
+    assert got.name == a0.name and got.node_id == a0.node_id
+    # promoted row shadows the block position everywhere, exactly once
+    by_job = snap.allocs_by_job(job.id)
+    assert len(by_job) == 512
+    assert sum(1 for a in by_job if a.id == a0.id) == 1
+    assert snap.alloc_by_id(a0.id).client_status == enums.ALLOC_CLIENT_COMPLETE
+    # usage dropped by exactly one ask on that node
+    delta = (np.asarray(snap_before.node_usage(a0.node_id))
+             - np.asarray(snap.node_usage(a0.node_id)))
+    assert np.allclose(delta, a0.allocated_vec)
+    # the older snapshot still sees the virtual pending row
+    assert (snap_before.alloc_by_id(a0.id).client_status
+            == enums.ALLOC_CLIENT_PENDING)
+
+
+def test_stop_via_plan_promotes_block_alloc():
+    h = Harness()
+    build_cluster(h.store)
+    job = bulk_job(512)
+    place_bulk(h, job)
+    # deregister: the stop eval must stop every block alloc
+    h.store.delete_job(job.id)
+    h.process(mock.eval_for(job, triggered_by=enums.TRIGGER_JOB_DEREGISTER),
+              sched_config=TPU_CFG)
+    snap = h.store.snapshot()
+    allocs = snap.allocs_by_job(job.id)
+    assert len(allocs) == 512
+    assert all(a.server_terminal() for a in allocs)
+    # usage fully released
+    for node in snap.nodes():
+        u = snap.node_usage(node.id)
+        assert u is None or np.allclose(u, 0)
+
+
+def test_applier_partial_commit_slices_block():
+    """A node that no longer fits rejects its whole block row; the rest
+    of the block commits (reference plan_apply.go partial commit)."""
+    from nomad_tpu.core.plan_apply import PlanApplier, PlanQueue
+    from nomad_tpu.structs.plan import Plan
+
+    store = StateStore()
+    build_cluster(store, n=8, cpu=4000, mem=8192)
+    job = bulk_job(8, cpu=1000, mem=64)
+    store.upsert_job(job)
+    snap = store.snapshot()
+    nodes = sorted(snap.nodes(), key=lambda n: n.id)
+    tg = job.task_groups[0]
+    vec = np.zeros_like(mock.alloc(job, nodes[0]).allocated_vec)
+    vec[0] = 1000.0
+    vec[1] = 64.0
+    block = AllocBlock(
+        id="blk-1", eval_id="ev-1", job_id=job.id, job=job,
+        task_group=tg.name,
+        name_indices=np.arange(8, dtype=np.int64),
+        node_ids=[nodes[0].id, nodes[1].id],
+        node_names=[nodes[0].name, nodes[1].name],
+        counts=np.array([4, 4], dtype=np.int64),
+        allocated_vec=vec,
+    )
+    # fill node 0 so the block's 4 x 1000MHz no longer fits there
+    filler = mock.alloc(job, nodes[0])
+    filler.allocated_vec = vec * 2.5  # 2500 MHz: 4000-2500 < 4000
+    store.upsert_allocs([filler])
+    plan = Plan(eval_id="ev-1", snapshot_index=store.latest_index)
+    plan.alloc_blocks.append(block)
+    applier = PlanApplier(store, PlanQueue())
+    result = applier.apply(plan)
+    assert result.rejected_nodes == [nodes[0].id]
+    full, expected, actual = result.full_commit(plan)
+    assert not full and expected == 8 and actual == 4
+    snap = store.snapshot()
+    got = snap.allocs_by_job(job.id)
+    placed = [a for a in got if a.id.startswith("blk-1")]
+    assert len(placed) == 4
+    assert all(a.node_id == nodes[1].id for a in placed)
+    # rejected node's usage untouched beyond the filler
+    assert np.allclose(snap.node_usage(nodes[0].id), filler.allocated_vec)
+
+
+def test_gc_drops_block_positions_without_resurrection():
+    h = Harness()
+    build_cluster(h.store)
+    job = bulk_job(512)
+    place_bulk(h, job)
+    snap = h.store.snapshot()
+    allocs = snap.allocs_by_job(job.id)
+    # stop everything, purge the job, then GC
+    h.store.delete_job(job.id)
+    h.process(mock.eval_for(job, triggered_by=enums.TRIGGER_JOB_DEREGISTER),
+              sched_config=TPU_CFG)
+    del snap, allocs
+    n = h.store.gc_terminal_allocs(before_index=h.store.latest_index + 1)
+    assert n == 512
+    snap = h.store.snapshot()
+    assert snap.allocs_by_job(job.id) == []
+    assert list(snap.alloc_blocks()) == []
+    assert list(snap.allocs()) == []
+
+
+def test_block_wire_roundtrip():
+    from nomad_tpu.structs.wire import wire_decode, wire_encode
+
+    block = AllocBlock(
+        id="blk-w", eval_id="ev", job_id="j", task_group="tg",
+        name_indices=np.arange(6, dtype=np.int64),
+        node_ids=["n1", "n2"], node_names=["n1", "n2"],
+        counts=np.array([2, 4], dtype=np.int64),
+        allocated_vec=np.array([50.0, 32.0, 0.0]),
+        rejected_rows=frozenset(), mean_score=0.5,
+    )
+    back = wire_decode(wire_encode(block))
+    assert back.id == block.id and back.size == 6
+    assert [a.id for a in back.iter_allocs()] == \
+        [a.id for a in block.iter_allocs()]
+
+
+def test_persist_roundtrip_materializes_blocks():
+    h = Harness()
+    build_cluster(h.store)
+    job = bulk_job(512)
+    place_bulk(h, job)
+    data = h.store.dump()
+    restored = StateStore()
+    restored.restore_dump(data)
+    snap = restored.snapshot()
+    allocs = snap.allocs_by_job(job.id)
+    assert len(allocs) == 512
+    orig = {a.id: a for a in h.store.snapshot().allocs_by_job(job.id)}
+    for a in allocs:
+        assert a.node_id == orig[a.id].node_id
+        assert a.name == orig[a.id].name
+    # usage rows survive the round trip
+    for node in snap.nodes():
+        u1 = snap.node_usage(node.id)
+        u0 = h.store.snapshot().node_usage(node.id)
+        assert (u1 is None and u0 is None) or np.allclose(u1, u0)
+
+
+def test_reconcile_retry_against_blocks_places_remainder():
+    """Partial commit leaves a shortfall; the blocked-eval retry
+    reconciles against materialized block allocs and places exactly the
+    missing names (reference generic_sched.go:341-356 refresh loop)."""
+    h = Harness()
+    build_cluster(h.store, n=64)
+    job = bulk_job(512)
+    h.store.upsert_job(job)
+    h.reject_plan = True
+    h.reject_once = True
+    h.process(mock.eval_for(job), sched_config=TPU_CFG)
+    snap = h.store.snapshot()
+    assert len(snap.allocs_by_job(job.id)) == 512
+    assert sorted(a.index() for a in snap.allocs_by_job(job.id)) == \
+        list(range(512))
