@@ -598,8 +598,10 @@ class HTTPAgent:
             sess = SESSIONS.get(m.group(1))
             if sess is None:
                 return h._error(404, "no such exec session")
-            if not self._ns_allowed(acl, getattr(sess, "namespace", ns),
-                                    aclp.CAP_ALLOC_EXEC):
+            # the session's own namespace only — never a caller-chosen
+            # fallback (sessions are namespace-bound at creation)
+            if not sess.namespace or not self._ns_allowed(
+                    acl, sess.namespace, aclp.CAP_ALLOC_EXEC):
                 return h._error(403, "Permission denied")
             offset = int(q.get("offset", ["0"])[0] or 0)
             wait_s = min(float(q.get("wait_s", ["10"])[0] or 10), 30.0)
@@ -1090,10 +1092,10 @@ class HTTPAgent:
             try:
                 sess = SESSIONS.create(
                     command, task_dir, env,
-                    tty=bool((body or {}).get("tty")))
+                    tty=bool((body or {}).get("tty")),
+                    namespace=runner.alloc.namespace)
             except OSError as e:
                 return h._error(400, f"exec failed: {e}")
-            sess.namespace = runner.alloc.namespace
             return h._reply(200, {"session_id": sess.id})
         if m := re.fullmatch(r"/v1/client/exec/([^/]+)/stdin", path):
             from ..client.execstream import SESSIONS
@@ -1101,8 +1103,10 @@ class HTTPAgent:
             sess = SESSIONS.get(m.group(1))
             if sess is None:
                 return h._error(404, "no such exec session")
-            if not self._ns_allowed(acl, getattr(sess, "namespace", ns),
-                                    aclp.CAP_ALLOC_EXEC):
+            # the session's own namespace only — never a caller-chosen
+            # fallback (sessions are namespace-bound at creation)
+            if not sess.namespace or not self._ns_allowed(
+                    acl, sess.namespace, aclp.CAP_ALLOC_EXEC):
                 return h._error(403, "Permission denied")
             data = base64.b64decode((body or {}).get("data", "") or "")
             written = sess.write_stdin(data) if data else 0
@@ -1168,9 +1172,9 @@ class HTTPAgent:
             from ..client.execstream import SESSIONS
 
             sess = SESSIONS.get(m.group(1))
-            if sess is not None and not self._ns_allowed(
-                    acl, getattr(sess, "namespace", ns),
-                    aclp2.CAP_ALLOC_EXEC):
+            if sess is not None and (
+                    not sess.namespace or not self._ns_allowed(
+                        acl, sess.namespace, aclp2.CAP_ALLOC_EXEC)):
                 return h._error(403, "Permission denied")
             SESSIONS.remove(m.group(1))
             return h._reply(200, {"closed": True})

@@ -29,7 +29,8 @@ import time
 def _client(args):
     from .api.client import ApiClient
 
-    return ApiClient(address=args.address, namespace=args.namespace)
+    return ApiClient(address=args.address, namespace=args.namespace,
+                     token=getattr(args, "token", "") or "")
 
 
 def _p(obj) -> None:
@@ -463,8 +464,14 @@ def cmd_monitor(args) -> int:
 
     url = (f"{args.address}/v1/agent/monitor?wait={args.wait}"
            f"&log_level={args.log_level}")
+    headers = {}
+    token = getattr(args, "token", "")
+    if token:
+        # agent:read-gated with ACLs on, like every _client() route
+        headers["X-Nomad-Token"] = token
+    req = urllib.request.Request(url, headers=headers)
     try:
-        with urllib.request.urlopen(url, timeout=args.wait + 30) as resp:
+        with urllib.request.urlopen(req, timeout=args.wait + 30) as resp:
             while True:
                 line = resp.readline()
                 if not line:
@@ -672,6 +679,8 @@ def build_parser() -> argparse.ArgumentParser:
                                                        "http://127.0.0.1:4646"))
     p.add_argument("--namespace", default=os.environ.get("NOMAD_NAMESPACE",
                                                          "default"))
+    p.add_argument("--token", default=os.environ.get("NOMAD_TOKEN", ""),
+                   help="ACL secret (X-Nomad-Token; env NOMAD_TOKEN)")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     ag = sub.add_parser("agent", help="run an agent (server+clients+http)")

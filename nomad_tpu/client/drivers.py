@@ -375,6 +375,7 @@ class RawExecDriver:
     # raw_exec runs unconfined by contract (reference drivers/rawexec:
     # "no isolation"); exec enforces the reservation
     ENFORCE_RESOURCES = False
+    ISOLATE = False
 
     def _build_env(self, env: Dict[str, str]) -> Dict[str, str]:
         return {**os.environ, **env}
@@ -410,6 +411,12 @@ class RawExecDriver:
             # memory/cpu limits, or its polling watchdog (executor.py)
             spec["memory_limit_mb"] = int(task.resources.memory_mb)
             spec["cpu_shares"] = int(task.resources.cpu)
+        if self.ISOLATE and have_dir:
+            # namespace+chroot confinement (executor.py setup_isolation);
+            # the executor records the achieved level in the status file
+            spec["isolation"] = True
+            if task.user:
+                spec["user"] = task.user
         try:
             os.unlink(spec["status_file"])  # stale status from a prior run
         except OSError:
@@ -464,16 +471,25 @@ class RawExecDriver:
 
 class ExecDriver(RawExecDriver):
     """Isolated subprocess driver (reference drivers/exec uses
-    libcontainer namespaces/cgroups, executor_linux.go:36-42). The
-    portable core is session isolation + a scrubbed environment (task
-    env only, plus a usable PATH — the reference injects a default task
-    PATH the same way). The scheduler's memory/cpu reservation is
-    ENFORCED by the executor: cgroup v2/v1 limits where the hierarchy
-    is writable, else a polling watchdog that evicts the task group
-    past its reservation (client/executor.py CgroupLimiter)."""
+    libcontainer namespaces/cgroups, executor_linux.go:36-42).
+
+    Isolation matrix (executor.py setup_isolation; achieved level is
+    recorded as `isolation` in the status file):
+    - Linux root w/ CAP_SYS_ADMIN ("ns+chroot"): private mount + PID +
+      IPC namespaces, chroot into the task dir with the system dirs
+      bind-mounted read-only, a private /proc (the task is PID 1 and
+      sees only its own tree), optional setuid drop to task.user;
+    - anywhere else ("none"): session isolation + scrubbed env (task
+      env only plus a usable PATH — the reference injects a default
+      task PATH the same way).
+    Either way the scheduler's memory/cpu reservation is ENFORCED by
+    the executor: cgroup v2/v1 limits where the hierarchy is writable,
+    else a polling watchdog that evicts the task group past its
+    reservation (client/executor.py CgroupLimiter)."""
 
     name = "exec"
     ENFORCE_RESOURCES = True
+    ISOLATE = True
 
     def _build_env(self, env: Dict[str, str]) -> Dict[str, str]:
         return {"PATH": os.environ.get("PATH", os.defpath), **env}

@@ -35,9 +35,14 @@ IDLE_TTL = 300.0       # s without a read before the reaper kills it
 
 class ExecSession:
     def __init__(self, argv: List[str], cwd: str, env: Dict[str, str],
-                 tty: bool = False):
+                 tty: bool = False, namespace: str = ""):
         self.id = generate_secret_uuid()
         self.tty = tty
+        # the alloc's namespace, bound at creation so authorization can
+        # never be evaluated against a caller-chosen fallback (ADVICE r4:
+        # the post-create assignment left a window where the session was
+        # registered but unowned)
+        self.namespace = namespace
         self._buf = bytearray()
         self._base = 0           # offset of _buf[0]
         self._cond = threading.Condition()
@@ -185,8 +190,8 @@ class ExecSessionManager:
         self._lock = threading.Lock()
         self._reaper: Optional[threading.Thread] = None
 
-    def create(self, argv, cwd, env, tty=False) -> ExecSession:
-        s = ExecSession(argv, cwd, env, tty=tty)
+    def create(self, argv, cwd, env, tty=False, namespace="") -> ExecSession:
+        s = ExecSession(argv, cwd, env, tty=tty, namespace=namespace)
         with self._lock:
             self._sessions[s.id] = s
             if self._reaper is None or not self._reaper.is_alive():

@@ -45,6 +45,121 @@ import time
 
 _CG2_ROOT = "/sys/fs/cgroup"
 
+# --------------------------------------------------------------------------
+# Namespace + chroot isolation (reference drivers/shared/executor/
+# executor_linux.go:36-42: libcontainer mount/PID/IPC namespaces + chroot;
+# ours composes the same primitives from os.unshare + bind mounts + the
+# util-linux `unshare` wrapper instead of vendoring a container runtime).
+#
+# Layering: the EXECUTOR unshares its own mount namespace and bind-mounts
+# the system directories read-only into the task dir (so the host mount
+# table never sees them and they vanish with the executor); the TASK then
+# launches under `unshare --fork --pid --mount --ipc --root=<taskdir>
+# --mount-proc` so it is PID 1 of a private PID namespace, sees only its
+# own /proc, and cannot reach any host path outside the task dir. Where
+# namespaces are unavailable (no CAP_SYS_ADMIN, seccomp) the executor
+# degrades to plain session+cgroup supervision and records
+# isolation="none" in the status file.
+# --------------------------------------------------------------------------
+
+# reference drivers/shared/executor default chroot env (executor docs
+# chroot_env), plus /opt (interpreter installs live there on this image)
+CHROOT_RO_DIRS = ("bin", "sbin", "usr", "lib", "lib32", "lib64", "etc",
+                  "opt", "run")
+
+
+def _libc_mount():
+    import ctypes
+
+    libc = ctypes.CDLL(None, use_errno=True)
+
+    def mount(src, dst, fstype, flags, data=None):
+        r = libc.mount(src.encode() if src else None, dst.encode(),
+                       fstype.encode() if fstype else None, flags,
+                       data.encode() if data else None)
+        if r != 0:
+            import ctypes as _c
+            err = _c.get_errno()
+            raise OSError(err, os.strerror(err), dst)
+    return mount
+
+
+MS_RDONLY = 0x1
+MS_REMOUNT = 0x20
+MS_BIND = 0x1000
+MS_REC = 0x4000
+MS_PRIVATE = 0x40000
+
+
+def setup_isolation(spec: dict):
+    """Prepare the task root and return (argv_prefix, workdir) for the
+    isolated launch, or (None, cwd) when isolation can't be established.
+    MUST run before any threads start (it unshares the calling process's
+    mount namespace)."""
+    import shutil
+
+    root = spec.get("cwd") or ""
+    unshare_bin = shutil.which("unshare")
+    if not root or unshare_bin is None or not hasattr(os, "unshare"):
+        return None, spec.get("cwd")
+    try:
+        mount = _libc_mount()
+        os.unshare(os.CLONE_NEWNS)
+        # our binds must not propagate back to the host mount table
+        mount(None, "/", None, MS_REC | MS_PRIVATE)
+        for d in CHROOT_RO_DIRS:
+            src = "/" + d
+            if not os.path.isdir(src) or os.path.islink(src):
+                # symlinked /bin -> usr/bin etc: recreate the link so
+                # PATH lookups resolve inside the root
+                if os.path.islink(src):
+                    dst = os.path.join(root, d)
+                    if not os.path.lexists(dst):
+                        os.symlink(os.readlink(src), dst)
+                continue
+            dst = os.path.join(root, d)
+            os.makedirs(dst, exist_ok=True)
+            mount(src, dst, None, MS_BIND | MS_REC)
+            try:  # write-protect; recursive ro needs newer kernels — best effort
+                mount(None, dst, None,
+                      MS_REMOUNT | MS_BIND | MS_RDONLY | MS_REC)
+            except OSError:
+                pass
+        # devices: bind the host /dev (the reference's device allowlist
+        # rides libcontainer; a bind keeps /dev/null|zero|urandom usable)
+        dev = os.path.join(root, "dev")
+        os.makedirs(dev, exist_ok=True)
+        mount("/dev", dev, None, MS_BIND | MS_REC)
+        os.makedirs(os.path.join(root, "proc"), exist_ok=True)
+        os.makedirs(os.path.join(root, "tmp"), exist_ok=True)
+    except OSError:
+        return None, spec.get("cwd")
+    prefix = [unshare_bin, "--fork", "--pid", "--mount", "--ipc",
+              "--kill-child", f"--root={root}", "--wd=/",
+              "--mount-proc=/proc"]
+    user = spec.get("user")
+    if user and os.geteuid() == 0:
+        try:
+            import pwd
+
+            pw = pwd.getpwnam(user)
+            setpriv = shutil.which("setpriv")
+            if setpriv is None:
+                raise KeyError("setpriv unavailable")
+            # the task's writable dirs must follow the identity drop
+            for d in ("local", "secrets", "tmp"):
+                p = os.path.join(root, d)
+                if os.path.isdir(p):
+                    os.chown(p, pw.pw_uid, pw.pw_gid)
+            prefix += [setpriv, f"--reuid={pw.pw_uid}",
+                       f"--regid={pw.pw_gid}", "--clear-groups"]
+            spec["_iso_user"] = user
+        except (KeyError, OSError):
+            # unknown user / no setpriv / chown failure: stay root
+            # inside the namespaces, VISIBLY (status isolation_user)
+            spec["_iso_user"] = "root"
+    return prefix, None
+
 
 class CgroupLimiter:
     """Best-effort cgroup memory/cpu enforcement for one task."""
@@ -115,6 +230,29 @@ class CgroupLimiter:
                 self.active = True
             except OSError:
                 pass
+
+    def add_group(self, pgid: int) -> None:
+        """Sweep every live member of the task's process group into the
+        cgroup (the isolated launch interposes an `unshare` wrapper, so
+        the real task is a grandchild that may have forked before the
+        wrapper pid was written)."""
+        try:
+            pids = [p for p in os.listdir("/proc") if p.isdigit()]
+        except OSError:
+            return
+        for p in pids:
+            try:
+                with open(f"/proc/{p}/stat", "rb") as f:
+                    fields = f.read().split(b") ")[-1].split()
+                if int(fields[2]) != pgid:
+                    continue
+            except (OSError, ValueError, IndexError):
+                continue
+            for d in self._dirs:
+                try:
+                    self._write(os.path.join(d, "cgroup.procs"), p)
+                except OSError:
+                    pass
 
     def oom_killed(self, sigkilled: bool = True) -> bool:
         """Did the kernel OOM-kill inside this cgroup? The v1 failcnt
@@ -216,6 +354,13 @@ def run(spec_path: str) -> int:
         with open(spec_path) as f:
             spec = json.load(f)
 
+    # isolation must be established before ANY thread exists (it
+    # unshares this process's mount namespace and bind-mounts the task
+    # root); LogMon starts reader threads
+    iso_prefix, iso_cwd = None, spec.get("cwd")
+    if spec.get("isolation"):
+        iso_prefix, iso_cwd = setup_isolation(spec)
+
     try:
         from .logmon import LogMon
     except ImportError:
@@ -231,11 +376,14 @@ def run(spec_path: str) -> int:
     status_file = spec["status_file"]
     grace = float(spec.get("grace_s", 5.0))
 
+    argv = spec["argv"]
+    if iso_prefix is not None:
+        argv = iso_prefix + argv
     try:
         proc = subprocess.Popen(
-            spec["argv"],
+            argv,
             env=spec.get("env") or None,
-            cwd=spec.get("cwd") or None,
+            cwd=iso_cwd or None,
             stdout=stdout_fd, stderr=stderr_fd,
             # the task gets ITS OWN process group (pgid == task pid) so
             # escalation can killpg the whole task tree — including
@@ -265,6 +413,11 @@ def run(spec_path: str) -> int:
         # watchdog on hosts where cgroups ARE writable
         limiter = CgroupLimiter(spec["task_name"], proc.pid, mem_mb,
                                 cpu_shares)
+        if limiter.active and iso_prefix is not None:
+            # the task is the unshare wrapper's CHILD and may have been
+            # forked before the wrapper pid landed in cgroup.procs;
+            # sweep the whole process group in to close the race
+            limiter.add_group(proc.pid)
         if not limiter.active:
             limiter = None
     # watchdog margin: the polling path can't account as precisely as
@@ -332,6 +485,13 @@ def run(spec_path: str) -> int:
     if oom["killed"]:
         status["oom_killed"] = True
         status["err"] = "task exceeded its memory reservation"
+    if spec.get("isolation"):
+        status["isolation"] = ("ns+chroot" if iso_prefix is not None
+                               else "none")
+        if spec.get("user"):
+            # the identity the task ACTUALLY ran as — a requested drop
+            # that couldn't be applied must be visible, not silent
+            status["isolation_user"] = spec.get("_iso_user", "root")
     _write_status(status_file, status)
     return 0
 

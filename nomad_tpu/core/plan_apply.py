@@ -434,6 +434,19 @@ class PlanApplier:
             self.stats["partial_commits"] += 1
             result.refresh_index = self.store.latest_index
             result.rejected_nodes = rejected
+        # post-apply hooks run HERE, synchronously with the commit (not
+        # in the scheduler after submit returns): the solver service's
+        # confirm() must close a solve's ledger entry as close as
+        # possible to the moment its usage lands in the store, or a
+        # resync in the window counts the placements twice (store row +
+        # still-open entry) and the inflated carry under-places for up
+        # to RESYNC_SOLVES solves
+        for hook in plan.post_apply_hooks:
+            try:
+                hook(result)
+            except Exception:
+                if self.logger:
+                    self.logger.exception("post-apply hook failed")
         return result
 
     def apply(self, plan: Plan) -> PlanResult:

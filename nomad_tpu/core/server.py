@@ -154,9 +154,16 @@ class Server:
         converge to the authoritative region's policies/roles so a
         token minted anywhere means the same thing everywhere."""
         from ..api.client import ApiClient, ApiError
+        from ..raft.node import NotLeaderError
 
         interval = self.config.acl_replication_interval
         while not self._repl_stop.wait(interval):
+            # leader-only for real: in a replicated region a follower's
+            # store.apply raises NotLeaderError — without this gate the
+            # thread died on its first write and replication silently
+            # stopped after any failover (ADVICE r4)
+            if not self._is_raft_leader():
+                continue
             addr = self.region_address(self.config.authoritative_region)
             if not addr:
                 continue
@@ -188,7 +195,7 @@ class Server:
                             and local.description == desc):
                         continue
                     self.upsert_acl_policy(name, rules, desc)
-                except (ApiError, OSError, ValueError):
+                except (ApiError, OSError, ValueError, NotLeaderError):
                     continue
             seen_r = set()
             for r in upstream_r:
@@ -202,16 +209,28 @@ class Server:
                             and local.description == desc):
                         continue
                     self.upsert_acl_role(name, pols, desc)
-                except (ApiError, OSError, ValueError):
+                except (ApiError, OSError, ValueError, NotLeaderError):
                     continue
             # full mirror: names revoked upstream must stop granting
-            # here (reference replication deletes too)
-            for local_p in list(snap.acl_policies()):
-                if local_p.name not in seen_p:
-                    self.store.delete_acl_policy(local_p.name)
-            for local_r in list(snap.acl_roles()):
-                if local_r.name not in seen_r:
-                    self.store.delete_acl_role(local_r.name)
+            # here (reference replication deletes too). A leadership
+            # change mid-cycle must never kill the thread — the next
+            # cycle's gate skips until this replica leads again.
+            try:
+                for local_p in list(snap.acl_policies()):
+                    if local_p.name not in seen_p:
+                        self.store.delete_acl_policy(local_p.name)
+                for local_r in list(snap.acl_roles()):
+                    if local_r.name not in seen_r:
+                        self.store.delete_acl_role(local_r.name)
+            except NotLeaderError:
+                continue
+
+    def _is_raft_leader(self) -> bool:
+        """True when this server may write: always in a single-server
+        deployment, leader-only under raft (the store facade is a
+        RaftStore there)."""
+        raft = getattr(self.store, "_raft", None)
+        return raft is None or raft.is_leader()
 
     def stop(self) -> None:
         if not self._running:

@@ -72,25 +72,37 @@ class _ExternalHandle(TaskHandle):
         self._handle = handle
 
     def wait(self, timeout: Optional[float] = None) -> Optional[ExitResult]:
+        # long-poll on a DEDICATED connection so concurrent kill_task /
+        # fingerprint calls on the shared one aren't stuck behind it
+        # (ADVICE r4: one serialized _Conn lagged kills a full poll)
         deadline = None if timeout is None else time.time() + timeout
-        while True:
-            step = 5.0 if deadline is None else min(5.0, deadline - time.time())
-            if step <= 0:
-                return None
-            try:
-                out = self._plugin.call("wait_task", timeout=step + 5.0,
-                                        handle=self._handle, timeout_s=step)
-            except PluginError:
-                return ExitResult(exit_code=1,
-                                  err="driver plugin died while waiting")
-            if out and out.get("done"):
-                return ExitResult(
-                    exit_code=int(out.get("exit_code", 0)),
-                    signal=int(out.get("signal", 0)),
-                    oom_killed=bool(out.get("oom_killed", False)),
-                    err=out.get("err", ""))
-            if deadline is not None and time.time() >= deadline:
-                return None
+        try:
+            conn = self._plugin.open_conn()
+        except PluginError:
+            return ExitResult(exit_code=1,
+                              err="driver plugin died while waiting")
+        try:
+            while True:
+                step = (5.0 if deadline is None
+                        else min(5.0, deadline - time.time()))
+                if step <= 0:
+                    return None
+                try:
+                    out = conn.call("wait_task", timeout=step + 5.0,
+                                    handle=self._handle, timeout_s=step)
+                except PluginError:
+                    return ExitResult(exit_code=1,
+                                      err="driver plugin died while waiting")
+                if out and out.get("done"):
+                    return ExitResult(
+                        exit_code=int(out.get("exit_code", 0)),
+                        signal=int(out.get("signal", 0)),
+                        oom_killed=bool(out.get("oom_killed", False)),
+                        err=out.get("err", ""))
+                if deadline is not None and time.time() >= deadline:
+                    return None
+        finally:
+            conn.close()
 
     def kill(self, grace_s: float = 5.0) -> None:
         try:
@@ -183,11 +195,7 @@ class PluginInstance:
         self._proc = subprocess.Popen(
             argv, env=env, stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL, start_new_session=True)
-        deadline = time.time() + HANDSHAKE_TIMEOUT
-        line = b""
-        while time.time() < deadline:
-            line = self._proc.stdout.readline()
-            break
+        line = self._read_handshake_line(HANDSHAKE_TIMEOUT)
         try:
             hello = json.loads(line or b"{}")
         except ValueError:
@@ -207,12 +215,56 @@ class PluginInstance:
         with self._lock:
             self._conn = _Conn(self._sock_path)
 
+    def _read_handshake_line(self, timeout: float) -> bytes:
+        """Read the one-line handshake with a REAL deadline: a plugin-dir
+        executable that never prints it (a daemon, a stray binary) must
+        not hang agent startup (the ADVICE r4 finding; the reference's
+        go-plugin client enforces the same timeout). The pipe goes
+        non-blocking and a selector waits out the deadline."""
+        import selectors
+
+        fd = self._proc.stdout
+        os.set_blocking(fd.fileno(), False)
+        sel = selectors.DefaultSelector()
+        sel.register(fd, selectors.EVENT_READ)
+        deadline = time.time() + timeout
+        buf = b""
+        try:
+            while b"\n" not in buf:
+                remaining = deadline - time.time()
+                if remaining <= 0 or not sel.select(remaining):
+                    self.stop()
+                    raise PluginError(
+                        f"{self.path}: no handshake within {timeout:.0f}s")
+                chunk = fd.read()
+                if chunk is None:
+                    continue
+                if not chunk:  # EOF without a handshake line
+                    break
+                buf += chunk
+        finally:
+            sel.close()
+            os.set_blocking(fd.fileno(), True)
+        return buf.split(b"\n", 1)[0]
+
     def call(self, method: str, timeout: float = 30.0, **args):
         with self._lock:
             conn = self._conn
         if conn is None:
             raise PluginError(f"plugin {self.name or self.path} not running")
         return conn.call(method, timeout=timeout, **args)
+
+    def open_conn(self) -> "_Conn":
+        """A dedicated connection (the SDK serves each connection on its
+        own thread). Long-polling callers (wait_task) use one of these so
+        kills/fingerprints on the shared connection never queue behind a
+        blocking poll (the reference multiplexes via gRPC instead)."""
+        if not self._sock_path or not self.alive():
+            raise PluginError(f"plugin {self.name or self.path} not running")
+        try:
+            return _Conn(self._sock_path)
+        except OSError as e:
+            raise PluginError(f"plugin connect failed: {e}") from e
 
     def alive(self) -> bool:
         return self._proc is not None and self._proc.poll() is None

@@ -231,14 +231,11 @@ class GenericScheduler:
             self._finish_success()
             return True
 
-        # submit
+        # submit; the planner runs plan.post_apply_hooks synchronously
+        # with its commit (core/plan_apply.py _commit, testing.py
+        # Harness.submit_plan) so the solver-service ledger closes in
+        # lockstep with the store write
         result, new_state = self.planner.submit_plan(self.plan)
-        for hook in self.plan.post_apply_hooks:
-            try:
-                hook(result)
-            except Exception:
-                if self.logger:
-                    self.logger.exception("post-apply hook failed")
         self._progress = bool(result.node_allocation or result.node_update
                               or result.node_preemptions or result.alloc_blocks
                               or result.deployment is not None)

@@ -165,6 +165,25 @@ class ClusterTensors:
                 if a.should_count_for_usage():
                     used[i] += a.allocated_vec
 
+    def latest_usage(self) -> np.ndarray:
+        """Freshly-gathered LATEST committed usage, (n_pad, D) float32.
+        The bulk solver service calls this at RESYNC time (not solve
+        time): a resync base captured when the eval started can be
+        seconds stale under queue depth, and usage committed by solves
+        whose ledger entries already closed would be lost from the
+        carry — the round-5 oversubscription cascade."""
+        rows = self.static.usage_rows if self.static is not None else None
+        if rows is not None and self._store is not None:
+            mat = self._store._usage_mat  # local ref: matrix may be
+            # swapped by a concurrent restore (_rebuild_usage_matrix);
+            # row assignments may then be stale — bounds-check and fall
+            # back, the applier re-verifies either way
+            if len(rows) == 0 or rows.max() < mat.shape[0]:
+                out = np.zeros((self.n_pad, RESOURCE_DIMS), dtype=np.float32)
+                out[: len(self.nodes)] = mat[rows]
+                return out
+        return self.used.astype(np.float32)
+
     def placement_counts(self, job: Job, tg: TaskGroup,
                          ctx: EvalContext) -> Tuple[np.ndarray, np.ndarray]:
         """(placed_tg, placed_job) int32 vectors counting this job's

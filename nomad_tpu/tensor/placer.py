@@ -375,15 +375,24 @@ class TPUPlacer:
         n_steps = k_pad // self.BULK_STEP
         static = cluster.static
         if (static is not None and tgt.feas_base is not None
-                and k <= 32767
-                and not tgt.placed_tg.any() and not tgt.placed_job.any()):
+                and k <= 32767):
+            # The service path serializes ALL bulk solves — including
+            # partial-commit retries (placed_tg/placed_job nonzero) —
+            # on one device-resident carry, so racing workers can never
+            # double-book. Retries routed around the service (the pre-r5
+            # gate) solved against store-latest usage and collided with
+            # each other, compounding the rejection cascade at 2M scale.
+            # Cost: the carry solve drops the per-node anti-affinity
+            # term for the retried remainder (a score preference, not a
+            # capacity constraint; fresh solves have placed_* == 0).
             from .solver import get_service
 
             service = get_service()
             counts, solve_token = service.solve(
                 static=static, feas_base=tgt.feas_base,
                 aff=tgt.affinity_boost, ask=tgt.ask, k=k,
-                tg_count=tgt.tg_count, seed=seed, used_host=cluster.used)
+                tg_count=tgt.tg_count, seed=seed,
+                used_fn=cluster.latest_usage)
             if ctx.plan is not None:
                 ctx.plan.post_apply_hooks.append(
                     lambda result, _t=solve_token: service.confirm(
@@ -470,54 +479,8 @@ class TPUPlacer:
         stays authoritative. With a cached ClusterStatic the fused entry
         runs against device-resident capacity/mask/affinity arrays and
         ships only the (N, D+2) dynamic matrix + scalars per eval."""
-        from .kernels import solve_bulk, solve_bulk_fused
-
         k = len(reqs)
-        k_pad = _pad_pow2(k, floor=self.BULK_STEP)
-        n_steps = k_pad // self.BULK_STEP
-        static = cluster.static
-        if (static is not None and tgt.feas_base is not None
-                and k <= 32767
-                and not tgt.placed_tg.any() and not tgt.placed_job.any()):
-            # fresh-placement fast path: the batched solver service owns
-            # a device-resident usage carry and amortizes the tunnel
-            # round trip across every eval racing right now
-            from .solver import get_service
-
-            service = get_service()
-            counts, solve_token = service.solve(
-                static=static, feas_base=tgt.feas_base,
-                aff=tgt.affinity_boost, ask=tgt.ask, k=k,
-                tg_count=tgt.tg_count, seed=seed, used_host=cluster.used)
-            if ctx.plan is not None:
-                # close the solve's overlay ledger entry with the plan
-                # outcome (solver.py: confirmed placements stay in the
-                # carry; rejected ones get corrected out)
-                ctx.plan.post_apply_hooks.append(
-                    lambda result, _t=solve_token: service.confirm(
-                        _t, getattr(result, "rejected_nodes", None) or ()))
-        elif static is not None and tgt.feas_base is not None:
-            from .solver import ensure_resident
-
-            f32 = np.float32
-            avail_dev, feas_dev, aff_dev = ensure_resident(
-                static, tgt.feas_base, tgt.affinity_boost)
-            dyn = np.concatenate(
-                [cluster.used, tgt.placed_tg[:, None],
-                 tgt.placed_job[:, None]], axis=1).astype(f32)
-            counts = np.asarray(solve_bulk_fused(
-                avail_dev, feas_dev, aff_dev, dyn, tgt.ask.astype(f32),
-                np.int32(k), f32(tgt.tg_count), np.uint32(seed),
-                batch=self.BULK_STEP, n_steps=n_steps)).astype(np.int64)
-        else:
-            counts = np.asarray(solve_bulk(
-                cluster.available, cluster.used, tgt.ask, tgt.feasible,
-                tgt.placed_tg, tgt.placed_job, tgt.affinity_boost,
-                np.zeros(cluster.n_pad), tgt.spread_val_id, tgt.spread_val_ok,
-                tgt.spread_counts, tgt.spread_desired, tgt.spread_has_targets,
-                tgt.spread_weight, np.int32(k), tgt.tg_count, tgt.dh_job,
-                tgt.dh_tg, tgt.spread_alg, tie_perm,
-                batch=self.BULK_STEP, n_steps=n_steps)).astype(np.int64)
+        counts = self._solve_bulk_counts(ctx, cluster, tgt, k, seed, tie_perm)
         mean_score = self._bulk_trajectory_mean(counts, cluster, tgt)
 
         # one shared metrics object for the whole group: per-alloc

@@ -82,10 +82,10 @@ def ensure_resident(static, feas_base, aff):
 
 class _Request:
     __slots__ = ("static", "feas_base", "aff", "ask", "k", "tg_count",
-                 "seed", "used_host", "future", "token")
+                 "seed", "used_fn", "future", "token")
 
     def __init__(self, static, feas_base, aff, ask, k, tg_count, seed,
-                 used_host):
+                 used_fn):
         self.static = static
         self.feas_base = feas_base
         self.aff = aff
@@ -93,7 +93,11 @@ class _Request:
         self.k = k
         self.tg_count = tg_count
         self.seed = seed
-        self.used_host = used_host
+        # called at RESYNC time for a fresh committed-usage base; a base
+        # captured at enqueue time goes stale under queue depth and
+        # loses usage whose ledger entries already closed (measured
+        # in-round: the 2M run's 1% rejection cascade)
+        self.used_fn = used_fn
         self.future = Future()
         self.token = 0
 
@@ -137,7 +141,7 @@ class BulkSolverService:
     # -- caller side (scheduler worker threads) --
 
     def solve(self, *, static, feas_base, aff, ask, k, tg_count, seed,
-              used_host):
+              used_fn):
         """Blocking solve of one fresh-placement bulk eval ->
         ((N_pad,) int64 per-node counts in canonical order, token).
         The caller must arrange for confirm(token, rejected_node_ids)
@@ -145,7 +149,7 @@ class BulkSolverService:
         (plan.post_apply_hooks)."""
         req = _Request(static, feas_base, aff,
                        np.asarray(ask, dtype=np.float32), int(k),
-                       float(tg_count), np.uint32(seed), used_host)
+                       float(tg_count), np.uint32(seed), used_fn)
         self._ensure_thread()
         self._q.put(req)
         return req.future.result(), req.token
@@ -298,7 +302,7 @@ class BulkSolverService:
                 # (queued corrections target phantoms in the old carry —
                 # the rebuild has none, so drop them)
                 self._corrections.clear()
-                base = rs[0].used_host.astype(np.float32).copy()
+                base = np.asarray(rs[0].used_fn(), dtype=np.float32).copy()
                 for e in self._ledger.values():
                     if e.static is static:
                         base[e.idx] += (e.counts[:, None].astype(np.float32)

@@ -37,6 +37,13 @@ class Harness:
                 if self.reject_once:
                     self.reject_plan = False
                 result = PlanResult(refresh_index=self.store.latest_index)
+                # nothing committed: every planned node counts as
+                # rejected so solver-ledger hooks correct their usage
+                nodes = set(plan.node_allocation)
+                for b in plan.alloc_blocks:
+                    nodes.update(b.node_ids)
+                result.rejected_nodes = sorted(nodes)
+                self._run_hooks(plan, result)
                 return result, self.store.snapshot()
 
             placements, stops, preemptions = [], [], []
@@ -60,7 +67,18 @@ class Harness:
                 alloc_blocks=list(plan.alloc_blocks),
                 alloc_index=index,
             )
+            self._run_hooks(plan, result)
             return result, None
+
+    @staticmethod
+    def _run_hooks(plan: Plan, result: PlanResult) -> None:
+        """Planner contract: post-apply hooks fire synchronously with
+        the commit (see core/plan_apply.py _commit)."""
+        for hook in plan.post_apply_hooks:
+            try:
+                hook(result)
+            except Exception:
+                pass
 
     def update_eval(self, evaluation: Evaluation) -> None:
         with self._lock:

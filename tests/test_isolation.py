@@ -1,0 +1,176 @@
+"""Task isolation: namespaces + chroot in the out-of-process executor
+(reference drivers/shared/executor/executor_linux.go:36-42 — mount/PID/
+IPC namespaces + chroot via libcontainer; ours composes os.unshare +
+read-only bind mounts + util-linux `unshare --root`).
+
+The round-4 verdict's bar: an exec task must not read host paths
+outside its task dir and must see only its own PID tree.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+
+EXECUTOR = os.path.join(os.path.dirname(__file__), "..",
+                        "nomad_tpu", "client", "executor.py")
+
+
+def _can_isolate() -> bool:
+    if os.geteuid() != 0 or shutil.which("unshare") is None \
+            or not hasattr(os, "unshare"):
+        return False
+    pid = os.fork()
+    if pid == 0:
+        try:
+            os.unshare(os.CLONE_NEWNS | os.CLONE_NEWPID | os.CLONE_NEWIPC)
+            os._exit(0)
+        except OSError:
+            os._exit(1)
+    _, st = os.waitpid(pid, 0)
+    return os.waitstatus_to_exitcode(st) == 0
+
+
+needs_ns = pytest.mark.skipif(not _can_isolate(),
+                              reason="namespaces unavailable")
+
+
+def run_isolated(tmp_path, argv, timeout=30.0, extra=None):
+    task_dir = tmp_path / "task"
+    for d in ("local", "secrets", "tmp", "logs"):
+        (task_dir / d).mkdir(parents=True, exist_ok=True)
+    status = task_dir / ".executor_status.json"
+    spec = {
+        "argv": argv,
+        "env": {"PATH": "/usr/bin:/bin"},
+        "cwd": str(task_dir),
+        "task_name": "iso",
+        "logs_dir": str(task_dir / "logs"),
+        "grace_s": 2.0,
+        "status_file": str(status),
+        "isolation": True,
+    }
+    spec.update(extra or {})
+    proc = subprocess.Popen(
+        [sys.executable, "-S", os.path.abspath(EXECUTOR), "-"],
+        stdin=subprocess.PIPE, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL, start_new_session=True)
+    proc.stdin.write(json.dumps(spec).encode())
+    proc.stdin.close()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            st = json.loads(status.read_text())
+            if "exit_code" in st:
+                return st, task_dir
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.1)
+    proc.kill()
+    raise AssertionError("executor never wrote final status")
+
+
+@needs_ns
+class TestNamespaceIsolation:
+    def test_host_paths_outside_taskdir_unreachable(self, tmp_path):
+        secret = tmp_path / "host-secret.txt"
+        secret.write_text("host only")
+        host_task_dir = tmp_path / "task"
+        st, task_dir = run_isolated(tmp_path, [
+            "/bin/sh", "-c",
+            f"cat {secret} && exit 7; "
+            # the absolute host path of the task dir itself must not
+            # resolve either (we are chrooted INTO it)
+            f"test -e {host_task_dir} && exit 8; "
+            "echo ok > /local/proof; exit 0"])
+        assert st["exit_code"] == 0, st
+        assert st.get("isolation") == "ns+chroot"
+        assert (task_dir / "local" / "proof").read_text().strip() == "ok"
+
+    def test_task_is_pid1_and_sees_only_its_tree(self, tmp_path):
+        st, task_dir = run_isolated(tmp_path, [
+            "/bin/sh", "-c",
+            "echo $$ > /local/pid; ls /proc | grep -c '^[0-9]' > /local/nproc"])
+        assert st["exit_code"] == 0, st
+        assert (task_dir / "local" / "pid").read_text().strip() == "1"
+        # sh + ls + grep at most — nothing of the host's process tree
+        assert int((task_dir / "local" / "nproc").read_text()) <= 4
+
+    def test_system_dirs_read_only(self, tmp_path):
+        st, task_dir = run_isolated(tmp_path, [
+            "/bin/sh", "-c",
+            "touch /etc/pwned 2>/dev/null && exit 9; "
+            "cat /etc/os-release > /dev/null || exit 10; exit 0"])
+        assert st["exit_code"] == 0, st
+
+    def test_host_mount_table_untouched(self, tmp_path):
+        before = open("/proc/self/mounts").read()
+        st, task_dir = run_isolated(tmp_path, ["/bin/sh", "-c", "true"])
+        assert st["exit_code"] == 0
+        after = open("/proc/self/mounts").read()
+        assert str(tmp_path) not in after
+        assert before == after
+
+    def test_stop_escalation_kills_isolated_tree(self, tmp_path):
+        task_dir = tmp_path / "task"
+        for d in ("local", "logs"):
+            (task_dir / d).mkdir(parents=True, exist_ok=True)
+        status = task_dir / ".executor_status.json"
+        spec = {
+            "argv": ["/bin/sh", "-c",
+                     "trap '' TERM; sleep 300 & wait"],
+            "env": {"PATH": "/usr/bin:/bin"},
+            "cwd": str(task_dir),
+            "task_name": "stopme",
+            "logs_dir": str(task_dir / "logs"),
+            "grace_s": 1.0,
+            "status_file": str(status),
+            "isolation": True,
+        }
+        proc = subprocess.Popen(
+            [sys.executable, "-S", os.path.abspath(EXECUTOR), "-"],
+            stdin=subprocess.PIPE, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL, start_new_session=True)
+        proc.stdin.write(json.dumps(spec).encode())
+        proc.stdin.close()
+        # wait for the task pid to land, then stop the executor
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                if json.loads(status.read_text()).get("task_pid"):
+                    break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.1)
+        time.sleep(0.5)
+        proc.terminate()
+        proc.wait(timeout=15)
+        st = json.loads(status.read_text())
+        assert st.get("signal") in (9, 15), st
+
+
+class TestGracefulDegradation:
+    def test_without_isolation_flag_runs_unconfined(self, tmp_path):
+        st, task_dir = run_isolated(tmp_path, [
+            "/bin/sh", "-c", "test -e /proc/1/cmdline"], extra={
+                "isolation": False})
+        assert st["exit_code"] == 0
+        assert "isolation" not in st
+
+    def test_isolation_degrades_when_unshare_missing(self, tmp_path,
+                                                     monkeypatch):
+        """No unshare binary -> plain supervision, recorded in status."""
+        import nomad_tpu.client.executor as ex
+
+        orig = shutil.which
+        monkeypatch.setattr(
+            "shutil.which",
+            lambda name, *a, **kw: None if name == "unshare"
+            else orig(name, *a, **kw))
+        spec = {"cwd": str(tmp_path), "isolation": True}
+        prefix, cwd = ex.setup_isolation(spec)
+        assert prefix is None and cwd == str(tmp_path)

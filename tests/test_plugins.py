@@ -107,3 +107,67 @@ class TestPluginE2E:
         finally:
             c.stop()
             s.stop()
+
+
+class TestHandshakeTimeout:
+    def test_silent_plugin_does_not_hang_launch(self, tmp_path, monkeypatch):
+        """An executable that never prints the handshake line (a daemon,
+        a stray binary) must fail launch within HANDSHAKE_TIMEOUT instead
+        of blocking agent startup forever (go-plugin enforces the same)."""
+        import nomad_tpu.plugins.manager as mgr
+        from nomad_tpu.plugins.manager import PluginError, PluginInstance
+
+        monkeypatch.setattr(mgr, "HANDSHAKE_TIMEOUT", 1.0)
+        silent = tmp_path / "silent.sh"
+        silent.write_text("#!/bin/sh\nsleep 60\n")
+        os.chmod(silent, 0o755)
+        inst = PluginInstance(str(silent))
+        t0 = time.time()
+        with pytest.raises(PluginError, match="no handshake"):
+            inst.launch()
+        assert time.time() - t0 < 10.0
+        assert not inst.alive()  # subprocess was reaped
+
+    def test_eof_without_handshake_fails_fast(self, tmp_path):
+        from nomad_tpu.plugins.manager import PluginError, PluginInstance
+
+        quiet = tmp_path / "quiet.sh"
+        quiet.write_text("#!/bin/sh\nexit 0\n")
+        os.chmod(quiet, 0o755)
+        inst = PluginInstance(str(quiet))
+        with pytest.raises(PluginError, match="bad plugin handshake"):
+            inst.launch()
+
+
+class TestDedicatedWaitConn:
+    def test_kill_not_stuck_behind_wait(self, plugin_dir, tmp_path):
+        """A kill issued while another thread long-polls wait_task must
+        land promptly (dedicated per-wait connection; ADVICE r4)."""
+        import threading
+
+        pm = PluginManager(plugin_dir)
+        names = pm.start()
+        assert names
+        drv = get_driver(names[0])
+        task = mock.job().task_groups[0].tasks[0]
+        task.driver = names[0]
+        task.config = {"command": "/bin/sh",
+                       "args": ["-c", "sleep 60"]}
+        tdir = tmp_path / "task"
+        tdir.mkdir()
+        handle = drv.start_task(task, {}, str(tdir))
+        got = {}
+
+        def waiter():
+            got["res"] = handle.wait(timeout=30.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.5)  # waiter is parked in a long poll
+        t0 = time.time()
+        handle.kill(grace_s=1.0)
+        kill_latency = time.time() - t0
+        assert kill_latency < 5.0, kill_latency
+        t.join(timeout=30.0)
+        assert got.get("res") is not None
+        pm.stop()
