@@ -588,45 +588,78 @@ def headline_spread_1k() -> None:
 
 
 def cfg7_sharded_5k() -> None:
-    """SURVEY §5 long-axis scaling, measured honestly: the 5K-node exact
-    placement solve through solve_task_group_sharded on the virtual
-    8-device CPU mesh vs the SAME kernel on one CPU device — the
-    sharded-vs-single comparison the multi-chip design claims must face.
-    Runs in a subprocess because the bench process owns the real
-    accelerator backend and the virtual mesh needs
+    """SURVEY §5 long-axis scaling: the BULK ENGINE (the C2M path) on
+    the virtual 8-device CPU mesh vs the SAME engine single-device —
+    16 chained 512-alloc evals against one usage carry at 10,240 nodes
+    (solve_bulk_multi vs tensor/sharding.make_solve_bulk_multi_sharded,
+    whose collective cadence is ONE all-gather per eval; round 4's
+    per-placement-argmax sharding ran 0.137x single and is retained
+    only for the general spread/distinct-hosts semantics). Runs in a
+    subprocess because the bench process owns the real accelerator
+    backend and the virtual mesh needs
     xla_force_host_platform_device_count. vs_baseline is
-    single/sharded wall-clock: >1 means 8-way sharding with its
-    per-step global argmax collectives actually helps at this scale;
-    <1 means it loses (report either way — the collectives are latency,
-    not throughput, and 5K nodes may be below the crossover)."""
+    single/sharded wall-clock; parity is bit-exact counts + carry
+    agreement."""
     import os
     import subprocess
 
     script = r"""
 import json, time
 import numpy as np
-import __graft_entry__ as graft
-from nomad_tpu.tensor.sharding import node_mesh, solve_task_group_sharded
 import jax
 
-args = graft._example_solve_args(n_nodes=5120, k=512, s=1, v=8)
+jax.config.update('jax_platforms', 'cpu')
+from nomad_tpu.tensor.kernels import solve_bulk_multi
+from nomad_tpu.tensor.sharding import (make_solve_bulk_multi_sharded,
+                                       node_mesh, shard_bulk_state)
+
+rng = np.random.RandomState(0)
+n, d, g, k_each = 10240, 4, 16, 512
+f = np.float32
+avail = np.stack([
+    rng.choice([8000, 16000, 32000], n),
+    rng.choice([16384, 32768, 65536], n),
+    np.full(n, 100 * 1024),
+    np.full(n, 12001),
+], axis=1).astype(f)
+used0 = np.zeros((n, d), f)
+feas = rng.rand(g, n) > 0.1
+aff = np.zeros((g, n), f)
+ask = np.tile(np.array([50.0, 32.0, 0.0, 0.0], f), (g, 1))
+k = np.full(g, k_each, np.int32)
+seeds = np.arange(g).astype(np.uint32)
+cidx = np.zeros(64, np.int32)
+cdelta = np.zeros((64, d), f)
+
 devs = jax.devices()
 assert len(devs) == 8, devs
 mesh8 = node_mesh(devs)
-mesh1 = node_mesh(devs[:1])
+solve8 = make_solve_bulk_multi_sharded(mesh8)
 out = {}
-for name, mesh in (("sharded8", mesh8), ("single", mesh1)):
-    c, f, s = solve_task_group_sharded(mesh, args)  # compile
-    np.asarray(c)
+
+def run_single():
+    u = jax.device_put(used0)
+    a = jax.device_put(avail)
+    return solve_bulk_multi(u, a, feas, aff, ask, k,
+                            np.ones(g, f), seeds, cidx, cdelta, g=g)
+
+def run_sharded():
+    u, a = shard_bulk_state(mesh8, used0, avail)
+    return solve8(u, a, feas, aff, ask, k, seeds, cidx, cdelta, g=g)
+
+for name, fn in (("single", run_single), ("sharded8", run_sharded)):
+    _, c = fn()
+    np.asarray(c)  # compile + settle
     t0 = time.perf_counter()
     for _ in range(3):
-        c, f, s = solve_task_group_sharded(mesh, args)
+        _, c = fn()
         np.asarray(c)
     out[name] = (time.perf_counter() - t0) / 3
-c8, _, s8 = map(np.asarray, solve_task_group_sharded(mesh8, args))
-c1, _, s1 = map(np.asarray, solve_task_group_sharded(mesh1, args))
-out["parity"] = bool((c8 == c1).all()
-                     and np.allclose(s8, s1, atol=1e-5))
+u1, c1 = run_single()
+u8, c8 = run_sharded()
+out["parity"] = bool((np.asarray(c8) == np.asarray(c1)).all()
+                     and np.allclose(np.asarray(u8), np.asarray(u1),
+                                     atol=1e-3))
 print(json.dumps(out))
 """
     env = dict(os.environ,
@@ -643,8 +676,8 @@ print(json.dumps(out))
             f"sharded bench subprocess failed (rc {proc.returncode}): "
             f"{proc.stderr[-2000:]}")
     out = json.loads(lines[-1])
-    emit("sharded_solve_512_allocs_5k_nodes_8dev",
-         512 / out["sharded8"], "allocs/s",
+    emit("sharded_bulk_8k_allocs_10k_nodes_8dev",
+         (16 * 512) / out["sharded8"], "allocs/s",
          out["single"] / out["sharded8"],
          sharded_s=out["sharded8"], single_s=out["single"],
          parity=out["parity"])

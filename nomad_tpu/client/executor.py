@@ -125,11 +125,25 @@ def setup_isolation(spec: dict):
                       MS_REMOUNT | MS_BIND | MS_RDONLY | MS_REC)
             except OSError:
                 pass
-        # devices: bind the host /dev (the reference's device allowlist
-        # rides libcontainer; a bind keeps /dev/null|zero|urandom usable)
+        # devices: a MINIMAL /dev of file-binds (the reference's
+        # libcontainer device allowlist is the same standard set) — a
+        # recursive host-/dev bind would hand the task the host's block
+        # and memory devices, a chroot escape for a root-inside task
         dev = os.path.join(root, "dev")
         os.makedirs(dev, exist_ok=True)
-        mount("/dev", dev, None, MS_BIND | MS_REC)
+        for name in ("null", "zero", "full", "random", "urandom", "tty"):
+            src = "/dev/" + name
+            if not os.path.exists(src):
+                continue
+            dst = os.path.join(dev, name)
+            try:
+                if not os.path.exists(dst):
+                    with open(dst, "w"):
+                        pass
+                mount(src, dst, None, MS_BIND)
+            except OSError:
+                continue
+        os.makedirs(os.path.join(dev, "shm"), exist_ok=True)
         os.makedirs(os.path.join(root, "proc"), exist_ok=True)
         os.makedirs(os.path.join(root, "tmp"), exist_ok=True)
     except OSError:

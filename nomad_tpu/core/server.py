@@ -862,11 +862,26 @@ class Server:
                 from ..structs.plan import PlanResult
 
                 self.plans.append(plan)
-                return PlanResult(
+                result = PlanResult(
                     node_allocation=plan.node_allocation,
                     node_update=plan.node_update,
                     node_preemptions=plan.node_preemptions,
-                    alloc_index=snap.index), None
+                    alloc_index=snap.index)
+                # nothing commits in a dry run: the planner contract
+                # still requires post-apply hooks to fire, with every
+                # planned node marked rejected so a bulk solve's
+                # solver-service ledger entry is corrected out of the
+                # usage carry instead of lingering until its TTL
+                rejected = set(plan.node_allocation)
+                for b in plan.alloc_blocks:
+                    rejected.update(b.node_ids)
+                result.rejected_nodes = sorted(rejected)
+                for hook in plan.post_apply_hooks:
+                    try:
+                        hook(result)
+                    except Exception:
+                        pass
+                return result, None
 
             def update_eval(self, ev):
                 self.evals.append(ev)

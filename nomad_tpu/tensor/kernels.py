@@ -26,6 +26,14 @@ import jax
 import jax.numpy as jnp
 
 NEG = -1.0e30  # "infeasible" score sentinel
+# additive tie-break jitter for the bulk engine's sort key (see
+# solve_bulk_multi). Sized between the two constraints: far below any
+# meaningful score gap (normalized scores live in [0, ~1.5] and the
+# bench's score-parity margin is ~1e-3), far ABOVE the f32 ulp at the
+# top of that range (np.spacing(1.0f) = 1.19e-7 — a jitter at or below
+# the ulp would be rounded away exactly where BestFit ties concentrate,
+# collapsing racing workers onto the same nodes again)
+TIE_JITTER = 3.0e-5
 BINPACK_MAX_FIT_SCORE = 18.0  # reference scheduler/rank.go:18
 
 
@@ -658,12 +666,19 @@ def solve_bulk_multi(
     # fold queued usage corrections into the carry (scatter-add; the
     # clamp guards against a correction racing a concurrent resync)
     used0 = jnp.maximum(used0.at[cidx].add(cdelta), 0.0)
-    perms = jax.vmap(
-        lambda s: jax.random.permutation(jax.random.PRNGKey(s), n)
-    )(seeds).astype(jnp.int32)                                     # (G, N)
+    # Tie-breaks: a per-(eval, node) additive score jitter << any
+    # meaningful score gap replaces the old permutation+stable-argsort
+    # scheme. Same decorrelation of racing workers' choices among
+    # equal-scoring nodes, but the sort key becomes a plain float —
+    # which is what lets the SHARDED twin of this kernel
+    # (tensor/sharding.make_solve_bulk_multi_sharded) use per-shard
+    # top-k + a small gathered merge instead of a replicated full sort.
+    jits = jax.vmap(
+        lambda s: jax.random.uniform(jax.random.PRNGKey(s), (n,),
+                                     jnp.float32, 0.0, TIE_JITTER)
+    )(seeds)                                                       # (G, N)
 
     def one_eval(used, gi):
-        perm = perms[gi]
         ask_g = ask[gi]
         ask_pos = ask_g > 0
         new_used = used + ask_g[None, :]
@@ -684,18 +699,12 @@ def solve_bulk_multi(
         cap = jnp.where(score > NEG, cap, 0.0)
         budget = k[gi]
         cap = jnp.minimum(cap, budget.astype(cap.dtype)).astype(jnp.int32)
-        # tie-break in permuted node space: identical trajectory to
-        # _bulk_scan's upfront permutation, expressed as gathers so the
-        # shared `used` carry stays canonical across evals with
-        # different permutations
-        sp = score[perm]
-        cp = cap[perm]
-        order_p = jnp.argsort(-sp)                # ties: permuted index
-        cap_sorted = cp[order_p]
+        key = score + jits[gi]
+        order = jnp.argsort(-key)            # residual ties: node index
+        cap_sorted = cap[order]
         cum = jnp.cumsum(cap_sorted)
         take_sorted = jnp.clip(budget - (cum - cap_sorted), 0, cap_sorted)
-        take_p = jnp.zeros(n, jnp.int32).at[order_p].set(take_sorted)
-        take = jnp.zeros(n, jnp.int32).at[perm].set(take_p)
+        take = jnp.zeros(n, jnp.int32).at[order].set(take_sorted)
         used = used + ask_g[None, :] * take[:, None].astype(used.dtype)
         return used, take.astype(jnp.int16)
 

@@ -55,28 +55,40 @@ import numpy as np
 _STOP = object()
 
 
-def ensure_resident(static, feas_base, aff):
+def ensure_resident(static, feas_base, aff, mesh=None):
     """Device-resident (capacity, mask, affinity) arrays for one
     ClusterStatic, uploaded once and cached in static.device_arrays —
     masks/boosts keyed by host-array identity (the static's mask_cache /
     aff_cache hold the strong refs, so ids can't be recycled). The ONE
-    place the cache-key protocol lives; used by both the service and
-    the placer's single-eval fused path."""
+    place the cache-key protocol lives; used by the service (single and
+    mesh layouts, distinguished by a cache-key tag) and the placer's
+    single-eval fused path."""
     import jax
 
+    if mesh is None:
+        put_mat = put_row = jax.device_put
+        tag = ""
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mat_sh = NamedSharding(mesh, P("nodes", None))
+        row_sh = NamedSharding(mesh, P("nodes"))
+        put_mat = lambda x: jax.device_put(x, mat_sh)  # noqa: E731
+        put_row = lambda x: jax.device_put(x, row_sh)  # noqa: E731
+        tag = "sh"
     da = static.device_arrays
-    avail = da.get("avail")
+    avail = da.get("avail" + tag)
     if avail is None:
-        avail = da["avail"] = jax.device_put(
+        avail = da["avail" + tag] = put_mat(
             static.available.astype(np.float32))
-    mkey = ("m", id(feas_base))
+    mkey = ("m" + tag, id(feas_base))
     m = da.get(mkey)
     if m is None:
-        m = da[mkey] = jax.device_put(feas_base)
-    akey = ("a", id(aff))
+        m = da[mkey] = put_row(feas_base)
+    akey = ("a" + tag, id(aff))
     a = da.get(akey)
     if a is None:
-        a = da[akey] = jax.device_put(aff.astype(np.float32))
+        a = da[akey] = put_row(aff.astype(np.float32))
     return avail, m, a
 
 
@@ -134,9 +146,36 @@ class BulkSolverService:
         self._token = 0
         self._ledger: Dict[int, _LedgerEntry] = {}
         self._corrections: List[tuple] = []  # (node_row, delta_vec)
+        # mesh scale-out: when the process owns >1 accelerator, the
+        # usage carry + capacity/mask rows shard over a node-axis mesh
+        # and launches go through solve_bulk_multi_sharded (ONE
+        # all-gather per eval — tensor/sharding.py). Resolved lazily on
+        # the service thread; _mesh stays None on single-device hosts.
+        self._mesh = None
+        self._mesh_resolved = False
+        self._mesh_solve = None
         # launch telemetry
         self.stats = {"launches": 0, "solves": 0, "resyncs": 0,
-                      "launch_s": 0.0, "corrections": 0}
+                      "launch_s": 0.0, "corrections": 0, "sharded": 0}
+
+    def _resolve_mesh(self, n_pad: int):
+        """Largest power-of-two device mesh that divides the padded node
+        axis, or None for single-device."""
+        if not self._mesh_resolved:
+            self._mesh_resolved = True
+            import jax
+
+            devs = jax.devices()
+            if len(devs) > 1:
+                from .sharding import make_solve_bulk_multi_sharded, node_mesh
+
+                n = 1 << (len(devs).bit_length() - 1)
+                self._mesh = node_mesh(devs[:n])
+                self._mesh_solve = make_solve_bulk_multi_sharded(self._mesh)
+        if self._mesh is None:
+            return None
+        n_dev = len(self._mesh.devices.reshape(-1))
+        return self._mesh if n_pad % n_dev == 0 else None
 
     # -- caller side (scheduler worker threads) --
 
@@ -238,17 +277,19 @@ class BulkSolverService:
                     if not r.future.done():
                         r.future.set_exception(e)
 
-    def _device_arrays(self, static, rs):
-        """Resident capacity + stacked per-eval mask/affinity arrays;
-        the stacked (G, N) combinations are cached by the tuple of the
-        underlying host-array ids — repeated batches of the same
-        task-group shapes ship nothing."""
+    def _device_arrays(self, static, rs, mesh=None):
+        """Resident capacity + stacked per-eval mask/affinity arrays
+        (node-axis sharded over `mesh` when given); the stacked (G, N)
+        combinations are cached by the tuple of the underlying
+        host-array ids — repeated batches of the same task-group shapes
+        ship nothing."""
         import jax.numpy as jnp
 
         da = static.device_arrays
         rows_m, rows_a = [], []
         for r in rs:
-            avail, m, a = ensure_resident(static, r.feas_base, r.aff)
+            avail, m, a = ensure_resident(static, r.feas_base, r.aff,
+                                          mesh=mesh)
             rows_m.append((id(r.feas_base), m))
             rows_a.append((id(r.aff), a))
         g_pad = 1 if len(rs) == 1 else self.G_PAD
@@ -261,7 +302,8 @@ class BulkSolverService:
         # permutation would pin unbounded device memory
         uniform = (all(i == rows_m[0][0] for i, _ in rows_m)
                    and all(i == rows_a[0][0] for i, _ in rows_a))
-        skey = ("stack", g_pad, rows_m[0][0], rows_a[0][0])
+        skey = ("stack" + ("sh" if mesh is not None else ""), g_pad,
+                rows_m[0][0], rows_a[0][0])
         stacked = da.get(skey) if uniform else None
         if stacked is None:
             # on-device stack: no host transfer
@@ -280,6 +322,7 @@ class BulkSolverService:
         t0 = _time.perf_counter()
         static = rs[0].static
         d = static.available.shape[1]
+        mesh = self._resolve_mesh(static.n_pad)
         state = self._state
         used_dev, since = None, 0
         if state is not None and state[0] is static:
@@ -312,7 +355,13 @@ class BulkSolverService:
                 corrections = self._corrections
                 self._corrections = []
         if need_resync:
-            used_dev = jax.device_put(base)
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                used_dev = jax.device_put(
+                    base, NamedSharding(mesh, P("nodes", None)))
+            else:
+                used_dev = jax.device_put(base)
             since = 0
             self.stats["resyncs"] += 1
 
@@ -322,7 +371,7 @@ class BulkSolverService:
             cidx[i] = row
             cdelta[i] = delta
 
-        avail, feas, aff, g_pad = self._device_arrays(static, rs)
+        avail, feas, aff, g_pad = self._device_arrays(static, rs, mesh)
         g = len(rs)
         ask = np.zeros((g_pad, d), dtype=np.float32)
         k = np.zeros(g_pad, dtype=np.int32)
@@ -334,9 +383,15 @@ class BulkSolverService:
             tgc[i] = r.tg_count
             seeds[i] = r.seed
 
-        new_used, counts = solve_bulk_multi(
-            used_dev, avail, feas, aff, ask, k, tgc, seeds, cidx, cdelta,
-            g=g_pad)
+        if mesh is not None:
+            new_used, counts = self._mesh_solve(
+                used_dev, avail, feas, aff, ask, k, seeds, cidx, cdelta,
+                g=g_pad)
+            self.stats["sharded"] += 1
+        else:
+            new_used, counts = solve_bulk_multi(
+                used_dev, avail, feas, aff, ask, k, tgc, seeds, cidx,
+                cdelta, g=g_pad)
         counts_np = np.asarray(counts)  # ONE readback for the whole batch
         self._state = (static, new_used, since + g)
         self.stats["launches"] += 1

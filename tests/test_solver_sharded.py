@@ -1,0 +1,24 @@
+"""Solver service engages the sharded bulk engine on a multi-device
+mesh (round 5: the carry itself shards; tensor/sharding.py
+make_solve_bulk_multi_sharded)."""
+
+import bench
+from nomad_tpu import mock
+from nomad_tpu.structs import enums
+from nomad_tpu.structs.operator import SchedulerConfiguration
+from nomad_tpu.testing import Harness
+from nomad_tpu.tensor.solver import get_service
+
+def test_sharded_service_engages():
+    h = Harness()
+    bench.build_nodes(h.store, 512)
+    cfg = SchedulerConfiguration(scheduler_algorithm=enums.SCHED_ALG_TPU_BINPACK)
+    jobs = [bench.service_job(1000, cpu=50, mem=32, batch=True) for _ in range(3)]
+    for j in jobs:
+        h.store.upsert_job(j)
+        h.process(mock.eval_for(j), sched_config=cfg)
+    snap = h.store.snapshot()
+    placed = sum(len(snap.allocs_by_job(j.id)) for j in jobs)
+    assert placed == 3000, placed
+    stats = get_service().stats
+    assert stats["sharded"] >= 3, stats
