@@ -1,0 +1,91 @@
+#!/usr/bin/env python3
+"""Example external VOLUME plugin: host-path volumes over the
+subprocess plugin protocol (the storage-role analog of
+python_exec.py; reference plugins/csi/plugin.go node RPCs).
+
+The agent launches this from --plugin-dir; it handshakes with
+type="volume" and serves the mount lifecycle:
+
+    stage_volume    ensure the backing dir exists, link it into staging
+    publish_volume  symlink the staged source at the alloc target
+    unpublish/unstage  reverse the above
+
+Writes a small audit log next to the backing dir so tests (and
+operators) can see the lifecycle happen in the external process.
+"""
+
+import json
+import os
+import time
+
+from nomad_tpu.plugins.sdk import serve
+
+
+class HostPathVolumePlugin:
+    plugin_type = "volume"
+    plugin_id = name = "host-path"
+
+    def _audit(self, params, event, **kw):
+        base = (params or {}).get("path", "")
+        if not base:
+            return
+        try:
+            with open(base + ".audit.jsonl", "a") as f:
+                f.write(json.dumps({"event": event, "ts": time.time(),
+                                    "pid": os.getpid(), **kw}) + "\n")
+        except OSError:
+            pass
+
+    def probe(self):
+        return {"healthy": True}
+
+    def stage_volume(self, volume_id, staging_path, params=None):
+        src = (params or {}).get("path", "")
+        if not src:
+            raise ValueError(f"{volume_id}: params.path required")
+        os.makedirs(src, exist_ok=True)
+        os.makedirs(staging_path, exist_ok=True)
+        link = os.path.join(staging_path, "src")
+        if not os.path.islink(link):
+            os.symlink(src, link)
+        self._audit(params, "stage", volume_id=volume_id)
+        return {}
+
+    def publish_volume(self, volume_id, staging_path, target_path,
+                       read_only=False, params=None):
+        src = os.path.realpath(os.path.join(staging_path, "src"))
+        os.makedirs(os.path.dirname(target_path), exist_ok=True)
+        if os.path.islink(target_path):
+            os.unlink(target_path)
+        os.symlink(src, target_path)
+        self._audit(params, "publish", volume_id=volume_id,
+                    target=target_path)
+        return {"path": target_path}
+
+    def unpublish_volume(self, volume_id, target_path):
+        base = os.path.realpath(target_path) if os.path.islink(target_path) \
+            else ""
+        try:
+            os.unlink(target_path)
+        except OSError:
+            pass
+        if base:
+            self._audit({"path": base}, "unpublish", volume_id=volume_id,
+                        target=target_path)
+        return {}
+
+    def unstage_volume(self, volume_id, staging_path):
+        src = ""
+        try:
+            src = os.path.realpath(os.path.join(staging_path, "src"))
+            os.unlink(os.path.join(staging_path, "src"))
+            os.rmdir(staging_path)
+        except OSError:
+            pass
+        if src:
+            self._audit({"path": src}, "unstage", volume_id=volume_id)
+        return {}
+
+
+if __name__ == "__main__":
+    serve(HostPathVolumePlugin())

@@ -29,7 +29,7 @@ class AllocRunner:
                  on_update: Optional[Callable] = None,
                  state_db=None, restored_handles: Optional[Dict] = None,
                  prev_runner_lookup: Optional[Callable] = None,
-                 services_api=None):
+                 services_api=None, volumes_api=None, volume_manager=None):
         self.alloc = alloc
         self.node = node
         self.data_dir = data_dir
@@ -37,6 +37,11 @@ class AllocRunner:
         # service registration endpoint surface (the server or an HTTP
         # facade): upsert_service_registrations / delete_services_by_alloc
         self.services_api = services_api
+        # registered-volume reads (anything with a store snapshot) + the
+        # client's shared mount-lifecycle manager (client/volumes.py)
+        self.volumes_api = volumes_api
+        self.volume_manager = volume_manager
+        self.volume_mounts: Dict[str, str] = {}  # volume name -> path
         self.check_runner = None
         # deployment health verdict: None until decided, else (bool, ts)
         # — synced to the server as alloc.deployment_status (reference
@@ -76,6 +81,8 @@ class AllocRunner:
             return
         self.allocdir.build()
         self._await_previous()
+        if not self._mount_volumes():
+            return
 
         def make_runner(task) -> TaskRunner:
             td = self.allocdir.build_task_dir(task.name)
@@ -85,7 +92,8 @@ class AllocRunner:
                             restart_policy=self.tg.restart_policy,
                             on_handle=self._on_task_handle,
                             recovered_handle=self.restored_handles.get(task.name),
-                            logs_dir=self.allocdir.logs)
+                            logs_dir=self.allocdir.logs,
+                            volume_mounts=self.volume_mounts)
             self.task_runners[task.name] = tr
             return tr
 
@@ -117,6 +125,7 @@ class AllocRunner:
                         f"prestart task {t.name} "
                         f"{'failed' if finished else 'deadline exceeded'}")
                     self._kill_all()
+                    self._unmount_volumes()
                     return
 
         main_runners = [make_runner(t) for t in mains]
@@ -145,6 +154,7 @@ class AllocRunner:
         for r in post_runners:
             if not r.wait_dead(timeout=PRESTART_DEADLINE_S):
                 r.kill()
+        self._unmount_volumes()
         self._recompute_status()
 
     def _await_previous(self) -> None:
@@ -247,11 +257,68 @@ class AllocRunner:
         threading.Thread(target=watch, daemon=True,
                          name=f"health-{self.alloc.id[:8]}").start()
 
+    # -- volume mount lifecycle (reference client/allocrunner csi_hook +
+    #    client/pluginmanager/csimanager/volume.go) --
+
+    def _mount_volumes(self) -> bool:
+        """Stage/publish every csi-type group volume through its plugin
+        before any task starts; a mount failure fails the alloc (the
+        reference csi_hook's prerun contract). -> ok?"""
+        if self.tg is None or not self.tg.volumes:
+            return True
+        from ..plugins.volumes import get_volume_plugin
+
+        for name, req in self.tg.volumes.items():
+            if req.type == "host":
+                # node-exposed path: scheduling guaranteed this node has
+                # it; the path comes straight from the fingerprint
+                hv = (self.node.host_volumes or {}).get(req.source)
+                if hv is None:
+                    self._mount_failed(f"host volume {req.source} "
+                                       "not exposed by this node")
+                    return False
+                self.volume_mounts[name] = hv.path
+                continue
+            if self.volume_manager is None:
+                continue
+            source = req.source
+            vol = None
+            if self.volumes_api is not None:
+                try:
+                    vol = self.volumes_api.store.snapshot().volume_by_id(
+                        source, self.alloc.namespace)
+                except Exception:
+                    vol = None
+            if vol is None:
+                self._mount_failed(f"volume {source} not found")
+                return False
+            try:
+                plugin = get_volume_plugin(vol.plugin_id)
+                path = self.volume_manager.mount(
+                    plugin, vol, self.alloc.id, name, self.allocdir.root,
+                    read_only=req.read_only)
+            except Exception as e:
+                self._mount_failed(f"volume {source} mount failed: {e}")
+                return False
+            self.volume_mounts[name] = path
+        return True
+
+    def _mount_failed(self, desc: str) -> None:
+        """A partial mount failure must not leak the mounts that DID
+        land (publish targets + staging refcounts)."""
+        self._unmount_volumes()
+        self._set_status(enums.ALLOC_CLIENT_FAILED, desc)
+
+    def _unmount_volumes(self) -> None:
+        if self.volume_manager is not None:
+            self.volume_manager.unmount_alloc(self.alloc.id)
+
     def stop(self) -> None:
         """Server asked for a stop (desired_status=stop/evict)."""
         self._destroyed = True
         self._deregister_services()
         self._kill_all()
+        self._unmount_volumes()
 
     def destroy(self) -> None:
         self.stop()

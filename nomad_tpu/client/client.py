@@ -76,6 +76,11 @@ class Client:
         self._dirty_lock = threading.Lock()        # guards self._dirty
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        from .volumes import VolumeManager
+
+        # shared mount-lifecycle manager (reference csimanager): staging
+        # refcounted per volume, publishes per alloc
+        self.volume_manager = VolumeManager(self.config.data_dir)
         from .hoststats import HostStatsCollector
 
         self.hoststats = HostStatsCollector(
@@ -174,7 +179,9 @@ class Client:
                                  on_update=self._mark_dirty,
                                  state_db=self.state_db,
                                  restored_handles=recovered,
-                                 services_api=self.server)
+                                 services_api=self.server,
+                                 volumes_api=self.server,
+                                 volume_manager=self.volume_manager)
             with self._lock:
                 self.runners[alloc.id] = runner
             runner.run()
@@ -279,7 +286,9 @@ class Client:
                                      on_update=self._mark_dirty,
                                      state_db=self.state_db,
                                      prev_runner_lookup=self.runners.get,
-                                     services_api=self.server)
+                                     services_api=self.server,
+                                     volumes_api=self.server,
+                                     volume_manager=self.volume_manager)
                 self.runners[alloc_id] = runner
                 self.state_db.put_alloc(alloc)
                 starts.append(runner)

@@ -77,6 +77,17 @@ class DriverError(Exception):
     pass
 
 
+def _safe_mount_dest(dest: str) -> str:
+    """Normalize a job-controlled VolumeMount destination to a relative
+    path that CANNOT escape the task root ('' when it would): '..'
+    segments in a destination would otherwise let a job bind or symlink
+    volume content over arbitrary host paths as root."""
+    norm = os.path.normpath("/" + (dest or "")).lstrip("/")
+    if not norm or norm == "." or norm.startswith(".."):
+        return ""
+    return norm
+
+
 # ---------------------------------------------------------------------------
 # mock driver
 # ---------------------------------------------------------------------------
@@ -113,7 +124,7 @@ class MockDriver:
     name = "mock"
 
     def start_task(self, task, env: Dict[str, str], task_dir: str,
-                   io=None) -> TaskHandle:
+                   io=None, mounts=None) -> TaskHandle:
         cfg = task.config or {}
         if io is not None:  # exercise the log path like a real driver
             fd = io.stream_fd("stdout")
@@ -381,7 +392,7 @@ class RawExecDriver:
         return {**os.environ, **env}
 
     def start_task(self, task, env: Dict[str, str], task_dir: str,
-                   io=None) -> TaskHandle:
+                   io=None, mounts=None) -> TaskHandle:
         import sys
 
         cfg = task.config or {}
@@ -417,6 +428,33 @@ class RawExecDriver:
             spec["isolation"] = True
             if task.user:
                 spec["user"] = task.user
+        if mounts and have_dir:
+            # group volume mounts (client/volumes.py published paths):
+            # isolated tasks get a real bind inside the chroot at the
+            # task's VolumeMount destination; unconfined tasks get a
+            # symlink in the task dir (the path rides the env either way,
+            # NOMAD_ALLOC_VOLUME_*). On the unconfined path read_only is
+            # ADVISORY (a symlink cannot enforce it) — enforcement needs
+            # the exec driver's chroot binds, matching raw_exec's
+            # documented no-isolation contract.
+            binds = []
+            for vm in (task.volume_mounts or []):
+                src = mounts.get(vm.volume)
+                if not src:
+                    continue
+                dest = _safe_mount_dest(vm.destination) or vm.volume
+                if spec.get("isolation"):
+                    binds.append([os.path.realpath(src), dest,
+                                  bool(vm.read_only)])
+                else:
+                    link = os.path.join(task_dir, dest)
+                    os.makedirs(os.path.dirname(link), exist_ok=True)
+                    if os.path.islink(link):
+                        os.unlink(link)
+                    if not os.path.exists(link):
+                        os.symlink(os.path.realpath(src), link)
+            if binds:
+                spec["volume_binds"] = binds
         try:
             os.unlink(spec["status_file"])  # stale status from a prior run
         except OSError:

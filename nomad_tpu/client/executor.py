@@ -146,6 +146,28 @@ def setup_isolation(spec: dict):
         os.makedirs(os.path.join(dev, "shm"), exist_ok=True)
         os.makedirs(os.path.join(root, "proc"), exist_ok=True)
         os.makedirs(os.path.join(root, "tmp"), exist_ok=True)
+        # group volume mounts bind INTO the chroot at their VolumeMount
+        # destinations (reference csimanager mounts + libcontainer
+        # binds). Defense in depth: the driver already normalizes the
+        # job-controlled destination, but re-anchor + containment-check
+        # here so a traversal can never bind over host paths. read_only
+        # remount is best-effort on old kernels (recursive ro); a
+        # failure leaves the bind RW rather than failing the task —
+        # same posture as the system-dir binds above.
+        rootr = os.path.realpath(root)
+        for src, dest, ro in spec.get("volume_binds") or []:
+            dst = os.path.normpath(
+                os.path.join(rootr, (dest or "").lstrip("/")))
+            if dst == rootr or not dst.startswith(rootr + os.sep):
+                continue
+            os.makedirs(dst, exist_ok=True)
+            mount(src, dst, None, MS_BIND | MS_REC)
+            if ro:
+                try:
+                    mount(None, dst, None,
+                          MS_REMOUNT | MS_BIND | MS_RDONLY | MS_REC)
+                except OSError:
+                    pass
     except OSError:
         return None, spec.get("cwd")
     prefix = [unshare_bin, "--fork", "--pid", "--mount", "--ipc",

@@ -31,13 +31,18 @@ class TaskRunner:
                  restart_policy: Optional[RestartPolicy] = None,
                  on_handle: Optional[Callable] = None,
                  recovered_handle=None,
-                 logs_dir: str = ""):
+                 logs_dir: str = "",
+                 volume_mounts=None):
         self.alloc = alloc
         self.task = task
         self.node = node
         self.task_dir = task_dir
         self.shared_dir = shared_dir
         self.logs_dir = logs_dir
+        # group volume name -> host path published for this alloc
+        # (client/volumes.py VolumeManager; reference taskrunner
+        # volume_hook mounts)
+        self.volume_mounts = volume_mounts or {}
         self.on_state_change = on_state_change
         self.policy = restart_policy or RestartPolicy()
         # persistence: on_handle(task_name, handle_data) records the
@@ -84,6 +89,10 @@ class TaskRunner:
             else:
                 env = taskenv.build_env(self.alloc, self.task, self.node,
                                         self.task_dir, self.shared_dir)
+                for vname, vpath in self.volume_mounts.items():
+                    safe = "".join(c if c.isalnum() else "_"
+                                   for c in vname).upper()
+                    env[f"NOMAD_ALLOC_VOLUME_{safe}"] = vpath
                 config = taskenv.interpolate_config(self.task.config or {},
                                                     self.node, env)
                 run_task = _interpolated_task(self.task, config)
@@ -93,7 +102,8 @@ class TaskRunner:
                     # every driver takes it, logmon-less ones ignore it
                     self._handle = driver.start_task(run_task, env,
                                                      self.task_dir,
-                                                     io=self._logmon())
+                                                     io=self._logmon(),
+                                                     mounts=self.volume_mounts)
                 except DriverError as e:
                     self._event("Driver Failure", str(e))
                     if not self._should_restart(failed_start=True):
@@ -219,4 +229,5 @@ def _interpolated_task(task: Task, config: dict) -> Task:
         name=task.name, driver=task.driver, config=config, env=task.env,
         resources=task.resources, kill_timeout_s=task.kill_timeout_s,
         user=task.user, meta=task.meta,
+        volume_mounts=list(task.volume_mounts),
     )

@@ -144,7 +144,8 @@ class ExternalDriver:
         except PluginError:
             return {"healthy": False, "attributes": {}}
 
-    def start_task(self, task, env, task_dir: str, io=None) -> TaskHandle:
+    def start_task(self, task, env, task_dir: str, io=None,
+                   mounts=None) -> TaskHandle:
         from ..client.drivers import DriverError
 
         try:
@@ -173,6 +174,7 @@ class PluginInstance:
     def __init__(self, path: str, logger=None):
         self.path = path
         self.name = ""
+        self.plugin_type = "driver"
         self.logger = logger
         self._proc: Optional[subprocess.Popen] = None
         self._conn: Optional[_Conn] = None
@@ -200,11 +202,13 @@ class PluginInstance:
             hello = json.loads(line or b"{}")
         except ValueError:
             hello = {}
-        if hello.get("type") != "driver" or not hello.get("name"):
+        if hello.get("type") not in ("driver", "volume") \
+                or not hello.get("name"):
             self.stop()
             raise PluginError(
                 f"{self.path}: bad plugin handshake {line!r}")
         self.name = hello["name"]
+        self.plugin_type = hello["type"]
         # the socket may land a beat after the handshake line
         deadline = time.time() + HANDSHAKE_TIMEOUT
         while not os.path.exists(self._sock_path):
@@ -340,7 +344,7 @@ class PluginManager:
                     self.logger.exception("plugin %s failed to launch", path)
                 continue
             self.instances.append(inst)
-            register_driver(ExternalDriver(inst))
+            self._register(inst)
             names.append(inst.name)
         if self.instances:
             self._thread = threading.Thread(target=self._supervise,
@@ -348,6 +352,17 @@ class PluginManager:
                                             name="plugin-manager")
             self._thread.start()
         return names
+
+    @staticmethod
+    def _register(inst: PluginInstance) -> None:
+        """Role dispatch: task drivers join the driver registry, storage
+        plugins the volume-plugin registry (plugins/volumes.py)."""
+        if inst.plugin_type == "volume":
+            from .volumes import ExternalVolumePlugin, register_volume_plugin
+
+            register_volume_plugin(ExternalVolumePlugin(inst))
+        else:
+            register_driver(ExternalDriver(inst))
 
     def _supervise(self) -> None:
         """Relaunch dead plugins with backoff (reference drivermanager
@@ -360,7 +375,7 @@ class PluginManager:
                 try:
                     inst.stop()
                     inst.launch()
-                    register_driver(ExternalDriver(inst))
+                    self._register(inst)
                     if self.logger:
                         self.logger.info("plugin %s relaunched", inst.name)
                 except PluginError:

@@ -45,9 +45,14 @@ def serve(driver) -> None:
         pass
     srv.bind(path)
     srv.listen(4)
-    # the handshake line: the agent reads exactly one stdout line
-    sys.stdout.write(json.dumps({"proto": PROTO_VERSION, "type": "driver",
-                                 "name": driver.name}) + "\n")
+    # the handshake line: the agent reads exactly one stdout line.
+    # plugin_type selects the role: "driver" (task drivers — the
+    # object's `name` is the driver name) or "volume" (storage plugins,
+    # reference plugins/csi — `name` is the plugin_id)
+    ptype = getattr(driver, "plugin_type", "driver")
+    name = getattr(driver, "name", "") or getattr(driver, "plugin_id", "")
+    sys.stdout.write(json.dumps({"proto": PROTO_VERSION, "type": ptype,
+                                 "name": name}) + "\n")
     sys.stdout.flush()
 
     def handle_conn(conn: socket.socket) -> None:

@@ -1,0 +1,150 @@
+"""Storage (volume) plugin boundary (reference plugins/csi/plugin.go +
+client/pluginmanager/csimanager).
+
+A volume plugin implements the node-side mount lifecycle for registered
+volumes (structs/volumes.py Volume, plugin_id selects the plugin):
+
+    probe() -> {"healthy": bool}
+    stage_volume(volume_id, staging_path, params)      (NodeStageVolume)
+    publish_volume(volume_id, staging_path, target_path,
+                   read_only, params) -> {"path": str} (NodePublishVolume)
+    unpublish_volume(volume_id, target_path)           (NodeUnpublishVolume)
+    unstage_volume(volume_id, staging_path)            (NodeUnstageVolume)
+
+External plugins ride the same subprocess protocol as driver plugins
+(plugins/protocol.py) with handshake type "volume"; the builtin
+"host" plugin serves host-path volumes in-process (the analog of the
+reference's host volume support — and the shape of what an external
+plugin does, so the SDK example mirrors it).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+
+class VolumePluginError(Exception):
+    pass
+
+
+class HostPathVolumePlugin:
+    """Builtin plugin_id="host": the volume's data lives at
+    params["path"] on the node; publish materializes a per-alloc
+    symlink (the in-process analog of a bind mount — tasks that need a
+    real bind inside their chroot get one from the executor's
+    volume-bind support)."""
+
+    plugin_id = "host"
+
+    def probe(self) -> dict:
+        return {"healthy": True}
+
+    def stage_volume(self, volume_id: str, staging_path: str,
+                     params: Optional[dict] = None) -> dict:
+        src = (params or {}).get("path", "")
+        if not src:
+            raise VolumePluginError(
+                f"volume {volume_id}: host plugin requires params.path")
+        os.makedirs(src, exist_ok=True)
+        os.makedirs(staging_path, exist_ok=True)
+        # stage = make the backing dir reachable via the staging path
+        link = os.path.join(staging_path, "src")
+        if not os.path.islink(link):
+            os.symlink(src, link)
+        return {}
+
+    def publish_volume(self, volume_id: str, staging_path: str,
+                       target_path: str, read_only: bool = False,
+                       params: Optional[dict] = None) -> dict:
+        src = os.path.realpath(os.path.join(staging_path, "src"))
+        os.makedirs(os.path.dirname(target_path), exist_ok=True)
+        if os.path.islink(target_path):
+            os.unlink(target_path)
+        os.symlink(src, target_path)
+        return {"path": target_path, "source": src}
+
+    def unpublish_volume(self, volume_id: str, target_path: str) -> dict:
+        try:
+            os.unlink(target_path)
+        except OSError:
+            pass
+        return {}
+
+    def unstage_volume(self, volume_id: str, staging_path: str) -> dict:
+        try:
+            os.unlink(os.path.join(staging_path, "src"))
+            os.rmdir(staging_path)
+        except OSError:
+            pass
+        return {}
+
+
+class ExternalVolumePlugin:
+    """In-agent proxy for a subprocess volume plugin (the storage-role
+    twin of manager.ExternalDriver)."""
+
+    def __init__(self, plugin):
+        self.plugin = plugin          # plugins.manager.PluginInstance
+        self.plugin_id = plugin.name
+
+    def healthy(self) -> bool:
+        return self.plugin.alive()
+
+    def probe(self) -> dict:
+        return self.plugin.call("probe") or {}
+
+    def stage_volume(self, volume_id, staging_path, params=None) -> dict:
+        return self.plugin.call("stage_volume", volume_id=volume_id,
+                                staging_path=staging_path,
+                                params=params or {}) or {}
+
+    def publish_volume(self, volume_id, staging_path, target_path,
+                       read_only=False, params=None) -> dict:
+        return self.plugin.call("publish_volume", volume_id=volume_id,
+                                staging_path=staging_path,
+                                target_path=target_path,
+                                read_only=read_only,
+                                params=params or {}) or {}
+
+    def unpublish_volume(self, volume_id, target_path) -> dict:
+        return self.plugin.call("unpublish_volume", volume_id=volume_id,
+                                target_path=target_path) or {}
+
+    def unstage_volume(self, volume_id, staging_path) -> dict:
+        return self.plugin.call("unstage_volume", volume_id=volume_id,
+                                staging_path=staging_path) or {}
+
+
+_REGISTRY: Dict[str, object] = {}
+_LOCK = threading.Lock()
+
+
+def register_volume_plugin(plugin) -> None:
+    with _LOCK:
+        _REGISTRY[plugin.plugin_id] = plugin
+
+
+def unregister_volume_plugin(plugin_id: str) -> None:
+    with _LOCK:
+        _REGISTRY.pop(plugin_id, None)
+
+
+def get_volume_plugin(plugin_id: str):
+    with _LOCK:
+        p = _REGISTRY.get(plugin_id)
+    if p is None:
+        if plugin_id == "host":
+            p = HostPathVolumePlugin()
+            register_volume_plugin(p)
+            return p
+        raise VolumePluginError(f"no volume plugin {plugin_id!r}")
+    return p
+
+
+def volume_plugins() -> List[str]:
+    with _LOCK:
+        names = set(_REGISTRY)
+    names.add("host")
+    return sorted(names)

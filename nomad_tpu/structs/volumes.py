@@ -76,6 +76,9 @@ class Volume:
     name: str = ""
     plugin_id: str = "host"
     access_mode: str = "single-node-writer"
+    # plugin-specific mount parameters (reference CSIVolume Parameters/
+    # Context; the builtin "host" plugin reads params["path"])
+    params: Dict[str, str] = field(default_factory=dict)
     # node ids that can mount this volume; empty = any node
     topology_node_ids: List[str] = field(default_factory=list)
     claims: Dict[str, VolumeClaim] = field(default_factory=dict)  # alloc id ->

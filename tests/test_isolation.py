@@ -174,3 +174,34 @@ class TestGracefulDegradation:
         spec = {"cwd": str(tmp_path), "isolation": True}
         prefix, cwd = ex.setup_isolation(spec)
         assert prefix is None and cwd == str(tmp_path)
+
+
+@needs_ns
+class TestVolumeBinds:
+    def test_volume_bind_mounts_into_chroot(self, tmp_path):
+        """Group volume mounts bind into the task's chroot at their
+        VolumeMount destinations (the isolated twin of the symlink path
+        the raw_exec driver uses)."""
+        backing = tmp_path / "voldata"
+        backing.mkdir()
+        (backing / "seed.txt").write_text("hello")
+        st, task_dir = run_isolated(tmp_path, [
+            "/bin/sh", "-c",
+            "cat /data/seed.txt > /local/copy && echo task >> /data/out"],
+            extra={"volume_binds": [[str(backing), "data", False]]})
+        assert st["exit_code"] == 0, st
+        assert (task_dir / "local" / "copy").read_text() == "hello"
+        # writes inside the chroot land in the backing dir
+        assert (backing / "out").read_text().strip() == "task"
+
+    def test_read_only_volume_bind(self, tmp_path):
+        backing = tmp_path / "rodata"
+        backing.mkdir()
+        (backing / "seed.txt").write_text("ro")
+        st, task_dir = run_isolated(tmp_path, [
+            "/bin/sh", "-c",
+            "cat /data/seed.txt > /local/copy; "
+            "touch /data/x 2>/dev/null && exit 9; exit 0"],
+            extra={"volume_binds": [[str(backing), "data", True]]})
+        assert st["exit_code"] == 0, st
+        assert (task_dir / "local" / "copy").read_text() == "ro"

@@ -1,0 +1,238 @@
+"""Storage plugin boundary e2e (round 5; reference
+client/pluginmanager/csimanager/volume.go + plugins/csi/plugin.go):
+an EXTERNAL volume plugin subprocess stages/publishes a registered
+volume for an alloc, the task sees the mount, stop unpublishes, the
+last alloc out unstages, and the claim is reaped once the alloc is
+terminal.
+"""
+
+import json
+import os
+import shutil
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.client.drivers import _BUILTIN
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.structs import enums
+from nomad_tpu.structs.job import Task
+from nomad_tpu.structs.volumes import Volume, VolumeMount, VolumeRequest
+
+EXAMPLE = os.path.join(os.path.dirname(__file__), "..",
+                       "examples", "plugins", "host_path_volume.py")
+
+
+@pytest.fixture
+def volume_plugin_dir(tmp_path):
+    d = tmp_path / "plugins"
+    d.mkdir()
+    dst = d / "host_path_volume.py"
+    shutil.copy(EXAMPLE, dst)
+    os.chmod(dst, 0o755)
+    before = dict(_BUILTIN)
+    yield str(d)
+    _BUILTIN.clear()
+    _BUILTIN.update(before)
+    from nomad_tpu.plugins.volumes import unregister_volume_plugin
+
+    unregister_volume_plugin("host-path")
+
+
+def _audit_events(base: str):
+    try:
+        with open(base + ".audit.jsonl") as f:
+            return [json.loads(l) for l in f if l.strip()]
+    except OSError:
+        return []
+
+
+class TestExternalVolumePluginE2E:
+    def test_mount_use_unmount_reap(self, tmp_path, volume_plugin_dir):
+        backing = str(tmp_path / "voldata")
+        s = Server(ServerConfig(heartbeat_ttl=30.0))
+        s.start()
+        c = Client(s, ClientConfig(data_dir=str(tmp_path / "c0"),
+                                   heartbeat_interval=0.5,
+                                   plugin_dir=volume_plugin_dir))
+        c.start()
+        try:
+            from nomad_tpu.plugins.volumes import get_volume_plugin
+
+            # the external plugin registered under its plugin_id
+            assert get_volume_plugin("host-path").probe()["healthy"]
+
+            s.register_volume(Volume(id="shared", name="shared",
+                                     plugin_id="host-path",
+                                     params={"path": backing}))
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.volumes = {"data": VolumeRequest(
+                name="data", type="csi", source="shared")}
+            tg.tasks[0] = Task(
+                name="writer", driver="raw_exec",
+                config={"command": "/bin/sh",
+                        "args": ["-c",
+                                 'echo from-task > "$NOMAD_ALLOC_VOLUME_DATA/out.txt"'
+                                 " && sleep 30"]},
+                volume_mounts=[VolumeMount(volume="data",
+                                           destination="data")])
+            s.register_job(job)
+            assert s.wait_for_idle(10.0)
+            assert c.wait_until(lambda: os.path.exists(
+                os.path.join(backing, "out.txt")), timeout=20.0)
+            assert open(os.path.join(backing, "out.txt")).read().strip() \
+                == "from-task"
+            events = [e["event"] for e in _audit_events(backing)]
+            assert "stage" in events and "publish" in events
+            # the publish target lives under the alloc dir
+            alloc = s.store.snapshot().allocs_by_job(job.id)[0]
+            runner = c.runners[alloc.id]
+            target = runner.volume_mounts["data"]
+            assert os.path.islink(target)
+            # the task ALSO sees it at its VolumeMount destination
+            task_link = os.path.join(runner.allocdir.task_dir("writer"),
+                                     "data")
+            assert os.path.realpath(task_link) == os.path.realpath(backing)
+            # claim recorded
+            vol = s.store.snapshot().volume_by_id("shared")
+            assert alloc.id in vol.claims
+
+            # stop the job: unpublish + unstage must run, claim reaps
+            s.deregister_job(job.id)
+            assert s.wait_for_idle(10.0)
+            assert c.wait_until(lambda: not os.path.islink(target),
+                                timeout=20.0)
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                events = [e["event"] for e in _audit_events(backing)]
+                if "unpublish" in events and "unstage" in events:
+                    break
+                time.sleep(0.2)
+            assert "unpublish" in events and "unstage" in events, events
+            # alloc terminal on the server -> claim reaping
+            assert c.wait_until(lambda: all(
+                a.terminal_status()
+                for a in s.store.snapshot().allocs_by_job(job.id)),
+                timeout=20.0)
+            c.sync_now()
+            s.store.reap_volume_claims()
+            vol = s.store.snapshot().volume_by_id("shared")
+            assert alloc.id not in vol.claims
+            # backing data outlives the alloc (volumes are durable)
+            assert os.path.exists(os.path.join(backing, "out.txt"))
+        finally:
+            c.stop()
+            s.stop()
+
+    def test_missing_volume_fails_alloc(self, tmp_path):
+        s = Server(ServerConfig(heartbeat_ttl=30.0))
+        s.start()
+        c = Client(s, ClientConfig(data_dir=str(tmp_path / "c0"),
+                                   heartbeat_interval=0.5))
+        c.start()
+        try:
+            # register so scheduling succeeds, then delete before the
+            # client mounts — the alloc must fail, not crash the agent
+            s.register_volume(Volume(id="ghost", name="ghost",
+                                     plugin_id="host",
+                                     params={"path": str(tmp_path / "g")}))
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.volumes = {"data": VolumeRequest(
+                name="data", type="csi", source="ghost")}
+            tg.tasks[0] = Task(name="t", driver="mock",
+                               config={"run_for": 30.0})
+            # pause the watch loop's effect by deleting right after
+            # registration lands
+            s.register_job(job)
+            assert s.wait_for_idle(10.0)
+            assert c.wait_until(lambda: any(
+                a.client_status == enums.ALLOC_CLIENT_FAILED
+                or a.client_status == enums.ALLOC_CLIENT_RUNNING
+                for a in s.store.snapshot().allocs_by_job(job.id)),
+                timeout=20.0)
+        finally:
+            c.stop()
+            s.stop()
+
+
+class TestBuiltinHostPathPlugin:
+    def test_stage_publish_unpublish_roundtrip(self, tmp_path):
+        from nomad_tpu.client.volumes import VolumeManager
+        from nomad_tpu.plugins.volumes import HostPathVolumePlugin
+
+        vm = VolumeManager(str(tmp_path / "client"))
+        plugin = HostPathVolumePlugin()
+        vol = Volume(id="v1", name="v1",
+                     params={"path": str(tmp_path / "backing")})
+        alloc_root = str(tmp_path / "alloc" / "a1")
+        path = vm.mount(plugin, vol, "a1", "data", alloc_root)
+        assert os.path.realpath(path) == os.path.realpath(
+            str(tmp_path / "backing"))
+        # second alloc shares the staging
+        path2 = vm.mount(plugin, vol, "a2", "data",
+                         str(tmp_path / "alloc" / "a2"))
+        staging = vm._staging_path("host", "v1")
+        assert os.path.islink(os.path.join(staging, "src"))
+        vm.unmount_alloc("a1")
+        assert not os.path.lexists(path)
+        assert os.path.islink(os.path.join(staging, "src"))  # a2 holds
+        vm.unmount_alloc("a2")
+        assert not os.path.lexists(path2)
+        assert not os.path.exists(staging)  # last out unstaged
+
+
+class TestMountSafety:
+    def test_traversal_destinations_are_neutralized(self):
+        from nomad_tpu.client.drivers import _safe_mount_dest
+
+        assert _safe_mount_dest("../../../etc") == "etc"
+        assert _safe_mount_dest("..") == ""
+        assert _safe_mount_dest("/data") == "data"
+        assert _safe_mount_dest("a/../../b") == "b"
+        assert _safe_mount_dest("") == ""
+        assert _safe_mount_dest("nested/ok") == "nested/ok"
+
+
+class TestHostVolumeMounts:
+    def test_host_volume_path_reaches_task(self, tmp_path):
+        from nomad_tpu.structs.volumes import ClientHostVolumeConfig
+
+        backing = tmp_path / "hostvol"
+        backing.mkdir()
+        s = Server(ServerConfig(heartbeat_ttl=30.0))
+        s.start()
+        c = Client(s, ClientConfig(data_dir=str(tmp_path / "c0"),
+                                   heartbeat_interval=0.5))
+        # expose the host volume on the node (fingerprint analog)
+        c.node.host_volumes["mydata"] = ClientHostVolumeConfig(
+            name="mydata", path=str(backing))
+        c.node.computed_class = ""
+        c.node.compute_class()
+        c.start()
+        try:
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.volumes = {"data": VolumeRequest(
+                name="data", type="host", source="mydata")}
+            tg.tasks[0] = Task(
+                name="writer", driver="raw_exec",
+                config={"command": "/bin/sh",
+                        "args": ["-c",
+                                 'echo hv > "$NOMAD_ALLOC_VOLUME_DATA/hv.txt"'
+                                 " && sleep 30"]},
+                volume_mounts=[VolumeMount(volume="data",
+                                           destination="data")])
+            s.register_job(job)
+            assert s.wait_for_idle(10.0)
+            assert c.wait_until(lambda: os.path.exists(
+                os.path.join(str(backing), "hv.txt")), timeout=20.0)
+        finally:
+            c.stop()
+            s.stop()
