@@ -1,0 +1,50 @@
+#!/usr/bin/env python3
+"""Example external DEVICE plugin: a fake GPU family over the
+subprocess plugin protocol (reference plugins/device/device.go:28-41:
+Fingerprint / Reserve / Stats).
+
+The agent launches this from --plugin-dir; it handshakes with
+type="device", advertises one homogeneous device group, returns
+visibility env on Reserve (the fake analog of CUDA_VISIBLE_DEVICES),
+and serves synthetic per-instance stats.
+"""
+
+import time
+
+from nomad_tpu.plugins.sdk import serve
+
+INSTANCES = [f"fakegpu-{i}" for i in range(4)]
+
+
+class FakeGpuDevicePlugin:
+    plugin_type = "device"
+    plugin_id = name = "fake-gpu"
+
+    def fingerprint(self):
+        return {"devices": [{
+            "vendor": "fake",
+            "type": "gpu",
+            "name": "mk1",
+            "instance_ids": list(INSTANCES),
+            "attributes": {"memory_mb": 16384, "cores": 128},
+        }]}
+
+    def reserve(self, instance_ids):
+        unknown = [i for i in instance_ids if i not in INSTANCES]
+        if unknown:
+            raise ValueError(f"unknown instances {unknown}")
+        return {"envs": {
+            "FAKE_GPU_VISIBLE_DEVICES": ",".join(instance_ids),
+        }}
+
+    def stats(self):
+        now = time.time()
+        return {"groups": {"fake/gpu/mk1": {
+            i: {"memory_used_mb": 100 + idx, "utilization_pct": 5 * idx,
+                "ts": now}
+            for idx, i in enumerate(INSTANCES)
+        }}}
+
+
+if __name__ == "__main__":
+    serve(FakeGpuDevicePlugin())

@@ -46,7 +46,13 @@ class HostPathVolumePlugin:
         os.makedirs(src, exist_ok=True)
         os.makedirs(staging_path, exist_ok=True)
         link = os.path.join(staging_path, "src")
-        if not os.path.islink(link):
+        # a stale link (crashed agent, re-registered volume with a new
+        # path) must not silently serve the previous backing dir
+        if os.path.islink(link):
+            if os.readlink(link) != src:
+                os.unlink(link)
+                os.symlink(src, link)
+        else:
             os.symlink(src, link)
         self._audit(params, "stage", volume_id=volume_id)
         return {}

@@ -588,7 +588,13 @@ class HTTPAgent:
         if path == "/v1/client/stats":
             if acl is not None and not acl.allow_node_read():
                 return h._error(403, "Permission denied")
-            return h._reply(200, [c.hoststats.latest() for c in self.clients])
+            # per-instance device stats ride beside host stats (reference
+            # client/devicemanager stats surfaced in client stats)
+            return h._reply(200, [
+                {**c.hoststats.latest(),
+                 "device_stats": c.device_manager.latest_stats()
+                 if getattr(c, "device_manager", None) is not None else {}}
+                for c in self.clients])
         if m := re.fullmatch(r"/v1/client/fs/(ls|cat|stat)/([^/]+)", path):
             return self._route_fs(h, m.group(1), m.group(2), q, acl)
         if m := re.fullmatch(r"/v1/client/exec/([^/]+)/stdout", path):

@@ -29,7 +29,8 @@ class AllocRunner:
                  on_update: Optional[Callable] = None,
                  state_db=None, restored_handles: Optional[Dict] = None,
                  prev_runner_lookup: Optional[Callable] = None,
-                 services_api=None, volumes_api=None, volume_manager=None):
+                 services_api=None, volumes_api=None, volume_manager=None,
+                 device_manager=None):
         self.alloc = alloc
         self.node = node
         self.data_dir = data_dir
@@ -42,6 +43,10 @@ class AllocRunner:
         self.volumes_api = volumes_api
         self.volume_manager = volume_manager
         self.volume_mounts: Dict[str, str] = {}  # volume name -> path
+        # device plugin boundary (client/devices.py): Reserve at task
+        # start returns the env the tasks need to see their instances
+        self.device_manager = device_manager
+        self.device_env: Dict[str, str] = {}
         self.check_runner = None
         # deployment health verdict: None until decided, else (bool, ts)
         # — synced to the server as alloc.deployment_status (reference
@@ -83,6 +88,15 @@ class AllocRunner:
         self._await_previous()
         if not self._mount_volumes():
             return
+        if self.device_manager is not None and self.alloc.allocated_devices:
+            try:
+                self.device_env = self.device_manager.reserve(
+                    self.alloc.allocated_devices)
+            except Exception as e:
+                self._set_status(enums.ALLOC_CLIENT_FAILED,
+                                 f"device reserve failed: {e}")
+                self._unmount_volumes()
+                return
 
         def make_runner(task) -> TaskRunner:
             td = self.allocdir.build_task_dir(task.name)
@@ -93,7 +107,8 @@ class AllocRunner:
                             on_handle=self._on_task_handle,
                             recovered_handle=self.restored_handles.get(task.name),
                             logs_dir=self.allocdir.logs,
-                            volume_mounts=self.volume_mounts)
+                            volume_mounts=self.volume_mounts,
+                            extra_env=self.device_env)
             self.task_runners[task.name] = tr
             return tr
 

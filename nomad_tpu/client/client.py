@@ -60,9 +60,16 @@ class Client:
             from ..plugins import PluginManager
 
             self.plugins = PluginManager.shared(self.config.plugin_dir)
+        # device manager polls plugin fingerprints into the node's
+        # device groups + collects per-instance stats (client/devices.py)
+        from .devices import DeviceManager
+
+        self.device_manager = DeviceManager(
+            stats_interval=self.config.hoststats_interval)
         self.node = node or fingerprint(datacenter=self.config.datacenter,
                                         node_class=self.config.node_class,
                                         data_dir=self.config.data_dir)
+        self._merge_plugin_devices(self.node)
         # persistent identity + alloc/handle state (client/state/db_bolt
         # equivalent): a restarted client keeps its node id, so the server
         # sees a re-registration, not a new node
@@ -92,7 +99,21 @@ class Client:
 
     # -- lifecycle --
 
+    def _merge_plugin_devices(self, node) -> None:
+        """Fold plugin-advertised device groups into the node's device
+        resources (replacing stale rows from the same group id)."""
+        groups = self.device_manager.device_groups()
+        if not groups:
+            return
+        plugin_ids = {g.id for g in groups}
+        kept = [d for d in node.resources.devices
+                if d.id not in plugin_ids]
+        node.resources.devices = kept + groups
+        node.computed_class = ""
+        node.compute_class()
+
     def start(self) -> None:
+        self.device_manager.start()
         self._restore()
         self._register_with_retry()
         self.hoststats.start()
@@ -129,6 +150,7 @@ class Client:
             self.plugins.release()
             self.plugins = None
         self.hoststats.stop()
+        self.device_manager.stop()
         for t in self._threads:
             t.join(timeout=2.0)
         for r in list(self.runners.values()):
@@ -181,7 +203,8 @@ class Client:
                                  restored_handles=recovered,
                                  services_api=self.server,
                                  volumes_api=self.server,
-                                 volume_manager=self.volume_manager)
+                                 volume_manager=self.volume_manager,
+                                 device_manager=self.device_manager)
             with self._lock:
                 self.runners[alloc.id] = runner
             runner.run()
@@ -245,6 +268,7 @@ class Client:
             updated.attributes = fresh.attributes
             updated.drivers = fresh.drivers
             updated.resources = fresh.resources
+            self._merge_plugin_devices(updated)
             updated._avail_vec = None
             updated.computed_class = ""
             updated.compute_class()
@@ -288,7 +312,8 @@ class Client:
                                      prev_runner_lookup=self.runners.get,
                                      services_api=self.server,
                                      volumes_api=self.server,
-                                     volume_manager=self.volume_manager)
+                                     volume_manager=self.volume_manager,
+                                     device_manager=self.device_manager)
                 self.runners[alloc_id] = runner
                 self.state_db.put_alloc(alloc)
                 starts.append(runner)

@@ -1,0 +1,106 @@
+"""Client-side device manager (reference client/devicemanager:
+fingerprint streams -> node device resources, Reserve at task start,
+periodic stats collection, instance.go:139-175).
+
+Polls every registered device plugin (builtin fingerprinting stays in
+client/fingerprint.py; this covers the PLUGIN boundary), remembers
+which plugin owns which device group so Reserve and Stats route
+correctly, and exposes a stats snapshot the host-stats surface embeds.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..plugins.devices import device_plugins
+from ..structs.resources import NodeDeviceResource
+
+
+class DeviceManager:
+    def __init__(self, stats_interval: float = 10.0):
+        self.stats_interval = stats_interval
+        self._lock = threading.Lock()
+        self._owners: Dict[str, object] = {}   # group id -> plugin
+        self._stats: Dict[str, dict] = {}      # group id -> instance stats
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- fingerprint (reference device.go Fingerprint stream; we poll) --
+
+    def device_groups(self) -> List[NodeDeviceResource]:
+        """Current device groups advertised by every healthy plugin;
+        records group ownership for reserve/stats routing."""
+        out: List[NodeDeviceResource] = []
+        for plugin in device_plugins():
+            try:
+                if not plugin.healthy():
+                    continue
+                fp = plugin.fingerprint()
+            except Exception:
+                continue
+            for d in fp.get("devices") or []:
+                group = NodeDeviceResource(
+                    vendor=str(d.get("vendor", "")),
+                    type=str(d.get("type", "")),
+                    name=str(d.get("name", "")),
+                    instance_ids=[str(i) for i in d.get("instance_ids", [])],
+                    attributes=dict(d.get("attributes") or {}),
+                )
+                with self._lock:
+                    self._owners[group.id] = plugin
+                out.append(group)
+        return out
+
+    # -- reserve (reference device.go Reserve; taskrunner device_hook) --
+
+    def reserve(self, allocated_devices: Dict[str, List[str]]) -> Dict[str, str]:
+        """Reserve every plugin-owned instance the placement assigned;
+        -> merged task environment. Unknown groups (builtin-fingerprinted
+        devices) reserve nothing — their env is the driver's business."""
+        env: Dict[str, str] = {}
+        for group_id, instance_ids in (allocated_devices or {}).items():
+            with self._lock:
+                plugin = self._owners.get(group_id)
+            if plugin is None:
+                continue
+            out = plugin.reserve(list(instance_ids))
+            for k, v in (out.get("envs") or {}).items():
+                env[str(k)] = str(v)
+        return env
+
+    # -- stats (reference instance.go:139-175 stats collection loop) --
+
+    def start(self) -> "DeviceManager":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="device-stats")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.stats_interval):
+            self.collect_stats()
+
+    def collect_stats(self) -> Dict[str, dict]:
+        merged: Dict[str, dict] = {}
+        for plugin in device_plugins():
+            try:
+                out = plugin.stats()
+            except Exception:
+                continue
+            for gid, instances in (out.get("groups") or {}).items():
+                merged[str(gid)] = {str(i): dict(v)
+                                    for i, v in (instances or {}).items()}
+        with self._lock:
+            self._stats = merged
+        return merged
+
+    def latest_stats(self) -> Dict[str, dict]:
+        with self._lock:
+            return dict(self._stats)

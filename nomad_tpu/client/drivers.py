@@ -442,7 +442,12 @@ class RawExecDriver:
                 src = mounts.get(vm.volume)
                 if not src:
                     continue
-                dest = _safe_mount_dest(vm.destination) or vm.volume
+                # volume NAMES are job-controlled too: the fallback must
+                # go through the same traversal guard as the destination
+                dest = (_safe_mount_dest(vm.destination)
+                        or _safe_mount_dest(vm.volume))
+                if not dest:
+                    continue
                 if spec.get("isolation"):
                     binds.append([os.path.realpath(src), dest,
                                   bool(vm.read_only)])

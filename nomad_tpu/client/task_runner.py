@@ -32,7 +32,7 @@ class TaskRunner:
                  on_handle: Optional[Callable] = None,
                  recovered_handle=None,
                  logs_dir: str = "",
-                 volume_mounts=None):
+                 volume_mounts=None, extra_env=None):
         self.alloc = alloc
         self.task = task
         self.node = node
@@ -43,6 +43,8 @@ class TaskRunner:
         # (client/volumes.py VolumeManager; reference taskrunner
         # volume_hook mounts)
         self.volume_mounts = volume_mounts or {}
+        # device-plugin Reserve env (reference taskrunner device_hook)
+        self.extra_env = extra_env or {}
         self.on_state_change = on_state_change
         self.policy = restart_policy or RestartPolicy()
         # persistence: on_handle(task_name, handle_data) records the
@@ -89,6 +91,7 @@ class TaskRunner:
             else:
                 env = taskenv.build_env(self.alloc, self.task, self.node,
                                         self.task_dir, self.shared_dir)
+                env.update(self.extra_env)
                 for vname, vpath in self.volume_mounts.items():
                     safe = "".join(c if c.isalnum() else "_"
                                    for c in vname).upper()

@@ -25,10 +25,15 @@ class VolumeManager:
         self._lock = threading.Lock()
         # (plugin_id, vol_id) -> set of alloc ids staged for
         self._staged: Dict[Tuple[str, str], set] = {}
-        # (plugin_id, vol_id) -> Event set once staging completed: a
+        # (plugin_id, vol_id) -> ["pending"|"ok"|"failed", Event]: a
         # second alloc racing the first must not publish from a
-        # half-staged dir (alloc runners are concurrent threads)
-        self._stage_done: Dict[Tuple[str, str], threading.Event] = {}
+        # half-staged (or failed) dir — waiters check the verdict, not
+        # just completion (alloc runners are concurrent threads)
+        self._stage_state: Dict[Tuple[str, str], list] = {}
+        # (plugin_id, vol_id) -> Event while an unstage is in flight: a
+        # re-mount must not stage into a dir a concurrent unstage is
+        # about to tear down
+        self._unstaging: Dict[Tuple[str, str], threading.Event] = {}
         # alloc id -> [(plugin, vol_id, target, staging)]
         self._published: Dict[str, List[tuple]] = {}
 
@@ -40,25 +45,46 @@ class VolumeManager:
               alloc_root: str, read_only: bool = False) -> str:
         """Stage (once per node) + publish (per alloc) -> the path the
         alloc's tasks mount. `volume` is the structs Volume row."""
+        # the publish target path embeds the job-controlled volume name:
+        # flatten it so it cannot traverse out of the alloc dir
+        safe_name = name.replace("/", "_").replace("..", "_") or "volume"
         key = (plugin.plugin_id, volume.id)
         staging = self._staging_path(plugin.plugin_id, volume.id)
+        # an in-flight unstage of this very volume must finish first
+        # (stop of the previous alloc racing the replacement's start)
+        while True:
+            with self._lock:
+                pending = self._unstaging.get(key)
+            if pending is None:
+                break
+            pending.wait(timeout=60.0)
         with self._lock:
             holders = self._staged.setdefault(key, set())
             first = not holders
             holders.add(alloc_id)
-            done = self._stage_done.setdefault(key, threading.Event())
+            state = self._stage_state.setdefault(
+                key, ["pending", threading.Event()])
         try:
             if first:
                 try:
                     plugin.stage_volume(volume.id, staging,
                                         params=dict(volume.params))
+                    state[0] = "ok"
+                except Exception:
+                    state[0] = "failed"
+                    raise
                 finally:
-                    done.set()  # waiters must never hang on our failure
-            elif not done.wait(timeout=120.0):
-                raise VolumeMountError(
-                    f"volume {volume.id}: staging by a sibling alloc "
-                    "timed out")
-            target = os.path.join(alloc_root, "volumes", name)
+                    state[1].set()  # waiters must never hang
+            else:
+                if not state[1].wait(timeout=120.0):
+                    raise VolumeMountError(
+                        f"volume {volume.id}: staging by a sibling alloc "
+                        "timed out")
+                if state[0] != "ok":
+                    raise VolumeMountError(
+                        f"volume {volume.id}: staging by a sibling alloc "
+                        "failed")
+            target = os.path.join(alloc_root, "volumes", safe_name)
             out = plugin.publish_volume(
                 volume.id, staging, target, read_only=read_only,
                 params=dict(volume.params))
@@ -68,7 +94,7 @@ class VolumeManager:
                 holders.discard(alloc_id)
                 if not holders:
                     self._staged.pop(key, None)
-                    self._stage_done.pop(key, None)
+                    self._stage_state.pop(key, None)
             raise VolumeMountError(
                 f"volume {volume.id} mount failed: {e}") from e
         path = (out or {}).get("path", target)
@@ -88,17 +114,25 @@ class VolumeManager:
             except Exception:
                 pass
             key = (plugin.plugin_id, vol_id)
-            unstage = False
+            unstage_ev = None
             with self._lock:
                 holders = self._staged.get(key)
                 if holders is not None:
                     holders.discard(alloc_id)
                     if not holders:
                         del self._staged[key]
-                        self._stage_done.pop(key, None)
-                        unstage = True
-            if unstage:
+                        self._stage_state.pop(key, None)
+                        # publish the in-flight unstage so a concurrent
+                        # mount() waits instead of staging into a dir
+                        # we're about to tear down
+                        unstage_ev = self._unstaging.setdefault(
+                            key, threading.Event())
+            if unstage_ev is not None:
                 try:
                     plugin.unstage_volume(vol_id, staging)
                 except Exception:
                     pass
+                finally:
+                    with self._lock:
+                        self._unstaging.pop(key, None)
+                    unstage_ev.set()

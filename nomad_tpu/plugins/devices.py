@@ -1,0 +1,69 @@
+"""Device plugin boundary (reference plugins/device/device.go:28-41
+DevicePlugin: Fingerprint stream, Reserve, Stats).
+
+A device plugin advertises homogeneous device groups, reserves concrete
+instances for a starting task (returning the environment the task needs
+to see them), and reports per-instance stats. External plugins ride the
+subprocess protocol with handshake type "device":
+
+    fingerprint() -> {"devices": [{vendor, type, name, instance_ids,
+                                   attributes}]}
+    reserve(instance_ids) -> {"envs": {...}}           (Reserve)
+    stats() -> {"groups": {"<vendor/type/name>":
+                           {"<instance>": {...metrics}}}}
+
+The client's DeviceManager (client/devices.py) polls fingerprints into
+the node's device resources (the reference's fingerprint stream,
+device.go Fingerprint), calls reserve at task start (taskrunner
+device_hook), and folds stats into host stats
+(client/devicemanager/instance.go:139-175).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+
+class DevicePluginError(Exception):
+    pass
+
+
+class ExternalDevicePlugin:
+    """In-agent proxy for a subprocess device plugin."""
+
+    def __init__(self, plugin):
+        self.plugin = plugin          # plugins.manager.PluginInstance
+        self.plugin_id = plugin.name
+
+    def healthy(self) -> bool:
+        return self.plugin.alive()
+
+    def fingerprint(self) -> dict:
+        return self.plugin.call("fingerprint") or {}
+
+    def reserve(self, instance_ids: List[str]) -> dict:
+        return self.plugin.call("reserve",
+                                instance_ids=list(instance_ids)) or {}
+
+    def stats(self) -> dict:
+        return self.plugin.call("stats") or {}
+
+
+_REGISTRY: Dict[str, object] = {}
+_LOCK = threading.Lock()
+
+
+def register_device_plugin(plugin) -> None:
+    with _LOCK:
+        _REGISTRY[plugin.plugin_id] = plugin
+
+
+def unregister_device_plugin(plugin_id: str) -> None:
+    with _LOCK:
+        _REGISTRY.pop(plugin_id, None)
+
+
+def device_plugins() -> List[object]:
+    with _LOCK:
+        return list(_REGISTRY.values())

@@ -202,7 +202,7 @@ class PluginInstance:
             hello = json.loads(line or b"{}")
         except ValueError:
             hello = {}
-        if hello.get("type") not in ("driver", "volume") \
+        if hello.get("type") not in ("driver", "volume", "device") \
                 or not hello.get("name"):
             self.stop()
             raise PluginError(
@@ -361,6 +361,10 @@ class PluginManager:
             from .volumes import ExternalVolumePlugin, register_volume_plugin
 
             register_volume_plugin(ExternalVolumePlugin(inst))
+        elif inst.plugin_type == "device":
+            from .devices import ExternalDevicePlugin, register_device_plugin
+
+            register_device_plugin(ExternalDevicePlugin(inst))
         else:
             register_driver(ExternalDriver(inst))
 
@@ -387,3 +391,14 @@ class PluginManager:
             self._thread.join(timeout=5.0)
         for inst in self.instances:
             inst.stop()
+            # a dead subprocess must not leave a proxy in the
+            # process-global registries (a later agent in this process
+            # would get opaque socket errors instead of "no plugin")
+            if inst.plugin_type == "volume":
+                from .volumes import unregister_volume_plugin
+
+                unregister_volume_plugin(inst.name)
+            elif inst.plugin_type == "device":
+                from .devices import unregister_device_plugin
+
+                unregister_device_plugin(inst.name)

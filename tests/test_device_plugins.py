@@ -1,0 +1,156 @@
+"""Device plugin boundary e2e (round 5; reference
+plugins/device/device.go:28-41 + client/devicemanager/instance.go):
+an EXTERNAL device plugin advertises a device group, the node registers
+with it, the scheduler places a device-asking job against it, Reserve
+env reaches the task, and per-instance stats surface through the API.
+"""
+
+import json
+import os
+import shutil
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.client.drivers import _BUILTIN
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.structs import enums
+from nomad_tpu.structs.job import Task
+from nomad_tpu.structs.resources import RequestedDevice, Resources
+
+EXAMPLE = os.path.join(os.path.dirname(__file__), "..",
+                       "examples", "plugins", "fake_gpu_device.py")
+
+
+@pytest.fixture
+def device_plugin_dir(tmp_path):
+    d = tmp_path / "plugins"
+    d.mkdir()
+    dst = d / "fake_gpu_device.py"
+    shutil.copy(EXAMPLE, dst)
+    os.chmod(dst, 0o755)
+    before = dict(_BUILTIN)
+    yield str(d)
+    _BUILTIN.clear()
+    _BUILTIN.update(before)
+    from nomad_tpu.plugins.devices import unregister_device_plugin
+
+    unregister_device_plugin("fake-gpu")
+
+
+class TestDevicePluginE2E:
+    def test_advertise_place_reserve_stats(self, tmp_path,
+                                           device_plugin_dir):
+        from nomad_tpu.api.http import HTTPAgent
+
+        s = Server(ServerConfig(heartbeat_ttl=30.0))
+        s.start()
+        c = Client(s, ClientConfig(data_dir=str(tmp_path / "c0"),
+                                   heartbeat_interval=0.5,
+                                   plugin_dir=device_plugin_dir,
+                                   hoststats_interval=0.5))
+        c.start()
+        agent = HTTPAgent(s, port=0, clients=[c]).start()
+        try:
+            # 1. the node registered with the plugin's device group
+            node = s.store.snapshot().node_by_id(c.node.id)
+            groups = {d.id: d for d in node.resources.devices}
+            assert "fake/gpu/mk1" in groups
+            assert len(groups["fake/gpu/mk1"].instance_ids) == 4
+
+            # 2. the scheduler places a device ask against it and the
+            #    Reserve env reaches the task
+            out = tmp_path / "reserve.txt"
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 2
+            tg.tasks[0] = Task(
+                name="gpuuser", driver="raw_exec",
+                resources=Resources(
+                    cpu=100, memory_mb=64,
+                    devices=[RequestedDevice(name="fake/gpu", count=1)]),
+                config={"command": "/bin/sh",
+                        "args": ["-c",
+                                 f'echo "$FAKE_GPU_VISIBLE_DEVICES" >> {out}'
+                                 " && sleep 30"]})
+            s.register_job(job)
+            assert s.wait_for_idle(10.0)
+            allocs = s.store.snapshot().allocs_by_job(job.id)
+            assert len(allocs) == 2
+            assigned = []
+            for a in allocs:
+                assert a.allocated_devices, a
+                assigned.extend(a.allocated_devices.get("fake/gpu/mk1", []))
+            assert len(assigned) == 2 and len(set(assigned)) == 2
+            assert c.wait_until(
+                lambda: out.exists() and len(out.read_text().split()) == 2,
+                timeout=20.0)
+            seen = set(out.read_text().split())
+            assert seen == set(assigned)
+
+            # 3. per-instance stats through the API
+            c.device_manager.collect_stats()
+            stats = json.loads(urllib.request.urlopen(
+                f"{agent.address}/v1/client/stats").read())
+            dev = stats[0]["device_stats"]
+            assert "fake/gpu/mk1" in dev
+            assert "fakegpu-0" in dev["fake/gpu/mk1"]
+            assert "utilization_pct" in dev["fake/gpu/mk1"]["fakegpu-0"]
+        finally:
+            agent.stop()
+            c.stop()
+            s.stop()
+
+    def test_reserve_failure_fails_alloc(self, tmp_path,
+                                         device_plugin_dir):
+        """A plugin that rejects Reserve must fail the alloc, not strand
+        it pending."""
+        s = Server(ServerConfig(heartbeat_ttl=30.0))
+        s.start()
+        c = Client(s, ClientConfig(data_dir=str(tmp_path / "c0"),
+                                   heartbeat_interval=0.5,
+                                   plugin_dir=device_plugin_dir))
+        c.start()
+        try:
+            from nomad_tpu.plugins import devices as devmod
+
+            class Rejecting:
+                plugin_id = "fake-gpu"
+
+                def healthy(self):
+                    return True
+
+                def fingerprint(self):
+                    return {"devices": [{"vendor": "fake", "type": "gpu",
+                                         "name": "mk1",
+                                         "instance_ids": ["fakegpu-0"]}]}
+
+                def reserve(self, instance_ids):
+                    raise RuntimeError("no capacity")
+
+                def stats(self):
+                    return {}
+
+            devmod.register_device_plugin(Rejecting())
+            c.device_manager.device_groups()  # refresh ownership
+
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.tasks[0] = Task(
+                name="g", driver="mock",
+                resources=Resources(
+                    cpu=100, memory_mb=64,
+                    devices=[RequestedDevice(name="fake/gpu", count=1)]),
+                config={"run_for": 30.0})
+            s.register_job(job)
+            assert s.wait_for_idle(10.0)
+            assert c.wait_until(lambda: any(
+                a.client_status == enums.ALLOC_CLIENT_FAILED
+                for a in s.store.snapshot().allocs_by_job(job.id)),
+                timeout=20.0)
+        finally:
+            c.stop()
+            s.stop()

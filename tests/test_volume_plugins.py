@@ -129,17 +129,20 @@ class TestExternalVolumePluginE2E:
             s.stop()
 
     def test_missing_volume_fails_alloc(self, tmp_path):
+        """Volume vanishes between scheduling and the client mount: the
+        alloc must FAIL (not crash the agent, not run). The client
+        starts only after the deregister, so ordering is deterministic."""
         s = Server(ServerConfig(heartbeat_ttl=30.0))
         s.start()
         c = Client(s, ClientConfig(data_dir=str(tmp_path / "c0"),
                                    heartbeat_interval=0.5))
-        c.start()
         try:
-            # register so scheduling succeeds, then delete before the
-            # client mounts — the alloc must fail, not crash the agent
             s.register_volume(Volume(id="ghost", name="ghost",
                                      plugin_id="host",
                                      params={"path": str(tmp_path / "g")}))
+            # register the node so scheduling can proceed with no
+            # runners active yet
+            s.register_node(c.node)
             job = mock.job()
             tg = job.task_groups[0]
             tg.count = 1
@@ -147,13 +150,14 @@ class TestExternalVolumePluginE2E:
                 name="data", type="csi", source="ghost")}
             tg.tasks[0] = Task(name="t", driver="mock",
                                config={"run_for": 30.0})
-            # pause the watch loop's effect by deleting right after
-            # registration lands
             s.register_job(job)
             assert s.wait_for_idle(10.0)
+            assert s.store.snapshot().allocs_by_job(job.id)
+            s.deregister_volume("ghost", force=True)
+            c.start()
             assert c.wait_until(lambda: any(
                 a.client_status == enums.ALLOC_CLIENT_FAILED
-                or a.client_status == enums.ALLOC_CLIENT_RUNNING
+                and "not found" in a.client_description
                 for a in s.store.snapshot().allocs_by_job(job.id)),
                 timeout=20.0)
         finally:
