@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 AUTH_TYPE_JWT = "JWT"
+AUTH_TYPE_OIDC = "OIDC"
 
 BIND_ROLE = "role"
 BIND_POLICY = "policy"

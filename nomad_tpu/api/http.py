@@ -687,6 +687,53 @@ class HTTPAgent:
                 },
                 "version": "0.1.0",
             })
+        if path == "/v1/agent/pprof/threads":
+            # goroutine-dump analog: every thread's current stack
+            # (reference /v1/agent/pprof goroutine profile,
+            # command/agent/pprof/; agent:read-gated by the /v1/agent
+            # prefix check above)
+            import sys as _sys
+            import threading as _threading
+            import traceback as _traceback
+
+            names = {t.ident: t.name for t in _threading.enumerate()}
+            dump = []
+            for tid, frame in _sys._current_frames().items():
+                dump.append(f"thread {names.get(tid, '?')} ({tid}):\n"
+                            + "".join(_traceback.format_stack(frame)))
+            return h._reply(200, {"threads": len(dump),
+                                  "dump": "\n".join(dump)})
+        if path == "/v1/agent/pprof/profile":
+            # statistical CPU profile: sample every thread's stack for
+            # ?seconds=S, emit collapsed stacks with sample counts (the
+            # pprof-profile analog a maintainer can flamegraph)
+            import sys as _sys
+            import traceback as _traceback
+
+            try:
+                seconds = min(float(q.get("seconds", ["5"])[0] or 5), 30.0)
+                hz = min(max(float(q.get("hz", ["100"])[0] or 100), 1.0),
+                         500.0)
+            except ValueError:
+                return h._error(400, "bad seconds/hz")
+            counts: Dict[str, int] = {}
+            me = threading.get_ident()
+            deadline = time.time() + seconds
+            samples = 0
+            while time.time() < deadline:
+                for tid, frame in _sys._current_frames().items():
+                    if tid == me:
+                        continue  # don't profile the profiler
+                    stack = ";".join(
+                        f"{f.name}@{os.path.basename(f.filename)}:{f.lineno}"
+                        for f in _traceback.extract_stack(frame))
+                    counts[stack] = counts.get(stack, 0) + 1
+                samples += 1
+                time.sleep(1.0 / hz)
+            top = sorted(counts.items(), key=lambda kv: -kv[1])
+            return h._reply(200, {
+                "seconds": seconds, "samples": samples,
+                "collapsed": [f"{stack} {n}" for stack, n in top[:500]]})
         if path == "/v1/operator/raft/configuration":
             # peer set + leadership (reference operator_endpoint.go
             # RaftGetConfiguration); authorization rides the coarse
@@ -861,10 +908,44 @@ class HTTPAgent:
             elif not self._ns_allowed(acl, ns, aclp.CAP_SUBMIT_JOB):
                 return h._error(403, "Permission denied")
         elif path.startswith("/v1/acl") and path not in (
-                "/v1/acl/bootstrap", "/v1/acl/login"):
+                "/v1/acl/bootstrap", "/v1/acl/login",
+                "/v1/acl/oidc/auth-url", "/v1/acl/oidc/complete-auth"):
             if acl is not None and not acl.management:
                 return h._error(403, "Permission denied")
 
+        if path == "/v1/acl/oidc/auth-url":
+            # OIDC step 1: provider authorization URL + request state
+            # (reference acl_endpoint.go OIDCAuthURL; unauthenticated)
+            try:
+                out = self.writer.oidc_auth_url(
+                    body.get("auth_method", ""),
+                    body.get("redirect_uri", ""),
+                    body.get("client_nonce", ""))
+            except PermissionError as e:
+                return h._error(403, str(e))
+            except ValueError as e:
+                return h._error(400, str(e))
+            return h._reply(200, out)
+        if path == "/v1/acl/oidc/complete-auth":
+            # OIDC step 2: code -> id_token -> bound ACL token
+            # (reference acl_endpoint.go OIDCCompleteAuth)
+            try:
+                token = self.writer.oidc_complete_auth(
+                    body.get("auth_method", ""),
+                    body.get("state", ""),
+                    body.get("code", ""),
+                    body.get("redirect_uri", ""),
+                    body.get("client_nonce", ""))
+            except PermissionError as e:
+                return h._error(403, str(e))
+            except ValueError as e:
+                return h._error(400, str(e))
+            return h._reply(200, {
+                "accessor_id": token.accessor_id,
+                "secret_id": token.secret_id,
+                "type": token.type,
+                "policies": token.policies, "roles": token.roles,
+                "expiration_time": token.expiration_time})
         if path == "/v1/acl/login":
             # SSO: exchange an external JWT for an ephemeral token —
             # unauthenticated by design (reference acl_endpoint.go Login)

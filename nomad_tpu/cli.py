@@ -84,10 +84,16 @@ def cmd_agent(args) -> int:
                                     peers).start()
         joining = bool(getattr(args, "join", ""))
         cleanup = getattr(args, "dead_server_cleanup", 0.0) or None
+        gossip_bind = getattr(args, "gossip", "") or None
+        gossip_seeds = [a for a in
+                        (getattr(args, "retry_join", "") or "").split(",")
+                        if a]
         replicated = ReplicatedServer(
             args.server_id, list(peers), transport, cfg,
             data_dir=args.data_dir or None,
-            bootstrap=not joining, dead_server_cleanup_s=cleanup)
+            bootstrap=not joining and not gossip_seeds,
+            dead_server_cleanup_s=cleanup,
+            gossip_bind=gossip_bind, gossip_seeds=gossip_seeds)
         replicated.start()
         if joining:
             replicated.join(args.join)
@@ -111,6 +117,9 @@ def cmd_agent(args) -> int:
         c.start()
         clients.append(c)
     http_agent.clients = clients  # serve /v1/client/* for local clients
+    if replicated is not None:
+        # WAN gossip members read this to maintain the region registry
+        replicated.set_gossip_http(http_agent.address)
     print(f"agent started: {http_agent.address} "
           f"(workers={args.workers} clients={args.clients} "
           f"algorithm={args.algorithm}"
@@ -427,6 +436,88 @@ def cmd_operator_snapshot(args) -> int:
     return 0
 
 
+def cmd_operator_debug(args) -> int:
+    """Capture a support bundle a maintainer can triage from (reference
+    command/operator_debug.go): cluster state, metrics, thread dumps, a
+    sampled CPU profile, recent events, and a monitor-log slice, packed
+    into one tar.gz."""
+    import io
+    import tarfile
+    import urllib.request
+
+    out_path = args.output or f"nomad-debug-{int(time.time())}.tar.gz"
+    dur = max(1.0, min(args.duration, 30.0))
+    token = getattr(args, "token", "") or ""
+
+    def _get_json(path: str, timeout: float = 15.0):
+        req = urllib.request.Request(f"{args.address}{path}",
+                                     headers={"X-Nomad-Token": token})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    captures = {
+        "agent_self.json": lambda: _get_json("/v1/agent/self"),
+        "leader.json": lambda: _get_json("/v1/status/leader"),
+        "raft_configuration.json":
+            lambda: _get_json("/v1/operator/raft/configuration"),
+        "scheduler_config.json":
+            lambda: _get_json("/v1/operator/scheduler/configuration"),
+        "jobs.json": lambda: _get_json("/v1/jobs"),
+        "nodes.json": lambda: _get_json("/v1/nodes"),
+        "evals.json": lambda: _get_json("/v1/evaluations"),
+        "deployments.json": lambda: _get_json("/v1/deployments"),
+        "threads.json": lambda: _get_json("/v1/agent/pprof/threads"),
+        "profile.json":
+            lambda: _get_json(f"/v1/agent/pprof/profile?seconds={dur}",
+                              timeout=dur + 30.0),
+    }
+
+    with tarfile.open(out_path, "w:gz") as tar:
+        def add(name: str, payload) -> None:
+            if isinstance(payload, (dict, list)):
+                data = json.dumps(payload, indent=2, default=str).encode()
+            else:
+                data = str(payload).encode()
+            info = tarfile.TarInfo(f"nomad-debug/{name}")
+            info.size = len(data)
+            info.mtime = int(time.time())
+            tar.addfile(info, io.BytesIO(data))
+
+        for name, fn in captures.items():
+            try:
+                add(name, fn())
+            except Exception as e:
+                add(name + ".error", f"{type(e).__name__}: {e}")
+        # prometheus metrics ride raw (non-JSON body)
+        try:
+            req = urllib.request.Request(
+                f"{args.address}/v1/metrics?format=prometheus",
+                headers={"X-Nomad-Token": getattr(args, "token", "") or ""})
+            add("metrics.prom",
+                urllib.request.urlopen(req, timeout=15).read().decode())
+        except Exception as e:
+            add("metrics.prom.error", f"{type(e).__name__}: {e}")
+        # a short live log slice (the monitor stream)
+        try:
+            req = urllib.request.Request(
+                f"{args.address}/v1/agent/monitor?wait={dur}"
+                "&log_level=debug",
+                headers={"X-Nomad-Token": getattr(args, "token", "") or ""})
+            lines = []
+            with urllib.request.urlopen(req, timeout=dur + 15) as resp:
+                deadline = time.time() + dur
+                while time.time() < deadline:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    lines.append(line.decode(errors="replace"))
+            add("monitor.log", "".join(lines))
+        except Exception as e:
+            add("monitor.log.error", f"{type(e).__name__}: {e}")
+    print(f"debug bundle written to {out_path}")
+    return 0
+
+
 def cmd_operator_scheduler(args) -> int:
     api = _client(args)
     if args.op == "get-config":
@@ -491,6 +582,70 @@ def cmd_monitor(args) -> int:
         return 1
 
 
+def _oidc_login(api, args) -> int:
+    """OIDC authorization-code flow (reference command/login.go): start
+    a localhost callback listener, hand the user the provider auth URL,
+    wait for the redirect, complete the exchange server-side."""
+    import secrets as _secrets
+    import threading
+    import webbrowser
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+    from urllib.parse import parse_qs, urlparse
+
+    got: dict = {}
+    done = threading.Event()
+
+    class CB(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            u = urlparse(self.path)
+            if u.path != "/oidc/callback":
+                # stray fetches (favicon) must not clobber the code
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            q = parse_qs(u.query)
+            got["code"] = (q.get("code") or [""])[0]
+            got["state"] = (q.get("state") or [""])[0]
+            body = b"Login complete. You can close this tab."
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            done.set()
+
+    srv = HTTPServer(("127.0.0.1", args.callback_port), CB)
+    port = srv.server_port
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    redirect_uri = f"http://127.0.0.1:{port}/oidc/callback"
+    nonce = _secrets.token_hex(16)
+    out, _ = api._request("POST", "/v1/acl/oidc/auth-url", body={
+        "auth_method": args.method, "redirect_uri": redirect_uri,
+        "client_nonce": nonce})
+    url = out["auth_url"]
+    if args.no_browser:
+        print(f"Open the following URL to authenticate:\n{url}",
+              file=sys.stderr, flush=True)
+    else:
+        print(f"Opening browser for {url}", file=sys.stderr, flush=True)
+        webbrowser.open(url)
+    if not done.wait(timeout=300.0):
+        srv.shutdown()
+        print("timed out waiting for the OIDC callback", file=sys.stderr)
+        return 1
+    srv.shutdown()
+    token, _ = api._request("POST", "/v1/acl/oidc/complete-auth", body={
+        "auth_method": args.method, "state": got.get("state", ""),
+        "code": got.get("code", ""), "redirect_uri": redirect_uri,
+        "client_nonce": nonce})
+    _p(token)
+    return 0
+
+
 def cmd_acl(args) -> int:
     """ACL operations (reference command/acl_*.go): bootstrap, SSO
     login, auth methods, binding rules."""
@@ -499,6 +654,8 @@ def cmd_acl(args) -> int:
         _p(api._request("POST", "/v1/acl/bootstrap")[0])
         return 0
     if args.acl_cmd == "login":
+        if getattr(args, "login_type", "jwt") == "oidc":
+            return _oidc_login(api, args)
         token = args.login_token
         if token == "-":
             token = sys.stdin.read().strip()
@@ -708,6 +865,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="address of any live cluster member; this server "
                          "joins that cluster instead of bootstrapping "
                          "(use with --peers listing only itself)")
+    ag.add_argument("--gossip", default="",
+                    help="gossip bind addr host:port (enables serf-style "
+                         "membership, reference nomad/serf.go)")
+    ag.add_argument("--retry-join", dest="retry_join", default="",
+                    help="comma-separated gossip seed addresses to join via")
     ag.add_argument("--dead-server-cleanup", type=float, default=0.0,
                     help="autopilot: remove a server unreachable this many "
                          "seconds (0 = disabled; reference nomad/autopilot.go)")
@@ -855,6 +1017,12 @@ def build_parser() -> argparse.ArgumentParser:
     oraft.add_argument("op", choices=["list-peers", "remove-peer"])
     oraft.add_argument("-peer-id", dest="peer_id", default="")
     oraft.set_defaults(fn=cmd_operator_raft)
+    odebug = op.add_parser("debug", help="capture a support bundle")
+    odebug.add_argument("-output", default="",
+                        help="bundle path (default nomad-debug-<ts>.tar.gz)")
+    odebug.add_argument("-duration", type=float, default=5.0,
+                        help="seconds of CPU profile + log capture")
+    odebug.set_defaults(fn=cmd_operator_debug)
 
     mon = sub.add_parser("monitor")
     mon.add_argument("-log-level", dest="log_level", default="info")
@@ -866,8 +1034,18 @@ def build_parser() -> argparse.ArgumentParser:
     ab.set_defaults(fn=cmd_acl)
     alog = aclp.add_parser("login")
     alog.add_argument("-method", required=True)
-    alog.add_argument("login_token",
-                      help="external JWT ('-' reads from stdin)")
+    alog.add_argument("-type", dest="login_type", default="jwt",
+                      choices=("jwt", "oidc"),
+                      help="jwt: exchange a provided JWT; oidc: browser "
+                           "authorization-code flow with a local callback")
+    alog.add_argument("-callback-port", type=int, default=0,
+                      help="oidc: local callback port (0 = ephemeral)")
+    alog.add_argument("-no-browser", action="store_true",
+                      help="oidc: print the auth URL instead of opening "
+                           "a browser")
+    alog.add_argument("login_token", nargs="?", default="",
+                      help="external JWT ('-' reads from stdin; "
+                           "jwt type only)")
     alog.set_defaults(fn=cmd_acl)
     for kind in ("auth-method", "binding-rule"):
         ap = aclp.add_parser(kind)

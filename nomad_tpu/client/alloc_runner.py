@@ -47,6 +47,7 @@ class AllocRunner:
         # start returns the env the tasks need to see their instances
         self.device_manager = device_manager
         self.device_env: Dict[str, str] = {}
+        self.widmgr = None  # workload identity renewal (client/widmgr.py)
         self.check_runner = None
         # deployment health verdict: None until decided, else (bool, ts)
         # — synced to the server as alloc.deployment_status (reference
@@ -97,6 +98,22 @@ class AllocRunner:
                                  f"device reserve failed: {e}")
                 self._unmount_volumes()
                 return
+        # workload identities: mint each task's JWT into its secrets dir
+        # and keep renewing at half-life (client/widmgr.py; reference
+        # client/widmgr/widmgr.go). Best-effort — a server without the
+        # signing surface (HTTP facade) just runs without identities.
+        if getattr(self.services_api, "sign_workload_identity", None) \
+                is not None:
+            from .widmgr import WIDMgr
+
+            self.widmgr = WIDMgr(
+                self.services_api, self.alloc,
+                [t.name for t in self.tg.tasks],
+                self.allocdir.task_dir)
+            for t in self.tg.tasks:
+                self.allocdir.build_task_dir(t.name)
+            self.widmgr.run_initial()
+            self.widmgr.start()
 
         def make_runner(task) -> TaskRunner:
             td = self.allocdir.build_task_dir(task.name)
@@ -169,6 +186,8 @@ class AllocRunner:
         for r in post_runners:
             if not r.wait_dead(timeout=PRESTART_DEADLINE_S):
                 r.kill()
+        if self.widmgr is not None:
+            self.widmgr.stop()
         self._unmount_volumes()
         self._recompute_status()
 
@@ -331,6 +350,8 @@ class AllocRunner:
     def stop(self) -> None:
         """Server asked for a stop (desired_status=stop/evict)."""
         self._destroyed = True
+        if getattr(self, "widmgr", None) is not None:
+            self.widmgr.stop()
         self._deregister_services()
         self._kill_all()
         self._unmount_volumes()

@@ -1,0 +1,102 @@
+"""Workload identity manager (reference client/widmgr/widmgr.go).
+
+Obtains a signed workload-identity JWT per task from the server
+(Server.sign_workload_identity; the reference signs at plan time and
+renews via Alloc.SignIdentities), writes it to the task's secrets dir
+as `nomad_token` (atomic replace, 0600), and renews it at ~half TTL so
+long-running tasks always hold a live token. The FILE is the renewable
+channel — env vars can't change after exec, which is exactly the
+reference's contract (identity file in secrets/).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+TOKEN_FILE = "nomad_token"
+MIN_RENEW_WAIT = 0.5
+
+
+class WIDMgr:
+    def __init__(self, server, alloc, task_names: List[str],
+                 task_dir_fn, logger=None):
+        self.server = server
+        self.alloc = alloc
+        self.task_names = list(task_names)
+        self.task_dir_fn = task_dir_fn  # task name -> task dir path
+        self.logger = logger
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # task -> (written_at, expiry) of the currently-written token;
+        # renewal is due at the half-life
+        self._exp: Dict[str, tuple] = {}
+
+    # -- lifecycle --
+
+    def run_initial(self) -> bool:
+        """Mint + write every task's first identity; False when the
+        server refuses (terminal alloc, no server)."""
+        for task in self.task_names:
+            if not self._renew_one(task):
+                return False
+        return True
+
+    def start(self) -> "WIDMgr":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"widmgr-{self.alloc.id[:8]}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    # -- renewal loop (reference widmgr.go renew at half-life) --
+
+    @staticmethod
+    def _due(entry) -> float:
+        written, exp = entry
+        return written + (exp - written) / 2.0
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            now = time.time()
+            if self._exp:
+                next_due = min(self._due(e) for e in self._exp.values())
+            else:
+                next_due = now + MIN_RENEW_WAIT
+            if self._stop.wait(max(MIN_RENEW_WAIT, next_due - now)):
+                return
+            now = time.time()
+            for task in self.task_names:
+                entry = self._exp.get(task)
+                if entry is None or now >= self._due(entry):
+                    self._renew_one(task)
+
+    def _renew_one(self, task: str) -> bool:
+        try:
+            out = self.server.sign_workload_identity(self.alloc.id, task)
+        except Exception:
+            if self.logger:
+                self.logger.debug("identity renewal failed for %s/%s",
+                                  self.alloc.id[:8], task)
+            return False
+        token, exp = out["token"], float(out["exp"])
+        td = self.task_dir_fn(task)
+        secrets = os.path.join(td, "secrets")
+        try:
+            os.makedirs(secrets, exist_ok=True)
+            tmp = os.path.join(secrets, f".{TOKEN_FILE}.tmp")
+            with open(tmp, "w") as f:
+                f.write(token)
+            os.chmod(tmp, 0o600)
+            os.replace(tmp, os.path.join(secrets, TOKEN_FILE))
+        except OSError:
+            return False
+        self._exp[task] = (time.time(), exp)
+        return True

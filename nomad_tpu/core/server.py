@@ -61,6 +61,9 @@ class ServerConfig:
     plan_rejection_window: float = 300.0
     gc_interval: float = 60.0
     acl_enabled: bool = False
+    # workload-identity JWT lifetime (client/widmgr renews at ~half TTL;
+    # reference nomad/structs WorkloadIdentity TTL)
+    identity_ttl: float = 3600.0
     # multi-region federation (reference nomad/rpc.go region forwarding
     # + leader.go replication loops)
     region: str = "global"
@@ -97,6 +100,10 @@ class Server:
         from .encrypter import Encrypter
 
         self.encrypter = Encrypter()
+        # pending OIDC auth requests: state -> request (leader-local,
+        # reference acl_endpoint.go oidcRequestCache)
+        self._oidc_lock = threading.Lock()
+        self._oidc_requests = {}
         self.acl_enabled = self.config.acl_enabled
         self.deployment_watcher = DeploymentWatcher(self)
         self.drainer = NodeDrainer(self)
@@ -1066,13 +1073,13 @@ class Server:
         return r.address if r is not None else None
 
     def upsert_auth_method(self, method) -> None:
-        from ..acl.auth import AUTH_TYPE_JWT, AuthMethod
+        from ..acl.auth import AUTH_TYPE_JWT, AUTH_TYPE_OIDC, AuthMethod
 
         if isinstance(method, dict):
             method = AuthMethod(**method)
         if not method.name:
             raise ValueError("auth method name is required")
-        if method.type != AUTH_TYPE_JWT:
+        if method.type not in (AUTH_TYPE_JWT, AUTH_TYPE_OIDC):
             raise ValueError(f"unsupported auth method type {method.type!r}")
         if method.max_token_ttl_s < 0:
             raise ValueError("max_token_ttl_s must be >= 0")
@@ -1105,13 +1112,19 @@ class Server:
         """Exchange an external JWT for an ephemeral ACL token
         (reference acl_endpoint.go Login)."""
         from ..acl import auth as a
-        from ..acl.tokens import TOKEN_TYPE_MANAGEMENT, AclToken
 
         snap = self.store.snapshot()
         method = snap.auth_method(auth_method)
         if method is None:
             raise PermissionError(f"unknown auth method {auth_method!r}")
         claims = a.verify_jwt(login_token, method)
+        return self._login_with_claims(snap, method, claims)
+
+    def _login_with_claims(self, snap, method, claims: dict):
+        """Shared bind-and-mint tail of the JWT and OIDC logins."""
+        from ..acl import auth as a
+        from ..acl.tokens import TOKEN_TYPE_MANAGEMENT, AclToken
+
         variables = a.map_claims(claims, method)
         rules = list(snap.binding_rules(method.name))
         management, roles, policies = a.evaluate_binding_rules(rules,
@@ -1135,6 +1148,134 @@ class Server:
             token.expiration_time = token.create_time + method.max_token_ttl_s
         self.store.upsert_acl_token(token)
         return token
+
+    # -- OIDC login flow (reference acl_endpoint.go OIDCAuthURL /
+    #    OIDCCompleteAuth; command/login.go drives the browser side) --
+
+    OIDC_REQUEST_TTL = 600.0
+
+    def oidc_auth_url(self, auth_method: str, redirect_uri: str,
+                      client_nonce: str = "") -> dict:
+        """Build the provider authorization URL for an OIDC auth method
+        and remember the request state (leader-local, like the
+        reference's oidcRequestCache)."""
+        from ..acl.auth import AUTH_TYPE_OIDC
+        from ..utils import generate_secret_uuid
+
+        snap = self.store.snapshot()
+        method = snap.auth_method(auth_method)
+        if method is None or method.type != AUTH_TYPE_OIDC:
+            raise PermissionError(f"unknown OIDC auth method {auth_method!r}")
+        allowed = method.config.get("allowed_redirect_uris") or []
+        if allowed and redirect_uri not in allowed:
+            raise PermissionError(
+                f"redirect_uri {redirect_uri!r} is not allowed")
+        auth_ep = method.config.get("oidc_auth_endpoint", "")
+        if not auth_ep:
+            raise ValueError(
+                f"auth method {auth_method!r} has no oidc_auth_endpoint")
+        state = generate_secret_uuid()
+        now = time.time()
+        with self._oidc_lock:
+            # opportunistic expiry sweep
+            self._oidc_requests = {
+                s: r for s, r in self._oidc_requests.items()
+                if r["expires"] > now}
+            self._oidc_requests[state] = {
+                "method": auth_method, "redirect_uri": redirect_uri,
+                "nonce": client_nonce, "expires": now + self.OIDC_REQUEST_TTL}
+        from urllib.parse import urlencode
+
+        q = urlencode({
+            "response_type": "code",
+            "client_id": method.config.get("oidc_client_id", ""),
+            "redirect_uri": redirect_uri,
+            "scope": " ".join(method.config.get("oidc_scopes")
+                              or ["openid"]),
+            "state": state,
+            "nonce": client_nonce,
+        })
+        sep = "&" if "?" in auth_ep else "?"
+        return {"auth_url": f"{auth_ep}{sep}{q}", "state": state}
+
+    def oidc_complete_auth(self, auth_method: str, state: str, code: str,
+                           redirect_uri: str, client_nonce: str = ""):
+        """Exchange the provider's authorization code for an id_token at
+        the token endpoint, validate it, and mint the bound ACL token."""
+        import json as _json
+        import urllib.request
+        from urllib.parse import urlencode
+
+        from ..acl import auth as a
+
+        now = time.time()
+        with self._oidc_lock:
+            req = self._oidc_requests.pop(state, None)
+        if req is None or req["expires"] <= now \
+                or req["method"] != auth_method \
+                or req["redirect_uri"] != redirect_uri \
+                or req["nonce"] != client_nonce:
+            raise PermissionError("unknown or expired OIDC request state")
+        snap = self.store.snapshot()
+        method = snap.auth_method(auth_method)
+        if method is None:
+            raise PermissionError(f"unknown auth method {auth_method!r}")
+        token_ep = method.config.get("oidc_token_endpoint", "")
+        if not token_ep:
+            raise ValueError(
+                f"auth method {auth_method!r} has no oidc_token_endpoint")
+        body = urlencode({
+            "grant_type": "authorization_code",
+            "code": code,
+            "redirect_uri": redirect_uri,
+            "client_id": method.config.get("oidc_client_id", ""),
+            "client_secret": method.config.get("oidc_client_secret", ""),
+        }).encode()
+        try:
+            with urllib.request.urlopen(urllib.request.Request(
+                    token_ep, data=body, headers={
+                        "Content-Type": "application/x-www-form-urlencoded"}),
+                    timeout=15.0) as resp:
+                out = _json.loads(resp.read())
+        except Exception as e:
+            raise PermissionError(f"OIDC code exchange failed: {e}") from e
+        id_token = out.get("id_token", "")
+        if not id_token:
+            raise PermissionError("provider returned no id_token")
+        claims = a.verify_jwt(id_token, method)
+        if client_nonce and claims.get("nonce") not in ("", None,
+                                                        client_nonce):
+            raise PermissionError("id_token nonce mismatch")
+        return self._login_with_claims(snap, method, claims)
+
+    # -- workload identities (reference nomad/structs WorkloadIdentity +
+    #    plan-time SignClaims; renewed via client/widmgr) --
+
+    def sign_workload_identity(self, alloc_id: str, task: str) -> dict:
+        """Mint (or renew) a task's workload-identity JWT. The client's
+        WIDMgr calls this before expiry for long-running tasks
+        (reference client/widmgr/widmgr.go renewal loop)."""
+        snap = self.store.snapshot()
+        alloc = snap.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise KeyError(f"alloc {alloc_id} not found")
+        if alloc.terminal_status():
+            raise PermissionError(
+                f"alloc {alloc_id} is terminal; no identity")
+        now = time.time()
+        ttl = self.config.identity_ttl
+        claims = {
+            "sub": f"{alloc.namespace}:{alloc.job_id}:{alloc.task_group}"
+                   f":{alloc_id}:{task}",
+            "alloc_id": alloc_id,
+            "job_id": alloc.job_id,
+            "namespace": alloc.namespace,
+            "task": task,
+            "iat": now,
+            "exp": now + ttl,
+        }
+        return {"token": self.encrypter.sign_identity(claims),
+                "exp": claims["exp"]}
 
     def resolve_token(self, secret_id: str):
         """secret -> compiled ACL (reference nomad/auth/auth.go)."""

@@ -39,6 +39,8 @@ FORWARD = ("register_job", "deregister_job", "dispatch_job",
            "upsert_acl_role", "delete_acl_role",
            "upsert_auth_method", "delete_auth_method",
            "upsert_binding_rule", "delete_binding_rule", "acl_login",
+           "oidc_auth_url", "oidc_complete_auth",
+           "sign_workload_identity",
            "upsert_region", "delete_region")
 
 
@@ -49,7 +51,9 @@ class ReplicatedServer:
                  data_dir: Optional[str] = None,
                  snapshot_threshold: int = 1024,
                  bootstrap: bool = True,
-                 dead_server_cleanup_s: Optional[float] = None):
+                 dead_server_cleanup_s: Optional[float] = None,
+                 gossip_bind: Optional[str] = None,
+                 gossip_seeds: Optional[List[str]] = None):
         self.id = node_id
         self.local_store = StateStore()
         self.fsm = FSM(self.local_store)
@@ -90,6 +94,22 @@ class ReplicatedServer:
         # "call" frames here (reference nomad/rpc.go forwardLeader)
         if hasattr(transport, "register_call_handler"):
             transport.register_call_handler(self._handle_remote_call)
+        # gossip membership (reference nomad/serf.go): when enabled the
+        # leader auto-joins gossip-discovered servers into the raft
+        # configuration and reaps gossip-dead ones — `server join`
+        # becomes "point a new server at ANY gossip address"
+        self.gossip = None
+        self._gossip_seeds = list(gossip_seeds or [])
+        self._gossip_stop = threading.Event()
+        self._gossip_dead_since = {}
+        if gossip_bind is not None:
+            from .gossip import GossipAgent
+
+            cfg = config or ServerConfig()
+            self.gossip = GossipAgent(
+                node_id, gossip_bind,
+                meta={"rpc": getattr(transport, "bind_addr", ""),
+                      "region": cfg.region})
 
     def _on_config_change(self, servers: Dict[str, str]) -> None:
         """Membership changed (config entry applied): teach the socket
@@ -169,11 +189,110 @@ class ReplicatedServer:
 
     def start(self) -> None:
         self.raft.start()
+        if self.gossip is not None:
+            self.gossip.start()
+            for seed in self._gossip_seeds:
+                self.gossip.join(seed)
+            t = threading.Thread(target=self._run_gossip_reconcile,
+                                 daemon=True,
+                                 name=f"gossip-reconcile-{self.id}")
+            t.start()
 
     def stop(self) -> None:
+        self._gossip_stop.set()
+        if self.gossip is not None:
+            self.gossip.stop()
         if self.server._running:
             self.server.stop()
         self.raft.stop()
+
+    def set_gossip_http(self, http_addr: str) -> None:
+        """Advertise this server's agent HTTP address in gossip meta
+        (WAN members use it to keep the federation region registry
+        fresh). Bumps our incarnation so the change disseminates."""
+        if self.gossip is None:
+            return
+        with self.gossip._lock:
+            me = self.gossip.members[self.id]
+            me["meta"]["http"] = http_addr
+            me["inc"] += 1
+
+    # -- gossip-driven autopilot (reference nomad/serf.go serverJoin /
+    #    serverFailed feeding autopilot member reconciliation) --
+
+    GOSSIP_RECONCILE_INTERVAL = 1.0
+
+    def _run_gossip_reconcile(self) -> None:
+        while not self._gossip_stop.wait(self.GOSSIP_RECONCILE_INTERVAL):
+            if not self.raft.is_leader():
+                continue
+            try:
+                self._gossip_reconcile_once()
+            except Exception:
+                pass  # transient raft state changes; next tick retries
+
+    # a gossip-DEAD verdict must persist this long before the leader
+    # removes the voter: one dropped UDP probe or a brief stall must not
+    # churn raft membership (the reference's autopilot applies the same
+    # kind of grace before dead-server cleanup)
+    GOSSIP_DEAD_REAP_S = 15.0
+
+    def _gossip_reconcile_once(self) -> None:
+        from .gossip import ALIVE, DEAD
+
+        cfg_region = self.server.config.region
+        members = self.gossip.snapshot()
+        current = dict(self.raft.servers)
+        now = time.time()
+        dead_since = self._gossip_dead_since
+        for mid in list(dead_since):
+            m = members.get(mid)
+            if m is None or m["status"] != DEAD:
+                dead_since.pop(mid, None)
+        for mid, m in members.items():
+            meta = m.get("meta") or {}
+            region = meta.get("region", cfg_region)
+            if region != cfg_region:
+                # WAN members maintain the federation registry instead
+                # of joining this region's raft quorum
+                http = meta.get("http", "")
+                if http:
+                    try:
+                        snap_region = self.server.store.snapshot().region(
+                            region)
+                        if m["status"] != DEAD and (
+                                snap_region is None
+                                or snap_region.address != http):
+                            self.server.upsert_region(
+                                {"name": region, "address": http})
+                    except Exception:
+                        pass
+                continue
+            rpc = meta.get("rpc", "")
+            if m["status"] == DEAD:
+                if mid not in current or mid == self.id:
+                    continue
+                since = dead_since.setdefault(mid, now)
+                if now - since < self.GOSSIP_DEAD_REAP_S:
+                    continue
+                # never remove a voter if the remaining set would lack
+                # a gossip-alive majority (availability over cleanup)
+                remaining = [sid for sid in current if sid != mid]
+                alive = sum(
+                    1 for sid in remaining
+                    if sid == self.id
+                    or (members.get(sid) or {}).get("status") == ALIVE)
+                if remaining and alive < len(remaining) // 2 + 1:
+                    continue
+                try:
+                    self.raft.remove_server(mid)
+                except Exception:
+                    pass
+            elif mid not in current and rpc:
+                try:
+                    self.raft.add_server(mid, rpc)
+                except Exception:
+                    pass
 
     def _on_leadership(self, is_leader: bool) -> None:
         # runs on raft threads; establish/revoke the leader subsystems

@@ -1,0 +1,264 @@
+"""SWIM-style UDP gossip membership (reference nomad/serf.go — the
+Serf LAN/WAN gossip that discovers servers, health-checks them, and
+feeds autopilot + region federation).
+
+A GossipAgent per server: JSON datagrams over UDP carrying the full
+member map (anti-entropy full-state merge — exact at the handful-of-
+servers scale a control plane runs at, where SWIM's O(1) piggyback
+dissemination buys nothing). Protocol:
+
+    ping: {"t": "ping", "from": id, "m": {member map}}
+    ack:  {"t": "ack",  "from": id, "m": {member map}}
+
+Liveness: every `interval` the agent probes one random live member; a
+probe with no ack within `ack_timeout` marks the member SUSPECT, and a
+suspect past `suspect_timeout` is DEAD (no indirect probes — at control
+plane scale every member probes every other within a few rounds, which
+is the redundancy indirect probing exists to approximate). Merge rules
+are standard SWIM: higher incarnation wins; at equal incarnation
+dead > suspect > alive; a member refutes suspicion about ITSELF by
+bumping its incarnation. Receiving any datagram from a member is direct
+proof of life.
+
+Members carry metadata (raft RPC address, region, HTTP address) so the
+consumers need no second lookup:
+- ReplicatedServer auto-joins gossip-discovered servers into the raft
+  configuration and reaps gossip-dead ones (the reference's
+  serverHealth-driven autopilot, nomad/server.go:1602);
+- foreign-region members keep the federation region registry fresh
+  (reference WAN serf feeding multi-region forwarding).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import socket
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+ALIVE = "alive"
+SUSPECT = "suspect"
+DEAD = "dead"
+
+_PRECEDENCE = {ALIVE: 0, SUSPECT: 1, DEAD: 2}
+
+
+class GossipAgent:
+    def __init__(self, node_id: str, bind: str = "127.0.0.1:0", *,
+                 meta: Optional[dict] = None,
+                 interval: float = 0.5,
+                 ack_timeout: float = 0.4,
+                 suspect_timeout: float = 2.0,
+                 on_change: Optional[Callable[[str, dict], None]] = None,
+                 logger=None):
+        self.id = node_id
+        self.interval = interval
+        self.ack_timeout = ack_timeout
+        self.suspect_timeout = suspect_timeout
+        self.on_change = on_change
+        self.logger = logger
+        host, port = bind.rsplit(":", 1)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind((host, int(port)))
+        self._sock.settimeout(0.2)
+        self.bind_addr = "%s:%d" % self._sock.getsockname()
+        self._lock = threading.Lock()
+        self.members: Dict[str, dict] = {
+            node_id: {"gossip": self.bind_addr, "inc": 1, "status": ALIVE,
+                      "meta": dict(meta or {})}}
+        # member id -> deadline of the outstanding probe
+        self._pending: Dict[str, float] = {}
+        # suspect since (local clock)
+        self._suspect_at: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._threads = []
+
+    # -- lifecycle --
+
+    def start(self) -> "GossipAgent":
+        for name, fn in (("gossip-rx", self._run_rx),
+                         ("gossip-probe", self._run_probe)):
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"{name}-{self.id}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def join(self, seed_addr: str) -> None:
+        """Introduce ourselves to one seed; the merge does the rest."""
+        self._send(seed_addr, {"t": "ping", "from": self.id,
+                               "m": self._snapshot()})
+
+    # -- wire --
+
+    def _send(self, addr: str, msg: dict) -> None:
+        host, port = addr.rsplit(":", 1)
+        try:
+            self._sock.sendto(json.dumps(msg).encode(), (host, int(port)))
+        except OSError:
+            pass
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            return {mid: {k: v for k, v in m.items()}
+                    for mid, m in self.members.items()}
+
+    def _run_rx(self) -> None:
+        while not self._stop.is_set():
+            try:
+                data, src = self._sock.recvfrom(256 * 1024)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                msg = json.loads(data)
+            except ValueError:
+                continue
+            sender = msg.get("from", "")
+            self._merge(msg.get("m") or {})
+            if sender and sender != self.id:
+                # direct proof of life beats any gossiped suspicion
+                self._evidence_alive(sender)
+                with self._lock:
+                    self._pending.pop(sender, None)
+            if msg.get("t") == "ping":
+                peer = self.members.get(sender)
+                addr = (peer or {}).get("gossip", "")
+                if addr:
+                    self._send(addr, {"t": "ack", "from": self.id,
+                                      "m": self._snapshot()})
+
+    def _run_probe(self) -> None:
+        while not self._stop.wait(self.interval):
+            now = time.time()
+            with self._lock:
+                # outstanding probe expired -> suspect
+                for mid, deadline in list(self._pending.items()):
+                    if now >= deadline:
+                        del self._pending[mid]
+                        self._set_status_locked(mid, SUSPECT)
+                # suspicion expired -> dead
+                for mid, since in list(self._suspect_at.items()):
+                    m = self.members.get(mid)
+                    if m is None or m["status"] != SUSPECT:
+                        del self._suspect_at[mid]
+                    elif now - since >= self.suspect_timeout:
+                        del self._suspect_at[mid]
+                        self._set_status_locked(mid, DEAD)
+                candidates = [
+                    (mid, m["gossip"]) for mid, m in self.members.items()
+                    if mid != self.id and m["status"] != DEAD
+                    and m.get("gossip") and mid not in self._pending]
+            if not candidates:
+                continue
+            mid, addr = random.choice(candidates)
+            with self._lock:
+                self._pending[mid] = now + self.ack_timeout
+            self._send(addr, {"t": "ping", "from": self.id,
+                              "m": self._snapshot()})
+
+    # -- membership state machine --
+
+    def _evidence_alive(self, mid: str) -> None:
+        with self._lock:
+            m = self.members.get(mid)
+            if m is None:
+                return
+            if m["status"] != ALIVE:
+                # direct contact refutes gossiped suspicion/death at the
+                # member's current incarnation
+                m["inc"] += 1
+                self._set_status_locked(mid, ALIVE)
+
+    def _set_status_locked(self, mid: str, status: str) -> None:
+        m = self.members.get(mid)
+        if m is None or m["status"] == status:
+            return
+        m["status"] = status
+        if status == SUSPECT:
+            self._suspect_at[mid] = time.time()
+        else:
+            self._suspect_at.pop(mid, None)
+        self._notify(mid, m)
+
+    def _notify(self, mid: str, m: dict) -> None:
+        if self.on_change is not None:
+            try:
+                self.on_change(mid, dict(m))
+            except Exception:
+                if self.logger:
+                    self.logger.exception("gossip on_change failed")
+
+    def _merge(self, remote: dict) -> None:
+        changed = []
+        with self._lock:
+            for mid, rm in remote.items():
+                if not isinstance(rm, dict):
+                    continue
+                r_inc = int(rm.get("inc", 0))
+                r_status = rm.get("status", ALIVE)
+                if mid == self.id:
+                    # refute rumors of our own demise with a higher
+                    # incarnation (SWIM refutation)
+                    me = self.members[self.id]
+                    if r_status != ALIVE and r_inc >= me["inc"]:
+                        me["inc"] = r_inc + 1
+                    continue
+                mine = self.members.get(mid)
+                if mine is None:
+                    self.members[mid] = {
+                        "gossip": rm.get("gossip", ""),
+                        "inc": r_inc, "status": r_status,
+                        "meta": dict(rm.get("meta") or {})}
+                    if r_status == SUSPECT:
+                        self._suspect_at[mid] = time.time()
+                    changed.append(mid)
+                    continue
+                if r_inc > mine["inc"] or (
+                        r_inc == mine["inc"]
+                        and _PRECEDENCE[r_status]
+                        > _PRECEDENCE[mine["status"]]):
+                    before = mine["status"]
+                    mine["inc"] = r_inc
+                    mine["status"] = r_status
+                    if rm.get("gossip"):
+                        mine["gossip"] = rm["gossip"]
+                    if rm.get("meta"):
+                        mine["meta"] = dict(rm["meta"])
+                    if r_status == SUSPECT:
+                        self._suspect_at.setdefault(mid, time.time())
+                    else:
+                        self._suspect_at.pop(mid, None)
+                    if before != r_status:
+                        changed.append(mid)
+            snapshot = {mid: dict(self.members[mid]) for mid in changed}
+        for mid in changed:
+            self._notify(mid, snapshot[mid])
+
+    # -- read surface --
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Locked copy of the full member map."""
+        return self._snapshot()
+
+    def alive_members(self) -> Dict[str, dict]:
+        with self._lock:
+            return {mid: dict(m) for mid, m in self.members.items()
+                    if m["status"] == ALIVE}
+
+    def member(self, mid: str) -> Optional[dict]:
+        with self._lock:
+            m = self.members.get(mid)
+            return dict(m) if m else None

@@ -152,18 +152,25 @@ class ClusterTensors:
                 if u is not None:
                     used[i] = u
         plan = ctx.plan
-        if plan is None:
-            return
-        touched = (set(plan.node_update) | set(plan.node_preemptions)
-                   | set(plan.node_allocation))
-        for node_id in touched:
-            i = self.node_index.get(node_id)
-            if i is None:
-                continue
-            used[i] = 0.0
-            for a in ctx.proposed_allocs(node_id):
-                if a.should_count_for_usage():
-                    used[i] += a.allocated_vec
+        if plan is not None:
+            touched = (set(plan.node_update) | set(plan.node_preemptions)
+                       | set(plan.node_allocation))
+            for node_id in touched:
+                i = self.node_index.get(node_id)
+                if i is None:
+                    continue
+                used[i] = 0.0
+                for a in ctx.proposed_allocs(node_id):
+                    if a.should_count_for_usage():
+                        used[i] += a.allocated_vec
+        # other racing evals' in-flight (solved, not yet committed)
+        # placements: fold LAST so this solve plans around them instead
+        # of colliding on the same best-fit nodes (tensor/overlay.py;
+        # the per-eval twin of the bulk solver service's carry)
+        from .overlay import INFLIGHT
+
+        INFLIGHT.fold(used[:n], self.node_index,
+                      exclude_plan=ctx.plan)
 
     def latest_usage(self) -> np.ndarray:
         """Freshly-gathered LATEST committed usage, (n_pad, D) float32.
@@ -181,6 +188,13 @@ class ClusterTensors:
             if len(rows) == 0 or rows.max() < mat.shape[0]:
                 out = np.zeros((self.n_pad, RESOURCE_DIMS), dtype=np.float32)
                 out[: len(self.nodes)] = mat[rows]
+                # per-eval in-flight placements (tensor/overlay.py) are
+                # not in the store yet NOR in the service's own ledger —
+                # fold them so a bulk resync can't double-book against
+                # racing spread/constraint evals
+                from .overlay import INFLIGHT
+
+                INFLIGHT.fold(out[: len(self.nodes)], self.node_index)
                 return out
         return self.used.astype(np.float32)
 

@@ -1,0 +1,95 @@
+"""In-flight usage overlay for the PER-EVAL solve paths.
+
+The bulk C2M path serializes racing workers on the solver service's
+device-resident carry, so concurrent solves see each other's placements
+before they commit (tensor/solver.py). The per-eval kernel paths
+(spread/constraints/distinct-hosts — one fused launch per eval) had no
+such visibility: two workers racing at the same snapshot both fill the
+same best-fit nodes to capacity, the applier rejects the loser's whole
+node lists, and the spread rung's rejection rate ran ABOVE stock
+(round 4 weak #5: 0.0018 vs 0.0; stock's log2-N candidate subsampling
+decorrelates workers by accident).
+
+This overlay is the host-side twin of the service's ledger: each
+per-eval solve registers its placements' per-node usage deltas keyed by
+node ID; every ClusterTensors usage gather folds the open entries in,
+so the NEXT racing eval plans around them. Entries close through the
+same plan post-apply hooks the service uses (confirmed usage is then in
+the store; rejected nodes' deltas die with the entry), with a TTL
+backstop for evals that die between solve and submit. Like the carry,
+this is optimism-repair only — the serialized plan applier remains the
+correctness gate.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+ENTRY_TTL = 60.0
+
+
+class InflightOverlay:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[int, dict] = {}  # token -> entry
+        self._token = 0
+        self.stats = {"registered": 0, "confirmed": 0, "expired": 0}
+
+    def register(self, deltas: Dict[str, object], plan) -> None:
+        """Record one eval's in-flight per-node usage deltas
+        ({node_id: vec}) and arrange for the plan outcome to close the
+        entry (planner contract: hooks fire with the commit)."""
+        if not deltas:
+            return
+        now = time.time()
+        with self._lock:
+            self._token += 1
+            token = self._token
+            self._entries[token] = {"deltas": deltas, "born": now,
+                                    "plan": id(plan)}
+            self.stats["registered"] += 1
+        if plan is not None:
+            plan.post_apply_hooks.append(
+                lambda result, _t=token: self.confirm(
+                    _t, getattr(result, "rejected_nodes", None) or ()))
+        else:
+            # no plan to hook (harness edge): rely on the TTL
+            pass
+
+    def confirm(self, token: int, rejected_node_ids) -> None:
+        """Plan applied: committed usage is now in the store, rejected
+        nodes never landed — either way the entry closes."""
+        with self._lock:
+            if self._entries.pop(token, None) is not None:
+                self.stats["confirmed"] += 1
+
+    def fold(self, used, node_index: Dict[str, int],
+             exclude_plan=None) -> None:
+        """Add every open entry's deltas into a canonical-order usage
+        matrix (in place). Called from ClusterTensors usage gathers.
+        `exclude_plan` skips the calling eval's OWN entries — its
+        placements are already in the plan the usage recompute reads
+        (double-counting them made multi-group evals see full nodes)."""
+        now = time.time()
+        exclude = id(exclude_plan) if exclude_plan is not None else None
+        with self._lock:
+            if not self._entries:
+                return
+            dead = [t for t, e in self._entries.items()
+                    if now - e["born"] > ENTRY_TTL]
+            for t in dead:
+                del self._entries[t]
+                self.stats["expired"] += 1
+            entries = [e for e in self._entries.values()
+                       if e.get("plan") != exclude or exclude is None]
+        d = used.shape[1]
+        for e in entries:
+            for node_id, vec in e["deltas"].items():
+                row = node_index.get(node_id)
+                if row is not None:
+                    used[row] += vec[:d]
+
+
+INFLIGHT = InflightOverlay()

@@ -81,6 +81,12 @@ def _preempt_pick_host(available, used, evictable, ask, feasible, net_prio,
     return picks
 
 
+# One solve at a time across racing workers' PER-EVAL kernel path (the
+# device serializes launches regardless); see the critical-section note
+# in place(). The bulk path has its own serializer (the solver service).
+_PER_EVAL_SOLVE_LOCK = __import__("threading").Lock()
+
+
 class TPUPlacer:
     """Placer implementation: dense-tensor batch solve on the device."""
 
@@ -210,29 +216,60 @@ class TPUPlacer:
                 if req.ignore_node:
                     penalty_idx[i] = cluster.node_index.get(req.ignore_node, -1)
 
-            # device/core count columns extend the dense dims per group
-            has_extra = tgt.extra_ask is not None and len(tgt.extra_ask)
-            if has_extra:
-                avail = np.concatenate([cluster.available, tgt.extra_cap], axis=1)
-                used = np.concatenate([cluster.used, tgt.extra_used], axis=1)
-                ask = np.concatenate([tgt.ask, tgt.extra_ask])
-            else:
-                avail, used, ask = cluster.available, cluster.used, tgt.ask
+            # The usage gather -> solve -> in-flight registration runs
+            # as ONE critical section across racing workers: the device
+            # serializes launches anyway, and without this ordering two
+            # concurrent evals both fill the same near-full best-fit
+            # nodes to the brim and the applier rejects the loser's
+            # whole node lists (the round-4 spread-rung rejection gap —
+            # measured: overflows on the smallest-capacity nodes, base +
+            # planned > available). Inside the lock each solve re-reads
+            # usage WITH every earlier solve's overlay entries folded
+            # (tensor/overlay.py), so racing workers interleave around
+            # each other like the bulk path's carry provides for free.
+            from .overlay import INFLIGHT
 
-            packed = pack_solve_args(
-                avail, used, tgt.placed_tg, tgt.placed_job,
-                ask, tgt.feasible, tgt.affinity_boost, penalty_idx, active,
-                tgt.spread_val_id, tgt.spread_val_ok, tgt.spread_counts,
-                tgt.spread_desired, tgt.spread_has_targets, tgt.spread_weight,
-                -1.0, tgt.tg_count, tgt.dh_job, tgt.dh_tg, tgt.spread_alg,
-                dev_affinity=tgt.dev_affinity,
-                dp_val_id=tgt.dp_val_id, dp_val_ok=tgt.dp_val_ok,
-                dp_counts0=tgt.dp_counts, dp_limit=tgt.dp_limit,
-                tie_perm=tie_perm)
-            out = np.asarray(solve_task_group_fused(*packed))  # one readback
-            choices = out[0].astype(np.int64)
-            founds = out[1] > 0.5
-            scores = out[2]
+            with _PER_EVAL_SOLVE_LOCK:
+                cluster.refresh_usage(ctx)
+                # device/core count columns extend the dense dims
+                has_extra = tgt.extra_ask is not None and len(tgt.extra_ask)
+                if has_extra:
+                    avail = np.concatenate([cluster.available, tgt.extra_cap],
+                                           axis=1)
+                    used = np.concatenate([cluster.used, tgt.extra_used],
+                                          axis=1)
+                    ask = np.concatenate([tgt.ask, tgt.extra_ask])
+                else:
+                    avail, used, ask = (cluster.available, cluster.used,
+                                        tgt.ask)
+
+                packed = pack_solve_args(
+                    avail, used, tgt.placed_tg, tgt.placed_job,
+                    ask, tgt.feasible, tgt.affinity_boost, penalty_idx,
+                    active,
+                    tgt.spread_val_id, tgt.spread_val_ok, tgt.spread_counts,
+                    tgt.spread_desired, tgt.spread_has_targets,
+                    tgt.spread_weight,
+                    -1.0, tgt.tg_count, tgt.dh_job, tgt.dh_tg, tgt.spread_alg,
+                    dev_affinity=tgt.dev_affinity,
+                    dp_val_id=tgt.dp_val_id, dp_val_ok=tgt.dp_val_ok,
+                    dp_counts0=tgt.dp_counts, dp_limit=tgt.dp_limit,
+                    tie_perm=tie_perm)
+                out = np.asarray(solve_task_group_fused(*packed))  # 1 readback
+                choices = out[0].astype(np.int64)
+                founds = out[1] > 0.5
+                scores = out[2]
+                if ctx.plan is not None and founds.any():
+                    vec = ctx.tg_vec(tg)
+                    kernel_counts: Dict[int, int] = {}
+                    for i in range(len(reqs)):
+                        if founds[i]:
+                            ni = int(choices[i])
+                            kernel_counts[ni] = kernel_counts.get(ni, 0) + 1
+                    INFLIGHT.register(
+                        {cluster.nodes[ni].id: vec * c
+                         for ni, c in kernel_counts.items()},
+                        ctx.plan)
 
             # exact port numbers / device instances / core ids are
             # host-side, per chosen node, after the solve (the kernel only

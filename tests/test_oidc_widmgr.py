@@ -1,0 +1,287 @@
+"""OIDC login flow + workload-identity renewal (round 5; reference
+nomad/acl_endpoint.go OIDCAuthURL/OIDCCompleteAuth, command/login.go,
+client/widmgr/widmgr.go)."""
+
+import base64
+import hashlib
+import hmac
+import json
+import threading
+import time
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.acl.auth import AUTH_TYPE_OIDC, AuthMethod, BindingRule
+from nomad_tpu.core.server import Server, ServerConfig
+
+HMAC_KEY = b"oidc-test-key"
+HMAC_KEY_B64 = base64.b64encode(HMAC_KEY).decode()
+
+
+def _b64(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+
+def make_jwt(claims: dict) -> str:
+    head = _b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    body = _b64(json.dumps(claims).encode())
+    sig = hmac.new(HMAC_KEY, f"{head}.{body}".encode(),
+                   hashlib.sha256).digest()
+    return f"{head}.{body}.{_b64(sig)}"
+
+
+class StubProvider:
+    """A minimal OIDC provider: /auth redirects back with a code,
+    /token exchanges the code for an id_token."""
+
+    def __init__(self):
+        self.codes = {}  # code -> nonce
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                u = urllib.parse.urlparse(self.path)
+                q = urllib.parse.parse_qs(u.query)
+                if u.path == "/auth":
+                    code = f"code-{len(stub.codes)}"
+                    stub.codes[code] = (q.get("nonce") or [""])[0]
+                    loc = (q["redirect_uri"][0]
+                           + f"?code={code}&state={q['state'][0]}")
+                    self.send_response(302)
+                    self.send_header("Location", loc)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(404)
+                self.end_headers()
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                form = urllib.parse.parse_qs(
+                    self.rfile.read(length).decode())
+                code = (form.get("code") or [""])[0]
+                if self.path == "/token" and code in stub.codes:
+                    idt = make_jwt({
+                        "iss": "stub", "sub": "dev-user",
+                        "aud": "nomad-tpu",
+                        "nonce": stub.codes[code],
+                        "exp": time.time() + 300,
+                        "login": "devuser",
+                    })
+                    body = json.dumps({"id_token": idt}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                self.send_response(400)
+                self.end_headers()
+
+        self.httpd = HTTPServer(("127.0.0.1", 0), Handler)
+        self.base = f"http://127.0.0.1:{self.httpd.server_port}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+@pytest.fixture
+def oidc_server():
+    provider = StubProvider()
+    s = Server(ServerConfig(acl_enabled=True))
+    s.start()
+    yield s, provider
+    s.stop()
+    provider.stop()
+
+
+class TestOIDCFlow:
+    def _setup_method(self, s, provider, redirect):
+        s.upsert_acl_policy(
+            "devs", '{"namespace": {"default": {"policy": "read"}}}',
+            "dev read")
+        s.upsert_auth_method(AuthMethod(
+            name="corp", type=AUTH_TYPE_OIDC,
+            max_token_ttl_s=600.0,
+            config={
+                "oidc_auth_endpoint": provider.base + "/auth",
+                "oidc_token_endpoint": provider.base + "/token",
+                "oidc_client_id": "nomad-tpu",
+                "oidc_client_secret": "shh",
+                "allowed_redirect_uris": [redirect],
+                "jwt_validation_keys": [HMAC_KEY_B64],
+                "bound_issuer": "stub",
+                "bound_audiences": ["nomad-tpu"],
+                "claim_mappings": {"login": "login"},
+            }))
+        s.upsert_binding_rule(BindingRule(
+            auth_method="corp", selector="login==devuser",
+            bind_type="policy", bind_name="devs"))
+
+    def test_round_trip_via_manual_redirect(self, oidc_server):
+        s, provider = oidc_server
+        redirect = "http://127.0.0.1:9/oidc/callback"
+        self._setup_method(s, provider, redirect)
+        out = s.oidc_auth_url("corp", redirect, client_nonce="n-2")
+
+        class NoRedirect(urllib.request.HTTPRedirectHandler):
+            def redirect_request(self, *a, **kw):
+                return None
+
+        opener = urllib.request.build_opener(NoRedirect)
+        try:
+            resp = opener.open(out["auth_url"])
+            loc = resp.headers.get("Location", "")
+        except urllib.error.HTTPError as e:
+            loc = e.headers.get("Location", "")
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(loc).query)
+        code, state = q["code"][0], q["state"][0]
+        assert state == out["state"]
+        token = s.oidc_complete_auth("corp", state, code, redirect,
+                                     client_nonce="n-2")
+        assert token.policies == ["devs"]
+        assert token.expiration_time > time.time()
+        # state is single-use
+        with pytest.raises(PermissionError):
+            s.oidc_complete_auth("corp", state, code, redirect,
+                                 client_nonce="n-2")
+        # the minted token authorizes reads
+        acl = s.resolve_token(token.secret_id)
+        assert acl is not None and not acl.management
+
+    def test_auth_url_rejects_unknown_redirect(self, oidc_server):
+        s, provider = oidc_server
+        redirect = "http://127.0.0.1:9/oidc/callback"
+        self._setup_method(s, provider, redirect)
+        with pytest.raises(PermissionError):
+            s.oidc_auth_url("corp", "http://evil/cb", client_nonce="x")
+
+    def test_nonce_mismatch_rejected(self, oidc_server):
+        s, provider = oidc_server
+        redirect = "http://127.0.0.1:9/oidc/callback"
+        self._setup_method(s, provider, redirect)
+        out = s.oidc_auth_url("corp", redirect, client_nonce="right")
+        with pytest.raises(PermissionError):
+            s.oidc_complete_auth("corp", out["state"], "code-x", redirect,
+                                 client_nonce="wrong")
+
+
+class TestWIDMgr:
+    def test_task_observes_refreshed_token(self, tmp_path):
+        """A long-running task's secrets/nomad_token is rewritten with a
+        fresh identity before the old one expires (reference
+        client/widmgr renewal at half TTL)."""
+        import os
+
+        from nomad_tpu.client import Client, ClientConfig
+        from nomad_tpu.structs.job import Task
+
+        s = Server(ServerConfig(heartbeat_ttl=30.0, identity_ttl=2.0))
+        s.start()
+        c = Client(s, ClientConfig(data_dir=str(tmp_path / "c0"),
+                                   heartbeat_interval=0.5))
+        c.start()
+        try:
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.tasks[0] = Task(name="long", driver="mock",
+                               config={"run_for": 60.0})
+            s.register_job(job)
+            assert s.wait_for_idle(10.0)
+            alloc = s.store.snapshot().allocs_by_job(job.id)[0]
+            token_file = os.path.join(
+                c.config.data_dir, "alloc", alloc.id, "long", "secrets",
+                "nomad_token")
+            assert c.wait_until(lambda: os.path.exists(token_file),
+                                timeout=20.0)
+            first = open(token_file).read()
+            claims = s.encrypter.verify_identity(first)
+            assert claims["alloc_id"] == alloc.id
+            assert claims["task"] == "long"
+            # within 2x TTL the file must hold a DIFFERENT, LIVE token
+            assert c.wait_until(
+                lambda: open(token_file).read() != first, timeout=10.0)
+            second = open(token_file).read()
+            claims2 = s.encrypter.verify_identity(second)
+            assert claims2["exp"] > claims["exp"]
+            assert claims2["exp"] > time.time()
+        finally:
+            c.stop()
+            s.stop()
+
+    def test_terminal_alloc_gets_no_identity(self, tmp_path):
+        s = Server(ServerConfig())
+        s.start()
+        try:
+            n = mock.node()
+            s.store.upsert_node(n)
+            job = mock.job()
+            s.store.upsert_job(job)
+            a = mock.alloc(job, n)
+            from nomad_tpu.structs import enums
+
+            a.desired_status = enums.ALLOC_DESIRED_STOP
+            s.store.upsert_allocs([a])
+            with pytest.raises(PermissionError):
+                s.sign_workload_identity(a.id, "t")
+        finally:
+            s.stop()
+
+
+class TestCLIOIDCLogin:
+    def test_cli_acl_login_type_oidc(self, oidc_server, capsys,
+                                     monkeypatch):
+        """Full CLI round-trip: `acl login -type=oidc` starts the local
+        callback, the 'browser' (a thread fetching the auth URL and
+        following the provider redirect) lands on it, and the CLI prints
+        the bound ephemeral token (reference command/login.go)."""
+        from nomad_tpu.api.http import HTTPAgent
+        from nomad_tpu.cli import main
+
+        s, provider = oidc_server
+        agent = HTTPAgent(s, port=0).start()
+        try:
+            # method allowing ANY loopback redirect (the CLI picks an
+            # ephemeral callback port)
+            TestOIDCFlow()._setup_method(s, provider, redirect="")
+            m = s.store.snapshot().auth_method("corp")
+            import copy as _copy
+
+            m2 = _copy.copy(m)
+            m2.config = dict(m.config)
+            m2.config["allowed_redirect_uris"] = []  # allow any (dev)
+            s.upsert_auth_method(m2)
+
+            def fake_browser(url):
+                def follow():
+                    try:
+                        urllib.request.urlopen(url, timeout=10.0)
+                    except Exception:
+                        pass
+                threading.Thread(target=follow, daemon=True).start()
+                return True
+
+            monkeypatch.setattr("webbrowser.open", fake_browser)
+            rc = main(["--address", agent.address, "acl", "login",
+                       "-method", "corp", "-type", "oidc"])
+            assert rc == 0
+            out = json.loads(capsys.readouterr().out)
+            assert out["policies"] == ["devs"]
+            assert out["secret_id"]
+            # the minted secret works against the API
+            req = urllib.request.Request(
+                f"{agent.address}/v1/jobs",
+                headers={"X-Nomad-Token": out["secret_id"]})
+            assert urllib.request.urlopen(req).status == 200
+        finally:
+            agent.stop()

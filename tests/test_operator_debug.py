@@ -1,0 +1,78 @@
+"""Operator debug bundle + pprof-style endpoints (round 5; reference
+command/operator_debug.go + command/agent/http.go:534-538 pprof)."""
+
+import json
+import tarfile
+import urllib.request
+
+from nomad_tpu import mock
+from nomad_tpu.api.http import HTTPAgent
+from nomad_tpu.cli import main
+from nomad_tpu.core.server import Server, ServerConfig
+
+
+class TestPprofEndpoints:
+    def test_thread_dump(self):
+        s = Server(ServerConfig())
+        s.start()
+        agent = HTTPAgent(s, port=0).start()
+        try:
+            out = json.loads(urllib.request.urlopen(
+                f"{agent.address}/v1/agent/pprof/threads").read())
+            assert out["threads"] > 3  # workers, applier, pumps...
+            assert "plan-applier" in out["dump"] or "worker" in out["dump"]
+        finally:
+            agent.stop()
+            s.stop()
+
+    def test_sampled_profile(self):
+        s = Server(ServerConfig())
+        s.start()
+        agent = HTTPAgent(s, port=0).start()
+        try:
+            out = json.loads(urllib.request.urlopen(
+                f"{agent.address}/v1/agent/pprof/profile?seconds=0.5&hz=50"
+            ).read())
+            assert out["samples"] > 5
+            assert isinstance(out["collapsed"], list)
+            # collapsed stacks end with a sample count
+            if out["collapsed"]:
+                assert out["collapsed"][0].rsplit(" ", 1)[1].isdigit()
+        finally:
+            agent.stop()
+            s.stop()
+
+
+class TestDebugBundle:
+    def test_bundle_has_triageable_contents(self, tmp_path):
+        s = Server(ServerConfig())
+        s.start()
+        s.store.upsert_node(mock.node())
+        job = mock.job()
+        s.register_job(job)
+        s.wait_for_idle(10.0)
+        agent = HTTPAgent(s, port=0).start()
+        out = tmp_path / "bundle.tar.gz"
+        try:
+            rc = main(["--address", agent.address, "operator", "debug",
+                       "-output", str(out), "-duration", "1"])
+            assert rc == 0
+            with tarfile.open(out) as tar:
+                names = {m.name for m in tar.getmembers()}
+                for want in ("nomad-debug/agent_self.json",
+                             "nomad-debug/jobs.json",
+                             "nomad-debug/nodes.json",
+                             "nomad-debug/threads.json",
+                             "nomad-debug/profile.json",
+                             "nomad-debug/metrics.prom",
+                             "nomad-debug/scheduler_config.json"):
+                    assert want in names, (want, names)
+                jobs = json.loads(tar.extractfile(
+                    "nomad-debug/jobs.json").read())
+                assert any(j["id"] == job.id for j in jobs)
+                prom = tar.extractfile(
+                    "nomad-debug/metrics.prom").read().decode()
+                assert "nomad" in prom
+        finally:
+            agent.stop()
+            s.stop()
