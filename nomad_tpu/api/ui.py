@@ -1,10 +1,19 @@
 """Embedded web UI (reference ui/: a 4.7MB Ember app served from
-bindata; here a single-file dashboard the agent serves at /ui).
+bindata; here a single-file hash-routed SPA the agent serves at /ui).
 
-Read-only operational view over the /v1 API: cluster summary, jobs
-with per-group allocation rollups, nodes with resource fill, recent
-deployments and evaluations. Auto-refreshes; zero external assets so
-it works in the air-gapped environments the reference targets."""
+Views over the /v1 API:
+  #/            cluster overview (jobs, nodes, deployments, services)
+  #/job/<id>    job detail: deployment progress, evaluations, and the
+                allocation table (reference ui/app/routes/jobs/job)
+  #/alloc/<id>  allocation drill-down: task states/events and a LIVE
+                log tail (stdout/stderr toggle) polling
+                /v1/client/fs/logs (reference ui taskstreaming)
+
+Auto-refreshes; zero external assets so it works in the air-gapped
+environments the reference targets. A deployment can be followed from
+submit to healthy without the CLI: overview -> job -> deployment bar +
+allocs -> alloc -> live logs.
+"""
 
 UI_HTML = """<!DOCTYPE html>
 <html lang="en">
@@ -21,41 +30,47 @@ UI_HTML = """<!DOCTYPE html>
   header { padding:14px 24px; border-bottom:1px solid var(--border);
            display:flex; align-items:baseline; gap:16px; }
   header h1 { font-size:18px; margin:0; }
+  header h1 a { color:var(--text); text-decoration:none; }
   header .sub { color:var(--dim); font-size:12px; }
   main { padding:18px 24px; display:grid; gap:18px;
          grid-template-columns:repeat(auto-fit,minmax(420px,1fr)); }
   section { background:var(--panel); border:1px solid var(--border);
             border-radius:8px; padding:14px 16px; }
+  section.wide { grid-column:1/-1; }
   section h2 { margin:0 0 10px; font-size:13px; text-transform:uppercase;
                letter-spacing:.08em; color:var(--dim); }
   table { width:100%; border-collapse:collapse; font-size:13px; }
   th { text-align:left; color:var(--dim); font-weight:500;
        border-bottom:1px solid var(--border); padding:4px 8px 4px 0; }
   td { padding:4px 8px 4px 0; border-bottom:1px solid #21262d; }
+  a { color:var(--blue); text-decoration:none; }
   .ok { color:var(--green); } .bad { color:var(--red); }
   .warn { color:var(--amber); } .dim { color:var(--dim); }
   .mono { font-family:ui-monospace, monospace; font-size:12px; }
   .bar { background:#21262d; border-radius:3px; height:8px; width:120px;
          display:inline-block; vertical-align:middle; overflow:hidden; }
   .bar i { display:block; height:100%; background:var(--blue); }
+  .bar i.g { background:var(--green); }
   .stats { display:flex; gap:24px; flex-wrap:wrap; }
   .stat b { display:block; font-size:22px; }
   .stat span { color:var(--dim); font-size:12px; }
+  pre.logs { background:#010409; border:1px solid var(--border);
+             border-radius:6px; padding:10px; height:420px; overflow:auto;
+             font:12px/1.4 ui-monospace, monospace; white-space:pre-wrap;
+             word-break:break-all; margin:0; }
+  .tabs button { background:var(--panel); color:var(--dim);
+                 border:1px solid var(--border); border-radius:6px;
+                 padding:4px 12px; cursor:pointer; font-size:12px; }
+  .tabs button.on { color:var(--text); border-color:var(--blue); }
+  .crumbs { font-size:12px; color:var(--dim); margin-bottom:4px; }
 </style>
 </head>
 <body>
 <header>
-  <h1>nomad-tpu</h1>
+  <h1><a href="#/">nomad-tpu</a></h1>
   <span class="sub" id="meta">loading…</span>
 </header>
-<main>
-  <section style="grid-column:1/-1"><h2>Cluster</h2>
-    <div class="stats" id="summary"></div></section>
-  <section><h2>Jobs</h2><table id="jobs"></table></section>
-  <section><h2>Nodes</h2><table id="nodes"></table></section>
-  <section><h2>Deployments</h2><table id="deps"></table></section>
-  <section><h2>Services</h2><table id="services"></table></section>
-</main>
+<main id="main"></main>
 <script>
 async function j(path) {
   const r = await fetch(path);
@@ -63,73 +78,233 @@ async function j(path) {
   return r.json();
 }
 function esc(v) {
-  return String(v).replace(/[&<>"']/g, c => ({"&":"&amp;","<":"&lt;",
-    ">":"&gt;","\"":"&quot;","'":"&#39;"}[c]));
+  return String(v ?? "").replace(/[&<>"']/g, c => ({"&":"&amp;","<":"&lt;",
+    ">":"&gt;","\\"":"&quot;","'":"&#39;"}[c]));
 }
 function cls(s) {
-  if (["running","ready","successful","complete","eligible"].includes(s))
-    return "ok";
-  if (["failed","down","lost","error"].includes(s)) return "bad";
-  if (["pending","paused","blocked","initializing"].includes(s))
+  if (["running","ready","successful","complete","eligible","healthy"]
+      .includes(s)) return "ok";
+  if (["failed","down","lost","error","unhealthy"].includes(s)) return "bad";
+  if (["pending","paused","blocked","initializing","unknown"].includes(s))
     return "warn";
   return "dim";
 }
 function row(cells) { return "<tr>" + cells.map(c => "<td>"+c+"</td>")
   .join("") + "</tr>"; }
-function bar(frac) {
+function bar(frac, green) {
   const pct = Math.min(100, Math.round(frac*100));
-  return `<span class="bar"><i style="width:${pct}%"></i></span>
-          <span class="dim"> ${pct}%</span>`;
+  return `<span class="bar"><i class="${green?'g':''}"
+    style="width:${pct}%"></i></span><span class="dim"> ${pct}%</span>`;
 }
-async function refresh() {
+function short(id) { return `<a class="mono" href="#/alloc/${esc(id)}">` +
+  esc(String(id).slice(0, 8)) + "</a>"; }
+let timer = null, logState = null;
+
+// ---- overview ----------------------------------------------------------
+async function viewOverview() {
+  const [jobs, nodes, deps, svcs, self] = await Promise.all([
+    j("/v1/jobs"), j("/v1/nodes"), j("/v1/deployments"),
+    j("/v1/services"), j("/v1/agent/self")]);
+  document.getElementById("meta").textContent =
+    (self.version ? "v"+self.version : "");
+  const running = jobs.filter(x => x.status === "running").length;
+  const ready = nodes.filter(n => n.status === "ready").length;
+  document.getElementById("main").innerHTML = `
+    <section class="wide"><h2>Cluster</h2><div class="stats">` +
+    [["jobs", jobs.length], ["running", running],
+     ["nodes", nodes.length], ["ready", ready],
+     ["deployments", deps.length], ["services", svcs.length]]
+     .map(([k,v]) => `<div class="stat"><b>${v}</b><span>${k}</span></div>`)
+     .join("") + `</div></section>
+    <section><h2>Jobs</h2><table>` +
+    "<tr><th>id</th><th>type</th><th>status</th><th>allocs</th></tr>" +
+    jobs.slice(0, 40).map(x => row([
+      `<a class="mono" href="#/job/${esc(x.id)}">${esc(x.id)}</a>`,
+      esc(x.type),
+      `<span class="${cls(x.status)}">${esc(x.status)}</span>`,
+      Object.entries(x.alloc_summary || {})
+        .map(([k,v]) => esc(k)+":"+esc(v)).join(" ") || "—"])).join("") +
+    `</table></section>
+    <section><h2>Nodes</h2><table>` +
+    "<tr><th>name</th><th>status</th><th>elig</th><th>cpu</th></tr>" +
+    nodes.slice(0, 40).map(n => row([
+      `<span class="mono">${esc(n.name || n.id.slice(0,8))}</span>`,
+      `<span class="${cls(n.status)}">${esc(n.status)}</span>`,
+      `<span class="${cls(n.scheduling_eligibility)}">` +
+        `${esc(n.scheduling_eligibility)}</span>`,
+      n.cpu_frac !== undefined ? bar(n.cpu_frac) : "—"])).join("") +
+    `</table></section>
+    <section><h2>Deployments</h2><table>` +
+    "<tr><th>job</th><th>status</th><th>detail</th></tr>" +
+    deps.slice(0, 20).map(d => row([
+      `<a class="mono" href="#/job/${esc(d.job_id)}">${esc(d.job_id)}</a>`,
+      `<span class="${cls(d.status)}">${esc(d.status)}</span>`,
+      `<span class="dim">${esc(d.status_description || "")}</span>`]))
+      .join("") + `</table></section>
+    <section><h2>Services</h2><table>` +
+    "<tr><th>name</th><th>instances</th><th>tags</th></tr>" +
+    svcs.slice(0, 20).map(s => row([
+      `<span class="mono">${esc(s.service_name)}</span>`, esc(s.instances),
+      `<span class="dim">${esc((s.tags||[]).join(", "))}</span>`]))
+      .join("") + `</table></section>`;
+}
+
+// ---- job detail --------------------------------------------------------
+async function viewJob(id) {
+  const [job, allocs, deps, evals] = await Promise.all([
+    j(`/v1/job/${id}`), j(`/v1/job/${id}/allocations`),
+    j(`/v1/job/${id}/deployments`), j(`/v1/job/${id}/evaluations`)]);
+  document.getElementById("meta").textContent = "job " + id;
+  const dep = deps[0];
+  let depHtml = "<span class='dim'>no deployments</span>";
+  if (dep) {
+    const groups = Object.entries(dep.task_groups || {}).map(([g, st]) => {
+      const healthy = st.healthy_allocs ?? 0, total = st.desired_total ?? 0;
+      return row([esc(g), `${healthy} / ${total} healthy`,
+                  bar(total ? healthy/total : 0, true),
+                  st.promoted ? "promoted" :
+                    (st.desired_canaries ? `canaries ${
+                     (st.placed_canaries||[]).length}/${st.desired_canaries}`
+                     : "—")]);
+    }).join("");
+    depHtml = `<div>status: <span class="${cls(dep.status)}">` +
+      `${esc(dep.status)}</span> <span class="dim">${
+        esc(dep.status_description || "")}</span></div>
+      <table><tr><th>group</th><th>health</th><th></th><th>canaries</th>
+      </tr>${groups}</table>`;
+  }
+  document.getElementById("main").innerHTML = `
+    <section class="wide"><div class="crumbs">
+      <a href="#/">cluster</a> / job</div>
+      <h2>${esc(id)} <span class="${cls(job.status)}">${esc(job.status)}
+      </span> <span class="dim">v${esc(job.version)} · ${esc(job.type)}
+      </span></h2>${depHtml}</section>
+    <section class="wide"><h2>Allocations (${allocs.length})</h2><table>` +
+    "<tr><th>id</th><th>name</th><th>node</th><th>desired</th>" +
+    "<th>client</th><th>health</th></tr>" +
+    allocs.slice(0, 200).map(a => row([
+      short(a.id), `<span class="mono">${esc(a.name)}</span>`,
+      `<span class="mono dim">${esc((a.node_name || a.node_id || "")
+        .slice(0, 12))}</span>`,
+      `<span class="${cls(a.desired_status)}">${esc(a.desired_status)}
+       </span>`,
+      `<span class="${cls(a.client_status)}">${esc(a.client_status)}</span>`,
+      a.deployment_status
+        ? `<span class="${a.deployment_status.healthy ? 'ok' : 'bad'}">` +
+          (a.deployment_status.healthy ? "healthy" : "unhealthy") + "</span>"
+        : "—"])).join("") + `</table></section>
+    <section class="wide"><h2>Evaluations</h2><table>` +
+    "<tr><th>id</th><th>status</th><th>triggered by</th><th>detail</th>" +
+    "</tr>" +
+    evals.slice(0, 20).map(e => row([
+      `<span class="mono">${esc(e.id.slice(0,8))}</span>`,
+      `<span class="${cls(e.status)}">${esc(e.status)}</span>`,
+      esc(e.triggered_by),
+      `<span class="dim">${esc(e.status_description || "")}</span>`]))
+      .join("") + `</table></section>`;
+}
+
+// ---- alloc detail + live logs ------------------------------------------
+async function viewAlloc(id) {
+  const a = await j(`/v1/allocation/${id}`);
+  document.getElementById("meta").textContent = "alloc " +
+    String(id).slice(0, 8);
+  const tasks = Object.keys(a.task_states || {});
+  const taskRows = Object.entries(a.task_states || {}).map(([name, st]) => {
+    const events = (st.events || []).slice(-4).map(ev =>
+      `<div class="dim">${esc(ev.type)}: ${esc(ev.message)}</div>`).join("");
+    return row([esc(name),
+      `<span class="${st.failed ? 'bad' : cls(st.state)}">` +
+        `${esc(st.state)}${st.failed ? " (failed)" : ""}</span>`,
+      esc(st.restarts ?? 0), events || "—"]);
+  }).join("");
+  if (!logState || logState.alloc !== id) {
+    logState = {alloc: id, task: tasks[0] || "", type: "stdout",
+                offset: 0, text: "", gen: 0, busy: false};
+  }
+  document.getElementById("main").innerHTML = `
+    <section class="wide"><div class="crumbs"><a href="#/">cluster</a> /
+      <a href="#/job/${esc(a.job_id)}">${esc(a.job_id)}</a> / alloc</div>
+      <h2>${esc(a.name)} <span class="mono dim">${esc(id)}</span></h2>
+      <div>desired <span class="${cls(a.desired_status)}">` +
+      `${esc(a.desired_status)}</span> · client <span
+        class="${cls(a.client_status)}">${esc(a.client_status)}</span>
+       · node <span class="mono dim">${esc(a.node_name || a.node_id)}
+       </span></div></section>
+    <section class="wide"><h2>Tasks</h2><table>
+      <tr><th>task</th><th>state</th><th>restarts</th><th>recent events
+      </th></tr>${taskRows}</table></section>
+    <section class="wide"><h2>Logs
+      <span class="tabs">` +
+      tasks.map(t => `<button data-task="${esc(t)}"
+        class="${t === logState.task ? 'on' : ''}">${esc(t)}</button>`)
+        .join(" ") +
+      ` <button data-type="stdout"
+          class="${logState.type === 'stdout' ? 'on' : ''}">stdout</button>
+        <button data-type="stderr"
+          class="${logState.type === 'stderr' ? 'on' : ''}">stderr</button>
+      </span></h2>
+      <pre class="logs" id="logs">${esc(logState.text)}</pre></section>`;
+  document.querySelectorAll(".tabs button").forEach(b =>
+    b.addEventListener("click", () => {
+      if (b.dataset.task) logState.task = b.dataset.task;
+      if (b.dataset.type) logState.type = b.dataset.type;
+      logState.offset = 0; logState.text = "";
+      logState.gen++;  // in-flight fetches for the old stream discard
+      render();
+    }));
+  await pollLogs(id);
+}
+async function pollLogs(id) {
+  if (!logState || logState.alloc !== id || logState.busy) return;
+  logState.busy = true;
+  const gen = logState.gen;
   try {
-    const [jobs, nodes, deps, svcs, self] = await Promise.all([
-      j("/v1/jobs"), j("/v1/nodes"), j("/v1/deployments"),
-      j("/v1/services"), j("/v1/agent/self")]);
-    document.getElementById("meta").textContent =
-      (self.version ? "v"+self.version : "") +
-      (self.leader !== undefined ? " · leader: "+(self.leader||"local") : "");
-    const running = jobs.filter(x => x.status === "running").length;
-    const ready = nodes.filter(n => n.status === "ready").length;
-    document.getElementById("summary").innerHTML = [
-      ["jobs", jobs.length], ["running", running],
-      ["nodes", nodes.length], ["ready", ready],
-      ["deployments", deps.length], ["services", svcs.length],
-    ].map(([k,v]) => `<div class="stat"><b>${v}</b><span>${k}</span></div>`)
-     .join("");
-    document.getElementById("jobs").innerHTML =
-      "<tr><th>id</th><th>type</th><th>status</th><th>allocs</th></tr>" +
-      jobs.slice(0, 40).map(x => row([
-        `<span class="mono">${esc(x.id)}</span>`, esc(x.type),
-        `<span class="${cls(x.status)}">${esc(x.status)}</span>`,
-        Object.entries(x.alloc_summary || {}).map(([k,v]) => esc(k)+":"+esc(v)).join(" ") ||
-          "—"])).join("");
-    document.getElementById("nodes").innerHTML =
-      "<tr><th>name</th><th>status</th><th>elig</th><th>cpu</th></tr>" +
-      nodes.slice(0, 40).map(n => row([
-        `<span class="mono">${esc(n.name || n.id.slice(0,8))}</span>`,
-        `<span class="${cls(n.status)}">${esc(n.status)}</span>`,
-        `<span class="${cls(n.scheduling_eligibility)}">` +
-          `${esc(n.scheduling_eligibility)}</span>`,
-        n.cpu_frac !== undefined ? bar(n.cpu_frac) : "—"])).join("");
-    document.getElementById("deps").innerHTML =
-      "<tr><th>job</th><th>status</th><th>detail</th></tr>" +
-      deps.slice(0, 20).map(d => row([
-        `<span class="mono">${esc(d.job_id)}</span>`,
-        `<span class="${cls(d.status)}">${esc(d.status)}</span>`,
-        `<span class="dim">${esc(d.status_description || "")}</span>`]))
-        .join("");
-    document.getElementById("services").innerHTML =
-      "<tr><th>name</th><th>instances</th><th>tags</th></tr>" +
-      svcs.slice(0, 20).map(s => row([
-        `<span class="mono">${esc(s.service_name)}</span>`, esc(s.instances),
-        `<span class="dim">${esc((s.tags||[]).join(", "))}</span>`])).join("");
+    const out = await j(`/v1/client/fs/logs/${id}?task=` +
+      encodeURIComponent(logState.task) + `&type=${logState.type}` +
+      `&offset=${logState.offset}&limit=65536`);
+    if (!logState || logState.alloc !== id || logState.gen !== gen)
+      return;  // stream switched while this fetch was in flight
+    const chunk = atob(out.data || "");
+    if (chunk) {
+      logState.text = (logState.text + chunk).slice(-200000);
+      // the reply's offset echoes the READ START; advance past the chunk
+      logState.offset = out.offset + chunk.length;
+      const el = document.getElementById("logs");
+      if (el) { el.textContent = logState.text;
+                el.scrollTop = el.scrollHeight; }
+    }
   } catch (e) {
-    document.getElementById("meta").textContent = "refresh failed: " + e;
+    const el = document.getElementById("logs");
+    if (el && logState && !logState.text)
+      el.textContent = "(no logs: " + e + ")";
+  } finally {
+    if (logState) logState.busy = false;
   }
 }
-refresh();
-setInterval(refresh, 3000);
+
+// ---- router ------------------------------------------------------------
+async function render() {
+  const hash = location.hash || "#/";
+  try {
+    let m;
+    if ((m = hash.match(/^#\\/job\\/(.+)$/)))
+      await viewJob(decodeURIComponent(m[1]));
+    else if ((m = hash.match(/^#\\/alloc\\/(.+)$/)))
+      await viewAlloc(decodeURIComponent(m[1]));
+    else await viewOverview();
+  } catch (e) {
+    document.getElementById("meta").textContent = "error: " + e;
+  }
+}
+window.addEventListener("hashchange", () => { logState = null; render(); });
+render();
+timer = setInterval(() => {
+  const hash = location.hash || "#/";
+  const m = hash.match(/^#\\/alloc\\/(.+)$/);
+  if (m) pollLogs(decodeURIComponent(m[1]));
+  else render();
+}, 3000);
 </script>
 </body>
 </html>

@@ -65,6 +65,7 @@ def cmd_agent(args) -> int:
 
     cfg = ServerConfig(
         num_workers=args.workers,
+        gossip_key=getattr(args, "gossip_key", "") or "",
         region=getattr(args, "region", "global"),
         authoritative_region=getattr(args, "authoritative_region", ""),
         sched_config=SchedulerConfiguration(scheduler_algorithm=args.algorithm))
@@ -656,6 +657,10 @@ def cmd_acl(args) -> int:
     if args.acl_cmd == "login":
         if getattr(args, "login_type", "jwt") == "oidc":
             return _oidc_login(api, args)
+        if not args.login_token:
+            print("acl login -type=jwt requires a login token argument",
+                  file=sys.stderr)
+            return 2
         token = args.login_token
         if token == "-":
             token = sys.stdin.read().strip()
@@ -870,6 +875,8 @@ def build_parser() -> argparse.ArgumentParser:
                          "membership, reference nomad/serf.go)")
     ag.add_argument("--retry-join", dest="retry_join", default="",
                     help="comma-separated gossip seed addresses to join via")
+    ag.add_argument("--gossip-key", dest="gossip_key", default="",
+                    help="shared secret authenticating gossip datagrams")
     ag.add_argument("--dead-server-cleanup", type=float, default=0.0,
                     help="autopilot: remove a server unreachable this many "
                          "seconds (0 = disabled; reference nomad/autopilot.go)")

@@ -33,7 +33,9 @@ class _Rotator:
         self.max_files = max(1, max_files)
         self.max_bytes = max(1, max_bytes)
         self._idx = self._newest_index()
-        self._file = open(self._path(self._idx), "ab")
+        # unbuffered: a live `alloc logs -f` / UI tail must see output
+        # as the task emits it, not when an 8KB userspace buffer fills
+        self._file = open(self._path(self._idx), "ab", buffering=0)
         # finish any prune a crash interrupted: files at or below the
         # persisted through_index are already counted in the pruned base
         _, through = _read_pruned(prefix)
@@ -64,7 +66,7 @@ class _Rotator:
         if self._file.tell() >= self.max_bytes:
             self._file.close()
             self._idx += 1
-            self._file = open(self._path(self._idx), "ab")
+            self._file = open(self._path(self._idx), "ab", buffering=0)
             drop = self._idx - self.max_files
             if drop >= 0:
                 # account the dropped bytes BEFORE unlinking so logical

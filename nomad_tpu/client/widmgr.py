@@ -33,6 +33,13 @@ class WIDMgr:
         # task -> (written_at, expiry) of the currently-written token;
         # renewal is due at the half-life
         self._exp: Dict[str, tuple] = {}
+        # task -> consecutive renewal failures (exponential backoff —
+        # a leaderless window must not turn every widmgr thread into a
+        # 2-RPC/s flood) ; task -> next allowed attempt time
+        self._fails: Dict[str, int] = {}
+        self._retry_at: Dict[str, float] = {}
+        # tasks the server permanently refused (terminal alloc)
+        self._dead: set = set()
 
     # -- lifecycle --
 
@@ -74,6 +81,10 @@ class WIDMgr:
                 return
             now = time.time()
             for task in self.task_names:
+                if task in self._dead:
+                    continue
+                if now < self._retry_at.get(task, 0.0):
+                    continue
                 entry = self._exp.get(task)
                 if entry is None or now >= self._due(entry):
                     self._renew_one(task)
@@ -81,11 +92,21 @@ class WIDMgr:
     def _renew_one(self, task: str) -> bool:
         try:
             out = self.server.sign_workload_identity(self.alloc.id, task)
+        except PermissionError:
+            # terminal alloc server-side: no identity will ever be
+            # minted again — stop asking
+            self._dead.add(task)
+            return False
         except Exception:
             if self.logger:
                 self.logger.debug("identity renewal failed for %s/%s",
                                   self.alloc.id[:8], task)
+            n = self._fails.get(task, 0) + 1
+            self._fails[task] = n
+            self._retry_at[task] = time.time() + min(2.0 ** n, 60.0)
             return False
+        self._fails.pop(task, None)
+        self._retry_at.pop(task, None)
         token, exp = out["token"], float(out["exp"])
         td = self.task_dir_fn(task)
         secrets = os.path.join(td, "secrets")

@@ -64,6 +64,9 @@ class ServerConfig:
     # workload-identity JWT lifetime (client/widmgr renews at ~half TTL;
     # reference nomad/structs WorkloadIdentity TTL)
     identity_ttl: float = 3600.0
+    # shared secret authenticating gossip datagrams (reference: Serf
+    # encrypt key); empty = unauthenticated gossip (dev only)
+    gossip_key: str = ""
     # multi-region federation (reference nomad/rpc.go region forwarding
     # + leader.go replication loops)
     region: str = "global"
@@ -1154,6 +1157,29 @@ class Server:
 
     OIDC_REQUEST_TTL = 600.0
 
+    @staticmethod
+    def _redirect_allowed(redirect_uri: str, allowed) -> bool:
+        """An EMPTY allowlist denies everything (an unauthenticated
+        auth-url endpoint with allow-any redirects is an authorization-
+        code theft primitive — the reference requires registered
+        redirect URIs too). Entries may use a `:*` port wildcard so the
+        CLI's ephemeral-port loopback callback can be registered as
+        e.g. "http://127.0.0.1:*/oidc/callback"."""
+        if not redirect_uri or not allowed:
+            return False
+        for entry in allowed:
+            if entry == redirect_uri:
+                return True
+            if ":*/" in entry:
+                prefix, _, suffix = entry.partition(":*/")
+                if (redirect_uri.startswith(prefix + ":")
+                        and redirect_uri.endswith("/" + suffix)):
+                    port = redirect_uri[len(prefix) + 1:
+                                        -len(suffix) - 1]
+                    if port.isdigit():
+                        return True
+        return False
+
     def oidc_auth_url(self, auth_method: str, redirect_uri: str,
                       client_nonce: str = "") -> dict:
         """Build the provider authorization URL for an OIDC auth method
@@ -1167,7 +1193,7 @@ class Server:
         if method is None or method.type != AUTH_TYPE_OIDC:
             raise PermissionError(f"unknown OIDC auth method {auth_method!r}")
         allowed = method.config.get("allowed_redirect_uris") or []
-        if allowed and redirect_uri not in allowed:
+        if not self._redirect_allowed(redirect_uri, allowed):
             raise PermissionError(
                 f"redirect_uri {redirect_uri!r} is not allowed")
         auth_ep = method.config.get("oidc_auth_endpoint", "")

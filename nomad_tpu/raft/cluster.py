@@ -108,6 +108,7 @@ class ReplicatedServer:
             cfg = config or ServerConfig()
             self.gossip = GossipAgent(
                 node_id, gossip_bind,
+                key=(cfg.gossip_key.encode() if cfg.gossip_key else None),
                 meta={"rpc": getattr(transport, "bind_addr", ""),
                       "region": cfg.region})
 

@@ -31,6 +31,8 @@ consumers need no second lookup:
 
 from __future__ import annotations
 
+import hashlib
+import hmac as _hmac
 import json
 import random
 import socket
@@ -46,17 +48,29 @@ _PRECEDENCE = {ALIVE: 0, SUSPECT: 1, DEAD: 2}
 
 
 class GossipAgent:
+    # a DEAD tombstone this old is dropped from the member map (and a
+    # remote map's DEAD entry for an unknown member is never adopted),
+    # so full-state datagrams don't grow forever across server churn —
+    # the reason Serf reaps tombstones
+    DEAD_REAP_S = 60.0
+
     def __init__(self, node_id: str, bind: str = "127.0.0.1:0", *,
                  meta: Optional[dict] = None,
                  interval: float = 0.5,
                  ack_timeout: float = 0.4,
                  suspect_timeout: float = 2.0,
+                 key: Optional[bytes] = None,
                  on_change: Optional[Callable[[str, dict], None]] = None,
                  logger=None):
         self.id = node_id
         self.interval = interval
         self.ack_timeout = ack_timeout
         self.suspect_timeout = suspect_timeout
+        # shared-secret datagram authentication (reference: Serf's
+        # encrypted gossip): with a key set, unsigned or mis-signed
+        # datagrams are DROPPED — otherwise anyone who can reach the
+        # UDP port could inject members into the raft voter set
+        self._key = key
         self.on_change = on_change
         self.logger = logger
         host, port = bind.rsplit(":", 1)
@@ -104,8 +118,13 @@ class GossipAgent:
 
     def _send(self, addr: str, msg: dict) -> None:
         host, port = addr.rsplit(":", 1)
+        payload = json.dumps(msg, sort_keys=True)
+        if self._key is not None:
+            sig = _hmac.new(self._key, payload.encode(),
+                            hashlib.sha256).hexdigest()
+            payload = json.dumps({"p": payload, "sig": sig})
         try:
-            self._sock.sendto(json.dumps(msg).encode(), (host, int(port)))
+            self._sock.sendto(payload.encode(), (host, int(port)))
         except OSError:
             pass
 
@@ -126,6 +145,21 @@ class GossipAgent:
                 msg = json.loads(data)
             except ValueError:
                 continue
+            if self._key is not None:
+                payload = msg.get("p")
+                sig = msg.get("sig", "")
+                if not isinstance(payload, str):
+                    continue  # unsigned datagram with a key configured
+                want = _hmac.new(self._key, payload.encode(),
+                                 hashlib.sha256).hexdigest()
+                if not _hmac.compare_digest(want, sig):
+                    continue
+                try:
+                    msg = json.loads(payload)
+                except ValueError:
+                    continue
+            elif "p" in msg and "sig" in msg:
+                continue  # signed traffic from a keyed peer: can't verify
             sender = msg.get("from", "")
             self._merge(msg.get("m") or {})
             if sender and sender != self.id:
@@ -149,6 +183,14 @@ class GossipAgent:
                     if now >= deadline:
                         del self._pending[mid]
                         self._set_status_locked(mid, SUSPECT)
+                # old tombstones fall out of the map entirely
+                for mid, m in list(self.members.items()):
+                    if (m["status"] == DEAD and mid != self.id
+                            and now - m.get("dead_at", now)
+                            >= self.DEAD_REAP_S):
+                        del self.members[mid]
+                        self._pending.pop(mid, None)
+                        self._suspect_at.pop(mid, None)
                 # suspicion expired -> dead
                 for mid, since in list(self._suspect_at.items()):
                     m = self.members.get(mid)
@@ -186,11 +228,21 @@ class GossipAgent:
         m = self.members.get(mid)
         if m is None or m["status"] == status:
             return
+        if m["status"] == DEAD and status == SUSPECT:
+            # a stale probe expiring must not resurrect a corpse into
+            # the suspect/dead flip-flop (only direct contact or a
+            # higher incarnation revives); drop the stale probe instead
+            self._pending.pop(mid, None)
+            return
         m["status"] = status
+        if status == DEAD:
+            self._pending.pop(mid, None)
         if status == SUSPECT:
             self._suspect_at[mid] = time.time()
         else:
             self._suspect_at.pop(mid, None)
+        if status == DEAD:
+            m["dead_at"] = time.time()
         self._notify(mid, m)
 
     def _notify(self, mid: str, m: dict) -> None:
@@ -218,6 +270,8 @@ class GossipAgent:
                     continue
                 mine = self.members.get(mid)
                 if mine is None:
+                    if r_status == DEAD:
+                        continue  # never adopt a tombstone we reaped
                     self.members[mid] = {
                         "gossip": rm.get("gossip", ""),
                         "inc": r_inc, "status": r_status,
@@ -233,6 +287,8 @@ class GossipAgent:
                     before = mine["status"]
                     mine["inc"] = r_inc
                     mine["status"] = r_status
+                    if r_status == DEAD and "dead_at" not in mine:
+                        mine["dead_at"] = time.time()
                     if rm.get("gossip"):
                         mine["gossip"] = rm["gossip"]
                     if rm.get("meta"):

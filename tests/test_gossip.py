@@ -159,3 +159,57 @@ class TestGossipAutopilot:
         finally:
             s0.stop()
             t0.stop()
+
+
+class TestGossipAuth:
+    def test_unsigned_datagrams_dropped_when_keyed(self):
+        import json as _json
+        import socket as _socket
+
+        a = mk("a", key=b"secret")
+        b = mk("b", key=b"secret")
+        try:
+            b.join(a.bind_addr)
+            assert wait_until(lambda: len(a.alive_members()) == 2)
+            # an attacker without the key injects a forged member
+            forged = {"t": "ping", "from": "evil", "m": {
+                "evil": {"gossip": "127.0.0.1:1", "inc": 1,
+                         "status": ALIVE,
+                         "meta": {"rpc": "attacker:1",
+                                  "region": "global"}}}}
+            s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+            host, port = a.bind_addr.rsplit(":", 1)
+            s.sendto(_json.dumps(forged).encode(), (host, int(port)))
+            s.close()
+            time.sleep(1.0)
+            assert "evil" not in a.members
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_keyed_and_unkeyed_do_not_mix(self):
+        a = mk("a", key=b"secret")
+        b = mk("b")  # no key
+        try:
+            b.join(a.bind_addr)
+            time.sleep(1.0)
+            assert "b" not in a.members  # unsigned ping dropped
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_dead_tombstones_reaped(self):
+        a = mk("a")
+        a.DEAD_REAP_S = 1.0
+        b = mk("b")
+        try:
+            b.join(a.bind_addr)
+            assert wait_until(lambda: len(a.alive_members()) == 2)
+            b.stop()
+            assert wait_until(lambda: a.member("b") is not None
+                              and a.member("b")["status"] == DEAD,
+                              timeout=15.0)
+            # the tombstone falls out of the map entirely
+            assert wait_until(lambda: a.member("b") is None, timeout=10.0)
+        finally:
+            a.stop()

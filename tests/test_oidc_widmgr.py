@@ -259,7 +259,10 @@ class TestCLIOIDCLogin:
 
             m2 = _copy.copy(m)
             m2.config = dict(m.config)
-            m2.config["allowed_redirect_uris"] = []  # allow any (dev)
+            # the CLI binds an ephemeral loopback port: register the
+            # port-wildcard form (an EMPTY allowlist denies everything)
+            m2.config["allowed_redirect_uris"] = [
+                "http://127.0.0.1:*/oidc/callback"]
             s.upsert_auth_method(m2)
 
             def fake_browser(url):
@@ -285,3 +288,21 @@ class TestCLIOIDCLogin:
             assert urllib.request.urlopen(req).status == 200
         finally:
             agent.stop()
+
+
+    def test_empty_allowlist_denies(self, oidc_server):
+        """No registered redirect URIs = every redirect refused (an
+        unauthenticated allow-any auth-url endpoint would be a code
+        theft primitive)."""
+        s, provider = oidc_server
+        TestOIDCFlow()._setup_method(s, provider, redirect="x")
+        import copy as _copy
+
+        m = s.store.snapshot().auth_method("corp")
+        m2 = _copy.copy(m)
+        m2.config = dict(m.config)
+        m2.config["allowed_redirect_uris"] = []
+        s.upsert_auth_method(m2)
+        with pytest.raises(PermissionError):
+            s.oidc_auth_url("corp", "http://127.0.0.1:9/oidc/callback",
+                            client_nonce="n")

@@ -76,3 +76,79 @@ class TestDebugBundle:
         finally:
             agent.stop()
             s.stop()
+
+
+class TestUIDrilldown:
+    """/ui follows a deployment from submit to healthy without the CLI:
+    the SPA's three views consume exactly these API shapes (round 5;
+    reference ui/app/routes/jobs + taskstreaming)."""
+
+    def test_ui_serves_spa_and_backing_endpoints(self, tmp_path):
+        import base64
+        import time as _time
+
+        from nomad_tpu.client import Client, ClientConfig
+        from nomad_tpu.structs.job import Task, UpdateStrategy
+
+        s = Server(ServerConfig(heartbeat_ttl=30.0))
+        s.start()
+        c = Client(s, ClientConfig(data_dir=str(tmp_path / "c0"),
+                                   heartbeat_interval=0.5))
+        c.start()
+        agent = HTTPAgent(s, port=0, clients=[c]).start()
+        try:
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.update = UpdateStrategy(max_parallel=1, min_healthy_time_s=0.2,
+                               healthy_deadline_s=30.0)
+            tg.tasks[0] = Task(
+                name="server", driver="raw_exec",
+                config={"command": "/bin/sh",
+                        "args": ["-c",
+                                 "i=0; while true; do echo tick $i; "
+                                 "i=$((i+1)); sleep 0.2; done"]})
+            s.register_job(job)
+            assert s.wait_for_idle(10.0)
+
+            html = urllib.request.urlopen(
+                f"{agent.address}/ui").read().decode()
+            for marker in ("#/job/", "#/alloc/", "pollLogs",
+                           "/v1/client/fs/logs/"):
+                assert marker in html, marker
+
+            # job view backing data
+            allocs = json.loads(urllib.request.urlopen(
+                f"{agent.address}/v1/job/{job.id}/allocations").read())
+            assert len(allocs) == 1
+            deps = json.loads(urllib.request.urlopen(
+                f"{agent.address}/v1/job/{job.id}/deployments").read())
+            assert deps and "task_groups" in deps[0]
+            # deployment goes healthy (the submit -> healthy arc)
+            assert c.wait_until(lambda: json.loads(urllib.request.urlopen(
+                f"{agent.address}/v1/job/{job.id}/deployments").read()
+            )[0]["status"] == "successful", timeout=30.0)
+
+            # alloc view backing data + live log tail with offset paging
+            aid = allocs[0]["id"]
+            detail = json.loads(urllib.request.urlopen(
+                f"{agent.address}/v1/allocation/{aid}").read())
+            assert "server" in detail["task_states"]
+            deadline = _time.time() + 20
+            text, offset = "", 0
+            while _time.time() < deadline and text.count("tick") < 3:
+                out = json.loads(urllib.request.urlopen(
+                    f"{agent.address}/v1/client/fs/logs/{aid}"
+                    f"?task=server&type=stdout&offset={offset}&limit=4096"
+                ).read())
+                chunk = base64.b64decode(out["data"]).decode()
+                text += chunk
+                offset = out["offset"] + len(chunk)
+                _time.sleep(0.3)
+            assert text.count("tick") >= 3, text[:200]
+            # paging continued from the advanced offset (no duplicates)
+            assert text.count("tick 0") == 1, text[:200]
+        finally:
+            agent.stop()
+            c.stop()
+            s.stop()
