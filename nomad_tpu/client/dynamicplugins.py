@@ -1,0 +1,138 @@
+"""Dynamic plugins: plugins that run AS scheduled tasks (reference
+client/dynamicplugins/registry.go — how the reference ships CSI
+drivers: a job runs the plugin binary, the task registers it with the
+client, consumers dispense it by type+name).
+
+A task declares itself a plugin via its `plugin` stanza
+({"type": "volume"|"device", "id": "<plugin id>"}). The task runner
+exports NOMAD_PLUGIN_SOCKET into the task's secrets dir; the plugin
+executable (anything built on nomad_tpu.plugins.sdk.serve) binds it and
+serves the normal subprocess plugin protocol. When the socket appears
+the task's registration lands in the process-global volume/device
+plugin registries (plugins/volumes.py, plugins/devices.py) — exactly
+where agent-launched plugins land — and is withdrawn when the task
+dies. Multiple allocs may register the same plugin id (rolling
+updates); the most recent healthy registration wins, and deregistering
+one falls back to the next (the reference keeps the same
+list-per-name, registry.go RegistryState).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..plugins.manager import PluginError, _Conn
+
+PLUGIN_TYPE_VOLUME = "volume"
+PLUGIN_TYPE_DEVICE = "device"
+
+SOCKET_NAME = "plugin.sock"
+
+
+class SocketPluginHandle:
+    """Proxy for a task-served plugin socket: the `call()/alive()`
+    surface the ExternalVolumePlugin/ExternalDevicePlugin wrappers
+    consume (their agent-subprocess twin is plugins.manager
+    PluginInstance)."""
+
+    def __init__(self, name: str, sock_path: str, is_alive=None):
+        self.name = name
+        self._sock_path = sock_path
+        self._is_alive = is_alive
+        self._lock = threading.Lock()
+        self._conn: Optional[_Conn] = None
+
+    def call(self, method: str, timeout: float = 30.0, **args):
+        with self._lock:
+            if self._conn is None:
+                try:
+                    self._conn = _Conn(self._sock_path)
+                except OSError as e:
+                    raise PluginError(
+                        f"dynamic plugin {self.name}: {e}") from e
+            conn = self._conn
+        try:
+            return conn.call(method, timeout=timeout, **args)
+        except PluginError:
+            with self._lock:
+                if self._conn is conn:
+                    conn.close()
+                    self._conn = None
+            raise
+
+    def alive(self) -> bool:
+        if self._is_alive is not None and not self._is_alive():
+            return False
+        return os.path.exists(self._sock_path)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+
+
+class DynamicPluginRegistry:
+    """Stacked registrations per (type, plugin id); the newest lands in
+    the global plugin registry, deregistration falls back to the next
+    (reference registry.go list-per-name semantics)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (ptype, name) -> [(alloc_id, handle)], newest last
+        self._stacks: Dict[Tuple[str, str], List[tuple]] = {}
+
+    def register(self, ptype: str, name: str, alloc_id: str,
+                 sock_path: str, is_alive=None) -> None:
+        handle = SocketPluginHandle(name, sock_path, is_alive=is_alive)
+        with self._lock:
+            stack = self._stacks.setdefault((ptype, name), [])
+            stack.append((alloc_id, handle))
+        self._publish(ptype, handle)
+
+    def deregister(self, ptype: str, name: str, alloc_id: str) -> None:
+        with self._lock:
+            stack = self._stacks.get((ptype, name), [])
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i][0] == alloc_id:
+                    stack[i][1].close()
+                    del stack[i]
+                    break
+            survivor = stack[-1][1] if stack else None
+            if not stack:
+                self._stacks.pop((ptype, name), None)
+        if survivor is not None:
+            self._publish(ptype, survivor)
+        else:
+            self._unpublish(ptype, name)
+
+    def _publish(self, ptype: str, handle: SocketPluginHandle) -> None:
+        if ptype == PLUGIN_TYPE_VOLUME:
+            from ..plugins.volumes import (ExternalVolumePlugin,
+                                           register_volume_plugin)
+
+            register_volume_plugin(ExternalVolumePlugin(handle))
+        elif ptype == PLUGIN_TYPE_DEVICE:
+            from ..plugins.devices import (ExternalDevicePlugin,
+                                           register_device_plugin)
+
+            register_device_plugin(ExternalDevicePlugin(handle))
+
+    def _unpublish(self, ptype: str, name: str) -> None:
+        if ptype == PLUGIN_TYPE_VOLUME:
+            from ..plugins.volumes import unregister_volume_plugin
+
+            unregister_volume_plugin(name)
+        elif ptype == PLUGIN_TYPE_DEVICE:
+            from ..plugins.devices import unregister_device_plugin
+
+            unregister_device_plugin(name)
+
+    def plugins(self, ptype: str) -> List[str]:
+        with self._lock:
+            return sorted(n for t, n in self._stacks if t == ptype)
+
+
+REGISTRY = DynamicPluginRegistry()

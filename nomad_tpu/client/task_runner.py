@@ -13,6 +13,7 @@ out the interval (mode=delay).
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, Dict, Optional
@@ -92,6 +93,22 @@ class TaskRunner:
                 env = taskenv.build_env(self.alloc, self.task, self.node,
                                         self.task_dir, self.shared_dir)
                 env.update(self.extra_env)
+                if self.task.plugin:
+                    # plugins-as-tasks: the executable binds this socket
+                    # and serves the plugin protocol (plugins/sdk.py);
+                    # registration happens when the socket appears. The
+                    # socket lives in a SHORT tmp dir — AF_UNIX paths
+                    # cap at ~108 chars and alloc dirs easily exceed it
+                    import tempfile
+
+                    from ..plugins.protocol import SOCKET_ENV
+                    from .dynamicplugins import SOCKET_NAME
+
+                    if getattr(self, "_plugin_sock", None) is None:
+                        self._plugin_sock = os.path.join(
+                            tempfile.mkdtemp(prefix="nomadtpu-dp-"),
+                            SOCKET_NAME)
+                    env[SOCKET_ENV] = self._plugin_sock
                 for vname, vpath in self.volume_mounts.items():
                     safe = "".join(c if c.isalnum() else "_"
                                    for c in vname).upper()
@@ -116,6 +133,8 @@ class TaskRunner:
 
             if self.on_handle is not None:
                 self.on_handle(self.task.name, self._handle.handle_data())
+            if self.task.plugin:
+                self._watch_plugin_socket()
 
             self.state.state = "running"
             self.state.started_at = self.state.started_at or time.time()
@@ -134,6 +153,7 @@ class TaskRunner:
                             "was killed", exit_code=result.exit_code)
             self._event("Terminated", f"exit code {result.exit_code}",
                         exit_code=result.exit_code)
+            self._deregister_plugin()
             if result.successful():
                 self._die(failed=False)
                 return
@@ -142,11 +162,52 @@ class TaskRunner:
                 self._die(failed=True)
                 return
 
+        self._deregister_plugin()
         # killed
         if self._handle is not None:
             self._handle.kill(self.task.kill_timeout_s)
         self._event("Killed", "task killed by client")
         self._die(failed=False)
+
+    def _watch_plugin_socket(self) -> None:
+        """Register the task's plugin once its socket appears
+        (client/dynamicplugins.py; reference csi_plugin_supervisor
+        hook's socket wait)."""
+        from .dynamicplugins import REGISTRY, SOCKET_NAME
+
+        spec = dict(self.task.plugin or {})
+        ptype, pid = spec.get("type", ""), spec.get("id", "")
+        sock = self._plugin_sock
+        handle = self._handle
+
+        def wait():
+            deadline = time.time() + 60.0
+            while time.time() < deadline and not self._killed.is_set():
+                if sock and os.path.exists(sock):
+                    REGISTRY.register(
+                        ptype, pid, self.alloc.id, sock,
+                        is_alive=lambda: (handle is not None
+                                          and handle.is_running()))
+                    self._plugin_registered = (ptype, pid)
+                    return
+                time.sleep(0.1)
+
+        threading.Thread(target=wait, daemon=True,
+                         name=f"plugin-wait-{self.task.name}").start()
+
+    def _deregister_plugin(self) -> None:
+        reg = getattr(self, "_plugin_registered", None)
+        if reg is not None:
+            from .dynamicplugins import REGISTRY
+
+            REGISTRY.deregister(reg[0], reg[1], self.alloc.id)
+            self._plugin_registered = None
+        sock = getattr(self, "_plugin_sock", None)
+        if sock is not None:
+            import shutil
+
+            shutil.rmtree(os.path.dirname(sock), ignore_errors=True)
+            self._plugin_sock = None
 
     def _logmon(self):
         """Rotated stdout/stderr capture per start attempt (reference
@@ -233,4 +294,5 @@ def _interpolated_task(task: Task, config: dict) -> Task:
         resources=task.resources, kill_timeout_s=task.kill_timeout_s,
         user=task.user, meta=task.meta,
         volume_mounts=list(task.volume_mounts),
+        plugin=task.plugin,
     )

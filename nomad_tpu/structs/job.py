@@ -119,6 +119,11 @@ class Task:
     templates: List[dict] = field(default_factory=list)
     user: str = ""
     meta: Dict[str, str] = field(default_factory=dict)
+    # plugins-as-tasks (reference client/dynamicplugins + the task
+    # csi_plugin stanza): {"type": "volume"|"device", "id": "<id>"} —
+    # the client exports NOMAD_PLUGIN_SOCKET and registers the task's
+    # plugin while it runs (client/dynamicplugins.py)
+    plugin: Optional[Dict[str, str]] = None
 
 
 @dataclass(slots=True)

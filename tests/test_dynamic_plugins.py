@@ -1,0 +1,97 @@
+"""Plugins-as-tasks (round 5; reference client/dynamicplugins/
+registry.go — the mechanism the reference ships CSI drivers with):
+a scheduled task serves the plugin protocol on a client-provided
+socket, registers while it runs, and deregisters when it stops."""
+
+import os
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.structs import enums
+from nomad_tpu.structs.job import Task
+from nomad_tpu.structs.volumes import Volume, VolumeRequest
+
+PLUGIN_SRC = os.path.join(os.path.dirname(__file__), "..",
+                          "examples", "plugins", "host_path_volume.py")
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+class TestPluginsAsTasks:
+    def test_task_plugin_registers_serves_deregisters(self, tmp_path):
+        import sys
+
+        from nomad_tpu.plugins.volumes import (VolumePluginError,
+                                               get_volume_plugin)
+
+        s = Server(ServerConfig(heartbeat_ttl=30.0))
+        s.start()
+        c = Client(s, ClientConfig(data_dir=str(tmp_path / "c0"),
+                                   heartbeat_interval=0.5))
+        c.start()
+        backing = str(tmp_path / "voldata")
+        try:
+            # 1. run the PLUGIN as a scheduled task
+            pjob = mock.job()
+            pjob.id = "csi-plugin"
+            tg = pjob.task_groups[0]
+            tg.count = 1
+            tg.tasks[0] = Task(
+                name="plugin", driver="raw_exec",
+                plugin={"type": "volume", "id": "host-path"},
+                env={"PYTHONPATH": os.path.abspath(REPO)},
+                config={"command": sys.executable,
+                        "args": [os.path.abspath(PLUGIN_SRC)]})
+            s.register_job(pjob)
+            assert s.wait_for_idle(10.0)
+            deadline = time.time() + 20
+            plugin = None
+            while time.time() < deadline:
+                try:
+                    plugin = get_volume_plugin("host-path")
+                    break
+                except VolumePluginError:
+                    time.sleep(0.2)
+            assert plugin is not None, "task plugin never registered"
+            assert plugin.probe()["healthy"]
+
+            # 2. a SECOND job mounts a volume THROUGH the task-plugin
+            s.register_volume(Volume(id="shared", name="shared",
+                                     plugin_id="host-path",
+                                     params={"path": backing}))
+            vjob = mock.job()
+            vjob.id = "consumer"
+            vtg = vjob.task_groups[0]
+            vtg.count = 1
+            vtg.volumes = {"data": VolumeRequest(
+                name="data", type="csi", source="shared")}
+            vtg.tasks[0] = Task(
+                name="writer", driver="raw_exec",
+                config={"command": "/bin/sh",
+                        "args": ["-c",
+                                 'echo via-task-plugin > '
+                                 '"$NOMAD_ALLOC_VOLUME_DATA/out.txt" && '
+                                 'sleep 30']})
+            s.register_job(vjob)
+            assert s.wait_for_idle(10.0)
+            assert c.wait_until(lambda: os.path.exists(
+                os.path.join(backing, "out.txt")), timeout=20.0)
+
+            # 3. stopping the plugin job deregisters the plugin
+            s.deregister_job("csi-plugin")
+            assert s.wait_for_idle(10.0)
+            assert c.wait_until(lambda: _gone(), timeout=20.0)
+        finally:
+            c.stop()
+            s.stop()
+
+
+def _gone() -> bool:
+    from nomad_tpu.plugins.volumes import VolumePluginError, get_volume_plugin
+
+    try:
+        get_volume_plugin("host-path")
+        return False
+    except VolumePluginError:
+        return True
