@@ -374,6 +374,10 @@ def cfg_c2m() -> None:
     emit("c2m_sched_throughput_2m_allocs_10k_nodes",
          placed / dt, "allocs/s", (hdt / hn) / (tdt / tn),
          wall_clock_s=dt, score_parity_pp=tscore - hscore,
+         # parity/speedup come from a serial same-cluster sample — a
+         # full 2M host-path run is ~days (round-4 verdict asked for
+         # the sample size to ride the metric)
+         score_parity_sample_allocs=tn,
          plan_rejection_rate=rej)
 
 

@@ -98,7 +98,9 @@ def setup_isolation(spec: dict):
     mount namespace)."""
     import shutil
 
-    root = spec.get("cwd") or ""
+    task_dir = spec.get("cwd") or ""
+    root = task_dir
+    rootfs = spec.get("container_rootfs") or ""
     unshare_bin = shutil.which("unshare")
     if not root or unshare_bin is None or not hasattr(os, "unshare"):
         return None, spec.get("cwd")
@@ -107,7 +109,43 @@ def setup_isolation(spec: dict):
         os.unshare(os.CLONE_NEWNS)
         # our binds must not propagate back to the host mount table
         mount(None, "/", None, MS_REC | MS_PRIVATE)
-        for d in CHROOT_RO_DIRS:
+        if rootfs:
+            # CONTAINER flavor (the docker-class shape minus the image
+            # daemon, reference drivers/docker/driver.go:306): the task
+            # roots in a provided IMAGE rootfs — read-only, with the
+            # task's own writable dirs bound in — instead of the host
+            # dirs. Mountpoint dirs are created in the image first (a
+            # benign, idempotent normalization) because nothing can be
+            # created once the view is read-only.
+            image = os.path.realpath(rootfs)
+            norm_dirs = ["local", "secrets", "tmp", "dev", "dev/shm",
+                         "proc", "alloc"]
+            # volume-mount destinations must pre-exist too: nothing can
+            # be created once the view is read-only
+            for _, dest, _ro in spec.get("volume_binds") or []:
+                norm_dirs.append(dest.lstrip("/"))
+            for d in norm_dirs:
+                os.makedirs(os.path.join(image, d), exist_ok=True)
+            for name in ("null", "zero", "full", "random", "urandom",
+                         "tty"):
+                p = os.path.join(image, "dev", name)
+                if not os.path.exists(p):
+                    with open(p, "w"):
+                        pass
+            view = os.path.join(task_dir, ".rootfs")
+            os.makedirs(view, exist_ok=True)
+            mount(image, view, None, MS_BIND | MS_REC)
+            try:  # protect the shared image from the task
+                mount(None, view, None,
+                      MS_REMOUNT | MS_BIND | MS_RDONLY | MS_REC)
+            except OSError:
+                pass
+            root = view
+            for d in ("local", "secrets", "tmp"):
+                src = os.path.join(task_dir, d)
+                if os.path.isdir(src):
+                    mount(src, os.path.join(view, d), None, MS_BIND)
+        for d in () if rootfs else CHROOT_RO_DIRS:
             src = "/" + d
             if not os.path.isdir(src) or os.path.islink(src):
                 # symlinked /bin -> usr/bin etc: recreate the link so
@@ -396,6 +434,13 @@ def run(spec_path: str) -> int:
     iso_prefix, iso_cwd = None, spec.get("cwd")
     if spec.get("isolation"):
         iso_prefix, iso_cwd = setup_isolation(spec)
+    if spec.get("container_rootfs") and iso_prefix is None:
+        # a container task must not silently run against the host root
+        _write_status(spec["status_file"], {
+            "exit_code": 127, "signal": 0, "isolation": "none",
+            "err": "container driver requires namespace support",
+            "task_pid": 0, "finished_at": time.time()})
+        return 1
 
     try:
         from .logmon import LogMon
