@@ -278,6 +278,13 @@ def _task_dict(block: dict) -> dict:
         if "cores" in res:
             r["cores"] = int(res["cores"])
         out["resources"] = r
+    if "plugin" in block:
+        # plugins-as-tasks stanza (client/dynamicplugins.py; reference
+        # task csi_plugin): plugin { type = "volume" id = "x" }
+        pl = (block["plugin"][0] if isinstance(block["plugin"], list)
+              else block["plugin"])
+        out["plugin"] = {k: str(v) for k, v in pl.items()
+                         if k != "__label__"}
     out["constraints"] = [_constraint_dict(c) for c in block.get("constraint", [])]
     mounts = []
     for vm in block.get("volume_mount", []):

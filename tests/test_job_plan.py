@@ -175,3 +175,52 @@ class TestPlanEndpoint:
             with urllib.request.urlopen(r, timeout=10) as resp:
                 out = json.loads(resp.read())
             assert out["annotations"]["web"]["place"] == 10
+
+
+class TestRound5JobspecSurface:
+    def test_hcl_expresses_container_csi_plugin(self):
+        """The HCL-shaped jobspec can express every round-5 feature:
+        csi volumes, container driver, task plugin stanza, user."""
+        from nomad_tpu.api.jobspec import parse_hcl_like
+
+        hcl = '''
+        job "demo" {
+          type = "service"
+          group "g" {
+            count = 1
+            volume "data" {
+              type = "csi"
+              source = "shared"
+            }
+            task "t" {
+              driver = "container"
+              user = "nobody"
+              plugin {
+                type = "volume"
+                id = "host-path"
+              }
+              config {
+                image = "/images/app"
+                command = "/bin/app"
+              }
+              volume_mount {
+                volume = "data"
+                destination = "/data"
+              }
+              resources {
+                cpu = 100
+                memory_mb = 64
+              }
+            }
+          }
+        }
+        '''
+        job = parse_hcl_like(hcl)
+        tg = job.task_groups[0]
+        t = tg.tasks[0]
+        assert tg.volumes["data"].type == "csi"
+        assert tg.volumes["data"].source == "shared"
+        assert t.driver == "container" and t.user == "nobody"
+        assert t.plugin == {"type": "volume", "id": "host-path"}
+        assert t.config["image"] == "/images/app"
+        assert t.volume_mounts[0].destination == "/data"
