@@ -91,6 +91,15 @@ def _validate(job: Job) -> None:
                     raise ValueError(
                         f"task {t.name!r} mounts undeclared volume "
                         f"{vm.volume!r}")
+            if t.plugin:  # {} / None = no stanza (codec may inflate {})
+                ptype = t.plugin.get("type", "")
+                if ptype not in ("volume", "device"):
+                    raise ValueError(
+                        f"task {t.name!r}: unknown plugin type "
+                        f"{ptype!r} (volume | device)")
+                if not t.plugin.get("id"):
+                    raise ValueError(
+                        f"task {t.name!r}: plugin stanza requires an id")
 
 
 # ---------------------------------------------------------------------------
@@ -283,6 +292,9 @@ def _task_dict(block: dict) -> dict:
         # task csi_plugin): plugin { type = "volume" id = "x" }
         pl = (block["plugin"][0] if isinstance(block["plugin"], list)
               else block["plugin"])
+        if not isinstance(pl, dict):
+            raise ValueError(
+                "plugin must be a block: plugin { type = ... id = ... }")
         out["plugin"] = {k: str(v) for k, v in pl.items()
                          if k != "__label__"}
     out["constraints"] = [_constraint_dict(c) for c in block.get("constraint", [])]

@@ -224,3 +224,19 @@ class TestRound5JobspecSurface:
         assert t.plugin == {"type": "volume", "id": "host-path"}
         assert t.config["image"] == "/images/app"
         assert t.volume_mounts[0].destination == "/data"
+
+    def test_plugin_stanza_validation(self):
+        import pytest as _pytest
+
+        from nomad_tpu.api.jobspec import parse_hcl_like
+
+        base = ('job "j" {{ group "g" {{ task "t" {{ driver = "mock" '
+                '{stanza} config {{ }} }} }} }}')
+        with _pytest.raises(ValueError, match="unknown plugin type"):
+            parse_hcl_like(base.format(
+                stanza='plugin { type = "csi" id = "x" }'))
+        with _pytest.raises(ValueError, match="requires an id"):
+            parse_hcl_like(base.format(
+                stanza='plugin { type = "volume" }'))
+        with _pytest.raises(ValueError, match="must be a block"):
+            parse_hcl_like(base.format(stanza='plugin = "volume"'))
