@@ -1044,6 +1044,24 @@ class HTTPAgent:
                 return h._error(400, str(e))
             return h._reply(200, {"ok": True})
 
+        if path == "/v1/jobs/parse":
+            # server-side jobspec parsing (reference /v1/jobs/parse,
+            # command/agent/job_endpoint.go JobsParseRequest): HCL in,
+            # canonical api.Job JSON out — no registration
+            from .jobspec import parse_hcl_like, parse_json
+
+            spec = (body or {}).get("job_hcl", "")
+            if not spec:
+                return h._error(400, "job_hcl is required")
+            try:
+                if spec.lstrip().startswith("{"):
+                    job = parse_json(spec)
+                else:
+                    job = parse_hcl_like(
+                        spec, variables=(body or {}).get("variables"))
+            except ValueError as e:
+                return h._error(400, str(e))
+            return h._reply(200, job)
         if path == "/v1/jobs":
             data = body.get("job") or body.get("Job") or body
             job = from_dict(Job, data)

@@ -172,6 +172,27 @@ def cmd_agent(args) -> int:
 # -- job ---------------------------------------------------------------------
 
 
+def cmd_job_validate(args) -> int:
+    """Parse + validate a jobspec locally (reference
+    command/job_validate.go; the API twin is POST /v1/jobs/parse)."""
+    from .api.codec import to_dict
+    from .api.jobspec import parse_file
+
+    try:
+        job = parse_file(args.spec, variables=_spec_vars(args))
+    except (OSError, ValueError) as e:
+        print(f"Job validation failed: {e}", file=sys.stderr)
+        return 1
+    if getattr(args, "as_json", False):
+        _p(to_dict(job))
+    else:
+        groups = ", ".join(f"{tg.name}[{tg.count}]"
+                           for tg in job.task_groups)
+        print(f"Job validation successful: {job.id!r} "
+              f"({job.type}; groups: {groups})")
+    return 0
+
+
 def cmd_job_plan(args) -> int:
     """Dry-run the update and print per-group desired changes
     (reference command/job_plan.go)."""
@@ -893,6 +914,13 @@ def build_parser() -> argparse.ArgumentParser:
     jp.add_argument("spec")
     jp.add_argument("-var", action="append", dest="var")
     jp.set_defaults(fn=cmd_job_plan)
+    jv = job.add_parser("validate", help="parse + validate a jobspec "
+                        "without submitting (reference job validate)")
+    jv.add_argument("spec")
+    jv.add_argument("-var", action="append", dest="var")
+    jv.add_argument("-json", action="store_true", dest="as_json",
+                    help="print the canonical parsed job as JSON")
+    jv.set_defaults(fn=cmd_job_validate)
     jd = job.add_parser("dispatch")
     jd.add_argument("job_id")
     jd.add_argument("--payload-file", default="")

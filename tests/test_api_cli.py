@@ -492,3 +492,70 @@ def test_cli_deployment_flow(capsys):
         assert rc == 0 and "failed" in out
         assert (srv.store.snapshot().deployment_by_id(dep_id).status
                 == enums.DEPLOYMENT_STATUS_FAILED)
+
+
+class TestJobsParseAndValidate:
+    """POST /v1/jobs/parse (reference command/agent/job_endpoint.go
+    JobsParseRequest) + `job validate` (reference command/job_validate.go)."""
+
+    HCL = '''
+    job "parse-me" {
+      type = "service"
+      group "g" {
+        count = 2
+        task "t" {
+          driver = "raw_exec"
+          config { command = "/bin/true" }
+          resources { cpu = 100
+                      memory_mb = 64 }
+        }
+      }
+    }
+    '''
+
+    def test_http_jobs_parse(self):
+        import json as _json
+        import urllib.request
+
+        from nomad_tpu.api.http import HTTPAgent
+        from nomad_tpu.core.server import Server, ServerConfig
+
+        s = Server(ServerConfig())
+        s.start()
+        agent = HTTPAgent(s, port=0).start()
+        try:
+            req = urllib.request.Request(
+                f"{agent.address}/v1/jobs/parse",
+                data=_json.dumps({"job_hcl": self.HCL}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            out = _json.loads(urllib.request.urlopen(req).read())
+            assert out["id"] == "parse-me"
+            assert out["task_groups"][0]["count"] == 2
+            # nothing was registered
+            assert s.store.snapshot().job_by_id("parse-me") is None
+            # a bad spec is a clean 400
+            bad = urllib.request.Request(
+                f"{agent.address}/v1/jobs/parse",
+                data=_json.dumps({"job_hcl": 'job "x" { }'}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(bad)
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            agent.stop()
+            s.stop()
+
+    def test_cli_job_validate(self, tmp_path, capsys):
+        from nomad_tpu.cli import main
+
+        spec = tmp_path / "demo.nomad"
+        spec.write_text(self.HCL)
+        assert main(["job", "validate", str(spec)]) == 0
+        assert "validation successful" in capsys.readouterr().out
+        bad = tmp_path / "bad.nomad"
+        bad.write_text('job "x" { }')
+        assert main(["job", "validate", str(bad)]) == 1
