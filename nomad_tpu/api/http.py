@@ -57,6 +57,18 @@ def _monitor_level_pop(logger) -> None:
             del _monitor_state[id(logger)]
 
 
+
+def _token_wire(token) -> dict:
+    """The ACL-token response shape every token-returning route shares
+    (bootstrap, create, login, OIDC, one-time exchange)."""
+    return {
+        "accessor_id": token.accessor_id,
+        "secret_id": token.secret_id,
+        "type": token.type,
+        "policies": token.policies, "roles": token.roles,
+        "expiration_time": token.expiration_time}
+
+
 class HTTPAgent:
     """The agent HTTP server. Start with port=0 for an ephemeral port."""
 
@@ -909,6 +921,7 @@ class HTTPAgent:
                 return h._error(403, "Permission denied")
         elif path.startswith("/v1/acl") and path not in (
                 "/v1/acl/bootstrap", "/v1/acl/login",
+                "/v1/acl/token/onetime", "/v1/acl/token/onetime/exchange",
                 "/v1/acl/oidc/auth-url", "/v1/acl/oidc/complete-auth"):
             if acl is not None and not acl.management:
                 return h._error(403, "Permission denied")
@@ -940,12 +953,24 @@ class HTTPAgent:
                 return h._error(403, str(e))
             except ValueError as e:
                 return h._error(400, str(e))
-            return h._reply(200, {
-                "accessor_id": token.accessor_id,
-                "secret_id": token.secret_id,
-                "type": token.type,
-                "policies": token.policies, "roles": token.roles,
-                "expiration_time": token.expiration_time})
+            return h._reply(200, _token_wire(token))
+        if path == "/v1/acl/token/onetime":
+            # mint a single-use stand-in for the CALLER's token
+            # (reference acl_endpoint.go UpsertOneTimeToken)
+            secret = h.headers.get("X-Nomad-Token", "")
+            try:
+                out = self.writer.create_one_time_token(secret)
+            except PermissionError as e:
+                return h._error(403, str(e))
+            return h._reply(200, out)
+        if path == "/v1/acl/token/onetime/exchange":
+            # unauthenticated by design: the ott IS the credential
+            try:
+                token = self.writer.exchange_one_time_token(
+                    (body or {}).get("one_time_secret", ""))
+            except PermissionError as e:
+                return h._error(403, str(e))
+            return h._reply(200, _token_wire(token))
         if path == "/v1/acl/login":
             # SSO: exchange an external JWT for an ephemeral token —
             # unauthenticated by design (reference acl_endpoint.go Login)
@@ -955,12 +980,7 @@ class HTTPAgent:
                     body.get("login_token", ""))
             except PermissionError as e:
                 return h._error(403, str(e))
-            return h._reply(200, {
-                "accessor_id": token.accessor_id,
-                "secret_id": token.secret_id,
-                "type": token.type,
-                "policies": token.policies, "roles": token.roles,
-                "expiration_time": token.expiration_time})
+            return h._reply(200, _token_wire(token))
         if m := re.fullmatch(r"/v1/acl/auth-method/([^/]+)", path):
             try:
                 method = dict(body or {})

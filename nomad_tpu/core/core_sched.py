@@ -88,6 +88,7 @@ class CoreScheduler:
         # expiredACLTokenGC): SSO login tokens are ephemeral and must
         # not accumulate in the replicated store ---
         reaped = store.gc_expired_acl_tokens(ts=now)
+        reaped += store.gc_one_time_tokens(ts=now)
         self.stats["acl_tokens"] = self.stats.get("acl_tokens", 0) + reaped
 
         # --- volume claim reaping (reference nomad/volumewatcher/):

@@ -1303,6 +1303,42 @@ class Server:
         return {"token": self.encrypter.sign_identity(claims),
                 "exp": claims["exp"]}
 
+    # one-time tokens (reference acl_endpoint.go UpsertOneTimeToken /
+    # ExchangeOneTimeToken; how `nomad ui -authenticate` hands a browser
+    # a short-lived single-use credential instead of the real secret)
+
+    ONE_TIME_TOKEN_TTL = 600.0
+
+    def create_one_time_token(self, secret_id: str) -> dict:
+        """Mint a single-use, short-TTL stand-in for the caller's token."""
+        from ..utils import generate_secret_uuid
+
+        snap = self.store.snapshot()
+        token = snap.acl_token_by_secret(secret_id)
+        if token is None:
+            raise PermissionError("token not found")
+        if token.expiration_time and time.time() >= token.expiration_time:
+            raise PermissionError("token expired")
+        ott = generate_secret_uuid()
+        expires = time.time() + self.ONE_TIME_TOKEN_TTL
+        self.store.upsert_one_time_token(
+            {"secret": ott, "accessor_id": token.accessor_id,
+             "expires": expires})
+        return {"one_time_secret": ott, "expires": expires}
+
+    def exchange_one_time_token(self, one_time_secret: str):
+        """Burn the one-time token, return the underlying ACL token.
+        The burn is atomic in the store (take_one_time_token) so two
+        concurrent exchanges can never both win."""
+        row = self.store.take_one_time_token(one_time_secret)
+        if row is None:
+            raise PermissionError("one-time token invalid or expired")
+        token = self.store.snapshot().acl_token_by_accessor(
+            row["accessor_id"])
+        if token is None:
+            raise PermissionError("underlying token no longer exists")
+        return token
+
     def resolve_token(self, secret_id: str):
         """secret -> compiled ACL (reference nomad/auth/auth.go)."""
         from ..acl.policy import ACL, compile_acl

@@ -40,6 +40,7 @@ FORWARD = ("register_job", "deregister_job", "dispatch_job",
            "upsert_auth_method", "delete_auth_method",
            "upsert_binding_rule", "delete_binding_rule", "acl_login",
            "oidc_auth_url", "oidc_complete_auth",
+           "create_one_time_token", "exchange_one_time_token",
            "sign_workload_identity",
            "upsert_region", "delete_region")
 

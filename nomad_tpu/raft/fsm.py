@@ -33,6 +33,8 @@ MUTATIONS = {
     "upsert_auth_method", "delete_auth_method",
     "upsert_binding_rule", "delete_binding_rule",
     "gc_expired_acl_tokens", "upsert_region", "delete_region",
+    "upsert_one_time_token", "delete_one_time_token",
+    "take_one_time_token", "gc_one_time_tokens",
     "append_scaling_event",
     "upsert_variable", "delete_variable",
     "upsert_volume", "delete_volume", "reap_volume_claims",
@@ -67,7 +69,8 @@ class FSM:
 # leader on time-gated decisions (gc_terminal_allocs cutoffs). The
 # reference embeds times in the raft request structs for the same reason.
 TIMESTAMPED = {
-    "gc_expired_acl_tokens",
+    "gc_expired_acl_tokens", "gc_one_time_tokens",
+    "take_one_time_token",
     "upsert_evals", "upsert_allocs", "update_allocs_from_client",
     "upsert_plan_results", "update_node_status",
     "update_alloc_desired_transitions",

@@ -49,6 +49,9 @@ def dump_store(store) -> dict:
                               store._binding_rules.iterate(snap.index)],
             "regions": [wire_encode(r) for _, r in
                         store._regions.iterate(snap.index)],
+            "one_time_tokens": [
+                {"secret": k, **row} for k, row in
+                store._one_time_tokens.iterate(snap.index)],
             "scaling_events": [
                 {"key": list(k), "events": list(v)}
                 for k, v in store._scaling_events.iterate(snap.index)],
@@ -78,6 +81,7 @@ def restore_store(store, data: dict) -> None:
     auth_methods = [wire_decode(x) for x in data.get("auth_methods", [])]
     binding_rules = [wire_decode(x) for x in data.get("binding_rules", [])]
     regions = [wire_decode(x) for x in data.get("regions", [])]
+    one_time_tokens = data.get("one_time_tokens", [])
     scaling_events = data.get("scaling_events", [])
 
     with store._write_lock:
@@ -113,6 +117,8 @@ def restore_store(store, data: dict) -> None:
             id(store._auth_methods): {m.name for m in auth_methods},
             id(store._binding_rules): {r.id for r in binding_rules},
             id(store._regions): {r.name for r in regions},
+            id(store._one_time_tokens): {o["secret"]
+                                         for o in one_time_tokens},
             id(store._scaling_events): {tuple(e["key"])
                                         for e in scaling_events},
         }
@@ -180,6 +186,12 @@ def restore_store(store, data: dict) -> None:
             store._binding_rules.put(r.id, r, gen, live)
         for r in regions:
             store._regions.put(r.name, r, gen, live)
+        for o in one_time_tokens:
+            store._one_time_tokens.put(
+                o["secret"],
+                {"accessor_id": o["accessor_id"],
+                 "expires": float(o["expires"])},
+                gen, live)
         for e in scaling_events:
             store._scaling_events.put(tuple(e["key"]),
                                       tuple(e["events"]), gen, live)

@@ -271,6 +271,9 @@ class StateSnapshot:
     def acl_tokens(self):
         return (t for _, t in self._store._acl_tokens.iterate(self.index))
 
+    def one_time_token(self, secret: str):
+        return self._store._one_time_tokens.get(secret, self.index)
+
     def scaling_events(self, job_id: str, namespace: str = "default"):
         return list(self._store._scaling_events.get(
             (namespace, job_id), self.index) or ())
@@ -454,6 +457,9 @@ class StateStore:
         # ACL + variables (reference schema.go acl_* and variables tables)
         self._acl_policies = VersionedTable("acl_policies")     # key name
         self._acl_tokens = VersionedTable("acl_tokens")         # key accessor id
+        # one-time tokens (reference schema.go one_time_token): ott
+        # secret -> {"accessor_id", "expires"} rows, single-exchange
+        self._one_time_tokens = VersionedTable("one_time_tokens")
         self._acl_secret_idx = VersionedTable("acl_secret_idx")  # secret -> accessor
         self._acl_roles = VersionedTable("acl_roles")           # key name
         self._auth_methods = VersionedTable("acl_auth_methods")  # key name
@@ -505,6 +511,7 @@ class StateStore:
             self._deployments, self._allocs_by_node, self._allocs_by_job,
             self._allocs_by_eval, self._evals_by_job, self._deployments_by_job,
             self._acl_policies, self._acl_tokens, self._acl_secret_idx,
+            self._one_time_tokens,
             self._acl_roles, self._auth_methods, self._binding_rules,
             self._regions, self._scaling_events,
             self._variables, self._volumes, self._node_pools,
@@ -1497,6 +1504,57 @@ class StateStore:
                 self._acl_secret_idx.delete(tok.secret_id, gen, live)
             self._commit(gen, [("acl-token-delete", tok)])
             return gen
+
+    def upsert_one_time_token(self, ott: dict) -> int:
+        """Mint a one-time token row (reference
+        state_store UpsertOneTimeToken): {"secret", "accessor_id",
+        "expires"}. The secret is the key; the row never stores the
+        underlying token's secret."""
+        with self._write_lock:
+            gen, live = self._begin()
+            row = {"accessor_id": ott["accessor_id"],
+                   "expires": float(ott["expires"])}
+            self._one_time_tokens.put(ott["secret"], row, gen, live)
+            self._commit(gen, [("ott-upsert", None)])
+            return gen
+
+    def take_one_time_token(self, secret: str, ts: float = None):
+        """ATOMIC single-use exchange step: return-and-burn the row, or
+        None when absent/expired. Check-then-delete outside the write
+        lock would let two concurrent exchanges both win (reference
+        one-time tokens are single-use by contract)."""
+        ts = ts if ts is not None else time.time()
+        with self._write_lock:
+            row = self._one_time_tokens.get_latest(secret)
+            if row is None or ts >= row["expires"]:
+                return None
+            gen, live = self._begin()
+            self._one_time_tokens.delete(secret, gen, live)
+            self._commit(gen, [("ott-delete", None)])
+            return dict(row)
+
+    def delete_one_time_token(self, secret: str) -> int:
+        """Burn a one-time token (exchange consumed it, or GC)."""
+        with self._write_lock:
+            gen, live = self._begin()
+            self._one_time_tokens.delete(secret, gen, live)
+            self._commit(gen, [("ott-delete", None)])
+            return gen
+
+    def gc_one_time_tokens(self, ts: float = None) -> int:
+        """Expire unexchanged one-time tokens (reference core_sched.go
+        expiredOneTimeTokenGC)."""
+        ts = ts if ts is not None else time.time()
+        with self._write_lock:
+            dead = [k for k, row in self._one_time_tokens.iterate(self._index)
+                    if ts >= row["expires"]]
+            if not dead:
+                return 0
+            gen, live = self._begin()
+            for k in dead:
+                self._one_time_tokens.delete(k, gen, live)
+            self._commit(gen, [("ott-delete", None)])
+            return len(dead)
 
     def gc_expired_acl_tokens(self, ts: float = None) -> int:
         """Drop tokens past their expiration (reference core_sched.go

@@ -149,3 +149,132 @@ class TestExpiredTokenGC:
         assert snap.acl_token_by_secret(token.secret_id) is None
         # the bootstrap token (no expiry) survives
         assert any(True for _ in snap.acl_tokens())
+
+
+class TestOneTimeTokens:
+    """One-time token mint + exchange (reference acl_endpoint.go
+    UpsertOneTimeToken/ExchangeOneTimeToken + the one_time_token
+    table): a short-TTL single-use stand-in for a real secret."""
+
+    def _server(self):
+        from nomad_tpu.core.server import Server, ServerConfig
+
+        s = Server(ServerConfig(acl_enabled=True))
+        s.start()
+        return s
+
+    def test_mint_exchange_single_use(self):
+        import time as _time
+
+        s = self._server()
+        try:
+            boot = s.acl_bootstrap()
+            out = s.create_one_time_token(boot.secret_id)
+            assert out["one_time_secret"] != boot.secret_id
+            assert out["expires"] > _time.time()
+            token = s.exchange_one_time_token(out["one_time_secret"])
+            assert token.secret_id == boot.secret_id
+            # single use: the second exchange is refused
+            import pytest as _pytest
+
+            with _pytest.raises(PermissionError):
+                s.exchange_one_time_token(out["one_time_secret"])
+        finally:
+            s.stop()
+
+    def test_expired_ott_refused_and_gced(self):
+        import pytest as _pytest
+
+        s = self._server()
+        try:
+            boot = s.acl_bootstrap()
+            s.ONE_TIME_TOKEN_TTL = -1.0  # born expired
+            out = s.create_one_time_token(boot.secret_id)
+            with _pytest.raises(PermissionError):
+                s.exchange_one_time_token(out["one_time_secret"])
+            assert s.store.gc_one_time_tokens() >= 1
+        finally:
+            s.stop()
+
+    def test_invalid_caller_refused(self):
+        import pytest as _pytest
+
+        s = self._server()
+        try:
+            with _pytest.raises(PermissionError):
+                s.create_one_time_token("not-a-secret")
+        finally:
+            s.stop()
+
+    def test_http_roundtrip(self):
+        import json as _json
+        import urllib.request
+
+        from nomad_tpu.api.http import HTTPAgent
+
+        s = self._server()
+        agent = HTTPAgent(s, port=0).start()
+        try:
+            boot = s.acl_bootstrap()
+            req = urllib.request.Request(
+                f"{agent.address}/v1/acl/token/onetime", data=b"{}",
+                method="POST",
+                headers={"X-Nomad-Token": boot.secret_id,
+                         "Content-Type": "application/json"})
+            out = _json.loads(urllib.request.urlopen(req).read())
+            req = urllib.request.Request(
+                f"{agent.address}/v1/acl/token/onetime/exchange",
+                data=_json.dumps(
+                    {"one_time_secret": out["one_time_secret"]}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            tok = _json.loads(urllib.request.urlopen(req).read())
+            assert tok["secret_id"] == boot.secret_id
+        finally:
+            agent.stop()
+            s.stop()
+
+    def test_ott_survives_dump_restore(self):
+        from nomad_tpu.state import StateStore
+
+        s = self._server()
+        try:
+            boot = s.acl_bootstrap()
+            out = s.create_one_time_token(boot.secret_id)
+            data = s.store.dump()
+            restored = StateStore()
+            restored.restore_dump(data)
+            row = restored.snapshot().one_time_token(
+                out["one_time_secret"])
+            assert row is not None
+            assert row["accessor_id"] == boot.accessor_id
+        finally:
+            s.stop()
+
+    def test_concurrent_exchange_single_winner(self):
+        """The burn is atomic: N racing exchanges yield exactly one
+        winner (the single-use contract)."""
+        import threading
+
+        s = self._server()
+        try:
+            boot = s.acl_bootstrap()
+            out = s.create_one_time_token(boot.secret_id)
+            results = []
+
+            def attempt():
+                try:
+                    results.append(s.exchange_one_time_token(
+                        out["one_time_secret"]))
+                except PermissionError:
+                    results.append(None)
+
+            threads = [threading.Thread(target=attempt) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            winners = [r for r in results if r is not None]
+            assert len(winners) == 1, len(winners)
+        finally:
+            s.stop()
