@@ -690,6 +690,27 @@ class HTTPAgent:
                     "leader": raft.leader_id or "",
                     "is_leader": self.writer.is_leader()})
             return h._reply(200, "local")
+        if path == "/v1/agent/members":
+            # server membership (reference agent_endpoint.go members,
+            # backed by serf; ours by the gossip agent when running,
+            # else the raft configuration, else just this server)
+            gossip = getattr(self.writer, "gossip", None)
+            if gossip is not None:
+                return h._reply(200, {
+                    "members": [
+                        {"name": mid, "status": m.get("status", ""),
+                         "gossip_addr": m.get("gossip", ""),
+                         "meta": m.get("meta") or {}}
+                        for mid, m in sorted(gossip.snapshot().items())]})
+            raft = getattr(self.writer, "raft", None)
+            if raft is not None:
+                return h._reply(200, {
+                    "members": [
+                        {"name": sid, "status": "alive",
+                         "rpc_addr": addr, "meta": {}}
+                        for sid, addr in sorted(raft.servers.items())]})
+            return h._reply(200, {"members": [
+                {"name": "local", "status": "alive", "meta": {}}]})
         if path == "/v1/agent/self":
             return h._reply(200, {
                 "stats": {

@@ -480,6 +480,7 @@ def cmd_operator_debug(args) -> int:
     captures = {
         "agent_self.json": lambda: _get_json("/v1/agent/self"),
         "leader.json": lambda: _get_json("/v1/status/leader"),
+        "members.json": lambda: _get_json("/v1/agent/members"),
         "raft_configuration.json":
             lambda: _get_json("/v1/operator/raft/configuration"),
         "scheduler_config.json":

@@ -213,3 +213,63 @@ class TestGossipAuth:
             assert wait_until(lambda: a.member("b") is None, timeout=10.0)
         finally:
             a.stop()
+
+
+class TestMembersEndpoint:
+    def test_agent_members_via_gossip(self):
+        import json as _json
+        import socket as _socket
+        import urllib.request
+
+        from nomad_tpu.api.http import HTTPAgent
+        from nomad_tpu.core.server import ServerConfig
+        from nomad_tpu.raft.cluster import ReplicatedServer
+        from nomad_tpu.raft.transport import SocketTransport
+
+        def free_port():
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+            s.close()
+            return p
+
+        port_map = {"s0": f"127.0.0.1:{free_port()}"}
+        transport = SocketTransport("s0", port_map["s0"],
+                                    dict(port_map)).start()
+        rs = ReplicatedServer("s0", ["s0"], transport,
+                              ServerConfig(heartbeat_ttl=30.0),
+                              bootstrap=True, gossip_bind="127.0.0.1:0")
+        rs.start()
+        agent = None
+        try:
+            assert wait_until(lambda: rs.is_leader(), timeout=15.0)
+            agent = HTTPAgent(rs.server, port=0, writer=rs).start()
+            out = _json.loads(urllib.request.urlopen(
+                f"{agent.address}/v1/agent/members").read())
+            names = {m["name"]: m for m in out["members"]}
+            assert "s0" in names
+            assert names["s0"]["status"] == "alive"
+            assert names["s0"]["meta"].get("rpc") == port_map["s0"]
+        finally:
+            if agent is not None:
+                agent.stop()
+            rs.stop()
+            transport.stop()
+
+    def test_agent_members_single_server(self):
+        import json as _json
+        import urllib.request
+
+        from nomad_tpu.api.http import HTTPAgent
+        from nomad_tpu.core.server import Server, ServerConfig
+
+        s = Server(ServerConfig())
+        s.start()
+        agent = HTTPAgent(s, port=0).start()
+        try:
+            out = _json.loads(urllib.request.urlopen(
+                f"{agent.address}/v1/agent/members").read())
+            assert out["members"][0]["name"] == "local"
+        finally:
+            agent.stop()
+            s.stop()
