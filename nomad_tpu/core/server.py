@@ -138,6 +138,7 @@ class Server:
         self.broker.set_enabled(True)
         self.blocked.set_enabled(True)
         self.heartbeats.set_enabled(True)
+        self._restore_scheduler_config()
         self._restore_evals()
         for w in self.workers:
             w.start()
@@ -269,6 +270,11 @@ class Server:
     def __exit__(self, *exc):
         self.stop()
 
+    def _restore_scheduler_config(self) -> None:
+        cfg = self.store.snapshot().scheduler_configuration()
+        if cfg is not None:
+            self._apply_scheduler_config(cfg)
+
     def _restore_evals(self) -> None:
         """Re-enqueue non-terminal evals and re-track periodic parents
         after (re)start (leader.go:389-403 restoreEvals + :412 periodic
@@ -296,6 +302,17 @@ class Server:
 
     def _on_commit(self, index: int, events: list) -> None:
         for kind, payload in events:
+            if kind == "scheduler-config" and payload is not None:
+                # idempotent apply — the leader already applied its own
+                # update synchronously; replicas apply here
+                self._apply_scheduler_config(payload)
+                continue
+            if kind == "restore":
+                # operator snapshot restore replaced the whole store:
+                # the restored scheduler config must govern the RUNNING
+                # server too, not just the next restart
+                self._restore_scheduler_config()
+                continue
             if kind in ("node-upsert", "node-status", "node-eligibility", "node-drain"):
                 if payload is not None and payload.ready():
                     self.blocked.unblock(payload.computed_class)
@@ -468,10 +485,16 @@ class Server:
         return self._create_job_eval(job, trigger)
 
     def set_scheduler_config(self, cfg: SchedulerConfiguration) -> None:
-        """Operator scheduler-config update. Applied on the leader via
-        forwarding; not yet raft-replicated, so a failover reverts to the
-        boot-time config (the reference stores this in raft state,
-        operator_endpoint.go — replication TODO)."""
+        """Operator scheduler-config update, stored in REPLICATED state
+        (reference operator_endpoint.go SchedulerSetConfiguration ->
+        scheduler_config table): every replica applies it via the
+        commit listener, so a failover keeps the operator's settings."""
+        self.store.set_scheduler_configuration(cfg)
+        self._apply_scheduler_config(cfg)
+
+    def _apply_scheduler_config(self, cfg: SchedulerConfiguration) -> None:
+        """Make a (locally committed or replicated) scheduler config
+        effective on this server."""
         self.sched_config = cfg
         self.config.sched_config = cfg
         # pause/resume the broker (reference operator.go PauseEvalBroker):

@@ -33,6 +33,7 @@ MUTATIONS = {
     "upsert_auth_method", "delete_auth_method",
     "upsert_binding_rule", "delete_binding_rule",
     "gc_expired_acl_tokens", "upsert_region", "delete_region",
+    "set_scheduler_configuration",
     "upsert_one_time_token", "delete_one_time_token",
     "take_one_time_token", "gc_one_time_tokens",
     "append_scaling_event",

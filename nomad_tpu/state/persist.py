@@ -52,6 +52,9 @@ def dump_store(store) -> dict:
             "one_time_tokens": [
                 {"secret": k, **row} for k, row in
                 store._one_time_tokens.iterate(snap.index)],
+            "scheduler_config": (
+                wire_encode(snap.scheduler_configuration())
+                if snap.scheduler_configuration() is not None else None),
             "scaling_events": [
                 {"key": list(k), "events": list(v)}
                 for k, v in store._scaling_events.iterate(snap.index)],
@@ -82,6 +85,8 @@ def restore_store(store, data: dict) -> None:
     binding_rules = [wire_decode(x) for x in data.get("binding_rules", [])]
     regions = [wire_decode(x) for x in data.get("regions", [])]
     one_time_tokens = data.get("one_time_tokens", [])
+    sched_cfg = (wire_decode(data["scheduler_config"])
+                 if data.get("scheduler_config") is not None else None)
     scaling_events = data.get("scaling_events", [])
 
     with store._write_lock:
@@ -119,6 +124,8 @@ def restore_store(store, data: dict) -> None:
             id(store._regions): {r.name for r in regions},
             id(store._one_time_tokens): {o["secret"]
                                          for o in one_time_tokens},
+            id(store._scheduler_config): ({"config"} if sched_cfg is not None
+                                          else set()),
             id(store._scaling_events): {tuple(e["key"])
                                         for e in scaling_events},
         }
@@ -192,6 +199,8 @@ def restore_store(store, data: dict) -> None:
                 {"accessor_id": o["accessor_id"],
                  "expires": float(o["expires"])},
                 gen, live)
+        if sched_cfg is not None:
+            store._scheduler_config.put("config", sched_cfg, gen, live)
         for e in scaling_events:
             store._scaling_events.put(tuple(e["key"]),
                                       tuple(e["events"]), gen, live)

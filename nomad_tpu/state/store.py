@@ -274,6 +274,11 @@ class StateSnapshot:
     def one_time_token(self, secret: str):
         return self._store._one_time_tokens.get(secret, self.index)
 
+    def scheduler_configuration(self):
+        """The replicated runtime scheduler config, or None when the
+        operator never set one (boot-time config applies)."""
+        return self._store._scheduler_config.get("config", self.index)
+
     def scaling_events(self, job_id: str, namespace: str = "default"):
         return list(self._store._scaling_events.get(
             (namespace, job_id), self.index) or ())
@@ -460,6 +465,9 @@ class StateStore:
         # one-time tokens (reference schema.go one_time_token): ott
         # secret -> {"accessor_id", "expires"} rows, single-exchange
         self._one_time_tokens = VersionedTable("one_time_tokens")
+        # cluster-wide runtime scheduler configuration (reference
+        # schema.go scheduler_config: a raft-replicated singleton)
+        self._scheduler_config = VersionedTable("scheduler_config")
         self._acl_secret_idx = VersionedTable("acl_secret_idx")  # secret -> accessor
         self._acl_roles = VersionedTable("acl_roles")           # key name
         self._auth_methods = VersionedTable("acl_auth_methods")  # key name
@@ -511,7 +519,7 @@ class StateStore:
             self._deployments, self._allocs_by_node, self._allocs_by_job,
             self._allocs_by_eval, self._evals_by_job, self._deployments_by_job,
             self._acl_policies, self._acl_tokens, self._acl_secret_idx,
-            self._one_time_tokens,
+            self._one_time_tokens, self._scheduler_config,
             self._acl_roles, self._auth_methods, self._binding_rules,
             self._regions, self._scaling_events,
             self._variables, self._volumes, self._node_pools,
@@ -1503,6 +1511,17 @@ class StateStore:
             if tok is not None:
                 self._acl_secret_idx.delete(tok.secret_id, gen, live)
             self._commit(gen, [("acl-token-delete", tok)])
+            return gen
+
+    def set_scheduler_configuration(self, cfg) -> int:
+        """Replicated scheduler-config write (reference FSM
+        ApplySchedulerConfigUpdate -> scheduler_config table): the
+        operator's algorithm/preemption/pause settings survive leader
+        failover because every replica applies this entry."""
+        with self._write_lock:
+            gen, live = self._begin()
+            self._scheduler_config.put("config", cfg, gen, live)
+            self._commit(gen, [("scheduler-config", cfg)])
             return gen
 
     def upsert_one_time_token(self, ott: dict) -> int:

@@ -473,3 +473,39 @@ class TestAllocStop:
                 s.stop_alloc("nope")
             with pytest.raises(ValueError):
                 s.stop_alloc(victim.id)  # already terminal
+
+
+class TestSchedulerConfigReplication:
+    def test_snapshot_restore_applies_config_live(self):
+        """`operator snapshot restore` must make the restored scheduler
+        config effective on the RUNNING server, not just after restart
+        (review finding: the store and live config diverged)."""
+        import time as _time
+
+        from nomad_tpu.core.server import Server, ServerConfig
+        from nomad_tpu.structs import enums
+        from nomad_tpu.structs.operator import SchedulerConfiguration
+
+        donor = Server(ServerConfig())
+        donor.start()
+        donor.set_scheduler_config(SchedulerConfiguration(
+            scheduler_algorithm=enums.SCHED_ALG_TPU_BINPACK))
+        dump = donor.store.dump()
+        donor.stop()
+
+        s = Server(ServerConfig())
+        s.start()
+        try:
+            assert (s.sched_config.scheduler_algorithm
+                    == enums.SCHED_ALG_BINPACK)
+            s.store.restore_dump(dump)
+            deadline = _time.time() + 5
+            while _time.time() < deadline:
+                if (s.sched_config.scheduler_algorithm
+                        == enums.SCHED_ALG_TPU_BINPACK):
+                    break
+                _time.sleep(0.05)
+            assert (s.sched_config.scheduler_algorithm
+                    == enums.SCHED_ALG_TPU_BINPACK)
+        finally:
+            s.stop()

@@ -308,3 +308,45 @@ class TestRaftConfigurationEndpoint:
                 assert me["leader"] is True
             finally:
                 agent.stop()
+
+
+class TestReplicatedSchedulerConfig:
+    def test_config_survives_leader_failover(self):
+        """Operator scheduler-config lives in replicated state
+        (reference scheduler_config table): after the leader dies, the
+        new leader keeps the operator's settings instead of reverting
+        to its boot-time config."""
+        import time as _time
+
+        from nomad_tpu.raft.cluster import RaftCluster
+        from nomad_tpu.structs import enums
+        from nomad_tpu.structs.operator import SchedulerConfiguration
+
+        with RaftCluster(3) as cluster:
+            leader = cluster.wait_for_leader()
+            assert leader is not None
+            assert (leader.server.sched_config.scheduler_algorithm
+                    == enums.SCHED_ALG_BINPACK)
+            leader.set_scheduler_config(SchedulerConfiguration(
+                scheduler_algorithm=enums.SCHED_ALG_TPU_BINPACK))
+            # kill the leader; a follower takes over
+            leader.stop()
+            deadline = _time.time() + 20
+            new_leader = None
+            while _time.time() < deadline:
+                new_leader = next(
+                    (s for s in cluster.servers.values()
+                     if s is not leader and s.is_leader()), None)
+                if new_leader is not None:
+                    break
+                _time.sleep(0.05)
+            assert new_leader is not None
+            # the replicated config governs the new leader
+            deadline = _time.time() + 10
+            while _time.time() < deadline:
+                if (new_leader.server.sched_config.scheduler_algorithm
+                        == enums.SCHED_ALG_TPU_BINPACK):
+                    break
+                _time.sleep(0.05)
+            assert (new_leader.server.sched_config.scheduler_algorithm
+                    == enums.SCHED_ALG_TPU_BINPACK)
