@@ -1,0 +1,23 @@
+"""nomadlint: AST-based invariant checker for this codebase.
+
+The test suite cannot see two invariant classes this package
+machine-checks on every run (see ANALYSIS.md at the repo root):
+
+- replica determinism: everything reachable from the raft FSM apply
+  dispatch must be a pure function of the replicated command
+  (`fsm-determinism`, `shared-struct-mutation`);
+- hot-path health: the JAX scheduling kernels must stay free of host
+  syncs and retrace traps (`jax-hot-path`), errors must not vanish
+  (`silent-except`), and lock pairs must nest one way (`lock-order`).
+
+Run `python -m nomad_tpu.analysis`; the gate is zero findings beyond
+the checked-in `baseline.json` allowlist.
+"""
+
+from .core import (AnalysisContext, Finding, all_rules, baseline_path,
+                   load_baseline, partition, run_analysis, write_baseline)
+
+__all__ = [
+    "AnalysisContext", "Finding", "all_rules", "baseline_path",
+    "load_baseline", "partition", "run_analysis", "write_baseline",
+]

@@ -1,0 +1,76 @@
+"""CLI: `python -m nomad_tpu.analysis [paths...]`.
+
+Exit status is non-zero iff any finding is not in the baseline — the
+shape CI wants: pre-existing debt is allowlisted, new violations fail.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .core import (all_rules, baseline_path, load_baseline, partition,
+                   run_analysis, write_baseline)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m nomad_tpu.analysis",
+        description="AST invariant checker (see ANALYSIS.md)")
+    parser.add_argument("paths", nargs="*", type=Path,
+                        help="files/dirs to analyze (default: the "
+                             "nomad_tpu package)")
+    parser.add_argument("--rule", action="append", dest="rules",
+                        metavar="ID", help="run only this rule "
+                        "(repeatable); default: all")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help=f"baseline file (default {baseline_path()})")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="fail on every finding, allowlist ignored")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline from current findings")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--root", type=Path, default=None,
+                        help="root for relative paths (default: repo root)")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, (_, doc) in sorted(all_rules().items()):
+            print(f"{rule_id}: {doc}")
+        return 0
+
+    findings = run_analysis(paths=args.paths or None, rules=args.rules,
+                            root=args.root)
+
+    if args.write_baseline:
+        path = write_baseline(findings, args.baseline)
+        print(f"wrote {len(findings)} finding(s) to {path}")
+        return 0
+
+    baseline = set() if args.no_baseline else load_baseline(args.baseline)
+    new, stale = partition(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [vars(f) for f in findings],
+            "new": [vars(f) for f in new],
+            "stale_baseline": sorted(stale),
+        }, indent=2))
+        return 1 if new else 0
+
+    for f in new:
+        print(f.render())
+    baselined = len(findings) - len(new)
+    print(f"{len(findings)} finding(s): {len(new)} new, "
+          f"{baselined} baselined"
+          + (f", {len(stale)} stale baseline entrie(s) — "
+             "consider --write-baseline" if stale else ""))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,130 @@
+"""Name-based call graph over the analyzed modules.
+
+Resolution is deliberately over-approximate (soundness beats precision
+for a determinism gate): `self.m(...)` resolves to every method named
+`m` — same class first, then same module, then anywhere; a bare `f(...)`
+resolves to the same-module function or any module-level function with
+that name; `obj.m(...)` resolves to every analyzed function named `m`.
+Dynamic dispatch (`getattr(store, op)` in the FSM) is handled by the
+FSM rule rooting at the MUTATIONS name set instead of chasing the call.
+
+Nested `def`s are not separate graph nodes: a function's edges and body
+include its closures, so reaching the function reaches everything it
+could possibly run.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set
+
+from .core import Module
+
+
+@dataclass(frozen=True)
+class FuncInfo:
+    module_rel: str
+    class_name: Optional[str]   # None for module-level functions
+    name: str
+    node: ast.AST = None
+
+    def __hash__(self):
+        return hash((self.module_rel, self.class_name, self.name))
+
+    def __eq__(self, other):
+        return (self.module_rel, self.class_name, self.name) == (
+            other.module_rel, other.class_name, other.name)
+
+    @property
+    def qualname(self) -> str:
+        if self.class_name:
+            return f"{self.class_name}.{self.name}"
+        return self.name
+
+
+def _called_names(fn_node: ast.AST):
+    """Yield ("self"|"name"|"attr", name) for every call in the subtree
+    (closures included)."""
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Name):
+            yield "name", func.id
+        elif isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name) and func.value.id == "self":
+                yield "self", func.attr
+            else:
+                yield "attr", func.attr
+
+
+class CallGraph:
+    def __init__(self, modules: List[Module]):
+        self.functions: List[FuncInfo] = []
+        self.by_name: Dict[str, List[FuncInfo]] = {}
+        self._index(modules)
+        self._edges: Dict[FuncInfo, Set[FuncInfo]] = {}
+
+    def _add(self, info: FuncInfo) -> None:
+        self.functions.append(info)
+        self.by_name.setdefault(info.name, []).append(info)
+
+    def _index(self, modules: List[Module]) -> None:
+        for mod in modules:
+            for stmt in mod.tree.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._add(FuncInfo(mod.rel, None, stmt.name, stmt))
+                elif isinstance(stmt, ast.ClassDef):
+                    for sub in stmt.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            self._add(FuncInfo(mod.rel, stmt.name,
+                                               sub.name, sub))
+
+    def resolve(self, caller: FuncInfo, kind: str, name: str) -> List[FuncInfo]:
+        candidates = self.by_name.get(name)
+        if not candidates:
+            return []
+        if kind == "self":
+            same_class = [c for c in candidates
+                          if c.module_rel == caller.module_rel
+                          and c.class_name == caller.class_name
+                          and c.class_name is not None]
+            if same_class:
+                return same_class
+            same_module = [c for c in candidates
+                           if c.module_rel == caller.module_rel
+                           and c.class_name is not None]
+            if same_module:
+                return same_module
+            return [c for c in candidates if c.class_name is not None]
+        if kind == "name":
+            same_module = [c for c in candidates
+                           if c.module_rel == caller.module_rel
+                           and c.class_name is None]
+            if same_module:
+                return same_module
+            return [c for c in candidates if c.class_name is None]
+        return list(candidates)  # plain attribute call: any match
+
+    def edges(self, fn: FuncInfo) -> Set[FuncInfo]:
+        cached = self._edges.get(fn)
+        if cached is not None:
+            return cached
+        out: Set[FuncInfo] = set()
+        for kind, name in _called_names(fn.node):
+            out.update(self.resolve(fn, kind, name))
+        self._edges[fn] = out
+        return out
+
+    def reachable(self, roots: List[FuncInfo]) -> Set[FuncInfo]:
+        seen: Set[FuncInfo] = set()
+        frontier = list(roots)
+        while frontier:
+            fn = frontier.pop()
+            if fn in seen:
+                continue
+            seen.add(fn)
+            frontier.extend(self.edges(fn) - seen)
+        return seen

@@ -1,0 +1,200 @@
+"""Analyzer driver: module loading, findings, rule registry, baseline.
+
+The analyzer is pure-AST (no imports of the code under analysis, no jax
+dependency) so it runs in milliseconds as a pre-test gate. Each rule is
+a callable taking an :class:`AnalysisContext` and returning findings.
+
+Baselining: findings are keyed by (rule, file, context, detail) — NOT by
+line number — so unrelated edits that shift lines don't invalidate the
+baseline, while new instances of a violation in the same function do
+show up (distinct detail ordinals).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str        # posix path relative to the analysis root
+    line: int
+    severity: str
+    message: str
+    context: str     # enclosing function qualname or "<module>"
+    detail: str      # stable token used (with rule/path/context) as baseline key
+
+    @property
+    def key(self) -> Tuple[str, str, str, str]:
+        return (self.rule, self.path, self.context, self.detail)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.severity}: "
+                f"{self.message} (in {self.context})")
+
+
+@dataclass
+class Module:
+    path: Path
+    rel: str                 # posix, relative to the analysis root
+    tree: ast.Module
+    source: str
+    parents: Dict[ast.AST, ast.AST] = field(default_factory=dict)
+
+    def build_parents(self) -> None:
+        if self.parents:
+            return
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    def enclosing_function(self, node: ast.AST) -> str:
+        """Qualname of the innermost def/class chain containing `node`."""
+        self.build_parents()
+        names: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                names.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(names)) or "<module>"
+
+
+class AnalysisContext:
+    def __init__(self, modules: List[Module], root: Path):
+        self.modules = modules
+        self.root = root
+        self._callgraph = None
+
+    @property
+    def callgraph(self):
+        if self._callgraph is None:
+            from .callgraph import CallGraph
+            self._callgraph = CallGraph(self.modules)
+        return self._callgraph
+
+
+def in_scope(rel: str, subdirs: Tuple[str, ...]) -> bool:
+    """Rule scoping: inside the nomad_tpu package, restrict to the given
+    package subdirectories; outside it (fixture trees), apply everywhere
+    so the rule is testable on standalone snippets."""
+    parts = Path(rel).parts
+    if "nomad_tpu" not in parts:
+        return True
+    i = parts.index("nomad_tpu")
+    return len(parts) > i + 1 and parts[i + 1] in subdirs
+
+
+# --- rule registry ---
+
+RuleFn = Callable[[AnalysisContext], List[Finding]]
+_RULES: Dict[str, Tuple[RuleFn, str]] = {}
+
+
+def rule(rule_id: str, doc: str) -> Callable[[RuleFn], RuleFn]:
+    def register(fn: RuleFn) -> RuleFn:
+        _RULES[rule_id] = (fn, doc)
+        return fn
+    return register
+
+
+def all_rules() -> Dict[str, Tuple[RuleFn, str]]:
+    # importing the rule modules populates the registry
+    from . import rules_fsm, rules_hygiene, rules_jax  # noqa: F401
+    return dict(_RULES)
+
+
+# --- module loading ---
+
+def iter_py_files(paths: Iterable[Path]) -> Iterator[Path]:
+    for p in paths:
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+
+
+def load_modules(paths: Iterable[Path], root: Path) -> List[Module]:
+    modules = []
+    for f in iter_py_files(paths):
+        src = f.read_text()
+        try:
+            tree = ast.parse(src, filename=str(f))
+        except SyntaxError:
+            continue  # not our concern; ruff/pytest report it
+        try:
+            rel = f.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        modules.append(Module(path=f, rel=rel, tree=tree, source=src))
+    return modules
+
+
+def run_analysis(paths: Optional[Iterable[Path]] = None,
+                 rules: Optional[Iterable[str]] = None,
+                 root: Optional[Path] = None) -> List[Finding]:
+    """Run the given rules (default: all) over the given paths (default:
+    the nomad_tpu package) and return findings sorted by location."""
+    pkg_dir = Path(__file__).resolve().parent.parent
+    if paths is None:
+        paths = [pkg_dir]
+    paths = [Path(p) for p in paths]
+    if root is None:
+        root = pkg_dir.parent
+    ctx = AnalysisContext(load_modules(paths, root), root)
+    registry = all_rules()
+    wanted = set(rules) if rules is not None else set(registry)
+    unknown = wanted - set(registry)
+    if unknown:
+        raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+    findings: List[Finding] = []
+    for rule_id in sorted(wanted):
+        findings.extend(registry[rule_id][0](ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# --- baseline ---
+
+def baseline_path() -> Path:
+    return Path(__file__).resolve().parent / "baseline.json"
+
+
+def load_baseline(path: Optional[Path] = None) -> set:
+    path = path or baseline_path()
+    if not path.exists():
+        return set()
+    data = json.loads(path.read_text())
+    return {(e["rule"], e["file"], e["context"], e["detail"])
+            for e in data.get("findings", [])}
+
+
+def write_baseline(findings: List[Finding], path: Optional[Path] = None) -> Path:
+    path = path or baseline_path()
+    entries = sorted({f.key for f in findings})
+    data = {
+        "comment": ("Allowlisted pre-existing findings; the gate is "
+                    "zero NEW violations. Regenerate with "
+                    "`python -m nomad_tpu.analysis --write-baseline` "
+                    "only after triaging each addition (see ANALYSIS.md)."),
+        "findings": [{"rule": r, "file": f, "context": c, "detail": d}
+                     for r, f, c, d in entries],
+    }
+    path.write_text(json.dumps(data, indent=2) + "\n")
+    return path
+
+
+def partition(findings: List[Finding],
+              baseline: set) -> Tuple[List[Finding], set]:
+    """Split into (new findings, stale baseline keys)."""
+    new = [f for f in findings if f.key not in baseline]
+    stale = baseline - {f.key for f in findings}
+    return new, stale

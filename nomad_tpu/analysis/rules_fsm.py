@@ -1,0 +1,192 @@
+"""Rule `fsm-determinism`: no nondeterminism reachable from raft apply.
+
+Every replica applies the identical raft log; any function reachable
+from the FSM apply dispatch (the methods named in raft/fsm.py's
+MUTATIONS set, plus FSM.apply itself) must therefore compute identical
+results from identical arguments. Wall-clock reads, RNGs, uuid minting,
+and set-iteration orders (string hashing is per-process randomized) all
+break that and fork replica state silently — the bug only surfaces much
+later as divergent GC/scheduling decisions.
+
+Timestamps must instead ride the replicated command from the proposer
+(raft/fsm.py TIMESTAMPED + StateStore._clock), which is exactly what
+this rule keeps honest.
+
+Python dict iteration is insertion-ordered and therefore deterministic
+given a deterministic insert sequence, so plain dict/.keys()/.items()
+iteration is NOT flagged; set/frozenset iteration is, unless wrapped in
+sorted().
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .callgraph import CallGraph, FuncInfo
+from .core import AnalysisContext, Finding, Module, in_scope, rule
+
+# The determinism contract binds the FSM dispatch and the state store it
+# mutates; the call graph is built over exactly those layers. A wider
+# graph drowns in name-collision edges (every `.wait()`/`.add()` in the
+# package), and the layers outside it run on ONE node pre-proposal where
+# wall-clock/random are legitimate.
+FSM_SCOPE = ("raft", "state")
+
+ROOT_SET_NAMES = ("MUTATIONS",)
+ROOT_CLASS_METHODS = (("FSM", "apply"),)
+
+# modules whose attribute calls are nondeterministic across replicas
+NONDET_CALLS = {
+    ("time", "time"), ("time", "time_ns"), ("time", "monotonic"),
+    ("time", "monotonic_ns"), ("time", "perf_counter"),
+    ("time", "perf_counter_ns"),
+    ("uuid", "uuid1"), ("uuid", "uuid4"),
+    ("os", "urandom"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("date", "today"),
+}
+NONDET_MODULE_PREFIXES = ("random", "secrets")
+
+
+def _dotted(node: ast.expr) -> Optional[List[str]]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def _nondet_call(call: ast.Call) -> Optional[str]:
+    parts = _dotted(call.func)
+    if not parts or len(parts) < 2:
+        return None
+    dotted = ".".join(parts)
+    if parts[0] in NONDET_MODULE_PREFIXES:
+        return dotted
+    # np.random.*, numpy.random.* (jax.random is key-driven: deterministic)
+    if parts[0] in ("np", "numpy") and "random" in parts[1:]:
+        return dotted
+    if tuple(parts[-2:]) in NONDET_CALLS:
+        return dotted
+    return None
+
+
+def _collect_roots(modules: List[Module], cg: CallGraph) -> List[FuncInfo]:
+    names: Set[str] = set()
+    for mod in modules:
+        for stmt in mod.tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            for target in stmt.targets:
+                if (isinstance(target, ast.Name)
+                        and target.id in ROOT_SET_NAMES
+                        and isinstance(stmt.value, ast.Set)):
+                    for elt in stmt.value.elts:
+                        if isinstance(elt, ast.Constant) and isinstance(
+                                elt.value, str):
+                            names.add(elt.value)
+    roots = [f for f in cg.functions if f.name in names]
+    for cls, meth in ROOT_CLASS_METHODS:
+        roots.extend(f for f in cg.functions
+                     if f.class_name == cls and f.name == meth)
+    return roots
+
+
+class _SetIterVisitor(ast.NodeVisitor):
+    """Per-function scan for iteration over set-typed expressions.
+
+    Tracks simple local bindings (`x = set(...)` / `x = {a, b}` /
+    `x = {... for ...}`) so `for k in jobs_touched:` is caught, and
+    clears the binding on any other reassignment."""
+
+    def __init__(self):
+        self.set_locals: Set[str] = set()
+        self.hits: List[ast.AST] = []
+
+    @staticmethod
+    def _is_set_expr(node: ast.expr, set_locals: Set[str]) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in ("set", "frozenset")):
+            return True
+        if isinstance(node, ast.Name) and node.id in set_locals:
+            return True
+        if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+            # set algebra: a | b, a - b, ...
+            return (_SetIterVisitor._is_set_expr(node.left, set_locals)
+                    or _SetIterVisitor._is_set_expr(node.right, set_locals))
+        return False
+
+    def visit_Assign(self, node: ast.Assign):
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                if self._is_set_expr(node.value, self.set_locals):
+                    self.set_locals.add(target.id)
+                else:
+                    self.set_locals.discard(target.id)
+        self.generic_visit(node)
+
+    def _check_iter(self, node: ast.AST, iter_expr: ast.expr):
+        if self._is_set_expr(iter_expr, self.set_locals):
+            self.hits.append(node)
+
+    def visit_For(self, node: ast.For):
+        self._check_iter(node, node.iter)
+        self.generic_visit(node)
+
+    def _visit_comp(self, node):
+        for gen in node.generators:
+            self._check_iter(node, gen.iter)
+        self.generic_visit(node)
+
+    visit_ListComp = visit_SetComp = visit_GeneratorExp = _visit_comp
+
+    def visit_DictComp(self, node: ast.DictComp):
+        self._visit_comp(node)
+
+
+@rule("fsm-determinism",
+      "no wall-clock/RNG/uuid/set-order nondeterminism reachable from "
+      "raft FSM apply")
+def check_fsm_determinism(ctx: AnalysisContext) -> List[Finding]:
+    modules = [m for m in ctx.modules if in_scope(m.rel, FSM_SCOPE)]
+    cg = CallGraph(modules)
+    roots = _collect_roots(modules, cg)
+    if not roots:
+        return []
+    reachable = cg.reachable(roots)
+    by_rel: Dict[str, Module] = {m.rel: m for m in modules}
+    findings: List[Finding] = []
+    for fn in sorted(reachable, key=lambda f: (f.module_rel, f.qualname)):
+        mod = by_rel[fn.module_rel]
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                dotted = _nondet_call(node)
+                if dotted is not None:
+                    findings.append(Finding(
+                        rule="fsm-determinism", path=fn.module_rel,
+                        line=node.lineno, severity="error",
+                        message=(f"nondeterministic call {dotted}() in a "
+                                 "function reachable from FSM apply — "
+                                 "replicas applying the same log entry "
+                                 "would diverge; thread the value through "
+                                 "the replicated command instead"),
+                        context=f"{fn.module_rel}:{fn.qualname}",
+                        detail=dotted))
+        visitor = _SetIterVisitor()
+        visitor.visit(fn.node)
+        for node in visitor.hits:
+            findings.append(Finding(
+                rule="fsm-determinism", path=fn.module_rel,
+                line=node.lineno, severity="error",
+                message=("iteration over a set in a function reachable "
+                         "from FSM apply — set order is hash-randomized "
+                         "per process; iterate sorted(...) instead"),
+                context=f"{fn.module_rel}:{fn.qualname}",
+                detail=f"set-iteration@{node.lineno - fn.node.lineno}"))
+    return findings

@@ -1,0 +1,311 @@
+"""Hygiene rules: silent-except, lock-order, shared-struct-mutation.
+
+`silent-except` — an `except Exception: pass` in the raft/state/scheduler
+layers converts a correctness bug into an invisible no-op (a dropped
+reconcile tick, a swallowed apply error). Broad handlers must at least
+log before dropping.
+
+`lock-order` — the package holds ~43 lock sites; two code paths taking
+the same pair of locks in opposite orders is a deadlock waiting for the
+right interleaving. The rule records every nested `with <lock>` pair
+per function and flags pairs observed in both orders anywhere in the
+analyzed tree.
+
+`shared-struct-mutation` — StateStore reads return the live stored row
+(go-memdb contract in the reference): mutating one in place corrupts
+MVCC history for every open snapshot. Rows must be copied
+(`copy.copy(...)`) before mutation; this rule taints locals bound from
+store read calls and flags attribute/keyed assignment through them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .core import AnalysisContext, Finding, Module, in_scope, rule
+
+# --- silent-except -----------------------------------------------------
+
+EXCEPT_SCOPE = ("raft", "state", "scheduler")
+LOG_TOKENS = ("log", "debug", "info", "warn", "error", "exception",
+              "print", "record")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    for node in ([t.elts if isinstance(t, ast.Tuple) else [t]][0]):
+        if isinstance(node, ast.Attribute):
+            names.append(node.attr)
+        elif isinstance(node, ast.Name):
+            names.append(node.id)
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _has_log_or_raise(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = ""
+            if isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                name = node.func.id
+            if any(tok in name.lower() for tok in LOG_TOKENS):
+                return True
+    return False
+
+
+def _is_pass_shaped(handler: ast.ExceptHandler) -> bool:
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Return) and (
+                stmt.value is None or isinstance(stmt.value, ast.Constant)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue
+        return False
+    return True
+
+
+@rule("silent-except",
+      "broad exception handlers in raft/state/scheduler must log "
+      "before dropping the error")
+def check_silent_except(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        if not in_scope(mod.rel, EXCEPT_SCOPE):
+            continue
+        per_context: Dict[str, int] = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if _has_log_or_raise(node) or not _is_pass_shaped(node):
+                continue
+            context = f"{mod.rel}:{mod.enclosing_function(node)}"
+            ordinal = per_context.get(context, 0)
+            per_context[context] = ordinal + 1
+            findings.append(Finding(
+                rule="silent-except", path=mod.rel, line=node.lineno,
+                severity="warning",
+                message=("broad except silently drops the error — add at "
+                         "least a debug-level log line"),
+                context=context, detail=f"silent:{ordinal}"))
+    return findings
+
+
+# --- lock-order --------------------------------------------------------
+
+LOCK_NAME_TOKENS = ("lock", "cond", "mutex", "sem")
+
+
+def _lock_name(expr: ast.expr) -> str:
+    """Dotted text of a lock-ish `with` context expr, or ""."""
+    parts = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    else:
+        return ""
+    parts.reverse()
+    if not any(tok in parts[-1].lower() for tok in LOCK_NAME_TOKENS):
+        return ""
+    if parts[0] == "self":
+        parts = parts[1:]
+    return ".".join(parts)
+
+
+class _LockVisitor(ast.NodeVisitor):
+    def __init__(self):
+        self.stack: List[str] = []
+        self.pairs: List[Tuple[str, str, int]] = []  # (outer, inner, line)
+
+    def visit_With(self, node: ast.With):
+        acquired = []
+        for item in node.items:
+            name = _lock_name(item.context_expr)
+            if name:
+                for outer in self.stack + acquired:
+                    if outer != name:
+                        self.pairs.append((outer, name, node.lineno))
+                acquired.append(name)
+        self.stack.extend(acquired)
+        self.generic_visit(node)
+        if acquired:
+            del self.stack[-len(acquired):]
+
+    def visit_FunctionDef(self, node):
+        pass  # closures run later, outside this lock scope; walked separately
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+@rule("lock-order",
+      "lock pairs must be acquired in one consistent order everywhere")
+def check_lock_order(ctx: AnalysisContext) -> List[Finding]:
+    sites: Dict[Tuple[str, str], List[Tuple[Module, str, int]]] = {}
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            visitor = _LockVisitor()
+            for stmt in node.body:
+                visitor.visit(stmt)
+            for outer, inner, line in visitor.pairs:
+                sites.setdefault((outer, inner), []).append(
+                    (mod, f"{mod.rel}:{mod.enclosing_function(node)}", line))
+    findings: List[Finding] = []
+    for (outer, inner), occurrences in sorted(sites.items()):
+        if (inner, outer) not in sites or (outer, inner) < (inner, outer):
+            continue  # consistent, or report each conflicting pair once
+        other = sites[(inner, outer)][0]
+        for mod, context, line in occurrences + sites[(inner, outer)]:
+            findings.append(Finding(
+                rule="lock-order", path=mod.rel, line=line,
+                severity="error",
+                message=(f"locks '{outer}' and '{inner}' are acquired in "
+                         f"both orders (other order at {other[1]}) — "
+                         "deadlock risk; pick one global order"),
+                context=context, detail=f"{outer}<->{inner}"))
+    return findings
+
+
+# --- shared-struct-mutation --------------------------------------------
+
+READ_METHODS = {
+    # StateStore / table internals
+    "get_latest", "_latest_alloc", "iterate",
+    # StateSnapshot read surface
+    "node_by_id", "nodes", "ready_nodes_in_pool",
+    "job_by_id", "jobs", "job_version", "job_versions",
+    "eval_by_id", "evals", "evals_by_job",
+    "alloc_by_id", "allocs", "alloc_blocks",
+    "allocs_by_node", "allocs_by_node_terminal",
+    "allocs_by_job", "allocs_by_eval",
+    "deployments", "deployment_by_id", "deployments_by_job",
+    "latest_deployment_by_job",
+    "acl_policy", "acl_policies", "acl_token_by_accessor",
+    "acl_token_by_secret", "acl_tokens", "acl_role", "acl_roles",
+    "one_time_token", "scheduler_configuration",
+    "auth_method", "auth_methods", "binding_rule", "binding_rules",
+    "variable", "variables", "volume_by_id", "volumes",
+    "service_registrations", "service_by_name",
+    "node_pool", "node_pools", "namespace", "namespaces",
+    "node_usage", "node_dev_usage",
+}
+UNWRAP_CALLS = ("list", "tuple", "sorted", "reversed", "iter", "next")
+
+
+def _read_call(expr: ast.expr) -> bool:
+    """True if `expr` evaluates to object(s) owned by the store."""
+    if isinstance(expr, ast.Call):
+        func = expr.func
+        if isinstance(func, ast.Attribute) and func.attr in READ_METHODS:
+            return True
+        if (isinstance(func, ast.Name) and func.id in UNWRAP_CALLS
+                and expr.args):
+            return _read_call(expr.args[0])
+    return False
+
+
+def _target_names(target: ast.expr) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out = []
+        for elt in target.elts:
+            out.extend(_target_names(elt))
+        return out
+    return []
+
+
+class _TaintVisitor(ast.NodeVisitor):
+    """Source-order walk of one function: taints locals bound from store
+    reads, clears them on any other rebind (copy.copy included), flags
+    attribute / keyed-attribute stores through tainted names."""
+
+    def __init__(self, mod: Module, qual: str):
+        self.mod = mod
+        self.qual = qual
+        self.tainted: Dict[str, int] = {}   # name -> read line
+        self.findings: List[Finding] = []
+
+    def _flag(self, node: ast.AST, name: str, what: str):
+        self.findings.append(Finding(
+            rule="shared-struct-mutation", path=self.mod.rel,
+            line=node.lineno, severity="error",
+            message=(f"{what} on '{name}' read from the state store "
+                     f"(line {self.tainted[name]}) — store rows are "
+                     "shared across snapshots; copy.copy() before "
+                     "mutating"),
+            context=self.qual, detail=f"{name}.{what}"))
+
+    def _check_store(self, node: ast.AST, target: ast.expr):
+        # x.attr = ... / x.attr[k] = ... with x tainted
+        inner = target
+        if isinstance(inner, ast.Subscript):
+            inner = inner.value
+        if (isinstance(inner, ast.Attribute)
+                and isinstance(inner.value, ast.Name)
+                and inner.value.id in self.tainted):
+            what = (inner.attr if inner is target
+                    else f"{inner.attr}[...]")
+            self._flag(node, inner.value.id, what)
+
+    def visit_Assign(self, node: ast.Assign):
+        self.visit(node.value)
+        for target in node.targets:
+            self._check_store(node, target)
+            for name in _target_names(target):
+                if _read_call(node.value):
+                    self.tainted[name] = node.lineno
+                else:
+                    self.tainted.pop(name, None)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self.visit(node.value)
+        self._check_store(node, node.target)
+        for name in _target_names(node.target):
+            self.tainted.pop(name, None)
+
+    def visit_For(self, node: ast.For):
+        names = _target_names(node.target)
+        if _read_call(node.iter):
+            for name in names:
+                self.tainted[name] = node.lineno
+        else:
+            for name in names:
+                self.tainted.pop(name, None)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def visit_FunctionDef(self, node):
+        pass  # closures get their own pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+@rule("shared-struct-mutation",
+      "objects read from the state store must be copied before mutation")
+def check_shared_struct(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            qual = f"{mod.rel}:{mod.enclosing_function(node)}"
+            visitor = _TaintVisitor(mod, qual)
+            for stmt in node.body:
+                visitor.visit(stmt)
+            findings.extend(visitor.findings)
+    return findings

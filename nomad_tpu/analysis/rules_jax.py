@@ -1,0 +1,216 @@
+"""Rule `jax-hot-path`: no host syncs or trace hazards inside jit.
+
+The scheduling kernels' whole value is staying on-device: one host sync
+(`.item()`, `np.asarray`, `block_until_ready`, `jax.device_get`,
+`float()` on a tracer) inside a `@jax.jit` body either fails at trace
+time or — worse — silently forces a device round-trip per call and
+erases the BENCH win. Python `if`/`while` on a traced argument is the
+recompilation/ConcretizationError trap: each new value re-traces.
+
+Allowed and not flagged: branching on `static_argnames` parameters, on
+`x is None` (structure, static under jit), and on shape/dtype metadata
+(`x.shape`, `x.ndim`, `x.size`, `x.dtype`, `len(x)`) — all static at
+trace time.
+
+Scope: tensor/ and scheduler/ inside the package; everywhere in
+standalone fixture trees.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import AnalysisContext, Finding, Module, in_scope, rule
+
+SCOPE = ("tensor", "scheduler")
+SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+SYNC_NUMPY_ALIASES = {"np", "numpy", "onp"}
+STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+
+def _jit_decoration(dec: ast.expr) -> Optional[Tuple[str, ...]]:
+    """Return static_argnames if `dec` is a jit decorator, else None."""
+    # @jax.jit / @jit
+    if isinstance(dec, ast.Attribute) and dec.attr == "jit":
+        return ()
+    if isinstance(dec, ast.Name) and dec.id == "jit":
+        return ()
+    if not isinstance(dec, ast.Call):
+        return None
+    func = dec.func
+    # @jax.jit(...) / @jit(...)
+    if ((isinstance(func, ast.Attribute) and func.attr == "jit")
+            or (isinstance(func, ast.Name) and func.id == "jit")):
+        return _static_argnames(dec)
+    # @partial(jax.jit, ...) / @functools.partial(jit, ...)
+    is_partial = ((isinstance(func, ast.Name) and func.id == "partial")
+                  or (isinstance(func, ast.Attribute)
+                      and func.attr == "partial"))
+    if is_partial and dec.args:
+        inner = _jit_decoration(dec.args[0])
+        if inner is not None:
+            return _static_argnames(dec)
+    return None
+
+
+def _static_argnames(call: ast.Call) -> Tuple[str, ...]:
+    for kw in call.keywords:
+        if kw.arg != "static_argnames":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            return tuple(e.value for e in v.elts
+                         if isinstance(e, ast.Constant))
+    return ()
+
+
+def _jitted_functions(mod: Module) -> Dict[ast.FunctionDef, Tuple[str, ...]]:
+    """All jit-compiled defs in the module with their static argnames:
+    decorated defs, plus defs wrapped by module-level assignments like
+    `solve = partial(jax.jit, ...)(_impl)` or `solve = jax.jit(_impl)`."""
+    out: Dict[ast.FunctionDef, Tuple[str, ...]] = {}
+    by_name: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.FunctionDef):
+            by_name.setdefault(node.name, node)
+            for dec in node.decorator_list:
+                statics = _jit_decoration(dec)
+                if statics is not None:
+                    out[node] = statics
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call):
+            continue
+        call = node.value
+        # jax.jit(fn, ...) form
+        statics = None
+        target_fn = None
+        func = call.func
+        if ((isinstance(func, ast.Attribute) and func.attr == "jit")
+                or (isinstance(func, ast.Name) and func.id == "jit")):
+            statics = _static_argnames(call)
+            if call.args and isinstance(call.args[0], ast.Name):
+                target_fn = by_name.get(call.args[0].id)
+        # partial(jax.jit, ...)(fn) form
+        elif isinstance(func, ast.Call):
+            statics = _jit_decoration(func)
+            if statics is not None and call.args and isinstance(
+                    call.args[0], ast.Name):
+                target_fn = by_name.get(call.args[0].id)
+        if target_fn is not None and statics is not None:
+            out.setdefault(target_fn, statics)
+    return out
+
+
+def _param_names(fn: ast.FunctionDef) -> Set[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return set(names)
+
+
+def _is_none_check(parents: Dict[ast.AST, ast.AST], name: ast.Name) -> bool:
+    p = parents.get(name)
+    return (isinstance(p, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot)) for op in p.ops)
+            and all(isinstance(c, ast.Constant) and c.value is None
+                    for c in p.comparators))
+
+
+def _traced_uses(test: ast.expr, traced: Set[str]) -> List[ast.Name]:
+    """Names in `test` that read a traced value non-statically."""
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(test):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    bad = []
+    for node in ast.walk(test):
+        if not isinstance(node, ast.Name) or node.id not in traced:
+            continue
+        p = parents.get(node)
+        if isinstance(p, ast.Attribute) and p.attr in STATIC_ATTRS:
+            continue
+        if (isinstance(p, ast.Call) and isinstance(p.func, ast.Name)
+                and p.func.id in ("len", "isinstance")):
+            continue
+        if _is_none_check(parents, node):
+            continue
+        bad.append(node)
+    return bad
+
+
+def _check_jitted(mod: Module, fn: ast.FunctionDef,
+                  statics: Tuple[str, ...]) -> List[Finding]:
+    findings: List[Finding] = []
+    traced = _param_names(fn) - set(statics)
+    qual = f"{mod.rel}:{fn.name}"
+
+    def add(node, message, detail):
+        findings.append(Finding(
+            rule="jax-hot-path", path=mod.rel, line=node.lineno,
+            severity="error", message=message, context=qual, detail=detail))
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr in SYNC_METHODS:
+                    add(node, f"host sync .{func.attr}() inside @jax.jit "
+                        f"'{fn.name}' forces a device round-trip per call",
+                        f".{func.attr}")
+                elif (isinstance(func.value, ast.Name)
+                      and func.value.id in SYNC_NUMPY_ALIASES):
+                    add(node, f"numpy call {func.value.id}.{func.attr}() "
+                        f"inside @jax.jit '{fn.name}' concretizes the "
+                        "tracer (host sync or trace error)",
+                        f"{func.value.id}.{func.attr}")
+                elif (func.attr == "device_get"
+                      and isinstance(func.value, ast.Name)
+                      and func.value.id == "jax"):
+                    add(node, f"jax.device_get inside @jax.jit '{fn.name}' "
+                        "is a host sync", "jax.device_get")
+            elif (isinstance(func, ast.Name)
+                  and func.id in ("float", "int", "bool")
+                  and len(node.args) == 1):
+                arg = node.args[0]
+                ok = (isinstance(arg, ast.Constant)
+                      or (isinstance(arg, ast.Name) and arg.id in statics)
+                      or (isinstance(arg, ast.Call)
+                          and isinstance(arg.func, ast.Name)
+                          and arg.func.id == "len")
+                      or (isinstance(arg, ast.Attribute)
+                          and arg.attr in STATIC_ATTRS)
+                      or (isinstance(arg, ast.Subscript)
+                          and isinstance(arg.value, ast.Attribute)
+                          and arg.value.attr in STATIC_ATTRS))
+                if not ok:
+                    add(node, f"{func.id}() on a (possibly traced) value "
+                        f"inside @jax.jit '{fn.name}' concretizes the "
+                        "tracer; use jnp ops instead", f"{func.id}()")
+        elif isinstance(node, (ast.If, ast.While)):
+            for use in _traced_uses(node.test, traced):
+                kind = "while" if isinstance(node, ast.While) else "if"
+                add(node, f"Python `{kind}` branches on traced argument "
+                    f"'{use.id}' inside @jax.jit '{fn.name}' — re-traces "
+                    "per value (use jnp.where / lax.cond / mark it "
+                    "static_argnames)", f"{kind}:{use.id}")
+    return findings
+
+
+@rule("jax-hot-path",
+      "no host syncs or traced-value Python branching inside "
+      "jit-compiled scheduling kernels")
+def check_jax_hot_path(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        if not in_scope(mod.rel, SCOPE):
+            continue
+        for fn, statics in _jitted_functions(mod).items():
+            findings.extend(_check_jitted(mod, fn, statics))
+    return findings

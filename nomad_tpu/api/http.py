@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import base64
 import json
+import logging
 import os
 import re
 import threading
@@ -23,6 +24,8 @@ from ..structs.job import Job
 from ..structs.node import DrainStrategy
 from .codec import from_dict, to_dict
 from .jobspec import _validate
+
+log = logging.getLogger("nomad_tpu.api")
 
 MAX_BLOCK_S = 30.0
 
@@ -223,12 +226,19 @@ class HTTPAgent:
                             self._error(502,
                                         f"region {region!r} failed: {e}")
                         except OSError:
-                            pass
+                            log.debug("client gone before 502 for region "
+                                      "%s could be written", region,
+                                      exc_info=True)
+                    else:
+                        log.debug("relay to region %s failed mid-stream",
+                                  region, exc_info=True)
                 except Exception:
                     # e.g. http.client.IncompleteRead mid-relay: same
                     # rule — never write a second response
                     if not committed:
                         raise
+                    log.debug("relay to region %s failed after response "
+                              "was committed", region, exc_info=True)
                 return True
 
             def do_GET(self):
@@ -266,6 +276,8 @@ class HTTPAgent:
                 except PermissionError as e:
                     self._error(403, str(e))
                 except Exception as e:
+                    # the client only sees str(e); keep the traceback
+                    log.debug("GET %s -> 500", self.path, exc_info=True)
                     self._error(500, str(e))
 
             def do_POST(self):
@@ -280,6 +292,7 @@ class HTTPAgent:
                 except PermissionError as e:
                     self._error(403, str(e))
                 except Exception as e:
+                    log.debug("POST %s -> 500", self.path, exc_info=True)
                     self._error(500, str(e))
 
             do_PUT = do_POST
@@ -294,6 +307,7 @@ class HTTPAgent:
                 except PermissionError as e:
                     self._error(403, str(e))
                 except Exception as e:
+                    log.debug("DELETE %s -> 500", self.path, exc_info=True)
                     self._error(500, str(e))
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)

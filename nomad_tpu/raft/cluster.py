@@ -10,6 +10,7 @@ follower are forwarded to the leader (reference nomad/rpc.go forward).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -19,6 +20,8 @@ from ..state import StateStore
 from .fsm import FSM, RaftStore
 from .node import NotLeaderError, RaftNode
 from .transport import InProcTransport, RemoteCallError, TransportError
+
+log = logging.getLogger("nomad_tpu.raft")
 
 FORWARD = ("register_job", "deregister_job", "dispatch_job",
            "scale_job", "revert_job",
@@ -231,7 +234,9 @@ class ReplicatedServer:
             try:
                 self._gossip_reconcile_once()
             except Exception:
-                pass  # transient raft state changes; next tick retries
+                # transient raft state changes; next tick retries
+                log.debug("gossip reconcile tick failed on %s",
+                          self.id, exc_info=True)
 
     # a gossip-DEAD verdict must persist this long before the leader
     # removes the voter: one dropped UDP probe or a brief stall must not
@@ -268,7 +273,8 @@ class ReplicatedServer:
                             self.server.upsert_region(
                                 {"name": region, "address": http})
                     except Exception:
-                        pass
+                        log.debug("federation registry upsert for region "
+                                  "%s failed", region, exc_info=True)
                 continue
             rpc = meta.get("rpc", "")
             if m["status"] == DEAD:
@@ -289,12 +295,14 @@ class ReplicatedServer:
                 try:
                     self.raft.remove_server(mid)
                 except Exception:
-                    pass
+                    log.debug("autopilot removal of dead server %s failed",
+                              mid, exc_info=True)
             elif mid not in current and rpc:
                 try:
                     self.raft.add_server(mid, rpc)
                 except Exception:
-                    pass
+                    log.debug("autopilot join of gossip member %s failed",
+                              mid, exc_info=True)
 
     def _on_leadership(self, is_leader: bool) -> None:
         # runs on raft threads; establish/revoke the leader subsystems

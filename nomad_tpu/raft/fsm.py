@@ -47,9 +47,21 @@ MUTATIONS = {
 }
 
 
+def _refuse_wallclock() -> float:
+    raise RuntimeError(
+        "wall-clock read during a replicated apply: a timestamped command "
+        "reached the store without an explicit ts — the proposer must stamp "
+        "it (RaftStore fills ts for every TIMESTAMPED op)")
+
+
 class FSM:
     def __init__(self, store):
         self.store = store
+        # A replica applying the shared log must never stamp local time:
+        # replace the store's ts-fallback clock with a guard so any
+        # mutator that would read wall clock fails loudly instead of
+        # silently diverging from its peers.
+        store._clock = _refuse_wallclock
 
     def apply(self, command: tuple) -> Any:
         op, args, kwargs = command
@@ -57,6 +69,12 @@ class FSM:
             return None  # leader barrier entry (raft/node.py _become_leader)
         if op not in MUTATIONS:
             raise ValueError(f"unknown FSM op {op!r}")
+        if op in TIMESTAMPED and kwargs.get("ts") is None:
+            # catch the divergence at the boundary, with the op name,
+            # rather than via the _clock guard deep in a mutator
+            raise ValueError(
+                f"replicated {op!r} command carries no ts: replicas "
+                "would each stamp their own apply time and diverge")
         fn = getattr(self.store, op)
         # each replica must own its objects
         args = copy.deepcopy(args)

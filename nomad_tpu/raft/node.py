@@ -11,12 +11,15 @@ the linearizable write the plan applier needs.
 
 from __future__ import annotations
 
+import logging
 import random
 import threading
 import time
 from typing import Callable, Dict, List, Optional
 
 from .log import Entry, RaftLog
+
+log = logging.getLogger("nomad_tpu.raft")
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 
@@ -176,7 +179,8 @@ class RaftNode:
             try:
                 self.on_config_change(dict(self.servers))
             except Exception:
-                pass
+                log.debug("on_config_change callback failed on %s",
+                          self.id, exc_info=True)
 
     def _recover_config_from_log(self, reset_on_missing: bool = False) -> None:
         base = getattr(self.log, "base_index", 0)

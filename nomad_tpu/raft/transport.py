@@ -17,11 +17,14 @@ implementations:
 from __future__ import annotations
 
 import json
+import logging
 import socket
 import socketserver
 import struct
 import threading
 from typing import Callable, Dict, Optional, Tuple
+
+log = logging.getLogger("nomad_tpu.raft")
 
 
 class InProcTransport:
@@ -55,6 +58,8 @@ class InProcTransport:
         try:
             return handler(msg)
         except Exception:
+            log.debug("in-proc handler on %s raised for message from %s",
+                      to_id, from_id, exc_info=True)
             return None
 
 
@@ -150,6 +155,8 @@ class SocketTransport:
                     try:
                         frame = _recv_frame(self.request)
                     except Exception:
+                        log.debug("rpc connection to %s dropped mid-frame",
+                                  transport.node_id, exc_info=True)
                         return
                     if frame is None:
                         return
@@ -162,6 +169,9 @@ class SocketTransport:
                     try:
                         _send_frame(self.request, reply)
                     except Exception:
+                        log.debug("rpc reply from %s lost: peer closed "
+                                  "the connection", transport.node_id,
+                                  exc_info=True)
                         return
 
         class Server(socketserver.ThreadingTCPServer):

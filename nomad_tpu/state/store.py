@@ -442,6 +442,12 @@ class StateStore:
         self._next_gen = 0       # last allocated generation (>= _index during a write)
         self._tracker = SnapshotTracker()
         self._cond = threading.Condition()
+        # Wall-clock source for the ts-fallbacks in the mutators below.
+        # A plain (non-replicated) store stamps local time; attaching a
+        # raft FSM swaps in a guard that refuses the read (raft/fsm.py),
+        # because a replica applying the shared log must never stamp
+        # replica-local time — the proposer embeds ts in the command.
+        self._clock = time.time
 
         self._nodes = VersionedTable("nodes")
         self._jobs = VersionedTable("jobs")                  # key (ns, job_id)
@@ -631,7 +637,7 @@ class StateStore:
             return gen
 
     def update_node_status(self, node_id: str, status: str, ts: float = None) -> int:
-        ts = ts if ts is not None else time.time()
+        ts = ts if ts is not None else self._clock()
 
         def mut(n):
             n.status = status
@@ -730,7 +736,7 @@ class StateStore:
     def upsert_evals(self, evals: List[Evaluation], ts: float = None) -> int:
         with self._write_lock:
             gen, live = self._begin()
-            ts = ts if ts is not None else time.time()
+            ts = ts if ts is not None else self._clock()
             events = []
             for ev in evals:
                 self._put_eval(ev, gen, live, ts)
@@ -744,7 +750,7 @@ class StateStore:
         ev.modify_index = gen
         # ts flows from the proposer via the raft command so replicas stamp
         # identical times (replay-time stamping would fork GC decisions)
-        ev.modify_time = ts if ts is not None else time.time()
+        ev.modify_time = ts if ts is not None else self._clock()
         if not ev.create_time:
             ev.create_time = ev.modify_time
         self._evals.put(ev.id, ev, gen, live)
@@ -764,7 +770,9 @@ class StateStore:
                     jobs_touched.add((ev.namespace, ev.job_id))
                 self._evals.delete(eid, gen, live)
             # compact the job index so dead eval ids don't accumulate
-            for key in jobs_touched:
+            # (sorted: set order is hash-randomized per process, and every
+            # replica must rewrite the index chains identically)
+            for key in sorted(jobs_touched):
                 cell = self._evals_by_job.get_latest(key)
                 ids = [i for i in cons_iter(cell) if i not in dead]
                 if cell is not None and len(ids) != cell.length:
@@ -778,7 +786,7 @@ class StateStore:
         """Server-side alloc upsert (placements, desired-status changes)."""
         with self._write_lock:
             gen, live = self._begin()
-            ts = ts if ts is not None else time.time()
+            ts = ts if ts is not None else self._clock()
             events = []
             for alloc in allocs:
                 self._put_alloc(alloc, gen, live, ts)
@@ -879,7 +887,7 @@ class StateStore:
 
     def _put_alloc(self, alloc: Allocation, gen: int, live: int, ts: float = None,
                    prev=_MISS) -> None:
-        alloc.modify_time = ts if ts is not None else time.time()
+        alloc.modify_time = ts if ts is not None else self._clock()
         if prev is StateStore._MISS:
             prev = self._latest_alloc(alloc.id)
         if prev is not None:
@@ -907,7 +915,7 @@ class StateStore:
         client batches at client/client.go:2198)."""
         with self._write_lock:
             gen, live = self._begin()
-            ts = ts if ts is not None else time.time()
+            ts = ts if ts is not None else self._clock()
             events = []
             for upd in updates:
                 existing = self._latest_alloc(upd.id)
@@ -969,7 +977,7 @@ class StateStore:
     ) -> int:
         with self._write_lock:
             gen, live = self._begin()
-            ts = ts if ts is not None else time.time()
+            ts = ts if ts is not None else self._clock()
             events = []
             for alloc in stopped_allocs:
                 self._reap_services_for_terminal(alloc, gen, live, events)
@@ -1542,7 +1550,7 @@ class StateStore:
         None when absent/expired. Check-then-delete outside the write
         lock would let two concurrent exchanges both win (reference
         one-time tokens are single-use by contract)."""
-        ts = ts if ts is not None else time.time()
+        ts = ts if ts is not None else self._clock()
         with self._write_lock:
             row = self._one_time_tokens.get_latest(secret)
             if row is None or ts >= row["expires"]:
@@ -1563,7 +1571,7 @@ class StateStore:
     def gc_one_time_tokens(self, ts: float = None) -> int:
         """Expire unexchanged one-time tokens (reference core_sched.go
         expiredOneTimeTokenGC)."""
-        ts = ts if ts is not None else time.time()
+        ts = ts if ts is not None else self._clock()
         with self._write_lock:
             dead = [k for k, row in self._one_time_tokens.iterate(self._index)
                     if ts >= row["expires"]]
@@ -1579,7 +1587,7 @@ class StateStore:
         """Drop tokens past their expiration (reference core_sched.go
         expiredACLTokenGC). `ts` rides the replicated command so
         followers replaying the log agree on what was expired."""
-        ts = ts if ts is not None else time.time()
+        ts = ts if ts is not None else self._clock()
         with self._write_lock:
             dead = [t for _, t in self._acl_tokens.iterate(self._index)
                     if getattr(t, "expiration_time", 0.0)

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# The full local gate: lint + AST invariant checker + tier-1 tests.
+# Mirrors what CI should run; every step must pass.
+set -u
+cd "$(dirname "$0")/.."
+
+failed=0
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff =="
+    ruff check . || failed=1
+else
+    echo "== ruff == (not installed, skipping)"
+fi
+
+echo "== nomad_tpu.analysis =="
+python -m nomad_tpu.analysis || failed=1
+
+echo "== tier-1 tests =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider || failed=1
+
+exit $failed

@@ -1,0 +1,23 @@
+"""Negative fixture: the deterministic shapes the fsm rule must allow."""
+
+import time
+
+MUTATIONS = {"upsert_thing"}
+
+
+class Store:
+    def upsert_thing(self, row, ts):
+        row["mtime"] = ts                  # ts rides the command: fine
+        touched = {"a", "b"}
+        for key in sorted(touched):        # sorted set: deterministic
+            row[key] = ts
+        ordered = {"x": 1, "y": 2}
+        for key in ordered:                # dict order is insertion order
+            row[key] = ordered[key]
+        return row
+
+
+def propose(op, args):
+    # proposer-side stamping happens on ONE node — wall clock is fine
+    # here because the result travels inside the replicated command
+    return (op, args, {"ts": time.time()})

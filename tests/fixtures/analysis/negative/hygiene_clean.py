@@ -1,0 +1,48 @@
+"""Negative fixture: hygienic versions of every hygiene-rule pattern."""
+
+import copy
+import logging
+import threading
+
+log = logging.getLogger(__name__)
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+
+def risky():
+    raise RuntimeError("boom")
+
+
+def observed():
+    try:
+        risky()
+    except Exception:
+        log.debug("risky failed", exc_info=True)   # logged: fine
+
+
+def narrow():
+    try:
+        risky()
+    except KeyError:
+        pass                                       # narrow type: fine
+
+
+def consistent_one():
+    with a_lock:
+        with b_lock:
+            return 1
+
+
+def consistent_two():
+    with a_lock:
+        with b_lock:                               # same order: fine
+            return 2
+
+
+def copy_before_mutate(snap):
+    alloc = copy.copy(snap.alloc_by_id("a1"))
+    alloc.client_status = "lost"                   # copied first: fine
+    evs = [copy.copy(ev) for ev in snap.evals()]
+    for ev in evs:
+        ev.status = "complete"                     # copies again: fine

@@ -1,0 +1,21 @@
+"""Negative fixture: trace-static patterns the jax rule must allow."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n",))
+def clean_kernel(x, n, mask=None):
+    if n > 3:                    # static_argnames param: fine
+        x = x * 2
+    if mask is not None:         # structure check, static under jit: fine
+        x = jnp.where(mask, x, 0.0)
+    if x.ndim > 1:               # shape metadata is static: fine
+        x = x.reshape(-1)
+    return jnp.sum(x)
+
+
+def host_helper(x):
+    return float(x)              # not jitted: host code may concretize

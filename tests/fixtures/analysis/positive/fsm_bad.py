@@ -1,0 +1,26 @@
+"""Positive fixture: every fsm-determinism hazard the rule must flag.
+
+Mirrors the shape of raft/fsm.py + state/store.py: a MUTATIONS set names
+the dispatchable mutators, and nondeterminism both directly in a mutator
+and in a helper it calls must be caught.
+"""
+
+import random
+import time
+import uuid
+
+MUTATIONS = {"upsert_thing"}
+
+
+class Store:
+    def upsert_thing(self, row, ts=None):
+        stamp = ts if ts is not None else time.time()  # flag: wall clock
+        row["id"] = str(uuid.uuid4())                  # flag: uuid minting
+        touched = {"a", "b"}
+        for key in touched:                            # flag: set iteration
+            row[key] = stamp
+        return self._index(row)
+
+    def _index(self, row):
+        row["jitter"] = random.random()                # flag: RNG in helper
+        return row

@@ -1,0 +1,36 @@
+"""Positive fixture: silent-except, lock-order, shared-struct hazards."""
+
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+
+def risky():
+    raise RuntimeError("boom")
+
+
+def swallow():
+    try:
+        risky()
+    except Exception:
+        pass                        # flag: error vanishes
+
+
+def forward_order():
+    with a_lock:
+        with b_lock:                # pair (a_lock, b_lock)
+            return 1
+
+
+def reverse_order():
+    with b_lock:
+        with a_lock:                # flag: opposite order — deadlock risk
+            return 2
+
+
+def mutate_store_rows(snap):
+    alloc = snap.alloc_by_id("a1")
+    alloc.client_status = "lost"    # flag: mutating a live store row
+    for ev in snap.evals():
+        ev.status = "complete"      # flag: mutating rows while iterating

@@ -1,0 +1,21 @@
+"""Positive fixture: host syncs and trace hazards inside jit bodies."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_sync(x):
+    total = jnp.sum(x)
+    return total.item()       # flag: host sync
+
+
+@partial(jax.jit, static_argnames="n")
+def bad_branch(x, n):
+    if x > 0:                 # flag: Python branch on traced arg
+        x = x + n
+    host = np.asarray(x)      # flag: numpy concretizes the tracer
+    return float(host)        # flag: float() on a traced value

@@ -1,0 +1,85 @@
+"""Replica determinism: timestamps ride the replicated command.
+
+A follower replaying the log at catch-up time must stamp the SAME
+modify_time the leader stamped at propose time — i.e. the time comes
+from inside the command, never from the applying replica's clock. The
+FSM installs a wall-clock guard on its store so any regression fails
+loudly instead of silently forking replica state.
+"""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.raft.fsm import FSM, TIMESTAMPED, RaftStore
+from nomad_tpu.state import StateStore
+
+
+def _replicas(n=3):
+    return [FSM(StateStore()) for _ in range(n)]
+
+
+def test_three_replicas_stamp_identical_modify_times():
+    node = mock.node()
+    job = mock.job()
+    ev = mock.eval_for(job)
+    alloc = mock.alloc(job, node)
+    log = [
+        ("upsert_node", (node,), {}),
+        ("upsert_job", (job,), {}),
+        ("upsert_evals", ([ev],), {"ts": 1111.5}),
+        ("upsert_allocs", ([alloc],), {"ts": 2222.25}),
+        ("update_node_status", (node.id, "down"), {"ts": 3333.125}),
+    ]
+    replicas = _replicas(3)
+    for fsm in replicas:
+        for cmd in log:
+            fsm.apply(cmd)
+
+    snaps = [f.store.snapshot() for f in replicas]
+    assert {s.eval_by_id(ev.id).modify_time for s in snaps} == {1111.5}
+    assert {s.alloc_by_id(alloc.id).modify_time for s in snaps} == {2222.25}
+    assert {s.node_by_id(node.id).status_updated_at
+            for s in snaps} == {3333.125}
+    # identical command sequence -> identical store generation
+    assert len({f.store.latest_index for f in replicas}) == 1
+
+
+def test_timestamped_command_without_ts_is_rejected():
+    fsm = _replicas(1)[0]
+    ev = mock.eval_for(mock.job())
+    with pytest.raises(ValueError, match="no ts"):
+        fsm.apply(("upsert_evals", ([ev],), {}))
+
+
+def test_fsm_store_refuses_wallclock_fallback():
+    store = StateStore()
+    FSM(store)  # installs the guard
+    with pytest.raises(RuntimeError, match="wall-clock"):
+        store.upsert_evals([mock.eval_for(mock.job())])
+
+
+def test_standalone_store_still_self_stamps():
+    # single-node/test usage without raft keeps the convenience default
+    store = StateStore()
+    ev = mock.eval_for(mock.job())
+    store.upsert_evals([ev])
+    assert store.snapshot().eval_by_id(ev.id).modify_time > 0
+
+
+def test_raftstore_stamps_every_timestamped_op_at_propose_time():
+    class FakeRaft:
+        def __init__(self):
+            self.commands = []
+
+        def apply(self, cmd):
+            self.commands.append(cmd)
+
+    raft = FakeRaft()
+    rs = RaftStore(StateStore(), raft)
+    ev = mock.eval_for(mock.job())
+    rs.upsert_evals([ev])
+    rs.upsert_node(mock.node())
+    ops = {op: kwargs for op, _args, kwargs in raft.commands}
+    assert ops["upsert_evals"]["ts"] is not None
+    assert "upsert_evals" in TIMESTAMPED
+    assert "ts" not in ops["upsert_node"]  # untimestamped ops untouched

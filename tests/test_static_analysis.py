@@ -1,0 +1,110 @@
+"""Tier-1 gate for the AST invariant checker (nomad_tpu/analysis/).
+
+Three contracts:
+- each rule flags its positive fixtures and stays quiet on the matched
+  clean negatives (tests/fixtures/analysis/);
+- the repo itself carries no findings beyond the checked-in baseline —
+  in particular the fsm-determinism rule is clean on raft/ + state/;
+- the CLI exit code is the CI contract: non-zero iff non-baselined
+  findings exist.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from nomad_tpu.analysis import (all_rules, load_baseline, partition,
+                                run_analysis, write_baseline)
+from nomad_tpu.analysis.__main__ import main
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "fixtures" / "analysis"
+POSITIVE = FIXTURES / "positive"
+NEGATIVE = FIXTURES / "negative"
+
+ALL_RULES = ("fsm-determinism", "jax-hot-path", "lock-order",
+             "shared-struct-mutation", "silent-except")
+
+
+def _by_rule(findings):
+    out = {}
+    for f in findings:
+        out.setdefault(f.rule, []).append(f)
+    return out
+
+
+def test_registry_exposes_all_five_rules():
+    assert set(all_rules()) == set(ALL_RULES)
+
+
+def test_positive_fixtures_flag_every_rule():
+    found = _by_rule(run_analysis(paths=[POSITIVE], root=FIXTURES))
+    assert set(found) == set(ALL_RULES)
+
+    fsm = {f.detail for f in found["fsm-determinism"]}
+    assert "time.time" in fsm
+    assert "uuid.uuid4" in fsm
+    assert "random.random" in fsm
+    assert any(d.startswith("set-iteration") for d in fsm)
+
+    jax = {f.detail for f in found["jax-hot-path"]}
+    assert jax == {".item", "if:x", "np.asarray", "float()"}
+
+    assert [f.detail for f in found["silent-except"]] == ["silent:0"]
+
+    lock = found["lock-order"]
+    assert len(lock) == 2  # one finding per conflicting site
+    assert {f.detail for f in lock} == {"b_lock<->a_lock"}
+
+    shared = {f.detail for f in found["shared-struct-mutation"]}
+    assert shared == {"alloc.client_status", "ev.status"}
+
+
+def test_negative_fixtures_are_clean():
+    assert run_analysis(paths=[NEGATIVE], root=FIXTURES) == []
+
+
+def test_fsm_determinism_clean_on_raft_and_state():
+    # The hard acceptance bar: determinism bugs were FIXED, not baselined.
+    findings = run_analysis(
+        paths=[REPO / "nomad_tpu" / "raft", REPO / "nomad_tpu" / "state"],
+        rules=["fsm-determinism"], root=REPO)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_repo_has_no_findings_beyond_baseline():
+    new, _stale = partition(run_analysis(), load_baseline())
+    assert new == [], [f.render() for f in new]
+
+
+def test_cli_exit_codes(capsys):
+    assert main([str(POSITIVE), "--no-baseline", "--root",
+                 str(FIXTURES)]) == 1
+    assert main([str(NEGATIVE), "--no-baseline", "--root",
+                 str(FIXTURES)]) == 0
+    assert main([]) == 0  # whole package vs checked-in baseline
+    capsys.readouterr()
+
+
+def test_cli_baseline_allowlists_known_findings(tmp_path, capsys):
+    baseline = tmp_path / "baseline.json"
+    findings = run_analysis(paths=[POSITIVE], root=FIXTURES)
+    assert findings
+    write_baseline(findings, baseline)
+    assert main([str(POSITIVE), "--root", str(FIXTURES),
+                 "--baseline", str(baseline)]) == 0
+    out = capsys.readouterr().out
+    assert "0 new" in out
+
+
+def test_cli_rejects_unknown_rule():
+    with pytest.raises(ValueError):
+        main([str(POSITIVE), "--rule", "no-such-rule"])
+
+
+def test_baseline_keys_survive_line_shifts():
+    # keys are (rule, file, context, detail) — no line numbers, so edits
+    # elsewhere in a file never invalidate the allowlist
+    findings = run_analysis(paths=[POSITIVE], root=FIXTURES)
+    for f in findings:
+        assert not any(str(f.line) == part for part in f.key)
