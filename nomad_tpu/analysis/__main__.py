@@ -34,6 +34,9 @@ def main(argv=None) -> int:
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="machine-readable output")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--threads", action="store_true",
+                        help="dump every discovered thread entrypoint "
+                             "(Thread/Timer/executor-submit site) and exit")
     parser.add_argument("--root", type=Path, default=None,
                         help="root for relative paths (default: repo root)")
     args = parser.parse_args(argv)
@@ -41,6 +44,18 @@ def main(argv=None) -> int:
     if args.list_rules:
         for rule_id, (_, doc) in sorted(all_rules().items()):
             print(f"{rule_id}: {doc}")
+        return 0
+
+    if args.threads:
+        from .core import load_modules
+        from .rules_concurrency import discover_thread_sites
+        pkg_dir = Path(__file__).resolve().parent.parent
+        paths = args.paths or [pkg_dir]
+        root = args.root or pkg_dir.parent
+        sites = discover_thread_sites(load_modules(paths, root))
+        for s in sites:
+            print(f"{s.module_rel}:{s.lineno}: {s.factory} -> {s.target}")
+        print(f"{len(sites)} thread entrypoint site(s)")
         return 0
 
     findings = run_analysis(paths=args.paths or None, rules=args.rules,

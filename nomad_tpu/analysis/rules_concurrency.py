@@ -1,0 +1,685 @@
+"""nomadsan static prong: thread entrypoints, lock regions, two rules.
+
+`shared-mutation-unlocked` — the control plane is ~25 threaded modules
+whose objects are mutated from watcher loops, worker pools, timers and
+the caller's thread. Per class, this rule discovers every thread
+entrypoint (``threading.Thread(target=self.x)``, ``threading.Timer``,
+executor ``.submit``, thread-spawned closures), adds the public-method
+surface as one collective "api" root (public methods may be called from
+any thread), computes which methods each root reaches via self-calls,
+and flags any ``self.attr`` mutation site that (a) sits in a method
+reachable from >= 2 distinct roots of a class that actually runs
+threads and (b) holds no lock at the mutation site. Attributes bound to
+thread-safe primitives in ``__init__`` (locks, events, queues, deques)
+are exempt, as are ``__init__`` itself and methods following the
+``*_locked`` suffix convention (their callers own the lock).
+
+`lock-order-cycle` — the static generalization of PR 1's pairwise
+``lock-order`` rule: build the package-wide lock-acquisition-order
+graph (lock names qualified by class, so ``EvalBroker._lock`` and
+``PlanQueue._lock`` are distinct nodes), including interprocedural
+edges — a function holding L that calls ``g()`` points L at every lock
+``g`` transitively acquires — and flag every cycle as a deadlock
+candidate. Attribute-kind calls (``obj.m()``) are followed only when
+the name resolves uniquely in the tree; anything noisier is the runtime
+prong's job (sanitizer.py).
+
+False positives are suppressed in code with a ``# san-ok: <why>``
+comment on the flagged line (or the line above), never baselined — the
+justification lives next to the code it excuses.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import CallGraph, FuncInfo
+from .core import AnalysisContext, Finding, Module, rule
+
+SUPPRESS_TOKEN = "san-ok:"
+
+# attribute-call names that mutate the receiver container in place
+MUTATORS = {
+    "append", "appendleft", "add", "insert", "extend", "update",
+    "pop", "popitem", "popleft", "remove", "discard", "clear",
+    "setdefault", "sort", "reverse",
+}
+
+# constructors whose instances are internally synchronized: attributes
+# bound to these in __init__ are not "shared mutable state"
+THREADSAFE_CTORS = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+    "Event", "Barrier", "Queue", "LifoQueue", "PriorityQueue",
+    "SimpleQueue", "deque", "local",
+}
+
+# plain-container constructors: a mutator-method call (`self.x.add(...)`)
+# only counts as container mutation when __init__ binds the attribute to
+# one of these (or a display literal). Anything else — e.g.
+# `self.periodic = PeriodicDispatcher(...)` — is a delegated call to an
+# object that owns its own locking and is analyzed on its own.
+CONTAINER_CTORS = {
+    "dict", "list", "set", "defaultdict", "OrderedDict", "Counter",
+    "ChainMap",
+}
+
+LOCK_NAME_TOKENS = ("lock", "cond", "mutex", "sem")
+
+
+def _analysis_scope(mod: Module) -> bool:
+    """Everything in the package except the analyzer itself; fixture
+    trees (outside nomad_tpu) are always in scope so rules are testable
+    on standalone snippets."""
+    from pathlib import Path
+
+    parts = Path(mod.rel).parts
+    if "nomad_tpu" not in parts:
+        return True
+    i = parts.index("nomad_tpu")
+    return not (len(parts) > i + 1 and parts[i + 1] == "analysis")
+
+
+def _suppressed(mod: Module, lineno: int) -> bool:
+    lines = mod.source.splitlines()
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines) and SUPPRESS_TOKEN in lines[ln - 1]:
+            return True
+    return False
+
+
+def _dotted_parts(node: ast.expr) -> Optional[List[str]]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def _lockish(name: str) -> bool:
+    return any(tok in name.lower() for tok in LOCK_NAME_TOKENS)
+
+
+def _qualified_lock_name(expr: ast.expr, class_name: Optional[str]) -> str:
+    """Class-qualified dotted name of a lock-ish `with` context, or "".
+    `self._lock` in class C -> "C._lock" (distinct graph nodes per
+    class); bare/module locks keep their dotted spelling."""
+    parts = _dotted_parts(expr)
+    if not parts or not _lockish(parts[-1]):
+        return ""
+    if parts[0] == "self":
+        parts = parts[1:]
+        if class_name:
+            parts = [class_name] + parts
+    return ".".join(parts)
+
+
+# --------------------------------------------------------------------
+# thread-entrypoint discovery
+# --------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ThreadSite:
+    module_rel: str
+    lineno: int
+    factory: str                 # "Thread" | "Timer" | "submit"
+    target: str                  # source-ish description of the callable
+
+
+def _thread_target_expr(call: ast.Call) -> Optional[Tuple[str, ast.expr]]:
+    """(factory, target-callable expr) for thread-spawning calls."""
+    func = call.func
+    name = func.attr if isinstance(func, ast.Attribute) else (
+        func.id if isinstance(func, ast.Name) else "")
+    if name == "Thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return "Thread", kw.value
+        return None
+    if name == "Timer":
+        # Timer(interval, function, ...)
+        if len(call.args) >= 2:
+            return "Timer", call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "function":
+                return "Timer", kw.value
+        return None
+    if name == "submit" and isinstance(func, ast.Attribute) and call.args:
+        return "submit", call.args[0]
+    return None
+
+
+def discover_thread_sites(modules: List[Module]) -> List[ThreadSite]:
+    """Every Thread/Timer/executor-submit spawn site in the tree (the
+    pass `python -m nomad_tpu.analysis --threads` dumps)."""
+    sites: List[ThreadSite] = []
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = _thread_target_expr(node)
+            if hit is None:
+                continue
+            factory, target = hit
+            parts = _dotted_parts(target)
+            desc = ".".join(parts) if parts else (
+                "<lambda>" if isinstance(target, ast.Lambda) else
+                ast.unparse(target) if hasattr(ast, "unparse") else "<expr>")
+            sites.append(ThreadSite(mod.rel, node.lineno, factory, desc))
+    return sites
+
+
+# --------------------------------------------------------------------
+# shared-mutation-unlocked
+# --------------------------------------------------------------------
+
+@dataclass
+class _Mutation:
+    attr: str
+    kind: str        # "assign" | "subscript" | mutator method name
+    lineno: int
+    locked: bool     # any lock-named `with` encloses the site
+    method: str      # owning method name (or "method.closure")
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One pass over a method scope: self-call edges, self.attr
+    mutations with held-lock context, thread spawns. Nested defs that
+    are thread targets are excluded (they are their own root scope);
+    other closures stay attributed to the enclosing method (they may
+    run inline)."""
+
+    def __init__(self, skip_defs: Set[ast.AST]):
+        self.skip_defs = skip_defs
+        self.self_calls: Set[str] = set()
+        self.mutations: List[Tuple[str, str, int]] = []  # (attr, kind, line)
+        self.locked_lines: List[Tuple[int, int]] = []    # with-lock spans
+        self._lock_depth = 0
+        self.mutation_ctx: List[Tuple[str, str, int, bool]] = []
+
+    def visit_FunctionDef(self, node):
+        if node in self.skip_defs:
+            return
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node: ast.With):
+        lockish = any(
+            _lockish((_dotted_parts(item.context_expr) or ["?"])[-1])
+            for item in node.items
+            if _dotted_parts(item.context_expr))
+        for item in node.items:
+            self.visit(item.context_expr)
+        if lockish:
+            self._lock_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if lockish:
+            self._lock_depth -= 1
+
+    def _self_attr(self, expr: ast.expr) -> Optional[str]:
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            return expr.attr
+        return None
+
+    def _note(self, attr: str, kind: str, lineno: int):
+        self.mutation_ctx.append((attr, kind, lineno, self._lock_depth > 0))
+
+    def _check_target(self, target: ast.expr, lineno: int):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._check_target(elt, lineno)
+            return
+        attr = self._self_attr(target)
+        if attr is not None:
+            self._note(attr, "assign", lineno)
+            return
+        if isinstance(target, ast.Subscript):
+            attr = self._self_attr(target.value)
+            if attr is not None:
+                self._note(attr, "subscript", lineno)
+
+    def visit_Assign(self, node: ast.Assign):
+        for target in node.targets:
+            self._check_target(target, node.lineno)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._check_target(node.target, node.lineno)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._check_target(node.target, node.lineno)
+            self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete):
+        for target in node.targets:
+            if isinstance(target, ast.Subscript):
+                attr = self._self_attr(target.value)
+                if attr is not None:
+                    self._note(attr, "subscript", node.lineno)
+            elif (attr := self._self_attr(target)) is not None:
+                self._note(attr, "assign", node.lineno)
+
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in MUTATORS:
+                attr = self._self_attr(func.value)
+                if attr is not None:
+                    self._note(attr, func.attr, node.lineno)
+            if (isinstance(func.value, ast.Name)
+                    and func.value.id == "self"):
+                self.self_calls.add(func.attr)
+        self.generic_visit(node)
+
+
+def _init_attr_kinds(init_node: Optional[ast.AST]
+                     ) -> Tuple[Set[str], Set[str], Set[str]]:
+    """(threadsafe, container, other-call) attribute sets from __init__
+    assignments. Attrs never assigned in __init__ land in none of them
+    (treated as containers, over-approximately)."""
+    safe: Set[str] = set()
+    containers: Set[str] = set()
+    delegates: Set[str] = set()
+    if init_node is None:
+        return safe, containers, delegates
+    for node in ast.walk(init_node):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        attrs = [t.attr for t in node.targets
+                 if isinstance(t, ast.Attribute)
+                 and isinstance(t.value, ast.Name) and t.value.id == "self"]
+        if not attrs:
+            continue
+        if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                              ast.DictComp, ast.SetComp)):
+            containers.update(attrs)
+        elif isinstance(value, ast.Call):
+            parts = _dotted_parts(value.func)
+            ctor = parts[-1] if parts else ""
+            if ctor in THREADSAFE_CTORS:
+                safe.update(attrs)
+            elif ctor in CONTAINER_CTORS:
+                containers.update(attrs)
+            else:
+                delegates.update(attrs)
+    return safe, containers, delegates
+
+
+class _ClassModel:
+    def __init__(self, mod: Module, node: ast.ClassDef):
+        self.mod = mod
+        self.node = node
+        self.name = node.name
+        self.methods: Dict[str, ast.AST] = {
+            s.name: s for s in node.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        (self.safe_attrs, self.container_attrs,
+         self.delegate_attrs) = _init_attr_kinds(self.methods.get("__init__"))
+        # thread-target closures: nested def nodes spawned as threads
+        self.closure_roots: Dict[str, Tuple[str, ast.AST]] = {}
+        # method-name entrypoints via self.<m> targets inside this class
+        self.entry_methods: Set[str] = set()
+        self._discover_spawns()
+        self.scans: Dict[str, _MethodScan] = {}
+        skip = {node for _, node in self.closure_roots.values()}
+        for mname, mnode in self.methods.items():
+            scan = _MethodScan(skip)
+            for stmt in mnode.body:
+                scan.visit(stmt)
+            self.scans[mname] = scan
+        for rname, (owner, cnode) in self.closure_roots.items():
+            scan = _MethodScan(set())
+            for stmt in cnode.body:
+                scan.visit(stmt)
+            self.scans[rname] = scan
+
+    def _discover_spawns(self):
+        for mname, mnode in self.methods.items():
+            nested = {n.name: n for n in ast.walk(mnode)
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+                      and n is not mnode}
+            for node in ast.walk(mnode):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = _thread_target_expr(node)
+                if hit is None:
+                    continue
+                _, target = hit
+                parts = _dotted_parts(target)
+                if parts and parts[0] == "self" and len(parts) == 2:
+                    if parts[1] in self.methods:
+                        self.entry_methods.add(parts[1])
+                elif (isinstance(target, ast.Name)
+                      and target.id in nested):
+                    root = f"{mname}.{target.id}"
+                    self.closure_roots[root] = (mname, nested[target.id])
+
+    def roots(self) -> Dict[str, Set[str]]:
+        """root name -> set of scan keys (methods/closures) it reaches
+        via self-calls."""
+        out: Dict[str, Set[str]] = {}
+        # a public method that IS a thread entrypoint (e.g. Worker.run)
+        # is excluded from the collective api root: calling it directly
+        # while it also runs as the thread is a usage error, not a race
+        public = {m for m in self.methods
+                  if not m.startswith("_") and m != "__init__"
+                  and m not in self.entry_methods}
+
+        def reach(seed: Set[str]) -> Set[str]:
+            seen: Set[str] = set()
+            frontier = [s for s in seed if s in self.scans]
+            while frontier:
+                cur = frontier.pop()
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                for callee in self.scans[cur].self_calls:
+                    if callee in self.scans and callee not in seen:
+                        frontier.append(callee)
+            return seen
+
+        if public:
+            out["api"] = reach(public)
+        for m in self.entry_methods:
+            out[f"thread:{m}"] = reach({m})
+        for rname in self.closure_roots:
+            seen = reach({rname})
+            seen |= reach(self.scans[rname].self_calls)
+            seen.add(rname)
+            out[f"thread:{rname}"] = seen
+        return out
+
+
+@rule("shared-mutation-unlocked",
+      "self.attr mutation reachable from >=2 thread roots with no lock "
+      "held at the site")
+def check_shared_mutation_unlocked(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    models: List[_ClassModel] = []
+    # global pass: `target=obj.m` spawns outside the class mark every
+    # class owning method m as threaded via that entrypoint
+    attr_targets: Set[str] = set()
+    modules = [m for m in ctx.modules if _analysis_scope(m)]
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                hit = _thread_target_expr(node)
+                if hit is None:
+                    continue
+                parts = _dotted_parts(hit[1])
+                if parts and parts[0] != "self" and len(parts) >= 2:
+                    attr_targets.add(parts[-1])
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                models.append(_ClassModel(mod, node))
+    for model in models:
+        for mname in list(model.methods):
+            if mname in attr_targets and mname != "__init__":
+                model.entry_methods.add(mname)
+    for model in models:
+        if not model.entry_methods and not model.closure_roots:
+            continue  # class runs no threads of its own
+        roots = model.roots()
+        if len(roots) < 2:
+            continue
+        # attr -> roots that reach a mutation of it
+        attr_roots: Dict[str, Set[str]] = {}
+        for rname, reached in roots.items():
+            for scan_key in reached:
+                for attr, kind, lineno, locked in (
+                        model.scans[scan_key].mutation_ctx):
+                    attr_roots.setdefault(attr, set()).add(rname)
+        per_ctx: Dict[str, int] = {}
+        for scan_key, scan in sorted(model.scans.items()):
+            if scan_key == "__init__" or scan_key.endswith("_locked"):
+                continue
+            reaching = {r for r, reached in roots.items()
+                        if scan_key in reached}
+            if not reaching:
+                continue
+            for attr, kind, lineno, locked in scan.mutation_ctx:
+                if locked or attr in model.safe_attrs or _lockish(attr):
+                    continue
+                if kind in MUTATORS and attr in model.delegate_attrs:
+                    continue  # delegated call; the callee class locks
+                if len(attr_roots.get(attr, ())) < 2:
+                    continue
+                if _suppressed(model.mod, lineno):
+                    continue
+                context = (f"{model.mod.rel}:"
+                           f"{model.name}.{scan_key}")
+                ordinal = per_ctx.get(f"{context}:{attr}", 0)
+                per_ctx[f"{context}:{attr}"] = ordinal + 1
+                findings.append(Finding(
+                    rule="shared-mutation-unlocked",
+                    path=model.mod.rel, line=lineno, severity="error",
+                    message=(f"'self.{attr}' mutated ({kind}) with no "
+                             f"lock held; reachable from threads "
+                             f"{sorted(attr_roots[attr])} — hold the "
+                             "object's lock or make the field "
+                             "thread-confined"),
+                    context=context,
+                    detail=f"{attr}:{ordinal}"))
+    return findings
+
+
+# --------------------------------------------------------------------
+# lock-order-cycle
+# --------------------------------------------------------------------
+
+class _LockOrderScan(ast.NodeVisitor):
+    """Per-scope: nested with-lock pairs, direct acquisitions, and call
+    sites annotated with the locks held there. Nested defs are separate
+    scopes (they run later, outside the enclosing `with`)."""
+
+    def __init__(self, class_name: Optional[str], root: ast.AST):
+        self.class_name = class_name
+        self.root = root
+        self.stack: List[str] = []
+        self.acquires: Dict[str, int] = {}       # lock -> first line
+        self.pairs: List[Tuple[str, str, int]] = []
+        self.calls: List[Tuple[str, str, Tuple[str, ...], int]] = []
+
+    def visit_FunctionDef(self, node):
+        if node is not self.root:
+            return
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node: ast.With):
+        acquired = []
+        for item in node.items:
+            name = _qualified_lock_name(item.context_expr, self.class_name)
+            self.visit(item.context_expr)
+            if name:
+                self.acquires.setdefault(name, node.lineno)
+                for outer in self.stack + acquired:
+                    if outer != name:
+                        self.pairs.append((outer, name, node.lineno))
+                acquired.append(name)
+        self.stack.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        if acquired:
+            del self.stack[-len(acquired):]
+
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        if isinstance(func, ast.Name):
+            self.calls.append(("name", func.id, tuple(self.stack),
+                               node.lineno))
+        elif isinstance(func, ast.Attribute):
+            kind = ("self" if isinstance(func.value, ast.Name)
+                    and func.value.id == "self" else "attr")
+            self.calls.append((kind, func.attr, tuple(self.stack),
+                               node.lineno))
+        self.generic_visit(node)
+
+
+def _scopes_for(fn: FuncInfo) -> List[ast.AST]:
+    """The function node plus each nested def, as separate scopes."""
+    out = [fn.node]
+    for node in ast.walk(fn.node):
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node is not fn.node):
+            out.append(node)
+    return out
+
+
+@rule("lock-order-cycle",
+      "the package-wide static lock-acquisition-order graph must be "
+      "acyclic (cycles are deadlock candidates)")
+def check_lock_order_cycle(ctx: AnalysisContext) -> List[Finding]:
+    modules = [m for m in ctx.modules if _analysis_scope(m)]
+    cg = CallGraph(modules)
+    by_rel: Dict[str, Module] = {m.rel: m for m in modules}
+
+    scans: Dict[FuncInfo, List[_LockOrderScan]] = {}
+    for fn in cg.functions:
+        fn_scans = []
+        for scope in _scopes_for(fn):
+            scan = _LockOrderScan(fn.class_name, scope)
+            scan.visit(scope)
+            fn_scans.append(scan)
+        scans[fn] = fn_scans
+
+    def _callees(fn: FuncInfo, kind: str, name: str) -> List[FuncInfo]:
+        cands = cg.resolve(fn, kind, name)
+        if kind == "attr" and len(cands) > 1:
+            return []  # ambiguous cross-object call: runtime prong's job
+        return cands
+
+    # transitive may-acquire sets, to fixpoint
+    acq: Dict[FuncInfo, Set[str]] = {
+        fn: set().union(*(s.acquires for s in fn_scans)) if fn_scans
+        else set()
+        for fn, fn_scans in scans.items()}
+    changed = True
+    while changed:
+        changed = False
+        for fn, fn_scans in scans.items():
+            cur = acq[fn]
+            before = len(cur)
+            for scan in fn_scans:
+                for kind, name, _, _ in scan.calls:
+                    for callee in _callees(fn, kind, name):
+                        cur |= acq.get(callee, set())
+            if len(cur) != before:
+                changed = True
+
+    # edges: (outer, inner) -> (module rel, context, line)
+    edges: Dict[Tuple[str, str], Tuple[str, str, int]] = {}
+
+    def _edge(outer: str, inner: str, fn: FuncInfo, line: int):
+        if outer == inner:
+            return
+        key = (outer, inner)
+        if key not in edges:
+            edges[key] = (fn.module_rel, f"{fn.module_rel}:{fn.qualname}",
+                          line)
+
+    for fn, fn_scans in scans.items():
+        for scan in fn_scans:
+            for outer, inner, line in scan.pairs:
+                _edge(outer, inner, fn, line)
+            for kind, name, held, line in scan.calls:
+                if not held:
+                    continue
+                for callee in _callees(fn, kind, name):
+                    for inner in acq.get(callee, ()):
+                        for outer in held:
+                            _edge(outer, inner, fn, line)
+
+    # Tarjan SCC over the lock graph
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str):
+        # iterative Tarjan (the lock graph is small, but no recursion
+        # limits in a lint pass)
+        work = [(v, iter(sorted(graph[v])))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+
+    findings: List[Finding] = []
+    for scc in sorted(sccs):
+        members = set(scc)
+        sites = sorted(
+            f"{ctxt} (line {line}): {a} -> {b}"
+            for (a, b), (_, ctxt, line) in edges.items()
+            if a in members and b in members)
+        rel, ctxt, line = min(
+            (edges[(a, b)] for (a, b) in edges
+             if a in members and b in members),
+            key=lambda t: (t[0], t[2]))
+        mod = by_rel.get(rel)
+        if mod is not None and _suppressed(mod, line):
+            continue
+        findings.append(Finding(
+            rule="lock-order-cycle", path=rel, line=line,
+            severity="error",
+            message=("lock-acquisition-order cycle "
+                     f"{' -> '.join(scc + [scc[0]])} — deadlock "
+                     "candidate; edges: " + "; ".join(sites[:4])
+                     + ("; ..." if len(sites) > 4 else "")),
+            context=ctxt,
+            detail="|".join(scc)))
+    return findings

@@ -1,0 +1,383 @@
+"""nomadsan runtime prong: instrumented locks + Eraser-style lockset.
+
+The static rules (rules_concurrency.py) reason about names; this module
+watches the real interleavings. Enabled via ``NOMAD_TPU_SAN=1`` (the
+pytest plugin in tests/conftest.py calls :func:`install` before any
+nomad_tpu module is imported), it
+
+- wraps ``threading.Lock``/``threading.RLock`` construction so every
+  lock records per-thread acquisition order into a global lock-order
+  graph; acquiring B while holding A when a B->..->A path already exists
+  anywhere in the run is a potential-deadlock *inversion* and is
+  recorded as a violation (the dynamic analogue of the static
+  ``lock-order-cycle`` rule — it needs no unlucky interleaving, only
+  that both orders ever happen);
+- implements an Eraser-style lockset checker (Savage et al. '97) for
+  objects whose classes opt in via the :func:`sanitized` decorator
+  (StateStore, EvalBroker, PlanQueue, DeploymentWatcher): each field
+  starts *exclusive* to its first-writing thread; on the first write
+  from a second thread it turns *shared* and its candidate lockset is
+  initialized to the locks that thread holds; every later write
+  intersects the candidate set with the writer's held locks, and an
+  empty set means two threads mutate the field with no common lock —
+  a write/write race — recorded as a violation.
+
+Known soundness limits (documented, deliberate):
+
+- only attribute REBINDS are seen (``self.x = ...`` through the wrapped
+  ``__setattr__``); interior container mutation (``self.d[k] = v``) is
+  invisible — the static ``shared-mutation-unlocked`` rule covers those
+  sites by name;
+- reads are not tracked (read/write races need ``__getattribute__``
+  interception, which is far outside the <2x overhead budget);
+- the lockset state machine ignores happens-before edges other than
+  "same thread", so a field handed off through a join/queue can be a
+  false positive — suppress per-field with ``_nomadsan_exempt``.
+
+Violations never raise at the access site (raising inside an arbitrary
+``acquire`` would corrupt the program under test); they accumulate in
+``Sanitizer.violations`` and the pytest plugin fails the run at session
+end. Tests can build private :class:`Sanitizer` instances so assertions
+don't pollute the global run state.
+"""
+
+from __future__ import annotations
+
+import _thread
+import itertools
+import sys
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+_REAL_LOCK = _thread.allocate_lock     # un-patchable originals
+_REAL_RLOCK = threading.RLock
+
+_SKIP_FILES = (__file__, "threading.py", "queue.py")
+
+
+def _call_site(extra_skip: int = 0) -> str:
+    """file:line of the nearest frame outside sanitizer/threading."""
+    f = sys._getframe(2 + extra_skip)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.endswith(_SKIP_FILES):
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+@dataclass
+class Violation:
+    kind: str            # "lock-order-inversion" | "lockset"
+    message: str
+    stack: List[str] = field(default_factory=list)
+
+    def render(self) -> str:
+        return f"[{self.kind}] {self.message}"
+
+
+class Sanitizer:
+    """One lock-order graph + lockset state space. The module-level
+    GLOBAL instance is what install()/the @sanitized decorator feed;
+    tests may build private instances."""
+
+    def __init__(self):
+        self.active = False
+        # internal bookkeeping lock MUST be a raw lock: an instrumented
+        # one would recurse into this class
+        self._ilock = _REAL_LOCK()
+        self._tls = threading.local()
+        self._serials = itertools.count(1)
+        self._labels: Dict[int, str] = {}            # serial -> creation site
+        self._adj: Dict[int, Set[int]] = {}          # serial -> acquired-after set
+        self._edge_sites: Dict[Tuple[int, int], str] = {}
+        self._inversions_seen: Set[frozenset] = set()
+        self._lockset_seen: Set[Tuple[str, str]] = set()
+        self.violations: List[Violation] = []
+
+    # -- lock factories ------------------------------------------------
+
+    def Lock(self):
+        return _SanLock(self, _REAL_LOCK())
+
+    def RLock(self):
+        return _SanRLock(self, _REAL_RLOCK())
+
+    # -- global patching ----------------------------------------------
+
+    def install(self) -> None:
+        """Patch threading.Lock/RLock so every lock created from here on
+        (including queue.Queue mutexes and Condition/Event internals,
+        which look the factories up at call time) is instrumented."""
+        if self.active:
+            return
+        self.active = True
+        threading.Lock = self.Lock          # type: ignore[assignment]
+        threading.RLock = self.RLock        # type: ignore[assignment]
+
+    def uninstall(self) -> None:
+        if not self.active:
+            return
+        self.active = False
+        threading.Lock = _thread.allocate_lock  # type: ignore[assignment]
+        threading.RLock = _REAL_RLOCK           # type: ignore[assignment]
+
+    # -- per-thread held stack ----------------------------------------
+
+    def _held(self) -> List[int]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def held_serials(self) -> List[int]:
+        """Introspection for tests: serials this thread currently holds."""
+        return list(self._held())
+
+    def _note_acquire(self, serial: int) -> None:
+        held = self._held()
+        if serial in held:          # reentrant RLock re-acquire: no edges
+            held.append(serial)
+            return
+        for outer in held:
+            self._add_edge(outer, serial)
+        held.append(serial)
+
+    def _note_release(self, serial: int) -> None:
+        held = self._held()
+        # release the most recent acquisition (tolerates Condition
+        # protocol asymmetries)
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == serial:
+                del held[i]
+                return
+
+    def _note_release_all(self, serial: int) -> None:
+        """Condition.wait fully releases an RLock regardless of depth."""
+        self._tls.held = [s for s in self._held() if s != serial]
+
+    # -- lock-order graph ---------------------------------------------
+
+    def _add_edge(self, a: int, b: int) -> None:
+        with self._ilock:
+            succ = self._adj.setdefault(a, set())
+            if b in succ:
+                return
+            succ.add(b)
+            site = _call_site(1)
+            self._edge_sites[(a, b)] = site
+            # new edge a->b: a cycle exists iff a is reachable from b
+            path = self._find_path(b, a)
+        if path is not None:
+            self._report_inversion(a, b, site, path)
+
+    def _find_path(self, src: int, dst: int) -> Optional[List[int]]:
+        """DFS under _ilock; returns node path src..dst or None."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self._adj.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def _report_inversion(self, a: int, b: int, site: str,
+                          path: List[int]) -> None:
+        key = frozenset((a, b))
+        with self._ilock:
+            if key in self._inversions_seen:
+                return
+            self._inversions_seen.add(key)
+            cycle = [self._label(b)] + [self._label(n) for n in path[1:]]
+            other = self._edge_sites.get((path[0], path[1] if len(path) > 1
+                                          else a), "?")
+            v = Violation(
+                "lock-order-inversion",
+                f"acquired {self._label(b)} while holding {self._label(a)} "
+                f"at {site}, but the reverse order exists "
+                f"(cycle: {' -> '.join(cycle + [cycle[0]])}; "
+                f"first reverse edge at {other})",
+                stack=traceback.format_stack()[:-3])
+            self.violations.append(v)
+
+    def _label(self, serial: int) -> str:
+        return f"lock#{serial}@{self._labels.get(serial, '?')}"
+
+    # -- Eraser lockset ------------------------------------------------
+
+    def sanitized(self, cls):
+        """Class decorator: route attribute rebinds through the lockset
+        state machine. Near-zero cost while inactive (one flag test)."""
+        orig_setattr = cls.__setattr__
+        san = self
+
+        def __setattr__(obj, name, value):
+            if san.active and not name.startswith("_nomadsan"):
+                san._record_write(obj, name)
+            orig_setattr(obj, name, value)
+
+        cls.__setattr__ = __setattr__
+        cls._nomadsan_watched = True
+        return cls
+
+    def _record_write(self, obj, name: str) -> None:
+        if name in getattr(obj, "_nomadsan_exempt", ()):
+            return
+        tid = _thread.get_ident()
+        held = frozenset(self._held())
+        with self._ilock:
+            try:
+                fields = object.__getattribute__(obj, "_nomadsan_fields")
+            except AttributeError:
+                fields = {}
+                object.__setattr__(obj, "_nomadsan_fields", fields)
+            st = fields.get(name)
+            if st is None:
+                fields[name] = {"tid": tid, "lockset": None}
+                return
+            if st["lockset"] is None:       # exclusive phase
+                if st["tid"] == tid:
+                    return
+                st["lockset"] = set(held)   # first shared write
+            else:
+                st["lockset"] &= held
+            if st["lockset"]:
+                return
+            key = (type(obj).__name__, name)
+            if key in self._lockset_seen:
+                return
+            self._lockset_seen.add(key)
+            v = Violation(
+                "lockset",
+                f"{key[0]}.{name} is written by multiple threads with no "
+                f"common lock held (second writer at {_call_site(1)}) — "
+                "write/write race",
+                stack=traceback.format_stack()[:-3])
+        self.violations.append(v)
+
+    # -- reporting -----------------------------------------------------
+
+    def check(self) -> None:
+        """Raise if any violation was recorded (stress tests call this
+        directly; the pytest plugin prefers a session-end report)."""
+        if self.violations:
+            raise AssertionError(
+                "nomadsan violations:\n"
+                + "\n".join(v.render() for v in self.violations))
+
+    def report(self) -> str:
+        lines = [f"nomadsan: {len(self.violations)} violation(s)"]
+        for v in self.violations:
+            lines.append("  " + v.render())
+        return "\n".join(lines)
+
+
+class _SanLockBase:
+    """Shared instrumentation shell. Everything not overridden delegates
+    to the real lock, so Condition's duck probes keep working."""
+
+    _reentrant = False
+
+    def __init__(self, san: Sanitizer, inner):
+        self._san = san
+        self._inner = inner
+        self._serial = next(san._serials)
+        san._labels[self._serial] = _call_site()
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._san._note_acquire(self._serial)
+        return ok
+
+    def release(self):
+        self._inner.release()
+        self._san._note_release(self._serial)
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def _at_fork_reinit(self):
+        # os.fork/register_at_fork protocol (concurrent.futures.thread
+        # registers its shutdown lock); the child starts with one thread
+        self._inner._at_fork_reinit()
+
+    def __repr__(self):
+        return (f"<nomadsan {type(self).__name__} #{self._serial} "
+                f"wrapping {self._inner!r}>")
+
+
+class _SanLock(_SanLockBase):
+    pass
+
+
+class _SanRLock(_SanLockBase):
+    """Instrumented RLock, including the private Condition protocol
+    (_release_save/_acquire_restore/_is_owned) so ``Condition(rlock)``
+    and ``Condition()`` both stay correct: wait() releases the lock for
+    real, and the held-stack must reflect that or every post-wait
+    acquisition would record phantom edges."""
+
+    _reentrant = True
+
+    def _release_save(self):
+        self._san._note_release_all(self._serial)
+        return self._inner._release_save()
+
+    def _acquire_restore(self, state):
+        self._inner._acquire_restore(state)
+        self._san._note_acquire(self._serial)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def locked(self):
+        # 3.12 RLock.locked(); fall back to ownership probe on older runtimes
+        probe = getattr(self._inner, "locked", None)
+        if probe is not None:
+            return probe()
+        return self._inner._is_owned()
+
+
+# -- module-level surface (what production code + conftest import) ------
+
+GLOBAL = Sanitizer()
+
+
+def install() -> None:
+    GLOBAL.install()
+
+
+def uninstall() -> None:
+    GLOBAL.uninstall()
+
+
+def enabled() -> bool:
+    return GLOBAL.active
+
+
+def sanitized(cls):
+    """Opt a class into the global lockset checker. Applied to the
+    control plane's shared-state owners (StateStore, EvalBroker,
+    PlanQueue, DeploymentWatcher); inert unless install() ran."""
+    return GLOBAL.sanitized(cls)
+
+
+def violations() -> List[Violation]:
+    return list(GLOBAL.violations)
+
+
+def check() -> None:
+    GLOBAL.check()

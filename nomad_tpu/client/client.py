@@ -155,6 +155,12 @@ class Client:
             t.join(timeout=2.0)
         for r in list(self.runners.values()):
             r.stop()
+        # full stop kills the tasks, so cached image extractions have
+        # no remaining users (shutdown() below leaves tasks running and
+        # must NOT evict)
+        from .drivers import ContainerDriver
+
+        ContainerDriver.evict_image_cache()
 
     def shutdown(self) -> None:
         """Stop the agent threads but LEAVE TASKS RUNNING (the reference

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import signal
 import subprocess
 import tempfile
@@ -558,7 +559,10 @@ class ContainerDriver(ExecDriver):
 
     name = "container"
 
-    _image_cache: Dict[tuple, str] = {}
+    # realpath -> (mtime, extraction dir): one live extraction per
+    # image file; a rebuild at the same path (new mtime) supersedes and
+    # evicts the old one instead of leaking a full rootfs in tmp
+    _image_cache: Dict[str, tuple] = {}
     _image_lock = threading.Lock()
 
     def start_task(self, task, env: Dict[str, str], task_dir: str,
@@ -579,13 +583,14 @@ class ContainerDriver(ExecDriver):
         if not os.path.isfile(image):
             raise DriverError(f"container image {image!r} not found")
         try:
-            key = (os.path.realpath(image), os.path.getmtime(image))
+            path = os.path.realpath(image)
+            mtime = os.path.getmtime(image)
         except OSError as e:
             raise DriverError(f"container image {image!r}: {e}") from e
         with self._image_lock:
-            cached = self._image_cache.get(key)
-            if cached and os.path.isdir(cached):
-                return cached
+            cached = self._image_cache.get(path)
+            if cached and cached[0] == mtime and os.path.isdir(cached[1]):
+                return cached[1]
             import tarfile
 
             dst = tempfile.mkdtemp(prefix="nomadtpu-img-")
@@ -593,10 +598,23 @@ class ContainerDriver(ExecDriver):
                 with tarfile.open(image) as tar:
                     tar.extractall(dst, filter="data")
             except Exception as e:
+                shutil.rmtree(dst, ignore_errors=True)
                 raise DriverError(
                     f"container image {image!r} extract failed: {e}") from e
-            self._image_cache[key] = dst
+            if cached is not None:
+                shutil.rmtree(cached[1], ignore_errors=True)
+            self._image_cache[path] = (mtime, dst)
             return dst
+
+    @classmethod
+    def evict_image_cache(cls) -> None:
+        """Drop every cached extraction (agent shutdown; also keeps
+        long test runs from accumulating rootfs copies in tmp)."""
+        with cls._image_lock:
+            entries = list(cls._image_cache.values())
+            cls._image_cache = {}
+        for _mtime, dst in entries:
+            shutil.rmtree(dst, ignore_errors=True)
 
 
 def _copy_task_with_config(task, config: dict):

@@ -60,6 +60,12 @@ class TaskRunner:
         self._dead = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._restart_times: list = []  # timestamps inside current interval
+        # plugin registration races the run thread: the socket-wait
+        # thread registers while the run thread may already be
+        # deregistering a fast-exiting task
+        self._plugin_lock = threading.Lock()
+        self._plugin_sock: Optional[str] = None
+        self._plugin_registered = None
 
     # -- lifecycle --
 
@@ -104,11 +110,12 @@ class TaskRunner:
                     from ..plugins.protocol import SOCKET_ENV
                     from .dynamicplugins import SOCKET_NAME
 
-                    if getattr(self, "_plugin_sock", None) is None:
-                        self._plugin_sock = os.path.join(
-                            tempfile.mkdtemp(prefix="nomadtpu-dp-"),
-                            SOCKET_NAME)
-                    env[SOCKET_ENV] = self._plugin_sock
+                    with self._plugin_lock:
+                        if self._plugin_sock is None:
+                            self._plugin_sock = os.path.join(
+                                tempfile.mkdtemp(prefix="nomadtpu-dp-"),
+                                SOCKET_NAME)
+                        env[SOCKET_ENV] = self._plugin_sock
                 for vname, vpath in self.volume_mounts.items():
                     safe = "".join(c if c.isalnum() else "_"
                                    for c in vname).upper()
@@ -184,11 +191,14 @@ class TaskRunner:
             deadline = time.time() + 60.0
             while time.time() < deadline and not self._killed.is_set():
                 if sock and os.path.exists(sock):
-                    REGISTRY.register(
-                        ptype, pid, self.alloc.id, sock,
-                        is_alive=lambda: (handle is not None
-                                          and handle.is_running()))
-                    self._plugin_registered = (ptype, pid)
+                    with self._plugin_lock:
+                        if self._plugin_sock != sock:
+                            return  # task already deregistered/cleaned up
+                        REGISTRY.register(
+                            ptype, pid, self.alloc.id, sock,
+                            is_alive=lambda: (handle is not None
+                                              and handle.is_running()))
+                        self._plugin_registered = (ptype, pid)
                     return
                 time.sleep(0.1)
 
@@ -196,18 +206,17 @@ class TaskRunner:
                          name=f"plugin-wait-{self.task.name}").start()
 
     def _deregister_plugin(self) -> None:
-        reg = getattr(self, "_plugin_registered", None)
+        with self._plugin_lock:
+            reg, self._plugin_registered = self._plugin_registered, None
+            sock, self._plugin_sock = self._plugin_sock, None
         if reg is not None:
             from .dynamicplugins import REGISTRY
 
             REGISTRY.deregister(reg[0], reg[1], self.alloc.id)
-            self._plugin_registered = None
-        sock = getattr(self, "_plugin_sock", None)
         if sock is not None:
             import shutil
 
             shutil.rmtree(os.path.dirname(sock), ignore_errors=True)
-            self._plugin_sock = None
 
     def _logmon(self):
         """Rotated stdout/stderr capture per start attempt (reference

@@ -30,6 +30,10 @@ class WIDMgr:
         self.logger = logger
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # guards the renewal bookkeeping below: run_initial (alloc-runner
+        # thread) and the renewal loop both write it, and the manager
+        # doesn't forbid a forced run_initial while the loop is live
+        self._lock = threading.Lock()
         # task -> (written_at, expiry) of the currently-written token;
         # renewal is due at the half-life
         self._exp: Dict[str, tuple] = {}
@@ -73,19 +77,21 @@ class WIDMgr:
     def _run(self) -> None:
         while not self._stop.is_set():
             now = time.time()
-            if self._exp:
-                next_due = min(self._due(e) for e in self._exp.values())
-            else:
-                next_due = now + MIN_RENEW_WAIT
+            with self._lock:
+                if self._exp:
+                    next_due = min(self._due(e) for e in self._exp.values())
+                else:
+                    next_due = now + MIN_RENEW_WAIT
             if self._stop.wait(max(MIN_RENEW_WAIT, next_due - now)):
                 return
             now = time.time()
             for task in self.task_names:
-                if task in self._dead:
+                with self._lock:
+                    skip = (task in self._dead
+                            or now < self._retry_at.get(task, 0.0))
+                    entry = self._exp.get(task)
+                if skip:
                     continue
-                if now < self._retry_at.get(task, 0.0):
-                    continue
-                entry = self._exp.get(task)
                 if entry is None or now >= self._due(entry):
                     self._renew_one(task)
 
@@ -95,18 +101,21 @@ class WIDMgr:
         except PermissionError:
             # terminal alloc server-side: no identity will ever be
             # minted again — stop asking
-            self._dead.add(task)
+            with self._lock:
+                self._dead.add(task)
             return False
         except Exception:
             if self.logger:
                 self.logger.debug("identity renewal failed for %s/%s",
                                   self.alloc.id[:8], task)
-            n = self._fails.get(task, 0) + 1
-            self._fails[task] = n
-            self._retry_at[task] = time.time() + min(2.0 ** n, 60.0)
+            with self._lock:
+                n = self._fails.get(task, 0) + 1
+                self._fails[task] = n
+                self._retry_at[task] = time.time() + min(2.0 ** n, 60.0)
             return False
-        self._fails.pop(task, None)
-        self._retry_at.pop(task, None)
+        with self._lock:
+            self._fails.pop(task, None)
+            self._retry_at.pop(task, None)
         token, exp = out["token"], float(out["exp"])
         td = self.task_dir_fn(task)
         secrets = os.path.join(td, "secrets")
@@ -119,5 +128,6 @@ class WIDMgr:
             os.replace(tmp, os.path.join(secrets, TOKEN_FILE))
         except OSError:
             return False
-        self._exp[task] = (time.time(), exp)
+        with self._lock:
+            self._exp[task] = (time.time(), exp)
         return True

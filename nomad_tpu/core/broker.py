@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..analysis.sanitizer import sanitized
 from ..structs import enums
 from ..structs.evaluation import Evaluation
 from ..utils import generate_secret_uuid
@@ -37,6 +38,7 @@ DEFAULT_NACK_TIMEOUT = 60.0
 DEFAULT_DELIVERY_LIMIT = 3
 
 
+@sanitized
 class EvalBroker:
     def __init__(self, nack_timeout: float = DEFAULT_NACK_TIMEOUT,
                  delivery_limit: int = DEFAULT_DELIVERY_LIMIT):

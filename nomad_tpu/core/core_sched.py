@@ -32,6 +32,10 @@ class CoreScheduler:
         self.node_gc_threshold = node_gc_threshold
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # serializes whole GC passes: `nomad system gc` (API thread) vs
+        # the timer thread — overlapping passes double-count stats and
+        # double-delete the same candidates
+        self._gc_lock = threading.Lock()
         self.stats = {"evals": 0, "allocs": 0, "jobs": 0, "deployments": 0,
                       "nodes": 0, "rows_compacted": 0}
 
@@ -58,6 +62,10 @@ class CoreScheduler:
         """Run every collector now (reference `nomad system gc` /
         CoreJobForceGC). threshold_override=0 collects everything
         terminal regardless of age."""
+        with self._gc_lock:
+            return self._force_gc_locked(threshold_override)
+
+    def _force_gc_locked(self, threshold_override: Optional[float] = None) -> dict:
         now = time.time()
         et = self.eval_gc_threshold if threshold_override is None else threshold_override
         jt = self.job_gc_threshold if threshold_override is None else threshold_override

@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..analysis.sanitizer import sanitized
 from ..structs import enums
 from ..structs.evaluation import Evaluation
 from ..utils import generate_uuid
@@ -60,16 +61,37 @@ def alloc_healthy(alloc, job, now: float) -> bool:
     return True
 
 
+@sanitized
 class DeploymentWatcher:
+    # store commits that can change a deployment's health verdict; any
+    # of these wakes the loop immediately instead of waiting out the
+    # poll interval (reference deploymentwatcher blocks on state
+    # changes via blocking queries, not timers)
+    _WAKE_EVENTS = frozenset((
+        "alloc-upsert", "alloc-client-update", "alloc-stop",
+        "deployment-upsert", "job-upsert"))
+
     def __init__(self, server, interval: float = 0.2):
         self.server = server
         self.interval = interval
         self._stop = threading.Event()
+        self._wake = threading.Event()
         self._thread = None
         # deployment id -> healthy count at last follow-up eval
         self._progress: Dict[str, int] = {}
         self.stats = {"succeeded": 0, "failed": 0, "reverted": 0,
                       "auto_promoted": 0}
+        # event-driven ticks: alloc-health commits wake the loop, so
+        # fail/revert reacts to the triggering write, deterministically,
+        # even when a loaded suite starves the poll cadence. Setting an
+        # Event from the commit path cannot deadlock the applier (cf.
+        # the commit-pump note in server.py — this listener never
+        # re-enters the store).
+        server.store.add_commit_listener(self._on_commit)
+
+    def _on_commit(self, index: int, events: list) -> None:
+        if any(kind in self._WAKE_EVENTS for kind, _ in events):
+            self._wake.set()
 
     def start(self) -> None:
         self._stop.clear()
@@ -79,11 +101,18 @@ class DeploymentWatcher:
 
     def stop(self) -> None:
         self._stop.set()
+        self._wake.set()  # unblock the wait promptly
         if self._thread is not None:
             self._thread.join(timeout=2.0)
 
     def _run(self) -> None:
-        while not self._stop.wait(self.interval):
+        while not self._stop.is_set():
+            # the interval is now only the deadline-polling floor
+            # (progress/min_healthy deadlines still need wall time)
+            if self._wake.wait(self.interval):
+                self._wake.clear()
+            if self._stop.is_set():
+                return
             try:
                 self._tick()
             except Exception:
@@ -223,9 +252,13 @@ class DeploymentWatcher:
             return
         reverted = _copy.copy(prior)
         reverted.stop = False
+        # count BEFORE the store write: the version bump is the
+        # externally-observable revert signal, and observers (tests,
+        # metrics scrapes) must never see the new version with a stale
+        # counter
+        self.stats["reverted"] += 1
         self.server.store.upsert_job(reverted)  # becomes the next version
         self._create_eval(reverted)
-        self.stats["reverted"] += 1
 
     def _update_status(self, dep, status: str, desc: str) -> None:
         upd = _copy.copy(dep)

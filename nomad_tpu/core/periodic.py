@@ -141,13 +141,17 @@ class PeriodicDispatcher:
                 live = [a for a in snap.allocs_by_job(other.id, other.namespace)
                         if not a.terminal_status() and not a.server_terminal()]
                 if live:
-                    self.stats["skipped_overlap"] += 1
+                    with self._lock:
+                        self.stats["skipped_overlap"] += 1
                     return None
         child = _copy.copy(job)
         child.id = f"{job.id}{PERIODIC_LAUNCH_SUFFIX}{int(launch_time)}"
         child.name = child.id
         child.periodic = None
         child.parent_id = job.id
-        self.stats["launched"] += 1
+        # counter only under the lock; register_job re-enters add() which
+        # takes self._lock itself, so it must run outside the scope
+        with self._lock:
+            self.stats["launched"] += 1
         self.server.register_job(child)
         return child.id

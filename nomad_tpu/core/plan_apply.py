@@ -35,6 +35,7 @@ import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
+from ..analysis.sanitizer import sanitized
 from ..structs import allocs_fit, enums
 from ..structs.plan import Plan, PlanResult
 
@@ -63,6 +64,7 @@ class PendingPlan:
         return self.result
 
 
+@sanitized
 class PlanQueue:
     """Priority queue of pending plans (reference plan_queue.go)."""
 
@@ -260,6 +262,10 @@ class PlanApplier:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.stats = {"applied": 0, "nodes_rejected": 0, "partial_commits": 0}
+        # commits are serialized through the 1-worker commit pool, but
+        # the synchronous apply() entrypoint can run concurrently with
+        # the loop; counters get their own leaf lock
+        self._stats_lock = threading.Lock()
         # reference plan_apply_pool.go: half the cores
         self.pool_workers = pool_workers or max(2, (os.cpu_count() or 2) // 2)
         self._pool: Optional[ThreadPoolExecutor] = None
@@ -426,12 +432,14 @@ class PlanApplier:
 
         from .metrics import REGISTRY
 
-        self.stats["applied"] += 1
+        with self._stats_lock:
+            self.stats["applied"] += 1
+            if rejected:
+                self.stats["nodes_rejected"] += len(rejected)
+                self.stats["partial_commits"] += 1
         REGISTRY.incr("nomad.plan.submit")
         if rejected:
-            self.stats["nodes_rejected"] += len(rejected)
             REGISTRY.incr("nomad.plan.node_rejected", len(rejected))
-            self.stats["partial_commits"] += 1
             result.refresh_index = self.store.latest_index
             result.rejected_nodes = rejected
         # post-apply hooks run HERE, synchronously with the commit (not
@@ -532,6 +540,7 @@ class PlanApplier:
                 # the node's health (reference evaluateNodePlan-only
                 # accounting, plan_apply_node_tracker.go)
                 if not ok:
+                    # san-ok: BadNodeTracker.add locks internally
                     self.bad_nodes.add(node_id)
         if rejected and plan.all_at_once:
             # all-or-nothing plan: reject everything

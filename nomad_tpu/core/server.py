@@ -495,7 +495,10 @@ class Server:
     def _apply_scheduler_config(self, cfg: SchedulerConfiguration) -> None:
         """Make a (locally committed or replicated) scheduler config
         effective on this server."""
-        self.sched_config = cfg
+        # single-reference rebind of an immutable config object: readers
+        # (workers mid-eval) tolerate either snapshot, GIL makes the
+        # swap atomic, and the two fields need no mutual consistency
+        self.sched_config = cfg  # san-ok: atomic reference swap by design
         self.config.sched_config = cfg
         # pause/resume the broker (reference operator.go PauseEvalBroker):
         # disabling flushes the in-memory queues, so resuming restores
@@ -1292,8 +1295,12 @@ class Server:
         if not id_token:
             raise PermissionError("provider returned no id_token")
         claims = a.verify_jwt(id_token, method)
-        if client_nonce and claims.get("nonce") not in ("", None,
-                                                        client_nonce):
+        if client_nonce and claims.get("nonce") != client_nonce:
+            # strict echo check: a bound nonce MUST come back verbatim.
+            # Accepting a missing/empty nonce claim would let an
+            # attacker-supplied id_token minted outside this auth
+            # request (no nonce at all) complete the login — the
+            # classic OIDC code/token-injection vector
             raise PermissionError("id_token nonce mismatch")
         return self._login_with_claims(snap, method, claims)
 

@@ -64,10 +64,14 @@ class Worker:
             self.process_one(ev, token)
 
     def process_one(self, ev: Evaluation, token: str) -> None:
-        self._eval, self._token = ev, token
+        # Worker-thread confined: process_one (and the Planner methods it
+        # drives through sched.process) only ever runs on this worker's
+        # own run() loop; the public name exists for the Planner
+        # interface and direct-drive tests, never for concurrent callers.
+        self._eval, self._token = ev, token  # san-ok: worker-thread confined
         try:
             snap = self.server.store.snapshot_min_index(ev.modify_index)
-            self._snapshot = snap
+            self._snapshot = snap  # san-ok: worker-thread confined
             sched = NewScheduler(
                 ev.type, snap, self,
                 sched_config=self.server.sched_config,
@@ -79,18 +83,18 @@ class Worker:
             with REGISTRY.time(f"nomad.worker.invoke_scheduler_{ev.type}"):
                 sched.process(ev)
             self.server.broker.ack(ev.id, token)
-            self.stats["processed"] += 1
+            self.stats["processed"] += 1  # san-ok: worker-thread confined
         except Exception:
             if self.server.logger:
                 self.server.logger.exception("eval %s failed", ev.id)
-            self.stats["nacked"] += 1
+            self.stats["nacked"] += 1  # san-ok: worker-thread confined
             try:
                 self.server.broker.nack(ev.id, token)
             except ValueError:
                 pass  # nack timer already fired
         finally:
-            self._eval = self._token = None
-            self._snapshot = None
+            self._eval = self._token = None  # san-ok: worker-thread confined
+            self._snapshot = None  # san-ok: worker-thread confined
 
     # -- Planner interface (worker.go:650-802) --
 
@@ -106,7 +110,7 @@ class Worker:
         if result.refresh_index:
             # partial commit: hand the scheduler a fresher snapshot
             new_snap = self.server.store.snapshot_min_index(result.refresh_index)
-            self._snapshot = new_snap
+            self._snapshot = new_snap  # san-ok: worker-thread confined
             return result, new_snap
         return result, None
 

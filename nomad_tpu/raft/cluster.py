@@ -23,6 +23,15 @@ from .transport import InProcTransport, RemoteCallError, TransportError
 
 log = logging.getLogger("nomad_tpu.raft")
 
+
+def _is_loopback_bind(bind: str) -> bool:
+    """True when a host:port bind string stays on the local machine
+    (loopback or unspecified-but-local test binds are NOT included:
+    0.0.0.0/:: listen on every interface)."""
+    host = bind.rsplit(":", 1)[0].strip("[]").lower()
+    return (host in ("localhost", "::1")
+            or host.startswith("127."))
+
 FORWARD = ("register_job", "deregister_job", "dispatch_job",
            "scale_job", "revert_job",
            "register_node", "heartbeat",
@@ -62,7 +71,7 @@ class ReplicatedServer:
         self.local_store = StateStore()
         self.fsm = FSM(self.local_store)
         self.data_dir = data_dir
-        log = stable = snapshots = None
+        raft_log = stable = snapshots = None
         fsm_snapshot = fsm_restore = None
         if data_dir is not None:
             # durable mode: boltdb-equivalent log + stable + snapshot
@@ -76,12 +85,13 @@ class ReplicatedServer:
             os.makedirs(raft_dir, exist_ok=True)
             stable = StableStore(raft_dir)
             snapshots = SnapshotStore(raft_dir)
-            log = DurableLog(raft_dir)
+            raft_log = DurableLog(raft_dir)
             fsm_snapshot = lambda: dump_store(self.local_store)  # noqa: E731
             fsm_restore = lambda data: restore_store(self.local_store, data)  # noqa: E731
         self.raft = RaftNode(node_id, peers, transport, self.fsm.apply,
                              on_leadership=self._on_leadership,
-                             log=log, stable=stable, snapshots=snapshots,
+                             log=raft_log, stable=stable,
+                             snapshots=snapshots,
                              fsm_snapshot=fsm_snapshot,
                              fsm_restore=fsm_restore,
                              snapshot_threshold=snapshot_threshold,
@@ -106,10 +116,22 @@ class ReplicatedServer:
         self._gossip_seeds = list(gossip_seeds or [])
         self._gossip_stop = threading.Event()
         self._gossip_dead_since = {}
+        self._gossip_auto_join_disabled = False
         if gossip_bind is not None:
             from .gossip import GossipAgent
 
             cfg = config or ServerConfig()
+            if not cfg.gossip_key and not _is_loopback_bind(gossip_bind):
+                # unkeyed gossip on a routable interface: anyone on the
+                # network can inject ALIVE members, and the leader would
+                # auto-join them as raft voters — a cluster takeover.
+                # Keep membership visibility but refuse to act on it
+                # (reference serf requires encrypt for WAN exposure)
+                self._gossip_auto_join_disabled = True
+                log.warning(
+                    "gossip on %s binds a non-loopback interface with no "
+                    "gossip_key: auto-join of gossip-discovered servers "
+                    "is DISABLED (set gossip_key to enable)", gossip_bind)
             self.gossip = GossipAgent(
                 node_id, gossip_bind,
                 key=(cfg.gossip_key.encode() if cfg.gossip_key else None),
@@ -207,8 +229,11 @@ class ReplicatedServer:
         self._gossip_stop.set()
         if self.gossip is not None:
             self.gossip.stop()
-        if self.server._running:
-            self.server.stop()
+        # same lock as the leadership flip threads: a concurrent
+        # establish/revoke must not interleave with shutdown
+        with self._lock:
+            if self.server._running:
+                self.server.stop()
         self.raft.stop()
 
     def set_gossip_http(self, http_addr: str) -> None:
@@ -298,6 +323,10 @@ class ReplicatedServer:
                     log.debug("autopilot removal of dead server %s failed",
                               mid, exc_info=True)
             elif mid not in current and rpc:
+                if self._gossip_auto_join_disabled:
+                    # unkeyed non-loopback gossip (see __init__): treat
+                    # discovered members as advisory only
+                    continue
                 try:
                     self.raft.add_server(mid, rpc)
                 except Exception:

@@ -66,7 +66,7 @@ class FSM:
     def apply(self, command: tuple) -> Any:
         op, args, kwargs = command
         if op == "noop":
-            return None  # leader barrier entry (raft/node.py _become_leader)
+            return None  # leader barrier entry (raft/node.py _become_leader_locked)
         if op not in MUTATIONS:
             raise ValueError(f"unknown FSM op {op!r}")
         if op in TIMESTAMPED and kwargs.get("ts") is None:

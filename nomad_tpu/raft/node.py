@@ -58,7 +58,7 @@ class RaftNode:
         self._last_contact: Dict[str, float] = {}
         self._config_index = 0  # log index of the latest config entry
         # replication state precedes the durability restore below:
-        # a recovered snapshot/log config calls _set_servers, which
+        # a recovered snapshot/log config calls _set_servers_locked, which
         # maintains these
         self._next_index: Dict[str, int] = {}
         self._match_index: Dict[str, int] = {}
@@ -92,13 +92,13 @@ class RaftNode:
                 self.commit_index = snap["index"]
                 self.last_applied = snap["index"]
                 if snap.get("servers"):
-                    self._set_servers(dict(snap["servers"]))
+                    self._set_servers_locked(dict(snap["servers"]))
         # the config to fall back to if a log truncation drops the only
         # config entry (snapshot membership, else the bootstrap peers)
         self._fallback_servers = dict(self.servers)
         # membership survives restarts: the latest config entry in the
         # recovered log wins over the snapshot's
-        self._recover_config_from_log()
+        self._recover_config_from_log_locked()
         self._last_leader_contact = 0.0
 
         self._snap_inflight: set = set()  # peers mid-install-snapshot
@@ -162,7 +162,7 @@ class RaftNode:
     # -- membership (reference nomad/server.go:1602 join,
     #    nomad/autopilot.go dead-server cleanup) --
 
-    def _set_servers(self, servers: Dict[str, str]) -> None:
+    def _set_servers_locked(self, servers: Dict[str, str]) -> None:
         """Install a membership set (call with the lock held or from
         __init__). Takes effect immediately — Raft's single-server
         change rule applies configs at append, not commit."""
@@ -182,7 +182,7 @@ class RaftNode:
                 log.debug("on_config_change callback failed on %s",
                           self.id, exc_info=True)
 
-    def _recover_config_from_log(self, reset_on_missing: bool = False) -> None:
+    def _recover_config_from_log_locked(self, reset_on_missing: bool = False) -> None:
         base = getattr(self.log, "base_index", 0)
         last, _ = self.log.last()
         idx = base + 1
@@ -197,13 +197,13 @@ class RaftNode:
             idx = chunk[-1].index + 1
         if latest is not None:
             self._config_index = latest[0]
-            self._set_servers(dict(latest[1]))
+            self._set_servers_locked(dict(latest[1]))
         elif reset_on_missing:
             # a truncation dropped the only config entry: the membership
             # applied at append time must revert to the snapshot /
             # bootstrap configuration, not linger
             self._config_index = 0
-            self._set_servers(dict(self._fallback_servers))
+            self._set_servers_locked(dict(self._fallback_servers))
 
     def change_config(self, servers: Dict[str, str], timeout: float = 5.0):
         """Leader-only single-server membership change: append a config
@@ -223,7 +223,7 @@ class RaftNode:
             entry = self.log.append(self.current_term,
                                     ("config", (dict(servers),), {}))
             self._config_index = entry.index
-            self._set_servers(servers)
+            self._set_servers_locked(servers)
             index = entry.index
         self._maybe_advance_commit()
         deadline = time.time() + timeout
@@ -312,7 +312,7 @@ class RaftNode:
                 return {"term": self.current_term, "granted": False}
             term = msg["term"]
             if term > self.current_term:
-                self._become_follower(term)
+                self._become_follower_locked(term)
             granted = False
             if term == self.current_term and self.voted_for in (None, msg["candidate"]):
                 last_index, last_term = self.log.last()
@@ -331,7 +331,7 @@ class RaftNode:
             if term < self.current_term:
                 return {"term": self.current_term, "success": False}
             if term > self.current_term or self.state != FOLLOWER:
-                self._become_follower(term)
+                self._become_follower_locked(term)
             self.leader_id = msg["leader"]
             self._deadline = self._new_deadline()
             self._last_leader_contact = time.time()
@@ -349,11 +349,11 @@ class RaftNode:
                 if truncated and not configs:
                     # a dropped conflicting suffix may have contained a
                     # config entry: recompute membership from the log
-                    self._recover_config_from_log(reset_on_missing=True)
+                    self._recover_config_from_log_locked(reset_on_missing=True)
                 elif configs:
                     last_cfg = configs[-1]
                     self._config_index = last_cfg.index
-                    self._set_servers(dict(last_cfg.command[1][0]))
+                    self._set_servers_locked(dict(last_cfg.command[1][0]))
             leader_commit = msg["leader_commit"]
             if leader_commit > self.commit_index:
                 last_index, _ = self.log.last()
@@ -371,7 +371,7 @@ class RaftNode:
             if term < self.current_term:
                 return {"term": self.current_term, "success": False}
             if term > self.current_term or self.state != FOLLOWER:
-                self._become_follower(term)
+                self._become_follower_locked(term)
             self.leader_id = msg["leader"]
             self._deadline = self._new_deadline()
             self._last_leader_contact = time.time()
@@ -385,7 +385,7 @@ class RaftNode:
             if hasattr(self.log, "reset_to"):
                 self.log.reset_to(index, snap_term)
             if msg.get("servers"):
-                self._set_servers(dict(msg["servers"]))
+                self._set_servers_locked(dict(msg["servers"]))
             if self.snapshots is not None:
                 self.snapshots.save(index, snap_term, msg["data"],
                                     servers=self.servers)
@@ -421,7 +421,7 @@ class RaftNode:
 
     # -- roles --
 
-    def _become_follower(self, term: int) -> None:
+    def _become_follower_locked(self, term: int) -> None:
         was_leader = self.state == LEADER
         self.state = FOLLOWER
         # Vote safety: voted_for is per-term state, so it only resets when
@@ -436,7 +436,7 @@ class RaftNode:
         if was_leader and self.on_leadership:
             self.on_leadership(False)
 
-    def _become_leader(self) -> None:
+    def _become_leader_locked(self) -> None:
         self.state = LEADER
         self.leader_id = self.id
         last_index, _ = self.log.last()
@@ -477,14 +477,14 @@ class RaftNode:
                 continue
             with self._lock:
                 if reply["term"] > self.current_term:
-                    self._become_follower(reply["term"])
+                    self._become_follower_locked(reply["term"])
                     return
             if reply.get("granted"):
                 votes += 1
         with self._lock:
             if self.state == CANDIDATE and self.current_term == term \
                     and votes * 2 > len(self.peers) + 1:
-                self._become_leader()
+                self._become_leader_locked()
 
     # -- ticker --
 
@@ -540,7 +540,7 @@ class RaftNode:
             return
         with self._lock:
             if reply["term"] > self.current_term:
-                self._become_follower(reply["term"])
+                self._become_follower_locked(reply["term"])
                 return
             if self.state != LEADER or reply["term"] != self.current_term:
                 return
@@ -576,7 +576,7 @@ class RaftNode:
                     return
                 with self._lock:
                     if reply["term"] > self.current_term:
-                        self._become_follower(reply["term"])
+                        self._become_follower_locked(reply["term"])
                         return
                     if self.state != LEADER:
                         return

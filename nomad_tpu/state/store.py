@@ -34,6 +34,7 @@ from ..structs.deployment import Deployment
 from ..structs.evaluation import Evaluation
 from ..structs.job import Job
 from ..structs.node import Node
+from ..analysis.sanitizer import sanitized
 from .mvcc import ConsList, SnapshotTracker, VersionedTable, cons, cons_from_iter, cons_iter
 
 
@@ -429,6 +430,7 @@ class CanonicalNodeList(list):
     canonical_key = None
 
 
+@sanitized
 class StateStore:
     """MVCC tables + serialized write path (reference nomad/state/state_store.go).
 

@@ -363,7 +363,8 @@ class BulkSolverService:
             else:
                 used_dev = jax.device_put(base)
             since = 0
-            self.stats["resyncs"] += 1
+            with self._lock:
+                self.stats["resyncs"] += 1
 
         cidx = np.zeros(self.CORRECTIONS, dtype=np.int32)
         cdelta = np.zeros((self.CORRECTIONS, d), dtype=np.float32)
@@ -387,18 +388,21 @@ class BulkSolverService:
             new_used, counts = self._mesh_solve(
                 used_dev, avail, feas, aff, ask, k, seeds, cidx, cdelta,
                 g=g_pad)
-            self.stats["sharded"] += 1
         else:
             new_used, counts = solve_bulk_multi(
                 used_dev, avail, feas, aff, ask, k, tgc, seeds, cidx,
                 cdelta, g=g_pad)
         counts_np = np.asarray(counts)  # ONE readback for the whole batch
         self._state = (static, new_used, since + g)
-        self.stats["launches"] += 1
-        self.stats["solves"] += g
-        self.stats["launch_s"] += _time.perf_counter() - t0
         born = _time.time()
         with self._lock:
+            # counters share self._lock with the ledger: solve()/confirm()
+            # mutate stats from API threads under the same lock
+            self.stats["launches"] += 1
+            self.stats["solves"] += g
+            self.stats["launch_s"] += _time.perf_counter() - t0
+            if mesh is not None:
+                self.stats["sharded"] += 1
             for i, r in enumerate(rs):
                 row = counts_np[i]
                 idx = np.nonzero(row)[0]

@@ -16,6 +16,16 @@ fi
 echo "== nomad_tpu.analysis =="
 python -m nomad_tpu.analysis || failed=1
 
+# runtime sanitizer smoke test: lock wrapping + lockset checking armed
+# over the sanitizer's own suite and the concurrency-heavy store/plan
+# tests (the full suite runs under NOMAD_TPU_SAN=1 in nightly; this
+# keeps the gate fast while still exercising install/report/fail paths)
+echo "== nomadsan smoke (NOMAD_TPU_SAN=1) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" NOMAD_TPU_SAN=1 python -m pytest \
+    tests/test_sanitizer.py tests/test_state_store.py \
+    tests/test_plan_apply_scale.py -q \
+    -p no:cacheprovider || failed=1
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \

@@ -23,7 +23,29 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# nomadsan runtime prong (ANALYSIS.md): NOMAD_TPU_SAN=1 instruments
+# every threading.Lock/RLock created after this point and arms the
+# lockset checker on @sanitized classes. Must run before any nomad_tpu
+# module is imported so module- and __init__-level locks are wrapped;
+# jax is deliberately imported first so its internals stay raw.
+_SAN = os.environ.get("NOMAD_TPU_SAN") == "1"
+if _SAN:
+    from nomad_tpu.analysis import sanitizer as _sanitizer
+
+    _sanitizer.install()
+
 import pytest  # noqa: E402
+
+
+def pytest_terminal_summary(terminalreporter):
+    if _SAN:
+        terminalreporter.write_line(_sanitizer.GLOBAL.report())
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # a green test run with recorded races is still a failed run
+    if _SAN and _sanitizer.GLOBAL.violations:
+        session.exitstatus = 3
 
 
 @pytest.fixture(scope="session")

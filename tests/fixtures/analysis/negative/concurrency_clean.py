@@ -1,0 +1,97 @@
+"""Negative fixtures: threaded code with sound lock discipline — the
+nomadsan static rules must stay silent on everything here."""
+
+import collections
+import queue
+import threading
+
+ordered_a = threading.Lock()
+ordered_b = threading.Lock()
+
+
+class LockedCounter:
+    """Every shared mutation happens under the object's lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.items = []
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.count += 1
+                self.items.append(1)
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+
+class ThreadsafePrimitives:
+    """Mutation of internally-synchronized primitives (queues, events,
+    deques) needs no extra lock; *_locked helpers are callee-exempt."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._buf = collections.deque()
+        self._stop = threading.Event()
+        self.seen = 0
+
+    def start(self):
+        threading.Thread(target=self._run).start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._q.put(1)
+            self._buf.append(1)
+            with self._lock:
+                self._bump_locked()
+
+    def _bump_locked(self):
+        self.seen += 1  # caller holds self._lock (the naming contract)
+
+    def push(self, item):
+        self._q.put(item)
+        with self._lock:
+            self._bump_locked()
+
+
+class SingleThreadOwner:
+    """Only the worker thread ever mutates; the public surface reads."""
+
+    def __init__(self):
+        self.processed = 0
+        self._stop = threading.Event()
+
+    def start(self):
+        threading.Thread(target=self._run).start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self.processed += 1  # one mutating root -> clean
+
+    def snapshot(self):
+        return self.processed
+
+
+def consistent_outer_inner():
+    with ordered_a:
+        with ordered_b:
+            pass
+
+
+def consistent_again():
+    # same order everywhere -> acyclic graph, no finding
+    with ordered_a:
+        with ordered_b:
+            pass

@@ -1,0 +1,87 @@
+"""Positive fixtures for the nomadsan static rules: every class here
+must trip shared-mutation-unlocked or lock-order-cycle."""
+
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+class UnlockedCounter:
+    """Background thread and public API both bump the counter with no
+    lock -> shared-mutation-unlocked (assign + container mutation)."""
+
+    def __init__(self):
+        self.count = 0
+        self.items = []
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self.count += 1          # flagged: no lock, 2 roots
+            self.items.append(1)     # flagged: container mutator
+
+    def bump(self):
+        self.count += 1              # flagged: api root overlaps
+
+    def add_item(self, item):
+        self.items.append(item)      # flagged: second root for items
+
+
+class ClosureSpawner:
+    """Thread target is a nested closure; its mutations are a distinct
+    root from the public surface."""
+
+    def __init__(self):
+        self.latest = None
+
+    def watch(self):
+        def loop():
+            self.latest = object()   # flagged: closure-thread write
+
+        threading.Thread(target=loop).start()
+
+    def reset(self):
+        self.latest = None           # flagged: api write, no lock
+
+
+def grab_ab():
+    with lock_a:
+        with lock_b:
+            pass
+
+
+def grab_ba():
+    # reverse nesting order -> lock-order-cycle (a -> b and b -> a)
+    with lock_b:
+        with lock_a:
+            pass
+
+
+class InterproceduralInversion:
+    """Cycle built through a call edge: helper() acquires pot_lock while
+    the caller holds pan_lock, and vice versa elsewhere."""
+
+    def __init__(self):
+        self.pan_lock = threading.Lock()
+        self.pot_lock = threading.Lock()
+
+    def _take_pot(self):
+        with self.pot_lock:
+            pass
+
+    def _take_pan(self):
+        with self.pan_lock:
+            pass
+
+    def cook(self):
+        with self.pan_lock:
+            self._take_pot()         # pan -> pot
+
+    def wash(self):
+        with self.pot_lock:
+            self._take_pan()         # pot -> pan: cycle

@@ -273,3 +273,62 @@ class TestMembersEndpoint:
         finally:
             agent.stop()
             s.stop()
+
+
+class TestGossipAutoJoinSafety:
+    """Round-5 hardening: unkeyed gossip on a routable interface must
+    not feed raft membership — anyone on the segment could inject
+    ALIVE members and the leader would vote them into the quorum."""
+
+    def _rs(self, gossip_bind, gossip_key=""):
+        from nomad_tpu.core.server import ServerConfig
+        from nomad_tpu.raft.cluster import ReplicatedServer
+        from nomad_tpu.raft.transport import InProcTransport
+
+        return ReplicatedServer(
+            "s0", ["s0"], InProcTransport(),
+            ServerConfig(heartbeat_ttl=30.0, gossip_key=gossip_key),
+            bootstrap=True, gossip_bind=gossip_bind)
+
+    @staticmethod
+    def _alive_member(region):
+        return {"gossip": "10.0.0.9:9999", "inc": 1, "status": ALIVE,
+                "meta": {"rpc": "10.0.0.9:4647", "region": region}}
+
+    def test_unkeyed_nonloopback_disables_auto_join(self, caplog):
+        import logging
+
+        with caplog.at_level(logging.WARNING, logger="nomad_tpu.raft"):
+            rs = self._rs("0.0.0.0:0")
+        try:
+            assert rs._gossip_auto_join_disabled
+            assert any("DISABLED" in r.getMessage()
+                       for r in caplog.records)
+            added = []
+            rs.raft.add_server = lambda mid, addr: added.append(mid)
+            rs.gossip.members["intruder"] = self._alive_member(
+                rs.server.config.region)
+            rs._gossip_reconcile_once()
+            assert added == []  # discovered but never joined
+        finally:
+            rs.stop()
+
+    def test_loopback_unkeyed_auto_join_still_enabled(self):
+        rs = self._rs("127.0.0.1:0")
+        try:
+            assert not rs._gossip_auto_join_disabled
+            added = []
+            rs.raft.add_server = lambda mid, addr: added.append(mid)
+            rs.gossip.members["friend"] = self._alive_member(
+                rs.server.config.region)
+            rs._gossip_reconcile_once()
+            assert added == ["friend"]
+        finally:
+            rs.stop()
+
+    def test_keyed_nonloopback_auto_join_enabled(self):
+        rs = self._rs("0.0.0.0:0", gossip_key="sekrit")
+        try:
+            assert not rs._gossip_auto_join_disabled
+        finally:
+            rs.stop()

@@ -284,3 +284,39 @@ class TestContainerDriver:
         with _pytest.raises(DriverError, match="config.image"):
             d.start_task(Task(name="x", driver="container", config={}),
                          {}, str(tmp_path))
+
+
+class TestImageCache:
+    """Extraction cache mechanics — pure file ops, no namespaces."""
+
+    def test_image_cache_evicts_superseded_extraction(self, tmp_path):
+        import tarfile
+
+        from nomad_tpu.client.drivers import ContainerDriver
+
+        payload = tmp_path / "v"
+        img = tmp_path / "img.tar"
+
+        def pack(content):
+            payload.write_text(content)
+            with tarfile.open(img, "w") as tar:
+                tar.add(payload, arcname="v")
+
+        pack("one")
+        d = ContainerDriver()
+        first = d._resolve_image(str(img))
+        assert open(os.path.join(first, "v")).read() == "one"
+        # unchanged mtime -> cache hit, same extraction
+        assert d._resolve_image(str(img)) == first
+        # rebuilt image at the same path: old extraction is evicted
+        pack("two")
+        bump = os.path.getmtime(img) + 5
+        os.utime(img, (bump, bump))
+        second = d._resolve_image(str(img))
+        assert second != first
+        assert not os.path.isdir(first), "superseded extraction leaked"
+        assert open(os.path.join(second, "v")).read() == "two"
+        # shutdown cleanup drops everything
+        ContainerDriver.evict_image_cache()
+        assert not os.path.isdir(second)
+        assert ContainerDriver._image_cache == {}

@@ -174,6 +174,20 @@ class TestOIDCFlow:
             s.oidc_complete_auth("corp", out["state"], "code-x", redirect,
                                  client_nonce="wrong")
 
+    def test_injected_nonceless_code_rejected(self, oidc_server):
+        """Code-injection: the attacker starts their own flow with NO
+        nonce and splices the resulting code into the victim's
+        callback. The minted id_token carries an empty nonce claim —
+        it must not satisfy a request that bound one."""
+        s, provider = oidc_server
+        redirect = "http://127.0.0.1:9/oidc/callback"
+        self._setup_method(s, provider, redirect)
+        out = s.oidc_auth_url("corp", redirect, client_nonce="victim-n")
+        provider.codes["code-evil"] = ""  # attacker's nonce-less code
+        with pytest.raises(PermissionError, match="nonce mismatch"):
+            s.oidc_complete_auth("corp", out["state"], "code-evil",
+                                 redirect, client_nonce="victim-n")
+
 
 class TestWIDMgr:
     def test_task_observes_refreshed_token(self, tmp_path):

@@ -1,0 +1,328 @@
+"""nomadsan runtime prong (nomad_tpu/analysis/sanitizer.py).
+
+Unit tests drive private Sanitizer instances so assertions never
+pollute the session-global run state (which the NOMAD_TPU_SAN=1 plugin
+in conftest.py reports on at session end); the slow stress test runs
+the real control-plane structures under the GLOBAL instance, which is
+what the @sanitized decorators are bound to.
+"""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.analysis import sanitizer
+from nomad_tpu.analysis.sanitizer import Sanitizer
+
+# -- lock-order graph ----------------------------------------------------
+
+
+def test_consistent_lock_order_is_clean():
+    san = Sanitizer()
+    a, b = san.Lock(), san.Lock()
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert san.violations == []
+
+
+def test_lock_order_inversion_detected_and_deduplicated():
+    san = Sanitizer()
+    a, b = san.Lock(), san.Lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert len(san.violations) == 1
+    v = san.violations[0]
+    assert v.kind == "lock-order-inversion"
+    assert "cycle:" in v.message
+    # the same inverted pair never reports twice
+    with b:
+        with a:
+            pass
+    assert len(san.violations) == 1
+
+
+def test_transitive_inversion_through_third_lock():
+    # a->b and b->c are each fine; c->a closes a cycle no pairwise
+    # check would see
+    san = Sanitizer()
+    a, b, c = san.Lock(), san.Lock(), san.Lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with a:
+            pass
+    assert [v.kind for v in san.violations] == ["lock-order-inversion"]
+
+
+def test_inversion_found_across_threads():
+    # the graph is global: each order happens on a different thread and
+    # the run never actually deadlocks — the inversion is still real
+    san = Sanitizer()
+    a, b = san.Lock(), san.Lock()
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    for fn in (t1, t2):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    assert [v.kind for v in san.violations] == ["lock-order-inversion"]
+
+
+def test_rlock_reentry_records_no_self_edge():
+    san = Sanitizer()
+    r = san.RLock()
+    with r:
+        with r:
+            pass
+    assert san.violations == []
+    assert san.held_serials() == []
+
+
+def test_condition_wait_releases_the_instrumented_rlock():
+    # Condition goes through the private _release_save/_acquire_restore
+    # protocol; the notifier can only get in if wait() released for
+    # real, and the waiter's held stack must survive the round trip
+    san = Sanitizer()
+    cond = threading.Condition(san.RLock())
+    ready, done = threading.Event(), threading.Event()
+
+    def waiter():
+        with cond:
+            ready.set()
+            cond.wait(timeout=5.0)
+            assert san.held_serials() != []  # reacquired
+        done.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    assert ready.wait(2.0)
+    time.sleep(0.05)  # let the waiter enter wait()
+    with cond:
+        cond.notify()
+    assert done.wait(2.0)
+    t.join(2.0)
+    assert san.violations == []
+
+
+def test_install_patches_and_uninstall_restores():
+    san = Sanitizer()
+    prev_lock, prev_rlock = threading.Lock, threading.RLock
+    try:
+        san.install()
+        lk = threading.Lock()
+        assert "nomadsan" in repr(lk)
+        with lk:
+            assert san.held_serials() != []
+        assert san.held_serials() == []
+    finally:
+        san.uninstall()
+        # restore whatever was there before (the session-global
+        # sanitizer may be installed when NOMAD_TPU_SAN=1)
+        threading.Lock = prev_lock
+        threading.RLock = prev_rlock
+    assert san.violations == []
+
+
+# -- Eraser lockset ------------------------------------------------------
+
+
+def _box_class(san):
+    @san.sanitized
+    class Box:
+        pass
+
+    return Box
+
+
+def test_lockset_flags_unlocked_cross_thread_writes():
+    san = Sanitizer()
+    san.active = True  # arm without patching threading globally
+    box = _box_class(san)()
+    box.value = 1  # exclusive to this thread
+    t = threading.Thread(target=lambda: setattr(box, "value", 2))
+    t.start()
+    t.join()
+    assert [v.kind for v in san.violations] == ["lockset"]
+    assert "Box.value" in san.violations[0].message
+    # de-duplicated per (class, field)
+    t = threading.Thread(target=lambda: setattr(box, "value", 3))
+    t.start()
+    t.join()
+    assert len(san.violations) == 1
+
+
+def test_lockset_accepts_common_lock():
+    san = Sanitizer()
+    san.active = True
+    box = _box_class(san)()
+    guard = san.Lock()
+    with guard:
+        box.value = 1
+
+    def writer():
+        with guard:
+            box.value = 2
+
+    t = threading.Thread(target=writer)
+    t.start()
+    t.join()
+    assert san.violations == []
+
+
+def test_lockset_exclusive_single_thread_needs_no_lock():
+    san = Sanitizer()
+    san.active = True
+    box = _box_class(san)()
+    for i in range(100):
+        box.value = i
+    assert san.violations == []
+
+
+def test_lockset_exempt_field_is_skipped():
+    san = Sanitizer()
+    san.active = True
+    box = _box_class(san)()
+    box._nomadsan_exempt = ("value",)
+    box.value = 1
+    t = threading.Thread(target=lambda: setattr(box, "value", 2))
+    t.start()
+    t.join()
+    assert san.violations == []
+
+
+def test_decorator_is_inert_while_inactive():
+    san = Sanitizer()
+    box = _box_class(san)()
+    box.value = 1
+    assert not hasattr(box, "_nomadsan_fields")
+    assert san.violations == []
+
+
+def test_production_classes_are_watched():
+    from nomad_tpu.core.broker import EvalBroker
+    from nomad_tpu.core.deployments import DeploymentWatcher
+    from nomad_tpu.core.plan_apply import PlanQueue
+    from nomad_tpu.state import StateStore
+
+    for cls in (StateStore, EvalBroker, PlanQueue, DeploymentWatcher):
+        assert getattr(cls, "_nomadsan_watched", False), cls
+
+
+def test_check_raises_and_report_renders():
+    san = Sanitizer()
+    a, b = san.Lock(), san.Lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    with pytest.raises(AssertionError, match="lock-order-inversion"):
+        san.check()
+    assert "1 violation" in san.report()
+
+
+# -- stress: the real control plane under the global checker -------------
+
+
+@pytest.mark.slow
+def test_statestore_plan_applier_stress_under_lockset():
+    """8 threads hammer one StateStore + plan applier with the GLOBAL
+    sanitizer armed: concurrent plan submissions, node/job upserts,
+    client status updates, and snapshot readers. Any lock-order
+    inversion or lockset race in the store/applier/queue surfaces as a
+    violation here without needing an unlucky interleaving."""
+    from nomad_tpu import mock
+    from nomad_tpu.core.plan_apply import PlanApplier, PlanQueue
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.structs.plan import Plan
+
+    was_active = sanitizer.enabled()
+    before = len(sanitizer.violations())
+    sanitizer.install()
+    try:
+        store = StateStore()
+        job = mock.job()
+        store.upsert_job(job)
+        nodes = []
+        for _ in range(8):
+            n = mock.node()
+            n.compute_class()
+            store.upsert_node(n)
+            nodes.append(n)
+
+        queue = PlanQueue()
+        queue.set_enabled(True)
+        ap = PlanApplier(store, queue)
+        ap.start()
+        errors = []
+
+        def submit_plans(k):
+            try:
+                for i in range(15):
+                    plan = Plan(eval_id=f"ev-{k}-{i}",
+                                snapshot_index=store.latest_index)
+                    plan.append_alloc(
+                        mock.alloc(job, nodes[(k + i) % len(nodes)],
+                                   index=k * 100 + i))
+                    ap.apply(plan)
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        def churn_nodes():
+            try:
+                for _ in range(30):
+                    n = mock.node()
+                    n.compute_class()
+                    store.upsert_node(n)
+                    store.update_node_status(
+                        n.id, "ready", ts=time.time())
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        def read_snapshots():
+            try:
+                for _ in range(60):
+                    with store.snapshot() as snap:
+                        list(snap.nodes())
+                        list(snap.allocs())
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        workers = ([threading.Thread(target=submit_plans, args=(k,))
+                    for k in range(4)]
+                   + [threading.Thread(target=churn_nodes)
+                      for _ in range(2)]
+                   + [threading.Thread(target=read_snapshots)
+                      for _ in range(2)])
+        for t in workers:
+            t.start()
+        for t in workers:
+            t.join(timeout=60.0)
+        ap.stop()
+        assert errors == []
+    finally:
+        if not was_active:
+            sanitizer.uninstall()
+    fresh = sanitizer.violations()[before:]
+    assert fresh == [], "\n".join(v.render() for v in fresh)

@@ -23,6 +23,7 @@ POSITIVE = FIXTURES / "positive"
 NEGATIVE = FIXTURES / "negative"
 
 ALL_RULES = ("fsm-determinism", "jax-hot-path", "lock-order",
+             "lock-order-cycle", "shared-mutation-unlocked",
              "shared-struct-mutation", "silent-except")
 
 
@@ -33,7 +34,7 @@ def _by_rule(findings):
     return out
 
 
-def test_registry_exposes_all_five_rules():
+def test_registry_exposes_all_rules():
     assert set(all_rules()) == set(ALL_RULES)
 
 
@@ -52,12 +53,30 @@ def test_positive_fixtures_flag_every_rule():
 
     assert [f.detail for f in found["silent-except"]] == ["silent:0"]
 
-    lock = found["lock-order"]
+    # the pairwise rule fires on both fixture files (concurrency_bad's
+    # inverted module locks are also a pairwise conflict); scope per file
+    lock = [f for f in found["lock-order"] if "hygiene_bad" in f.path]
     assert len(lock) == 2  # one finding per conflicting site
     assert {f.detail for f in lock} == {"b_lock<->a_lock"}
 
     shared = {f.detail for f in found["shared-struct-mutation"]}
     assert shared == {"alloc.client_status", "ev.status"}
+
+    unlocked = found["shared-mutation-unlocked"]
+    attrs = {f.detail.split(":")[0] for f in unlocked}
+    assert attrs == {"count", "items", "latest"}
+    # the closure spawned as a thread is its own root
+    assert any("watch.loop" in f.context for f in unlocked)
+
+    # hygiene_bad's inverted a_lock/b_lock also forms a cycle; scope to
+    # the concurrency fixture for the exact-set check
+    cycles = {f.detail for f in found["lock-order-cycle"]
+              if "concurrency_bad" in f.path}
+    assert cycles == {
+        "lock_a|lock_b",
+        ("InterproceduralInversion.pan_lock"
+         "|InterproceduralInversion.pot_lock"),
+    }
 
 
 def test_negative_fixtures_are_clean():
@@ -100,6 +119,47 @@ def test_cli_baseline_allowlists_known_findings(tmp_path, capsys):
 def test_cli_rejects_unknown_rule():
     with pytest.raises(ValueError):
         main([str(POSITIVE), "--rule", "no-such-rule"])
+
+
+def test_thread_entrypoint_discovery():
+    from nomad_tpu.analysis.core import load_modules
+    from nomad_tpu.analysis.rules_concurrency import discover_thread_sites
+
+    sites = discover_thread_sites(
+        load_modules([REPO / "nomad_tpu"], REPO))
+    factories = {s.factory for s in sites}
+    assert "Thread" in factories
+    assert "submit" in factories
+    # known entrypoints the pass must see
+    targets = {(s.module_rel, s.target) for s in sites}
+    assert ("nomad_tpu/core/worker.py", "self.run") in targets
+    assert any(rel == "nomad_tpu/raft/node.py" and t == "send"
+               for rel, t in targets)  # snapshot-send closure
+
+
+def test_san_ok_comment_suppresses(tmp_path):
+    bad = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self.n = 0\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._run).start()\n"
+        "    def _run(self):\n"
+        "        self.n += 1  # san-ok: test-only single writer\n"
+        "    def bump(self):\n"
+        "        # san-ok: test-only single writer\n"
+        "        self.n += 1\n")
+    p = tmp_path / "suppressed.py"
+    p.write_text(bad)
+    assert run_analysis(paths=[p], rules=["shared-mutation-unlocked"],
+                        root=tmp_path) == []
+    p.write_text(bad.replace("  # san-ok: test-only single writer", "")
+                    .replace("        # san-ok: test-only single writer\n",
+                             ""))
+    flagged = run_analysis(paths=[p], rules=["shared-mutation-unlocked"],
+                           root=tmp_path)
+    assert len(flagged) == 2
 
 
 def test_baseline_keys_survive_line_shifts():
