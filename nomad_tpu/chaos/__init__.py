@@ -1,0 +1,27 @@
+"""nomadchaos: deterministic fault injection for the replicated control
+plane.
+
+Pieces (see ROBUSTNESS.md for the fault model and workflow):
+
+- ``FaultPlan`` / ``LinkFaults`` — seeded per-message verdicts (drop,
+  delay, duplicate, reorder) plus scripted directed link cuts,
+  consulted by InProcTransport and SocketTransport;
+- ``FSFaults`` — disk-fault shim (ENOSPC/EIO at the durable-storage
+  chokepoints) plus torn-tail helpers;
+- ``InvariantChecker`` — election safety, log matching, committed
+  durability, FSM convergence, alloc reschedule;
+- ``ScenarioRunner`` — scripted steps with the safety sweep between
+  them, seeded from ``NOMAD_TPU_CHAOS_SEED``.
+"""
+
+from .fsfaults import FSFaults, tear_log_tail, truncate_log_mid_line
+from .invariants import InvariantChecker, InvariantViolation
+from .plan import FaultPlan, LinkFaults, Verdict
+from .runner import ScenarioRunner, seed_from_env
+
+__all__ = [
+    "FaultPlan", "LinkFaults", "Verdict",
+    "FSFaults", "tear_log_tail", "truncate_log_mid_line",
+    "InvariantChecker", "InvariantViolation",
+    "ScenarioRunner", "seed_from_env",
+]

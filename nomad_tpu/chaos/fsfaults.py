@@ -1,0 +1,120 @@
+"""Disk fault injection for the durable raft storage.
+
+The durable layer funnels its writes through two chokepoints —
+`utils.files.atomic_write_text` (stable store, snapshots) and
+`DurableLog._write` (log appends) — and both call
+`utils.files.check_fault(op, path)` before touching the disk. That hook
+is a no-op until an `FSFaults` shim is installed, at which point armed
+faults raise real `OSError`s (ENOSPC, EIO) at the exact write the
+scenario scripts.
+
+Ops seen today: "atomic_write_text", "log_append", "log_rewrite".
+
+    faults = FSFaults()
+    with faults.installed():
+        faults.arm("log_append", errno_=errno.ENOSPC, count=2)
+        ...  # the next two log appends fail with ENOSPC
+
+`tear_log_tail` simulates the other classic crash artifact: a torn
+(half-written) final line in log.jsonl, which `DurableLog` must drop
+with a warning on the next open instead of refusing to start.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+import os
+import threading
+from typing import Dict, List, Optional
+
+from ..utils import files as _files
+
+
+class FaultArmed:
+    __slots__ = ("op", "errno_", "count", "path_substr")
+
+    def __init__(self, op: str, errno_: int, count: int,
+                 path_substr: Optional[str]):
+        self.op = op
+        self.errno_ = errno_
+        self.count = count
+        self.path_substr = path_substr
+
+
+class FSFaults:
+    """Swappable fs fault shim (install/uninstall around a scenario)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed: Dict[str, List[FaultArmed]] = {}
+        self.stats = {"raised": 0}
+
+    # -- arming --
+
+    def arm(self, op: str, errno_: int = errno.ENOSPC, count: int = 1,
+            path_substr: Optional[str] = None) -> None:
+        """The next `count` writes of `op` (optionally restricted to
+        paths containing path_substr) raise OSError(errno_)."""
+        with self._lock:
+            self._armed.setdefault(op, []).append(
+                FaultArmed(op, errno_, count, path_substr))
+
+    def disarm(self, op: Optional[str] = None) -> None:
+        with self._lock:
+            if op is None:
+                self._armed.clear()
+            else:
+                self._armed.pop(op, None)
+
+    # -- the hook --
+
+    def __call__(self, op: str, path: str) -> None:
+        with self._lock:
+            for fault in self._armed.get(op, []):
+                if fault.path_substr is not None \
+                        and fault.path_substr not in path:
+                    continue
+                if fault.count <= 0:
+                    continue
+                fault.count -= 1
+                self.stats["raised"] += 1
+                raise OSError(fault.errno_,
+                              f"{os.strerror(fault.errno_)} "
+                              f"(chaos-injected, op={op})", path)
+
+    # -- lifecycle --
+
+    def install(self) -> "FSFaults":
+        _files.set_fault_hook(self)
+        return self
+
+    def uninstall(self) -> None:
+        _files.set_fault_hook(None)
+
+    @contextlib.contextmanager
+    def installed(self):
+        self.install()
+        try:
+            yield self
+        finally:
+            self.uninstall()
+
+
+def tear_log_tail(raft_dir: str, garbage: str = '{"index": 999, "ter') -> str:
+    """Append a torn half-line to <raft_dir>/log.jsonl, as a crash
+    mid-append would leave it. Returns the path."""
+    path = os.path.join(raft_dir, "log.jsonl")
+    with open(path, "a") as f:
+        f.write(garbage)
+    return path
+
+
+def truncate_log_mid_line(raft_dir: str, cut_bytes: int = 7) -> str:
+    """Truncate log.jsonl `cut_bytes` short of its end — a torn tail
+    with no newline, the other shape a crashed append leaves."""
+    path = os.path.join(raft_dir, "log.jsonl")
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(0, size - cut_bytes))
+    return path

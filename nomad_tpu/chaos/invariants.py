@@ -1,0 +1,241 @@
+"""Safety invariants checked between chaos steps.
+
+Four checks, mirroring the safety arguments in raft (Ongaro §5.2/§5.4)
+and the reference scheduler's liveness contract:
+
+  1. election safety — at most one leader per term, ever, across the
+     whole run (crash/restart included);
+  2. log matching — any two live nodes agree on (term, command) for
+     every index both have committed;
+  3. committed durability — once an entry is observed committed it is
+     never lost or rewritten, across crashes and restarts (snapshot
+     compaction counts as retention, not loss);
+  4. convergence / reschedule — after a heal, every FSM reaches the
+     same state, and every alloc on a heartbeat-invalidated node is
+     eventually rescheduled off it.
+
+The checker is stateful on purpose: election safety and durability are
+*history* properties, so the same ``InvariantChecker`` must live for a
+whole scenario and see every intermediate state the runner produces.
+All reads snapshot one node at a time under that node's own lock —
+never two node locks at once, so the checker cannot introduce a
+lock-order cycle into the raft graph nomadsan watches.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger("nomad_tpu.chaos")
+
+
+class InvariantViolation(AssertionError):
+    """A safety property was broken; chaos runs must fail loudly."""
+
+
+def _digest(command) -> str:
+    """Interleaving- and storage-independent fingerprint of a command.
+
+    json round-trips tuples to lists, so an in-memory node (tuples) and
+    a durably restarted one (lists from log.jsonl) digest identically.
+    """
+    payload = json.dumps(command, sort_keys=True, default=str)
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def _live(cluster) -> List:
+    return [s for s in cluster.servers.values()
+            if not s.crashed and not s.raft._stop.is_set()]
+
+
+def _log_prefix(server, committed_only: bool = True,
+                ) -> Tuple[int, int, List[Tuple[int, int, str]]]:
+    """(first_index, commit_index, [(index, term, digest), ...]) for the
+    entries this node holds in its log — the committed prefix by
+    default, or the whole log (``committed_only=False``; used by the
+    durability check because commit *knowledge* is volatile: a restarted
+    leader re-derives commit_index after election while its log already
+    holds everything). Entries below first_index were compacted into a
+    snapshot — covered, not lost."""
+    raft = server.raft
+    with raft._lock:
+        last = raft.log.last()[0]
+        commit = min(raft.commit_index, last)
+        first = raft.log.first_index() if hasattr(raft.log, "first_index") else 1
+        upto = last if not committed_only else commit
+        rows = []
+        for idx in range(first, upto + 1):
+            e = raft.log.get(idx)
+            if e is None:  # compacted under us; harmless
+                continue
+            rows.append((idx, e.term, _digest(e.command)))
+    return first, commit, rows
+
+
+def _dump_comparable(server) -> dict:
+    """FSM dump minus the MVCC index: a restarted replica that restored
+    a snapshot and replayed the tail holds identical *contents* at a
+    possibly different generation counter."""
+    from ..state.persist import dump_store
+    d = dump_store(server.local_store)
+    d.pop("index", None)
+    return d
+
+
+class InvariantChecker:
+    def __init__(self):
+        # term -> leader id, accumulated over the whole scenario
+        self._leaders_by_term: Dict[int, str] = {}
+        # index -> (term, digest) once observed committed anywhere
+        self._committed: Dict[int, Tuple[int, str]] = {}
+        self.stats = {"checks": 0, "violations": 0}
+
+    # -- 1: election safety ------------------------------------------
+
+    def check_election_safety(self, cluster) -> None:
+        for s in _live(cluster):
+            raft = s.raft
+            with raft._lock:
+                is_leader = raft.state == "leader"
+                term = raft.current_term
+            if not is_leader:
+                continue
+            prev = self._leaders_by_term.get(term)
+            if prev is not None and prev != s.id:
+                self._fail(
+                    f"election safety: term {term} has two leaders "
+                    f"({prev} and {s.id})")
+            self._leaders_by_term[term] = s.id
+
+    # -- 2: log matching ---------------------------------------------
+
+    def check_log_matching(self, cluster) -> None:
+        prefixes = [(s.id, _log_prefix(s)) for s in _live(cluster)]
+        by_index: Dict[int, Tuple[str, int, str]] = {}
+        for sid, (_first, _commit, rows) in prefixes:
+            for idx, term, dig in rows:
+                seen = by_index.get(idx)
+                if seen is None:
+                    by_index[idx] = (sid, term, dig)
+                elif (term, dig) != seen[1:]:
+                    self._fail(
+                        f"log matching: committed index {idx} diverges — "
+                        f"{seen[0]} has (term={seen[1]}, {seen[2]}), "
+                        f"{sid} has (term={term}, {dig})")
+
+    # -- 3: committed entries survive crashes ------------------------
+
+    def check_committed_durability(self, cluster) -> None:
+        """Record every committed (index, term, digest) seen so far and
+        verify all previous records are still held (or snapshotted) by
+        at least one live node, unchanged.
+
+        Records come from committed prefixes; the retention check scans
+        whole logs: raft only guarantees committed entries are present
+        in a quorum's LOGS — commit_index itself is volatile knowledge
+        every node re-derives after an election, so right after a
+        leader crash no live node may *know* the commit point yet."""
+        live = _live(cluster)
+        full = {s.id: _log_prefix(s, committed_only=False) for s in live}
+        maps = {sid: {idx: (term, dig) for idx, term, dig in rows}
+                for sid, (_f, _c, rows) in full.items()}
+        for sid, (_f, commit, rows) in full.items():
+            for idx, term, dig in rows:
+                if idx > commit:
+                    continue  # record only what this node knows committed
+                prev = self._committed.get(idx)
+                if prev is not None and prev != (term, dig):
+                    self._fail(
+                        f"durability: committed index {idx} rewritten — "
+                        f"recorded (term={prev[0]}, {prev[1]}), {sid} now "
+                        f"has (term={term}, {dig})")
+                self._committed[idx] = (term, dig)
+        if not live:
+            return
+        for idx, (term, dig) in self._committed.items():
+            held = False
+            for sid, (first, _commit, _rows) in full.items():
+                if idx < first:
+                    held = True  # compacted into this node's snapshot
+                    break
+                if maps[sid].get(idx) == (term, dig):
+                    held = True
+                    break
+            if not held:
+                self._fail(
+                    f"durability: committed index {idx} (term={term}, "
+                    f"{dig}) vanished from every live node")
+
+    # -- 4a: FSM convergence after heal ------------------------------
+
+    def check_convergence(self, cluster, timeout: float = 15.0) -> None:
+        """After a heal: all live nodes apply up to the max commit index
+        and hold identical FSM contents."""
+        deadline = time.monotonic() + timeout
+        last_err = "no live nodes"
+        while time.monotonic() < deadline:
+            live = _live(cluster)
+            if not live:
+                break
+            target = max(s.raft.commit_index for s in live)
+            lagging = [s.id for s in live if s.raft.last_applied < target]
+            if lagging:
+                last_err = (f"replicas {lagging} applied < commit "
+                            f"index {target}")
+                time.sleep(0.05)
+                continue
+            dumps = {s.id: _dump_comparable(s) for s in live}
+            ref_id = live[0].id
+            ref = dumps[ref_id]
+            diverged = [sid for sid, d in dumps.items() if d != ref]
+            if not diverged:
+                self.stats["checks"] += 1
+                return
+            last_err = f"FSM contents of {diverged} differ from {ref_id}"
+            time.sleep(0.05)
+        self._fail(f"convergence: {last_err} after {timeout:.0f}s")
+
+    # -- 4b: allocs leave heartbeat-invalidated nodes ----------------
+
+    def check_reschedule(self, server, timeout: float = 15.0) -> None:
+        """Every alloc placed on a node the heartbeat manager marked
+        down must eventually stop being live there (lost/stopped, with
+        the scheduler free to place replacements elsewhere)."""
+        from ..structs import enums
+        deadline = time.monotonic() + timeout
+        last_err = ""
+        while time.monotonic() < deadline:
+            snap = server.store.snapshot()
+            down = [n.id for n in snap.nodes()
+                    if n.status == enums.NODE_STATUS_DOWN]
+            stranded = []
+            for nid in down:
+                for a in snap.allocs_by_node(nid):
+                    if not a.terminal_status() and not a.server_terminal():
+                        stranded.append((a.id[:8], nid))
+            if not stranded:
+                self.stats["checks"] += 1
+                return
+            last_err = f"live allocs still on down nodes: {stranded}"
+            time.sleep(0.05)
+        self._fail(f"reschedule: {last_err} after {timeout:.0f}s")
+
+    # -- aggregate ----------------------------------------------------
+
+    def check_all(self, cluster) -> None:
+        """The per-step safety sweep (history properties only; the
+        liveness checks — convergence, reschedule — take timeouts and
+        run where a scenario expects quiescence)."""
+        self.check_election_safety(cluster)
+        self.check_log_matching(cluster)
+        self.check_committed_durability(cluster)
+        self.stats["checks"] += 1
+
+    def _fail(self, msg: str) -> None:
+        self.stats["violations"] += 1
+        log.error("invariant violated: %s", msg)
+        raise InvariantViolation(msg)

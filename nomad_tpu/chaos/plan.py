@@ -1,0 +1,198 @@
+"""Deterministic per-message fault plan for the raft transports.
+
+Both `InProcTransport` and `SocketTransport` consult an attached
+FaultPlan on every outgoing raft message. The plan can:
+
+- cut **directed** links (src -> dst) or whole nodes, optionally with a
+  clock-based expiry (auto-heal);
+- drop, delay, duplicate, or reorder messages probabilistically per
+  link rule.
+
+Determinism: each (src, dst) link keeps a message counter, and the
+verdict for message #n derives from `sha256(seed:src>dst:n)` — a fixed
+seed reproduces the same per-link drop/delay/duplicate pattern
+regardless of thread interleaving. Scripted cuts are exact. The seed
+comes from `NOMAD_TPU_CHAOS_SEED` when the runner builds the plan (see
+ROBUSTNESS.md for the reproduction workflow).
+
+Virtual time: the plan reads time only through `self.clock` (default
+`time.monotonic`), so tests may inject a virtual clock and expiring
+cuts / delay windows follow it deterministically.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Tuple
+
+
+@dataclass
+class LinkFaults:
+    """Per-link probabilistic fault rule. Probabilities are independent
+    per message; `delay_range` applies when the delay roll hits."""
+    drop: float = 0.0        # lose the message (sender sees a lost reply)
+    delay: float = 0.0       # stall the send in-line for delay_range s
+    duplicate: float = 0.0   # deliver again asynchronously a bit later
+    reorder: float = 0.0     # deliver asynchronously after delay_range,
+    #                          returning loss to the sender — the message
+    #                          arrives late, out of order with successors
+    delay_range: Tuple[float, float] = (0.005, 0.05)
+
+
+@dataclass
+class Verdict:
+    """What the transport should do with one message."""
+    drop: bool = False
+    delay: float = 0.0           # sleep before synchronous delivery
+    duplicate_after: float = 0.0  # >0: also deliver a copy this much later
+    reorder_after: float = 0.0    # >0: deliver ONLY asynchronously after
+    #                               this delay; sender sees message loss
+
+
+_DELIVER = Verdict()
+
+
+@dataclass
+class _Cut:
+    expires_at: Optional[float] = None  # plan-clock time; None = until heal
+
+
+class FaultPlan:
+    """Seeded, virtual-time-aware fault schedule (see module docstring).
+
+    Thread-safe: transports call decide() from raft tick / RPC threads
+    while the scenario runner mutates the rule set.
+    """
+
+    def __init__(self, seed: int = 0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.seed = seed
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._cut_links: Dict[Tuple[str, str], _Cut] = {}
+        self._cut_nodes: Dict[str, _Cut] = {}
+        # (src|None, dst|None) -> rule; None is a wildcard side
+        self._rules: Dict[Tuple[Optional[str], Optional[str]], LinkFaults] = {}
+        self._counters: Dict[Tuple[str, str], int] = {}
+        self.stats: Dict[str, int] = {
+            "delivered": 0, "cut": 0, "dropped": 0, "delayed": 0,
+            "duplicated": 0, "reordered": 0}
+
+    # -- scripted cuts --
+
+    def cut_link(self, src: str, dst: str,
+                 for_s: Optional[float] = None) -> None:
+        """Cut the directed link src -> dst (dst -> src stays up)."""
+        expires = None if for_s is None else self.clock() + for_s
+        with self._lock:
+            self._cut_links[(src, dst)] = _Cut(expires)
+
+    def heal_link(self, src: str, dst: str) -> None:
+        with self._lock:
+            self._cut_links.pop((src, dst), None)
+
+    def cut_node(self, node_id: str, for_s: Optional[float] = None) -> None:
+        """Cut every link to and from node_id (symmetric isolation)."""
+        expires = None if for_s is None else self.clock() + for_s
+        with self._lock:
+            self._cut_nodes[node_id] = _Cut(expires)
+
+    def heal_node(self, node_id: str) -> None:
+        with self._lock:
+            self._cut_nodes.pop(node_id, None)
+
+    def heal_all(self) -> None:
+        """Heal every cut; probabilistic rules stay (clear_faults)."""
+        with self._lock:
+            self._cut_links.clear()
+            self._cut_nodes.clear()
+
+    # -- probabilistic rules --
+
+    def set_link_faults(self, src: Optional[str] = None,
+                        dst: Optional[str] = None,
+                        faults: Optional[LinkFaults] = None,
+                        **kw) -> None:
+        """Attach a fault rule to a link; None on either side is a
+        wildcard (set_link_faults(drop=0.1) faults every link)."""
+        with self._lock:
+            self._rules[(src, dst)] = faults if faults is not None \
+                else LinkFaults(**kw)
+
+    def clear_faults(self) -> None:
+        with self._lock:
+            self._rules.clear()
+
+    def quiesce(self) -> None:
+        """Heal everything — cuts and probabilistic rules."""
+        with self._lock:
+            self._cut_links.clear()
+            self._cut_nodes.clear()
+            self._rules.clear()
+
+    # -- the per-message verdict --
+
+    def _cut_active_locked(self, cut: Optional[_Cut], now: float) -> bool:
+        if cut is None:
+            return False
+        return cut.expires_at is None or now < cut.expires_at
+
+    def decide(self, src: str, dst: str, msg: Optional[dict] = None) -> Verdict:
+        now = self.clock()
+        with self._lock:
+            if (self._cut_active_locked(self._cut_nodes.get(src), now)
+                    or self._cut_active_locked(self._cut_nodes.get(dst), now)
+                    or self._cut_active_locked(
+                        self._cut_links.get((src, dst)), now)):
+                self.stats["cut"] += 1
+                return Verdict(drop=True)
+            rule = (self._rules.get((src, dst))
+                    or self._rules.get((None, dst))
+                    or self._rules.get((src, None))
+                    or self._rules.get((None, None)))
+            if rule is None:
+                self.stats["delivered"] += 1
+                return _DELIVER
+            n = self._counters.get((src, dst), 0)
+            self._counters[(src, dst)] = n + 1
+        # three independent uniform rolls + a delay magnitude, all derived
+        # from (seed, link, n) so replays are interleaving-independent
+        u = _hash_uniforms(self.seed, src, dst, n, 4)
+        lo, hi = rule.delay_range
+        span = lo + (hi - lo) * u[3]
+        with self._lock:
+            if u[0] < rule.drop:
+                self.stats["dropped"] += 1
+                return Verdict(drop=True)
+            if u[0] < rule.drop + rule.reorder:
+                self.stats["reordered"] += 1
+                return Verdict(reorder_after=span)
+            v = Verdict()
+            if u[1] < rule.delay:
+                v.delay = span
+                self.stats["delayed"] += 1
+            if u[2] < rule.duplicate:
+                v.duplicate_after = max(span, 0.005)
+                self.stats["duplicated"] += 1
+            if not v.delay and not v.duplicate_after:
+                self.stats["delivered"] += 1
+            return v
+
+    def snapshot_stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.stats)
+
+
+def _hash_uniforms(seed: int, src: str, dst: str, n: int,
+                   count: int) -> list:
+    """`count` uniforms in [0,1) from a stable hash of the message
+    coordinates (thread-interleaving-independent determinism)."""
+    h = hashlib.sha256(f"{seed}:{src}>{dst}:{n}".encode()).digest()
+    out = []
+    for i in range(count):
+        chunk = h[i * 8:(i + 1) * 8]
+        out.append(int.from_bytes(chunk, "big") / 2 ** 64)
+    return out

@@ -1,0 +1,112 @@
+"""Scenario runner: scripted fault sequences with invariants between steps.
+
+A scenario is an ordered list of named steps — plain callables that
+poke the cluster (cut links, crash servers, write data, heal). After
+every step the runner sweeps the history invariants (election safety,
+log matching, committed durability); liveness checks (convergence,
+reschedule) are steps themselves, placed where the scenario expects
+quiescence.
+
+Determinism: the fault seed comes from ``NOMAD_TPU_CHAOS_SEED``
+(default 0). Every probabilistic verdict a ``FaultPlan`` hands out is
+derived by hashing (seed, link, per-link message counter), so a failing
+run replays with::
+
+    NOMAD_TPU_CHAOS_SEED=1234 python -m pytest tests/test_chaos.py -x
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Callable, List, Optional, Tuple
+
+from .invariants import InvariantChecker, InvariantViolation
+from .plan import FaultPlan
+
+log = logging.getLogger("nomad_tpu.chaos")
+
+__all__ = ["ScenarioRunner", "seed_from_env", "InvariantViolation"]
+
+
+def seed_from_env(default: int = 0) -> int:
+    raw = os.environ.get("NOMAD_TPU_CHAOS_SEED", "")
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        log.warning("NOMAD_TPU_CHAOS_SEED=%r is not an int; using %d",
+                    raw, default)
+        return default
+
+
+class ScenarioRunner:
+    """Drive one scripted scenario against a live RaftCluster.
+
+    The runner wires a seeded FaultPlan into the cluster transport,
+    executes steps in order, and runs the safety sweep after each one.
+    ``quiesce()`` before teardown clears every standing fault so the
+    cluster can converge (and late Timer deliveries become no-ops).
+    """
+
+    def __init__(self, cluster, seed: Optional[int] = None,
+                 checker: Optional[InvariantChecker] = None):
+        self.cluster = cluster
+        self.seed = seed_from_env() if seed is None else seed
+        self.plan = FaultPlan(seed=self.seed)
+        self.checker = checker or InvariantChecker()
+        self._steps: List[Tuple[str, Callable[["ScenarioRunner"], None]]] = []
+        self.report = {"seed": self.seed, "steps": []}
+        if hasattr(cluster.transport, "set_fault_plan"):
+            cluster.transport.set_fault_plan(self.plan)
+
+    def add(self, name: str,
+            fn: Callable[["ScenarioRunner"], None]) -> "ScenarioRunner":
+        self._steps.append((name, fn))
+        return self
+
+    def step(self, name: str):
+        """Decorator form: @runner.step("cut leader->follower")."""
+        def register(fn):
+            self.add(name, fn)
+            return fn
+        return register
+
+    def run(self) -> dict:
+        log.info("scenario start: %d step(s), seed=%d",
+                 len(self._steps), self.seed)
+        try:
+            for name, fn in self._steps:
+                t0 = time.monotonic()
+                fn(self)
+                self.checker.check_all(self.cluster)
+                dt = time.monotonic() - t0
+                self.report["steps"].append({"name": name,
+                                             "seconds": round(dt, 3)})
+                log.info("step ok (%.2fs): %s", dt, name)
+        finally:
+            self.quiesce()
+            self.report["faults"] = self.plan.snapshot_stats()
+            self.report["invariants"] = dict(self.checker.stats)
+        return self.report
+
+    def quiesce(self) -> None:
+        """Clear all faults so teardown/convergence isn't fighting the
+        plan: heal cuts, zero probabilities, heal transport links."""
+        self.plan.quiesce()
+        if hasattr(self.cluster.transport, "heal"):
+            self.cluster.transport.heal()
+
+    # -- step helpers (the verbs scenarios are written in) -----------
+
+    def heal_and_converge(self, timeout: float = 15.0) -> None:
+        self.quiesce()
+        self.checker.check_convergence(self.cluster, timeout=timeout)
+
+    def wait_for_leader(self, timeout: float = 10.0):
+        leader = self.cluster.wait_for_leader(timeout=timeout)
+        if leader is None:
+            raise InvariantViolation(
+                f"no leader elected within {timeout:.0f}s "
+                f"(seed={self.seed})")
+        return leader

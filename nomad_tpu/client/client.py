@@ -132,17 +132,11 @@ class Client:
         client/client.go:1735 registerAndHeartbeat retries with backoff;
         a client crashing because it raced the election would take the
         whole agent process down with it)."""
-        deadline = time.time() + deadline_s
-        delay = 0.2
-        while True:
-            try:
-                self.server.register_node(self.node)
-                return
-            except Exception:
-                if self._stop.is_set() or time.time() >= deadline:
-                    raise
-                time.sleep(delay)
-                delay = min(delay * 2, 5.0)
+        from ..utils.backoff import Retryer
+
+        Retryer(deadline_s=deadline_s, base=0.2, cap=5.0,
+                stop=self._stop).call(
+            lambda: self.server.register_node(self.node))
 
     def stop(self) -> None:
         self._stop.set()
@@ -218,14 +212,25 @@ class Client:
     # -- heartbeats (client.go:1735 registerAndHeartbeat) --
 
     def _run_heartbeat(self) -> None:
+        from ..utils.backoff import Backoff
+
+        # while the server is unreachable each failed heartbeat RPC
+        # burns a 5 s forwarding deadline (raft/cluster.py _forward), so
+        # consecutive failures space out on a jittered backoff instead
+        # of hammering a cluster that is mid-election
+        failure_backoff = Backoff(base=self.config.heartbeat_interval,
+                                  factor=2.0, cap=5.0, jitter=0.25)
         while not self._stop.wait(self.config.heartbeat_interval):
             try:
                 self.server.heartbeat(self.node.id)
                 self._last_heartbeat_ok = time.time()
+                failure_backoff.reset()
             except Exception:
                 # server unreachable: the TTL will mark us down; local
                 # stop_after_client_disconnect timers start running
                 self._check_heartbeat_stop()
+                if self._stop.wait(failure_backoff.next_delay()):
+                    return
 
     def _check_heartbeat_stop(self) -> None:
         """Stop allocs whose stop_after_client_disconnect window expired

@@ -51,6 +51,18 @@ class HeartbeatManager:
             t.start()
             return self.ttl
 
+    def restore(self, node_ids) -> int:
+        """Arm TTLs for nodes recovered from replicated state (reference
+        heartbeat.go initializeHeartbeatTimers): a freshly established
+        leader must time out clients that went silent during the
+        failover — not only the ones that heartbeat again. Returns the
+        number of timers armed."""
+        count = 0
+        for node_id in node_ids:
+            self.reset(node_id)
+            count += 1
+        return count
+
     def remove(self, node_id: str) -> None:
         with self._lock:
             t = self._timers.pop(node_id, None)

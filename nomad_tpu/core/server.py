@@ -138,6 +138,7 @@ class Server:
         self.broker.set_enabled(True)
         self.blocked.set_enabled(True)
         self.heartbeats.set_enabled(True)
+        self._restore_heartbeats()
         self._restore_scheduler_config()
         self._restore_evals()
         for w in self.workers:
@@ -274,6 +275,16 @@ class Server:
         cfg = self.store.snapshot().scheduler_configuration()
         if cfg is not None:
             self._apply_scheduler_config(cfg)
+
+    def _restore_heartbeats(self) -> None:
+        """Arm TTL timers from replicated state on establishLeadership
+        (reference heartbeat.go initializeHeartbeatTimers). Without
+        this, a client that went silent during a leader failover is
+        never invalidated by the new leader — its timer lived only on
+        the old one — and its allocs are never rescheduled."""
+        ready = [n.id for n in self.store.snapshot().nodes()
+                 if n.status == enums.NODE_STATUS_READY]
+        self.heartbeats.restore(ready)
 
     def _restore_evals(self) -> None:
         """Re-enqueue non-terminal evals and re-track periodic parents

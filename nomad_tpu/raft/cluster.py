@@ -17,6 +17,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..core.server import Server, ServerConfig
 from ..state import StateStore
+from ..utils.backoff import Backoff, Retryer
 from .fsm import FSM, RaftStore
 from .node import NotLeaderError, RaftNode
 from .transport import InProcTransport, RemoteCallError, TransportError
@@ -68,6 +69,7 @@ class ReplicatedServer:
                  gossip_bind: Optional[str] = None,
                  gossip_seeds: Optional[List[str]] = None):
         self.id = node_id
+        self.crashed = False  # set by crash(); chaos invariants skip dead nodes
         self.local_store = StateStore()
         self.fsm = FSM(self.local_store)
         self.data_dir = data_dir
@@ -117,6 +119,10 @@ class ReplicatedServer:
         self._gossip_stop = threading.Event()
         self._gossip_dead_since = {}
         self._gossip_auto_join_disabled = False
+        # seed (re-)join backoff: a lone agent whose seeds weren't up yet
+        # keeps introducing itself, ever more slowly (utils/backoff.py)
+        self._seed_backoff = Backoff(base=0.5, factor=2.0, cap=10.0)
+        self._next_seed_join = 0.0
         if gossip_bind is not None:
             from .gossip import GossipAgent
 
@@ -165,8 +171,7 @@ class ReplicatedServer:
         leads, else one forwarded hop (the joiner only knows the address
         it contacted; this member knows the leader — reference
         nomad/serf.go join forwarding)."""
-        deadline = time.time() + 10.0
-        while time.time() < deadline:
+        for _ in Retryer(deadline_s=10.0, base=0.05, cap=0.5, jitter=0.25):
             if self.raft.is_leader():
                 getattr(self.raft, op)(*args)
                 return {"ok": True}
@@ -185,7 +190,6 @@ class ReplicatedServer:
                         raise
                 except TransportError:
                     pass
-            time.sleep(0.05)
         raise NotLeaderError(self.raft.leader_id)
 
     def join(self, contact_addr: str, timeout: float = 15.0) -> None:
@@ -197,17 +201,15 @@ class ReplicatedServer:
             raise RuntimeError("join requires the socket transport")
         contact_id = f"_join:{contact_addr}"
         transport.peer_addrs[contact_id] = contact_addr
-        deadline = time.time() + timeout
         last_err = None
         try:
-            while time.time() < deadline:
+            for _ in Retryer(deadline_s=timeout, base=0.2, cap=1.0):
                 try:
                     transport.call(contact_id, "raft_add_server",
                                    (self.id, transport.bind_addr), {})
                     return
                 except (RemoteCallError, TransportError) as e:
                     last_err = e
-                    time.sleep(0.2)
         finally:
             transport.peer_addrs.pop(contact_id, None)
         raise TimeoutError(f"join via {contact_addr} failed: {last_err}")
@@ -236,6 +238,26 @@ class ReplicatedServer:
                 self.server.stop()
         self.raft.stop()
 
+    def crash(self) -> None:
+        """Abrupt kill (chaos harness): the node stops answering and
+        sending immediately — no graceful leader handoff, no flush
+        beyond what each append already fsynced — so the durable state
+        left on disk is exactly what a real process crash leaves.
+        Restart by building a fresh ReplicatedServer over the same
+        data_dir (RaftCluster.restart)."""
+        self.crashed = True
+        if hasattr(self.transport, "unregister"):
+            self.transport.unregister(self.id)
+        self._gossip_stop.set()
+        if self.gossip is not None:
+            self.gossip.stop()
+        self.raft.stop()
+        with self._lock:
+            if self.server._running:
+                self.server.stop()
+        if hasattr(self.raft.log, "close"):
+            self.raft.log.close()
+
     def set_gossip_http(self, http_addr: str) -> None:
         """Advertise this server's agent HTTP address in gossip meta
         (WAN members use it to keep the federation region registry
@@ -254,6 +276,7 @@ class ReplicatedServer:
 
     def _run_gossip_reconcile(self) -> None:
         while not self._gossip_stop.wait(self.GOSSIP_RECONCILE_INTERVAL):
+            self._maybe_rejoin_seeds()
             if not self.raft.is_leader():
                 continue
             try:
@@ -262,6 +285,23 @@ class ReplicatedServer:
                 # transient raft state changes; next tick retries
                 log.debug("gossip reconcile tick failed on %s",
                           self.id, exc_info=True)
+
+    def _maybe_rejoin_seeds(self) -> None:
+        """A single UDP join datagram to a not-yet-listening seed is
+        simply lost: while this agent knows nobody but itself, keep
+        re-introducing it to the seeds on an escalating backoff."""
+        if self.gossip is None or not self._gossip_seeds:
+            return
+        if len(self.gossip.alive_members()) > 1:
+            self._seed_backoff.reset()
+            self._next_seed_join = 0.0
+            return
+        now = time.time()
+        if now < self._next_seed_join:
+            return
+        self._next_seed_join = now + self._seed_backoff.next_delay()
+        for seed in self._gossip_seeds:
+            self.gossip.join(seed)
 
     # a gossip-DEAD verdict must persist this long before the leader
     # removes the voter: one dropped UDP probe or a brief stall must not
@@ -366,8 +406,10 @@ class ReplicatedServer:
         """Run the endpoint on the leader: locally if this node leads,
         in-process via peer_lookup, or over the socket transport
         (reference nomad/rpc.go:445 forward)."""
-        deadline = time.time() + 5.0
-        while time.time() < deadline:
+        # jittered backoff instead of a fixed 20 ms poll: during an
+        # election every forwarder on every node spins this loop, and
+        # synchronized polls pile onto the freshly elected leader
+        for _ in Retryer(deadline_s=5.0, base=0.02, cap=0.25, jitter=0.5):
             if self.is_leader():
                 return getattr(self.server, name)(*args, **kwargs)
             lid = self.raft.leader_id
@@ -382,7 +424,6 @@ class ReplicatedServer:
                     except RemoteCallError as e:
                         if e.error_type == "NotLeaderError":
                             # stale leader hint: wait for the next election
-                            time.sleep(0.02)
                             continue
                         cls = self._WIRE_ERRORS.get(e.error_type)
                         if cls is not None:
@@ -396,7 +437,6 @@ class ReplicatedServer:
                         if getattr(e, "maybe_delivered", False):
                             raise
                         # connect failure: definitely not delivered; retry
-            time.sleep(0.02)
         raise NotLeaderError(self.raft.leader_id)
 
     def __getattr__(self, name: str):
@@ -416,6 +456,10 @@ class RaftCluster:
                  data_dir: Optional[str] = None, snapshot_threshold: int = 1024):
         self.transport = InProcTransport()
         ids = [f"server-{i}" for i in range(n)]
+        self._ids = ids
+        self._config_fn = config_fn
+        self._data_dir = data_dir
+        self._snapshot_threshold = snapshot_threshold
         self.servers: Dict[str, ReplicatedServer] = {}
         for i, node_id in enumerate(ids):
             cfg = config_fn(i) if config_fn else ServerConfig(heartbeat_ttl=30.0)
@@ -443,6 +487,33 @@ class RaftCluster:
 
     def __exit__(self, *exc):
         self.stop()
+
+    # -- chaos crash/restart (the harness's server-death injection) --
+
+    def crash(self, node_id: str) -> ReplicatedServer:
+        """Kill one server abruptly (see ReplicatedServer.crash). The
+        dead instance stays in self.servers until restart() replaces
+        it, like a dead process whose data_dir persists."""
+        server = self.servers[node_id]
+        server.crash()
+        return server
+
+    def restart(self, node_id: str) -> ReplicatedServer:
+        """Start a fresh ReplicatedServer over the crashed one's
+        data_dir — the durable-recovery path a real restart takes.
+        Meaningful only for clusters built with data_dir (otherwise the
+        replacement boots empty and rejoins via snapshot transfer)."""
+        old = self.servers[node_id]
+        i = self._ids.index(node_id)
+        cfg = (self._config_fn(i) if self._config_fn
+               else ServerConfig(heartbeat_ttl=30.0))
+        replacement = ReplicatedServer(
+            node_id, self._ids, self.transport, cfg,
+            peer_lookup=self.servers.get, data_dir=old.data_dir,
+            snapshot_threshold=self._snapshot_threshold)
+        self.servers[node_id] = replacement
+        replacement.start()
+        return replacement
 
     def wait_for_leader(self, timeout: float = 10.0) -> Optional[ReplicatedServer]:
         deadline = time.time() + timeout

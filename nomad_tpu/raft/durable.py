@@ -17,13 +17,17 @@ Layout under <dir>/:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 from typing import List, Optional, Tuple
 
 from ..structs.wire import wire_decode, wire_encode
 from ..utils.files import atomic_write_text as _atomic_write
+from ..utils.files import check_fault as _check_fault
 from .log import Entry
+
+log = logging.getLogger("nomad_tpu.raft")
 
 
 class StableStore:
@@ -41,10 +45,12 @@ class StableStore:
             self.voted_for = data.get("voted_for")
 
     def save(self, term: int, voted_for: Optional[str]) -> None:
-        self.term = term
-        self.voted_for = voted_for
+        # disk first: if the write fails (ENOSPC, injected fault), the
+        # in-memory view must not claim a persistence that never happened
         _atomic_write(self._path,
                       json.dumps({"term": term, "voted_for": voted_for}))
+        self.term = term
+        self.voted_for = voted_for
 
 
 class SnapshotStore:
@@ -102,12 +108,17 @@ class DurableLog:
                     if line:
                         try:
                             rec = json.loads(line)
-                        except json.JSONDecodeError:
-                            # torn tail write (crash mid-append): drop it
+                            e = Entry(index=int(rec["index"]),
+                                      term=int(rec["term"]),
+                                      command=tuple(
+                                          wire_decode(rec["command"])))
+                        except (ValueError, KeyError, TypeError):
+                            # torn tail write (crash mid-append) — or a
+                            # JSON-shaped fragment missing fields: drop
+                            # it and everything after; never brick the
+                            # server on restart
                             torn = True
                             break
-                        e = Entry(index=rec["index"], term=rec["term"],
-                                  command=tuple(wire_decode(rec["command"])))
                         if e.index > self.base_index:
                             # conflict-truncated entries may linger
                             # physically; keep the last write per index
@@ -120,12 +131,20 @@ class DurableLog:
                             self._entries.append(e)
                     good_offset += len(raw)
             if torn:
+                last_idx = (self._entries[-1].index if self._entries
+                            else self.base_index)
+                dropped = os.path.getsize(self._path) - good_offset
+                log.warning(
+                    "%s: torn tail (%d byte(s) past entry %d) dropped; "
+                    "truncating to the last good entry",
+                    self._path, dropped, last_idx)
                 # truncate the garbage so the next append starts clean
                 with open(self._path, "r+b") as f:
                     f.truncate(good_offset)
         self._fh = open(self._path, "a")
 
     def _write(self, entries: List[Entry]) -> None:
+        _check_fault("log_append", self._path)
         for e in entries:
             self._fh.write(json.dumps({
                 "index": e.index, "term": e.term,
@@ -137,17 +156,23 @@ class DurableLog:
     def _rewrite(self) -> None:
         """Rewrite the whole file from the logical view (truncation or
         compaction — both rare)."""
+        _check_fault("log_rewrite", self._path)
         self._fh.close()
         tmp = self._path + ".tmp"
-        with open(tmp, "w") as f:
-            for e in self._entries:
-                f.write(json.dumps({
-                    "index": e.index, "term": e.term,
-                    "command": wire_encode(list(e.command))}) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._path)
-        self._fh = open(self._path, "a")
+        try:
+            with open(tmp, "w") as f:
+                for e in self._entries:
+                    f.write(json.dumps({
+                        "index": e.index, "term": e.term,
+                        "command": wire_encode(list(e.command))}) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._path)
+        finally:
+            # even a failed rewrite (disk fault) leaves the old file in
+            # place atomically; the append handle must come back either
+            # way or every later write dies on a closed fh
+            self._fh = open(self._path, "a")
 
     def close(self) -> None:
         with self._lock:
@@ -198,11 +223,19 @@ class DurableLog:
                     else self.base_index)
             e = Entry(index=last + 1, term=term, command=command)
             self._entries.append(e)
-            self._write([e])
+            try:
+                self._write([e])
+            except OSError:
+                # disk fault (ENOSPC/EIO): roll the in-memory entry back
+                # so memory never claims an entry the disk lost — a
+                # crash-restart would otherwise drop an acked write
+                del self._entries[-1]
+                raise
             return e
 
     def append_entries(self, prev_index: int, entries: List[Entry]) -> bool:
         with self._lock:
+            before_len = len(self._entries)
             appended: List[Entry] = []
             truncated = False
             for e in entries:
@@ -219,10 +252,17 @@ class DurableLog:
                 else:
                     self._entries.append(e)
                     appended.append(e)
-            if truncated:
-                self._rewrite()
-            elif appended:
-                self._write(appended)
+            try:
+                if truncated:
+                    self._rewrite()
+                elif appended:
+                    self._write(appended)
+            except OSError:
+                if not truncated:
+                    # plain-append fault: shed the entries the disk
+                    # never saw (the follower will nack and be retried)
+                    del self._entries[before_len:]
+                raise
             return truncated
 
     def length(self) -> int:

@@ -11,6 +11,7 @@ the linearizable write the plan applier needs.
 
 from __future__ import annotations
 
+import copy
 import logging
 import random
 import threading
@@ -140,6 +141,12 @@ class RaftNode:
     def apply(self, command: tuple, timeout: float = 5.0):
         """Leader-only: replicate a command, wait for commit + local
         apply, return the FSM result. Raises NotLeaderError otherwise."""
+        # Freeze the payload: callers keep mutating their structs after
+        # proposing (eval status transitions, alloc updates), and a log
+        # entry aliasing those objects would retransmit the MUTATED
+        # payload to any follower that catches up later — replicas
+        # applying different commands at the same index.
+        command = copy.deepcopy(command)
         with self._lock:
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_id)

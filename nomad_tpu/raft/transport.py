@@ -22,39 +22,111 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Callable, Dict, Optional, Tuple
+
+from ..utils.backoff import Backoff
 
 log = logging.getLogger("nomad_tpu.raft")
 
 
 class InProcTransport:
     """A registry of node handlers; send() is a function call with a
-    configurable failure set for partition tests."""
+    configurable failure set for partition tests.
+
+    Failure model, consulted in order per message:
+    - per-node partitions (symmetric: the node is cut from everyone);
+    - directed per-link cuts (partition_link(a, b) drops a->b only —
+      the asymmetric failures real networks produce);
+    - an optional chaos FaultPlan (chaos/plan.py) deciding
+      drop/delay/duplicate/reorder per message.
+    """
 
     def __init__(self):
         self._handlers: Dict[str, Callable[[dict], dict]] = {}
         self._lock = threading.Lock()
         self._partitioned: set = set()  # node ids cut off from everyone
+        self._cut_links: set = set()    # directed (src, dst) pairs
+        self.fault_plan = None          # chaos.FaultPlan or None
 
     def register(self, node_id: str, handler: Callable[[dict], dict]) -> None:
         with self._lock:
             self._handlers[node_id] = handler
 
+    def unregister(self, node_id: str) -> None:
+        """Crashed process: its handler vanishes (chaos crash path)."""
+        with self._lock:
+            self._handlers.pop(node_id, None)
+
     def partition(self, node_id: str) -> None:
         with self._lock:
             self._partitioned.add(node_id)
 
-    def heal(self, node_id: str) -> None:
+    def partition_link(self, src: str, dst: str) -> None:
+        """Cut src -> dst only; dst -> src still delivers."""
         with self._lock:
-            self._partitioned.discard(node_id)
+            self._cut_links.add((src, dst))
+
+    def heal_link(self, src: str, dst: str) -> None:
+        with self._lock:
+            self._cut_links.discard((src, dst))
+
+    def heal(self, node_id: Optional[str] = None) -> None:
+        """Heal one node's symmetric partition, or — with no argument —
+        heal everything: node partitions and directed link cuts."""
+        with self._lock:
+            if node_id is None:
+                self._partitioned.clear()
+                self._cut_links.clear()
+            else:
+                self._partitioned.discard(node_id)
+
+    def set_fault_plan(self, plan) -> None:
+        self.fault_plan = plan
+
+    def _deliver_later(self, to_id: str, msg: dict, delay: float) -> None:
+        """Late/duplicate delivery: hand the message to whoever holds
+        the node id at delivery time (survives crash-restart) and drop
+        the reply — the sender already moved on."""
+        def fire():
+            with self._lock:
+                if to_id in self._partitioned:
+                    return
+                handler = self._handlers.get(to_id)
+            if handler is None:
+                return
+            try:
+                handler(msg)
+            except Exception:
+                log.debug("late-delivered message to %s raised",
+                          to_id, exc_info=True)
+        t = threading.Timer(delay, fire)
+        t.daemon = True
+        t.start()
 
     def send(self, from_id: str, to_id: str, msg: dict) -> Optional[dict]:
         with self._lock:
             if from_id in self._partitioned or to_id in self._partitioned:
                 return None
+            if (from_id, to_id) in self._cut_links:
+                return None
             handler = self._handlers.get(to_id)
         if handler is None:
             return None
+        plan = self.fault_plan
+        if plan is not None:
+            verdict = plan.decide(from_id, to_id, msg)
+            if verdict.drop:
+                return None
+            if verdict.reorder_after > 0:
+                # late delivery out of order with successors; the sender
+                # sees message loss (raft tolerates both)
+                self._deliver_later(to_id, msg, verdict.reorder_after)
+                return None
+            if verdict.delay > 0:
+                time.sleep(verdict.delay)
+            if verdict.duplicate_after > 0:
+                self._deliver_later(to_id, msg, verdict.duplicate_after)
         try:
             return handler(msg)
         except Exception:
@@ -128,8 +200,14 @@ class SocketTransport:
         self._conns: Dict[Tuple[str, str], socket.socket] = {}
         self._conn_locks: Dict[Tuple[str, str], threading.Lock] = {}
         self._down_until: Dict[Tuple[str, str], float] = {}
+        # per-link escalating reconnect backoff (utils/backoff.py): a
+        # peer that stays down is probed ever more slowly up to the cap,
+        # and a restarted peer resets to the base on first contact
+        self._backoffs: Dict[Tuple[str, str], Backoff] = {}
+        self._exhaustion_logged: set = set()
         self._lock = threading.Lock()
         self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self.fault_plan = None  # chaos.FaultPlan or None
 
     # -- registration (transport interface) --
 
@@ -142,6 +220,10 @@ class SocketTransport:
         """handler(method, args, kwargs) -> result; exceptions propagate
         back to the caller as typed error replies."""
         self._call_handler = handler
+
+    def set_fault_plan(self, plan) -> None:
+        """Attach a chaos FaultPlan consulted per outgoing raft frame."""
+        self.fault_plan = plan
 
     # -- server side --
 
@@ -222,35 +304,65 @@ class SocketTransport:
         host, _, port = addr.rpartition(":")
         return host or "127.0.0.1", int(port)
 
-    def _conn(self, key: Tuple[str, str]) -> Tuple[socket.socket, threading.Lock]:
-        import time as _time
+    def _mark_down(self, key: Tuple[str, str]) -> None:
+        """Peer unreachable: schedule the next probe on an escalating
+        jittered backoff; log once when the backoff saturates (retry
+        exhaustion — the peer has been down for many probes)."""
+        with self._lock:
+            bo = self._backoffs.get(key)
+            if bo is None:
+                bo = self._backoffs[key] = Backoff(
+                    base=self.retry_cooldown, factor=2.0,
+                    cap=max(self.retry_cooldown * 8, 2.0), jitter=0.2)
+            at_cap = bo.at_cap()
+            self._down_until[key] = time.monotonic() + bo.next_delay()
+            if at_cap and key not in self._exhaustion_logged:
+                self._exhaustion_logged.add(key)
+                log.warning(
+                    "%s: peer %s (%s channel) unreachable after %d "
+                    "attempts; retrying at the capped interval",
+                    self.node_id, key[0], key[1], bo.attempt)
 
+    def _mark_up(self, key: Tuple[str, str]) -> None:
+        with self._lock:
+            self._down_until.pop(key, None)
+            bo = self._backoffs.get(key)
+            if bo is not None:
+                bo.reset()
+            if key in self._exhaustion_logged:
+                self._exhaustion_logged.discard(key)
+                log.info("%s: peer %s (%s channel) reachable again",
+                         self.node_id, key[0], key[1])
+
+    def _conn(self, key: Tuple[str, str]) \
+            -> Tuple[socket.socket, threading.Lock, bool]:
+        """Returns (socket, per-connection lock, was_cached). A cached
+        socket may be stale (peer restarted since) — callers sending
+        idempotent frames retry once on a fresh connection."""
         with self._lock:
             lock = self._conn_locks.setdefault(key, threading.Lock())
             sock = self._conns.get(key)
-            if sock is None and _time.monotonic() < self._down_until.get(key, 0):
+            if sock is None and time.monotonic() < self._down_until.get(key, 0):
                 raise TransportError(f"{key[0]} in reconnect cooldown")
         if sock is not None:
-            return sock, lock
+            return sock, lock, True
         host, port = self._split(self.peer_addrs[key[0]])
         try:
             sock = socket.create_connection((host, port),
                                             timeout=self.connect_timeout)
         except OSError:
-            with self._lock:
-                self._down_until[key] = _time.monotonic() + self.retry_cooldown
+            self._mark_down(key)
             raise
-        with self._lock:
-            self._down_until.pop(key, None)
+        self._mark_up(key)
         sock.settimeout(self.raft_timeout if key[1] == "raft" else self.timeout)
         with self._lock:
             # lost a race? keep the first connection
             existing = self._conns.get(key)
             if existing is not None:
                 sock.close()
-                return existing, lock
+                return existing, lock, True
             self._conns[key] = sock
-        return sock, lock
+        return sock, lock, False
 
     def _drop(self, key: Tuple[str, str]) -> None:
         with self._lock:
@@ -267,21 +379,38 @@ class SocketTransport:
         # separate connections per frame kind so a large forwarded call
         # can't stall raft heartbeats behind it (the reference gets this
         # from yamux stream multiplexing)
-        import time as _time
-
         key = (to_id, frame["t"])
-        try:
-            sock, lock = self._conn(key)
-            with lock:  # one in-flight request per connection
-                _send_frame(sock, frame)
-                return _recv_frame(sock)
-        except Exception:
-            self._drop(key)
-            with self._lock:
-                # hung or dead peer: skip it for a cooldown so serial
-                # raft fan-outs keep heartbeating the healthy peers
-                self._down_until[key] = _time.monotonic() + self.retry_cooldown
-            return None
+        for attempt in (0, 1):
+            try:
+                sock, lock, cached = self._conn(key)
+            except Exception:
+                log.debug("%s: cannot reach %s", self.node_id, to_id,
+                          exc_info=True)
+                return None
+            try:
+                with lock:  # one in-flight request per connection
+                    _send_frame(sock, frame)
+                    reply = _recv_frame(sock)
+            except Exception:
+                self._drop(key)
+                if cached and attempt == 0:
+                    # a cached connection that dies is the signature of
+                    # a RESTARTED peer: raft frames are idempotent, so
+                    # retry once on a fresh connection instead of
+                    # failing the send permanently
+                    continue
+                # hung or dead peer: back off so serial raft fan-outs
+                # keep heartbeating the healthy peers
+                self._mark_down(key)
+                return None
+            if reply is None:
+                self._drop(key)
+                if cached and attempt == 0:
+                    continue
+                self._mark_down(key)
+                return None
+            return reply
+        return None
 
     def send(self, from_id: str, to_id: str, msg: dict) -> Optional[dict]:
         """Raft message send (transport interface). Snapshot installs get
@@ -291,7 +420,28 @@ class SocketTransport:
         from ..structs.wire import wire_decode, wire_encode
 
         channel = "snap" if msg.get("kind") == "install_snapshot" else "raft"
-        reply = self._roundtrip(to_id, {"t": channel, "m": wire_encode(msg)})
+        frame = {"t": channel, "m": wire_encode(msg)}
+        plan = self.fault_plan
+        if plan is not None:
+            verdict = plan.decide(self.node_id, to_id, msg)
+            if verdict.drop:
+                return None
+            if verdict.reorder_after > 0:
+                # deliver late from a side thread, reply discarded;
+                # raft treats the original send as lost
+                t = threading.Timer(verdict.reorder_after,
+                                    self._roundtrip, (to_id, frame))
+                t.daemon = True
+                t.start()
+                return None
+            if verdict.delay > 0:
+                time.sleep(verdict.delay)
+            if verdict.duplicate_after > 0:
+                t = threading.Timer(verdict.duplicate_after,
+                                    self._roundtrip, (to_id, frame))
+                t.daemon = True
+                t.start()
+        reply = self._roundtrip(to_id, frame)
         if reply is None or not reply.get("ok"):
             return None
         return wire_decode(reply["m"])
@@ -313,7 +463,7 @@ class SocketTransport:
         key = (to_id, "call")
         for attempt in (0, 1):
             try:
-                sock, lock = self._conn(key)
+                sock, lock, _cached = self._conn(key)
             except TransportError:
                 raise
             except Exception as e:  # connect failed: definitely not delivered

@@ -4,11 +4,28 @@ state DB (reference helper/ file utilities)."""
 from __future__ import annotations
 
 import os
+from typing import Callable, Optional
+
+# chaos fs fault shim (chaos/fsfaults.py): a no-op until a scenario
+# installs a hook; durable-layer writes call check_fault before disk IO
+_fault_hook: Optional[Callable[[str, str], None]] = None
+
+
+def set_fault_hook(hook: Optional[Callable[[str, str], None]]) -> None:
+    global _fault_hook
+    _fault_hook = hook
+
+
+def check_fault(op: str, path: str) -> None:
+    hook = _fault_hook
+    if hook is not None:
+        hook(op, path)
 
 
 def atomic_write_text(path: str, payload: str) -> None:
     """Write-temp + fsync + rename so readers see the old or the new
     file, never a torn one."""
+    check_fault("atomic_write_text", path)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         f.write(payload)

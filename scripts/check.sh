@@ -26,6 +26,12 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" NOMAD_TPU_SAN=1 python -m pytest \
     tests/test_plan_apply_scale.py -q \
     -p no:cacheprovider || failed=1
 
+# chaos smoke: one scripted partition + crash scenario on a durable
+# 3-node cluster, fixed seed, safety invariants between steps
+# (see ROBUSTNESS.md; the full matrix is tests/test_chaos.py)
+echo "== chaos smoke (python -m nomad_tpu.chaos) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m nomad_tpu.chaos || failed=1
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \

@@ -1,0 +1,109 @@
+"""utils/backoff.py: the one retry policy every recovery path shares
+(client registration/heartbeat, leader forwarding, socket reconnect,
+gossip seed join)."""
+
+import random
+import threading
+
+import pytest
+
+from nomad_tpu.utils.backoff import Backoff, Retryer
+
+
+class TestBackoff:
+    def test_exponential_until_cap(self):
+        b = Backoff(base=0.1, factor=2.0, cap=1.0, jitter=0)
+        assert [round(b.next_delay(), 3) for _ in range(6)] == \
+            [0.1, 0.2, 0.4, 0.8, 1.0, 1.0]
+        assert b.at_cap()
+        b.reset()
+        assert b.next_delay() == pytest.approx(0.1)
+        assert not b.at_cap()
+
+    def test_jitter_bounded_and_seeded(self):
+        b = Backoff(base=1.0, factor=1.0, cap=1.0, jitter=0.25,
+                    rng=random.Random(7))
+        delays = [b.next_delay() for _ in range(100)]
+        assert all(0.75 <= d <= 1.25 for d in delays)
+        b2 = Backoff(base=1.0, factor=1.0, cap=1.0, jitter=0.25,
+                     rng=random.Random(7))
+        assert delays == [b2.next_delay() for _ in range(100)]
+
+    def test_peek_does_not_advance(self):
+        b = Backoff(base=0.1, factor=2.0, cap=5.0, jitter=0)
+        assert b.peek() == pytest.approx(0.1)
+        assert b.peek() == pytest.approx(0.1)
+        b.next_delay()
+        assert b.peek() == pytest.approx(0.2)
+
+
+class TestRetryer:
+    def _virtual(self, deadline_s, **kw):
+        # virtual clock: sleeps advance time instantly
+        t = [0.0]
+
+        def sleep(d):
+            t[0] += d
+
+        return Retryer(deadline_s=deadline_s, sleep=sleep,
+                       clock=lambda: t[0], jitter=0, **kw), t
+
+    def test_first_attempt_immediate_and_deadline_bounds_total(self):
+        r, t = self._virtual(5.0, base=0.5, factor=2.0, cap=10.0)
+        attempts = list(r)
+        assert attempts[0] == 0
+        assert len(attempts) > 1
+        # the iterator never sleeps past the deadline
+        assert t[0] <= 5.0 + 1e-9
+
+    def test_zero_deadline_yields_exactly_once(self):
+        r, _ = self._virtual(0.0)
+        assert list(r) == [0]
+
+    def test_no_deadline_runs_until_stop(self):
+        stop = threading.Event()
+        seen = []
+        for attempt in Retryer(deadline_s=None, base=0.001, cap=0.001,
+                               stop=stop):
+            seen.append(attempt)
+            if attempt == 4:
+                stop.set()
+        assert seen == [0, 1, 2, 3, 4]
+
+    def test_stop_preset_never_attempts(self):
+        stop = threading.Event()
+        stop.set()
+        assert list(Retryer(deadline_s=5.0, stop=stop)) == []
+        with pytest.raises(TimeoutError):
+            Retryer(deadline_s=5.0, stop=stop).call(lambda: 1)
+
+    def test_call_retries_then_returns(self):
+        r, _ = self._virtual(10.0, base=0.01)
+        tries = []
+
+        def flaky():
+            tries.append(1)
+            if len(tries) < 3:
+                raise ConnectionError("transient")
+            return "ok"
+
+        assert r.call(flaky) == "ok"
+        assert len(tries) == 3
+
+    def test_call_reraises_last_error_on_exhaustion(self):
+        r, _ = self._virtual(0.05, base=0.02)
+
+        def always_down():
+            raise ConnectionError("still down")
+
+        with pytest.raises(ConnectionError, match="still down"):
+            r.call(always_down)
+
+    def test_call_does_not_swallow_unlisted_errors(self):
+        r, _ = self._virtual(1.0)
+
+        def broken():
+            raise ValueError("a bug, not a transient")
+
+        with pytest.raises(ValueError):
+            r.call(broken, retry_on=(ConnectionError,))

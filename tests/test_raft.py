@@ -80,6 +80,53 @@ class TestRaftCore:
             for n in nodes.values():
                 n.stop()
 
+    def test_asymmetric_link_cut_deposes_leader(self):
+        # cut only the leader's OUTBOUND links: followers stop hearing
+        # heartbeats and elect a new leader; the old leader still hears
+        # the higher term on its open inbound side and steps down — the
+        # asymmetric failure real networks produce (one-way firewall,
+        # half-broken NIC)
+        transport, nodes, applied = _mini_cluster()
+        try:
+            leader = _wait_leader(nodes)
+            others = [i for i in nodes if i != leader.id]
+            for i in others:
+                transport.partition_link(leader.id, i)
+            remaining = {k: v for k, v in nodes.items() if k != leader.id}
+            new_leader = _wait_leader(remaining)
+            assert new_leader.id != leader.id
+            deadline = time.time() + 5
+            while time.time() < deadline and leader.is_leader():
+                time.sleep(0.02)
+            assert not leader.is_leader()
+            # directed heal: reopen the old leader's outbound side
+            for i in others:
+                transport.heal_link(leader.id, i)
+            new_leader.apply(("compact", ("x",), {}))
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if applied[leader.id] == applied[new_leader.id] != []:
+                    break
+                time.sleep(0.02)
+            assert applied[leader.id] == applied[new_leader.id]
+        finally:
+            for n in nodes.values():
+                n.stop()
+
+    def test_heal_with_no_args_clears_links_and_partitions(self):
+        transport, nodes, _ = _mini_cluster()
+        try:
+            leader = _wait_leader(nodes)
+            other = next(i for i in nodes if i != leader.id)
+            transport.partition(other)
+            transport.partition_link(leader.id, other)
+            assert transport.send(leader.id, other, {"kind": "ping"}) is None
+            transport.heal()  # no args: everything
+            leader.apply(("compact", ("y",), {}))  # replication works again
+        finally:
+            for n in nodes.values():
+                n.stop()
+
     def test_leader_failover_and_catchup(self):
         transport, nodes, applied = _mini_cluster()
         try:
