@@ -687,7 +687,100 @@ print(json.dumps(out))
          parity=out["parity"])
 
 
+def _raft_commit_trial(fsync: bool, batch: bool, proposers: int = 8,
+                       duration: float = 1.5):
+    """One 3-node in-proc cluster trial: `proposers` threads slam the
+    leader for `duration` seconds. Returns (commits/s, p50_ms, p99_ms)
+    of end-to-end commit latency (propose -> committed + applied)."""
+    import os
+    import shutil
+    import statistics
+    import tempfile
+    import threading
+
+    from nomad_tpu.raft.durable import DurableLog
+    from nomad_tpu.raft.node import NotLeaderError, RaftNode
+    from nomad_tpu.raft.transport import InProcTransport
+
+    tmp = tempfile.mkdtemp(prefix="raftbench-")
+    transport = InProcTransport()
+    ids = ["a", "b", "c"]
+    nodes = []
+    try:
+        for nid in ids:
+            d = os.path.join(tmp, nid)
+            os.makedirs(d)
+            nodes.append(RaftNode(nid, ids, transport, lambda cmd: None,
+                                  log=DurableLog(d, fsync=fsync),
+                                  batch=batch))
+        for n in nodes:
+            n.start()
+        leader = None
+        deadline = time.time() + 10.0
+        while leader is None and time.time() < deadline:
+            leader = next((n for n in nodes if n.is_leader()), None)
+            time.sleep(0.01)
+        if leader is None:
+            raise TimeoutError("no leader elected for the bench cluster")
+
+        lats: list = []
+        lats_lock = threading.Lock()
+        stop_at = time.time() + duration
+
+        def propose():
+            mine = []
+            while time.time() < stop_at:
+                t0 = time.perf_counter()
+                try:
+                    leader.apply(("bench", (), {}), timeout=5.0)
+                except (NotLeaderError, TimeoutError):
+                    continue
+                mine.append(time.perf_counter() - t0)
+            with lats_lock:
+                lats.extend(mine)
+
+        threads = [threading.Thread(target=propose, daemon=True)
+                   for _ in range(proposers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if not lats:
+            raise RuntimeError("no commits completed in the trial window")
+        lats.sort()
+        p50 = statistics.median(lats) * 1e3
+        p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1e3
+        return len(lats) / duration, p50, p99
+    finally:
+        for n in nodes:
+            n.stop()
+        for n in nodes:
+            if hasattr(n.log, "close"):
+                n.log.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def raft_commit_throughput_3node() -> None:
+    """Replicated write path: 3-node in-proc cluster, 8 concurrent
+    proposers, group commit + pipelined replication (ISSUE 4) against
+    the pre-batch single-proposal path (batch=False). vs_baseline is
+    the fsync-on speedup — the configuration a real deployment runs."""
+    batched_on, p50_on, p99_on = _raft_commit_trial(fsync=True, batch=True)
+    batched_off, p50_off, p99_off = _raft_commit_trial(fsync=False, batch=True)
+    single_on, _, _ = _raft_commit_trial(fsync=True, batch=False)
+    single_off, _, _ = _raft_commit_trial(fsync=False, batch=False)
+    emit("raft_commit_throughput_3node",
+         batched_on, "commits/s", batched_on / max(single_on, 1e-9),
+         p50_ms=p50_on, p99_ms=p99_on,
+         fsync_off_commits_s=round(batched_off, 1),
+         fsync_off_p50_ms=p50_off, fsync_off_p99_ms=p99_off,
+         single_proposal_commits_s=round(single_on, 1),
+         single_proposal_fsync_off_commits_s=round(single_off, 1))
+
+
 CONFIGS = [
+    # before the headline: a driver timeout must not eat the raft rung
+    ("raft3", raft_commit_throughput_3node),
     ("headline", headline_spread_1k),
     ("c2m", cfg_c2m),
     ("cfg1", cfg1_service_binpack),

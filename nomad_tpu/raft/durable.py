@@ -233,6 +233,44 @@ class DurableLog:
                 raise
             return e
 
+    def append_batch(self, term: int, commands: List[tuple],
+                     prev: Optional[Tuple[int, int]] = None
+                     ) -> Optional[List[Entry]]:
+        """Group commit: append a whole batch of commands with ONE
+        buffered write and ONE fsync — the amortization the leader's
+        log-writer thread lives on.
+
+        When ``prev`` is given the append is conditional on the tail
+        still being exactly ``(last_index, last_term)``; a concurrent
+        append (config entry, new-leader noop, post-step-down
+        truncation) fails the compare-and-swap and returns None, so the
+        caller re-reads the tail instead of writing onto a diverged
+        log. Entries become visible (and replicable) only after the
+        fsync returns: memory never claims what disk might lose, and a
+        disk fault rolls the whole batch back — the same atomicity
+        contract as append()."""
+        with self._lock:
+            if not self._entries:
+                tail = (self.base_index, self.base_term)
+            else:
+                e = self._entries[-1]
+                tail = (e.index, e.term)
+            if prev is not None and tail != tuple(prev):
+                return None
+            batch = [Entry(index=tail[0] + 1 + i, term=term, command=c)
+                     for i, c in enumerate(commands)]
+            before = len(self._entries)
+            self._entries.extend(batch)
+            try:
+                self._write(batch)
+            except OSError:
+                # one fault fails the whole batch: every entry rolls
+                # back together, so there is never a gap where a prefix
+                # is durable but memory claims the full batch
+                del self._entries[before:]
+                raise
+            return batch
+
     def append_entries(self, prev_index: int, entries: List[Entry]) -> bool:
         with self._lock:
             before_len = len(self._entries)

@@ -55,6 +55,32 @@ class RaftLog:
             self._entries.append(e)
             return e
 
+    def append_batch(self, term: int, commands: List[tuple],
+                     prev: Optional[Tuple[int, int]] = None
+                     ) -> Optional[List[Entry]]:
+        """Append a whole batch in one lock hold (the group-commit
+        primitive; DurableLog adds the single-fsync disk write on top).
+
+        When ``prev`` is given the append is conditional on the tail
+        still being exactly ``(last_index, last_term)``: the log writer
+        snapshots the tail under the node lock, builds the batch outside
+        it, and any interleaved append — a config entry, a new leader's
+        noop, a follower truncation after step-down — fails the
+        compare-and-swap instead of landing the batch on a diverged log.
+        Returns None on a CAS mismatch."""
+        with self._lock:
+            if not self._entries:
+                tail = (0, 0)
+            else:
+                e = self._entries[-1]
+                tail = (e.index, e.term)
+            if prev is not None and tail != tuple(prev):
+                return None
+            batch = [Entry(index=tail[0] + 1 + i, term=term, command=c)
+                     for i, c in enumerate(commands)]
+            self._entries.extend(batch)
+            return batch
+
     def append_entries(self, prev_index: int, entries: List[Entry]) -> bool:
         """Follower-side: truncate conflicts after prev_index, then
         append (the AppendEntries receiver rules). Returns True when a

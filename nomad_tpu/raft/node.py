@@ -7,6 +7,28 @@ restricted to current-term entries. Committed commands are applied to
 the FSM in log order on a dedicated apply thread; leader-side apply()
 blocks until the entry is both committed and locally applied, giving
 the linearizable write the plan applier needs.
+
+The write path is batched at every stage (hashicorp/raft's leader
+loop + group commit, PERF.md "The replicated write path"):
+
+- **Group commit** — apply() enqueues the proposal and a log-writer
+  thread drains the whole queue, deep-copies the batch outside the node
+  lock, and lands it with ONE buffered write + ONE fsync
+  (DurableLog.append_batch). RPC handlers and the tick thread never
+  block on client-write disk I/O.
+- **Pipelined replication** — one replicator thread per peer, woken by
+  a condition variable on every append and commit advance; the timed
+  wait doubles as the idle-heartbeat fallback. Catch-up uses the
+  follower's conflict hint (conflict_term/first_index) instead of
+  decrement-by-one, and followers persist each entry batch with a
+  single fsync before acking.
+- **Batched apply** — the apply thread applies a whole committed range
+  per lock hold with one notify_all; leader-side waiters are per-
+  proposal events in a registry (no polling, no unbounded results map).
+
+`batch=False` keeps the pre-batch single-proposal path (synchronous
+append+fsync under the lock, tick-paced replication) for A/B
+comparison — bench.py's raft_commit_throughput_3node rung.
 """
 
 from __future__ import annotations
@@ -24,6 +46,32 @@ log = logging.getLogger("nomad_tpu.raft")
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 
+# per-AppendEntries in-flight window (entries per RPC); the replicator
+# streams back-to-back windows while a peer has backlog
+MAX_APPEND_ENTRIES = 256
+# cap on proposals landed per log-writer flush: bounds the size of one
+# buffered write (and the blast radius of one fsync fault)
+MAX_GROUP_COMMIT = 1024
+# committed entries applied per lock hold: large enough to amortize the
+# lock, small enough that RPC handlers never stall behind a big backlog
+APPLY_CHUNK = 64
+
+
+class _Proposal:
+    """A leader-side write waiting for commit + local apply. The event
+    replaces the old 0.1 s polling wait; `command` doubles as an
+    identity token so a result can never be delivered to a waiter whose
+    registration lost the append CAS (see _commit_batch)."""
+
+    __slots__ = ("command", "index", "result", "error", "done")
+
+    def __init__(self, command: tuple):
+        self.command = command
+        self.index: Optional[int] = None
+        self.result: object = None
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+
 
 class RaftNode:
     def __init__(self, node_id: str, peers: List[str], transport,
@@ -38,7 +86,9 @@ class RaftNode:
                  peer_addrs: Optional[Dict[str, str]] = None,
                  on_config_change: Optional[Callable[[Dict[str, str]], None]] = None,
                  bootstrap: bool = True,
-                 dead_server_cleanup_s: Optional[float] = None):
+                 dead_server_cleanup_s: Optional[float] = None,
+                 batch: bool = True,
+                 max_append_entries: int = MAX_APPEND_ENTRIES):
         self.id = node_id
         # membership: server id -> address ("" when the transport
         # resolves ids directly). Config-change log entries rewrite this
@@ -56,6 +106,8 @@ class RaftNode:
         # real membership from the leader's append_entries
         self.bootstrap = bootstrap
         self.dead_server_cleanup_s = dead_server_cleanup_s
+        self.batch = batch
+        self.max_append_entries = max_append_entries
         self._last_contact: Dict[str, float] = {}
         self._config_index = 0  # log index of the latest config entry
         # replication state precedes the durability restore below:
@@ -63,6 +115,14 @@ class RaftNode:
         # maintains these
         self._next_index: Dict[str, int] = {}
         self._match_index: Dict[str, int] = {}
+        # per-peer replicator scheduling: next idle-heartbeat time, the
+        # leader commit index last acked down, and the retry-backoff
+        # gate for unreachable peers
+        self._next_heartbeat: Dict[str, float] = {}
+        self._peer_commit: Dict[str, int] = {}
+        self._repl_backoff: Dict[str, float] = {}
+        self._replicators: Dict[str, threading.Thread] = {}
+        self._started = False
         self.transport = transport
         self.fsm_apply = fsm_apply
         self.on_leadership = on_leadership
@@ -105,28 +165,50 @@ class RaftNode:
         self._snap_inflight: set = set()  # peers mid-install-snapshot
         self._lock = threading.RLock()
         self._apply_cond = threading.Condition(self._lock)
+        # both conditions share the node lock (so notify is race-free
+        # with the state they guard) but carry distinct wait-sets: the
+        # log-writer sleeps on _propose_cond, replicators on _repl_cond
+        self._propose_cond = threading.Condition(self._lock)
+        self._repl_cond = threading.Condition(self._lock)
         self._deadline = self._new_deadline()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
-        # apply results by index for leader-side waiters
-        self._results: Dict[int, object] = {}
+        # the group-commit queue and the waiter registry: proposals wait
+        # here for the log-writer, then (keyed by index) for commit +
+        # apply. Results without a registered waiter are dropped at
+        # apply time — nothing accumulates.
+        self._proposals: List[_Proposal] = []
+        self._waiters: Dict[int, _Proposal] = {}
+        self._autopilot: Optional[threading.Thread] = None
 
         transport.register(node_id, self.handle)
 
     # -- lifecycle --
 
     def start(self) -> None:
-        for name, fn in (("tick", self._run_tick), ("apply", self._run_apply)):
+        for name, fn in (("tick", self._run_tick),
+                         ("apply", self._run_apply),
+                         ("logwriter", self._run_log_writer)):
             t = threading.Thread(target=fn, daemon=True,
                                  name=f"raft-{self.id}-{name}")
             t.start()
             self._threads.append(t)
+        with self._lock:
+            self._started = True
+            self._spawn_replicators_locked()
 
     def stop(self) -> None:
         self._stop.set()
-        with self._apply_cond:
+        with self._lock:
+            # unblock every apply() caller promptly: after stop there is
+            # no writer/apply thread left to complete them
+            self._fail_waiters_locked(
+                lambda: TimeoutError("raft node stopped"))
             self._apply_cond.notify_all()
-        for t in self._threads:
+            self._propose_cond.notify_all()
+            self._repl_cond.notify_all()
+            repls = list(self._replicators.values())
+        for t in self._threads + repls:
             t.join(timeout=2.0)
 
     def _new_deadline(self) -> float:
@@ -141,30 +223,152 @@ class RaftNode:
     def apply(self, command: tuple, timeout: float = 5.0):
         """Leader-only: replicate a command, wait for commit + local
         apply, return the FSM result. Raises NotLeaderError otherwise."""
+        deadline = time.time() + timeout
+        if not self.batch:
+            return self._apply_single(command, deadline)
+        prop = _Proposal(command)
+        with self._lock:
+            if self._stop.is_set():
+                raise TimeoutError("raft node stopped")
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            self._proposals.append(prop)
+            self._propose_cond.notify()
+        return self._await_proposal(prop, deadline)
+
+    def _apply_single(self, command: tuple, deadline: float):
+        """The pre-batch write path (batch=False): one synchronous
+        append + fsync under the node lock per proposal, replication
+        left to the idle-heartbeat cadence. Kept as the A/B baseline
+        for the group-commit rung in bench.py."""
         # Freeze the payload: callers keep mutating their structs after
         # proposing (eval status transitions, alloc updates), and a log
         # entry aliasing those objects would retransmit the MUTATED
         # payload to any follower that catches up later — replicas
         # applying different commands at the same index.
         command = copy.deepcopy(command)
+        prop = _Proposal(command)
         with self._lock:
+            if self._stop.is_set():
+                raise TimeoutError("raft node stopped")
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_id)
             entry = self.log.append(self.current_term, command)
-            index = entry.index
-        # single-node cluster commits immediately; otherwise replication
-        # advances commit on acks
-        self._maybe_advance_commit()
-        deadline = time.time() + timeout
-        with self._apply_cond:
-            while self.last_applied < index:
-                remaining = deadline - time.time()
-                if remaining <= 0 or self._stop.is_set():
-                    raise TimeoutError(f"apply of index {index} timed out")
-                self._apply_cond.wait(min(remaining, 0.1))
-            if self.state != LEADER:
-                raise NotLeaderError(self.leader_id)
-            return self._results.pop(index, None)
+            prop.index = entry.index
+            self._waiters[entry.index] = prop
+            # single-node cluster commits immediately; otherwise
+            # replication advances commit on acks
+            self._maybe_advance_commit_locked()
+        return self._await_proposal(prop, deadline)
+
+    def _await_proposal(self, prop: _Proposal, deadline: float):
+        prop.done.wait(max(0.0, deadline - time.time()))
+        if not prop.done.is_set():
+            with self._lock:
+                # completion may have raced the timeout: every
+                # completion path holds the lock, so re-check under it
+                if not prop.done.is_set():
+                    # unregister so the result landing later finds no
+                    # waiter and is dropped instead of leaking
+                    try:
+                        self._proposals.remove(prop)
+                    except ValueError:
+                        pass
+                    if prop.index is not None \
+                            and self._waiters.get(prop.index) is prop:
+                        del self._waiters[prop.index]
+                    idx = prop.index if prop.index is not None else "?"
+                    raise TimeoutError(f"apply of index {idx} timed out")
+        if prop.error is not None:
+            raise prop.error
+        return prop.result
+
+    def _fail_waiters_locked(self, make_err: Callable[[], BaseException]) -> None:
+        """Complete every queued proposal and registered waiter with an
+        error (step-down / stop). Call with the lock held."""
+        stale = list(self._proposals) + list(self._waiters.values())
+        self._proposals.clear()
+        self._waiters.clear()
+        for p in stale:
+            if not p.done.is_set():
+                p.error = make_err()
+                p.done.set()
+
+    # -- group commit (the log-writer thread) --
+
+    def _run_log_writer(self) -> None:
+        while not self._stop.is_set():
+            with self._propose_cond:
+                while not self._proposals and not self._stop.is_set():
+                    self._propose_cond.wait(0.5)
+                if self._stop.is_set():
+                    return
+                batch = self._proposals[:MAX_GROUP_COMMIT]
+                del self._proposals[:MAX_GROUP_COMMIT]
+            # Freeze the payloads at the propose boundary
+            # (ROBUSTNESS.md): callers keep mutating their structs after
+            # proposing, and a log entry aliasing them would retransmit
+            # the MUTATED payload to a follower that catches up later.
+            # Copying here — off the caller threads and outside the node
+            # lock — is the point of the log-writer: serialization cost
+            # never stalls RPC handlers or the tick thread.
+            for p in batch:
+                p.command = copy.deepcopy(p.command)
+            self._commit_batch(batch)
+
+    def _commit_batch(self, batch: List[_Proposal]) -> None:
+        """Land a drained batch: one buffered write + one fsync via
+        DurableLog.append_batch, outside the node lock. The append is
+        CAS-guarded on the log tail: if a config entry, a new leader's
+        noop, or a post-step-down truncation moved the tail while we
+        were unlocked, the append refuses and we re-read the world."""
+        while True:
+            with self._lock:
+                if self._stop.is_set() or self.state != LEADER:
+                    stopped = self._stop.is_set()
+                    for p in batch:
+                        if not p.done.is_set():
+                            p.error = (TimeoutError("raft node stopped")
+                                       if stopped
+                                       else NotLeaderError(self.leader_id))
+                            p.done.set()
+                    return
+                term = self.current_term
+                last_index, last_term = self.log.last()
+                # register waiters BEFORE the disk write: the CAS pins
+                # the indexes, and registering now means an ack that
+                # races the fsync can commit + apply the entry and still
+                # find its waiter. A registration that loses the CAS is
+                # unregistered below; the apply loop's identity check
+                # (waiter.command is entry.command) makes a stale
+                # registration unable to swallow someone else's result.
+                for i, p in enumerate(batch):
+                    p.index = last_index + 1 + i
+                    self._waiters[p.index] = p
+            try:
+                entries = self.log.append_batch(
+                    term, [p.command for p in batch],
+                    prev=(last_index, last_term))
+            except OSError as e:
+                # disk fault: the log rolled the whole batch back;
+                # surface the error to every caller in it
+                with self._lock:
+                    for p in batch:
+                        if self._waiters.get(p.index) is p:
+                            del self._waiters[p.index]
+                        if not p.done.is_set():
+                            p.error = e
+                            p.done.set()
+                return
+            if entries is not None:
+                break
+            with self._lock:
+                for p in batch:
+                    if self._waiters.get(p.index) is p:
+                        del self._waiters[p.index]
+        with self._lock:
+            self._maybe_advance_commit_locked()
+            self._repl_cond.notify_all()
 
     # -- membership (reference nomad/server.go:1602 join,
     #    nomad/autopilot.go dead-server cleanup) --
@@ -182,12 +386,31 @@ class RaftNode:
             self._match_index.pop(gone, None)
             self._next_index.pop(gone, None)
             self._last_contact.pop(gone, None)
+            self._next_heartbeat.pop(gone, None)
+            self._peer_commit.pop(gone, None)
+            self._repl_backoff.pop(gone, None)
+        self._spawn_replicators_locked()
         if self.on_config_change is not None:
             try:
                 self.on_config_change(dict(self.servers))
             except Exception:
                 log.debug("on_config_change callback failed on %s",
                           self.id, exc_info=True)
+
+    def _spawn_replicators_locked(self) -> None:
+        """One replicator thread per peer (call with the lock held).
+        A thread whose peer leaves the config exits on its own; a peer
+        that rejoins gets a fresh thread here."""
+        if not self._started or self._stop.is_set():
+            return
+        for p in self.peers:
+            t = self._replicators.get(p)
+            if t is None or not t.is_alive():
+                t = threading.Thread(target=self._run_replicator, args=(p,),
+                                     daemon=True,
+                                     name=f"raft-{self.id}-repl-{p}")
+                self._replicators[p] = t
+                t.start()
 
     def _recover_config_from_log_locked(self, reset_on_missing: bool = False) -> None:
         base = getattr(self.log, "base_index", 0)
@@ -232,14 +455,15 @@ class RaftNode:
             self._config_index = entry.index
             self._set_servers_locked(servers)
             index = entry.index
-        self._maybe_advance_commit()
+            self._maybe_advance_commit_locked()
+            self._repl_cond.notify_all()
         deadline = time.time() + timeout
         with self._apply_cond:
             while self.commit_index < index:
                 remaining = deadline - time.time()
                 if remaining <= 0 or self._stop.is_set():
                     raise TimeoutError(f"config change {index} timed out")
-                self._apply_cond.wait(min(remaining, 0.1))
+                self._apply_cond.wait(min(remaining, 0.5))
 
     def add_server(self, server_id: str, addr: str = "",
                    timeout: float = 5.0) -> None:
@@ -332,6 +556,26 @@ class RaftNode:
                     self._deadline = self._new_deadline()
             return {"term": self.current_term, "granted": granted}
 
+    def _conflict_hint_locked(self, prev_index: int) -> dict:
+        """Follower-side catch-up hint on a prev-entry mismatch
+        (hashicorp/raft / the Raft paper's fast-backtracking note):
+        conflict_term is the term of our entry at prev_index and
+        first_index the first index of that term, so the leader jumps a
+        whole term per round trip instead of decrementing by one."""
+        last_index, _ = self.log.last()
+        base = getattr(self.log, "base_index", 0)
+        if prev_index > last_index:
+            return {"conflict_term": 0, "first_index": last_index + 1}
+        ct = self.log.term_at(prev_index)
+        if ct < 0:
+            # prev_index fell below our snapshot base: everything up to
+            # the base is committed state, resync from just past it
+            return {"conflict_term": 0, "first_index": base + 1}
+        fi = prev_index
+        while fi - 1 > base and self.log.term_at(fi - 1) == ct:
+            fi -= 1
+        return {"conflict_term": ct, "first_index": fi}
+
     def _on_append_entries(self, msg: dict) -> dict:
         with self._lock:
             term = msg["term"]
@@ -346,10 +590,14 @@ class RaftNode:
             prev_index = msg["prev_log_index"]
             prev_term = msg["prev_log_term"]
             if prev_index > 0 and self.log.term_at(prev_index) != prev_term:
-                return {"term": self.current_term, "success": False}
+                reply = {"term": self.current_term, "success": False}
+                reply.update(self._conflict_hint_locked(prev_index))
+                return reply
             entries = [Entry(**e) if isinstance(e, dict) else e
                        for e in msg["entries"]]
             if entries:
+                # the whole batch lands with a single buffered write +
+                # fsync (DurableLog.append_entries) before the ack below
                 truncated = self.log.append_entries(prev_index, entries)
                 configs = [e for e in entries
                            if tuple(e.command)[:1] == ("config",)]
@@ -363,9 +611,14 @@ class RaftNode:
                     self._set_servers_locked(dict(last_cfg.command[1][0]))
             leader_commit = msg["leader_commit"]
             if leader_commit > self.commit_index:
-                last_index, _ = self.log.last()
-                self.commit_index = min(leader_commit, last_index)
-                self._apply_cond.notify_all()
+                # cap at the last entry this RPC verified, not our last
+                # log index: a stale divergent tail past prev+len must
+                # never be committed by a leader_commit that refers to
+                # the leader's (different) entries at those indexes
+                new_commit = min(leader_commit, prev_index + len(entries))
+                if new_commit > self.commit_index:
+                    self.commit_index = new_commit
+                    self._apply_cond.notify_all()
             return {"term": self.current_term,
                     "success": True,
                     "match_index": prev_index + len(entries)}
@@ -440,6 +693,12 @@ class RaftNode:
             self.voted_for = None
             self._persist_vote()
         self._deadline = self._new_deadline()
+        # leader-side writes can't complete any more: fail queued
+        # proposals and registered waiters instead of letting callers
+        # hang to their timeout (the entry may still commit under the
+        # new leader — NotLeaderError means "outcome unknown", exactly
+        # the old wake-time semantics)
+        self._fail_waiters_locked(lambda: NotLeaderError(self.leader_id))
         if was_leader and self.on_leadership:
             self.on_leadership(False)
 
@@ -456,12 +715,17 @@ class RaftNode:
             # gets cleaned up, and stale timestamps from an earlier
             # tenure can't condemn a healthy peer instantly
             self._last_contact[p] = now
+            self._next_heartbeat[p] = 0.0
+            self._peer_commit[p] = 0
+            self._repl_backoff.pop(p, None)
         # Barrier entry: commit counting skips prior-term entries, so without
         # a fresh current-term entry, anything replicated under the old
         # leader stays uncommitted until the next client write. The no-op
         # commits promptly and drags predecessors with it (hashicorp/raft
         # does the same).
         self.log.append(self.current_term, ("noop", (), {}))
+        self._maybe_advance_commit_locked()
+        self._repl_cond.notify_all()
         if self.on_leadership:
             self.on_leadership(True)
 
@@ -493,7 +757,8 @@ class RaftNode:
                     and votes * 2 > len(self.peers) + 1:
                 self._become_leader_locked()
 
-    # -- ticker --
+    # -- ticker (election deadlines + autopilot; replication moved to
+    #    the per-peer replicator threads) --
 
     def _run_tick(self) -> None:
         last_cleanup = time.time()
@@ -506,35 +771,84 @@ class RaftNode:
                 # cluster; it waits for the real membership
                 can_elect = self.bootstrap or len(self.servers) > 1
             if state == LEADER:
-                self._replicate_all()
                 if (self.dead_server_cleanup_s is not None
                         and time.time() - last_cleanup >= 1.0):
                     last_cleanup = time.time()
                     # off-thread: remove_server blocks on commit and
-                    # must not stall the heartbeat fan-out
-                    threading.Thread(target=self._dead_server_cleanup,
-                                     daemon=True,
-                                     name=f"raft-{self.id}-autopilot").start()
+                    # must not stall the tick. ONE outstanding worker:
+                    # a removal blocked on commit used to leak a new
+                    # thread every second on top of the stuck one.
+                    t = self._autopilot
+                    if t is None or not t.is_alive():
+                        t = threading.Thread(
+                            target=self._dead_server_cleanup,
+                            daemon=True,
+                            name=f"raft-{self.id}-autopilot")
+                        self._autopilot = t
+                        t.start()
             elif expired and can_elect:
                 self._start_election()
 
-    def _replicate_all(self) -> None:
-        for p in self.peers:
-            self._replicate(p)
-        self._maybe_advance_commit()
+    # -- replication (one pipelined replicator thread per peer) --
+
+    def _repl_due_locked(self, peer: str, now: float) -> bool:
+        """Does this peer need a send right now? (call with the lock
+        held). True on: idle-heartbeat due, backlog to ship, or a commit
+        advance the peer hasn't heard. The backoff gate keeps a dead
+        peer from turning backlog into a hot retry loop."""
+        if self.state != LEADER:
+            return False
+        if now < self._repl_backoff.get(peer, 0.0):
+            return False
+        if now >= self._next_heartbeat.get(peer, 0.0):
+            return True
+        if not self.batch:
+            # pre-batch semantics (the bench baseline): replication runs
+            # only at the heartbeat cadence, never woken by backlog —
+            # exactly the old tick-paced _replicate_all
+            return False
+        if peer in self._snap_inflight:
+            return False
+        last_index, _ = self.log.last()
+        if last_index >= self._next_index.get(peer, 1):
+            return True
+        return self.commit_index > self._peer_commit.get(peer, 0)
+
+    def _run_replicator(self, peer: str) -> None:
+        """Wake-on-propose replication: the log-writer (and commit
+        advancement) notify _repl_cond; the timed wait is the idle-
+        heartbeat fallback that replaces the old tick-paced fan-out."""
+        while not self._stop.is_set():
+            with self._repl_cond:
+                while not self._stop.is_set() and peer in self.servers \
+                        and not self._repl_due_locked(peer, time.time()):
+                    self._repl_cond.wait(self.heartbeat_interval / 2)
+                if self._stop.is_set():
+                    return
+                if peer not in self.servers:
+                    # peer left the configuration; a rejoin spawns a
+                    # fresh thread (_spawn_replicators_locked)
+                    if self._replicators.get(peer) is threading.current_thread():
+                        self._replicators.pop(peer, None)
+                    return
+            self._replicate(peer)
 
     def _replicate(self, peer: str) -> None:
+        now = time.time()
         with self._lock:
-            if self.state != LEADER:
+            if self.state != LEADER or peer not in self.servers:
                 return
             term = self.current_term
             next_idx = self._next_index.get(peer, 1)
             base = getattr(self.log, "base_index", 0)
+            self._next_heartbeat[peer] = now + self.heartbeat_interval
             if next_idx <= base:
-                return self._send_snapshot(peer, term, base)
+                return self._send_snapshot_locked(peer, term, base)
             prev_index = next_idx - 1
             prev_term = self.log.term_at(prev_index)
-            entries = self.log.slice_from(next_idx)
+            # pre-batch mode keeps the old 64-entry default window
+            window = self.max_append_entries if self.batch else 64
+            entries = self.log.slice_from(next_idx, window)
             commit = self.commit_index
         reply = self.transport.send(self.id, peer, {
             "kind": "append_entries", "term": term, "leader": self.id,
@@ -543,27 +857,54 @@ class RaftNode:
                         for e in entries],
             "leader_commit": commit,
         })
-        if reply is None:
-            return
         with self._lock:
+            if reply is None:
+                # unreachable: retry at heartbeat cadence, not hot-loop
+                self._repl_backoff[peer] = time.time() + self.heartbeat_interval
+                return
             if reply["term"] > self.current_term:
                 self._become_follower_locked(reply["term"])
                 return
             if self.state != LEADER or reply["term"] != self.current_term:
                 return
             self._last_contact[peer] = time.time()
+            self._repl_backoff.pop(peer, None)
             if reply["success"]:
                 self._match_index[peer] = max(self._match_index.get(peer, 0),
                                               reply["match_index"])
                 self._next_index[peer] = self._match_index[peer] + 1
+                self._peer_commit[peer] = commit
+                self._maybe_advance_commit_locked()
             else:
-                self._next_index[peer] = max(1, next_idx - 1)
+                self._next_index[peer] = \
+                    self._conflict_next_index_locked(reply, next_idx)
 
-    def _send_snapshot(self, peer: str, term: int, base: int) -> None:
+    def _conflict_next_index_locked(self, reply: dict, next_idx: int) -> int:
+        """Leader-side fast backtrack from a follower's conflict hint
+        (call with the lock held). If we have entries of the conflicting
+        term, resend from just past our last one; otherwise jump all the
+        way to the follower's first index of that term. Falls back to
+        decrement-by-one against a peer that sent no hint."""
+        first_index = reply.get("first_index")
+        if not first_index:
+            return max(1, next_idx - 1)
+        conflict_term = reply.get("conflict_term", 0)
+        base = getattr(self.log, "base_index", 0)
+        if conflict_term:
+            idx = min(next_idx - 1, self.log.last()[0])
+            while idx > base and self.log.term_at(idx) > conflict_term:
+                idx -= 1
+            if idx > base and self.log.term_at(idx) == conflict_term:
+                return idx + 1
+        return max(1, min(first_index, next_idx - 1))
+
+    def _send_snapshot_locked(self, peer: str, term: int, base: int) -> None:
         """The peer needs entries the log compacted away: ship the whole
-        snapshot instead (called with the lock held; sends outside it).
-        At most one install per peer in flight — replication ticks fire
-        every heartbeat and a full-state transfer outlives them."""
+        snapshot instead (call with the lock held — the _snap_inflight
+        reservation below relies on it; the transfer itself runs on a
+        spawned thread outside the lock). At most one install per peer
+        in flight — a full-state transfer outlives any replication
+        round."""
         if self.snapshots is None or peer in self._snap_inflight:
             return
         self._snap_inflight.add(peer)
@@ -592,6 +933,7 @@ class RaftNode:
                             self._match_index.get(peer, 0),
                             reply["match_index"])
                         self._next_index[peer] = self._match_index[peer] + 1
+                        self._maybe_advance_commit_locked()
             finally:
                 with self._lock:
                     self._snap_inflight.discard(peer)
@@ -599,61 +941,78 @@ class RaftNode:
         threading.Thread(target=send, daemon=True,
                          name=f"raft-{self.id}-snap-{peer}").start()
 
-    def _maybe_advance_commit(self) -> None:
-        with self._lock:
-            if self.state != LEADER:
-                return
-            last_index, _ = self.log.last()
-            for n in range(last_index, self.commit_index, -1):
-                if self.log.term_at(n) != self.current_term:
-                    break  # only current-term entries commit by counting
-                acks = 1 + sum(1 for p in self.peers
-                               if self._match_index.get(p, 0) >= n)
-                if acks * 2 > len(self.peers) + 1:
-                    self.commit_index = n
-                    self._apply_cond.notify_all()
-                    break
+    def _maybe_advance_commit_locked(self) -> None:
+        """Quorum commit via one sorted match-index pass (call with the
+        lock held). The median-ish element of the descending-sorted
+        match vector IS the highest index a majority holds; one
+        current-term check suffices because terms are monotone in index —
+        if the quorum index carries an older term, no current-term entry
+        is quorum-replicated yet (the leader barrier noop closes that
+        window at term start)."""
+        if self.state != LEADER:
+            return
+        last_index, _ = self.log.last()
+        matches = [last_index]  # the leader's own durable log
+        matches.extend(self._match_index.get(p, 0) for p in self.peers)
+        matches.sort(reverse=True)
+        n = matches[len(matches) // 2]
+        if n > self.commit_index and self.log.term_at(n) == self.current_term:
+            self.commit_index = n
+            self._apply_cond.notify_all()
+            # piggyback the new commit index to followers promptly so
+            # their FSMs converge without waiting for the idle heartbeat
+            self._repl_cond.notify_all()
 
     # -- apply loop --
 
     def _run_apply(self) -> None:
         while not self._stop.is_set():
             with self._apply_cond:
-                while self.last_applied >= self.commit_index:
-                    self._apply_cond.wait(0.1)
-                    if self._stop.is_set():
-                        return
-                start = self.last_applied + 1
-                end = self.commit_index
-            for idx in range(start, end + 1):
-                # The re-check, fetch, and FSM mutation must be one
-                # critical section with _on_install_snapshot (RPC thread):
-                # releasing the lock between the last_applied check and
-                # fsm_apply would let a snapshot restore land in between,
-                # after which applying the stale entry regresses the
-                # restored store. Same discipline _maybe_snapshot uses.
-                with self._lock:
-                    if idx <= self.last_applied:
-                        continue  # an install_snapshot leapfrogged us
-                    entry = self.log.get(idx)
-                    if entry is None:
-                        break
-                    if tuple(entry.command)[:1] in (("noop",), ("config",)):
-                        result = None  # raft-internal entries, not FSM ops
-                    else:
-                        try:
-                            result = self.fsm_apply(tuple(entry.command))
-                        except Exception as e:
-                            result = e
-                with self._apply_cond:
-                    self._results[idx] = result
-                    if len(self._results) > 4096:
-                        # drop results nobody waited for
-                        for k in sorted(self._results)[:-1024]:
-                            self._results.pop(k, None)
-                    self.last_applied = max(self.last_applied, idx)
-                    self._apply_cond.notify_all()
+                while self.last_applied >= self.commit_index \
+                        and not self._stop.is_set():
+                    self._apply_cond.wait(0.5)
+            if self._stop.is_set():
+                return
+            while self._apply_chunk():
+                pass
             self._maybe_snapshot()
+
+    def _apply_chunk(self) -> bool:
+        """Apply up to APPLY_CHUNK committed entries under ONE lock hold
+        and wake all waiters with ONE notify_all. The re-check, fetch,
+        and FSM mutation stay a single critical section with
+        _on_install_snapshot (RPC thread): releasing the lock between
+        the last_applied check and fsm_apply would let a snapshot
+        restore land in between, after which applying the stale entry
+        regresses the restored store. The chunk bound keeps RPC handlers
+        from stalling behind an arbitrarily large committed backlog."""
+        with self._lock:
+            start = self.last_applied + 1
+            end = min(self.commit_index, start + APPLY_CHUNK - 1)
+            if start > end:
+                return False
+            for idx in range(start, end + 1):
+                entry = self.log.get(idx)
+                if entry is None:
+                    break  # compacted/leapfrogged: recompute next round
+                if tuple(entry.command)[:1] in (("noop",), ("config",)):
+                    result = None  # raft-internal entries, not FSM ops
+                else:
+                    try:
+                        result = self.fsm_apply(tuple(entry.command))
+                    except Exception as e:
+                        result = e
+                self.last_applied = idx
+                waiter = self._waiters.get(idx)
+                if waiter is not None and waiter.command is entry.command:
+                    # identity check: a registration that lost the
+                    # append CAS must not swallow another entry's result
+                    del self._waiters[idx]
+                    waiter.result = result
+                    waiter.done.set()
+            progressed = self.last_applied >= start
+            self._apply_cond.notify_all()
+        return progressed
 
 
 class NotLeaderError(Exception):

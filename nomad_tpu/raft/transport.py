@@ -140,9 +140,17 @@ class InProcTransport:
 # ---------------------------------------------------------------------------
 
 
-def _send_frame(sock: socket.socket, payload: dict) -> None:
+def _encode_frame(payload: dict) -> bytes:
+    """Serialize once, outside any connection lock: batched
+    append_entries frames are the largest thing on the wire now, and
+    encoding them while holding the per-connection lock would stall the
+    next frame behind CPU work instead of just the socket."""
     data = json.dumps(payload).encode()
-    sock.sendall(struct.pack(">I", len(data)) + data)
+    return struct.pack(">I", len(data)) + data
+
+
+def _send_frame(sock: socket.socket, payload: dict) -> None:
+    sock.sendall(_encode_frame(payload))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -380,6 +388,9 @@ class SocketTransport:
         # can't stall raft heartbeats behind it (the reference gets this
         # from yamux stream multiplexing)
         key = (to_id, frame["t"])
+        # encode before taking the connection lock, and only once even
+        # if the stale-connection retry below resends the frame
+        wire_frame = _encode_frame(frame)
         for attempt in (0, 1):
             try:
                 sock, lock, cached = self._conn(key)
@@ -389,7 +400,7 @@ class SocketTransport:
                 return None
             try:
                 with lock:  # one in-flight request per connection
-                    _send_frame(sock, frame)
+                    sock.sendall(wire_frame)
                     reply = _recv_frame(sock)
             except Exception:
                 self._drop(key)
@@ -461,6 +472,7 @@ class SocketTransport:
                  "args": wire_encode(list(args)),
                  "kwargs": wire_encode(kwargs or {})}
         key = (to_id, "call")
+        wire_frame = _encode_frame(frame)
         for attempt in (0, 1):
             try:
                 sock, lock, _cached = self._conn(key)
@@ -471,7 +483,7 @@ class SocketTransport:
             try:
                 with lock:
                     try:
-                        _send_frame(sock, frame)
+                        sock.sendall(wire_frame)
                     except OSError as e:
                         # another thread dropped this shared socket before
                         # we sent a byte (EBADF/ENOTCONN): provably not

@@ -32,6 +32,13 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" NOMAD_TPU_SAN=1 python -m pytest \
 echo "== chaos smoke (python -m nomad_tpu.chaos) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m nomad_tpu.chaos || failed=1
 
+# raft commit smoke (~1s, 10s budget): 500 commands through a durable
+# 3-node cluster with a leader crash/restart mid-stream — zero acked
+# commits may be lost (the group-commit write path, PERF.md)
+echo "== raft commit smoke (python -m nomad_tpu.chaos --raft-smoke) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 60 \
+    python -m nomad_tpu.chaos --raft-smoke || failed=1
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \

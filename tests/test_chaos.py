@@ -376,6 +376,133 @@ class TestDiskFaults:
 
 
 # ---------------------------------------------------------------------------
+# scenario 8: the batched write path (group commit + pipelined
+# replication, ISSUE 4) under the PR 3 fault model
+# ---------------------------------------------------------------------------
+
+
+class TestBatchedWritePath:
+    def test_crash_mid_batch_append_loses_no_acked_writes(self, tmp_path):
+        """Concurrent proposers keep the log-writer's batches full; the
+        leader dies mid-stream and its log tail is torn mid-line (the
+        disk state a crash inside a batched write leaves). Recovery must
+        drop only the un-fsynced suffix — every ACKED write survives,
+        because an ack requires the whole batch fsynced + committed."""
+        with RaftCluster(3, data_dir=str(tmp_path)) as cluster:
+            r = ScenarioRunner(cluster, seed=11)
+            leader = r.wait_for_leader()
+            victim = leader.id
+            stop = threading.Event()
+            accepted = []
+            acc_lock = threading.Lock()
+
+            def writer():
+                entry = _live_entry(cluster, exclude=(victim,))
+                while not stop.is_set():
+                    n = mock.node()
+                    try:
+                        entry.register_node(n)
+                        with acc_lock:
+                            accepted.append(n.id)
+                    except (NotLeaderError, TimeoutError):
+                        pass  # ambiguous during the crash window
+
+            threads = [threading.Thread(target=writer, daemon=True)
+                       for _ in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.4)
+            cluster.crash(victim)
+            tear_log_tail(os.path.join(
+                cluster.servers[victim].data_dir, "raft"))
+            _wait(lambda: cluster.leader() is not None,
+                  msg="new leader after mid-batch crash")
+            time.sleep(0.3)
+            cluster.restart(victim)
+            time.sleep(0.2)
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+            r.checker.check_all(cluster)
+            r.heal_and_converge(timeout=25.0)
+            snap = cluster.leader().store.snapshot()
+            present = {n.id for n in snap.nodes()}
+            missing = [nid for nid in accepted if nid not in present]
+            assert not missing, f"acked writes lost mid-batch: {missing}"
+            assert len(accepted) > 20  # proposers actually formed batches
+
+    def test_partition_mid_pipeline_converges(self):
+        """Directed cuts land while the per-peer replicators are mid-
+        pipeline: the cut peer's replicator backs off, the quorum keeps
+        committing, and heal converges every FSM (log matching holds —
+        no entry the cut follower acked can be rolled back)."""
+        with RaftCluster(3) as cluster:
+            r = ScenarioRunner(cluster, seed=13)
+            leader = r.wait_for_leader()
+            stop = threading.Event()
+            accepted = []
+            acc_lock = threading.Lock()
+
+            def writer():
+                entry = _live_entry(cluster)
+                while not stop.is_set():
+                    n = mock.node()
+                    try:
+                        entry.register_node(n)
+                        with acc_lock:
+                            accepted.append(n.id)
+                    except (NotLeaderError, TimeoutError):
+                        pass
+
+            threads = [threading.Thread(target=writer, daemon=True)
+                       for _ in range(4)]
+            for t in threads:
+                t.start()
+            # cut one replication pipeline at a time, mid-flight; the
+            # remaining follower keeps the quorum
+            followers = [s.id for s in cluster.followers()]
+            for fid in followers:
+                cluster.transport.partition_link(leader.id, fid)
+                time.sleep(0.25)
+                cluster.transport.heal_link(leader.id, fid)
+                time.sleep(0.1)
+            r.checker.check_all(cluster)
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+            r.heal_and_converge(timeout=25.0)
+            assert accepted, "no write survived the pipeline cuts"
+            snap = cluster.leader().store.snapshot()
+            present = {n.id for n in snap.nodes()}
+            missing = [nid for nid in accepted if nid not in present]
+            assert not missing, f"acked writes lost mid-pipeline: {missing}"
+
+    def test_torn_batch_tail_recovers_to_line_boundary(self, tmp_path):
+        """A batch is one buffered write: a crash mid-write tears the
+        LAST line, and recovery keeps the intact prefix of the batch
+        (safe: commit requires the whole batch fsynced, so nothing in
+        a torn suffix was ever acked)."""
+        from nomad_tpu.raft.durable import DurableLog
+
+        d = str(tmp_path)
+        log = DurableLog(d)
+        batch = log.append_batch(1, [("compact", (i,), {})
+                                     for i in range(6)])
+        assert [e.index for e in batch] == [1, 2, 3, 4, 5, 6]
+        log.close()
+        truncate_log_mid_line(d)
+        log2 = DurableLog(d)
+        last_index, last_term = log2.last()
+        assert last_term == 1 and last_index == 5, \
+            "torn batch tail must drop exactly the torn suffix"
+        assert [e.index for e in log2.slice_from(1, 100)] == [1, 2, 3, 4, 5]
+        # and the next batch lands cleanly after the boundary
+        cont = log2.append_batch(1, [("compact", (99,), {})])
+        assert cont[0].index == 6
+        log2.close()
+
+
+# ---------------------------------------------------------------------------
 # randomized sweep (slow; seed printed for replay)
 # ---------------------------------------------------------------------------
 

@@ -80,6 +80,60 @@ class TestDurableLog:
         log3 = DurableLog(d)
         assert log3.last() == (4, 1)
 
+    def test_append_batch_survives_reopen(self, tmp_path):
+        d = str(tmp_path)
+        log = DurableLog(d)
+        batch = log.append_batch(1, [("compact", (i,), {}) for i in range(5)])
+        assert [e.index for e in batch] == [1, 2, 3, 4, 5]
+        log.close()
+        log2 = DurableLog(d)
+        assert log2.last() == (5, 1)
+        assert log2.get(3).command == ("compact", (2,), {})
+        log2.close()
+
+    def test_append_batch_is_one_physical_write(self, tmp_path, monkeypatch):
+        d = str(tmp_path)
+        log = DurableLog(d)
+        fsyncs = []
+        monkeypatch.setattr(os, "fsync", lambda fd: fsyncs.append(fd))
+        log.append_batch(1, [("compact", (i,), {}) for i in range(64)])
+        # the whole point of group commit: 64 entries, ONE fsync
+        assert len(fsyncs) == 1
+        log.close()
+
+    def test_append_batch_cas_mismatch_refuses(self, tmp_path):
+        d = str(tmp_path)
+        log = DurableLog(d)
+        log.append(1, ("compact", (0,), {}))
+        # stale tail view (e.g. a config entry raced in): refuse, don't
+        # land the batch on a diverged log
+        assert log.append_batch(1, [("compact", (1,), {})], prev=(0, 0)) is None
+        assert log.last() == (1, 1)
+        got = log.append_batch(1, [("compact", (1,), {})], prev=(1, 1))
+        assert [e.index for e in got] == [2]
+        log.close()
+
+    def test_append_batch_fault_rolls_back_whole_batch(self, tmp_path):
+        from nomad_tpu.chaos import FSFaults
+
+        d = str(tmp_path)
+        log = DurableLog(d)
+        log.append(1, ("compact", (0,), {}))
+        fs = FSFaults()
+        fs.arm("log_append", count=1)
+        with fs.installed():
+            with pytest.raises(OSError):
+                log.append_batch(1, [("compact", (i,), {})
+                                     for i in range(4)])
+        # no partial batch: memory rolled all 4 back together
+        assert log.last() == (1, 1)
+        retry = log.append_batch(1, [("compact", (9,), {})])
+        assert retry[0].index == 2
+        log.close()
+        log2 = DurableLog(d)
+        assert log2.last() == (2, 1)
+        log2.close()
+
     def test_compaction_drops_prefix(self, tmp_path):
         d = str(tmp_path)
         log = DurableLog(d)

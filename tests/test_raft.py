@@ -315,6 +315,136 @@ class TestAdviceRegressions:
         assert "upsert_plan_results" in TIMESTAMPED
 
 
+class TestBatchedWritePath:
+    """ISSUE 4: group commit, conflict-hint catch-up, and the waiter
+    registry that replaced the unbounded `_results` map."""
+
+    def test_concurrent_proposers_each_get_their_own_result(self):
+        """8 proposers race the group-commit queue; every apply() must
+        return the FSM result for ITS OWN command (the waiter registry's
+        identity check), and every command applies exactly once."""
+        import threading
+
+        transport, nodes, applied = _mini_cluster()
+        try:
+            leader = _wait_leader(nodes)
+            results = {}
+            res_lock = threading.Lock()
+
+            def propose(start):
+                for i in range(start, 200, 8):
+                    r = leader.apply(("compact", (i,), {}))
+                    with res_lock:
+                        results[i] = r
+
+            threads = [threading.Thread(target=propose, args=(k,))
+                       for k in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert len(results) == 200
+            # the FSM returns the apply-sequence number: all distinct,
+            # and results mapped to the right proposal means the i-th
+            # command's position in the applied list matches its result
+            assert len(set(results.values())) == 200
+            mine = [c for c in applied[leader.id] if c[0] == "compact"]
+            assert len(mine) == 200  # each applied exactly once
+            order = {c[1][0]: pos + 1 for pos, c in
+                     enumerate(applied[leader.id])}
+            for i, r in results.items():
+                assert order[i] == r, \
+                    f"proposal {i} got another entry's result"
+        finally:
+            for n in nodes.values():
+                n.stop()
+
+    def test_follower_conflict_hint_shape(self):
+        """On a prev-entry mismatch the follower reports the conflicting
+        term and its first index, so the leader backtracks a term per
+        round trip instead of one index."""
+        transport = InProcTransport()
+        node = RaftNode("a", ["a", "b"], transport, lambda c: None,
+                        election_timeout=999, heartbeat_interval=999)
+        for term in (1, 1, 2, 2, 2):
+            node.log.append(term, ("noop", (), {}))
+        # leader probes past our tail: hint says "start at my tail + 1"
+        reply = node.handle({"kind": "append_entries", "term": 3,
+                             "leader": "b", "prev_log_index": 9,
+                             "prev_log_term": 3, "entries": [],
+                             "leader_commit": 0})
+        assert not reply["success"]
+        assert reply["conflict_term"] == 0 and reply["first_index"] == 6
+        # term mismatch at prev: hint names our term-2 run start
+        reply = node.handle({"kind": "append_entries", "term": 3,
+                             "leader": "b", "prev_log_index": 5,
+                             "prev_log_term": 3, "entries": [],
+                             "leader_commit": 0})
+        assert not reply["success"]
+        assert reply["conflict_term"] == 2 and reply["first_index"] == 3
+
+    def test_leader_backtracks_past_conflicting_term(self):
+        transport = InProcTransport()
+        node = RaftNode("a", ["a", "b"], transport, lambda c: None,
+                        election_timeout=999, heartbeat_interval=999)
+        for term in (1, 1, 2, 3, 3):
+            node.log.append(term, ("noop", (), {}))
+        # follower conflicts in term 2 starting at 3; we hold term 2
+        # only at index 3 -> resend from 4 (just past our last of term 2)
+        nxt = node._conflict_next_index_locked(
+            {"conflict_term": 2, "first_index": 3}, next_idx=6)
+        assert nxt == 4
+        # follower names a term we don't hold at all -> jump to its
+        # first_index
+        nxt = node._conflict_next_index_locked(
+            {"conflict_term": 7, "first_index": 2}, next_idx=6)
+        assert nxt == 2
+        # hint-less peer (legacy reply) -> decrement-by-one fallback
+        assert node._conflict_next_index_locked({}, next_idx=6) == 5
+
+    def test_follower_commit_capped_at_verified_prefix(self):
+        """leader_commit must never commit a follower's stale divergent
+        tail: the cap is the last entry THIS RPC verified, not the
+        follower's own last index."""
+        from nomad_tpu.raft.log import Entry
+
+        transport = InProcTransport()
+        node = RaftNode("a", ["a", "b"], transport, lambda c: None,
+                        election_timeout=999, heartbeat_interval=999)
+        # stale tail from a deposed leader: term-1 entries 1..4
+        for _ in range(4):
+            node.log.append(1, ("compact", (0,), {}))
+        # the real leader (term 3) confirms only entry 1 and pushes
+        # entry 2; its commit index (4) refers to ITS entries, not ours
+        reply = node.handle({
+            "kind": "append_entries", "term": 3, "leader": "b",
+            "prev_log_index": 1, "prev_log_term": 1,
+            "entries": [Entry(index=2, term=3, command=("noop", (), {}))],
+            "leader_commit": 4})
+        assert reply["success"]
+        assert node.commit_index == 2, \
+            "commit beyond the verified prefix would apply stale entries"
+
+    def test_timed_out_waiter_unregisters(self):
+        """A proposal that times out must leave no waiter behind (the
+        pre-batch code leaked `_results` entries when the waiter gave up
+        before the result landed)."""
+        transport, nodes, applied = _mini_cluster()
+        try:
+            leader = _wait_leader(nodes)
+            leader.apply(("compact", (0,), {}))
+            # cut the leader off: proposals append but can never commit
+            transport.partition(leader.id)
+            with pytest.raises((TimeoutError, NotLeaderError)):
+                leader.apply(("compact", (1,), {}), timeout=0.4)
+            with leader._lock:
+                assert not leader._waiters, "timed-out waiter leaked"
+                assert not leader._proposals
+        finally:
+            for n in nodes.values():
+                n.stop()
+
+
 class TestRaftConfigurationEndpoint:
     def test_single_server_reports_single_mode(self):
         import json
