@@ -778,9 +778,157 @@ def raft_commit_throughput_3node() -> None:
          single_proposal_fsync_off_commits_s=round(single_off, 1))
 
 
+def _e2e_trial(workers: int, batching: bool, *, nodes_n: int = 60,
+               jobs_n: int = 96, count: int = 2, timeout: float = 240.0,
+               algorithm: str = None):
+    """One live 3-node replicated cluster trial of the WHOLE pipeline:
+    register `jobs_n` small service jobs on the leader and measure
+    wall-clock from first registration until every alloc is committed
+    in the leader's FSM (drained broker + drained blocked set).
+
+    `batching` flips both halves of the end-to-end batch path at once —
+    plan_commit_batching (applier coalesces commits into one raft
+    command) and eval_batch_size (workers drain ready evals in bulk
+    against one shared snapshot). batching=False is the pre-ISSUE-5
+    one-at-a-time pipeline, preserved as the A/B baseline.
+
+    Returns {"allocs_s", "p50_ms", "p99_ms", "rejection", ...}.
+    """
+    import shutil
+    import tempfile
+
+    from nomad_tpu.core.metrics import REGISTRY
+    from nomad_tpu.core.server import ServerConfig
+    from nomad_tpu.raft.cluster import RaftCluster
+    from nomad_tpu.structs import enums
+    from nomad_tpu.structs.operator import SchedulerConfiguration
+
+    algorithm = algorithm or enums.SCHED_ALG_TPU_BINPACK
+
+    def config_fn(_i: int) -> ServerConfig:
+        return ServerConfig(
+            num_workers=workers,
+            plan_commit_batching=batching,
+            eval_batch_size=8 if batching else 1,
+            sched_config=SchedulerConfiguration(scheduler_algorithm=algorithm),
+            heartbeat_ttl=3600.0,  # bench-safe timers (see run_server)
+            gc_interval=3600.0,
+            nack_timeout=900.0,
+            failed_eval_followup_delay=3600.0,
+            failed_eval_unblock_interval=0.5,
+        )
+
+    # durable log dirs => every raft commit pays a real fsync, like a
+    # production deployment; this is the cost plan-commit batching
+    # amortizes, so the A/B would be meaningless without it
+    tmp = tempfile.mkdtemp(prefix="e2ebench-")
+    cluster = RaftCluster(3, config_fn=config_fn, data_dir=tmp)
+    try:
+        cluster.start()
+        leader = cluster.wait_for_leader(timeout=15.0)
+        if leader is None:
+            raise TimeoutError("no leader elected for the e2e bench cluster")
+        build_nodes(leader.store, nodes_n)  # replicated node upserts
+        srv = leader.server
+
+        # workload-shaped warmup (see run_harness)
+        warm = service_job(count)
+        srv.register_job(warm)
+        srv.wait_for_idle(timeout=60.0, include_delayed=False)
+        srv.deregister_job(warm.id)
+        srv.wait_for_idle(timeout=60.0, include_delayed=False)
+        srv.plan_applier.stats.update(applied=0, nodes_rejected=0,
+                                      partial_commits=0, commit_batches=0,
+                                      batched_commits=0)
+        REGISTRY.reset("nomad.eval.enqueue_to_commit")
+
+        # Setup (untimed): upsert the jobs WITHOUT their registration
+        # evals — the rung measures the eval pipeline (enqueue ->
+        # alloc-committed-in-FSM), not job-registration throughput,
+        # which would otherwise pace the fast configurations.
+        from nomad_tpu import mock
+
+        jobs = [service_job(count) for _ in range(jobs_n)]
+        expect = jobs_n * count
+        for j in jobs:
+            leader.store.upsert_job(j)
+        evals = [mock.eval_for(j, create_time=time.time()) for j in jobs]
+        index = leader.store.upsert_evals(evals)  # one replicated round
+        for ev in evals:
+            ev.modify_index = index
+
+        t0 = time.perf_counter()
+        for ev in evals:
+            srv.broker.enqueue(ev)
+        deadline = time.time() + timeout
+        while True:
+            if not srv.wait_for_idle(timeout=max(1.0, deadline - time.time()),
+                                     include_delayed=False):
+                raise TimeoutError("e2e trial did not drain the eval queue")
+            if srv.blocked.blocked_count() == 0:
+                break
+            if time.time() > deadline:
+                raise TimeoutError("e2e trial: blocked evals did not drain")
+            time.sleep(0.2)
+        dt = time.perf_counter() - t0
+
+        # committed-in-FSM means the leader's LOCAL applied store, not a
+        # client-side echo: count allocs there
+        snap = leader.local_store.snapshot()
+        placed = sum(len([a for a in snap.allocs_by_job(j.id)
+                          if not a.terminal_status()]) for j in jobs)
+        if placed < expect:
+            raise RuntimeError(
+                f"e2e trial placed {placed}/{expect} allocs "
+                f"(workers={workers} batching={batching})")
+        stats = dict(srv.plan_applier.stats)
+        rejected = stats.get("nodes_rejected", 0)
+        rejection = rejected / max(placed + rejected, 1)
+        return {
+            "allocs_s": placed / dt,
+            "p50_ms": 1e3 * REGISTRY.percentile("nomad.eval.enqueue_to_commit", 0.50),
+            "p99_ms": 1e3 * REGISTRY.percentile("nomad.eval.enqueue_to_commit", 0.99),
+            "rejection": rejection,
+            "commit_batches": stats.get("commit_batches", 0),
+            "batched_commits": stats.get("batched_commits", 0),
+        }
+    finally:
+        cluster.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def e2e_sched_commit_throughput_3node() -> None:
+    """ISSUE 5 headline rung: enqueue->alloc-committed-in-FSM throughput
+    on a live fsync-on 3-node cluster, swept over num_workers x batching.
+    vs_baseline is (4 workers, batching on) / (1 worker, batching off) —
+    the end-to-end win of the whole batched pipeline over the serialized
+    one-at-a-time path (acceptance: >= 5x at equal-or-lower rejection)."""
+    results = {}
+    for workers in (1, 2, 4, 8):
+        for batching in (False, True):
+            key = f"w{workers}_{'on' if batching else 'off'}"
+            results[key] = _e2e_trial(workers, batching)
+    on, off = results["w4_on"], results["w1_off"]
+    extras = {}
+    for key, r in results.items():
+        extras[f"{key}_allocs_s"] = round(r["allocs_s"], 1)
+        extras[f"{key}_p99_ms"] = round(r["p99_ms"], 1)
+        extras[f"{key}_rej"] = round(r["rejection"], 4)
+    emit("e2e_sched_commit_throughput_3node",
+         on["allocs_s"], "allocs/s",
+         on["allocs_s"] / max(off["allocs_s"], 1e-9),
+         p50_ms=on["p50_ms"], p99_ms=on["p99_ms"],
+         rejection=on["rejection"],
+         baseline_rejection=off["rejection"],
+         commit_batches=on["commit_batches"],
+         batched_commits=on["batched_commits"],
+         **extras)
+
+
 CONFIGS = [
     # before the headline: a driver timeout must not eat the raft rung
     ("raft3", raft_commit_throughput_3node),
+    ("e2e3", e2e_sched_commit_throughput_3node),
     ("headline", headline_spread_1k),
     ("c2m", cfg_c2m),
     ("cfg1", cfg1_service_binpack),

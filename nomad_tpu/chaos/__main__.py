@@ -3,6 +3,7 @@
 
     python -m nomad_tpu.chaos [--seed N]
     python -m nomad_tpu.chaos --raft-smoke
+    python -m nomad_tpu.chaos --e2e-smoke
 
 Exit 0 when every invariant holds; 2 on a violation (the CI gate in
 scripts/check.sh). This is the smallest end-to-end proof that the
@@ -12,7 +13,13 @@ the full scenario matrix lives in tests/test_chaos.py.
 `--raft-smoke` runs the group-commit write-path smoke instead: 3
 durable raft nodes, 500 commands from 8 concurrent proposers, a leader
 crash-restart in the middle — asserts zero acknowledged commits lost
-(PERF.md "The replicated write path")."""
+(PERF.md "The replicated write path").
+
+`--e2e-smoke` runs the full-pipeline smoke: 300 evals through
+broker -> batched workers -> pipelined plan applier -> raft group
+commit -> FSM on a durable 3-node cluster, with one leader restart
+mid-stream — zero acked allocs lost, rejection <= 5% (the
+scripts/check.sh --e2e-smoke gate; PERF.md "End-to-end pipeline")."""
 
 from __future__ import annotations
 
@@ -206,12 +213,153 @@ def raft_smoke(total: int = 500, proposers: int = 8) -> int:
     return 0
 
 
+def e2e_smoke(jobs_n: int = 300, nodes_n: int = 75, workers: int = 4) -> int:
+    """Full-pipeline smoke (scripts/check.sh --e2e-smoke): 300 evals
+    through broker -> batched workers -> pipelined plan applier -> raft
+    group commit -> FSM on a durable 3-node cluster, with one leader
+    crash-restart mid-stream. Asserts: zero acked (committed-in-FSM)
+    allocs lost across the failover, plan rejection rate <= 5%, every
+    eval drained, and the alloc-uniqueness + safety invariants hold."""
+    import os
+    import shutil
+
+    from ..core.server import ServerConfig
+    from ..raft.cluster import RaftCluster
+    from .invariants import InvariantChecker
+
+    t0 = time.monotonic()
+
+    def config_fn(_i: int) -> ServerConfig:
+        return ServerConfig(
+            num_workers=workers, plan_commit_batching=True,
+            eval_batch_size=8,
+            heartbeat_ttl=3600.0, gc_interval=3600.0, nack_timeout=900.0,
+            failed_eval_followup_delay=3600.0,
+            failed_eval_unblock_interval=0.5)
+
+    tmp = tempfile.mkdtemp(prefix="nomad-e2e-smoke-")
+    checker = InvariantChecker()
+    try:
+        cluster = RaftCluster(3, config_fn=config_fn, data_dir=tmp)
+        cluster.start()
+        try:
+            leader = cluster.wait_for_leader(timeout=15.0)
+            if leader is None:
+                print("E2E SMOKE: FAIL — no leader elected")
+                return 2
+            for _ in range(nodes_n):
+                leader.register_node(mock.node())
+
+            jobs = []
+            for _ in range(jobs_n):
+                j = mock.job()
+                j.task_groups[0].count = 1
+                # small tasks, low cluster utilization: the gate measures
+                # pipeline safety across a failover, not placement
+                # contention (bench.py's rungs own the contention axis)
+                j.task_groups[0].tasks[0].resources.cpu = 100
+                j.task_groups[0].tasks[0].resources.memory_mb = 64
+                jobs.append(j)
+                leader.store.upsert_job(j)
+            evals = [mock.eval_for(j, create_time=time.time())
+                     for j in jobs]
+            index = leader.store.upsert_evals(evals)
+            for ev in evals:
+                ev.modify_index = index
+            for ev in evals:
+                leader.server.broker.enqueue(ev)
+
+            # crash the leader once the pipeline is genuinely mid-batch:
+            # some allocs committed, many evals still in flight
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                snap = leader.local_store.snapshot()
+                committed = [a.id for a in snap.allocs()]
+                if len(committed) >= jobs_n // 4:
+                    break
+                time.sleep(0.002)
+            else:
+                print("E2E SMOKE: FAIL — pipeline never reached the "
+                      "crash window")
+                return 2
+            # everything in the crashed leader's applied FSM was
+            # committed by a quorum => acked; none of it may vanish
+            acked = set(committed)
+            old_stats = dict(leader.server.plan_applier.stats)
+            cluster.crash(leader.id)
+
+            fresh = cluster.wait_for_leader(timeout=20.0)
+            if fresh is None:
+                print("E2E SMOKE: FAIL — no leader after the crash")
+                return 2
+            cluster.restart(leader.id)
+
+            # drain: _restore_evals re-enqueued every still-pending
+            # eval on the new leader; wait until all evals terminal
+            # and nothing is parked in the blocked tracker
+            deadline = time.time() + 180
+            while True:
+                fresh = cluster.leader() or fresh
+                if fresh.server._running \
+                        and fresh.server.wait_for_idle(
+                            timeout=10.0, include_delayed=False) \
+                        and fresh.server.blocked.blocked_count() == 0:
+                    snap = fresh.local_store.snapshot()
+                    placed = [a for a in snap.allocs()
+                              if not a.terminal_status()
+                              and not a.server_terminal()]
+                    if len(placed) >= jobs_n:
+                        break
+                if time.time() > deadline:
+                    print("E2E SMOKE: FAIL — pipeline did not drain "
+                          "after the failover")
+                    return 2
+                time.sleep(0.1)
+
+            checker.check_convergence(cluster, timeout=30.0)
+            checker.check_all(cluster)
+
+            snap = fresh.local_store.snapshot()
+            lost = acked - {a.id for a in snap.allocs()}
+            if lost:
+                print(f"E2E SMOKE: FAIL — {len(lost)} acked alloc(s) "
+                      f"lost across the failover: "
+                      f"{sorted(i[:8] for i in lost)[:5]}")
+                return 2
+
+            # rejection across BOTH leaderships: optimistic-concurrency
+            # rejects are retried by the submitter, so the rate is
+            # rejected / (placed + rejected) like bench.py's rungs
+            stats = dict(fresh.server.plan_applier.stats)
+            rejected = (stats.get("nodes_rejected", 0)
+                        + old_stats.get("nodes_rejected", 0))
+            rejection = rejected / max(len(placed) + rejected, 1)
+            if rejection > 0.05:
+                print(f"E2E SMOKE: FAIL — plan rejection rate "
+                      f"{rejection:.1%} > 5%")
+                return 2
+        finally:
+            cluster.stop()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    dt = time.monotonic() - t0
+    print(f"E2E SMOKE: ok — {jobs_n} evals, {len(acked)} allocs acked "
+          f"pre-crash all survived the leader restart, "
+          f"rejection {rejection:.1%}, "
+          f"{checker.stats['checks']} invariant sweeps, {dt:.1f}s")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m nomad_tpu.chaos")
     parser.add_argument("--seed", type=int, default=None,
                         help="fault seed (default: NOMAD_TPU_CHAOS_SEED or 0)")
     parser.add_argument("--raft-smoke", action="store_true",
                         help="run the raft group-commit crash smoke "
+                             "instead of the scenario smoke")
+    parser.add_argument("--e2e-smoke", action="store_true",
+                        help="run the full-pipeline smoke (300 evals, "
+                             "3 nodes, leader restart mid-stream) "
                              "instead of the scenario smoke")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
@@ -223,6 +371,8 @@ def main(argv=None) -> int:
         os.environ["NOMAD_TPU_CHAOS_SEED"] = str(args.seed)
     if args.raft_smoke:
         return raft_smoke()
+    if args.e2e_smoke:
+        return e2e_smoke()
 
     t0 = time.monotonic()
     with tempfile.TemporaryDirectory(prefix="nomad-chaos-") as tmp:

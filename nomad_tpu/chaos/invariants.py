@@ -224,6 +224,34 @@ class InvariantChecker:
             time.sleep(0.05)
         self._fail(f"reschedule: {last_err} after {timeout:.0f}s")
 
+    # -- 5: alloc-set uniqueness -------------------------------------
+
+    def check_alloc_uniqueness(self, cluster) -> None:
+        """No duplicate placements: on every live node's FSM, at most
+        one *live* (neither client- nor server-terminal) alloc exists
+        per (namespace, job_id, alloc name). The batched plan-commit
+        path re-applies ambiguous rounds through the idempotent per-plan
+        fallback after a failover — upserts keyed by alloc id converge,
+        so a duplicate under a FRESH id is exactly the bug class this
+        catches (a round answered twice re-planning the same slot)."""
+        for s in _live(cluster):
+            snap = s.local_store.snapshot()
+            by_slot: Dict[tuple, List[str]] = {}
+            for a in snap.allocs():
+                if a.terminal_status() or a.server_terminal():
+                    continue
+                by_slot.setdefault(
+                    (a.namespace, a.job_id, a.name), []).append(a.id)
+            dups = {slot: ids for slot, ids in by_slot.items()
+                    if len(ids) > 1}
+            if dups:
+                worst = next(iter(dups.items()))
+                self._fail(
+                    f"alloc uniqueness: {len(dups)} slot(s) on {s.id} "
+                    f"hold multiple live allocs, e.g. {worst[0]} -> "
+                    f"{[i[:8] for i in worst[1]]}")
+        self.stats["checks"] += 1
+
     # -- aggregate ----------------------------------------------------
 
     def check_all(self, cluster) -> None:
@@ -233,6 +261,7 @@ class InvariantChecker:
         self.check_election_safety(cluster)
         self.check_log_matching(cluster)
         self.check_committed_durability(cluster)
+        self.check_alloc_uniqueness(cluster)
         self.stats["checks"] += 1
 
     def _fail(self, msg: str) -> None:

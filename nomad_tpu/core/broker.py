@@ -58,6 +58,10 @@ class EvalBroker:
         self._unacked: Dict[str, dict] = {}              # eval id -> {token, deliveries, timer}
         self._delay: List[Tuple[float, int, Evaluation]] = []  # (wait_until, seq, eval)
         self._delivery_counts: Dict[str, int] = {}
+        # eval id -> first-enqueue wall time; ack() observes the
+        # enqueue→commit latency histogram from it (an eval is acked
+        # only after its plan committed)
+        self._enqueue_times: Dict[str, float] = {}
         self._failed: List[Evaluation] = []
         self._cancelled: List[Evaluation] = []           # superseded pending evals
         self._delay_thread: Optional[threading.Thread] = None
@@ -90,6 +94,7 @@ class EvalBroker:
         self._delay.clear()
         self._failed.clear()
         self._cancelled.clear()
+        self._enqueue_times.clear()
 
     @property
     def enabled(self) -> bool:
@@ -122,6 +127,7 @@ class EvalBroker:
             return
         self.stats["enqueued"] += 1
         now = time.time()
+        self._enqueue_times.setdefault(ev.id, now)
         if ev.wait_until and ev.wait_until > now:
             heapq.heappush(self._delay, (ev.wait_until, next(self._seq), ev))
             self._lock.notify_all()  # delay loop re-sleeps
@@ -151,31 +157,73 @@ class EvalBroker:
             while True:
                 if not self._enabled:
                     return None, ""
-                best = None
-                for st in sched_types:
-                    heap = self._ready.get(st)
-                    while heap and heap[0][2] not in self._evals:
-                        heapq.heappop(heap)  # stale entry
-                    if heap and (best is None or heap[0] < best[1][0]):
-                        best = (st, heap[0])
+                best = self._best_ready_locked(sched_types)
                 if best is not None:
-                    st, (negp, seq, eval_id) = best
-                    heapq.heappop(self._ready[st])
-                    ev = self._evals.pop(eval_id)
-                    token = generate_secret_uuid()
-                    timer = threading.Timer(self.nack_timeout,
-                                            self._nack_timeout, (eval_id, token))
-                    timer.daemon = True
-                    info = {"token": token, "eval": ev, "timer": timer,
-                            "deliveries": self._delivery_count(eval_id) + 1}
-                    self._unacked[eval_id] = info
-                    timer.start()
-                    self.stats["dequeued"] += 1
-                    return ev, token
+                    return self._deliver_locked(*best)
                 remaining = None if deadline is None else deadline - time.time()
                 if remaining is not None and remaining <= 0:
                     return None, ""
                 self._lock.wait(remaining if remaining is not None else 1.0)
+
+    def dequeue_batch(self, sched_types: List[str], max_batch: int = 8,
+                      timeout: Optional[float] = None,
+                      ) -> List[Tuple[Evaluation, str]]:
+        """Blocking batch dequeue: wait exactly like dequeue() for the
+        first ready eval, then drain up to max_batch-1 more that are
+        ready RIGHT NOW (never waiting for stragglers — a batch of one
+        beats idling). Returns [(eval, token), ...]; [] on timeout or
+        disable. Per-member semantics are identical to dequeue():
+        per-job serialization still holds (job siblings park in the
+        pending heap until ack), each member gets its own delivery
+        token and nack timer, and ack/nack stay per-eval — so one
+        failing member of a batch redelivers alone."""
+        deadline = None if timeout is None else time.time() + timeout
+        with self._lock:
+            while True:
+                if not self._enabled:
+                    return []
+                out: List[Tuple[Evaluation, str]] = []
+                while len(out) < max_batch:
+                    best = self._best_ready_locked(sched_types)
+                    if best is None:
+                        break
+                    out.append(self._deliver_locked(*best))
+                if out:
+                    return out
+                remaining = None if deadline is None else deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    return []
+                self._lock.wait(remaining if remaining is not None else 1.0)
+
+    def _best_ready_locked(self, sched_types: List[str]
+                           ) -> Optional[Tuple[str, Tuple[int, int, str]]]:
+        """Best (priority, FIFO) ready entry across the given queues."""
+        best = None
+        for st in sched_types:
+            heap = self._ready.get(st)
+            while heap and heap[0][2] not in self._evals:
+                heapq.heappop(heap)  # stale entry
+            if heap and (best is None or heap[0] < best[1]):
+                best = (st, heap[0])
+        return best
+
+    def _deliver_locked(self, st: str, entry: Tuple[int, int, str]
+                        ) -> Tuple[Evaluation, str]:
+        """Pop a ready entry, mint its delivery token, arm its nack
+        timer."""
+        eval_id = entry[2]
+        heapq.heappop(self._ready[st])
+        ev = self._evals.pop(eval_id)
+        token = generate_secret_uuid()
+        timer = threading.Timer(self.nack_timeout,
+                                self._nack_timeout, (eval_id, token))
+        timer.daemon = True
+        info = {"token": token, "eval": ev, "timer": timer,
+                "deliveries": self._delivery_count(eval_id) + 1}
+        self._unacked[eval_id] = info
+        timer.start()
+        self.stats["dequeued"] += 1
+        return ev, token
 
     def _delivery_count(self, eval_id: str) -> int:
         return self._delivery_counts.get(eval_id, 0)
@@ -191,6 +239,11 @@ class EvalBroker:
             del self._unacked[eval_id]
             self._delivery_counts.pop(eval_id, None)
             self.stats["acked"] += 1
+            t0 = self._enqueue_times.pop(eval_id, None)
+            if t0 is not None:
+                from .metrics import REGISTRY
+                REGISTRY.observe("nomad.eval.enqueue_to_commit",
+                                 time.time() - t0)
             ev = info["eval"]
             key = (ev.namespace, ev.job_id)
             if self._job_tracked.get(key) == eval_id:
@@ -208,6 +261,7 @@ class EvalBroker:
                     upd.status = enums.EVAL_STATUS_CANCELLED
                     upd.status_description = "cancelled after more recent eval was processed"
                     self._cancelled.append(upd)
+                    self._enqueue_times.pop(stale.id, None)
                 self._enqueue_locked(nxt)
                 self._lock.notify_all()
 
@@ -281,6 +335,29 @@ class EvalBroker:
     def delayed_count(self) -> int:
         with self._lock:
             return len(self._delay)
+
+    def wait_for_reaper_work(self, timeout: Optional[float] = None) -> bool:
+        """Block until the reaper has something to do: a failed-queue
+        eval is ready or cancelled evals await persistence. True = work
+        available, False = timeout or broker disabled. Replaces the
+        reaper's 100ms busy-poll — every path that creates reaper work
+        (delivery-limit redelivery, failed-eval enqueue, ack-time
+        cancellation) already notifies this condition, and set_enabled
+        (False) wakes waiters so a stopping server joins promptly."""
+        deadline = None if timeout is None else time.time() + timeout
+        with self._lock:
+            while True:
+                if not self._enabled:
+                    return False
+                heap = self._ready.get(FAILED_QUEUE)
+                while heap and heap[0][2] not in self._evals:
+                    heapq.heappop(heap)  # stale entry
+                if heap or self._cancelled:
+                    return True
+                remaining = None if deadline is None else deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._lock.wait(remaining if remaining is not None else 1.0)
 
     def failed_evals(self) -> List[Evaluation]:
         """Evals parked in the failed queue (leader reaps these)."""

@@ -41,11 +41,47 @@ class _Timer:
         self._reg.sample(self._name, time.perf_counter() - self._t0)
 
 
+class _Histogram:
+    """Bounded-reservoir latency histogram: keeps the most recent
+    `capacity` observations in a ring and reports p50/p99 over them
+    (recent-window percentiles, like go-metrics' stream sample)."""
+
+    __slots__ = ("count", "total_s", "max_s", "_ring", "_capacity", "_next")
+
+    def __init__(self, capacity: int = 2048):
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self._ring: list = []
+        self._capacity = capacity
+        self._next = 0
+
+    def observe(self, seconds: float) -> None:
+        self.count += 1
+        self.total_s += seconds
+        if seconds > self.max_s:
+            self.max_s = seconds
+        if len(self._ring) < self._capacity:
+            self._ring.append(seconds)
+        else:
+            self._ring[self._next] = seconds
+            self._next = (self._next + 1) % self._capacity
+
+    def percentile(self, q: float) -> float:
+        if not self._ring:
+            return 0.0
+        data = sorted(self._ring)
+        k = min(len(data) - 1, max(0, int(round(q * (len(data) - 1)))))
+        return data[k]
+
+
 class Registry:
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = {}
         self._samples: Dict[str, _Sample] = {}
+        self._gauges: Dict[str, float] = {}
+        self._histograms: Dict[str, _Histogram] = {}
 
     def incr(self, name: str, n: float = 1.0) -> None:
         with self._lock:
@@ -61,18 +97,63 @@ class Registry:
             if seconds > s.max_s:
                 s.max_s = seconds
 
+    def set_gauge(self, name: str, value: float) -> None:
+        """Last-write-wins instantaneous value (queue depths, batch
+        sizes): unlike incr it never accumulates between scrapes."""
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe(self, name: str, seconds: float) -> None:
+        """Record into a percentile histogram (enqueue→commit latency);
+        dumped as count/mean/p50/p99/max."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = _Histogram()
+            h.observe(seconds)
+
+    def percentile(self, name: str, q: float) -> float:
+        """Current q-percentile (seconds) of a histogram, 0.0 if empty."""
+        with self._lock:
+            h = self._histograms.get(name)
+            return h.percentile(q) if h is not None else 0.0
+
     def time(self, name: str) -> "_Timer":
         """Context manager: times the block into `name`."""
         return _Timer(self, name)
 
+    def reset(self, name: str = None) -> None:
+        """Drop one metric (all families) or, with no name, everything.
+        Bench/test isolation: the registry is process-global, so A/B
+        trials in one process must clear between measurements."""
+        with self._lock:
+            if name is None:
+                self._counters.clear()
+                self._samples.clear()
+                self._gauges.clear()
+                self._histograms.clear()
+            else:
+                self._counters.pop(name, None)
+                self._samples.pop(name, None)
+                self._gauges.pop(name, None)
+                self._histograms.pop(name, None)
+
     def dump(self) -> dict:
         with self._lock:
             out = dict(self._counters)
+            out.update(self._gauges)
             for name, s in self._samples.items():
                 out[name] = {"count": s.count,
                              "mean_ms": (1000.0 * s.total_s / s.count
                                          if s.count else 0.0),
                              "max_ms": 1000.0 * s.max_s}
+            for name, h in self._histograms.items():
+                out[name] = {"count": h.count,
+                             "mean_ms": (1000.0 * h.total_s / h.count
+                                         if h.count else 0.0),
+                             "p50_ms": 1000.0 * h.percentile(0.50),
+                             "p99_ms": 1000.0 * h.percentile(0.99),
+                             "max_ms": 1000.0 * h.max_s}
             return out
 
 

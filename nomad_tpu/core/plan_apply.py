@@ -32,6 +32,7 @@ import heapq
 import itertools
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
@@ -240,6 +241,26 @@ class _OverlaySnapshot:
         return out
 
 
+class _CommitEntry:
+    """One verified plan waiting on the batching commit thread — or,
+    with plan=None, a bare eval-status update riding the same batch
+    (payload pre-built, no verification, no overlay cell)."""
+
+    __slots__ = ("plan", "result", "rejected", "verify_gen", "cell",
+                 "future", "error", "payload")
+
+    def __init__(self, plan, result, rejected, verify_gen, cell, future,
+                 payload=None):
+        self.plan = plan
+        self.result = result
+        self.rejected = rejected
+        self.verify_gen = verify_gen
+        self.cell = cell
+        self.future = future
+        self.error: Optional[Exception] = None
+        self.payload = payload
+
+
 class PlanApplier:
     """The serialized applier goroutine (reference plan_apply.go:96 planApply)."""
 
@@ -251,17 +272,42 @@ class PlanApplier:
     # grows GIL-releasing work (native fit kernels, IO).
     PARALLEL_THRESHOLD = 1 << 30
 
+    # Commit coalescing cap: one raft round (fsync + quorum) covers at
+    # most this many verified plans. Far above what verification can
+    # queue behind one round trip in practice; bounds worst-case
+    # batch-failure fallback work.
+    COMMIT_BATCH_MAX = 64
+
+    # Commit rounds in flight at once when the store can propose
+    # without waiting (RaftStore.propose_async under a group-commit
+    # raft node). The replicated round costs ~1 disk fsync of latency
+    # quiet but inflates several-fold under scheduler thread load (GIL
+    # handoffs on the propose→log-writer→replicate→ack→apply path);
+    # overlapping rounds hides that latency the same way pipelined
+    # replication hides the follower round trip. Raft log order =
+    # propose order, so apply order across overlapping rounds is
+    # exactly the serialized path's.
+    COMMIT_PIPELINE_DEPTH = 4
+
     def __init__(self, store, queue: PlanQueue, logger=None,
                  pool_workers: Optional[int] = None,
-                 bad_node_tracker: Optional[BadNodeTracker] = None):
+                 bad_node_tracker: Optional[BadNodeTracker] = None,
+                 batch: bool = True):
         import os
 
         self.store = store
         self.queue = queue
         self.logger = logger
+        self.batch = batch
         self._thread: Optional[threading.Thread] = None
+        self._commit_thread: Optional[threading.Thread] = None
+        # verified-and-waiting commit entries the commit thread coalesces
+        self._commit_q: "deque[_CommitEntry]" = deque()
+        self._commit_cond = threading.Condition()
         self._stop = threading.Event()
-        self.stats = {"applied": 0, "nodes_rejected": 0, "partial_commits": 0}
+        self.stats = {"applied": 0, "nodes_rejected": 0, "partial_commits": 0,
+                      "commit_batches": 0, "batched_commits": 0,
+                      "batched_eval_updates": 0}
         # commits are serialized through the 1-worker commit pool, but
         # the synchronous apply() entrypoint can run concurrently with
         # the loop; counters get their own leaf lock
@@ -283,8 +329,13 @@ class PlanApplier:
         self._stop.clear()
         self._pool = ThreadPoolExecutor(max_workers=self.pool_workers,
                                         thread_name_prefix="plan-verify")
-        self._commit_pool = ThreadPoolExecutor(max_workers=1,
-                                               thread_name_prefix="plan-commit")
+        if self.batch:
+            self._commit_thread = threading.Thread(
+                target=self._run_commit, daemon=True, name="plan-commit")
+            self._commit_thread.start()
+        else:
+            self._commit_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="plan-commit")
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="plan-applier")
         self._thread.start()
@@ -294,6 +345,11 @@ class PlanApplier:
         self.queue.set_enabled(False)
         if self._thread is not None:
             self._thread.join(timeout=2.0)
+        if self._commit_thread is not None:
+            with self._commit_cond:
+                self._commit_cond.notify_all()
+            self._commit_thread.join(timeout=5.0)
+            self._commit_thread = None
         if self._pool is not None:
             self._pool.shutdown(wait=False)
         if self._commit_pool is not None:
@@ -306,9 +362,12 @@ class PlanApplier:
         # Seqlock discipline with _poison_gen: writers update the cell
         # THEN bump the generation; readers read the generation THEN the
         # cells, and re-verify at commit if the generation moved.
+        from .metrics import REGISTRY
+
         inflight: List[Tuple[Future, dict]] = []
         while not self._stop.is_set():
             pending = self.queue.dequeue(timeout=0.2)
+            REGISTRY.set_gauge("nomad.plan.queue_depth", self.queue.depth())
             if pending is None:
                 continue
             try:
@@ -316,14 +375,26 @@ class PlanApplier:
                 verify_gen = self._poison_gen
                 overlays = [c["result"] for _, c in inflight]
                 result, rejected = self._verify(pending.plan, overlays)
-                # the single-worker commit pool serializes commits in
-                # submission order; the submitter is answered from the
-                # future's callback the moment its commit lands
+                # commits are serialized in submission order — through
+                # the batching commit thread (which coalesces every
+                # verified-and-waiting plan into one store/raft round)
+                # or the single-worker pool (batch=False A/B baseline);
+                # either way the submitter is answered from the future's
+                # callback the moment its commit lands
                 cell = {"result": result}
-                fut = self._commit_pool.submit(
-                    self._commit_task, pending.plan, result, rejected,
-                    verify_gen, cell)
-                fut.add_done_callback(self._responder(pending))
+                if self.batch:
+                    fut: Future = Future()
+                    fut.add_done_callback(self._responder(pending))
+                    entry = _CommitEntry(pending.plan, result, rejected,
+                                         verify_gen, cell, fut)
+                    with self._commit_cond:
+                        self._commit_q.append(entry)
+                        self._commit_cond.notify()
+                else:
+                    fut = self._commit_pool.submit(
+                        self._commit_task, pending.plan, result, rejected,
+                        verify_gen, cell)
+                    fut.add_done_callback(self._responder(pending))
                 inflight.append((fut, cell))
             except Exception as e:  # surface to the submitting worker
                 if self.logger:
@@ -380,16 +451,14 @@ class PlanApplier:
             new_result, new_rejected = self._verify(plan, None)
             if not self._result_equal(result, rejected,
                                       new_result, new_rejected):
-                cell["result"] = new_result   # data first...
-                self._poison_gen += 1         # ...then the version bump
+                self._poison(cell, new_result)
             result, rejected = new_result, new_rejected
         try:
             return self._commit(plan, result, rejected)
         except Exception:
-            # nothing landed: empty the overlay cell before bumping so a
+            # nothing landed: empty the overlay cell (and bump) so a
             # reader that sees the new generation also sees the new cell
-            cell["result"] = PlanResult()
-            self._poison_gen += 1
+            self._poison(cell, PlanResult())
             raise
 
     @staticmethod
@@ -408,8 +477,340 @@ class PlanApplier:
         b2 = {(b.id, b.rejected_rows) for b in r2.alloc_blocks}
         return b1 == b2
 
-    def _commit(self, plan: Plan, result: PlanResult,
-                rejected: List[str]) -> PlanResult:
+    # -- the batching commit thread (batch=True) --
+
+    def _run_commit(self) -> None:
+        """Group commit for plans: drain every verified-and-waiting
+        entry and land the lot as ONE upsert_plan_results_batch — under
+        raft, one replicated command, one fsync+quorum round (riding the
+        log writer's append_batch) — instead of one round per plan.
+        Entries keep submission order, so the pipelined-overlay
+        invariants are exactly the serialized commit pool's.
+
+        When the store can propose without waiting (a group-commit raft
+        node), commit rounds additionally PIPELINE up to
+        COMMIT_PIPELINE_DEPTH deep: round K+1 is verified and proposed
+        while K is still replicating. Raft log order equals propose
+        order from this single thread, so the FSM applies the rounds in
+        exactly the order they were built; responses are reaped oldest
+        round first, preserving the serialized path's answer order."""
+        if getattr(self.store, "can_propose_async", False):
+            return self._run_commit_pipelined()
+        while True:
+            with self._commit_cond:
+                while not self._commit_q and not self._stop.is_set():
+                    self._commit_cond.wait(0.2)
+                if not self._commit_q:
+                    if self._stop.is_set():
+                        return
+                    continue
+                entries = []
+                while self._commit_q and len(entries) < self.COMMIT_BATCH_MAX:
+                    entries.append(self._commit_q.popleft())
+            try:
+                self._commit_entries(entries)
+            except Exception as e:
+                # belt-and-braces: _commit_entries contains per-entry
+                # handling; anything escaping here must still answer the
+                # submitters or their workers block until nack timeout
+                if self.logger:
+                    self.logger.exception("plan commit batch failed")
+                for entry in entries:
+                    if not entry.future.done():
+                        entry.future.set_exception(e)
+
+    def _run_commit_pipelined(self) -> None:
+        """The overlapping-rounds variant of _run_commit, split across
+        two threads so a round in flight never stalls the next one:
+
+        - THIS thread (the proposer) drains the commit queue, verifies
+          and PROPOSES rounds back-to-back — the workload is a convoy
+          (every submitter blocks on its round, then produces its next
+          write only after the round lands), so the entries for round
+          K+1 arrive precisely while round K replicates; a proposer
+          that waited for K would re-serialize the rounds it is meant
+          to overlap.
+        - The reap thread waits on rounds OLDEST FIRST and answers
+          their submitters, preserving the serialized path's response
+          order. The reap deque doubles as the in-flight window the
+          proposer overlays (rounds leave it only after landing) and
+          as backpressure: the proposer stalls at COMMIT_PIPELINE_DEPTH
+          unreaped rounds.
+
+        On stop, the proposer drains the queue, then the reaper drains
+        every in-flight round — submitters are always answered."""
+        reap_q: deque = deque()
+        reap_cond = threading.Condition()
+        reap_done = threading.Event()
+
+        def reaper() -> None:
+            while True:
+                with reap_cond:
+                    while not reap_q and not reap_done.is_set():
+                        reap_cond.wait(0.2)
+                    if not reap_q:
+                        return
+                    # peek, don't pop: the proposer must keep
+                    # overlaying this round until it has LANDED
+                    round_ = reap_q[0]
+                try:
+                    self._finish_round(round_)
+                except Exception as e:
+                    # belt-and-braces: _finish_round answers per-entry;
+                    # anything escaping must still answer the rest or
+                    # their workers block until nack timeout
+                    if self.logger:
+                        self.logger.exception("plan commit reap failed")
+                    for entry in round_["entries"]:
+                        if not entry.future.done():
+                            entry.future.set_exception(e)
+                with reap_cond:
+                    reap_q.popleft()
+                    reap_cond.notify_all()  # release backpressure
+
+        reap_thread = threading.Thread(target=reaper, daemon=True,
+                                       name="plan-commit-reap")
+        reap_thread.start()
+        try:
+            while True:
+                entries: List[_CommitEntry] = []
+                with self._commit_cond:
+                    while not self._commit_q and not self._stop.is_set():
+                        self._commit_cond.wait(0.2)
+                    while self._commit_q \
+                            and len(entries) < self.COMMIT_BATCH_MAX:
+                        entries.append(self._commit_q.popleft())
+                if not entries:
+                    return  # stopped with a drained queue
+                with reap_cond:
+                    while len(reap_q) >= self.COMMIT_PIPELINE_DEPTH:
+                        reap_cond.wait(0.2)
+                    inflight = list(reap_q)
+                try:
+                    round_ = self._begin_round(entries, inflight)
+                except Exception as e:
+                    if self.logger:
+                        self.logger.exception("plan commit round failed")
+                    for entry in entries:
+                        if not entry.future.done():
+                            entry.future.set_exception(e)
+                    continue
+                with reap_cond:
+                    reap_q.append(round_)
+                    reap_cond.notify_all()
+        finally:
+            reap_done.set()
+            with reap_cond:
+                reap_cond.notify_all()
+            reap_thread.join(timeout=5.0)
+
+    def _commit_entries(self, entries: List[_CommitEntry]) -> None:
+        plans = self._round_prologue(entries)
+        # 1: poisoned-overlay re-verification, in order. Unlike the
+        # serialized pool, in-batch predecessors have NOT landed yet, so
+        # a stale entry re-verifies against the bare store overlaid with
+        # its predecessors' current cells (they land atomically with it).
+        # Eval-only entries carry no placements: nothing to verify.
+        self._reverify_stale(plans, [])
+        # 2: one transaction for the whole batch
+        writers = self._writers_for(entries)
+        if writers:
+            try:
+                index = self.store.upsert_plan_results_batch(
+                    [p for _, p in writers])
+                for e, _ in writers:
+                    if e.result is not None:
+                        e.result.alloc_index = index
+            except Exception:
+                if self.logger:
+                    self.logger.exception(
+                        "batched plan commit failed; retrying per-plan")
+                self._commit_fallback(writers)
+        # 3: respond in order
+        self._respond(entries)
+
+    def _round_prologue(self, entries: List[_CommitEntry]
+                        ) -> List[_CommitEntry]:
+        """Stats + gauges for one commit round; returns the plan-backed
+        entries (the rest are bare eval updates)."""
+        from .metrics import REGISTRY
+
+        plans = [e for e in entries if e.plan is not None]
+        REGISTRY.set_gauge("nomad.plan.commit_batch_size", len(entries))
+        with self._stats_lock:
+            self.stats["commit_batches"] += 1
+            self.stats["batched_commits"] += len(plans)
+            self.stats["batched_eval_updates"] += len(entries) - len(plans)
+        return plans
+
+    def _poison(self, cell: Optional[dict], result: PlanResult) -> None:
+        """Rewrite an overlay cell and bump the poison generation as
+        one guarded step. With pipelined rounds there are TWO writer
+        threads (the proposer re-verifying stale entries, the reaper
+        failing/falling-back rounds); readers stay lock-free — the
+        generation check is a bare int read — per the seqlock
+        discipline described in _run."""
+        with self._stats_lock:
+            if cell is not None:
+                cell["result"] = result  # data first...
+            self._poison_gen += 1        # ...then the version bump
+
+    def _reverify_stale(self, plans: List[_CommitEntry],
+                        prior: List[_CommitEntry]) -> None:
+        """Phase 1: entries whose verify-time generation went stale
+        re-verify against the bare store overlaid with every
+        predecessor that has not landed yet — `prior` (plan entries of
+        in-flight pipelined rounds, oldest first) plus this round's
+        earlier entries. All of them enter the raft log strictly before
+        this entry, so overlaying their current cells is exact."""
+        done: List[_CommitEntry] = list(prior)
+        for e in plans:
+            if self._poison_gen != e.verify_gen:
+                overlays = [p.cell["result"] for p in done] or None
+                new_result, new_rejected = self._verify(e.plan, overlays)
+                if not self._result_equal(e.result, e.rejected,
+                                          new_result, new_rejected):
+                    self._poison(e.cell, new_result)
+                e.result, e.rejected = new_result, new_rejected
+            done.append(e)
+
+    def _writers_for(self, entries: List[_CommitEntry]
+                     ) -> List[Tuple[_CommitEntry, dict]]:
+        payloads = [e.payload if e.plan is None
+                    else self._payload_for(e.plan, e.result)
+                    for e in entries]
+        return [(e, p) for e, p in zip(entries, payloads) if p is not None]
+
+    def _respond(self, entries: List[_CommitEntry]) -> None:
+        """Phase 3: answer every submitter, in order."""
+        for e in entries:
+            if e.error is not None:
+                self._poison(e.cell, PlanResult())  # nothing of e landed
+                e.future.set_exception(e.error)
+            elif e.plan is None:
+                e.future.set_result(None)
+            else:
+                e.future.set_result(
+                    self._finalize(e.plan, e.result, e.rejected))
+
+    # -- the pipelined rounds (store.can_propose_async) --
+
+    def _begin_round(self, entries: List[_CommitEntry],
+                     inflight: "deque") -> dict:
+        """Verify and PROPOSE one commit round without waiting for the
+        raft commit. Phase-1 overlays must include the plan entries of
+        every round still in flight — they precede this round in the
+        log but have not applied yet. A propose failure (lost
+        leadership, stopped node) is recorded on the round and handled
+        at reap time exactly like a failed batch transaction."""
+        plans = self._round_prologue(entries)
+        prior = [e for r in inflight for e in r["plans"]]
+        self._reverify_stale(plans, prior)
+        writers = self._writers_for(entries)
+        round_ = {"entries": entries, "plans": plans, "writers": writers,
+                  "prop": None, "error": None}
+        if writers:
+            try:
+                round_["prop"] = self.store.propose_async(
+                    "upsert_plan_results_batch", [p for _, p in writers])
+            except Exception as err:
+                round_["error"] = err
+                # The round's outcome is now ambiguous until the reap
+                # thread's fallback resolves it, but a successor round
+                # may be verified and proposed before then. Make the
+                # overlay cells conservative in BOTH directions: keep
+                # the placements (they may still land via the fallback
+                # — successors must not reuse that capacity) and drop
+                # the stops/preemptions (they may never land —
+                # successors must not move into capacity they "freed").
+                for e in plans:
+                    conservative = PlanResult()
+                    conservative.node_allocation = dict(
+                        e.result.node_allocation)
+                    conservative.alloc_blocks = list(e.result.alloc_blocks)
+                    self._poison(e.cell, conservative)
+        return round_
+
+    def _finish_round(self, round_: dict) -> None:
+        """Reap one in-flight round: wait for its raft apply, then
+        respond. A failed wait falls back to per-plan commits — the
+        retried payloads land AFTER any younger in-flight rounds, but
+        every payload is an upsert keyed by alloc/eval id, so a round
+        that actually landed before the ambiguous timeout re-applies as
+        a no-op and a genuinely lost round converges to the same final
+        state the in-order apply would have produced."""
+        writers = round_["writers"]
+        prop = round_["prop"]
+        if prop is not None:
+            try:
+                index = self.store.wait_applied(prop, timeout=30.0)
+                for e, _ in writers:
+                    if e.result is not None:
+                        e.result.alloc_index = index
+            except Exception:
+                if self.logger:
+                    self.logger.exception(
+                        "pipelined plan commit failed; retrying per-plan")
+                self._commit_fallback(writers)
+        elif round_["error"] is not None and writers:
+            if self.logger:
+                self.logger.error(
+                    "plan commit propose failed; retrying per-plan: %s",
+                    round_["error"])
+            self._commit_fallback(writers)
+        self._respond(round_["entries"])
+
+    def _commit_fallback(self, writers: List[Tuple[_CommitEntry, dict]]
+                         ) -> None:
+        """The whole-batch transaction failed (nothing landed): land
+        each plan individually so one poisoned plan fails alone. After
+        any individual failure, later entries re-verify against the bare
+        store — by then every predecessor has landed individually or
+        failed, so the store is exact again."""
+        dirty = False
+        for e, payload in writers:
+            try:
+                if dirty and e.plan is not None:
+                    new_result, new_rejected = self._verify(e.plan, None)
+                    if not self._result_equal(e.result, e.rejected,
+                                              new_result, new_rejected):
+                        self._poison(e.cell, new_result)
+                    e.result, e.rejected = new_result, new_rejected
+                    payload = self._payload_for(e.plan, e.result)
+                if payload is not None:
+                    index = self.store.upsert_plan_results(**payload)
+                    if e.result is not None:
+                        e.result.alloc_index = index
+            except Exception as err:
+                e.error = err
+                dirty = True
+
+    @staticmethod
+    def _payload_for(plan: Plan, result: PlanResult) -> Optional[dict]:
+        """The store-write kwargs for one verified plan, or None when
+        the plan has nothing left to write (fully rejected).
+
+        Plan normalization (reference nomad 0.9 plan normalization,
+        plan_normalization.go + structs Allocation.Job denormalization):
+        every Allocation embeds its full Job, which measured as ~70% of
+        the replicated bytes for small service plans — paid again at
+        every stage of the write path (log-writer deepcopy, durable-log
+        json, follower persistence x2, FSM decode). Ship the plan's job
+        ONCE in the payload and strip it from each alloc via shallow
+        copies (the scheduler's objects and the overlay cells keep
+        theirs); the FSM re-attaches at apply
+        (StateStore._rehydrate_alloc_jobs)."""
+        import copy as _copy
+
+        def stripped(allocs: list) -> list:
+            out = []
+            for a in allocs:
+                if a.job is not None:
+                    a = _copy.copy(a)
+                    a.job = None
+                out.append(a)
+            return out
+
         placements, stops, preemptions = [], [], []
         for allocs in result.node_allocation.values():
             placements.extend(allocs)
@@ -417,19 +818,31 @@ class PlanApplier:
             stops.extend(allocs)
         for allocs in result.node_preemptions.values():
             preemptions.extend(allocs)
+        if not (placements or stops or preemptions or result.alloc_blocks
+                or result.deployment is not None
+                or result.deployment_updates or plan.eval_updates):
+            return None
+        return {
+            "result_allocs": stripped(placements),
+            "stopped_allocs": stripped(stops),
+            "preempted_allocs": stripped(preemptions),
+            "deployment": result.deployment,
+            "deployment_updates": result.deployment_updates,
+            "evals": list(plan.eval_updates),
+            "alloc_blocks": list(result.alloc_blocks),
+            "job": plan.job,
+        }
 
-        if placements or stops or preemptions or result.alloc_blocks \
-                or result.deployment is not None \
-                or result.deployment_updates or plan.eval_updates:
-            index = self.store.upsert_plan_results(
-                placements, stopped_allocs=stops, preempted_allocs=preemptions,
-                deployment=result.deployment,
-                deployment_updates=result.deployment_updates,
-                evals=list(plan.eval_updates),
-                alloc_blocks=list(result.alloc_blocks),
-            )
+    def _commit(self, plan: Plan, result: PlanResult,
+                rejected: List[str]) -> PlanResult:
+        payload = self._payload_for(plan, result)
+        if payload is not None:
+            index = self.store.upsert_plan_results(**payload)
             result.alloc_index = index
+        return self._finalize(plan, result, rejected)
 
+    def _finalize(self, plan: Plan, result: PlanResult,
+                  rejected: List[str]) -> PlanResult:
         from .metrics import REGISTRY
 
         with self._stats_lock:
@@ -456,6 +869,34 @@ class PlanApplier:
                 if self.logger:
                     self.logger.exception("post-apply hook failed")
         return result
+
+    def submit_eval_updates(self, evals) -> Future:
+        """Durably persist eval status updates by riding the plan-commit
+        batch: every eval update and plan commit waiting at the commit
+        thread lands as ONE replicated command (one fsync + quorum
+        round) instead of a dedicated upsert_evals round per eval — the
+        second half of the per-eval raft cost the batched pipeline
+        amortizes. The returned future resolves (to None) when the
+        update is committed; callers needing durability-before-ack wait
+        on it, preserving the direct write's semantics exactly.
+
+        Only meaningful on a batching applier; batch=False callers
+        should write through the store directly (the A/B baseline
+        path)."""
+        if not self.batch:
+            raise RuntimeError("submit_eval_updates requires batch=True")
+        fut: Future = Future()
+        entry = _CommitEntry(None, None, (), 0, None, fut,
+                             payload={"evals": list(evals)})
+        with self._commit_cond:
+            if self._stop.is_set() or self._commit_thread is None:
+                # the commit thread may already have drained and exited
+                # (or never started); an entry appended now would never
+                # be answered
+                raise RuntimeError("plan applier not running")
+            self._commit_q.append(entry)
+            self._commit_cond.notify()
+        return fut
 
     def apply(self, plan: Plan) -> PlanResult:
         """Synchronous verify+commit (tests and direct callers; the

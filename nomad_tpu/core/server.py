@@ -45,6 +45,15 @@ class ServerConfig:
     heartbeat_ttl: float = 10.0
     nack_timeout: float = 60.0
     eval_delivery_limit: int = 3
+    # End-to-end pipeline batching (PERF.md "End-to-end pipeline").
+    # plan_commit_batching: the applier's commit thread coalesces every
+    # verified-and-waiting plan into one store/raft transaction; False
+    # restores the serialized one-commit-per-plan pool (A/B baseline).
+    plan_commit_batching: bool = True
+    # eval_batch_size: max ready evals a scheduler worker drains per
+    # dequeue and runs against one shared snapshot + ClusterStatic;
+    # 1 = classic one-eval-per-dequeue behavior (A/B baseline).
+    eval_batch_size: int = 8
     # backoff before a delivery-limited eval is retried
     # (reference leader.go failedEvalUnblockInterval)
     failed_eval_followup_delay: float = 60.0
@@ -93,6 +102,7 @@ class Server:
 
         self.plan_applier = PlanApplier(
             self.store, self.plan_queue, self.logger,
+            batch=self.config.plan_commit_batching,
             bad_node_tracker=BadNodeTracker(
                 threshold=self.config.plan_rejection_threshold,
                 window=self.config.plan_rejection_window,
@@ -368,6 +378,15 @@ class Server:
     def _run_reaper(self) -> None:
         next_unblock_failed = time.time() + self.config.failed_eval_unblock_interval
         while self._running:
+            # condition wait, not a busy-poll: wakes the moment the
+            # broker produces reaper work (failed-queue eval, cancelled
+            # pending evals), at the unblock-failed deadline, or when a
+            # stopping server disables the broker — an idle server burns
+            # zero wakeups between deadlines
+            self.broker.wait_for_reaper_work(
+                timeout=max(0.05, next_unblock_failed - time.time()))
+            if not self._running:
+                return
             # persist cancellations of superseded pending evals
             cancelled = self.broker.drain_cancelled()
             if cancelled:
@@ -380,7 +399,7 @@ class Server:
             # delivery-limited evals: mark failed, schedule a follow-up
             from .broker import FAILED_QUEUE
 
-            ev, token = self.broker.dequeue([FAILED_QUEUE], timeout=0.1)
+            ev, token = self.broker.dequeue([FAILED_QUEUE], timeout=0)
             if ev is None:
                 continue
             failed = _copy.copy(ev)

@@ -1,17 +1,28 @@
 """Scheduler workers (reference nomad/worker.go, 905 LoC).
 
-Each worker loops: dequeue an eval from the broker, wait for the state
+Each worker loops: dequeue evals from the broker, wait for the state
 store to reach the eval's modify index (worker.go:591 snapshotMinIndex),
 instantiate the right scheduler against that immutable snapshot, run it,
 and ack/nack. The worker is also the scheduler's Planner: plan submission
 routes through the leader plan queue and blocks on the applier's verdict
 (worker.go:650 SubmitPlan); partial commits hand back a fresher snapshot
 so the scheduler retries in-process.
+
+Batched mode (ServerConfig.eval_batch_size > 1): the worker drains up to
+K ready evals in one dequeue, acquires ONE snapshot at the batch's max
+modify index, and runs the members concurrently on a small per-worker
+pool. Each member's plan commit and final eval-status write then overlap
+with its siblings', so the plan applier's commit thread coalesces the
+whole batch — up to workers x K commits — into one replicated round
+instead of one round per eval. Per-eval state lives in an _EvalRun, so
+concurrent members never share mutable scheduler state; per-job
+serialization is the broker's (a batch never holds two evals of one job).
 """
 
 from __future__ import annotations
 
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
 from ..scheduler.scheduler import NewScheduler
@@ -25,81 +36,61 @@ ALL_SCHED_TYPES = [
 ]
 
 
-class Worker:
-    def __init__(self, server, worker_id: int = 0,
-                 sched_types: Optional[List[str]] = None):
-        self.server = server
-        self.id = worker_id
-        self.sched_types = sched_types or list(ALL_SCHED_TYPES)
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        self.stats = {"processed": 0, "nacked": 0}
-        # set per-eval; consulted by the Planner interface
-        self._snapshot = None
-        self._eval: Optional[Evaluation] = None
-        self._token: str = ""
+class _EvalRun:
+    """One eval's processing state + its Planner implementation.
 
-    # -- lifecycle --
+    Confined to the single thread executing run() (the worker loop or
+    one of the worker's batch-pool threads); nothing here is shared,
+    which is what lets batch members run concurrently.
+    """
 
-    def start(self) -> None:
-        self._stop.clear()
-        self._thread = threading.Thread(target=self.run, daemon=True,
-                                        name=f"worker-{self.id}")
-        self._thread.start()
+    def __init__(self, worker: "Worker", ev: Evaluation, token: str,
+                 snapshot=None):
+        self.worker = worker
+        self.server = worker.server
+        self.ev = ev
+        self.token = token
+        self.snapshot = snapshot
 
-    def stop(self) -> None:
-        self._stop.set()
-
-    def join(self, timeout: float = 2.0) -> None:
-        if self._thread is not None:
-            self._thread.join(timeout)
-
-    # -- the loop (worker.go:397 run) --
-
-    def run(self) -> None:
-        while not self._stop.is_set():
-            ev, token = self.server.broker.dequeue(self.sched_types, timeout=0.2)
-            if ev is None:
-                continue
-            self.process_one(ev, token)
-
-    def process_one(self, ev: Evaluation, token: str) -> None:
-        # Worker-thread confined: process_one (and the Planner methods it
-        # drives through sched.process) only ever runs on this worker's
-        # own run() loop; the public name exists for the Planner
-        # interface and direct-drive tests, never for concurrent callers.
-        self._eval, self._token = ev, token  # san-ok: worker-thread confined
+    def run(self):
+        """Process the eval; ack on success (after every status write
+        is durably committed), nack on failure. Returns the snapshot
+        the eval ended on (possibly refreshed by a partial commit) so a
+        serial caller can carry it forward, or None on failure."""
+        ev, server = self.ev, self.server
         try:
-            snap = self.server.store.snapshot_min_index(ev.modify_index)
-            self._snapshot = snap  # san-ok: worker-thread confined
+            snap = self.snapshot
+            if snap is None or snap.index < ev.modify_index:
+                snap = server.store.snapshot_min_index(ev.modify_index)
+            self.snapshot = snap
             sched = NewScheduler(
                 ev.type, snap, self,
-                sched_config=self.server.sched_config,
-                logger=self.server.logger,
-                on_event=lambda e: self.server.events.publish(
+                sched_config=server.sched_config,
+                logger=server.logger,
+                shared_caches=self.worker._sched_caches,
+                on_event=lambda e: server.events.publish(
                     "Scheduler", e.get("type", "scheduler-event"), e))
             from .metrics import REGISTRY
 
             with REGISTRY.time(f"nomad.worker.invoke_scheduler_{ev.type}"):
                 sched.process(ev)
-            self.server.broker.ack(ev.id, token)
-            self.stats["processed"] += 1  # san-ok: worker-thread confined
+            server.broker.ack(ev.id, self.token)
+            self.worker._count("processed")
+            return self.snapshot
         except Exception:
-            if self.server.logger:
-                self.server.logger.exception("eval %s failed", ev.id)
-            self.stats["nacked"] += 1  # san-ok: worker-thread confined
+            if server.logger:
+                server.logger.exception("eval %s failed", ev.id)
+            self.worker._count("nacked")
             try:
-                self.server.broker.nack(ev.id, token)
+                server.broker.nack(ev.id, self.token)
             except ValueError:
                 pass  # nack timer already fired
-        finally:
-            self._eval = self._token = None  # san-ok: worker-thread confined
-            self._snapshot = None  # san-ok: worker-thread confined
+            return None
 
     # -- Planner interface (worker.go:650-802) --
 
     def submit_plan(self, plan: Plan):
-        plan.snapshot_index = getattr(self._snapshot, "index", 0) or 0
+        plan.snapshot_index = getattr(self.snapshot, "index", 0) or 0
         pending = self.server.plan_queue.enqueue(plan)
         # Generous (queue depth spikes when every worker submits a large
         # plan at once) but bounded well inside the broker's nack timer —
@@ -110,22 +101,156 @@ class Worker:
         if result.refresh_index:
             # partial commit: hand the scheduler a fresher snapshot
             new_snap = self.server.store.snapshot_min_index(result.refresh_index)
-            self._snapshot = new_snap  # san-ok: worker-thread confined
+            self.snapshot = new_snap
             return result, new_snap
         return result, None
 
+    def _persist_eval(self, ev: Evaluation) -> None:
+        """Durably commit one eval's status before acting on it. On a
+        batching applier the write rides the plan-commit batch — one
+        replicated round shared with every plan and eval update
+        concurrently waiting at the commit thread — and blocks until
+        that round lands, preserving the direct write's
+        durability-before-ack semantics exactly. batch=False keeps the
+        dedicated upsert_evals write (A/B baseline)."""
+        applier = self.server.plan_applier
+        if getattr(applier, "batch", False):
+            try:
+                fut = applier.submit_eval_updates([ev])
+            except RuntimeError:
+                # applier already stopped (leadership lost mid-eval):
+                # fall through to the direct write, which surfaces the
+                # real not-leader error to run()'s nack path
+                self.server.store.upsert_evals([ev])
+                return
+            fut.result(timeout=max(10.0, self.server.config.nack_timeout / 2.0))
+        else:
+            self.server.store.upsert_evals([ev])
+
     def update_eval(self, ev: Evaluation) -> None:
-        self.server.store.upsert_evals([ev])
+        self._persist_eval(ev)
         if ev.should_block():
             self.server.blocked.block(ev)
 
     def create_eval(self, ev: Evaluation) -> None:
-        self.server.store.upsert_evals([ev])
+        self._persist_eval(ev)
         if ev.should_block():
             self.server.blocked.block(ev)
         elif ev.should_enqueue():
             self.server.broker.enqueue(ev)
 
     def reblock_eval(self, ev: Evaluation) -> None:
-        self.server.store.upsert_evals([ev])
+        self._persist_eval(ev)
         self.server.blocked.block(ev)
+
+
+class Worker:
+    def __init__(self, server, worker_id: int = 0,
+                 sched_types: Optional[List[str]] = None):
+        self.server = server
+        self.id = worker_id
+        self.sched_types = sched_types or list(ALL_SCHED_TYPES)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stats = {"processed": 0, "nacked": 0}
+        self._stats_lock = threading.Lock()
+        # batch-member pool (created at start when eval_batch_size > 1)
+        self._batch_pool: Optional[ThreadPoolExecutor] = None
+        # cross-eval constraint caches (regex compiles, parsed versions):
+        # content-keyed with immutable values, so the worst concurrent
+        # access from batch-pool members is a benign duplicate compile
+        # (dict get/set are single GIL-atomic ops)
+        self._sched_caches: dict = {}
+
+    def _count(self, key: str) -> None:
+        with self._stats_lock:
+            self.stats[key] += 1
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        self._stop.clear()
+        batch_size = getattr(self.server.config, "eval_batch_size", 1)
+        if batch_size > 1 and self._batch_pool is None:
+            self._batch_pool = ThreadPoolExecutor(
+                max_workers=batch_size,
+                thread_name_prefix=f"worker-{self.id}-eval")
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name=f"worker-{self.id}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._batch_pool is not None:
+            self._batch_pool.shutdown(wait=False)
+            self._batch_pool = None
+
+    def join(self, timeout: float = 2.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- the loop (worker.go:397 run) --
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            batch_size = getattr(self.server.config, "eval_batch_size", 1)
+            if batch_size > 1:
+                batch = self.server.broker.dequeue_batch(
+                    self.sched_types, max_batch=batch_size, timeout=0.2)
+                if not batch:
+                    continue
+                self.process_batch(batch)
+            else:
+                ev, token = self.server.broker.dequeue(
+                    self.sched_types, timeout=0.2)
+                if ev is None:
+                    continue
+                self.process_one(ev, token)
+
+    def process_batch(self, batch: List) -> None:
+        """Run a drained batch of evals against ONE shared snapshot:
+        snapshot_min_index is paid once for the whole batch (at the max
+        member index), and every scheduler in the batch reuses the
+        store-cached ClusterStatic for that node-set version — the
+        per-eval constant costs the small-eval bench rungs showed
+        dominating. Members run concurrently on the worker's pool, so
+        their plan commits and status writes coalesce at the applier's
+        commit thread. Members still ack/nack individually; a failure
+        redelivers that eval alone."""
+        from .metrics import REGISTRY
+
+        REGISTRY.set_gauge("nomad.worker.eval_batch_size", len(batch))
+        snap = None
+        try:
+            target = max(ev.modify_index for ev, _ in batch)
+            snap = self.server.store.snapshot_min_index(target)
+        except Exception:
+            snap = None  # fall back to per-eval acquisition
+        pool = self._batch_pool
+        if len(batch) == 1 or pool is None:
+            for ev, token in batch:
+                if self._stop.is_set():
+                    # shutting down: leave the rest to the nack timers
+                    break
+                # a partial commit inside a previous member refreshed
+                # the snapshot; carry the fresher one forward
+                snap = self.process_one(ev, token, snapshot=snap) or snap
+            return
+        futs = []
+        try:
+            for ev, token in batch:
+                futs.append(pool.submit(
+                    _EvalRun(self, ev, token, snapshot=snap).run))
+        except RuntimeError:
+            # pool shut down mid-batch: unsubmitted members redeliver
+            # via their nack timers
+            pass
+        for f in futs:
+            try:
+                f.result()
+            except Exception:
+                pass  # _EvalRun.run never raises; belt and braces
+
+    def process_one(self, ev: Evaluation, token: str, snapshot=None):
+        """Process a single eval inline on the calling thread."""
+        return _EvalRun(self, ev, token, snapshot=snapshot).run()

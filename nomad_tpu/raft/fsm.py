@@ -25,7 +25,7 @@ MUTATIONS = {
     "upsert_evals", "delete_evals",
     "upsert_allocs", "update_allocs_from_client",
     "update_alloc_desired_transitions",
-    "upsert_plan_results",
+    "upsert_plan_results", "upsert_plan_results_batch",
     "upsert_deployment", "update_deployment_status", "delete_deployment",
     "upsert_acl_policy", "delete_acl_policy",
     "upsert_acl_token", "delete_acl_token",
@@ -91,7 +91,7 @@ TIMESTAMPED = {
     "gc_expired_acl_tokens", "gc_one_time_tokens",
     "take_one_time_token",
     "upsert_evals", "upsert_allocs", "update_allocs_from_client",
-    "upsert_plan_results", "update_node_status",
+    "upsert_plan_results", "upsert_plan_results_batch", "update_node_status",
     "update_alloc_desired_transitions",
 }
 
@@ -112,6 +112,34 @@ class RaftStore:
 
             return propose
         return getattr(self._store, name)
+
+    @property
+    def can_propose_async(self) -> bool:
+        """True when the raft node runs the group-commit log writer —
+        the prerequisite for propose_async/wait_applied. Callers (the
+        plan applier's commit pipeline) probe this to decide whether
+        commit rounds may overlap."""
+        return bool(getattr(self._raft, "batch", False))
+
+    def propose_async(self, name: str, *args, **kwargs):
+        """Start a replicated mutation without waiting for its commit:
+        returns a proposal handle for wait_applied. Timestamp stamping
+        matches the synchronous propose path (the ts must be fixed at
+        propose time, not apply time — see TIMESTAMPED). Because
+        proposal order at the raft node is log order, a single proposer
+        pipelining rounds through this API keeps FSM apply order equal
+        to its propose order."""
+        if name not in MUTATIONS:
+            raise AttributeError(f"{name} is not a replicated mutation")
+        if name in TIMESTAMPED and kwargs.get("ts") is None:
+            kwargs["ts"] = time.time()
+        return self._raft.apply_async((name, args, kwargs))
+
+    def wait_applied(self, prop, timeout: float = 30.0):
+        """Block until a propose_async proposal is committed and
+        applied locally; returns the FSM result (the raft index for
+        store mutations)."""
+        return self._raft.apply_wait(prop, timeout)
 
     # explicit read-path passthroughs used as attributes (not calls)
     @property

@@ -236,6 +236,31 @@ class RaftNode:
             self._propose_cond.notify()
         return self._await_proposal(prop, deadline)
 
+    def apply_async(self, command: tuple) -> _Proposal:
+        """First half of apply (batch mode only): enqueue the command
+        for the group-commit log writer and return the proposal handle
+        without waiting. Proposals enter the log in apply_async call
+        order, so one caller serializing its apply_async calls gets FSM
+        apply order equal to its propose order — the ordering contract
+        the plan applier's pipelined commit rounds depend on."""
+        if not self.batch:
+            raise RuntimeError("apply_async requires batch mode")
+        prop = _Proposal(command)
+        with self._lock:
+            if self._stop.is_set():
+                raise TimeoutError("raft node stopped")
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            self._proposals.append(prop)
+            self._propose_cond.notify()
+        return prop
+
+    def apply_wait(self, prop: _Proposal, timeout: float = 5.0):
+        """Second half of apply_async: wait for commit + local apply,
+        return the FSM result. Same timeout/step-down semantics as
+        apply; safe to call at most once per proposal."""
+        return self._await_proposal(prop, time.time() + timeout)
+
     def _apply_single(self, command: tuple, deadline: float):
         """The pre-batch write path (batch=False): one synchronous
         append + fsync under the node lock per proposal, replication

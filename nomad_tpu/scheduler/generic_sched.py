@@ -29,13 +29,16 @@ BLOCKED_EVAL_FAILED_PLACEMENT_DESC = "created to place remaining allocations"
 
 class GenericScheduler:
     def __init__(self, state, planner, *, batch: bool = False,
-                 sched_config=None, logger=None, placer=None, on_event=None):
+                 sched_config=None, logger=None, placer=None, on_event=None,
+                 shared_caches=None):
         self.state = state            # a StateSnapshot-like view
         self.planner = planner
         self.batch = batch
         self.sched_config = sched_config
         self.logger = logger
         self.on_event = on_event
+        # cross-eval constraint caches (see NewScheduler); None = per-eval
+        self.shared_caches = shared_caches
         algorithm = (sched_config.scheduler_algorithm
                      if sched_config is not None else enums.SCHED_ALG_BINPACK)
         self._placer_injected = placer is not None
@@ -93,6 +96,9 @@ class GenericScheduler:
         self.plan = ev.make_plan(job)
         ctx = EvalContext(self.state, self.plan, eval_id=ev.id, logger=self.logger,
                           on_event=self.on_event)
+        if self.shared_caches is not None:
+            ctx.regex_cache = self.shared_caches.setdefault("regex", {})
+            ctx.version_cache = self.shared_caches.setdefault("version", {})
         if job is not None:
             ctx.eligibility.set_job(job)
 

@@ -40,13 +40,21 @@ class Scheduler(Protocol):
 
 def NewScheduler(sched_type: str, state, planner: Planner, *,
                  sched_config=None, logger=None, placer=None,
-                 on_event=None) -> "Scheduler":
-    """Factory (reference scheduler/scheduler.go:36 NewScheduler)."""
+                 on_event=None, shared_caches=None) -> "Scheduler":
+    """Factory (reference scheduler/scheduler.go:36 NewScheduler).
+
+    shared_caches: optional {"regex": {}, "version": {}} dicts seeded
+    into every EvalContext this scheduler builds, so a worker processing
+    a batch of evals compiles each constraint regex / parses each
+    version string once per batch instead of once per eval. The caches
+    are content-keyed (pattern -> compiled), so sharing across evals is
+    always sound; the caller owns their thread-confinement."""
     factory = BUILTIN_SCHEDULERS.get(sched_type)
     if factory is None:
         raise ValueError(f"unknown scheduler type {sched_type!r}")
     return factory(state, planner, sched_config=sched_config, logger=logger,
-                   placer=placer, on_event=on_event)
+                   placer=placer, on_event=on_event,
+                   shared_caches=shared_caches)
 
 
 def _make_registry():

@@ -20,13 +20,16 @@ from .util import tainted_nodes, update_non_terminal_allocs_to_lost
 
 class SystemScheduler:
     def __init__(self, state, planner, *, sysbatch: bool = False,
-                 sched_config=None, logger=None, placer=None, on_event=None):
+                 sched_config=None, logger=None, placer=None, on_event=None,
+                 shared_caches=None):
         self.state = state
         self.planner = planner
         self.sysbatch = sysbatch
         self.sched_config = sched_config
         self.logger = logger
         self.on_event = on_event
+        # cross-eval constraint caches (see NewScheduler); None = per-eval
+        self.shared_caches = shared_caches
         self.eval: Optional[Evaluation] = None
         self.plan = None
         self.failed_tg_allocs = {}
@@ -46,6 +49,9 @@ class SystemScheduler:
         self.plan = ev.make_plan(job)
         ctx = EvalContext(self.state, self.plan, eval_id=ev.id, logger=self.logger,
                           on_event=self.on_event)
+        if self.shared_caches is not None:
+            ctx.regex_cache = self.shared_caches.setdefault("regex", {})
+            ctx.version_cache = self.shared_caches.setdefault("version", {})
 
         all_allocs = self.state.allocs_by_job(ev.job_id, ev.namespace)
         tainted = tainted_nodes(self.state, all_allocs)

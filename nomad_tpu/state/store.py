@@ -975,55 +975,116 @@ class StateStore:
         deployment_updates: List = (),
         evals: List[Evaluation] = (),
         alloc_blocks: List[AllocBlock] = (),
+        job=None,
         ts: float = None,
     ) -> int:
         with self._write_lock:
             gen, live = self._begin()
             ts = ts if ts is not None else self._clock()
             events = []
-            for alloc in stopped_allocs:
-                self._reap_services_for_terminal(alloc, gen, live, events)
-                self._put_alloc(alloc, gen, live, ts)
-                events.append(("alloc-stop", alloc))
-            for alloc in preempted_allocs:
-                self._put_alloc(alloc, gen, live, ts)
-                events.append(("alloc-preempt", alloc))
-            new_allocs: List[Allocation] = []
-            for alloc in result_allocs:
-                # ANY alloc without an existing row is a first insert and
-                # must go through the bulk path, which records volume
-                # claims — not just fresh placements (create_index == 0):
-                # a re-upsert whose row was GC'd mid-flight still needs
-                # its claims tracked. Block positions resolve via
-                # _latest_alloc so a stop/annotation of a block alloc
-                # promotes instead of double-indexing.
-                prev = self._latest_alloc(alloc.id)
-                if prev is None:
-                    new_allocs.append(alloc)
-                    continue
-                self._put_alloc(alloc, gen, live, ts, prev=prev)
-                events.append(("alloc-upsert", alloc))
-            if new_allocs:
-                self._put_new_allocs_bulk(new_allocs, gen, live, ts, events)
-            for block in alloc_blocks:
-                self._put_alloc_block(block, gen, live, ts, events)
-            if deployment is not None:
-                self._put_deployment(deployment, gen, live)
-                events.append(("deployment-upsert", deployment))
-            for du in deployment_updates:
-                dep = self._deployments.get_latest(du.deployment_id)
-                if dep is not None:
-                    dep = copy.copy(dep)
-                    dep.status = du.status
-                    dep.status_description = du.status_description
-                    dep.modify_index = gen
-                    self._deployments.put(dep.id, dep, gen, live)
-                    events.append(("deployment-update", dep))
-            for ev in evals:
-                self._put_eval(ev, gen, live, ts)
-                events.append(("eval-upsert", ev))
+            self._apply_plan_payload(
+                result_allocs, stopped_allocs, preempted_allocs, deployment,
+                deployment_updates, evals, alloc_blocks, gen, live, ts, events,
+                job=job)
             self._commit(gen, events)
             return gen
+
+    def upsert_plan_results_batch(self, payloads: List[dict],
+                                  ts: float = None) -> int:
+        """Apply N plans' results in ONE transaction — one generation,
+        one publish, one commit-listener pass — so the plan applier's
+        group commit rides a single raft round instead of N. Each
+        payload is a kwargs dict for upsert_plan_results (minus ts).
+        Payloads apply in order: a later plan's update of an alloc an
+        earlier payload inserted resolves exactly as it would across two
+        back-to-back transactions, because get_latest sees same-gen
+        puts."""
+        with self._write_lock:
+            gen, live = self._begin()
+            ts = ts if ts is not None else self._clock()
+            events = []
+            for p in payloads:
+                self._apply_plan_payload(
+                    p.get("result_allocs", ()),
+                    p.get("stopped_allocs", ()),
+                    p.get("preempted_allocs", ()),
+                    p.get("deployment"),
+                    p.get("deployment_updates", ()),
+                    p.get("evals", ()),
+                    p.get("alloc_blocks", ()),
+                    gen, live, ts, events, job=p.get("job"))
+            self._commit(gen, events)
+            return gen
+
+    def _rehydrate_alloc_jobs(self, allocs, job) -> None:
+        """Reverse of the plan applier's normalization: allocs ride the
+        raft log without their embedded job (the plan's job rides once
+        per payload). Re-attach — from the existing row when there is
+        one (the exact version: stops and preemptions may carry an
+        older job than the plan's), else the payload's job, else the
+        job table. Deterministic across replicas: every input is FSM
+        state or the replicated payload itself."""
+        for a in allocs:
+            if a.job is not None:
+                continue
+            prev = self._latest_alloc(a.id)
+            if prev is not None and prev.job is not None:
+                a.job = prev.job
+            elif job is not None and getattr(job, "id", None) == a.job_id:
+                a.job = job
+            else:
+                a.job = self._jobs.get_latest((a.namespace, a.job_id))
+
+    def _apply_plan_payload(self, result_allocs, stopped_allocs,
+                            preempted_allocs, deployment, deployment_updates,
+                            evals, alloc_blocks, gen: int, live: int,
+                            ts: float, events: list, job=None) -> None:
+        """One plan's writes inside an open transaction. Must hold
+        _write_lock; the caller owns _begin/_commit."""
+        self._rehydrate_alloc_jobs(result_allocs, job)
+        self._rehydrate_alloc_jobs(stopped_allocs, job)
+        self._rehydrate_alloc_jobs(preempted_allocs, job)
+        for alloc in stopped_allocs:
+            self._reap_services_for_terminal(alloc, gen, live, events)
+            self._put_alloc(alloc, gen, live, ts)
+            events.append(("alloc-stop", alloc))
+        for alloc in preempted_allocs:
+            self._put_alloc(alloc, gen, live, ts)
+            events.append(("alloc-preempt", alloc))
+        new_allocs: List[Allocation] = []
+        for alloc in result_allocs:
+            # ANY alloc without an existing row is a first insert and
+            # must go through the bulk path, which records volume
+            # claims — not just fresh placements (create_index == 0):
+            # a re-upsert whose row was GC'd mid-flight still needs
+            # its claims tracked. Block positions resolve via
+            # _latest_alloc so a stop/annotation of a block alloc
+            # promotes instead of double-indexing.
+            prev = self._latest_alloc(alloc.id)
+            if prev is None:
+                new_allocs.append(alloc)
+                continue
+            self._put_alloc(alloc, gen, live, ts, prev=prev)
+            events.append(("alloc-upsert", alloc))
+        if new_allocs:
+            self._put_new_allocs_bulk(new_allocs, gen, live, ts, events)
+        for block in alloc_blocks:
+            self._put_alloc_block(block, gen, live, ts, events)
+        if deployment is not None:
+            self._put_deployment(deployment, gen, live)
+            events.append(("deployment-upsert", deployment))
+        for du in deployment_updates:
+            dep = self._deployments.get_latest(du.deployment_id)
+            if dep is not None:
+                dep = copy.copy(dep)
+                dep.status = du.status
+                dep.status_description = du.status_description
+                dep.modify_index = gen
+                self._deployments.put(dep.id, dep, gen, live)
+                events.append(("deployment-update", dep))
+        for ev in evals:
+            self._put_eval(ev, gen, live, ts)
+            events.append(("eval-upsert", ev))
 
     def _put_new_allocs_bulk(self, allocs: List[Allocation], gen: int,
                              live: int, ts: float, events: list) -> None:

@@ -13,6 +13,7 @@ and interned value-id tables ship to the device.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -80,6 +81,11 @@ class ClusterStatic:
         self.device_arrays: Dict = {}
 
 
+# one build at a time cluster-wide: builds are keyed per (version, node
+# set) and idempotent, so a global lock (not per-store) is fine
+_static_build_lock = threading.Lock()
+
+
 def _static_for(ctx: EvalContext, nodes: Sequence[Node]):
     """Cached ClusterStatic when `nodes` is the canonical ready-node list
     (see StateSnapshot.ready_nodes_in_pool); None otherwise."""
@@ -95,12 +101,19 @@ def _static_for(ctx: EvalContext, nodes: Sequence[Node]):
     key = (version, getattr(nodes, "canonical_key", None))
     static = statics.get(key)
     if static is None:
-        # drop stale versions; benign races just rebuild (iterate a
-        # keys copy — concurrent workers insert into this dict)
-        for k in [k for k in list(statics) if k[0] != version]:
-            statics.pop(k, None)
-        static = ClusterStatic(nodes, store=store, version=version)
-        statics[key] = static
+        # serialize the (expensive, O(nodes)) build so N workers racing
+        # on the same key share ONE ClusterStatic instead of each
+        # building a duplicate — with batched eval processing every
+        # worker hits this on the same version at once
+        with _static_build_lock:
+            static = statics.get(key)
+            if static is None:
+                # drop stale versions (iterate a keys copy — readers are
+                # concurrent)
+                for k in [k for k in list(statics) if k[0] != version]:
+                    statics.pop(k, None)
+                static = ClusterStatic(nodes, store=store, version=version)
+                statics[key] = static
     return static
 
 

@@ -1,8 +1,21 @@
 #!/usr/bin/env bash
 # The full local gate: lint + AST invariant checker + tier-1 tests.
 # Mirrors what CI should run; every step must pass.
+#
+#   scripts/check.sh              the standard gate
+#   scripts/check.sh --e2e-smoke  also run the full-pipeline failover
+#                                 smoke (3-node cluster, 4 workers,
+#                                 300 evals, one leader restart)
 set -u
 cd "$(dirname "$0")/.."
+
+run_e2e_smoke=0
+for arg in "$@"; do
+    case "$arg" in
+        --e2e-smoke) run_e2e_smoke=1 ;;
+        *) echo "unknown flag: $arg" >&2; exit 64 ;;
+    esac
+done
 
 failed=0
 
@@ -23,7 +36,7 @@ python -m nomad_tpu.analysis || failed=1
 echo "== nomadsan smoke (NOMAD_TPU_SAN=1) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" NOMAD_TPU_SAN=1 python -m pytest \
     tests/test_sanitizer.py tests/test_state_store.py \
-    tests/test_plan_apply_scale.py -q \
+    tests/test_plan_apply_scale.py tests/test_e2e_pipeline.py -q \
     -p no:cacheprovider || failed=1
 
 # chaos smoke: one scripted partition + crash scenario on a durable
@@ -38,6 +51,17 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m nomad_tpu.chaos || failed=1
 echo "== raft commit smoke (python -m nomad_tpu.chaos --raft-smoke) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 60 \
     python -m nomad_tpu.chaos --raft-smoke || failed=1
+
+# full-pipeline smoke (opt-in: ~a minute of wall clock): 300 evals
+# through broker -> batched workers -> pipelined applier -> raft group
+# commit -> FSM with a leader crash-restart mid-stream; zero acked
+# allocs may be lost and rejection must stay <= 5% (PERF.md
+# "End-to-end pipeline")
+if [ "$run_e2e_smoke" = 1 ]; then
+    echo "== e2e pipeline smoke (python -m nomad_tpu.chaos --e2e-smoke) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 300 \
+        python -m nomad_tpu.chaos --e2e-smoke || failed=1
+fi
 
 echo "== tier-1 tests =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \

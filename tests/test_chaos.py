@@ -540,3 +540,94 @@ class TestRandomizedSweep:
                     cluster.restart(victim)
                 r.checker.check_all(cluster)
                 r.heal_and_converge(timeout=30.0)
+
+
+# ---------------------------------------------------------------------------
+# scenario 8: leader crash mid-plan-batch-commit (ISSUE 5)
+# ---------------------------------------------------------------------------
+
+
+def _batched_pipeline_cfg(_i):
+    """The full batched pipeline: 4 workers draining evals in bulk,
+    plan-commit batching + pipelined commit rounds on, background
+    timers parked so the scenario only exercises the eval pipeline."""
+    return ServerConfig(
+        num_workers=4, plan_commit_batching=True, eval_batch_size=8,
+        heartbeat_ttl=3600.0, gc_interval=3600.0, nack_timeout=900.0,
+        failed_eval_followup_delay=3600.0,
+        failed_eval_unblock_interval=0.5)
+
+
+class TestLeaderCrashMidPlanBatchCommit:
+    def test_acked_allocs_survive_unacked_evals_requeue(self, tmp_path):
+        """Crash the leader while batched commit rounds are in flight:
+        every alloc committed in the leader's FSM (= acked to its plan
+        submitter) must survive the failover, no slot may end up with
+        duplicate live allocs (the fallback re-apply is idempotent),
+        and every eval the old leader never acked must be re-enqueued
+        and drained by the new leader (_restore_evals)."""
+        jobs_n = 60
+        with RaftCluster(3, config_fn=_batched_pipeline_cfg,
+                         data_dir=str(tmp_path)) as cluster:
+            r = ScenarioRunner(cluster, seed=0)
+            leader = r.wait_for_leader()
+            for _ in range(12):
+                leader.register_node(mock.node())
+            jobs = []
+            for _ in range(jobs_n):
+                j = mock.job()
+                j.task_groups[0].count = 1
+                j.task_groups[0].tasks[0].resources.cpu = 100
+                j.task_groups[0].tasks[0].resources.memory_mb = 64
+                jobs.append(j)
+                leader.store.upsert_job(j)
+            evals = [mock.eval_for(j, create_time=time.time())
+                     for j in jobs]
+            index = leader.store.upsert_evals(evals)
+            for ev in evals:
+                ev.modify_index = index
+            for ev in evals:
+                leader.server.broker.enqueue(ev)
+
+            # the crash must land mid-stream: some batches committed,
+            # many evals still in flight on the old leader's workers
+            _wait(lambda: len(list(leader.local_store.snapshot()
+                                   .allocs())) >= jobs_n // 4,
+                  timeout=30.0, interval=0.002,
+                  msg="mid-batch crash window")
+            acked = {a.id for a in leader.local_store.snapshot().allocs()}
+            cluster.crash(leader.id)
+
+            _wait(lambda: cluster.leader() is not None, timeout=20.0,
+                  msg="new leader after mid-batch crash")
+            cluster.restart(leader.id)
+
+            def drained():
+                fresh = cluster.leader()
+                if fresh is None or not fresh.server._running:
+                    return False
+                if not fresh.server.wait_for_idle(timeout=5.0,
+                                                  include_delayed=False):
+                    return False
+                if fresh.server.blocked.blocked_count() != 0:
+                    return False
+                live = [a for a in fresh.local_store.snapshot().allocs()
+                        if not a.terminal_status()
+                        and not a.server_terminal()]
+                return len(live) >= jobs_n
+
+            _wait(drained, timeout=120.0, interval=0.1,
+                  msg="pipeline drained after failover")
+
+            r.checker.check_convergence(cluster, timeout=30.0)
+            r.checker.check_alloc_uniqueness(cluster)
+            r.checker.check_all(cluster)
+
+            snap = cluster.leader().local_store.snapshot()
+            lost = acked - {a.id for a in snap.allocs()}
+            assert not lost, \
+                f"acked allocs lost across failover: {sorted(lost)[:5]}"
+            stranded = [e.id for e in snap.evals() if e.should_enqueue()]
+            assert not stranded, \
+                f"evals stranded pending after failover: {stranded[:5]}"
+            assert len(acked) >= jobs_n // 4  # really was mid-stream
