@@ -39,7 +39,24 @@ def main(argv=None) -> int:
                              "(Thread/Timer/executor-submit site) and exit")
     parser.add_argument("--root", type=Path, default=None,
                         help="root for relative paths (default: repo root)")
+    parser.add_argument("--modelcheck", action="store_true",
+                        help="run the deterministic interleaving model "
+                             "checker (nomadcheck dynamic prong) and exit")
+    parser.add_argument("--seeds", type=int, default=3, metavar="N",
+                        help="schedules per scenario per policy for "
+                             "--modelcheck (default 3); base seed comes "
+                             "from NOMAD_TPU_CHECK_SEED")
     args = parser.parse_args(argv)
+
+    if args.modelcheck:
+        from .modelcheck import seed_from_env, smoke
+        base = seed_from_env()
+        print(f"nomadcheck: base seed {base} "
+              f"(replay with NOMAD_TPU_CHECK_SEED={base}), "
+              f"{args.seeds} seed(s)/scenario/policy")
+        failures = smoke(base, seeds_per_scenario=args.seeds)
+        print(f"nomadcheck: {failures} failing schedule(s)")
+        return 1 if failures else 0
 
     if args.list_rules:
         for rule_id, (_, doc) in sorted(all_rules().items()):

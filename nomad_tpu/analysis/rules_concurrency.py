@@ -683,3 +683,525 @@ def check_lock_order_cycle(ctx: AnalysisContext) -> List[Finding]:
             context=ctxt,
             detail="|".join(scc)))
     return findings
+
+
+# --------------------------------------------------------------------
+# condvar protocol lints (nomadcheck static prong; see modelcheck.py
+# for the dynamic scheduler that explores what these rules approximate)
+# --------------------------------------------------------------------
+
+# names that read as a shutdown/lifecycle gate: a wait loop or queue
+# handoff that consults one of these has a way to terminate
+STOP_NAME_TOKENS = ("stop", "enabled", "enable", "closed", "close",
+                    "done", "shut", "running", "quit", "exit", "drain",
+                    "cancel", "alive", "dead")
+
+# attribute-call names that are reads/infrastructure, not state
+# mutation, for the lost-signal heuristic
+_NON_EVIDENCE_METHODS = {
+    "wait", "wait_for", "notify", "notify_all", "acquire", "release",
+    "locked", "is_set", "is_alive", "debug", "info", "warning", "error",
+    "exception", "log", "get", "items", "keys", "values", "copy",
+    "time", "monotonic", "sleep", "join", "format", "startswith",
+    "endswith", "lower", "upper", "count", "index",
+}
+
+
+def _stopish(name: str) -> bool:
+    return any(tok in name.lower() for tok in STOP_NAME_TOKENS)
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+def _while_refs_stopish(wh: ast.While) -> bool:
+    return any(_stopish(n) for n in _names_in(wh))
+
+
+def _while_has_escape(wh: ast.While) -> bool:
+    """Return/Raise anywhere inside, or Break belonging to this loop
+    (not to a nested While/For)."""
+    def scan(stmts, owner_is_wh: bool) -> bool:
+        for s in stmts:
+            if isinstance(s, (ast.Return, ast.Raise)):
+                return True
+            if isinstance(s, ast.Break) and owner_is_wh:
+                return True
+            for field in ("body", "orelse", "finalbody", "handlers"):
+                sub = getattr(s, field, None)
+                if not sub:
+                    continue
+                if field == "handlers":
+                    for h in sub:
+                        if scan(h.body, owner_is_wh
+                                and not isinstance(s, (ast.While, ast.For))):
+                            return True
+                    continue
+                inner_owner = owner_is_wh and not isinstance(
+                    s, (ast.While, ast.For))
+                if scan(sub, inner_owner):
+                    return True
+        return False
+    return scan(wh.body, True)
+
+
+@dataclass
+class _WaitSite:
+    base: str                    # dotted receiver, e.g. "self._cond"
+    lineno: int
+    whiles: List[ast.While]      # enclosing While nodes, outermost first
+    has_timeout: bool
+
+
+@dataclass
+class _NotifySite:
+    base: str
+    method: str                  # "notify" | "notify_all"
+    lineno: int
+    held: List[str]              # lockish with-contexts held at the site
+
+
+class _CondvarScan(ast.NodeVisitor):
+    """One function scope: condvar wait/notify sites with their
+    enclosing while-loops and held lockish `with` contexts, plus
+    state-mutation evidence linenos (for the lost-signal heuristic).
+    Nested defs are separate scopes (scanned on their own)."""
+
+    def __init__(self, func_node: ast.AST):
+        self.root = func_node
+        self.with_stack: List[str] = []
+        self.while_stack: List[ast.While] = []
+        self.waits: List[_WaitSite] = []
+        self.notifies: List[_NotifySite] = []
+        self.evidence: List[int] = []
+        # local `x = threading.Condition(y)` aliases in this scope
+        self.local_backing: Dict[str, str] = {}
+        for stmt in getattr(func_node, "body", []):
+            self.visit(stmt)
+
+    def visit_FunctionDef(self, node):
+        if node is self.root:
+            for stmt in node.body:
+                self.visit(stmt)
+        # nested def: separate scope
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_While(self, node: ast.While):
+        self.visit(node.test)
+        self.while_stack.append(node)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.while_stack.pop()
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    def visit_With(self, node: ast.With):
+        names: List[str] = []
+        for item in node.items:
+            self.visit(item.context_expr)
+            parts = _dotted_parts(item.context_expr)
+            if parts and _lockish(parts[-1]):
+                names.append(".".join(parts))
+        self.with_stack.extend(names)
+        for stmt in node.body:
+            self.visit(stmt)
+        if names:
+            del self.with_stack[-len(names):]
+
+    def _mark(self, lineno: int):
+        self.evidence.append(lineno)
+
+    def visit_Assign(self, node: ast.Assign):
+        self._mark(node.lineno)
+        if (isinstance(node.value, ast.Call)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            parts = _dotted_parts(node.value.func)
+            if parts and parts[-1] == "Condition":
+                arg_parts = (_dotted_parts(node.value.args[0])
+                             if node.value.args else None)
+                self.local_backing[node.targets[0].id] = (
+                    ".".join(arg_parts) if arg_parts
+                    else node.targets[0].id)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._mark(node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._mark(node.lineno)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node):
+        self._mark(node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        parts = _dotted_parts(node.func)
+        if parts and len(parts) >= 2:
+            meth = parts[-1]
+            base = ".".join(parts[:-1])
+            if _lockish(parts[-2]):
+                if meth == "wait":
+                    self.waits.append(_WaitSite(
+                        base, node.lineno, list(self.while_stack),
+                        bool(node.args or node.keywords)))
+                elif meth in ("notify", "notify_all"):
+                    self.notifies.append(_NotifySite(
+                        base, meth, node.lineno, list(self.with_stack)))
+            if meth not in _NON_EVIDENCE_METHODS:
+                self._mark(node.lineno)
+        self.generic_visit(node)
+
+
+@dataclass
+class _CondScope:
+    """A function scope prepared for the condvar rules."""
+    mod: Module
+    context: str                 # qualname-ish context string
+    class_name: Optional[str]
+    method_name: str
+    node: ast.AST
+    scan: _CondvarScan
+
+
+def _cond_backing_map(class_node: Optional[ast.ClassDef]) -> Dict[str, str]:
+    """self-attr condvar -> self-attr backing lock, from __init__
+    (`self.c = threading.Condition(self._lock)`; a Condition() with no
+    arg backs itself)."""
+    out: Dict[str, str] = {}
+    if class_node is None:
+        return out
+    init = next((s for s in class_node.body
+                 if isinstance(s, ast.FunctionDef)
+                 and s.name == "__init__"), None)
+    if init is None:
+        return out
+    for node in ast.walk(init):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        parts = _dotted_parts(node.value.func)
+        if not parts or parts[-1] != "Condition":
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                arg = node.value.args[0] if node.value.args else None
+                arg_parts = _dotted_parts(arg) if arg is not None else None
+                if (arg_parts and arg_parts[0] == "self"
+                        and len(arg_parts) == 2):
+                    out[t.attr] = arg_parts[1]
+                else:
+                    out[t.attr] = t.attr
+    return out
+
+
+def _stopish_attr_in_init(class_node: Optional[ast.ClassDef]) -> bool:
+    """Does __init__ bind any lifecycle-gate attribute (self._stop,
+    self._enabled, self._closed, ...)? Classes with no close concept
+    are exempt from the queue-handoff rules."""
+    if class_node is None:
+        return False
+    init = next((s for s in class_node.body
+                 if isinstance(s, ast.FunctionDef)
+                 and s.name == "__init__"), None)
+    if init is None:
+        return False
+    for node in ast.walk(init):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self" and _stopish(t.attr)):
+                    return True
+    return False
+
+
+def _cond_scopes(ctx: AnalysisContext) -> List[Tuple[_CondScope,
+                                                     Optional[ast.ClassDef]]]:
+    """Every function/method/nested-closure scope in analysis scope,
+    paired with its owning top-level class (None for module funcs)."""
+    out: List[Tuple[_CondScope, Optional[ast.ClassDef]]] = []
+
+    def add_scope(mod, fn, class_node, prefix):
+        ctxt = f"{mod.rel}:{prefix}{fn.name}"
+        out.append((_CondScope(mod, ctxt, class_node.name if class_node
+                               else None, fn.name, fn, _CondvarScan(fn)),
+                    class_node))
+        for inner in ast.walk(fn):
+            if (isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and inner is not fn):
+                ictxt = f"{mod.rel}:{prefix}{fn.name}.{inner.name}"
+                out.append((_CondScope(
+                    mod, ictxt, class_node.name if class_node else None,
+                    inner.name, inner, _CondvarScan(inner)), class_node))
+
+    for mod in ctx.modules:
+        if not _analysis_scope(mod):
+            continue
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                add_scope(mod, node, None, "")
+            elif isinstance(node, ast.ClassDef):
+                for s in node.body:
+                    if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        add_scope(mod, s, node, f"{node.name}.")
+    return out
+
+
+@rule("condvar-wait-outside-loop",
+      "Condition.wait() not wrapped in a predicate-rechecking while "
+      "loop (spurious/stolen wakeups break the caller)")
+def check_condvar_wait_outside_loop(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    per_ctx: Dict[str, int] = {}
+    for scope, _cls in _cond_scopes(ctx):
+        for w in scope.scan.waits:
+            if w.whiles:
+                continue
+            if _suppressed(scope.mod, w.lineno):
+                continue
+            key = f"{scope.context}:{w.base}"
+            ordinal = per_ctx.get(key, 0)
+            per_ctx[key] = ordinal + 1
+            findings.append(Finding(
+                rule="condvar-wait-outside-loop",
+                path=scope.mod.rel, line=w.lineno, severity="error",
+                message=(f"'{w.base}.wait()' outside a while loop — a "
+                         "spurious or stolen wakeup returns with the "
+                         "predicate false; wrap in "
+                         "'while not <predicate>: ...wait()'"),
+                context=scope.context,
+                detail=f"{w.base}:{ordinal}"))
+    return findings
+
+
+@rule("condvar-notify-unlocked",
+      "notify/notify_all without the condvar's (or its backing) lock "
+      "held — the waiter can miss the signal")
+def check_condvar_notify_unlocked(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    per_ctx: Dict[str, int] = {}
+    backing_cache: Dict[int, Dict[str, str]] = {}
+    for scope, cls in _cond_scopes(ctx):
+        if scope.method_name.endswith("_locked"):
+            continue  # caller owns the lock by convention
+        if cls is not None and id(cls) not in backing_cache:
+            backing_cache[id(cls)] = _cond_backing_map(cls)
+        backing = backing_cache.get(id(cls), {}) if cls else {}
+        for n in scope.scan.notifies:
+            allowed = {n.base}
+            if n.base.startswith("self."):
+                attr = n.base[len("self."):]
+                back = backing.get(attr, attr)
+                allowed.add(f"self.{back}")
+                for sib, b in backing.items():
+                    if b == back:
+                        allowed.add(f"self.{sib}")
+            else:
+                back = scope.scan.local_backing.get(n.base)
+                if back:
+                    allowed.add(back)
+            if any(h in allowed for h in n.held):
+                continue
+            if _suppressed(scope.mod, n.lineno):
+                continue
+            key = f"{scope.context}:{n.base}"
+            ordinal = per_ctx.get(key, 0)
+            per_ctx[key] = ordinal + 1
+            findings.append(Finding(
+                rule="condvar-notify-unlocked",
+                path=scope.mod.rel, line=n.lineno, severity="error",
+                message=(f"'{n.base}.{n.method}()' with no associated "
+                         "lock held — a waiter between its predicate "
+                         "check and wait() misses this signal; wrap in "
+                         f"'with {n.base}:' (or the backing lock)"),
+                context=scope.context,
+                detail=f"{n.base}:{ordinal}"))
+    return findings
+
+
+@rule("condvar-lost-signal",
+      "notify with no preceding shared-state mutation in the function "
+      "— the woken waiter re-checks its predicate and sleeps again")
+def check_condvar_lost_signal(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    per_ctx: Dict[str, int] = {}
+    for scope, _cls in _cond_scopes(ctx):
+        if scope.method_name.endswith("_locked"):
+            continue  # protocol owned by callers
+        for n in scope.scan.notifies:
+            if any(ln < n.lineno for ln in scope.scan.evidence):
+                continue
+            if _suppressed(scope.mod, n.lineno):
+                continue
+            key = f"{scope.context}:{n.base}"
+            ordinal = per_ctx.get(key, 0)
+            per_ctx[key] = ordinal + 1
+            findings.append(Finding(
+                rule="condvar-lost-signal",
+                path=scope.mod.rel, line=n.lineno, severity="warning",
+                message=(f"'{n.base}.{n.method}()' with no shared-state "
+                         "mutation earlier in this function — waiters "
+                         "wake, find their predicate unchanged, and "
+                         "sleep again (signal does nothing); mutate "
+                         "the guarded state before notifying"),
+                context=scope.context,
+                detail=f"{n.base}:{ordinal}"))
+    return findings
+
+
+@rule("condvar-wait-no-shutdown-check",
+      "wait loop with no shutdown sentinel and no bounded escape — "
+      "the thread can never be joined (drain-without-sentinel)")
+def check_condvar_wait_no_shutdown(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    per_ctx: Dict[str, int] = {}
+    for scope, _cls in _cond_scopes(ctx):
+        for w in scope.scan.waits:
+            if not w.whiles:
+                continue  # condvar-wait-outside-loop's finding
+            if any(_while_refs_stopish(wh) for wh in w.whiles):
+                continue
+            if w.has_timeout and _while_has_escape(w.whiles[-1]):
+                continue  # bounded wait with an exit path
+            if _suppressed(scope.mod, w.lineno):
+                continue
+            key = f"{scope.context}:{w.base}"
+            ordinal = per_ctx.get(key, 0)
+            per_ctx[key] = ordinal + 1
+            findings.append(Finding(
+                rule="condvar-wait-no-shutdown-check",
+                path=scope.mod.rel, line=w.lineno, severity="error",
+                message=(f"wait loop on '{w.base}' checks no shutdown "
+                         "sentinel (stop/enabled/closed/...) and has "
+                         "no timed escape — shutdown must wake AND "
+                         "terminate this loop or join() hangs"),
+                context=scope.context,
+                detail=f"{w.base}:{ordinal}"))
+    return findings
+
+
+@rule("thread-no-shutdown-join",
+      "class spawns threads/timers but no method joins, cancels, or "
+      "signals them to stop — leaked on shutdown")
+def check_thread_no_shutdown_join(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        if not _analysis_scope(mod):
+            continue
+        for node in mod.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            model = _ClassModel(mod, node)
+            spawns: List[int] = []
+            for mname, mnode in model.methods.items():
+                for call in ast.walk(mnode):
+                    if (isinstance(call, ast.Call)
+                            and _thread_target_expr(call) is not None):
+                        spawns.append(call.lineno)
+            if not spawns:
+                continue
+            has_shutdown = False
+            for mname, mnode in model.methods.items():
+                for call in ast.walk(mnode):
+                    if isinstance(call, ast.Assign):
+                        for t in call.targets:
+                            if (isinstance(t, ast.Attribute)
+                                    and isinstance(t.value, ast.Name)
+                                    and t.value.id == "self"
+                                    and _stopish(t.attr)):
+                                has_shutdown = True
+                    if not isinstance(call, ast.Call):
+                        continue
+                    parts = _dotted_parts(call.func)
+                    if not parts or len(parts) < 2:
+                        continue
+                    meth = parts[-1]
+                    if meth in ("join", "cancel", "shutdown"):
+                        has_shutdown = True
+                    elif (meth in ("set", "clear")
+                          and _stopish(parts[-2])):
+                        has_shutdown = True
+                if has_shutdown:
+                    break
+            if has_shutdown:
+                continue
+            line = spawns[0]
+            if _suppressed(mod, line) or _suppressed(mod, node.lineno):
+                continue
+            findings.append(Finding(
+                rule="thread-no-shutdown-join",
+                path=mod.rel, line=line, severity="error",
+                message=(f"class '{node.name}' spawns threads/timers "
+                         "but no method joins, cancels, or sets a "
+                         "stop flag for them — add a stop()/close() "
+                         "that shuts the threads down"),
+                context=f"{mod.rel}:{node.name}",
+                detail=node.name))
+    return findings
+
+
+@rule("queue-enqueue-no-close-check",
+      "queue handoff (append + notify) with no lifecycle-gate read — "
+      "items enqueued after close are silently lost")
+def check_queue_enqueue_no_close(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for scope, cls in _cond_scopes(ctx):
+        if (scope.method_name.endswith("_locked")
+                or scope.method_name == "__init__"):
+            continue
+        if cls is None or not _stopish_attr_in_init(cls):
+            continue  # class has no close concept to race with
+        if not scope.scan.notifies:
+            continue
+        # append-shaped mutation: MUTATORS call on a self attr,
+        # heapq.heappush(self.x, ...), or self.x[k] = v
+        appends: List[int] = []
+        for n in ast.walk(scope.node):
+            if isinstance(n, ast.Call):
+                parts = _dotted_parts(n.func)
+                if (parts and len(parts) >= 2 and parts[-1] in
+                        ("append", "appendleft", "add", "insert")
+                        and parts[0] == "self"):
+                    appends.append(n.lineno)
+                elif (parts and parts[-1] == "heappush" and n.args
+                      and (_dotted_parts(n.args[0]) or [""])[0]
+                      == "self"):
+                    appends.append(n.lineno)
+            elif isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if (isinstance(t, ast.Subscript)
+                            and (_dotted_parts(t.value) or [""])[0]
+                            == "self"):
+                        appends.append(n.lineno)
+        if not appends:
+            continue
+        if any(_stopish(name) for name in _names_in(scope.node)):
+            continue  # gate consulted somewhere in the method
+        line = appends[0]
+        if _suppressed(scope.mod, line):
+            continue
+        findings.append(Finding(
+            rule="queue-enqueue-no-close-check",
+            path=scope.mod.rel, line=line, severity="error",
+            message=("queue handoff (append + notify) never reads the "
+                     "class's lifecycle gate — an enqueue racing "
+                     "close/stop strands the item with no consumer; "
+                     "check the stop/enabled flag under the lock"),
+            context=scope.context,
+            detail=scope.method_name))
+    return findings

@@ -189,16 +189,32 @@ class ExecSessionManager:
         self._sessions: Dict[str, ExecSession] = {}
         self._lock = threading.Lock()
         self._reaper: Optional[threading.Thread] = None
+        self._stop = threading.Event()
 
     def create(self, argv, cwd, env, tty=False, namespace="") -> ExecSession:
         s = ExecSession(argv, cwd, env, tty=tty, namespace=namespace)
         with self._lock:
             self._sessions[s.id] = s
             if self._reaper is None or not self._reaper.is_alive():
+                self._stop.clear()
                 self._reaper = threading.Thread(
                     target=self._reap_loop, daemon=True, name="exec-reaper")
                 self._reaper.start()
         return s
+
+    def stop(self) -> None:
+        """Kill every session and shut the reaper down; a later
+        create() restarts it."""
+        self._stop.set()
+        with self._lock:
+            sessions = list(self._sessions.values())
+            self._sessions.clear()
+            reaper = self._reaper
+            self._reaper = None
+        for s in sessions:
+            s.kill()
+        if reaper is not None and reaper.is_alive():
+            reaper.join(timeout=2.0)
 
     def get(self, sid: str) -> Optional[ExecSession]:
         with self._lock:
@@ -214,8 +230,7 @@ class ExecSessionManager:
         """Kill idle sessions and drop finished ones — on a timer, so
         an abandoned session dies even if no exec is ever started
         again. TERM at IDLE_TTL; SIGKILL for one that shrugged it off."""
-        while True:
-            time.sleep(10.0)
+        while not self._stop.wait(10.0):
             now = time.time()
             with self._lock:
                 items = list(self._sessions.items())

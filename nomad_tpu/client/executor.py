@@ -189,9 +189,11 @@ def setup_isolation(spec: dict):
         # binds). Defense in depth: the driver already normalizes the
         # job-controlled destination, but re-anchor + containment-check
         # here so a traversal can never bind over host paths. read_only
-        # remount is best-effort on old kernels (recursive ro); a
-        # failure leaves the bind RW rather than failing the task —
-        # same posture as the system-dir binds above.
+        # remount: recursive ro needs a newer kernel, so fall back to a
+        # non-recursive remount (covers the bind itself, not submounts)
+        # before giving up; a bind left RW is recorded in the spec and
+        # surfaces in the task's status file rather than degrading
+        # silently.
         rootr = os.path.realpath(root)
         for src, dest, ro in spec.get("volume_binds") or []:
             dst = os.path.normpath(
@@ -205,7 +207,12 @@ def setup_isolation(spec: dict):
                     mount(None, dst, None,
                           MS_REMOUNT | MS_BIND | MS_RDONLY | MS_REC)
                 except OSError:
-                    pass
+                    try:
+                        mount(None, dst, None,
+                              MS_REMOUNT | MS_BIND | MS_RDONLY)
+                    except OSError:
+                        spec.setdefault("_ro_degraded", []).append(
+                            dest or "/")
     except OSError:
         return None, spec.get("cwd")
     prefix = [unshare_bin, "--fork", "--pid", "--mount", "--ipc",
@@ -460,20 +467,25 @@ def run(spec_path: str) -> int:
     argv = spec["argv"]
     if iso_prefix is not None:
         argv = iso_prefix + argv
+    # the task gets ITS OWN process group (pgid == task pid) so
+    # escalation can killpg the whole task tree — including
+    # TERM-trapping grandchildren — without nuking the executor before
+    # it records the exit status. process_group (3.11+) rather than a
+    # preexec_fn: the logmon reader threads are already running and
+    # fork+preexec with live threads can deadlock. Pre-3.11, setsid
+    # (start_new_session, C-level, thread-safe) gives the same
+    # pgid == task pid property via a fresh session.
+    if sys.version_info >= (3, 11):
+        group_kw = {"process_group": 0}
+    else:
+        group_kw = {"start_new_session": True}
     try:
         proc = subprocess.Popen(
             argv,
             env=spec.get("env") or None,
             cwd=iso_cwd or None,
             stdout=stdout_fd, stderr=stderr_fd,
-            # the task gets ITS OWN process group (pgid == task pid) so
-            # escalation can killpg the whole task tree — including
-            # TERM-trapping grandchildren — without nuking the executor
-            # before it records the exit status. process_group (3.11+)
-            # rather than a preexec_fn: the logmon reader threads are
-            # already running and fork+preexec with live threads can
-            # deadlock
-            process_group=0,
+            **group_kw,
         )
     except OSError as e:
         lm.close_parent_fds()
@@ -573,6 +585,10 @@ def run(spec_path: str) -> int:
             # the identity the task ACTUALLY ran as — a requested drop
             # that couldn't be applied must be visible, not silent
             status["isolation_user"] = spec.get("_iso_user", "root")
+        if spec.get("_ro_degraded"):
+            # read_only volume binds the kernel would not remount ro
+            # (even non-recursively): the task ran with these WRITABLE
+            status["readonly_degraded"] = list(spec["_ro_degraded"])
     _write_status(status_file, status)
     return 0
 

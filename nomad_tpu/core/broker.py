@@ -65,6 +65,11 @@ class EvalBroker:
         self._failed: List[Evaluation] = []
         self._cancelled: List[Evaluation] = []           # superseded pending evals
         self._delay_thread: Optional[threading.Thread] = None
+        # incremented on every enable: a delay thread from a previous
+        # enable generation exits on its next wakeup even if the broker
+        # was re-enabled before it noticed the disable (nomadcheck
+        # broker_batch scenario: two live delay threads otherwise)
+        self._delay_gen = 0
         self.stats = {"enqueued": 0, "dequeued": 0, "acked": 0, "nacked": 0}
 
     # -- lifecycle --
@@ -73,8 +78,10 @@ class EvalBroker:
         with self._lock:
             if enabled and not self._enabled:
                 self._enabled = True
+                self._delay_gen += 1
                 self._delay_thread = threading.Thread(
-                    target=self._run_delay, daemon=True, name="broker-delay")
+                    target=self._run_delay, args=(self._delay_gen,),
+                    daemon=True, name="broker-delay")
                 self._delay_thread.start()
             elif not enabled and self._enabled:
                 self._enabled = False
@@ -303,10 +310,10 @@ class EvalBroker:
 
     # -- delayed evals --
 
-    def _run_delay(self) -> None:
+    def _run_delay(self, gen: int) -> None:
         while True:
             with self._lock:
-                if not self._enabled:
+                if not self._enabled or gen != self._delay_gen:
                     return
                 now = time.time()
                 while self._delay and self._delay[0][0] <= now:

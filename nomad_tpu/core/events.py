@@ -10,6 +10,7 @@ of blocking writers (the reference's ring semantics).
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Dict, Iterator, List, Optional, Tuple
 
@@ -115,9 +116,16 @@ class EventBroker:
         """-> (events with seq > cursor, truncated?). Blocks up to
         timeout for new events. seq is dense, so a gap between the
         cursor and the ring head means events were evicted."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
         with self._lock:
-            if not self._ring or self._ring[-1].seq <= cursor:
-                self._lock.wait(timeout)
+            while not self._ring or self._ring[-1].seq <= cursor:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    break
+                if not self._lock.wait(remaining):
+                    break
             truncated = bool(self._ring) and self._ring[0].seq > cursor + 1
             out = [e for e in self._ring if e.seq > cursor]
             return out, truncated

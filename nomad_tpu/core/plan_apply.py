@@ -95,12 +95,15 @@ class PlanQueue:
         return pending
 
     def dequeue(self, timeout: Optional[float] = None) -> Optional[PendingPlan]:
+        # While disabled, WAIT rather than return: the applier polls
+        # this in a loop, and an instant None turns that loop into a
+        # full-CPU busy-wait for as long as the queue stays disabled
+        # (nomadcheck plan_pipeline, preemption-bounded schedule).
+        # set_enabled() notifies, so an enable wakes the sleeper.
         with self._lock:
             while True:
-                if self._heap:
+                if self._enabled and self._heap:
                     return heapq.heappop(self._heap)[2]
-                if not self._enabled:
-                    return None
                 if not self._lock.wait(timeout):
                     return None
 
@@ -349,7 +352,21 @@ class PlanApplier:
             with self._commit_cond:
                 self._commit_cond.notify_all()
             self._commit_thread.join(timeout=5.0)
-            self._commit_thread = None
+            # drain anything that raced in after the commit thread's
+            # final queue check: an entry left here would strand its
+            # submitter until nack timeout (found by the nomadcheck
+            # plan_pipeline scenario). _commit_thread goes to None in
+            # the same lock hold, so _run/submit_eval_updates either
+            # append before this drain (failed here) or observe
+            # None+stop and refuse.
+            with self._commit_cond:
+                stranded = list(self._commit_q)
+                self._commit_q.clear()
+                self._commit_thread = None
+            for entry in stranded:
+                if not entry.future.done():
+                    entry.future.set_exception(
+                        RuntimeError("plan applier stopped"))
         if self._pool is not None:
             self._pool.shutdown(wait=False)
         if self._commit_pool is not None:
@@ -388,6 +405,10 @@ class PlanApplier:
                     entry = _CommitEntry(pending.plan, result, rejected,
                                          verify_gen, cell, fut)
                     with self._commit_cond:
+                        if self._stop.is_set() and self._commit_thread is None:
+                            # stop() already drained the commit queue;
+                            # an entry appended now is never answered
+                            raise RuntimeError("plan applier stopped")
                         self._commit_q.append(entry)
                         self._commit_cond.notify()
                 else:

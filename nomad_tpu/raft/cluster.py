@@ -487,6 +487,8 @@ class RaftCluster:
     def stop(self) -> None:
         for s in self.servers.values():
             s.stop()
+        if hasattr(self.transport, "close"):
+            self.transport.close()
 
     def __enter__(self):
         return self.start()

@@ -485,6 +485,14 @@ class RaftNode:
         deadline = time.time() + timeout
         with self._apply_cond:
             while self.commit_index < index:
+                if self.state != LEADER:
+                    # stepped down while the change replicated — the
+                    # entry may still commit under the new leader, but
+                    # this node can no longer confirm it; fail fast
+                    # (NotLeaderError = "outcome unknown") instead of
+                    # spinning out the full timeout (nomadcheck
+                    # raft_commit step-down schedule)
+                    raise NotLeaderError(self.leader_id)
                 remaining = deadline - time.time()
                 if remaining <= 0 or self._stop.is_set():
                     raise TimeoutError(f"config change {index} timed out")
@@ -724,6 +732,9 @@ class RaftNode:
         # new leader — NotLeaderError means "outcome unknown", exactly
         # the old wake-time semantics)
         self._fail_waiters_locked(lambda: NotLeaderError(self.leader_id))
+        # wake commit-index waiters (change_config) so they observe the
+        # step-down now rather than at their next poll tick
+        self._apply_cond.notify_all()
         if was_leader and self.on_leadership:
             self.on_leadership(False)
 

@@ -47,6 +47,7 @@ class InProcTransport:
         self._lock = threading.Lock()
         self._partitioned: set = set()  # node ids cut off from everyone
         self._cut_links: set = set()    # directed (src, dst) pairs
+        self._timers: set = set()       # outstanding late-delivery timers
         self.fault_plan = None          # chaos.FaultPlan or None
 
     def register(self, node_id: str, handler: Callable[[dict], dict]) -> None:
@@ -90,6 +91,7 @@ class InProcTransport:
         the reply — the sender already moved on."""
         def fire():
             with self._lock:
+                self._timers.discard(t)
                 if to_id in self._partitioned:
                     return
                 handler = self._handlers.get(to_id)
@@ -102,7 +104,18 @@ class InProcTransport:
                           to_id, exc_info=True)
         t = threading.Timer(delay, fire)
         t.daemon = True
+        with self._lock:
+            self._timers.add(t)
         t.start()
+
+    def close(self) -> None:
+        """Cancel any outstanding late-delivery timers (shutdown path;
+        a timer that already fired removed itself)."""
+        with self._lock:
+            timers = list(self._timers)
+            self._timers.clear()
+        for t in timers:
+            t.cancel()
 
     def send(self, from_id: str, to_id: str, msg: dict) -> Optional[dict]:
         with self._lock:

@@ -39,6 +39,17 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" NOMAD_TPU_SAN=1 python -m pytest \
     tests/test_plan_apply_scale.py tests/test_e2e_pipeline.py -q \
     -p no:cacheprovider || failed=1
 
+# nomadcheck smoke (~2s, 60s budget): the deterministic interleaving
+# model checker drives the raft-commit / raft-stepdown / plan-pipeline
+# / broker-batch scenarios through seeded schedules (random +
+# preemption-bounded) plus one disk-fault-composed raft schedule.
+# Replay any failure with NOMAD_TPU_CHECK_SEED=<seed> (ANALYSIS.md);
+# the full >=200-schedules-per-scenario sweep is the slow-marked
+# tests/test_modelcheck.py::test_exploration_sweep
+echo "== nomadcheck smoke (python -m nomad_tpu.analysis --modelcheck) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 60 \
+    python -m nomad_tpu.analysis --modelcheck --seeds 10 || failed=1
+
 # chaos smoke: one scripted partition + crash scenario on a durable
 # 3-node cluster, fixed seed, safety invariants between steps
 # (see ROBUSTNESS.md; the full matrix is tests/test_chaos.py)

@@ -32,6 +32,10 @@ class LockedCounter:
         with self._lock:
             self.count += 1
 
+    def stop(self):
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+
 
 class ThreadsafePrimitives:
     """Mutation of internally-synchronized primitives (queues, events,

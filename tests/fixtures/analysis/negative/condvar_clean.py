@@ -1,0 +1,165 @@
+"""Negative fixtures for the nomadcheck condvar-protocol rules: the
+full analyzer must produce zero findings on this file. Each class
+exercises the clean shape of one rule, including the exemptions
+(backing-lock aliases, *_locked convention, timed escape)."""
+
+import heapq
+import threading
+import time
+
+
+class CleanHandoff:
+    """The textbook protocol: gate-checked enqueue, while-loop wait
+    with a shutdown sentinel, notify under the lock after mutation."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items = []
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def put(self, item):
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("closed")
+            self._items.append(item)
+            self._cond.notify()
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while not self._items and not self._closed:
+                    self._cond.wait()
+                if self._closed:
+                    return
+                self._items.pop()
+
+    def close(self):
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=1.0)
+
+
+class BackingLockAlias:
+    """Two condvars sharing one RLock: notifying either while holding
+    the backing lock (or the sibling) is correct, not a violation."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._full_cond = threading.Condition(self._lock)
+        self._empty_cond = threading.Condition(self._lock)
+        self._items = []
+        self._stop = False
+
+    def put(self, item):
+        with self._lock:
+            if self._stop:
+                return
+            self._items.append(item)
+            self._full_cond.notify()
+
+    def take(self):
+        with self._full_cond:
+            while not self._items and not self._stop:
+                self._full_cond.wait()
+            item = self._items.pop() if self._items else None
+            self._empty_cond.notify()
+            return item
+
+    def stop(self):
+        with self._lock:
+            self._stop = True
+            self._full_cond.notify_all()
+            self._empty_cond.notify_all()
+
+
+class LockedConvention:
+    """*_locked methods notify without a visible `with` — their callers
+    own the lock by convention, so the rules exempt them."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._pending = []
+        self._stop = False
+
+    def _push_locked(self, item):
+        self._pending.append(item)
+        self._cond.notify()
+
+    def put(self, item):
+        with self._cond:
+            if self._stop:
+                return
+            self._push_locked(item)
+
+    def drain(self):
+        with self._cond:
+            while not self._pending and not self._stop:
+                self._cond.wait()
+            out = list(self._pending)
+            del self._pending[:]
+            return out
+
+    def stop(self):
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+
+
+class TimedEscape:
+    """A deadline-bounded wait loop with a return path needs no
+    shutdown sentinel: it cannot outlive its deadline."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._heap = []
+        self._done = False
+
+    def poll(self, timeout):
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while not self._heap:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            return heapq.heappop(self._heap)
+
+    def put(self, item):
+        with self._cond:
+            if self._done:
+                return
+            heapq.heappush(self._heap, item)
+            self._cond.notify()
+
+    def finish(self):
+        with self._cond:
+            self._done = True
+            self._cond.notify_all()
+
+
+class JoinedWorker:
+    """Spawns a thread and a timer, and stop() both cancels the timer
+    and joins the thread — the shutdown path the join rule wants."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._timer = threading.Timer(5.0, self._tick)
+
+    def start(self):
+        self._thread.start()
+        self._timer.start()
+
+    def _run(self):
+        while not self._stop.wait(0.1):
+            pass
+
+    def _tick(self):
+        pass
+
+    def stop(self):
+        self._stop.set()
+        self._timer.cancel()
+        self._thread.join(timeout=1.0)

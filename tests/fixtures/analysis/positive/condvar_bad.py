@@ -1,0 +1,149 @@
+"""Positive fixtures for the nomadcheck condvar-protocol rules: every
+class here must trip exactly the rule named in its docstring."""
+
+import heapq
+import threading
+
+
+class WaitNoLoop:
+    """condvar-wait-outside-loop: wait() under `if`, not `while` — a
+    spurious or stolen wakeup returns with the predicate false."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._ready = False
+        self._stop = threading.Event()
+
+    def get(self):
+        with self._cond:
+            if not self._ready:
+                self._cond.wait()      # flagged
+            return self._ready
+
+    def stop(self):
+        self._stop.set()
+        with self._cond:
+            self._ready = True
+            self._cond.notify_all()
+
+
+class NotifyUnlocked:
+    """condvar-notify-unlocked: notify_all() with no lock held — a
+    waiter between predicate check and wait() misses the signal."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._value = None
+        self._stop = threading.Event()
+
+    def put(self, v):
+        with self._cond:
+            self._value = v
+        self._cond.notify_all()        # flagged: lock already released
+
+    def get(self):
+        with self._cond:
+            while self._value is None and not self._stop.is_set():
+                self._cond.wait()
+            return self._value
+
+    def stop(self):
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+
+
+class LostSignal:
+    """condvar-lost-signal: kick() notifies without mutating any
+    guarded state first — waiters re-check, see nothing new, sleep."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items = []
+        self._stop = threading.Event()
+
+    def kick(self):
+        with self._cond:
+            self._cond.notify_all()    # flagged: no mutation precedes
+
+    def drain(self):
+        with self._cond:
+            while not self._items and not self._stop.is_set():
+                self._cond.wait()
+            return list(self._items)
+
+    def stop(self):
+        self._stop.set()
+        with self._cond:
+            self._items.append(None)
+            self._cond.notify_all()
+
+
+class WaitNoShutdown:
+    """condvar-wait-no-shutdown-check: untimed wait loop that consults
+    no stop/enabled flag — join() can hang forever on shutdown."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items = []
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        with self._cond:
+            while not self._items:
+                self._cond.wait()      # flagged: no sentinel, no escape
+            self._items.pop()
+
+    def put(self, item):
+        with self._cond:
+            self._items.append(item)
+            self._cond.notify_all()
+
+    def stop(self):
+        self._thread.join(timeout=1.0)
+
+
+class NoShutdownJoin:
+    """thread-no-shutdown-join: spawns a worker thread and a timer but
+    has no method that joins, cancels, or signals them."""
+
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._timer = threading.Timer(1.0, self._tick)
+
+    def launch(self):
+        self._thread.start()
+        self._timer.start()
+
+    def _run(self):
+        pass
+
+    def _tick(self):
+        pass
+
+
+class EnqueueNoCloseCheck:
+    """queue-enqueue-no-close-check: the class has a lifecycle gate
+    (_closed) but put() appends + notifies without ever reading it —
+    items enqueued after close are stranded."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._heap = []
+        self._closed = False
+
+    def put(self, item):
+        with self._cond:
+            heapq.heappush(self._heap, item)   # flagged
+            self._cond.notify()
+
+    def get(self):
+        with self._cond:
+            while not self._heap and not self._closed:
+                self._cond.wait()
+            return heapq.heappop(self._heap) if self._heap else None
+
+    def close(self):
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()

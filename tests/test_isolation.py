@@ -320,3 +320,96 @@ class TestImageCache:
         ContainerDriver.evict_image_cache()
         assert not os.path.isdir(second)
         assert ContainerDriver._image_cache == {}
+
+
+class TestReadOnlyRemountFallback:
+    """A read_only volume bind whose RECURSIVE ro remount the kernel
+    refuses must fall back to a non-recursive MS_RDONLY remount; only
+    when that also fails is the bind left writable — and then the
+    degradation is recorded for the status file, never silent."""
+
+    def _patched_setup(self, monkeypatch, tmp_path, fail):
+        """Run setup_isolation with a fake libc mount. `fail(flags)`
+        says which mount calls raise; returns (spec, calls, prefix)."""
+        import nomad_tpu.client.executor as ex
+
+        calls = []
+
+        def fake_mount(src, dst, fstype, flags, data=None):
+            calls.append((src, dst, flags))
+            if fail(dst, flags):
+                raise OSError(1, "mount refused")
+
+        backing = tmp_path / "vol"
+        backing.mkdir()
+        task_dir = tmp_path / "task"
+        task_dir.mkdir()
+        monkeypatch.setattr(ex, "_libc_mount", lambda: fake_mount)
+        monkeypatch.setattr(os, "unshare", lambda flags: None,
+                            raising=False)
+        monkeypatch.setattr(os, "CLONE_NEWNS", 0x20000, raising=False)
+        orig_which = shutil.which
+        monkeypatch.setattr(
+            "shutil.which",
+            lambda name, *a, **kw: "/usr/bin/unshare"
+            if name == "unshare" else orig_which(name, *a, **kw))
+        spec = {"cwd": str(task_dir),
+                "volume_binds": [[str(backing), "data", True]]}
+        prefix, _cwd = ex.setup_isolation(spec)
+        return spec, calls, prefix
+
+    def test_falls_back_to_nonrecursive_remount(self, monkeypatch,
+                                                tmp_path):
+        import nomad_tpu.client.executor as ex
+
+        ro_rec = ex.MS_REMOUNT | ex.MS_BIND | ex.MS_RDONLY | ex.MS_REC
+        ro_flat = ex.MS_REMOUNT | ex.MS_BIND | ex.MS_RDONLY
+        spec, calls, prefix = self._patched_setup(
+            monkeypatch, tmp_path,
+            fail=lambda dst, flags: flags == ro_rec)
+        assert prefix is not None
+        vol_dst = os.path.join(os.path.realpath(str(tmp_path / "task")),
+                               "data")
+        assert (None, vol_dst, ro_flat) in calls, calls
+        assert "_ro_degraded" not in spec
+
+    def test_degradation_recorded_when_both_remounts_fail(
+            self, monkeypatch, tmp_path):
+        import nomad_tpu.client.executor as ex
+
+        vol_dst = os.path.join(os.path.realpath(str(tmp_path / "task")),
+                               "data")
+        spec, calls, prefix = self._patched_setup(
+            monkeypatch, tmp_path,
+            fail=lambda dst, flags: dst == vol_dst
+            and flags & ex.MS_REMOUNT)
+        assert prefix is not None          # task still launches
+        assert spec.get("_ro_degraded") == ["data"]
+
+    def test_status_file_surfaces_degradation(self, monkeypatch,
+                                              tmp_path):
+        import nomad_tpu.client.executor as ex
+
+        task_dir = tmp_path / "task"
+        for d in ("local", "logs"):
+            (task_dir / d).mkdir(parents=True)
+        status = task_dir / "status.json"
+        spec_file = tmp_path / "spec.json"
+        spec_file.write_text(json.dumps({
+            "argv": ["/bin/sh", "-c", "true"],
+            "cwd": str(task_dir),
+            "task_name": "ro-degraded",
+            "logs_dir": str(task_dir / "logs"),
+            "status_file": str(status),
+            "isolation": True,
+        }))
+
+        def fake_setup(spec):
+            spec["_ro_degraded"] = ["data"]
+            return None, spec.get("cwd")
+
+        monkeypatch.setattr(ex, "setup_isolation", fake_setup)
+        assert ex.run(str(spec_file)) == 0
+        st = json.loads(status.read_text())
+        assert st["readonly_degraded"] == ["data"]
+        assert st["exit_code"] == 0

@@ -24,7 +24,11 @@ NEGATIVE = FIXTURES / "negative"
 
 ALL_RULES = ("fsm-determinism", "jax-hot-path", "lock-order",
              "lock-order-cycle", "shared-mutation-unlocked",
-             "shared-struct-mutation", "silent-except")
+             "shared-struct-mutation", "silent-except",
+             # nomadcheck condvar-protocol lints (PR 6)
+             "condvar-wait-outside-loop", "condvar-notify-unlocked",
+             "condvar-lost-signal", "condvar-wait-no-shutdown-check",
+             "thread-no-shutdown-join", "queue-enqueue-no-close-check")
 
 
 def _by_rule(findings):
