@@ -1311,8 +1311,111 @@ def _scenario_broker_batch(env: ScenarioEnv) -> None:
             t.join(timeout=10.0)
 
 
+@scenario("solve_batch")
+def _scenario_solve_batch(env: ScenarioEnv) -> None:
+    """BulkSolverService worker-batch rendezvous (the "tpu-solve" joint
+    tier): two batched workers, each an open_batch(2) whose member
+    evals race their first joint submit against the service thread's
+    bounded launch hold, a third non-joint request that must never
+    share a launch group with the joint tier, and a stop() racing the
+    tail. Asserts: every member's future resolves (solved or
+    failed-at-stop — never stranded), solved == launched, the
+    joint/greedy grouping stays pure, and every confirmed solve closes
+    its ledger entry (the plan-applier handshake)."""
+    import numpy as np
+
+    from ..tensor.solver import (BulkSolverService, _LedgerEntry,
+                                 batch_member, open_batch)
+
+    svc = BulkSolverService()
+    launches: List[tuple] = []
+    launches_lock = threading.Lock()
+
+    class _Static:
+        node_index = {"n0": 0}
+        device_arrays: dict = {}
+
+    static = _Static()
+
+    def host_solve_group(rs) -> None:
+        # host stub for the device launch: same token/ledger/future
+        # protocol as _solve_group, no accelerator
+        with launches_lock:
+            launches.append(tuple(sorted(bool(r.joint) for r in rs)))
+        for r in rs:
+            with svc._lock:
+                svc._token += 1
+                r.token = svc._token
+                svc._ledger[r.token] = _LedgerEntry(
+                    static, np.array([0]), np.array([1]),
+                    np.ones(2, np.float32), 0.0)
+            r.future.set_result(np.zeros(8, np.int64))
+
+    svc._solve_group = host_solve_group
+
+    outcomes: List[str] = []
+    out_lock = threading.Lock()
+
+    def member(ctx, seed: int, joint: bool, reject: bool) -> None:
+        with batch_member(ctx if joint else None):
+            try:
+                _counts, token = svc.solve(
+                    static=static, feas_base=None, aff=None,
+                    ask=np.ones(2), k=1, tg_count=1.0, seed=seed,
+                    used_fn=lambda: None, joint=joint)
+            except RuntimeError:
+                with out_lock:
+                    outcomes.append("failed")  # drained at stop: answered
+                return
+            svc.confirm(token, ["n0"] if reject else [])
+            with out_lock:
+                outcomes.append("solved")
+
+    def worker(base: int) -> None:
+        ctx = open_batch(2)
+        ms = [threading.Thread(target=member,
+                               args=(ctx, base + i, True, i == 0),
+                               name=f"member-{base + i}")
+              for i in range(2)]
+        for m in ms:
+            m.start()
+        for m in ms:
+            m.join()
+
+    w1 = threading.Thread(target=worker, args=(0,), name="worker-0")
+    w2 = threading.Thread(target=worker, args=(10,), name="worker-1")
+    lone = threading.Thread(target=member, args=(None, 20, False, False),
+                            name="greedy-lone")
+    stopper = threading.Thread(target=svc.stop, name="stopper")
+    w1.start()
+    w2.start()
+    lone.start()
+    stopper.start()
+    for t in (w1, w2, lone, stopper):
+        t.join()
+    svc.stop()
+
+    if len(outcomes) != 5:
+        raise AssertionError(f"member outcomes missing: {outcomes}")
+    solved = outcomes.count("solved")
+    launched = sum(len(group) for group in launches)
+    if launched != solved:
+        raise AssertionError(
+            f"{launched} requests launched but {solved} futures "
+            f"resolved with results")
+    if any(len(set(group)) > 1 for group in launches):
+        raise AssertionError(
+            f"a launch group mixed joint and greedy requests: {launches}")
+    with svc._lock:
+        leaked = dict(svc._ledger)
+    if leaked:
+        raise AssertionError(
+            f"{len(leaked)} ledger entr(ies) leaked past confirm: "
+            f"{sorted(leaked)}")
+
+
 SMOKE_SCENARIOS = ("raft_commit", "raft_stepdown", "plan_pipeline",
-                   "broker_batch")
+                   "broker_batch", "solve_batch")
 
 
 def smoke(base_seed: int, seeds_per_scenario: int = 3,

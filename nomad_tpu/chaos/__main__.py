@@ -4,6 +4,7 @@
     python -m nomad_tpu.chaos [--seed N]
     python -m nomad_tpu.chaos --raft-smoke
     python -m nomad_tpu.chaos --e2e-smoke
+    python -m nomad_tpu.chaos --solve-smoke
 
 Exit 0 when every invariant holds; 2 on a violation (the CI gate in
 scripts/check.sh). This is the smallest end-to-end proof that the
@@ -19,7 +20,14 @@ crash-restart in the middle — asserts zero acknowledged commits lost
 broker -> batched workers -> pipelined plan applier -> raft group
 commit -> FSM on a durable 3-node cluster, with one leader restart
 mid-stream — zero acked allocs lost, rejection <= 5% (the
-scripts/check.sh --e2e-smoke gate; PERF.md "End-to-end pipeline")."""
+scripts/check.sh --e2e-smoke gate; PERF.md "End-to-end pipeline").
+
+`--solve-smoke` runs the global-batch solve smoke: bulk-sized jobs
+through batched workers under "tpu-solve" on a live 3-node cluster —
+asserts a whole worker batch reached the joint auction launch, the
+selected packing score dominates the in-launch greedy counterfactual,
+and every replica holds a unique alloc set (the scripts/check.sh
+--solve-smoke gate; PERF.md "Global-batch solve")."""
 
 from __future__ import annotations
 
@@ -350,6 +358,116 @@ def e2e_smoke(jobs_n: int = 300, nodes_n: int = 75, workers: int = 4) -> int:
     return 0
 
 
+def solve_smoke(nodes_n: int = 40, jobs_n: int = 4,
+                count: int = 256) -> int:
+    """Global-batch solve smoke (scripts/check.sh --solve-smoke): a
+    live 3-node cluster with batched workers under "tpu-solve", jobs
+    sized to engage the bulk tier (count >= tensor/placer BULK_MIN).
+    Asserts: every placement lands, at least one whole worker batch
+    went through the joint auction launch, the selected assignment's
+    packing score is >= the in-launch greedy counterfactual (the
+    portfolio guarantee, checked end to end), and the alloc-set
+    uniqueness + safety invariants hold on every replica."""
+    import shutil
+
+    from ..core.server import ServerConfig
+    from ..structs import enums
+    from ..structs.operator import SchedulerConfiguration
+    from .invariants import InvariantChecker
+
+    t0 = time.monotonic()
+
+    def config_fn(_i: int) -> ServerConfig:
+        return ServerConfig(
+            num_workers=2, eval_batch_size=4, plan_commit_batching=True,
+            sched_config=SchedulerConfiguration(
+                scheduler_algorithm=enums.SCHED_ALG_TPU_SOLVE),
+            heartbeat_ttl=3600.0, gc_interval=3600.0, nack_timeout=900.0,
+            failed_eval_followup_delay=3600.0,
+            failed_eval_unblock_interval=0.5)
+
+    tmp = tempfile.mkdtemp(prefix="nomad-solve-smoke-")
+    checker = InvariantChecker()
+    try:
+        cluster = RaftCluster(3, config_fn=config_fn, data_dir=tmp)
+        cluster.start()
+        try:
+            leader = cluster.wait_for_leader(timeout=15.0)
+            if leader is None:
+                print("SOLVE SMOKE: FAIL — no leader elected")
+                return 2
+            for i in range(nodes_n):
+                n = mock.node()
+                n.resources.cpu = 16000
+                n.resources.memory_mb = 32768
+                n.compute_class()
+                leader.register_node(n)
+
+            from ..tensor.solver import get_service
+            svc0 = dict(get_service().stats)
+
+            jobs = []
+            for i in range(jobs_n):
+                j = mock.batch_job()
+                tg = j.task_groups[0]
+                tg.count = count
+                tg.tasks[0].resources.cpu = (50, 80, 120, 60)[i % 4]
+                tg.tasks[0].resources.memory_mb = (48, 96, 64, 128)[i % 4]
+                jobs.append(j)
+                leader.register_job(j)
+
+            deadline = time.time() + 240
+            while True:
+                if leader.server.wait_for_idle(
+                        timeout=10.0, include_delayed=False) \
+                        and leader.server.blocked.blocked_count() == 0:
+                    break
+                if time.time() > deadline:
+                    print("SOLVE SMOKE: FAIL — pipeline did not drain")
+                    return 2
+                time.sleep(0.1)
+
+            checker.check_convergence(cluster, timeout=30.0)
+            checker.check_all(cluster)
+
+            snap = leader.local_store.snapshot()
+            placed = [a for a in snap.allocs()
+                      if not a.terminal_status() and not a.server_terminal()]
+            want = jobs_n * count
+            if len(placed) != want:
+                print(f"SOLVE SMOKE: FAIL — {len(placed)}/{want} "
+                      f"placements landed")
+                return 2
+            ids = {a.id for a in placed}
+            if len(ids) != len(placed):
+                print("SOLVE SMOKE: FAIL — duplicate alloc ids")
+                return 2
+
+            svc = get_service().stats
+            launches = svc["joint_launches"] - svc0.get("joint_launches", 0)
+            score_s = svc["joint_score"] - svc0.get("joint_score", 0.0)
+            score_g = svc["greedy_score"] - svc0.get("greedy_score", 0.0)
+            if launches < 1:
+                print("SOLVE SMOKE: FAIL — no batch reached the joint "
+                      "auction tier (joint_launches == 0)")
+                return 2
+            if score_s < score_g - 1e-3:
+                print(f"SOLVE SMOKE: FAIL — selected packing score "
+                      f"{score_s:.3f} below the greedy counterfactual "
+                      f"{score_g:.3f}")
+                return 2
+        finally:
+            cluster.stop()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    dt = time.monotonic() - t0
+    print(f"SOLVE SMOKE: ok — {want} placements via {launches} joint "
+          f"launch(es), selected score {score_s:.2f} >= greedy "
+          f"{score_g:.2f}, {checker.stats['checks']} invariant sweeps, "
+          f"{dt:.1f}s")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m nomad_tpu.chaos")
     parser.add_argument("--seed", type=int, default=None,
@@ -361,6 +479,11 @@ def main(argv=None) -> int:
                         help="run the full-pipeline smoke (300 evals, "
                              "3 nodes, leader restart mid-stream) "
                              "instead of the scenario smoke")
+    parser.add_argument("--solve-smoke", action="store_true",
+                        help="run the global-batch solve smoke "
+                             "(batched workers under tpu-solve; joint "
+                             "launch, score dominance, alloc "
+                             "uniqueness) instead of the scenario smoke")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
     logging.basicConfig(
@@ -373,6 +496,8 @@ def main(argv=None) -> int:
         return raft_smoke()
     if args.e2e_smoke:
         return e2e_smoke()
+    if args.solve_smoke:
+        return solve_smoke()
 
     t0 = time.monotonic()
     with tempfile.TemporaryDirectory(prefix="nomad-chaos-") as tmp:

@@ -236,20 +236,46 @@ class Worker:
                 # the snapshot; carry the fresher one forward
                 snap = self.process_one(ev, token, snapshot=snap) or snap
             return
+        # "tpu-solve": open a rendezvous sized to this dequeue_batch so
+        # the bulk-solver service coalesces every member's solve into
+        # ONE joint auction launch (tensor/batch_solver.py). Each member
+        # keeps its own _EvalRun / Plan / ack, so per-job plan
+        # boundaries and broker serialization are untouched — the
+        # rendezvous only shapes WHEN the device launch fires.
+        batch_ctx = None
+        sched_config = getattr(self.server, "sched_config", None)
+        if (sched_config is not None and sched_config.scheduler_algorithm
+                == enums.SCHED_ALG_TPU_SOLVE):
+            from ..tensor.solver import open_batch
+
+            batch_ctx = open_batch(len(batch))
         futs = []
         try:
             for ev, token in batch:
                 futs.append(pool.submit(
-                    _EvalRun(self, ev, token, snapshot=snap).run))
+                    self._run_member, batch_ctx,
+                    _EvalRun(self, ev, token, snapshot=snap)))
         except RuntimeError:
             # pool shut down mid-batch: unsubmitted members redeliver
-            # via their nack timers
-            pass
+            # via their nack timers; settle them so the solver service
+            # doesn't hold the launch for members that never ran
+            if batch_ctx is not None:
+                for _ in range(len(batch) - len(futs)):
+                    batch_ctx.settle()
         for f in futs:
             try:
                 f.result()
             except Exception:
                 pass  # _EvalRun.run never raises; belt and braces
+
+    @staticmethod
+    def _run_member(batch_ctx, eval_run):
+        if batch_ctx is None:
+            return eval_run.run()
+        from ..tensor.solver import batch_member
+
+        with batch_member(batch_ctx):
+            return eval_run.run()
 
     def process_one(self, ev: Evaluation, token: str, snapshot=None):
         """Process a single eval inline on the calling thread."""

@@ -82,4 +82,11 @@ def placer_for_algorithm(algorithm: str):
         from ..tensor.placer import TPUPlacer
 
         return TPUPlacer()
+    if algorithm == enums.SCHED_ALG_TPU_SOLVE:
+        # the global-batch tier: same TPUPlacer surface, but bulk solves
+        # route to the joint auction kernel (tensor/batch_solver.py);
+        # everything non-bulk degrades to the greedy/host fallback arms
+        from ..tensor.placer import TPUPlacer
+
+        return TPUPlacer(algorithm=enums.SCHED_ALG_TPU_SOLVE)
     return HostPlacer(algorithm=algorithm)

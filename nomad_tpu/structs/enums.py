@@ -84,9 +84,13 @@ COMPARISON_OPERANDS = ("=", "==", "is", "!=", "not", "<", "<=", ">", ">=")
 # Scheduler algorithms (reference: nomad/structs/operator.go:199-255,
 # consumed by BinPackIterator.SetSchedulerConfiguration rank.go:192-203).
 # "tpu-binpack" is the new batched JAX backend; the north-star plug point.
+# "tpu-solve" is its global-batch tier: a whole dequeued eval batch is
+# solved as ONE tensorized assignment problem (auction rounds on device,
+# tensor/batch_solver.py); greedy "tpu-binpack" stays the fallback arm.
 SCHED_ALG_BINPACK = "binpack"
 SCHED_ALG_SPREAD = "spread"
 SCHED_ALG_TPU_BINPACK = "tpu-binpack"
+SCHED_ALG_TPU_SOLVE = "tpu-solve"
 
 # Deployment statuses (subset; reference structs.go Deployment*)
 DEPLOYMENT_STATUS_RUNNING = "running"

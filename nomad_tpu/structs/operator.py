@@ -2,9 +2,13 @@
 (reference nomad/structs/operator.go:199-255 SchedulerConfiguration).
 
 Stored in replicated state and settable at runtime via the operator API;
-`scheduler_algorithm` selects "binpack" | "spread" | "tpu-binpack" — the
-last being this framework's batched JAX backend (the north-star plug
-point, reference rank.go:192-203 SetSchedulerConfiguration).
+`scheduler_algorithm` selects "binpack" | "spread" | "tpu-binpack" |
+"tpu-solve" — the last two being this framework's batched JAX backend
+(the north-star plug point, reference rank.go:192-203
+SetSchedulerConfiguration). "tpu-solve" additionally coalesces a whole
+dequeued eval batch into one on-device assignment solve
+(tensor/batch_solver.py); it degrades to the greedy "tpu-binpack"
+behavior wherever the joint path does not apply.
 """
 
 from __future__ import annotations

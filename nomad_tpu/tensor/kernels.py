@@ -37,19 +37,35 @@ TIE_JITTER = 3.0e-5
 BINPACK_MAX_FIT_SCORE = 18.0  # reference scheduler/rank.go:18
 
 
-def _free_fractions(available: jnp.ndarray, used: jnp.ndarray) -> jnp.ndarray:
+def _free_fractions_xp(xp, available, used):
     """Free fraction per (node, dim) after `used` is placed
     (reference funcs.go:213 computeFreePercentage).
 
     x/0 capacity -> -inf free (its 10^free term vanishes); 0/0 -> 0.0.
+
+    `xp` is the array namespace (jnp on the device path, numpy on the
+    host oracle) — the ONE copy of the formula, so the host fallback,
+    the greedy kernel, and the batch solver cannot drift apart.
     """
-    safe = jnp.where(available > 0, available, 1.0)
-    ratio = jnp.where(
+    safe = xp.where(available > 0, available, 1.0)
+    ratio = xp.where(
         available > 0,
         used / safe,
-        jnp.where(used > 0, jnp.inf, 0.0),
+        xp.where(used > 0, xp.inf, 0.0),
     )
     return 1.0 - ratio
+
+
+def _fit_scores_xp(xp, available, used, spread_alg):
+    free = _free_fractions_xp(xp, available, used)
+    total = 10.0 ** free[..., 0] + 10.0 ** free[..., 1]
+    binpack = xp.clip(20.0 - total, 0.0, BINPACK_MAX_FIT_SCORE)
+    spread = xp.clip(total - 2.0, 0.0, BINPACK_MAX_FIT_SCORE)
+    return xp.where(spread_alg, spread, binpack) / BINPACK_MAX_FIT_SCORE
+
+
+def _free_fractions(available: jnp.ndarray, used: jnp.ndarray) -> jnp.ndarray:
+    return _free_fractions_xp(jnp, available, used)
 
 
 def fit_scores(available: jnp.ndarray, used: jnp.ndarray,
@@ -60,11 +76,16 @@ def fit_scores(available: jnp.ndarray, used: jnp.ndarray,
     spread  (WorstFit):   clip((10^freeCpu + 10^freeMem) - 2, 0, 18)/18
     (reference funcs.go:236 ScoreFitBinPack / :263 ScoreFitSpread)
     """
-    free = _free_fractions(available, used)
-    total = 10.0 ** free[:, 0] + 10.0 ** free[:, 1]
-    binpack = jnp.clip(20.0 - total, 0.0, BINPACK_MAX_FIT_SCORE)
-    spread = jnp.clip(total - 2.0, 0.0, BINPACK_MAX_FIT_SCORE)
-    return jnp.where(spread_alg, spread, binpack) / BINPACK_MAX_FIT_SCORE
+    return _fit_scores_xp(jnp, available, used, spread_alg)
+
+
+def fit_scores_np(available, used, spread_alg=False):
+    """Numpy twin of `fit_scores` — same `_fit_scores_xp` core, so the
+    host oracle (`tensor/placer._binpack_fitness_np`), the tests, and
+    the bench score the exact formula the kernels run on device."""
+    import numpy as np
+    return _fit_scores_xp(np, np.asarray(available, dtype=np.float64),
+                          np.asarray(used, dtype=np.float64), spread_alg)
 
 
 def score_nodes(
@@ -618,8 +639,7 @@ def score_nodes_once(
     return score
 
 
-@partial(jax.jit, static_argnames=("g",), donate_argnums=(0,))
-def solve_bulk_multi(
+def _solve_bulk_multi_impl(
     used0,       # (N, D) f32 usage carry — device-RESIDENT, donated back
     available,   # (N, D) f32 resident capacity
     feas,        # (G, N) bool stacked per-eval feasibility masks
@@ -710,6 +730,13 @@ def solve_bulk_multi(
 
     used, counts = jax.lax.scan(one_eval, used0, jnp.arange(g))
     return used, counts
+
+
+# public jitted entry; the raw impl stays importable so the batch solver
+# (tensor/batch_solver.solve_batch) can inline the exact greedy chain as
+# its baseline arm inside ONE launch instead of a second round trip
+solve_bulk_multi = partial(jax.jit, static_argnames=("g",),
+                           donate_argnums=(0,))(_solve_bulk_multi_impl)
 
 
 @jax.jit

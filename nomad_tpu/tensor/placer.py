@@ -36,16 +36,13 @@ from .cluster import ClusterTensors, build_task_group_tensors, _pad_pow2
 
 
 def _binpack_fitness_np(available: np.ndarray, used: np.ndarray) -> np.ndarray:
-    """Vectorized BestFit-v3 fit score (numpy twin of
-    kernels.fit_scores; reference funcs.go:236 ScoreFitBinPack) —
-    the ONE host-side copy of the formula, shared by the preemption
-    pick mirror and the bulk trajectory mean."""
-    safe = np.where(available > 0, available, 1.0)
-    ratio = np.where(available > 0, used / safe,
-                     np.where(used > 0, np.inf, 0.0))
-    free = 1.0 - ratio
-    total10 = 10.0 ** free[:, 0] + 10.0 ** free[:, 1]
-    return np.clip(20.0 - total10, 0.0, 18.0) / 18.0
+    """Vectorized BestFit-v3 fit score (reference funcs.go:236
+    ScoreFitBinPack), shared by the preemption pick mirror and the bulk
+    trajectory mean. Thin wrapper over kernels._fit_scores_xp — the one
+    formula the device kernels, the batch solver, and this host oracle
+    all evaluate (parity pinned by test_batch_solver.py)."""
+    from .kernels import fit_scores_np
+    return fit_scores_np(available, used, spread_alg=False)
 
 
 def _preempt_pick_host(available, used, evictable, ask, feasible, net_prio,
@@ -429,7 +426,8 @@ class TPUPlacer:
                 static=static, feas_base=tgt.feas_base,
                 aff=tgt.affinity_boost, ask=tgt.ask, k=k,
                 tg_count=tgt.tg_count, seed=seed,
-                used_fn=cluster.latest_usage)
+                used_fn=cluster.latest_usage,
+                joint=(self.algorithm == enums.SCHED_ALG_TPU_SOLVE))
             if ctx.plan is not None:
                 ctx.plan.post_apply_hooks.append(
                     lambda result, _t=solve_token: service.confirm(
@@ -738,7 +736,8 @@ class TPUPlacer:
 
     def _host_algorithm(self) -> str:
         return (enums.SCHED_ALG_BINPACK
-                if self.algorithm == enums.SCHED_ALG_TPU_BINPACK
+                if self.algorithm in (enums.SCHED_ALG_TPU_BINPACK,
+                                      enums.SCHED_ALG_TPU_SOLVE)
                 else self.algorithm)
 
     def _host_one(self, ctx, job, tg, nodes, req, batch: bool,

@@ -55,6 +55,81 @@ import numpy as np
 _STOP = object()
 
 
+class BatchContext:
+    """Rendezvous for one `Worker.process_batch` under "tpu-solve": the
+    worker opens a context sized to the dequeued batch, each member eval
+    runs inside it (`batch_member`), and the service thread holds the
+    next launch open while members that may still submit their FIRST
+    bulk solve are running — so a whole `dequeue_batch` result lands in
+    ONE joint `tensor/batch_solver.solve_batch` launch instead of
+    fragmenting across arrival timing. A member counts as "settled" the
+    moment it submits a solve (it is in the queue) or when its run
+    returns without one (host path, no-op eval, failure) — either way
+    the service never waits on a member that cannot contribute, and the
+    wait itself is deadline-bounded (JOINT_WAIT_S) so a wedged member
+    degrades the batch to two launches instead of stalling it."""
+
+    __slots__ = ("_lock", "_pending", "expected")
+
+    def __init__(self, expected: int):
+        self._lock = threading.Lock()
+        self.expected = expected
+        self._pending = expected
+
+    def settle(self) -> None:
+        with self._lock:
+            self._pending -= 1
+
+    def pending(self) -> int:
+        with self._lock:
+            return self._pending
+
+
+_batch_tls = threading.local()
+
+
+def current_batch() -> Optional[BatchContext]:
+    return getattr(_batch_tls, "ctx", None)
+
+
+def open_batch(expected: int) -> BatchContext:
+    return BatchContext(expected)
+
+
+class batch_member:
+    """Context manager run by each member eval's thread: binds the
+    BatchContext to the thread so the placer's solve call (deep in the
+    scheduler stack) finds it, and settles the member on exit if it
+    never submitted a joint solve."""
+
+    def __init__(self, ctx: Optional[BatchContext]):
+        self._ctx = ctx
+
+    def __enter__(self):
+        if self._ctx is not None:
+            _batch_tls.ctx = self._ctx
+            _batch_tls.settled = False
+        return self
+
+    def __exit__(self, *exc):
+        if self._ctx is not None:
+            if not getattr(_batch_tls, "settled", True):
+                self._ctx.settle()
+            _batch_tls.ctx = None
+            _batch_tls.settled = True
+        return False
+
+
+def _settle_current_member() -> Optional[BatchContext]:
+    """Mark the calling thread's batch member as settled (first joint
+    solve submitted); returns the context, or None outside a batch."""
+    ctx = current_batch()
+    if ctx is not None and not getattr(_batch_tls, "settled", True):
+        _batch_tls.settled = True
+        ctx.settle()
+    return ctx
+
+
 def ensure_resident(static, feas_base, aff, mesh=None):
     """Device-resident (capacity, mask, affinity) arrays for one
     ClusterStatic, uploaded once and cached in static.device_arrays —
@@ -94,10 +169,11 @@ def ensure_resident(static, feas_base, aff, mesh=None):
 
 class _Request:
     __slots__ = ("static", "feas_base", "aff", "ask", "k", "tg_count",
-                 "seed", "used_fn", "future", "token")
+                 "seed", "used_fn", "future", "token", "joint",
+                 "batch_ctx")
 
     def __init__(self, static, feas_base, aff, ask, k, tg_count, seed,
-                 used_fn):
+                 used_fn, joint=False, batch_ctx=None):
         self.static = static
         self.feas_base = feas_base
         self.aff = aff
@@ -112,6 +188,8 @@ class _Request:
         self.used_fn = used_fn
         self.future = Future()
         self.token = 0
+        self.joint = joint          # solve via the batch auction tier
+        self.batch_ctx = batch_ctx  # worker-batch rendezvous, or None
 
 
 class _LedgerEntry:
@@ -134,6 +212,7 @@ class BulkSolverService:
     RESYNC_SOLVES = 64  # overlay refresh cadence (external usage churn)
     CORRECTIONS = 64    # sparse correction slots per launch
     LEDGER_TTL = 60.0   # s before an unconfirmed solve is presumed dead
+    JOINT_WAIT_S = 0.25  # max hold for worker-batch rendezvous members
 
     def __init__(self):
         self._q: "queue.Queue" = queue.Queue()
@@ -154,9 +233,13 @@ class BulkSolverService:
         self._mesh = None
         self._mesh_resolved = False
         self._mesh_solve = None
+        self._mesh_solve_joint = None
         # launch telemetry
         self.stats = {"launches": 0, "solves": 0, "resyncs": 0,
-                      "launch_s": 0.0, "corrections": 0, "sharded": 0}
+                      "launch_s": 0.0, "corrections": 0, "sharded": 0,
+                      "joint_launches": 0, "joint_solves": 0,
+                      "auction_won": 0, "auction_rounds": 0,
+                      "joint_score": 0.0, "greedy_score": 0.0}
 
     def _resolve_mesh(self, n_pad: int):
         """Largest power-of-two device mesh that divides the padded node
@@ -167,11 +250,14 @@ class BulkSolverService:
 
             devs = jax.devices()
             if len(devs) > 1:
-                from .sharding import make_solve_bulk_multi_sharded, node_mesh
+                from .sharding import (make_solve_batch_sharded,
+                                       make_solve_bulk_multi_sharded,
+                                       node_mesh)
 
                 n = 1 << (len(devs).bit_length() - 1)
                 self._mesh = node_mesh(devs[:n])
                 self._mesh_solve = make_solve_bulk_multi_sharded(self._mesh)
+                self._mesh_solve_joint = make_solve_batch_sharded(self._mesh)
         if self._mesh is None:
             return None
         n_dev = len(self._mesh.devices.reshape(-1))
@@ -180,17 +266,35 @@ class BulkSolverService:
     # -- caller side (scheduler worker threads) --
 
     def solve(self, *, static, feas_base, aff, ask, k, tg_count, seed,
-              used_fn):
+              used_fn, joint=False):
         """Blocking solve of one fresh-placement bulk eval ->
         ((N_pad,) int64 per-node counts in canonical order, token).
         The caller must arrange for confirm(token, rejected_node_ids)
         to run once the plan containing these placements is applied
-        (plan.post_apply_hooks)."""
+        (plan.post_apply_hooks). With joint=True ("tpu-solve") the
+        request is solved by the global-batch auction kernel together
+        with every compatible request in the same launch; a worker-batch
+        BatchContext bound to the calling thread rides along so the
+        launch waits for the rest of the dequeued batch."""
         req = _Request(static, feas_base, aff,
                        np.asarray(ask, dtype=np.float32), int(k),
-                       float(tg_count), np.uint32(seed), used_fn)
-        self._ensure_thread()
+                       float(tg_count), np.uint32(seed), used_fn,
+                       joint=joint,
+                       batch_ctx=current_batch() if joint else None)
+        # put BEFORE ensure: the service thread clears self._thread
+        # before its final stop-drain, so a request racing stop() is
+        # either caught by that drain (failed, answered) or observes
+        # the cleared slot here and starts a fresh thread — ensure
+        # first could watch a thread that exits without ever reading
+        # the queue, stranding the caller on the future (found by the
+        # solve_batch modelcheck scenario)
         self._q.put(req)
+        self._ensure_thread()
+        if req.batch_ctx is not None:
+            # settle AFTER the put: the service may launch without a
+            # member whose settle it observed but whose request it
+            # didn't — never the reverse
+            _settle_current_member()
         return req.future.result(), req.token
 
     def confirm(self, token: int, rejected_node_ids) -> None:
@@ -221,26 +325,57 @@ class BulkSolverService:
                 self._thread.start()
 
     def stop(self) -> None:
-        if self._thread is not None and self._thread.is_alive():
+        t = self._thread
+        if t is not None and t.is_alive():
             self._q.put(_STOP)
-            self._thread.join(timeout=10.0)
+            t.join(timeout=10.0)
 
     # -- service thread --
 
+    def _retire(self) -> None:
+        """Clear the thread slot BEFORE the final stop-drain: any
+        solve() that puts after the drain finishes then sees the empty
+        slot and starts a fresh thread instead of stranding (solve()
+        puts before it checks, so a request the drain missed always
+        has its ensure still ahead of it)."""
+        with self._lock:
+            self._thread = None
+
     def _run(self) -> None:
+        import time as _time
+
         while True:
             req = self._q.get()
             if req is _STOP:
+                self._retire()
                 self._drain_failed()
                 return
             batch = [req]
             # drain whatever queued while the previous launch ran
+            deadline = None
             while len(batch) < self.G_PAD:
                 try:
                     nxt = self._q.get_nowait()
                 except queue.Empty:
-                    break
+                    # worker-batch rendezvous: members of an open
+                    # BatchContext that haven't settled yet may still
+                    # submit — hold the launch (bounded) so the whole
+                    # dequeued batch solves jointly
+                    if not any(r.batch_ctx is not None
+                               and r.batch_ctx.pending() > 0
+                               for r in batch):
+                        break
+                    if deadline is None:
+                        deadline = _time.monotonic() + self.JOINT_WAIT_S
+                    remain = deadline - _time.monotonic()
+                    if remain <= 0:
+                        break
+                    try:
+                        nxt = self._q.get(timeout=min(remain, 0.01))
+                    except queue.Empty:
+                        continue
                 if nxt is _STOP:
+                    self._retire()
                     self._flush(batch)
                     self._drain_failed()
                     return
@@ -260,11 +395,14 @@ class BulkSolverService:
                     RuntimeError("bulk solver service stopped"))
 
     def _flush(self, batch: List[_Request]) -> None:
-        # one launch per distinct static (mixed batches happen only
-        # across a node-set version change)
+        # one launch per distinct (static, tier): mixed statics happen
+        # only across a node-set version change, mixed tiers only while
+        # an A/B run flips the algorithm — either way the greedy tier's
+        # requests must never route through the auction arm, the
+        # baseline has to stay pure
         groups = {}
         for r in batch:
-            groups.setdefault(id(r.static), []).append(r)
+            groups.setdefault((id(r.static), r.joint), []).append(r)
         for rs in groups.values():
             try:
                 self._solve_group(rs)
@@ -292,7 +430,13 @@ class BulkSolverService:
                                           mesh=mesh)
             rows_m.append((id(r.feas_base), m))
             rows_a.append((id(r.aff), a))
-        g_pad = 1 if len(rs) == 1 else self.G_PAD
+        # joint solves always take the full padded width: padded rows
+        # (k=0) exit the kernel loops immediately, and a single-row
+        # joint warmup then compiles the SAME shape the production
+        # batches run — a g=1 special case would bill a fresh g=G_PAD
+        # XLA compile to the first real batch launch
+        g_pad = (self.G_PAD if rs[0].joint
+                 else 1 if len(rs) == 1 else self.G_PAD)
         while len(rows_m) < g_pad:
             rows_m.append(rows_m[0])
             rows_a.append(rows_a[0])
@@ -384,15 +528,31 @@ class BulkSolverService:
             tgc[i] = r.tg_count
             seeds[i] = r.seed
 
-        if mesh is not None:
+        joint = rs[0].joint
+        info_np = None
+        if joint and mesh is None:
+            from .batch_solver import solve_batch
+
+            new_used, counts, info = solve_batch(
+                used_dev, avail, feas, aff, ask, k, tgc, seeds, cidx,
+                cdelta, g=g_pad)
+            # ONE readback for the whole batch (counts + info row)
+            counts_np, info_np = jax.device_get((counts, info))
+        elif joint:
+            new_used, counts, info = self._mesh_solve_joint(
+                used_dev, avail, feas, aff, ask, k, seeds, cidx, cdelta,
+                g=g_pad)
+            counts_np, info_np = jax.device_get((counts, info))
+        elif mesh is not None:
             new_used, counts = self._mesh_solve(
                 used_dev, avail, feas, aff, ask, k, seeds, cidx, cdelta,
                 g=g_pad)
+            counts_np = np.asarray(counts)  # ONE readback for the batch
         else:
             new_used, counts = solve_bulk_multi(
                 used_dev, avail, feas, aff, ask, k, tgc, seeds, cidx,
                 cdelta, g=g_pad)
-        counts_np = np.asarray(counts)  # ONE readback for the whole batch
+            counts_np = np.asarray(counts)  # ONE readback for the batch
         self._state = (static, new_used, since + g)
         born = _time.time()
         with self._lock:
@@ -403,6 +563,14 @@ class BulkSolverService:
             self.stats["launch_s"] += _time.perf_counter() - t0
             if mesh is not None:
                 self.stats["sharded"] += 1
+            if info_np is not None:
+                self.stats["joint_launches"] += 1
+                self.stats["joint_solves"] += g
+                self.stats["auction_won"] += int(info_np[5] > 0.5)
+                self.stats["auction_rounds"] += int(info_np[4])
+                self.stats["joint_score"] += float(
+                    info_np[0] if info_np[5] > 0.5 else info_np[1])
+                self.stats["greedy_score"] += float(info_np[1])
             for i, r in enumerate(rs):
                 row = counts_np[i]
                 idx = np.nonzero(row)[0]

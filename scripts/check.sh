@@ -2,17 +2,23 @@
 # The full local gate: lint + AST invariant checker + tier-1 tests.
 # Mirrors what CI should run; every step must pass.
 #
-#   scripts/check.sh              the standard gate
-#   scripts/check.sh --e2e-smoke  also run the full-pipeline failover
-#                                 smoke (3-node cluster, 4 workers,
-#                                 300 evals, one leader restart)
+#   scripts/check.sh                the standard gate
+#   scripts/check.sh --e2e-smoke    also run the full-pipeline failover
+#                                   smoke (3-node cluster, 4 workers,
+#                                   300 evals, one leader restart)
+#   scripts/check.sh --solve-smoke  also run the global-batch solve
+#                                   smoke (batched workers under
+#                                   tpu-solve: joint launch reached,
+#                                   score dominance, alloc uniqueness)
 set -u
 cd "$(dirname "$0")/.."
 
 run_e2e_smoke=0
+run_solve_smoke=0
 for arg in "$@"; do
     case "$arg" in
         --e2e-smoke) run_e2e_smoke=1 ;;
+        --solve-smoke) run_solve_smoke=1 ;;
         *) echo "unknown flag: $arg" >&2; exit 64 ;;
     esac
 done
@@ -36,7 +42,8 @@ python -m nomad_tpu.analysis || failed=1
 echo "== nomadsan smoke (NOMAD_TPU_SAN=1) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" NOMAD_TPU_SAN=1 python -m pytest \
     tests/test_sanitizer.py tests/test_state_store.py \
-    tests/test_plan_apply_scale.py tests/test_e2e_pipeline.py -q \
+    tests/test_plan_apply_scale.py tests/test_e2e_pipeline.py \
+    tests/test_batch_solver.py -q \
     -p no:cacheprovider || failed=1
 
 # nomadcheck smoke (~2s, 60s budget): the deterministic interleaving
@@ -72,6 +79,18 @@ if [ "$run_e2e_smoke" = 1 ]; then
     echo "== e2e pipeline smoke (python -m nomad_tpu.chaos --e2e-smoke) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 300 \
         python -m nomad_tpu.chaos --e2e-smoke || failed=1
+fi
+
+# global-batch solve smoke (opt-in, ~10s): bulk-sized jobs through
+# batched workers under tpu-solve on a live 3-node cluster — a whole
+# worker batch must reach the joint auction launch, the selected
+# packing score must dominate the in-launch greedy counterfactual, and
+# the alloc set must stay unique on every replica (PERF.md
+# "Global-batch solve")
+if [ "$run_solve_smoke" = 1 ]; then
+    echo "== solve smoke (python -m nomad_tpu.chaos --solve-smoke) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 300 \
+        python -m nomad_tpu.chaos --solve-smoke || failed=1
 fi
 
 echo "== tier-1 tests =="

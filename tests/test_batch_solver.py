@@ -1,0 +1,409 @@
+"""Property tests for the "tpu-solve" global-batch assignment tier.
+
+Three layers, mirroring the paths a joint solve crosses:
+
+  * kernel: randomized feasibility / usage-reconstruction / dominance
+    properties of batch_solver.solve_batch against the greedy chain it
+    portfolios with;
+  * fit formula: numpy/jax parity of the deduplicated fit-score core
+    (kernels._fit_scores_xp — the single source of truth the host
+    fallback, the auction, and the bench scorer all consume);
+  * pipeline: a live batched-worker server under tpu-solve, asserting
+    host-checker feasibility of every placement, per-job plan
+    boundaries, broker per-job serialization, and alloc uniqueness.
+
+All green under NOMAD_TPU_SAN=1 (scripts/check.sh runs this file in the
+sanitizer smoke).
+"""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.structs import Constraint, enums
+
+
+# --------------------------------------------------------------------------
+# fit-score formula parity (the satellite dedup: one formula, two hosts)
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("spread_alg", [False, True])
+def test_fit_scores_np_jax_parity(seed, spread_alg):
+    import jax.numpy as jnp
+
+    from nomad_tpu.tensor.kernels import fit_scores, fit_scores_np
+
+    rng = np.random.default_rng(seed)
+    n, d = 48, 4
+    avail = rng.uniform(1000, 32000, (n, d))
+    used = avail * rng.uniform(0.0, 1.1, (n, d))  # includes overfull rows
+    got_np = fit_scores_np(avail, used, spread_alg)
+    got_jx = np.asarray(fit_scores(
+        jnp.asarray(avail, jnp.float32), jnp.asarray(used, jnp.float32),
+        spread_alg))
+    assert got_np.shape == got_jx.shape == (n,)
+    np.testing.assert_allclose(got_np, got_jx, atol=1e-4)
+
+
+def test_fit_scores_batched_shape():
+    """The ellipsis indexing keeps (G, N, D) batched inputs working —
+    the shape the auction's per-round bid matrix uses."""
+    from nomad_tpu.tensor.kernels import fit_scores_np
+
+    rng = np.random.default_rng(0)
+    avail = rng.uniform(1000, 32000, (3, 16, 4))
+    used = avail * rng.uniform(0, 1, (3, 16, 4))
+    out = fit_scores_np(avail, used)
+    assert out.shape == (3, 16)
+    np.testing.assert_allclose(out[1], fit_scores_np(avail[1], used[1]))
+
+
+def test_binpack_fitness_np_is_kernel_formula():
+    """tensor/placer._binpack_fitness_np must stay a thin wrapper over
+    the kernel formula — the host preemption mirror and the device
+    scorer may not drift apart."""
+    from nomad_tpu.tensor.kernels import fit_scores_np
+    from nomad_tpu.tensor.placer import _binpack_fitness_np
+
+    rng = np.random.default_rng(1)
+    avail = rng.uniform(1000, 32000, (32, 4))
+    used = avail * rng.uniform(0, 1, (32, 4))
+    np.testing.assert_allclose(_binpack_fitness_np(avail, used),
+                               fit_scores_np(avail, used))
+
+
+def test_packing_score_np_matches_kernel_metric():
+    import jax.numpy as jnp
+
+    from nomad_tpu.tensor.batch_solver import (_packing_score_xp,
+                                               packing_score_np)
+
+    rng = np.random.default_rng(2)
+    avail = rng.uniform(1000, 32000, (24, 4))
+    used = avail * rng.uniform(0, 1, (24, 4))
+    counts = rng.integers(0, 5, (6, 24))
+    host = packing_score_np(counts, avail, used)
+    dev = float(_packing_score_xp(
+        jnp, jnp.asarray(counts), jnp.asarray(avail, jnp.float32),
+        jnp.asarray(used, jnp.float32)))
+    assert abs(host - dev) < 1e-2
+
+
+# --------------------------------------------------------------------------
+# kernel-level randomized properties
+# --------------------------------------------------------------------------
+
+def _random_problem(seed, n=40, g=6):
+    rng = np.random.default_rng(seed)
+    d = 4
+    avail = np.zeros((n, d), np.float32)
+    avail[:, 0] = rng.choice([4000, 8000, 16000], n)
+    avail[:, 1] = rng.choice([8192, 16384, 32768], n)
+    avail[:, 2] = 100_000
+    avail[:, 3] = 1000
+    used0 = np.zeros((n, d), np.float32)
+    used0[:, 0] = rng.integers(0, 2000, n)
+    used0[:, 1] = rng.integers(0, 4000, n)
+    feas = rng.random((g, n)) > 0.25
+    aff = np.where(rng.random((g, n)) > 0.7, 0.3, 0.0).astype(np.float32)
+    ask = np.zeros((g, d), np.float32)
+    ask[:, 0] = rng.integers(50, 400, g)
+    ask[:, 1] = rng.integers(32, 512, g)
+    k = rng.integers(10, 150, g).astype(np.int32)
+    seeds = rng.integers(0, 2**31, g).astype(np.uint32)
+    return avail, used0, feas, aff, ask, k, seeds
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_solve_batch_feasible_and_dominates_greedy(seed):
+    """Every solve_batch assignment must (a) respect the feasibility
+    mask, per-eval demand, and node capacity, (b) reconstruct its own
+    usage delta exactly, and (c) never lose to the greedy chain run
+    from the same start state on (placed, packing score) — the
+    portfolio selection guarantee."""
+    import jax.numpy as jnp
+
+    from nomad_tpu.tensor.batch_solver import packing_score_np, solve_batch
+    from nomad_tpu.tensor.kernels import solve_bulk_multi
+
+    avail, used0, feas, aff, ask, k, seeds = _random_problem(seed)
+    g, d = ask.shape
+    tgc = k.astype(np.float32)
+    cidx = np.zeros(1, np.int32)
+    cdelta = np.zeros((1, d), np.float32)
+    args = [jnp.asarray(x) for x in
+            (avail, feas, aff, ask, k, tgc, seeds, cidx, cdelta)]
+
+    used, counts, info = solve_batch(jnp.asarray(used0), *args, g=g)
+    used, counts = np.asarray(used), np.asarray(counts)
+    assert (counts >= 0).all()
+    assert (counts[~feas] == 0).all(), "placement on an infeasible node"
+    assert (counts.sum(axis=1) <= k).all(), "demand overrun"
+    recon = used0 + (counts[:, :, None] * ask[:, None, :]).sum(axis=0)
+    np.testing.assert_allclose(used, recon, atol=1e-2)
+    assert (used <= avail + 1e-2).all(), "capacity overrun"
+
+    used_g, counts_g = solve_bulk_multi(jnp.asarray(used0), *args, g=g)
+    used_g, counts_g = np.asarray(used_g), np.asarray(counts_g)
+    sel = packing_score_np(counts.astype(np.int64), avail, used)
+    grd = packing_score_np(counts_g.astype(np.int64), avail, used_g)
+    assert counts.sum() >= counts_g.sum()
+    if counts.sum() == counts_g.sum():
+        assert sel >= grd - 1e-3
+    # the info row must agree with the recomputed host-side facts
+    assert int(info[2] if info[5] > 0.5 else info[3]) == counts.sum()
+
+
+def test_solve_batch_respects_usage_corrections():
+    """Correction slots fold into the carry before either arm runs."""
+    import jax.numpy as jnp
+
+    from nomad_tpu.tensor.batch_solver import solve_batch
+
+    avail, used0, feas, aff, ask, k, seeds = _random_problem(11)
+    g, d = ask.shape
+    cidx = np.array([0, 3], np.int32)
+    cdelta = np.zeros((2, d), np.float32)
+    cdelta[:, 0] = [500.0, -200.0]
+    used, counts, _ = solve_batch(
+        jnp.asarray(used0), jnp.asarray(avail), jnp.asarray(feas),
+        jnp.asarray(aff), jnp.asarray(ask), jnp.asarray(k),
+        jnp.asarray(k.astype(np.float32)), jnp.asarray(seeds),
+        jnp.asarray(cidx), jnp.asarray(cdelta), g=g)
+    used, counts = np.asarray(used), np.asarray(counts)
+    start = used0.copy()
+    start[0, 0] += 500.0
+    start[3, 0] = max(start[3, 0] - 200.0, 0.0)
+    recon = start + (counts[:, :, None] * ask[:, None, :]).sum(axis=0)
+    np.testing.assert_allclose(used, recon, atol=1e-2)
+    assert (used <= avail + 1e-2).all()
+
+
+def test_solve_batch_sharded_parity():
+    """The mesh-sharded joint solve must agree with the single-device
+    kernel bit-exactly on counts (the top-R all-gather merge reproduces
+    single-device top_k order; scores only to float tolerance)."""
+    import jax
+    import jax.numpy as jnp
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >1 device (conftest sets 8 virtual)")
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from nomad_tpu.tensor.batch_solver import solve_batch
+    from nomad_tpu.tensor.sharding import make_solve_batch_sharded, node_mesh
+
+    avail, used0, feas, aff, ask, k, seeds = _random_problem(7, n=64, g=8)
+    g, d = ask.shape
+    cidx = np.array([0, 5], np.int32)
+    cdelta = np.zeros((2, d), np.float32)
+    cdelta[0, 0] = 300.0
+
+    used_1, counts_1, info_1 = solve_batch(
+        jnp.asarray(used0), jnp.asarray(avail), jnp.asarray(feas),
+        jnp.asarray(aff), jnp.asarray(ask), jnp.asarray(k),
+        jnp.asarray(k.astype(np.float32)), jnp.asarray(seeds),
+        jnp.asarray(cidx), jnp.asarray(cdelta), g=g)
+
+    mesh = node_mesh()
+    solve_sh = make_solve_batch_sharded(mesh)
+    sh = NamedSharding(mesh, P("nodes", None))
+    used_m, counts_m, info_m = solve_sh(
+        jax.device_put(used0, sh), jax.device_put(avail, sh),
+        jnp.asarray(feas), jnp.asarray(aff), jnp.asarray(ask),
+        jnp.asarray(k), jnp.asarray(seeds), jnp.asarray(cidx),
+        jnp.asarray(cdelta), g=g)
+
+    np.testing.assert_array_equal(np.asarray(counts_m),
+                                  np.asarray(counts_1))
+    np.testing.assert_allclose(np.asarray(used_m), np.asarray(used_1),
+                               atol=1e-2)
+    # placed / rounds / arm choice agree exactly; scores to f32 psum tol
+    np.testing.assert_array_equal(np.asarray(info_m)[2:4],
+                                  np.asarray(info_1)[2:4])
+    np.testing.assert_allclose(np.asarray(info_m)[:2],
+                               np.asarray(info_1)[:2], rtol=1e-4)
+
+
+# --------------------------------------------------------------------------
+# pipeline-level: live batched server under tpu-solve
+# --------------------------------------------------------------------------
+
+def _solve_server(workers=2, eval_batch_size=4):
+    from nomad_tpu.core.server import Server, ServerConfig
+    from nomad_tpu.structs.operator import SchedulerConfiguration
+
+    return Server(ServerConfig(
+        num_workers=workers,
+        eval_batch_size=eval_batch_size,
+        sched_config=SchedulerConfiguration(
+            scheduler_algorithm=enums.SCHED_ALG_TPU_SOLVE),
+        heartbeat_ttl=3600.0, gc_interval=3600.0,
+        nack_timeout=900.0, failed_eval_followup_delay=3600.0,
+        failed_eval_unblock_interval=0.5))
+
+
+def _bulk_job(count, cpu, mem, constraints=None):
+    j = mock.batch_job()
+    tg = j.task_groups[0]
+    tg.count = count
+    tg.tasks[0].resources.cpu = cpu
+    tg.tasks[0].resources.memory_mb = mem
+    if constraints:
+        tg.constraints = list(constraints)
+    return j
+
+
+def _wait_idle(srv, deadline=120.0):
+    limit = time.time() + deadline
+    while True:
+        assert srv.wait_for_idle(timeout=max(1.0, limit - time.time()),
+                                 include_delayed=False), \
+            "server did not drain"
+        if srv.blocked.blocked_count() == 0:
+            return
+        assert time.time() < limit, "blocked evals did not drain"
+        time.sleep(0.1)
+
+
+def test_tpu_solve_server_feasible_boundaries_serialized():
+    """End-to-end through batched workers -> rendezvous -> joint solve
+    -> plan applier: every placement passes the host feasibility
+    checkers, every plan stays single-job, the broker never hands one
+    job's evals to two batch members at once, and no alloc id or
+    (job, group, index) name is double-committed."""
+    from nomad_tpu.core.broker import EvalBroker
+    from nomad_tpu.scheduler.feasible import feasible_mask_static
+    from nomad_tpu.tensor.solver import get_service
+
+    rng = random.Random(5)
+    cons = [Constraint(ltarget="${attr.kernel.version}", rtarget=">= 4.19",
+                       operand=enums.CONSTRAINT_VERSION)]
+    srv = _solve_server()
+
+    batches = []
+    orig_dequeue = EvalBroker.dequeue_batch
+
+    def recording_dequeue(self, *a, **kw):
+        out = orig_dequeue(self, *a, **kw)
+        if out:
+            batches.append([ev.job_id for ev, _ in out])
+        return out
+
+    plans = []
+    orig_enqueue = srv.plan_queue.enqueue
+
+    def recording_enqueue(plan):
+        jobs_in_plan = {a.job_id for allocs in plan.node_allocation.values()
+                        for a in allocs}
+        jobs_in_plan |= {b.job_id for b in plan.alloc_blocks}
+        plans.append(jobs_in_plan)
+        return orig_enqueue(plan)
+
+    EvalBroker.dequeue_batch = recording_dequeue
+    srv.plan_queue.enqueue = recording_enqueue
+    try:
+        nodes = []
+        for i in range(32):
+            n = mock.node()
+            n.attributes["kernel.version"] = ["4.14.0", "4.19.0", "5.10.0"][i % 3]
+            n.resources.cpu = rng.choice([8000, 16000])
+            n.resources.memory_mb = 16384
+            n.compute_class()
+            nodes.append(n)
+        jobs = [_bulk_job(256, cpu=rng.choice([50, 80, 120]),
+                          mem=rng.choice([32, 64, 96]), constraints=cons)
+                for _ in range(4)]
+        with srv:
+            for n in nodes:
+                srv.register_node(n)
+            stats0 = dict(get_service().stats)
+            for j in jobs:
+                srv.register_job(j)
+            _wait_idle(srv)
+            snap = srv.store.snapshot()
+            svc = get_service().stats
+            joint_launches = svc["joint_launches"] - stats0.get(
+                "joint_launches", 0)
+    finally:
+        EvalBroker.dequeue_batch = orig_dequeue
+        srv.plan_queue.enqueue = orig_enqueue
+
+    # all demand placeable and placed
+    placed = {j.id: [a for a in snap.allocs_by_job(j.id)
+                     if not a.terminal_status()] for j in jobs}
+    assert sum(len(v) for v in placed.values()) == 4 * 256
+
+    # (a) host-checker feasibility + per-node capacity
+    node_by_id = {n.id: n for n in nodes}
+    for j in jobs:
+        ok = feasible_mask_static(j, j.task_groups[0], nodes, {}, {})
+        feasible_ids = {nodes[i].id for i in range(len(nodes)) if ok[i]}
+        for a in placed[j.id]:
+            assert a.node_id in feasible_ids, \
+                f"alloc {a.id} on host-infeasible node"
+    usage = {}
+    for allocs in placed.values():
+        for a in allocs:
+            u = usage.setdefault(a.node_id, np.zeros(2))
+            u += [float(a.allocated_vec[0]), float(a.allocated_vec[1])]
+    for nid, u in usage.items():
+        n = node_by_id[nid]
+        assert u[0] <= n.resources.cpu + 1e-6
+        assert u[1] <= n.resources.memory_mb + 1e-6
+
+    # (b) per-job plan boundaries: no plan mixes jobs
+    assert plans and all(len(p) <= 1 for p in plans)
+
+    # (c) broker serialization: no dequeued batch holds two evals of
+    # one job
+    assert batches and all(len(b) == len(set(b)) for b in batches)
+
+    # (d) alloc uniqueness: ids and (job, name) slots committed once
+    ids = [a.id for allocs in placed.values() for a in allocs]
+    assert len(ids) == len(set(ids))
+    names = [(a.job_id, a.name) for allocs in placed.values()
+             for a in allocs]
+    assert len(names) == len(set(names))
+
+    # and the batch actually went through the joint tier
+    assert joint_launches >= 1
+
+
+def test_tpu_solve_matches_greedy_placement_count():
+    """Same cluster, same jobs: the solve tier places everything the
+    greedy tier places (the portfolio's placed-count dominance,
+    observed through the full scheduler rather than the bare kernel)."""
+    from nomad_tpu.core.server import Server, ServerConfig
+    from nomad_tpu.structs.operator import SchedulerConfiguration
+
+    def run(algorithm):
+        srv = Server(ServerConfig(
+            num_workers=2, eval_batch_size=4,
+            sched_config=SchedulerConfiguration(
+                scheduler_algorithm=algorithm),
+            heartbeat_ttl=3600.0, gc_interval=3600.0))
+        rng = random.Random(9)
+        jobs = [_bulk_job(256, cpu=rng.choice([60, 100, 140]),
+                          mem=rng.choice([48, 64, 128]))
+                for _ in range(3)]
+        with srv:
+            for i in range(24):
+                n = mock.node(id=f"pc-{algorithm}-{i:03d}")
+                n.resources.cpu = 16000
+                n.resources.memory_mb = 32768
+                n.compute_class()
+                srv.register_node(n)
+            for j in jobs:
+                srv.register_job(j)
+            _wait_idle(srv)
+            snap = srv.store.snapshot()
+            return sum(len([a for a in snap.allocs_by_job(j.id)
+                            if not a.terminal_status()]) for j in jobs)
+
+    assert (run(enums.SCHED_ALG_TPU_SOLVE)
+            == run(enums.SCHED_ALG_TPU_BINPACK) == 3 * 256)
