@@ -570,27 +570,42 @@ def cfg4_system_preemption() -> None:
         sysj.task_groups[0].tasks[0].resources.memory_mb = 128
         for j in (hi, sysj):
             h.store.upsert_job(j)
+        # traced per-phase breakdown of ONLY the timed region: the
+        # round-to-round swing diagnosis (PERF.md "The preemption
+        # rung's variance") needs to see WHICH phase moved, not just dt
+        from nomad_tpu.obs import TRACER
+        from nomad_tpu.obs.export import phase_breakdown
+
+        TRACER.clear()
         t0 = time.perf_counter()
         h.process(mock.eval_for(hi, id="bench4-ev-hi"), sched_config=cfg)
         h.process(mock.eval_for(sysj, id="bench4-ev-sys"), sched_config=cfg)
         dt = time.perf_counter() - t0
+        phases = {name: row["total_ms"] for name, row
+                  in phase_breakdown(TRACER.spans()).items()
+                  if name.startswith(("worker.", "solver."))}
         snap = h.store.snapshot()
         placed = sum(len([a for a in snap.allocs_by_job(j.id)
                           if not a.terminal_status()]) for j in (hi, sysj))
         preempted = len([a for a in snap.allocs_by_job(filler.id)
                          if a.desired_status == enums.ALLOC_DESIRED_EVICT])
-        return dt, placed, preempted
+        return dt, placed, preempted, phases
 
     def med(algorithm: str, repeats: int = 3):
         runs = [run(algorithm) for _ in range(repeats)]
-        return tuple(statistics.median(r[i] for r in runs) for i in range(3))
+        names = sorted({n for r in runs for n in r[3]})
+        phases = {n: round(statistics.median(
+            r[3].get(n, 0.0) for r in runs), 2) for n in names}
+        return tuple(statistics.median(r[i] for r in runs)
+                     for i in range(3)) + (phases,)
 
-    tdt, tplaced, tpre = med(enums.SCHED_ALG_TPU_BINPACK)
-    hdt, hplaced, hpre = med(enums.SCHED_ALG_BINPACK)
+    tdt, tplaced, tpre, tphases = med(enums.SCHED_ALG_TPU_BINPACK)
+    hdt, hplaced, hpre, _ = med(enums.SCHED_ALG_BINPACK)
     assert tplaced == hplaced, (tplaced, hplaced)
     return emit("system_preempt_sched_throughput_mixed_priorities",
                 tplaced / tdt, "allocs/s", hdt / tdt,
-                placed=tplaced, preempted=tpre, host_preempted=hpre)
+                placed=tplaced, preempted=tpre, host_preempted=hpre,
+                phase_total_ms=tphases)
 
 
 def cfg5_devices_numa() -> None:
@@ -1067,10 +1082,59 @@ def e2e_sched_commit_throughput_3node() -> None:
          **extras)
 
 
+def cfg_trace_ab() -> None:
+    """nomadtrace overhead A/B (OBSERVABILITY.md acceptance): the e2e3
+    trial configuration (4 workers, batching on, live fsync-on 3-node
+    cluster) with the tracer + flight recorder ON vs OFF, arms
+    interleaved, medians of 3. vs_baseline is on/off throughput — the
+    acceptance is >= 0.97 (tracing costs < 3%), and the off arm is the
+    NOMAD_TPU_TRACE=0 kill-switch path, so it doubles as proof the
+    switch restores the untraced baseline. The on arm also reports the
+    traced per-phase p50s — the breakdown the telemetry plane buys."""
+    import statistics
+
+    from nomad_tpu.obs import RECORDER, TRACER
+    from nomad_tpu.obs.export import phase_breakdown
+
+    def trial(enabled: bool):
+        TRACER.set_enabled(enabled)
+        RECORDER.set_enabled(enabled)
+        TRACER.clear()
+        RECORDER.clear()
+        try:
+            r = _e2e_trial(4, True)
+            r["phases"] = phase_breakdown(TRACER.spans()) if enabled else {}
+            return r
+        finally:
+            TRACER.set_enabled(True)
+            RECORDER.set_enabled(True)
+            TRACER.clear()
+            RECORDER.clear()
+
+    # one discarded warmup trial (XLA compiles, page cache, allocator
+    # high-water marks all land here), then alternate which arm leads
+    # each pair so residual drift hits both equally
+    trial(False)
+    on_runs, off_runs = [], []
+    for i in range(3):
+        for enabled in ((True, False) if i % 2 == 0 else (False, True)):
+            (on_runs if enabled else off_runs).append(trial(enabled))
+    on = statistics.median(r["allocs_s"] for r in on_runs)
+    off = statistics.median(r["allocs_s"] for r in off_runs)
+    phases = {name: round(row["p50_ms"], 3) for name, row
+              in sorted(on_runs[-1]["phases"].items())}
+    emit("trace_overhead_e2e3",
+         on, "allocs/s", on / max(off, 1e-9),
+         traced_allocs_s=round(on, 1), untraced_allocs_s=round(off, 1),
+         overhead_pct=round(100.0 * (1.0 - on / max(off, 1e-9)), 2),
+         phase_p50_ms=phases)
+
+
 CONFIGS = [
     # before the headline: a driver timeout must not eat the raft rung
     ("raft3", raft_commit_throughput_3node),
     ("e2e3", e2e_sched_commit_throughput_3node),
+    ("trace_ab", cfg_trace_ab),
     ("headline", headline_spread_1k),
     ("c2m", cfg_c2m),
     ("solve_ab", cfg_solve_ab),

@@ -912,6 +912,8 @@ def run_scenario(name: str, seed: int, policy: str = "random",
     pol = POLICIES[policy](seed)
     _NAME_SEQ[0] = 0                     # trace names restart per run
     MCTimer._seq[0] = 0
+    from ..obs import RECORDER
+    RECORDER.clear()                     # per-run forensics isolation
     sch = Scheduler(pol, max_steps=max_steps)
     env = ScenarioEnv(seed=seed, fsfaults=fsfaults)
     leaked: List[str] = []
@@ -933,10 +935,19 @@ def run_scenario(name: str, seed: int, policy: str = "random",
             main.state = "finished"
             sch.finalize_abort()
     err = sch.error
+    error = None
+    if err is not None:
+        error = f"{err}"
+        # attach the flight recorder to the finding: the subsystem
+        # transitions leading up to the failure, under this exact
+        # deterministic schedule
+        dump = RECORDER.dump_text(last=40)
+        if dump:
+            error += "\n  flight recorder (last 40 events):\n" + dump
     return CheckResult(
         scenario=name, seed=seed, policy=pol.name, steps=sch.step,
         trace=sch.trace, leaked=leaked,
-        error=None if err is None else f"{err}",
+        error=error,
         error_type="" if err is None else type(err).__name__)
 
 

@@ -365,7 +365,8 @@ class HTTPAgent:
         elif path.startswith(("/v1/nodes", "/v1/node/")):
             if acl is not None and not acl.allow_node_read():
                 return h._error(403, "Permission denied")
-        elif path.startswith("/v1/agent") or path == "/v1/metrics":
+        elif (path.startswith("/v1/agent")
+                or path in ("/v1/metrics", "/v1/traces")):
             if acl is not None and not acl.allow_agent_read():
                 return h._error(403, "Permission denied")
         elif path.startswith("/v1/operator"):
@@ -833,6 +834,21 @@ class HTTPAgent:
                 h.wfile.write(body)
                 return
             return h._reply(200, metrics)
+        if path == "/v1/traces":
+            from ..obs import TRACER
+            from ..obs.export import chrome_trace, phase_breakdown
+
+            spans = TRACER.spans()
+            limit = int(q.get("limit", ["500"])[0])
+            body = {
+                "enabled": TRACER.enabled,
+                "total_spans": len(spans),
+                "phases": phase_breakdown(spans),
+                # newest spans last, Chrome trace_event format — paste
+                # the traceEvents list into chrome://tracing / Perfetto
+                "trace": chrome_trace(spans[-limit:] if limit else spans),
+            }
+            return h._reply(200, body)
         h._error(404, f"no such route {path}")
 
     def _find_runner(self, alloc_id: str):

@@ -267,4 +267,13 @@ class InvariantChecker:
     def _fail(self, msg: str) -> None:
         self.stats["violations"] += 1
         log.error("invariant violated: %s", msg)
+        # flight recorder: the last few hundred subsystem transitions
+        # (broker deliveries, plan verdicts, raft role flips, solver
+        # launches) are exactly the forensics a violation needs — dump
+        # them with the failure instead of asking for a repro run
+        from ..obs import RECORDER
+
+        dump = RECORDER.dump_text(last=80)
+        if dump:
+            log.error("flight recorder (last 80 events):\n%s", dump)
         raise InvariantViolation(msg)

@@ -26,6 +26,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..analysis.sanitizer import sanitized
+from ..obs import RECORDER, TRACER
 from ..structs import enums
 from ..structs.evaluation import Evaluation
 from ..utils import generate_secret_uuid
@@ -135,6 +136,9 @@ class EvalBroker:
         self.stats["enqueued"] += 1
         now = time.time()
         self._enqueue_times.setdefault(ev.id, now)
+        TRACER.event("eval.enqueued", trace=ev.trace(), job=ev.job_id)
+        RECORDER.record("broker", "enqueue", eval=ev.id[:8],
+                        job=ev.job_id, type=ev.type)
         if ev.wait_until and ev.wait_until > now:
             heapq.heappush(self._delay, (ev.wait_until, next(self._seq), ev))
             self._lock.notify_all()  # delay loop re-sleeps
@@ -230,6 +234,15 @@ class EvalBroker:
         self._unacked[eval_id] = info
         timer.start()
         self.stats["dequeued"] += 1
+        # retroactive queue-wait span: first-enqueue time -> now (covers
+        # redeliveries too, matching the enqueue_to_commit side table)
+        t0 = self._enqueue_times.get(eval_id)
+        if t0 is not None:
+            TRACER.add_span("eval.queued", t0, time.time(),
+                            trace=ev.trace(),
+                            deliveries=info["deliveries"])
+        RECORDER.record("broker", "dequeue", eval=eval_id[:8],
+                        deliveries=info["deliveries"])
         return ev, token
 
     def _delivery_count(self, eval_id: str) -> int:
@@ -252,6 +265,8 @@ class EvalBroker:
                 REGISTRY.observe("nomad.eval.enqueue_to_commit",
                                  time.time() - t0)
             ev = info["eval"]
+            TRACER.event("eval.ack", trace=ev.trace())
+            RECORDER.record("broker", "ack", eval=eval_id[:8])
             key = (ev.namespace, ev.job_id)
             if self._job_tracked.get(key) == eval_id:
                 del self._job_tracked[key]
@@ -280,6 +295,8 @@ class EvalBroker:
             info["timer"].cancel()
             del self._unacked[eval_id]
             self.stats["nacked"] += 1
+            RECORDER.record("broker", "nack", eval=eval_id[:8],
+                            deliveries=info["deliveries"])
             self._redeliver_locked(info)
 
     def _nack_timeout(self, eval_id: str, token: str) -> None:
@@ -288,6 +305,8 @@ class EvalBroker:
             if info is None or info["token"] != token:
                 return
             del self._unacked[eval_id]
+            RECORDER.record("broker", "nack_timeout", eval=eval_id[:8],
+                            deliveries=info["deliveries"])
             self._redeliver_locked(info)
 
     def _redeliver_locked(self, info: dict) -> None:
@@ -299,6 +318,8 @@ class EvalBroker:
         if info["deliveries"] >= self.delivery_limit:
             # too many failed deliveries: route to the failed queue
             # (eval_broker.go:28 failedQueue)
+            RECORDER.record("broker", "failed_queue", eval=ev.id[:8],
+                            deliveries=info["deliveries"])
             self._evals[ev.id] = ev
             if ev.job_id:
                 self._job_tracked[key] = ev.id

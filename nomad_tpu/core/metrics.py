@@ -68,11 +68,23 @@ class _Histogram:
             self._next = (self._next + 1) % self._capacity
 
     def percentile(self, q: float) -> float:
-        if not self._ring:
-            return 0.0
-        data = sorted(self._ring)
-        k = min(len(data) - 1, max(0, int(round(q * (len(data) - 1)))))
-        return data[k]
+        return _pct(sorted(self._ring), q)
+
+    def snapshot(self) -> tuple:
+        """(count, total_s, max_s, ring copy). O(n) copy, NO sort —
+        built to run under the registry lock so percentile math (the
+        O(n log n) part) happens outside it; sorting a 2048-entry ring
+        per histogram per scrape inside the lock stalled every hot-path
+        incr/observe behind the scrape."""
+        return self.count, self.total_s, self.max_s, list(self._ring)
+
+
+def _pct(data: list, q: float) -> float:
+    """q-percentile of an already-sorted sample list (0.0 if empty)."""
+    if not data:
+        return 0.0
+    k = min(len(data) - 1, max(0, int(round(q * (len(data) - 1)))))
+    return data[k]
 
 
 class Registry:
@@ -113,10 +125,15 @@ class Registry:
             h.observe(seconds)
 
     def percentile(self, name: str, q: float) -> float:
-        """Current q-percentile (seconds) of a histogram, 0.0 if empty."""
+        """Current q-percentile (seconds) of a histogram, 0.0 if empty.
+        The ring is copied inside the lock and sorted outside it."""
         with self._lock:
             h = self._histograms.get(name)
-            return h.percentile(q) if h is not None else 0.0
+            if h is None:
+                return 0.0
+            data = list(h._ring)
+        data.sort()
+        return _pct(data, q)
 
     def time(self, name: str) -> "_Timer":
         """Context manager: times the block into `name`."""
@@ -139,6 +156,10 @@ class Registry:
                 self._histograms.pop(name, None)
 
     def dump(self) -> dict:
+        # snapshot every family inside the lock (cheap copies), compute
+        # the percentile sorts outside it: a scrape of H histograms used
+        # to hold the lock for H * O(n log n) sorts, stalling every
+        # concurrent incr/observe on the hot paths
         with self._lock:
             out = dict(self._counters)
             out.update(self._gauges)
@@ -147,14 +168,17 @@ class Registry:
                              "mean_ms": (1000.0 * s.total_s / s.count
                                          if s.count else 0.0),
                              "max_ms": 1000.0 * s.max_s}
-            for name, h in self._histograms.items():
-                out[name] = {"count": h.count,
-                             "mean_ms": (1000.0 * h.total_s / h.count
-                                         if h.count else 0.0),
-                             "p50_ms": 1000.0 * h.percentile(0.50),
-                             "p99_ms": 1000.0 * h.percentile(0.99),
-                             "max_ms": 1000.0 * h.max_s}
-            return out
+            hsnaps = {name: h.snapshot()
+                      for name, h in self._histograms.items()}
+        for name, (count, total_s, max_s, ring) in hsnaps.items():
+            ring.sort()
+            out[name] = {"count": count,
+                         "mean_ms": (1000.0 * total_s / count
+                                     if count else 0.0),
+                         "p50_ms": 1000.0 * _pct(ring, 0.50),
+                         "p99_ms": 1000.0 * _pct(ring, 0.99),
+                         "max_ms": 1000.0 * max_s}
+        return out
 
 
 def prometheus_text(metrics: dict, prefix: str = "") -> str:

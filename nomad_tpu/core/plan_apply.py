@@ -37,6 +37,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
 from ..analysis.sanitizer import sanitized
+from ..obs import RECORDER, TRACER
 from ..structs import allocs_fit, enums
 from ..structs.plan import Plan, PlanResult
 
@@ -250,7 +251,7 @@ class _CommitEntry:
     (payload pre-built, no verification, no overlay cell)."""
 
     __slots__ = ("plan", "result", "rejected", "verify_gen", "cell",
-                 "future", "error", "payload")
+                 "future", "error", "payload", "trace", "t0")
 
     def __init__(self, plan, result, rejected, verify_gen, cell, future,
                  payload=None):
@@ -262,6 +263,11 @@ class _CommitEntry:
         self.future = future
         self.error: Optional[Exception] = None
         self.payload = payload
+        # obs: the eval whose plan this is (None for bare eval updates)
+        # and the entry's creation time — _respond records the
+        # entry-to-verdict window as the plan.commit span from these
+        self.trace = getattr(plan, "eval_id", None) or None
+        self.t0 = time.time()
 
 
 class PlanApplier:
@@ -437,7 +443,9 @@ class PlanApplier:
     def _verify(self, plan, overlay=None):
         from .metrics import REGISTRY
 
-        with REGISTRY.time("nomad.plan.evaluate"):
+        with REGISTRY.time("nomad.plan.evaluate"), \
+                TRACER.span("plan.verify",
+                            trace=getattr(plan, "eval_id", None) or None):
             return self._verify_inner(plan, overlay)
 
     def _verify_inner(self, plan: Plan,
@@ -636,17 +644,19 @@ class PlanApplier:
         # 2: one transaction for the whole batch
         writers = self._writers_for(entries)
         if writers:
-            try:
-                index = self.store.upsert_plan_results_batch(
-                    [p for _, p in writers])
-                for e, _ in writers:
-                    if e.result is not None:
-                        e.result.alloc_index = index
-            except Exception:
-                if self.logger:
-                    self.logger.exception(
-                        "batched plan commit failed; retrying per-plan")
-                self._commit_fallback(writers)
+            with TRACER.span("plan.commit_round", n=len(writers),
+                             traces=[e.trace for e in entries if e.trace]):
+                try:
+                    index = self.store.upsert_plan_results_batch(
+                        [p for _, p in writers])
+                    for e, _ in writers:
+                        if e.result is not None:
+                            e.result.alloc_index = index
+                except Exception:
+                    if self.logger:
+                        self.logger.exception(
+                            "batched plan commit failed; retrying per-plan")
+                    self._commit_fallback(writers)
         # 3: respond in order
         self._respond(entries)
 
@@ -713,6 +723,13 @@ class PlanApplier:
             else:
                 e.future.set_result(
                     self._finalize(e.plan, e.result, e.rejected))
+            if e.trace is not None:
+                # the entry's whole commit-side life: queued at the
+                # commit thread -> verdict delivered
+                TRACER.add_span("plan.commit", e.t0, time.time(),
+                                trace=e.trace,
+                                rejected=len(e.rejected or ()),
+                                failed=e.error is not None)
 
     # -- the pipelined rounds (store.can_propose_async) --
 
@@ -731,25 +748,29 @@ class PlanApplier:
         round_ = {"entries": entries, "plans": plans, "writers": writers,
                   "prop": None, "error": None}
         if writers:
-            try:
-                round_["prop"] = self.store.propose_async(
-                    "upsert_plan_results_batch", [p for _, p in writers])
-            except Exception as err:
-                round_["error"] = err
-                # The round's outcome is now ambiguous until the reap
-                # thread's fallback resolves it, but a successor round
-                # may be verified and proposed before then. Make the
-                # overlay cells conservative in BOTH directions: keep
-                # the placements (they may still land via the fallback
-                # — successors must not reuse that capacity) and drop
-                # the stops/preemptions (they may never land —
-                # successors must not move into capacity they "freed").
-                for e in plans:
-                    conservative = PlanResult()
-                    conservative.node_allocation = dict(
-                        e.result.node_allocation)
-                    conservative.alloc_blocks = list(e.result.alloc_blocks)
-                    self._poison(e.cell, conservative)
+            with TRACER.span("plan.propose", n=len(writers),
+                             traces=[e.trace for e in entries if e.trace]):
+                try:
+                    round_["prop"] = self.store.propose_async(
+                        "upsert_plan_results_batch",
+                        [p for _, p in writers])
+                except Exception as err:
+                    round_["error"] = err
+        if round_["error"] is not None:
+            # The round's outcome is now ambiguous until the reap
+            # thread's fallback resolves it, but a successor round
+            # may be verified and proposed before then. Make the
+            # overlay cells conservative in BOTH directions: keep
+            # the placements (they may still land via the fallback
+            # — successors must not reuse that capacity) and drop
+            # the stops/preemptions (they may never land —
+            # successors must not move into capacity they "freed").
+            for e in plans:
+                conservative = PlanResult()
+                conservative.node_allocation = dict(
+                    e.result.node_allocation)
+                conservative.alloc_blocks = list(e.result.alloc_blocks)
+                self._poison(e.cell, conservative)
         return round_
 
     def _finish_round(self, round_: dict) -> None:
@@ -763,16 +784,20 @@ class PlanApplier:
         writers = round_["writers"]
         prop = round_["prop"]
         if prop is not None:
-            try:
-                index = self.store.wait_applied(prop, timeout=30.0)
-                for e, _ in writers:
-                    if e.result is not None:
-                        e.result.alloc_index = index
-            except Exception:
-                if self.logger:
-                    self.logger.exception(
-                        "pipelined plan commit failed; retrying per-plan")
-                self._commit_fallback(writers)
+            with TRACER.span("plan.commit_wait", n=len(writers),
+                             traces=[e.trace for e in round_["entries"]
+                                     if e.trace]):
+                try:
+                    index = self.store.wait_applied(prop, timeout=30.0)
+                    for e, _ in writers:
+                        if e.result is not None:
+                            e.result.alloc_index = index
+                except Exception:
+                    if self.logger:
+                        self.logger.exception(
+                            "pipelined plan commit failed; "
+                            "retrying per-plan")
+                    self._commit_fallback(writers)
         elif round_["error"] is not None and writers:
             if self.logger:
                 self.logger.error(
@@ -876,6 +901,13 @@ class PlanApplier:
             REGISTRY.incr("nomad.plan.node_rejected", len(rejected))
             result.refresh_index = self.store.latest_index
             result.rejected_nodes = rejected
+            RECORDER.record("plan", "partial_reject",
+                            eval=(plan.eval_id or "")[:8],
+                            nodes=[n[:8] for n in rejected[:4]],
+                            n=len(rejected))
+        else:
+            RECORDER.record("plan", "applied",
+                            eval=(plan.eval_id or "")[:8])
         # post-apply hooks run HERE, synchronously with the commit (not
         # in the scheduler after submit returns): the solver service's
         # confirm() must close a solve's ledger entry as close as

@@ -25,6 +25,7 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
+from ..obs import TRACER
 from ..scheduler.scheduler import NewScheduler
 from ..structs import enums
 from ..structs.evaluation import Evaluation
@@ -59,22 +60,31 @@ class _EvalRun:
         serial caller can carry it forward, or None on failure."""
         ev, server = self.ev, self.server
         try:
-            snap = self.snapshot
-            if snap is None or snap.index < ev.modify_index:
-                snap = server.store.snapshot_min_index(ev.modify_index)
-            self.snapshot = snap
-            sched = NewScheduler(
-                ev.type, snap, self,
-                sched_config=server.sched_config,
-                logger=server.logger,
-                shared_caches=self.worker._sched_caches,
-                on_event=lambda e: server.events.publish(
-                    "Scheduler", e.get("type", "scheduler-event"), e))
-            from .metrics import REGISTRY
+            # every span this thread opens for this eval (snapshot,
+            # schedule, plan.submit, eval.persist, solver waits deeper
+            # down) inherits the eval's trace id from the bind
+            with TRACER.bind(ev.trace()):
+                snap = self.snapshot
+                if snap is None or snap.index < ev.modify_index:
+                    with TRACER.span("worker.snapshot",
+                                     index=ev.modify_index):
+                        snap = server.store.snapshot_min_index(
+                            ev.modify_index)
+                self.snapshot = snap
+                sched = NewScheduler(
+                    ev.type, snap, self,
+                    sched_config=server.sched_config,
+                    logger=server.logger,
+                    shared_caches=self.worker._sched_caches,
+                    on_event=lambda e: server.events.publish(
+                        "Scheduler", e.get("type", "scheduler-event"), e))
+                from .metrics import REGISTRY
 
-            with REGISTRY.time(f"nomad.worker.invoke_scheduler_{ev.type}"):
-                sched.process(ev)
-            server.broker.ack(ev.id, self.token)
+                with REGISTRY.time(
+                        f"nomad.worker.invoke_scheduler_{ev.type}"), \
+                        TRACER.span("worker.schedule", type=ev.type):
+                    sched.process(ev)
+                server.broker.ack(ev.id, self.token)
             self.worker._count("processed")
             return self.snapshot
         except Exception:
@@ -91,13 +101,14 @@ class _EvalRun:
 
     def submit_plan(self, plan: Plan):
         plan.snapshot_index = getattr(self.snapshot, "index", 0) or 0
-        pending = self.server.plan_queue.enqueue(plan)
-        # Generous (queue depth spikes when every worker submits a large
-        # plan at once) but bounded well inside the broker's nack timer —
-        # waiting the full nack window guarantees redelivery of an eval
-        # that is still being processed.
-        result = pending.wait(
-            timeout=max(10.0, self.server.config.nack_timeout / 2.0))
+        with TRACER.span("plan.submit"):
+            pending = self.server.plan_queue.enqueue(plan)
+            # Generous (queue depth spikes when every worker submits a
+            # large plan at once) but bounded well inside the broker's
+            # nack timer — waiting the full nack window guarantees
+            # redelivery of an eval that is still being processed.
+            result = pending.wait(
+                timeout=max(10.0, self.server.config.nack_timeout / 2.0))
         if result.refresh_index:
             # partial commit: hand the scheduler a fresher snapshot
             new_snap = self.server.store.snapshot_min_index(result.refresh_index)
@@ -113,19 +124,21 @@ class _EvalRun:
         that round lands, preserving the direct write's
         durability-before-ack semantics exactly. batch=False keeps the
         dedicated upsert_evals write (A/B baseline)."""
-        applier = self.server.plan_applier
-        if getattr(applier, "batch", False):
-            try:
-                fut = applier.submit_eval_updates([ev])
-            except RuntimeError:
-                # applier already stopped (leadership lost mid-eval):
-                # fall through to the direct write, which surfaces the
-                # real not-leader error to run()'s nack path
+        with TRACER.span("eval.persist"):
+            applier = self.server.plan_applier
+            if getattr(applier, "batch", False):
+                try:
+                    fut = applier.submit_eval_updates([ev])
+                except RuntimeError:
+                    # applier already stopped (leadership lost mid-eval):
+                    # fall through to the direct write, which surfaces
+                    # the real not-leader error to run()'s nack path
+                    self.server.store.upsert_evals([ev])
+                    return
+                fut.result(timeout=max(
+                    10.0, self.server.config.nack_timeout / 2.0))
+            else:
                 self.server.store.upsert_evals([ev])
-                return
-            fut.result(timeout=max(10.0, self.server.config.nack_timeout / 2.0))
-        else:
-            self.server.store.upsert_evals([ev])
 
     def update_eval(self, ev: Evaluation) -> None:
         self._persist_eval(ev)
@@ -223,7 +236,11 @@ class Worker:
         snap = None
         try:
             target = max(ev.modify_index for ev, _ in batch)
-            snap = self.server.store.snapshot_min_index(target)
+            # batch-shared span: one snapshot serves every member, so
+            # the span lists all their traces instead of claiming one
+            with TRACER.span("worker.snapshot", index=target,
+                             traces=[ev.trace() for ev, _ in batch]):
+                snap = self.server.store.snapshot_min_index(target)
         except Exception:
             snap = None  # fall back to per-eval acquisition
         pool = self._batch_pool

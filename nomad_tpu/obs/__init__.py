@@ -1,0 +1,19 @@
+"""nomadtrace: eval-lifecycle tracing + flight recorder
+(OBSERVABILITY.md).
+
+The two process-global singletons every subsystem imports:
+
+- ``TRACER``  — span recording into per-thread bounded rings
+  (obs/trace.py); export via ``python -m nomad_tpu.obs --export``,
+  ``/v1/traces``, and the ``nomad.eval.phase.*`` Registry histograms.
+- ``RECORDER`` — per-subsystem bounded event rings (obs/recorder.py);
+  dumped automatically by chaos/modelcheck on invariant failures.
+
+Both honor the ``NOMAD_TPU_TRACE=0`` kill switch (checked at import,
+flippable at runtime via ``set_enabled``).
+"""
+
+from .recorder import RECORDER, FlightRecorder
+from .trace import NULL_SPAN, TRACER, Tracer
+
+__all__ = ["TRACER", "Tracer", "RECORDER", "FlightRecorder", "NULL_SPAN"]

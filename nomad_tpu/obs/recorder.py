@@ -1,0 +1,88 @@
+"""Flight recorder: bounded per-subsystem event rings.
+
+Where the tracer answers "where did this eval spend its time", the
+flight recorder answers "what was the control plane DOING around the
+failure": broker transitions, plan accept/reject verdicts with reasons,
+raft term/role changes, solver launch stats. Each subsystem gets its
+own ``deque(maxlen=...)`` ring — appends are GIL-atomic, so the record
+path takes no locks — and ``chaos.InvariantChecker`` / the modelcheck
+scenarios dump the merged timeline automatically on any invariant
+failure, turning "invariant X failed at seed S" into a causal event
+log.
+
+Shares the tracer's ``NOMAD_TPU_TRACE=0`` kill switch: a disabled
+recorder's ``record()`` is a bool check and a return.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+# events kept per subsystem; a dump prints the merged tail, so the ring
+# only needs to cover the window between cause and detection
+RING_EVENTS = int(os.environ.get("NOMAD_TPU_RECORDER_RING", "512"))
+
+
+class FlightRecorder:
+    def __init__(self, enabled: Optional[bool] = None,
+                 ring_events: int = RING_EVENTS):
+        if enabled is None:
+            enabled = os.environ.get("NOMAD_TPU_TRACE", "1") != "0"
+        self.enabled = bool(enabled)
+        self.ring_events = ring_events
+        # subsystem -> deque of (t, thread, event, fields); dict writes
+        # race only on first touch of a new subsystem, guarded below
+        self._rings: Dict[str, deque] = {}
+        self._create_lock = threading.Lock()
+
+    def set_enabled(self, enabled: bool) -> None:
+        self.enabled = bool(enabled)
+
+    def record(self, subsystem: str, event: str, **fields) -> None:
+        if not self.enabled:
+            return
+        ring = self._rings.get(subsystem)
+        if ring is None:
+            with self._create_lock:
+                ring = self._rings.setdefault(
+                    subsystem, deque(maxlen=self.ring_events))
+        ring.append((time.time(), threading.current_thread().name,
+                     event, fields))
+
+    def events(self, subsystem: Optional[str] = None) -> List[tuple]:
+        """Merged (t, subsystem, thread, event, fields) records, oldest
+        first. deque snapshots are GIL-atomic; no writer is blocked."""
+        with self._create_lock:
+            items = [(name, list(ring))
+                     for name, ring in self._rings.items()
+                     if subsystem is None or name == subsystem]
+        out = [(t, name, thread, event, fields)
+               for name, recs in items
+               for (t, thread, event, fields) in recs]
+        out.sort(key=lambda r: r[0])
+        return out
+
+    def dump_text(self, last: int = 80) -> str:
+        """The causal timeline a human reads after an invariant failure:
+        the merged tail, one line per event, relative timestamps."""
+        evs = self.events()[-last:]
+        if not evs:
+            return ""
+        t0 = evs[0][0]
+        lines = []
+        for t, subsystem, thread, event, fields in evs:
+            kv = " ".join(f"{k}={v}" for k, v in fields.items())
+            lines.append(f"+{t - t0:9.4f}s [{subsystem:<7}] {event:<18} "
+                         f"{kv}  ({thread})")
+        return "\n".join(lines)
+
+    def clear(self) -> None:
+        with self._create_lock:
+            self._rings.clear()
+
+
+RECORDER = FlightRecorder()

@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..obs import NULL_SPAN, RECORDER, TRACER
 from .log import Entry, RaftLog
 
 log = logging.getLogger("nomad_tpu.raft")
@@ -371,9 +372,11 @@ class RaftNode:
                     p.index = last_index + 1 + i
                     self._waiters[p.index] = p
             try:
-                entries = self.log.append_batch(
-                    term, [p.command for p in batch],
-                    prev=(last_index, last_term))
+                # the group-commit fsync: one durable write per batch
+                with TRACER.span("raft.fsync", n=len(batch)):
+                    entries = self.log.append_batch(
+                        term, [p.command for p in batch],
+                        prev=(last_index, last_term))
             except OSError as e:
                 # disk fault: the log rolled the whole batch back;
                 # surface the error to every caller in it
@@ -717,6 +720,8 @@ class RaftNode:
     def _become_follower_locked(self, term: int) -> None:
         was_leader = self.state == LEADER
         self.state = FOLLOWER
+        RECORDER.record("raft", "follower", node=self.id, term=term,
+                        was_leader=was_leader)
         # Vote safety: voted_for is per-term state, so it only resets when
         # the term advances. A same-term step-down (e.g. a candidate seeing
         # the elected leader's heartbeat) must keep its recorded vote, or it
@@ -741,6 +746,8 @@ class RaftNode:
     def _become_leader_locked(self) -> None:
         self.state = LEADER
         self.leader_id = self.id
+        RECORDER.record("raft", "leader", node=self.id,
+                        term=self.current_term)
         last_index, _ = self.log.last()
         now = time.time()
         for p in self.peers:
@@ -774,6 +781,7 @@ class RaftNode:
             term = self.current_term
             self._deadline = self._new_deadline()
             last_index, last_term = self.log.last()
+            RECORDER.record("raft", "candidate", node=self.id, term=term)
         votes = 1
         for p in self.peers:
             reply = self.transport.send(self.id, p, {
@@ -886,13 +894,18 @@ class RaftNode:
             window = self.max_append_entries if self.batch else 64
             entries = self.log.slice_from(next_idx, window)
             commit = self.commit_index
-        reply = self.transport.send(self.id, peer, {
-            "kind": "append_entries", "term": term, "leader": self.id,
-            "prev_log_index": prev_index, "prev_log_term": prev_term,
-            "entries": [{"index": e.index, "term": e.term, "command": e.command}
-                        for e in entries],
-            "leader_commit": commit,
-        })
+        # span only when entries ship — idle heartbeats would drown the
+        # trace in zero-payload sends
+        ctx = (TRACER.span("raft.replicate", peer=peer, n=len(entries))
+               if entries else NULL_SPAN)
+        with ctx:
+            reply = self.transport.send(self.id, peer, {
+                "kind": "append_entries", "term": term, "leader": self.id,
+                "prev_log_index": prev_index, "prev_log_term": prev_term,
+                "entries": [{"index": e.index, "term": e.term,
+                             "command": e.command} for e in entries],
+                "leader_commit": commit,
+            })
         with self._lock:
             if reply is None:
                 # unreachable: retry at heartbeat cadence, not hot-loop
@@ -1027,25 +1040,29 @@ class RaftNode:
             end = min(self.commit_index, start + APPLY_CHUNK - 1)
             if start > end:
                 return False
-            for idx in range(start, end + 1):
-                entry = self.log.get(idx)
-                if entry is None:
-                    break  # compacted/leapfrogged: recompute next round
-                if tuple(entry.command)[:1] in (("noop",), ("config",)):
-                    result = None  # raft-internal entries, not FSM ops
-                else:
-                    try:
-                        result = self.fsm_apply(tuple(entry.command))
-                    except Exception as e:
-                        result = e
-                self.last_applied = idx
-                waiter = self._waiters.get(idx)
-                if waiter is not None and waiter.command is entry.command:
-                    # identity check: a registration that lost the
-                    # append CAS must not swallow another entry's result
-                    del self._waiters[idx]
-                    waiter.result = result
-                    waiter.done.set()
+            with TRACER.span("raft.apply", n=end - start + 1,
+                             node=self.id):
+                for idx in range(start, end + 1):
+                    entry = self.log.get(idx)
+                    if entry is None:
+                        break  # compacted/leapfrogged: recompute next round
+                    if tuple(entry.command)[:1] in (("noop",), ("config",)):
+                        result = None  # raft-internal entries, not FSM ops
+                    else:
+                        try:
+                            result = self.fsm_apply(tuple(entry.command))
+                        except Exception as e:
+                            result = e
+                    self.last_applied = idx
+                    waiter = self._waiters.get(idx)
+                    if waiter is not None \
+                            and waiter.command is entry.command:
+                        # identity check: a registration that lost the
+                        # append CAS must not swallow another entry's
+                        # result
+                        del self._waiters[idx]
+                        waiter.result = result
+                        waiter.done.set()
             progressed = self.last_applied >= start
             self._apply_cond.notify_all()
         return progressed

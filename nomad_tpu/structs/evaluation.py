@@ -47,6 +47,16 @@ class Evaluation:
     create_time: float = 0.0
     modify_time: float = 0.0
     leader_ack: str = ""                         # broker delivery token
+    # Lifecycle trace id (nomad_tpu/obs): stamped at creation when a
+    # caller wants related evals (follow-ups, blocked retries) to share
+    # one trace; empty means "this eval is its own root trace". Never
+    # mutated after the eval reaches the store — evals are shared with
+    # MVCC snapshots and replicated FSM state.
+    trace_id: str = ""
+
+    def trace(self) -> str:
+        """The obs trace id covering this eval's lifecycle spans."""
+        return self.trace_id or self.id
 
     def terminal_status(self) -> bool:
         return self.status in (

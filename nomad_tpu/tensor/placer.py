@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import TRACER
 from ..structs import Job, Node, enums
 from ..scheduler.context import EvalContext
 from ..scheduler.rank import NodeScorer, RankedNode, select_best_node
@@ -131,7 +132,8 @@ class TPUPlacer:
         # (optimistic-concurrency livelock). The permutation rides INTO
         # the kernel so the host-side node order stays canonical and the
         # per-node arrays stay cacheable across evals (ClusterStatic).
-        cluster = ClusterTensors.build(ctx, nodes)
+        with TRACER.span("worker.tensor_build", n=len(nodes)):
+            cluster = ClusterTensors.build(ctx, nodes)
         nodes = cluster.nodes
         # crc32, not hash(): the seed must be deterministic ACROSS
         # processes (leader failover replaying an eval must explore the
@@ -167,11 +169,13 @@ class TPUPlacer:
                                                algorithm=self.algorithm)
                 if (self._bulk_shape_ok(ctx, tg, tgt)
                         and getattr(commit, "commit_block", None) is not None):
-                    self._place_bulk_columnar(
-                        ctx, job, tg, bulk, cluster, tgt, commit, seed,
-                        sched_batch=batch,
-                        preemption_enabled=preemption_enabled,
-                        attempt=attempt)
+                    with TRACER.span("worker.solve_bulk", k=bulk.count,
+                                     columnar=True):
+                        self._place_bulk_columnar(
+                            ctx, job, tg, bulk, cluster, tgt, commit, seed,
+                            sched_batch=batch,
+                            preemption_enabled=preemption_enabled,
+                            attempt=attempt)
                     continue
                 # group features (spread/ports/devices/...) need the
                 # per-placement machinery: expand and fall through
@@ -198,10 +202,13 @@ class TPUPlacer:
                                                  algorithm=self.algorithm))
 
             if self._bulk_eligible(ctx, tg, reqs, tgt):
-                self._place_bulk(ctx, job, tg, reqs, cluster, tgt, commit,
-                                 tie_perm, seed, sched_batch=batch,
-                                 preemption_enabled=preemption_enabled,
-                                 attempt=attempt)
+                with TRACER.span("worker.solve_bulk", k=len(reqs),
+                                 columnar=False):
+                    self._place_bulk(ctx, job, tg, reqs, cluster, tgt,
+                                     commit, tie_perm, seed,
+                                     sched_batch=batch,
+                                     preemption_enabled=preemption_enabled,
+                                     attempt=attempt)
                 continue
 
             k = len(reqs)
@@ -226,7 +233,10 @@ class TPUPlacer:
             # each other like the bulk path's carry provides for free.
             from .overlay import INFLIGHT
 
-            with _PER_EVAL_SOLVE_LOCK:
+            # the span covers the lock wait too: serialization behind
+            # racing workers is exactly the stall the trace should show
+            with TRACER.span("worker.solve", k=len(reqs)), \
+                    _PER_EVAL_SOLVE_LOCK:
                 cluster.refresh_usage(ctx)
                 # device/core count columns extend the dense dims
                 has_extra = tgt.extra_ask is not None and len(tgt.extra_ask)
@@ -565,6 +575,15 @@ class TPUPlacer:
     def _preempt_batch(self, ctx, job, tg, reqs, cluster, tgt, commit, *,
                        sched_batch: bool, attempt: int, n_feasible: int,
                        invalidate=None) -> None:
+        with TRACER.span("worker.preempt", k=len(reqs)):
+            self._preempt_batch_inner(
+                ctx, job, tg, reqs, cluster, tgt, commit,
+                sched_batch=sched_batch, attempt=attempt,
+                n_feasible=n_feasible, invalidate=invalidate)
+
+    def _preempt_batch_inner(self, ctx, job, tg, reqs, cluster, tgt,
+                             commit, *, sched_batch: bool, attempt: int,
+                             n_feasible: int, invalidate=None) -> None:
         """Preemption for K unplaced requests as ONE device pass + K
         single-node host victim selections, replacing the per-request
         full-cluster host scan (the round-3 fallback that ran cfg4 at

@@ -52,6 +52,9 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..core.metrics import REGISTRY
+from ..obs import RECORDER, TRACER
+
 _STOP = object()
 
 
@@ -295,7 +298,12 @@ class BulkSolverService:
             # member whose settle it observed but whose request it
             # didn't — never the reverse
             _settle_current_member()
-        return req.future.result(), req.token
+        # runs on the worker thread inside the eval's trace bind, so
+        # the wait (queue + rendezvous + device launch) lands on the
+        # eval's own span chain
+        with TRACER.span("solver.wait", k=int(k), joint=bool(joint)):
+            result = req.future.result()
+        return result, req.token
 
     def confirm(self, token: int, rejected_node_ids) -> None:
         """Plan outcome for one solve: close its ledger entry; queue
@@ -555,6 +563,14 @@ class BulkSolverService:
             counts_np = np.asarray(counts)  # ONE readback for the batch
         self._state = (static, new_used, since + g)
         born = _time.time()
+        # trace-less batch span (the service thread serves many evals at
+        # once); chain gap-attribution picks it up by time overlap, like
+        # the raft spans
+        TRACER.add_span("solver.launch", born - (_time.perf_counter() - t0),
+                        born, g=g, joint=bool(joint),
+                        sharded=mesh is not None)
+        RECORDER.record("solver", "launch", g=g, joint=bool(joint),
+                        sharded=mesh is not None, resync=need_resync)
         with self._lock:
             # counters share self._lock with the ledger: solve()/confirm()
             # mutate stats from API threads under the same lock
@@ -578,6 +594,18 @@ class BulkSolverService:
                 r.token = self._token
                 self._ledger[r.token] = _LedgerEntry(
                     static, idx, row[idx].astype(np.int64), r.ask, born)
+        # mirror the service stats into the Registry so /v1/metrics and
+        # bench dumps carry them without reaching into the singleton
+        # (REGISTRY is a leaf lock — taken after self._lock is dropped)
+        REGISTRY.incr("nomad.solver.launches")
+        REGISTRY.incr("nomad.solver.solves", g)
+        if info_np is not None:
+            REGISTRY.incr("nomad.solver.auction_won",
+                          int(info_np[5] > 0.5))
+            REGISTRY.incr("nomad.solver.auction_rounds", int(info_np[4]))
+            REGISTRY.incr("nomad.solver.joint_score", float(
+                info_np[0] if info_np[5] > 0.5 else info_np[1]))
+            REGISTRY.incr("nomad.solver.greedy_score", float(info_np[1]))
         for i, r in enumerate(rs):
             r.future.set_result(counts_np[i].astype(np.int64))
 

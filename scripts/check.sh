@@ -10,15 +10,21 @@
 #                                   smoke (batched workers under
 #                                   tpu-solve: joint launch reached,
 #                                   score dominance, alloc uniqueness)
+#   scripts/check.sh --trace-smoke  also run the nomadtrace smoke (live
+#                                   cluster with tracing on: complete
+#                                   enqueue->commit span chain for
+#                                   every eval; kill switch span-free)
 set -u
 cd "$(dirname "$0")/.."
 
 run_e2e_smoke=0
 run_solve_smoke=0
+run_trace_smoke=0
 for arg in "$@"; do
     case "$arg" in
         --e2e-smoke) run_e2e_smoke=1 ;;
         --solve-smoke) run_solve_smoke=1 ;;
+        --trace-smoke) run_trace_smoke=1 ;;
         *) echo "unknown flag: $arg" >&2; exit 64 ;;
     esac
 done
@@ -91,6 +97,17 @@ if [ "$run_solve_smoke" = 1 ]; then
     echo "== solve smoke (python -m nomad_tpu.chaos --solve-smoke) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 300 \
         python -m nomad_tpu.chaos --solve-smoke || failed=1
+fi
+
+# nomadtrace smoke (opt-in, ~15s): a live 3-node cluster with tracing
+# on — every committed eval must show a complete enqueue->commit span
+# chain (raft fsync/apply spans present for gap attribution), and the
+# same workload with the kill switch thrown must record zero spans
+# (OBSERVABILITY.md)
+if [ "$run_trace_smoke" = 1 ]; then
+    echo "== trace smoke (python -m nomad_tpu.obs --trace-smoke) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 300 \
+        python -m nomad_tpu.obs --trace-smoke || failed=1
 fi
 
 echo "== tier-1 tests =="
