@@ -39,6 +39,9 @@ def main(argv=None) -> int:
                              "(Thread/Timer/executor-submit site) and exit")
     parser.add_argument("--root", type=Path, default=None,
                         help="root for relative paths (default: repo root)")
+    parser.add_argument("--ownership", action="store_true",
+                        help="run only the nomadown ownership/aliasing "
+                             "rules (see ANALYSIS.md)")
     parser.add_argument("--modelcheck", action="store_true",
                         help="run the deterministic interleaving model "
                              "checker (nomadcheck dynamic prong) and exit")
@@ -47,6 +50,10 @@ def main(argv=None) -> int:
                              "--modelcheck (default 3); base seed comes "
                              "from NOMAD_TPU_CHECK_SEED")
     args = parser.parse_args(argv)
+
+    if args.ownership:
+        from .rules_ownership import OWNERSHIP_RULES
+        args.rules = list(OWNERSHIP_RULES)
 
     if args.modelcheck:
         from .modelcheck import seed_from_env, smoke

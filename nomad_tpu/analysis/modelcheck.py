@@ -894,8 +894,9 @@ def _preload() -> None:
 
     from ..chaos import fsfaults, invariants  # noqa: F401
     from ..core import broker, plan_apply  # noqa: F401
-    from ..raft import durable, node, transport  # noqa: F401
+    from ..raft import durable, fsm, node, transport  # noqa: F401
     from ..structs import evaluation  # noqa: F401
+    from . import ownership  # noqa: F401
     assert concurrent.futures.ThreadPoolExecutor is not None
 
 
@@ -1425,8 +1426,76 @@ def _scenario_solve_batch(env: ScenarioEnv) -> None:
             f"{sorted(leaked)}")
 
 
+@scenario("store_ownership")
+def _scenario_store_ownership(env: ScenarioEnv) -> None:
+    """nomadown integration: a proposer replicates eval upserts through
+    FSM.apply and keeps mutating its own retained objects afterwards —
+    legal ONLY because the FSM deep-copies every command before handing
+    it to the store — while readers race snapshots and iteration
+    against the writes. The ownership sanitizer must stay silent.
+
+    tests/test_ownership.py replays this scenario at a pinned seed with
+    the FSM's defensive deepcopy monkeypatched away: the store then
+    shares the proposer's objects, the post-apply mutations rewrite
+    MVCC history, and the same seed MUST fail — the historical
+    propose-retain-alias bug, reproduced deterministically."""
+    from ..raft.fsm import FSM
+    from ..state.store import StateStore
+    from ..structs.evaluation import Evaluation
+    from . import ownership
+
+    own = ownership.GLOBAL
+    was_active = own.active
+    if not was_active:
+        ownership.install()
+    base = len(own.violations)
+    store = StateStore()
+    fsm = FSM(store)
+    try:
+        def propose() -> None:
+            for i in range(4):
+                ev = Evaluation(id=f"own-e{i}", job_id=f"own-j{i}",
+                                status="pending")
+                fsm.apply(("upsert_evals", ([ev],), {"ts": float(i + 1)}))
+                # the proposer's object is private — the FSM deep-copied
+                # the command — so this must NOT trip the sanitizer
+                ev.status = "complete"
+                ev.modify_index = 999 + i
+
+        def read(name: str) -> None:
+            for _ in range(6):
+                snap = store.snapshot()
+                for ev in snap.evals():
+                    if ev.status != "pending":
+                        raise AssertionError(
+                            f"{name} saw a store row mutated after "
+                            f"insert: {ev.id} status={ev.status!r}")
+                time.sleep(0)
+
+        p = threading.Thread(target=propose, name="own-proposer")
+        r1 = threading.Thread(target=read, args=("r1",),
+                              name="own-reader-1")
+        r2 = threading.Thread(target=read, args=("r2",),
+                              name="own-reader-2")
+        p.start()
+        r1.start()
+        r2.start()
+        p.join()
+        r1.join()
+        r2.join()
+        own.verify_all()
+        fresh = own.violations[base:]
+        if fresh:
+            raise AssertionError(
+                "ownership sanitizer tripped: " + fresh[0].render())
+    finally:
+        del own.violations[base:]
+        if not was_active:
+            ownership.uninstall()
+
+
 SMOKE_SCENARIOS = ("raft_commit", "raft_stepdown", "plan_pipeline",
-                   "broker_batch", "solve_batch")
+                   "broker_batch", "solve_batch", "store_ownership")
 
 
 def smoke(base_seed: int, seeds_per_scenario: int = 3,

@@ -1,0 +1,434 @@
+"""nomadown runtime prong: snapshot-integrity fingerprints for owned structs.
+
+The static rules (rules_ownership.py) reason about names; this module
+watches the real objects. The control plane's correctness rests on a
+copy-on-write convention (state/store.py module docstring): every struct
+handed to the state store or proposed into the raft log becomes shared,
+immutable MVCC history, readable by any snapshot forever after. Nothing
+enforces that at runtime — an aliased mutation silently rewrites
+history for every live snapshot and, through the FSM, can diverge
+replicas (the PR-3 bug class).
+
+Enabled via ``NOMAD_TPU_SAN=1`` (tests/conftest.py calls :func:`install`
+alongside the nomadsan lock sanitizer), this module:
+
+- registers every ``nomad_tpu.structs`` dataclass the moment it enters a
+  ``VersionedTable`` (mvcc.py ``put``) or a commit event batch,
+  recording a *fingerprint* — a stable hash over the dataclass fields,
+  recursing through containers, nested dataclasses and numpy arrays;
+- patches ``__setattr__`` on every struct dataclass (a tracking proxy)
+  so an attribute write to a registered object is reported *at the
+  mutating site*, with one sanctioned exception: writes made while the
+  owning thread is inside the store's ``_begin``/``_commit`` window are
+  the store stamping its own rows (create_index/modify_index/...) and
+  only mark the entry for re-fingerprinting at commit;
+- re-verifies fingerprints on every snapshot read (mvcc ``get`` /
+  ``iterate``) and on event publish, throttled to once per object per
+  commit epoch, which catches *interior* container mutation
+  (``ev.queued_allocations[k] = v``) that no ``__setattr__`` proxy can
+  see;
+- exposes :func:`verify_all` for the chaos ``InvariantChecker`` and the
+  modelcheck ``store_ownership`` scenario, so a schedule that mutates
+  post-insert fails deterministically with a replayable seed.
+
+Known limits (documented, deliberate):
+
+- interior mutations are only caught at the next read/publish/sweep
+  after the next commit (the per-epoch throttle keeps snapshot walks
+  from re-hashing every row), and their mutating site is unknown —
+  attribute-level writes are the precise ones;
+- the registry holds strong references (slots dataclasses are not
+  weakref-able) bounded to the most recent ``_MAX_TRACKED`` rows, so a
+  mutation of a long-evicted row can be missed;
+- fingerprints hash ``repr``-sorted sets and insertion-ordered dicts;
+  they are compared only within one process, never persisted.
+
+Violations never raise at the access site; they accumulate in
+``OwnershipSanitizer.violations`` and the pytest plugin fails the run at
+session end (exit code 3), same contract as nomadsan.
+"""
+
+from __future__ import annotations
+
+import _thread
+import dataclasses
+import sys
+import threading
+import traceback
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+_REAL_LOCK = _thread.allocate_lock
+
+# Frames inside these files are never a useful "who did it" answer.
+_SKIP_FILES = (__file__, "mvcc.py", "threading.py")
+
+# Top-level row types: cross-references between rows (Allocation.job,
+# Evaluation payloads inside plans, ...) canonicalize as a shallow ref —
+# the referenced row is fingerprinted under its own registry entry, and
+# recursing would make one row's hash depend on another row's sanctioned
+# in-txn restamping.
+_ROW_TYPES = frozenset({
+    "Job", "Node", "Allocation", "AllocBlock", "Evaluation",
+    "Deployment", "Volume", "ServiceRegistration",
+})
+
+_MAX_TRACKED = 8192        # strong-ref registry bound (newest rows win)
+_MAX_DEPTH = 8             # canonicalization recursion cap
+_STRUCTS_PREFIX = "nomad_tpu.structs"
+
+
+def _call_site(extra_skip: int = 0) -> str:
+    """file:line of the nearest frame outside ownership/mvcc/threading."""
+    f = sys._getframe(2 + extra_skip)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.endswith(_SKIP_FILES):
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+# -- fingerprinting ------------------------------------------------------
+
+
+def _canon(obj: Any, depth: int, seen: set) -> Any:
+    """Hashable canonical form of a struct value. Deterministic within a
+    process; mutation of any reachable field/element changes it."""
+    if obj is None or obj is True or obj is False:
+        return obj
+    t = type(obj)
+    if t is int or t is float or t is str or t is bytes:
+        return obj
+    if depth >= _MAX_DEPTH:
+        return ("<deep>", t.__qualname__)
+    oid = id(obj)
+    if oid in seen:
+        return "<cycle>"
+    seen.add(oid)
+    try:
+        if dataclasses.is_dataclass(obj):
+            if depth > 0 and t.__qualname__ in _ROW_TYPES:
+                return ("ref", t.__qualname__, getattr(obj, "id", ""))
+            exempt = getattr(t, "_nomadown_exempt", ())
+            # leading-underscore fields are derived caches by repo
+            # convention (Node._avail_vec), not replicated state
+            return (t.__qualname__,) + tuple(
+                _canon(getattr(obj, f.name), depth + 1, seen)
+                for f in dataclasses.fields(obj)
+                if not f.name.startswith("_") and f.name not in exempt)
+        if t is list or t is tuple:
+            return ("L",) + tuple(_canon(x, depth + 1, seen) for x in obj)
+        if t is dict:
+            return ("D",) + tuple(
+                (_canon(k, depth + 1, seen), _canon(v, depth + 1, seen))
+                for k, v in obj.items())
+        if t is set or t is frozenset:
+            return ("S",) + tuple(
+                sorted(repr(_canon(x, depth + 1, seen)) for x in obj))
+        if isinstance(obj, np.ndarray):
+            return ("A", obj.shape, str(obj.dtype), obj.tobytes())
+        if isinstance(obj, np.generic):
+            return obj.item()
+        d = getattr(obj, "__dict__", None)
+        if d is not None:
+            return ("O", t.__qualname__) + tuple(
+                (k, _canon(v, depth + 1, seen)) for k, v in sorted(d.items()))
+        slots = getattr(t, "__slots__", None)
+        if slots is not None:
+            return ("O", t.__qualname__) + tuple(
+                (s, _canon(getattr(obj, s, None), depth + 1, seen))
+                for s in slots)
+        return ("X", t.__qualname__)
+    finally:
+        seen.discard(oid)
+
+
+def fingerprint(obj: Any) -> int:
+    """Stable (per-process) hash over a struct's fields, recursive."""
+    return hash(_canon(obj, 0, set()))
+
+
+def _is_struct(obj: Any) -> bool:
+    t = type(obj)
+    return (dataclasses.is_dataclass(obj)
+            and t.__module__.startswith(_STRUCTS_PREFIX))
+
+
+def _each_struct(payload: Any) -> Iterator[Any]:
+    """Structs inside an event payload: the payload itself, or one level
+    of list/tuple (batched eval/alloc events)."""
+    if _is_struct(payload):
+        yield payload
+    elif type(payload) in (list, tuple):
+        for item in payload:
+            if _is_struct(item):
+                yield item
+
+
+@dataclass
+class Violation:
+    kind: str            # "post-insert-mutation" | "snapshot-divergence"
+    message: str
+    stack: List[str] = field(default_factory=list)
+
+    def render(self) -> str:
+        return f"[{self.kind}] {self.message}"
+
+
+class OwnershipSanitizer:
+    """One fingerprint registry + tracking proxy. The module-level GLOBAL
+    instance is what install()/the store hooks feed; tests snapshot and
+    truncate its violation list around intentional triggers."""
+
+    def __init__(self):
+        self.active = False
+        # raw lock: an instrumented one would recurse through nomadsan
+        self._ilock = _REAL_LOCK()
+        self._tls = threading.local()
+        # id(obj) -> {"obj", "fp", "gen", "site", "epoch"}; strong refs,
+        # LRU-bounded (slots dataclasses cannot be weakly referenced)
+        self._tracked: "OrderedDict[int, Dict[str, Any]]" = OrderedDict()
+        self.violations: List[Violation] = []
+        self._epoch = 0
+        self._patched = False
+
+    # -- install / teardown -------------------------------------------
+
+    def install(self) -> None:
+        """Arm the registry and patch struct ``__setattr__`` (once; the
+        wrappers are inert while ``active`` is False)."""
+        self._patch_struct_classes()
+        self.active = True
+
+    def uninstall(self) -> None:
+        self.active = False
+
+    def forget_all(self) -> None:
+        """Drop every tracked entry (test isolation helper)."""
+        with self._ilock:
+            self._tracked.clear()
+
+    def _patch_struct_classes(self) -> None:
+        if self._patched:
+            return
+        self._patched = True
+        import importlib
+        import pkgutil
+
+        import nomad_tpu.structs as structs_pkg
+
+        for info in pkgutil.iter_modules(structs_pkg.__path__):
+            mod = importlib.import_module(f"{_STRUCTS_PREFIX}.{info.name}")
+            for cls in vars(mod).values():
+                if not (isinstance(cls, type) and dataclasses.is_dataclass(cls)):
+                    continue
+                if cls.__module__ != mod.__name__:
+                    continue        # re-export; patched where defined
+                if cls.__dataclass_params__.frozen:
+                    continue        # frozen structs cannot be mutated
+                if getattr(cls, "_nomadown_wrapped", False):
+                    continue        # self or a base already routes here
+                self._wrap_class(cls)
+
+    def _wrap_class(self, cls: type) -> None:
+        orig = cls.__setattr__
+        san = self
+
+        def __setattr__(obj, name, value):
+            if san.active:
+                san._on_setattr(obj, name, value)
+            orig(obj, name, value)
+
+        cls.__setattr__ = __setattr__
+        cls._nomadown_wrapped = True
+
+    # -- store txn window ----------------------------------------------
+
+    def _depth(self) -> int:
+        return getattr(self._tls, "depth", 0)
+
+    def txn_begin(self) -> None:
+        """StateStore._begin: writes by this thread until txn_commit are
+        the store stamping its own rows, not aliasing bugs."""
+        self._tls.depth = self._depth() + 1
+
+    def txn_commit(self, gen: int, events: list) -> None:
+        """StateStore._commit: re-fingerprint rows the store restamped,
+        register event payload structs, close the window, bump the
+        verify epoch."""
+        dirty = getattr(self._tls, "dirty", None)
+        if dirty:
+            with self._ilock:
+                for oid in dirty:
+                    entry = self._tracked.get(oid)
+                    if entry is not None:
+                        try:
+                            entry["fp"] = fingerprint(entry["obj"])
+                        except Exception:
+                            self._tracked.pop(oid, None)
+                            continue
+                        entry["gen"] = gen
+            dirty.clear()
+        for _kind, payload in events:
+            for obj in _each_struct(payload):
+                self.register(obj, gen)
+        self._tls.depth = max(self._depth() - 1, 0)
+        self._epoch += 1
+
+    # -- registry ------------------------------------------------------
+
+    def register(self, obj: Any, gen: int) -> None:
+        """Fingerprint and track a struct that just became shared
+        history. Called from mvcc put (table rows) and txn_commit (event
+        payloads); no-op for non-struct values."""
+        if not self.active or not _is_struct(obj):
+            return
+        try:
+            fp = fingerprint(obj)
+        except Exception:
+            return
+        oid = id(obj)
+        site = _call_site()
+        with self._ilock:
+            self._tracked[oid] = {
+                "obj": obj, "fp": fp, "gen": gen, "site": site,
+                "epoch": self._epoch,
+            }
+            self._tracked.move_to_end(oid)
+            while len(self._tracked) > _MAX_TRACKED:
+                self._tracked.popitem(last=False)
+
+    def tracked_count(self) -> int:
+        with self._ilock:
+            return len(self._tracked)
+
+    def is_tracked(self, obj: Any) -> bool:
+        entry = self._tracked.get(id(obj))
+        return entry is not None and entry["obj"] is obj
+
+    # -- tracking proxy callback ---------------------------------------
+
+    def _on_setattr(self, obj: Any, name: str, value: Any) -> None:
+        entry = self._tracked.get(id(obj))
+        if entry is None or entry["obj"] is not obj:
+            return
+        if name.startswith("_") or name in getattr(obj, "_nomadown_exempt", ()):
+            return      # derived caches, not replicated state
+        try:
+            old = getattr(obj, name)
+        except AttributeError:
+            old = entry     # sentinel: never equal to a field value
+        if old is value or (type(old) is type(value)
+                            and isinstance(old, (bool, int, float, str, bytes))
+                            and old == value):
+            return      # no-op rebind: the fingerprint cannot change
+        if self._depth() > 0:
+            dirty = getattr(self._tls, "dirty", None)
+            if dirty is None:
+                dirty = self._tls.dirty = set()
+            dirty.add(id(obj))
+            return
+        self._report_mutation(entry, obj, name)
+
+    def _report_mutation(self, entry: Dict[str, Any], obj: Any,
+                         name: str) -> None:
+        site = _call_site()
+        ident = getattr(obj, "id", "") or ""
+        with self._ilock:
+            self._tracked.pop(id(obj), None)
+        self.violations.append(Violation(
+            "post-insert-mutation",
+            f"{type(obj).__name__}{f'({ident})' if ident else ''}.{name} "
+            f"written at {site} after the object entered the store at "
+            f"{entry['site']} (gen {entry['gen']}) — committed rows are "
+            "shared MVCC history; copy before mutating",
+            stack=traceback.format_stack()[:-3]))
+
+    # -- verification --------------------------------------------------
+
+    def verify(self, obj: Any, gen: Optional[int] = None) -> None:
+        """Snapshot-read / publish hook: recheck the fingerprint, at most
+        once per object per commit epoch (interior-mutation detection)."""
+        entry = self._tracked.get(id(obj))
+        if entry is None or entry["obj"] is not obj:
+            return
+        if entry["epoch"] == self._epoch:
+            return
+        entry["epoch"] = self._epoch
+        self._check_entry(entry, obj)
+
+    def verify_all(self) -> int:
+        """Full unthrottled sweep; returns the number of new violations.
+        Used by the chaos InvariantChecker and modelcheck scenarios."""
+        before = len(self.violations)
+        with self._ilock:
+            entries = list(self._tracked.values())
+        for entry in entries:
+            self._check_entry(entry, entry["obj"])
+        return len(self.violations) - before
+
+    def _check_entry(self, entry: Dict[str, Any], obj: Any) -> None:
+        try:
+            fp = fingerprint(obj)
+        except Exception:
+            return
+        if fp == entry["fp"]:
+            return
+        ident = getattr(obj, "id", "") or ""
+        with self._ilock:
+            self._tracked.pop(id(obj), None)
+        self.violations.append(Violation(
+            "snapshot-divergence",
+            f"{type(obj).__name__}{f'({ident})' if ident else ''} diverged "
+            f"from its insert-time fingerprint (entered the store at "
+            f"{entry['site']}, gen {entry['gen']}) — interior container "
+            "mutation; attribute-level writes are reported at the "
+            "mutating site",
+            stack=traceback.format_stack()[:-3]))
+
+    # -- reporting -----------------------------------------------------
+
+    def check(self) -> None:
+        if self.violations:
+            raise AssertionError(
+                "nomadown violations:\n"
+                + "\n".join(v.render() for v in self.violations))
+
+    def report(self) -> str:
+        lines = [f"nomadown: {len(self.violations)} violation(s)"]
+        for v in self.violations:
+            lines.append("  " + v.render())
+        return "\n".join(lines)
+
+
+# -- module-level surface (what store/mvcc/events/conftest import) -------
+
+GLOBAL = OwnershipSanitizer()
+
+
+def install() -> None:
+    GLOBAL.install()
+
+
+def uninstall() -> None:
+    GLOBAL.uninstall()
+
+
+def enabled() -> bool:
+    return GLOBAL.active
+
+
+def violations() -> List[Violation]:
+    return list(GLOBAL.violations)
+
+
+def check() -> None:
+    GLOBAL.check()
+
+
+def verify_all() -> int:
+    return GLOBAL.verify_all()

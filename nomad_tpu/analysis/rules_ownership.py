@@ -1,0 +1,685 @@
+"""nomadown static prong: ownership/aliasing rules for owned structs.
+
+The control plane's copy-on-write discipline (state/store.py module
+docstring) says a struct handed to the state store or proposed into the
+raft log becomes shared immutable history. These rules encode that
+discipline as an interprocedural escape-and-mutation analysis over the
+callgraph.py machinery:
+
+- ``store-escape-mutation``: an object passed to a StateStore
+  ``upsert_*``/``_put_*`` sink or a raft ``propose``/``apply`` sink is
+  attribute-mutated afterwards in the same function — directly, or by
+  being passed to a callee whose (transitively computed) summary says
+  it mutates that parameter.
+- ``read-mutate-no-copy``: the interprocedural complement of the
+  intra-procedural ``shared-struct-mutation`` rule (rules_hygiene.py) —
+  a local bound from a store getter/snapshot iterator is handed to a
+  mutating callee, container-mutated (``ev.tags.append``), or
+  key-assigned, without an intervening copy/rebind. Direct attribute
+  assignments stay with the hygiene rule so a site is never flagged
+  twice.
+- ``propose-retain-alias``: a method proposes an object into the raft
+  log AND retains it (``self.pending[id] = ev``); any method of the
+  same class that pulls from that attribute and mutates the result is
+  mutating replicated log history through the retained alias.
+- ``publish-after-mutate``: a struct already appended to a commit-event
+  batch (the list handed to ``_commit``/``publish``) is mutated before
+  the batch is published — the event ring holds payloads by reference,
+  so subscribers would see the post-mutation state attributed to the
+  pre-mutation index.
+
+Mutation summaries are a fixpoint: a function mutates parameter ``p``
+if it attribute-mutates ``p`` (or an element alias of ``p`` bound by a
+``for``/subscript), or passes ``p`` to any resolution candidate that
+mutates the matching parameter. Resolution inherits callgraph.py's
+deliberate over-approximation; findings are fixed in-code per repo
+tradition (baseline.json stays empty) or suppressed with an inline
+``# san-ok: <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import CallGraph, FuncInfo
+from .core import AnalysisContext, Finding, Module, in_scope, rule
+from .rules_concurrency import MUTATORS, _analysis_scope, _suppressed
+from .rules_hygiene import _read_call, _target_names
+
+OWNERSHIP_RULES = ("store-escape-mutation", "read-mutate-no-copy",
+                   "propose-retain-alias", "publish-after-mutate")
+
+# Where owned structs actually flow. mock.py/testing.py build fixtures
+# that land in stores, so they are part of the discipline.
+OWNERSHIP_SCOPE = ("core", "raft", "state", "scheduler", "client", "chaos",
+                   "obs", "api", "tensor", "mock.py", "testing.py")
+PUBLISH_SCOPE = ("state", "core", "raft")
+RETAIN_SCOPE = ("core", "raft", "scheduler", "state")
+
+RAFT_VERBS = {"propose", "propose_async"}
+APPLY_VERBS = {"apply", "apply_async"}
+RAFTISH_TOKENS = ("raft", "fsm")
+EVENT_SINK_NAMES = {"_commit", "publish", "_on_commit"}
+_MAX_ARG_DEPTH = 4
+
+
+def _store_sink_name(call: ast.Call) -> Optional[str]:
+    func = call.func
+    name = None
+    if isinstance(func, ast.Attribute):
+        name = func.attr
+    elif isinstance(func, ast.Name):
+        name = func.id
+    if name and (name.startswith("upsert_") or name.startswith("_put_")):
+        return name
+    return None
+
+
+def _raft_sink_name(call: ast.Call) -> Optional[str]:
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    if func.attr in RAFT_VERBS:
+        return func.attr
+    if func.attr in APPLY_VERBS:
+        # only apply/apply_async on a raft-ish receiver (fsm.apply,
+        # self._raft.apply, node.raft.apply) — not e.g. pool.apply
+        recv, tokens = func.value, []
+        while isinstance(recv, ast.Attribute):
+            tokens.append(recv.attr)
+            recv = recv.value
+        if isinstance(recv, ast.Name):
+            tokens.append(recv.id)
+        if any(tok in t.lower() for t in tokens for tok in RAFTISH_TOKENS):
+            return func.attr
+    return None
+
+
+def _deep_names(node: ast.expr, depth: int = 0) -> Set[str]:
+    """Names reachable through display-literal nesting — the raft
+    command-tuple shape ``(op, ([ev],), {"ts": ts})`` included."""
+    out: Set[str] = set()
+    if depth > _MAX_ARG_DEPTH:
+        return out
+    if isinstance(node, ast.Name):
+        out.add(node.id)
+    elif isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        for elt in node.elts:
+            out |= _deep_names(elt, depth + 1)
+    elif isinstance(node, ast.Dict):
+        for v in node.values:
+            if v is not None:
+                out |= _deep_names(v, depth + 1)
+    elif isinstance(node, ast.Starred):
+        out |= _deep_names(node.value, depth + 1)
+    return out
+
+
+def _attr_chain(node: ast.expr) -> Tuple[Optional[str], List[str]]:
+    """(root name, attribute chain) for ``name.a.b`` — (None, []) when
+    the chain does not bottom out in a plain Name."""
+    attrs: List[str] = []
+    while isinstance(node, ast.Attribute):
+        attrs.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id, list(reversed(attrs))
+    return None, []
+
+
+def _params(fn_node: ast.AST) -> List[str]:
+    a = fn_node.args
+    names = [x.arg for x in
+             list(getattr(a, "posonlyargs", [])) + a.args + a.kwonlyargs]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+@dataclass
+class _CallRec:
+    line: int
+    kind: str                       # "self" | "name" | "attr"
+    name: str
+    pos: List[Tuple[int, str]] = field(default_factory=list)
+    kws: List[Tuple[str, str]] = field(default_factory=list)
+    elems: List[Tuple[int, str]] = field(default_factory=list)
+    is_sink: bool = False
+
+
+@dataclass
+class _FnFacts:
+    """Lineno-keyed facts about one function (closures included; loop
+    back-edges are deliberately ignored — source order only)."""
+    sinks_store: List[Tuple[int, str, Set[str]]] = field(default_factory=list)
+    sinks_raft: List[Tuple[int, str, Set[str]]] = field(default_factory=list)
+    event_appends: List[Tuple[int, Set[str]]] = field(default_factory=list)
+    # (line, root, what, via) — via in {assign, augassign, del, mcall,
+    # subscript}
+    mutations: List[Tuple[int, str, str, str]] = field(default_factory=list)
+    calls: List[_CallRec] = field(default_factory=list)
+    rebinds: Dict[str, List[int]] = field(default_factory=dict)
+    retains: List[Tuple[int, str, str]] = field(default_factory=list)
+    self_reads: List[Tuple[int, str, str]] = field(default_factory=list)
+    taints: List[Tuple[int, str]] = field(default_factory=list)
+    list_members: Dict[str, Set[str]] = field(default_factory=dict)
+    alias: Dict[str, str] = field(default_factory=dict)
+
+    def root(self, name: str) -> str:
+        seen = 0
+        while name in self.alias and seen < 2:
+            name = self.alias[name]
+            seen += 1
+        return name
+
+    def rebound_between(self, name: str, a: int, b: int) -> bool:
+        return any(a < r < b for r in self.rebinds.get(name, ()))
+
+
+def _self_read_of(value: ast.expr) -> Optional[str]:
+    """Attribute A when ``value`` reads an element out of ``self.A``
+    (subscript, .get(), .pop())."""
+    node = value
+    if isinstance(node, ast.Call):
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in ("get", "pop"):
+            node = func.value
+        else:
+            return None
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _iter_self_attr(it: ast.expr) -> Optional[str]:
+    """Attribute A when iterating ``self.A`` / ``self.A.values()`` /
+    ``self.A.items()``."""
+    node = it
+    if isinstance(node, ast.Call):
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in ("values", "items"):
+            node = func.value
+        else:
+            return None
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _record_mutation_target(facts: _FnFacts, node: ast.AST,
+                            target: ast.expr, via: str) -> None:
+    inner = target
+    sub = False
+    if isinstance(inner, ast.Subscript):
+        inner = inner.value
+        sub = True
+    if isinstance(inner, ast.Name):
+        if sub:
+            facts.mutations.append((node.lineno, inner.id, "[...]",
+                                    "subscript"))
+        return
+    root, attrs = _attr_chain(inner)
+    if root is None or root == "self" or not attrs:
+        return
+    what = ".".join(attrs) + ("[...]" if sub else "")
+    facts.mutations.append((node.lineno, root, what, via))
+
+
+def _scan_function(fn_node: ast.AST) -> _FnFacts:
+    facts = _FnFacts()
+
+    # prepass: which locals are commit-event batches (passed by name to
+    # _commit/publish)?
+    event_lists: Set[str] = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Call):
+            func = node.func
+            cname = (func.attr if isinstance(func, ast.Attribute)
+                     else func.id if isinstance(func, ast.Name) else None)
+            if cname in EVENT_SINK_NAMES:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        event_lists.add(arg.id)
+
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign):
+            value = node.value
+            for target in node.targets:
+                _record_mutation_target(facts, node, target, "assign")
+                # self.A = name retention
+                if (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                        and isinstance(value, ast.Name)):
+                    facts.retains.append((node.lineno, target.attr, value.id))
+                # self.A[k] = name retention
+                if (isinstance(target, ast.Subscript)
+                        and isinstance(target.value, ast.Attribute)
+                        and isinstance(target.value.value, ast.Name)
+                        and target.value.value.id == "self"
+                        and isinstance(value, ast.Name)):
+                    facts.retains.append((node.lineno, target.value.attr,
+                                          value.id))
+                if isinstance(target, ast.Name):
+                    name = target.id
+                    self_attr = _self_read_of(value)
+                    if self_attr is not None:
+                        facts.self_reads.append((node.lineno, name, self_attr))
+                        continue
+                    if (isinstance(value, ast.Subscript)
+                            and isinstance(value.value, ast.Name)):
+                        facts.alias[name] = value.value.id
+                        continue
+                    if _read_call(value):
+                        facts.taints.append((node.lineno, name))
+                    if isinstance(value, (ast.List, ast.Tuple)):
+                        members = {e.id for e in value.elts
+                                   if isinstance(e, ast.Name)}
+                        if members:
+                            facts.list_members[name] = members
+                        if name in event_lists:
+                            facts.event_appends.append((node.lineno,
+                                                        _deep_names(value)))
+                    facts.rebinds.setdefault(name, []).append(node.lineno)
+                else:
+                    for name in _target_names(target):
+                        facts.rebinds.setdefault(name, []).append(node.lineno)
+        elif isinstance(node, ast.AugAssign):
+            _record_mutation_target(facts, node, node.target, "augassign")
+            for name in _target_names(node.target):
+                facts.rebinds.setdefault(name, []).append(node.lineno)
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                _record_mutation_target(facts, node, target, "del")
+        elif isinstance(node, ast.For):
+            names = _target_names(node.target)
+            self_attr = _iter_self_attr(node.iter)
+            if self_attr is not None:
+                # for v in self.A.values() / for k, v in self.A.items()
+                picked = names[-1:] if names else []
+                for name in picked:
+                    facts.self_reads.append((node.lineno, name, self_attr))
+                continue
+            if isinstance(node.iter, ast.Name):
+                for name in names:
+                    facts.alias[name] = node.iter.id
+                continue
+            if _read_call(node.iter):
+                for name in names:
+                    facts.taints.append((node.lineno, name))
+            for name in names:
+                facts.rebinds.setdefault(name, []).append(node.lineno)
+        elif isinstance(node, ast.Call):
+            func = node.func
+            kind = cname = None
+            if isinstance(func, ast.Name):
+                kind, cname = "name", func.id
+            elif isinstance(func, ast.Attribute):
+                if (isinstance(func.value, ast.Name)
+                        and func.value.id == "self"):
+                    kind = "self"
+                else:
+                    kind = "attr"
+                cname = func.attr
+
+            store_sink = _store_sink_name(node)
+            raft_sink = _raft_sink_name(node)
+            is_sink = store_sink is not None or raft_sink is not None
+            if is_sink:
+                escaped: Set[str] = set()
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    escaped |= _deep_names(arg)
+                for name in list(escaped):
+                    escaped |= facts.list_members.get(name, set())
+                if store_sink is not None:
+                    facts.sinks_store.append((node.lineno, store_sink,
+                                              escaped))
+                if raft_sink is not None:
+                    facts.sinks_raft.append((node.lineno, raft_sink, escaped))
+
+            if isinstance(func, ast.Attribute):
+                root, attrs = _attr_chain(func)
+                if func.attr in MUTATORS and root is not None and root != "self":
+                    chain = attrs[:-1]      # drop the mutator itself
+                    what = ".".join(chain + [func.attr])
+                    facts.mutations.append((node.lineno, root, what, "mcall"))
+                # self.A.append(name) retention
+                if (func.attr in ("append", "add", "setdefault")
+                        and isinstance(func.value, ast.Attribute)
+                        and isinstance(func.value.value, ast.Name)
+                        and func.value.value.id == "self"):
+                    for arg in node.args:
+                        if isinstance(arg, ast.Name):
+                            facts.retains.append((node.lineno, func.value.attr,
+                                                  arg.id))
+                # event_batch.append((kind, obj)) escape
+                if (func.attr in ("append", "extend")
+                        and isinstance(func.value, ast.Name)
+                        and func.value.id in event_lists):
+                    names: Set[str] = set()
+                    for arg in node.args:
+                        names |= _deep_names(arg)
+                    if names:
+                        facts.event_appends.append((node.lineno, names))
+
+            if kind is not None:
+                rec = _CallRec(node.lineno, kind, cname, is_sink=is_sink)
+                for i, arg in enumerate(node.args):
+                    if isinstance(arg, ast.Name):
+                        rec.pos.append((i, arg.id))
+                    elif isinstance(arg, (ast.List, ast.Tuple)):
+                        for e in arg.elts:
+                            if isinstance(e, ast.Name):
+                                rec.elems.append((i, e.id))
+                for kw in node.keywords:
+                    if kw.arg is not None and isinstance(kw.value, ast.Name):
+                        rec.kws.append((kw.arg, kw.value.id))
+                facts.calls.append(rec)
+    return facts
+
+
+# --- interprocedural mutation summaries ---------------------------------
+
+
+def _facts_cache(ctx: AnalysisContext) -> Dict[FuncInfo, _FnFacts]:
+    cache = getattr(ctx, "_ownership_facts", None)
+    if cache is None:
+        cache = ctx._ownership_facts = {}
+    return cache
+
+
+def _facts(ctx: AnalysisContext, fn: FuncInfo) -> _FnFacts:
+    cache = _facts_cache(ctx)
+    facts = cache.get(fn)
+    if facts is None:
+        facts = cache[fn] = _scan_function(fn.node)
+    return facts
+
+
+def _summaries(ctx: AnalysisContext) -> Dict[FuncInfo, Set[str]]:
+    """fn -> parameter names it may attribute-mutate, directly or through
+    any resolution candidate of its calls (fixpoint)."""
+    cached = getattr(ctx, "_ownership_summaries", None)
+    if cached is not None:
+        return cached
+    cg: CallGraph = ctx.callgraph
+    summ: Dict[FuncInfo, Set[str]] = {}
+    for fn in cg.functions:
+        facts = _facts(ctx, fn)
+        params = set(_params(fn.node))
+        direct: Set[str] = set()
+        for line, root, _what, _via in facts.mutations:
+            resolved = facts.root(root)
+            if resolved not in params:
+                continue
+            first_rebind = min(facts.rebinds.get(root, [line + 1]))
+            if first_rebind < line:
+                continue        # rebound (e.g. copied) before the mutation
+            direct.add(resolved)
+        summ[fn] = direct
+    changed = True
+    while changed:
+        changed = False
+        for fn in cg.functions:
+            params = set(_params(fn.node))
+            have = summ[fn]
+            if params <= have:
+                continue
+            facts = _facts(ctx, fn)
+            for rec in facts.calls:
+                for argname in _mutated_args(rec, cg, fn, summ):
+                    if argname in params and argname not in have:
+                        have.add(argname)
+                        changed = True
+    ctx._ownership_summaries = summ
+    return summ
+
+
+def _mutated_args(rec: _CallRec, cg: CallGraph, caller: FuncInfo,
+                  summ: Dict[FuncInfo, Set[str]]) -> Set[str]:
+    """Argument names this call may mutate. Name-based resolution is an
+    over-approximation, so when a call is ambiguous (several same-named
+    candidates) a name counts only if EVERY candidate mutates that slot
+    — one innocent namesake vetoes, which keeps cross-class collisions
+    (e.g. an unrelated ``register``) from poisoning the summaries."""
+    per: List[Set[str]] = []
+    for callee in cg.resolve(caller, rec.kind, rec.name):
+        callee_summ = summ.get(callee, set())
+        cparams = _params(callee.node)
+        names: Set[str] = set()
+        if callee_summ:
+            for i, argname in rec.pos + rec.elems:
+                if i < len(cparams) and cparams[i] in callee_summ:
+                    names.add(argname)
+            for kwname, argname in rec.kws:
+                if kwname in callee_summ:
+                    names.add(argname)
+        per.append(names)
+    if not per:
+        return set()
+    out = per[0]
+    for names in per[1:]:
+        out &= names
+    return out
+
+
+# --- the rules ----------------------------------------------------------
+
+
+def _mods_by_rel(ctx: AnalysisContext) -> Dict[str, Module]:
+    return {mod.rel: mod for mod in ctx.modules}
+
+
+def _escape_findings(ctx: AnalysisContext, rule_id: str, scope,
+                     sink_lists, noun: str) -> List[Finding]:
+    """Shared engine for store-escape-mutation / publish-after-mutate:
+    flag mutations (direct or via a mutating callee) of names escaped to
+    a sink earlier in the function."""
+    cg: CallGraph = ctx.callgraph
+    summ = _summaries(ctx)
+    mods = _mods_by_rel(ctx)
+    findings: List[Finding] = []
+    seen: Set[Tuple] = set()
+
+    def emit(mod, fn, line, detail, message):
+        key = (rule_id, mod.rel, f"{mod.rel}:{fn.qualname}", detail)
+        if key in seen or _suppressed(mod, line):
+            return
+        seen.add(key)
+        findings.append(Finding(
+            rule=rule_id, path=mod.rel, line=line, severity="error",
+            message=message, context=f"{mod.rel}:{fn.qualname}",
+            detail=detail))
+
+    for fn in cg.functions:
+        mod = mods.get(fn.module_rel)
+        if mod is None or not scope(mod.rel):
+            continue
+        facts = _facts(ctx, fn)
+        sinks = sink_lists(facts)
+        if not sinks:
+            continue
+        for sline, label, names in sinks:
+            for mline, root, what, via in facts.mutations:
+                if mline <= sline:
+                    continue
+                if root not in names and facts.root(root) not in names:
+                    continue
+                if via == "mcall" and "." not in what and root in names:
+                    # whole-container mutator on the batch list itself:
+                    # the store iterates the list, it never retains it
+                    continue
+                if facts.rebound_between(root, sline, mline):
+                    continue
+                emit(mod, fn, mline, f"{root}@{label}->{what}",
+                     f"'{root}' escaped to {label}() at line {sline} and "
+                     f"is {noun} from then on; mutating '{root}.{what}' "
+                     f"afterwards rewrites it — copy before mutating")
+            for rec in facts.calls:
+                if rec.line <= sline or rec.is_sink:
+                    continue
+                for root in _mutated_args(rec, cg, fn, summ):
+                    if root not in names:
+                        continue
+                    if facts.rebound_between(root, sline, rec.line):
+                        continue
+                    emit(mod, fn, rec.line, f"{root}@{label}=>{rec.name}",
+                         f"'{root}' escaped to {label}() at line {sline} "
+                         f"and is {noun} from then on; passing it to "
+                         f"{rec.name}() afterwards mutates it — copy "
+                         f"before handing it off")
+    return findings
+
+
+@rule("store-escape-mutation",
+      "structs handed to store upserts or raft propose/apply are shared "
+      "history and must not be mutated afterwards")
+def check_store_escape(ctx: AnalysisContext) -> List[Finding]:
+    return _escape_findings(
+        ctx, "store-escape-mutation",
+        scope=lambda rel: in_scope(rel, OWNERSHIP_SCOPE),
+        sink_lists=lambda f: f.sinks_store + f.sinks_raft,
+        noun="shared store/raft-log history")
+
+
+@rule("publish-after-mutate",
+      "structs already appended to a commit-event batch must not be "
+      "mutated before the batch publishes")
+def check_publish_after_mutate(ctx: AnalysisContext) -> List[Finding]:
+    return _escape_findings(
+        ctx, "publish-after-mutate",
+        scope=lambda rel: in_scope(rel, PUBLISH_SCOPE),
+        sink_lists=lambda f: [(line, "events.append", names)
+                              for line, names in f.event_appends],
+        noun="referenced by the pending event batch")
+
+
+@rule("read-mutate-no-copy",
+      "store-read structs passed to mutating callees or container-mutated "
+      "without an intervening copy")
+def check_read_mutate(ctx: AnalysisContext) -> List[Finding]:
+    cg: CallGraph = ctx.callgraph
+    summ = _summaries(ctx)
+    mods = _mods_by_rel(ctx)
+    findings: List[Finding] = []
+    seen: Set[Tuple] = set()
+
+    def emit(mod, fn, line, name, tline, detail, how):
+        key = ("read-mutate-no-copy", mod.rel, f"{mod.rel}:{fn.qualname}",
+               detail)
+        if key in seen or _suppressed(mod, line):
+            return
+        seen.add(key)
+        findings.append(Finding(
+            rule="read-mutate-no-copy", path=mod.rel, line=line,
+            severity="error",
+            message=(f"'{name}' was read from the state store (line {tline}) "
+                     f"and {how} without an intervening copy — store rows "
+                     "are shared across snapshots; copy.copy() first"),
+            context=f"{mod.rel}:{fn.qualname}", detail=detail))
+
+    for fn in cg.functions:
+        mod = mods.get(fn.module_rel)
+        if mod is None or not _analysis_scope(mod):
+            continue
+        facts = _facts(ctx, fn)
+        if not facts.taints:
+            continue
+        taint_lines: Dict[str, List[int]] = {}
+        for tline, name in facts.taints:
+            taint_lines.setdefault(name, []).append(tline)
+
+        def live_taint(name: str, line: int) -> Optional[int]:
+            for tline in sorted(taint_lines.get(name, ()), reverse=True):
+                if tline < line and not facts.rebound_between(name, tline,
+                                                              line):
+                    return tline
+            return None
+
+        # (b) container-mutator calls / keyed assigns through tainted
+        # names — the attribute-assignment cases belong to the
+        # intra-procedural shared-struct-mutation rule
+        for mline, root, what, via in facts.mutations:
+            if via not in ("mcall", "subscript"):
+                continue
+            tline = live_taint(root, mline)
+            if tline is None:
+                continue
+            emit(mod, fn, mline, root, tline, f"{root}.{what}",
+                 f"container-mutated ('{root}.{what}')")
+        # (a) handed to a callee whose summary mutates that parameter
+        for rec in facts.calls:
+            muts = _mutated_args(rec, cg, fn, summ)
+            for name in muts:
+                tline = live_taint(name, rec.line)
+                if tline is None:
+                    continue
+                emit(mod, fn, rec.line, name, tline, f"{name}=>{rec.name}",
+                     f"passed to {rec.name}(), which mutates it")
+    return findings
+
+
+@rule("propose-retain-alias",
+      "objects proposed into the raft log and retained on self must not "
+      "be mutated through the retained alias")
+def check_propose_retain(ctx: AnalysisContext) -> List[Finding]:
+    mods = _mods_by_rel(ctx)
+    findings: List[Finding] = []
+    seen: Set[Tuple] = set()
+    for mod in ctx.modules:
+        if not in_scope(mod.rel, RETAIN_SCOPE):
+            continue
+        for cls in mod.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = [(sub, _scan_function(sub)) for sub in cls.body
+                       if isinstance(sub, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef))]
+            # attributes that retain a proposed object
+            retained: Dict[str, Tuple[str, str]] = {}
+            for sub, facts in methods:
+                proposed: Set[str] = set()
+                for _line, _label, names in facts.sinks_raft:
+                    proposed |= names
+                if not proposed:
+                    continue
+                for _line, attr, name in facts.retains:
+                    if name in proposed:
+                        retained[attr] = (sub.name, name)
+            if not retained:
+                continue
+            for sub, facts in methods:
+                for bline, local, attr in facts.self_reads:
+                    if attr not in retained:
+                        continue
+                    for mline, root, what, _via in facts.mutations:
+                        if root != local or mline <= bline:
+                            continue
+                        if facts.rebound_between(local, bline, mline):
+                            continue
+                        if _suppressed(mod, mline):
+                            continue
+                        qual = f"{cls.name}.{sub.name}"
+                        detail = f"self.{attr}->{local}.{what}"
+                        key = (mod.rel, qual, detail)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        src_m, src_n = retained[attr]
+                        findings.append(Finding(
+                            rule="propose-retain-alias", path=mod.rel,
+                            line=mline, severity="error",
+                            message=(f"'{local}' comes out of self.{attr}, "
+                                     f"which retains objects proposed into "
+                                     f"the raft log ({src_m}() retains "
+                                     f"'{src_n}'); mutating "
+                                     f"'{local}.{what}' rewrites replicated "
+                                     "log history — copy before mutating"),
+                            context=f"{mod.rel}:{qual}", detail=detail))
+    return findings

@@ -271,9 +271,7 @@ def e2e_smoke(jobs_n: int = 300, nodes_n: int = 75, workers: int = 4) -> int:
                 leader.store.upsert_job(j)
             evals = [mock.eval_for(j, create_time=time.time())
                      for j in jobs]
-            index = leader.store.upsert_evals(evals)
-            for ev in evals:
-                ev.modify_index = index
+            leader.store.upsert_evals(evals)
             for ev in evals:
                 leader.server.broker.enqueue(ev)
 

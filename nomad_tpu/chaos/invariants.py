@@ -252,12 +252,33 @@ class InvariantChecker:
                     f"{[i[:8] for i in worst[1]]}")
         self.stats["checks"] += 1
 
+    # -- 6: snapshot integrity (nomadown runtime prong) ---------------
+
+    def check_snapshot_integrity(self, cluster=None) -> None:
+        """When the nomadown ownership sanitizer is armed
+        (NOMAD_TPU_SAN=1), sweep every fingerprinted store row for
+        post-insert divergence — an aliased mutation rewrites MVCC
+        history for all live snapshots and, through the FSM, diverges
+        replicas; catch it here before it surfaces as a log-matching or
+        convergence failure."""
+        from ..analysis.ownership import GLOBAL as own
+
+        if not own.active:
+            return
+        before = len(own.violations)
+        own.verify_all()
+        fresh = own.violations[before:]
+        if fresh:
+            extra = f" (+{len(fresh) - 1} more)" if len(fresh) > 1 else ""
+            self._fail(f"snapshot integrity: {fresh[0].render()}{extra}")
+
     # -- aggregate ----------------------------------------------------
 
     def check_all(self, cluster) -> None:
         """The per-step safety sweep (history properties only; the
         liveness checks — convergence, reschedule — take timeouts and
         run where a scenario expects quiescence)."""
+        self.check_snapshot_integrity(cluster)
         self.check_election_safety(cluster)
         self.check_log_matching(cluster)
         self.check_committed_durability(cluster)

@@ -546,7 +546,7 @@ def cmd_operator_scheduler(args) -> int:
     if args.op == "get-config":
         _p(api.scheduler_configuration())
         return 0
-    cfg = api.scheduler_configuration()
+    cfg = dict(api.scheduler_configuration())
     if args.scheduler_algorithm:
         cfg["scheduler_algorithm"] = args.scheduler_algorithm
     api.set_scheduler_configuration(cfg)

@@ -277,6 +277,5 @@ class DeploymentWatcher:
             status=enums.EVAL_STATUS_PENDING,
             create_time=time.time(),
         )
-        index = self.server.store.upsert_evals([ev])
-        ev.modify_index = index
+        self.server.store.upsert_evals([ev])
         self.server.broker.enqueue(ev)

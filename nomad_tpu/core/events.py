@@ -14,6 +14,8 @@ import time
 from collections import deque
 from typing import Dict, Iterator, List, Optional, Tuple
 
+from ..analysis.ownership import GLOBAL as _OWN
+
 TOPIC_FOR_KIND = {
     "node-upsert": "Node", "node-status": "Node", "node-eligibility": "Node",
     "node-drain": "Node", "node-delete": "Node",
@@ -89,6 +91,10 @@ class EventBroker:
                 if topic is None:
                     continue
                 key = getattr(payload, "id", "") if payload is not None else ""
+                if _OWN.active:
+                    # nomadown: the ring holds payloads by reference —
+                    # verify snapshot integrity at the publish boundary
+                    _OWN.verify(payload)
                 self._seq += 1
                 self._ring.append(Event(self._seq, index, topic, kind, key,
                                         payload))
@@ -128,4 +134,7 @@ class EventBroker:
                     break
             truncated = bool(self._ring) and self._ring[0].seq > cursor + 1
             out = [e for e in self._ring if e.seq > cursor]
+            if _OWN.active:
+                for e in out:
+                    _OWN.verify(e.payload)
             return out, truncated

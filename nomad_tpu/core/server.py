@@ -369,8 +369,7 @@ class Server:
         upd = _copy.copy(ev)
         upd.status = enums.EVAL_STATUS_PENDING
         upd.wait_until = 0.0
-        index = self.store.upsert_evals([upd])
-        upd.modify_index = index
+        self.store.upsert_evals([upd])
         self.broker.enqueue(upd)
 
     # -- failed-eval reaper (leader.go:1162 reapFailedEvaluations) --
@@ -417,8 +416,7 @@ class Server:
                 previous_eval=ev.id,
                 create_time=time.time(),
             )
-            index = self.store.upsert_evals([failed, followup])
-            followup.modify_index = index
+            self.store.upsert_evals([failed, followup])
             try:
                 self.broker.ack(ev.id, token)
             except ValueError:
@@ -552,8 +550,9 @@ class Server:
             status=enums.EVAL_STATUS_PENDING,
             create_time=time.time(),
         )
-        index = self.store.upsert_evals([ev])
-        ev.modify_index = index
+        # upsert_evals stamps create/modify_index on ev in-txn; restamping
+        # here would mutate a row that is already shared MVCC history
+        self.store.upsert_evals([ev])
         self.broker.enqueue(ev)
         return ev.id
 
@@ -666,9 +665,7 @@ class Server:
             evals.append(ev)
             out.append(ev.id)
         if evals:
-            index = self.store.upsert_evals(evals)
-            for ev in evals:
-                ev.modify_index = index
+            self.store.upsert_evals(evals)
             self.broker.enqueue_all(evals)
         return out
 
@@ -729,9 +726,7 @@ class Server:
                 create_time=time.time(),
             ))
         if evals:
-            index = self.store.upsert_evals(evals)
-            for ev in evals:
-                ev.modify_index = index
+            self.store.upsert_evals(evals)
             self.broker.enqueue_all(evals)
 
     # -- Deployment endpoints (nomad/deployment_endpoint.go) --
@@ -1043,8 +1038,7 @@ class Server:
         self.store.delete_volume(vol_id, namespace, force=force)
 
     def create_eval(self, ev: Evaluation) -> str:
-        index = self.store.upsert_evals([ev])
-        ev.modify_index = index
+        self.store.upsert_evals([ev])
         if ev.should_enqueue():
             self.broker.enqueue(ev)
         return ev.id

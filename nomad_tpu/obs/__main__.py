@@ -46,9 +46,7 @@ def _run_workload(cluster, leader, jobs_n: int):
         jobs.append(j)
         leader.store.upsert_job(j)
     evals = [mock.eval_for(j, create_time=time.time()) for j in jobs]
-    index = leader.store.upsert_evals(evals)
-    for ev in evals:
-        ev.modify_index = index
+    leader.store.upsert_evals(evals)
     for ev in evals:
         leader.server.broker.enqueue(ev)
 

@@ -22,6 +22,8 @@ import bisect
 import threading
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
+from ..analysis.ownership import GLOBAL as _OWN
+
 _TOMBSTONE = object()
 
 
@@ -92,6 +94,9 @@ class VersionedTable:
         return len(self._rows)
 
     def put(self, key: Any, value: Any, gen: int, min_live_gen: int) -> None:
+        if _OWN.active:
+            # nomadown: the row becomes shared MVCC history right here
+            _OWN.register(value, gen)
         row = self._rows.get(key)
         if row is None:
             self._rows[key] = (gen, value)
@@ -137,20 +142,24 @@ class VersionedTable:
         if row is None:
             return None
         if type(row) is tuple:
-            if row[0] <= gen:
-                v = row[1]
-                return None if v is _TOMBSTONE else v
+            if row[0] > gen:
+                return None
+            v = row[1]
+        else:
+            gens = row.gens
+            # fast path: latest version visible
+            if gens[-1] <= gen:
+                v = row.vals[-1]
+            else:
+                i = bisect.bisect_right(gens, gen) - 1
+                if i < 0:
+                    return None
+                v = row.vals[i]
+        if v is _TOMBSTONE:
             return None
-        gens = row.gens
-        # fast path: latest version visible
-        if gens[-1] <= gen:
-            v = row.vals[-1]
-            return None if v is _TOMBSTONE else v
-        i = bisect.bisect_right(gens, gen) - 1
-        if i < 0:
-            return None
-        v = row.vals[i]
-        return None if v is _TOMBSTONE else v
+        if _OWN.active:
+            _OWN.verify(v, gen)
+        return v
 
     def get_latest(self, key: Any) -> Any:
         row = self._rows.get(key)
@@ -180,6 +189,8 @@ class VersionedTable:
                         continue
                     v = row.vals[i]
             if v is not _TOMBSTONE:
+                if _OWN.active:
+                    _OWN.verify(v, gen)
                 yield key, v
 
     def compact_key(self, key: Any, min_live_gen: int) -> None:

@@ -34,6 +34,7 @@ from ..structs.deployment import Deployment
 from ..structs.evaluation import Evaluation
 from ..structs.job import Job
 from ..structs.node import Node
+from ..analysis.ownership import GLOBAL as _OWN
 from ..analysis.sanitizer import sanitized
 from .mvcc import ConsList, SnapshotTracker, VersionedTable, cons, cons_from_iter, cons_iter
 
@@ -568,12 +569,18 @@ class StateStore:
         """Allocate the next generation (unpublished) and compute the
         prune floor. Must hold _write_lock."""
         self._next_gen += 1
+        if _OWN.active:
+            # nomadown: writes by this thread until _commit are the store
+            # stamping its own rows, not post-insert aliasing
+            _OWN.txn_begin()
         # Readers can only ever be at <= the published index, and
         # acquire_atomic serializes with this floor computation.
         live = self._tracker.min_live(self._index)
         return self._next_gen, live
 
     def _commit(self, gen: int, events: list) -> None:
+        if _OWN.active:
+            _OWN.txn_commit(gen, events)
         with self._cond:
             self._index = gen
             self._cond.notify_all()

@@ -41,13 +41,21 @@ fi
 echo "== nomad_tpu.analysis =="
 python -m nomad_tpu.analysis || failed=1
 
+# nomadown smoke (~2s): the four ownership/aliasing rules alone, with
+# the baseline disabled — store-/raft-owned structs must never be
+# mutated after escaping; findings are fixed in code, never allowlisted
+# (ANALYSIS.md "nomadown")
+echo "== nomadown smoke (python -m nomad_tpu.analysis --ownership) =="
+timeout 60 python -m nomad_tpu.analysis --ownership --no-baseline || failed=1
+
 # runtime sanitizer smoke test: lock wrapping + lockset checking armed
 # over the sanitizer's own suite and the concurrency-heavy store/plan
 # tests (the full suite runs under NOMAD_TPU_SAN=1 in nightly; this
 # keeps the gate fast while still exercising install/report/fail paths)
 echo "== nomadsan smoke (NOMAD_TPU_SAN=1) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" NOMAD_TPU_SAN=1 python -m pytest \
-    tests/test_sanitizer.py tests/test_state_store.py \
+    tests/test_sanitizer.py tests/test_ownership.py \
+    tests/test_state_store.py \
     tests/test_plan_apply_scale.py tests/test_e2e_pipeline.py \
     tests/test_batch_solver.py -q \
     -p no:cacheprovider || failed=1

@@ -28,11 +28,15 @@ jax.config.update("jax_enable_x64", True)
 # lockset checker on @sanitized classes. Must run before any nomad_tpu
 # module is imported so module- and __init__-level locks are wrapped;
 # jax is deliberately imported first so its internals stay raw.
+# nomadown (the ownership prong) rides the same switch: it fingerprints
+# every struct entering the state store and flags post-insert mutation.
 _SAN = os.environ.get("NOMAD_TPU_SAN") == "1"
 if _SAN:
+    from nomad_tpu.analysis import ownership as _ownership
     from nomad_tpu.analysis import sanitizer as _sanitizer
 
     _sanitizer.install()
+    _ownership.install()
 
 import pytest  # noqa: E402
 
@@ -40,11 +44,13 @@ import pytest  # noqa: E402
 def pytest_terminal_summary(terminalreporter):
     if _SAN:
         terminalreporter.write_line(_sanitizer.GLOBAL.report())
+        terminalreporter.write_line(_ownership.GLOBAL.report())
 
 
 def pytest_sessionfinish(session, exitstatus):
     # a green test run with recorded races is still a failed run
-    if _SAN and _sanitizer.GLOBAL.violations:
+    if _SAN and (_sanitizer.GLOBAL.violations
+                 or _ownership.GLOBAL.violations):
         session.exitstatus = 3
 
 

@@ -1,0 +1,58 @@
+"""Clean twins of the ownership_bad.py fixtures: same shapes, but each
+one honors the copy-on-write discipline (copy before mutating, stamp
+before escaping, retain only scalars)."""
+
+import copy
+
+
+def finish_alloc_clean(alloc):
+    alloc.client_status = "complete"
+
+
+class CleanProducer:
+    def stamp_then_escape(self, store, make_eval):
+        pending = make_eval()
+        pending.status = "done"
+        store.upsert_evals([pending])
+
+    def escape_then_copy(self, store, make_alloc):
+        placed = make_alloc()
+        store.upsert_allocs([placed])
+        placed = copy.copy(placed)
+        finish_alloc_clean(placed)
+
+    def propose_fresh(self, raft, make_job):
+        spec = make_job()
+        raft.propose(("upsert_job", (spec,), {}))
+
+
+def read_copy_then_helper(snap):
+    row = copy.copy(snap.alloc_by_id("a1"))
+    finish_alloc_clean(row)
+
+
+def read_then_read_only(snap):
+    ev = snap.eval_by_id("e1")
+    return ev.status
+
+
+class CleanProposer:
+    def __init__(self):
+        self.pending_ids = set()
+
+    def submit(self, raft, ev):
+        raft.propose(("upsert_evals", ([ev],), {}))
+        self.pending_ids.add(ev.id)
+
+    def finish(self, eval_id):
+        self.pending_ids.discard(eval_id)
+
+
+class CleanPublishingStore:
+    def _commit(self, gen, events):
+        raise NotImplementedError
+
+    def upsert_thing(self, thing, gen):
+        thing.modify_index = gen
+        events = [("thing-upsert", thing)]
+        self._commit(gen, events)

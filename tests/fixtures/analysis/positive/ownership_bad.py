@@ -1,0 +1,71 @@
+"""Positive fixtures for the nomadown ownership/aliasing rules.
+
+Each function trips exactly one ownership rule; none of them may leak
+findings into the other rule families (no direct attribute assignment
+on store-read locals — that belongs to shared-struct-mutation's own
+fixture — no locks, no threads, no jit, no bare excepts).
+"""
+
+
+def finish_alloc(alloc):
+    alloc.client_status = "complete"
+
+
+class EscapingProducer:
+    # store-escape-mutation: both direct attribute mutation and the
+    # interprocedural variant via a callee with a mutating summary
+    def escape_then_mutate(self, store, make_eval):
+        pending = make_eval()
+        store.upsert_evals([pending])
+        pending.status = "done"
+
+    def escape_then_helper(self, store, make_alloc):
+        placed = make_alloc()
+        store.upsert_allocs([placed])
+        finish_alloc(placed)
+
+    def propose_then_mutate(self, raft, make_job):
+        spec = make_job()
+        raft.propose(("upsert_job", (spec,), {}))
+        spec.priority = 99
+
+
+def read_then_helper(snap):
+    # read-mutate-no-copy (interprocedural): store-read struct handed to
+    # a callee whose summary mutates it
+    row = snap.alloc_by_id("a1")
+    finish_alloc(row)
+
+
+def read_then_container_mutate(snap):
+    # read-mutate-no-copy (container mutator through the shared row)
+    ev = snap.eval_by_id("e1")
+    ev.related_evals.append("e2")
+
+
+class RetainingProposer:
+    # propose-retain-alias: submit() retains the proposed eval on self,
+    # finish() mutates it through the retained alias
+    def __init__(self):
+        self.pending = {}
+
+    def submit(self, raft, ev):
+        raft.propose(("upsert_evals", ([ev],), {}))
+        self.pending[ev.id] = ev
+
+    def finish(self, eval_id):
+        ev = self.pending.pop(eval_id)
+        ev.status = "complete"
+
+
+class PublishingStore:
+    # publish-after-mutate: the struct is already referenced by the
+    # pending commit-event batch when it is mutated
+    def _commit(self, gen, events):
+        raise NotImplementedError
+
+    def upsert_thing(self, thing, gen):
+        events = []
+        events.append(("thing-upsert", thing))
+        thing.modify_index = gen
+        self._commit(gen, events)

@@ -183,6 +183,62 @@ def test_solve_batch_respects_usage_corrections():
     assert (used <= avail + 1e-2).all()
 
 
+def test_warm_solve_batch_never_retraces_or_transfers():
+    """The perf-correctness guard contract on the joint tier: once a
+    shape is warm, a no_retrace window around solve_batch must see zero
+    new compiles and zero implicit host transfers (the donated usage
+    carry stays on device; counts come back via explicit device_get)."""
+    import jax
+
+    from nomad_tpu.tensor.batch_solver import solve_batch
+    from nomad_tpu.tensor.jit_guard import cache_size, no_retrace
+
+    avail, used0, feas, aff, ask, k, seeds = _random_problem(3)
+    g, d = ask.shape
+    rest = jax.device_put((avail, feas, aff, ask, k,
+                           k.astype(np.float32), seeds,
+                           np.zeros(1, np.int32),
+                           np.zeros((1, d), np.float32)))
+    used_dev = jax.device_put(used0)
+    used_dev, counts, _ = solve_batch(used_dev, *rest, g=g)  # warmup
+    assert cache_size(solve_batch) >= 1
+    size_warm = cache_size(solve_batch)
+    with no_retrace(solve_batch) as win:
+        # the donated carry is re-fed from the previous launch's output
+        used_dev, counts, _ = solve_batch(used_dev, *rest, g=g)
+        counts_np, _ = jax.device_get((counts, _))
+    assert win["compiles"] == 0
+    assert cache_size(solve_batch) == size_warm
+    assert (counts_np >= 0).all()
+
+
+def test_no_retrace_window_flags_shape_drift():
+    import jax
+
+    from nomad_tpu.tensor.jit_guard import RetraceError, no_retrace
+
+    @jax.jit
+    def scale(x):
+        return x * 2.0
+
+    scale(jax.device_put(np.ones(4, np.float32)))  # warm at (4,)
+    drifted = jax.device_put(np.ones(5, np.float32))
+    with pytest.raises(RetraceError):
+        with no_retrace(scale):
+            scale(drifted).block_until_ready()
+
+
+def test_no_retrace_window_flags_implicit_transfer():
+    import jax.numpy as jnp
+
+    from nomad_tpu.tensor.jit_guard import no_retrace
+
+    host = np.ones(8, np.float32)
+    with pytest.raises(Exception, match="[Tt]ransfer"):
+        with no_retrace():
+            _ = jnp.asarray(host) + 1.0  # implicit host->device ship
+
+
 def test_solve_batch_sharded_parity():
     """The mesh-sharded joint solve must agree with the single-device
     kernel bit-exactly on counts (the top-R all-gather merge reproduces
@@ -329,6 +385,9 @@ def test_tpu_solve_server_feasible_boundaries_serialized():
             svc = get_service().stats
             joint_launches = svc["joint_launches"] - stats0.get(
                 "joint_launches", 0)
+            # perf-correctness: no post-warmup retrace and no implicit
+            # transfer survived a production launch window
+            assert svc["retraces"] == stats0.get("retraces", 0)
     finally:
         EvalBroker.dequeue_batch = orig_dequeue
         srv.plan_queue.enqueue = orig_enqueue

@@ -1,5 +1,7 @@
 """Service/batch scheduler tests (modeled on reference generic_sched_test.go)."""
 
+import copy
+
 import pytest
 
 from nomad_tpu import mock
@@ -68,8 +70,13 @@ class TestServiceScheduling:
         job2.task_groups[0].count = 4
         h.store.upsert_job(job2)
         # avoid destructive-update path interfering: same version semantics
+        # (copy-on-write: snapshot rows are shared MVCC history)
+        restamped = []
         for a in h.snapshot().allocs_by_job(job.id):
+            a = copy.copy(a)
             a.job_version = job2.version
+            restamped.append(a)
+        h.store.upsert_allocs(restamped)
         ev2 = mock.eval_for(job2)
         h.process(ev2)
         live = [a for a in h.snapshot().allocs_by_job(job.id)
@@ -272,7 +279,7 @@ class TestSystemScheduling:
         job = mock.system_job()
         nodes, job, ev = register(h, n_nodes=2, job=job)
         h.process(ev)
-        moved = h.store.snapshot().node_by_id(nodes[0].id)
+        moved = copy.copy(h.store.snapshot().node_by_id(nodes[0].id))
         moved.datacenter = "dc-elsewhere"
         h.store.upsert_node(moved)
         ev2 = mock.eval_for(job, triggered_by=enums.TRIGGER_NODE_UPDATE)

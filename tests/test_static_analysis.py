@@ -28,7 +28,10 @@ ALL_RULES = ("fsm-determinism", "jax-hot-path", "lock-order",
              # nomadcheck condvar-protocol lints (PR 6)
              "condvar-wait-outside-loop", "condvar-notify-unlocked",
              "condvar-lost-signal", "condvar-wait-no-shutdown-check",
-             "thread-no-shutdown-join", "queue-enqueue-no-close-check")
+             "thread-no-shutdown-join", "queue-enqueue-no-close-check",
+             # nomadown ownership/aliasing rules (PR 9)
+             "store-escape-mutation", "read-mutate-no-copy",
+             "propose-retain-alias", "publish-after-mutate")
 
 
 def _by_rule(findings):
@@ -81,6 +84,19 @@ def test_positive_fixtures_flag_every_rule():
         ("InterproceduralInversion.pan_lock"
          "|InterproceduralInversion.pot_lock"),
     }
+
+    # nomadown ownership rules: direct, interprocedural ("=>"), raft,
+    # retained-alias, and pending-event-batch variants
+    escape = {f.detail for f in found["store-escape-mutation"]}
+    assert escape == {"pending@upsert_evals->status",
+                      "placed@upsert_allocs=>finish_alloc",
+                      "spec@propose->priority"}
+    read_mut = {f.detail for f in found["read-mutate-no-copy"]}
+    assert read_mut == {"row=>finish_alloc", "ev.related_evals.append"}
+    assert [f.detail for f in found["propose-retain-alias"]] == \
+        ["self.pending->ev.status"]
+    assert [f.detail for f in found["publish-after-mutate"]] == \
+        ["thing@events.append->modify_index"]
 
 
 def test_negative_fixtures_are_clean():

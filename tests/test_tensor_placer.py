@@ -5,6 +5,7 @@ these tests pin the JAX kernels to it over randomized clusters
 (SURVEY.md §7 stage 3/4 test oracles).
 """
 
+import copy
 import random
 
 import numpy as np
@@ -96,12 +97,15 @@ def test_score_parity_with_affinities_and_constraints():
     rng = random.Random(7)
     store = StateStore()
     nodes = _rand_cluster(store, rng, n_nodes=16)
-    # give half the nodes a rack attribute
+    # give half the nodes a rack attribute (copy-on-write: _rand_cluster
+    # already upserted these rows, so they are shared MVCC history)
     for i, n in enumerate(nodes):
         if i % 2 == 0:
-            n.attributes["rack"] = f"r{i % 4}"
+            n = copy.copy(n)
+            n.attributes = dict(n.attributes, rack=f"r{i % 4}")
             n.compute_class()
             store.upsert_node(n)
+            nodes[i] = n
     job = mock.job(
         constraints=[Constraint("${attr.kernel.name}", "linux", "="),
                      Constraint("${attr.rack}", "", enums.CONSTRAINT_IS_SET)],
