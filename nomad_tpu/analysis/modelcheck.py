@@ -1131,6 +1131,162 @@ def _scenario_raft_stepdown(env: ScenarioEnv) -> None:
         transport.close()
 
 
+@scenario("snapshot_compact")
+def _scenario_snapshot_compact(env: ScenarioEnv) -> None:
+    """Off-lock snapshot capture interleaved with concurrent applies
+    and an incoming chunked install_snapshot. A partitioned follower
+    forces the leader's async snapshot worker to compact past the
+    follower's next index; on heal the leader streams a chunked
+    install while proposals keep committing, and the freshly installed
+    follower then runs its own off-lock capture. Invariants checked on
+    every save/compact under the schedule: a locally captured
+    snapshot's index never exceeds the node's last_applied at save
+    time, and the log base never passes an index no saved snapshot
+    covers."""
+    import os
+    import shutil
+    import tempfile
+
+    from ..chaos.invariants import InvariantChecker
+    from ..raft.durable import DurableLog, SnapshotStore
+    from ..raft.node import NotLeaderError, RaftNode
+    from ..raft.transport import InProcTransport
+
+    tmp = tempfile.mkdtemp(prefix="nomadcheck-snap-")
+    transport = InProcTransport()
+    violations: List[str] = []
+    applied = {nid: [] for nid in ("a", "b", "c")}
+    nodes: list = []
+
+    class AuditSnapshots(SnapshotStore):
+        """only_if_newer=True is unique to the async capture worker, so
+        gate the capture invariant on it (installs legitimately save an
+        index ABOVE last_applied — disk before memory)."""
+
+        def __init__(self, dir_path):
+            super().__init__(dir_path)
+            self.node = None
+
+        def _save_text(self, index, text, only_if_newer):
+            if (only_if_newer and self.node is not None
+                    and index > self.node.last_applied):
+                violations.append(
+                    f"{self.node.id}: captured snapshot index {index} > "
+                    f"last_applied {self.node.last_applied}")
+            return super()._save_text(index, text, only_if_newer)
+
+    class AuditLog(DurableLog):
+        def __init__(self, dir_path, snaps):
+            super().__init__(dir_path, fsync=False)
+            self._snaps = snaps
+
+        def _audit_base(self, what):
+            if self.base_index > max(self._snaps.last_index, 0):
+                violations.append(
+                    f"{what}: log base {self.base_index} > snapshot "
+                    f"index {self._snaps.last_index}")
+
+        def compact(self, upto_index, upto_term):
+            super().compact(upto_index, upto_term)
+            self._audit_base("compact")
+
+        def reset_to(self, index, term):
+            super().reset_to(index, term)
+            self._audit_base("reset_to")
+
+    try:
+        for nid in ("a", "b", "c"):
+            os.makedirs(f"{tmp}/{nid}", exist_ok=True)
+            snaps = AuditSnapshots(f"{tmp}/{nid}")
+            alog = AuditLog(f"{tmp}/{nid}", snaps)
+            lst = applied[nid]
+            n = RaftNode(
+                nid, [p for p in ("a", "b", "c") if p != nid],
+                transport, lst.append,
+                election_timeout=1e6,      # no spontaneous elections
+                heartbeat_interval=0.05, log=alog, snapshots=snaps,
+                fsm_restore=(lambda data, lst=lst: lst.__setitem__(
+                    slice(None), [tuple(x) for x in data["items"]])),
+                fsm_capture=(lambda lst=lst: list(lst)),
+                fsm_serialize=(lambda cap: {"items": [list(c)
+                                                      for c in cap]}),
+                snapshot_threshold=3, batch=True,
+                snapshot_chunk_bytes=64)   # force a multi-frame install
+            snaps.node = n
+            nodes.append(n)
+        for n in nodes:
+            n.start()
+        transport.partition("c")
+        _force_leader(nodes[0])
+        errors: List[str] = []
+
+        def propose(tag: str) -> None:
+            for i in range(4):
+                try:
+                    prop = nodes[0].apply_async((f"{tag}{i}",))
+                    nodes[0].apply_wait(prop, timeout=30.0)
+                except (OSError, NotLeaderError, TimeoutError) as e:
+                    errors.append(f"{tag}{i}: {e!r}")
+
+        t1 = threading.Thread(target=propose, args=("x",),
+                              name="proposer-x")
+        t2 = threading.Thread(target=propose, args=("y",),
+                              name="proposer-y")
+        t1.start()
+        t2.start()
+        t1.join()
+        t2.join()
+        if errors:
+            raise AssertionError(f"proposals failed: {errors}")
+        # the async worker must compact the leader past the cut
+        # follower's next index (1) to force the install path
+        for _ in range(300):
+            if nodes[0].log.base_index > 0 and not nodes[0]._snap_active:
+                break
+            time.sleep(0.05)
+        if nodes[0].log.base_index <= 0:
+            raise AssertionError("leader never compacted its log")
+        transport.heal("c")
+        # traffic keeps flowing while the chunked install streams
+        t3 = threading.Thread(target=propose, args=("z",),
+                              name="proposer-z")
+        t3.start()
+        t3.join()
+        if errors:
+            raise AssertionError(f"post-heal proposals failed: {errors}")
+        target = nodes[0].last_applied
+        for _ in range(600):
+            with nodes[0]._lock:
+                inflight = bool(nodes[0]._snap_inflight)
+            if nodes[2].last_applied >= target and not inflight \
+                    and not any(n._snap_active for n in nodes):
+                break
+            time.sleep(0.05)
+        if nodes[2].last_applied < target:
+            raise AssertionError(
+                f"wiped-in follower stuck at {nodes[2].last_applied} "
+                f"< {target}")
+        if violations:
+            raise AssertionError("; ".join(violations))
+        checker = InvariantChecker()
+        cluster = _FakeCluster(nodes)
+        checker.check_election_safety(cluster)
+        checker.check_log_matching(cluster)
+        checker.check_committed_durability(cluster)
+        # install restores the leader's prefix and replication extends
+        # it in log order, so the follower's applied sequence must be a
+        # prefix of the leader's
+        la, lc = applied["a"], applied["c"]
+        if lc != la[:len(lc)]:
+            raise AssertionError(
+                f"follower state diverged after install: {lc} vs {la}")
+    finally:
+        for n in nodes:
+            n.stop()
+        transport.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 class _PipelineStore:
     """Minimal async-proposing store for the plan_pipeline scenario: a
     managed apply thread turns propose_async tokens into applied
@@ -1494,8 +1650,9 @@ def _scenario_store_ownership(env: ScenarioEnv) -> None:
             ownership.uninstall()
 
 
-SMOKE_SCENARIOS = ("raft_commit", "raft_stepdown", "plan_pipeline",
-                   "broker_batch", "solve_batch", "store_ownership")
+SMOKE_SCENARIOS = ("raft_commit", "raft_stepdown", "snapshot_compact",
+                   "plan_pipeline", "broker_batch", "solve_batch",
+                   "store_ownership")
 
 
 def smoke(base_seed: int, seeds_per_scenario: int = 3,

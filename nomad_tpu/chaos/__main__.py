@@ -5,6 +5,7 @@
     python -m nomad_tpu.chaos --raft-smoke
     python -m nomad_tpu.chaos --e2e-smoke
     python -m nomad_tpu.chaos --solve-smoke
+    python -m nomad_tpu.chaos --snap-smoke
 
 Exit 0 when every invariant holds; 2 on a violation (the CI gate in
 scripts/check.sh). This is the smallest end-to-end proof that the
@@ -27,7 +28,15 @@ through batched workers under "tpu-solve" on a live 3-node cluster —
 asserts a whole worker batch reached the joint auction launch, the
 selected packing score dominates the in-launch greedy counterfactual,
 and every replica holds a unique alloc set (the scripts/check.sh
---solve-smoke gate; PERF.md "Global-batch solve")."""
+--solve-smoke gate; PERF.md "Global-batch solve").
+
+`--snap-smoke` runs the snapshot/compaction smoke: the e2e pipeline on
+a durable 3-node cluster with a low snapshot threshold (every replica
+snapshots + compacts under load); one follower is crashed and wiped
+after the leader compacts, and the restart must catch up via the
+chunked install-snapshot path mid-traffic — zero acked-commit loss and
+alloc-set uniqueness on every replica (the scripts/check.sh
+--snap-smoke gate; ROBUSTNESS.md "Durability at scale")."""
 
 from __future__ import annotations
 
@@ -466,6 +475,154 @@ def solve_smoke(nodes_n: int = 40, jobs_n: int = 4,
     return 0
 
 
+def snap_smoke(jobs_n: int = 200, nodes_n: int = 60, workers: int = 4,
+               snapshot_threshold: int = 120) -> int:
+    """Snapshot/compaction smoke (scripts/check.sh --snap-smoke): the
+    e2e pipeline runs on a durable 3-node cluster with a snapshot
+    threshold low enough that every replica snapshots + compacts under
+    load. One follower is crashed and its data_dir wiped AFTER the
+    leader has compacted past the wiped state, so the restart can only
+    catch up via the chunked install-snapshot path — mid-traffic.
+    Asserts: the wiped follower converges, zero acked-commit loss on
+    every replica, alloc-set uniqueness on every replica, and the full
+    invariant sweep passes."""
+    import os
+    import shutil
+
+    from ..core.server import ServerConfig
+    from ..raft.cluster import RaftCluster
+    from .invariants import InvariantChecker
+
+    t0 = time.monotonic()
+
+    def config_fn(_i: int) -> ServerConfig:
+        return ServerConfig(
+            num_workers=workers, plan_commit_batching=True,
+            eval_batch_size=8,
+            heartbeat_ttl=3600.0, gc_interval=3600.0, nack_timeout=900.0,
+            failed_eval_followup_delay=3600.0,
+            failed_eval_unblock_interval=0.5)
+
+    tmp = tempfile.mkdtemp(prefix="nomad-snap-smoke-")
+    checker = InvariantChecker()
+    try:
+        cluster = RaftCluster(3, config_fn=config_fn, data_dir=tmp,
+                              snapshot_threshold=snapshot_threshold)
+        cluster.start()
+        try:
+            leader = cluster.wait_for_leader(timeout=15.0)
+            if leader is None:
+                print("SNAP SMOKE: FAIL — no leader elected")
+                return 2
+            # shrink the transfer chunk so the install is genuinely
+            # multi-frame at this store size
+            for s in cluster.servers.values():
+                s.raft.snapshot_chunk_bytes = 64 * 1024
+
+            for _ in range(nodes_n):
+                leader.register_node(mock.node())
+            jobs = []
+            for _ in range(jobs_n):
+                j = mock.job()
+                j.task_groups[0].count = 1
+                j.task_groups[0].tasks[0].resources.cpu = 100
+                j.task_groups[0].tasks[0].resources.memory_mb = 64
+                jobs.append(j)
+                leader.store.upsert_job(j)
+            evals = [mock.eval_for(j, create_time=time.time())
+                     for j in jobs]
+            leader.store.upsert_evals(evals)
+            for ev in evals:
+                leader.server.broker.enqueue(ev)
+
+            # wipe window: some allocs committed (acked), many evals
+            # still in flight, and the leader has already compacted —
+            # so the wiped follower's entries are physically gone
+            deadline = time.time() + 90
+            while time.time() < deadline:
+                snap = leader.local_store.snapshot()
+                committed = [a.id for a in snap.allocs()]
+                if len(committed) >= jobs_n // 4 \
+                        and leader.raft.log.base_index > 0:
+                    break
+                time.sleep(0.002)
+            else:
+                print("SNAP SMOKE: FAIL — pipeline never reached the "
+                      "wipe window (committed allocs + a compaction)")
+                return 2
+            acked = set(committed)
+            leader_base = leader.raft.log.base_index
+
+            victim_id = next(i for i, s in cluster.servers.items()
+                             if s is not leader)
+            old = cluster.crash(victim_id)
+            shutil.rmtree(os.path.join(old.data_dir, "raft"),
+                          ignore_errors=True)
+            victim = cluster.restart(victim_id)
+
+            # drain with the wiped follower racing its chunked install
+            # against live plan traffic
+            deadline = time.time() + 180
+            while True:
+                if leader.server._running \
+                        and leader.server.wait_for_idle(
+                            timeout=10.0, include_delayed=False) \
+                        and leader.server.blocked.blocked_count() == 0:
+                    snap = leader.local_store.snapshot()
+                    placed = [a for a in snap.allocs()
+                              if not a.terminal_status()
+                              and not a.server_terminal()]
+                    if len(placed) >= jobs_n:
+                        break
+                if time.time() > deadline:
+                    print("SNAP SMOKE: FAIL — pipeline did not drain "
+                          "after the follower wipe")
+                    return 2
+                time.sleep(0.1)
+
+            checker.check_convergence(cluster, timeout=60.0)
+            checker.check_all(cluster)
+
+            # the wiped follower can't have replayed entries <= the
+            # leader's pre-wipe base from its (empty) log: a base past
+            # that point proves the chunked install delivered it
+            if victim.raft.log.base_index < leader_base:
+                print(f"SNAP SMOKE: FAIL — wiped follower base "
+                      f"{victim.raft.log.base_index} < leader's "
+                      f"pre-wipe base {leader_base}; catch-up did not "
+                      f"go through install-snapshot")
+                return 2
+            if victim.raft.snapshots.last_index <= 0:
+                print("SNAP SMOKE: FAIL — wiped follower has no "
+                      "persisted snapshot after catch-up")
+                return 2
+
+            for sid, s in cluster.servers.items():
+                snap = s.local_store.snapshot()
+                ids = [a.id for a in snap.allocs()]
+                if len(ids) != len(set(ids)):
+                    print(f"SNAP SMOKE: FAIL — duplicate alloc ids on "
+                          f"{sid}")
+                    return 2
+                lost = acked - set(ids)
+                if lost:
+                    print(f"SNAP SMOKE: FAIL — {len(lost)} acked "
+                          f"alloc(s) missing on {sid}: "
+                          f"{sorted(i[:8] for i in lost)[:5]}")
+                    return 2
+        finally:
+            cluster.stop()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    dt = time.monotonic() - t0
+    print(f"SNAP SMOKE: ok — {jobs_n} evals, {len(acked)} allocs acked "
+          f"pre-wipe all present on every replica, wiped follower "
+          f"caught up via chunked install (base {leader_base} -> "
+          f"{victim.raft.log.base_index}), "
+          f"{checker.stats['checks']} invariant sweeps, {dt:.1f}s")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m nomad_tpu.chaos")
     parser.add_argument("--seed", type=int, default=None,
@@ -482,6 +639,12 @@ def main(argv=None) -> int:
                              "(batched workers under tpu-solve; joint "
                              "launch, score dominance, alloc "
                              "uniqueness) instead of the scenario smoke")
+    parser.add_argument("--snap-smoke", action="store_true",
+                        help="run the snapshot/compaction smoke (low "
+                             "snapshot threshold under e2e load, one "
+                             "follower wiped + restarted, catch-up via "
+                             "chunked install-snapshot) instead of the "
+                             "scenario smoke")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
     logging.basicConfig(
@@ -496,6 +659,8 @@ def main(argv=None) -> int:
         return e2e_smoke()
     if args.solve_smoke:
         return solve_smoke()
+    if args.snap_smoke:
+        return snap_smoke()
 
     t0 = time.monotonic()
     with tempfile.TemporaryDirectory(prefix="nomad-chaos-") as tmp:

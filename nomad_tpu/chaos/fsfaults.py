@@ -8,7 +8,9 @@ is a no-op until an `FSFaults` shim is installed, at which point armed
 faults raise real `OSError`s (ENOSPC, EIO) at the exact write the
 scenario scripts.
 
-Ops seen today: "atomic_write_text", "log_append", "log_rewrite".
+Ops seen today: "atomic_write_text", "log_append", "log_rewrite",
+"snap_chunk" (each chunk write of an incoming install-snapshot
+transfer, `raft.durable.FileSnapshotSink.write`).
 
     faults = FSFaults()
     with faults.installed():

@@ -76,12 +76,14 @@ class ReplicatedServer:
         self.data_dir = data_dir
         raft_log = stable = snapshots = None
         fsm_snapshot = fsm_restore = None
+        fsm_capture = fsm_serialize = None
         if data_dir is not None:
             # durable mode: boltdb-equivalent log + stable + snapshot
             # files under <data_dir>/raft (reference server.go:1365)
             import os
 
-            from ..state.persist import dump_store, restore_store
+            from ..state.persist import (capture_store, dump_store,
+                                         restore_store, serialize_capture)
             from .durable import DurableLog, SnapshotStore, StableStore
 
             raft_dir = os.path.join(data_dir, "raft")
@@ -91,12 +93,18 @@ class ReplicatedServer:
             raft_log = DurableLog(raft_dir)
             fsm_snapshot = lambda: dump_store(self.local_store)  # noqa: E731
             fsm_restore = lambda data: restore_store(self.local_store, data)  # noqa: E731
+            # stall-free path: capture pins an MVCC generation under the
+            # node lock (O(1)); serialization runs on the snapshot worker
+            fsm_capture = lambda: capture_store(self.local_store)  # noqa: E731
+            fsm_serialize = lambda cap: serialize_capture(self.local_store, cap)  # noqa: E731
         self.raft = RaftNode(node_id, peers, transport, self.fsm.apply,
                              on_leadership=self._on_leadership,
                              log=raft_log, stable=stable,
                              snapshots=snapshots,
                              fsm_snapshot=fsm_snapshot,
                              fsm_restore=fsm_restore,
+                             fsm_capture=fsm_capture,
+                             fsm_serialize=fsm_serialize,
                              snapshot_threshold=snapshot_threshold,
                              peer_addrs=getattr(transport, "peer_addrs", None),
                              on_config_change=self._on_config_change,

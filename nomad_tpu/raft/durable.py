@@ -16,6 +16,7 @@ Layout under <dir>/:
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -28,6 +29,33 @@ from ..utils.files import check_fault as _check_fault
 from .log import Entry
 
 log = logging.getLogger("nomad_tpu.raft")
+
+
+def snapshot_digest(text: str) -> str:
+    """Whole-snapshot content digest for the chunked install protocol:
+    the follower only restores once the accumulated bytes hash to what
+    the leader announced with the final chunk."""
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def _load_snapshot_file(path: str) -> Optional[dict]:
+    """Read snapshot.json, tolerating a torn/corrupt file: a snapshot
+    that doesn't parse is treated as absent (warn + None) — the node
+    starts empty and the leader re-installs — never a bricked server.
+    The normal save path is atomic (tmp + fsync + rename), so this only
+    fires on truly exceptional artifacts (partial copy, bit rot)."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict) or "index" not in data:
+            raise ValueError("snapshot file missing index")
+        return data
+    except (ValueError, KeyError, OSError) as e:
+        log.warning("%s: unreadable snapshot dropped (%s); "
+                    "treating as absent", path, e)
+        return None
 
 
 class StableStore:
@@ -54,21 +82,125 @@ class StableStore:
 
 
 class SnapshotStore:
+    """snapshot.json plus a chunk-transfer staging file.
+
+    `last_index` tracks the index of the snapshot currently on disk
+    (kept current by save/load) so `save(..., only_if_newer=True)` can
+    reject a stale write without parsing the file — the off-lock
+    snapshot thread uses it to lose the race against a concurrent
+    install_snapshot cleanly."""
+
     def __init__(self, dir_path: str):
         self._path = os.path.join(dir_path, "snapshot.json")
+        self._partial = self._path + ".partial"
+        self._lock = threading.Lock()
+        self.last_index = -1
 
     def save(self, index: int, term: int, data: dict,
-             servers: Optional[dict] = None) -> None:
+             servers: Optional[dict] = None,
+             only_if_newer: bool = False) -> bool:
         payload = {"index": index, "term": term, "data": data}
         if servers:
             payload["servers"] = servers
-        _atomic_write(self._path, json.dumps(payload))
+        return self._save_text(index, json.dumps(payload), only_if_newer)
+
+    def save_raw(self, index: int, term: int, data_text: str,
+                 servers: Optional[dict] = None,
+                 only_if_newer: bool = False) -> bool:
+        """Save with the FSM dump already serialized (`data_text` is the
+        JSON text of the "data" value) — the chunked install path splices
+        the accumulated transfer bytes straight in instead of
+        parse-then-reserialize at C2M sizes."""
+        head = {"index": index, "term": term}
+        if servers:
+            head["servers"] = servers
+        text = json.dumps(head)[:-1] + ', "data": ' + data_text + "}"
+        return self._save_text(index, text, only_if_newer)
+
+    def _save_text(self, index: int, text: str,
+                   only_if_newer: bool) -> bool:
+        with self._lock:
+            if only_if_newer and index <= self.last_index:
+                log.info("%s: skipping stale snapshot save at index %d "
+                         "(disk already at %d)",
+                         self._path, index, self.last_index)
+                return False
+            _atomic_write(self._path, text)
+            self.last_index = index
+            return True
 
     def load(self) -> Optional[dict]:
+        data = _load_snapshot_file(self._path)
+        if data is not None:
+            with self._lock:
+                self.last_index = max(self.last_index, int(data["index"]))
+        return data
+
+    def sink(self) -> "FileSnapshotSink":
+        """A staging sink for an incoming chunked transfer. Writes land
+        in snapshot.json.partial; the real snapshot file is untouched
+        until the caller verifies the digest and calls save_raw."""
+        return FileSnapshotSink(self._partial)
+
+
+class FileSnapshotSink:
+    """Accumulates a chunked snapshot transfer in a temp file next to
+    snapshot.json. Crash/disconnect mid-transfer leaves only this file
+    behind — the previous snapshot stays loadable. Writes go through
+    the `check_fault("snap_chunk")` chokepoint so chaos scenarios can
+    tear the transfer at any offset."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._fh = None
+        self.offset = 0
+
+    def write(self, data: str) -> None:
+        _check_fault("snap_chunk", self._path)
+        if self._fh is None:
+            self._fh = open(self._path, "w")
+        self._fh.write(data)
+        self._fh.flush()
+        self.offset += len(data)
+
+    def read_all(self) -> str:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
         if not os.path.exists(self._path):
-            return None
+            return ""
         with open(self._path) as f:
-            return json.load(f)
+            return f.read()
+
+    def discard(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        try:
+            os.unlink(self._path)
+        except OSError:
+            pass
+        self.offset = 0
+
+
+class MemorySnapshotSink:
+    """Chunk accumulator for nodes running without durable storage
+    (in-proc tests): same surface as FileSnapshotSink."""
+
+    def __init__(self):
+        self._buf: List[str] = []
+        self.offset = 0
+
+    def write(self, data: str) -> None:
+        self._buf.append(data)
+        self.offset += len(data)
+
+    def read_all(self) -> str:
+        return "".join(self._buf)
+
+    def discard(self) -> None:
+        self._buf = []
+        self.offset = 0
 
 
 class DurableLog:
@@ -93,10 +225,8 @@ class DurableLog:
     # -- persistence internals --
 
     def _load(self) -> None:
-        snap_meta = os.path.join(self._dir, "snapshot.json")
-        if os.path.exists(snap_meta):
-            with open(snap_meta) as f:
-                meta = json.load(f)
+        meta = _load_snapshot_file(os.path.join(self._dir, "snapshot.json"))
+        if meta is not None:
             self.base_index = int(meta.get("index", 0))
             self.base_term = int(meta.get("term", 0))
         if os.path.exists(self._path):
@@ -312,6 +442,11 @@ class DurableLog:
     def compact(self, upto_index: int, upto_term: int) -> None:
         """Drop entries <= upto_index (now covered by a snapshot)."""
         with self._lock:
+            if self._fh is None:
+                # closed mid-race by a crash/stop (the async snapshot
+                # worker outlives the node lock); the snapshot is saved,
+                # compaction just waits for the next round
+                return
             keep = upto_index - self.base_index
             if keep <= 0:
                 return
@@ -324,6 +459,8 @@ class DurableLog:
         """Install-snapshot on a follower: discard everything, restart
         the log at the snapshot boundary."""
         with self._lock:
+            if self._fh is None:
+                return
             self._entries.clear()
             self.base_index = index
             self.base_term = term

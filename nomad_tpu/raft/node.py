@@ -34,6 +34,7 @@ comparison — bench.py's raft_commit_throughput_3node rung.
 from __future__ import annotations
 
 import copy
+import json
 import logging
 import random
 import threading
@@ -41,6 +42,8 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from ..obs import NULL_SPAN, RECORDER, TRACER
+from ..utils.backoff import Retryer
+from .durable import MemorySnapshotSink, snapshot_digest
 from .log import Entry, RaftLog
 
 log = logging.getLogger("nomad_tpu.raft")
@@ -56,6 +59,11 @@ MAX_GROUP_COMMIT = 1024
 # committed entries applied per lock hold: large enough to amortize the
 # lock, small enough that RPC handlers never stall behind a big backlog
 APPLY_CHUNK = 64
+# install-snapshot transfer chunk (Raft §7 offset/done protocol): large
+# enough to amortize per-frame overhead, small enough that one frame
+# never trips the transport's frame cap and a torn transfer wastes
+# little resend work
+SNAPSHOT_CHUNK_BYTES = 1 << 20
 
 
 class _Proposal:
@@ -89,7 +97,10 @@ class RaftNode:
                  bootstrap: bool = True,
                  dead_server_cleanup_s: Optional[float] = None,
                  batch: bool = True,
-                 max_append_entries: int = MAX_APPEND_ENTRIES):
+                 max_append_entries: int = MAX_APPEND_ENTRIES,
+                 fsm_capture: Optional[Callable[[], object]] = None,
+                 fsm_serialize: Optional[Callable[[object], dict]] = None,
+                 snapshot_chunk_bytes: int = SNAPSHOT_CHUNK_BYTES):
         self.id = node_id
         # membership: server id -> address ("" when the transport
         # resolves ids directly). Config-change log entries rewrite this
@@ -144,6 +155,13 @@ class RaftNode:
         self.fsm_snapshot = fsm_snapshot
         self.fsm_restore = fsm_restore
         self.snapshot_threshold = snapshot_threshold
+        # stall-free capture: fsm_capture pins an O(1) MVCC handle under
+        # the node lock; fsm_serialize turns it into the snapshot dict on
+        # a worker thread, outside the lock. When unset, _maybe_snapshot
+        # falls back to the legacy under-lock fsm_snapshot path.
+        self.fsm_capture = fsm_capture
+        self.fsm_serialize = fsm_serialize
+        self.snapshot_chunk_bytes = snapshot_chunk_bytes
         if stable is not None:
             self.current_term = stable.term
             self.voted_for = stable.voted_for
@@ -164,6 +182,12 @@ class RaftNode:
         self._last_leader_contact = 0.0
 
         self._snap_inflight: set = set()  # peers mid-install-snapshot
+        self._snap_active = False  # a local snapshot worker is running
+        # follower-side chunk accumulator: {"leader","term","index","sink"}
+        self._snap_rx: Optional[dict] = None
+        # snapshot worker/sender threads, joined by stop(); pruned on
+        # each spawn so the list stays bounded
+        self._bg_threads: List[threading.Thread] = []
         self._lock = threading.RLock()
         self._apply_cond = threading.Condition(self._lock)
         # both conditions share the node lock (so notify is race-free
@@ -209,7 +233,8 @@ class RaftNode:
             self._propose_cond.notify_all()
             self._repl_cond.notify_all()
             repls = list(self._replicators.values())
-        for t in self._threads + repls:
+            bg = list(self._bg_threads)
+        for t in self._threads + repls + bg:
             t.join(timeout=2.0)
 
     def _new_deadline(self) -> float:
@@ -661,7 +686,11 @@ class RaftNode:
 
     def _on_install_snapshot(self, msg: dict) -> dict:
         """Follower-side snapshot install: the leader compacted past the
-        entries this node needs (Raft §7 / hashicorp/raft InstallSnapshot)."""
+        entries this node needs (Raft §7 / hashicorp/raft InstallSnapshot).
+        Chunked transfers (offset/done protocol) carry an "offset" key;
+        the legacy single-frame form ships the whole dict in "data"."""
+        if "offset" in msg:
+            return self._on_install_snapshot_chunk(msg)
         with self._lock:
             term = msg["term"]
             if term < self.current_term:
@@ -677,29 +706,141 @@ class RaftNode:
                         "match_index": self.last_applied}
             if self.fsm_restore is None:
                 return {"term": self.current_term, "success": False}
-            self.fsm_restore(msg["data"])
-            if hasattr(self.log, "reset_to"):
-                self.log.reset_to(index, snap_term)
-            if msg.get("servers"):
-                self._set_servers_locked(dict(msg["servers"]))
-            if self.snapshots is not None:
-                self.snapshots.save(index, snap_term, msg["data"],
-                                    servers=self.servers)
-            self.commit_index = max(self.commit_index, index)
-            self.last_applied = index
-            self._apply_cond.notify_all()
+            try:
+                self._install_locked(index, snap_term, msg["data"], None,
+                                     msg.get("servers"))
+            except OSError as e:
+                log.warning("install_snapshot persist failed on %s: %s",
+                            self.id, e)
+                return {"term": self.current_term, "success": False}
+            return {"term": self.current_term, "success": True,
+                    "match_index": index}
+
+    def _install_locked(self, index: int, snap_term: int, data: dict,
+                        data_text: Optional[str],
+                        servers: Optional[dict]) -> None:
+        """Shared install tail, node lock held. Ordering is deliberate:
+        persist the snapshot FIRST, then truncate the log, then mutate
+        memory — a crash between any two steps leaves a state the normal
+        recovery path reads back correctly (the saved snapshot's base
+        makes stale log entries skippable; see DurableLog._load)."""
+        if self.snapshots is not None:
+            if data_text is not None:
+                self.snapshots.save_raw(index, snap_term, data_text,
+                                        servers=servers or self.servers)
+            else:
+                self.snapshots.save(index, snap_term, data,
+                                    servers=servers or self.servers)
+        if hasattr(self.log, "reset_to"):
+            self.log.reset_to(index, snap_term)
+        if servers:
+            self._set_servers_locked(dict(servers))
+        self.fsm_restore(data)
+        self.commit_index = max(self.commit_index, index)
+        self.last_applied = index
+        self._apply_cond.notify_all()
+        # installs can take seconds at C2M scale: restart the election
+        # clock so the node doesn't immediately campaign against the
+        # leader that just fed it
+        self._deadline = self._new_deadline()
+
+    def _on_install_snapshot_chunk(self, msg: dict) -> dict:
+        """One frame of a chunked InstallSnapshot (Raft §7). Chunks
+        accumulate in a sink (temp file beside snapshot.json when
+        durable); nothing is restored until the final frame's digest
+        verifies over the whole body, so a crash, disconnect, or
+        leadership change mid-transfer leaves the old state intact."""
+        with self._lock:
+            term = msg["term"]
+            if term < self.current_term:
+                return {"term": self.current_term, "success": False}
+            if term > self.current_term or self.state != FOLLOWER:
+                self._become_follower_locked(term)
+            self.leader_id = msg["leader"]
+            self._deadline = self._new_deadline()
+            self._last_leader_contact = time.time()
+            index, snap_term = msg["index"], msg["snap_term"]
+            if index <= self.last_applied:
+                return {"term": self.current_term, "success": True,
+                        "match_index": self.last_applied}
+            if self.fsm_restore is None:
+                return {"term": self.current_term, "success": False}
+            rx = self._snap_rx
+            if (rx is None or rx["leader"] != msg["leader"]
+                    or rx["term"] != term or rx["index"] != index):
+                if rx is not None:
+                    rx["sink"].discard()
+                sink = (self.snapshots.sink() if self.snapshots is not None
+                        else MemorySnapshotSink())
+                rx = self._snap_rx = {"leader": msg["leader"], "term": term,
+                                      "index": index, "sink": sink}
+            sink = rx["sink"]
+            if msg["offset"] != sink.offset:
+                # resume protocol: tell the leader where to rewind to
+                return {"term": self.current_term, "success": False,
+                        "offset": sink.offset}
+            try:
+                sink.write(msg["data"])
+            except OSError as e:
+                log.warning("snapshot chunk write failed on %s: %s",
+                            self.id, e)
+                sink.discard()
+                self._snap_rx = None
+                return {"term": self.current_term, "success": False,
+                        "offset": 0}
+            if not msg.get("done"):
+                return {"term": self.current_term, "success": True,
+                        "offset": sink.offset}
+            self._snap_rx = None
+        # final frame: verify + decode outside the lock (json.loads of a
+        # C2M snapshot takes seconds; applies/heartbeats must not stall)
+        text = sink.read_all()
+        ok = (len(text) == msg["total"]
+              and snapshot_digest(text) == msg["digest"])
+        data = None
+        if ok:
+            try:
+                data = json.loads(text)
+            except ValueError:
+                ok = False
+        if not ok:
+            log.warning("snapshot transfer to %s failed verification "
+                        "(%d bytes)", self.id, len(text))
+            sink.discard()
+            return {"term": self.current_term, "success": False,
+                    "offset": 0}
+        with self._lock:
+            if (msg["term"] != self.current_term or self.state != FOLLOWER
+                    or index <= self.last_applied):
+                sink.discard()
+                return {"term": self.current_term, "success": False,
+                        "offset": 0}
+            try:
+                self._install_locked(index, snap_term, data, text,
+                                     msg.get("servers"))
+            except OSError as e:
+                log.warning("install_snapshot persist failed on %s: %s",
+                            self.id, e)
+                sink.discard()
+                return {"term": self.current_term, "success": False,
+                        "offset": 0}
+            sink.discard()
             return {"term": self.current_term, "success": True,
                     "match_index": index}
 
     def _maybe_snapshot(self) -> None:
         """Apply-thread only: snapshot the FSM and compact the log once
-        enough entries accumulated past the last snapshot boundary. Runs
-        under the node lock so a concurrent install_snapshot (RPC thread)
-        can't interleave and leave an older-labeled snapshot covering
-        newer state."""
-        if self.snapshots is None or self.fsm_snapshot is None:
+        enough entries accumulated past the last snapshot boundary. With
+        an MVCC-capable FSM (fsm_capture/fsm_serialize wired) the work
+        runs on a worker thread and only the O(1) capture happens under
+        the node lock; otherwise the legacy under-lock path runs."""
+        if self.snapshots is None:
             return
         if not hasattr(self.log, "compact"):
+            return
+        if self.fsm_capture is not None and self.fsm_serialize is not None:
+            return self._maybe_snapshot_async()
+        if self.fsm_snapshot is None:
             return
         with self._lock:
             base = getattr(self.log, "base_index", 0)
@@ -714,6 +855,75 @@ class RaftNode:
             data = self.fsm_snapshot()
             self.snapshots.save(applied, term, data, servers=self.servers)
             self.log.compact(applied, term)
+
+    def _maybe_snapshot_async(self) -> None:
+        """Stall-free variant: pin an MVCC handle + (applied, term) under
+        the lock, then serialize/write/compact on a dedicated worker.
+        Concurrent applies, heartbeats, and elections proceed; a CAS on
+        (last_applied, base_index) discards the compaction if an
+        install_snapshot raced in."""
+        with self._lock:
+            if self._snap_active:
+                return
+            base = getattr(self.log, "base_index", 0)
+            applied = self.last_applied
+            if applied - base < self.snapshot_threshold:
+                return
+            term = self.log.term_at(applied)
+            if term < 0:
+                return
+            try:
+                capture = self.fsm_capture()
+            except Exception as e:
+                log.warning("snapshot capture failed on %s: %s", self.id, e)
+                return
+            servers = dict(self.servers)
+            self._snap_active = True
+            t = threading.Thread(
+                target=self._snapshot_worker,
+                args=(capture, applied, term, servers, base),
+                daemon=True, name=f"raft-{self.id}-snapshot")
+            self._bg_threads = [x for x in self._bg_threads
+                                if x.is_alive()] + [t]
+        t.start()
+
+    def _snapshot_worker(self, capture, applied: int, term: int,
+                         servers: dict, base: int) -> None:
+        try:
+            with TRACER.span("raft.snapshot_persist", node=self.id,
+                             index=applied):
+                try:
+                    data = self.fsm_serialize(capture)
+                finally:
+                    close = getattr(capture, "close", None)
+                    if close is not None:
+                        close()
+                saved = self.snapshots.save(applied, term, data,
+                                            servers=servers,
+                                            only_if_newer=True)
+            if not saved:
+                return
+            with self._lock:
+                # CAS: an install_snapshot that raced in moved the base
+                # (and possibly last_applied) — its snapshot supersedes
+                # ours, so compacting to `applied` would be wrong/no-op
+                if (self._stop.is_set() or self.last_applied < applied
+                        or getattr(self.log, "base_index", 0) != base):
+                    return
+            # the log has its own lock; compacting outside the node lock
+            # keeps the fsync off the commit path. A reset_to that lands
+            # between the CAS and here moves base past `applied`, which
+            # makes this compact a no-op inside DurableLog.
+            self.log.compact(applied, term)
+        except OSError as e:
+            # disk fault mid-save: atomic_write left the previous
+            # snapshot loadable; skip compaction and retry next round
+            log.warning("snapshot persist failed on %s: %s", self.id, e)
+        except Exception:
+            log.exception("snapshot worker crashed on %s", self.id)
+        finally:
+            with self._lock:
+                self._snap_active = False
 
     # -- roles --
 
@@ -948,47 +1158,128 @@ class RaftNode:
         return max(1, min(first_index, next_idx - 1))
 
     def _send_snapshot_locked(self, peer: str, term: int, base: int) -> None:
-        """The peer needs entries the log compacted away: ship the whole
-        snapshot instead (call with the lock held — the _snap_inflight
-        reservation below relies on it; the transfer itself runs on a
-        spawned thread outside the lock). At most one install per peer
-        in flight — a full-state transfer outlives any replication
-        round."""
+        """The peer needs entries the log compacted away: stream the
+        snapshot in chunks instead (call with the lock held — the
+        _snap_inflight reservation below relies on it; the transfer
+        itself runs on a spawned thread outside the lock). At most one
+        install per peer in flight — a full-state transfer outlives any
+        replication round."""
         if self.snapshots is None or peer in self._snap_inflight:
             return
         self._snap_inflight.add(peer)
+        t = threading.Thread(target=self._snapshot_sender, args=(peer, term),
+                             daemon=True,
+                             name=f"raft-{self.id}-snap-{peer}")
+        self._bg_threads = [x for x in self._bg_threads
+                            if x.is_alive()] + [t]
+        t.start()
 
-        def send():
-            try:
-                snap = self.snapshots.load()
-                if snap is None:
-                    return
-                reply = self.transport.send(self.id, peer, {
-                    "kind": "install_snapshot", "term": term,
-                    "leader": self.id, "index": snap["index"],
-                    "snap_term": snap["term"], "data": snap["data"],
-                    "servers": dict(self.servers),
-                })
-                if reply is None:
-                    return
-                with self._lock:
-                    if reply["term"] > self.current_term:
-                        self._become_follower_locked(reply["term"])
+    def _snapshot_sender(self, peer: str, term: int) -> None:
+        """Chunked InstallSnapshot transfer (Raft §7 offset/done).
+        Fixed-size frames ride the "snap" transport channel; a None
+        reply (peer unreachable) backs off via Retryer and resumes at
+        the follower-reported offset on reconnect. Leadership loss,
+        stop, or a higher term abort the transfer — the follower's
+        accumulated chunks are simply superseded or discarded."""
+        try:
+            snap = self.snapshots.load()
+            if snap is None:
+                return
+            index, snap_term = snap["index"], snap["term"]
+            text = json.dumps(snap["data"])
+            digest = snapshot_digest(text)
+            total = len(text)
+            with self._lock:
+                servers = dict(self.servers)
+            offset = 0
+            with TRACER.span("raft.snapshot_send", peer=peer, index=index,
+                             bytes=total):
+                # each Retryer pass is one connection attempt; progress
+                # resets backoff by starting a fresh Retryer
+                while not self._stop.is_set():
+                    retryer = Retryer(deadline_s=None, stop=self._stop,
+                                      base=self.heartbeat_interval,
+                                      cap=2.0)
+                    progressed = False
+                    for _ in retryer:
+                        outcome, offset = self._push_snapshot_chunks(
+                            peer, term, index, snap_term, text, digest,
+                            total, servers, offset)
+                        if outcome == "done":
+                            return
+                        if outcome == "progress":
+                            progressed = True
+                            break  # fresh Retryer → backoff resets
+                    if not progressed:
                         return
-                    if self.state != LEADER:
-                        return
-                    if reply.get("success"):
+        except Exception:
+            log.exception("snapshot sender to %s crashed", peer)
+        finally:
+            with self._lock:
+                self._snap_inflight.discard(peer)
+
+    def _push_snapshot_chunks(self, peer: str, term: int, index: int,
+                              snap_term: int, text: str, digest: str,
+                              total: int, servers: dict, offset: int):
+        """Send frames from `offset` until the transfer completes, the
+        peer rewinds us, or the peer stops answering. Returns
+        (outcome, next_offset): "done" = finished or aborted for good,
+        "progress" = at least one frame landed before a None reply
+        (caller resets backoff), "retry" = unreachable with no
+        progress."""
+        chunk = self.snapshot_chunk_bytes
+        made_progress = False
+        while True:
+            with self._lock:
+                if (self._stop.is_set() or self.state != LEADER
+                        or self.current_term != term):
+                    return "done", offset
+            done = offset + chunk >= total
+            msg = {"kind": "install_snapshot", "term": term,
+                   "leader": self.id, "index": index,
+                   "snap_term": snap_term, "offset": offset,
+                   "data": text[offset:offset + chunk], "done": done}
+            if done:
+                msg["total"] = total
+                msg["digest"] = digest
+                msg["servers"] = servers
+            reply = self.transport.send(self.id, peer, msg)
+            if reply is None:
+                return ("progress" if made_progress else "retry"), offset
+            with self._lock:
+                if reply["term"] > self.current_term:
+                    self._become_follower_locked(reply["term"])
+                    return "done", offset
+                if self.state != LEADER or self.current_term != term:
+                    return "done", offset
+                self._last_contact[peer] = time.time()
+                if reply.get("success"):
+                    if "match_index" in reply:
+                        # follower finished the install (or already had
+                        # this index)
                         self._match_index[peer] = max(
                             self._match_index.get(peer, 0),
                             reply["match_index"])
                         self._next_index[peer] = self._match_index[peer] + 1
                         self._maybe_advance_commit_locked()
-            finally:
-                with self._lock:
-                    self._snap_inflight.discard(peer)
-
-        threading.Thread(target=send, daemon=True,
-                         name=f"raft-{self.id}-snap-{peer}").start()
+                        return "done", offset
+                    offset = reply.get("offset", offset + len(msg["data"]))
+                    made_progress = True
+                    continue
+                if "offset" in reply:
+                    # resume protocol: realign to where the follower is.
+                    # A rewind that makes no net progress (e.g. a disk
+                    # fault reset the sink to 0) backs off via the
+                    # caller's Retryer instead of hot-looping.
+                    new_off = reply["offset"]
+                    forward = new_off > offset
+                    offset = new_off
+                    if forward or made_progress:
+                        made_progress = True
+                        continue
+                    return "retry", offset
+                # hard refusal (no fsm_restore, stale term view): give up
+                return "done", offset
 
     def _maybe_advance_commit_locked(self) -> None:
         """Quorum commit via one sorted match-index pass (call with the

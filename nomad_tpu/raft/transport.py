@@ -438,9 +438,10 @@ class SocketTransport:
 
     def send(self, from_id: str, to_id: str, msg: dict) -> Optional[dict]:
         """Raft message send (transport interface). Snapshot installs get
-        their own channel: multi-MB frames need the long timeout, and the
-        short raft timeout exists precisely so heartbeats never wait on a
-        transfer like that."""
+        their own channel: even chunked frames (SNAPSHOT_CHUNK_BYTES per
+        install_snapshot message) are large enough to want the long
+        timeout, and the short raft timeout exists precisely so
+        heartbeats never wait on a transfer like that."""
         from ..structs.wire import wire_decode, wire_encode
 
         channel = "snap" if msg.get("kind") == "install_snapshot" else "raft"

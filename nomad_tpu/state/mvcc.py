@@ -174,7 +174,16 @@ class VersionedTable:
         return None if v is _TOMBSTONE else v
 
     def iterate(self, gen: int) -> Iterator[Tuple[Any, Any]]:
-        for key, row in self._rows.items():
+        # Materialize the key set first: snapshot readers (the off-lock
+        # raft snapshot worker) iterate concurrently with the single
+        # writer, and a dict grown mid-iteration raises. list(dict) is
+        # one atomic bytecode under the GIL; keys inserted after it
+        # carry gen > snapshot gen and would be skipped anyway, keys
+        # swept by GC read back as None.
+        for key in list(self._rows):
+            row = self._rows.get(key)
+            if row is None:
+                continue
             if type(row) is tuple:
                 if row[0] > gen:
                     continue

@@ -14,17 +14,24 @@
 #                                   cluster with tracing on: complete
 #                                   enqueue->commit span chain for
 #                                   every eval; kill switch span-free)
+#   scripts/check.sh --snap-smoke   also run the snapshot/compaction
+#                                   smoke (low snapshot threshold under
+#                                   e2e load; one follower wiped +
+#                                   restarted, catch-up via chunked
+#                                   install-snapshot, zero acked loss)
 set -u
 cd "$(dirname "$0")/.."
 
 run_e2e_smoke=0
 run_solve_smoke=0
 run_trace_smoke=0
+run_snap_smoke=0
 for arg in "$@"; do
     case "$arg" in
         --e2e-smoke) run_e2e_smoke=1 ;;
         --solve-smoke) run_solve_smoke=1 ;;
         --trace-smoke) run_trace_smoke=1 ;;
+        --snap-smoke) run_snap_smoke=1 ;;
         *) echo "unknown flag: $arg" >&2; exit 64 ;;
     esac
 done
@@ -116,6 +123,18 @@ if [ "$run_trace_smoke" = 1 ]; then
     echo "== trace smoke (python -m nomad_tpu.obs --trace-smoke) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 300 \
         python -m nomad_tpu.obs --trace-smoke || failed=1
+fi
+
+# snapshot/compaction smoke (opt-in, ~5s): the e2e pipeline with a low
+# snapshot threshold so every replica snapshots + compacts under load;
+# one follower is wiped after the leader compacts and must catch up
+# via the chunked install-snapshot path mid-traffic with zero
+# acked-commit loss and alloc-set uniqueness on every replica
+# (ROBUSTNESS.md "Durability at scale")
+if [ "$run_snap_smoke" = 1 ]; then
+    echo "== snap smoke (python -m nomad_tpu.chaos --snap-smoke) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 300 \
+        python -m nomad_tpu.chaos --snap-smoke || failed=1
 fi
 
 echo "== tier-1 tests =="

@@ -631,3 +631,96 @@ class TestLeaderCrashMidPlanBatchCommit:
             assert not stranded, \
                 f"evals stranded pending after failover: {stranded[:5]}"
             assert len(acked) >= jobs_n // 4  # really was mid-stream
+
+
+# ---------------------------------------------------------------------------
+# scenario: chunked install-snapshot transfer under network/process chaos
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotTransferChaos:
+    def test_wiped_follower_catches_up_through_dropped_frames(self, tmp_path):
+        """A follower that lost its disk can only recover via the
+        chunked install path; with frames dropped in transit the sender
+        must resume from the follower-reported offset until the whole
+        body lands and the digest verifies."""
+        import shutil
+
+        with RaftCluster(3, data_dir=str(tmp_path),
+                         snapshot_threshold=10) as cluster:
+            r = ScenarioRunner(cluster, seed=3)
+            leader = r.wait_for_leader()
+            for s in cluster.servers.values():
+                s.raft.snapshot_chunk_bytes = 128  # force many frames
+            nodes = [mock.node() for _ in range(30)]
+            for n in nodes:
+                leader.register_node(n)
+            _wait(lambda: leader.raft.log.base_index > 0, 10.0,
+                  msg="leader compaction")
+            leader_base = leader.raft.log.base_index
+            victim = cluster.followers()[0]
+            cluster.crash(victim.id)
+            shutil.rmtree(os.path.join(victim.data_dir, "raft"))
+            r.plan.set_link_faults(src=leader.id, dst=victim.id, drop=0.2)
+            cluster.restart(victim.id)
+            victim = cluster.servers[victim.id]
+
+            def caught_up():
+                return (len(list(victim.local_store.snapshot().nodes()))
+                        == len(nodes))
+            _wait(caught_up, 30.0,
+                  msg="wiped follower catch-up through dropped frames")
+            # an empty log cannot replay compacted entries: only the
+            # install path reaches a compacted base
+            assert victim.raft.log.base_index >= leader_base
+            assert r.plan.snapshot_stats()["dropped"] > 0, \
+                "the drop faults never bit — transfer not exercised"
+            r.heal_and_converge(timeout=20.0)
+            r.checker.check_all(cluster)
+
+    def test_leader_crash_mid_transfer_completes_from_new_leader(
+            self, tmp_path):
+        """Crash the leader while an install transfer is in flight: the
+        half-accumulated sink on the follower is superseded and the new
+        leader's transfer completes the catch-up (or, had no new leader
+        compacted, plain replication would — either way the follower
+        must converge with no torn state)."""
+        import shutil
+
+        with RaftCluster(3, data_dir=str(tmp_path),
+                         snapshot_threshold=10) as cluster:
+            r = ScenarioRunner(cluster, seed=4)
+            leader = r.wait_for_leader()
+            for s in cluster.servers.values():
+                s.raft.snapshot_chunk_bytes = 64
+            nodes = [mock.node() for _ in range(30)]
+            for n in nodes:
+                leader.register_node(n)
+            _wait(lambda: all(s.raft.log.base_index > 0
+                              for s in cluster.servers.values()), 10.0,
+                  msg="every replica compacted")
+            victim = cluster.followers()[0]
+            cluster.crash(victim.id)
+            shutil.rmtree(os.path.join(victim.data_dir, "raft"))
+            # heavy drops stretch the transfer so the crash lands inside
+            r.plan.set_link_faults(src=leader.id, dst=victim.id, drop=0.6)
+            cluster.restart(victim.id)
+            victim = cluster.servers[victim.id]
+            _wait(lambda: victim.raft._snap_rx is not None
+                  or victim.raft.log.base_index > 0, 15.0,
+                  msg="transfer reached the follower")
+            old_leader = leader.id
+            cluster.crash(old_leader)
+            r.plan.clear_faults()
+            _wait(lambda: cluster.leader() is not None
+                  and cluster.leader().id != old_leader, 20.0,
+                  msg="new leader after crash")
+
+            def caught_up():
+                return (len(list(victim.local_store.snapshot().nodes()))
+                        == len(nodes))
+            _wait(caught_up, 30.0, msg="catch-up completed by new leader")
+            assert victim.raft.log.base_index > 0
+            cluster.restart(old_leader)
+            r.heal_and_converge(timeout=20.0)
+            r.checker.check_all(cluster)

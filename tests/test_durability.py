@@ -12,7 +12,12 @@ import pytest
 
 from nomad_tpu import mock
 from nomad_tpu.raft import RaftCluster, RaftNode
-from nomad_tpu.raft.durable import DurableLog, SnapshotStore, StableStore
+from nomad_tpu.raft.durable import (
+    DurableLog,
+    SnapshotStore,
+    StableStore,
+    snapshot_digest,
+)
 from nomad_tpu.raft.log import Entry
 from nomad_tpu.raft.transport import InProcTransport
 from nomad_tpu.state import StateStore
@@ -297,6 +302,38 @@ class TestClusterDurability:
                 return len(allocs) == 10
             assert _wait(placed2, 15.0), "scheduling must resume after restart"
 
+    def test_wiped_follower_catches_up_via_chunked_install(self, tmp_path):
+        """A follower that lost its disk entirely can only recover via
+        the chunked install path once the leader compacted; force many
+        frames with a tiny chunk size."""
+        d = str(tmp_path)
+        with RaftCluster(3, data_dir=d, snapshot_threshold=10) as cluster:
+            leader = cluster.wait_for_leader()
+            assert leader is not None
+            for s in cluster.servers.values():
+                s.raft.snapshot_chunk_bytes = 256
+            mock_nodes = [mock.node() for _ in range(30)]
+            for n in mock_nodes:
+                leader.server.register_node(n)
+            assert _wait(lambda: leader.raft.log.base_index > 0, 10.0)
+            leader_base = leader.raft.log.base_index
+            victim = cluster.followers()[0]
+            cluster.crash(victim.id)
+            import shutil
+            shutil.rmtree(os.path.join(victim.data_dir, "raft"))
+            cluster.restart(victim.id)
+            victim = cluster.servers[victim.id]
+
+            def caught_up():
+                return (len(list(victim.local_store.snapshot().nodes()))
+                        == len(mock_nodes))
+            assert _wait(caught_up, 15.0), \
+                "wiped follower should catch up via chunked install"
+            # an empty log cannot replay compacted entries: the only way
+            # to a compacted base is the install path
+            assert victim.raft.log.base_index >= leader_base
+            assert victim.raft.snapshots.load()["index"] >= leader_base
+
     def test_lagging_follower_catches_up_via_install_snapshot(self, tmp_path):
         d = str(tmp_path)
         with RaftCluster(3, data_dir=d, snapshot_threshold=10) as cluster:
@@ -320,3 +357,210 @@ class TestClusterDurability:
             assert _wait(caught_up, 15.0), \
                 "partitioned follower should catch up from the snapshot"
             assert lagger.raft.log.base_index >= leader.raft.log.base_index - 30
+
+
+# ---------------------------------------------------------------------------
+# chunked install protocol (follower side, driven frame by frame)
+# ---------------------------------------------------------------------------
+
+
+def _src_dump(n_nodes=3):
+    """A small source store + its snapshot text, as the leader would
+    serialize it for a chunked transfer."""
+    src = StateStore()
+    ids = []
+    for _ in range(n_nodes):
+        n = mock.node()
+        ids.append(n.id)
+        src.upsert_node(n)
+    return json.dumps(dump_store(src)), ids
+
+
+def _frames(text, chunk, *, term=1, leader="n9", index=50, snap_term=1):
+    """The exact frame sequence RaftNode._push_snapshot_chunks emits."""
+    frames, off = [], 0
+    while True:
+        data = text[off:off + chunk]
+        done = off + chunk >= len(text)
+        msg = {"kind": "install_snapshot", "term": term, "leader": leader,
+               "index": index, "snap_term": snap_term,
+               "offset": off, "data": data, "done": done}
+        if done:
+            msg["total"] = len(text)
+            msg["digest"] = snapshot_digest(text)
+        frames.append(msg)
+        off += len(data)
+        if done:
+            return frames
+
+
+class TestChunkedInstallProtocol:
+    def test_multi_frame_install_restores_and_resets_log(self, tmp_path):
+        d = str(tmp_path / "n0")
+        node, store, _ = _durable_node(d)
+        text, ids = _src_dump()
+        frames = _frames(text, chunk=64, index=50)
+        assert len(frames) > 3  # actually multi-frame
+        for msg in frames[:-1]:
+            reply = node._on_install_snapshot(msg)
+            assert reply["success"] is True
+            assert reply["offset"] == msg["offset"] + len(msg["data"])
+        final = node._on_install_snapshot(frames[-1])
+        assert final["success"] is True
+        assert final["match_index"] == 50
+        assert {n.id for n in store.snapshot().nodes()} == set(ids)
+        assert node.last_applied == 50
+        assert node.log.base_index == 50
+        assert node.snapshots.load()["index"] == 50
+        # the staging file is gone; only the real snapshot remains
+        assert not os.path.exists(os.path.join(d, "snapshot.json.partial"))
+        node.log.close()
+
+    def test_offset_mismatch_rewinds_then_resumes(self, tmp_path):
+        d = str(tmp_path / "n0")
+        node, store, _ = _durable_node(d)
+        text, ids = _src_dump()
+        frames = _frames(text, chunk=64)
+        assert node._on_install_snapshot(frames[0])["success"] is True
+        # frame 1 lost in transit; frame 2 arrives at the wrong offset
+        reply = node._on_install_snapshot(frames[2])
+        assert reply["success"] is False
+        assert reply["offset"] == len(frames[0]["data"])
+        # leader rewinds to the reported offset and finishes
+        for msg in frames[1:]:
+            reply = node._on_install_snapshot(msg)
+            assert reply["success"] is True
+        assert reply["match_index"] == frames[-1]["index"]
+        assert {n.id for n in store.snapshot().nodes()} == set(ids)
+        node.log.close()
+
+    def test_digest_mismatch_rejected_old_state_intact(self, tmp_path):
+        d = str(tmp_path / "n0")
+        node, store, _ = _durable_node(d)
+        text, _ids = _src_dump()
+        frames = _frames(text, chunk=64)
+        frames[-1]["digest"] = "0" * 64
+        for msg in frames[:-1]:
+            assert node._on_install_snapshot(msg)["success"] is True
+        reply = node._on_install_snapshot(frames[-1])
+        assert reply["success"] is False
+        assert reply["offset"] == 0  # full restart of the transfer
+        # nothing restored, nothing truncated, no snapshot written
+        assert list(store.snapshot().nodes()) == []
+        assert node.last_applied == 0
+        assert node.log.base_index == 0
+        assert node.snapshots.load() is None
+        node.log.close()
+
+    def test_truncated_body_rejected_by_total_check(self, tmp_path):
+        d = str(tmp_path / "n0")
+        node, store, _ = _durable_node(d)
+        text, _ids = _src_dump()
+        frames = _frames(text, chunk=64)
+        # final frame claims done without the middle of the body
+        last = dict(frames[-1])
+        last["offset"] = len(frames[0]["data"])
+        assert node._on_install_snapshot(frames[0])["success"] is True
+        reply = node._on_install_snapshot(last)
+        assert reply["success"] is False
+        assert node.last_applied == 0
+        node.log.close()
+
+    def test_chunk_write_fault_drops_transfer_then_recovers(self, tmp_path):
+        from nomad_tpu.chaos import FSFaults
+
+        d = str(tmp_path / "n0")
+        node, store, _ = _durable_node(d)
+        text, ids = _src_dump()
+        frames = _frames(text, chunk=64)
+        fs = FSFaults()
+        fs.arm("snap_chunk", count=1)
+        with fs.installed():
+            reply = node._on_install_snapshot(frames[0])
+        assert reply["success"] is False
+        assert reply["offset"] == 0  # sink discarded, restart from zero
+        assert fs.stats["raised"] == 1
+        # with the disk healthy again the same transfer completes
+        for msg in frames:
+            reply = node._on_install_snapshot(msg)
+            assert reply["success"] is True
+        assert reply["match_index"] == frames[-1]["index"]
+        assert {n.id for n in store.snapshot().nodes()} == set(ids)
+        node.log.close()
+
+    def test_stale_term_chunk_refused(self, tmp_path):
+        d = str(tmp_path / "n0")
+        node, _store, _ = _durable_node(d)
+        node.current_term = 5
+        text, _ids = _src_dump()
+        msg = _frames(text, chunk=1 << 20, term=4)[0]
+        reply = node._on_install_snapshot(msg)
+        assert reply["success"] is False
+        assert reply["term"] == 5
+        node.log.close()
+
+    def test_crash_between_save_and_reset_to_recovers(self, tmp_path):
+        """_install_locked persists the snapshot BEFORE truncating the
+        log; a crash exactly between the two must restore the installed
+        state on restart (the stale log prefix is skippable because the
+        snapshot's base supersedes it)."""
+        d = str(tmp_path / "n0")
+        os.makedirs(d, exist_ok=True)
+        log = DurableLog(d)
+        for i in range(5):
+            log.append(1, ("compact", (i,), {}))
+        log.close()
+        src = StateStore()
+        nodes = [mock.node() for _ in range(3)]
+        for n in nodes:
+            src.upsert_node(n)
+        # the install's first step landed, then the process died
+        SnapshotStore(d).save(50, 1, dump_store(src))
+
+        node, store, _ = _durable_node(d)
+        assert node.last_applied == 50
+        assert node.log.base_index == 50
+        assert {n.id for n in store.snapshot().nodes()} == \
+            {n.id for n in nodes}
+        node.log.close()
+
+    def test_torn_snapshot_file_dropped_with_warning(self, tmp_path, caplog):
+        import logging
+
+        d = str(tmp_path / "n0")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "snapshot.json"), "w") as f:
+            f.write('{"index": 50, "term": 1, "data": {"form')  # torn
+        with caplog.at_level(logging.WARNING, logger="nomad_tpu.raft"):
+            node, store, _ = _durable_node(d)
+        assert any("unreadable snapshot" in r.message for r in caplog.records)
+        # starts empty and functional instead of bricked
+        assert node.last_applied == 0
+        assert node.snapshots.load() is None
+        node.log.close()
+
+
+class TestSnapshotStoreFaults:
+    def test_only_if_newer_rejects_stale_write(self, tmp_path):
+        d = str(tmp_path)
+        s = SnapshotStore(d)
+        assert s.save(50, 1, {"format": 1, "index": 50}) is True
+        # the async worker lost the race against an install at 50
+        assert s.save(30, 1, {"format": 1, "index": 30},
+                      only_if_newer=True) is False
+        assert s.load()["index"] == 50
+
+    def test_save_fault_leaves_previous_snapshot_loadable(self, tmp_path):
+        from nomad_tpu.chaos import FSFaults
+
+        d = str(tmp_path)
+        s = SnapshotStore(d)
+        s.save(50, 1, {"format": 1, "index": 50})
+        fs = FSFaults()
+        fs.arm("atomic_write_text", path_substr="snapshot.json")
+        with fs.installed():
+            with pytest.raises(OSError):
+                s.save(80, 1, {"format": 1, "index": 80})
+        assert s.load()["index"] == 50  # old state intact
+        assert s.save(80, 1, {"format": 1, "index": 80}) is True
+        assert s.load()["index"] == 80

@@ -1,0 +1,185 @@
+"""Snapshot format migration: the FORMAT=2 columnar writer/reader and
+the FORMAT=1 legacy path must round-trip the same store, and a
+format-1 dump (what the previous release wrote) must restore
+bit-identically through the new reader (nomad_tpu/state/persist.py).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.state import StateStore
+from nomad_tpu.state.persist import dump_store, restore_store
+from nomad_tpu.structs import enums
+from nomad_tpu.structs.alloc import AllocBlock, Allocation
+
+
+def _populated_store(n_allocs: int, n_nodes: int = 24) -> StateStore:
+    """Nodes + jobs + n_allocs real alloc rows: every 7th terminal,
+    every 11th carrying device instances + reserved cores (the sparse
+    `extras` path), the rest plain running allocs."""
+    store = StateStore()
+    nodes = []
+    for _ in range(n_nodes):
+        n = mock.node()
+        n.compute_class()
+        nodes.append(n)
+        store.upsert_node(n)
+    jobs = [mock.job() for _ in range(max(1, min(4, n_allocs)))]
+    for j in jobs:
+        store.upsert_job(j)
+    allocs = []
+    for i in range(n_allocs):
+        a = mock.alloc(jobs[i % len(jobs)], nodes[i % n_nodes], index=i)
+        if i % 7 == 3:
+            a.desired_status = enums.ALLOC_DESIRED_STOP
+            a.client_status = enums.ALLOC_CLIENT_COMPLETE
+        if i % 11 == 5:
+            a.allocated_devices = {"nvidia/gpu/t4": [f"inst-{i}-0",
+                                                     f"inst-{i}-1"]}
+            a.allocated_cores = [0, 1]
+        allocs.append(a)
+    if allocs:
+        store.upsert_allocs(allocs)
+    return store
+
+
+def _normalize(dump: dict) -> str:
+    """Canonical JSON text of a dump: row order inside each table is a
+    dict-iteration artifact, so sort rows before comparing bytes."""
+    out = {}
+    for key, val in dump.items():
+        if isinstance(val, list):
+            out[key] = sorted(json.dumps(row, sort_keys=True)
+                              for row in val)
+        else:
+            out[key] = val
+    return json.dumps(out, sort_keys=True)
+
+
+def _usage_parity(s1: StateStore, s2: StateStore) -> None:
+    snap1, snap2 = s1.snapshot(), s2.snapshot()
+    for n in snap1.nodes():
+        u1, u2 = snap1.node_usage(n.id), snap2.node_usage(n.id)
+        if u1 is None or not np.asarray(u1).any():
+            assert u2 is None or not np.asarray(u2).any(), n.id
+        else:
+            assert u2 is not None and np.allclose(u1, u2), n.id
+        assert snap1.node_dev_usage(n.id) == snap2.node_dev_usage(n.id)
+
+
+class TestFormat1Migration:
+    def test_format1_dump_restores_bit_identically(self):
+        """A dump the previous release wrote (FORMAT=1, per-row allocs)
+        must survive the wire (json text), restore through the new
+        reader, and re-dump to the identical bytes."""
+        store = _populated_store(60)
+        d1 = json.loads(json.dumps(dump_store(store, fmt=1)))
+        assert d1["format"] == 1
+        s2 = StateStore()
+        restore_store(s2, d1)
+        d2 = dump_store(s2, fmt=1)
+        assert _normalize(d2) == _normalize(d1)
+        _usage_parity(store, s2)
+
+    def test_unsupported_format_rejected(self):
+        with pytest.raises(ValueError, match="unsupported snapshot"):
+            restore_store(StateStore(), {"format": 3, "index": 1})
+        with pytest.raises(ValueError, match="cannot write"):
+            dump_store(StateStore(), fmt=7)
+
+
+class TestFormat2RoundTrip:
+    @pytest.mark.parametrize("n_allocs", [0, 1, 10_000])
+    def test_roundtrip_preserves_allocs_and_usage(self, n_allocs):
+        store = _populated_store(n_allocs)
+        text = json.dumps(dump_store(store))
+        d = json.loads(text)
+        assert d["format"] == 2
+        s2 = StateStore()
+        restore_store(s2, d)
+
+        snap1, snap2 = store.snapshot(), s2.snapshot()
+        a1 = {a.id: a for a in snap1.allocs()}
+        a2 = {a.id: a for a in snap2.allocs()}
+        assert len(a1) == n_allocs and a1.keys() == a2.keys()
+        for aid, a in a1.items():
+            b = a2[aid]
+            assert (a.node_id, a.job_id, a.name) == \
+                (b.node_id, b.job_id, b.name)
+            assert (a.desired_status, a.client_status) == \
+                (b.desired_status, b.client_status)
+            assert a.terminal_status() == b.terminal_status()
+            assert np.allclose(a.allocated_vec, b.allocated_vec)
+            assert a.allocated_devices == b.allocated_devices
+            assert a.allocated_cores == b.allocated_cores
+        assert {n.id for n in snap1.nodes()} == \
+            {n.id for n in snap2.nodes()}
+        assert {j.id for j in snap1.jobs()} == {j.id for j in snap2.jobs()}
+        _usage_parity(store, s2)
+        # restore lands exactly at the dump's index (replay determinism)
+        assert s2.latest_index == d["index"]
+
+    def test_roundtrip_with_blocks_and_promoted_rows(self):
+        """AllocBlocks ride format 2 natively; a promoted row must come
+        back as the real row, indexed exactly once, with the block's
+        usage contribution excluding it."""
+        store = StateStore()
+        nodes = []
+        for _ in range(8):
+            n = mock.node()
+            n.compute_class()
+            nodes.append(n)
+            store.upsert_node(n)
+        job = mock.batch_job()
+        job.task_groups[0].count = 32
+        store.upsert_job(job)
+        vec = np.zeros_like(mock.alloc(job, nodes[0]).allocated_vec)
+        vec[0] = 50.0
+        vec[1] = 32.0
+        block = AllocBlock(
+            id="blk-rt", eval_id="ev-rt", namespace=job.namespace,
+            job_id=job.id, job=job, job_version=job.version,
+            task_group=job.task_groups[0].name,
+            name_indices=np.arange(32, dtype=np.int64),
+            node_ids=[n.id for n in nodes[:4]],
+            node_names=[n.name for n in nodes[:4]],
+            counts=np.full(4, 8, dtype=np.int64),
+            allocated_vec=vec,
+        )
+        store.upsert_plan_results([], alloc_blocks=[block], job=job)
+        # promote one block position into a real row via a client update
+        target = store.snapshot().allocs_by_job(job.id)[0]
+        store.update_allocs_from_client([Allocation(
+            id=target.id, client_status=enums.ALLOC_CLIENT_COMPLETE)])
+
+        d = json.loads(json.dumps(dump_store(store)))
+        s2 = StateStore()
+        restore_store(s2, d)
+        snap1, snap2 = store.snapshot(), s2.snapshot()
+        assert len(list(snap2.alloc_blocks())) == 1
+        by_job = snap2.allocs_by_job(job.id)
+        assert len(by_job) == 32
+        # the promoted row shadows its block position exactly once
+        assert sum(1 for a in by_job if a.id == target.id) == 1
+        assert snap2.alloc_by_id(target.id).client_status == \
+            enums.ALLOC_CLIENT_COMPLETE
+        _usage_parity(store, s2)
+        # the terminal promoted row releases its usage in both stores
+        u1 = np.asarray(snap1.node_usage(target.node_id))
+        u2 = np.asarray(snap2.node_usage(target.node_id))
+        assert np.allclose(u1, u2)
+
+    def test_format1_and_format2_restore_identical_state(self):
+        """Both writers over the same store restore to stores whose
+        format-1 dumps match — the columnar encoding is lossless."""
+        store = _populated_store(120)
+        s_v1, s_v2 = StateStore(), StateStore()
+        restore_store(s_v1, json.loads(json.dumps(dump_store(store,
+                                                             fmt=1))))
+        restore_store(s_v2, json.loads(json.dumps(dump_store(store))))
+        assert _normalize(dump_store(s_v1, fmt=1)) == \
+            _normalize(dump_store(s_v2, fmt=1))
+        _usage_parity(s_v1, s_v2)

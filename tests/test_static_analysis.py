@@ -153,8 +153,8 @@ def test_thread_entrypoint_discovery():
     # known entrypoints the pass must see
     targets = {(s.module_rel, s.target) for s in sites}
     assert ("nomad_tpu/core/worker.py", "self.run") in targets
-    assert any(rel == "nomad_tpu/raft/node.py" and t == "send"
-               for rel, t in targets)  # snapshot-send closure
+    assert ("nomad_tpu/raft/node.py", "self._snapshot_sender") in targets
+    assert ("nomad_tpu/raft/node.py", "self._snapshot_worker") in targets
 
 
 def test_san_ok_comment_suppresses(tmp_path):
