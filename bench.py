@@ -1329,6 +1329,124 @@ def cfg_trace_ab() -> None:
          phase_p50_ms=phases)
 
 
+def cfg_swarm_heartbeat() -> None:
+    """Client-plane swarm rung (ROBUSTNESS.md "Client plane"): one
+    server driven through the batch heartbeat surface by 4 swarm-style
+    driver threads at 10K/50K/100K registered sim nodes. heartbeats/s is
+    the sustained `heartbeat_batch` rate over the whole fleet at 100K;
+    vs_baseline is the sharded (8 timer-wheel shards) over single-shard
+    (the old one-global-lock shape) A/B at 100K. Also reports the delta
+    alloc-push fan-out latency (store commit -> AllocSyncHub subscriber
+    delivery) p50/p99 while the fleet keeps heartbeating."""
+    import statistics
+    import threading
+
+    from nomad_tpu import mock
+    from nomad_tpu.chaos.swarm import make_sim_node
+    from nomad_tpu.core.server import Server, ServerConfig
+
+    sizes = (10_000, 50_000, 100_000)
+    drivers_n, chunk = 4, 1024
+
+    def build_server(shards: int) -> Server:
+        return Server(ServerConfig(
+            num_workers=1, heartbeat_ttl=3600.0, heartbeat_shards=shards,
+            gc_interval=3600.0, nack_timeout=900.0,
+            failed_eval_followup_delay=3600.0))
+
+    def make_fleet(n: int) -> list:
+        first = make_sim_node(0)
+        first.compute_class()
+        fleet = [first]
+        for i in range(1, n):
+            node = make_sim_node(i)
+            node.computed_class = first.computed_class
+            fleet.append(node)
+        return fleet
+
+    def hb_rate(srv: Server, ids: list, window: float = 1.5) -> float:
+        stop = threading.Event()
+        counts = [0] * drivers_n
+
+        def drive(k: int) -> None:
+            part = ids[k::drivers_n]
+            while not stop.is_set():
+                for start in range(0, len(part), chunk):
+                    batch = part[start:start + chunk]
+                    srv.heartbeat_batch(batch)
+                    counts[k] += len(batch)
+                    if stop.is_set():
+                        return
+
+        threads = [threading.Thread(target=drive, args=(k,), daemon=True)
+                   for k in range(drivers_n)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(window)
+        stop.set()
+        for t in threads:
+            t.join()
+        return sum(counts) / (time.perf_counter() - t0)
+
+    fleet = make_fleet(sizes[-1])
+    ids = [n.id for n in fleet]
+
+    rates = {}
+    with build_server(8) as srv:
+        done = 0
+        for size in sizes:
+            srv.store.upsert_nodes(fleet[done:size])
+            done = size
+            rates[size] = hb_rate(srv, ids[:size])
+
+        # delta alloc-push fan-out while the full fleet keeps beating
+        stop = threading.Event()
+        noise = threading.Thread(
+            target=lambda: [srv.heartbeat_batch(ids[s:s + chunk])
+                            for s in range(0, len(ids), chunk)
+                            if not stop.is_set()] and None,
+            daemon=True)
+        noise.start()
+        sampled = fleet[::12500]  # 8 nodes spread across the shards
+        sub = srv.alloc_sync.subscribe([n.id for n in sampled])
+        lats = []
+        try:
+            j = mock.job()
+            for i in range(120):
+                a = mock.alloc(j, sampled[i % len(sampled)])
+                t0 = time.perf_counter()
+                srv.store.upsert_allocs([a])
+                deadline = time.time() + 10.0
+                got = False
+                while not got and time.time() < deadline:
+                    batch, resync = sub.poll(timeout=1.0)
+                    got = resync or any(x.id == a.id for x in batch)
+                if not got:
+                    raise RuntimeError("alloc push never delivered")
+                lats.append((time.perf_counter() - t0) * 1e3)
+        finally:
+            sub.close()
+            stop.set()
+            noise.join(timeout=10.0)
+    q = statistics.quantiles(lats, n=100)
+    push_p50, push_p99 = q[49], q[98]
+
+    with build_server(1) as srv:
+        srv.store.upsert_nodes(fleet)
+        single_rate = hb_rate(srv, ids)
+
+    emit("swarm_heartbeat_100k", rates[sizes[-1]], "heartbeats/s",
+         rates[sizes[-1]] / max(single_rate, 1e-9),
+         heartbeats_s_10k=round(rates[10_000], 1),
+         heartbeats_s_50k=round(rates[50_000], 1),
+         heartbeats_s_100k=round(rates[100_000], 1),
+         single_shard_100k=round(single_rate, 1),
+         alloc_push_p50_ms=round(push_p50, 3),
+         alloc_push_p99_ms=round(push_p99, 3),
+         shards=8, drivers=drivers_n, rpc_batch=chunk)
+
+
 CONFIGS = [
     # before the headline: a driver timeout must not eat the raft rung
     ("raft3", raft_commit_throughput_3node),
@@ -1345,6 +1463,7 @@ CONFIGS = [
     ("cfg5", cfg5_devices_numa),
     ("cfg6", cfg6_applier_5k),
     ("cfg7", cfg7_sharded_5k),
+    ("swarm_heartbeat", cfg_swarm_heartbeat),
 ]
 
 

@@ -893,7 +893,8 @@ def _preload() -> None:
     import tempfile  # noqa: F401
 
     from ..chaos import fsfaults, invariants  # noqa: F401
-    from ..core import broker, plan_apply  # noqa: F401
+    from ..core import broker, heartbeat, metrics, plan_apply  # noqa: F401
+    from ..obs import trace  # noqa: F401
     from ..raft import durable, fsm, node, transport  # noqa: F401
     from ..structs import evaluation  # noqa: F401
     from . import ownership  # noqa: F401
@@ -1650,9 +1651,96 @@ def _scenario_store_ownership(env: ScenarioEnv) -> None:
             ownership.uninstall()
 
 
+@scenario("node_lifecycle")
+def _scenario_node_lifecycle(env: ScenarioEnv) -> None:
+    """The sharded HeartbeatManager under adversarial interleavings: a
+    client heartbeating across its TTL, a remove() racing the expiry
+    sweep, and a failover restore() with duplicate/ghost ids — all
+    against the shard threads. Asserts: a removed node is NEVER marked
+    down, a heartbeating node is marked down only after a real silence
+    >= TTL since its last beat, restored ids expire exactly once each,
+    and every entry in the expiry attribution log spans >= TTL."""
+    from ..core.heartbeat import HeartbeatManager
+
+    ttl = 1.0
+    marks: List[tuple] = []            # (node_id, monotonic mark time)
+    marks_lock = threading.Lock()
+
+    class _HBServer:
+        def mark_nodes_down(self, node_ids, reason=""):
+            now = time.monotonic()
+            with marks_lock:
+                for nid in node_ids:
+                    marks.append((nid, now))
+
+        def mark_node_down(self, node_id, reason=""):
+            self.mark_nodes_down([node_id], reason=reason)
+
+    mgr = HeartbeatManager(_HBServer(), ttl=ttl, shards=2, expiry_rate=0.0)
+    mgr.set_enabled(True)
+    try:
+        beat_times: List[float] = []
+
+        def beater() -> None:
+            for _ in range(6):
+                mgr.reset("alive")
+                beat_times.append(time.monotonic())
+                time.sleep(ttl * 0.4)
+
+        def remover() -> None:
+            mgr.reset("removed")
+            time.sleep(ttl * 0.3)
+            mgr.remove("removed")
+
+        def restorer() -> None:
+            time.sleep(ttl * 0.2)
+            if mgr.restore(["dup", "dup", "ghost", ""]) != 2:
+                raise AssertionError("restore armed wrong timer count")
+
+        threads = [threading.Thread(target=beater, name="hb-beater"),
+                   threading.Thread(target=remover, name="hb-remover"),
+                   threading.Thread(target=restorer, name="hb-restorer")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # everything has gone silent now; give every armed timer (last
+        # "alive" beat + restore grace) room to fire
+        time.sleep(ttl * 3.0)
+
+        with marks_lock:
+            down = list(marks)
+        by_id: Dict[str, List[float]] = {}
+        for nid, at in down:
+            by_id.setdefault(nid, []).append(at)
+        if "removed" in by_id:
+            raise AssertionError(
+                "remove()d node was marked down anyway (lost-removal "
+                "race with the expiry sweep)")
+        for nid in ("alive", "dup", "ghost"):
+            if len(by_id.get(nid, [])) != 1:
+                raise AssertionError(
+                    f"{nid!r} marked down {len(by_id.get(nid, []))} "
+                    f"times, want exactly 1: {by_id}")
+        if by_id["alive"][0] < beat_times[-1] + ttl * 0.95:
+            raise AssertionError(
+                f"'alive' expired {by_id['alive'][0] - beat_times[-1]:.3f}s "
+                f"after its last beat — a missed-TTL false positive")
+        for nid, armed_at, expired_at in mgr.expiry_snapshot():
+            if expired_at - armed_at < ttl * 0.95:
+                raise AssertionError(
+                    f"attribution log shows {nid!r} expired only "
+                    f"{expired_at - armed_at:.3f}s after arming")
+        if mgr.active() != 0:
+            raise AssertionError(
+                f"{mgr.active()} timers still armed after the sweep")
+    finally:
+        mgr.set_enabled(False)
+
+
 SMOKE_SCENARIOS = ("raft_commit", "raft_stepdown", "snapshot_compact",
                    "plan_pipeline", "broker_batch", "solve_batch",
-                   "store_ownership")
+                   "store_ownership", "node_lifecycle")
 
 
 def smoke(base_seed: int, seeds_per_scenario: int = 3,

@@ -6,6 +6,8 @@
     python -m nomad_tpu.chaos --e2e-smoke
     python -m nomad_tpu.chaos --solve-smoke
     python -m nomad_tpu.chaos --snap-smoke
+    python -m nomad_tpu.chaos --swarm-smoke
+    python -m nomad_tpu.chaos --swarm-scale [N]
 
 Exit 0 when every invariant holds; 2 on a violation (the CI gate in
 scripts/check.sh). This is the smallest end-to-end proof that the
@@ -36,7 +38,20 @@ snapshots + compacts under load); one follower is crashed and wiped
 after the leader compacts, and the restart must catch up via the
 chunked install-snapshot path mid-traffic — zero acked-commit loss and
 alloc-set uniqueness on every replica (the scripts/check.sh
---snap-smoke gate; ROBUSTNESS.md "Durability at scale")."""
+--snap-smoke gate; ROBUSTNESS.md "Durability at scale").
+
+`--swarm-smoke` runs the client-plane swarm smoke: 200 sim nodes
+speaking the real register/heartbeat-batch/alloc-ack surface while a
+churn loop flaps a rolling slice and THREE leaders crash in sequence —
+no stable node is ever wrongly expired, silenced nodes expire only
+after a real >= TTL silence and recover on their next beat, and every
+replica passes check_node_liveness + alloc uniqueness (the
+scripts/check.sh --swarm-smoke gate; ROBUSTNESS.md "Client plane").
+
+`--swarm-scale [N]` runs the fleet-scale acceptance smoke: N (default
+50,000) sim nodes heartbeating at the production TTL against a live
+3-node cluster WHILE the e2e pipeline runs, one leader crash/failover
+mid-stream — zero missed-TTL false positives on any replica."""
 
 from __future__ import annotations
 
@@ -44,6 +59,7 @@ import argparse
 import logging
 import sys
 import tempfile
+import threading
 import time
 
 from .. import mock
@@ -623,6 +639,391 @@ def snap_smoke(jobs_n: int = 200, nodes_n: int = 60, workers: int = 4,
     return 0
 
 
+def swarm_smoke(nodes_n: int = 200, ttl: float = 2.0,
+                crashes: int = 3) -> int:
+    """Client-plane flap-churn smoke (scripts/check.sh --swarm-smoke):
+    200 sim nodes heartbeating through the batch endpoints while a
+    churn loop registers/deregisters a rolling slice and THREE leaders
+    crash in sequence. Asserts: no stable node is ever wrongly marked
+    down (check_node_liveness on every replica), silenced nodes expire
+    only after a real >= TTL silence and recover on their next beat,
+    allocs pushed to sim nodes are acked without loss, and the
+    alloc-uniqueness + safety invariants hold."""
+    import shutil
+
+    from ..core.server import ServerConfig
+    from ..raft.cluster import RaftCluster
+    from ..structs import enums as _enums
+    from .invariants import InvariantChecker
+    from .swarm import Swarm
+
+    t0 = time.monotonic()
+
+    def config_fn(_i: int) -> ServerConfig:
+        return ServerConfig(
+            num_workers=2, plan_commit_batching=True, eval_batch_size=8,
+            heartbeat_ttl=ttl, heartbeat_shards=4,
+            heartbeat_expiry_rate=128.0,
+            gc_interval=3600.0, nack_timeout=900.0,
+            failed_eval_followup_delay=3600.0,
+            failed_eval_unblock_interval=0.5)
+
+    tmp = tempfile.mkdtemp(prefix="nomad-swarm-smoke-")
+    checker = InvariantChecker()
+    try:
+        cluster = RaftCluster(3, config_fn=config_fn, data_dir=tmp)
+        cluster.start()
+        stop_churn = threading.Event()
+        churn_thread = None
+        swarm = None
+        try:
+            leader = cluster.wait_for_leader(timeout=15.0)
+            if leader is None:
+                print("SWARM SMOKE: FAIL — no leader elected")
+                return 2
+
+            def entry():
+                return cluster.leader()
+
+            swarm = Swarm(entry, nodes_n, ttl=ttl, interval=ttl / 4.0,
+                          drivers=2, rpc_batch=64, ack=True)
+            if swarm.register_all(chunk=50) != nodes_n:
+                print("SWARM SMOKE: FAIL — fleet registration timed out")
+                return 2
+
+            # stable population: never churned, never silenced — these
+            # must NEVER be marked down across all three failovers
+            churn_pool = swarm.nodes[-60:]
+            silence_pool = swarm.nodes[:20]
+            stable = swarm.nodes[20:-60]
+
+            # a real workload rides the sim nodes: its allocs must be
+            # pushed out via delta sync and acked back without loss
+            for _ in range(30):
+                j = mock.job()
+                j.task_groups[0].count = 2
+                j.task_groups[0].tasks[0].resources.cpu = 50
+                j.task_groups[0].tasks[0].resources.memory_mb = 32
+                leader.register_job(j)
+
+            swarm.start()
+
+            def churn():
+                i = 0
+                while not stop_churn.is_set():
+                    batch = churn_pool[i % 3::3]
+                    swarm.deregister(batch)
+                    if stop_churn.wait(0.3):
+                        return
+                    swarm.register_all(chunk=50, deadline_s=20.0,
+                                       subset=batch)
+                    if stop_churn.wait(0.3):
+                        return
+                    i += 1
+
+            churn_thread = threading.Thread(target=churn, daemon=True,
+                                            name="swarm-churn")
+            churn_thread.start()
+
+            for round_i in range(crashes):
+                victim = cluster.wait_for_leader(timeout=15.0)
+                if victim is None:
+                    print("SWARM SMOKE: FAIL — lost the leader before "
+                          f"crash round {round_i}")
+                    return 2
+                cluster.crash(victim.id)
+                fresh = cluster.wait_for_leader(timeout=20.0)
+                if fresh is None:
+                    print("SWARM SMOKE: FAIL — no leader after crash "
+                          f"round {round_i}")
+                    return 2
+                cluster.restart(victim.id)
+                # let the fleet beat through the new leader's grace
+                # window before sweeping
+                time.sleep(ttl * 1.5)
+                checker.check_all(cluster)
+                checker.check_node_liveness(cluster, swarm=swarm, ttl=ttl)
+
+            stop_churn.set()
+            churn_thread.join(timeout=30.0)
+
+            # no stable node may ever have been wrongly expired
+            leader = cluster.wait_for_leader(timeout=15.0)
+            deadline = time.time() + 60
+            stable_ids = {sn.id for sn in stable}
+            while True:
+                snap = leader.local_store.snapshot()
+                bad = [n.id for n in snap.nodes()
+                       if n.id in stable_ids
+                       and n.status != _enums.NODE_STATUS_READY]
+                if not bad:
+                    break
+                if time.time() > deadline:
+                    print(f"SWARM SMOKE: FAIL — {len(bad)} stable "
+                          f"node(s) not ready after churn+crashes: "
+                          f"{bad[:5]}")
+                    return 2
+                time.sleep(0.2)
+
+            # silenced nodes must expire (real silence >= TTL)...
+            swarm.silence(silence_pool)
+            silence_ids = {sn.id for sn in silence_pool}
+            deadline = time.time() + ttl * 10 + 30
+            while True:
+                snap = leader.local_store.snapshot()
+                down = [n.id for n in snap.nodes()
+                        if n.id in silence_ids
+                        and n.status in (_enums.NODE_STATUS_DOWN,
+                                         _enums.NODE_STATUS_DISCONNECTED)]
+                if len(down) == len(silence_ids):
+                    break
+                if time.time() > deadline:
+                    print(f"SWARM SMOKE: FAIL — only {len(down)}/"
+                          f"{len(silence_ids)} silenced nodes expired")
+                    return 2
+                time.sleep(0.2)
+            checker.check_node_liveness(cluster, swarm=swarm, ttl=ttl)
+
+            # ...and recover to ready on their next successful beat
+            swarm.unsilence(silence_pool)
+            deadline = time.time() + 60
+            while True:
+                snap = leader.local_store.snapshot()
+                ready = [n.id for n in snap.nodes()
+                         if n.id in silence_ids
+                         and n.status == _enums.NODE_STATUS_READY]
+                if len(ready) == len(silence_ids):
+                    break
+                if time.time() > deadline:
+                    print(f"SWARM SMOKE: FAIL — only {len(ready)}/"
+                          f"{len(silence_ids)} silenced nodes recovered")
+                    return 2
+                time.sleep(0.2)
+
+            # every live desired-run alloc on a registered sim node must
+            # end up acked running — delta push + batched acks, no loss
+            deadline = time.time() + 120
+            while True:
+                leader = cluster.wait_for_leader(timeout=15.0)
+                snap = leader.local_store.snapshot()
+                pending = [a.id for a in snap.allocs()
+                           if a.node_id in swarm.ids()
+                           and not a.terminal_status()
+                           and not a.server_terminal()
+                           and a.desired_status == _enums.ALLOC_DESIRED_RUN
+                           and a.client_status != _enums.ALLOC_CLIENT_RUNNING]
+                placed = [a for a in snap.allocs()
+                          if not a.terminal_status()
+                          and not a.server_terminal()]
+                if not pending and placed:
+                    break
+                if time.time() > deadline:
+                    print(f"SWARM SMOKE: FAIL — {len(pending)} alloc "
+                          f"ack(s) still missing: {pending[:5]}")
+                    return 2
+                time.sleep(0.2)
+
+            checker.check_convergence(cluster, timeout=30.0)
+            checker.check_all(cluster)
+            checker.check_node_liveness(cluster, swarm=swarm, ttl=ttl)
+            beats = swarm.total_beats()
+            acked = len(swarm.acked_ids)
+            expiries = sum(
+                s.server.heartbeats.stats["invalidated"]
+                for s in cluster.servers.values() if not s.crashed)
+        finally:
+            stop_churn.set()
+            if swarm is not None:
+                swarm.stop()
+            if churn_thread is not None:
+                churn_thread.join(timeout=5.0)
+            cluster.stop()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    dt = time.monotonic() - t0
+    print(f"SWARM SMOKE: ok — {nodes_n} sim nodes, {beats} heartbeats, "
+          f"{crashes} leader crashes, {len(silence_pool)} real expiries "
+          f"(total {expiries}) all attributed, {acked} allocs acked, "
+          f"{checker.stats['checks']} invariant sweeps, {dt:.1f}s")
+    return 0
+
+
+def swarm_scale_smoke(nodes_n: int = 50000, ttl: float = 10.0,
+                      jobs_n: int = 150) -> int:
+    """The ROADMAP acceptance run: 50K+ sim nodes heartbeating at the
+    production TTL against a live 3-node cluster WHILE the e2e3 write
+    pipeline runs, one leader crash/failover mid-stream, and ZERO
+    missed-TTL false positives — verified by check_node_liveness on
+    every replica. Heavy (minutes); run explicitly via
+    `python -m nomad_tpu.chaos --swarm-scale [N]`."""
+    import shutil
+
+    from ..core.server import ServerConfig
+    from ..raft.cluster import RaftCluster
+    from ..structs import enums as _enums
+    from .invariants import InvariantChecker
+    from .swarm import Swarm
+
+    t0 = time.monotonic()
+
+    def config_fn(_i: int) -> ServerConfig:
+        return ServerConfig(
+            num_workers=4, plan_commit_batching=True, eval_batch_size=8,
+            heartbeat_ttl=ttl, heartbeat_shards=8,
+            gc_interval=3600.0, nack_timeout=900.0,
+            failed_eval_followup_delay=3600.0,
+            failed_eval_unblock_interval=0.5)
+
+    tmp = tempfile.mkdtemp(prefix="nomad-swarm-scale-")
+    checker = InvariantChecker()
+    try:
+        cluster = RaftCluster(3, config_fn=config_fn, data_dir=tmp,
+                              snapshot_threshold=8192)
+        cluster.start()
+        swarm = None
+        try:
+            leader = cluster.wait_for_leader(timeout=15.0)
+            if leader is None:
+                print("SWARM SCALE: FAIL — no leader elected")
+                return 2
+
+            def entry():
+                return cluster.leader()
+
+            swarm = Swarm(entry, nodes_n, ttl=ttl, interval=3.0,
+                          drivers=8, rpc_batch=1024, ack=True)
+            # drivers first, registration second: a real fleet ramps —
+            # each node starts heartbeating the moment it registers. A
+            # fleet-sized registration takes several TTLs, so arming
+            # 50K timers and only then starting the beats would expire
+            # (and revive) every early chunk purely as a harness
+            # artifact.
+            swarm.start()
+            reg_t0 = time.monotonic()
+            if swarm.register_all(chunk=1000, deadline_s=600.0) != nodes_n:
+                print("SWARM SCALE: FAIL — fleet registration timed out")
+                return 2
+            reg_dt = time.monotonic() - reg_t0
+
+            # registration load can move leadership; re-resolve, and
+            # retry workload proposals through any further election
+            def propose(fn):
+                nonlocal leader
+                deadline = time.time() + 60
+                while True:
+                    try:
+                        return fn(leader)
+                    except Exception:
+                        if time.time() > deadline:
+                            raise
+                        time.sleep(0.25)
+                        leader = (cluster.wait_for_leader(timeout=30.0)
+                                  or leader)
+
+            leader = cluster.wait_for_leader(timeout=30.0) or leader
+
+            # e2e3 write pipeline in parallel with the heartbeat storm
+            jobs = []
+            for _ in range(jobs_n):
+                j = mock.job()
+                j.task_groups[0].count = 1
+                j.task_groups[0].tasks[0].resources.cpu = 100
+                j.task_groups[0].tasks[0].resources.memory_mb = 64
+                jobs.append(j)
+                propose(lambda srv: srv.store.upsert_job(j))
+            evals = [mock.eval_for(j, create_time=time.time())
+                     for j in jobs]
+            propose(lambda srv: srv.store.upsert_evals(evals))
+            for ev in evals:
+                propose(lambda srv: srv.server.broker.enqueue(ev))
+
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                snap = leader.local_store.snapshot()
+                if len([a for a in snap.allocs()]) >= jobs_n // 4:
+                    break
+                time.sleep(0.05)
+            else:
+                print("SWARM SCALE: FAIL — pipeline never reached the "
+                      "crash window")
+                return 2
+
+            hb_before = swarm.total_beats()
+            victim = cluster.wait_for_leader(timeout=15.0) or leader
+            cluster.crash(victim.id)
+            fresh = cluster.wait_for_leader(timeout=30.0)
+            if fresh is None:
+                print("SWARM SCALE: FAIL — no leader after the crash")
+                return 2
+            cluster.restart(victim.id)
+
+            # beat through the new leader's grace window + one full TTL
+            time.sleep(ttl * 2.0)
+
+            checker.check_all(cluster)
+
+            # ZERO missed-TTL false positives: no sim node may END UP
+            # down on any live replica. If election churn stalled a
+            # driver past the TTL, that expiry is a TRUE positive — but
+            # it must be attributed (checker, below) and must heal via
+            # the heartbeat revival path, so recovery gets a bounded
+            # window before the hard zero-down assertion.
+            sim_ids = set(swarm.ids())
+            down_states = (_enums.NODE_STATUS_DOWN,
+                           _enums.NODE_STATUS_DISCONNECTED)
+
+            def down_on(s):
+                snap = s.local_store.snapshot()
+                return [n.id for n in snap.nodes()
+                        if n.id in sim_ids and n.status in down_states]
+
+            recover_deadline = time.time() + 60.0
+            while time.time() < recover_deadline:
+                if not any(down_on(s) for s in cluster.servers.values()
+                           if not s.crashed):
+                    break
+                time.sleep(0.5)
+            checker.check_node_liveness(cluster, swarm=swarm, ttl=ttl)
+            for s in cluster.servers.values():
+                if s.crashed:
+                    continue
+                wrong = down_on(s)
+                if wrong:
+                    print(f"SWARM SCALE: FAIL — {len(wrong)} node(s) "
+                          f"still down on {s.id} after the recovery "
+                          f"window: {wrong[:5]}")
+                    return 2
+
+            hb_after = swarm.total_beats()
+            # every expiry that did fire was verified attributable to a
+            # real >= TTL silence by check_node_liveness; surface count
+            expiries = sum(
+                s.server.heartbeats.stats["invalidated"]
+                for s in cluster.servers.values() if not s.crashed)
+            checker.check_convergence(cluster, timeout=60.0)
+            snap = cluster.wait_for_leader(timeout=15.0).local_store.snapshot()
+            placed = len([a for a in snap.allocs()
+                          if not a.terminal_status()
+                          and not a.server_terminal()])
+        finally:
+            if swarm is not None:
+                swarm.stop()
+            cluster.stop()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    dt = time.monotonic() - t0
+    rate = (hb_after - hb_before) / max(dt, 1e-9)
+    print(f"SWARM SCALE: ok — {nodes_n} sim nodes at TTL {ttl:.0f}s, "
+          f"{swarm.total_beats()} heartbeats "
+          f"({hb_after - hb_before} post-crash, ~{rate:.0f}/s overall), "
+          f"{placed} live allocs placed by the concurrent pipeline, "
+          f"registration {reg_dt:.1f}s, {expiries} attributed "
+          f"expiries and ZERO missed-TTL false positives across the "
+          f"failover, {checker.stats['checks']} invariant sweeps, "
+          f"{dt:.1f}s")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m nomad_tpu.chaos")
     parser.add_argument("--seed", type=int, default=None,
@@ -645,6 +1046,19 @@ def main(argv=None) -> int:
                              "follower wiped + restarted, catch-up via "
                              "chunked install-snapshot) instead of the "
                              "scenario smoke")
+    parser.add_argument("--swarm-smoke", action="store_true",
+                        help="run the client-plane swarm smoke (200 sim "
+                             "nodes flap-churning while 3 leaders crash "
+                             "in sequence; liveness + alloc-uniqueness "
+                             "on every replica) instead of the scenario "
+                             "smoke")
+    parser.add_argument("--swarm-scale", type=int, nargs="?",
+                        const=50000, default=None, metavar="N",
+                        help="run the fleet-scale acceptance smoke: N "
+                             "(default 50000) sim nodes at production "
+                             "TTL against a live 3-node cluster with "
+                             "the e2e pipeline + a leader crash; zero "
+                             "missed-TTL false positives (minutes)")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
     logging.basicConfig(
@@ -661,6 +1075,10 @@ def main(argv=None) -> int:
         return solve_smoke()
     if args.snap_smoke:
         return snap_smoke()
+    if args.swarm_smoke:
+        return swarm_smoke()
+    if args.swarm_scale is not None:
+        return swarm_scale_smoke(nodes_n=args.swarm_scale)
 
     t0 = time.monotonic()
     with tempfile.TemporaryDirectory(prefix="nomad-chaos-") as tmp:

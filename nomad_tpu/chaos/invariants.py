@@ -30,6 +30,8 @@ import logging
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..structs import enums
+
 log = logging.getLogger("nomad_tpu.chaos")
 
 
@@ -250,6 +252,67 @@ class InvariantChecker:
                     f"alloc uniqueness: {len(dups)} slot(s) on {s.id} "
                     f"hold multiple live allocs, e.g. {worst[0]} -> "
                     f"{[i[:8] for i in worst[1]]}")
+        self.stats["checks"] += 1
+
+    # -- 6: node liveness (client-plane swarm) ------------------------
+
+    def check_node_liveness(self, cluster, swarm=None,
+                            ttl: float = None) -> None:
+        """No missed-TTL false positives, on every live replica:
+
+        (a) every expiry the heartbeat manager fired is attributable to
+            a real silence — its attribution log shows >= ~one full TTL
+            between arming and expiry (the failover grace window makes
+            this hold across restore() too);
+        (b) with a swarm attached: any swarm node marked down/
+            disconnected went at least ~one TTL without a server-acked
+            heartbeat before the mark (`status_updated_at - last_ok`);
+        (c) no node is both down and heartbeating: a down-marked node
+            whose heartbeats have been succeeding for > 2 TTLs since
+            the mark should have flipped back to ready.
+
+        Accepts a RaftCluster or a single (possibly replicated)
+        server. Small epsilons absorb clock skew between the proposer's
+        wall-clock stamp and the swarm's ack timestamps."""
+        down_states = (enums.NODE_STATUS_DOWN,
+                       enums.NODE_STATUS_DISCONNECTED)
+        servers = (_live(cluster) if hasattr(cluster, "servers")
+                   else [cluster])
+        for s in servers:
+            core = getattr(s, "server", s)
+            store = getattr(s, "local_store", None) or core.store
+            mgr = core.heartbeats
+            t = ttl if ttl is not None else mgr.ttl
+            for node_id, armed_at, expired_at in mgr.expiry_snapshot():
+                silence = expired_at - armed_at
+                if silence < t * 0.95 - 0.01:
+                    self._fail(
+                        f"node liveness: {getattr(s, 'id', 'server')} "
+                        f"expired {node_id} after only {silence:.3f}s "
+                        f"of a {t:.3f}s TTL")
+            if swarm is None:
+                continue
+            now = time.time()
+            for node in store.snapshot().nodes():
+                sn = swarm.sim(node.id)
+                if sn is None or node.status not in down_states:
+                    continue
+                last_ok = swarm.last_ok(node.id)
+                silence = node.status_updated_at - last_ok
+                if last_ok > 0 and silence < t * 0.9 - 0.1:
+                    self._fail(
+                        f"node liveness: {node.id} marked {node.status} "
+                        f"on {getattr(s, 'id', 'server')} only "
+                        f"{silence:.3f}s after a server-acked heartbeat "
+                        f"(TTL {t:.3f}s) — missed-TTL false positive")
+                if (last_ok - node.status_updated_at > 2 * t
+                        and now - last_ok < t):
+                    self._fail(
+                        f"node liveness: {node.id} is {node.status} on "
+                        f"{getattr(s, 'id', 'server')} yet has been "
+                        f"heartbeating successfully for "
+                        f"{last_ok - node.status_updated_at:.3f}s since "
+                        f"the mark — down AND heartbeating")
         self.stats["checks"] += 1
 
     # -- 6: snapshot integrity (nomadown runtime prong) ---------------

@@ -1,0 +1,329 @@
+"""Client-plane swarm: fleet-scale simulated nodes.
+
+A SimNode speaks the REAL node RPC surface — register / heartbeat /
+alloc-ack / deregister — without running tasks, so one process can
+sustain 50–100K nodes against a live cluster while the e2e write
+pipeline runs. The design constraints:
+
+  * No thread per node. A few driver threads each own a slice of the
+    fleet, organized as a time wheel: the slice is spread across S
+    slots, every `interval / S` seconds one slot's nodes heartbeat in
+    `heartbeat_batch` chunks. Heartbeat load is phase-staggered by
+    construction, like a real fleet's jittered check-ins.
+
+  * No per-node RPC. Registration, heartbeats, and alloc acks all ride
+    the batch endpoints (`register_nodes`, `heartbeat_batch`,
+    `update_allocs_from_client`).
+
+  * Failover-transparent. Every batch re-resolves the entry server via
+    `entry_fn` (e.g. `cluster.leader()`), and a failed batch is simply
+    a missed beat — the TTL plus the new leader's grace window absorb
+    it, which is exactly the property check_node_liveness audits.
+
+`last_ok` per node records the wall-clock time of the last
+SERVER-ACKNOWLEDGED heartbeat; the liveness invariant uses it to prove
+every down-mark corresponds to a real silence >= TTL.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set
+
+from ..structs import enums
+from ..structs.node import Node
+from ..structs.resources import NodeResources
+
+_SIM_ATTRS = {
+    "kernel.name": "linux",
+    "arch": "x86_64",
+    "cpu.arch": "amd64",
+    "nomad.version": "0.1.0",
+    "driver.mock": "1",
+}
+
+
+def make_sim_node(index: int, prefix: str = "sim") -> Node:
+    """A lightweight but fully real Node row (mock.node minus the
+    per-node attribute churn — 100K of these must build in seconds)."""
+    return Node(
+        id=f"{prefix}-{index:06d}",
+        name=f"{prefix}-{index}",
+        datacenter="dc1",
+        attributes=dict(_SIM_ATTRS),
+        resources=NodeResources(cpu=4000, memory_mb=8192, disk_mb=102400,
+                                total_cores=4),
+        drivers={"mock": True, "exec": True},
+        status=enums.NODE_STATUS_READY,
+    )
+
+
+class SimNode:
+    __slots__ = ("id", "node", "last_ok", "beats", "silenced", "registered")
+
+    def __init__(self, node: Node):
+        self.id = node.id
+        self.node = node
+        self.last_ok = 0.0     # wall clock of last server-acked heartbeat
+        self.beats = 0
+        self.silenced = False
+        self.registered = False
+
+
+class Swarm:
+    def __init__(self, entry_fn: Callable[[], object], count: int,
+                 ttl: float, interval: Optional[float] = None,
+                 drivers: int = 4, rpc_batch: int = 512,
+                 prefix: str = "sim", ack: bool = False):
+        self.entry_fn = entry_fn
+        self.ttl = ttl
+        self.interval = interval if interval is not None else ttl / 3.0
+        self.rpc_batch = max(1, rpc_batch)
+        self.ack_enabled = ack
+        first = make_sim_node(0, prefix)
+        first.compute_class()
+        self.nodes: List[SimNode] = [SimNode(first)]
+        for i in range(1, count):
+            n = make_sim_node(i, prefix)
+            # identical scheduling-relevant fields => identical class;
+            # skip re-hashing it 100K times
+            n.computed_class = first.computed_class
+            self.nodes.append(SimNode(n))
+        self._by_id: Dict[str, SimNode] = {sn.id: sn for sn in self.nodes}
+        self._lock = threading.Lock()   # guards SimNode flags + stats
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._drivers = max(1, drivers)
+        self.stats = {"heartbeats": 0, "hb_failures": 0, "acked": 0,
+                      "ack_failures": 0, "registered": 0, "deregistered": 0}
+        self.acked_ids: Set[str] = set()
+
+    # -- registration ------------------------------------------------
+
+    def register_all(self, chunk: int = 2000, deadline_s: float = 180.0,
+                     subset: Optional[List[SimNode]] = None) -> int:
+        """Register the fleet in `register_nodes` chunks, retrying each
+        chunk through elections until the deadline."""
+        import copy as _copy
+
+        sims = subset if subset is not None else self.nodes
+        deadline = time.time() + deadline_s
+        done = 0
+        for start in range(0, len(sims), chunk):
+            batch = sims[start:start + chunk]
+            while True:
+                try:
+                    entry = self.entry_fn()
+                    if entry is None:
+                        raise ConnectionError("no live server")
+                    # register COPIES: in-proc the store takes ownership
+                    # of the row object; the swarm's copy stays ours to
+                    # re-register during churn
+                    entry.register_nodes([_copy.copy(sn.node)
+                                          for sn in batch])
+                    break
+                except Exception:
+                    if time.time() > deadline or self._stop.wait(0.25):
+                        return done
+            now = time.time()
+            with self._lock:
+                for sn in batch:
+                    sn.registered = True
+                    sn.last_ok = now
+                self.stats["registered"] += len(batch)
+            done += len(batch)
+        return done
+
+    def deregister(self, sims: List[SimNode]) -> int:
+        done = 0
+        for sn in sims:
+            try:
+                entry = self.entry_fn()
+                if entry is None:
+                    raise ConnectionError("no live server")
+                entry.deregister_node(sn.id)
+            except Exception:
+                continue
+            with self._lock:
+                sn.registered = False
+                self.stats["deregistered"] += 1
+            done += 1
+        return done
+
+    # -- heartbeat drivers -------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        for d in range(self._drivers):
+            sims = self.nodes[d::self._drivers]
+            t = threading.Thread(target=self._run_driver, args=(sims,),
+                                 daemon=True, name=f"swarm-driver-{d}")
+            t.start()
+            self._threads.append(t)
+        if self.ack_enabled:
+            t = threading.Thread(target=self._run_acks, daemon=True,
+                                 name="swarm-acks")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads.clear()
+
+    def _run_driver(self, sims: List[SimNode]) -> None:
+        # time wheel: spread the slice over S slots; each tick fires one
+        # slot, so every node beats once per interval, phase-staggered
+        slots_n = max(2, min(32, int(self.interval / 0.1) or 2))
+        slots: List[List[SimNode]] = [[] for _ in range(slots_n)]
+        for i, sn in enumerate(sims):
+            slots[i % slots_n].append(sn)
+        tick = self.interval / slots_n
+        cursor = 0
+        next_t = time.time() + tick
+        while not self._stop.is_set():
+            delay = next_t - time.time()
+            if delay > 0:
+                if self._stop.wait(delay):
+                    return
+            elif delay < -self.interval:
+                next_t = time.time()   # fell a whole interval behind
+            next_t += tick
+            slot = slots[cursor]
+            cursor = (cursor + 1) % slots_n
+            with self._lock:
+                due = [sn for sn in slot
+                       if sn.registered and not sn.silenced]
+            for start in range(0, len(due), self.rpc_batch):
+                chunk = due[start:start + self.rpc_batch]
+                try:
+                    entry = self.entry_fn()
+                    if entry is None:
+                        raise ConnectionError("no live server")
+                    entry.heartbeat_batch([sn.id for sn in chunk])
+                except Exception:
+                    # missed beat: TTL + failover grace absorb it
+                    with self._lock:
+                        self.stats["hb_failures"] += 1
+                    continue
+                now = time.time()
+                with self._lock:
+                    for sn in chunk:
+                        sn.last_ok = now
+                        sn.beats += 1
+                    self.stats["heartbeats"] += len(chunk)
+
+    # -- alloc acks (the client-ack half of the RPC surface) ---------
+
+    def _hub_owner(self):
+        """The core Server whose AllocSyncHub is live (the leader's)."""
+        try:
+            s = self.entry_fn()
+        except Exception:
+            return None
+        if s is None:
+            return None
+        core = getattr(s, "server", s)
+        hub = getattr(core, "alloc_sync", None)
+        if hub is not None and hub.running:
+            return core
+        return None
+
+    def _run_acks(self) -> None:
+        """Subscribe ONE delta feed covering the whole fleet and ack
+        every alloc pushed to a sim node: desired-run allocs ack
+        `running`, stop/evict-desired allocs ack `complete` (the drain
+        path needs a client-side terminal ack to converge)."""
+        owner = None
+        sub = None
+        rescan = True
+        while not self._stop.is_set():
+            cur = self._hub_owner()
+            if cur is not owner or sub is None or sub.closed:
+                if sub is not None:
+                    sub.close()
+                owner = cur
+                sub = None
+                if owner is None:
+                    if self._stop.wait(0.2):
+                        return
+                    continue
+                sub = owner.alloc_sync.subscribe(list(self._by_id))
+                rescan = True
+            batch, resync = sub.poll(timeout=0.25)
+            if self._stop.is_set():
+                return
+            if resync or rescan:
+                rescan = False
+                try:
+                    entry = self.entry_fn()
+                    snap = entry.store.snapshot()
+                    batch = [a for a in snap.allocs()
+                             if a.node_id in self._by_id]
+                except Exception:
+                    rescan = True
+                    continue
+            if batch:
+                self._ack(batch)
+
+    def _ack(self, allocs: List) -> None:
+        updates = []
+        for a in allocs:
+            if a.client_terminal():
+                continue
+            if a.desired_status == enums.ALLOC_DESIRED_RUN:
+                status = enums.ALLOC_CLIENT_RUNNING
+                if a.client_status == status:
+                    continue
+            else:
+                status = enums.ALLOC_CLIENT_COMPLETE
+            upd = a.copy_for_update()
+            upd.client_status = status
+            updates.append(upd)
+        for start in range(0, len(updates), self.rpc_batch):
+            chunk = updates[start:start + self.rpc_batch]
+            try:
+                entry = self.entry_fn()
+                if entry is None:
+                    raise ConnectionError("no live server")
+                entry.update_allocs_from_client(chunk)
+            except Exception:
+                with self._lock:
+                    self.stats["ack_failures"] += 1
+                continue
+            with self._lock:
+                self.stats["acked"] += len(chunk)
+                self.acked_ids.update(u.id for u in chunk)
+
+    # -- silence / flap controls -------------------------------------
+
+    def silence(self, sims: List[SimNode]) -> None:
+        with self._lock:
+            for sn in sims:
+                sn.silenced = True
+
+    def unsilence(self, sims: List[SimNode]) -> None:
+        with self._lock:
+            for sn in sims:
+                sn.silenced = False
+
+    # -- accessors for the liveness invariant ------------------------
+
+    def ids(self) -> Set[str]:
+        return set(self._by_id)
+
+    def sim(self, node_id: str) -> Optional[SimNode]:
+        return self._by_id.get(node_id)
+
+    def last_ok(self, node_id: str) -> float:
+        sn = self._by_id.get(node_id)
+        if sn is None:
+            return 0.0
+        with self._lock:
+            return sn.last_ok
+
+    def total_beats(self) -> int:
+        with self._lock:
+            return self.stats["heartbeats"]

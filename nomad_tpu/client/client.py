@@ -37,6 +37,10 @@ class ClientConfig:
     heartbeat_interval: float = 3.0
     sync_interval: float = 0.2     # allocSync batching (client.go:2198)
     watch_interval: float = 0.1
+    # safety full-resync cadence on the delta alloc-sync path (deltas
+    # never report GC'd allocs vanishing; a periodic snapshot read
+    # prunes them and bounds any missed-delta window)
+    resync_interval: float = 5.0
     # periodic re-fingerprint (reference fingerprint_manager periodics)
     fingerprint_interval: float = 60.0
     # external driver plugins (reference plugin_dir, plugins/serve.go)
@@ -81,6 +85,11 @@ class Client:
         self._dirty: Dict[str, AllocRunner] = {}   # pending status syncs
         self._lock = threading.Lock()              # guards self.runners
         self._dirty_lock = threading.Lock()        # guards self._dirty
+        # serializes node-mutating RPCs (heartbeat / re-register) against
+        # stop(): a heartbeat already past the stop-flag check would
+        # otherwise race deregistration and re-arm the server-side TTL
+        # for a node that is going away
+        self._rpc_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         from .volumes import VolumeManager
@@ -139,7 +148,14 @@ class Client:
             lambda: self.server.register_node(self.node))
 
     def stop(self) -> None:
+        # take the RPC lock BEFORE raising the stop flag is not enough:
+        # a heartbeat could be blocked waiting for the lock already.
+        # Order: set the flag first, then wait out any RPC in flight —
+        # every RPC loop re-checks the flag under the lock before
+        # issuing, so after this block no further node RPC can start
         self._stop.set()
+        with self._rpc_lock:
+            pass
         if self.plugins is not None:
             self.plugins.release()
             self.plugins = None
@@ -222,9 +238,27 @@ class Client:
                                   factor=2.0, cap=5.0, jitter=0.25)
         while not self._stop.wait(self.config.heartbeat_interval):
             try:
-                self.server.heartbeat(self.node.id)
+                with self._rpc_lock:
+                    if self._stop.is_set():
+                        return
+                    self.server.heartbeat(self.node.id)
                 self._last_heartbeat_ok = time.time()
                 failure_backoff.reset()
+            except KeyError:
+                # the server no longer knows us (registration lost, or
+                # we were GC'd while partitioned): re-register instead
+                # of arming a ghost TTL for a node row that isn't there
+                try:
+                    with self._rpc_lock:
+                        if self._stop.is_set():
+                            return
+                        self.server.register_node(self.node)
+                    self._last_heartbeat_ok = time.time()
+                    failure_backoff.reset()
+                except Exception:
+                    self._check_heartbeat_stop()
+                    if self._stop.wait(failure_backoff.next_delay()):
+                        return
             except Exception:
                 # server unreachable: the TTL will mark us down; local
                 # stop_after_client_disconnect timers start running
@@ -284,7 +318,10 @@ class Client:
             updated.computed_class = ""
             updated.compute_class()
             try:
-                self.server.register_node(updated)
+                with self._rpc_lock:
+                    if self._stop.is_set():
+                        return
+                    self.server.register_node(updated)
             except Exception:
                 continue  # retried on the next tick
             self.node = updated
@@ -292,12 +329,60 @@ class Client:
     # -- alloc watching (client.go:2281 watchAllocations -> :2539 runAllocs) --
 
     def _run_watch(self) -> None:
+        # delta path: the server pushes per-node changed allocs off the
+        # event broker; a full snapshot read happens only on subscribe,
+        # on a subscription gap, and on the periodic safety resync.
+        # Falls back to interval polling against servers without a hub
+        # (e.g. a follower in a replicated cluster, or an HTTP facade).
+        hub = getattr(self.server, "alloc_sync", None)
+        if hub is not None and hub.running:
+            # returns if the hub shuts down mid-session; fall through to
+            # polling then (the server may be stopping — or restarting)
+            self._watch_deltas(hub)
         while not self._stop.wait(self.config.watch_interval):
             try:
                 desired = self.server.store.snapshot().allocs_by_node(self.node.id)
             except Exception:
                 continue
             self._reconcile(desired)
+
+    def _watch_deltas(self, hub) -> None:
+        sub = hub.subscribe(self.node.id)
+        try:
+            desired: Dict[str, Allocation] = {}
+            last_full = 0.0
+            while not self._stop.is_set():
+                now = time.monotonic()
+                need_full = (now - last_full) >= self.config.resync_interval
+                if not need_full:
+                    batch, need_full = sub.poll(
+                        timeout=self.config.watch_interval)
+                    if self._stop.is_set() or sub.closed:
+                        return
+                    if batch and not need_full:
+                        for alloc in batch:
+                            prev = desired.get(alloc.id)
+                            if (prev is None
+                                    or alloc.modify_index >= prev.modify_index):
+                                desired[alloc.id] = alloc
+                        self._reconcile(list(desired.values()))
+                        continue
+                    if not need_full:
+                        continue
+                try:
+                    full = self.server.store.snapshot().allocs_by_node(
+                        self.node.id)
+                except Exception:
+                    # server unreachable: keep the last desired set and
+                    # retry the resync on the next tick
+                    if self._stop.wait(self.config.watch_interval):
+                        return
+                    continue
+                desired = {a.id: a for a in full}
+                last_full = time.monotonic()
+                self._reconcile(list(desired.values()))
+        finally:
+            sub.close()
 
     def _reconcile(self, desired: List[Allocation]) -> None:
         by_id = {a.id: a for a in desired}
@@ -368,8 +453,12 @@ class Client:
             if fin:
                 upd.task_finished_at = fin
             updates.append(upd)
+        from ..obs.trace import TRACER
+
         try:
-            self.server.update_allocs_from_client(updates)
+            with TRACER.span("client.sync", node=self.node.id[:8],
+                             count=len(updates)):
+                self.server.update_allocs_from_client(updates)
         except Exception:
             with self._dirty_lock:  # retry next tick
                 for r in dirty.values():

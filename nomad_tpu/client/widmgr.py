@@ -56,16 +56,25 @@ class WIDMgr:
         return True
 
     def start(self) -> "WIDMgr":
-        self._thread = threading.Thread(
-            target=self._run, daemon=True,
-            name=f"widmgr-{self.alloc.id[:8]}")
-        self._thread.start()
+        # atomic with stop(): without the lock a concurrent stop() can
+        # observe the thread object between construction and start()
+        # and die joining a never-started thread
+        with self._lock:
+            if self._stop.is_set() or self._thread is not None:
+                return self
+            t = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"widmgr-{self.alloc.id[:8]}")
+            self._thread = t
+            t.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
 
     # -- renewal loop (reference widmgr.go renew at half-life) --
 

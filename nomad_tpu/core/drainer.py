@@ -66,6 +66,13 @@ class NodeDrainer:
             # (availability is only restored once the task actually exits)
             allocs = [a for a in snap.allocs_by_node(node.id)
                       if not a.client_terminal()]
+            if node.status in (enums.NODE_STATUS_DOWN,
+                               enums.NODE_STATUS_DISCONNECTED):
+                # a dead client never reports its allocs terminal, so
+                # waiting for client_terminal strands the drain forever;
+                # once the server has decided an alloc's fate
+                # (server-terminal) it no longer holds the drain open
+                allocs = [a for a in allocs if not a.server_terminal()]
             if strat.ignore_system_jobs:
                 allocs = [a for a in allocs
                           if a.job is None

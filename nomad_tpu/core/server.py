@@ -33,7 +33,7 @@ from .core_sched import CoreScheduler
 from .deployments import DeploymentWatcher
 from .drainer import NodeDrainer
 from .events import EventBroker
-from .heartbeat import HeartbeatManager
+from .heartbeat import HeartbeatManager, HeartbeatPlaneInactive
 from .periodic import PeriodicDispatcher
 from .plan_apply import PlanApplier, PlanQueue
 from .worker import Worker
@@ -43,6 +43,19 @@ from .worker import Worker
 class ServerConfig:
     num_workers: int = 2
     heartbeat_ttl: float = 10.0
+    # Heartbeat manager sharding (fleet-scale node plane): timers are
+    # spread over this many timer-wheel shards, each drained by one
+    # expiry thread. 1 restores the single-lock manager (A/B baseline).
+    heartbeat_shards: int = 8
+    # Expiry-rate limiter: max missed-TTL mark-downs per second across
+    # all shards — a mass expiry (partitioned rack, dead leader's
+    # backlog) degrades to a paced trickle of mark-down batches instead
+    # of an FSM thundering herd. <= 0 disables the limiter.
+    heartbeat_expiry_rate: float = 512.0
+    # Coalesce concurrent client alloc-status commits into one FSM
+    # command per round (the PR-5 plan-commit batching shape applied to
+    # the node plane). False restores one command per client sync.
+    client_update_batching: bool = True
     nack_timeout: float = 60.0
     eval_delivery_limit: int = 3
     # End-to-end pipeline batching (PERF.md "End-to-end pipeline").
@@ -107,7 +120,10 @@ class Server:
                 threshold=self.config.plan_rejection_threshold,
                 window=self.config.plan_rejection_window,
                 on_bad_node=self._on_bad_node))
-        self.heartbeats = HeartbeatManager(self, ttl=self.config.heartbeat_ttl)
+        self.heartbeats = HeartbeatManager(
+            self, ttl=self.config.heartbeat_ttl,
+            shards=self.config.heartbeat_shards,
+            expiry_rate=self.config.heartbeat_expiry_rate)
         self.workers: List[Worker] = [
             Worker(self, i) for i in range(self.config.num_workers)]
         from .encrypter import Encrypter
@@ -123,6 +139,12 @@ class Server:
         self.periodic = PeriodicDispatcher(self)
         self.core_gc = CoreScheduler(self, interval=self.config.gc_interval)
         self.events = EventBroker(self.store)
+        from .allocsync import AllocSyncHub, ClientUpdateBatcher
+
+        # delta alloc push to clients + batched client status commits
+        self.alloc_sync = AllocSyncHub(self)
+        self.client_updates = ClientUpdateBatcher(
+            self.store, batch=self.config.client_update_batching)
         self._running = False
         # Commit listeners fire inline on the store's write path — which
         # under raft is the apply thread. The unblock path re-proposes
@@ -147,6 +169,8 @@ class Server:
         self.plan_applier.start()
         self.broker.set_enabled(True)
         self.blocked.set_enabled(True)
+        self.alloc_sync.start()
+        self.client_updates.start()
         self.heartbeats.set_enabled(True)
         self._restore_heartbeats()
         self._restore_scheduler_config()
@@ -269,6 +293,8 @@ class Server:
         self.drainer.stop()
         self.deployment_watcher.stop()
         self.heartbeats.set_enabled(False)
+        self.client_updates.stop()
+        self.alloc_sync.stop()
         self.blocked.set_enabled(False)
         self.broker.set_enabled(False)
         self.plan_applier.stop()
@@ -576,16 +602,93 @@ class Server:
             self._create_node_evals(node.id)
         return self.heartbeats.reset(node.id)
 
+    def register_nodes(self, nodes: List[Node]) -> float:
+        """Batched Node.Register: one FSM command upserts the whole
+        chunk, one eval pass covers every ready node (the swarm's
+        registration path — 100K nodes cannot afford one raft round
+        trip each)."""
+        for node in nodes:
+            if not node.id:
+                raise ValueError("node registration requires node.id")
+            if not node.computed_class:
+                node.compute_class()
+        if not nodes:
+            return self.config.heartbeat_ttl
+        self.store.upsert_nodes(list(nodes))
+        ready = [n.id for n in nodes if n.ready()]
+        if ready:
+            self._create_node_evals_batch(ready)
+        for node in nodes:
+            self.heartbeats.reset(node.id)
+        return self.config.heartbeat_ttl
+
     def heartbeat(self, node_id: str) -> float:
         """Node.UpdateStatus(ready) from a live client. A node that was
         marked down by a missed TTL comes back to ready here (the
-        reference heartbeat is literally an UpdateStatus(ready) RPC)."""
+        reference heartbeat is literally an UpdateStatus(ready) RPC).
+        An UNKNOWN node raises KeyError instead of arming a ghost TTL
+        timer for a row that does not exist — the client re-registers."""
+        if not self.heartbeats.enabled:
+            raise HeartbeatPlaneInactive(
+                "heartbeat plane is not active on this server")
         snap = self.store.snapshot()
         node = snap.node_by_id(node_id)
-        if node is not None and node.status != enums.NODE_STATUS_READY:
+        if node is None:
+            raise KeyError(f"node {node_id} is not registered")
+        if node.status != enums.NODE_STATUS_READY:
             self.update_node_status(node_id, enums.NODE_STATUS_READY)
             return self.config.heartbeat_ttl
-        return self.heartbeats.reset(node_id)
+        ttl = self.heartbeats.reset(node_id)
+        # re-read AFTER arming: a missed-TTL mark that committed while
+        # this call was in flight (first snapshot stale) must not
+        # survive an acked heartbeat
+        node = self.store.snapshot().node_by_id(node_id)
+        if node is not None and node.status != enums.NODE_STATUS_READY:
+            self.update_node_status(node_id, enums.NODE_STATUS_READY)
+        return ttl
+
+    def heartbeat_batch(self, node_ids: List[str]) -> float:
+        """Batched heartbeat for swarm-scale clients: ready nodes are a
+        leader-local timer re-arm (NO FSM traffic); nodes coming back
+        from down/disconnected flip to ready in one batched status
+        command; unknown (deregistered mid-flight) ids are dropped. On a
+        server whose expiry plane is down (lost leadership, stopping)
+        the whole batch is rejected — an acked heartbeat that armed no
+        timer is exactly the missed-TTL false positive this plane must
+        not produce."""
+        if not self.heartbeats.enabled:
+            raise HeartbeatPlaneInactive(
+                "heartbeat plane is not active on this server")
+        snap = self.store.snapshot()
+        known: List[str] = []
+        stale: List[str] = []
+        for node_id in node_ids:
+            node = snap.node_by_id(node_id)
+            if node is None:
+                continue
+            known.append(node_id)
+            if node.status != enums.NODE_STATUS_READY:
+                stale.append(node_id)
+            else:
+                self.heartbeats.reset(node_id)
+        if known:
+            # re-read AFTER arming: a missed-TTL mark that committed
+            # while this batch was in flight saw none of these timers
+            # armed — revive those nodes too, in the same ack
+            snap2 = self.store.snapshot()
+            seen = set(stale)
+            for node_id in known:
+                node = snap2.node_by_id(node_id)
+                if (node is not None and node_id not in seen
+                        and node.status != enums.NODE_STATUS_READY):
+                    stale.append(node_id)
+        if stale:
+            self.store.update_nodes_status(stale, enums.NODE_STATUS_READY,
+                                           ts=time.time())
+            for node_id in stale:
+                self.heartbeats.reset(node_id)
+            self._create_node_evals_batch(stale)
+        return self.config.heartbeat_ttl
 
     def update_node_status(self, node_id: str, status: str) -> None:
         self.store.update_node_status(node_id, status, ts=time.time())
@@ -601,9 +704,27 @@ class Server:
         disconnects (max_client_disconnect), the node goes `disconnected`
         — its allocs turn unknown rather than lost — otherwise `down`
         (reference node_endpoint.go disconnect handling)."""
-        try:
+        self.mark_nodes_down([node_id], reason=reason)
+
+    def mark_nodes_down(self, node_ids: List[str], reason: str = "") -> None:
+        """Batched missed-TTL handler: one status command per status
+        class and one eval pass for the whole expiry batch. A node that
+        heartbeated AFTER its expiry was collected (its TTL is armed
+        again) is skipped — expiry collection and the mark-down commit
+        are not atomic, and marking a just-checked-in node down would be
+        exactly the missed-TTL false positive this plane must not
+        produce."""
+        snap = self.store.snapshot()
+        down: List[str] = []
+        disconnected: List[str] = []
+        for node_id in node_ids:
+            if self.heartbeats.armed(node_id):
+                continue
+            if snap.node_by_id(node_id) is None:
+                # node was deleted while its TTL timer was in flight
+                self.heartbeats.remove(node_id)
+                continue
             status = enums.NODE_STATUS_DOWN
-            snap = self.store.snapshot()
             for alloc in snap.allocs_by_node(node_id):
                 if alloc.terminal_status():
                     continue
@@ -612,10 +733,30 @@ class Server:
                 if tg is not None and tg.max_client_disconnect_s is not None:
                     status = enums.NODE_STATUS_DISCONNECTED
                     break
-            self.update_node_status(node_id, status)
-        except KeyError:
-            # node was deleted while its TTL timer was in flight
-            self.heartbeats.remove(node_id)
+            if status == enums.NODE_STATUS_DOWN:
+                down.append(node_id)
+            else:
+                disconnected.append(node_id)
+        ts = time.time()
+        revived: List[str] = []
+        for group, status in ((down, enums.NODE_STATUS_DOWN),
+                              (disconnected, enums.NODE_STATUS_DISCONNECTED)):
+            if not group:
+                continue
+            self.store.update_nodes_status(group, status, ts=ts)
+            for node_id in group:
+                # a heartbeat that re-armed the TTL while the mark was
+                # committing wins: leave its timer running and flip the
+                # node straight back to ready below
+                if self.heartbeats.armed(node_id):
+                    revived.append(node_id)
+                else:
+                    self.heartbeats.remove(node_id)
+        if revived:
+            self.store.update_nodes_status(
+                revived, enums.NODE_STATUS_READY, ts=time.time())
+        if down or disconnected:
+            self._create_node_evals_batch(down + disconnected)
 
     def deregister_node(self, node_id: str) -> None:
         """Node.Deregister: drop the node and reschedule its work."""
@@ -634,36 +775,48 @@ class Server:
     def _create_node_evals(self, node_id: str) -> List[str]:
         """One eval per job with allocs on the node
         (node_endpoint.go:1645 createNodeEvals)."""
+        return self._create_node_evals_batch([node_id])
+
+    def _create_node_evals_batch(self, node_ids: List[str]) -> List[str]:
+        """createNodeEvals over a whole node batch off ONE snapshot: one
+        eval per (job, node) pair, one store write + one broker enqueue
+        for the lot (the expiry/registration batches feed this)."""
         snap = self.store.snapshot()
-        node = snap.node_by_id(node_id)
-        jobs: Dict[tuple, Job] = {}
-        for alloc in snap.allocs_by_node(node_id):
-            if alloc.terminal_status():
-                continue
-            job = snap.job_by_id(alloc.job_id, alloc.namespace)
-            if job is not None:
-                jobs[(alloc.namespace, alloc.job_id)] = job
-        # system jobs must also re-evaluate when a node comes up
-        if node is not None and node.ready():
-            for job in snap.jobs():
-                if job.type in (enums.JOB_TYPE_SYSTEM, enums.JOB_TYPE_SYSBATCH):
-                    jobs[(job.namespace, job.id)] = job
+        now = time.time()
+        sys_jobs: Optional[List[Job]] = None
         out = []
         evals = []
-        for job in jobs.values():
-            ev = Evaluation(
-                id=generate_uuid(),
-                namespace=job.namespace,
-                priority=job.priority,
-                type=job.type,
-                triggered_by=enums.TRIGGER_NODE_UPDATE,
-                job_id=job.id,
-                node_id=node_id,
-                status=enums.EVAL_STATUS_PENDING,
-                create_time=time.time(),
-            )
-            evals.append(ev)
-            out.append(ev.id)
+        for node_id in node_ids:
+            node = snap.node_by_id(node_id)
+            jobs: Dict[tuple, Job] = {}
+            for alloc in snap.allocs_by_node(node_id):
+                if alloc.terminal_status():
+                    continue
+                job = snap.job_by_id(alloc.job_id, alloc.namespace)
+                if job is not None:
+                    jobs[(alloc.namespace, alloc.job_id)] = job
+            # system jobs must also re-evaluate when a node comes up
+            if node is not None and node.ready():
+                if sys_jobs is None:
+                    sys_jobs = [j for j in snap.jobs() if j.type in
+                                (enums.JOB_TYPE_SYSTEM,
+                                 enums.JOB_TYPE_SYSBATCH)]
+                for job in sys_jobs:
+                    jobs[(job.namespace, job.id)] = job
+            for job in jobs.values():
+                ev = Evaluation(
+                    id=generate_uuid(),
+                    namespace=job.namespace,
+                    priority=job.priority,
+                    type=job.type,
+                    triggered_by=enums.TRIGGER_NODE_UPDATE,
+                    job_id=job.id,
+                    node_id=node_id,
+                    status=enums.EVAL_STATUS_PENDING,
+                    create_time=now,
+                )
+                evals.append(ev)
+                out.append(ev.id)
         if evals:
             self.store.upsert_evals(evals)
             self.broker.enqueue_all(evals)
@@ -701,7 +854,13 @@ class Server:
         """Node.UpdateAlloc: batched client -> server alloc status sync;
         failed allocs trigger reschedule evals (node_endpoint.go
         UpdateAlloc -> createRescheduleEvals)."""
-        self.store.update_allocs_from_client(updates)
+        if not updates:
+            return
+        if self.client_updates.running:
+            # coalesce with every other client's in-flight sync round
+            self.client_updates.submit(updates)
+        else:
+            self.store.update_allocs_from_client(updates)
         snap = self.store.snapshot()
         seen = set()
         evals = []

@@ -35,7 +35,7 @@ def _is_loopback_bind(bind: str) -> bool:
 
 FORWARD = ("register_job", "deregister_job", "dispatch_job",
            "scale_job", "revert_job",
-           "register_node", "heartbeat",
+           "register_node", "register_nodes", "heartbeat", "heartbeat_batch",
            "update_node_status", "update_node_drain",
            "update_node_eligibility", "deregister_node",
            "update_allocs_from_client", "stop_alloc",

@@ -19,7 +19,8 @@ import time
 from typing import Any, List
 
 MUTATIONS = {
-    "upsert_node", "update_node_status", "update_node_eligibility",
+    "upsert_node", "upsert_nodes", "update_node_status",
+    "update_nodes_status", "update_node_eligibility",
     "update_node_drain", "delete_node",
     "upsert_job", "delete_job", "update_job_status",
     "upsert_evals", "delete_evals",
@@ -92,6 +93,7 @@ TIMESTAMPED = {
     "take_one_time_token",
     "upsert_evals", "upsert_allocs", "update_allocs_from_client",
     "upsert_plan_results", "upsert_plan_results_batch", "update_node_status",
+    "update_nodes_status",
     "update_alloc_desired_transitions",
 }
 

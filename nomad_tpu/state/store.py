@@ -631,6 +631,58 @@ class StateStore:
             self._commit(gen, [("node-upsert", node)])
             return gen
 
+    def upsert_nodes(self, nodes: List[Node]) -> int:
+        """Batched node upsert: one generation, one commit, one event
+        per node (the swarm registration path — per-node commits would
+        be one raft round trip each at 100K nodes)."""
+        with self._write_lock:
+            gen, live = self._begin()
+            events = []
+            for node in nodes:
+                prev = self._nodes.get_latest(node.id)
+                if prev is not None:
+                    node.create_index = prev.create_index
+                    if (node.drain_strategy is None
+                            and prev.drain_strategy is not None):
+                        node.drain_strategy = prev.drain_strategy
+                        node.scheduling_eligibility = prev.scheduling_eligibility
+                else:
+                    node.create_index = gen
+                node.modify_index = gen
+                node._avail_vec = None
+                if not node.computed_class:
+                    node.compute_class()
+                self._nodes.put(node.id, node, gen, live)
+                self._usage_row(node.id)
+                events.append(("node-upsert", node))
+            self._bump_node_set(gen)
+            self._commit(gen, events)
+            return gen
+
+    def update_nodes_status(self, node_ids: List[str], status: str,
+                            ts: float = None) -> int:
+        """Batched status flip: one generation for a whole expiry or
+        recovery batch. Unknown ids are skipped, not raised — under raft
+        a node may be deleted between proposing the batch and applying
+        it, and the FSM must apply identically on every replica."""
+        ts = ts if ts is not None else self._clock()
+        with self._write_lock:
+            gen, live = self._begin()
+            events = []
+            for node_id in node_ids:
+                node = self._nodes.get_latest(node_id)
+                if node is None:
+                    continue
+                node = copy.copy(node)
+                node.status = status
+                node.status_updated_at = ts
+                node.modify_index = gen
+                self._nodes.put(node_id, node, gen, live)
+                events.append(("node-status", node))
+            self._bump_node_set(gen)
+            self._commit(gen, events)
+            return gen
+
     def _update_node(self, node_id: str, event: str, mutate) -> int:
         with self._write_lock:
             node = self._nodes.get_latest(node_id)

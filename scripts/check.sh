@@ -19,6 +19,11 @@
 #                                   e2e load; one follower wiped +
 #                                   restarted, catch-up via chunked
 #                                   install-snapshot, zero acked loss)
+#   scripts/check.sh --swarm-smoke  also run the client-plane swarm
+#                                   smoke (200 sim nodes flap-churning
+#                                   while 3 leaders crash in sequence;
+#                                   node liveness + alloc uniqueness on
+#                                   every replica)
 set -u
 cd "$(dirname "$0")/.."
 
@@ -26,12 +31,14 @@ run_e2e_smoke=0
 run_solve_smoke=0
 run_trace_smoke=0
 run_snap_smoke=0
+run_swarm_smoke=0
 for arg in "$@"; do
     case "$arg" in
         --e2e-smoke) run_e2e_smoke=1 ;;
         --solve-smoke) run_solve_smoke=1 ;;
         --trace-smoke) run_trace_smoke=1 ;;
         --snap-smoke) run_snap_smoke=1 ;;
+        --swarm-smoke) run_swarm_smoke=1 ;;
         *) echo "unknown flag: $arg" >&2; exit 64 ;;
     esac
 done
@@ -135,6 +142,18 @@ if [ "$run_snap_smoke" = 1 ]; then
     echo "== snap smoke (python -m nomad_tpu.chaos --snap-smoke) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 300 \
         python -m nomad_tpu.chaos --snap-smoke || failed=1
+fi
+
+# client-plane swarm smoke (opt-in, ~20s): 200 sim nodes speaking the
+# real register/heartbeat-batch/alloc-ack surface while a churn loop
+# flaps a rolling slice and THREE leaders crash in sequence — no
+# stable node wrongly expired, silenced nodes expire only after a real
+# >= TTL silence and recover, check_node_liveness + alloc uniqueness
+# hold on every replica (ROBUSTNESS.md "Client plane")
+if [ "$run_swarm_smoke" = 1 ]; then
+    echo "== swarm smoke (python -m nomad_tpu.chaos --swarm-smoke) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 300 \
+        python -m nomad_tpu.chaos --swarm-smoke || failed=1
 fi
 
 echo "== tier-1 tests =="

@@ -1,0 +1,220 @@
+"""Sharded HeartbeatManager tests: arming/expiry across timer-wheel
+shards, restore() semantics (dedup, grace horizon), remove() racing the
+expiry path, disable mid-expiry, and the expiry-rate limiter. The
+cross-thread interleaving sweep lives in the `node_lifecycle` modelcheck
+scenario (nomad_tpu/analysis/modelcheck.py)."""
+
+import threading
+import time
+
+from nomad_tpu.core.heartbeat import HeartbeatManager
+
+
+class FakeServer:
+    """Records mark-down calls; optionally blocks inside the mark (to
+    pin an expiry thread mid-flight) or raises (leadership lost)."""
+
+    def __init__(self, block=None, fail=False):
+        self.marks = []            # (node_id, monotonic time)
+        self.lock = threading.Lock()
+        self.entered = threading.Event()
+        self.block = block
+        self.fail = fail
+
+    def mark_nodes_down(self, node_ids, reason=""):
+        self.entered.set()
+        if self.block is not None:
+            self.block.wait(timeout=10.0)
+        if self.fail:
+            raise RuntimeError("not the leader")
+        now = time.monotonic()
+        with self.lock:
+            for nid in node_ids:
+                self.marks.append((nid, now))
+
+    def mark_node_down(self, node_id, reason=""):
+        self.mark_nodes_down([node_id], reason=reason)
+
+    def down_ids(self):
+        with self.lock:
+            return [nid for nid, _ in self.marks]
+
+
+def _manager(ttl=0.15, shards=4, **kw):
+    srv = FakeServer()
+    mgr = HeartbeatManager(srv, ttl=ttl, shards=shards, **kw)
+    mgr.set_enabled(True)
+    return srv, mgr
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def test_reset_returns_ttl_and_noops_when_disabled():
+    srv = FakeServer()
+    mgr = HeartbeatManager(srv, ttl=7.5, shards=2)
+    # disabled (followers): reset still reports the TTL but arms nothing
+    assert mgr.reset("n1") == 7.5
+    assert mgr.active() == 0 and not mgr.armed("n1")
+    mgr.set_enabled(True)
+    try:
+        assert mgr.reset("n1") == 7.5
+        assert mgr.armed("n1") and mgr.active() == 1
+    finally:
+        mgr.set_enabled(False)
+    assert mgr.active() == 0
+
+
+def test_expiry_across_shards_marks_batch_down():
+    srv, mgr = _manager(ttl=0.15, shards=4)
+    try:
+        ids = [f"n{i}" for i in range(24)]
+        for nid in ids:
+            mgr.reset(nid)
+        assert sum(mgr.shard_depths()) == 24
+        assert _wait(lambda: sorted(srv.down_ids()) == sorted(ids))
+        assert mgr.active() == 0
+        assert mgr.stats["invalidated"] == 24
+        # attribution: every expiry spans >= ~TTL from arming
+        for nid, armed_at, expired_at in mgr.expiry_snapshot():
+            assert expired_at - armed_at >= 0.15 * 0.95 - 0.01
+    finally:
+        mgr.set_enabled(False)
+
+
+def test_heartbeat_before_ttl_prevents_expiry():
+    srv, mgr = _manager(ttl=0.3)
+    try:
+        stop = time.time() + 1.0
+        while time.time() < stop:
+            mgr.reset("live")
+            time.sleep(0.05)
+        assert srv.down_ids() == []     # 3+ TTLs, never silent
+        assert _wait(lambda: srv.down_ids() == ["live"])
+    finally:
+        mgr.set_enabled(False)
+
+
+def test_restore_dedups_ignores_empty_and_arms_unknown_ids():
+    srv, mgr = _manager(ttl=0.2)
+    try:
+        # dup armed once, empty skipped, ghost (not in any store) armed:
+        # a fresh leader must time out nodes that never check in again
+        assert mgr.restore(["dup", "dup", "", "ghost"]) == 2
+        assert mgr.active() == 2
+        assert _wait(lambda: sorted(srv.down_ids()) == ["dup", "ghost"])
+        assert len(srv.down_ids()) == 2   # exactly once each
+    finally:
+        mgr.set_enabled(False)
+
+
+def test_restore_grace_clamps_preexisting_deadlines():
+    srv, mgr = _manager(ttl=0.4)
+    try:
+        mgr.reset("old")              # deadline ~t0+0.4
+        time.sleep(0.3)
+        t_restore = time.monotonic()
+        mgr.restore(["failover"])     # grace horizon ~t0+0.7
+        assert _wait(lambda: "old" in srv.down_ids())
+        with srv.lock:
+            at = dict(srv.marks)["old"]
+        # "old" was clamped to the grace horizon, not expired at its
+        # original (pre-failover) deadline
+        assert at - t_restore >= 0.4 * 0.95 - 0.02
+        assert _wait(lambda: "failover" in srv.down_ids())
+    finally:
+        mgr.set_enabled(False)
+
+
+def test_remove_racing_expiry_never_double_marks():
+    srv, mgr = _manager(ttl=0.05, shards=2)
+    try:
+        for rnd in range(30):
+            nid = f"race-{rnd}"
+            mgr.reset(nid)
+            t = threading.Thread(target=mgr.remove, args=(nid,))
+            t.start()
+            mgr._invalidate(nid)
+            t.join()
+        time.sleep(0.2)
+        counts = {}
+        for nid in srv.down_ids():
+            counts[nid] = counts.get(nid, 0) + 1
+        assert all(c == 1 for c in counts.values()), counts
+    finally:
+        mgr.set_enabled(False)
+
+
+def test_removed_node_is_not_expired():
+    srv, mgr = _manager(ttl=0.15)
+    try:
+        mgr.reset("gone")
+        mgr.reset("stays")
+        mgr.remove("gone")
+        assert not mgr.armed("gone") and mgr.armed("stays")
+        assert _wait(lambda: srv.down_ids() == ["stays"])
+        time.sleep(0.2)
+        assert srv.down_ids() == ["stays"]
+    finally:
+        mgr.set_enabled(False)
+
+
+def test_set_enabled_false_mid_expiry_joins_cleanly():
+    release = threading.Event()
+    srv = FakeServer(block=release)
+    mgr = HeartbeatManager(srv, ttl=0.1, shards=2)
+    mgr.set_enabled(True)
+    mgr.reset("victim")
+    assert srv.entered.wait(timeout=5.0)   # shard thread pinned in mark
+    done = threading.Event()
+
+    def disable():
+        mgr.set_enabled(False)             # must join the pinned thread
+        done.set()
+
+    t = threading.Thread(target=disable)
+    t.start()
+    time.sleep(0.05)
+    assert not done.is_set()               # still waiting on the mark
+    release.set()
+    t.join(timeout=5.0)
+    assert done.is_set()
+    assert mgr.active() == 0
+    # a reset after disable is a no-op, not a resurrection
+    mgr.reset("victim")
+    assert mgr.active() == 0
+
+
+def test_mark_failure_is_swallowed_and_counted():
+    srv = FakeServer(fail=True)
+    mgr = HeartbeatManager(srv, ttl=0.1, shards=2)
+    mgr.set_enabled(True)
+    try:
+        mgr.reset("n1")
+        assert _wait(lambda: mgr.stats["mark_failed"] >= 1)
+        # the expiry is still attributed even though the mark failed
+        assert mgr.stats["invalidated"] >= 1
+    finally:
+        mgr.set_enabled(False)
+
+
+def test_expiry_rate_limiter_paces_mass_expiry():
+    srv, mgr = _manager(ttl=0.1, shards=2, expiry_rate=20.0)
+    try:
+        # more simultaneous deadlines than the bucket's burst (= rate):
+        # the limiter must defer the overflow, then drain the backlog
+        # as tokens refill — a paced trickle, not a thundering herd
+        ids = [f"n{i}" for i in range(40)]
+        for nid in ids:
+            mgr.reset(nid)
+        assert _wait(lambda: sorted(srv.down_ids()) == sorted(ids), 10.0)
+        assert mgr.stats["rate_limited"] > 0
+        assert len(srv.down_ids()) == 40
+    finally:
+        mgr.set_enabled(False)

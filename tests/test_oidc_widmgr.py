@@ -233,6 +233,37 @@ class TestWIDMgr:
             c.stop()
             s.stop()
 
+    def test_stop_racing_start_never_joins_unstarted_thread(self):
+        """Client.stop() can reach WIDMgr.stop() while the alloc-runner
+        thread is inside WIDMgr.start(); joining the thread object
+        between its construction and Thread.start() raises RuntimeError.
+        The pair must be atomic whichever side wins."""
+        from nomad_tpu.client.widmgr import WIDMgr
+
+        for _ in range(50):
+            mgr = WIDMgr(server=None, alloc=mock.alloc(mock.job(),
+                                                       mock.node()),
+                         task_names=[], task_dir_fn=lambda name: "/tmp")
+            barrier = threading.Barrier(2)
+
+            def starter():
+                barrier.wait()
+                mgr.start()
+
+            def stopper():
+                barrier.wait()
+                mgr.stop()
+
+            threads = [threading.Thread(target=starter),
+                       threading.Thread(target=stopper)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=5.0)
+            mgr.stop()   # idempotent; joins the loop if start() won
+            t = mgr._thread
+            assert t is None or not t.is_alive()
+
     def test_terminal_alloc_gets_no_identity(self, tmp_path):
         s = Server(ServerConfig())
         s.start()

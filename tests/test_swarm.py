@@ -1,0 +1,402 @@
+"""Client-plane swarm + delta alloc sync tests: the sim-node fleet
+against a live single server, the AllocSyncHub delta/resync protocol,
+the ClientUpdateBatcher, the client's delta watch path, and the
+Client.stop() shutdown race. The 3-node failover matrix is the
+--swarm-smoke chaos gate (nomad_tpu/chaos/__main__.py)."""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.chaos.invariants import InvariantChecker, InvariantViolation
+from nomad_tpu.chaos.swarm import Swarm, make_sim_node
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.core import Server, ServerConfig
+from nomad_tpu.core.allocsync import AllocSyncHub, ClientUpdateBatcher
+from nomad_tpu.core.events import EventBroker
+from nomad_tpu.state.store import StateStore
+from nomad_tpu.structs import enums
+from nomad_tpu.structs.job import Task
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def _statuses(server, ids):
+    snap = server.store.snapshot()
+    return {nid: snap.node_by_id(nid).status for nid in ids
+            if snap.node_by_id(nid) is not None}
+
+
+# ---------------------------------------------------------------------------
+# swarm against a live server
+# ---------------------------------------------------------------------------
+
+
+def test_swarm_fleet_stays_alive_then_silenced_slice_expires():
+    ttl = 0.5
+    s = Server(ServerConfig(heartbeat_ttl=ttl, heartbeat_shards=4,
+                            gc_interval=3600.0))
+    s.start()
+    swarm = Swarm(lambda: s, 120, ttl=ttl, drivers=2, rpc_batch=32)
+    checker = InvariantChecker()
+    try:
+        assert swarm.register_all(chunk=40) == 120
+        swarm.start()
+        time.sleep(ttl * 3)            # several TTLs of sustained beats
+        stats = _statuses(s, swarm.ids())
+        assert len(stats) == 120
+        assert all(v == enums.NODE_STATUS_READY for v in stats.values())
+        checker.check_node_liveness(s, swarm=swarm, ttl=ttl)
+
+        silenced = swarm.nodes[:30]
+        swarm.silence(silenced)
+        sil_ids = {sn.id for sn in silenced}
+        assert _wait(lambda: all(
+            v != enums.NODE_STATUS_READY
+            for k, v in _statuses(s, sil_ids).items()), ttl * 20 + 10)
+        # exactly the silenced slice went down, and each down-mark is
+        # attributable to a real >= TTL silence
+        stats = _statuses(s, swarm.ids())
+        wrong = [k for k, v in stats.items()
+                 if (v == enums.NODE_STATUS_READY) == (k in sil_ids)]
+        assert not wrong, wrong[:5]
+        checker.check_node_liveness(s, swarm=swarm, ttl=ttl)
+
+        swarm.unsilence(silenced)      # recovery: next beat flips ready
+        assert _wait(lambda: all(
+            v == enums.NODE_STATUS_READY
+            for v in _statuses(s, swarm.ids()).values()), 15.0)
+        checker.check_node_liveness(s, swarm=swarm, ttl=ttl)
+        assert swarm.total_beats() > 0
+    finally:
+        swarm.stop()
+        s.stop()
+
+
+def test_liveness_invariant_catches_fabricated_false_positive():
+    ttl = 5.0
+    s = Server(ServerConfig(heartbeat_ttl=ttl))
+    s.start()
+    swarm = Swarm(lambda: s, 2, ttl=ttl)
+    checker = InvariantChecker()
+    try:
+        assert swarm.register_all(chunk=2) == 2
+        nid = swarm.nodes[0].id
+        # a down-mark right after a server-acked heartbeat IS the
+        # missed-TTL false positive the invariant exists to catch
+        s.store.update_node_status(nid, enums.NODE_STATUS_DOWN)
+        with pytest.raises(InvariantViolation):
+            checker.check_node_liveness(s, swarm=swarm, ttl=ttl)
+    finally:
+        swarm.stop()
+        s.stop()
+
+
+def test_register_nodes_batch_validates_and_arms():
+    s = Server(ServerConfig(heartbeat_ttl=30.0))
+    s.start()
+    try:
+        nodes = [make_sim_node(i) for i in range(5)]
+        ttl = s.register_nodes(nodes)
+        assert ttl == 30.0
+        snap = s.store.snapshot()
+        assert all(snap.node_by_id(n.id) is not None for n in nodes)
+        assert all(s.heartbeats.armed(n.id) for n in nodes)
+        bad = make_sim_node(6)
+        bad.id = ""
+        with pytest.raises(ValueError):
+            s.register_nodes([bad])
+    finally:
+        s.stop()
+
+
+def test_heartbeat_batch_revives_stale_and_drops_unknown():
+    s = Server(ServerConfig(heartbeat_ttl=30.0))
+    s.start()
+    try:
+        nodes = [make_sim_node(i) for i in range(3)]
+        s.register_nodes(nodes)
+        nid = nodes[0].id
+        s.store.update_node_status(nid, enums.NODE_STATUS_DOWN)
+        assert s.heartbeat_batch([n.id for n in nodes] + ["ghost"]) == 30.0
+        assert (s.store.snapshot().node_by_id(nid).status
+                == enums.NODE_STATUS_READY)
+        assert not s.heartbeats.armed("ghost")
+        # single-node path still raises for unknown nodes (the client
+        # re-registers on KeyError)
+        with pytest.raises(KeyError):
+            s.heartbeat("ghost")
+    finally:
+        s.stop()
+
+
+def test_heartbeat_rejected_when_plane_inactive():
+    """A server whose expiry plane is down (not the leader, stopping)
+    must REJECT heartbeats rather than ack a no-op: the silent ack lets
+    the client believe it checked in while the real leader's TTL keeps
+    running toward a missed-TTL false positive."""
+    from nomad_tpu.core.heartbeat import HeartbeatPlaneInactive
+
+    s = Server(ServerConfig(heartbeat_ttl=30.0))
+    s.start()
+    try:
+        nodes = [make_sim_node(i) for i in range(2)]
+        s.register_nodes(nodes)
+        assert s.heartbeat_batch([n.id for n in nodes]) == 30.0
+        s.heartbeats.set_enabled(False)
+        with pytest.raises(HeartbeatPlaneInactive):
+            s.heartbeat_batch([n.id for n in nodes])
+        with pytest.raises(HeartbeatPlaneInactive):
+            s.heartbeat(nodes[0].id)
+    finally:
+        s.heartbeats.set_enabled(True)
+        s.stop()
+
+
+def test_mark_nodes_down_revives_node_rearmed_mid_commit():
+    """A heartbeat that re-arms the TTL while the mark-down command is
+    committing must win: the node flips straight back to ready and its
+    timer keeps running (expiry collection and the mark are not
+    atomic)."""
+    s = Server(ServerConfig(heartbeat_ttl=30.0))
+    s.start()
+    try:
+        nodes = [make_sim_node(i) for i in range(2)]
+        s.register_nodes(nodes)
+        racer, bystander = nodes[0].id, nodes[1].id
+        for nid in (racer, bystander):
+            s.heartbeats.remove(nid)    # disarm as an expiry would
+        orig = s.store.update_nodes_status
+
+        def rearm_mid_commit(ids, status, ts=None):
+            out = orig(ids, status, ts=ts)
+            if status == enums.NODE_STATUS_DOWN and racer in ids:
+                s.heartbeats.reset(racer)   # beat lands just after commit
+            return out
+
+        s.store.update_nodes_status = rearm_mid_commit
+        try:
+            s.mark_nodes_down([racer, bystander], reason="ttl")
+        finally:
+            s.store.update_nodes_status = orig
+        snap = s.store.snapshot()
+        assert snap.node_by_id(racer).status == enums.NODE_STATUS_READY
+        assert snap.node_by_id(bystander).status == enums.NODE_STATUS_DOWN
+        assert s.heartbeats.armed(racer)
+        assert not s.heartbeats.armed(bystander)
+    finally:
+        s.stop()
+
+
+# ---------------------------------------------------------------------------
+# delta alloc sync
+# ---------------------------------------------------------------------------
+
+
+class _HubServer:
+    """Store + broker, nothing else — the surface AllocSyncHub needs."""
+
+    def __init__(self, ring_size=4096):
+        self.store = StateStore()
+        self.events = EventBroker(self.store, ring_size=ring_size)
+
+
+def test_alloc_sync_delivers_deltas_per_node():
+    srv = _HubServer()
+    hub = AllocSyncHub(srv)
+    hub.start()
+    try:
+        n1, n2 = make_sim_node(1), make_sim_node(2)
+        sub = hub.subscribe(n1.id)
+        j = mock.job()
+        mine = mock.alloc(j, n1)
+        other = mock.alloc(j, n2)
+        srv.store.upsert_allocs([mine, other])
+        batch, resync = sub.poll(timeout=5.0)
+        assert not resync
+        assert [a.id for a in batch] == [mine.id]
+        # coalescing: several updates to one alloc keep the newest
+        upd = mine.copy_for_update()
+        upd.client_status = enums.ALLOC_CLIENT_RUNNING
+        srv.store.update_allocs_from_client([upd])
+        assert _wait(lambda: hub.stats["deltas"] >= 2)
+        batch, resync = sub.poll(timeout=5.0)
+        assert [a.id for a in batch] == [mine.id] and not resync
+        sub.close()
+        assert sub.closed
+    finally:
+        hub.stop()
+
+
+def test_alloc_sync_ring_truncation_forces_full_resync():
+    srv = _HubServer(ring_size=8)
+    hub = AllocSyncHub(srv)
+    sub = hub.subscribe("sim-000001")
+    hub.start()
+    n = make_sim_node(1)
+    j = mock.job()
+    try:
+        # wedge the pump: hold the subscriber's condvar so any delivery
+        # to it blocks, then wrap the 8-slot ring past the pump's
+        # cursor — a guaranteed subscription gap once it resumes
+        with sub._cond:
+            for _ in range(40):
+                srv.store.upsert_allocs([mock.alloc(j, n)])
+        deadline = time.time() + 10.0
+        resync = False
+        while not resync and time.time() < deadline:
+            _batch, resync = sub.poll(timeout=1.0)
+        assert resync, "pump never flagged the gap for a full resync"
+        assert hub.stats["resyncs"] >= 1
+    finally:
+        sub.close()
+        hub.stop()
+
+
+def test_client_update_batcher_coalesces_rounds():
+    srv = _HubServer()
+    n = make_sim_node(1)
+    j = mock.job()
+    allocs = [mock.alloc(j, n) for _ in range(8)]
+    srv.store.upsert_allocs(allocs)
+    b = ClientUpdateBatcher(srv.store)
+    b.start()
+    try:
+        def ack(a):
+            upd = a.copy_for_update()
+            upd.client_status = enums.ALLOC_CLIENT_RUNNING
+            b.submit([upd])
+
+        threads = [threading.Thread(target=ack, args=(a,)) for a in allocs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = srv.store.snapshot()
+        assert all(snap.alloc_by_id(a.id).client_status
+                   == enums.ALLOC_CLIENT_RUNNING for a in allocs)
+        assert b.stats["batched_updates"] == 8
+        assert 1 <= b.stats["rounds"] <= 8
+    finally:
+        b.stop()
+    # after stop, submit falls through to a direct store commit
+    upd = allocs[0].copy_for_update()
+    upd.client_status = enums.ALLOC_CLIENT_COMPLETE
+    b.submit([upd])
+    assert (srv.store.snapshot().alloc_by_id(allocs[0].id).client_status
+            == enums.ALLOC_CLIENT_COMPLETE)
+
+
+def test_client_update_batcher_isolates_poisoned_update():
+    class _PoisonStore:
+        def __init__(self):
+            self.applied = []
+
+        def update_allocs_from_client(self, updates, ts=None):
+            if any(u.id == "poison" for u in updates):
+                raise ValueError("bad update")
+            self.applied.extend(u.id for u in updates)
+
+    store = _PoisonStore()
+    b = ClientUpdateBatcher(store)
+    b.start()
+    try:
+        n = make_sim_node(1)
+        j = mock.job()
+        good = mock.alloc(j, n)
+        bad = mock.alloc(j, n)
+        bad.id = "poison"
+        errs = []
+
+        def submit(u):
+            try:
+                b.submit([u])
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=submit, args=(u,))
+                   for u in (good, bad)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # the good caller committed; only the poisoned caller failed
+        assert store.applied == [good.id]
+        assert len(errs) == 1 and isinstance(errs[0], ValueError)
+    finally:
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# client delta watch + shutdown race
+# ---------------------------------------------------------------------------
+
+
+def test_client_runs_job_via_delta_watch(tmp_path):
+    s = Server(ServerConfig(heartbeat_ttl=30.0))
+    s.start()
+    c = Client(s, ClientConfig(data_dir=str(tmp_path / "c"),
+                               heartbeat_interval=0.5,
+                               watch_interval=5.0))  # deltas, not polls
+    c.start()
+    try:
+        assert s.alloc_sync.running
+        job = mock.job()
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0] = Task(
+            name="web", driver="mock", config={"run_for": 60.0})
+        s.register_job(job)
+        assert c.wait_until(lambda: any(
+            a.client_status == enums.ALLOC_CLIENT_RUNNING
+            for a in s.store.snapshot().allocs_by_job(job.id)), 15.0)
+        # the placement reached the client as a pushed delta: with a 5s
+        # watch_interval a poll-only client could not have started it
+        assert s.alloc_sync.stats["deltas"] >= 1
+        # stop flows back through the delta path too
+        s.deregister_job(job.id)
+        assert c.wait_until(lambda: all(
+            a.client_terminal()
+            for a in s.store.snapshot().allocs_by_job(job.id)), 15.0)
+    finally:
+        c.stop()
+        s.stop()
+
+
+def test_client_stop_halts_heartbeats_without_racing_deregister(tmp_path):
+    s = Server(ServerConfig(heartbeat_ttl=30.0))
+    s.start()
+    c = Client(s, ClientConfig(data_dir=str(tmp_path / "c"),
+                               heartbeat_interval=0.01))
+    c.start()
+    try:
+        assert _wait(lambda: s.store.snapshot().node_by_id(c.node.id)
+                     is not None)
+        calls = []
+        real = s.heartbeat
+
+        def spying_heartbeat(node_id):
+            calls.append(time.monotonic())
+            return real(node_id)
+
+        s.heartbeat = spying_heartbeat
+        time.sleep(0.1)                 # let the spy observe some beats
+        c.stop()
+        stopped_at = time.monotonic()
+        time.sleep(0.3)
+        # no heartbeat RPC may START after stop() returned: stop() holds
+        # the rpc lock until in-flight calls finish and the loops
+        # re-check the stop flag under it (the deregister/heartbeat
+        # resurrection race)
+        late = [t for t in calls if t > stopped_at]
+        assert not late, f"{len(late)} heartbeat(s) after stop()"
+    finally:
+        s.stop()
