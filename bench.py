@@ -575,12 +575,19 @@ def cfg4_system_preemption() -> None:
         # rung's variance") needs to see WHICH phase moved, not just dt
         from nomad_tpu.obs import TRACER
         from nomad_tpu.obs.export import phase_breakdown
+        from nomad_tpu.tensor.placer import preempt_stats
 
         TRACER.clear()
+        pstats0 = preempt_stats()
         t0 = time.perf_counter()
         h.process(mock.eval_for(hi, id="bench4-ev-hi"), sched_config=cfg)
         h.process(mock.eval_for(sysj, id="bench4-ev-sys"), sched_config=cfg)
         dt = time.perf_counter() - t0
+        # preemption-path split over the timed region only: in-kernel
+        # victim selections vs exact-host-scanner routes vs host-side
+        # allocs_fit revalidations of kernel victim sets
+        pstats = {key: val - pstats0[key]
+                  for key, val in preempt_stats().items()}
         phases = {name: row["total_ms"] for name, row
                   in phase_breakdown(TRACER.spans()).items()
                   if name.startswith(("worker.", "solver."))}
@@ -589,22 +596,28 @@ def cfg4_system_preemption() -> None:
                           if not a.terminal_status()]) for j in (hi, sysj))
         preempted = len([a for a in snap.allocs_by_job(filler.id)
                          if a.desired_status == enums.ALLOC_DESIRED_EVICT])
-        return dt, placed, preempted, phases
+        return dt, placed, preempted, phases, pstats
 
     def med(algorithm: str, repeats: int = 3):
         runs = [run(algorithm) for _ in range(repeats)]
         names = sorted({n for r in runs for n in r[3]})
         phases = {n: round(statistics.median(
             r[3].get(n, 0.0) for r in runs), 2) for n in names}
+        pstats = {n: statistics.median(r[4][n] for r in runs)
+                  for n in runs[0][4]}
         return tuple(statistics.median(r[i] for r in runs)
-                     for i in range(3)) + (phases,)
+                     for i in range(3)) + (phases, pstats)
 
-    tdt, tplaced, tpre, tphases = med(enums.SCHED_ALG_TPU_BINPACK)
-    hdt, hplaced, hpre, _ = med(enums.SCHED_ALG_BINPACK)
+    tdt, tplaced, tpre, tphases, tpstats = med(enums.SCHED_ALG_TPU_BINPACK)
+    hdt, hplaced, hpre, _, _ = med(enums.SCHED_ALG_BINPACK)
     assert tplaced == hplaced, (tplaced, hplaced)
     return emit("system_preempt_sched_throughput_mixed_priorities",
                 tplaced / tdt, "allocs/s", hdt / tdt,
-                placed=tplaced, preempted=tpre, host_preempted=hpre,
+                placed=tplaced, preempted=tpre,
+                kernel_preempted=tpstats["kernel_preempted"],
+                host_preempted=tpstats["host_preempted"],
+                victim_parity_checked=tpstats["victim_parity_checked"],
+                host_arm_preempted=hpre,
                 phase_total_ms=tphases)
 
 

@@ -390,12 +390,19 @@ def solve_smoke(nodes_n: int = 40, jobs_n: int = 4,
     went through the joint auction launch, the selected assignment's
     packing score is >= the in-launch greedy counterfactual (the
     portfolio guarantee, checked end to end), and the alloc-set
-    uniqueness + safety invariants hold on every replica."""
+    uniqueness + safety invariants hold on every replica.
+
+    A second leg exercises in-kernel preemption end to end: a
+    low-priority filler eats the head room, then a high-priority batch
+    job must preempt its way on. Asserts the whole preemption wave
+    resolved through kernels.preempt_solve (host_preempted delta == 0,
+    kernel_preempted > 0) and re-runs the full invariant sweep (alloc
+    uniqueness on every replica) over the post-eviction state."""
     import shutil
 
     from ..core.server import ServerConfig
     from ..structs import enums
-    from ..structs.operator import SchedulerConfiguration
+    from ..structs.operator import PreemptionConfig, SchedulerConfiguration
     from .invariants import InvariantChecker
 
     t0 = time.monotonic()
@@ -404,7 +411,10 @@ def solve_smoke(nodes_n: int = 40, jobs_n: int = 4,
         return ServerConfig(
             num_workers=2, eval_batch_size=4, plan_commit_batching=True,
             sched_config=SchedulerConfiguration(
-                scheduler_algorithm=enums.SCHED_ALG_TPU_SOLVE),
+                scheduler_algorithm=enums.SCHED_ALG_TPU_SOLVE,
+                preemption_config=PreemptionConfig(
+                    batch_scheduler_enabled=True,
+                    service_scheduler_enabled=True)),
             heartbeat_ttl=3600.0, gc_interval=3600.0, nack_timeout=900.0,
             failed_eval_followup_delay=3600.0,
             failed_eval_unblock_interval=0.5)
@@ -479,6 +489,70 @@ def solve_smoke(nodes_n: int = 40, jobs_n: int = 4,
                       f"{score_s:.3f} below the greedy counterfactual "
                       f"{score_g:.3f}")
                 return 2
+
+            # -- preemption leg: filler (prio 20) eats the head room,
+            # then a high-priority batch job preempts its way on. Every
+            # row must resolve through the kernel's victim columns —
+            # the exact host scanner staying cold IS the assertion.
+            from ..tensor.placer import preempt_stats
+            pstats0 = preempt_stats()
+
+            def drain(label: str) -> bool:
+                deadline = time.time() + 240
+                while True:
+                    if leader.server.wait_for_idle(
+                            timeout=10.0, include_delayed=False) \
+                            and leader.server.blocked.blocked_count() == 0:
+                        return True
+                    if time.time() > deadline:
+                        print(f"SOLVE SMOKE: FAIL — {label} did not "
+                              f"drain")
+                        return False
+                    time.sleep(0.1)
+
+            filler = mock.batch_job()
+            filler.priority = 20
+            ftg = filler.task_groups[0]
+            ftg.count = nodes_n
+            ftg.tasks[0].resources.cpu = 8000
+            ftg.tasks[0].resources.memory_mb = 13000
+            leader.register_job(filler)
+            if not drain("preemption filler"):
+                return 2
+            hi = mock.batch_job()
+            hi.priority = 80
+            htg = hi.task_groups[0]
+            htg.count = count
+            htg.tasks[0].resources.cpu = 1500
+            htg.tasks[0].resources.memory_mb = 2000
+            leader.register_job(hi)
+            if not drain("preemption wave"):
+                return 2
+
+            pdelta = {key: val - pstats0[key]
+                      for key, val in preempt_stats().items()}
+            kpre, hpre = (pdelta["kernel_preempted"],
+                          pdelta["host_preempted"])
+            if kpre < 1:
+                print("SOLVE SMOKE: FAIL — the preemption wave never "
+                      "reached the kernel (kernel_preempted == 0)")
+                return 2
+            if hpre != 0:
+                print(f"SOLVE SMOKE: FAIL — {hpre} preemption(s) "
+                      f"routed through the exact host scanner on the "
+                      f"bulk path (expected 0)")
+                return 2
+            snap = leader.local_store.snapshot()
+            hi_placed = [a for a in snap.allocs_by_job(hi.id)
+                         if not a.terminal_status()
+                         and not a.server_terminal()]
+            if len(hi_placed) != count:
+                print(f"SOLVE SMOKE: FAIL — {len(hi_placed)}/{count} "
+                      f"high-priority placements landed")
+                return 2
+            # post-eviction state: uniqueness + safety on every replica
+            checker.check_convergence(cluster, timeout=30.0)
+            checker.check_all(cluster)
         finally:
             cluster.stop()
     finally:
@@ -486,8 +560,9 @@ def solve_smoke(nodes_n: int = 40, jobs_n: int = 4,
     dt = time.monotonic() - t0
     print(f"SOLVE SMOKE: ok — {want} placements via {launches} joint "
           f"launch(es), selected score {score_s:.2f} >= greedy "
-          f"{score_g:.2f}, {checker.stats['checks']} invariant sweeps, "
-          f"{dt:.1f}s")
+          f"{score_g:.2f}, preemption wave {len(hi_placed)} placements "
+          f"({kpre} in-kernel, {hpre} host), "
+          f"{checker.stats['checks']} invariant sweeps, {dt:.1f}s")
     return 0
 
 

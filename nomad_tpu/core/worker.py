@@ -231,8 +231,14 @@ class Worker:
         commit thread. Members still ack/nack individually; a failure
         redelivers that eval alone."""
         from .metrics import REGISTRY
+        from ..tensor.placer import preempt_stats
 
         REGISTRY.set_gauge("nomad.worker.eval_batch_size", len(batch))
+        # per-batch preemption-path split: how much of this batch's
+        # preemption resolved in-kernel vs through the exact host
+        # scanner (the nomad.preempt.* counters are cumulative; the
+        # delta across one batch is what the obs plane graphs)
+        preempt_before = preempt_stats()
         snap = None
         try:
             target = max(ev.modify_index for ev, _ in batch)
@@ -243,6 +249,13 @@ class Worker:
                 snap = self.server.store.snapshot_min_index(target)
         except Exception:
             snap = None  # fall back to per-eval acquisition
+        def publish_preempt_delta():
+            post = preempt_stats()
+            for key in ("kernel_preempted", "host_preempted"):
+                delta = post[key] - preempt_before[key]
+                if delta:
+                    REGISTRY.set_gauge(f"nomad.worker.batch_{key}", delta)
+
         pool = self._batch_pool
         if len(batch) == 1 or pool is None:
             for ev, token in batch:
@@ -252,6 +265,7 @@ class Worker:
                 # a partial commit inside a previous member refreshed
                 # the snapshot; carry the fresher one forward
                 snap = self.process_one(ev, token, snapshot=snap) or snap
+            publish_preempt_delta()
             return
         # "tpu-solve": open a rendezvous sized to this dequeue_batch so
         # the bulk-solver service coalesces every member's solve into
@@ -284,6 +298,7 @@ class Worker:
                 f.result()
             except Exception:
                 pass  # _EvalRun.run never raises; belt and braces
+        publish_preempt_delta()
 
     @staticmethod
     def _run_member(batch_ctx, eval_run):

@@ -44,6 +44,32 @@ def is_preemptible(alloc: Allocation, current_priority: int) -> bool:
             and alloc.should_count_for_usage())
 
 
+def victim_candidates(proposed: Sequence[Allocation],
+                      current_priority: int) -> List[Allocation]:
+    """Eligible victims in the CANONICAL COLUMN ORDER the in-kernel
+    prefix rule consumes: priority ascending (the reference's
+    filterAndGroupPreemptibleAllocs group order), alloc id ascending
+    within a priority tie so the order is deterministic across
+    processes (leader failover replaying an eval must select the same
+    victims). This is the single eligibility definition shared by the
+    exact scanner below, the tensor victim-column builder
+    (tensor/cluster.build_victim_tensors), and the preempt_solve kernel
+    parity oracle."""
+    cands = [a for a in proposed if is_preemptible(a, current_priority)]
+    cands.sort(key=lambda a: (a.job.priority, a.id))
+    return cands
+
+
+def victim_holds_exact_resources(alloc: Allocation) -> bool:
+    """True when evicting this alloc changes state the dense resource
+    columns can't model — reserved/dynamic port numbers or concrete
+    device instances. The preempt_solve kernel flags any row whose
+    victim set includes such an alloc so the placer re-routes that one
+    request through the exact host scanner (preempt_for_network /
+    preempt_for_device semantics)."""
+    return bool(alloc.allocated_ports) or bool(alloc.allocated_devices)
+
+
 def basic_resource_distance(need: np.ndarray, have: np.ndarray) -> float:
     """Euclidean distance between normalized resource vectors
     (reference preemption.go basicResourceDistance)."""
@@ -86,16 +112,15 @@ def preempt_for_task_group(
     None/empty when impossible. `preempted_counts` carries per-(ns, job,
     tg) evictions already in the plan so migrate max_parallel penalties
     apply across the whole eval."""
-    candidates = [a for a in proposed if is_preemptible(a, current_priority)]
+    # shared eligibility + canonical priority-ascending order; within a
+    # group the loop below prefers the alloc whose resources best match
+    # what's still missing (smallest distance to need, plus the
+    # max_parallel penalty)
+    candidates = victim_candidates(proposed, current_priority)
     if not candidates:
         return None
 
     counts: Dict[tuple, int] = dict(preempted_counts or {})
-
-    # group by priority ascending; within a group prefer the alloc whose
-    # resources best match what's still missing (smallest distance to
-    # need, plus the max_parallel penalty)
-    candidates.sort(key=lambda a: (a.job.priority,))
 
     victims: List[Allocation] = []
     victim_ids = set()

@@ -252,6 +252,79 @@ class ClusterTensors:
 
 
 @dataclass
+class VictimTensors:
+    """Per-node victim columns for the in-kernel preemption solve
+    (kernels.preempt_solve): every eligible lower-priority alloc on a
+    node becomes a column slot carrying its priority, allocated
+    resource vector, eligibility, and an exact-resource flag
+    (port/device holders the dense columns can't model — rows whose
+    victim set touches one fall back to the exact host scanner).
+
+    Built per (eval, task-group priority) snapshot — eligibility
+    depends on the in-progress plan's proposed allocs, so unlike
+    ClusterStatic these are NOT cacheable across evals. Column order is
+    scheduler.preemption.victim_candidates' canonical order (priority
+    asc, alloc id asc), which is exactly the prefix order the kernel
+    consumes; `refs[i][v]` maps column v of node i back to the concrete
+    Allocation. v_pad quantizes to powers of two (same G_PAD/K_PAD
+    discipline as the solver service) so the production shape compiles
+    once at warmup."""
+
+    v_pad: int
+    prio: np.ndarray       # (Np, V) f32, 0 on empty slots
+    vec: np.ndarray        # (Np, V, D) f32 allocated resource vectors
+    elig: np.ndarray       # (Np, V) bool
+    flagged: np.ndarray    # (Np, V) bool port/device holders
+    refs: List[List]       # per real node, column order
+    evictable: np.ndarray  # (Np, D) f32 sum of eligible victim vectors
+    net_prio: np.ndarray   # (Np,) f32 aggregate max + sum/max
+
+
+def build_victim_tensors(ctx: EvalContext, cluster: "ClusterTensors",
+                         current_priority: int,
+                         v_floor: int = 8) -> VictimTensors:
+    """Lower every node's preemptible-alloc set into padded victim
+    columns + the per-node aggregates (evictable capacity, approximate
+    netPriority) the node-choice score consumes. One pass over proposed
+    allocs per node — this replaces the Python aggregate loops the old
+    host preemption path re-ran per batch."""
+    from ..scheduler.preemption import (victim_candidates,
+                                        victim_holds_exact_resources)
+
+    nodes = cluster.nodes
+    n_pad = cluster.n_pad
+    d = cluster.available.shape[1]
+    per_node = [victim_candidates(ctx.proposed_allocs(node.id),
+                                  current_priority) for node in nodes]
+    v_max = max((len(c) for c in per_node), default=0)
+    v_pad = _pad_pow2(max(v_max, 1), floor=v_floor)
+
+    prio = np.zeros((n_pad, v_pad), dtype=np.float32)
+    vec = np.zeros((n_pad, v_pad, d), dtype=np.float32)
+    elig = np.zeros((n_pad, v_pad), dtype=bool)
+    flagged = np.zeros((n_pad, v_pad), dtype=bool)
+    max_p = np.zeros(n_pad, dtype=np.float32)
+    sum_p = np.zeros(n_pad, dtype=np.float32)
+    for i, cands in enumerate(per_node):
+        for v, a in enumerate(cands):
+            p = float(a.job.priority)
+            prio[i, v] = p
+            vec[i, v] = np.asarray(a.allocated_vec[:d], dtype=np.float32)
+            elig[i, v] = True
+            flagged[i, v] = victim_holds_exact_resources(a)
+            sum_p[i] += p
+            if p > max_p[i]:
+                max_p[i] = p
+    evictable = (vec * elig[:, :, None]).sum(axis=1)
+    net_prio = np.where(max_p > 0,
+                        max_p + sum_p / np.maximum(max_p, 1.0),
+                        0.0).astype(np.float32)
+    return VictimTensors(v_pad=v_pad, prio=prio, vec=vec, elig=elig,
+                         flagged=flagged, refs=per_node,
+                         evictable=evictable, net_prio=net_prio)
+
+
+@dataclass
 class TaskGroupTensors:
     """Everything kernels.solve_task_group needs for one task group."""
 

@@ -794,3 +794,93 @@ def preempt_pick(
     _, picks = jax.lax.scan(step, (used0, evictable0),
                             jnp.arange(active.shape[0]))
     return picks.astype(jnp.int32)
+
+
+@jax.jit
+def preempt_solve(
+    available,   # (N, D) capacity
+    used0,       # (N, D) proposed usage
+    ask,         # (D,)
+    feasible,    # (N,) bool constraint/driver mask
+    net_prio,    # (N,) approximate netPriority aggregate (see preempt_pick)
+    active,      # (K,) bool request slots
+    v_prio,      # (N, V) f32 victim priorities (column order: priority
+                 #        asc, alloc id asc — scheduler.preemption.
+                 #        victim_candidates' canonical order)
+    v_vec,       # (N, V, D) f32 victim allocated resource vectors
+    v_elig,      # (N, V) bool eligibility (delta-10 + usage-counting)
+    v_flag,      # (N, V) bool port/device holders the dense columns
+                 #        can't model — rows selecting one are flagged
+                 #        for the exact host scanner
+):
+    """Whole preemption solve for K requests in ONE launch: node choice
+    (same ordering as preempt_pick — fit after eviction + logistic
+    preemption penalty) AND concrete victim selection.
+
+    Victims are a priority-ascending PREFIX of the chosen node's
+    still-unclaimed eligible column, taken until the deficit is covered
+    in every resource dim (the kernel analog of preempt_for_task_group's
+    ascending priority groups; within-group distance refinement and the
+    filterSuperset drop stay host-side in the exact scanner, which is
+    the fallback for flagged rows). The carry commits usage, remaining
+    evictable capacity, and a per-victim `taken` mask so sibling
+    requests in the same launch never double-claim a victim.
+
+    Returns (picks (K,) i32 node or -1,
+             victims (K, V) bool mask into the picked node's column,
+             flagged (K,) bool — victim set includes an exact-resource
+                     holder, route this row through the host scanner,
+             scores (K,) f32 winning node score).
+    """
+    f = available.dtype
+    rate, origin = 0.0048, 2048.0
+    pscore_node = 1.0 / (1.0 + jnp.exp(rate * (net_prio - origin)))
+
+    ev0 = jnp.sum(v_vec * v_elig[:, :, None].astype(f), axis=1)
+    taken0 = jnp.zeros(v_prio.shape, dtype=bool)
+
+    def step(carry, i):
+        used, ev, taken = carry
+        new_used = used + ask[None, :]
+        deficit = jnp.maximum(new_used - available, 0.0)
+        can = feasible & jnp.all(deficit <= ev, axis=1)
+        needs_evict = jnp.any(deficit > 0.0, axis=1)
+        fitness = fit_scores(available, jnp.minimum(new_used, available), False)
+        divisor = 1.0 + needs_evict.astype(f)
+        score = (fitness + jnp.where(needs_evict, pscore_node, 0.0)) / divisor
+        score = jnp.where(can, score, NEG)
+        best = jnp.argmax(score)
+        found = (score[best] > NEG) & active[i]
+
+        # priority-ascending prefix over the best node's unclaimed
+        # eligible column: a victim is selected while ANY dim's deficit
+        # is not yet covered by the victims before it (columns are
+        # pre-sorted, so cumsum-before IS the prefix sum)
+        row_elig = v_elig[best] & ~taken[best]
+        vecs = v_vec[best] * row_elig[:, None].astype(f)
+        cum_before = jnp.cumsum(vecs, axis=0) - vecs
+        def_b = deficit[best]
+        sel = (row_elig & needs_evict[best]
+               & jnp.any((def_b[None, :] > 0.0)
+                         & (cum_before < def_b[None, :]), axis=1))
+        sel = sel & found
+        evicted = jnp.sum(v_vec[best] * sel[:, None].astype(f), axis=0)
+        flagged_i = jnp.any(sel & v_flag[best])
+
+        def apply(c):
+            used, ev, taken = c
+            used = used.at[best].set(
+                jnp.maximum(used[best] + ask - evicted, 0.0))
+            ev = ev.at[best].set(jnp.maximum(ev[best] - evicted, 0.0))
+            taken = taken.at[best].set(taken[best] | sel)
+            return used, ev, taken
+
+        used, ev, taken = jax.lax.cond(found, apply, lambda c: c,
+                                       (used, ev, taken))
+        return ((used, ev, taken),
+                (jnp.where(found, best, -1), sel, flagged_i,
+                 jnp.where(found, score[best], NEG)))
+
+    _, (picks, victims, flagged, scores) = jax.lax.scan(
+        step, (used0, ev0, taken0), jnp.arange(active.shape[0]))
+    return (picks.astype(jnp.int32), victims, flagged, scores.astype(f))

@@ -79,6 +79,97 @@ def _preempt_pick_host(available, used, evictable, ask, feasible, net_prio,
     return picks
 
 
+def _preempt_solve_host(available, used, ask, feasible, net_prio, active,
+                        v_prio, v_vec, v_elig, v_flag):
+    """Numpy mirror of kernels.preempt_solve — same node ordering AND the
+    same priority-ascending victim-prefix rule, same op order, so the
+    small-shape path and the parity tests pin the kernel bit-exact
+    (victims, order, post-eviction usage). Returns (picks, victims,
+    flagged, scores) with the kernel's shapes."""
+    pscore = 1.0 / (1.0 + np.exp(0.0048 * (net_prio - 2048.0)))
+    used = np.asarray(used, dtype=np.float64).copy()
+    v_vec = np.asarray(v_vec, dtype=np.float64)
+    elig = np.asarray(v_elig, dtype=bool)
+    ev = (v_vec * elig[:, :, None]).sum(axis=1)
+    taken = np.zeros(elig.shape, dtype=bool)
+    kq, vq = active.shape[0], elig.shape[1]
+    picks = np.full(kq, -1, dtype=np.int32)
+    victims = np.zeros((kq, vq), dtype=bool)
+    flagged = np.zeros(kq, dtype=bool)
+    neg = -1.0e30
+    scores = np.full(kq, neg)
+    for i in range(kq):
+        if not active[i]:
+            continue
+        new_used = used + ask[None, :]
+        deficit = np.maximum(new_used - available, 0.0)
+        can = feasible & (deficit <= ev).all(axis=1)
+        if not can.any():
+            continue
+        needs_evict = (deficit > 0.0).any(axis=1)
+        fitness = _binpack_fitness_np(available,
+                                      np.minimum(new_used, available))
+        score = np.where(
+            can,
+            (fitness + np.where(needs_evict, pscore, 0.0))
+            / (1.0 + needs_evict.astype(float)),
+            neg)
+        best = int(np.argmax(score))
+        if score[best] <= neg:
+            continue
+        row = elig[best] & ~taken[best]
+        vecs = v_vec[best] * row[:, None]
+        cum_before = np.cumsum(vecs, axis=0) - vecs
+        def_b = deficit[best]
+        sel = (row & bool(needs_evict[best])
+               & ((def_b[None, :] > 0.0)
+                  & (cum_before < def_b[None, :])).any(axis=1))
+        evicted = (v_vec[best] * sel[:, None]).sum(axis=0)
+        picks[i] = best
+        victims[i] = sel
+        flagged[i] = bool((sel & v_flag[best]).any())
+        scores[i] = score[best]
+        used[best] = np.maximum(used[best] + ask - evicted, 0.0)
+        ev[best] = np.maximum(ev[best] - evicted, 0.0)
+        taken[best] |= sel
+    return picks, victims, flagged, scores
+
+
+# Preemption-path counters: kernel_preempted = placements whose victims
+# came straight from the preempt_solve column prefix; host_preempted =
+# rows re-routed through the exact host scanner (flagged port/device
+# holders, exact-resource groups, or a revalidation miss);
+# victim_parity_checked = kernel rows revalidated host-side via
+# allocs_fit before commit (every kernel row takes this check, so
+# kernel_preempted counts only validated successes). Mirrored into the
+# Registry as nomad.preempt.* for the obs plane; read via
+# preempt_stats() (bench cfg4, chaos solve-smoke).
+PREEMPT_STATS = {"kernel_preempted": 0, "host_preempted": 0,
+                 "victim_parity_checked": 0}
+_PREEMPT_STATS_LOCK = __import__("threading").Lock()
+# shapes (n_pad, k_pad, v_pad, d) already compiled: later launches of the
+# same shape run under a jit_guard no_retrace window (retrace there is a
+# bug, not a warmup)
+_PREEMPT_WARM: set = set()
+
+
+def preempt_stats() -> Dict[str, int]:
+    """Snapshot of the preemption-path counters (thread-safe copy)."""
+    with _PREEMPT_STATS_LOCK:
+        return dict(PREEMPT_STATS)
+
+
+def _count_preempt(**deltas: int) -> None:
+    from ..core.metrics import REGISTRY
+
+    with _PREEMPT_STATS_LOCK:
+        for key, n in deltas.items():
+            PREEMPT_STATS[key] += n
+    for key, n in deltas.items():
+        if n:
+            REGISTRY.incr(f"nomad.preempt.{key}", n)
+
+
 # One solve at a time across racing workers' PER-EVAL kernel path (the
 # device serializes launches regardless); see the critical-section note
 # in place(). The bulk path has its own serializer (the solver service).
@@ -363,9 +454,10 @@ class TPUPlacer:
     BULK_MIN = 256     # below this the per-placement scan is fine
     BULK_STEP = 256    # placements assigned per scan step
     HOST_CUTOVER = 16  # at/below this the host oracle beats a launch
-    # preemption node-choice runs on-device only when the (nodes x
-    # requests) matrix is big enough to beat the tunnel's fixed latency
-    PREEMPT_DEVICE_MIN = 1 << 20
+    # preempt_solve runs on-device only when the (nodes x requests)
+    # matrix is big enough to beat the tunnel's fixed latency (measured
+    # at 1024x512/V=8: warm scan ~13 ms vs ~80 ms for the numpy mirror)
+    PREEMPT_DEVICE_MIN = 1 << 18
 
     def _bulk_eligible(self, ctx, tg, reqs, tgt) -> bool:
         """K large, every request a fresh placement, BestFit binpack with
@@ -575,69 +667,93 @@ class TPUPlacer:
     def _preempt_batch(self, ctx, job, tg, reqs, cluster, tgt, commit, *,
                        sched_batch: bool, attempt: int, n_feasible: int,
                        invalidate=None) -> None:
-        with TRACER.span("worker.preempt", k=len(reqs)):
+        """Preemption for K unplaced requests as ONE in-kernel solve:
+        kernels.preempt_solve picks each request's node (fit after
+        eviction + the logistic preemption penalty) AND its concrete
+        victims (priority-ascending prefix over the node's eligible
+        victim column, carry-committed so siblings never double-claim).
+        The host's remaining work per kernel row is one allocs_fit
+        revalidation of the selected victim set (counted as
+        victim_parity_checked) before the RankedNode commits.
+
+        Span layout follows the work's new home: building the victim
+        columns is tensor build (`worker.tensor_build`), the device/
+        mirror launch is solver work (`solver.preempt`), revalidate +
+        commit of kernel rows is `worker.preempt_commit`, and
+        `worker.preempt` — the historically GC-noisy pure-Python host
+        pass PERF.md tracks — now wraps ONLY the exact-scanner arm, so
+        it reads ~0 when the kernel resolves every row."""
+        from .cluster import build_victim_tensors
+
+        with TRACER.span("worker.tensor_build", kind="victim_columns"):
+            vt = build_victim_tensors(ctx, cluster, job.priority)
+        k_pad = _pad_pow2(len(reqs), floor=1)
+        active = np.zeros(k_pad, dtype=bool)
+        active[: len(reqs)] = True
+        with TRACER.span("solver.preempt", k=len(reqs)):
+            picks, victims, flagged, scores = self._launch_preempt_solve(
+                cluster, tgt, vt, active, k_pad)
+        with TRACER.span("worker.preempt_commit", k=len(reqs)):
             self._preempt_batch_inner(
-                ctx, job, tg, reqs, cluster, tgt, commit,
+                ctx, job, tg, reqs, cluster, tgt, commit, vt,
+                picks, victims, flagged, scores,
                 sched_batch=sched_batch, attempt=attempt,
                 n_feasible=n_feasible, invalidate=invalidate)
 
     def _preempt_batch_inner(self, ctx, job, tg, reqs, cluster, tgt,
-                             commit, *, sched_batch: bool, attempt: int,
+                             commit, vt, picks, victims, flagged, scores,
+                             *, sched_batch: bool, attempt: int,
                              n_feasible: int, invalidate=None) -> None:
-        """Preemption for K unplaced requests as ONE device pass + K
-        single-node host victim selections, replacing the per-request
-        full-cluster host scan (the round-3 fallback that ran cfg4 at
-        0.47x stock). The kernel (kernels.preempt_pick) orders candidate
-        nodes by fit-after-eviction + the logistic preemption penalty
-        over per-node preemptible aggregates; the host then runs the
-        exact reference victim selection (scheduler/preemption.py) only
-        on each chosen node, falling back to the full host scan for any
-        request whose chosen node can't actually be freed (aggregate
-        mispredictions: delta-10 groups, device/port holders)."""
+        """Resolve the kernel's (pick, victim-set) rows into committed
+        placements. The exact host scanner (NodeScorer.rank ->
+        preempt_for_* + filterSuperset) survives as the fallback arm:
+        rows the kernel flags (victim holds exact ports/devices), groups
+        that need exact id assignment, reschedules carrying a node
+        penalty, and revalidation misses. Those count as host_preempted
+        — ~0 on the bulk path."""
         from ..scheduler.rank import NodeScorer
-        from ..scheduler.preemption import PRIORITY_DELTA
-        from .kernels import preempt_pick
+        from ..structs import allocs_fit
+        from ..structs.alloc import Allocation
 
         nodes = cluster.nodes
-        n_pad = cluster.n_pad
-        prio = job.priority
-        evictable = np.zeros((n_pad, cluster.available.shape[1]))
-        max_prio = np.zeros(n_pad)
-        sum_prio = np.zeros(n_pad)
-        for i, node in enumerate(nodes):
-            for a in ctx.proposed_allocs(node.id):
-                if (a.job is not None
-                        and prio - a.job.priority >= PRIORITY_DELTA
-                        and a.should_count_for_usage()):
-                    evictable[i] += a.allocated_vec[: evictable.shape[1]]
-                    p = float(a.job.priority)
-                    sum_prio[i] += p
-                    if p > max_prio[i]:
-                        max_prio[i] = p
-        net_prio = np.where(max_prio > 0,
-                            max_prio + sum_prio / np.maximum(max_prio, 1.0),
-                            0.0)
-        k_pad = _pad_pow2(len(reqs), floor=1)
-        active = np.zeros(k_pad, dtype=bool)
-        active[: len(reqs)] = True
-        if n_pad * k_pad >= self.PREEMPT_DEVICE_MIN:
-            picks = np.asarray(preempt_pick(
-                cluster.available, cluster.used, evictable, tgt.ask,
-                tgt.feasible, net_prio, active))
-        else:
-            # same math without a device launch: below this size the
-            # tunnel's fixed latency dwarfs the vector work
-            picks = _preempt_pick_host(
-                cluster.available, cluster.used.copy(), evictable, tgt.ask,
-                tgt.feasible, net_prio, active)
+        ask_res = ctx.tg_resources(tg)
+        # exact port numbers / device instances / cores can't come from
+        # the dense victim columns — those groups keep the host scanner
+        exact_needed = bool(ask_res.reserved_port_asks()
+                            or ask_res.dynamic_port_count()
+                            or ask_res.devices or ask_res.cores)
+        ask_vec = ctx.tg_vec(tg)
 
         scorer = NodeScorer(ctx, job, tg, algorithm=self._host_algorithm(),
                             preemption_enabled=True)
+        # one shared metrics object for kernel rows (bulk-path idiom —
+        # a per-alloc AllocMetric at K=512 is pure overhead); host-arm
+        # rows keep per-row metrics the scorer populates
+        kernel_metrics = ctx.new_metrics()
+        kernel_metrics.nodes_in_pool = len(nodes)
+        kernel_metrics.nodes_evaluated = len(nodes)
+        # ProposedAllocs walks snapshot + plan rows per call; cache it
+        # per node and drop the entry whenever a commit mutates that
+        # node's plan, so repeat rows reuse the walk without ever
+        # reading a stale victim list
+        prop_cache: Dict[str, list] = {}
+
+        def proposed(node_id: str):
+            out = prop_cache.get(node_id)
+            if out is None:
+                out = prop_cache[node_id] = ctx.proposed_allocs(node_id)
+            return out
+
+        def host_metrics():
+            m = ctx.new_metrics()
+            m.nodes_in_pool = len(nodes)
+            m.nodes_evaluated = len(nodes)
+            return m
+
+        n_kernel = n_host = n_parity = 0
         for i, req in enumerate(reqs):
-            metrics = ctx.new_metrics()
-            metrics.nodes_in_pool = len(nodes)
-            metrics.nodes_evaluated = len(nodes)
             option = None
+            kernel_row = False
             ni = int(picks[i])
             if req.ignore_node:
                 # rescheduled alloc: the batched pick carries no
@@ -645,22 +761,114 @@ class TPUPlacer:
                 # (which weighs it) for these rare requests
                 ni = -1
             if 0 <= ni < len(nodes):
-                # exact victim selection + scoring on the chosen node
-                # only (ports/devices/spread handled by the scorer)
-                option = scorer.rank(nodes[ni])
-            if option is None:
+                node = nodes[ni]
+                if not exact_needed and not bool(flagged[i]):
+                    ctx.metrics = kernel_metrics
+                    option = self._commit_kernel_victims(
+                        ctx, node, vt, ni, victims[i], float(scores[i]),
+                        ask_vec, proposed, allocs_fit, Allocation)
+                    n_parity += 1
+                    kernel_row = option is not None
+                if option is None:
+                    # exact-resource group, flagged victim, or a
+                    # revalidation miss: exact victim selection + scoring
+                    # on the chosen node (ports/devices/spread handled by
+                    # the scorer)
+                    with TRACER.span("worker.preempt"):
+                        host_metrics()
+                        option = scorer.rank(node)
+            if option is None and not kernel_row:
                 # aggregate misprediction: full host scan for this one
-                option = self._preempt_fallback(ctx, job, tg, nodes, req,
-                                                sched_batch, attempt)
+                with TRACER.span("worker.preempt"):
+                    host_metrics()
+                    option = self._preempt_fallback(ctx, job, tg, nodes,
+                                                    req, sched_batch,
+                                                    attempt)
             if option is not None:
                 commit(req, option)
+                prop_cache.pop(option.node.id, None)
                 scorer.record_placement(option.node)
                 if invalidate is not None:
                     invalidate(option.node.id)
+                if kernel_row:
+                    n_kernel += 1
+                else:
+                    n_host += 1
                 continue
-            metrics = ctx.metrics or metrics
-            self._attribute_failure(ctx, metrics, len(nodes), n_feasible)
+            self._attribute_failure(ctx, ctx.metrics or host_metrics(),
+                                    len(nodes), n_feasible)
             commit(req, None)
+        _count_preempt(kernel_preempted=n_kernel, host_preempted=n_host,
+                       victim_parity_checked=n_parity)
+
+    def _launch_preempt_solve(self, cluster, tgt, vt, active, k_pad):
+        """Run kernels.preempt_solve on-device (big shapes, under a
+        jit_guard no_retrace window once the shape is warm) or through
+        the numpy mirror (below PREEMPT_DEVICE_MIN the tunnel's fixed
+        latency dwarfs the vector work). Both arms return identical
+        (picks, victims, flagged, scores) host arrays."""
+        n_pad = cluster.n_pad
+        if n_pad * k_pad < self.PREEMPT_DEVICE_MIN:
+            return _preempt_solve_host(
+                cluster.available, cluster.used.copy(), tgt.ask,
+                tgt.feasible, vt.net_prio, active,
+                vt.prio, vt.vec, vt.elig, vt.flagged)
+        import jax
+
+        from .jit_guard import no_retrace
+        from .kernels import preempt_solve
+
+        f32 = np.float32
+        args = (cluster.available.astype(f32), cluster.used.astype(f32),
+                tgt.ask.astype(f32), tgt.feasible,
+                vt.net_prio.astype(f32), active,
+                vt.prio, vt.vec, vt.elig, vt.flagged)
+        shape_key = (n_pad, k_pad, vt.v_pad, cluster.available.shape[1])
+        # explicit shipment on BOTH arms: committed jax.Arrays and bare
+        # numpy hit different jit cache entries, so a cold bare call
+        # followed by a warm device_put call would read as a retrace
+        dev = jax.device_put(args)
+        if shape_key in _PREEMPT_WARM:
+            # warm shape: any retrace or implicit transfer is a bug
+            with no_retrace(preempt_solve):
+                out = jax.device_get(preempt_solve(*dev))
+        else:
+            out = jax.device_get(preempt_solve(*dev))
+            _PREEMPT_WARM.add(shape_key)
+        picks, victims, flagged, scores = out
+        return (np.asarray(picks), np.asarray(victims),
+                np.asarray(flagged), np.asarray(scores))
+
+    def _commit_kernel_victims(self, ctx, node, vt, ni, sel, score,
+                               ask_vec, proposed, allocs_fit, Allocation):
+        """Turn one kernel row (node ni + victim column mask) into a
+        scored RankedNode, revalidating the post-eviction fit host-side
+        with the exact AllocsFit (cores/ports collision semantics the
+        dense columns can't see). Returns None on a revalidation miss —
+        the caller re-routes that row through the exact scanner.
+
+        The kernel's combined score is reused as the final score: its
+        (fitness + preemption)/2 is the same mean the host scorer's
+        binpack+preemption normalize() produces, evaluated against the
+        solve's own carried usage — recomputing it per row was a third
+        of the residual loop."""
+        refs = vt.refs[ni] if ni < len(vt.refs) else []
+        chosen = [refs[v] for v in np.nonzero(sel)[0] if v < len(refs)]
+        prop = proposed(node.id)
+        prop_ids = {a.id for a in prop}
+        # a victim already evicted by an earlier host-arm row in this
+        # batch is gone from proposed — its capacity is already free
+        chosen = [a for a in chosen if a.id in prop_ids]
+        victim_ids = {a.id for a in chosen}
+        placement = Allocation(id="_cand", allocated_vec=ask_vec)
+        remaining = [a for a in prop if a.id not in victim_ids]
+        fit, _dim, _used_after = allocs_fit(node, remaining + [placement])
+        if not fit:
+            return None
+        option = RankedNode(node=node)
+        option.preempted_allocs = chosen or None
+        option.final_score = score
+        return option
 
     @staticmethod
     def _bulk_trajectory_mean(counts: np.ndarray, cluster, tgt) -> float:

@@ -350,7 +350,7 @@ def make_solve_batch_sharded(mesh: Mesh, axis: str = "nodes",
     import jax.numpy as jnp
     from functools import partial
 
-    from .batch_solver import (MAX_ROUNDS, PRICE_EPS, RESTARTS, TOP_R,
+    from .batch_solver import (MAX_ROUNDS, PORTFOLIO, PRICE_EPS, TOP_R,
                                _packing_score_xp)
     from .kernels import NEG, TIE_JITTER
 
@@ -358,7 +358,7 @@ def make_solve_batch_sharded(mesh: Mesh, axis: str = "nodes",
     n_dev = int(np.prod(mesh.devices.shape))
 
     def _joint_body(used0, avail, feas, aff, ask, k, seeds, cidx, cdelta,
-                    g: int):
+                    evict=None, net_prio=None, *, g: int):
         from .kernels import _fit_scores_xp as fit_xp
 
         n_loc, d = used0.shape
@@ -366,6 +366,10 @@ def make_solve_batch_sharded(mesh: Mesh, axis: str = "nodes",
         f = used0.dtype
         me = jax.lax.axis_index(axis)
         lo = me * n_loc
+        # victim budgets (row-sharded like avail); pscore is local too
+        avail_cap = avail if evict is None else avail + evict
+        pscore_loc = (None if net_prio is None else
+                      1.0 / (1.0 + jnp.exp(0.0048 * (net_prio - 2048.0))))
         # int32 throughout the carry (x64 mode: arange defaults int64,
         # sum() promotes int32 -> int64 — both break the loop carry)
         g_idx = jnp.arange(g, dtype=jnp.int32)
@@ -390,17 +394,29 @@ def make_solve_batch_sharded(mesh: Mesh, axis: str = "nodes",
         r_loc = min(TOP_R, n_loc)
         r_glob = min(TOP_R, n)
 
-        def body(state, jits):
+        def body(state, jits, price_eps):
             used, remaining, take, price, rnd, _ = state
             price_loc = jax.lax.dynamic_slice(price, (lo,), (n_loc,))
             new_used = used[None, :, :] + ask[:, None, :]     # (G,nl,D)
-            ok = feas & jnp.all(new_used <= avail[None, :, :], axis=2)
+            ok = feas & jnp.all(new_used <= avail_cap[None, :, :], axis=2)
             ok &= (remaining > 0)[:, None]
-            fitness = fit_xp(jnp, avail[None, :, :], new_used, False)
-            score = (fitness + jnp.where(aff_present, aff, 0.0)) / divisor
+            if evict is None:
+                fitness = fit_xp(jnp, avail[None, :, :], new_used, False)
+                score = (fitness
+                         + jnp.where(aff_present, aff, 0.0)) / divisor
+            else:
+                # over-capacity bids spend victim budget (mirrors the
+                # single-device eviction branch exactly)
+                fitness = fit_xp(
+                    jnp, avail[None, :, :],
+                    jnp.minimum(new_used, avail[None, :, :]), False)
+                over = jnp.any(new_used > avail[None, :, :], axis=2)
+                score = (fitness + jnp.where(aff_present, aff, 0.0)
+                         + jnp.where(over, pscore_loc[None, :], 0.0)) / (
+                             divisor + over.astype(f))
             bid = jnp.where(ok, score + jits - price_loc[None, :], NEG)
             lvals, lidx = jax.lax.top_k(bid, r_loc)           # (G, RL)
-            free = avail[lidx] - used[lidx]                   # (G,RL,D)
+            free = avail_cap[lidx] - used[lidx]               # (G,RL,D)
             per_dim = jnp.where(
                 ask_pos[:, None, :],
                 jnp.floor(free
@@ -459,7 +475,7 @@ def make_solve_batch_sharded(mesh: Mesh, axis: str = "nodes",
             filled = won & (cap_w > 0) & (amt.astype(f) >= cap_w)
             node_filled = jnp.zeros(n, jnp.bool_).at[flat_gid].max(
                 filled.reshape(-1))
-            price = price + PRICE_EPS * (
+            price = price + price_eps * (
                 node_filled & (bids_per_node > 1)).astype(f)
             return (used, remaining, take, price, rnd + 1,
                     jnp.any(amt > 0))
@@ -469,25 +485,27 @@ def make_solve_batch_sharded(mesh: Mesh, axis: str = "nodes",
             return ((rnd < MAX_ROUNDS) & progressed
                     & jnp.any(remaining > 0))
 
-        # auction arm: RESTARTS runs with fresh tie-break jitter each
-        # time (same fold_in stream as the single-device kernel, global
-        # (N,) generated then sliced so values per node agree across
-        # layouts); selection chain mirrors batch_solver.solve_batch
-        # exactly — earliest restart wins exact ties — so counts stay
-        # bit-identical to the single-device path
+        # auction arm: one run per PORTFOLIO (jitter_scale, price_temp)
+        # entry with fresh tie-break jitter each time (same fold_in
+        # stream as the single-device kernel, global (N,) generated then
+        # sliced so values per node agree across layouts); selection
+        # chain mirrors batch_solver.solve_batch exactly — earliest
+        # restart wins exact ties — so counts stay bit-identical to the
+        # single-device path
         used_a = take = rnd = None
         score_a = placed_a = None
-        for t in range(RESTARTS):
-            jits = jax.vmap(lambda s: jax.lax.dynamic_slice(
+        for t, (jscale, ptemp) in enumerate(PORTFOLIO):
+            jits = jax.vmap(lambda s, _t=t, _js=jscale: jax.lax.dynamic_slice(
                 jax.random.uniform(
-                    jax.random.fold_in(jax.random.PRNGKey(s), t), (n,),
-                    jnp.float32, 0.0, TIE_JITTER),
+                    jax.random.fold_in(jax.random.PRNGKey(s), _t), (n,),
+                    jnp.float32, 0.0, TIE_JITTER * _js),
                 (lo,), (n_loc,)))(seeds)
             init = (used0, k.astype(jnp.int32),
                     jnp.zeros((g, n_loc), jnp.int32), jnp.zeros(n, f),
                     jnp.int32(0), jnp.bool_(True))
             used_t, _, take_t, _, rnd_t, _ = jax.lax.while_loop(
-                cond, lambda st, j=jits: body(st, j), init)
+                cond, lambda st, j=jits, pe=PRICE_EPS * ptemp:
+                body(st, j, pe), init)
             placed_t = jax.lax.psum(take_t.sum(), axis)
             score_t = jax.lax.psum(
                 _packing_score_xp(jnp, take_t, avail, used_t), axis)
@@ -519,13 +537,24 @@ def make_solve_batch_sharded(mesh: Mesh, axis: str = "nodes",
         return used, counts, info
 
     @partial(jax.jit, static_argnames=("g",), donate_argnums=(0,))
-    def solve(used0, avail, feas, aff, ask, k, seeds, cidx, cdelta, *,
-              g: int):
+    def solve(used0, avail, feas, aff, ask, k, seeds, cidx, cdelta,
+              evict=None, net_prio=None, *, g: int):
+        base_specs = (P(axis, None), P(axis, None), P(None, axis),
+                      P(None, axis), P(), P(), P(), P(), P())
+        out = (P(axis, None), P(None, axis), P())
+        if evict is None:
+            fn = shard_map(
+                partial(_joint_body, g=g), mesh=mesh,
+                in_specs=base_specs, out_specs=out)
+            return fn(used0, avail, feas, aff, ask, k, seeds, cidx,
+                      cdelta)
+        # victim budgets ride the node axis like avail; net_prio is a
+        # plain (N,) node row
         fn = shard_map(
             partial(_joint_body, g=g), mesh=mesh,
-            in_specs=(P(axis, None), P(axis, None), P(None, axis),
-                      P(None, axis), P(), P(), P(), P(), P()),
-            out_specs=(P(axis, None), P(None, axis), P()))
-        return fn(used0, avail, feas, aff, ask, k, seeds, cidx, cdelta)
+            in_specs=base_specs + (P(axis, None), P(axis)),
+            out_specs=out)
+        return fn(used0, avail, feas, aff, ask, k, seeds, cidx, cdelta,
+                  evict, net_prio)
 
     return solve

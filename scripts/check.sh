@@ -71,7 +71,7 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" NOMAD_TPU_SAN=1 python -m pytest \
     tests/test_sanitizer.py tests/test_ownership.py \
     tests/test_state_store.py \
     tests/test_plan_apply_scale.py tests/test_e2e_pipeline.py \
-    tests/test_batch_solver.py -q \
+    tests/test_batch_solver.py tests/test_preempt_solve.py -q \
     -p no:cacheprovider || failed=1
 
 # nomadcheck smoke (~2s, 60s budget): the deterministic interleaving
@@ -109,12 +109,17 @@ if [ "$run_e2e_smoke" = 1 ]; then
         python -m nomad_tpu.chaos --e2e-smoke || failed=1
 fi
 
-# global-batch solve smoke (opt-in, ~10s): bulk-sized jobs through
+# global-batch solve smoke (opt-in, ~25s): bulk-sized jobs through
 # batched workers under tpu-solve on a live 3-node cluster — a whole
 # worker batch must reach the joint auction launch, the selected
 # packing score must dominate the in-launch greedy counterfactual, and
 # the alloc set must stay unique on every replica (PERF.md
-# "Global-batch solve")
+# "Global-batch solve"). A second leg fills the cluster with low-prio
+# batch allocs and drives a high-prio wave through the in-kernel
+# preemption path: every placement must resolve from the preempt_solve
+# victim columns (host_preempted == 0), evictions stay unique, and the
+# invariant sweeps re-pass after the wave (PERF.md "Diagnosing the
+# preemption rung")
 if [ "$run_solve_smoke" = 1 ]; then
     echo "== solve smoke (python -m nomad_tpu.chaos --solve-smoke) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 300 \

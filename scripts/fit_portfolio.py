@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Fit the solve_batch restart portfolio offline.
+
+The auction arm of batch_solver.solve_batch runs one restart per
+PORTFOLIO (jitter_scale, price_temperature) entry and keeps the best
+(placed, packing-score) assignment. This script picks those frozen
+constants honestly instead of guessing: it replays seeded problems with
+the shapes the obs plane records for the solver rung (G evals x N nodes,
+mixed ask sizes, high-fill starts — the regime where the Registry's
+nomad.solver.joint_score / greedy_score pairs diverge), scores every
+candidate (jitter_scale, price_temp) pair AT ITS RESTART SLOT (slot t
+selects the fold_in(t) jitter stream, exactly as the kernel draws it),
+then greedy-forward-selects a portfolio of RESTARTS entries starting
+from the pinned legacy (1.0, 1.0) arm.
+
+Objective per portfolio, at EQUAL restart count: lexicographic
+(win-rate vs the greedy chain, mean packing-score edge over greedy) —
+the same portfolio-pick rule the kernel applies per launch.
+
+Usage: JAX_PLATFORMS=cpu python scripts/fit_portfolio.py [--seeds 12]
+Prints the ranked selection; paste the winner into
+nomad_tpu/tensor/batch_solver.PORTFOLIO.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from functools import partial
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from nomad_tpu.tensor.batch_solver import (  # noqa: E402
+    MAX_ROUNDS, PRICE_EPS, _auction, _packing_score_xp, packing_score_np)
+from nomad_tpu.tensor.kernels import (  # noqa: E402
+    TIE_JITTER, _solve_bulk_multi_impl)
+
+# candidate grid: jitter scales around the measured-safe TIE_JITTER
+# (see kernels.py for the ulp/score-gap bracketing) and price
+# temperatures around PRICE_EPS
+J_SCALES = (0.25, 0.5, 1.0, 2.0, 4.0, 8.0)
+P_TEMPS = (0.25, 0.5, 1.0, 2.0, 4.0, 8.0)
+RESTARTS = 5
+
+
+@partial(jax.jit, static_argnames=("g",))
+def _one_arm(used0, avail, feas, aff, ask, k, seeds, t, jscale, price_eps,
+             *, g: int):
+    """One auction restart exactly as solve_batch's unrolled loop draws
+    it: fold_in(seed, t) jitter stream scaled by jscale, temperature-
+    scaled price bump. Returns (placed, packing_score)."""
+    n = avail.shape[0]
+    jits = jax.vmap(
+        lambda s: jax.random.uniform(
+            jax.random.fold_in(jax.random.PRNGKey(s), t), (n,),
+            jnp.float32, 0.0, TIE_JITTER * jscale))(seeds)
+    used_t, take_t, _ = _auction(used0, avail, feas, aff, ask, k, jits, g,
+                                 MAX_ROUNDS, price_eps=price_eps)
+    return (take_t.sum(),
+            _packing_score_xp(jnp, take_t, avail, used_t))
+
+
+def _problem(seed: int, n: int = 64, g: int = 8):
+    """A solver-rung-shaped problem: near-full heterogeneous cluster,
+    small mixed asks, demand above capacity — the contended regime where
+    the Registry's joint/greedy score pairs actually diverge (under low
+    fill both arms place everything and the portfolio is moot)."""
+    rng = np.random.default_rng(seed)
+    d = 3
+    available = rng.integers(4000, 32000, (n, d)).astype(np.float32)
+    used0 = (available * rng.uniform(0.55, 0.95, (n, d))).astype(np.float32)
+    feas = rng.random((g, n)) > 0.25
+    aff = np.where(rng.random((g, n)) > 0.8,
+                   rng.uniform(-0.5, 0.5, (g, n)), 0.0).astype(np.float32)
+    ask = rng.integers(100, 1500, (g, d)).astype(np.float32)
+    k = rng.integers(16, 128, g).astype(np.int32)
+    seeds = rng.integers(0, 2**31, g).astype(np.uint32)
+    return (jnp.asarray(available), jnp.asarray(used0), jnp.asarray(feas),
+            jnp.asarray(aff), jnp.asarray(ask), jnp.asarray(k),
+            jnp.asarray(seeds))
+
+
+def _greedy_baseline(problems):
+    out = []
+    for avail, used0, feas, aff, ask, k, seeds in problems:
+        g = feas.shape[0]
+        used_g, counts_g = _solve_bulk_multi_impl(
+            used0, avail, feas, aff, ask, k, jnp.zeros(g, jnp.float32),
+            seeds, jnp.zeros(1, jnp.int32), jnp.zeros((1, 3), jnp.float32),
+            g=g)
+        cg = np.asarray(counts_g, dtype=np.int64)
+        out.append((int(cg.sum()),
+                    packing_score_np(cg, np.asarray(avail),
+                                     np.asarray(used_g))))
+    return out
+
+
+def _slot_results(problems, t: int, cache: dict):
+    """All candidate pairs evaluated at restart slot t ->
+    {(js, pt): [(placed, score) per problem]}."""
+    if t in cache:
+        return cache[t]
+    res = {}
+    for js in J_SCALES:
+        for pt in P_TEMPS:
+            rows = []
+            for avail, used0, feas, aff, ask, k, seeds in problems:
+                g = int(feas.shape[0])
+                placed, score = _one_arm(
+                    used0, avail, feas, aff, ask, k, seeds,
+                    jnp.uint32(t), jnp.float32(js),
+                    jnp.float32(PRICE_EPS * pt), g=g)
+                rows.append((int(placed), float(score)))
+            res[(js, pt)] = rows
+    cache[t] = res
+    return res
+
+
+def _objective(slot_rows, greedy):
+    """slot_rows: list per slot of that slot's [(placed, score)] rows.
+    Per problem, the kernel keeps the lexicographic (placed, score) best
+    restart; objective = (win-rate vs greedy, mean score edge)."""
+    wins, edge = 0, 0.0
+    n = len(greedy)
+    for i, (pg, sg) in enumerate(greedy):
+        best = max((rows[i] for rows in slot_rows),
+                   key=lambda ps: (ps[0], ps[1]))
+        if (best[0], best[1]) > (pg, sg):
+            wins += 1
+        edge += best[1] - sg
+    return wins / n, edge / n
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, default=12,
+                    help="problems to replay (seeded, deterministic)")
+    args = ap.parse_args()
+
+    problems = [_problem(1000 + s) for s in range(args.seeds)]
+    print(f"replaying {len(problems)} seeded solver-shaped problems, "
+          f"grid {len(J_SCALES)}x{len(P_TEMPS)} arms x {RESTARTS} slots")
+    greedy = _greedy_baseline(problems)
+    cache: dict = {}
+
+    # greedy forward selection from the pinned legacy arm at slot 0
+    portfolio = [(1.0, 1.0)]
+    chosen_rows = [_slot_results(problems, 0, cache)[(1.0, 1.0)]]
+    while len(portfolio) < RESTARTS:
+        slot = len(portfolio)
+        slot_res = _slot_results(problems, slot, cache)
+        base_obj = _objective(chosen_rows, greedy)
+        scored = []
+        for pair in sorted(slot_res):
+            obj = _objective(chosen_rows + [slot_res[pair]], greedy)
+            scored.append((obj, pair))
+        scored.sort(key=lambda x: (x[0][0], x[0][1]), reverse=True)
+        (win, edge), pick = scored[0]
+        if (win, edge) <= base_obj:
+            # nothing improves the portfolio on this slot's streams:
+            # take the best pair NOT already selected (stream diversity
+            # beats a literal repeat of an arm that added nothing)
+            for obj, pair in scored:
+                if pair not in portfolio:
+                    (win, edge), pick = obj, pair
+                    break
+        portfolio.append(pick)
+        chosen_rows.append(slot_res[pick])
+        print(f"  slot {slot}: + {pick}  -> win-rate {win:.2f}, "
+              f"mean score edge {edge:+.3f}")
+
+    win, edge = _objective(chosen_rows, greedy)
+    legacy_rows = [_slot_results(problems, t, cache)[(1.0, 1.0)]
+                   for t in range(RESTARTS)]
+    base = _objective(legacy_rows, greedy)
+    print(f"\nfitted portfolio ({RESTARTS} restarts): win-rate "
+          f"{win:.2f}, mean score edge {edge:+.3f}")
+    print(f"legacy 5x(1.0, 1.0) baseline:           win-rate "
+          f"{base[0]:.2f}, mean score edge {base[1]:+.3f}")
+    print("\nPORTFOLIO = (")
+    for js, pt in portfolio:
+        print(f"    ({js}, {pt}),")
+    print(")")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
