@@ -1460,6 +1460,267 @@ def cfg_swarm_heartbeat() -> None:
          shards=8, drivers=drivers_n, rpc_batch=chunk)
 
 
+def cfg_read_fanout() -> None:
+    """Read-path fan-out rung (PERF.md "Read path at fan-out scale"):
+    10K+ concurrent watchers — WatchTable blocking queries + sharded
+    event subscriptions, spread across all three replicas — parked
+    against a live 3-node cluster while the e2e write pipeline
+    (register_job -> scheduler workers -> plan applier -> raft commit)
+    keeps committing. Wakeup latency is commit-publish -> watcher
+    observes, measured per wakeup from the WatchTable's wake_ts stamp;
+    vs_baseline is poll_p99 / wake_p99 against a cohort running the old
+    20 ms sleep-poll loop over the same store indexes. A side channel
+    of HTTP readers GETs round-robin across all three agents to measure
+    the leader-vs-follower read share via the nomad.reads.* counters
+    (acceptance: followers serve >= 60% of GET traffic)."""
+    import bisect
+    import http.client
+    import os
+    import random
+    import statistics
+    import threading
+
+    from nomad_tpu.api.http import HTTPAgent
+    from nomad_tpu.core.metrics import REGISTRY
+    from nomad_tpu.core.server import ServerConfig
+    from nomad_tpu.raft.cluster import RaftCluster
+
+    watchers_n, subs_n, pollers_n, readers_n = 8_192, 2_048, 64, 6
+    window = 10.0
+
+    def config_fn(_i: int) -> ServerConfig:
+        return ServerConfig(
+            num_workers=2, heartbeat_ttl=3600.0, gc_interval=3600.0,
+            nack_timeout=900.0, failed_eval_followup_delay=3600.0)
+
+    stop, rec = threading.Event(), threading.Event()
+    cluster = RaftCluster(3, config_fn=config_fn)
+    agents, subs, threads = [], [], []
+    _t00 = time.perf_counter()
+
+    def _dbg(msg):
+        if os.environ.get("NOMAD_TPU_BENCH_DEBUG"):
+            print(f"[rf +{time.perf_counter() - _t00:6.1f}s] {msg}",
+                  file=sys.stderr, flush=True)
+
+    old_stack = threading.stack_size(256 * 1024)
+    try:
+        cluster.start()
+        leader = cluster.wait_for_leader(timeout=15.0)
+        if leader is None:
+            raise TimeoutError("no leader elected for the read-fanout rung")
+        replicas = list(cluster.servers.values())
+        # bench-safe raft timers (cf. heartbeat_ttl=3600 above): 10K
+        # runnable threads on a small host starve the heartbeat thread
+        # past the default 0.3 s election timeout, and a mid-rung
+        # election would measure raft failover, not read fan-out
+        for srv in replicas:
+            srv.raft.election_timeout = 30.0
+        build_nodes(leader.store, 60)
+        _dbg("cluster up, nodes built")
+
+        # per-replica commit-timestamp log: the poll cohort has no
+        # wake_ts (nothing wakes it), so it dates its observation
+        # against the commit that first crossed its threshold
+        logs = []
+        for srv in replicas:
+            lk, idxs, tss = threading.Lock(), [], []
+
+            def _listener(index, events, _lk=lk, _idxs=idxs, _tss=tss):
+                ts = time.time()
+                with _lk:
+                    _idxs.append(index)
+                    _tss.append(ts)
+
+            srv.server.store.add_commit_listener(_listener)
+            logs.append((lk, idxs, tss))
+
+        bq_lat, poll_lat, http_lat = [], [], []
+        sub_counts = [0] * subs_n
+
+        def bq_watcher(st, seed):
+            rng = random.Random(seed)
+            while not stop.is_set():
+                # wide threshold spread: ~20 watchers wake per commit,
+                # not all 8K (no thundering herd, like production
+                # watchers spread across resource indexes). Park with no
+                # timeout: 8K threads periodically churning their
+                # deadlines would melt a small host's GIL — the commit
+                # is the only wake, exactly like the waiter table's
+                # production shape (the HTTP deadline is per-request)
+                want = st.latest_index + rng.randint(10, 800)
+                _idx, wake_ts = st.watches.wait_min_index(want, timeout=None)
+                if wake_ts is not None and rec.is_set():
+                    bq_lat.append((time.time() - wake_ts) * 1e3)
+
+        def poller(st, log, seed):
+            lk, idxs, tss = log
+            rng = random.Random(seed)
+            while not stop.is_set():
+                want = st.latest_index + rng.randint(1, 100)
+                deadline = time.time() + 5.0
+                while (st.latest_index < want and time.time() < deadline
+                       and not stop.is_set()):
+                    time.sleep(0.02)  # the pre-waiter-table _block loop
+                if st.latest_index < want:
+                    continue
+                now = time.time()
+                with lk:
+                    i = bisect.bisect_left(idxs, want)
+                    ts = tss[i] if i < len(idxs) else None
+                if ts is not None and rec.is_set():
+                    poll_lat.append(max(0.0, now - ts) * 1e3)
+
+        def sub_watcher(sub, k):
+            while not stop.is_set():
+                evs = sub.next_events(timeout=None)  # close() unparks
+                if evs and rec.is_set():
+                    sub_counts[k] += len(evs)
+
+        def http_reader(base):
+            # one persistent keep-alive connection per reader: the
+            # thread-per-connection server must not pay a thread spawn
+            # per GET while 10K parked threads weigh on the scheduler
+            conn = http.client.HTTPConnection(base.split("//", 1)[1],
+                                              timeout=5.0)
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    conn.request("GET", "/v1/nodes")
+                    conn.getresponse().read()
+                except (OSError, http.client.HTTPException):
+                    conn.close()
+                    time.sleep(0.05)
+                    continue
+                if rec.is_set():
+                    http_lat.append((time.perf_counter() - t0) * 1e3)
+                # fixed-rate pacing: sleeping a constant after each GET
+                # would let the (faster) leader serve more requests than
+                # the followers and skew the read-share measurement
+                time.sleep(max(0.0, 0.06 - (time.perf_counter() - t0)))
+            conn.close()
+
+        def writer():
+            errs = 0
+            while not stop.is_set():
+                try:
+                    leader.server.register_job(service_job(1, cpu=20, mem=16))
+                except Exception as e:
+                    # one apply timing out under the spawn burst must
+                    # not kill the whole write pipeline
+                    errs += 1
+                    if errs <= 3:
+                        _dbg(f"writer: {type(e).__name__}: {e}")
+                time.sleep(0.05)
+
+        # Most subscriptions watch the Node topic, which the job writer
+        # never publishes: they stay parked for the whole window (the
+        # production shape — most watchers watch keys that rarely
+        # change, and the sharded broker must not wake them for foreign
+        # topics; topic-hash isolation is what makes 2K subs cheap). An
+        # active cohort splits across the three hot topics — each hot
+        # publish wakes ~43 threads, which is what one core sustains
+        # alongside the write pipeline (every active sub waking per
+        # publish is the broker's designed per-shard fan-out cost).
+        active_subs = 128
+        hot = ({"Job": ["*"]}, {"Evaluation": ["*"]}, {"Allocation": ["*"]})
+        for i in range(watchers_n):
+            st = replicas[i % 3].server.store
+            threads.append(threading.Thread(
+                target=bq_watcher, args=(st, i), daemon=True))
+        for i in range(subs_n):
+            topics = hot[i % 3] if i < active_subs else {"Node": ["*"]}
+            sub = replicas[i % 3].server.events.subscribe(topics)
+            subs.append(sub)
+            threads.append(threading.Thread(
+                target=sub_watcher, args=(sub, i), daemon=True))
+        for i in range(pollers_n):
+            threads.append(threading.Thread(
+                target=poller,
+                args=(replicas[i % 3].server.store, logs[i % 3], i),
+                daemon=True))
+        for srv in replicas:
+            agents.append(HTTPAgent(srv.server, port=0, writer=srv).start())
+        for i in range(readers_n):
+            threads.append(threading.Thread(
+                target=http_reader, args=(agents[i % 3].address,),
+                daemon=True))
+        _dbg(f"built {len(threads)} threads")
+        for t in threads:
+            t.start()
+        _dbg("fan-out spawned")
+
+        # the write pipeline starts LAST: the 10K-thread spawn burst
+        # must not contend with (and stall) live raft applies
+        threads.append(threading.Thread(target=writer, daemon=True))
+        threads[-1].start()
+
+        time.sleep(2.0)  # let the fan-out park and the pipeline settle
+        _dbg(f"settled, idx={leader.server.store.latest_index}")
+        before = REGISTRY.dump()
+        rec.set()
+        peak_parked = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < window:
+            time.sleep(0.25)
+            # parked blocking queries only: broker waiter_count counts
+            # per-shard registrations (an all-topics sub appears once
+            # per shard), so subscriptions are reported by count instead
+            parked = sum(s.server.store.watches.parked() for s in replicas)
+            peak_parked = max(peak_parked, parked)
+            _dbg(f"parked={parked} idx={leader.server.store.latest_index} "
+                 f"bq={len(bq_lat)} poll={len(poll_lat)}")
+        rec.clear()
+        elapsed = time.perf_counter() - t0
+        after = REGISTRY.dump()
+        _dbg("window done")
+    finally:
+        stop.set()
+        for sub in subs:
+            sub.close()  # unparks the subscription threads immediately
+        # the bq waiters parked with no timeout: fire one synthetic
+        # all-indexes-passed commit per replica so every daemon unparks,
+        # sees the stop flag, and exits (no per-thread join needed)
+        for srv in cluster.servers.values():
+            try:
+                srv.server.store.watches._on_commit(1 << 60, [])
+            except Exception:
+                pass
+        time.sleep(0.2)
+        for a in agents:
+            a.stop()
+        _dbg("agents stopped")
+        cluster.stop()
+        _dbg("cluster stopped")
+        threading.stack_size(old_stack)
+
+    if len(bq_lat) < 2 or len(poll_lat) < 2:
+        raise RuntimeError(f"fan-out rung starved: {len(bq_lat)} wakeups, "
+                           f"{len(poll_lat)} poll observations")
+
+    def delta(name: str) -> float:
+        return after.get(name, 0.0) - before.get(name, 0.0)
+
+    follower = delta("nomad.reads.follower")
+    leader_reads = delta("nomad.reads.leader")
+    share = follower / max(follower + leader_reads, 1)
+    wq = statistics.quantiles(bq_lat, n=100)
+    pq = statistics.quantiles(poll_lat, n=100)
+    hq = statistics.quantiles(http_lat, n=100) if len(http_lat) > 1 else [0.0] * 99
+    emit("read_path_fanout_3node", len(bq_lat) / elapsed, "wakeups/s",
+         pq[98] / max(wq[98], 1e-9),
+         watchers=watchers_n + subs_n + pollers_n,
+         peak_parked_queries=peak_parked,
+         subscriptions=subs_n,
+         wake_p50_ms=round(wq[49], 3), wake_p99_ms=round(wq[98], 3),
+         poll_p50_ms=round(pq[49], 3), poll_p99_ms=round(pq[98], 3),
+         events_s=round(sum(sub_counts) / elapsed, 1),
+         follower_read_share=round(share, 3),
+         http_gets=int(follower + leader_reads),
+         http_get_p99_ms=round(hq[98], 3),
+         lease_reads=int(delta("nomad.reads.lease_reads")))
+
+
 CONFIGS = [
     # before the headline: a driver timeout must not eat the raft rung
     ("raft3", raft_commit_throughput_3node),
@@ -1477,6 +1738,7 @@ CONFIGS = [
     ("cfg6", cfg6_applier_5k),
     ("cfg7", cfg7_sharded_5k),
     ("swarm_heartbeat", cfg_swarm_heartbeat),
+    ("read_fanout", cfg_read_fanout),
 ]
 
 

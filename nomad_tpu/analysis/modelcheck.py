@@ -893,9 +893,10 @@ def _preload() -> None:
     import tempfile  # noqa: F401
 
     from ..chaos import fsfaults, invariants  # noqa: F401
-    from ..core import broker, heartbeat, metrics, plan_apply  # noqa: F401
+    from ..core import broker, events, heartbeat, metrics, plan_apply  # noqa: F401
     from ..obs import trace  # noqa: F401
     from ..raft import durable, fsm, node, transport  # noqa: F401
+    from ..state import store, watch  # noqa: F401
     from ..structs import evaluation  # noqa: F401
     from . import ownership  # noqa: F401
     assert concurrent.futures.ThreadPoolExecutor is not None
@@ -1130,6 +1131,108 @@ def _scenario_raft_stepdown(env: ScenarioEnv) -> None:
     finally:
         node.stop()
         transport.close()
+
+
+@scenario("read_index")
+def _scenario_read_index(env: ScenarioEnv) -> None:
+    """Read-path safety under adversarial schedules (the follower-read
+    PR). Two independent hazards in one scenario:
+
+    (1) Lease safety — a deposed leader holding a (lapsed) lease must
+    never serve a read index: after the old leader is partitioned and a
+    newer leader commits a write, read_index() on the old leader must
+    raise NotLeaderError (its lease expired, its confirmation round
+    cannot reach a quorum). Returning an index there would let a client
+    read state that misses the new leader's committed write.
+
+    (2) Waiter-table race — a blocking query whose deadline fires in
+    the same window as the commit that satisfies it must either wake
+    with the committed index or time out cleanly; the parked entry must
+    never be lost or leak (WatchTable settles the race under its lock).
+    """
+    from ..raft.node import NotLeaderError, RaftNode
+    from ..raft.transport import InProcTransport
+    from ..state.store import StateStore
+
+    # -- (1) lease safety across a silent deposition --
+    transport = InProcTransport()
+    nodes = {}
+    for nid in ("a", "b", "c"):
+        nodes[nid] = RaftNode(
+            nid, [p for p in ("a", "b", "c") if p != nid],
+            transport, lambda cmd: None,
+            election_timeout=1e6,      # no spontaneous elections
+            heartbeat_interval=0.05, batch=True,
+            lease_duration=0.01)       # lapses within one sleep below
+    try:
+        for n in nodes.values():
+            n.start()
+        _force_leader(nodes["a"])
+        # a quorum-committed write under A (also commits A's barrier)
+        prop = nodes["a"].apply_async(("w1",))
+        nodes["a"].apply_wait(prop, timeout=30.0)
+        idx1 = nodes["a"].read_index(timeout=5.0)
+        if idx1 < 1:
+            raise AssertionError(f"connected leader read index {idx1}")
+        # cut A off; let any held lease lapse, then depose it silently
+        transport.partition("a")
+        time.sleep(0.2)
+        _force_leader(nodes["b"], term=2)
+        prop = nodes["b"].apply_async(("w2",))
+        nodes["b"].apply_wait(prop, timeout=30.0)  # b+c quorum commits
+        try:
+            stale = nodes["a"].read_index(timeout=0.5)
+        except (NotLeaderError, TimeoutError):
+            stale = None
+        if stale is not None:
+            raise AssertionError(
+                f"deposed leader served read index {stale} while the new "
+                f"leader committed through {nodes['b'].commit_index}")
+    finally:
+        for n in nodes.values():
+            n.stop()
+        transport.close()
+
+    # -- (2) waiter-table commit/deadline race --
+    store = StateStore()
+    results: List[tuple] = []
+
+    def waiter() -> None:
+        results.append(store.watches.wait_min_index(1, timeout=0.05))
+
+    def committer() -> None:
+        time.sleep(0.05)           # lands right on the waiter deadline
+        with store._write_lock:
+            gen, _ = store._begin()
+            store._commit(gen, [])
+
+    t1 = threading.Thread(target=waiter, name="block-waiter")
+    t2 = threading.Thread(target=committer, name="committer")
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+    idx, wake_ts = results[0]
+    if wake_ts is not None and idx < 1:
+        raise AssertionError(
+            f"woken waiter observed index {idx} below its threshold")
+    if idx not in (0, 1):
+        raise AssertionError(f"impossible observed index {idx}")
+    if store.watches.parked() != 0:
+        raise AssertionError(
+            f"waiter leaked: parked={store.watches.parked()}")
+    # liveness after the race: a fresh waiter still wakes
+    results.clear()
+    t3 = threading.Thread(target=lambda: results.append(
+        store.watches.wait_min_index(2, timeout=10.0)), name="waiter-2")
+    t3.start()
+    time.sleep(0.05)
+    with store._write_lock:
+        gen, _ = store._begin()
+        store._commit(gen, [])
+    t3.join()
+    if results[0][0] < 2:
+        raise AssertionError(f"post-race waiter saw {results[0]}")
 
 
 @scenario("snapshot_compact")
@@ -1738,7 +1841,8 @@ def _scenario_node_lifecycle(env: ScenarioEnv) -> None:
         mgr.set_enabled(False)
 
 
-SMOKE_SCENARIOS = ("raft_commit", "raft_stepdown", "snapshot_compact",
+SMOKE_SCENARIOS = ("raft_commit", "raft_stepdown", "read_index",
+                   "snapshot_compact",
                    "plan_pipeline", "broker_batch", "solve_batch",
                    "store_ownership", "node_lifecycle")
 

@@ -19,6 +19,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from ..obs import TRACER
 from ..structs import enums
 from ..structs.job import Job
 from ..structs.node import DrainStrategy
@@ -28,6 +29,27 @@ from .jobspec import _validate
 log = logging.getLogger("nomad_tpu.api")
 
 MAX_BLOCK_S = 30.0
+
+_WAIT_UNITS = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0}
+
+
+def _parse_wait(raw: str) -> Optional[float]:
+    """Blocking-query ``wait`` values: plain seconds or a Go-style
+    duration ("10s", "250ms", "1m") — the reference client sends the
+    latter. None for empty/garbage; the caller picks the policy (a
+    long-poll falls back to its default, the event stream 400s before
+    committing the chunked response)."""
+    raw = (raw or "").strip()
+    for unit in ("ms", "s", "m", "h"):
+        if raw.endswith(unit):
+            try:
+                return float(raw[:-len(unit)]) * _WAIT_UNITS[unit]
+            except ValueError:
+                return None
+    try:
+        return float(raw) if raw else None
+    except ValueError:
+        return None
 
 # /v1/agent/monitor may lower the framework logger level while streams
 # are attached; overlapping streams refcount the original level so the
@@ -96,14 +118,33 @@ class HTTPAgent:
                 if agent.server.logger:
                     agent.server.logger.debug("http: " + fmt, *args)
 
+            # per-request read state (reset at the top of each verb —
+            # handler instances persist across keep-alive requests)
+            _read_index: Optional[int] = None
+            _known_leader: Optional[bool] = None
+            _last_contact_ms: Optional[int] = None
+
             def _reply(self, code: int, payload, index: Optional[int] = None):
                 body = json.dumps(to_dict(payload)).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                if index is None:
+                    # the index of the snapshot the payload was read
+                    # from (_route_get stamps it) — NOT latest_index,
+                    # which can be ahead of the data and make a watcher
+                    # skip a wakeup
+                    index = self._read_index
                 self.send_header("X-Nomad-Index",
                                  str(index if index is not None
                                      else agent.server.store.latest_index))
+                if self._known_leader is not None:
+                    self.send_header("X-Nomad-KnownLeader",
+                                     "true" if self._known_leader
+                                     else "false")
+                if self._last_contact_ms is not None:
+                    self.send_header("X-Nomad-LastContact",
+                                     str(self._last_contact_ms))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -117,15 +158,18 @@ class HTTPAgent:
                 return json.loads(self.rfile.read(length))
 
             def _block(self, q: dict) -> None:
-                """Blocking query: wait for the store to move past index."""
+                """Blocking query: park until the store moves past index
+                (the waiter table wakes us on the exact commit — no
+                20 ms poll loop, no latency floor)."""
                 want = int(q.get("index", ["0"])[0] or 0)
                 if want <= 0:
                     return
-                wait = min(float(q.get("wait", ["5"])[0] or 5), MAX_BLOCK_S)
-                deadline = time.time() + wait
-                store = agent.server.store
-                while store.latest_index <= want and time.time() < deadline:
-                    time.sleep(0.02)
+                parsed = _parse_wait(q.get("wait", [""])[0])
+                wait = min(parsed if parsed is not None else 5.0,
+                           MAX_BLOCK_S)
+                with TRACER.span("read.index_wait", want=want):
+                    agent.server.store.watches.wait_min_index(
+                        want + 1, wait)
 
             def _acl(self):
                 """Resolve X-Nomad-Token -> ACL (None when ACLs are off;
@@ -175,10 +219,10 @@ class HTTPAgent:
                 # the timeout must outlast a forwarded blocking query or
                 # stream wait, or healthy long-polls turn into 502s
                 try:
-                    wait = min(float(fq.get("wait", ["60"])[0] or 60),
-                               600.0)
-                except (ValueError, IndexError):
-                    wait = 60.0
+                    fwait = _parse_wait(fq.get("wait", [""])[0])
+                except IndexError:
+                    fwait = None
+                wait = min(fwait if fwait is not None else 60.0, 600.0)
                 committed = False
                 try:
                     with _rq.urlopen(req, timeout=wait + 30.0) as resp:
@@ -243,6 +287,9 @@ class HTTPAgent:
 
             def do_GET(self):
                 try:
+                    self._read_index = None
+                    self._known_leader = None
+                    self._last_contact_ms = None
                     url = urlparse(self.path)
                     if url.path in ("/", "/ui", "/ui/"):
                         # the embedded dashboard (reference serves the
@@ -271,6 +318,8 @@ class HTTPAgent:
                         if acl is not None and not acl.allow_agent_read():
                             return self._error(403, "Permission denied")
                         return agent._route_monitor(self, q)
+                    if agent._setup_read(self, q):
+                        return  # no leader / read index timed out
                     self._block(q)
                     agent._route_get(self, url.path, q, acl)
                 except PermissionError as e:
@@ -282,6 +331,9 @@ class HTTPAgent:
 
             def do_POST(self):
                 try:
+                    self._read_index = None
+                    self._known_leader = None
+                    self._last_contact_ms = None
                     url = urlparse(self.path)
                     q = parse_qs(url.query)
                     body = self._body()
@@ -299,6 +351,9 @@ class HTTPAgent:
 
             def do_DELETE(self):
                 try:
+                    self._read_index = None
+                    self._known_leader = None
+                    self._last_contact_ms = None
                     url = urlparse(self.path)
                     q = parse_qs(url.query)
                     if self._maybe_forward_region("DELETE", url.path, q):
@@ -341,10 +396,62 @@ class HTTPAgent:
     def _ns_allowed(acl, ns: str, cap: str) -> bool:
         return acl is None or acl.allow_namespace_operation(ns, cap)
 
+    def _setup_read(self, h, q: dict) -> bool:
+        """Read-consistency negotiation for GETs on a replicated server
+        (reference api/api.go QueryOptions AllowStale/consistency modes).
+        Three modes, all answered by THIS server — reads never forward:
+
+        - ``?stale=true``: serve immediately from the local replica,
+          staleness bounded by X-Nomad-LastContact.
+        - default: read-index protocol — the leader (one hop away at
+          most) confirms leadership via its held lease and names a read
+          index; we serve once the local FSM has applied past it.
+        - ``?consistent=true``: same, but the leader must prove
+          leadership with a full heartbeat round (no lease shortcut).
+
+        Returns True when the request was fully handled here (503 no
+        leader / 500 timeout); False to continue into the route."""
+        raft = getattr(self.writer, "raft", None)
+        if raft is None:
+            return False  # standalone server: local reads are the truth
+        from ..core.metrics import REGISTRY
+
+        h._known_leader = self.writer.known_leader()
+        lc = self.writer.last_contact()
+        h._last_contact_ms = int(min(lc, 10 ** 6) * 1000)
+        if raft.is_leader():
+            REGISTRY.incr("nomad.reads.leader")
+        else:
+            REGISTRY.incr("nomad.reads.follower")
+        if q.get("stale", [""])[0] == "true":
+            REGISTRY.incr("nomad.reads.stale")
+            return False
+        consistent = q.get("consistent", [""])[0] == "true"
+        from ..raft.node import NotLeaderError
+
+        try:
+            with TRACER.span("read.index_wait", mode="read_index"):
+                idx = self.writer.read_index(consistent=consistent,
+                                             timeout=2.0)
+                self.writer.wait_applied(idx, timeout=5.0)
+        except NotLeaderError:
+            REGISTRY.incr("nomad.reads.no_leader")
+            h._known_leader = self.writer.known_leader()
+            h._reply(503, {"error": "no cluster leader"})
+            return True
+        except TimeoutError as e:
+            h._reply(500, {"error": f"read index wait: {e}"})
+            return True
+        return False
+
     def _route_get(self, h, path: str, q: dict, acl=None) -> None:
         from ..acl import policy as aclp
 
         snap = self.server.store.snapshot()
+        # X-Nomad-Index must be the index of THIS snapshot — the default
+        # (latest_index at reply time) can run ahead of the payload and
+        # make a blocking-query client skip a change
+        h._read_index = snap.index
         ns = q.get("namespace", ["default"])[0]
         prefix = q.get("prefix", [""])[0]
 
@@ -822,6 +929,14 @@ class HTTPAgent:
                     self.server.broker.unacked_count(),
                 "nomad.blocked_evals.total_blocked":
                     self.server.blocked.blocked_count(),
+                # read-path fan-out gauges (sampled live; the wakeup
+                # counters/histograms come in via REGISTRY.dump)
+                "nomad.reads.parked":
+                    self.server.store.watches.parked(),
+                "nomad.reads.event_waiters":
+                    self.server.events.waiter_count(),
+                "nomad.state.live_snapshots":
+                    self.server.store._tracker.live_count(),
                 **REGISTRY.dump(),
             }
             if q.get("format", [""])[0] == "prometheus":
@@ -1440,11 +1555,14 @@ class HTTPAgent:
         `wait` must be a clean 400, not a second response injected onto
         a committed chunked connection), then send the chunked headers.
         -> (write_chunk, deadline)."""
-        try:
-            wait = min(float(q.get("wait", ["60"])[0] or 60), 600.0)
-        except ValueError:
-            h._error(400, "invalid wait")
-            return None, None
+        raw = q.get("wait", [""])[0]
+        wait = _parse_wait(raw)
+        if wait is None:
+            if raw:
+                h._error(400, "invalid wait")
+                return None, None
+            wait = 60.0
+        wait = min(wait, 600.0)
         deadline = time.time() + wait
         h.send_response(200)
         h.send_header("Content-Type", "application/json")

@@ -7,6 +7,7 @@
     python -m nomad_tpu.chaos --solve-smoke
     python -m nomad_tpu.chaos --snap-smoke
     python -m nomad_tpu.chaos --swarm-smoke
+    python -m nomad_tpu.chaos --watch-smoke
     python -m nomad_tpu.chaos --swarm-scale [N]
 
 Exit 0 when every invariant holds; 2 on a violation (the CI gate in
@@ -51,7 +52,15 @@ scripts/check.sh --swarm-smoke gate; ROBUSTNESS.md "Client plane").
 `--swarm-scale [N]` runs the fleet-scale acceptance smoke: N (default
 50,000) sim nodes heartbeating at the production TTL against a live
 3-node cluster WHILE the e2e pipeline runs, one leader crash/failover
-mid-stream — zero missed-TTL false positives on any replica."""
+mid-stream — zero missed-TTL false positives on any replica.
+
+`--watch-smoke` runs the read-path failover smoke: blocking queries +
+event subscriptions parked on ALL 3 servers while the leader crashes —
+survivors' parked queries complete with the post-failover result at a
+higher index, fresh reads on the dead server fail fast with
+X-Nomad-KnownLeader=false, and the X-Nomad-LastContact stale bound
+holds across the transition (the scripts/check.sh --watch-smoke gate;
+PERF.md "Read path at fan-out scale")."""
 
 from __future__ import annotations
 
@@ -1099,6 +1108,187 @@ def swarm_scale_smoke(nodes_n: int = 50000, ttl: float = 10.0,
     return 0
 
 
+def watch_smoke(watchers_per_server: int = 12) -> int:
+    """Leader-failover-mid-watch smoke (scripts/check.sh --watch-smoke):
+    blocking queries + event subscriptions parked on ALL 3 servers of a
+    live cluster while the leader crashes. Asserts: every parked query
+    on a survivor completes with the post-failover result at a higher
+    index; subscriptions on survivors deliver the post-failover event;
+    fresh reads against the dead server fail fast with
+    X-Nomad-KnownLeader=false; and the stale-read bound
+    (X-Nomad-LastContact) holds on survivors across the transition."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from ..api.http import HTTPAgent
+    from ..core.server import ServerConfig
+
+    t0 = time.monotonic()
+    cluster = RaftCluster(3, config_fn=lambda i: ServerConfig(
+        num_workers=0, heartbeat_ttl=3600.0, gc_interval=3600.0))
+    agents = {}
+    failures: list = []
+    try:
+        cluster.start()
+        leader = cluster.wait_for_leader(15.0)
+        if leader is None:
+            print("WATCH SMOKE: FAIL — no leader elected")
+            return 2
+        for sid, srv in cluster.servers.items():
+            agents[sid] = HTTPAgent(srv.server, port=0, writer=srv).start()
+
+        leader.register_node(mock.node())
+
+        def get(sid, path, timeout=10.0):
+            r = urllib.request.urlopen(f"{agents[sid].address}{path}",
+                                       timeout=timeout)
+            return json.loads(r.read()), r.headers
+
+        # pre-crash: every server answers with staleness headers
+        want = 0
+        for sid in cluster.servers:
+            nodes, hdrs = get(sid, "/v1/nodes")
+            if len(nodes) != 1:
+                failures.append(f"{sid}: pre-crash read saw {len(nodes)}")
+            if hdrs["X-Nomad-KnownLeader"] != "true":
+                failures.append(f"{sid}: pre-crash KnownLeader false")
+            lc = int(hdrs["X-Nomad-LastContact"])
+            if lc >= 2000:
+                failures.append(f"{sid}: pre-crash LastContact {lc}ms")
+            want = max(want, int(hdrs["X-Nomad-Index"]))
+
+        # park blocking queries on all 3 servers + one event
+        # subscription per server
+        results: dict = {}
+        lock = threading.Lock()
+
+        def block(tag, sid, wait_s):
+            try:
+                data, hdrs = get(
+                    sid, f"/v1/nodes?index={want}&wait={wait_s}",
+                    timeout=wait_s + 20.0)
+                out = ("ok", len(data), int(hdrs["X-Nomad-Index"]))
+            except (urllib.error.URLError, OSError) as e:
+                out = ("err", repr(e), None)
+            with lock:
+                results[tag] = out
+
+        subs = {sid: srv.server.events.subscribe({"Node": ["*"]})
+                for sid, srv in cluster.servers.items()}
+        sub_got: dict = {}
+
+        def watch_events(sid, timeout):
+            evs = subs[sid].next_events(timeout=timeout)
+            with lock:
+                sub_got[sid] = [e.type for e in evs]
+
+        victim = leader.id
+        threads = []
+        for sid in cluster.servers:
+            # parked watchers on the (about to be) dead server can only
+            # time out — keep their windows short so the smoke stays fast
+            wait_s = 6.0 if sid == victim else 20.0
+            for i in range(watchers_per_server):
+                threads.append(threading.Thread(
+                    target=block, args=(f"{sid}/{i}", sid, wait_s)))
+            threads.append(threading.Thread(
+                target=watch_events,
+                args=(sid, 8.0 if sid == victim else 25.0)))
+        for t in threads:
+            t.start()
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            parked = sum(s.store.watches.parked()
+                         for s in cluster.servers.values())
+            if parked >= 3 * watchers_per_server:
+                break
+            time.sleep(0.05)
+        else:
+            failures.append(f"only {parked} queries parked")
+
+        # crash the leader mid-watch, write through a survivor
+        cluster.crash(victim)
+        new_leader = cluster.wait_for_leader(15.0)
+        if new_leader is None:
+            print("WATCH SMOKE: FAIL — no post-crash leader")
+            return 2
+        _live_entry(cluster).register_node(mock.node())
+
+        for t in threads:
+            t.join(timeout=40.0)
+        if any(t.is_alive() for t in threads):
+            failures.append("watcher threads wedged")
+
+        for tag, out in sorted(results.items()):
+            sid = tag.split("/")[0]
+            if sid == victim:
+                continue  # below
+            if out[0] != "ok" or out[1] != 2 or out[2] <= want:
+                failures.append(f"survivor watcher {tag}: {out}")
+        # dead-server watchers: a timed-out long-poll returning the old
+        # state at the old index is a CONSISTENT bounded-stale answer;
+        # a torn connection is a fail-fast. Both are allowed — seeing
+        # the post-crash write from the dead server's store is not.
+        for tag, out in sorted(results.items()):
+            if not tag.startswith(victim):
+                continue
+            if out[0] == "ok" and out[1] != 1:
+                failures.append(f"dead-server watcher {tag}: {out}")
+        for sid in cluster.servers:
+            if sid == victim:
+                continue
+            if sub_got.get(sid) != ["node-upsert"]:
+                failures.append(
+                    f"{sid}: subscription saw {sub_got.get(sid)}")
+
+        # fresh reads post-failover: survivors answer with a fresh
+        # stale bound; the dead server fails fast, KnownLeader=false
+        for sid in cluster.servers:
+            if sid == victim:
+                continue
+            nodes, hdrs = get(sid, "/v1/nodes")
+            if len(nodes) != 2:
+                failures.append(f"{sid}: post-crash read {len(nodes)}")
+            if hdrs["X-Nomad-KnownLeader"] != "true":
+                failures.append(f"{sid}: post-crash KnownLeader false")
+            if int(hdrs["X-Nomad-LastContact"]) >= 2000:
+                failures.append(
+                    f"{sid}: post-crash LastContact "
+                    f"{hdrs['X-Nomad-LastContact']}ms")
+        t1 = time.monotonic()
+        try:
+            get(victim, "/v1/nodes", timeout=10.0)
+            failures.append("dead server served a read-index GET")
+        except urllib.error.HTTPError as e:
+            if e.code != 503:
+                failures.append(f"dead server replied {e.code}")
+            if e.headers.get("X-Nomad-KnownLeader") != "false":
+                failures.append("dead server claimed KnownLeader")
+        except (urllib.error.URLError, OSError):
+            pass  # connection-level death is fail-fast too
+        if time.monotonic() - t1 > 5.0:
+            failures.append("dead-server read was not fail-fast")
+
+        if failures:
+            print("WATCH SMOKE: FAIL —")
+            for f in failures[:20]:
+                print(f"  {f}")
+            return 2
+    finally:
+        for sub in locals().get("subs", {}).values():
+            sub.close()
+        for a in agents.values():
+            a.stop()
+        cluster.stop()
+    dt = time.monotonic() - t0
+    print(f"WATCH SMOKE: ok — {3 * watchers_per_server} parked queries "
+          f"+ 3 subscriptions across a leader crash: survivors woke "
+          f"consistent, dead server failed fast, stale bounds held, "
+          f"{dt:.1f}s")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m nomad_tpu.chaos")
     parser.add_argument("--seed", type=int, default=None,
@@ -1127,6 +1317,12 @@ def main(argv=None) -> int:
                              "in sequence; liveness + alloc-uniqueness "
                              "on every replica) instead of the scenario "
                              "smoke")
+    parser.add_argument("--watch-smoke", action="store_true",
+                        help="run the read-path failover smoke (blocking "
+                             "queries + event subscriptions parked on "
+                             "all 3 servers across a leader crash; "
+                             "stale-read bounds + fail-fast on the dead "
+                             "server) instead of the scenario smoke")
     parser.add_argument("--swarm-scale", type=int, nargs="?",
                         const=50000, default=None, metavar="N",
                         help="run the fleet-scale acceptance smoke: N "
@@ -1152,6 +1348,8 @@ def main(argv=None) -> int:
         return snap_smoke()
     if args.swarm_smoke:
         return swarm_smoke()
+    if args.watch_smoke:
+        return watch_smoke()
     if args.swarm_scale is not None:
         return swarm_scale_smoke(nodes_n=args.swarm_scale)
 

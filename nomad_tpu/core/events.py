@@ -1,10 +1,25 @@
-"""Event broker (reference nomad/stream/event_broker.go:40-70).
+"""Sharded event broker (reference nomad/stream/event_broker.go:40-70).
 
 Change-stream pub/sub fed by the state store's commit listener: every
-commit becomes a batch of topic-tagged events in a bounded ring buffer;
-subscribers consume from their own cursor and can filter by topic/key.
-Slow subscribers that fall off the ring see a truncation marker instead
-of blocking writers (the reference's ring semantics).
+commit becomes a batch of topic-tagged events; subscribers consume from
+their own cursors and can filter by topic/key. Slow subscribers that
+fall off a ring see a truncation marker instead of blocking writers
+(the reference's ring semantics).
+
+Scale shape (the read-path fan-out PR): the broker is sharded by
+topic-hash — each shard owns its own ring, lock, dense seq counter, and
+parked-waiter list, so tens of thousands of concurrent subscriptions
+never serialize on one global lock. Dispatch is coalesced: one publish
+appends the whole batch under the shard lock, then walks that shard's
+waiter list ONCE and sets each parked subscription's wake event — N
+parked subscribers cost one list walk per publish, not N condition
+broadcasts. A subscription parks with a single Event registered on
+every shard it reads, so blocking across shards needs no per-shard
+threads.
+
+Truncation detection is per shard: each shard records the highest seq
+evicted off its ring, and a cursor behind that watermark missed events
+— gap-free numbering the store's (sparse) indexes can't provide.
 """
 
 from __future__ import annotations
@@ -12,9 +27,11 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
+from zlib import crc32
 
 from ..analysis.ownership import GLOBAL as _OWN
+from .metrics import REGISTRY
 
 TOPIC_FOR_KIND = {
     "node-upsert": "Node", "node-status": "Node", "node-eligibility": "Node",
@@ -29,18 +46,34 @@ TOPIC_FOR_KIND = {
     "deployment-delete": "Deployment",
 }
 
+DEFAULT_SHARDS = 8
+
 
 class Event:
     __slots__ = ("seq", "index", "topic", "type", "key", "payload")
 
     def __init__(self, seq: int, index: int, topic: str, etype: str, key: str,
                  payload):
-        self.seq = seq      # dense per-event cursor (ring bookkeeping)
+        self.seq = seq      # dense per-SHARD cursor (ring bookkeeping)
         self.index = index  # state-store index (external meaning)
         self.topic = topic
         self.type = etype
         self.key = key
         self.payload = payload
+
+
+class _Shard:
+    __slots__ = ("lock", "ring", "seq", "evicted", "waiters")
+
+    def __init__(self, ring_size: int):
+        self.lock = threading.Lock()
+        self.ring: deque = deque(maxlen=ring_size)
+        self.seq = 0       # dense per-shard event counter
+        self.evicted = 0   # highest seq dropped off this ring
+        # parked subscriptions: id(sub) -> wake Event. One-shot — the
+        # publisher drains the whole list in one walk (coalesced
+        # dispatch); a woken subscription re-registers if it parks again
+        self.waiters: Dict[int, threading.Event] = {}
 
 
 class Subscription:
@@ -49,8 +82,15 @@ class Subscription:
         self._broker = broker
         # topic -> keys ("*" = all); empty dict = all topics
         self.topics = topics or {}
-        self.cursor = broker.last_seq()
+        if self.topics and "*" not in self.topics:
+            self._shard_ids = sorted({broker.shard_of(t)
+                                      for t in self.topics})
+        else:
+            self._shard_ids = list(range(len(broker._shards)))
+        self._cursors = broker._shard_seqs(self._shard_ids)
+        self._wake = threading.Event()
         self.truncated = False
+        self.closed = False
 
     def _wants(self, ev: Event) -> bool:
         if not self.topics:
@@ -62,79 +102,180 @@ class Subscription:
             return False
         return "*" in keys or ev.key in keys
 
+    def _collect(self) -> List[Event]:
+        """Drain every relevant shard past this subscription's cursors
+        (non-blocking). Advances cursors past ALL drained events —
+        filtering happens in next_events, the cursor tracks the ring."""
+        out: List[Event] = []
+        shards = self._broker._shards
+        for sid in self._shard_ids:
+            sh = shards[sid]
+            cur = self._cursors[sid]
+            if sh.seq <= cur:       # racy fast path: seq is monotone,
+                continue            # a miss is caught next round
+            with sh.lock:
+                if sh.evicted > cur:
+                    self.truncated = True
+                ring = sh.ring
+                if ring and ring[-1].seq > cur:
+                    out.extend(e for e in ring if e.seq > cur)
+                    self._cursors[sid] = ring[-1].seq
+                else:
+                    # everything new was already evicted (tiny ring):
+                    # jump the cursor so the marker fires exactly once
+                    self._cursors[sid] = sh.seq
+        if len(self._shard_ids) > 1 and out:
+            # cross-shard merge: store index is the global order; the
+            # stable sort keeps per-shard (per-topic) publish order
+            out.sort(key=lambda e: e.index)
+        if _OWN.active:
+            for e in out:
+                _OWN.verify(e.payload)
+        return out
+
+    def _park(self, remaining: Optional[float]) -> None:
+        """Register one wake event on every relevant shard, re-check for
+        events that raced the registration, then wait."""
+        self._wake.clear()
+        shards = self._broker._shards
+        me = id(self)
+        for sid in self._shard_ids:
+            sh = shards[sid]
+            with sh.lock:
+                sh.waiters[me] = self._wake
+        try:
+            # lost-wakeup guard: a publish between _collect and the
+            # registrations above would have found no waiter entry
+            for sid in self._shard_ids:
+                if shards[sid].seq > self._cursors[sid]:
+                    return
+            self._wake.wait(remaining)
+        finally:
+            for sid in self._shard_ids:
+                sh = shards[sid]
+                with sh.lock:
+                    sh.waiters.pop(me, None)
+
     def next_events(self, timeout: Optional[float] = 1.0) -> List[Event]:
-        """Events past this subscription's cursor (blocking)."""
-        evs, truncated = self._broker.events_after(self.cursor, timeout)
-        if truncated:
-            self.truncated = True
-        if evs:
-            self.cursor = evs[-1].seq
-        return [e for e in evs if self._wants(e)]
+        """Events past this subscription's cursors (blocking). Returns
+        as soon as ANY new event passed the cursors — possibly [] after
+        filtering, like the pre-shard broker."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while not self.closed:
+            evs = self._collect()
+            if evs:
+                return [e for e in evs if self._wants(e)]
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                return []
+            self._park(remaining)
+        return []
 
     def close(self) -> None:
-        """Nothing to release: delivery is pull-based off the shared
-        ring, a subscription is just a cursor."""
+        """Unpark and drop the waiter registrations; the cursors need no
+        release — delivery is pull-based off the shared rings."""
+        self.closed = True
+        me = id(self)
+        for sid in self._shard_ids:
+            sh = self._broker._shards[sid]
+            with sh.lock:
+                sh.waiters.pop(me, None)
+        self._wake.set()
 
 
 class EventBroker:
-    def __init__(self, store, ring_size: int = 4096):
-        self._ring: deque = deque(maxlen=ring_size)
-        self._lock = threading.Condition()
-        self._seq = 0  # dense event counter: truncation detection needs
-        #                gap-free numbering, which store indexes are not
+    def __init__(self, store, ring_size: int = 4096,
+                 shards: int = DEFAULT_SHARDS):
+        self._shards = [_Shard(ring_size) for _ in range(max(1, shards))]
         store.add_commit_listener(self._on_commit)
 
+    def shard_of(self, topic: str) -> int:
+        # stable across processes (hash() is salted): topic -> shard
+        return crc32(topic.encode()) % len(self._shards)
+
+    def _shard_seqs(self, shard_ids) -> Dict[int, int]:
+        out = {}
+        for sid in shard_ids:
+            sh = self._shards[sid]
+            with sh.lock:
+                out[sid] = sh.seq
+        return out
+
     def _on_commit(self, index: int, events: list) -> None:
-        with self._lock:
-            for kind, payload in events:
-                topic = TOPIC_FOR_KIND.get(kind)
-                if topic is None:
-                    continue
-                key = getattr(payload, "id", "") if payload is not None else ""
-                if _OWN.active:
-                    # nomadown: the ring holds payloads by reference —
-                    # verify snapshot integrity at the publish boundary
-                    _OWN.verify(payload)
-                self._seq += 1
-                self._ring.append(Event(self._seq, index, topic, kind, key,
-                                        payload))
-            self._lock.notify_all()
+        by_shard: Dict[int, List[Tuple[str, str, str, object]]] = {}
+        for kind, payload in events:
+            topic = TOPIC_FOR_KIND.get(kind)
+            if topic is None:
+                continue
+            key = getattr(payload, "id", "") if payload is not None else ""
+            if _OWN.active:
+                # nomadown: the rings hold payloads by reference —
+                # verify snapshot integrity at the publish boundary
+                _OWN.verify(payload)
+            by_shard.setdefault(self.shard_of(topic), []).append(
+                (topic, kind, key, payload))
+        woken = 0
+        for sid, items in by_shard.items():
+            woken += self._publish_shard(sid, items, index)
+        if woken:
+            REGISTRY.incr("nomad.reads.event_wakeups", woken)
+            REGISTRY.observe("nomad.reads.event_wakeup_batch", float(woken))
+
+    def _publish_shard(self, sid: int, items, index: int) -> int:
+        """Append one batch to one shard and wake its parked
+        subscriptions with ONE waiter-list walk. Returns waiters woken."""
+        sh = self._shards[sid]
+        with sh.lock:
+            ring = sh.ring
+            cap = ring.maxlen
+            for topic, kind, key, payload in items:
+                sh.seq += 1
+                if cap is not None and len(ring) == cap:
+                    sh.evicted = ring[0].seq
+                ring.append(Event(sh.seq, index, topic, kind, key, payload))
+            if not sh.waiters:
+                return 0
+            waiters = list(sh.waiters.values())
+            sh.waiters.clear()
+        for ev in waiters:
+            ev.set()
+        return len(waiters)
 
     def publish(self, topic: str, kind: str, payload) -> None:
         """Direct publish for non-store events (scheduler sanitizer
         signals like port collisions — reference server.go:1883
         listenWorkerEvents)."""
-        with self._lock:
-            self._seq += 1
-            key = payload.get("node_id", "") if isinstance(payload, dict) else ""
-            self._ring.append(Event(self._seq, 0, topic, kind, key, payload))
-            self._lock.notify_all()
+        key = payload.get("node_id", "") if isinstance(payload, dict) else ""
+        self._publish_shard(self.shard_of(topic),
+                            [(topic, kind, key, payload)], 0)
 
-    def last_seq(self) -> int:
-        with self._lock:
-            return self._seq
+    def waiter_count(self) -> int:
+        """Parked subscriptions across all shards (the
+        nomad.reads.event_waiters gauge)."""
+        n = 0
+        for sh in self._shards:
+            with sh.lock:
+                n += len(sh.waiters)
+        return n
+
+    def last_seq(self) -> tuple:
+        """Opaque broker-wide cursor: pass it back to events_after."""
+        return tuple(sh.seq for sh in self._shards)
 
     def subscribe(self, topics: Optional[Dict[str, List[str]]] = None) -> Subscription:
         return Subscription(self, topics)
 
-    def events_after(self, cursor: int, timeout: Optional[float]
+    def events_after(self, cursor, timeout: Optional[float]
                      ) -> Tuple[List[Event], bool]:
-        """-> (events with seq > cursor, truncated?). Blocks up to
-        timeout for new events. seq is dense, so a gap between the
-        cursor and the ring head means events were evicted."""
-        deadline = (None if timeout is None
-                    else time.monotonic() + timeout)
-        with self._lock:
-            while not self._ring or self._ring[-1].seq <= cursor:
-                remaining = (None if deadline is None
-                             else deadline - time.monotonic())
-                if remaining is not None and remaining <= 0:
-                    break
-                if not self._lock.wait(remaining):
-                    break
-            truncated = bool(self._ring) and self._ring[0].seq > cursor + 1
-            out = [e for e in self._ring if e.seq > cursor]
-            if _OWN.active:
-                for e in out:
-                    _OWN.verify(e.payload)
-            return out, truncated
+        """-> (events past cursor, truncated?). Blocks up to timeout for
+        new events. `cursor` is a last_seq() token (or an int applied to
+        every shard — 0 reads each ring from its start)."""
+        sub = Subscription(self, None)
+        if isinstance(cursor, int):
+            sub._cursors = {sid: cursor for sid in sub._shard_ids}
+        else:
+            sub._cursors = {sid: cursor[sid] for sid in sub._shard_ids}
+        evs = sub.next_events(timeout)
+        return evs, sub.truncated

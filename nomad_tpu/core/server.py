@@ -82,6 +82,10 @@ class ServerConfig:
     plan_rejection_threshold: int = 100
     plan_rejection_window: float = 300.0
     gc_interval: float = 60.0
+    # event-broker fan-out shards (per-topic-hash rings/locks; see
+    # core/events.py) and per-shard ring capacity
+    event_shards: int = 8
+    event_ring_size: int = 4096
     acl_enabled: bool = False
     # workload-identity JWT lifetime (client/widmgr renews at ~half TTL;
     # reference nomad/structs WorkloadIdentity TTL)
@@ -138,7 +142,9 @@ class Server:
         self.drainer = NodeDrainer(self)
         self.periodic = PeriodicDispatcher(self)
         self.core_gc = CoreScheduler(self, interval=self.config.gc_interval)
-        self.events = EventBroker(self.store)
+        self.events = EventBroker(self.store,
+                                  ring_size=self.config.event_ring_size,
+                                  shards=self.config.event_shards)
         from .allocsync import AllocSyncHub, ClientUpdateBatcher
 
         # delta alloc push to clients + batched client status commits

@@ -172,6 +172,13 @@ class ReplicatedServer:
             return self._membership_change("add_server", *args)
         if method == "raft_remove_server":
             return self._membership_change("remove_server", *args)
+        if method == "raft_read_index":
+            # follower read support: a remote follower asks us (the
+            # presumed leader) for a read index (reference nomad's
+            # forwarded Status.Peers/blocking-query pattern)
+            consistent, timeout = args
+            return self.raft.read_index(timeout=timeout,
+                                        lease=not consistent)
         if method not in FORWARD:
             raise ValueError(f"method {method!r} is not forwardable")
         if not self.is_leader():
@@ -407,6 +414,60 @@ class ReplicatedServer:
 
     def is_leader(self) -> bool:
         return self.raft.is_leader() and self.server._running
+
+    # -- read path (follower reads) --
+
+    def known_leader(self) -> bool:
+        """X-Nomad-KnownLeader: does this server currently know who the
+        leader is? A crashed/stopped node's stale leader_id doesn't
+        count — its belief is frozen, not current."""
+        if self.crashed or self.raft._stop.is_set():
+            return False
+        return bool(self.raft.leader_id)
+
+    def last_contact(self) -> float:
+        """X-Nomad-LastContact: seconds since last leader contact (0.0
+        on the leader, inf when no leader was ever heard)."""
+        return self.raft.last_contact_age()
+
+    def read_index(self, consistent: bool = False, timeout: float = 2.0
+                   ) -> int:
+        """Obtain a linearizable read index from the leader — locally
+        when this node leads, else one hop to the leader (in-process via
+        peer_lookup or over the socket transport). The caller then waits
+        for its LOCAL store to reach the index and serves the read from
+        any server (the Raft §6.4 follower-read protocol)."""
+        if self.raft.is_leader():
+            return self.raft.read_index(timeout=timeout,
+                                        lease=not consistent)
+        lid = self.raft.leader_id
+        if not lid or lid == self.id:
+            raise NotLeaderError(lid)
+        if self._peer_lookup is not None:
+            peer = self._peer_lookup(lid)
+            if peer is None:
+                raise NotLeaderError(lid)
+            return peer.raft.read_index(timeout=timeout,
+                                        lease=not consistent)
+        if hasattr(self.transport, "call"):
+            try:
+                return self.transport.call(
+                    lid, "raft_read_index", (consistent, timeout), {})
+            except RemoteCallError as e:
+                if e.error_type in ("NotLeaderError", "TimeoutError"):
+                    raise NotLeaderError(lid) from e
+                cls = self._WIRE_ERRORS.get(e.error_type)
+                if cls is not None:
+                    raise cls(str(e)) from e
+                raise
+            except TransportError as e:
+                # reads are idempotent: a torn call is just "no index"
+                raise NotLeaderError(lid) from e
+        raise NotLeaderError(lid)
+
+    def wait_applied(self, index: int, timeout: float = 5.0) -> None:
+        """Wait until the LOCAL fsm reaches a read_index() result."""
+        self.raft.wait_applied(index, timeout)
 
     # forwarded endpoints raise these; the HTTP layer maps them to status
     # codes, so they must survive the socket hop as their concrete types

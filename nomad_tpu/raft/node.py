@@ -100,7 +100,8 @@ class RaftNode:
                  max_append_entries: int = MAX_APPEND_ENTRIES,
                  fsm_capture: Optional[Callable[[], object]] = None,
                  fsm_serialize: Optional[Callable[[object], dict]] = None,
-                 snapshot_chunk_bytes: int = SNAPSHOT_CHUNK_BYTES):
+                 snapshot_chunk_bytes: int = SNAPSHOT_CHUNK_BYTES,
+                 lease_duration: Optional[float] = None):
         self.id = node_id
         # membership: server id -> address ("" when the transport
         # resolves ids directly). Config-change log entries rewrite this
@@ -148,6 +149,19 @@ class RaftNode:
         self.commit_index = 0
         self.last_applied = 0
         self.leader_id: Optional[str] = None
+        # Leader lease for read_index: a read may skip the heartbeat
+        # confirmation round while a quorum of peers acked within this
+        # window. Safe at half the election timeout because followers
+        # refuse votes while they heard from a live leader within a full
+        # election_timeout (_on_request_vote leader-stickiness): by the
+        # time a rival CAN win votes, any lease granted on pre-partition
+        # acks has expired.
+        self.lease_duration = (lease_duration if lease_duration is not None
+                               else election_timeout * 0.5)
+        # index of this term's barrier noop: reads wait for it to commit
+        # (Raft §6.4 / §8 — earlier-term commits aren't known final
+        # until a current-term entry commits on top)
+        self._term_start_index = 0
 
         # durability (raft/durable.py); all optional — in-memory otherwise
         self.stable = stable
@@ -976,7 +990,8 @@ class RaftNode:
         # leader stays uncommitted until the next client write. The no-op
         # commits promptly and drags predecessors with it (hashicorp/raft
         # does the same).
-        self.log.append(self.current_term, ("noop", (), {}))
+        self._term_start_index = self.log.append(
+            self.current_term, ("noop", (), {})).index
         self._maybe_advance_commit_locked()
         self._repl_cond.notify_all()
         if self.on_leadership:
@@ -1357,6 +1372,142 @@ class RaftNode:
             progressed = self.last_applied >= start
             self._apply_cond.notify_all()
         return progressed
+
+    # -- read path (read-index / lease; Raft §6.4) --
+
+    def wait_applied(self, index: int, timeout: float = 5.0) -> None:
+        """Block until this node's FSM has applied through the given
+        RAFT log index (the second half of a follower read: the leader
+        names a read index, the serving node waits to reach it). Note
+        the raft index space counts noop/config entries — it is NOT the
+        state store's MVCC index."""
+        deadline = time.monotonic() + timeout
+        with self._apply_cond:
+            while self.last_applied < index:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stop.is_set():
+                    raise TimeoutError(
+                        f"fsm at {self.last_applied}, read index {index}")
+                self._apply_cond.wait(min(remaining, 0.05))
+
+    def last_contact_age(self) -> float:
+        """Seconds since this node last heard from a live leader — the
+        HTTP layer's X-Nomad-LastContact bound. 0.0 on the leader (it IS
+        the source), inf when no leader was ever heard."""
+        with self._lock:
+            if self.state == LEADER:
+                return 0.0
+            if self._last_leader_contact <= 0.0:
+                return float("inf")
+            return max(0.0, time.time() - self._last_leader_contact)
+
+    def _lease_valid_locked(self, now: float) -> bool:
+        """True while a quorum of the cluster acked this leader within
+        lease_duration (call with the lock held). The leader counts
+        toward its own quorum, so it needs quorum-1 recent peer acks."""
+        peers = self.peers
+        if not peers:
+            return True
+        need = (len(peers) + 1) // 2 + 1 - 1  # quorum minus self
+        recent = sum(1 for p in peers
+                     if now - self._last_contact.get(p, 0.0)
+                     < self.lease_duration)
+        return recent >= need
+
+    def read_index(self, timeout: float = 1.0, lease: bool = True) -> int:
+        """Leader-side half of a linearizable read: confirm we are still
+        the leader, then return a commit index the reader must wait past
+        (serve once ``last_applied >= read_index`` on ANY server).
+
+        Confirmation is a held lease (quorum of replication acks within
+        lease_duration) when ``lease=True``, else a full round of empty
+        append_entries (``lease=False`` = the ?consistent= HTTP mode —
+        immune even to clock-rate assumptions). Either way the read
+        index is only valid once this term's barrier noop has committed:
+        before that, entries committed by the previous leader are not
+        yet known final (Raft §8), so we first wait for it.
+
+        Raises NotLeaderError when not (or no longer provably) the
+        leader, TimeoutError when the barrier noop doesn't commit in
+        time (e.g. a freshly elected leader still replicating)."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            if self._stop.is_set():
+                # a stopped (crashed) node may still carry LEADER state;
+                # it must never vouch for a read
+                raise NotLeaderError(None)
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            term = self.current_term
+            # wait for the term-start barrier to commit
+            while self.commit_index < self._term_start_index:
+                if self.state != LEADER or self.current_term != term \
+                        or self._stop.is_set():
+                    raise NotLeaderError(self.leader_id)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError("term-start barrier not committed")
+                self._apply_cond.wait(min(remaining, 0.05))
+            index = self.commit_index
+            if lease and self._lease_valid_locked(time.time()):
+                _registry().incr("nomad.reads.lease_reads")
+                return index
+        # no valid lease (or caller opted out): prove leadership with a
+        # round of empty append_entries — outside the lock, it's I/O
+        self._confirm_leadership(term, deadline)
+        return index
+
+    def _confirm_leadership(self, term: int, deadline: float) -> None:
+        """One empty-AppendEntries round: a quorum answering in our term
+        proves no newer leader exists (their acks double as fresh lease
+        basis). Raises NotLeaderError on a higher term or no quorum."""
+        with self._lock:
+            if self.state != LEADER or self.current_term != term:
+                raise NotLeaderError(self.leader_id)
+            peers = list(self.peers)
+            last_index, _ = self.log.last()
+            prev_term = self.log.term_at(last_index)
+            commit = self.commit_index
+        acks = 1  # self
+        for p in peers:
+            if time.monotonic() > deadline:
+                break
+            reply = self.transport.send(self.id, p, {
+                "kind": "append_entries", "term": term, "leader": self.id,
+                "prev_log_index": last_index, "prev_log_term": prev_term,
+                "entries": [], "leader_commit": commit,
+            })
+            if reply is None:
+                continue
+            with self._lock:
+                if reply["term"] > self.current_term:
+                    self._become_follower_locked(reply["term"])
+                    raise NotLeaderError(self.leader_id)
+                if reply["term"] == term:
+                    # success or not, a same-term reply acknowledges our
+                    # leadership (a log mismatch is a replication
+                    # problem, not an authority one)
+                    acks += 1
+                    self._last_contact[p] = time.time()
+        with self._lock:
+            if self.state != LEADER or self.current_term != term:
+                raise NotLeaderError(self.leader_id)
+        if acks * 2 <= len(peers) + 1:
+            raise NotLeaderError(None)
+        _registry().incr("nomad.reads.lease_extensions")
+
+
+def _registry():
+    """Lazy: core.metrics is standalone, but importing it at module load
+    would pull core/__init__ -> server -> raft while raft is mid-load."""
+    global _REG
+    if _REG is None:
+        from ..core.metrics import REGISTRY
+        _REG = REGISTRY
+    return _REG
+
+
+_REG = None
 
 
 class NotLeaderError(Exception):

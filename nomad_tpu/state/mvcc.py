@@ -269,3 +269,10 @@ class SnapshotTracker:
             if not self._live:
                 return current_gen
             return min(self._live)
+
+    def live_count(self) -> int:
+        """Snapshots currently pinned (refcounts summed) — the
+        nomad.state.live_snapshots gauge: a runaway value means readers
+        are pinning generations and blocking compaction."""
+        with self._lock:
+            return sum(self._live.values())

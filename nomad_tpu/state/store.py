@@ -37,6 +37,7 @@ from ..structs.node import Node
 from ..analysis.ownership import GLOBAL as _OWN
 from ..analysis.sanitizer import sanitized
 from .mvcc import ConsList, SnapshotTracker, VersionedTable, cons, cons_from_iter, cons_iter
+from .watch import WatchTable
 
 
 def _block_alloc_fallback(alloc_id: str, lookup) -> Optional[Allocation]:
@@ -537,6 +538,9 @@ class StateStore:
             self._node_usage, self._node_dev_usage,
         ]
         self._listeners: List[Callable[[int, list], None]] = []
+        # parked blocking queries (state/watch.py): first listener so
+        # watchers wake before heavier derived-cache listeners run
+        self.watches = WatchTable(self)
 
     # --- infrastructure ---
 

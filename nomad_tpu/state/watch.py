@@ -1,0 +1,140 @@
+"""Store-index waiter table: coalesced blocking-query wakeups.
+
+Replaces the HTTP layer's per-watcher 20 ms sleep-poll (the old
+``api/http.py:_block`` loop) with a min-heap of parked waiters keyed by
+the store index they wait for. One commit publishes ONE timestamped
+notification batch: the heap pop wakes exactly the waiters whose index
+threshold passed — no per-watcher thread, no poll loop, no latency
+floor, and no thundering herd (a waiter parked at index N+100 never
+wakes for the commit at N+1).
+
+Deadlines need no timer thread: each parked HTTP handler already owns a
+thread, so it enforces its own deadline with ``Event.wait(timeout)`` and
+marks its heap entry cancelled on the way out (lazy removal — the entry
+is discarded the next time a commit pops past it). The commit/deadline
+race is settled under the table lock: a waiter that times out re-checks
+its event under the lock, so a wakeup that raced the deadline is never
+lost (the nomadcheck ``read_index`` scenario drives this interleaving).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import List, Optional, Tuple
+
+
+def _registry():
+    """Lazy: importing core.metrics at module load would cycle through
+    core/__init__ -> server -> state while state is still loading."""
+    global _REG
+    if _REG is None:
+        from ..core.metrics import REGISTRY
+        _REG = REGISTRY
+    return _REG
+
+
+_REG = None
+
+
+class _Waiter:
+    __slots__ = ("event", "index", "wake_ts", "cancelled")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.index = 0        # the committed index that woke us
+        self.wake_ts = 0.0    # commit publish timestamp of that batch
+        self.cancelled = False
+
+
+class WatchTable:
+    """Parked blocking queries for one state store, woken by its commit
+    listener. Registered at store construction so every replica — leader
+    or follower — wakes its own watchers as replication applies commits
+    locally (the substrate for follower blocking queries)."""
+
+    def __init__(self, store):
+        self._store = store
+        self._lock = threading.Lock()
+        self._heap: List[Tuple[int, int, _Waiter]] = []
+        self._tie = 0       # FIFO within one index threshold
+        self._parked = 0    # live (non-cancelled) waiters
+        self._gauge_ts = 0.0
+        store.add_commit_listener(self._on_commit)
+
+    def _publish_gauge(self, now: Optional[float] = None) -> None:
+        """Refresh the parked gauge at most every 50 ms (call under
+        _lock). At fan-out scale thousands of parks per second would
+        otherwise serialize on the process-global registry lock — the
+        gauge is a scrape-rate observable, not an exact live count."""
+        if now is None:
+            now = time.time()
+        if now - self._gauge_ts >= 0.05:
+            self._gauge_ts = now
+            _registry().set_gauge("nomad.reads.parked", self._parked)
+
+    def parked(self) -> int:
+        with self._lock:
+            return self._parked
+
+    def wait_min_index(self, index: int, timeout: Optional[float] = None
+                       ) -> Tuple[int, Optional[float]]:
+        """Park until the store publishes ``latest_index >= index`` or
+        the timeout expires. Returns ``(observed_index, wake_ts)`` where
+        wake_ts is the waking commit's publish timestamp (None when the
+        store was already past the threshold or the wait timed out) —
+        the bench uses it to measure commit-to-wake latency."""
+        latest = self._store.latest_index
+        if latest >= index:
+            return latest, None
+        w = _Waiter()
+        with self._lock:
+            # re-check under the table lock: _on_commit takes it too,
+            # so a commit publishing between the check above and the
+            # push below is guaranteed to pop this entry
+            latest = self._store.latest_index
+            if latest >= index:
+                return latest, None
+            self._tie += 1
+            heapq.heappush(self._heap, (index, self._tie, w))
+            self._parked += 1
+            self._publish_gauge()
+        if not w.event.wait(timeout):
+            with self._lock:
+                if not w.event.is_set():
+                    # deadline won the race: cancel in place (lazy
+                    # removal — a later commit pop discards the entry)
+                    w.cancelled = True
+                    self._parked -= 1
+                    self._publish_gauge()
+                    return self._store.latest_index, None
+            # the commit won the race under the lock: fall through as a
+            # normal wakeup — the parked query is never lost
+        return w.index, w.wake_ts
+
+    def _on_commit(self, index: int, events: list) -> None:
+        """One commit -> one timestamped notification batch. Runs on
+        the store's commit path (under raft, the apply thread): heap
+        pops and Event.set only — never blocks, never re-enters the
+        store."""
+        batch: List[_Waiter] = []
+        with self._lock:
+            heap = self._heap
+            while heap and heap[0][0] <= index:
+                _, _, w = heapq.heappop(heap)
+                if w.cancelled:
+                    continue
+                batch.append(w)
+            if batch:
+                self._parked -= len(batch)
+                self._publish_gauge()
+        if not batch:
+            return
+        now = time.time()
+        for w in batch:
+            w.index = index
+            w.wake_ts = now
+            w.event.set()
+        _registry().incr("nomad.reads.wakeups", len(batch))
+        _registry().observe("nomad.reads.wakeup_batch", float(len(batch)))

@@ -24,6 +24,11 @@
 #                                   while 3 leaders crash in sequence;
 #                                   node liveness + alloc uniqueness on
 #                                   every replica)
+#   scripts/check.sh --watch-smoke  also run the read-path watch smoke
+#                                   (blocking queries + event subs
+#                                   parked on all 3 replicas across a
+#                                   leader crash; survivors wake
+#                                   consistent, dead server fails fast)
 set -u
 cd "$(dirname "$0")/.."
 
@@ -32,6 +37,7 @@ run_solve_smoke=0
 run_trace_smoke=0
 run_snap_smoke=0
 run_swarm_smoke=0
+run_watch_smoke=0
 for arg in "$@"; do
     case "$arg" in
         --e2e-smoke) run_e2e_smoke=1 ;;
@@ -39,6 +45,7 @@ for arg in "$@"; do
         --trace-smoke) run_trace_smoke=1 ;;
         --snap-smoke) run_snap_smoke=1 ;;
         --swarm-smoke) run_swarm_smoke=1 ;;
+        --watch-smoke) run_watch_smoke=1 ;;
         *) echo "unknown flag: $arg" >&2; exit 64 ;;
     esac
 done
@@ -159,6 +166,19 @@ if [ "$run_swarm_smoke" = 1 ]; then
     echo "== swarm smoke (python -m nomad_tpu.chaos --swarm-smoke) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 300 \
         python -m nomad_tpu.chaos --swarm-smoke || failed=1
+fi
+
+# read-path watch smoke (opt-in, ~10s): blocking queries + event
+# subscriptions parked on all three replicas of a live cluster, then
+# the leader is crashed mid-watch — survivors' watchers must wake with
+# a consistent post-failover view, the dead server's watchers must
+# fail fast (or return bounded-stale), and follower reads must carry
+# truthful X-Nomad-KnownLeader / X-Nomad-LastContact headers
+# (ROBUSTNESS.md "Read path")
+if [ "$run_watch_smoke" = 1 ]; then
+    echo "== watch smoke (python -m nomad_tpu.chaos --watch-smoke) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 300 \
+        python -m nomad_tpu.chaos --watch-smoke || failed=1
 fi
 
 echo "== tier-1 tests =="
