@@ -426,6 +426,147 @@ def cfg_c2m() -> None:
          plan_rejection_rate=rej)
 
 
+def cfg_c2m_sharded() -> None:
+    """Multi-chip C2M: the FULL flagship pipeline (dequeue -> tensor
+    build -> bulk solve -> plan-apply -> commit) through the
+    mesh-sharded engine, swept across mesh sizes {1, 2, 4, 8} on the
+    virtual 8-device CPU mesh. Every sweep point runs in its own
+    subprocess (the virtual mesh needs
+    xla_force_host_platform_device_count at jax import;
+    NOMAD_TPU_MESH_DEVICES then caps the mesh per run — 1 forces the
+    single-device engine, so the baseline runs under identical process
+    conditions). Per point it reports wall clock, per-phase span
+    medians, the solve/apply overlap occupancy of the double-buffered
+    launch pipeline, and the all-gather cadence; a serial pinned-id
+    parity digest (same workload the e2e parity test pins) must be
+    BIT-IDENTICAL across all mesh sizes or the rung fails.
+    vs_baseline is single-device/mesh-m wall-clock."""
+    import os
+    import subprocess
+
+    script = r"""
+import hashlib, json, os, time
+import numpy as np
+import jax
+
+jax.config.update('jax_platforms', 'cpu')
+import bench
+from nomad_tpu import mock
+from nomad_tpu.obs import TRACER
+from nomad_tpu.obs.trace import R_NAME, R_T0, R_T1
+from nomad_tpu.structs import enums
+from nomad_tpu.structs.operator import SchedulerConfiguration
+from nomad_tpu.testing import Harness
+
+assert len(jax.devices()) == 8, jax.devices()
+m = int(os.environ["NOMAD_TPU_MESH_DEVICES"])
+out = {"mesh": m}
+
+# -- timed flagship run: 100K allocs / 5,120 nodes, 16 racing workers --
+def jobs():
+    return [bench.service_job(1000, cpu=50, mem=32, batch=True)
+            for _ in range(100)]
+
+extras = {}
+dt, placed, rej = bench.run_server(
+    5120, jobs, enums.SCHED_ALG_TPU_BINPACK, workers=16,
+    timeout=1500.0, extras=extras)
+assert placed == 100_000, placed
+svc = extras.get("service", {})
+out["wall_s"] = dt
+out["allocs_s"] = placed / dt
+out["rejection_rate"] = rej
+out["sharded_launches"] = svc.get("sharded", 0)
+out["mesh_devices"] = svc.get("mesh_devices", 0)
+out["pipelined"] = svc.get("pipelined", 0)
+busy = svc.get("busy_s", 0.0)
+out["overlap_occupancy"] = (svc.get("overlap_s", 0.0) / busy
+                            if busy > 0 else 0.0)
+out["allgathers_per_eval"] = (svc.get("allgathers", 0)
+                              / max(svc.get("solves", 1), 1))
+
+# -- per-phase medians over the span rings (last RING_CAP per thread) --
+phases = ("worker.tensor_build", "worker.solve_bulk", "solver.launch",
+          "solver.apply", "plan.verify", "plan.commit")
+durs = {p: [] for p in phases}
+for rec in TRACER.spans():
+    if rec[R_NAME] in durs:
+        durs[rec[R_NAME]].append(rec[R_T1] - rec[R_T0])
+out["phase_median_ms"] = {
+    p: (float(np.median(v)) * 1e3 if v else None) for p, v in durs.items()}
+
+# -- pinned-id parity digest (mirrors tests/test_c2m_sharded.py) --
+h = Harness()
+bench.build_nodes(h.store, 256)
+cfg = SchedulerConfiguration(
+    scheduler_algorithm=enums.SCHED_ALG_TPU_BINPACK)
+pjobs = []
+for i, (count, cpu, mem) in enumerate(
+        ((700, 50, 32), (900, 60, 48), (500, 80, 64))):
+    j = bench.service_job(count, cpu=cpu, mem=mem, batch=True)
+    j.id = f"parity-bench-{i}"
+    pjobs.append(j)
+for i, j in enumerate(pjobs):
+    h.store.upsert_job(j)
+    h.process(mock.eval_for(j, id=f"parity-bench-ev-{i}"),
+              sched_config=cfg)
+snap = h.store.snapshot()
+ordinal = {n.id: i for i, n in enumerate(snap.nodes())}
+fp = []
+for j in pjobs:
+    per_node = {}
+    scores = set()
+    for a in snap.allocs_by_job(j.id):
+        per_node[ordinal[a.node_id]] = per_node.get(
+            ordinal[a.node_id], 0) + 1
+        if a.metrics is not None:
+            scores.update(v for k, v in a.metrics.scores.items()
+                          if k.endswith(".normalized-score"))
+    fp.append((j.id, tuple(sorted(per_node.items())),
+               tuple(sorted(scores))))
+out["digest"] = hashlib.sha256(repr(fp).encode()).hexdigest()
+print("C2M_SHARDED " + json.dumps(out))
+"""
+    results = {}
+    for m in (1, 2, 4, 8):
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   NOMAD_TPU_MESH_DEVICES=str(m),
+                   XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
+                              " --xla_force_host_platform_device_count=8"),
+                   PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              capture_output=True, text=True, timeout=1800,
+                              cwd=os.path.dirname(os.path.abspath(__file__)))
+        lines = [ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("C2M_SHARDED ")]
+        if proc.returncode != 0 or not lines:
+            raise RuntimeError(
+                f"c2m_sharded mesh={m} subprocess failed "
+                f"(rc {proc.returncode}): {proc.stderr[-2000:]}")
+        results[m] = json.loads(lines[-1][len("C2M_SHARDED "):])
+
+    digests = {m: r["digest"] for m, r in results.items()}
+    if len(set(digests.values())) != 1:
+        raise RuntimeError(f"c2m_sharded parity digest diverged: {digests}")
+    base = results[1]["wall_s"]
+    for m in (1, 2, 4, 8):
+        r = results[m]
+        phases = {f"phase_{k.split('.')[-1]}_ms": v
+                  for k, v in r["phase_median_ms"].items()
+                  if v is not None}
+        emit(f"c2m_sharded_100k_allocs_5k_nodes_mesh{m}",
+             r["allocs_s"], "allocs/s", base / r["wall_s"],
+             wall_clock_s=r["wall_s"],
+             overlap_occupancy=r["overlap_occupancy"],
+             allgathers_per_eval=r["allgathers_per_eval"],
+             sharded_launches=r["sharded_launches"],
+             pipelined=r["pipelined"],
+             plan_rejection_rate=r["rejection_rate"],
+             parity="bit-exact",
+             **phases)
+
+
 def cfg_solve_ab() -> None:
     """Global-batch solve A/B: "tpu-solve" (whole worker dequeue-batch
     coalesced into ONE joint auction launch, tensor/batch_solver.py)
@@ -819,7 +960,8 @@ def run_single():
 
 def run_sharded():
     u, a = shard_bulk_state(mesh8, used0, avail)
-    return solve8(u, a, feas, aff, ask, k, seeds, cidx, cdelta, g=g)
+    u2, c2, _ = solve8(u, a, feas, aff, ask, k, seeds, cidx, cdelta, g=g)
+    return u2, c2
 
 for name, fn in (("single", run_single), ("sharded8", run_sharded)):
     _, c = fn()
@@ -1728,6 +1870,7 @@ CONFIGS = [
     ("trace_ab", cfg_trace_ab),
     ("headline", headline_spread_1k),
     ("c2m", cfg_c2m),
+    ("c2m_sharded", cfg_c2m_sharded),
     ("snap_restore", cfg_snap_restore),
     ("solve_ab", cfg_solve_ab),
     ("cfg1", cfg1_service_binpack),

@@ -59,6 +59,7 @@ import _thread
 import random
 import threading
 import time
+import types
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -1609,12 +1610,20 @@ def _scenario_solve_batch(env: ScenarioEnv) -> None:
 
     static = _Static()
 
-    def host_solve_group(rs) -> None:
-        # host stub for the device launch: same token/ledger/future
-        # protocol as _solve_group, no accelerator
+    def host_dispatch_group(rs):
+        # host stub for the device dispatch: record the launch group
+        # and hand back an inflight handle — the service pipelines the
+        # FETCH (ledger + future resolution) exactly as it would a real
+        # double-buffered device launch, so the checker explores the
+        # deferred-resolution interleavings too
         with launches_lock:
             launches.append(tuple(sorted(bool(r.joint) for r in rs)))
-        for r in rs:
+        return types.SimpleNamespace(rs=rs)
+
+    def host_fetch(inf, pipelined: bool = False) -> None:
+        # host stub for the single device_get: same token/ledger/future
+        # protocol as _fetch, no accelerator
+        for r in inf.rs:
             with svc._lock:
                 svc._token += 1
                 r.token = svc._token
@@ -1623,7 +1632,8 @@ def _scenario_solve_batch(env: ScenarioEnv) -> None:
                     np.ones(2, np.float32), 0.0)
             r.future.set_result(np.zeros(8, np.int64))
 
-    svc._solve_group = host_solve_group
+    svc._dispatch_group = host_dispatch_group
+    svc._fetch = host_fetch
 
     outcomes: List[str] = []
     out_lock = threading.Lock()

@@ -575,6 +575,137 @@ def solve_smoke(nodes_n: int = 40, jobs_n: int = 4,
     return 0
 
 
+def mesh_smoke(nodes_n: int = 40, jobs_n: int = 4,
+               count: int = 256) -> int:
+    """Multi-chip C2M smoke (scripts/check.sh --mesh-smoke): the live
+    3-node cluster pipeline with the solver service running on the
+    8-virtual-device mesh (check.sh exports
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 before jax
+    imports). Batched workers under "tpu-solve" drive node-sharded
+    joint launches end to end; asserts every placement lands, the
+    sharded engine actually engaged (sharded launches > 0 at
+    mesh_devices == 8, with live all-gather accounting and ZERO warm
+    retraces), and the alloc-set uniqueness + safety invariants hold
+    on every replica."""
+    import os
+    import shutil
+
+    import jax
+
+    from ..core.server import ServerConfig
+    from ..structs import enums
+    from ..structs.operator import SchedulerConfiguration
+    from .invariants import InvariantChecker
+
+    t0 = time.monotonic()
+    if len(jax.devices()) < 2:
+        print("MESH SMOKE: FAIL — single-device jax backend; export "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+              "before launching (scripts/check.sh --mesh-smoke does)")
+        return 2
+    os.environ["NOMAD_TPU_MESH_DEVICES"] = "8"
+
+    def config_fn(_i: int) -> ServerConfig:
+        return ServerConfig(
+            num_workers=2, eval_batch_size=4, plan_commit_batching=True,
+            sched_config=SchedulerConfiguration(
+                scheduler_algorithm=enums.SCHED_ALG_TPU_SOLVE),
+            heartbeat_ttl=3600.0, gc_interval=3600.0, nack_timeout=900.0,
+            failed_eval_followup_delay=3600.0,
+            failed_eval_unblock_interval=0.5)
+
+    tmp = tempfile.mkdtemp(prefix="nomad-mesh-smoke-")
+    checker = InvariantChecker()
+    try:
+        cluster = RaftCluster(3, config_fn=config_fn, data_dir=tmp)
+        cluster.start()
+        try:
+            leader = cluster.wait_for_leader(timeout=15.0)
+            if leader is None:
+                print("MESH SMOKE: FAIL — no leader elected")
+                return 2
+            for _ in range(nodes_n):
+                n = mock.node()
+                n.resources.cpu = 16000
+                n.resources.memory_mb = 32768
+                n.compute_class()
+                leader.register_node(n)
+
+            from ..tensor.solver import get_service
+            svc0 = dict(get_service().stats)
+
+            jobs = []
+            for i in range(jobs_n):
+                j = mock.batch_job()
+                tg = j.task_groups[0]
+                tg.count = count
+                tg.tasks[0].resources.cpu = (50, 80, 120, 60)[i % 4]
+                tg.tasks[0].resources.memory_mb = (48, 96, 64, 128)[i % 4]
+                jobs.append(j)
+                leader.register_job(j)
+
+            deadline = time.time() + 240
+            while True:
+                if leader.server.wait_for_idle(
+                        timeout=10.0, include_delayed=False) \
+                        and leader.server.blocked.blocked_count() == 0:
+                    break
+                if time.time() > deadline:
+                    print("MESH SMOKE: FAIL — pipeline did not drain")
+                    return 2
+                time.sleep(0.1)
+
+            checker.check_convergence(cluster, timeout=30.0)
+            checker.check_all(cluster)
+
+            snap = leader.local_store.snapshot()
+            placed = [a for a in snap.allocs()
+                      if not a.terminal_status() and not a.server_terminal()]
+            want = jobs_n * count
+            if len(placed) != want:
+                print(f"MESH SMOKE: FAIL — {len(placed)}/{want} "
+                      f"placements landed")
+                return 2
+            if len({a.id for a in placed}) != len(placed):
+                print("MESH SMOKE: FAIL — duplicate alloc ids")
+                return 2
+
+            svc = get_service().stats
+            delta = {k: svc[k] - svc0.get(k, 0) for k in svc}
+            if svc.get("mesh_devices", 0) != 8:
+                print(f"MESH SMOKE: FAIL — solver mesh has "
+                      f"{svc.get('mesh_devices', 0)} devices, wanted 8")
+                return 2
+            if delta.get("sharded", 0) < 1:
+                print("MESH SMOKE: FAIL — no launch ran through the "
+                      "node-sharded engine (sharded == 0)")
+                return 2
+            if delta.get("joint_launches", 0) < 1:
+                print("MESH SMOKE: FAIL — no batch reached the joint "
+                      "auction tier (joint_launches == 0)")
+                return 2
+            if delta.get("allgathers", 0) < 1:
+                print("MESH SMOKE: FAIL — sharded launches ran but the "
+                      "all-gather accounting stayed at 0")
+                return 2
+            if delta.get("retraces", 0) != 0:
+                print(f"MESH SMOKE: FAIL — {delta['retraces']} warm "
+                      f"retrace(s) under the no_retrace window")
+                return 2
+        finally:
+            cluster.stop()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    dt = time.monotonic() - t0
+    print(f"MESH SMOKE: ok — {want} placements via "
+          f"{delta.get('sharded', 0)} sharded launch(es) "
+          f"({delta.get('joint_launches', 0)} joint) on an 8-device "
+          f"mesh, {delta.get('allgathers', 0)} all-gathers, "
+          f"0 retraces, {checker.stats['checks']} invariant sweeps, "
+          f"{dt:.1f}s")
+    return 0
+
+
 def snap_smoke(jobs_n: int = 200, nodes_n: int = 60, workers: int = 4,
                snapshot_threshold: int = 120) -> int:
     """Snapshot/compaction smoke (scripts/check.sh --snap-smoke): the
@@ -1305,6 +1436,16 @@ def main(argv=None) -> int:
                              "(batched workers under tpu-solve; joint "
                              "launch, score dominance, alloc "
                              "uniqueness) instead of the scenario smoke")
+    parser.add_argument("--mesh-smoke", action="store_true",
+                        help="run the multi-chip C2M smoke (live "
+                             "3-node cluster with the solver on an "
+                             "8-virtual-device mesh; sharded joint "
+                             "launches, zero retraces, alloc "
+                             "uniqueness on every replica) instead of "
+                             "the scenario smoke — export XLA_FLAGS="
+                             "--xla_force_host_platform_device_count=8 "
+                             "first (scripts/check.sh --mesh-smoke "
+                             "does)")
     parser.add_argument("--snap-smoke", action="store_true",
                         help="run the snapshot/compaction smoke (low "
                              "snapshot threshold under e2e load, one "
@@ -1344,6 +1485,8 @@ def main(argv=None) -> int:
         return e2e_smoke()
     if args.solve_smoke:
         return solve_smoke()
+    if args.mesh_smoke:
+        return mesh_smoke()
     if args.snap_smoke:
         return snap_smoke()
     if args.swarm_smoke:

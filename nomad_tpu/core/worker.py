@@ -169,6 +169,12 @@ class Worker:
         self._stats_lock = threading.Lock()
         # batch-member pool (created at start when eval_batch_size > 1)
         self._batch_pool: Optional[ThreadPoolExecutor] = None
+        # the still-settling previous batch: (futures, publish_delta).
+        # process_batch leaves a batch draining on the pool and returns
+        # to the dequeue loop, so the NEXT batch's solves reach the
+        # solver service while these members plan-verify/commit — the
+        # worker half of the solve/apply double buffer
+        self._prev_batch = None
         # cross-eval constraint caches (regex compiles, parsed versions):
         # content-keyed with immutable values, so the worst concurrent
         # access from batch-pool members is a benign duplicate compile
@@ -185,8 +191,12 @@ class Worker:
         self._stop.clear()
         batch_size = getattr(self.server.config, "eval_batch_size", 1)
         if batch_size > 1 and self._batch_pool is None:
+            # 2x: one batch plan-applying + one batch solving at any
+            # moment (the double buffer) — a pool sized at batch_size
+            # would make the fresh batch's rendezvous wait out the
+            # previous batch's commits thread-by-thread
             self._batch_pool = ThreadPoolExecutor(
-                max_workers=batch_size,
+                max_workers=2 * batch_size,
                 thread_name_prefix=f"worker-{self.id}-eval")
         self._thread = threading.Thread(target=self.run, daemon=True,
                                         name=f"worker-{self.id}")
@@ -211,6 +221,9 @@ class Worker:
                 batch = self.server.broker.dequeue_batch(
                     self.sched_types, max_batch=batch_size, timeout=0.2)
                 if not batch:
+                    # idle: settle the deferred batch so its ack/nack
+                    # and stats publish promptly
+                    self._drain_prev()
                     continue
                 self.process_batch(batch)
             else:
@@ -219,6 +232,22 @@ class Worker:
                 if ev is None:
                     continue
                 self.process_one(ev, token)
+        self._drain_prev()
+
+    def _drain_prev(self) -> None:
+        """Block until the deferred previous batch finishes and publish
+        its preemption split. Runs on the worker thread only."""
+        prev = self._prev_batch
+        if prev is None:
+            return
+        self._prev_batch = None  # san-ok: confined to the run-loop thread
+        futs, publish = prev
+        for f in futs:
+            try:
+                f.result()
+            except Exception:
+                pass  # _EvalRun.run never raises; belt and braces
+        publish()
 
     def process_batch(self, batch: List) -> None:
         """Run a drained batch of evals against ONE shared snapshot:
@@ -258,6 +287,7 @@ class Worker:
 
         pool = self._batch_pool
         if len(batch) == 1 or pool is None:
+            self._drain_prev()  # the inline path stays strictly ordered
             for ev, token in batch:
                 if self._stop.is_set():
                     # shutting down: leave the rest to the nack timers
@@ -293,12 +323,16 @@ class Worker:
             if batch_ctx is not None:
                 for _ in range(len(batch) - len(futs)):
                     batch_ctx.settle()
-        for f in futs:
-            try:
-                f.result()
-            except Exception:
-                pass  # _EvalRun.run never raises; belt and braces
-        publish_preempt_delta()
+        # double buffer: drain the PREVIOUS batch (its members ran while
+        # this one was dequeued, snapshotted, and submitted), then leave
+        # THIS batch settling on the pool — the dequeue loop goes
+        # straight back to the broker, and the next batch's solves reach
+        # the solver service while these members plan-verify/commit.
+        # Each member still acks/nacks its own eval, so at most two
+        # batches in flight is indistinguishable from two workers.
+        self._drain_prev()
+        # san-ok: confined to the run-loop thread (only run() reaches here)
+        self._prev_batch = (futs, publish_preempt_delta)
 
     @staticmethod
     def _run_member(batch_ctx, eval_run):

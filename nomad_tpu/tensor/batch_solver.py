@@ -113,12 +113,32 @@ PORTFOLIO = (
 RESTARTS = len(PORTFOLIO)
 
 
+def _pairwise_sum_xp(xp, v):
+    """Fixed-tree pairwise sum of a 1-D vector. A plain ``.sum()``
+    leaves the float add order to the backend's reduction strategy,
+    which varies with the surrounding fusion context — the same
+    per-node contributions summed inside two different compiled graphs
+    (single-device vs mesh-sharded) can disagree in the last ulp, and
+    that is enough to flip a near-tied portfolio selection. Explicit
+    halving adds pin the association order by shape alone, so every
+    layout reduces identically bit-for-bit."""
+    n = int(v.shape[0])
+    p = 1
+    while p < n:
+        p *= 2
+    if p != n:
+        v = xp.concatenate([v, xp.zeros(p - n, dtype=v.dtype)])
+    while v.shape[0] > 1:
+        v = v[0::2] + v[1::2]
+    return v[0]
+
+
 def _packing_score_xp(xp, counts, available, used_final):
     """Order-independent packing quality of a whole-batch assignment:
     sum_n placed[n] * BestFit-fitness(available[n], used_final[n])."""
     per_node = _fit_scores_xp(xp, available, used_final, False)   # (N,)
     placed = counts.sum(axis=0) if counts.ndim == 2 else counts   # (N,)
-    return (placed.astype(per_node.dtype) * per_node).sum()
+    return _pairwise_sum_xp(xp, placed.astype(per_node.dtype) * per_node)
 
 
 def packing_score_np(counts, available, used_final) -> float:

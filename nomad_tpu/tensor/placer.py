@@ -506,12 +506,13 @@ class TPUPlacer:
         (solver service with device-resident carry > fused resident
         arrays > generic kernel) -> (N_pad,) int64 per-node counts."""
         from .kernels import solve_bulk, solve_bulk_fused
+        from .solver import BulkSolverService
 
         k_pad = _pad_pow2(k, floor=self.BULK_STEP)
         n_steps = k_pad // self.BULK_STEP
         static = cluster.static
         if (static is not None and tgt.feas_base is not None
-                and k <= 32767):
+                and k <= BulkSolverService.MAX_K):
             # The service path serializes ALL bulk solves — including
             # partial-commit retries (placed_tg/placed_job nonzero) —
             # on one device-resident carry, so racing workers can never
@@ -569,24 +570,29 @@ class TPUPlacer:
             tie_perm = np.random.default_rng(seed).permutation(
                 cluster.n_pad).astype(np.int32)
         counts = self._solve_bulk_counts(ctx, cluster, tgt, k, seed, tie_perm)
-        mean_score = self._bulk_trajectory_mean(counts, cluster, tgt)
+        # everything below is pure host work on fetched counts — under a
+        # pipelined solver this "apply" window runs WHILE the device
+        # solves the next batch; the span makes that overlap visible
+        # next to solver.shard/solver.launch in the trace
+        with TRACER.span("solver.apply", k=k):
+            mean_score = self._bulk_trajectory_mean(counts, cluster, tgt)
 
-        metrics = ctx.new_metrics()
-        metrics.nodes_in_pool = len(cluster.nodes)
-        metrics.nodes_evaluated = len(cluster.nodes)
-        metrics.scores["bulk.normalized-score"] = mean_score
+            metrics = ctx.new_metrics()
+            metrics.nodes_in_pool = len(cluster.nodes)
+            metrics.nodes_evaluated = len(cluster.nodes)
+            metrics.scores["bulk.normalized-score"] = mean_score
 
-        nz = np.nonzero(counts)[0]
-        placed_counts = counts[nz]
-        total = int(placed_counts.sum())
-        nodes = cluster.nodes
-        commit.commit_block(
-            tg,
-            [nodes[int(ni)].id for ni in nz],
-            [nodes[int(ni)].name for ni in nz],
-            placed_counts.astype(np.int64),
-            np.asarray(bulk.name_indices[:total], dtype=np.int64),
-            mean_score)
+            nz = np.nonzero(counts)[0]
+            placed_counts = counts[nz]
+            total = int(placed_counts.sum())
+            nodes = cluster.nodes
+            commit.commit_block(
+                tg,
+                [nodes[int(ni)].id for ni in nz],
+                [nodes[int(ni)].name for ni in nz],
+                placed_counts.astype(np.int64),
+                np.asarray(bulk.name_indices[:total], dtype=np.int64),
+                mean_score)
 
         n_unplaced = k - total
         if not n_unplaced:

@@ -208,7 +208,7 @@ def _bulk_shard_body(used0, avail, feas, aff, ask, k, seeds, cidx, cdelta,
         key0 = score + jax.lax.dynamic_slice(jit_all, (lo,), (n_loc,))
 
         def round_body(state):
-            take_loc, cap_loc, key_loc, budget, _ = state
+            take_loc, cap_loc, key_loc, budget, rnd, _ = state
             masked = jnp.where(cap_loc > 0, key_loc, NEG)
             vals, loc_idx = jax.lax.top_k(masked, r)
             pool = jnp.stack([
@@ -250,21 +250,25 @@ def _bulk_shard_body(used0, avail, feas, aff, ask, k, seeds, cidx, cdelta,
                 jnp.where(mine & elig_c, 0, 1))
             budget = budget - consumed
             go = (budget > 0) & (keys_s[0] > NEG) & (consumed > 0)
-            return take_loc, cap_loc, key_loc, budget, go
+            return take_loc, cap_loc, key_loc, budget, rnd + 1, go
 
         def round_cond(state):
-            return state[4]
+            return state[5]
 
         init = (jnp.zeros(n_loc, jnp.int32), cap, key0, budget0,
-                budget0 > 0)
-        take_loc, _, _, _, _ = jax.lax.while_loop(
+                jnp.int32(0), budget0 > 0)
+        take_loc, _, _, _, rnd, _ = jax.lax.while_loop(
             round_cond, round_body, init)
         used = used + ask_g[None, :] * take_loc[:, None].astype(
             used.dtype)
-        return used, take_loc.astype(jnp.int16)
+        # rnd == all-gathers this eval consumed (one per round); the
+        # while state is replicated math so every shard reports the same
+        # value — the launch's collective cadence, surfaced so the bench
+        # can prove the one-gather-per-eval contract held at scale
+        return used, (take_loc.astype(jnp.int16), rnd)
 
-    used, counts = jax.lax.scan(one_eval, used0, jnp.arange(g))
-    return used, counts
+    used, (counts, rounds) = jax.lax.scan(one_eval, used0, jnp.arange(g))
+    return used, counts, rounds
 
 
 def make_solve_bulk_multi_sharded(mesh: Mesh, axis: str = "nodes",
@@ -294,7 +298,8 @@ def make_solve_bulk_multi_sharded(mesh: Mesh, axis: str = "nodes",
 
     Returns solve(used0_sharded, avail_sharded, feas, aff, ask, k,
     seeds, cidx, cdelta, *, g) -> (new_used sharded, (G, N) int16
-    counts sharded on the node axis).
+    counts sharded on the node axis, (G,) int32 replicated all-gather
+    rounds per eval — the launch's collective cadence).
     """
     from functools import partial
 
@@ -310,7 +315,7 @@ def make_solve_bulk_multi_sharded(mesh: Mesh, axis: str = "nodes",
             mesh=mesh,
             in_specs=(P(axis, None), P(axis, None), P(None, axis),
                       P(None, axis), P(), P(), P(), P(), P()),
-            out_specs=(P(axis, None), P(None, axis)))
+            out_specs=(P(axis, None), P(None, axis), P()))
         return fn(used0, avail, feas, aff, ask, k, seeds, cidx, cdelta)
 
     return solve
@@ -345,13 +350,15 @@ def make_solve_batch_sharded(mesh: Mesh, axis: str = "nodes",
     Returns solve(used0_sharded, avail_sharded, feas, aff, ask, k,
     seeds, cidx, cdelta, *, g) -> (new_used sharded, (G, N) int16
     counts sharded on the node axis, (6,) f32 replicated info row with
-    the same layout as batch_solver.solve_batch).
+    the same layout as batch_solver.solve_batch, plus a replicated
+    int32 scalar counting the launch's all-gathers across every
+    portfolio arm and the greedy chain).
     """
     import jax.numpy as jnp
     from functools import partial
 
     from .batch_solver import (MAX_ROUNDS, PORTFOLIO, PRICE_EPS, TOP_R,
-                               _packing_score_xp)
+                               _pairwise_sum_xp)
     from .kernels import NEG, TIE_JITTER
 
     shard_map = _shard_map_nocheck()
@@ -382,10 +389,14 @@ def make_solve_batch_sharded(mesh: Mesh, axis: str = "nodes",
 
         # greedy arm: the distributed bulk fill from the same start
         # state (corrections already folded -> no-op slots)
-        used_g, counts_g = _bulk_shard_body(
+        used_g, counts_g, rounds_g = _bulk_shard_body(
             used0, avail, feas, aff, ask, k, seeds,
             jnp.zeros(1, jnp.int32), jnp.zeros((1, d), f),
             g=g, axis=axis, n_dev=n_dev, top_r=top_r)
+        # collective cadence of the whole launch: the greedy arm's
+        # per-eval gathers plus one gather per auction round per
+        # portfolio restart (accumulated below) — replicated math
+        gathers = jnp.sum(rounds_g)
 
         ask_pos = ask > 0
         aff_present = aff != 0.0
@@ -492,6 +503,19 @@ def make_solve_batch_sharded(mesh: Mesh, axis: str = "nodes",
         # chain mirrors batch_solver.solve_batch exactly — earliest
         # restart wins exact ties — so counts stay bit-identical to the
         # single-device path
+        def det_score(take2d, used_loc):
+            # bit-identical to the single-device _packing_score_xp:
+            # gather the per-node contributions and reduce over the
+            # GLOBAL node order with the same fixed pairwise tree. A
+            # psum of per-shard partial sums reassociates the float
+            # adds per mesh size, and a one-ulp score wobble is enough
+            # to flip a near-tied portfolio selection — breaking
+            # cross-mesh count parity
+            contrib = (take2d.sum(axis=0).astype(f)
+                       * fit_xp(jnp, avail, used_loc, False))  # (n_loc,)
+            return _pairwise_sum_xp(
+                jnp, jax.lax.all_gather(contrib, axis).reshape(-1))
+
         used_a = take = rnd = None
         score_a = placed_a = None
         for t, (jscale, ptemp) in enumerate(PORTFOLIO):
@@ -506,9 +530,11 @@ def make_solve_batch_sharded(mesh: Mesh, axis: str = "nodes",
             used_t, _, take_t, _, rnd_t, _ = jax.lax.while_loop(
                 cond, lambda st, j=jits, pe=PRICE_EPS * ptemp:
                 body(st, j, pe), init)
+            # +1 for the det_score gather (placed stays a psum: integer
+            # adds are associative, so it cannot wobble)
+            gathers = gathers + rnd_t + 1
             placed_t = jax.lax.psum(take_t.sum(), axis)
-            score_t = jax.lax.psum(
-                _packing_score_xp(jnp, take_t, avail, used_t), axis)
+            score_t = det_score(take_t, used_t)
             if t == 0:
                 used_a, take, rnd = used_t, take_t, rnd_t
                 score_a, placed_a = score_t, placed_t
@@ -523,9 +549,8 @@ def make_solve_batch_sharded(mesh: Mesh, axis: str = "nodes",
 
         # portfolio selection vs greedy on globally-reduced scores
         placed_g = jax.lax.psum(counts_g.astype(jnp.int32).sum(), axis)
-        score_g = jax.lax.psum(
-            _packing_score_xp(jnp, counts_g.astype(jnp.int32), avail,
-                              used_g), axis)
+        score_g = det_score(counts_g.astype(jnp.int32), used_g)
+        gathers = gathers + 1
         pick_a = (placed_a > placed_g) | (
             (placed_a == placed_g) & (score_a > score_g))
         used = jnp.where(pick_a, used_a, used_g)
@@ -534,14 +559,14 @@ def make_solve_batch_sharded(mesh: Mesh, axis: str = "nodes",
             score_a.astype(jnp.float32), score_g.astype(jnp.float32),
             placed_a.astype(jnp.float32), placed_g.astype(jnp.float32),
             rnd.astype(jnp.float32), pick_a.astype(jnp.float32)])
-        return used, counts, info
+        return used, counts, info, gathers
 
     @partial(jax.jit, static_argnames=("g",), donate_argnums=(0,))
     def solve(used0, avail, feas, aff, ask, k, seeds, cidx, cdelta,
               evict=None, net_prio=None, *, g: int):
         base_specs = (P(axis, None), P(axis, None), P(None, axis),
                       P(None, axis), P(), P(), P(), P(), P())
-        out = (P(axis, None), P(None, axis), P())
+        out = (P(axis, None), P(None, axis), P(), P())
         if evict is None:
             fn = shard_map(
                 partial(_joint_body, g=g), mesh=mesh,

@@ -209,6 +209,34 @@ class _LedgerEntry:
         self.born = born
 
 
+class _Inflight:
+    """One dispatched-but-unfetched launch: device handles for the
+    outputs plus everything the deferred fetch needs to register the
+    ledger entries, account stats, and resolve the workers' futures.
+    JAX dispatch is async — holding these handles costs nothing until
+    jax.device_get, which is the launch's ONLY host sync."""
+
+    __slots__ = ("rs", "static", "counts", "info", "gathers", "rounds",
+                 "joint", "sharded", "mesh_devices", "g", "resync",
+                 "t0", "t_dispatched")
+
+    def __init__(self, rs, static, counts, info, gathers, rounds, joint,
+                 sharded, mesh_devices, g, resync, t0, t_dispatched):
+        self.rs = rs
+        self.static = static
+        self.counts = counts        # (G, N) device handle
+        self.info = info            # (6,) device handle (joint) or None
+        self.gathers = gathers      # scalar device handle (joint+mesh)
+        self.rounds = rounds        # (G,) device handle (greedy+mesh)
+        self.joint = joint
+        self.sharded = sharded
+        self.mesh_devices = mesh_devices
+        self.g = g
+        self.resync = resync
+        self.t0 = t0                # perf_counter at dispatch start
+        self.t_dispatched = t_dispatched  # perf_counter at dispatch end
+
+
 class BulkSolverService:
     G_PAD = 16          # evals per launch (padded; k=0 rows are no-ops)
     MAX_K = 32767       # int16 counts ceiling per eval
@@ -247,17 +275,37 @@ class BulkSolverService:
                       "joint_launches": 0, "joint_solves": 0,
                       "auction_won": 0, "auction_rounds": 0,
                       "joint_score": 0.0, "greedy_score": 0.0,
-                      "compiles": 0, "retraces": 0}
+                      "compiles": 0, "retraces": 0,
+                      # pipeline telemetry: launches whose fetch was
+                      # deferred behind a newer dispatch, host time spent
+                      # off the fetch while a launch ran, device-window
+                      # time, and the sharded launches' collective count
+                      "pipelined": 0, "overlap_s": 0.0, "busy_s": 0.0,
+                      "allgathers": 0, "mesh_devices": 0}
         self._warm_shapes: set = set()
+        # double buffer: the one dispatched-but-unfetched launch. Only
+        # the service thread touches it. While it rides the device, the
+        # host resolves the PREVIOUS batch's futures (workers verify +
+        # commit their AllocBlocks) and collects/dispatches the next —
+        # the solve/apply overlap the c2m rung measures.
+        self._inflight: Optional["_Inflight"] = None
 
     def _resolve_mesh(self, n_pad: int):
         """Largest power-of-two device mesh that divides the padded node
-        axis, or None for single-device."""
+        axis, or None for single-device. NOMAD_TPU_MESH_DEVICES caps the
+        mesh (1 forces single-device) so bench sweeps and parity tests
+        can pin a size without re-execing under a different XLA device
+        count; resolved once per service instance."""
         if not self._mesh_resolved:
             self._mesh_resolved = True
+            import os
+
             import jax
 
             devs = jax.devices()
+            cap = int(os.environ.get("NOMAD_TPU_MESH_DEVICES", "0") or 0)
+            if cap > 0:
+                devs = devs[:cap]
             if len(devs) > 1:
                 from .sharding import (make_solve_batch_sharded,
                                        make_solve_bulk_multi_sharded,
@@ -267,6 +315,9 @@ class BulkSolverService:
                 self._mesh = node_mesh(devs[:n])
                 self._mesh_solve = make_solve_bulk_multi_sharded(self._mesh)
                 self._mesh_solve_joint = make_solve_batch_sharded(self._mesh)
+                with self._lock:
+                    self.stats["mesh_devices"] = n
+                REGISTRY.set_gauge("nomad.solver.mesh_devices", n)
         if self._mesh is None:
             return None
         n_dev = len(self._mesh.devices.reshape(-1))
@@ -359,8 +410,17 @@ class BulkSolverService:
         import time as _time
 
         while True:
-            req = self._q.get()
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                # queue drained: every worker that could feed the next
+                # batch may be blocked on the in-flight launch's futures
+                # — fetch it (resolving them) BEFORE parking on the
+                # queue, or the pipeline deadlocks on an empty queue
+                self._fetch_inflight()
+                req = self._q.get()
             if req is _STOP:
+                self._fetch_inflight()
                 self._retire()
                 self._drain_failed()
                 return
@@ -379,6 +439,10 @@ class BulkSolverService:
                                and r.batch_ctx.pending() > 0
                                for r in batch):
                         break
+                    # spend the hold productively: drain the in-flight
+                    # launch now so ITS workers verify/commit while the
+                    # rendezvous waits
+                    self._fetch_inflight()
                     if deadline is None:
                         deadline = _time.monotonic() + self.JOINT_WAIT_S
                     remain = deadline - _time.monotonic()
@@ -391,6 +455,7 @@ class BulkSolverService:
                 if nxt is _STOP:
                     self._retire()
                     self._flush(batch)
+                    self._fetch_inflight()
                     self._drain_failed()
                     return
                 batch.append(nxt)
@@ -419,15 +484,45 @@ class BulkSolverService:
             groups.setdefault((id(r.static), r.joint), []).append(r)
         for rs in groups.values():
             try:
-                self._solve_group(rs)
+                inflight = self._dispatch_group(rs)
             except Exception as e:  # propagate to every blocked worker
                 # the launch may have consumed (donated) the usage carry
                 # before failing — drop the state so the next solve
                 # resyncs instead of feeding a deleted buffer back in
                 self._state = None
+                # the PREVIOUS launch's outputs are independent buffers;
+                # drain it so its workers aren't stranded by our failure
+                self._fetch_inflight()
                 for r in rs:
                     if not r.future.done():
                         r.future.set_exception(e)
+                continue
+            # double buffer: fetch launch i only now that launch i+1 is
+            # queued behind it on the device — i's workers plan-verify
+            # and commit while the device solves i+1
+            self._fetch_inflight(pipelined=True)
+            self._inflight = inflight
+
+    def _fetch_inflight(self, pipelined: bool = False) -> None:
+        """Drain the one unfetched launch, if any: register its ledger
+        entries, account stats, resolve its workers' futures. Must run
+        before anything that rebuilds the carry from the ledger (resync,
+        static change, stop) — an unfetched launch has no entries yet,
+        so a base built without draining it would silently drop its
+        usage from the overlay."""
+        inf = self._inflight
+        if inf is None:
+            return
+        self._inflight = None
+        try:
+            self._fetch(inf, pipelined=pipelined)
+        except Exception as e:
+            # readback failed: the carry chained off this launch is
+            # suspect too — poison it so the next dispatch resyncs
+            self._state = None
+            for r in inf.rs:
+                if not r.future.done():
+                    r.future.set_exception(e)
 
     def _launch_guard(self, fn, shape_key):
         """no_retrace window + warmup accounting for one launch shape:
@@ -498,7 +593,14 @@ class BulkSolverService:
                 da[skey] = stacked
         return avail, stacked[0], stacked[1], g_pad
 
-    def _solve_group(self, rs: List[_Request]) -> None:
+    def _dispatch_group(self, rs: List[_Request]) -> "_Inflight":
+        """Build the launch inputs, ship them, and DISPATCH the solve —
+        returning device handles without syncing. JAX dispatch is async:
+        the returned _Inflight's outputs materialize while the host does
+        other work, and the chained usage carry (donated argument 0)
+        lets the NEXT dispatch queue behind this one device-side, so
+        launch order alone guarantees every solve sees its predecessor's
+        usage — never a stale carry — regardless of fetch timing."""
         from .kernels import solve_bulk_multi
 
         import jax
@@ -513,6 +615,17 @@ class BulkSolverService:
         if state is not None and state[0] is static:
             used_dev, since = state[1], state[2]
 
+        with self._lock:
+            need_resync = (used_dev is None
+                           or since >= self.RESYNC_SOLVES
+                           or len(self._corrections) > self.CORRECTIONS)
+        if need_resync:
+            # the resync base is committed usage + OPEN ledger entries.
+            # A still-unfetched launch has no entries yet — drain it
+            # first, or the rebuilt base silently drops its in-flight
+            # usage (double-booking burst at the next commit wave)
+            self._fetch_inflight()
+
         now = _time.time()
         with self._lock:
             # unconfirmed solves past the TTL belong to evals that died
@@ -522,9 +635,6 @@ class BulkSolverService:
                     if now - e.born > self.LEDGER_TTL]
             for t in dead:
                 del self._ledger[t]
-            need_resync = (used_dev is None
-                           or since >= self.RESYNC_SOLVES
-                           or len(self._corrections) > self.CORRECTIONS)
             if need_resync:
                 # exact rebuild: committed usage + still-in-flight solves
                 # (queued corrections target phantoms in the old carry —
@@ -537,8 +647,15 @@ class BulkSolverService:
                                         * e.ask[None, :])
                 corrections = []
             else:
-                corrections = self._corrections
-                self._corrections = []
+                # take at most one launch's worth: confirm() may have
+                # pushed past the cap while _fetch_inflight ran above
+                # (the pre-check and this take are separate lock holds
+                # now) — leftovers stay queued and trip the overflow
+                # pre-check on the NEXT dispatch, which resyncs after
+                # draining the inflight launch instead of silently
+                # dropping corrections here
+                corrections = self._corrections[:self.CORRECTIONS]
+                self._corrections = self._corrections[self.CORRECTIONS:]
         if need_resync:
             if mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -570,59 +687,121 @@ class BulkSolverService:
             seeds[i] = r.seed
 
         joint = rs[0].joint
-        info_np = None
+        info = gathers = rounds = None
+        n_dev = 0 if mesh is None else len(mesh.devices.reshape(-1))
         if mesh is None:
             # explicit shipment of the per-batch host rows so the
             # no_retrace transfer guard can outlaw every IMPLICIT
             # transfer inside the launch window
             ask, k, tgc, seeds, cidx, cdelta = jax.device_put(
                 (ask, k, tgc, seeds, cidx, cdelta))
+        else:
+            # explicit REPLICATED shipment: a bare device_put here would
+            # hand the sharded jit uncommitted single-device arrays —
+            # the committed-vs-bare cache fork (one graph per layout) —
+            # and letting the launch ship them implicitly is exactly
+            # what the transfer guard below outlaws on the warm path
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(mesh, P())
+            ask, k, seeds, cidx, cdelta = (
+                jax.device_put(x, rep)
+                for x in (ask, k, seeds, cidx, cdelta))
         if joint and mesh is None:
             from .batch_solver import solve_batch
-            from .jit_guard import no_retrace
 
             with self._launch_guard(solve_batch,
                                     ("joint", g_pad, static.n_pad, d)):
                 new_used, counts, info = solve_batch(
                     used_dev, avail, feas, aff, ask, k, tgc, seeds,
                     cidx, cdelta, g=g_pad)
-                # ONE readback for the whole batch (counts + info row)
-                counts_np, info_np = jax.device_get((counts, info))
         elif joint:
-            new_used, counts, info = self._mesh_solve_joint(
-                used_dev, avail, feas, aff, ask, k, seeds, cidx, cdelta,
-                g=g_pad)
-            counts_np, info_np = jax.device_get((counts, info))
+            with self._launch_guard(
+                    self._mesh_solve_joint,
+                    ("joint-sh", g_pad, static.n_pad, d, n_dev)):
+                new_used, counts, info, gathers = self._mesh_solve_joint(
+                    used_dev, avail, feas, aff, ask, k, seeds, cidx,
+                    cdelta, g=g_pad)
         elif mesh is not None:
-            new_used, counts = self._mesh_solve(
-                used_dev, avail, feas, aff, ask, k, seeds, cidx, cdelta,
-                g=g_pad)
-            counts_np = np.asarray(counts)  # ONE readback for the batch
+            with self._launch_guard(
+                    self._mesh_solve,
+                    ("greedy-sh", g_pad, static.n_pad, d, n_dev)):
+                new_used, counts, rounds = self._mesh_solve(
+                    used_dev, avail, feas, aff, ask, k, seeds, cidx,
+                    cdelta, g=g_pad)
         else:
             with self._launch_guard(solve_bulk_multi,
                                     ("greedy", g_pad, static.n_pad, d)):
                 new_used, counts = solve_bulk_multi(
                     used_dev, avail, feas, aff, ask, k, tgc, seeds, cidx,
                     cdelta, g=g_pad)
-                # ONE readback for the batch
-                counts_np = jax.device_get(counts)
         self._state = (static, new_used, since + g)
-        born = _time.time()
-        # trace-less batch span (the service thread serves many evals at
-        # once); chain gap-attribution picks it up by time overlap, like
-        # the raft spans
-        TRACER.add_span("solver.launch", born - (_time.perf_counter() - t0),
-                        born, g=g, joint=bool(joint),
-                        sharded=mesh is not None)
+        t1 = _time.perf_counter()
+        if mesh is not None:
+            # dispatch-side span: the sharded launch is queued, the host
+            # keeps running — the solve/apply overlap window opens here
+            wall = _time.time()
+            TRACER.add_span("solver.shard", wall - (t1 - t0), wall,
+                            g=g, joint=bool(joint), mesh_devices=n_dev)
         RECORDER.record("solver", "launch", g=g, joint=bool(joint),
                         sharded=mesh is not None, resync=need_resync)
+        return _Inflight(rs=rs, static=static, counts=counts, info=info,
+                         gathers=gathers, rounds=rounds, joint=joint,
+                         sharded=mesh is not None, mesh_devices=n_dev,
+                         g=g, resync=need_resync, t0=t0, t_dispatched=t1)
+
+    def _fetch(self, inf: "_Inflight", pipelined: bool = False) -> None:
+        """The launch's ONLY host sync: read the counts (+ info/gather
+        stats) back, register ledger entries, account stats, resolve the
+        workers' futures. Everything between dispatch and this call is
+        host time the device solve ran under — the overlap the
+        nomad.solver.overlap_occupancy gauge reports."""
+        import jax
+        import time as _time
+
+        g = inf.g
+        t_f0 = _time.perf_counter()
+        handles = [h for h in (inf.counts, inf.info, inf.gathers,
+                               inf.rounds) if h is not None]
+        got = list(jax.device_get(handles))
+        counts_np = got.pop(0)
+        info_np = got.pop(0) if inf.info is not None else None
+        gathers_np = got.pop(0) if inf.gathers is not None else None
+        rounds_np = got.pop(0) if inf.rounds is not None else None
+        t_f1 = _time.perf_counter()
+        born = _time.time()
+        allg = 0
+        if gathers_np is not None:
+            allg = int(gathers_np)
+        elif rounds_np is not None:
+            allg = int(rounds_np[:g].sum())
+        overlap = max(0.0, t_f0 - inf.t_dispatched)
+        busy = max(0.0, t_f1 - inf.t_dispatched)
+        # trace-less batch spans (the service thread serves many evals
+        # at once); chain gap-attribution picks them up by time overlap,
+        # like the raft spans
+        TRACER.add_span("solver.launch", born - (t_f1 - inf.t0), born,
+                        g=g, joint=bool(inf.joint), sharded=inf.sharded,
+                        pipelined=pipelined)
+        if inf.sharded:
+            TRACER.add_span("solver.allgather", born - (t_f1 - t_f0),
+                            born, gathers=allg,
+                            per_eval=allg / max(g, 1))
         with self._lock:
             # counters share self._lock with the ledger: solve()/confirm()
             # mutate stats from API threads under the same lock
             self.stats["launches"] += 1
             self.stats["solves"] += g
-            self.stats["launch_s"] += _time.perf_counter() - t0
-            if mesh is not None:
+            # host cost only: dispatch + fetch, NOT the device wait a
+            # pipelined launch absorbed while the host worked elsewhere
+            self.stats["launch_s"] += ((inf.t_dispatched - inf.t0)
+                                       + (t_f1 - t_f0))
+            self.stats["overlap_s"] += overlap
+            self.stats["busy_s"] += busy
+            self.stats["allgathers"] += allg
+            if pipelined:
+                self.stats["pipelined"] += 1
+            if inf.sharded:
                 self.stats["sharded"] += 1
             if info_np is not None:
                 self.stats["joint_launches"] += 1
@@ -632,18 +811,24 @@ class BulkSolverService:
                 self.stats["joint_score"] += float(
                     info_np[0] if info_np[5] > 0.5 else info_np[1])
                 self.stats["greedy_score"] += float(info_np[1])
-            for i, r in enumerate(rs):
+            for i, r in enumerate(inf.rs):
                 row = counts_np[i]
                 idx = np.nonzero(row)[0]
                 self._token += 1
                 r.token = self._token
                 self._ledger[r.token] = _LedgerEntry(
-                    static, idx, row[idx].astype(np.int64), r.ask, born)
+                    inf.static, idx, row[idx].astype(np.int64), r.ask,
+                    born)
+            occupancy = (self.stats["overlap_s"] / self.stats["busy_s"]
+                         if self.stats["busy_s"] > 0 else 0.0)
         # mirror the service stats into the Registry so /v1/metrics and
         # bench dumps carry them without reaching into the singleton
         # (REGISTRY is a leaf lock — taken after self._lock is dropped)
         REGISTRY.incr("nomad.solver.launches")
         REGISTRY.incr("nomad.solver.solves", g)
+        if allg:
+            REGISTRY.incr("nomad.solver.allgathers", allg)
+        REGISTRY.set_gauge("nomad.solver.overlap_occupancy", occupancy)
         if info_np is not None:
             REGISTRY.incr("nomad.solver.auction_won",
                           int(info_np[5] > 0.5))
@@ -651,7 +836,7 @@ class BulkSolverService:
             REGISTRY.incr("nomad.solver.joint_score", float(
                 info_np[0] if info_np[5] > 0.5 else info_np[1]))
             REGISTRY.incr("nomad.solver.greedy_score", float(info_np[1]))
-        for i, r in enumerate(rs):
+        for i, r in enumerate(inf.rs):
             r.future.set_result(counts_np[i].astype(np.int64))
 
 

@@ -29,6 +29,11 @@
 #                                   parked on all 3 replicas across a
 #                                   leader crash; survivors wake
 #                                   consistent, dead server fails fast)
+#   scripts/check.sh --mesh-smoke   also run the multi-chip C2M smoke
+#                                   (live 3-node cluster, solver on an
+#                                   8-virtual-device mesh: sharded
+#                                   joint launches, zero retraces,
+#                                   alloc uniqueness on every replica)
 set -u
 cd "$(dirname "$0")/.."
 
@@ -38,6 +43,7 @@ run_trace_smoke=0
 run_snap_smoke=0
 run_swarm_smoke=0
 run_watch_smoke=0
+run_mesh_smoke=0
 for arg in "$@"; do
     case "$arg" in
         --e2e-smoke) run_e2e_smoke=1 ;;
@@ -46,6 +52,7 @@ for arg in "$@"; do
         --snap-smoke) run_snap_smoke=1 ;;
         --swarm-smoke) run_swarm_smoke=1 ;;
         --watch-smoke) run_watch_smoke=1 ;;
+        --mesh-smoke) run_mesh_smoke=1 ;;
         *) echo "unknown flag: $arg" >&2; exit 64 ;;
     esac
 done
@@ -179,6 +186,19 @@ if [ "$run_watch_smoke" = 1 ]; then
     echo "== watch smoke (python -m nomad_tpu.chaos --watch-smoke) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 300 \
         python -m nomad_tpu.chaos --watch-smoke || failed=1
+fi
+
+# multi-chip C2M smoke (opt-in, ~40s): the live 3-node pipeline with
+# the solver service on the 8-virtual-device mesh — batched workers
+# under tpu-solve must drive node-sharded joint launches (live
+# all-gather accounting, zero warm retraces), every placement lands,
+# and alloc-set uniqueness + safety invariants hold on every replica
+# (PERF.md "Multi-chip C2M")
+if [ "$run_mesh_smoke" = 1 ]; then
+    echo "== mesh smoke (python -m nomad_tpu.chaos --mesh-smoke) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+        timeout 300 python -m nomad_tpu.chaos --mesh-smoke || failed=1
 fi
 
 echo "== tier-1 tests =="

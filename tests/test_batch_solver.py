@@ -268,11 +268,12 @@ def test_solve_batch_sharded_parity():
     mesh = node_mesh()
     solve_sh = make_solve_batch_sharded(mesh)
     sh = NamedSharding(mesh, P("nodes", None))
-    used_m, counts_m, info_m = solve_sh(
+    used_m, counts_m, info_m, gathers_m = solve_sh(
         jax.device_put(used0, sh), jax.device_put(avail, sh),
         jnp.asarray(feas), jnp.asarray(aff), jnp.asarray(ask),
         jnp.asarray(k), jnp.asarray(seeds), jnp.asarray(cidx),
         jnp.asarray(cdelta), g=g)
+    assert int(np.asarray(gathers_m)) > 0
 
     np.testing.assert_array_equal(np.asarray(counts_m),
                                   np.asarray(counts_1))

@@ -514,7 +514,7 @@ def test_sharded_twin_parity_with_victim_columns():
     mesh = node_mesh()
     solve_sh = make_solve_batch_sharded(mesh)
     sh = NamedSharding(mesh, P("nodes", None))
-    used_m, counts_m, info_m = solve_sh(
+    used_m, counts_m, info_m, _ = solve_sh(
         jax.device_put(used0, sh), jax.device_put(avail, sh),
         jnp.asarray(feas), jnp.asarray(aff), jnp.asarray(ask),
         jnp.asarray(k), jnp.asarray(seeds), jnp.asarray(cidx),
