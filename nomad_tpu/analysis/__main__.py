@@ -42,6 +42,9 @@ def main(argv=None) -> int:
     parser.add_argument("--ownership", action="store_true",
                         help="run only the nomadown ownership/aliasing "
                              "rules (see ANALYSIS.md)")
+    parser.add_argument("--tensor", action="store_true",
+                        help="run only the nomadjit tensor determinism/"
+                             "launch-discipline rules (see ANALYSIS.md)")
     parser.add_argument("--modelcheck", action="store_true",
                         help="run the deterministic interleaving model "
                              "checker (nomadcheck dynamic prong) and exit")
@@ -54,6 +57,10 @@ def main(argv=None) -> int:
     if args.ownership:
         from .rules_ownership import OWNERSHIP_RULES
         args.rules = list(OWNERSHIP_RULES)
+
+    if args.tensor:
+        from .rules_tensor import TENSOR_RULES
+        args.rules = (args.rules or []) + list(TENSOR_RULES)
 
     if args.modelcheck:
         from .modelcheck import seed_from_env, smoke

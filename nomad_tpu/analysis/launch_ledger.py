@@ -1,0 +1,348 @@
+"""nomadjit runtime prong: the launch ledger.
+
+The static rules (rules_tensor.py) prove hazard *shapes* absent; this
+module watches the real launches. Enabled via ``NOMAD_TPU_SAN=1`` (the
+pytest plugin in tests/conftest.py calls :func:`install` before any
+nomad_tpu module is imported), it
+
+- registers a ``jax.monitoring`` duration listener for backend compiles
+  (the event fires once per cold XLA compile and never on a warm cache
+  hit — empirically the only public warm/cold signal), attributing each
+  compile to the nearest non-jax stack frame and to the launch window
+  open on that thread, if any;
+- patches ``jax.device_put`` / ``jax.device_get`` with recording
+  wrappers: these are the repo's SANCTIONED transfer sites (solver.py
+  documents device_get as "the launch's ONLY host sync"), and every
+  call lands in the ledger with call-site attribution;
+- exposes :func:`window` — the per-launch ledger entry. Launch drivers
+  (``solver._launch_guard``, ``placer._warm_launch``) open one window
+  per launch, marked ``warm`` once the shape key has compiled. A
+  compile inside a warm window and a second ``device_get`` inside any
+  window are recorded as violations — the whole-suite generalization of
+  the opt-in ``jit_guard.no_retrace`` discipline.
+
+Known soundness limits (documented, deliberate):
+
+- on CPU backends ``np.asarray(device_array)`` reads back through the
+  buffer protocol, bypassing ``__array__`` and the transfer guard
+  entirely (host and device share memory) — no runtime hook can see it.
+  The static ``host-sync-in-launch`` rule covers those sites by name;
+- implicit host->device transfers outside a guard window dispatch
+  through C++ with no Python boundary to patch; inside warm windows
+  ``jit_guard.no_retrace`` arms ``jax.transfer_guard("disallow")`` and
+  reports each trip here via :func:`note_unsanctioned` before
+  re-raising, so ``stats["unsanctioned_transfers"]`` is the count of
+  transfers that escaped the sanctioned sites where detection is
+  possible.
+
+Violations never raise at the launch site (raising inside a monitoring
+callback would corrupt the launch under test); they accumulate in
+``LaunchLedger.violations`` and the pytest plugin fails the run at
+session end (exit 3, same as nomadsan). The chaos
+``InvariantChecker.check_launch_ledger`` sweep and the ``tensor_launch``
+modelcheck scenario read the same instance. Tests can build private
+:class:`LaunchLedger` instances so assertions don't pollute the global
+run state.
+"""
+
+from __future__ import annotations
+
+import _thread
+import sys
+import threading
+import traceback
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Deque, Dict, Iterator, List, Optional
+
+_REAL_LOCK = _thread.allocate_lock
+
+# the monitoring event XLA fires once per backend compile (verified: no
+# emission on warm cache hits, one per cold jit specialization)
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+# bounded attribution: the ledger keeps the last N launch records and at
+# most M attributed sites per record — enough to diagnose, never enough
+# to leak memory on a long soak
+MAX_RECORDS = 256
+MAX_SITES = 16
+
+_SKIP_FILES = (__file__, "threading.py", "contextlib.py")
+_SKIP_DIRS = ("/jax/", "/jaxlib/", "/jax_plugins/")
+
+
+def _call_site(extra_skip: int = 0) -> str:
+    """file:line of the nearest frame outside the ledger and jax."""
+    try:
+        f = sys._getframe(2 + extra_skip)
+    except ValueError:
+        return "<unknown>"
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.endswith(_SKIP_FILES) and not any(
+                d in fn for d in _SKIP_DIRS):
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+@dataclass
+class Violation:
+    kind: str            # "warm-compile" | "extra-host-sync" | "unsanctioned-transfer"
+    message: str
+    stack: List[str] = field(default_factory=list)
+
+    def render(self) -> str:
+        return f"[{self.kind}] {self.message}"
+
+
+@dataclass
+class LaunchRecord:
+    """One launch window's ledger entry."""
+    name: str                     # launched kernel, e.g. "preempt_solve"
+    key: object = None            # shape key the driver warms on
+    warm: bool = False
+    compiles: int = 0
+    puts: int = 0
+    gets: int = 0
+    sites: List[str] = field(default_factory=list)
+    open: bool = True
+
+    def note(self, site: str) -> None:
+        if len(self.sites) < MAX_SITES:
+            self.sites.append(site)
+
+
+class LaunchLedger:
+    """One compile/transfer ledger. The module-level GLOBAL instance is
+    what install()/the launch drivers feed; tests build private ones."""
+
+    def __init__(self):
+        self.active = False
+        # raw lock: monitoring callbacks can fire under an instrumented
+        # sanitizer lock and must not feed back into its order graph
+        self._ilock = _REAL_LOCK()
+        self._tls = threading.local()
+        self.records: Deque[LaunchRecord] = deque(maxlen=MAX_RECORDS)
+        self.violations: List[Violation] = []
+        self.stats: Dict[str, int] = {
+            "compiles": 0, "device_puts": 0, "device_gets": 0,
+            "windows": 0, "warm_windows": 0, "unsanctioned_transfers": 0}
+        self._listener_registered = False
+        self._orig_put = None
+        self._orig_get = None
+
+    # -- global patching ----------------------------------------------
+
+    def install(self) -> None:
+        """Arm the compile listener and wrap the sanctioned transfer
+        sites. Listener registration is once-per-process (jax exposes no
+        deregistration that spares other listeners) and gated on
+        ``active``, so uninstall() is still a clean revert."""
+        if self.active:
+            return
+        import jax
+
+        self.active = True
+        if not self._listener_registered:
+            jax.monitoring.register_event_duration_secs_listener(
+                self._on_event_duration)
+            self._listener_registered = True
+        self._orig_put = jax.device_put
+        self._orig_get = jax.device_get
+        orig_put, orig_get = self._orig_put, self._orig_get
+        ledger = self
+
+        def device_put(*args, **kwargs):
+            ledger._record_transfer("device_puts", _call_site())
+            return orig_put(*args, **kwargs)
+
+        def device_get(*args, **kwargs):
+            ledger._record_transfer("device_gets", _call_site())
+            return orig_get(*args, **kwargs)
+
+        device_put.__name__ = "device_put"
+        device_put.__doc__ = orig_put.__doc__
+        device_get.__name__ = "device_get"
+        device_get.__doc__ = orig_get.__doc__
+        jax.device_put = device_put
+        jax.device_get = device_get
+
+    def uninstall(self) -> None:
+        if not self.active:
+            return
+        import jax
+
+        self.active = False
+        if self._orig_put is not None:
+            jax.device_put = self._orig_put
+            jax.device_get = self._orig_get
+
+    # -- signal intake -------------------------------------------------
+
+    def _on_event_duration(self, event: str, duration: float,
+                           **kwargs) -> None:
+        if not self.active or event != COMPILE_EVENT:
+            return
+        site = _call_site()
+        win = self._current()
+        with self._ilock:
+            self.stats["compiles"] += 1
+        if win is None:
+            return
+        win.compiles += 1
+        win.note(f"compile@{site}")
+        if win.warm:
+            with self._ilock:
+                self.violations.append(Violation(
+                    "warm-compile",
+                    f"XLA compile inside warm launch window "
+                    f"'{win.name}' (key={win.key!r}) at {site} — the "
+                    "shape was promised compiled; an argument's "
+                    "shape/dtype/weak-type drifted on the hot path",
+                    stack=traceback.format_stack()[:-2]))
+
+    def _record_transfer(self, kind: str, site: str) -> None:
+        if not self.active:
+            return
+        with self._ilock:
+            self.stats[kind] += 1
+        win = self._current()
+        if win is None:
+            return
+        if kind == "device_puts":
+            win.puts += 1
+            win.note(f"put@{site}")
+            return
+        win.gets += 1
+        win.note(f"get@{site}")
+        if win.gets == 2:
+            with self._ilock:
+                self.violations.append(Violation(
+                    "extra-host-sync",
+                    f"second jax.device_get inside launch window "
+                    f"'{win.name}' at {site} — a launch gets ONE host "
+                    "sync (solver.py launch contract)",
+                    stack=traceback.format_stack()[:-2]))
+
+    def note_unsanctioned(self, where: str) -> None:
+        """A transfer guard tripped on an implicit transfer inside a
+        guarded window (jit_guard reports it here before re-raising)."""
+        if not self.active:
+            return
+        with self._ilock:
+            self.stats["unsanctioned_transfers"] += 1
+            self.violations.append(Violation(
+                "unsanctioned-transfer",
+                f"implicit host<->device transfer inside {where} — bytes "
+                "moved outside the sanctioned device_put/device_get "
+                "sites",
+                stack=traceback.format_stack()[:-2]))
+
+    # -- per-launch windows -------------------------------------------
+
+    def _stack(self) -> List[LaunchRecord]:
+        stack = getattr(self._tls, "windows", None)
+        if stack is None:
+            stack = self._tls.windows = []
+        return stack
+
+    def _current(self) -> Optional[LaunchRecord]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    @contextmanager
+    def window(self, name: str, key: object = None,
+               warm: bool = False) -> Iterator[Optional[LaunchRecord]]:
+        """Open one per-launch ledger entry on this thread. Compiles and
+        sanctioned transfers that occur inside attribute to it; a warm
+        window recording a compile is a violation."""
+        if not self.active:
+            yield None
+            return
+        rec = LaunchRecord(name=name, key=key, warm=warm)
+        with self._ilock:
+            self.stats["windows"] += 1
+            if warm:
+                self.stats["warm_windows"] += 1
+            self.records.append(rec)
+        self._stack().append(rec)
+        try:
+            yield rec
+        finally:
+            self._stack().pop()
+            rec.open = False
+
+    # -- reporting -----------------------------------------------------
+
+    def verify_all(self, strict: bool = False) -> List[str]:
+        """Rendered violations — the chaos invariant sweep's view of the
+        ledger. With ``strict`` (callers that KNOW every launch thread
+        has quiesced, e.g. the modelcheck scenario after joining), a
+        window still open is a leak and reported too; the default sweep
+        runs concurrently with live workers, where an open window on
+        another thread is just a launch in flight."""
+        out = [v.render() for v in self.violations]
+        if strict:
+            with self._ilock:
+                leaked = [r for r in self.records if r.open]
+            for r in leaked:
+                out.append(f"[leaked-window] launch window '{r.name}' "
+                           f"(key={r.key!r}) never closed")
+        return out
+
+    def check(self) -> None:
+        if self.violations:
+            raise AssertionError(
+                "nomadjit violations:\n"
+                + "\n".join(v.render() for v in self.violations))
+
+    def report(self) -> str:
+        s = self.stats
+        lines = [
+            f"nomadjit: {len(self.violations)} violation(s); "
+            f"compiles={s['compiles']} device_puts={s['device_puts']} "
+            f"device_gets={s['device_gets']} windows={s['windows']} "
+            f"(warm={s['warm_windows']}) "
+            f"unsanctioned_transfers={s['unsanctioned_transfers']}"]
+        for v in self.violations:
+            lines.append("  " + v.render())
+        return "\n".join(lines)
+
+
+# -- module-level surface (what launch drivers + conftest import) --------
+
+GLOBAL = LaunchLedger()
+
+
+def install() -> None:
+    GLOBAL.install()
+
+
+def uninstall() -> None:
+    GLOBAL.uninstall()
+
+
+def enabled() -> bool:
+    return GLOBAL.active
+
+
+@contextmanager
+def window(name: str, key: object = None,
+           warm: bool = False) -> Iterator[Optional[LaunchRecord]]:
+    """Per-launch ledger window on the GLOBAL ledger (no-op while the
+    sanitizer switch is off — launch drivers call this unconditionally)."""
+    with GLOBAL.window(name, key=key, warm=warm) as rec:
+        yield rec
+
+
+def note_unsanctioned(where: str) -> None:
+    GLOBAL.note_unsanctioned(where)
+
+
+def violations() -> List[Violation]:
+    return list(GLOBAL.violations)
+
+
+def check() -> None:
+    GLOBAL.check()

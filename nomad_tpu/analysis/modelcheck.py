@@ -883,6 +883,9 @@ def scenario(name: str):
     return register
 
 
+_JAX_COMPILE_PATH_WARM = False
+
+
 def _preload() -> None:
     """Import every module the scenarios touch BEFORE the patch window:
     module-level locks (logging, concurrent.futures internals) must be
@@ -899,7 +902,24 @@ def _preload() -> None:
     from ..raft import durable, fsm, node, transport  # noqa: F401
     from ..state import store, watch  # noqa: F401
     from ..structs import evaluation  # noqa: F401
-    from . import ownership  # noqa: F401
+    from ..tensor import jit_guard, placer  # noqa: F401  (module locks)
+    from . import launch_ledger, ownership  # noqa: F401
+
+    # jax imports big chunks of its compile path lazily on the FIRST
+    # compile (jax._src.compilation_cache among them, whose module-level
+    # _cache_initialized_mutex would otherwise be born inside the patch
+    # window as a cooperative lock and deadlock against XLA's own C++
+    # compile serialization). One throwaway compile here forces every
+    # lazy import and lock on that path into existence as real OS
+    # primitives; per-process, so repeat runs pay nothing.
+    global _JAX_COMPILE_PATH_WARM
+    if not _JAX_COMPILE_PATH_WARM:
+        import jax
+        import numpy as np
+
+        from jax._src import compilation_cache  # noqa: F401
+        jax.jit(lambda a: a + 0.0)(np.float32(0.0)).block_until_ready()
+        _JAX_COMPILE_PATH_WARM = True
     assert concurrent.futures.ThreadPoolExecutor is not None
 
 
@@ -1851,10 +1871,123 @@ def _scenario_node_lifecycle(env: ScenarioEnv) -> None:
         mgr.set_enabled(False)
 
 
+@scenario("tensor_launch")
+def _scenario_tensor_launch(env: ScenarioEnv) -> None:
+    """nomadjit integration: the main task cold-launches each shape
+    through placer._warm_launch (the real launch driver), then two
+    racing workers hammer the warmed shapes under adversarial
+    interleavings. Cold compiles stay on the main task deliberately:
+    XLA serializes concurrent compiles behind C++ mutexes the scheduler
+    cannot see, so a parked cooperative task mid-compile would wedge a
+    peer blocked in native code. Warm launches take jit's C++ cache-hit
+    fast path and are safe to race. Asserts: the cold launch of each
+    shape attributes >= 1 compile to its ledger window, warm windows
+    record ZERO compiles and exactly one host sync each, a quiesced
+    strict sweep reports no leaked windows, and the violation list
+    stays empty. A final leg opens a deliberately warm-marked window
+    around an uncompiled shape and asserts the warm-compile violation
+    IS recorded (then scrubs it) — the detector must be live, not
+    vacuously green."""
+    import jax
+    import numpy as np
+
+    from ..tensor.placer import _warm_launch
+    from . import launch_ledger
+
+    ledger = launch_ledger.GLOBAL
+    was_active = ledger.active
+    if not was_active:
+        launch_ledger.install()
+    base = len(ledger.violations)
+    tag = f"mc_launch_{env.seed}"
+
+    def kernel(a):
+        return a * 2.0 + 1.0
+
+    f = jax.jit(kernel)
+    f.__name__ = tag
+    warm: set = set()
+    shapes = [(4 + (env.seed % 3),), (9 + (env.seed % 3),)]
+    errors: List[str] = []
+
+    def launch(shape) -> object:
+        dev = jax.device_put(np.ones(shape, np.float32))
+        with _warm_launch(f, shape, warm):
+            return jax.device_get(f(dev))
+
+    def worker(name: str) -> None:
+        try:
+            for _ in range(3):
+                for shape in shapes:
+                    if launch(shape).shape != shape:
+                        errors.append(f"{name}: bad launch result")
+                    time.sleep(0)
+        except Exception as e:  # surfaced after join
+            errors.append(f"{name}: {type(e).__name__}: {e}")
+
+    try:
+        for shape in shapes:       # cold, main task only (see docstring)
+            if launch(shape).shape != shape:
+                raise AssertionError("bad cold launch result")
+        if set(shapes) - warm:
+            raise AssertionError(
+                f"cold launches left shapes unwarmed: {set(shapes) - warm}")
+        t1 = threading.Thread(target=worker, args=("w1",),
+                              name="launch-w1")
+        t2 = threading.Thread(target=worker, args=("w2",),
+                              name="launch-w2")
+        t1.start()
+        t2.start()
+        t1.join()
+        t2.join()
+        if errors:
+            raise AssertionError("; ".join(errors))
+        mine = [r for r in ledger.records if r.name == tag]
+        if not mine:
+            raise AssertionError("no ledger records for the launches")
+        cold_compiles = sum(r.compiles for r in mine if not r.warm)
+        if cold_compiles < len(shapes):
+            raise AssertionError(
+                f"cold launches attributed only {cold_compiles} "
+                f"compile(s) for {len(shapes)} shapes — the compile "
+                "listener is not feeding the ledger")
+        for r in mine:
+            if r.warm and r.compiles:
+                raise AssertionError(
+                    f"warm window {r.key!r} recorded {r.compiles} "
+                    f"compile(s): {r.sites}")
+            if r.gets != 1:
+                raise AssertionError(
+                    f"launch window {r.key!r} recorded {r.gets} host "
+                    f"syncs, want exactly 1: {r.sites}")
+        problems = ledger.verify_all(strict=True)
+        fresh = ledger.violations[base:]
+        if fresh or problems:
+            raise AssertionError(
+                "launch ledger tripped on a clean schedule: "
+                + (fresh[0].render() if fresh else problems[0]))
+        # negative leg: a warm-marked window around a cold shape MUST
+        # record the warm-compile violation
+        g = jax.jit(kernel)
+        g.__name__ = tag + "_neg"
+        dev = jax.device_put(np.ones((17,), np.float32))
+        with ledger.window(g.__name__, key=(17,), warm=True):
+            jax.device_get(g(dev))
+        fresh = ledger.violations[base:]
+        if not any(v.kind == "warm-compile" for v in fresh):
+            raise AssertionError(
+                "warm-compile detector is dead: a compile inside a "
+                "warm-marked window recorded no violation")
+    finally:
+        del ledger.violations[base:]
+        if not was_active:
+            launch_ledger.uninstall()
+
+
 SMOKE_SCENARIOS = ("raft_commit", "raft_stepdown", "read_index",
                    "snapshot_compact",
                    "plan_pipeline", "broker_batch", "solve_batch",
-                   "store_ownership", "node_lifecycle")
+                   "store_ownership", "node_lifecycle", "tensor_launch")
 
 
 def smoke(base_seed: int, seeds_per_scenario: int = 3,

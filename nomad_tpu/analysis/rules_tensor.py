@@ -1,0 +1,740 @@
+"""nomadjit static prong: tensor-layer determinism / launch-discipline rules.
+
+The solver tier's correctness contract is threefold — cross-mesh
+bit-exactness, zero warm-path retraces, one host sync per launch — and
+each clause has a statically detectable violation shape:
+
+- ``reassociable-reduction-feeds-selection``: a float ``.sum()`` /
+  ``jnp.sum`` / ``lax.psum`` whose result flows into ``argmax`` / a
+  comparison / a ``where``/``select`` predicate inside a jitted graph
+  (or a helper it calls).  XLA re-associates plain reductions per fusion
+  context, so the same contributions summed in two compiled graphs
+  (single-device vs mesh-sharded) can differ in the last ulp — enough to
+  flip a near-tied portfolio selection (the PR 14 determinism bug, fixed
+  by routing through the fixed-tree ``_pairwise_sum_xp``).  Integer
+  reductions are associative and stay legal when the int dtype is
+  visible (``dtype=jnp.int32`` / ``.astype(jnp.int32)``).
+- ``host-sync-in-launch``: launch drivers (solver.py / placer.py) own
+  the "ONE host sync per launch" contract: duplicated
+  ``jax.device_get`` sites for the same launch, ``.item()``-style syncs
+  in launch functions, and ``np.asarray(<jitted call>)`` readbacks
+  (implicit device->host transfers the CPU-backend transfer guard
+  cannot see — host and device share memory there) are all flagged.
+- ``retrace-hazard``: Python ``for range()`` bounds, slice bounds, or
+  shape-constructor arguments derived from traced (non-static) args of
+  a jitted function — each new value re-traces; the static complement
+  to ``jit_guard.no_retrace``.
+- ``unguarded-launch``: a call to a jit-compiled kernel from solver.py /
+  placer.py outside any ``no_retrace`` / ``_launch_guard`` /
+  ``_warm_launch`` window, and a bare ``jax.device_put`` (no sharding)
+  in a mesh-aware function outside a mesh-conditional branch (a bare
+  put hands the sharded jit uncommitted arrays — the committed-vs-bare
+  cache fork).
+- ``prng-key-reuse``: one ``PRNGKey`` consumed by two sampling calls
+  without ``split``/``fold_in``, or a loop-invariant key constructed
+  inside a loop — every restart slot / auction round would replay the
+  same stream.
+
+Scope: tensor/ inside the package (host-sync/unguarded-launch further
+restrict to solver.py/placer.py); everywhere in standalone fixture
+trees.  Suppress deliberate exceptions in-line with ``# san-ok:
+<reason>`` — findings are otherwise fixed in code, never baselined
+(ANALYSIS.md "nomadjit").
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import AnalysisContext, Finding, Module, in_scope, rule
+from .rules_concurrency import _suppressed
+from .rules_jax import (_jit_decoration, _jitted_functions, _param_names,
+                        _traced_uses)
+
+SCOPE = ("tensor",)
+LAUNCH_FILES = ("solver.py", "placer.py")
+
+# wrappers whose function-name arguments run as device code
+JIT_WRAPPERS = {"jit", "shard_map", "pmap"}
+# context-manager factories that establish a guarded launch window
+GUARD_NAMES = {"no_retrace", "_launch_guard", "_warm_launch"}
+# helpers implementing a fixed-association reduction tree: calls to (or
+# through) these are the blessed way to reduce floats feeding selection
+PAIRWISE_TOKEN = "pairwise"
+SELECTORS = {"argmax", "argmin", "top_k"}
+PREDICATED = {"where", "select"}        # only args[0] (the predicate) selects
+NUMPY_ALIASES = {"np", "numpy", "onp"}
+SHAPE_FNS = {"zeros", "ones", "full", "empty", "arange", "eye",
+             "linspace", "broadcast_to", "tile", "reshape"}
+SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+KEY_CONSUMERS = {"uniform", "normal", "randint", "permutation",
+                 "bernoulli", "choice", "gumbel", "categorical",
+                 "truncated_normal", "shuffle", "bits", "exponential"}
+KEY_DERIVERS = {"split", "fold_in"}
+
+
+# --- shared AST helpers -------------------------------------------------
+
+def _final_name(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _int_dtype_token(node: ast.expr) -> bool:
+    """Does this expression name an integer/bool dtype (jnp.int32,
+    np.uint8, "int32", int, bool)?"""
+    tokens = ("int", "uint", "bool")
+    if isinstance(node, ast.Attribute):
+        return node.attr.startswith(tokens)
+    if isinstance(node, ast.Name):
+        return node.id.startswith(tokens)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.startswith(tokens)
+    return False
+
+
+def _has_int_evidence(node: ast.AST) -> bool:
+    """True if the subtree pins an integer dtype: a ``dtype=<int>``
+    keyword or an ``.astype(<int>)`` call anywhere inside."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.keyword) and sub.arg == "dtype" \
+                and _int_dtype_token(sub.value):
+            return True
+        if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "astype" and sub.args
+                and _int_dtype_token(sub.args[0])):
+            return True
+    return False
+
+
+def _under_pairwise(parents: Dict[ast.AST, ast.AST], node: ast.AST) -> bool:
+    """Is `node` inside a call to a fixed-tree pairwise reducer?"""
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.Call):
+            name = _final_name(cur.func)
+            if name and PAIRWISE_TOKEN in name:
+                return True
+        cur = parents.get(cur)
+    return False
+
+
+def _fn_parents(fn: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(fn):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+MODULE_ALIASES = NUMPY_ALIASES | {"jnp", "xp"}
+
+
+def _is_reduction(call: ast.Call) -> Optional[bool]:
+    """Reassociable float reduction?  Returns True for a FULL (to
+    scalar / collective) reduction, False for an axis reduction, None
+    for not-a-reduction."""
+    func = call.func
+    name = _final_name(func)
+    if name == "psum":
+        return True
+    if name != "sum" or not isinstance(func, ast.Attribute):
+        return None
+    has_axis = any(kw.arg == "axis" for kw in call.keywords)
+    if _final_name(func.value) in MODULE_ALIASES:
+        # module form jnp.sum(x[, axis]) — args[0] is the operand
+        has_axis = has_axis or len(call.args) > 1
+    else:
+        # method form x.sum([axis]) — any positional arg is the axis
+        has_axis = has_axis or bool(call.args)
+    return not has_axis
+
+
+def _device_functions(mod: Module) -> Dict[ast.FunctionDef,
+                                           Optional[Tuple[str, ...]]]:
+    """Functions that run as device code: jit-decorated/assigned defs
+    (with their static argnames), defs handed to jit/shard_map/pmap by
+    name, and — transitively, intra-module — defs they call by name.
+    Pairwise reducers are excluded (their internals ARE the fix)."""
+    by_name: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.FunctionDef):
+            by_name.setdefault(node.name, node)
+    device: Dict[ast.FunctionDef, Optional[Tuple[str, ...]]] = {}
+    for fn, statics in _jitted_functions(mod).items():
+        device[fn] = statics
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _final_name(node.func) not in JIT_WRAPPERS:
+            continue
+        for arg in node.args:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Name) and sub.id in by_name:
+                    device.setdefault(by_name[sub.id], None)
+    # intra-module closure over by-name calls
+    work = list(device)
+    while work:
+        fn = work.pop()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                callee = by_name.get(node.func.id)
+                if callee is not None and callee not in device:
+                    device[callee] = None
+                    work.append(callee)
+    return {fn: st for fn, st in device.items()
+            if PAIRWISE_TOKEN not in fn.name}
+
+
+def _jitted_global_names(ctx: AnalysisContext) -> Set[str]:
+    """Names bound to jit-compiled callables anywhere in the analyzed
+    tree: decorated defs plus ``f = jax.jit(impl)`` assignment targets."""
+    names: Set[str] = set()
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.FunctionDef):
+                if any(_jit_decoration(d) is not None
+                       for d in node.decorator_list):
+                    names.add(node.name)
+            elif isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                call = node.value
+                jitted = _jit_decoration(call.func) is not None \
+                    if isinstance(call.func, ast.Call) \
+                    else _final_name(call.func) == "jit"
+                if jitted:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            names.add(tgt.id)
+    return names
+
+
+# --- rule 1: reassociable-reduction-feeds-selection ---------------------
+
+def _helper_sources(mod: Module,
+                    device: Dict[ast.FunctionDef, object]) -> Set[str]:
+    """Device helpers whose RETURN expression contains a raw (full,
+    non-int, non-pairwise-routed) reduction — calls to them carry the
+    reassociation hazard into the caller (the pre-PR-14
+    ``_packing_score_xp`` shape)."""
+    out: Set[str] = set()
+    for fn in device:
+        parents = _fn_parents(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            for sub in ast.walk(node.value):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if _is_reduction(sub) is not True:
+                    continue
+                if _has_int_evidence(sub) or _suppressed(mod, sub.lineno):
+                    continue
+                if _under_pairwise(parents, sub):
+                    continue
+                out.add(fn.name)
+    return out
+
+
+def _taint_names(fn: ast.FunctionDef, parents: Dict[ast.AST, ast.AST],
+                 seeds: List[ast.AST], helper_names: Set[str]) -> Set[str]:
+    """Names transitively assigned from the seed expressions (or calls
+    to hazard helpers), with pairwise-reducer calls acting as cleansing
+    boundaries."""
+    seed_ids = {id(s) for s in seeds}
+
+    def rhs_tainted(expr: ast.expr, tainted: Set[str]) -> bool:
+        for sub in ast.walk(expr):
+            if id(sub) in seed_ids and not _under_pairwise(parents, sub):
+                return True
+            if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+                    and sub.func.id in helper_names
+                    and not _under_pairwise(parents, sub)):
+                return True
+            if (isinstance(sub, ast.Name) and sub.id in tainted
+                    and isinstance(sub.ctx, ast.Load)
+                    and not _under_pairwise(parents, sub)):
+                return True
+        return False
+
+    tainted: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, ast.AugAssign):
+                value, targets = node.value, [node.target]
+            else:
+                continue
+            if not rhs_tainted(value, tainted):
+                continue
+            for tgt in targets:
+                for sub in ast.walk(tgt):
+                    if isinstance(sub, ast.Name) and sub.id not in tainted:
+                        tainted.add(sub.id)
+                        changed = True
+    return tainted
+
+
+def _selection_sink(fn: ast.FunctionDef, parents: Dict[ast.AST, ast.AST],
+                    seeds: List[ast.AST], tainted: Set[str],
+                    helper_names: Set[str],
+                    direct_only: bool) -> Optional[str]:
+    """First selection construct the taint reaches, or None.  With
+    direct_only (axis reductions), only the seed expression itself or
+    its directly-assigned name sitting immediately under the sink
+    counts — elementwise axis sums feeding ordinary capacity arithmetic
+    are not portfolio selections."""
+    seed_ids = {id(s) for s in seeds}
+    direct_names: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and id(node.value) in seed_ids:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    direct_names.add(tgt.id)
+
+    def hits(expr: ast.expr, immediate: bool) -> bool:
+        if direct_only:
+            if id(expr) in seed_ids:
+                return True
+            if isinstance(expr, ast.Name) and expr.id in direct_names:
+                return True
+            if immediate:
+                return False
+            return False
+        for sub in ast.walk(expr):
+            if id(sub) in seed_ids and not _under_pairwise(parents, sub):
+                return True
+            if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+                    and sub.func.id in helper_names):
+                return True
+            if (isinstance(sub, ast.Name) and sub.id in tainted
+                    and isinstance(sub.ctx, ast.Load)
+                    and not _under_pairwise(parents, sub)):
+                return True
+        return False
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Compare):
+            for operand in [node.left] + list(node.comparators):
+                if hits(operand, immediate=True):
+                    return f"comparison at line {node.lineno}"
+        elif isinstance(node, ast.Call):
+            name = _final_name(node.func)
+            if name in SELECTORS and node.args:
+                for arg in node.args:
+                    if hits(arg, immediate=True):
+                        return f"{name}() at line {node.lineno}"
+            elif name in PREDICATED and node.args:
+                if hits(node.args[0], immediate=True):
+                    return f"{name}() predicate at line {node.lineno}"
+    return None
+
+
+@rule("reassociable-reduction-feeds-selection",
+      "float sum/psum results must not feed argmax/comparison/selection "
+      "inside jitted graphs — route through _pairwise_sum_xp")
+def check_reassoc_reduction(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        if not in_scope(mod.rel, SCOPE):
+            continue
+        device = _device_functions(mod)
+        if not device:
+            continue
+        helper_names = _helper_sources(mod, device)
+        for fn in device:
+            parents = _fn_parents(fn)
+            ordinal = 0
+            sources: List[Tuple[ast.AST, bool, str]] = []
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    full = _is_reduction(node)
+                    if full is None:
+                        continue
+                    if _has_int_evidence(node):
+                        continue
+                    parent = parents.get(node)
+                    if (isinstance(parent, ast.Attribute)
+                            and parent.attr == "astype"):
+                        gp = parents.get(parent)
+                        if isinstance(gp, ast.Call) and gp.args \
+                                and _int_dtype_token(gp.args[0]):
+                            continue       # sum(...).astype(int32)
+                    if _under_pairwise(parents, node):
+                        continue
+                    if _suppressed(mod, node.lineno):
+                        continue
+                    token = _final_name(node.func) or "sum"
+                    sources.append((node, bool(full), token))
+            # calls to hazard helpers are full-reduction sources too
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id in helper_names
+                        and not _suppressed(mod, node.lineno)):
+                    sources.append((node, True, node.func.id))
+            for src, full, token in sources:
+                tainted = _taint_names(fn, parents, [src],
+                                       helper_names if full else set()) \
+                    if full else set()
+                sink = _selection_sink(fn, parents, [src], tainted,
+                                       helper_names if full else set(),
+                                       direct_only=not full)
+                if sink is None:
+                    continue
+                ordinal += 1
+                findings.append(Finding(
+                    rule="reassociable-reduction-feeds-selection",
+                    path=mod.rel, line=src.lineno, severity="error",
+                    message=(f"reassociable float reduction '{token}' flows "
+                             f"into {sink} inside device code '{fn.name}' — "
+                             "XLA may re-associate it per fusion context and "
+                             "flip a near-tied selection; route through "
+                             "_pairwise_sum_xp (or pin an integer dtype)"),
+                    context=f"{mod.rel}:{fn.name}",
+                    detail=f"{token}#{ordinal}"))
+    return findings
+
+
+# --- rule 2: host-sync-in-launch ----------------------------------------
+
+def _launch_scope(mod: Module) -> bool:
+    from pathlib import Path
+
+    parts = Path(mod.rel).parts
+    if "nomad_tpu" not in parts:
+        return True
+    return in_scope(mod.rel, SCOPE) and Path(mod.rel).name in LAUNCH_FILES
+
+
+@rule("host-sync-in-launch",
+      "launch drivers get ONE explicit host sync per launch: no "
+      "duplicated device_get sites, no .item()/np.asarray readbacks")
+def check_host_sync_in_launch(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    jitted_names = _jitted_global_names(ctx)
+    for mod in ctx.modules:
+        if not _launch_scope(mod):
+            continue
+        jitted_here = set(_jitted_functions(mod))
+        for fn in [n for n in ast.walk(mod.tree)
+                   if isinstance(n, ast.FunctionDef)
+                   and n not in jitted_here]:
+            qual = f"{mod.rel}:{fn.name}"
+            ordinal = 0
+
+            def add(node, message, detail):
+                findings.append(Finding(
+                    rule="host-sync-in-launch", path=mod.rel,
+                    line=node.lineno, severity="error", message=message,
+                    context=qual, detail=detail))
+
+            gets: Dict[str, List[ast.Call]] = {}
+            is_launch_fn = False
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _final_name(node.func)
+                if isinstance(node.func, ast.Name) \
+                        and node.func.id in jitted_names:
+                    is_launch_fn = True
+                if name == "device_get":
+                    is_launch_fn = True
+                    inner = ""
+                    if node.args and isinstance(node.args[0], ast.Call):
+                        inner = _final_name(node.args[0].func) or ""
+                    gets.setdefault(inner, []).append(node)
+                elif name == "asarray" and isinstance(
+                        node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id in NUMPY_ALIASES \
+                        and node.args and isinstance(node.args[0], ast.Call) \
+                        and isinstance(node.args[0].func, ast.Name) \
+                        and node.args[0].func.id in jitted_names:
+                    if not _suppressed(mod, node.lineno):
+                        ordinal += 1
+                        add(node,
+                            f"np.asarray({node.args[0].func.id}(...)) reads "
+                            "the launch back through an IMPLICIT "
+                            "device->host transfer (invisible to the "
+                            "transfer guard on CPU backends) — use the "
+                            "sanctioned jax.device_get",
+                            f"asarray:{node.args[0].func.id}")
+            for inner, sites in gets.items():
+                if inner and len(sites) > 1:
+                    for node in sites[1:]:
+                        if _suppressed(mod, node.lineno):
+                            continue
+                        add(node,
+                            f"duplicated jax.device_get({inner}(...)) call "
+                            f"site in '{fn.name}' — a launch window gets "
+                            "ONE host sync; collapse the branches into one "
+                            "guarded call site",
+                            f"dup-get:{inner}")
+            if is_launch_fn:
+                for node in ast.walk(fn):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr in SYNC_ATTRS
+                            and not _suppressed(mod, node.lineno)):
+                        add(node,
+                            f".{node.func.attr}() inside launch driver "
+                            f"'{fn.name}' is an extra host sync beyond the "
+                            "launch's single device_get",
+                            f".{node.func.attr}")
+    return findings
+
+
+# --- rule 3: retrace-hazard ---------------------------------------------
+
+@rule("retrace-hazard",
+      "no traced-value loop bounds, slice bounds, or shape arguments in "
+      "jitted functions — each new value re-traces (static complement "
+      "to jit_guard.no_retrace)")
+def check_retrace_hazard(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        if not in_scope(mod.rel, SCOPE):
+            continue
+        for fn, statics in _jitted_functions(mod).items():
+            traced = _param_names(fn) - set(statics)
+            qual = f"{mod.rel}:{fn.name}"
+
+            def add(node, message, detail):
+                if not _suppressed(mod, node.lineno):
+                    findings.append(Finding(
+                        rule="retrace-hazard", path=mod.rel,
+                        line=node.lineno, severity="error", message=message,
+                        context=qual, detail=detail))
+
+            for node in ast.walk(fn):
+                if isinstance(node, ast.For) and isinstance(
+                        node.iter, ast.Call) \
+                        and _final_name(node.iter.func) == "range":
+                    for use in _traced_uses(node.iter, traced):
+                        add(node, f"`for range()` bound uses traced arg "
+                            f"'{use.id}' in @jax.jit '{fn.name}' — the "
+                            "loop unrolls per VALUE, re-tracing each time "
+                            "(use lax.fori_loop or static_argnames)",
+                            f"for-range:{use.id}")
+                elif isinstance(node, ast.Subscript):
+                    slices = [node.slice]
+                    if isinstance(node.slice, ast.Tuple):
+                        slices = list(node.slice.elts)
+                    for sl in slices:
+                        if not isinstance(sl, ast.Slice):
+                            continue
+                        for bound in (sl.lower, sl.upper, sl.step):
+                            if bound is None:
+                                continue
+                            for use in _traced_uses(bound, traced):
+                                add(node, f"slice bound uses traced arg "
+                                    f"'{use.id}' in @jax.jit '{fn.name}' — "
+                                    "slice sizes must be static (use "
+                                    "lax.dynamic_slice for traced offsets)",
+                                    f"slice:{use.id}")
+                elif isinstance(node, ast.Call) and \
+                        _final_name(node.func) in SHAPE_FNS:
+                    for arg in node.args:
+                        for use in _traced_uses(arg, traced):
+                            add(node, f"shape argument of "
+                                f"{_final_name(node.func)}() uses traced "
+                                f"arg '{use.id}' in @jax.jit '{fn.name}' — "
+                                "shapes derived from traced VALUES "
+                                "re-trace per value",
+                                f"shape:{use.id}")
+    return findings
+
+
+# --- rule 4: unguarded-launch -------------------------------------------
+
+def _guarded(parents: Dict[ast.AST, ast.AST], node: ast.AST) -> bool:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                for sub in ast.walk(item.context_expr):
+                    if isinstance(sub, ast.Call) and \
+                            _final_name(sub.func) in GUARD_NAMES:
+                        return True
+        cur = parents.get(cur)
+    return False
+
+
+@rule("unguarded-launch",
+      "solver/placer jit launches run under a shape-keyed no_retrace "
+      "window; mesh-aware device_puts carry an explicit NamedSharding")
+def check_unguarded_launch(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    jitted_names = _jitted_global_names(ctx)
+    for mod in ctx.modules:
+        if not _launch_scope(mod):
+            continue
+        jitted_here = set(_jitted_functions(mod))
+        for fn in [n for n in ast.walk(mod.tree)
+                   if isinstance(n, ast.FunctionDef)
+                   and n not in jitted_here]:
+            parents = _fn_parents(fn)
+            qual = f"{mod.rel}:{fn.name}"
+            mentions_mesh = any(isinstance(n, ast.Name) and n.id == "mesh"
+                                for n in ast.walk(fn)) \
+                or "mesh" in _param_names(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _final_name(node.func)
+                if isinstance(node.func, ast.Name) \
+                        and node.func.id in jitted_names:
+                    if not _guarded(parents, node) \
+                            and not _suppressed(mod, node.lineno):
+                        findings.append(Finding(
+                            rule="unguarded-launch", path=mod.rel,
+                            line=node.lineno, severity="error",
+                            message=(f"jit launch {node.func.id}(...) in "
+                                     f"'{fn.name}' runs outside a "
+                                     "shape-keyed no_retrace window — warm "
+                                     "retraces and implicit transfers go "
+                                     "undetected (wrap in _warm_launch / "
+                                     "_launch_guard)"),
+                            context=qual, detail=f"launch:{node.func.id}"))
+                elif name == "device_put" and len(node.args) == 1 \
+                        and not node.keywords and mentions_mesh:
+                    branch_ok = False
+                    cur = parents.get(node)
+                    while cur is not None:
+                        if isinstance(cur, ast.If) and any(
+                                isinstance(s, ast.Name) and s.id == "mesh"
+                                for s in ast.walk(cur.test)):
+                            branch_ok = True
+                            break
+                        cur = parents.get(cur)
+                    if not branch_ok and not _suppressed(mod, node.lineno):
+                        findings.append(Finding(
+                            rule="unguarded-launch", path=mod.rel,
+                            line=node.lineno, severity="error",
+                            message=(f"bare jax.device_put in mesh-aware "
+                                     f"'{fn.name}' — without an explicit "
+                                     "NamedSharding the sharded jit sees "
+                                     "uncommitted single-device arrays "
+                                     "(committed-vs-bare cache fork)"),
+                            context=qual, detail="bare-device_put"))
+    return findings
+
+
+# --- rule 5: prng-key-reuse ---------------------------------------------
+
+def _is_key_ctor(call: ast.Call) -> bool:
+    return _final_name(call.func) in ("PRNGKey", "key")
+
+
+@rule("prng-key-reuse",
+      "a PRNGKey feeds ONE sampling call — reuse without fold_in/split "
+      "replays the same stream across restarts/rounds")
+def check_prng_key_reuse(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        if not in_scope(mod.rel, SCOPE):
+            continue
+        fns = [n for n in ast.walk(mod.tree)
+               if isinstance(n, (ast.FunctionDef, ast.Lambda))]
+        for fn in fns:
+            nested = [n for n in ast.walk(fn)
+                      if isinstance(n, (ast.FunctionDef, ast.Lambda))
+                      and n is not fn]
+            nested_nodes = {id(x) for sub in nested for x in ast.walk(sub)}
+            parents = _fn_parents(fn)
+            qual = (f"{mod.rel}:{getattr(fn, 'name', '<lambda>')}")
+
+            # (a) a named key consumed by 2+ sampling calls
+            key_names: Set[str] = set()
+            for node in ast.walk(fn):
+                if id(node) in nested_nodes:
+                    continue
+                if isinstance(node, ast.Assign) and isinstance(
+                        node.value, ast.Call) and _is_key_ctor(node.value):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            key_names.add(tgt.id)
+            uses: Dict[str, List[ast.Call]] = {k: [] for k in key_names}
+            for node in ast.walk(fn):
+                if id(node) in nested_nodes or not isinstance(node, ast.Call):
+                    continue
+                if _final_name(node.func) not in KEY_CONSUMERS:
+                    continue
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id in key_names:
+                        uses[arg.id].append(node)
+            for key, sites in uses.items():
+                for node in sites[1:]:
+                    if _suppressed(mod, node.lineno):
+                        continue
+                    findings.append(Finding(
+                        rule="prng-key-reuse", path=mod.rel,
+                        line=node.lineno, severity="error",
+                        message=(f"PRNGKey '{key}' consumed again by "
+                                 f"{_final_name(node.func)}() — identical "
+                                 "stream both times; derive per-use keys "
+                                 "with jax.random.split/fold_in"),
+                        context=qual, detail=f"reuse:{key}"))
+
+            # (b) a loop-invariant key constructed inside the loop body
+            for node in ast.walk(fn):
+                if id(node) in nested_nodes or not isinstance(node, ast.Call):
+                    continue
+                if not _is_key_ctor(node):
+                    continue
+                loop_targets: Set[str] = set()
+                in_loop = False
+                cur = parents.get(node)
+                derived = False
+                while cur is not None:
+                    if isinstance(cur, ast.Call) and \
+                            _final_name(cur.func) in KEY_DERIVERS:
+                        derived = True
+                    if isinstance(cur, (ast.FunctionDef, ast.Lambda)) \
+                            and cur is not fn:
+                        # a nested fn's key depends on ITS params
+                        derived = True
+                    if isinstance(cur, ast.For):
+                        in_loop = True
+                        for sub in ast.walk(cur.target):
+                            if isinstance(sub, ast.Name):
+                                loop_targets.add(sub.id)
+                    elif isinstance(cur, ast.While):
+                        in_loop = True
+                    cur = parents.get(cur)
+                if not in_loop or derived:
+                    continue
+                seed_names = {s.id for arg in node.args
+                              for s in ast.walk(arg)
+                              if isinstance(s, ast.Name)}
+                if seed_names & loop_targets:
+                    continue
+                if _suppressed(mod, node.lineno):
+                    continue
+                findings.append(Finding(
+                    rule="prng-key-reuse", path=mod.rel,
+                    line=node.lineno, severity="error",
+                    message=("loop-invariant PRNGKey constructed inside a "
+                             f"loop in '{qual.split(':')[-1]}' — every "
+                             "round replays the same stream; fold_in the "
+                             "round index"),
+                    context=qual, detail="loop-invariant-key"))
+    return findings
+
+
+# the nomadjit static prong, runnable alone via --tensor (ANALYSIS.md)
+TENSOR_RULES = (
+    "reassociable-reduction-feeds-selection",
+    "host-sync-in-launch",
+    "retrace-hazard",
+    "unguarded-launch",
+    "prng-key-reuse",
+)

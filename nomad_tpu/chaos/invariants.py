@@ -254,7 +254,7 @@ class InvariantChecker:
                     f"{[i[:8] for i in worst[1]]}")
         self.stats["checks"] += 1
 
-    # -- 6: node liveness (client-plane swarm) ------------------------
+    # -- 8: node liveness (client-plane swarm) ------------------------
 
     def check_node_liveness(self, cluster, swarm=None,
                             ttl: float = None) -> None:
@@ -335,6 +335,24 @@ class InvariantChecker:
             extra = f" (+{len(fresh) - 1} more)" if len(fresh) > 1 else ""
             self._fail(f"snapshot integrity: {fresh[0].render()}{extra}")
 
+    # -- 7: launch ledger (nomadjit runtime prong) --------------------
+
+    def check_launch_ledger(self, cluster=None) -> None:
+        """When the nomadjit launch ledger is armed (NOMAD_TPU_SAN=1),
+        sweep it for warm-path compiles, extra host syncs, unsanctioned
+        transfers, and leaked launch windows — a retrace or stray sync
+        on the solve hot path bills milliseconds to every launch long
+        before it surfaces as a failed perf gate."""
+        from ..analysis.launch_ledger import GLOBAL as ledger
+
+        if not ledger.active:
+            return
+        problems = ledger.verify_all()
+        if problems:
+            extra = (f" (+{len(problems) - 1} more)"
+                     if len(problems) > 1 else "")
+            self._fail(f"launch ledger: {problems[0]}{extra}")
+
     # -- aggregate ----------------------------------------------------
 
     def check_all(self, cluster) -> None:
@@ -342,6 +360,7 @@ class InvariantChecker:
         liveness checks — convergence, reschedule — take timeouts and
         run where a scenario expects quiescence)."""
         self.check_snapshot_integrity(cluster)
+        self.check_launch_ledger(cluster)
         self.check_election_safety(cluster)
         self.check_log_matching(cluster)
         self.check_committed_durability(cluster)

@@ -51,7 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .kernels import (NEG, TIE_JITTER, _fit_scores_xp,
+from .kernels import (NEG, TIE_JITTER, _fit_scores_xp, _pairwise_sum_xp,
                       _solve_bulk_multi_impl)
 
 # Auction round budget. Each round fills at least one node to capacity
@@ -113,24 +113,9 @@ PORTFOLIO = (
 RESTARTS = len(PORTFOLIO)
 
 
-def _pairwise_sum_xp(xp, v):
-    """Fixed-tree pairwise sum of a 1-D vector. A plain ``.sum()``
-    leaves the float add order to the backend's reduction strategy,
-    which varies with the surrounding fusion context — the same
-    per-node contributions summed inside two different compiled graphs
-    (single-device vs mesh-sharded) can disagree in the last ulp, and
-    that is enough to flip a near-tied portfolio selection. Explicit
-    halving adds pin the association order by shape alone, so every
-    layout reduces identically bit-for-bit."""
-    n = int(v.shape[0])
-    p = 1
-    while p < n:
-        p *= 2
-    if p != n:
-        v = xp.concatenate([v, xp.zeros(p - n, dtype=v.dtype)])
-    while v.shape[0] > 1:
-        v = v[0::2] + v[1::2]
-    return v[0]
+# _pairwise_sum_xp now lives in kernels (score_nodes needs it for the
+# spread-presence reduction); re-exported here because sharding.py and
+# the PR 14 determinism tests import it from this module.
 
 
 def _packing_score_xp(xp, counts, available, used_final):
@@ -339,7 +324,9 @@ def solve_batch(
         used_t, take_t, rnd_t = _auction(
             used0, available, feas, aff, ask, k, jits, g, rounds,
             price_eps=PRICE_EPS * ptemp, evict=evict, pscore=pscore)
-        placed_t = take_t.sum()
+        # dtype pin: placement counts reduce as int32 (associative adds
+        # — legal before a comparison; x64 would promote to int64)
+        placed_t = take_t.sum(dtype=jnp.int32)
         score_t = _packing_score_xp(jnp, take_t, available, used_t)
         if t == 0:
             used_auction, take, rnd = used_t, take_t, rnd_t
@@ -353,7 +340,7 @@ def solve_batch(
             score_best = jnp.where(better, score_t, score_best)
             placed_best = jnp.where(better, placed_t, placed_best)
 
-    placed_a = take.sum()
+    placed_a = take.sum(dtype=jnp.int32)
     placed_g = counts_greedy.astype(jnp.int32).sum()
     score_a = _packing_score_xp(jnp, take, available, used_auction)
     score_g = _packing_score_xp(jnp, counts_greedy.astype(jnp.int32),

@@ -54,7 +54,18 @@ def no_retrace(*fns, expect: int = 0) -> Iterator[Dict]:
     before = [(fn, cache_size(fn)) for fn in fns]
     out: Dict = {"compiles": 0}
     with jax.transfer_guard("disallow"):
-        yield out
+        try:
+            yield out
+        except Exception as e:
+            # attribute the trip to the launch ledger (NOMAD_TPU_SAN=1)
+            # before re-raising: the guard is the enforcement point, the
+            # ledger is the attribution record
+            if "transfer" in str(e).lower():
+                from ..analysis import launch_ledger
+                launch_ledger.note_unsanctioned(
+                    f"a no_retrace window over "
+                    f"{[getattr(f, '__name__', str(f)) for f in fns]}")
+            raise
     grew = []
     for fn, b in before:
         a = cache_size(fn)

@@ -88,6 +88,28 @@ def fit_scores_np(available, used, spread_alg=False):
                           np.asarray(used, dtype=np.float64), spread_alg)
 
 
+def _pairwise_sum_xp(xp, v):
+    """Fixed-tree pairwise sum over the LEADING axis. A plain ``.sum()``
+    leaves the float add order to the backend's reduction strategy,
+    which varies with the surrounding fusion context — the same
+    contributions summed inside two different compiled graphs
+    (single-device vs mesh-sharded) can disagree in the last ulp, and
+    that is enough to flip a near-tied selection. Explicit halving adds
+    pin the association order by shape alone, so every layout reduces
+    identically bit-for-bit. 1-D input reduces to a scalar; (S, ...)
+    input reduces axis 0 elementwise (the jnp.sum(x, axis=0) twin)."""
+    n = int(v.shape[0])
+    p = 1
+    while p < n:
+        p *= 2
+    if p != n:
+        v = xp.concatenate(
+            [v, xp.zeros((p - n,) + tuple(v.shape[1:]), dtype=v.dtype)])
+    while v.shape[0] > 1:
+        v = v[0::2] + v[1::2]
+    return v[0]
+
+
 def score_nodes(
     *,
     available,        # (N, D) node capacity minus reserved; D = 4 base
@@ -202,7 +224,9 @@ def score_nodes(
     even = jnp.where(spread_val_ok, even, -1.0)
 
     boost = jnp.where(spread_has_targets[:, None], explicit, even)  # (S, N)
-    spread_total = jnp.sum(boost, axis=0)                           # (N,)
+    # fixed-tree reduction: spread_total feeds the != 0 presence test,
+    # so its float add order must not vary with the fusion context
+    spread_total = _pairwise_sum_xp(jnp, boost)                     # (N,)
     spread_present = spread_total != 0.0
 
     divisor = (

@@ -151,6 +151,44 @@ _PREEMPT_STATS_LOCK = __import__("threading").Lock()
 # same shape run under a jit_guard no_retrace window (retrace there is a
 # bug, not a warmup)
 _PREEMPT_WARM: set = set()
+# same discipline for the other placer-driven launch sites: per-eval
+# fused solve, resident bulk solve, generic bulk solve
+_FUSED_WARM: set = set()
+_BULK_FUSED_WARM: set = set()
+_BULK_WARM: set = set()
+
+
+def _warm_launch(fn, shape_key, warm: set):
+    """Shape-keyed launch window around one kernel launch: a warm shape
+    runs under a hard jit_guard.no_retrace window (zero new compiles,
+    implicit transfers raise), a cold shape may compile once and then
+    marks itself warm. Either way the launch lands in the nomadjit
+    ledger (no-op unless NOMAD_TPU_SAN=1) with its warm/cold standing.
+
+    Callers jax.device_put EVERY argument first — committed jax.Arrays
+    and bare numpy hit different jit cache entries, so a mixed diet
+    would read as a retrace — and read back through a single
+    jax.device_get, the launch's only host sync."""
+    import contextlib
+
+    from ..analysis import launch_ledger
+    from .jit_guard import count_compiles, no_retrace
+
+    is_warm = shape_key in warm
+
+    @contextlib.contextmanager
+    def _window():
+        name = getattr(fn, "__name__", str(fn))
+        with launch_ledger.window(name, key=shape_key, warm=is_warm):
+            if is_warm:
+                with no_retrace(fn):
+                    yield
+            else:
+                with count_compiles(fn):
+                    yield
+                warm.add(shape_key)
+
+    return _window()
 
 
 def preempt_stats() -> Dict[str, int]:
@@ -353,7 +391,15 @@ class TPUPlacer:
                     dp_val_id=tgt.dp_val_id, dp_val_ok=tgt.dp_val_ok,
                     dp_counts0=tgt.dp_counts, dp_limit=tgt.dp_limit,
                     tie_perm=tie_perm)
-                out = np.asarray(solve_task_group_fused(*packed))  # 1 readback
+                import jax
+
+                # explicit shipment + shape-keyed window; the
+                # device_get is the launch's only host sync
+                dev = jax.device_put(packed)
+                fused_key = tuple(np.shape(a) for a in packed)
+                with _warm_launch(solve_task_group_fused, fused_key,
+                                  _FUSED_WARM):
+                    out = jax.device_get(solve_task_group_fused(*dev))
                 choices = out[0].astype(np.int64)
                 founds = out[1] > 0.5
                 scores = out[2]
@@ -542,21 +588,40 @@ class TPUPlacer:
             f32 = np.float32
             avail_dev, feas_dev, aff_dev = ensure_resident(
                 static, tgt.feas_base, tgt.affinity_boost)
+            import jax
+
             dyn = np.concatenate(
                 [cluster.used, tgt.placed_tg[:, None],
                  tgt.placed_job[:, None]], axis=1).astype(f32)
-            return np.asarray(solve_bulk_fused(
-                avail_dev, feas_dev, aff_dev, dyn, tgt.ask.astype(f32),
-                np.int32(k), f32(tgt.tg_count), np.uint32(seed),
-                batch=self.BULK_STEP, n_steps=n_steps)).astype(np.int64)
-        return np.asarray(solve_bulk(
-            cluster.available, cluster.used, tgt.ask, tgt.feasible,
-            tgt.placed_tg, tgt.placed_job, tgt.affinity_boost,
-            np.zeros(cluster.n_pad), tgt.spread_val_id, tgt.spread_val_ok,
-            tgt.spread_counts, tgt.spread_desired, tgt.spread_has_targets,
-            tgt.spread_weight, np.int32(k), tgt.tg_count, tgt.dh_job,
-            tgt.dh_tg, tgt.spread_alg, tie_perm,
-            batch=self.BULK_STEP, n_steps=n_steps)).astype(np.int64)
+            # avail/feas/aff are already device-resident (device_put of
+            # a committed array is a no-op); ship the per-solve host
+            # args explicitly — scalars included, an implicit scalar
+            # transfer trips the warm window's transfer guard
+            host = jax.device_put((dyn, tgt.ask.astype(f32), np.int32(k),
+                                   f32(tgt.tg_count), np.uint32(seed)))
+            fused_key = (dyn.shape, tgt.ask.shape,
+                         np.shape(avail_dev), n_steps)
+            with _warm_launch(solve_bulk_fused, fused_key,
+                              _BULK_FUSED_WARM):
+                out = jax.device_get(solve_bulk_fused(
+                    avail_dev, feas_dev, aff_dev, *host,
+                    batch=self.BULK_STEP, n_steps=n_steps))
+            return out.astype(np.int64)
+        import jax
+
+        args = (cluster.available, cluster.used, tgt.ask, tgt.feasible,
+                tgt.placed_tg, tgt.placed_job, tgt.affinity_boost,
+                np.zeros(cluster.n_pad), tgt.spread_val_id,
+                tgt.spread_val_ok, tgt.spread_counts, tgt.spread_desired,
+                tgt.spread_has_targets, tgt.spread_weight, np.int32(k),
+                tgt.tg_count, tgt.dh_job, tgt.dh_tg, tgt.spread_alg,
+                tie_perm)
+        dev = jax.device_put(args)
+        bulk_key = tuple(np.shape(a) for a in args) + (n_steps,)
+        with _warm_launch(solve_bulk, bulk_key, _BULK_WARM):
+            out = jax.device_get(solve_bulk(
+                *dev, batch=self.BULK_STEP, n_steps=n_steps))
+        return out.astype(np.int64)
 
     def _place_bulk_columnar(self, ctx, job, tg, bulk, cluster, tgt,
                              commit, seed, *, sched_batch: bool,
@@ -821,7 +886,6 @@ class TPUPlacer:
                 vt.prio, vt.vec, vt.elig, vt.flagged)
         import jax
 
-        from .jit_guard import no_retrace
         from .kernels import preempt_solve
 
         f32 = np.float32
@@ -834,13 +898,8 @@ class TPUPlacer:
         # numpy hit different jit cache entries, so a cold bare call
         # followed by a warm device_put call would read as a retrace
         dev = jax.device_put(args)
-        if shape_key in _PREEMPT_WARM:
-            # warm shape: any retrace or implicit transfer is a bug
-            with no_retrace(preempt_solve):
-                out = jax.device_get(preempt_solve(*dev))
-        else:
+        with _warm_launch(preempt_solve, shape_key, _PREEMPT_WARM):
             out = jax.device_get(preempt_solve(*dev))
-            _PREEMPT_WARM.add(shape_key)
         picks, victims, flagged, scores = out
         return (np.asarray(picks), np.asarray(victims),
                 np.asarray(flagged), np.asarray(scores))

@@ -235,7 +235,10 @@ def _bulk_shard_body(used0, avail, feas, aff, ask, k, seeds, cidx, cdelta,
             caps_e = jnp.where(eligible, caps_s, 0)
             cum = jnp.cumsum(caps_e).astype(jnp.int32)
             take_s = jnp.clip(budget - (cum - caps_e), 0, caps_e)
-            consumed = jnp.sum(take_s).astype(budget.dtype)
+            # int32 pin: integer adds are associative, and the result
+            # feeds the round-progress comparisons below
+            consumed = jnp.sum(take_s, dtype=jnp.int32).astype(
+                budget.dtype)
             # scatter back: mark eligible candidates consumed (cap
             # 0) and add takes on our own rows
             take_c = jnp.zeros_like(caps_all).at[order].set(take_s)
@@ -533,7 +536,7 @@ def make_solve_batch_sharded(mesh: Mesh, axis: str = "nodes",
             # +1 for the det_score gather (placed stays a psum: integer
             # adds are associative, so it cannot wobble)
             gathers = gathers + rnd_t + 1
-            placed_t = jax.lax.psum(take_t.sum(), axis)
+            placed_t = jax.lax.psum(take_t.sum(dtype=jnp.int32), axis)
             score_t = det_score(take_t, used_t)
             if t == 0:
                 used_a, take, rnd = used_t, take_t, rnd_t

@@ -532,14 +532,17 @@ class BulkSolverService:
         bugs the tests pin at zero."""
         import contextlib
 
+        from ..analysis import launch_ledger
         from .jit_guard import RetraceError, no_retrace
 
         @contextlib.contextmanager
         def window():
             warm = shape_key in self._warm_shapes
             win = no_retrace(fn, expect=0 if warm else 2)
+            ledger = launch_ledger.window(
+                getattr(fn, "__name__", str(fn)), key=shape_key, warm=warm)
             try:
-                with win as counters:
+                with ledger, win as counters:
                     yield
             except RetraceError:
                 with self._lock:

@@ -76,6 +76,14 @@ python -m nomad_tpu.analysis || failed=1
 echo "== nomadown smoke (python -m nomad_tpu.analysis --ownership) =="
 timeout 60 python -m nomad_tpu.analysis --ownership --no-baseline || failed=1
 
+# nomadjit smoke (~2s): the five tensor determinism/launch-discipline
+# rules alone, baseline disabled — reassociable reductions must never
+# feed a selection, launch drivers keep one guarded host sync per
+# launch, keys never replay; findings are fixed in code, never
+# allowlisted (ANALYSIS.md "nomadjit")
+echo "== nomadjit smoke (python -m nomad_tpu.analysis --tensor) =="
+timeout 60 python -m nomad_tpu.analysis --tensor --no-baseline || failed=1
+
 # runtime sanitizer smoke test: lock wrapping + lockset checking armed
 # over the sanitizer's own suite and the concurrency-heavy store/plan
 # tests (the full suite runs under NOMAD_TPU_SAN=1 in nightly; this
@@ -83,7 +91,7 @@ timeout 60 python -m nomad_tpu.analysis --ownership --no-baseline || failed=1
 echo "== nomadsan smoke (NOMAD_TPU_SAN=1) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" NOMAD_TPU_SAN=1 python -m pytest \
     tests/test_sanitizer.py tests/test_ownership.py \
-    tests/test_state_store.py \
+    tests/test_tensor_rules.py tests/test_state_store.py \
     tests/test_plan_apply_scale.py tests/test_e2e_pipeline.py \
     tests/test_batch_solver.py tests/test_preempt_solve.py -q \
     -p no:cacheprovider || failed=1

@@ -32,11 +32,17 @@ jax.config.update("jax_enable_x64", True)
 # every struct entering the state store and flags post-insert mutation.
 _SAN = os.environ.get("NOMAD_TPU_SAN") == "1"
 if _SAN:
+    from nomad_tpu.analysis import launch_ledger as _launch_ledger
     from nomad_tpu.analysis import ownership as _ownership
     from nomad_tpu.analysis import sanitizer as _sanitizer
 
     _sanitizer.install()
     _ownership.install()
+    # nomadjit (the launch-ledger prong) rides the same switch: every
+    # XLA compile and sanctioned device_put/device_get is recorded with
+    # call-site attribution, and the solver/placer launch windows turn
+    # warm-path compiles or extra host syncs into session failures
+    _launch_ledger.install()
 
 import pytest  # noqa: E402
 
@@ -45,12 +51,14 @@ def pytest_terminal_summary(terminalreporter):
     if _SAN:
         terminalreporter.write_line(_sanitizer.GLOBAL.report())
         terminalreporter.write_line(_ownership.GLOBAL.report())
+        terminalreporter.write_line(_launch_ledger.GLOBAL.report())
 
 
 def pytest_sessionfinish(session, exitstatus):
     # a green test run with recorded races is still a failed run
     if _SAN and (_sanitizer.GLOBAL.violations
-                 or _ownership.GLOBAL.violations):
+                 or _ownership.GLOBAL.violations
+                 or _launch_ledger.GLOBAL.violations):
         session.exitstatus = 3
 
 

@@ -1,0 +1,83 @@
+"""Negative fixture: disciplined tensor-layer code the nomadjit rules accept.
+
+Each function is the blessed counterpart of a tensor_bad.py hazard:
+pairwise-routed or int-pinned reductions, static loop/slice/shape
+arguments, shape-keyed guarded launches with one host sync, and
+split/fold_in key hygiene.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+kernel = jax.jit(lambda a: a + 1.0)
+
+
+def _pairwise_sum_xp(xp, v):
+    n = int(v.shape[0])
+    p = 1
+    while p < n:
+        p *= 2
+    if p != n:
+        v = xp.concatenate(
+            [v, xp.zeros((p - n,) + tuple(v.shape[1:]), dtype=v.dtype)])
+    while v.shape[0] > 1:
+        v = v[0::2] + v[1::2]
+    return v[0]
+
+
+@jax.jit
+def pick_best(scores, weights):
+    # fixed-tree reduction: association order never varies per fusion
+    total = _pairwise_sum_xp(jnp, scores * weights)
+    return jnp.where(total > 0.0, scores, -scores)
+
+
+@jax.jit
+def count_placed(take):
+    # integer adds are associative — legal before a comparison
+    placed = take.sum(dtype=jnp.int32)
+    return placed > 0
+
+
+@jax.jit
+def column_load(m, w):
+    # axis reduction feeding plain capacity arithmetic, and only a
+    # derived (not directly-assigned) value near the selector: allowed
+    col = m.sum(axis=0)
+    scaled = col * w
+    return jnp.argmax(scaled)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def scan_static(x, n):
+    acc = x
+    for _ in range(n):           # static bound: unrolls once per n
+        acc = acc * 1.5
+    head = acc[:4]               # constant slice
+    pad = jnp.zeros(8)           # constant shape
+    return acc + head[:1] + pad[:1]
+
+
+def launch(batch, mesh, shard):
+    if mesh is not None:
+        dev = jax.device_put(batch, shard)   # explicit sharding
+    else:
+        dev = jax.device_put(batch)  # mesh-conditional branch: allowed
+    with no_retrace(kernel):  # noqa: F821  (parse-only fixture)
+        return jax.device_get(kernel(dev))   # the ONE host sync
+
+
+def sample(seed, n):
+    key = jax.random.PRNGKey(seed)
+    ka, kb = jax.random.split(key)
+    a = jax.random.uniform(ka, (n,))
+    b = jax.random.normal(kb, (n,))
+    outs = []
+    for i in range(n):
+        k = jax.random.fold_in(key, i)       # per-round derived key
+        outs.append(jax.random.uniform(k, (4,)))
+        k2 = jax.random.PRNGKey(i)           # loop-var-seeded: fresh
+        outs.append(jax.random.normal(k2, (4,)))
+    return a, b, outs

@@ -1,0 +1,94 @@
+"""Positive fixture: tensor-layer determinism / launch-discipline hazards.
+
+Every shape here is a distilled real bug class from the solver tier —
+the reassociable portfolio reduction is the literal pre-PR-14
+determinism bug (see ANALYSIS.md "nomadjit").
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+solve_kernel = jax.jit(lambda a: a * 2.0 + 1.0)
+
+
+# --- reassociable-reduction-feeds-selection ------------------------------
+
+@jax.jit
+def pick_best(scores, weights):
+    total = (scores * weights).sum()        # flag: full sum -> comparison
+    return jnp.where(total > 0.0, scores, -scores)
+
+
+def _score_xp(xp, fit):
+    # raw full reduction in a device helper's return — the pre-PR-14
+    # _packing_score_xp shape; callers inherit the hazard
+    return (fit * fit).sum()
+
+
+@jax.jit
+def choose(fit, cand):
+    score = _score_xp(jnp, fit)             # flag: helper-source -> argmax
+    return jnp.argmax(cand * score)
+
+
+@jax.jit
+def merge_shards(scores):
+    merged = jax.lax.psum(scores, "shard")  # flag: psum -> argmin
+    return jnp.argmin(merged)
+
+
+# --- retrace-hazard ------------------------------------------------------
+
+@partial(jax.jit, static_argnames="n")
+def unroll(x, n, steps):
+    acc = x
+    for _ in range(steps):                  # flag: traced loop bound
+        acc = acc + 1.0
+    head = x[:steps]                        # flag: traced slice bound
+    pad = jnp.zeros(steps)                  # flag: traced shape argument
+    return acc, head, pad
+
+
+# --- host-sync-in-launch / unguarded-launch ------------------------------
+
+def run_launch(batch):
+    dev = jax.device_put(batch)
+    return jax.device_get(solve_kernel(dev))    # flag: unguarded launch
+
+
+def ship_sharded(batch, mesh):
+    dev = jax.device_put(batch)     # flag: bare put in mesh-aware driver
+    with no_retrace(solve_kernel):  # noqa: F821  (parse-only fixture)
+        return jax.device_get(solve_kernel(dev))
+
+
+def drive_launch(packed, warm):
+    dev = jax.device_put(packed)
+    with _launch_guard(solve_kernel, warm):  # noqa: F821
+        if warm:
+            out = jax.device_get(solve_kernel(dev))
+        else:
+            out = jax.device_get(solve_kernel(dev))  # flag: dup get site
+    flag = out.item()                       # flag: extra host sync
+    return out, flag
+
+
+def peek_launch(batch):
+    with no_retrace(solve_kernel):  # noqa: F821
+        return np.asarray(solve_kernel(batch))  # flag: implicit readback
+
+
+# --- prng-key-reuse ------------------------------------------------------
+
+def sample_restarts(seed, n):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.uniform(key, (n,))
+    b = jax.random.normal(key, (n,))        # flag: key consumed twice
+    outs = []
+    for _ in range(n):
+        k = jax.random.PRNGKey(seed)        # flag: loop-invariant key
+        outs.append(jax.random.uniform(k, (4,)))
+    return a, b, outs

@@ -231,12 +231,25 @@ def test_no_retrace_window_flags_shape_drift():
 def test_no_retrace_window_flags_implicit_transfer():
     import jax.numpy as jnp
 
+    from nomad_tpu.analysis.launch_ledger import GLOBAL as ledger
     from nomad_tpu.tensor.jit_guard import no_retrace
 
+    base = len(ledger.violations)
     host = np.ones(8, np.float32)
-    with pytest.raises(Exception, match="[Tt]ransfer"):
-        with no_retrace():
-            _ = jnp.asarray(host) + 1.0  # implicit host->device ship
+    try:
+        with pytest.raises(Exception, match="[Tt]ransfer"):
+            with no_retrace():
+                _ = jnp.asarray(host) + 1.0  # implicit host->device ship
+        if ledger.active:
+            # the trip is attributed to the launch ledger (nomadjit) —
+            # asserted here, then scrubbed: it is this test's own bait
+            fresh = ledger.violations[base:]
+            assert any(v.kind == "unsanctioned-transfer" for v in fresh)
+    finally:
+        scrubbed = sum(1 for v in ledger.violations[base:]
+                       if v.kind == "unsanctioned-transfer")
+        del ledger.violations[base:]
+        ledger.stats["unsanctioned_transfers"] -= scrubbed
 
 
 def test_solve_batch_sharded_parity():

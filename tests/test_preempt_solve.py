@@ -6,6 +6,7 @@ sharded twin, the fitted restart portfolio regression, and the e2e
 placer paths (mirror + device, warm no-retrace)."""
 
 import random
+from copy import deepcopy
 
 import numpy as np
 import pytest
@@ -200,7 +201,8 @@ def test_build_victim_tensors_mirrors_candidates():
     nodes = [_filled_node(store) for _ in range(3)]
     _alloc_at(store, nodes[0], prio=20, cpu=300, mem=256)
     _alloc_at(store, nodes[0], prio=10, cpu=500, mem=128)
-    ported = _alloc_at(store, nodes[1], prio=15, cpu=200, mem=64)
+    # committed rows are shared MVCC history: copy before mutating
+    ported = deepcopy(_alloc_at(store, nodes[1], prio=15, cpu=200, mem=64))
     ported.allocated_ports = {"http": 8080}
     store.upsert_allocs([ported])
     # node 2 stays empty

@@ -31,7 +31,11 @@ ALL_RULES = ("fsm-determinism", "jax-hot-path", "lock-order",
              "thread-no-shutdown-join", "queue-enqueue-no-close-check",
              # nomadown ownership/aliasing rules (PR 9)
              "store-escape-mutation", "read-mutate-no-copy",
-             "propose-retain-alias", "publish-after-mutate")
+             "propose-retain-alias", "publish-after-mutate",
+             # nomadjit tensor determinism/launch rules (PR 16)
+             "reassociable-reduction-feeds-selection",
+             "host-sync-in-launch", "retrace-hazard",
+             "unguarded-launch", "prng-key-reuse")
 
 
 def _by_rule(findings):

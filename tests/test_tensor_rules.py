@@ -1,0 +1,227 @@
+"""Tier-1 gate for the nomadjit tensor prong (ANALYSIS.md "nomadjit").
+
+Four contracts:
+- each static rule flags its tensor_bad.py shapes and stays silent on
+  the disciplined tensor_clean.py counterparts;
+- the pinned determinism regression: batch_solver's portfolio metric
+  with its fixed-tree reduction swapped back to a plain ``.sum()`` (the
+  literal pre-PR-14 bug) MUST be flagged, and the shipped pairwise code
+  MUST stay silent — the rule can re-find the bug it encodes;
+- the repo itself carries ZERO tensor-rule findings and none are
+  baselined — findings are fixed in code, never allowlisted;
+- the launch ledger attributes compiles/transfers to the window that
+  launched them, turns warm-path compiles and extra host syncs into
+  violations, and the ``tensor_launch`` modelcheck scenario holds under
+  adversarial schedules.
+"""
+
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from nomad_tpu.analysis import load_baseline, run_analysis
+from nomad_tpu.analysis.launch_ledger import LaunchLedger
+from nomad_tpu.analysis.rules_tensor import TENSOR_RULES
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "fixtures" / "analysis"
+POSITIVE = FIXTURES / "positive"
+NEGATIVE = FIXTURES / "negative"
+BATCH_SOLVER = REPO / "nomad_tpu" / "tensor" / "batch_solver.py"
+
+PAIRWISE_RETURN = (
+    "return _pairwise_sum_xp(xp, placed.astype(per_node.dtype) * per_node)")
+RAW_SUM_RETURN = "return (placed.astype(per_node.dtype) * per_node).sum()"
+
+
+def _details(findings):
+    return sorted(f.detail for f in findings)
+
+
+def _run(path, rules):
+    return run_analysis(paths=[path], rules=list(rules), root=path.parent)
+
+
+# --- static rules: per-rule positive/negative fixtures -------------------
+
+def test_reassociable_reduction_fixture():
+    found = _run(POSITIVE / "tensor_bad.py",
+                 ["reassociable-reduction-feeds-selection"])
+    assert _details(found) == ["_score_xp#1", "psum#1", "sum#1"]
+    # the helper-source finding points at the CALL in the consumer, not
+    # the helper body — that is where the pairwise reroute goes
+    helper = next(f for f in found if f.detail == "_score_xp#1")
+    assert helper.context.endswith(":choose")
+
+
+def test_host_sync_in_launch_fixture():
+    found = _run(POSITIVE / "tensor_bad.py", ["host-sync-in-launch"])
+    assert _details(found) == [
+        ".item", "asarray:solve_kernel", "dup-get:solve_kernel"]
+
+
+def test_retrace_hazard_fixture():
+    found = _run(POSITIVE / "tensor_bad.py", ["retrace-hazard"])
+    assert _details(found) == [
+        "for-range:steps", "shape:steps", "slice:steps"]
+
+
+def test_unguarded_launch_fixture():
+    found = _run(POSITIVE / "tensor_bad.py", ["unguarded-launch"])
+    assert _details(found) == ["bare-device_put", "launch:solve_kernel"]
+
+
+def test_prng_key_reuse_fixture():
+    found = _run(POSITIVE / "tensor_bad.py", ["prng-key-reuse"])
+    assert _details(found) == ["loop-invariant-key", "reuse:key"]
+
+
+def test_clean_fixture_is_silent_under_every_tensor_rule():
+    assert _run(NEGATIVE / "tensor_clean.py", TENSOR_RULES) == []
+
+
+# --- the pinned determinism regression -----------------------------------
+
+def test_pinned_pre_pr14_packing_score_is_flagged(tmp_path):
+    """String-swap _packing_score_xp's fixed-tree reduction back to the
+    plain float ``.sum()`` it shipped with before PR 14 and run the
+    rule over the otherwise-identical module: the reassociation hazard
+    (portfolio scores compared across restarts/arms) must be re-found,
+    attributed to the jitted portfolio solve."""
+    src = BATCH_SOLVER.read_text()
+    assert PAIRWISE_RETURN in src, "pinned fixture drifted from source"
+    mutated = tmp_path / "batch_solver_pre_pr14.py"
+    mutated.write_text(src.replace(PAIRWISE_RETURN, RAW_SUM_RETURN))
+    found = _run(mutated, ["reassociable-reduction-feeds-selection"])
+    assert found, "the rule no longer catches the PR 14 determinism bug"
+    assert any("_packing_score_xp" in f.detail for f in found)
+    assert any(f.context.endswith(":solve_batch") for f in found)
+
+
+def test_shipped_pairwise_batch_solver_is_silent(tmp_path):
+    # the same module as shipped (pairwise reduction in place) carries
+    # no finding — copied out of the package so the rule runs with the
+    # everywhere scope it gets on fixture trees
+    clean = tmp_path / "batch_solver_shipped.py"
+    clean.write_text(BATCH_SOLVER.read_text())
+    assert _run(clean, ["reassociable-reduction-feeds-selection"]) == []
+
+
+# --- repo sweep: fixed in code, never baselined --------------------------
+
+def test_repo_is_clean_under_tensor_rules():
+    findings = run_analysis(rules=list(TENSOR_RULES))
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_no_tensor_findings_are_baselined():
+    assert not [k for k in load_baseline() if k[0] in TENSOR_RULES]
+
+
+def test_san_ok_comment_suppresses(tmp_path):
+    bad = (
+        "import jax\n"
+        "f = jax.jit(lambda a: a)\n"
+        "def run(x):\n"
+        "    return f(x)  # san-ok: cold diagnostic path\n")
+    p = tmp_path / "launchy.py"
+    p.write_text(bad)
+    assert _run(p, ["unguarded-launch"]) == []
+    p.write_text(bad.replace("  # san-ok: cold diagnostic path", ""))
+    flagged = _run(p, ["unguarded-launch"])
+    assert [f.detail for f in flagged] == ["launch:f"]
+
+
+# --- launch ledger: runtime attribution ----------------------------------
+
+@pytest.fixture
+def ledger():
+    """A private installed ledger (stacks over the global one when
+    NOMAD_TPU_SAN=1 — uninstall restores whatever was patched)."""
+    led = LaunchLedger()
+    led.install()
+    try:
+        yield led
+    finally:
+        led.uninstall()
+
+
+def test_ledger_attributes_cold_compile_and_transfers(ledger):
+    f = jax.jit(lambda a: a * 3.0 + 0.5)   # fresh callable: cold cache
+    x = np.ones((6,), np.float32)
+    with ledger.window("probe", key=(6,), warm=False) as rec:
+        dev = jax.device_put(x)
+        out = jax.device_get(f(dev))
+    assert out.shape == (6,)
+    assert rec.compiles >= 1
+    assert rec.puts == 1 and rec.gets == 1
+    assert any(site.startswith("compile@") for site in rec.sites)
+    assert any("test_tensor_rules.py" in site for site in rec.sites
+               if site.startswith(("put@", "get@")))
+    assert not rec.open
+    assert ledger.violations == []
+
+
+def test_ledger_warm_window_compile_is_a_violation(ledger):
+    f = jax.jit(lambda a: a * 5.0 - 2.0)
+    x = np.ones((7,), np.float32)
+    with ledger.window("probe", key=(7,), warm=True):
+        jax.device_get(f(jax.device_put(x)))
+    kinds = [v.kind for v in ledger.violations]
+    assert "warm-compile" in kinds
+    # and a warm window over the NOW-compiled shape is quiet
+    del ledger.violations[:]
+    with ledger.window("probe", key=(7,), warm=True) as rec:
+        jax.device_get(f(jax.device_put(x)))
+    assert rec.compiles == 0
+    assert ledger.violations == []
+
+
+def test_ledger_second_host_sync_is_a_violation(ledger):
+    f = jax.jit(lambda a: a + 4.0)
+    x = np.ones((5,), np.float32)
+    with ledger.window("probe", key=(5,), warm=False) as rec:
+        dev = jax.device_put(x)
+        jax.device_get(f(dev))
+        jax.device_get(f(dev))
+    assert rec.gets == 2
+    kinds = [v.kind for v in ledger.violations]
+    assert kinds.count("extra-host-sync") == 1
+
+
+def test_ledger_unsanctioned_transfer_and_check(ledger):
+    ledger.note_unsanctioned("a no_retrace window over ['probe']")
+    assert ledger.stats["unsanctioned_transfers"] == 1
+    with pytest.raises(AssertionError, match="unsanctioned-transfer"):
+        ledger.check()
+
+
+def test_ledger_strict_verify_reports_leaked_window(ledger):
+    win = ledger.window("leaky", key=(3,), warm=False)
+    win.__enter__()
+    try:
+        assert any("leaked-window" in p
+                   for p in ledger.verify_all(strict=True))
+        # the concurrent (non-strict) sweep treats it as in flight
+        assert ledger.verify_all() == []
+    finally:
+        win.__exit__(None, None, None)
+    assert ledger.verify_all(strict=True) == []
+
+
+def test_inactive_ledger_windows_are_noops():
+    led = LaunchLedger()
+    with led.window("off", key=(1,), warm=True) as rec:
+        pass
+    assert rec is None
+    led.note_unsanctioned("nowhere")
+    assert led.stats["unsanctioned_transfers"] == 0
+    assert len(led.records) == 0
+
+
+def test_tensor_launch_scenario_holds():
+    from nomad_tpu.analysis import modelcheck as mc
+    r = mc.run_scenario("tensor_launch", seed=0)
+    assert r.ok, r.error
