@@ -45,6 +45,9 @@ def main(argv=None) -> int:
     parser.add_argument("--tensor", action="store_true",
                         help="run only the nomadjit tensor determinism/"
                              "launch-discipline rules (see ANALYSIS.md)")
+    parser.add_argument("--flow", action="store_true",
+                        help="run only the nomadflow mutation→event "
+                             "completeness rules (see ANALYSIS.md)")
     parser.add_argument("--modelcheck", action="store_true",
                         help="run the deterministic interleaving model "
                              "checker (nomadcheck dynamic prong) and exit")
@@ -61,6 +64,10 @@ def main(argv=None) -> int:
     if args.tensor:
         from .rules_tensor import TENSOR_RULES
         args.rules = (args.rules or []) + list(TENSOR_RULES)
+
+    if args.flow:
+        from .rules_flow import FLOW_RULES
+        args.rules = (args.rules or []) + list(FLOW_RULES)
 
     if args.modelcheck:
         from .modelcheck import seed_from_env, smoke

@@ -108,8 +108,8 @@ def rule(rule_id: str, doc: str) -> Callable[[RuleFn], RuleFn]:
 
 def all_rules() -> Dict[str, Tuple[RuleFn, str]]:
     # importing the rule modules populates the registry
-    from . import (rules_concurrency, rules_fsm, rules_hygiene,  # noqa: F401
-                   rules_jax, rules_ownership, rules_tensor)
+    from . import (rules_concurrency, rules_flow, rules_fsm,  # noqa: F401
+                   rules_hygiene, rules_jax, rules_ownership, rules_tensor)
     return dict(_RULES)
 
 

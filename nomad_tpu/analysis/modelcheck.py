@@ -900,10 +900,10 @@ def _preload() -> None:
     from ..core import broker, events, heartbeat, metrics, plan_apply  # noqa: F401
     from ..obs import trace  # noqa: F401
     from ..raft import durable, fsm, node, transport  # noqa: F401
-    from ..state import store, watch  # noqa: F401
-    from ..structs import evaluation  # noqa: F401
+    from ..state import persist, store, watch  # noqa: F401
+    from ..structs import alloc, evaluation, node  # noqa: F401
     from ..tensor import jit_guard, placer  # noqa: F401  (module locks)
-    from . import launch_ledger, ownership  # noqa: F401
+    from . import launch_ledger, ownership, shadow  # noqa: F401
 
     # jax imports big chunks of its compile path lazily on the FIRST
     # compile (jax._src.compilation_cache among them, whose module-level
@@ -1984,10 +1984,92 @@ def _scenario_tensor_launch(env: ScenarioEnv) -> None:
             launch_ledger.uninstall()
 
 
+@scenario("event_flow")
+def _scenario_event_flow(env: ScenarioEnv) -> None:
+    """nomadflow integration: a store + event broker with a shadow
+    replica attached, driven by concurrent mutators covering every
+    Allocation/Node/Evaluation delta kind — bulk upserts, client status
+    updates (including terminal flips), eval churn with deletes, a
+    terminal-alloc GC sweep, and an operator dump/restore that forces
+    the full-ring truncation → resync path. After every writer joins,
+    the replica's fingerprint compare against a fresh MVCC snapshot
+    rebuild (usage columns included) must be exact: under ANY
+    interleaving the event stream carries enough information to
+    reconstruct the store, or a consumer somewhere is silently stale.
+
+    tests/test_flow_rules.py replays this scenario at a pinned seed
+    with a delta kind suppressed to prove the compare actually bites."""
+    import numpy as np
+
+    from ..core.events import EventBroker
+    from ..state.persist import dump_store, restore_store
+    from ..state.store import StateStore
+    from ..structs.alloc import Allocation
+    from ..structs.evaluation import Evaluation
+    from ..structs.node import Node
+    from . import shadow as shadow_mod
+
+    store = StateStore()
+    broker = EventBroker(store, ring_size=32, shards=2)
+    tracker = shadow_mod.ShadowTracker(every=3)
+    tracker.install()
+    rep = tracker.attach(store, broker)
+
+    def write_nodes() -> None:
+        for i in range(4):
+            store.upsert_node(Node(id=f"fn{i}"))
+        # rewrite a node (same id, new status) — the upsert event must
+        # carry the new row, not the old
+        store.upsert_node(Node(id="fn0", status="down"))
+
+    def write_evals() -> None:
+        store.upsert_evals([Evaluation(id=f"fe{i}", job_id="fj")
+                            for i in range(5)])
+        store.delete_evals(["fe1", "fe3"])
+
+    def write_allocs() -> None:
+        allocs = []
+        for i in range(6):
+            a = Allocation(id=f"fa{i}", node_id=f"fn{i % 4}",
+                           job_id="fj", eval_id="fe0")
+            a.allocated_vec = np.full_like(a.allocated_vec,
+                                           float(i + 1))
+            allocs.append(a)
+        store.upsert_allocs(allocs)
+        # client flips two to terminal, then GC reaps the orphans
+        # (no job row exists, so terminal allocs are collectable)
+        for aid in ("fa1", "fa4"):
+            upd = Allocation(id=aid, client_status="complete")
+            store.update_allocs_from_client([upd])
+        store.gc_terminal_allocs(before_index=store._index + 1)
+
+    def restore_leg() -> None:
+        # operator restore: the broker truncates every ring and the
+        # replica must resync instead of patching a holey stream
+        restore_store(store, dump_store(store))
+        store.upsert_node(Node(id="fn-post-restore"))
+
+    threads = [threading.Thread(target=write_nodes, name="flow-nodes"),
+               threading.Thread(target=write_evals, name="flow-evals"),
+               threading.Thread(target=write_allocs, name="flow-allocs"),
+               threading.Thread(target=restore_leg, name="flow-restore")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    msg = rep.force_compare()
+    if msg is not None:
+        raise AssertionError(f"shadow diverged: {msg}")
+    if tracker.violations:
+        raise AssertionError("shadow tracker tripped: "
+                             + tracker.violations[0].render())
+
+
 SMOKE_SCENARIOS = ("raft_commit", "raft_stepdown", "read_index",
                    "snapshot_compact",
                    "plan_pipeline", "broker_batch", "solve_batch",
-                   "store_ownership", "node_lifecycle", "tensor_launch")
+                   "store_ownership", "node_lifecycle", "tensor_launch",
+                   "event_flow")
 
 
 def smoke(base_seed: int, seeds_per_scenario: int = 3,

@@ -1,0 +1,538 @@
+"""nomadflow static prong: mutation→event completeness rules.
+
+The device-resident incremental cluster state (ROADMAP) consumes the
+broker's commit stream as THE source of truth for what changed — as
+AllocSyncHub already does. That is only sound if the stream is a
+*complete, ordered, keyed* function of store commits. These five
+pure-AST rules prove the shape of that contract; the runtime half
+(analysis/shadow.py) proves the values.
+
+The table→topic map is derived, not hand-written: ``TOPIC_FOR_KIND``
+(core/events.py) gives kind→topic, and every ``VersionedTable("<name>")``
+binding in state/store.py gives attr→table. A table maps to a topic when
+its dash-singularized name prefixes kinds of exactly one topic
+("alloc_blocks" → "alloc-block-*" → Allocation); tables whose names
+prefix no kind (volumes, secondary indexes, usage columns) carry no
+delta obligation.
+
+Rules (all suppressible with ``# san-ok: <why>``, never baselined):
+
+``flow-mutation-without-delta`` — an FSM-reachable store mutator (the
+raft/fsm.py MUTATIONS dispatch surface) whose call closure writes a
+delta-consumed table but emits no event kind on that table's topic. A
+closure that emits the ``restore`` sentinel is exempt: the broker turns
+it into a full ring truncation, so every subscriber resyncs anyway.
+
+``flow-publish-before-commit`` — (a) a function that publishes an event
+and THEN runs the store mutation it describes: a woken subscriber can
+snapshot before the commit and see stale state; (b) a commit
+implementation that runs its listener fan-out before publishing the new
+index.
+
+``flow-delta-payload-narrowing`` — a dict-literal event payload that
+omits a field some in-scope subscriber of that topic reads off the
+payload (interprocedural: consumer field sets are collected per
+subscribing module, ``getattr(payload, ...)`` and ``*.payload``
+projections included).
+
+``flow-resync-gap-unhandled`` — a consumer that calls
+``Subscription.next_events`` without ever reading ``.truncated``
+(gap-unchecked), or reads it but neither triggers a resync/snapshot
+re-read nor acknowledges the flag (gap-unhandled). Returning the flag
+to the caller (the ``events_after`` shape) counts as propagation.
+
+``flow-unkeyed-delta`` — an event ring append carrying the literal
+index 0 instead of a store generation: index-0 events sort before
+everything in cross-shard merges and give cursors nothing to resume
+from.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import CallGraph, FuncInfo
+from .core import AnalysisContext, Finding, Module, in_scope, rule
+from .rules_concurrency import _suppressed
+
+# Producers (store mutators, the broker) live in state/core/raft;
+# consumers of the stream additionally live in the API layer (the
+# ndjson event stream), so the consumer-side rules scan there too.
+FLOW_SCOPE = ("state", "core", "raft")
+CONSUMER_SCOPE = ("state", "core", "raft", "api")
+
+# Event kinds that invalidate EVERY topic: the broker truncates all
+# rings on them, forcing each subscriber through its resync path, so a
+# mutator emitting one owes no per-table deltas.
+RESYNC_KINDS = frozenset({"restore"})
+
+FLOW_RULES = (
+    "flow-mutation-without-delta",
+    "flow-publish-before-commit",
+    "flow-delta-payload-narrowing",
+    "flow-resync-gap-unhandled",
+    "flow-unkeyed-delta",
+)
+
+
+# --- table→topic map -----------------------------------------------------
+
+def build_topic_map(modules: List[Module]
+                    ) -> Tuple[Dict[str, str], Dict[str, str]]:
+    """-> (kind→topic, table_attr→topic), both derived from the ASTs."""
+    kind_topic: Dict[str, str] = {}
+    tables: Dict[str, str] = {}          # attr name -> table ctor name
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Name) and tgt.id == "TOPIC_FOR_KIND"
+                        and isinstance(node.value, ast.Dict)):
+                    for k, v in zip(node.value.keys, node.value.values):
+                        if (isinstance(k, ast.Constant)
+                                and isinstance(k.value, str)
+                                and isinstance(v, ast.Constant)
+                                and isinstance(v.value, str)):
+                            kind_topic[k.value] = v.value
+                elif (isinstance(tgt, ast.Attribute)
+                        and isinstance(node.value, ast.Call)):
+                    fn = node.value.func
+                    ctor = (fn.id if isinstance(fn, ast.Name)
+                            else fn.attr if isinstance(fn, ast.Attribute)
+                            else None)
+                    if (ctor == "VersionedTable" and node.value.args
+                            and isinstance(node.value.args[0], ast.Constant)
+                            and isinstance(node.value.args[0].value, str)):
+                        tables[tgt.attr] = node.value.args[0].value
+    table_topic: Dict[str, str] = {}
+    for attr, tname in tables.items():
+        singular = tname[:-1] if tname.endswith("s") else tname
+        prefix = singular.replace("_", "-") + "-"
+        topics = {t for k, t in kind_topic.items() if k.startswith(prefix)}
+        if len(topics) == 1:
+            table_topic[attr] = topics.pop()
+    return kind_topic, table_topic
+
+
+def _mutation_names(modules: List[Module]) -> Set[str]:
+    """Names in module-level MUTATIONS set literals (the FSM dispatch
+    surface, raft/fsm.py)."""
+    names: Set[str] = set()
+    for mod in modules:
+        for stmt in mod.tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            for tgt in stmt.targets:
+                if (isinstance(tgt, ast.Name) and tgt.id == "MUTATIONS"
+                        and isinstance(stmt.value, ast.Set)):
+                    for elt in stmt.value.elts:
+                        if (isinstance(elt, ast.Constant)
+                                and isinstance(elt.value, str)):
+                            names.add(elt.value)
+    return names
+
+
+def _scoped(ctx: AnalysisContext, subdirs) -> List[Module]:
+    return [m for m in ctx.modules if in_scope(m.rel, subdirs)]
+
+
+def _nested_def_nodes(fn_node: ast.AST) -> Set[int]:
+    """ids of every node inside a def/lambda nested under fn_node —
+    deferred code, not part of fn's own execution order."""
+    inner: Set[int] = set()
+    for sub in ast.walk(fn_node):
+        if sub is fn_node:
+            continue
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            inner.update(id(n) for n in ast.walk(sub))
+    return inner
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+# --- rule 1: mutation-without-delta --------------------------------------
+
+def _table_writes(fn_node: ast.AST, table_topic: Dict[str, str]):
+    """(table_attr, call node) for every ``*._table.put/.delete`` in the
+    subtree — attribute-chain writes (``store._nodes.put``) included."""
+    out = []
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if (isinstance(func, ast.Attribute)
+                and func.attr in ("put", "delete")
+                and isinstance(func.value, ast.Attribute)
+                and func.value.attr in table_topic):
+            out.append((func.value.attr, node))
+    return out
+
+
+def _emitted_kinds(closure, kind_topic: Dict[str, str]) -> Set[str]:
+    """Every string constant in the closure that names an event kind —
+    deliberately over-approximate (call-site literals like
+    ``self._update_node(id, "node-drain", mut)`` count)."""
+    out: Set[str] = set()
+    for fn in closure:
+        for node in ast.walk(fn.node):
+            if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                    and (node.value in kind_topic
+                         or node.value in RESYNC_KINDS)):
+                out.add(node.value)
+    return out
+
+
+@rule("flow-mutation-without-delta",
+      "an FSM-reachable store mutator writes a delta-consumed table "
+      "without publishing on that table's topic")
+def check_mutation_without_delta(ctx: AnalysisContext) -> List[Finding]:
+    modules = _scoped(ctx, FLOW_SCOPE)
+    kind_topic, table_topic = build_topic_map(modules)
+    if not table_topic:
+        return []
+    cg = CallGraph(modules)
+    names = _mutation_names(modules)
+    roots = [f for f in cg.functions if f.name in names]
+    by_rel = {m.rel: m for m in modules}
+    findings: List[Finding] = []
+    for root in sorted(roots, key=lambda f: (f.module_rel, f.qualname)):
+        closure = cg.reachable([root])
+        kinds = _emitted_kinds(closure, kind_topic)
+        if kinds & RESYNC_KINDS:
+            continue
+        covered = {kind_topic[k] for k in kinds if k in kind_topic}
+        seen: Set[str] = set()
+        for fn in sorted(closure, key=lambda f: (f.module_rel, f.qualname)):
+            mod = by_rel[fn.module_rel]
+            for table_attr, call in _table_writes(fn.node, table_topic):
+                topic = table_topic[table_attr]
+                if topic in covered or table_attr in seen:
+                    continue
+                if _suppressed(mod, call.lineno):
+                    seen.add(table_attr)
+                    continue
+                seen.add(table_attr)
+                findings.append(Finding(
+                    rule="flow-mutation-without-delta",
+                    path=fn.module_rel, line=call.lineno, severity="error",
+                    message=(f"store mutator '{root.name}' writes "
+                             f"{table_attr} (topic {topic}) but its call "
+                             f"closure publishes no {topic} event — delta "
+                             "consumers (AllocSyncHub, the shadow store, "
+                             "the incremental tensor state) silently "
+                             "diverge; emit a mapped kind or the "
+                             "'restore' resync sentinel"),
+                    context=f"{root.module_rel}:{root.qualname}",
+                    detail=f"{root.name}:{table_attr}"))
+    return findings
+
+
+# --- rule 2: publish-before-commit ---------------------------------------
+
+@rule("flow-publish-before-commit",
+      "event published before the store mutation/index bump that makes "
+      "the state visible")
+def check_publish_before_commit(ctx: AnalysisContext) -> List[Finding]:
+    modules = _scoped(ctx, FLOW_SCOPE)
+    cg = CallGraph(modules)
+    mutators = _mutation_names(modules) | {"_commit"}
+    by_rel = {m.rel: m for m in modules}
+    findings: List[Finding] = []
+    for fn in sorted(cg.functions, key=lambda f: (f.module_rel, f.qualname)):
+        mod = by_rel[fn.module_rel]
+        inner = _nested_def_nodes(fn.node)
+
+        # shape (a): .publish(...) textually before a store mutation in
+        # the same (non-deferred) body
+        publishes = []
+        mut_calls = []
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call) or id(node) in inner:
+                continue
+            name = _call_name(node)
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "publish"):
+                publishes.append(node)
+            elif name in mutators:
+                mut_calls.append((node.lineno, name))
+        for pub in publishes:
+            later = [n for ln, n in mut_calls if ln > pub.lineno]
+            if not later or _suppressed(mod, pub.lineno):
+                continue
+            findings.append(Finding(
+                rule="flow-publish-before-commit",
+                path=fn.module_rel, line=pub.lineno, severity="error",
+                message=(f"event published before the '{later[0]}' store "
+                         "mutation in the same function — a woken "
+                         "subscriber can snapshot stale state; commit "
+                         "first, then publish"),
+                context=f"{fn.module_rel}:{fn.qualname}",
+                detail=f"publish-before:{later[0]}"))
+
+        # shape (b): commit implementation fanning out to listeners
+        # before publishing the new index
+        index_lines = [n.lineno for n in ast.walk(fn.node)
+                       if isinstance(n, ast.Assign)
+                       and any(isinstance(t, ast.Attribute)
+                               and t.attr == "_index"
+                               and isinstance(t.value, ast.Name)
+                               and t.value.id == "self"
+                               for t in n.targets)]
+        loop_lines = []
+        for n in ast.walk(fn.node):
+            if not isinstance(n, ast.For):
+                continue
+            it = n.iter
+            name = (it.attr if isinstance(it, ast.Attribute)
+                    else it.id if isinstance(it, ast.Name) else "")
+            if "listener" in name and any(isinstance(c, ast.Call)
+                                          for b in n.body
+                                          for c in ast.walk(b)):
+                loop_lines.append(n.lineno)
+        if index_lines and loop_lines \
+                and min(loop_lines) < min(index_lines) \
+                and not _suppressed(mod, min(loop_lines)):
+            findings.append(Finding(
+                rule="flow-publish-before-commit",
+                path=fn.module_rel, line=min(loop_lines), severity="error",
+                message=("commit listeners run before the index is "
+                         "published — a listener-woken reader blocks on "
+                         "an index the store claims not to have"),
+                context=f"{fn.module_rel}:{fn.qualname}",
+                detail="listeners-before-index"))
+    return findings
+
+
+# --- rule 3: delta-payload-narrowing -------------------------------------
+
+def _subscribed_topics(tree: ast.AST) -> Set[str]:
+    """Topic keys of every ``.subscribe({dict literal})`` in the tree."""
+    topics: Set[str] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "subscribe" and node.args
+                and isinstance(node.args[0], ast.Dict)):
+            for k in node.args[0].keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    topics.add(k.value)
+    return topics
+
+
+def _payload_field_reads(tree: ast.AST) -> Set[str]:
+    """Fields projected off event payloads anywhere in the tree:
+    ``x = ev.payload; x.f``, ``ev.payload.f``, and
+    ``getattr(<payload-derived>, "f", ...)``. Function parameters
+    literally named ``payload`` are payload-derived (the helper-call
+    convention)."""
+    derived: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for a in node.args.args:
+                if a.arg == "payload":
+                    derived.add("payload")
+        elif (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr == "payload"):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    derived.add(t.id)
+
+    def _is_derived(expr: ast.expr) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in derived
+        return isinstance(expr, ast.Attribute) and expr.attr == "payload"
+
+    fields: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and _is_derived(node.value):
+            fields.add(node.attr)
+        elif (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "getattr" and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+                and _is_derived(node.args[0])):
+            fields.add(node.args[1].value)
+    return fields
+
+
+@rule("flow-delta-payload-narrowing",
+      "a dict-literal event payload omits fields a subscriber of that "
+      "topic reads")
+def check_payload_narrowing(ctx: AnalysisContext) -> List[Finding]:
+    consumers = _scoped(ctx, CONSUMER_SCOPE)
+    producers = _scoped(ctx, FLOW_SCOPE)
+    kind_topic, _ = build_topic_map(consumers)
+
+    fields_by_topic: Dict[str, Set[str]] = {}
+    for mod in consumers:
+        topics = _subscribed_topics(mod.tree)
+        if not topics:
+            continue
+        fields = _payload_field_reads(mod.tree)
+        for t in topics:
+            fields_by_topic.setdefault(t, set()).update(fields)
+
+    def _needed(topic: str) -> Set[str]:
+        return (fields_by_topic.get(topic, set())
+                | fields_by_topic.get("*", set()))
+
+    findings: List[Finding] = []
+    for mod in producers:
+        sites = []          # (topic, dict node)
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "publish"
+                    and len(node.args) >= 3
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and isinstance(node.args[2], ast.Dict)):
+                sites.append((node.args[0].value, node.args[2]))
+            elif (isinstance(node, ast.Tuple) and len(node.elts) == 2
+                    and isinstance(node.elts[0], ast.Constant)
+                    and isinstance(node.elts[0].value, str)
+                    and node.elts[0].value in kind_topic
+                    and isinstance(node.elts[1], ast.Dict)):
+                sites.append((kind_topic[node.elts[0].value], node.elts[1]))
+        for topic, payload in sites:
+            needed = _needed(topic)
+            if not needed:
+                continue
+            keys = {k.value for k in payload.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+            if any(k is None for k in payload.keys):
+                continue    # **spread: keys unknowable, stay silent
+            if _suppressed(mod, payload.lineno):
+                continue
+            for fieldname in sorted(needed - keys):
+                findings.append(Finding(
+                    rule="flow-delta-payload-narrowing",
+                    path=mod.rel, line=payload.lineno, severity="error",
+                    message=(f"payload for topic {topic} omits '{fieldname}'"
+                             " — a subscriber of this topic reads it off "
+                             "the payload and will see its default "
+                             "instead of the value"),
+                    context=mod.enclosing_function(payload),
+                    detail=f"narrowed:{topic}:{fieldname}"))
+    return findings
+
+
+# --- rule 4: resync-gap-unhandled ----------------------------------------
+
+@rule("flow-resync-gap-unhandled",
+      "a subscription consumer ignores or fails to act on the ring "
+      "truncation flag")
+def check_resync_gap(ctx: AnalysisContext) -> List[Finding]:
+    modules = _scoped(ctx, CONSUMER_SCOPE)
+    cg = CallGraph(modules)
+    by_rel = {m.rel: m for m in modules}
+    findings: List[Finding] = []
+    for fn in sorted(cg.functions, key=lambda f: (f.module_rel, f.qualname)):
+        next_calls = [n for n in ast.walk(fn.node)
+                      if isinstance(n, ast.Call)
+                      and isinstance(n.func, ast.Attribute)
+                      and n.func.attr == "next_events"]
+        if not next_calls:
+            continue
+        mod = by_rel[fn.module_rel]
+        in_return: Set[int] = set()
+        for n in ast.walk(fn.node):
+            if isinstance(n, ast.Return):
+                in_return.update(id(c) for c in ast.walk(n))
+        reads = [n for n in ast.walk(fn.node)
+                 if isinstance(n, ast.Attribute) and n.attr == "truncated"
+                 and isinstance(n.ctx, ast.Load)]
+        site = next_calls[0]
+        if not reads:
+            if not _suppressed(mod, site.lineno):
+                findings.append(Finding(
+                    rule="flow-resync-gap-unhandled",
+                    path=fn.module_rel, line=site.lineno, severity="error",
+                    message=("next_events consumer never reads "
+                             ".truncated — a lapped ring silently drops "
+                             "deltas and this consumer's view diverges "
+                             "forever; check the flag and resync from a "
+                             "snapshot"),
+                    context=f"{fn.module_rel}:{fn.qualname}",
+                    detail="gap-unchecked"))
+            continue
+        if all(id(r) in in_return for r in reads):
+            continue        # propagated to the caller (events_after shape)
+        acks = [n for n in ast.walk(fn.node)
+                if (isinstance(n, ast.Assign)
+                    and any(isinstance(t, ast.Attribute)
+                            and ("resync" in t.attr
+                                 or t.attr == "truncated")
+                            for t in n.targets))
+                or (isinstance(n, ast.Call)
+                    and any(tok in (_call_name(n) or "")
+                            for tok in ("resync", "snapshot", "restore",
+                                        "rebuild")))]
+        if not acks and not _suppressed(mod, reads[0].lineno):
+            findings.append(Finding(
+                rule="flow-resync-gap-unhandled",
+                path=fn.module_rel, line=reads[0].lineno, severity="error",
+                message=("truncation flag read but never acted on — set "
+                         "the resync flag / re-read a snapshot (and clear "
+                         ".truncated) so the gap is actually healed"),
+                context=f"{fn.module_rel}:{fn.qualname}",
+                detail="gap-unhandled"))
+    return findings
+
+
+# --- rule 5: unkeyed-delta -----------------------------------------------
+
+@rule("flow-unkeyed-delta",
+      "event ring append carries literal index 0 instead of a store "
+      "generation")
+def check_unkeyed_delta(ctx: AnalysisContext) -> List[Finding]:
+    modules = _scoped(ctx, FLOW_SCOPE)
+    findings: List[Finding] = []
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            zero_at = None
+            if name == "_publish_shard":
+                if (len(node.args) >= 3
+                        and isinstance(node.args[2], ast.Constant)
+                        and node.args[2].value == 0):
+                    zero_at = "_publish_shard"
+            elif name == "Event":
+                if (len(node.args) >= 2
+                        and isinstance(node.args[1], ast.Constant)
+                        and node.args[1].value == 0):
+                    zero_at = "Event"
+            if zero_at is None:
+                for kw in node.keywords:
+                    if (name in ("_publish_shard", "Event")
+                            and kw.arg == "index"
+                            and isinstance(kw.value, ast.Constant)
+                            and kw.value.value == 0):
+                        zero_at = name
+            if zero_at is None or _suppressed(mod, node.lineno):
+                continue
+            findings.append(Finding(
+                rule="flow-unkeyed-delta",
+                path=mod.rel, line=node.lineno, severity="error",
+                message=(f"{zero_at} called with literal index 0 — "
+                         "index-0 events sort before every commit in "
+                         "cross-shard merges and leave cursors nothing "
+                         "to resume from; stamp the last committed "
+                         "store index"),
+                context=mod.enclosing_function(node),
+                detail=f"index-0:{zero_at}"))
+    return findings

@@ -1,0 +1,387 @@
+"""nomadflow runtime prong: the shadow-state differential sanitizer.
+
+The static rules (rules_flow.py) prove the mutation→event contract's
+*shape*; this module proves its *values*. Enabled via ``NOMAD_TPU_SAN=1``
+(tests/conftest.py installs it with the other runtime prongs), it
+attaches one :class:`ShadowReplica` per (store, broker) pair — the
+server wires this automatically at broker construction — which
+
+- subscribes to the broker's Allocation/Node/Evaluation topics and
+  replays every delta into reduced replicas: alloc rows keyed by id
+  (modify index, statuses, node, resource vector), node and eval rows
+  keyed by id, columnar ``AllocBlock`` payloads expanded through the
+  same ``iter_allocs`` materialization the MVCC snapshot uses, promoted
+  block positions overridden by their row events exactly as the store
+  overrides them;
+- treats ring truncation and the ``restore`` sentinel as a RESYNC, not
+  a violation: the replica rebuilds from a fresh snapshot, which is the
+  contract every delta consumer (AllocSyncHub today, the device-resident
+  incremental state next) must honor;
+- every K commits — and on demand from the chaos invariant sweep
+  (``check_event_completeness``, invariant 8) — fingerprint-compares the
+  replicas against a fresh MVCC snapshot rebuild, per-node usage columns
+  included, computed on BOTH sides by the same vectorized scatter
+  (:func:`usage_columns`, the PR 10 columnar path) over identically
+  sorted rows so float sums are bit-exact by construction. Any
+  divergence — a missed delta, a reordered overwrite, a narrowed
+  payload — is a violation.
+
+The replay runs inline on the commit listener (serialized under the
+store's write lock, after the broker's own listener has appended the
+events), so the drained subscription is always exactly caught up with
+the commit being compared — the gauge ``nomad.events.delta_lag`` (commit
+index minus shadow-applied index) therefore reads 0 until consumption
+moves off the commit path, which is precisely the number the
+incremental-state PR will watch grow.
+
+Violations never raise at the commit site (that would poison the store's
+write path mid-transaction); they accumulate on the tracker and the
+pytest plugin fails the session exit-3, same as nomadsan/nomadown/
+nomadjit. Tests build private :class:`ShadowTracker` instances.
+"""
+
+from __future__ import annotations
+
+import _thread
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+_REAL_LOCK = _thread.allocate_lock
+
+# default commit cadence between fingerprint compares; the chaos sweep
+# and scenario teardowns force extra compares on top
+COMPARE_EVERY = 64
+# bounded diff rendering: enough ids to diagnose, never enough to flood
+MAX_DIFF_IDS = 8
+
+NODE_KINDS = ("node-upsert", "node-status", "node-eligibility",
+              "node-drain")
+ALLOC_ROW_KINDS = ("alloc-upsert", "alloc-stop", "alloc-preempt",
+                   "alloc-client-update", "alloc-transition")
+CLIENT_TERMINAL = ("complete", "failed", "lost")
+
+SHADOW_TOPICS = {"Allocation": ["*"], "Node": ["*"], "Evaluation": ["*"]}
+
+
+def _client_terminal(status: str) -> bool:
+    return status in CLIENT_TERMINAL
+
+
+def _alloc_entry(a) -> tuple:
+    vec = a.allocated_vec
+    return (a.modify_index, a.client_status, a.desired_status, a.node_id,
+            None if vec is None else vec.tobytes())
+
+
+def _node_entry(n) -> tuple:
+    return (n.modify_index, n.status, n.scheduling_eligibility)
+
+
+def _eval_entry(e) -> tuple:
+    return (e.modify_index, e.status)
+
+
+def usage_columns(allocs: Dict[str, tuple]) -> Dict[str, bytes]:
+    """Per-node usage columns from reduced alloc entries via ONE
+    vectorized scatter-add (the persist._block_usage_into idiom). Rows
+    are stacked in sorted (node, alloc-id) order, so two entry maps
+    with equal contents produce bit-identical float sums — the compare
+    can demand exact equality, no tolerance."""
+    live = [(e[3], aid, e[4]) for aid, e in allocs.items()
+            if not _client_terminal(e[1]) and e[4] is not None]
+    if not live:
+        return {}
+    live.sort(key=lambda t: (t[0], t[1]))
+    node_ids = sorted({nid for nid, _, _ in live})
+    idx = {n: i for i, n in enumerate(node_ids)}
+    rows = np.fromiter((idx[nid] for nid, _, _ in live), np.int64,
+                       count=len(live))
+    vecs = np.stack([np.frombuffer(b, dtype=np.float64)
+                     for _, _, b in live])
+    mat = np.zeros((len(node_ids), vecs.shape[1]), vecs.dtype)
+    np.add.at(mat, rows, vecs)
+    return {n: mat[i].tobytes() for n, i in idx.items()}
+
+
+@dataclass
+class Violation:
+    kind: str            # "missed-delta" | "shadow-divergence"
+    message: str
+    stack: List[str] = field(default_factory=list)
+
+    def render(self) -> str:
+        return f"[{self.kind}] {self.message}"
+
+
+def _diff_maps(label: str, shadow: dict, truth: dict) -> List[str]:
+    out = []
+    missing = sorted(set(truth) - set(shadow))
+    extra = sorted(set(shadow) - set(truth))
+    stale = sorted(k for k in shadow.keys() & truth.keys()
+                   if shadow[k] != truth[k])
+    if missing:
+        out.append(f"{label}: {len(missing)} id(s) in the store but never "
+                   f"delivered as deltas {missing[:MAX_DIFF_IDS]}")
+    if extra:
+        out.append(f"{label}: {len(extra)} id(s) delivered as deltas but "
+                   f"absent from the store {extra[:MAX_DIFF_IDS]}")
+    if stale:
+        k = stale[0]
+        out.append(f"{label}: {len(stale)} id(s) stale "
+                   f"{stale[:MAX_DIFF_IDS]}; first: shadow={shadow[k]!r} "
+                   f"store={truth[k]!r}")
+    return out
+
+
+class ShadowReplica:
+    """Event-derived reduction of one store, compared against MVCC
+    snapshot rebuilds every `every` commits."""
+
+    def __init__(self, store, broker, tracker: "ShadowTracker",
+                 every: int = COMPARE_EVERY):
+        self.store = store
+        self.tracker = tracker
+        self.every = max(1, every)
+        self.sub = broker.subscribe(dict(SHADOW_TOPICS))
+        self.allocs: Dict[str, tuple] = {}
+        self.nodes: Dict[str, tuple] = {}
+        self.evals: Dict[str, tuple] = {}
+        self._promoted: Set[str] = set()
+        self.applied_index = 0
+        self.commits = 0
+        self.compares = 0
+        self.resyncs = 0
+        # raw lock: the listener runs under the store's (instrumented)
+        # write lock; the shadow's own serialization must not feed the
+        # sanitizer's lock-order graph
+        self._lock = _REAL_LOCK()
+        self._resync_locked()   # adopt whatever state predates the attach
+        store.add_commit_listener(self._on_commit)
+
+    # -- commit listener ----------------------------------------------
+
+    def _on_commit(self, gen: int, events: list) -> None:
+        if not self.tracker.active:
+            return
+        from ..core.metrics import REGISTRY
+        with self._lock:
+            evs = self.sub.next_events(timeout=0)
+            if self.sub.truncated:
+                # a lapped ring or the restore sentinel: the contract
+                # answer is a full resync, never incremental patching
+                self.sub.truncated = False
+                self._resync_locked()
+            else:
+                for e in evs:
+                    self._apply(e)
+            self.applied_index = gen
+            self.commits += 1
+            REGISTRY.set_gauge("nomad.events.delta_lag",
+                               float(self.store._index - self.applied_index))
+            if self.commits % self.every == 0:
+                self._compare_locked()
+
+    # -- delta replay --------------------------------------------------
+
+    def _apply(self, e) -> None:
+        kind = e.type
+        p = e.payload
+        if kind in ALLOC_ROW_KINDS:
+            self.allocs[p.id] = _alloc_entry(p)
+            if "." in p.id:
+                # a materialized block position got its own row: the row
+                # now overrides the block wherever both are visible
+                self._promoted.add(p.id)
+        elif kind == "alloc-block-upsert":
+            self._apply_block(p)
+        elif kind == "alloc-gc":
+            for aid in p:
+                self.allocs.pop(aid, None)
+                self._promoted.discard(aid)
+        elif kind in NODE_KINDS:
+            self.nodes[p.id] = _node_entry(p)
+        elif kind == "node-delete":
+            self.nodes.pop(p.id, None)
+        elif kind == "eval-upsert":
+            self.evals[p.id] = _eval_entry(p)
+        elif kind == "eval-delete":
+            for eid in p:
+                self.evals.pop(eid, None)
+        # other kinds (Job/Deployment topics, direct scheduler signals)
+        # are not part of the reduced replica
+
+    def _apply_block(self, block) -> None:
+        from ..structs.alloc import BLOCK_SEP
+        prefix = f"{block.id}{BLOCK_SEP}"
+        live: Set[str] = set()
+        for a in block.iter_allocs():
+            live.add(a.id)
+            if a.id not in self._promoted:
+                self.allocs[a.id] = _alloc_entry(a)
+        # a re-upserted block can only shrink its visible set (rejected
+        # rows / dropped positions); forget what fell out
+        for aid in [k for k in self.allocs
+                    if k.startswith(prefix) and k not in live
+                    and k not in self._promoted]:
+            del self.allocs[aid]
+
+    def _resync_locked(self) -> None:
+        snap = self.store.snapshot()
+        try:
+            self.allocs = {a.id: _alloc_entry(a) for a in snap.allocs()}
+            self.nodes = {n.id: _node_entry(n) for n in snap.nodes()}
+            self.evals = {e.id: _eval_entry(e) for e in snap.evals()}
+            self._promoted = {aid for aid in self.allocs
+                              if "." in aid
+                              and self.store._allocs.get(
+                                  aid, snap.index) is not None}
+        finally:
+            snap.close()
+        self.resyncs += 1
+
+    # -- differential compare -----------------------------------------
+
+    def _compare_locked(self) -> Optional[str]:
+        snap = self.store.snapshot()
+        try:
+            truth_allocs = {a.id: _alloc_entry(a) for a in snap.allocs()}
+            truth_nodes = {n.id: _node_entry(n) for n in snap.nodes()}
+            truth_evals = {e.id: _eval_entry(e) for e in snap.evals()}
+            index = snap.index
+        finally:
+            snap.close()
+        self.compares += 1
+        diffs = (_diff_maps("allocs", self.allocs, truth_allocs)
+                 + _diff_maps("nodes", self.nodes, truth_nodes)
+                 + _diff_maps("evals", self.evals, truth_evals))
+        if not diffs:
+            # alloc sets match — now the columnar reduction must too,
+            # through the same scatter the tensor state will use
+            su, tu = usage_columns(self.allocs), usage_columns(truth_allocs)
+            if su != tu:
+                bad = sorted(k for k in su.keys() | tu.keys()
+                             if su.get(k) != tu.get(k))
+                diffs = [f"usage columns diverge on {len(bad)} node(s) "
+                         f"{bad[:MAX_DIFF_IDS]}"]
+        if not diffs:
+            return None
+        msg = (f"shadow replica diverged from snapshot rebuild at "
+               f"index {index} (commit {self.commits}, "
+               f"{self.resyncs} resync(s)): " + "; ".join(diffs))
+        self.tracker.record(Violation("shadow-divergence", msg))
+        return msg
+
+    def force_compare(self) -> Optional[str]:
+        """Drain + compare now (invariant sweeps, scenario teardowns)."""
+        with self._lock:
+            evs = self.sub.next_events(timeout=0)
+            if self.sub.truncated:
+                self.sub.truncated = False
+                self._resync_locked()
+            else:
+                for e in evs:
+                    self._apply(e)
+            return self._compare_locked()
+
+
+class ShadowTracker:
+    """Registry of shadow replicas. The module-level GLOBAL instance is
+    what conftest installs and the server attaches to; tests build
+    private ones."""
+
+    def __init__(self, every: int = COMPARE_EVERY):
+        self.active = False
+        self.every = every
+        self._ilock = _REAL_LOCK()
+        self.replicas: List[ShadowReplica] = []
+        self.violations: List[Violation] = []
+
+    def install(self) -> None:
+        self.active = True
+
+    def uninstall(self) -> None:
+        self.active = False
+
+    def attach(self, store, broker,
+               every: Optional[int] = None) -> Optional[ShadowReplica]:
+        """Attach a replica to a (store, broker) pair. No-op while the
+        sanitizer switch is off — the server calls this unconditionally."""
+        if not self.active:
+            return None
+        rep = ShadowReplica(store, broker, self,
+                            every=every or self.every)
+        with self._ilock:
+            self.replicas.append(rep)
+        return rep
+
+    def record(self, v: Violation) -> None:
+        with self._ilock:
+            self.violations.append(v)
+
+    def verify_all(self) -> List[str]:
+        """Force-compare every replica; rendered violations after.
+        The chaos invariant sweep's view of the shadow state."""
+        with self._ilock:
+            reps = list(self.replicas)
+        for rep in reps:
+            rep.force_compare()
+        return [v.render() for v in self.violations]
+
+    def check(self) -> None:
+        if self.violations:
+            raise AssertionError(
+                "nomadflow violations:\n"
+                + "\n".join(v.render() for v in self.violations))
+
+    def stats(self) -> Dict[str, int]:
+        with self._ilock:
+            reps = list(self.replicas)
+        return {
+            "replicas": len(reps),
+            "commits": sum(r.commits for r in reps),
+            "compares": sum(r.compares for r in reps),
+            "resyncs": sum(r.resyncs for r in reps),
+        }
+
+    def report(self) -> str:
+        s = self.stats()
+        lines = [
+            f"nomadflow: {len(self.violations)} violation(s); "
+            f"replicas={s['replicas']} commits={s['commits']} "
+            f"compares={s['compares']} resyncs={s['resyncs']}"]
+        for v in self.violations:
+            lines.append("  " + v.render())
+        return "\n".join(lines)
+
+
+# -- module-level surface (what the server + conftest import) -------------
+
+GLOBAL = ShadowTracker()
+
+
+def install() -> None:
+    GLOBAL.install()
+
+
+def uninstall() -> None:
+    GLOBAL.uninstall()
+
+
+def enabled() -> bool:
+    return GLOBAL.active
+
+
+def maybe_attach(store, broker) -> Optional[ShadowReplica]:
+    """Server-side hook: attach a GLOBAL replica when the sanitizer is
+    armed, a no-op otherwise."""
+    return GLOBAL.attach(store, broker)
+
+
+def violations() -> List[Violation]:
+    return list(GLOBAL.violations)
+
+
+def check() -> None:
+    GLOBAL.check()

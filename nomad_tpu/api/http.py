@@ -1649,6 +1649,15 @@ class HTTPAgent:
         try:
             while time.time() < deadline:
                 events = sub.next_events(timeout=0.5)
+                if sub.truncated:
+                    # the ring lapped this stream: surface the gap as an
+                    # in-band marker so the client re-lists from a fresh
+                    # snapshot instead of trusting a holey delta stream
+                    sub.truncated = False
+                    write_chunk(json.dumps(
+                        {"Topic": "Truncation", "Type": "resync-required",
+                         "Key": "", "Index": 0,
+                         "Payload": None}).encode() + b"\n")
                 for e in events:
                     line = json.dumps({
                         "Topic": e.topic, "Type": e.type, "Key": e.key,

@@ -8,6 +8,7 @@
     python -m nomad_tpu.chaos --snap-smoke
     python -m nomad_tpu.chaos --swarm-smoke
     python -m nomad_tpu.chaos --watch-smoke
+    python -m nomad_tpu.chaos --flow-smoke
     python -m nomad_tpu.chaos --swarm-scale [N]
 
 Exit 0 when every invariant holds; 2 on a violation (the CI gate in
@@ -53,6 +54,14 @@ scripts/check.sh --swarm-smoke gate; ROBUSTNESS.md "Client plane").
 50,000) sim nodes heartbeating at the production TTL against a live
 3-node cluster WHILE the e2e pipeline runs, one leader crash/failover
 mid-stream — zero missed-TTL false positives on any replica.
+
+`--flow-smoke` runs the event-completeness smoke: the e2e pipeline on
+a 3-node cluster with the nomadflow shadow replicas force-armed — every
+server's event stream is replayed into a reduced replica and
+fingerprint-compared against MVCC snapshot rebuilds across a leader
+crash/restart; any mutation whose delta never reached the stream fails
+the run (the scripts/check.sh --flow-smoke gate; ANALYSIS.md
+"nomadflow").
 
 `--watch-smoke` runs the read-path failover smoke: blocking queries +
 event subscriptions parked on ALL 3 servers while the leader crashes —
@@ -386,6 +395,134 @@ def e2e_smoke(jobs_n: int = 300, nodes_n: int = 75, workers: int = 4) -> int:
     print(f"E2E SMOKE: ok — {jobs_n} evals, {len(acked)} allocs acked "
           f"pre-crash all survived the leader restart, "
           f"rejection {rejection:.1%}, "
+          f"{checker.stats['checks']} invariant sweeps, {dt:.1f}s")
+    return 0
+
+
+def flow_smoke(jobs_n: int = 120, nodes_n: int = 40,
+               workers: int = 4) -> int:
+    """Event-completeness smoke (scripts/check.sh --flow-smoke): the
+    e2e pipeline on a durable 3-node cluster with the nomadflow shadow
+    tracker force-armed, so every server construction auto-attaches a
+    shadow replica that replays the Allocation/Node/Evaluation stream
+    and fingerprint-compares against MVCC snapshot rebuilds. One leader
+    crash/restart mid-stream (the restarted server resyncs through the
+    restore-truncation path). Asserts: zero shadow divergences on ANY
+    replica — including the crashed one's final pre-crash state — plus
+    the standard safety sweep (which now includes invariant
+    check_event_completeness)."""
+    import shutil
+
+    from ..analysis import shadow
+    from ..core.server import ServerConfig
+    from ..raft.cluster import RaftCluster
+    from .invariants import InvariantChecker
+
+    t0 = time.monotonic()
+
+    def config_fn(_i: int) -> ServerConfig:
+        return ServerConfig(
+            num_workers=workers, plan_commit_batching=True,
+            eval_batch_size=8,
+            heartbeat_ttl=3600.0, gc_interval=3600.0, nack_timeout=900.0,
+            failed_eval_followup_delay=3600.0,
+            failed_eval_unblock_interval=0.5)
+
+    tmp = tempfile.mkdtemp(prefix="nomad-flow-smoke-")
+    checker = InvariantChecker()
+    was_active = shadow.GLOBAL.active
+    shadow.install()   # arm BEFORE any server constructs its broker
+    try:
+        cluster = RaftCluster(3, config_fn=config_fn, data_dir=tmp)
+        cluster.start()
+        try:
+            leader = cluster.wait_for_leader(timeout=15.0)
+            if leader is None:
+                print("FLOW SMOKE: FAIL — no leader elected")
+                return 2
+            for _ in range(nodes_n):
+                leader.register_node(mock.node())
+            jobs = []
+            for _ in range(jobs_n):
+                j = mock.job()
+                j.task_groups[0].count = 1
+                j.task_groups[0].tasks[0].resources.cpu = 100
+                j.task_groups[0].tasks[0].resources.memory_mb = 64
+                jobs.append(j)
+                leader.store.upsert_job(j)
+            evals = [mock.eval_for(j, create_time=time.time())
+                     for j in jobs]
+            leader.store.upsert_evals(evals)
+            for ev in evals:
+                leader.server.broker.enqueue(ev)
+
+            # crash once genuinely mid-batch, same shape as e2e_smoke
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                snap = leader.local_store.snapshot()
+                if len([a.id for a in snap.allocs()]) >= jobs_n // 4:
+                    break
+                time.sleep(0.002)
+            else:
+                print("FLOW SMOKE: FAIL — pipeline never reached the "
+                      "crash window")
+                return 2
+            cluster.crash(leader.id)
+            fresh = cluster.wait_for_leader(timeout=20.0)
+            if fresh is None:
+                print("FLOW SMOKE: FAIL — no leader after the crash")
+                return 2
+            cluster.restart(leader.id)
+
+            deadline = time.time() + 180
+            while True:
+                fresh = cluster.leader() or fresh
+                if fresh.server._running \
+                        and fresh.server.wait_for_idle(
+                            timeout=10.0, include_delayed=False) \
+                        and fresh.server.blocked.blocked_count() == 0:
+                    snap = fresh.local_store.snapshot()
+                    placed = [a for a in snap.allocs()
+                              if not a.terminal_status()
+                              and not a.server_terminal()]
+                    if len(placed) >= jobs_n:
+                        break
+                if time.time() > deadline:
+                    print("FLOW SMOKE: FAIL — pipeline did not drain "
+                          "after the failover")
+                    return 2
+                time.sleep(0.1)
+
+            checker.check_convergence(cluster, timeout=30.0)
+            checker.check_all(cluster)   # includes event completeness
+
+            problems = shadow.GLOBAL.verify_all()
+            stats = shadow.GLOBAL.stats()
+            if problems:
+                print(f"FLOW SMOKE: FAIL — {len(problems)} shadow "
+                      f"divergence(s): {problems[0]}")
+                return 2
+            if stats["replicas"] < 4:   # 3 initial + the restart
+                print(f"FLOW SMOKE: FAIL — only {stats['replicas']} "
+                      f"shadow replicas attached; the server hook is "
+                      f"not arming")
+                return 2
+            if stats["resyncs"] < stats["replicas"]:
+                print("FLOW SMOKE: FAIL — a replica never took its "
+                      "initial resync")
+                return 2
+        finally:
+            cluster.stop()
+    finally:
+        if not was_active:
+            shadow.uninstall()
+        shadow.GLOBAL.replicas.clear()
+        shutil.rmtree(tmp, ignore_errors=True)
+    dt = time.monotonic() - t0
+    print(f"FLOW SMOKE: ok — {jobs_n} evals across a leader restart, "
+          f"{stats['replicas']} shadow replicas, {stats['commits']} "
+          f"commits replayed, {stats['compares']} fingerprint compares, "
+          f"{stats['resyncs']} resyncs, 0 divergences, "
           f"{checker.stats['checks']} invariant sweeps, {dt:.1f}s")
     return 0
 
@@ -1458,6 +1595,12 @@ def main(argv=None) -> int:
                              "in sequence; liveness + alloc-uniqueness "
                              "on every replica) instead of the scenario "
                              "smoke")
+    parser.add_argument("--flow-smoke", action="store_true",
+                        help="run the event-completeness smoke (e2e "
+                             "pipeline with nomadflow shadow replicas "
+                             "force-armed on every server across a "
+                             "leader crash; zero shadow divergences) "
+                             "instead of the scenario smoke")
     parser.add_argument("--watch-smoke", action="store_true",
                         help="run the read-path failover smoke (blocking "
                              "queries + event subscriptions parked on "
@@ -1491,6 +1634,8 @@ def main(argv=None) -> int:
         return snap_smoke()
     if args.swarm_smoke:
         return swarm_smoke()
+    if args.flow_smoke:
+        return flow_smoke()
     if args.watch_smoke:
         return watch_smoke()
     if args.swarm_scale is not None:

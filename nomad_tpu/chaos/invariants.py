@@ -353,6 +353,26 @@ class InvariantChecker:
                      if len(problems) > 1 else "")
             self._fail(f"launch ledger: {problems[0]}{extra}")
 
+    # -- 9: event completeness (nomadflow runtime prong) ---------------
+
+    def check_event_completeness(self, cluster=None) -> None:
+        """When the nomadflow shadow tracker is armed (NOMAD_TPU_SAN=1),
+        force-compare every attached shadow replica against a fresh MVCC
+        snapshot rebuild — a mutation that skipped its delta leaves every
+        event consumer (alloc sync, the event stream API, the future
+        device-resident incremental state) silently stale; catch the
+        missing event here, at the commit that dropped it."""
+        from ..analysis.shadow import GLOBAL as shadow
+
+        if not shadow.active:
+            return
+        before = len(shadow.violations)
+        shadow.verify_all()
+        fresh = shadow.violations[before:]
+        if fresh:
+            extra = f" (+{len(fresh) - 1} more)" if len(fresh) > 1 else ""
+            self._fail(f"event completeness: {fresh[0].render()}{extra}")
+
     # -- aggregate ----------------------------------------------------
 
     def check_all(self, cluster) -> None:
@@ -361,6 +381,7 @@ class InvariantChecker:
         run where a scenario expects quiescence)."""
         self.check_snapshot_integrity(cluster)
         self.check_launch_ledger(cluster)
+        self.check_event_completeness(cluster)
         self.check_election_safety(cluster)
         self.check_log_matching(cluster)
         self.check_committed_durability(cluster)

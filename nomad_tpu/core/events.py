@@ -42,9 +42,16 @@ TOPIC_FOR_KIND = {
     "alloc-preempt": "Allocation", "alloc-client-update": "Allocation",
     "alloc-transition": "Allocation",
     "alloc-block-upsert": "Allocation",  # one event per columnar batch
+    "alloc-gc": "Allocation",            # payload: list of dead alloc ids
     "deployment-upsert": "Deployment", "deployment-update": "Deployment",
     "deployment-delete": "Deployment",
 }
+
+# Commit kinds that invalidate every topic at once (operator snapshot
+# restore replaced the whole store): the broker answers with a full ring
+# truncation so every subscriber takes its resync path. The nomadflow
+# rules treat these as covering all delta obligations.
+RESYNC_KINDS = ("restore",)
 
 DEFAULT_SHARDS = 8
 
@@ -189,6 +196,10 @@ class EventBroker:
     def __init__(self, store, ring_size: int = 4096,
                  shards: int = DEFAULT_SHARDS):
         self._shards = [_Shard(ring_size) for _ in range(max(1, shards))]
+        # last committed store index seen: stamps direct publishes so
+        # they merge/resume at the current position instead of index 0.
+        # Benign int: written under the store's write lock, read racily.
+        self._last_index = getattr(store, "_index", 0)
         store.add_commit_listener(self._on_commit)
 
     def shard_of(self, topic: str) -> int:
@@ -204,11 +215,18 @@ class EventBroker:
         return out
 
     def _on_commit(self, index: int, events: list) -> None:
+        self._last_index = index
+        if any(kind in RESYNC_KINDS for kind, _ in events):
+            self._truncate_all()
+            return
         by_shard: Dict[int, List[Tuple[str, str, str, object]]] = {}
+        alloc_deltas = 0
         for kind, payload in events:
             topic = TOPIC_FOR_KIND.get(kind)
             if topic is None:
                 continue
+            if topic == "Allocation":
+                alloc_deltas += 1
             key = getattr(payload, "id", "") if payload is not None else ""
             if _OWN.active:
                 # nomadown: the rings hold payloads by reference —
@@ -216,12 +234,32 @@ class EventBroker:
                 _OWN.verify(payload)
             by_shard.setdefault(self.shard_of(topic), []).append(
                 (topic, kind, key, payload))
+        if alloc_deltas:
+            # the O(Δ) seed metric: Allocation deltas on the stream —
+            # what an incremental tensor build would consume per round
+            REGISTRY.incr("nomad.events.alloc_deltas", alloc_deltas)
         woken = 0
         for sid, items in by_shard.items():
             woken += self._publish_shard(sid, items, index)
         if woken:
             REGISTRY.incr("nomad.reads.event_wakeups", woken)
             REGISTRY.observe("nomad.reads.event_wakeup_batch", float(woken))
+
+    def _truncate_all(self) -> None:
+        """Operator restore replaced the whole store: every ring is
+        stale. Advance + evict each shard's seq past every cursor so
+        ALL subscriptions — including fully caught-up ones — observe
+        truncation and take their resync path, then wake the parked
+        ones so nobody sleeps through the restore."""
+        for sh in self._shards:
+            with sh.lock:
+                sh.ring.clear()
+                sh.seq += 1
+                sh.evicted = sh.seq
+                waiters = list(sh.waiters.values())
+                sh.waiters.clear()
+            for ev in waiters:
+                ev.set()
 
     def _publish_shard(self, sid: int, items, index: int) -> int:
         """Append one batch to one shard and wake its parked
@@ -249,7 +287,8 @@ class EventBroker:
         listenWorkerEvents)."""
         key = payload.get("node_id", "") if isinstance(payload, dict) else ""
         self._publish_shard(self.shard_of(topic),
-                            [(topic, kind, key, payload)], 0)
+                            [(topic, kind, key, payload)],
+                            self._last_index)
 
     def waiter_count(self) -> int:
         """Parked subscriptions across all shards (the

@@ -135,6 +135,15 @@ class Registry:
         data.sort()
         return _pct(data, q)
 
+    def get(self, name: str, default: float = 0.0) -> float:
+        """Current value of a counter or gauge (counters win). Lets
+        subsystems read their own deltas between scrapes — e.g. the
+        placer differencing nomad.events.alloc_deltas across builds."""
+        with self._lock:
+            if name in self._counters:
+                return self._counters[name]
+            return self._gauges.get(name, default)
+
     def time(self, name: str) -> "_Timer":
         """Context manager: times the block into `name`."""
         return _Timer(self, name)

@@ -145,6 +145,12 @@ class Server:
         self.events = EventBroker(self.store,
                                   ring_size=self.config.event_ring_size,
                                   shards=self.config.event_shards)
+        # nomadflow shadow replica (NOMAD_TPU_SAN=1, else a no-op):
+        # replays this server's event stream and diff-checks it against
+        # MVCC snapshot rebuilds — see analysis/shadow.py
+        from ..analysis import shadow as _shadow
+
+        _shadow.maybe_attach(self.store, self.events)
         from .allocsync import AllocSyncHub, ClientUpdateBatcher
 
         # delta alloc push to clients + batched client status commits
@@ -387,13 +393,16 @@ class Server:
             self.logger.warning(
                 "node %s exceeded the plan rejection threshold; "
                 "marking ineligible", node_id)
-        self.events.publish("Node", "node-quarantined",
-                            {"node_id": node_id,
-                             "reason": "plan rejection threshold exceeded"})
+        # commit the eligibility flip BEFORE announcing it: a subscriber
+        # woken by the quarantine event must see the node ineligible in
+        # any snapshot it takes (flow-publish-before-commit)
         try:
             self.update_node_eligibility(node_id, enums.NODE_SCHED_INELIGIBLE)
         except KeyError:
             pass  # node vanished; nothing to quarantine
+        self.events.publish("Node", "node-quarantined",
+                            {"node_id": node_id,
+                             "reason": "plan rejection threshold exceeded"})
 
     def _requeue_unblocked(self, ev: Evaluation) -> None:
         """An unblocked eval re-enters the broker as pending; persist the

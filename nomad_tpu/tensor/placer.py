@@ -208,6 +208,26 @@ def _count_preempt(**deltas: int) -> None:
             REGISTRY.incr(f"nomad.preempt.{key}", n)
 
 
+# Incremental-state seed telemetry (ROADMAP "device-resident
+# incremental state"): per tensor build, how many Allocation deltas hit
+# the event stream since the previous build anywhere in the process —
+# the exact row count an O(Δ) scatter update to ClusterTensors would
+# touch instead of this full O(nodes) rebuild.
+_DELTA_MARK_LOCK = __import__("threading").Lock()
+_DELTA_MARK = [0.0]
+
+
+def _changed_allocs_since_last_build() -> int:
+    from ..core.metrics import REGISTRY
+
+    now = REGISTRY.get("nomad.events.alloc_deltas")
+    with _DELTA_MARK_LOCK:
+        prev, _DELTA_MARK[0] = _DELTA_MARK[0], now
+    delta = max(0.0, now - prev)  # REGISTRY.reset between benches rewinds
+    REGISTRY.observe("nomad.worker.changed_allocs_per_build", delta)
+    return int(delta)
+
+
 # One solve at a time across racing workers' PER-EVAL kernel path (the
 # device serializes launches regardless); see the critical-section note
 # in place(). The bulk path has its own serializer (the solver service).
@@ -261,7 +281,8 @@ class TPUPlacer:
         # (optimistic-concurrency livelock). The permutation rides INTO
         # the kernel so the host-side node order stays canonical and the
         # per-node arrays stay cacheable across evals (ClusterStatic).
-        with TRACER.span("worker.tensor_build", n=len(nodes)):
+        with TRACER.span("worker.tensor_build", n=len(nodes),
+                         changed_allocs=_changed_allocs_since_last_build()):
             cluster = ClusterTensors.build(ctx, nodes)
         nodes = cluster.nodes
         # crc32, not hash(): the seed must be deterministic ACROSS

@@ -34,6 +34,11 @@
 #                                   8-virtual-device mesh: sharded
 #                                   joint launches, zero retraces,
 #                                   alloc uniqueness on every replica)
+#   scripts/check.sh --flow-smoke   also run the event-completeness
+#                                   smoke (e2e pipeline with nomadflow
+#                                   shadow replicas armed on every
+#                                   server across a leader crash; zero
+#                                   shadow divergences)
 set -u
 cd "$(dirname "$0")/.."
 
@@ -44,6 +49,7 @@ run_snap_smoke=0
 run_swarm_smoke=0
 run_watch_smoke=0
 run_mesh_smoke=0
+run_flow_smoke=0
 for arg in "$@"; do
     case "$arg" in
         --e2e-smoke) run_e2e_smoke=1 ;;
@@ -53,6 +59,7 @@ for arg in "$@"; do
         --swarm-smoke) run_swarm_smoke=1 ;;
         --watch-smoke) run_watch_smoke=1 ;;
         --mesh-smoke) run_mesh_smoke=1 ;;
+        --flow-smoke) run_flow_smoke=1 ;;
         *) echo "unknown flag: $arg" >&2; exit 64 ;;
     esac
 done
@@ -84,6 +91,14 @@ timeout 60 python -m nomad_tpu.analysis --ownership --no-baseline || failed=1
 echo "== nomadjit smoke (python -m nomad_tpu.analysis --tensor) =="
 timeout 60 python -m nomad_tpu.analysis --tensor --no-baseline || failed=1
 
+# nomadflow smoke (~2s): the five mutation→event completeness rules
+# alone, baseline disabled — every table write inside a MUTATIONS entry
+# must emit its delta kind, publishes come after commits, payloads stay
+# wide enough for every consumer; findings are fixed in code, never
+# allowlisted (ANALYSIS.md "nomadflow")
+echo "== nomadflow smoke (python -m nomad_tpu.analysis --flow) =="
+timeout 60 python -m nomad_tpu.analysis --flow --no-baseline || failed=1
+
 # runtime sanitizer smoke test: lock wrapping + lockset checking armed
 # over the sanitizer's own suite and the concurrency-heavy store/plan
 # tests (the full suite runs under NOMAD_TPU_SAN=1 in nightly; this
@@ -91,7 +106,8 @@ timeout 60 python -m nomad_tpu.analysis --tensor --no-baseline || failed=1
 echo "== nomadsan smoke (NOMAD_TPU_SAN=1) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" NOMAD_TPU_SAN=1 python -m pytest \
     tests/test_sanitizer.py tests/test_ownership.py \
-    tests/test_tensor_rules.py tests/test_state_store.py \
+    tests/test_tensor_rules.py tests/test_flow_rules.py \
+    tests/test_state_store.py \
     tests/test_plan_apply_scale.py tests/test_e2e_pipeline.py \
     tests/test_batch_solver.py tests/test_preempt_solve.py -q \
     -p no:cacheprovider || failed=1
@@ -207,6 +223,18 @@ if [ "$run_mesh_smoke" = 1 ]; then
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
         timeout 300 python -m nomad_tpu.chaos --mesh-smoke || failed=1
+fi
+
+# event-completeness smoke (opt-in, ~5s): the e2e failover pipeline
+# with nomadflow shadow replicas force-armed on every server — each
+# replica replays the Allocation/Node/Evaluation stream and must stay
+# fingerprint-identical to MVCC snapshot rebuilds across a leader
+# crash/restart; any mutation whose delta never reached the event
+# stream fails the run (ANALYSIS.md "nomadflow")
+if [ "$run_flow_smoke" = 1 ]; then
+    echo "== flow smoke (python -m nomad_tpu.chaos --flow-smoke) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 300 \
+        python -m nomad_tpu.chaos --flow-smoke || failed=1
 fi
 
 echo "== tier-1 tests =="

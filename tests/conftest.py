@@ -35,6 +35,7 @@ if _SAN:
     from nomad_tpu.analysis import launch_ledger as _launch_ledger
     from nomad_tpu.analysis import ownership as _ownership
     from nomad_tpu.analysis import sanitizer as _sanitizer
+    from nomad_tpu.analysis import shadow as _shadow
 
     _sanitizer.install()
     _ownership.install()
@@ -43,6 +44,12 @@ if _SAN:
     # call-site attribution, and the solver/placer launch windows turn
     # warm-path compiles or extra host syncs into session failures
     _launch_ledger.install()
+    # nomadflow (the shadow-state prong) rides the same switch: every
+    # server's event stream is replayed into reduced replicas and
+    # fingerprint-compared against MVCC snapshot rebuilds — a mutation
+    # that forgot its delta becomes a session failure, not a silently
+    # stale read model
+    _shadow.install()
 
 import pytest  # noqa: E402
 
@@ -52,13 +59,15 @@ def pytest_terminal_summary(terminalreporter):
         terminalreporter.write_line(_sanitizer.GLOBAL.report())
         terminalreporter.write_line(_ownership.GLOBAL.report())
         terminalreporter.write_line(_launch_ledger.GLOBAL.report())
+        terminalreporter.write_line(_shadow.GLOBAL.report())
 
 
 def pytest_sessionfinish(session, exitstatus):
     # a green test run with recorded races is still a failed run
     if _SAN and (_sanitizer.GLOBAL.violations
                  or _ownership.GLOBAL.violations
-                 or _launch_ledger.GLOBAL.violations):
+                 or _launch_ledger.GLOBAL.violations
+                 or _shadow.GLOBAL.violations):
         session.exitstatus = 3
 
 

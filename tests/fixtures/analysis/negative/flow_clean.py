@@ -1,0 +1,88 @@
+"""Disciplined counterparts to flow_bad.py: every mutation→event shape
+the nomadflow rules police, done correctly. tests/test_flow_rules.py
+asserts every flow rule stays silent on this module.
+"""
+
+TOPIC_FOR_KIND = {
+    "node-upsert": "Node",
+    "node-delete": "Node",
+    "eval-upsert": "Evaluation",
+}
+
+MUTATIONS = {"upsert_node", "delete_node", "restore"}
+
+
+class Store:
+    def __init__(self, events):
+        self._nodes = VersionedTable("nodes")        # noqa: F821
+        # no kind prefixes "volume-": the table carries no delta
+        # obligation (secondary indexes, usage columns ride snapshots)
+        self._volumes = VersionedTable("volumes")    # noqa: F821
+        self._index = 0
+        self._listeners = []
+        self.events = events
+
+    # write + the table's mapped kind, full payload
+    def upsert_node(self, node):
+        self._nodes.put(node.id, node)
+        self._commit([("node-upsert",
+                       {"id": node.id, "status": node.status,
+                        "weight": node.weight})])
+
+    def delete_node(self, node_id):
+        self._nodes.delete(node_id)
+        self._commit([("node-delete",
+                       {"id": node_id, "status": "gone", "weight": 0})])
+
+    # full-state reload: the resync sentinel truncates every ring, so
+    # the unmapped-table write owes no per-row deltas
+    def restore(self, snap):
+        self._nodes.put(snap.id, snap)
+        self._volumes.put(snap.id, snap.volumes)
+        self._commit([("restore", None)])
+
+    # index published BEFORE the listener fan-out
+    def _commit(self, events):
+        gen = self._index + 1
+        self._index = gen
+        for fn in self._listeners:
+            fn(gen, events)
+
+    # commit first, then publish — with the full payload
+    def quarantine(self, node):
+        self.upsert_node(node)
+        self.events.publish("Node", "node-upsert",
+                            {"id": node.id, "status": node.status,
+                             "weight": node.weight})
+
+
+class Watcher:
+    def run(self, broker):
+        sub = broker.subscribe({"Node": ["*"]})
+        while not self.stop:
+            if sub.truncated:
+                # ack the flag and rebuild from a snapshot
+                sub.truncated = False
+                self.resync()
+            for ev in sub.next_events(timeout=1.0):
+                payload = ev.payload
+                self.apply(payload.id, payload.status,
+                           getattr(payload, "weight", 0))
+
+    # the events_after shape: the flag is PROPAGATED to the caller,
+    # which owns the resync decision
+    def events_after(self, sub, index):
+        batch = sub.next_events(timeout=0.0)
+        return [e for e in batch if e.index > index], sub.truncated
+
+
+class ShardedBroker:
+    # ring appends stamped with the committed store generation
+    def publish(self, topic, kind, payload):
+        index = self._last_index
+        self._publish_shard(self._shard_of(topic),
+                            [(topic, kind, "", payload)], index)
+
+    def replay(self, ring, seq, index, topic, kind, payload):
+        ring.append(Event(seq, index, topic, kind, "",   # noqa: F821
+                          payload))

@@ -1,0 +1,131 @@
+"""Deliberately broken mutation→event flow shapes, one per nomadflow
+rule (ANALYSIS.md "nomadflow"). Never imported — parsed by
+tests/test_flow_rules.py, which asserts each rule flags exactly its
+shapes here and nothing else.
+
+The module carries its own TOPIC_FOR_KIND / MUTATIONS / VersionedTable
+bindings so the derived table→topic map works on this standalone file
+exactly as it does on core/events.py + state/store.py.
+"""
+
+TOPIC_FOR_KIND = {
+    "node-upsert": "Node",
+    "node-delete": "Node",
+    "eval-upsert": "Evaluation",
+}
+
+# the FSM dispatch surface: names here are delta-obligated mutators
+MUTATIONS = {"upsert_node", "delete_node", "upsert_evals", "restore"}
+
+
+class Store:
+    def __init__(self, events):
+        self._nodes = VersionedTable("nodes")    # noqa: F821
+        self._evals = VersionedTable("evals")    # noqa: F821
+        self._index = 0
+        self._listeners = []
+        self.events = events
+
+    # silent under flow-mutation-without-delta: the closure emits the
+    # table's mapped kind
+    def upsert_node(self, node):
+        self._nodes.put(node.id, node)
+        self._commit([("node-upsert", node)])
+
+    # flow-mutation-without-delta: deletes a delta-consumed table row,
+    # publishes nothing
+    def delete_node(self, node_id):
+        self._nodes.delete(node_id)
+        self._commit([])
+
+    # flow-mutation-without-delta (interprocedural): the write hides in
+    # a helper reached from the mutator
+    def upsert_evals(self, evals):
+        for ev in evals:
+            self._put_eval(ev)
+        self._commit([])
+
+    def _put_eval(self, ev):
+        self._evals.put(ev.id, ev)
+
+    # silent: the restore sentinel truncates every ring, so the whole
+    # closure is exempt from per-table delta obligations
+    def restore(self, snap):
+        self._nodes.put(snap.id, snap)
+        self._commit([("restore", None)])
+
+    # flow-publish-before-commit shape (b): listener fan-out runs
+    # before the new index is published
+    def _commit(self, events):
+        gen = self._index + 1
+        for fn in self._listeners:
+            fn(gen, events)
+        self._index = gen
+
+    # flow-publish-before-commit shape (a): the event goes out, THEN
+    # the mutation it describes runs — a woken subscriber can snapshot
+    # stale state
+    def quarantine(self, node):
+        self.events.publish("Node", "node-upsert", node)
+        self.upsert_node(node)
+
+
+class Watcher:
+    # the module's Node subscriber: reads id/status/weight off payloads
+    # (so narrowed producers below are findable). Rule-4 clean: it acks
+    # the truncation flag and resyncs.
+    def run(self, broker):
+        sub = broker.subscribe({"Node": ["*"]})
+        while not self.stop:
+            if sub.truncated:
+                sub.truncated = False
+                self.resync()
+            for ev in sub.next_events(timeout=1.0):
+                payload = ev.payload
+                self.apply(payload.id, payload.status,
+                           getattr(payload, "weight", 0))
+
+
+class Publisher:
+    # flow-delta-payload-narrowing: dict payload omits 'weight', which
+    # Watcher.run reads off every Node payload
+    def announce(self, node):
+        self.events.publish("Node", "node-upsert",
+                            {"id": node.id, "status": node.status})
+
+    # flow-delta-payload-narrowing (tuple event shape): omits 'status'
+    def announce_batch(self, nodes):
+        out = []
+        for node in nodes:
+            out.append(("node-upsert",
+                        {"id": node.id, "weight": node.weight}))
+        return out
+
+
+# flow-resync-gap-unhandled: never looks at .truncated — a lapped ring
+# silently drops deltas forever
+def drain_unchecked(sub):
+    out = []
+    while True:
+        batch = sub.next_events(timeout=0.5)
+        if not batch:
+            return out
+        out.extend(batch)
+
+
+# flow-resync-gap-unhandled: sees the flag, logs, heals nothing
+def drain_unhandled(sub, log):
+    batch = sub.next_events(timeout=0.5)
+    if sub.truncated:
+        log.warning("ring lapped")
+    return batch
+
+
+class ShardedBroker:
+    # flow-unkeyed-delta: literal index 0 instead of the committed
+    # store generation
+    def publish_restore(self, topic, payload):
+        self._publish_shard(0, [(topic, "restore", "", payload)], 0)
+
+    def replay(self, ring, topic, kind, payload):
+        ring.append(Event(0, 0, topic, kind, "", payload))  # noqa: F821

@@ -35,7 +35,11 @@ ALL_RULES = ("fsm-determinism", "jax-hot-path", "lock-order",
              # nomadjit tensor determinism/launch rules (PR 16)
              "reassociable-reduction-feeds-selection",
              "host-sync-in-launch", "retrace-hazard",
-             "unguarded-launch", "prng-key-reuse")
+             "unguarded-launch", "prng-key-reuse",
+             # nomadflow mutation→event completeness rules (PR 17)
+             "flow-mutation-without-delta", "flow-publish-before-commit",
+             "flow-delta-payload-narrowing", "flow-resync-gap-unhandled",
+             "flow-unkeyed-delta")
 
 
 def _by_rule(findings):
