@@ -1863,6 +1863,157 @@ def cfg_read_fanout() -> None:
          lease_reads=int(delta("nomad.reads.lease_reads")))
 
 
+
+def cfg_overload_goodput() -> None:
+    """Overload goodput rung (PERF.md "Overload goodput", ROBUSTNESS.md
+    "Overload envelope"): a 3-node durable cluster under a 10x open-loop
+    job-submit burst, A/B over the nomadload admission plane
+    (loadctl_enabled on vs the NOMAD_TPU_LOADCTL=0 kill-switch shape).
+    Each arm calibrates its own max-sustainable closed-loop submit rate,
+    then offers 10x that on a seeded Poisson schedule
+    (chaos.overload.run_open_loop — open loop, so the generator does NOT
+    let up when the server slows down) while a tier-0 heartbeat thread
+    measures liveness latency straight through the burst.
+
+    value        = admitted goodput (jobs/s) at 10x with the plane ON
+    vs_baseline  = ON/OFF goodput ratio (the collapse the plane prevents)
+    gate_goodput = goodput >= 70% of the calibrated max-sustainable rate
+    gate_hb      = heartbeat p99 under burst <= 2x its unloaded value
+    (both gates evaluated on the ON arm; the OFF arm's hb p99 documents
+    the collapse curve)."""
+    import shutil
+    import tempfile
+    import threading
+
+    from nomad_tpu import mock
+    from nomad_tpu.chaos.overload import _percentile, run_open_loop
+    from nomad_tpu.core.server import ServerConfig
+    from nomad_tpu.raft.cluster import RaftCluster
+
+    # 64 open-loop workers: a shed-less server makes each submit
+    # BLOCK in the synchronous propose, so queue depth can only
+    # reach the worker count — the pool must be deep enough to
+    # genuinely trip the hard watermarks below
+    burst_s, workers_n, nodes_n = 5.0, 64, 20
+
+    def trial(enabled: bool) -> dict:
+        def config_fn(_i: int) -> ServerConfig:
+            return ServerConfig(
+                num_workers=2, plan_commit_batching=True,
+                eval_batch_size=8,
+                heartbeat_ttl=3600.0, gc_interval=3600.0,
+                nack_timeout=900.0, failed_eval_followup_delay=3600.0,
+                loadctl_enabled=enabled,
+                # laptop-scale watermarks: the pool above can push the
+                # proposal queue into the hard band, so the plane's
+                # engage/drain cycle — not the queue ceiling — sets
+                # the admitted rate
+                loadctl_proposal_soft=8, loadctl_proposal_hard=24,
+                loadctl_plan_soft=8, loadctl_plan_hard=24,
+                loadctl_broker_soft=16, loadctl_broker_hard=48,
+                loadctl_brownout_after=0.5)
+
+        tmp = tempfile.mkdtemp(prefix="overloadbench-")
+        cluster = RaftCluster(3, config_fn=config_fn, data_dir=tmp)
+        try:
+            cluster.start()
+            leader = cluster.wait_for_leader(timeout=15.0)
+            if leader is None:
+                raise TimeoutError("no leader for the overload bench")
+            nodes = [mock.node() for _ in range(nodes_n)]
+            for n in nodes:
+                leader.register_node(n)
+
+            def submit(_i: int) -> None:
+                (cluster.leader() or leader).register_job(service_job(1))
+
+            # max-sustainable: closed-loop sequential submits for ~1 s
+            # (the client waits for each quorum ack before the next)
+            t0 = time.perf_counter()
+            cal = 0
+            while time.perf_counter() - t0 < 1.0:
+                submit(-1)
+                cal += 1
+            base_rate = cal / (time.perf_counter() - t0)
+            rate = min(400.0, max(50.0, 10.0 * base_rate))
+            # drain the calibration backlog so the unloaded heartbeat
+            # baseline below isn't polluted by leftover eval work
+            leader.server.wait_for_idle(timeout=30.0,
+                                        include_delayed=False)
+
+            hb_stop = threading.Event()
+            hb_lock = threading.Lock()
+            hb_lat: list = []
+
+            def heartbeats() -> None:
+                k = 0
+                while not hb_stop.is_set():
+                    node = nodes[k % nodes_n]
+                    k += 1
+                    h0 = time.perf_counter()
+                    try:
+                        (cluster.leader() or leader).heartbeat(node.id)
+                    except Exception:
+                        pass  # liveness noise, measured via the gap
+                    else:
+                        with hb_lock:
+                            hb_lat.append(time.perf_counter() - h0)
+                    hb_stop.wait(0.05)
+
+            hb_thread = threading.Thread(target=heartbeats, daemon=True)
+            hb_thread.start()
+            time.sleep(1.0)  # unloaded heartbeat baseline
+            with hb_lock:
+                hb_base_p99 = _percentile(hb_lat, 0.99) or 0.05
+                hb_lat.clear()
+
+            # watchdog: the OFF arm may take much longer than burst_s
+            # to chew through the backlog (that IS the collapse); bound
+            # the trial so the rung terminates either way
+            stop_ev = threading.Event()
+            watchdog = threading.Timer(burst_s * 6, stop_ev.set)
+            watchdog.start()
+            try:
+                res = run_open_loop(submit, rate=rate, duration=burst_s,
+                                    workers=workers_n, stop=stop_ev)
+            finally:
+                watchdog.cancel()
+            hb_stop.set()
+            hb_thread.join(timeout=10.0)
+            with hb_lock:
+                hb_burst_p99 = _percentile(hb_lat, 0.99)
+            return {"base_rate": base_rate, "rate": rate,
+                    "goodput": res["goodput"], "ok": res["ok"],
+                    "shed": res["shed"], "errors": res["errors"],
+                    "hb_p99_base": hb_base_p99,
+                    "hb_p99_burst": hb_burst_p99}
+        finally:
+            cluster.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    on = trial(True)
+    off = trial(False)
+    goodput_frac = on["goodput"] / max(on["base_rate"], 1e-9)
+    hb_ratio = on["hb_p99_burst"] / max(on["hb_p99_base"], 1e-9)
+    # sub-ms unloaded p99s make a bare 2x multiple unmeetable under
+    # full CPU saturation (the GIL, not the queues, sets the tail);
+    # gate against 2x-or-an-absolute-second, the chaos smoke's bound
+    hb_bound = max(2.0 * on["hb_p99_base"], 1.0)
+    emit("overload_goodput", on["goodput"], "jobs_s",
+         vs_baseline=on["goodput"] / max(off["goodput"], 1e-9),
+         goodput_frac=goodput_frac,
+         gate_goodput=bool(goodput_frac >= 0.70),
+         hb_ratio=hb_ratio,
+         gate_hb=bool(on["hb_p99_burst"] <= hb_bound),
+         base_rate=on["base_rate"], offered_rate=on["rate"],
+         shed=on["shed"], errors=on["errors"],
+         hb_p99_base_ms=on["hb_p99_base"] * 1e3,
+         hb_p99_burst_ms=on["hb_p99_burst"] * 1e3,
+         off_goodput=off["goodput"], off_shed=off["shed"],
+         off_hb_p99_base_ms=off["hb_p99_base"] * 1e3,
+         off_hb_p99_burst_ms=off["hb_p99_burst"] * 1e3)
+
+
 CONFIGS = [
     # before the headline: a driver timeout must not eat the raft rung
     ("raft3", raft_commit_throughput_3node),
@@ -1882,6 +2033,7 @@ CONFIGS = [
     ("cfg7", cfg7_sharded_5k),
     ("swarm_heartbeat", cfg_swarm_heartbeat),
     ("read_fanout", cfg_read_fanout),
+    ("overload_goodput", cfg_overload_goodput),
 ]
 
 

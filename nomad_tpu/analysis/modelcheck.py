@@ -897,7 +897,8 @@ def _preload() -> None:
     import tempfile  # noqa: F401
 
     from ..chaos import fsfaults, invariants  # noqa: F401
-    from ..core import broker, events, heartbeat, metrics, plan_apply  # noqa: F401
+    from ..core import broker, events, heartbeat, loadctl, metrics, plan_apply  # noqa: F401
+    from ..utils import backoff  # noqa: F401
     from ..obs import trace  # noqa: F401
     from ..raft import durable, fsm, node, transport  # noqa: F401
     from ..state import persist, store, watch  # noqa: F401
@@ -2065,11 +2066,130 @@ def _scenario_event_flow(env: ScenarioEnv) -> None:
                              + tracker.violations[0].render())
 
 
+@scenario("overload")
+def _scenario_overload(env: ScenarioEnv) -> None:
+    """nomadload admission plane under racing callers on a virtual
+    clock: three submitter threads hammer the gate while one flips the
+    watermarked queue between calm and hard-tripped and another reads
+    snapshot()/ledger() concurrently. Checked across every explored
+    interleaving:
+
+    - tier-0 is NEVER shed while alive (invariant 10's kernel);
+    - accounting closes: admitted + shed == calls made, and the ledger
+      agrees with the stats;
+    - the shared RetryBudget can never hand out more retries than its
+      cap + ratio * recorded requests (no interleaving over-spends);
+    - RetryLater survives its wire str() round trip from inside a
+      racing thread."""
+    from ..core.loadctl import (
+        TIER_LIVENESS,
+        TIER_SUBMIT,
+        AdmissionController,
+        RetryLater,
+    )
+    from ..utils.backoff import RetryBudget
+
+    clock = [0.0]
+    clock_lock = threading.Lock()
+
+    def now() -> float:
+        with clock_lock:
+            clock[0] += 0.001  # every observation advances virtual time
+            return clock[0]
+
+    depth = [0]
+    adm = AdmissionController(enabled=True, clock=now, refresh_s=0.0,
+                              brownout_after=0.05, brownout_exit=0.1)
+    adm.register_queue("q", lambda: depth[0], soft=10, hard=100,
+                       commit_path=True)
+    budget = RetryBudget(ratio=0.25, min_rate=0.0, cap=3.0, clock=now)
+
+    calls = [0]
+    calls_lock = threading.Lock()
+    errors: List[str] = []
+
+    def submitter(name: str) -> None:
+        for _ in range(8):
+            budget.record_request()
+            with calls_lock:
+                calls[0] += 1
+            after = adm.try_admit(TIER_SUBMIT, source=name)
+            if after is not None:
+                # shed: retry once iff the budget allows, as a real
+                # client would; rehydrate the wire form on the way
+                e = RetryLater(TIER_SUBMIT, after, reason=name)
+                r = RetryLater("RetryLater: " + str(e))
+                if abs(r.after - e.after) > 0.001 or r.tier != e.tier:
+                    errors.append(f"wire roundtrip broke: {e} -> {r}")
+                if budget.spend_retry():
+                    with calls_lock:
+                        calls[0] += 1
+                    adm.try_admit(TIER_SUBMIT, source=name)
+
+    def liveness() -> None:
+        for _ in range(12):
+            with calls_lock:
+                calls[0] += 1
+            if adm.try_admit(TIER_LIVENESS, source="hb") is not None:
+                errors.append("tier-0 shed while alive")
+
+    def flipper() -> None:
+        for _ in range(6):
+            depth[0] = 100
+            now()
+            adm.shed_floor()
+            depth[0] = 0
+            now()
+            adm.shed_floor()
+
+    def reader() -> None:
+        for _ in range(6):
+            snap = adm.snapshot()
+            if snap["shed_floor"] < TIER_SUBMIT:
+                errors.append(f"floor below submit: {snap}")
+            adm.ledger()
+
+    threads = [threading.Thread(target=submitter, args=(f"s{i}",),
+                                name=f"submitter-{i}") for i in range(3)]
+    threads.append(threading.Thread(target=liveness, name="liveness"))
+    threads.append(threading.Thread(target=flipper, name="flipper"))
+    threads.append(threading.Thread(target=reader, name="reader"))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    if errors:
+        raise AssertionError(f"overload scenario: {errors[:3]}")
+    ledger = adm.ledger()
+    shed_t0 = [e for e in ledger if e[1] == TIER_LIVENESS
+               and e[2] == "shed"]
+    if shed_t0:
+        raise AssertionError(f"{len(shed_t0)} tier-0 sheds while alive")
+    # every try_admit records exactly one outcome, in both the stats
+    # and the ledger — no interleaving loses or double-counts one
+    if adm.stats["admitted"] + adm.stats["shed"] != calls[0]:
+        raise AssertionError(
+            f"gate accounting leak: {calls[0]} calls vs "
+            f"{adm.stats['admitted']} + {adm.stats['shed']} outcomes")
+    if len(ledger) != calls[0]:
+        raise AssertionError(
+            f"ledger/stats disagree: {calls[0]} calls, "
+            f"{len(ledger)} ledger entries")
+    # the retry budget can never over-spend: every retry was funded by
+    # the starting cap or a recorded request's deposit
+    max_retries = budget.cap + budget.ratio * budget.stats["requests"]
+    if budget.stats["retries"] > max_retries + 1e-9:
+        raise AssertionError(
+            f"retry budget over-spent: {budget.stats} (max "
+            f"{max_retries:.2f})")
+
+
 SMOKE_SCENARIOS = ("raft_commit", "raft_stepdown", "read_index",
                    "snapshot_compact",
                    "plan_pipeline", "broker_batch", "solve_batch",
                    "store_ownership", "node_lifecycle", "tensor_launch",
-                   "event_flow")
+                   "event_flow", "overload")
 
 
 def smoke(base_seed: int, seeds_per_scenario: int = 3,

@@ -2,17 +2,39 @@
 
 Talks to the /v1 HTTP agent. Supports blocking queries via
 (index, wait) the same way the reference QueryOptions do.
+
+nomadload client half: every request carries an X-Nomad-Deadline
+header (now + timeout) the server propagates end to end, and a 429
+(RetryLater) answer is retried after its Retry-After hint — but only
+inside the per-token RetryBudget (retries <= ~10% of requests), so a
+fleet of clients can never amplify a rejection storm.
 """
 
 from __future__ import annotations
 
 import json
+import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..structs.job import Job
+from ..utils.backoff import RetryBudget
 from .codec import to_dict
+
+# one budget per client token: every ApiClient sharing a credential
+# also shares its retry allowance (SRE retry-budget semantics)
+_BUDGET_LOCK = threading.Lock()
+_BUDGETS: Dict[str, RetryBudget] = {}
+
+
+def _budget_for(token: str) -> RetryBudget:
+    with _BUDGET_LOCK:
+        b = _BUDGETS.get(token)
+        if b is None:
+            b = _BUDGETS[token] = RetryBudget()
+        return b
 
 
 class ApiError(Exception):
@@ -29,6 +51,7 @@ class ApiClient:
         self.namespace = namespace
         self.timeout = timeout
         self.token = token  # X-Nomad-Token (reference SecretID auth)
+        self.retry_budget = _budget_for(token)
 
     # -- transport --
 
@@ -44,22 +67,43 @@ class ApiClient:
         data = None
         if body is not None:
             data = json.dumps(to_dict(body)).encode()
-        headers = {"Content-Type": "application/json"}
+        deadline = time.time() + self.timeout
+        headers = {"Content-Type": "application/json",
+                   # absolute deadline; the server sheds any stage of
+                   # this request that would finish after it
+                   "X-Nomad-Deadline": f"{deadline:.6f}"}
         if self.token:
             headers["X-Nomad-Token"] = self.token
-        req = urllib.request.Request(url, data=data, method=method,
-                                     headers=headers)
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                payload = json.loads(resp.read() or b"null")
-                index = int(resp.headers.get("X-Nomad-Index") or 0)
-                return payload, index
-        except urllib.error.HTTPError as e:
+        self.retry_budget.record_request()
+        while True:
+            req = urllib.request.Request(url, data=data, method=method,
+                                         headers=headers)
             try:
-                msg = json.loads(e.read()).get("error", str(e))
-            except Exception:
-                msg = str(e)
-            raise ApiError(e.code, msg) from e
+                with urllib.request.urlopen(
+                        req, timeout=max(0.05, deadline - time.time())
+                        ) as resp:
+                    payload = json.loads(resp.read() or b"null")
+                    index = int(resp.headers.get("X-Nomad-Index") or 0)
+                    return payload, index
+            except urllib.error.HTTPError as e:
+                try:
+                    msg = json.loads(e.read()).get("error", str(e))
+                except Exception:
+                    msg = str(e)
+                if e.code == 429:
+                    # honor Retry-After, bounded by the deadline and
+                    # the shared retry budget — an exhausted budget
+                    # fails fast instead of feeding the storm
+                    try:
+                        after = float(e.headers.get("Retry-After") or 0.5)
+                    except (TypeError, ValueError):
+                        after = 0.5
+                    after = min(max(after, 0.05), 30.0)
+                    if (time.time() + after < deadline
+                            and self.retry_budget.spend_retry()):
+                        time.sleep(after)
+                        continue
+                raise ApiError(e.code, msg) from e
 
     def get(self, path: str, **params):
         return self._request("GET", path, params=params)

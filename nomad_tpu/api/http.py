@@ -19,6 +19,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from ..core.loadctl import (RetryLater, TIER_COMMIT, TIER_READ, TIER_SUBMIT,
+                            bind_deadline, bind_tier, deadline_expired)
 from ..obs import TRACER
 from ..structs import enums
 from ..structs.job import Job
@@ -29,6 +31,17 @@ from .jobspec import _validate
 log = logging.getLogger("nomad_tpu.api")
 
 MAX_BLOCK_S = 30.0
+# nomadload HTTP hardening: reject oversized bodies (413) and malformed
+# JSON (400) BEFORE touching a store snapshot or any endpoint logic
+MAX_BODY_BYTES = 8 << 20
+
+
+class BodyTooLarge(Exception):
+    pass
+
+
+class MalformedBody(Exception):
+    pass
 
 _WAIT_UNITS = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0}
 
@@ -123,6 +136,7 @@ class HTTPAgent:
             _read_index: Optional[int] = None
             _known_leader: Optional[bool] = None
             _last_contact_ms: Optional[int] = None
+            _degraded: bool = False
 
             def _reply(self, code: int, payload, index: Optional[int] = None):
                 body = json.dumps(to_dict(payload)).encode()
@@ -145,6 +159,11 @@ class HTTPAgent:
                 if self._last_contact_ms is not None:
                     self.send_header("X-Nomad-LastContact",
                                      str(self._last_contact_ms))
+                if self._degraded:
+                    # brownout: this read skipped the read-index round
+                    # and may be stale — say so truthfully
+                    self.send_header("X-Nomad-Consistency-Degraded",
+                                     "true")
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -155,7 +174,41 @@ class HTTPAgent:
                 length = int(self.headers.get("Content-Length") or 0)
                 if not length:
                     return {}
-                return json.loads(self.rfile.read(length))
+                if length > MAX_BODY_BYTES:
+                    # refuse before reading: the bytes never enter the
+                    # process (the keep-alive connection is closed since
+                    # the unread body would corrupt the next request)
+                    raise BodyTooLarge(f"{length} bytes > {MAX_BODY_BYTES}")
+                raw = self.rfile.read(length)
+                try:
+                    return json.loads(raw)
+                except ValueError as e:
+                    raise MalformedBody(str(e)) from None
+
+            def _bound_ctx(self, tier: int):
+                """Bind the request's (deadline, tier) from headers for
+                the duration of the verb (nomadload deadline
+                propagation: X-Nomad-Deadline is an absolute epoch
+                timestamp stamped by the client from its timeout)."""
+                raw = self.headers.get("X-Nomad-Deadline", "")
+                dl = None
+                if raw:
+                    try:
+                        dl = float(raw)
+                    except ValueError:
+                        dl = None
+                return bind_deadline(dl), bind_tier(tier)
+
+            def _retry_later(self, e: RetryLater) -> None:
+                """429 + Retry-After: the admission plane shed this
+                request; the client backs off within its retry budget."""
+                body = json.dumps({"error": str(e)}).encode()
+                self.send_response(429)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Retry-After", f"{max(e.after, 0.0):.3f}")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def _block(self, q: dict) -> None:
                 """Blocking query: park until the store moves past index
@@ -290,6 +343,7 @@ class HTTPAgent:
                     self._read_index = None
                     self._known_leader = None
                     self._last_contact_ms = None
+                    self._degraded = False
                     url = urlparse(self.path)
                     if url.path in ("/", "/ui", "/ui/"):
                         # the embedded dashboard (reference serves the
@@ -307,21 +361,29 @@ class HTTPAgent:
                     q = parse_qs(url.query)
                     if self._maybe_forward_region("GET", url.path, q):
                         return
-                    acl = self._acl()
-                    if url.path == "/v1/event/stream":
-                        # the stream carries payloads from every
-                        # namespace; management-only under ACLs
-                        if acl is not None and not acl.management:
-                            return self._error(403, "Permission denied")
-                        return agent._route_event_stream(self, q)
-                    if url.path == "/v1/agent/monitor":
-                        if acl is not None and not acl.allow_agent_read():
-                            return self._error(403, "Permission denied")
-                        return agent._route_monitor(self, q)
-                    if agent._setup_read(self, q):
-                        return  # no leader / read index timed out
-                    self._block(q)
-                    agent._route_get(self, url.path, q, acl)
+                    b_dl, b_tier = self._bound_ctx(TIER_READ)
+                    with b_dl, b_tier:
+                        if deadline_expired():
+                            return self._error(
+                                504, "request deadline passed")
+                        agent._admit_http(TIER_READ, "http_get")
+                        acl = self._acl()
+                        if url.path == "/v1/event/stream":
+                            # the stream carries payloads from every
+                            # namespace; management-only under ACLs
+                            if acl is not None and not acl.management:
+                                return self._error(403, "Permission denied")
+                            return agent._route_event_stream(self, q)
+                        if url.path == "/v1/agent/monitor":
+                            if acl is not None and not acl.allow_agent_read():
+                                return self._error(403, "Permission denied")
+                            return agent._route_monitor(self, q)
+                        if agent._setup_read(self, q):
+                            return  # no leader / read index timed out
+                        self._block(q)
+                        agent._route_get(self, url.path, q, acl)
+                except RetryLater as e:
+                    self._retry_later(e)
                 except PermissionError as e:
                     self._error(403, str(e))
                 except Exception as e:
@@ -334,13 +396,31 @@ class HTTPAgent:
                     self._read_index = None
                     self._known_leader = None
                     self._last_contact_ms = None
+                    self._degraded = False
                     url = urlparse(self.path)
                     q = parse_qs(url.query)
+                    # body-size / JSON fast-reject runs BEFORE any store
+                    # snapshot or endpoint work (nomadload hardening)
                     body = self._body()
                     if self._maybe_forward_region("POST", url.path, q,
                                                   body):
                         return
-                    agent._route_post(self, url.path, q, body, self._acl())
+                    tier = agent._http_tier(url.path)
+                    b_dl, b_tier = self._bound_ctx(tier)
+                    with b_dl, b_tier:
+                        if deadline_expired():
+                            return self._error(
+                                504, "request deadline passed")
+                        agent._admit_http(tier, "http_write")
+                        agent._route_post(self, url.path, q, body,
+                                          self._acl())
+                except BodyTooLarge as e:
+                    self.close_connection = True
+                    self._error(413, f"request body too large: {e}")
+                except MalformedBody as e:
+                    self._error(400, f"malformed JSON body: {e}")
+                except RetryLater as e:
+                    self._retry_later(e)
                 except PermissionError as e:
                     self._error(403, str(e))
                 except Exception as e:
@@ -354,11 +434,21 @@ class HTTPAgent:
                     self._read_index = None
                     self._known_leader = None
                     self._last_contact_ms = None
+                    self._degraded = False
                     url = urlparse(self.path)
                     q = parse_qs(url.query)
                     if self._maybe_forward_region("DELETE", url.path, q):
                         return
-                    agent._route_delete(self, url.path, q, self._acl())
+                    tier = agent._http_tier(url.path)
+                    b_dl, b_tier = self._bound_ctx(tier)
+                    with b_dl, b_tier:
+                        if deadline_expired():
+                            return self._error(
+                                504, "request deadline passed")
+                        agent._admit_http(tier, "http_write")
+                        agent._route_delete(self, url.path, q, self._acl())
+                except RetryLater as e:
+                    self._retry_later(e)
                 except PermissionError as e:
                     self._error(403, str(e))
                 except Exception as e:
@@ -393,6 +483,21 @@ class HTTPAgent:
     # -- routing (reference http.go registerHandlers) --
 
     @staticmethod
+    def _http_tier(path: str) -> int:
+        """Admission tier of a mutating HTTP route: alloc/node
+        lifecycle updates are commit-tier (they answer running
+        workloads); everything else a write submits new work."""
+        if path.startswith(("/v1/allocation/", "/v1/node/", "/v1/nodes")):
+            return TIER_COMMIT
+        return TIER_SUBMIT
+
+    def _admit_http(self, tier: int, source: str) -> None:
+        """Ingress admission (nomadload): raises RetryLater -> 429."""
+        adm = getattr(self.server, "loadctl", None)
+        if adm is not None:
+            adm.admit(tier, source=source)
+
+    @staticmethod
     def _ns_allowed(acl, ns: str, cap: str) -> bool:
         return acl is None or acl.allow_namespace_operation(ns, cap)
 
@@ -425,6 +530,16 @@ class HTTPAgent:
             REGISTRY.incr("nomad.reads.follower")
         if q.get("stale", [""])[0] == "true":
             REGISTRY.incr("nomad.reads.stale")
+            return False
+        adm = getattr(self.server, "loadctl", None)
+        if adm is not None and adm.degraded():
+            # brownout: answer from the local replica without the
+            # read-index round trip; the response carries
+            # X-Nomad-Consistency-Degraded so the client knows the
+            # consistency contract was downgraded, and LastContact
+            # still bounds the staleness
+            REGISTRY.incr("nomad.reads.degraded")
+            h._degraded = True
             return False
         consistent = q.get("consistent", [""])[0] == "true"
         from ..raft.node import NotLeaderError

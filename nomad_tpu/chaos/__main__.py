@@ -9,6 +9,7 @@
     python -m nomad_tpu.chaos --swarm-smoke
     python -m nomad_tpu.chaos --watch-smoke
     python -m nomad_tpu.chaos --flow-smoke
+    python -m nomad_tpu.chaos --load-smoke
     python -m nomad_tpu.chaos --swarm-scale [N]
 
 Exit 0 when every invariant holds; 2 on a violation (the CI gate in
@@ -63,6 +64,15 @@ crash/restart; any mutation whose delta never reached the stream fails
 the run (the scripts/check.sh --flow-smoke gate; ANALYSIS.md
 "nomadflow").
 
+`--load-smoke` runs the overload smoke: a durable 3-node cluster under
+a ~10x open-loop job-submit burst (seeded Poisson arrivals that do NOT
+let up when the server slows) with a leader crash mid-burst — no
+heartbeat is ever shed, heartbeat p99 stays bounded, zero missed-TTL
+false positives, every acked submit survives the failover, and
+invariant 10 (overload tier ordering) holds on every replica (the
+scripts/check.sh --load-smoke gate; ROBUSTNESS.md "Overload
+envelope").
+
 `--watch-smoke` runs the read-path failover smoke: blocking queries +
 event subscriptions parked on ALL 3 servers while the leader crashes —
 survivors' parked queries complete with the post-failover result at a
@@ -75,6 +85,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import sys
 import tempfile
 import threading
@@ -396,6 +407,226 @@ def e2e_smoke(jobs_n: int = 300, nodes_n: int = 75, workers: int = 4) -> int:
           f"pre-crash all survived the leader restart, "
           f"rejection {rejection:.1%}, "
           f"{checker.stats['checks']} invariant sweeps, {dt:.1f}s")
+    return 0
+
+
+def load_smoke(nodes_n: int = 30, burst_s: float = 6.0,
+               workers: int = 24) -> int:
+    """Overload smoke (scripts/check.sh --load-smoke): a durable
+    3-node cluster under a ~10x open-loop job-submit burst with a
+    leader crash mid-burst (nomadload, ROBUSTNESS.md "Overload
+    envelope"). Asserts:
+
+    - tier-0 SLO: no heartbeat was ever shed, heartbeat p99 stayed
+      bounded through the burst, and zero missed-TTL false positives
+      (check_node_liveness attribution on every replica);
+    - the admission plane engaged (submit sheds > 0 at 10x) AND let
+      real work through (ok > 0);
+    - zero acked-work loss: every register_job that RETURNED is in the
+      FSM after the failover drains — a shed request was refused
+      before any state changed, an acked one is quorum-durable;
+    - invariant 10 (overload tier ordering) + the safety sweep on
+      every replica."""
+    import shutil
+
+    from ..core.loadctl import RetryLater
+    from ..core.server import ServerConfig
+    from ..raft.cluster import RaftCluster
+    from .invariants import InvariantChecker
+    from .overload import run_open_loop
+
+    t0 = time.monotonic()
+
+    def config_fn(_i: int) -> ServerConfig:
+        return ServerConfig(
+            num_workers=2, plan_commit_batching=True, eval_batch_size=8,
+            heartbeat_ttl=10.0, gc_interval=3600.0, nack_timeout=900.0,
+            failed_eval_followup_delay=3600.0,
+            # the plane under test: force-enabled (the smoke is
+            # meaningless against the kill-switch baseline) with
+            # watermarks low enough that a 10x burst genuinely trips
+            # them on a laptop-scale cluster. They must sit BELOW the
+            # open-loop worker pool: submits block in propose, so queue
+            # depth is bounded by the number of in-flight clients — a
+            # soft mark above that can never be reached.
+            loadctl_enabled=True,
+            loadctl_proposal_soft=8, loadctl_proposal_hard=24,
+            loadctl_plan_soft=8, loadctl_plan_hard=24,
+            loadctl_broker_soft=16, loadctl_broker_hard=48,
+            loadctl_brownout_after=0.5)
+
+    tmp = tempfile.mkdtemp(prefix="nomad-load-smoke-")
+    checker = InvariantChecker()
+    failures: list = []
+    try:
+        # high threshold: the burst commits ~10k entries, and default
+        # compaction would route the restarted victim's recovery
+        # through a chunked snapshot transfer that dominates the
+        # convergence budget. The transfer has its own dedicated smoke
+        # (--snap-smoke); this one audits the admission plane, so
+        # recovery stays on the plain append path.
+        cluster = RaftCluster(3, config_fn=config_fn, data_dir=tmp,
+                              snapshot_threshold=1 << 17)
+        cluster.start()
+        try:
+            leader = cluster.wait_for_leader(timeout=15.0)
+            if leader is None:
+                print("LOAD SMOKE: FAIL — no leader elected")
+                return 2
+            nodes = [mock.node() for _ in range(nodes_n)]
+            for n in nodes:
+                leader.register_node(n)
+
+            lock = threading.Lock()
+            acked_jobs: list = []
+
+            def submit(i: int) -> None:
+                j = mock.job()
+                j.task_groups[0].count = 1
+                j.task_groups[0].tasks[0].resources.cpu = 100
+                j.task_groups[0].tasks[0].resources.memory_mb = 64
+                entry = cluster.leader() or _live_entry(cluster)
+                entry.register_job(j)
+                with lock:
+                    acked_jobs.append(j.id)
+
+            # calibrate: closed-loop sequential submits for ~1 s give
+            # the max-sustainable single-client rate; the burst offers
+            # 10x that, open loop
+            cal_t0 = time.monotonic()
+            cal_n = 0
+            while time.monotonic() - cal_t0 < 1.0:
+                submit(-1)
+                cal_n += 1
+            base_rate = cal_n / (time.monotonic() - cal_t0)
+            # cap the offered rate: the smoke proves shedding + SLOs,
+            # not raw throughput, and the restarted victim must replay
+            # whatever the burst committed inside the smoke budget
+            burst_rate = min(500.0, max(100.0, 10.0 * base_rate))
+
+            # tier-0 plane: heartbeats keep flowing through the burst;
+            # a RetryLater here fails the smoke outright
+            hb_stop = threading.Event()
+            hb_lat: list = []
+            hb_shed = [0]
+            hb_err = [0]
+
+            def heartbeats():
+                k = 0
+                while not hb_stop.is_set():
+                    n = nodes[k % len(nodes)]
+                    k += 1
+                    h0 = time.monotonic()
+                    try:
+                        (cluster.leader()
+                         or _live_entry(cluster)).heartbeat(n.id)
+                    except RetryLater:
+                        with lock:
+                            hb_shed[0] += 1
+                    except Exception:
+                        # failover window: forwarding errors are
+                        # liveness noise, not sheds
+                        with lock:
+                            hb_err[0] += 1
+                    else:
+                        with lock:
+                            hb_lat.append(time.monotonic() - h0)
+                    hb_stop.wait(0.1)
+
+            hb_thread = threading.Thread(target=heartbeats, daemon=True)
+            hb_thread.start()
+            time.sleep(1.0)  # unloaded heartbeat baseline
+            with lock:
+                base_hb = sorted(hb_lat)
+                base_p99 = base_hb[int(0.99 * (len(base_hb) - 1))] \
+                    if base_hb else 0.05
+                hb_lat.clear()
+
+            victim = (cluster.leader() or leader).id
+
+            def crash_mid_burst():
+                time.sleep(burst_s / 2)
+                cluster.crash(victim)
+
+            crasher = threading.Thread(target=crash_mid_burst,
+                                       daemon=True)
+            crasher.start()
+            res = run_open_loop(submit, rate=burst_rate,
+                                duration=burst_s,
+                                seed=seed_from_env(), workers=workers)
+            crasher.join(timeout=burst_s + 10.0)
+
+            fresh = cluster.wait_for_leader(timeout=20.0)
+            if fresh is None:
+                print("LOAD SMOKE: FAIL — no leader after the crash")
+                return 2
+            cluster.restart(victim)
+            # let the admitted backlog drain before auditing the FSM
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                fresh = cluster.leader() or fresh
+                if fresh.server._running and fresh.server.wait_for_idle(
+                        timeout=10.0, include_delayed=False):
+                    break
+                time.sleep(0.1)
+            hb_stop.set()
+            hb_thread.join(timeout=10.0)
+
+            with lock:
+                burst_hb = sorted(hb_lat)
+                burst_p99 = burst_hb[int(0.99 * (len(burst_hb) - 1))] \
+                    if burst_hb else 0.0
+
+            # -- assertions --
+            if hb_shed[0]:
+                failures.append(
+                    f"tier-0 SLO: {hb_shed[0]} heartbeat(s) shed")
+            # absolute floor: under full CPU saturation the tail is
+            # GIL hand-off, not queueing the plane controls. With the
+            # nomadown sanitizer armed every FSM write also pays the
+            # fingerprint sweep, so the floor doubles — still 5x
+            # inside the 10 s heartbeat TTL.
+            hb_floor = 2.0 if os.environ.get("NOMAD_TPU_SAN") == "1" \
+                else 1.0
+            if burst_p99 > max(10.0 * base_p99, hb_floor):
+                failures.append(
+                    f"tier-0 SLO: heartbeat p99 {burst_p99 * 1e3:.0f}ms "
+                    f"under burst vs {base_p99 * 1e3:.0f}ms unloaded")
+            if res["ok"] == 0:
+                failures.append("no submit was admitted during the burst")
+            if res["shed"] == 0:
+                failures.append(
+                    f"admission plane never engaged at 10x "
+                    f"(rate {burst_rate:.0f}/s, {res})")
+            snap = fresh.local_store.snapshot()
+            have = {j.id for j in snap.jobs()}
+            lost = [j for j in acked_jobs if j not in have]
+            if lost:
+                failures.append(
+                    f"{len(lost)} acked job(s) lost across the "
+                    f"failover: {[i[:8] for i in lost[:5]]}")
+            checker.check_convergence(cluster, timeout=90.0)
+            checker.check_node_liveness(cluster)
+            checker.check_all(cluster)  # includes overload ordering
+
+            if failures:
+                print("LOAD SMOKE: FAIL —")
+                for f in failures[:20]:
+                    print(f"  {f}")
+                return 2
+        finally:
+            cluster.stop()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    dt = time.monotonic() - t0
+    print(f"LOAD SMOKE: ok — {res['offered']} offered at "
+          f"{burst_rate:.0f}/s (10x of {base_rate:.0f}/s), "
+          f"{res['ok']} admitted / {res['shed']} shed / "
+          f"{res['errors']} errors across a leader crash, "
+          f"{len(acked_jobs)} acked jobs all survived, heartbeat p99 "
+          f"{burst_p99 * 1e3:.0f}ms (unloaded {base_p99 * 1e3:.0f}ms), "
+          f"0 tier-0 sheds, {checker.stats['checks']} invariant "
+          f"sweeps, {dt:.1f}s")
     return 0
 
 
@@ -1595,6 +1826,12 @@ def main(argv=None) -> int:
                              "in sequence; liveness + alloc-uniqueness "
                              "on every replica) instead of the scenario "
                              "smoke")
+    parser.add_argument("--load-smoke", action="store_true",
+                        help="run the overload smoke (3-node cluster, "
+                             "10x open-loop submit burst, leader crash "
+                             "mid-burst; tier-0 heartbeat SLO, zero "
+                             "acked-work loss, overload tier ordering) "
+                             "instead of the scenario smoke")
     parser.add_argument("--flow-smoke", action="store_true",
                         help="run the event-completeness smoke (e2e "
                              "pipeline with nomadflow shadow replicas "
@@ -1634,6 +1871,8 @@ def main(argv=None) -> int:
         return snap_smoke()
     if args.swarm_smoke:
         return swarm_smoke()
+    if args.load_smoke:
+        return load_smoke()
     if args.flow_smoke:
         return flow_smoke()
     if args.watch_smoke:

@@ -373,6 +373,52 @@ class InvariantChecker:
             extra = f" (+{len(fresh) - 1} more)" if len(fresh) > 1 else ""
             self._fail(f"event completeness: {fresh[0].render()}{extra}")
 
+    # -- 10: overload tier ordering (nomadload) ------------------------
+
+    def check_overload_ordering(self, cluster, window: float = 0.5
+                                ) -> None:
+        """Audit every live server's admission ledger (nomadload): the
+        whole point of the overload plane is that liveness traffic
+        survives at the expense of bulk traffic, never the reverse.
+
+        (a) a tier-0 (liveness) request was never shed while the server
+            was alive — tier-0 sheds are legal only on a stopping
+            server (set_alive(False));
+        (b) tier ordering: no tier-0 shed has a tier>=2 (submit/read)
+            admit within ``window`` seconds of it — bulk work getting
+            through while heartbeats bounce is priority inversion.
+
+        Accepts a RaftCluster or a single (possibly replicated)
+        server."""
+        servers = (_live(cluster) if hasattr(cluster, "servers")
+                   else [cluster])
+        for s in servers:
+            core = getattr(s, "server", s)
+            adm = getattr(core, "loadctl", None)
+            if adm is None:
+                continue
+            ledger = adm.ledger()
+            t0_sheds = [(ts, src) for ts, tier, kind, src in ledger
+                        if tier == 0 and kind == "shed"]
+            if adm.snapshot()["alive"] and t0_sheds:
+                ts, src = t0_sheds[0]
+                self._fail(
+                    f"overload ordering: {getattr(s, 'id', 'server')} "
+                    f"shed {len(t0_sheds)} tier-0 request(s) while "
+                    f"alive (first: source={src})")
+            bulk_admits = [ts for ts, tier, kind, _src in ledger
+                           if tier >= 2 and kind == "admit"]
+            for ts, src in t0_sheds:
+                near = [b for b in bulk_admits if abs(b - ts) <= window]
+                if near:
+                    self._fail(
+                        f"overload ordering: "
+                        f"{getattr(s, 'id', 'server')} shed a tier-0 "
+                        f"request (source={src}) within {window:.1f}s "
+                        f"of {len(near)} tier>=2 admit(s) — priority "
+                        f"inversion")
+        self.stats["checks"] += 1
+
     # -- aggregate ----------------------------------------------------
 
     def check_all(self, cluster) -> None:
@@ -386,6 +432,7 @@ class InvariantChecker:
         self.check_log_matching(cluster)
         self.check_committed_durability(cluster)
         self.check_alloc_uniqueness(cluster)
+        self.check_overload_ordering(cluster)
         self.stats["checks"] += 1
 
     def _fail(self, msg: str) -> None:

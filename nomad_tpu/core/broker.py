@@ -13,7 +13,13 @@ Leader-only in-memory dispatch queue for evaluations:
   increments a counter and past delivery_limit the eval lands in the
   "_failed" queue for the leader to reap (eval_broker.go:28,678,728);
 - evals with wait_until in the future sit in a delay heap and enter the
-  ready queue when due (eval_broker.go:873 delayed evals).
+  ready queue when due (eval_broker.go:873 delayed evals);
+- poison-eval quarantine (nomadload): a job whose evals keep hitting
+  the delivery limit round after round gets capped-exponential followup
+  delays, and after quarantine_threshold rounds the eval is parked in a
+  quarantine list that RELEASES the job's serialization token — a
+  poisoned eval can delay its own job but never starve sibling evals of
+  the per-job ready slot.
 """
 
 from __future__ import annotations
@@ -37,14 +43,22 @@ FAILED_QUEUE = "_failed"
 # placements (the reference also uses 60s, eval_broker.go)
 DEFAULT_NACK_TIMEOUT = 60.0
 DEFAULT_DELIVERY_LIMIT = 3
+# failed-queue rounds (delivery-limit exhaustions) before a job's eval
+# chain is quarantined instead of re-entering the failed queue
+DEFAULT_QUARANTINE_THRESHOLD = 3
 
 
 @sanitized
 class EvalBroker:
     def __init__(self, nack_timeout: float = DEFAULT_NACK_TIMEOUT,
-                 delivery_limit: int = DEFAULT_DELIVERY_LIMIT):
+                 delivery_limit: int = DEFAULT_DELIVERY_LIMIT,
+                 quarantine_threshold: int = DEFAULT_QUARANTINE_THRESHOLD,
+                 admission=None):
         self.nack_timeout = nack_timeout
         self.delivery_limit = delivery_limit
+        self.quarantine_threshold = quarantine_threshold
+        # loadctl.AdmissionController or None; consulted on enqueue
+        self.admission = admission
 
         self._lock = threading.Condition()
         self._enabled = False
@@ -65,13 +79,18 @@ class EvalBroker:
         self._enqueue_times: Dict[str, float] = {}
         self._failed: List[Evaluation] = []
         self._cancelled: List[Evaluation] = []           # superseded pending evals
+        # (ns, job) -> consecutive failed-queue rounds; reset when any
+        # normally-delivered eval for the job acks
+        self._fail_rounds: Dict[Tuple[str, str], int] = {}
+        self._quarantined: List[Evaluation] = []
         self._delay_thread: Optional[threading.Thread] = None
         # incremented on every enable: a delay thread from a previous
         # enable generation exits on its next wakeup even if the broker
         # was re-enabled before it noticed the disable (nomadcheck
         # broker_batch scenario: two live delay threads otherwise)
         self._delay_gen = 0
-        self.stats = {"enqueued": 0, "dequeued": 0, "acked": 0, "nacked": 0}
+        self.stats = {"enqueued": 0, "dequeued": 0, "acked": 0, "nacked": 0,
+                      "quarantined": 0}
 
     # -- lifecycle --
 
@@ -103,6 +122,8 @@ class EvalBroker:
         self._failed.clear()
         self._cancelled.clear()
         self._enqueue_times.clear()
+        self._fail_rounds.clear()
+        self._quarantined.clear()
 
     @property
     def enabled(self) -> bool:
@@ -116,6 +137,7 @@ class EvalBroker:
             return len(self._unacked)
 
     def enqueue(self, ev: Evaluation) -> None:
+        self._admission_check(ev)
         with self._lock:
             if not self._enabled:
                 return
@@ -123,12 +145,36 @@ class EvalBroker:
             self._lock.notify_all()
 
     def enqueue_all(self, evals: List[Evaluation]) -> None:
+        if evals:
+            self._admission_check(evals[0], cost=float(len(evals)))
         with self._lock:
             if not self._enabled:
                 return
             for ev in evals:
                 self._enqueue_locked(ev)
             self._lock.notify_all()
+
+    def _admission_check(self, ev: Evaluation, cost: float = 1.0) -> None:
+        """nomadload consult at the broker boundary. An eval that was
+        already committed to the store (modify_index stamped) is NEVER
+        dropped here — shedding acked work breaks the load-smoke
+        zero-acked-work-loss invariant; those enqueues only charge the
+        tier bucket so pressure reflects the volume. An unpersisted eval
+        arriving under a tier>=submit request context may still be
+        refused with RetryLater (the caller has not acked anything
+        yet)."""
+        adm = self.admission
+        if adm is None:
+            return
+        from . import loadctl
+
+        tier = loadctl.current_tier(default=loadctl.TIER_NONE)
+        if tier < loadctl.TIER_SUBMIT or tier >= loadctl.TIER_NONE:
+            return  # liveness/commit work and unbound internal threads
+        if getattr(ev, "modify_index", 0):
+            adm.try_admit(tier, source="broker", cost=cost)
+        else:
+            adm.admit(tier, source="broker", cost=cost)
 
     def _enqueue_locked(self, ev: Evaluation) -> None:
         if ev.id in self._evals or ev.id in self._unacked:
@@ -229,7 +275,7 @@ class EvalBroker:
         timer = threading.Timer(self.nack_timeout,
                                 self._nack_timeout, (eval_id, token))
         timer.daemon = True
-        info = {"token": token, "eval": ev, "timer": timer,
+        info = {"token": token, "eval": ev, "timer": timer, "queue": st,
                 "deliveries": self._delivery_count(eval_id) + 1}
         self._unacked[eval_id] = info
         timer.start()
@@ -268,24 +314,34 @@ class EvalBroker:
             TRACER.event("eval.ack", trace=ev.trace())
             RECORDER.record("broker", "ack", eval=eval_id[:8])
             key = (ev.namespace, ev.job_id)
+            if info.get("queue") != FAILED_QUEUE:
+                # a normal delivery acked: the job's eval chain is
+                # healthy again, forget its quarantine history (the
+                # reaper's ack of a FAILED_QUEUE delivery must NOT
+                # reset the count — that ack is bookkeeping, not
+                # evidence the poison cleared)
+                self._fail_rounds.pop(key, None)
             if self._job_tracked.get(key) == eval_id:
                 del self._job_tracked[key]
-            # promote the *latest* pending eval for the job; older ones
-            # are superseded -> cancelled (reference eval dedup)
-            pending = self._pending.pop(key, None)
-            if pending:
-                _, _, nxt = heapq.heappop(pending)
-                for _, _, stale in pending:
-                    # record the cancellation on a copy — evals are shared
-                    # with MVCC store snapshots and must not mutate in
-                    # place; the server reaper persists these
-                    upd = _copy.copy(stale)
-                    upd.status = enums.EVAL_STATUS_CANCELLED
-                    upd.status_description = "cancelled after more recent eval was processed"
-                    self._cancelled.append(upd)
-                    self._enqueue_times.pop(stale.id, None)
-                self._enqueue_locked(nxt)
-                self._lock.notify_all()
+            self._promote_pending_locked(key)
+
+    def _promote_pending_locked(self, key: Tuple[str, str]) -> None:
+        """Promote the *latest* pending eval for the job; older ones
+        are superseded -> cancelled (reference eval dedup)."""
+        pending = self._pending.pop(key, None)
+        if pending:
+            _, _, nxt = heapq.heappop(pending)
+            for _, _, stale in pending:
+                # record the cancellation on a copy — evals are shared
+                # with MVCC store snapshots and must not mutate in
+                # place; the server reaper persists these
+                upd = _copy.copy(stale)
+                upd.status = enums.EVAL_STATUS_CANCELLED
+                upd.status_description = "cancelled after more recent eval was processed"
+                self._cancelled.append(upd)
+                self._enqueue_times.pop(stale.id, None)
+            self._enqueue_locked(nxt)
+            self._lock.notify_all()
 
     def nack(self, eval_id: str, token: str) -> None:
         with self._lock:
@@ -316,6 +372,29 @@ class EvalBroker:
             del self._job_tracked[key]
         self._delivery_counts[ev.id] = info["deliveries"]
         if info["deliveries"] >= self.delivery_limit:
+            rounds = self._fail_rounds.get(key, 0) + 1 if ev.job_id else 1
+            if ev.job_id:
+                self._fail_rounds[key] = rounds
+            if rounds >= self.quarantine_threshold:
+                # poison-eval quarantine: the job's eval chain has hit
+                # the delivery limit quarantine_threshold rounds in a
+                # row. Park it OUTSIDE the failed queue without
+                # re-taking _job_tracked, and promote siblings — a
+                # poisoned eval must never starve its job's
+                # serialization token.
+                self.stats["quarantined"] += 1
+                from .metrics import REGISTRY
+                REGISTRY.incr("nomad.broker.quarantined")
+                TRACER.event("eval.quarantined", trace=ev.trace(),
+                             rounds=rounds)
+                RECORDER.record("broker", "quarantine", eval=ev.id[:8],
+                                rounds=rounds)
+                self._quarantined.append(ev)
+                self._enqueue_times.pop(ev.id, None)
+                self._delivery_counts.pop(ev.id, None)
+                self._promote_pending_locked(key)
+                self._lock.notify_all()
+                return
             # too many failed deliveries: route to the failed queue
             # (eval_broker.go:28 failedQueue)
             RECORDER.record("broker", "failed_queue", eval=ev.id[:8],
@@ -345,6 +424,33 @@ class EvalBroker:
                     self._lock.notify_all()
                 sleep_for = (self._delay[0][0] - now) if self._delay else 0.2
                 self._lock.wait(min(max(sleep_for, 0.01), 0.2))
+
+    # -- quarantine (nomadload poison-eval handling) --
+
+    def followup_delay(self, ev: Evaluation, base: float) -> float:
+        """Delay before a delivery-limited eval's follow-up re-runs:
+        capped exponential in the job's consecutive failed-queue
+        rounds (base, 2*base, 4*base, ... <= 8*base). A flaky eval
+        retries quickly; a repeatedly-failing one backs off before the
+        quarantine threshold ends the chain."""
+        with self._lock:
+            rounds = self._fail_rounds.get((ev.namespace, ev.job_id), 1)
+        return min(base * 8.0, base * (2.0 ** max(0, rounds - 1)))
+
+    def drain_quarantined(self) -> List[Evaluation]:
+        """Quarantined evals for the reaper to mark failed — no
+        follow-up is scheduled for these."""
+        with self._lock:
+            out, self._quarantined = self._quarantined, []
+            return out
+
+    def quarantined_count(self) -> int:
+        with self._lock:
+            return len(self._quarantined)
+
+    def fail_rounds(self, namespace: str, job_id: str) -> int:
+        with self._lock:
+            return self._fail_rounds.get((namespace, job_id), 0)
 
     # -- introspection --
 
@@ -380,7 +486,7 @@ class EvalBroker:
                 heap = self._ready.get(FAILED_QUEUE)
                 while heap and heap[0][2] not in self._evals:
                     heapq.heappop(heap)  # stale entry
-                if heap or self._cancelled:
+                if heap or self._cancelled or self._quarantined:
                     return True
                 remaining = None if deadline is None else deadline - time.time()
                 if remaining is not None and remaining <= 0:

@@ -8,7 +8,19 @@ nomad.worker.invoke_scheduler_<type>, nomad.broker.total_unacked, ...).
 Gauges are computed by the HTTP layer from live subsystems at serve
 time; this module holds what must accumulate between scrapes. Exposed as
 JSON on /v1/metrics and prometheus text exposition with
-?format=prometheus."""
+?format=prometheus.
+
+The overload-control plane (core/loadctl.py, OBSERVABILITY.md) reports
+through the ``nomad.load.*`` family: per-tier admit/shed counters
+(nomad.load.admit.<tier> / nomad.load.shed.<tier> plus the aggregate
+nomad.load.shed), live queue-depth gauges (nomad.load.depth.<queue>),
+the pressure level and degraded flag (nomad.load.pressure,
+nomad.load.degraded), brownout transitions
+(nomad.load.degraded_entries), deadline-expired work dropped before
+service (nomad.load.expired_drops), coalesced watch wakeups
+(nomad.load.coalesced_wakeups), and its satellite counters
+nomad.transport.dropped_frames, nomad.broker.quarantined and
+nomad.reads.degraded."""
 
 from __future__ import annotations
 

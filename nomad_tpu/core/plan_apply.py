@@ -43,15 +43,21 @@ from ..structs.plan import Plan, PlanResult
 
 
 class PendingPlan:
-    """A submitted plan awaiting the applier (reference plan_queue.go:33)."""
+    """A submitted plan awaiting the applier (reference plan_queue.go:33).
 
-    __slots__ = ("plan", "_event", "result", "error")
+    `deadline` (absolute time.time(), nomadload) is stamped from the
+    submitting request's bound deadline at enqueue; the applier drops a
+    plan whose deadline already passed instead of verifying and
+    committing work whose submitter has given up."""
 
-    def __init__(self, plan: Plan):
+    __slots__ = ("plan", "_event", "result", "error", "deadline")
+
+    def __init__(self, plan: Plan, deadline: Optional[float] = None):
         self.plan = plan
         self._event = threading.Event()
         self.result: Optional[PlanResult] = None
         self.error: Optional[Exception] = None
+        self.deadline = deadline
 
     def respond(self, result: Optional[PlanResult], error: Optional[Exception]) -> None:
         self.result = result
@@ -86,7 +92,9 @@ class PlanQueue:
             self._lock.notify_all()
 
     def enqueue(self, plan: Plan) -> PendingPlan:
-        pending = PendingPlan(plan)
+        from . import loadctl
+
+        pending = PendingPlan(plan, deadline=loadctl.current_deadline())
         with self._lock:
             if not self._enabled:
                 pending.respond(None, RuntimeError("plan queue disabled"))
@@ -392,6 +400,15 @@ class PlanApplier:
             pending = self.queue.dequeue(timeout=0.2)
             REGISTRY.set_gauge("nomad.plan.queue_depth", self.queue.depth())
             if pending is None:
+                continue
+            from . import loadctl
+
+            if loadctl.check_expired(pending.deadline, "plan_apply"):
+                # submitter's deadline passed while the plan queued:
+                # verifying + committing it would be wasted work the
+                # worker already timed out on (nomadload)
+                pending.respond(None, TimeoutError(
+                    "plan deadline expired before apply"))
                 continue
             try:
                 inflight = [(f, c) for f, c in inflight if not f.done()]

@@ -13,6 +13,7 @@ beneath without touching this layer.
 from __future__ import annotations
 
 import copy as _copy
+import functools
 import logging
 import queue
 import threading
@@ -34,9 +35,33 @@ from .deployments import DeploymentWatcher
 from .drainer import NodeDrainer
 from .events import EventBroker
 from .heartbeat import HeartbeatManager, HeartbeatPlaneInactive
+from .loadctl import TIER_COMMIT, TIER_LIVENESS, TIER_SUBMIT, bind_tier
 from .periodic import PeriodicDispatcher
 from .plan_apply import PlanApplier, PlanQueue
 from .worker import Worker
+
+
+def _tiered(tier: int, source: str):
+    """Admission + tier binding for an RPC-endpoint method (nomadload):
+    consult the server's AdmissionController — RetryLater propagates to
+    the caller as HTTP 429 / a typed wire error — then bind the tier
+    thread-locally so every downstream consult point on this request
+    (raft propose, broker enqueue) classifies the work identically.
+    Tier 0 records its admit (the evidence chaos invariant 10 audits)
+    but is never shed while the server is alive; a stopping server's
+    heartbeat plane already rejects truthfully via
+    HeartbeatPlaneInactive."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            if tier <= TIER_LIVENESS:
+                self.loadctl.try_admit(tier, source=source)
+            else:
+                self.loadctl.admit(tier, source=source)
+            with bind_tier(tier):
+                return fn(self, *args, **kwargs)
+        return wrapper
+    return deco
 
 
 @dataclass
@@ -99,6 +124,30 @@ class ServerConfig:
     authoritative_region: str = ""
     acl_replication_interval: float = 30.0
     replication_token: str = ""
+    # -- nomadload overload envelope (ROBUSTNESS.md) -----------------
+    # loadctl_enabled: None reads the NOMAD_TPU_LOADCTL env kill
+    # switch; True/False overrides it (the bench baseline arm).
+    loadctl_enabled: Optional[bool] = None
+    # queue-depth watermarks feeding the shed floor: soft sheds reads,
+    # hard sheds submits too (loadctl.AdmissionController). Generous by
+    # design — they bound collapse, they don't police steady state.
+    loadctl_proposal_soft: int = 512
+    loadctl_proposal_hard: int = 2048
+    loadctl_plan_soft: int = 256
+    loadctl_plan_hard: int = 1024
+    loadctl_broker_soft: int = 8192
+    loadctl_broker_hard: int = 32768
+    loadctl_parked_soft: int = 16384
+    loadctl_parked_hard: int = 65536
+    # brownout hysteresis: sustained commit-path hard pressure for
+    # `brownout_after` s enters degraded mode (stale-only reads,
+    # coalesced watch wakeups); `brownout_exit` s of calm leaves it
+    loadctl_brownout_after: float = 1.0
+    loadctl_brownout_exit: float = 3.0
+    # poison-eval quarantine (core/broker.py): a job whose evals hit
+    # the delivery limit this many times in a row is quarantined — its
+    # serialization token released, no more hot followups
+    eval_quarantine_threshold: int = 3
     sched_config: SchedulerConfiguration = field(default_factory=SchedulerConfiguration)
 
 
@@ -110,8 +159,20 @@ class Server:
         self.logger = logger or logging.getLogger("nomad_tpu.server")
         self.sched_config = self.config.sched_config
 
-        self.broker = EvalBroker(nack_timeout=self.config.nack_timeout,
-                                 delivery_limit=self.config.eval_delivery_limit)
+        from .loadctl import AdmissionController
+
+        # nomadload admission plane: one controller per server, wired
+        # to the live queue depths below (ROBUSTNESS.md "Overload
+        # envelope"). Constructed first so every subsystem can take it.
+        self.loadctl = AdmissionController(
+            enabled=self.config.loadctl_enabled,
+            brownout_after=self.config.loadctl_brownout_after,
+            brownout_exit=self.config.loadctl_brownout_exit)
+        self.broker = EvalBroker(
+            nack_timeout=self.config.nack_timeout,
+            delivery_limit=self.config.eval_delivery_limit,
+            quarantine_threshold=self.config.eval_quarantine_threshold,
+            admission=self.loadctl)
         self.blocked = BlockedEvals(self._requeue_unblocked,
                                     persist_fn=self.store.upsert_evals)
         self.plan_queue = PlanQueue()
@@ -170,6 +231,22 @@ class Server:
         self._commit_pump = threading.Thread(
             target=self._run_commit_pump, daemon=True, name="commit-pump")
         self._commit_pump.start()
+        # watermark sources: the live queue depths the gauges already
+        # export. The raft proposal queue registers itself when a
+        # ReplicatedServer attaches (raft/cluster.py).
+        self.loadctl.register_queue(
+            "plan", self.plan_queue.depth,
+            self.config.loadctl_plan_soft, self.config.loadctl_plan_hard,
+            commit_path=True)
+        self.loadctl.register_queue(
+            "broker", self.broker.pending_count,
+            self.config.loadctl_broker_soft,
+            self.config.loadctl_broker_hard)
+        self.loadctl.register_queue(
+            "parked", self.store.watches.parked,
+            self.config.loadctl_parked_soft,
+            self.config.loadctl_parked_hard)
+        self.store.watches.admission = self.loadctl
 
     # -- lifecycle (leader.go:357 establishLeadership) --
 
@@ -177,6 +254,7 @@ class Server:
         if self._running:
             return
         self._running = True
+        self.loadctl.set_alive(True)
         self.plan_queue.set_enabled(True)
         self.plan_applier.start()
         self.broker.set_enabled(True)
@@ -294,6 +372,10 @@ class Server:
         if not self._running:
             return
         self._running = False
+        # a stopping server may truthfully reject liveness traffic
+        # (the HeartbeatPlaneInactive contract); flip BEFORE teardown
+        # so invariant 10 never sees a live server shed tier 0
+        self.loadctl.set_alive(False)
         if getattr(self, "_repl_stop", None) is not None:
             self._repl_stop.set()
         for w in self.workers:
@@ -310,6 +392,7 @@ class Server:
         self.blocked.set_enabled(False)
         self.broker.set_enabled(False)
         self.plan_applier.stop()
+        self.store.watches.teardown()
         self._reaper.join(timeout=2.0)
 
     def __enter__(self):
@@ -431,6 +514,19 @@ class Server:
             cancelled = self.broker.drain_cancelled()
             if cancelled:
                 self.store.upsert_evals(cancelled)
+            # quarantined poison evals: mark failed, NO follow-up — the
+            # chain already burned quarantine_threshold failed-queue
+            # rounds and the job's serialization token is released
+            quarantined = self.broker.drain_quarantined()
+            if quarantined:
+                updates = []
+                for ev in quarantined:
+                    failed = _copy.copy(ev)
+                    failed.status = enums.EVAL_STATUS_FAILED
+                    failed.status_description = (
+                        "evaluation quarantined after repeated delivery failures")
+                    updates.append(failed)
+                self.store.upsert_evals(updates)
             # retry conflict-stranded (max-plan) blocked evals on a timer
             if time.time() >= next_unblock_failed:
                 self.blocked.unblock_failed()
@@ -453,7 +549,8 @@ class Server:
                 triggered_by=enums.TRIGGER_FAILED_FOLLOW_UP,
                 job_id=ev.job_id,
                 status=enums.EVAL_STATUS_PENDING,
-                wait_until=time.time() + self.config.failed_eval_followup_delay,
+                wait_until=time.time() + self.broker.followup_delay(
+                    ev, self.config.failed_eval_followup_delay),
                 previous_eval=ev.id,
                 create_time=time.time(),
             )
@@ -466,6 +563,7 @@ class Server:
 
     # -- Job endpoints (nomad/job_endpoint.go) --
 
+    @_tiered(TIER_SUBMIT, "job_register")
     def register_job(self, job: Job) -> str:
         """Job.Register: upsert + create an eval. Returns the eval id."""
         if self.sched_config.reject_job_registration:
@@ -490,6 +588,7 @@ class Server:
             return ""
         return self._create_job_eval(job, enums.TRIGGER_JOB_REGISTER)
 
+    @_tiered(TIER_SUBMIT, "job_dispatch")
     def dispatch_job(self, job_id: str, payload: bytes = b"",
                      meta: Optional[Dict[str, str]] = None,
                      namespace: str = "default") -> Dict[str, str]:
@@ -536,6 +635,7 @@ class Server:
         eval_id = self._create_job_eval(child, enums.TRIGGER_JOB_REGISTER)
         return {"dispatched_job_id": child.id, "eval_id": eval_id}
 
+    @_tiered(TIER_SUBMIT, "job_deregister")
     def deregister_job(self, job_id: str, namespace: str = "default",
                        purge: bool = False) -> str:
         snap = self.store.snapshot()
@@ -548,6 +648,7 @@ class Server:
         return self._create_job_eval(job, enums.TRIGGER_JOB_DEREGISTER,
                                      namespace=namespace)
 
+    @_tiered(TIER_SUBMIT, "job_evaluate")
     def create_job_eval(self, job: Job, trigger: str = enums.TRIGGER_JOB_REGISTER) -> str:
         """Public force-evaluation endpoint (reference Job.Evaluate);
         forwardable to the leader in a replicated deployment."""
@@ -599,6 +700,7 @@ class Server:
 
     # -- Node endpoints (nomad/node_endpoint.go) --
 
+    @_tiered(TIER_LIVENESS, "node_register")
     def register_node(self, node: Node) -> float:
         """Node.Register -> heartbeat TTL. A ready node triggers evals so
         system jobs land on it (node_endpoint.go createNodeEvals on
@@ -617,6 +719,7 @@ class Server:
             self._create_node_evals(node.id)
         return self.heartbeats.reset(node.id)
 
+    @_tiered(TIER_LIVENESS, "node_register_batch")
     def register_nodes(self, nodes: List[Node]) -> float:
         """Batched Node.Register: one FSM command upserts the whole
         chunk, one eval pass covers every ready node (the swarm's
@@ -637,6 +740,7 @@ class Server:
             self.heartbeats.reset(node.id)
         return self.config.heartbeat_ttl
 
+    @_tiered(TIER_LIVENESS, "heartbeat")
     def heartbeat(self, node_id: str) -> float:
         """Node.UpdateStatus(ready) from a live client. A node that was
         marked down by a missed TTL comes back to ready here (the
@@ -662,6 +766,7 @@ class Server:
             self.update_node_status(node_id, enums.NODE_STATUS_READY)
         return ttl
 
+    @_tiered(TIER_LIVENESS, "heartbeat_batch")
     def heartbeat_batch(self, node_ids: List[str]) -> float:
         """Batched heartbeat for swarm-scale clients: ready nodes are a
         leader-local timer re-arm (NO FSM traffic); nodes coming back
@@ -705,6 +810,7 @@ class Server:
             self._create_node_evals_batch(stale)
         return self.config.heartbeat_ttl
 
+    @_tiered(TIER_LIVENESS, "node_status")
     def update_node_status(self, node_id: str, status: str) -> None:
         self.store.update_node_status(node_id, status, ts=time.time())
         if status in (enums.NODE_STATUS_DOWN, enums.NODE_STATUS_DISCONNECTED):
@@ -721,6 +827,7 @@ class Server:
         (reference node_endpoint.go disconnect handling)."""
         self.mark_nodes_down([node_id], reason=reason)
 
+    @_tiered(TIER_LIVENESS, "node_expiry")
     def mark_nodes_down(self, node_ids: List[str], reason: str = "") -> None:
         """Batched missed-TTL handler: one status command per status
         class and one eval pass for the whole expiry batch. A node that
@@ -773,6 +880,7 @@ class Server:
         if down or disconnected:
             self._create_node_evals_batch(down + disconnected)
 
+    @_tiered(TIER_LIVENESS, "node_deregister")
     def deregister_node(self, node_id: str) -> None:
         """Node.Deregister: drop the node and reschedule its work."""
         self.heartbeats.remove(node_id)
@@ -837,6 +945,7 @@ class Server:
             self.broker.enqueue_all(evals)
         return out
 
+    @_tiered(TIER_COMMIT, "alloc_stop")
     def stop_alloc(self, alloc_id: str) -> str:
         """Alloc.Stop (reference nomad/alloc_endpoint.go Stop): mark the
         alloc for reschedule and evaluate — it stops in place and a
@@ -865,6 +974,7 @@ class Server:
         self.broker.enqueue(ev)
         return ev.id
 
+    @_tiered(TIER_COMMIT, "alloc_update")
     def update_allocs_from_client(self, updates: List) -> None:
         """Node.UpdateAlloc: batched client -> server alloc status sync;
         failed allocs trigger reschedule evals (node_endpoint.go
@@ -976,6 +1086,7 @@ class Server:
 
     # -- Eval endpoints --
 
+    @_tiered(TIER_SUBMIT, "job_scale")
     def scale_job(self, job_id: str, task_group: str, count: int,
                   namespace: str = "default") -> str:
         """Job.Scale (reference job_endpoint.go Scale): registers a new
@@ -1211,6 +1322,7 @@ class Server:
                           force: bool = False) -> None:
         self.store.delete_volume(vol_id, namespace, force=force)
 
+    @_tiered(TIER_SUBMIT, "eval_create")
     def create_eval(self, ev: Evaluation) -> str:
         self.store.upsert_evals([ev])
         if ev.should_enqueue():

@@ -15,6 +15,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..core import loadctl
+from ..core.loadctl import RetryLater
 from ..core.server import Server, ServerConfig
 from ..state import StateStore
 from ..utils.backoff import Backoff, Retryer
@@ -115,6 +117,14 @@ class ReplicatedServer:
                              batch=batch)
         self.store = RaftStore(self.local_store, self.raft)
         self.server = Server(config, store=self.store)
+        # nomadload: proposes consult the server's admission plane, and
+        # the proposal queue is its primary commit-path watermark
+        self.raft.admission = self.server.loadctl
+        self.server.loadctl.register_queue(
+            "proposals", lambda: len(self.raft._proposals),
+            self.server.config.loadctl_proposal_soft,
+            self.server.config.loadctl_proposal_hard,
+            commit_path=True)
         self._peer_lookup = peer_lookup
         self.transport = transport
         self._lock = threading.Lock()
@@ -470,19 +480,32 @@ class ReplicatedServer:
         self.raft.wait_applied(index, timeout)
 
     # forwarded endpoints raise these; the HTTP layer maps them to status
-    # codes, so they must survive the socket hop as their concrete types
+    # codes, so they must survive the socket hop as their concrete types.
+    # RetryLater is nomadload's structured admission rejection (429 +
+    # Retry-After): it must arrive typed so the follower's _forward does
+    # NOT retry it — server-side retries of a shed request are exactly
+    # the amplification the admission plane exists to prevent.
     _WIRE_ERRORS = {"KeyError": KeyError, "ValueError": ValueError,
                     "PermissionError": PermissionError,
-                    "TimeoutError": TimeoutError, "RuntimeError": RuntimeError}
+                    "TimeoutError": TimeoutError, "RuntimeError": RuntimeError,
+                    "RetryLater": RetryLater}
 
     def _forward(self, name: str, args: tuple, kwargs: dict):
         """Run the endpoint on the leader: locally if this node leads,
         in-process via peer_lookup, or over the socket transport
         (reference nomad/rpc.go:445 forward)."""
+        # nomadload deadline propagation: the forward hop inherits the
+        # request deadline bound at ingress — already-expired work drops
+        # here, and the retry window never outlives the client
+        rem = loadctl.remaining()
+        if rem is not None and loadctl.drop_if_expired("forward"):
+            raise TimeoutError("request deadline passed before forward")
+        fwd_deadline = 5.0 if rem is None else max(0.05, min(5.0, rem))
         # jittered backoff instead of a fixed 20 ms poll: during an
         # election every forwarder on every node spins this loop, and
         # synchronized polls pile onto the freshly elected leader
-        for _ in Retryer(deadline_s=5.0, base=0.02, cap=0.25, jitter=0.5):
+        for _ in Retryer(deadline_s=fwd_deadline, base=0.02, cap=0.25,
+                         jitter=0.5):
             if self.is_leader():
                 return getattr(self.server, name)(*args, **kwargs)
             lid = self.raft.leader_id

@@ -70,16 +70,35 @@ class _Proposal:
     """A leader-side write waiting for commit + local apply. The event
     replaces the old 0.1 s polling wait; `command` doubles as an
     identity token so a result can never be delivered to a waiter whose
-    registration lost the append CAS (see _commit_batch)."""
+    registration lost the append CAS (see _commit_batch). `deadline`
+    (absolute, time.time() base) is stamped from the nomadload
+    request context at propose time: the log writer drops proposals
+    whose waiter has already given up instead of burning an fsync slot
+    on them (core/loadctl.py deadline propagation)."""
 
-    __slots__ = ("command", "index", "result", "error", "done")
+    __slots__ = ("command", "index", "result", "error", "done", "deadline")
 
-    def __init__(self, command: tuple):
+    def __init__(self, command: tuple, deadline: Optional[float] = None):
         self.command = command
         self.index: Optional[int] = None
         self.result: object = None
         self.error: Optional[BaseException] = None
         self.done = threading.Event()
+        self.deadline = deadline
+
+
+_loadctl = None
+
+
+def _lc():
+    """Lazy nomadload accessor: core imports raft, so raft reaches the
+    admission/deadline plane at call time only (the state/watch.py
+    lazy-registry pattern)."""
+    global _loadctl
+    if _loadctl is None:
+        from ..core import loadctl as _m
+        _loadctl = _m
+    return _loadctl
 
 
 class RaftNode:
@@ -219,6 +238,9 @@ class RaftNode:
         self._proposals: List[_Proposal] = []
         self._waiters: Dict[int, _Proposal] = {}
         self._autopilot: Optional[threading.Thread] = None
+        # nomadload: the owning server's AdmissionController (set by
+        # ReplicatedServer.attach); None = no admission at propose
+        self.admission = None
 
         transport.register(node_id, self.handle)
 
@@ -262,11 +284,17 @@ class RaftNode:
 
     def apply(self, command: tuple, timeout: float = 5.0):
         """Leader-only: replicate a command, wait for commit + local
-        apply, return the FSM result. Raises NotLeaderError otherwise."""
-        deadline = time.time() + timeout
+        apply, return the FSM result. Raises NotLeaderError otherwise.
+
+        nomadload: the effective deadline is min(timeout, the request
+        deadline bound at ingress); already-expired requests drop here
+        instead of burning an fsync, and the owning server's admission
+        controller is consulted at the propose enqueue (the proposal
+        queue IS the watermark it reads)."""
+        deadline = self._propose_checks(time.time() + timeout)
         if not self.batch:
             return self._apply_single(command, deadline)
-        prop = _Proposal(command)
+        prop = _Proposal(command, deadline=deadline)
         with self._lock:
             if self._stop.is_set():
                 raise TimeoutError("raft node stopped")
@@ -275,6 +303,22 @@ class RaftNode:
             self._proposals.append(prop)
             self._propose_cond.notify()
         return self._await_proposal(prop, deadline)
+
+    def _propose_checks(self, deadline: float) -> float:
+        """Deadline propagation + admission at the propose boundary:
+        returns the effective deadline; raises on expired work or a
+        tripped watermark (loadctl.RetryLater)."""
+        lc = _lc()
+        bound = lc.current_deadline()
+        if bound is not None:
+            deadline = min(deadline, bound)
+            if lc.drop_if_expired("raft_propose"):
+                raise TimeoutError(
+                    "request deadline passed before propose")
+        adm = self.admission
+        if adm is not None:
+            adm.admit(lc.current_tier(), source="raft")
+        return deadline
 
     def apply_async(self, command: tuple) -> _Proposal:
         """First half of apply (batch mode only): enqueue the command
@@ -285,7 +329,8 @@ class RaftNode:
         the plan applier's pipelined commit rounds depend on."""
         if not self.batch:
             raise RuntimeError("apply_async requires batch mode")
-        prop = _Proposal(command)
+        self._propose_checks(time.time() + 3600.0)
+        prop = _Proposal(command, deadline=_lc().current_deadline())
         with self._lock:
             if self._stop.is_set():
                 raise TimeoutError("raft node stopped")
@@ -377,9 +422,26 @@ class RaftNode:
             # Copying here — off the caller threads and outside the node
             # lock — is the point of the log-writer: serialization cost
             # never stalls RPC handlers or the tick thread.
+            # nomadload deadline propagation: a proposal whose waiter
+            # already gave up (deadline passed while queued) is dropped
+            # BEFORE it costs a serialize + fsync slot — capacity spent
+            # on replies nobody awaits is how overload collapses
+            now = time.time()
+            live = []
             for p in batch:
+                if (p.deadline is not None and now >= p.deadline
+                        and not p.done.is_set()):
+                    _lc().check_expired(p.deadline, "raft_logwriter", now)
+                    p.error = TimeoutError(
+                        "proposal deadline expired before append")
+                    p.done.set()
+                    continue
+                live.append(p)
+            if not live:
+                continue
+            for p in live:
                 p.command = copy.deepcopy(p.command)
-            self._commit_batch(batch)
+            self._commit_batch(live)
 
     def _commit_batch(self, batch: List[_Proposal]) -> None:
         """Land a drained batch: one buffered write + one fsync via

@@ -198,14 +198,30 @@ class SocketTransport:
     peer and treat any socket error as message loss (raft tolerates it).
     """
 
+    # nomadload ingress bounds: a flooding peer is answered RetryLater
+    # instead of queueing unbounded handler threads. Raft/snap frames
+    # (consensus liveness = tier 0) and tier-0 forwarded calls are
+    # never bounded.
+    DEFAULT_MAX_INFLIGHT_PER_PEER = 64
+    # pending-accept backlog (listen(2) queue) — beyond it the kernel
+    # refuses new connections instead of parking them invisibly
+    ACCEPT_BACKLOG = 128
+
     def __init__(self, node_id: str, bind_addr: str,
                  peer_addrs: Dict[str, str], timeout: float = 5.0,
                  connect_timeout: float = 0.3, retry_cooldown: float = 0.5,
-                 raft_timeout: float = 0.5):
+                 raft_timeout: float = 0.5,
+                 max_inflight_per_peer: Optional[int] = None):
         self.node_id = node_id
         self.bind_addr = bind_addr
         self.peer_addrs = dict(peer_addrs)
         self.timeout = timeout
+        self.max_inflight_per_peer = (
+            self.DEFAULT_MAX_INFLIGHT_PER_PEER
+            if max_inflight_per_peer is None else max_inflight_per_peer)
+        self._inflight: Dict[str, int] = {}   # peer host -> frames in dispatch
+        self._inflight_lock = threading.Lock()
+        self.dropped_frames = 0
         # Raft ticks send to every peer serially: connecting to a dead
         # peer must fail fast and then back off, or one crashed server
         # stalls heartbeats to the live ones and triggers elections. The
@@ -254,6 +270,7 @@ class SocketTransport:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                peer = self.client_address[0]
                 while True:
                     try:
                         frame = _recv_frame(self.request)
@@ -264,7 +281,7 @@ class SocketTransport:
                     if frame is None:
                         return
                     try:
-                        reply = transport._dispatch(frame)
+                        reply = transport._dispatch(frame, peer=peer)
                     except Exception as e:  # typed error back to caller
                         reply = {"ok": False, "error": str(e),
                                  "error_type": type(e).__name__,
@@ -280,6 +297,8 @@ class SocketTransport:
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
+            # bounded pending-accept backlog (nomadload ingress bounds)
+            request_queue_size = SocketTransport.ACCEPT_BACKLOG
 
         self._server = Server((host, port), Handler)
         t = threading.Thread(target=self._server.serve_forever, daemon=True,
@@ -299,11 +318,12 @@ class SocketTransport:
                     pass
             self._conns.clear()
 
-    def _dispatch(self, frame: dict) -> dict:
+    def _dispatch(self, frame: dict, peer: str = "") -> dict:
         from ..structs.wire import wire_decode, wire_encode
 
         kind = frame.get("t")
         if kind in ("raft", "snap"):
+            # consensus traffic is tier 0: never bounded, never shed
             if self._raft_handler is None:
                 return {"ok": False, "error": "no raft handler"}
             reply = self._raft_handler(wire_decode(frame["m"]))
@@ -311,12 +331,53 @@ class SocketTransport:
         if kind == "call":
             if self._call_handler is None:
                 return {"ok": False, "error": "no call handler"}
-            result = self._call_handler(
-                frame["method"],
-                tuple(wire_decode(frame.get("args", []))),
-                wire_decode(frame.get("kwargs", {})))
+            from ..core import loadctl
+
+            method = frame.get("method", "")
+            tier = loadctl.tier_for_method(method)
+            if tier > loadctl.TIER_LIVENESS \
+                    and not self._frame_slot(peer):
+                # per-peer inflight cap tripped: refuse the frame with
+                # a typed RetryLater the forwarding server decodes and
+                # passes through to its client as 429 — never applies
+                # to tier-0 (liveness) calls
+                self.dropped_frames += 1
+                from ..core.metrics import REGISTRY
+                REGISTRY.incr("nomad.transport.dropped_frames")
+                err = loadctl.RetryLater(
+                    tier, 0.25, reason="transport inflight cap")
+                return {"ok": False, "error": str(err),
+                        "error_type": "RetryLater", "leader_id": None}
+            with self._inflight_lock:
+                self._inflight[peer] = self._inflight.get(peer, 0) + 1
+            try:
+                # the forwarded request's absolute deadline rides the
+                # frame; expired work is dropped before dispatch
+                with loadctl.bind_deadline(frame.get("dl")), \
+                        loadctl.bind_tier(tier):
+                    if loadctl.drop_if_expired("transport_dispatch"):
+                        raise TimeoutError(
+                            "request deadline passed before dispatch")
+                    result = self._call_handler(
+                        method,
+                        tuple(wire_decode(frame.get("args", []))),
+                        wire_decode(frame.get("kwargs", {})))
+            finally:
+                with self._inflight_lock:
+                    left = self._inflight.get(peer, 1) - 1
+                    if left <= 0:
+                        self._inflight.pop(peer, None)
+                    else:
+                        self._inflight[peer] = left
             return {"ok": True, "result": wire_encode(result)}
         return {"ok": False, "error": f"unknown frame kind {kind!r}"}
+
+    def _frame_slot(self, peer: str) -> bool:
+        """True when the peer is under its inflight-frame cap."""
+        if self.max_inflight_per_peer <= 0:
+            return True
+        with self._inflight_lock:
+            return self._inflight.get(peer, 0) < self.max_inflight_per_peer
 
     # -- client side --
 
@@ -485,6 +546,12 @@ class SocketTransport:
         frame = {"t": "call", "method": method,
                  "args": wire_encode(list(args)),
                  "kwargs": wire_encode(kwargs or {})}
+        from ..core import loadctl
+
+        dl = loadctl.current_deadline()
+        if dl is not None:
+            frame["dl"] = dl  # absolute deadline rides the wire
+
         key = (to_id, "call")
         wire_frame = _encode_frame(frame)
         for attempt in (0, 1):

@@ -1098,6 +1098,78 @@ class StateStore:
             else:
                 a.job = self._jobs.get_latest((a.namespace, a.job_id))
 
+    def _supersede_slot_duplicates(self, new_allocs: List[Allocation],
+                                   gen: int, live: int, ts: float,
+                                   events: list) -> None:
+        """A fresh placement whose slot (namespace, job_id, name)
+        already holds a live alloc under a different id supersedes it:
+        the older alloc is server-stopped inside the same transaction.
+
+        Two plans CAN both commit for one slot across a failover — the
+        dying leader's round lands in the log unanswered, the eval is
+        re-run through the new leader before that suffix applies, and
+        the re-plan places a fresh alloc id for a slot the first plan
+        already filled. Serialized on one leader the applier would have
+        stopped one of them; this does the same thing deterministically
+        at apply time, on every replica. Canary placements are exempt
+        (a canary intentionally runs beside the stable alloc of the
+        same name), and so is an alloc in client state "unknown" (a
+        disconnect replacement runs beside the original on purpose;
+        the reconnect reconciliation picks the winner). Anything
+        already terminal is skipped too — reschedules and migrations
+        stop/fail their predecessor before or alongside the
+        replacement, so they never trip this."""
+        def slot(a: Allocation) -> tuple:
+            # system/sysbatch place one same-named alloc PER NODE; the
+            # slot identity there includes the node
+            jtype = a.job.type if a.job is not None else ""
+            node = (a.node_id if jtype in (enums.JOB_TYPE_SYSTEM,
+                                           enums.JOB_TYPE_SYSBATCH)
+                    else "")
+            return (a.namespace, a.job_id, a.name, node)
+
+        slots = {slot(a) for a in new_allocs if not a.canary}
+        if not slots:
+            return
+        fresh_ids = {a.id for a in new_allocs}
+        seen = set()
+        # sorted, derived from the payload list: replicas must walk
+        # jobs in one order (set iteration varies per process under
+        # hash randomization) so the stop events land identically on
+        # every FSM
+        for jkey in sorted({(a.namespace, a.job_id)
+                            for a in new_allocs if not a.canary}):
+            for entry in cons_iter(self._allocs_by_job.get_latest(jkey)):
+                if type(entry) is BlockRef:
+                    block = self._alloc_blocks.get_latest(entry.block_id)
+                    if block is None:
+                        continue
+                    cands = [a for m in block.live_rows()
+                             for a in block.allocs_for_row(m)]
+                else:
+                    cands = [self._latest_alloc(entry)]
+                for a in cands:
+                    if (a is None or a.id in fresh_ids or a.id in seen
+                            or a.canary):
+                        continue
+                    seen.add(a.id)
+                    # block rows may shadow a promoted real row
+                    cur = self._latest_alloc(a.id)
+                    if (cur is None or cur.terminal_status()
+                            or cur.client_status
+                            == enums.ALLOC_CLIENT_UNKNOWN
+                            or slot(cur) not in slots):
+                        continue
+                    stopped = cur.copy_for_update()
+                    stopped.desired_status = enums.ALLOC_DESIRED_STOP
+                    stopped.desired_description = (
+                        "alloc superseded by a newer placement for the "
+                        "same slot")
+                    self._reap_services_for_terminal(stopped, gen, live,
+                                                     events)
+                    self._put_alloc(stopped, gen, live, ts)
+                    events.append(("alloc-stop", stopped))
+
     def _apply_plan_payload(self, result_allocs, stopped_allocs,
                             preempted_allocs, deployment, deployment_updates,
                             evals, alloc_blocks, gen: int, live: int,
@@ -1130,6 +1202,8 @@ class StateStore:
             self._put_alloc(alloc, gen, live, ts, prev=prev)
             events.append(("alloc-upsert", alloc))
         if new_allocs:
+            self._supersede_slot_duplicates(new_allocs, gen, live, ts,
+                                            events)
             self._put_new_allocs_bulk(new_allocs, gen, live, ts, events)
         for block in alloc_blocks:
             self._put_alloc_block(block, gen, live, ts, events)

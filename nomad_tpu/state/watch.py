@@ -39,13 +39,14 @@ _REG = None
 
 
 class _Waiter:
-    __slots__ = ("event", "index", "wake_ts", "cancelled")
+    __slots__ = ("event", "index", "wake_ts", "cancelled", "claimed")
 
     def __init__(self):
         self.event = threading.Event()
         self.index = 0        # the committed index that woke us
         self.wake_ts = 0.0    # commit publish timestamp of that batch
         self.cancelled = False
+        self.claimed = False  # popped by a commit; wakeup imminent
 
 
 class WatchTable:
@@ -54,6 +55,12 @@ class WatchTable:
     or follower — wakes its own watchers as replication applies commits
     locally (the substrate for follower blocking queries)."""
 
+    # degraded-mode wakeup coalescing window (nomadload): while the
+    # admission controller holds the server in brownout, successive
+    # commits flush one merged wakeup batch per window instead of one
+    # per commit — watch fan-out is the first read-side cost to shed
+    COALESCE_WINDOW = 0.05
+
     def __init__(self, store):
         self._store = store
         self._lock = threading.Lock()
@@ -61,6 +68,11 @@ class WatchTable:
         self._tie = 0       # FIFO within one index threshold
         self._parked = 0    # live (non-cancelled) waiters
         self._gauge_ts = 0.0
+        # loadctl.AdmissionController or None (set by the owning server)
+        self.admission = None
+        self._coalesce_batch: List[_Waiter] = []
+        self._coalesce_idx = 0
+        self._coalesce_timer: Optional[threading.Timer] = None
         store.add_commit_listener(self._on_commit)
 
     def _publish_gauge(self, now: Optional[float] = None) -> None:
@@ -78,6 +90,20 @@ class WatchTable:
         with self._lock:
             return self._parked
 
+    def teardown(self) -> None:
+        """Owning server's stop: cancel the coalescing timer and flush
+        any batch it was holding — a waiter claimed by a commit must
+        still wake, even through shutdown. (Named `teardown`, not
+        `close`: the fsm-determinism call graph is name-keyed, and
+        FSM-reachable code closes snapshots — a `close` here would
+        drag the wake path into the determinism scope.)"""
+        with self._lock:
+            timer = self._coalesce_timer
+            self._coalesce_timer = None
+        if timer is not None:
+            timer.cancel()
+        self._flush_coalesced()
+
     def wait_min_index(self, index: int, timeout: Optional[float] = None
                        ) -> Tuple[int, Optional[float]]:
         """Park until the store publishes ``latest_index >= index`` or
@@ -88,6 +114,15 @@ class WatchTable:
         latest = self._store.latest_index
         if latest >= index:
             return latest, None
+        adm = self.admission
+        if adm is not None:
+            # nomadload: parking a watcher pins a thread + heap entry;
+            # under pressure the read tier is the first one shed.
+            # Raises RetryLater (HTTP answers 429 + Retry-After).
+            from ..core import loadctl
+
+            adm.admit(loadctl.current_tier(default=loadctl.TIER_READ),
+                      source="watch")
         w = _Waiter()
         with self._lock:
             # re-check under the table lock: _on_commit takes it too,
@@ -102,15 +137,17 @@ class WatchTable:
             self._publish_gauge()
         if not w.event.wait(timeout):
             with self._lock:
-                if not w.event.is_set():
+                if not w.event.is_set() and not w.claimed:
                     # deadline won the race: cancel in place (lazy
                     # removal — a later commit pop discards the entry)
                     w.cancelled = True
                     self._parked -= 1
                     self._publish_gauge()
                     return self._store.latest_index, None
-            # the commit won the race under the lock: fall through as a
-            # normal wakeup — the parked query is never lost
+            # a commit claimed this waiter under the lock: its wakeup —
+            # possibly held in the degraded-mode coalescing window — is
+            # imminent, and the parked query is never lost
+            w.event.wait(2 * self.COALESCE_WINDOW + 1.0)
         return w.index, w.wake_ts
 
     def _on_commit(self, index: int, events: list) -> None:
@@ -118,6 +155,8 @@ class WatchTable:
         the store's commit path (under raft, the apply thread): heap
         pops and Event.set only — never blocks, never re-enters the
         store."""
+        adm = self.admission
+        degraded = adm is not None and adm.degraded()
         batch: List[_Waiter] = []
         with self._lock:
             heap = self._heap
@@ -125,12 +164,39 @@ class WatchTable:
                 _, _, w = heapq.heappop(heap)
                 if w.cancelled:
                     continue
+                w.claimed = True
                 batch.append(w)
             if batch:
                 self._parked -= len(batch)
                 self._publish_gauge()
+            if degraded and batch:
+                # brownout: hold this batch in the coalescing window so
+                # a commit storm flushes one merged wakeup per window
+                self._coalesce_batch.extend(batch)
+                self._coalesce_idx = max(self._coalesce_idx, index)
+                if self._coalesce_timer is None:
+                    t = threading.Timer(self.COALESCE_WINDOW,
+                                        self._flush_coalesced)
+                    t.daemon = True
+                    self._coalesce_timer = t
+                    t.start()
+                return
         if not batch:
             return
+        self._wake(batch, index)
+
+    def _flush_coalesced(self) -> None:
+        with self._lock:
+            batch = self._coalesce_batch
+            index = self._coalesce_idx
+            self._coalesce_batch = []
+            self._coalesce_idx = 0
+            self._coalesce_timer = None
+        if batch:
+            self._wake(batch, index)
+            _registry().incr("nomad.load.coalesced_wakeups", len(batch))
+
+    def _wake(self, batch: List[_Waiter], index: int) -> None:
         now = time.time()
         for w in batch:
             w.index = index

@@ -24,6 +24,15 @@ Two pieces:
   sleep would cross the deadline (so a 5 s Retryer never sleeps past
   t+5 s) or when `stop` is set. `Retryer.call(fn)` wraps the common
   case and re-raises the last error on exhaustion.
+
+- RetryBudget: the SRE retry-budget (nomadload, ROBUSTNESS.md
+  "Overload envelope"): each first-try request deposits `ratio`
+  tokens, each retry withdraws one, so retries stay <= ~ratio of
+  request volume no matter how many callers share the budget. When an
+  overloaded server starts answering RetryLater/429, an exhausted
+  budget makes clients fail fast instead of amplifying the rejection
+  storm with synchronized retry waves. A Retryer given `budget=`
+  checks it before every retry (never before the first attempt).
 """
 
 from __future__ import annotations
@@ -74,6 +83,58 @@ class Backoff:
         self._attempt = 0
 
 
+class RetryBudget:
+    """Shared retry budget (retries <= ~``ratio`` of requests, SRE
+    style). Thread-safe: one instance is shared by every request a
+    client token issues.
+
+    Token bucket over *request volume* rather than time: record_request
+    deposits ``ratio`` tokens (plus a ``min_rate``/s trickle so an idle
+    client can always retry occasionally), spend_retry withdraws 1.0.
+    The balance is capped so a long quiet period cannot bank an
+    unbounded retry burst."""
+
+    def __init__(self, ratio: float = 0.1, min_rate: float = 1.0,
+                 cap: float = 50.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.ratio = ratio
+        self.min_rate = min_rate
+        self.cap = cap
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tokens = cap
+        self._stamp = clock()
+        self.stats = {"requests": 0, "retries": 0, "denied": 0}
+
+    def _refill_locked(self, now: float) -> None:
+        self._tokens = min(self.cap, self._tokens
+                           + (now - self._stamp) * self.min_rate)
+        self._stamp = now
+
+    def record_request(self) -> None:
+        """Count one first-try request (deposits ``ratio`` tokens)."""
+        with self._lock:
+            self._refill_locked(self._clock())
+            self._tokens = min(self.cap, self._tokens + self.ratio)
+            self.stats["requests"] += 1
+
+    def spend_retry(self) -> bool:
+        """True (and spends a token) when a retry is inside budget."""
+        with self._lock:
+            self._refill_locked(self._clock())
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                self.stats["retries"] += 1
+                return True
+            self.stats["denied"] += 1
+            return False
+
+    def balance(self) -> float:
+        with self._lock:
+            self._refill_locked(self._clock())
+            return self._tokens
+
+
 class Retryer:
     """Deadline-bounded attempt iterator (see module docstring)."""
 
@@ -82,17 +143,21 @@ class Retryer:
                  stop: Optional[threading.Event] = None,
                  sleep: Callable[[float], None] = time.sleep,
                  clock: Callable[[], float] = time.monotonic,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 budget: Optional[RetryBudget] = None):
         self.deadline_s = deadline_s
         self._backoff = Backoff(base=base, factor=factor, cap=cap,
                                 jitter=jitter, rng=rng)
         self._stop = stop
         self._sleep = sleep
         self._clock = clock
+        self._budget = budget
 
     def __iter__(self) -> Iterator[int]:
         start = self._clock()
         attempt = 0
+        if self._budget is not None:
+            self._budget.record_request()
         while True:
             if self._stop is not None and self._stop.is_set():
                 return
@@ -104,6 +169,10 @@ class Retryer:
                 if remaining <= 0:
                     return
                 delay = min(delay, remaining)
+            if self._budget is not None and not self._budget.spend_retry():
+                # budget exhausted: fail fast — under a rejection storm
+                # every client retrying on schedule IS the storm
+                return
             if self._stop is not None:
                 # an Event wait doubles as an interruptible sleep
                 if self._stop.wait(delay):

@@ -39,6 +39,12 @@
 #                                   shadow replicas armed on every
 #                                   server across a leader crash; zero
 #                                   shadow divergences)
+#   scripts/check.sh --load-smoke   also run the nomadload overload
+#                                   smoke (3-node cluster under a 10x
+#                                   open-loop submit burst with a
+#                                   leader crash mid-burst; no tier-0
+#                                   shed, zero acked-job loss, tier
+#                                   ordering on every replica)
 set -u
 cd "$(dirname "$0")/.."
 
@@ -50,6 +56,7 @@ run_swarm_smoke=0
 run_watch_smoke=0
 run_mesh_smoke=0
 run_flow_smoke=0
+run_load_smoke=0
 for arg in "$@"; do
     case "$arg" in
         --e2e-smoke) run_e2e_smoke=1 ;;
@@ -60,6 +67,7 @@ for arg in "$@"; do
         --watch-smoke) run_watch_smoke=1 ;;
         --mesh-smoke) run_mesh_smoke=1 ;;
         --flow-smoke) run_flow_smoke=1 ;;
+        --load-smoke) run_load_smoke=1 ;;
         *) echo "unknown flag: $arg" >&2; exit 64 ;;
     esac
 done
@@ -109,7 +117,8 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" NOMAD_TPU_SAN=1 python -m pytest \
     tests/test_tensor_rules.py tests/test_flow_rules.py \
     tests/test_state_store.py \
     tests/test_plan_apply_scale.py tests/test_e2e_pipeline.py \
-    tests/test_batch_solver.py tests/test_preempt_solve.py -q \
+    tests/test_batch_solver.py tests/test_preempt_solve.py \
+    tests/test_loadctl.py tests/test_backoff.py -q \
     -p no:cacheprovider || failed=1
 
 # nomadcheck smoke (~2s, 60s budget): the deterministic interleaving
@@ -235,6 +244,19 @@ if [ "$run_flow_smoke" = 1 ]; then
     echo "== flow smoke (python -m nomad_tpu.chaos --flow-smoke) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 300 \
         python -m nomad_tpu.chaos --flow-smoke || failed=1
+fi
+
+# nomadload overload smoke (opt-in, ~30s): a durable 3-node cluster
+# under a ~10x open-loop job-submit burst with a leader crash
+# mid-burst — no heartbeat is ever shed (tier-0 SLO), heartbeat p99
+# stays bounded through the burst, the admission plane both engages
+# (sheds > 0) and keeps admitting (ok > 0), zero acked jobs are lost
+# across the failover, and invariant 10 (overload tier ordering) holds
+# on every replica (ROBUSTNESS.md "Overload envelope")
+if [ "$run_load_smoke" = 1 ]; then
+    echo "== load smoke (python -m nomad_tpu.chaos --load-smoke) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 300 \
+        python -m nomad_tpu.chaos --load-smoke || failed=1
 fi
 
 echo "== tier-1 tests =="

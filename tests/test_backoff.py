@@ -7,7 +7,7 @@ import threading
 
 import pytest
 
-from nomad_tpu.utils.backoff import Backoff, Retryer
+from nomad_tpu.utils.backoff import Backoff, Retryer, RetryBudget
 
 
 class TestBackoff:
@@ -107,3 +107,105 @@ class TestRetryer:
 
         with pytest.raises(ValueError):
             r.call(broken, retry_on=(ConnectionError,))
+
+
+class TestRetryBudget:
+    """nomadload: retries <= ~ratio of request volume, shared across
+    every caller of one client token."""
+
+    def _budget(self, **kw):
+        t = [0.0]
+        kw.setdefault("clock", lambda: t[0])
+        return RetryBudget(**kw), t
+
+    def test_requests_fund_retries_at_ratio(self):
+        # drain the starting balance, then check the steady state
+        # (ratio 0.25 is float-exact: 4 requests bank exactly 1 retry)
+        b, _ = self._budget(ratio=0.25, min_rate=0.0, cap=50.0)
+        while b.spend_retry():
+            pass
+        for _ in range(8):
+            b.record_request()
+        assert b.balance() == pytest.approx(2.0)
+        assert b.spend_retry()
+        assert b.spend_retry()
+        assert not b.spend_retry()  # 1 retry per 4 requests, all spent
+        assert b.stats["denied"] >= 1
+
+    def test_min_rate_trickle_refills_idle_budget(self):
+        b, t = self._budget(ratio=0.1, min_rate=1.0, cap=50.0)
+        while b.spend_retry():
+            pass
+        assert not b.spend_retry()
+        t[0] += 2.0  # idle: the trickle banks 2 tokens
+        assert b.spend_retry()
+        assert b.spend_retry()
+        assert not b.spend_retry()
+
+    def test_balance_capped(self):
+        b, t = self._budget(ratio=0.1, min_rate=1.0, cap=5.0)
+        t[0] += 10 ** 6
+        for _ in range(10 ** 3):
+            b.record_request()
+        assert b.balance() == pytest.approx(5.0)
+
+    def test_stats_track_all_outcomes(self):
+        b, _ = self._budget(min_rate=0.0, cap=1.0)
+        b.record_request()
+        assert b.spend_retry()
+        assert not b.spend_retry()
+        assert b.stats == {"requests": 1, "retries": 1, "denied": 1}
+
+
+class TestRetryerBudget:
+    def _virtual(self, deadline_s, budget, **kw):
+        t = [0.0]
+
+        def sleep(d):
+            t[0] += d
+
+        return Retryer(deadline_s=deadline_s, sleep=sleep,
+                       clock=lambda: t[0], jitter=0, budget=budget,
+                       **kw), t
+
+    def test_exhausted_budget_fails_fast(self):
+        # budget with exactly 2 retries banked and no refill: the loop
+        # stops after 3 attempts no matter how much deadline remains
+        b = RetryBudget(ratio=0.0, min_rate=0.0, cap=2.0,
+                        clock=lambda: 0.0)
+        r, t = self._virtual(10 ** 6, b, base=0.01)
+        assert list(r) == [0, 1, 2]
+        assert b.stats == {"requests": 1, "retries": 2, "denied": 1}
+        assert t[0] < 1.0  # failed fast, no deadline-length stall
+
+    def test_first_attempt_never_needs_budget(self):
+        b = RetryBudget(ratio=0.0, min_rate=0.0, cap=0.0,
+                        clock=lambda: 0.0)
+        r, _ = self._virtual(10 ** 6, b)
+        assert list(r) == [0]
+
+    def test_deadline_short_circuits_before_budget_spend(self):
+        # deadline expires first: no retry token is burned on a sleep
+        # that can never lead to another attempt
+        b = RetryBudget(ratio=0.0, min_rate=0.0, cap=10.0,
+                        clock=lambda: 0.0)
+        r, _ = self._virtual(0.0, b)
+        assert list(r) == [0]
+        assert b.stats["retries"] == 0
+
+    def test_trickle_refill_resumes_retries(self):
+        t = [0.0]
+        b = RetryBudget(ratio=0.0, min_rate=1.0, cap=1.0,
+                        clock=lambda: t[0])
+
+        def sleep(d):
+            t[0] += d
+
+        r = Retryer(deadline_s=30.0, base=2.0, factor=1.0, cap=2.0,
+                    jitter=0, sleep=sleep, clock=lambda: t[0], budget=b)
+        # each 2 s backoff sleep banks 2 token-seconds (capped at 1):
+        # the trickle alone sustains the loop to the deadline
+        attempts = list(r)
+        assert len(attempts) > 5
+        assert b.stats["denied"] == 0
+        assert t[0] <= 30.0 + 1e-9

@@ -271,3 +271,76 @@ class TestVersionedTableRowLayouts:
         cell = cons(("a", "b", "c"), cons("z", None))
         assert list(cons_iter(cell)) == ["a", "b", "c", "z"]
         assert cell.length == 4
+
+
+class TestSlotSupersede:
+    """upsert_plan_results: a fresh placement for an occupied slot
+    server-stops the older live alloc (two plans for one slot can both
+    commit across a failover); legitimate same-name coexistence —
+    canaries, disconnect replacements, system jobs per node — is
+    exempt."""
+
+    def _seed(self, store):
+        j = mock.job()
+        j.task_groups[0].count = 1
+        store.upsert_job(j)
+        n = mock.node()
+        store.upsert_node(n)
+        return j, n
+
+    def _live(self, store):
+        return [a for a in store.snapshot().allocs()
+                if not a.terminal_status()]
+
+    def test_duplicate_placement_supersedes_older(self, store):
+        j, n = self._seed(store)
+        a1 = mock.alloc(j, n)
+        store.upsert_plan_results([a1])
+        a2 = mock.alloc(j, n)  # same name: job.web[0]
+        store.upsert_plan_results([a2])
+        live = self._live(store)
+        assert [a.id for a in live] == [a2.id]
+        old = store.snapshot().alloc_by_id(a1.id)
+        assert old.server_terminal()
+        assert "superseded" in old.desired_description
+
+    def test_reupsert_same_id_is_noop(self, store):
+        j, n = self._seed(store)
+        a = mock.alloc(j, n)
+        store.upsert_plan_results([a])
+        store.upsert_plan_results([a])  # idempotent fallback replay
+        assert [x.id for x in self._live(store)] == [a.id]
+
+    def test_canary_runs_beside_stable(self, store):
+        j, n = self._seed(store)
+        a1 = mock.alloc(j, n)
+        store.upsert_plan_results([a1])
+        canary = mock.alloc(j, n)
+        canary.canary = True
+        store.upsert_plan_results([canary])
+        assert {x.id for x in self._live(store)} == {a1.id, canary.id}
+
+    def test_unknown_original_not_stopped_by_replacement(self, store):
+        j, n = self._seed(store)
+        a1 = mock.alloc(j, n)
+        a1.client_status = enums.ALLOC_CLIENT_UNKNOWN
+        store.upsert_plan_results([a1])
+        repl = mock.alloc(j, n)
+        store.upsert_plan_results([repl])
+        assert {x.id for x in self._live(store)} == {a1.id, repl.id}
+
+    def test_system_job_one_alloc_per_node_coexists(self, store):
+        j = mock.system_job()
+        store.upsert_job(j)
+        n1, n2 = mock.node(), mock.node()
+        store.upsert_node(n1)
+        store.upsert_node(n2)
+        a1 = mock.alloc(j, n1)
+        store.upsert_plan_results([a1])
+        a2 = mock.alloc(j, n2)  # same name, different node
+        store.upsert_plan_results([a2])
+        assert {x.id for x in self._live(store)} == {a1.id, a2.id}
+        # but a true duplicate ON one node still supersedes
+        a3 = mock.alloc(j, n1)
+        store.upsert_plan_results([a3])
+        assert {x.id for x in self._live(store)} == {a2.id, a3.id}
