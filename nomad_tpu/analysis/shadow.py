@@ -15,8 +15,8 @@ server wires this automatically at broker construction — which
   overrides them;
 - treats ring truncation and the ``restore`` sentinel as a RESYNC, not
   a violation: the replica rebuilds from a fresh snapshot, which is the
-  contract every delta consumer (AllocSyncHub today, the device-resident
-  incremental state next) must honor;
+  contract every delta consumer (AllocSyncHub, the device-resident
+  incremental state in ``tensor/incremental.py``) must honor;
 - every K commits — and on demand from the chaos invariant sweep
   (``check_event_completeness``, invariant 8) — fingerprint-compares the
   replicas against a fresh MVCC snapshot rebuild, per-node usage columns
@@ -26,13 +26,18 @@ server wires this automatically at broker construction — which
   divergence — a missed delta, a reordered overwrite, a narrowed
   payload — is a violation.
 
+The delta-folding semantics themselves (kind dispatch, block expansion,
+promotion override, GC pops) live in ``state/deltas.py`` — one
+implementation shared with the incremental device state, so the
+sanitizer proves the exact replay rules the scheduler runs on.
+
 The replay runs inline on the commit listener (serialized under the
 store's write lock, after the broker's own listener has appended the
 events), so the drained subscription is always exactly caught up with
 the commit being compared — the gauge ``nomad.events.delta_lag`` (commit
 index minus shadow-applied index) therefore reads 0 until consumption
 moves off the commit path, which is precisely the number the
-incremental-state PR will watch grow.
+incremental-state feed watches grow.
 
 Violations never raise at the commit site (that would poison the store's
 write path mid-transaction); they accumulate on the tracker and the
@@ -44,9 +49,13 @@ from __future__ import annotations
 
 import _thread
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Set
 
-import numpy as np
+from ..state.deltas import (
+    ALLOC_ROW_KINDS, CLIENT_TERMINAL, NODE_KINDS, REPLAY_TOPICS,
+    EntryReplica, alloc_entry as _alloc_entry, client_terminal,
+    eval_entry as _eval_entry, node_entry as _node_entry, usage_columns,
+)
 
 _REAL_LOCK = _thread.allocate_lock
 
@@ -56,53 +65,11 @@ COMPARE_EVERY = 64
 # bounded diff rendering: enough ids to diagnose, never enough to flood
 MAX_DIFF_IDS = 8
 
-NODE_KINDS = ("node-upsert", "node-status", "node-eligibility",
-              "node-drain")
-ALLOC_ROW_KINDS = ("alloc-upsert", "alloc-stop", "alloc-preempt",
-                   "alloc-client-update", "alloc-transition")
-CLIENT_TERMINAL = ("complete", "failed", "lost")
-
-SHADOW_TOPICS = {"Allocation": ["*"], "Node": ["*"], "Evaluation": ["*"]}
+SHADOW_TOPICS = REPLAY_TOPICS
 
 
 def _client_terminal(status: str) -> bool:
-    return status in CLIENT_TERMINAL
-
-
-def _alloc_entry(a) -> tuple:
-    vec = a.allocated_vec
-    return (a.modify_index, a.client_status, a.desired_status, a.node_id,
-            None if vec is None else vec.tobytes())
-
-
-def _node_entry(n) -> tuple:
-    return (n.modify_index, n.status, n.scheduling_eligibility)
-
-
-def _eval_entry(e) -> tuple:
-    return (e.modify_index, e.status)
-
-
-def usage_columns(allocs: Dict[str, tuple]) -> Dict[str, bytes]:
-    """Per-node usage columns from reduced alloc entries via ONE
-    vectorized scatter-add (the persist._block_usage_into idiom). Rows
-    are stacked in sorted (node, alloc-id) order, so two entry maps
-    with equal contents produce bit-identical float sums — the compare
-    can demand exact equality, no tolerance."""
-    live = [(e[3], aid, e[4]) for aid, e in allocs.items()
-            if not _client_terminal(e[1]) and e[4] is not None]
-    if not live:
-        return {}
-    live.sort(key=lambda t: (t[0], t[1]))
-    node_ids = sorted({nid for nid, _, _ in live})
-    idx = {n: i for i, n in enumerate(node_ids)}
-    rows = np.fromiter((idx[nid] for nid, _, _ in live), np.int64,
-                       count=len(live))
-    vecs = np.stack([np.frombuffer(b, dtype=np.float64)
-                     for _, _, b in live])
-    mat = np.zeros((len(node_ids), vecs.shape[1]), vecs.dtype)
-    np.add.at(mat, rows, vecs)
-    return {n: mat[i].tobytes() for n, i in idx.items()}
+    return client_terminal(status)
 
 
 @dataclass
@@ -135,20 +102,19 @@ def _diff_maps(label: str, shadow: dict, truth: dict) -> List[str]:
     return out
 
 
-class ShadowReplica:
+class ShadowReplica(EntryReplica):
     """Event-derived reduction of one store, compared against MVCC
-    snapshot rebuilds every `every` commits."""
+    snapshot rebuilds every `every` commits. The replay rules are
+    :class:`state.deltas.EntryReplica`'s — shared verbatim with the
+    incremental device state."""
 
     def __init__(self, store, broker, tracker: "ShadowTracker",
                  every: int = COMPARE_EVERY):
+        EntryReplica.__init__(self)
         self.store = store
         self.tracker = tracker
         self.every = max(1, every)
         self.sub = broker.subscribe(dict(SHADOW_TOPICS))
-        self.allocs: Dict[str, tuple] = {}
-        self.nodes: Dict[str, tuple] = {}
-        self.evals: Dict[str, tuple] = {}
-        self._promoted: Set[str] = set()
         self.applied_index = 0
         self.commits = 0
         self.compares = 0
@@ -159,6 +125,10 @@ class ShadowReplica:
         self._lock = _REAL_LOCK()
         self._resync_locked()   # adopt whatever state predates the attach
         store.add_commit_listener(self._on_commit)
+
+    @property
+    def _promoted(self) -> Set[str]:
+        return self.promoted
 
     # -- commit listener ----------------------------------------------
 
@@ -186,59 +156,11 @@ class ShadowReplica:
     # -- delta replay --------------------------------------------------
 
     def _apply(self, e) -> None:
-        kind = e.type
-        p = e.payload
-        if kind in ALLOC_ROW_KINDS:
-            self.allocs[p.id] = _alloc_entry(p)
-            if "." in p.id:
-                # a materialized block position got its own row: the row
-                # now overrides the block wherever both are visible
-                self._promoted.add(p.id)
-        elif kind == "alloc-block-upsert":
-            self._apply_block(p)
-        elif kind == "alloc-gc":
-            for aid in p:
-                self.allocs.pop(aid, None)
-                self._promoted.discard(aid)
-        elif kind in NODE_KINDS:
-            self.nodes[p.id] = _node_entry(p)
-        elif kind == "node-delete":
-            self.nodes.pop(p.id, None)
-        elif kind == "eval-upsert":
-            self.evals[p.id] = _eval_entry(p)
-        elif kind == "eval-delete":
-            for eid in p:
-                self.evals.pop(eid, None)
-        # other kinds (Job/Deployment topics, direct scheduler signals)
-        # are not part of the reduced replica
-
-    def _apply_block(self, block) -> None:
-        from ..structs.alloc import BLOCK_SEP
-        prefix = f"{block.id}{BLOCK_SEP}"
-        live: Set[str] = set()
-        for a in block.iter_allocs():
-            live.add(a.id)
-            if a.id not in self._promoted:
-                self.allocs[a.id] = _alloc_entry(a)
-        # a re-upserted block can only shrink its visible set (rejected
-        # rows / dropped positions); forget what fell out
-        for aid in [k for k in self.allocs
-                    if k.startswith(prefix) and k not in live
-                    and k not in self._promoted]:
-            del self.allocs[aid]
+        # kept as a named seam: tests monkeypatch this to drop kinds
+        EntryReplica.apply(self, e)
 
     def _resync_locked(self) -> None:
-        snap = self.store.snapshot()
-        try:
-            self.allocs = {a.id: _alloc_entry(a) for a in snap.allocs()}
-            self.nodes = {n.id: _node_entry(n) for n in snap.nodes()}
-            self.evals = {e.id: _eval_entry(e) for e in snap.evals()}
-            self._promoted = {aid for aid in self.allocs
-                              if "." in aid
-                              and self.store._allocs.get(
-                                  aid, snap.index) is not None}
-        finally:
-            snap.close()
+        self.resync_from(self.store)
         self.resyncs += 1
 
     # -- differential compare -----------------------------------------
@@ -258,7 +180,7 @@ class ShadowReplica:
                  + _diff_maps("evals", self.evals, truth_evals))
         if not diffs:
             # alloc sets match — now the columnar reduction must too,
-            # through the same scatter the tensor state will use
+            # through the same scatter the tensor state uses
             su, tu = usage_columns(self.allocs), usage_columns(truth_allocs)
             if su != tu:
                 bad = sorted(k for k in su.keys() | tu.keys()

@@ -758,6 +758,174 @@ def flow_smoke(jobs_n: int = 120, nodes_n: int = 40,
     return 0
 
 
+def state_smoke(jobs_n: int = 120, nodes_n: int = 40,
+                workers: int = 4) -> int:
+    """Incremental-state smoke (scripts/check.sh --state-smoke): the
+    e2e pipeline on a durable 3-node cluster with the nomadstate parity
+    digests force-armed, so every tensor build the leader's workers run
+    rides the device-resident O(Δ) base (tensor/incremental.py) and is
+    periodically fingerprint-compared against gen-bounded snapshot
+    rebuilds. One leader crash/restart mid-stream, then a forced
+    event-ring truncation on the live leader followed by another
+    scheduling round (the feed must take the resync path, never patch
+    across the gap). Asserts: zero parity divergences on ANY feed —
+    followers included (their epochs build from snapshot at verify
+    time) — warm builds actually served off the fed base, and the
+    truncation actually forced a resync."""
+    import shutil
+
+    from ..core.server import ServerConfig
+    from ..raft.cluster import RaftCluster
+    from ..structs import enums
+    from ..structs.operator import SchedulerConfiguration
+    from ..tensor import incremental
+    from .invariants import InvariantChecker
+
+    t0 = time.monotonic()
+
+    def config_fn(_i: int) -> ServerConfig:
+        return ServerConfig(
+            num_workers=workers, plan_commit_batching=True,
+            eval_batch_size=8,
+            # the tensor path is the whole point: every build must route
+            # through ClusterTensors (and so the incremental feed)
+            sched_config=SchedulerConfiguration(
+                scheduler_algorithm=enums.SCHED_ALG_TPU_BINPACK),
+            heartbeat_ttl=3600.0, gc_interval=3600.0, nack_timeout=900.0,
+            failed_eval_followup_delay=3600.0,
+            failed_eval_unblock_interval=0.5)
+
+    def submit_round(node, n: int) -> None:
+        jobs = []
+        for _ in range(n):
+            j = mock.job()
+            j.task_groups[0].count = 1
+            j.task_groups[0].tasks[0].resources.cpu = 100
+            j.task_groups[0].tasks[0].resources.memory_mb = 64
+            jobs.append(j)
+            node.store.upsert_job(j)
+        evals = [mock.eval_for(j, create_time=time.time()) for j in jobs]
+        node.store.upsert_evals(evals)
+        for ev in evals:
+            node.server.broker.enqueue(ev)
+
+    def wait_placed(cluster, fallback, want: int, timeout: float):
+        deadline = time.time() + timeout
+        fresh = fallback
+        while True:
+            fresh = cluster.leader() or fresh
+            if fresh.server._running \
+                    and fresh.server.wait_for_idle(
+                        timeout=10.0, include_delayed=False) \
+                    and fresh.server.blocked.blocked_count() == 0:
+                snap = fresh.local_store.snapshot()
+                placed = [a for a in snap.allocs()
+                          if not a.terminal_status()
+                          and not a.server_terminal()]
+                if len(placed) >= want:
+                    return fresh
+            if time.time() > deadline:
+                return None
+            time.sleep(0.1)
+
+    tmp = tempfile.mkdtemp(prefix="nomad-state-smoke-")
+    checker = InvariantChecker()
+    was_armed = incremental.GLOBAL.san_active
+    incremental.install()   # arm the parity digests BEFORE any server
+    try:
+        cluster = RaftCluster(3, config_fn=config_fn, data_dir=tmp)
+        cluster.start()
+        try:
+            leader = cluster.wait_for_leader(timeout=15.0)
+            if leader is None:
+                print("STATE SMOKE: FAIL — no leader elected")
+                return 2
+            for _ in range(nodes_n):
+                leader.register_node(mock.node())
+            submit_round(leader, jobs_n)
+
+            # crash once genuinely mid-batch, same shape as flow_smoke
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                snap = leader.local_store.snapshot()
+                if len([a.id for a in snap.allocs()]) >= jobs_n // 4:
+                    break
+                time.sleep(0.002)
+            else:
+                print("STATE SMOKE: FAIL — pipeline never reached the "
+                      "crash window")
+                return 2
+            cluster.crash(leader.id)
+            fresh = cluster.wait_for_leader(timeout=20.0)
+            if fresh is None:
+                print("STATE SMOKE: FAIL — no leader after the crash")
+                return 2
+            cluster.restart(leader.id)
+
+            fresh = wait_placed(cluster, fresh, jobs_n, timeout=180.0)
+            if fresh is None:
+                print("STATE SMOKE: FAIL — pipeline did not drain "
+                      "after the failover")
+                return 2
+
+            # force the gap contract: lap every subscription on the
+            # live leader's broker, then schedule another round — the
+            # feed must resync from snapshot, never patch across it
+            resyncs_before = incremental.GLOBAL.stats()["resyncs"]
+            fresh.server.events._truncate_all()
+            submit_round(fresh, jobs_n // 4)
+            fresh = wait_placed(cluster, fresh, jobs_n + jobs_n // 4,
+                                timeout=120.0)
+            if fresh is None:
+                print("STATE SMOKE: FAIL — pipeline did not drain "
+                      "after the forced truncation")
+                return 2
+
+            checker.check_convergence(cluster, timeout=30.0)
+            checker.check_all(cluster)   # includes state parity (11)
+
+            problems = incremental.GLOBAL.verify_all()
+            stats = incremental.GLOBAL.stats()
+            if problems:
+                print(f"STATE SMOKE: FAIL — {len(problems)} parity "
+                      f"divergence(s): {problems[0]}")
+                return 2
+            if stats["feeds"] < 4:      # 3 initial + the restart
+                print(f"STATE SMOKE: FAIL — only {stats['feeds']} "
+                      f"feeds attached; the server hook is not arming")
+                return 2
+            if stats["fast_hits"] == 0 or stats["deltas_applied"] == 0:
+                print(f"STATE SMOKE: FAIL — no build ever rode the "
+                      f"incremental base (fast_hits="
+                      f"{stats['fast_hits']}, deltas_applied="
+                      f"{stats['deltas_applied']}); the O(Δ) path is "
+                      f"not engaging")
+                return 2
+            if stats["resyncs"] <= resyncs_before:
+                print("STATE SMOKE: FAIL — the forced ring truncation "
+                      "never drove a feed resync")
+                return 2
+            if stats["parity_checks"] == 0:
+                print("STATE SMOKE: FAIL — no parity digest ever ran")
+                return 2
+        finally:
+            cluster.stop()
+    finally:
+        if not was_armed:
+            incremental.uninstall()
+        incremental.GLOBAL.feeds.clear()
+        shutil.rmtree(tmp, ignore_errors=True)
+    dt = time.monotonic() - t0
+    print(f"STATE SMOKE: ok — {jobs_n + jobs_n // 4} evals across a "
+          f"leader restart + forced truncation, {stats['feeds']} feeds, "
+          f"{stats['builds']} builds ({stats['fast_hits']} off the fed "
+          f"base), {stats['deltas_applied']} deltas applied, "
+          f"{stats['resyncs']} resyncs, {stats['parity_checks']} parity "
+          f"digests, 0 divergences, {checker.stats['checks']} invariant "
+          f"sweeps, {dt:.1f}s")
+    return 0
+
+
 def solve_smoke(nodes_n: int = 40, jobs_n: int = 4,
                 count: int = 256) -> int:
     """Global-batch solve smoke (scripts/check.sh --solve-smoke): a
@@ -1838,6 +2006,13 @@ def main(argv=None) -> int:
                              "force-armed on every server across a "
                              "leader crash; zero shadow divergences) "
                              "instead of the scenario smoke")
+    parser.add_argument("--state-smoke", action="store_true",
+                        help="run the incremental-state smoke (e2e "
+                             "pipeline riding the device-resident O(Δ) "
+                             "usage base across a leader crash AND a "
+                             "forced event-ring truncation; parity "
+                             "clean on every feed) instead of the "
+                             "scenario smoke")
     parser.add_argument("--watch-smoke", action="store_true",
                         help="run the read-path failover smoke (blocking "
                              "queries + event subscriptions parked on "
@@ -1875,6 +2050,8 @@ def main(argv=None) -> int:
         return load_smoke()
     if args.flow_smoke:
         return flow_smoke()
+    if args.state_smoke:
+        return state_smoke()
     if args.watch_smoke:
         return watch_smoke()
     if args.swarm_scale is not None:

@@ -373,6 +373,27 @@ class InvariantChecker:
             extra = f" (+{len(fresh) - 1} more)" if len(fresh) > 1 else ""
             self._fail(f"event completeness: {fresh[0].render()}{extra}")
 
+    # -- 11: incremental-state parity (nomadstate) ---------------------
+
+    def check_state_parity(self, cluster=None) -> None:
+        """Force a parity digest on every attached incremental-state
+        feed (tensor/incremental.py): the delta-fed device-resident
+        usage base must equal a fresh gen-bounded snapshot rebuild
+        bit-exactly, flushed device twins included. Unlike the shadow
+        prong the feeds attach in production, so this sweep runs
+        whenever any feed exists (NOMAD_TPU_INCR=0 turns each digest
+        into a no-op)."""
+        from ..tensor.incremental import GLOBAL as state
+
+        if not state.feeds:
+            return
+        before = len(state.violations)
+        state.verify_all()
+        fresh = state.violations[before:]
+        if fresh:
+            extra = f" (+{len(fresh) - 1} more)" if len(fresh) > 1 else ""
+            self._fail(f"state parity: {fresh[0].render()}{extra}")
+
     # -- 10: overload tier ordering (nomadload) ------------------------
 
     def check_overload_ordering(self, cluster, window: float = 0.5
@@ -428,6 +449,7 @@ class InvariantChecker:
         self.check_snapshot_integrity(cluster)
         self.check_launch_ledger(cluster)
         self.check_event_completeness(cluster)
+        self.check_state_parity(cluster)
         self.check_election_safety(cluster)
         self.check_log_matching(cluster)
         self.check_committed_durability(cluster)

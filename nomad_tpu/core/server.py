@@ -212,6 +212,12 @@ class Server:
         from ..analysis import shadow as _shadow
 
         _shadow.maybe_attach(self.store, self.events)
+        # nomadstate incremental feed (always on; NOMAD_TPU_INCR=0 is a
+        # call-time kill switch): maintains the device-resident cluster
+        # usage base off this same event stream — tensor/incremental.py
+        from ..tensor import incremental as _incremental
+
+        _incremental.maybe_attach(self.store, self.events)
         from .allocsync import AllocSyncHub, ClientUpdateBatcher
 
         # delta alloc push to clients + batched client status commits

@@ -260,6 +260,7 @@ class Worker:
         commit thread. Members still ack/nack individually; a failure
         redelivers that eval alone."""
         from .metrics import REGISTRY
+        from ..tensor import incremental
         from ..tensor.placer import preempt_stats
 
         REGISTRY.set_gauge("nomad.worker.eval_batch_size", len(batch))
@@ -268,6 +269,10 @@ class Worker:
         # scanner (the nomad.preempt.* counters are cumulative; the
         # delta across one batch is what the obs plane graphs)
         preempt_before = preempt_stats()
+        # per-batch tensor-build route split: warm builds served O(Δ)
+        # off the incremental device state vs cold full rebuilds
+        # (resyncs) — the nomadstate feed's counters are cumulative
+        state_before = incremental.GLOBAL.stats()
         snap = None
         try:
             target = max(ev.modify_index for ev, _ in batch)
@@ -284,6 +289,14 @@ class Worker:
                 delta = post[key] - preempt_before[key]
                 if delta:
                     REGISTRY.set_gauge(f"nomad.worker.batch_{key}", delta)
+            state_post = incremental.GLOBAL.stats()
+            fast = state_post["fast_hits"] - state_before["fast_hits"]
+            full = ((state_post["builds"] - state_before["builds"]) - fast)
+            if fast or full:
+                REGISTRY.set_gauge("nomad.worker.batch_state_fast_builds",
+                                   fast)
+                REGISTRY.set_gauge("nomad.worker.batch_state_full_builds",
+                                   full)
 
         pool = self._batch_pool
         if len(batch) == 1 or pool is None:

@@ -1,0 +1,197 @@
+"""Shared delta-replay core for commit-stream consumers.
+
+Two subsystems replay the store's event stream into reduced replicas:
+the shadow-state differential sanitizer (``analysis/shadow.py``) and
+the device-resident incremental cluster state
+(``tensor/incremental.py``). Both must fold the SAME event kinds with
+the SAME semantics — columnar ``AllocBlock`` expansion, promoted-row
+override, GC pops, truncation→resync — or the sanitizer stops being a
+proof about the state the scheduler actually runs on. This module is
+that single implementation: the topic/kind constants, the reduced
+entry encodings, the vectorized usage-column scatter, a kind-dispatch
+base class (:class:`DeltaReplay`), and :class:`EntryReplica`, the
+entry-map reduction the shadow composes verbatim.
+
+The split matters because the two consumers want different
+*representations*: the sanitizer keeps every alloc row materialized
+(it diffs id sets against MVCC rebuilds), while the incremental feed
+folds straight into per-node usage columns and must NOT expand 2M
+block positions into a dict on the scheduler's warm path. They share
+the dispatch and the block/promotion/GC rules; they override only the
+fold targets.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Set
+
+import numpy as np
+
+NODE_KINDS = ("node-upsert", "node-status", "node-eligibility",
+              "node-drain")
+ALLOC_ROW_KINDS = ("alloc-upsert", "alloc-stop", "alloc-preempt",
+                   "alloc-client-update", "alloc-transition")
+CLIENT_TERMINAL = ("complete", "failed", "lost")
+
+REPLAY_TOPICS = {"Allocation": ["*"], "Node": ["*"], "Evaluation": ["*"]}
+
+
+def client_terminal(status: str) -> bool:
+    return status in CLIENT_TERMINAL
+
+
+def alloc_entry(a) -> tuple:
+    vec = a.allocated_vec
+    return (a.modify_index, a.client_status, a.desired_status, a.node_id,
+            None if vec is None else vec.tobytes())
+
+
+def node_entry(n) -> tuple:
+    return (n.modify_index, n.status, n.scheduling_eligibility)
+
+
+def eval_entry(e) -> tuple:
+    return (e.modify_index, e.status)
+
+
+def usage_columns(allocs: Dict[str, tuple]) -> Dict[str, bytes]:
+    """Per-node usage columns from reduced alloc entries via ONE
+    vectorized scatter-add (the persist._block_usage_into idiom). Rows
+    are stacked in sorted (node, alloc-id) order, so two entry maps
+    with equal contents produce bit-identical float sums — the compare
+    can demand exact equality, no tolerance."""
+    live = [(e[3], aid, e[4]) for aid, e in allocs.items()
+            if not client_terminal(e[1]) and e[4] is not None]
+    if not live:
+        return {}
+    live.sort(key=lambda t: (t[0], t[1]))
+    node_ids = sorted({nid for nid, _, _ in live})
+    idx = {n: i for i, n in enumerate(node_ids)}
+    rows = np.fromiter((idx[nid] for nid, _, _ in live), np.int64,
+                       count=len(live))
+    vecs = np.stack([np.frombuffer(b, dtype=np.float64)
+                     for _, _, b in live])
+    mat = np.zeros((len(node_ids), vecs.shape[1]), vecs.dtype)
+    np.add.at(mat, rows, vecs)
+    return {n: mat[i].tobytes() for n, i in idx.items()}
+
+
+class DeltaReplay:
+    """Kind-dispatch skeleton over the commit stream's reduced event
+    vocabulary. Subclasses override the ``on_*`` hooks; :meth:`apply`
+    routes one broker event. Kinds outside the reduced vocabulary
+    (Job/Deployment topics, direct scheduler signals) are ignored —
+    both consumers replicate only what the tensors are built from."""
+
+    def apply(self, e) -> None:
+        kind = e.type
+        p = e.payload
+        if kind in ALLOC_ROW_KINDS:
+            self.on_alloc_row(p)
+        elif kind == "alloc-block-upsert":
+            self.on_alloc_block(p)
+        elif kind == "alloc-gc":
+            self.on_alloc_gc(p)
+        elif kind in NODE_KINDS:
+            self.on_node(p)
+        elif kind == "node-delete":
+            self.on_node_delete(p)
+        elif kind == "eval-upsert":
+            self.on_eval(p)
+        elif kind == "eval-delete":
+            self.on_eval_delete(p)
+
+    def on_alloc_row(self, alloc) -> None:
+        pass
+
+    def on_alloc_block(self, block) -> None:
+        pass
+
+    def on_alloc_gc(self, ids) -> None:
+        pass
+
+    def on_node(self, node) -> None:
+        pass
+
+    def on_node_delete(self, node) -> None:
+        pass
+
+    def on_eval(self, ev) -> None:
+        pass
+
+    def on_eval_delete(self, ids) -> None:
+        pass
+
+
+class EntryReplica(DeltaReplay):
+    """Entry-map reduction of one store: alloc/node/eval rows keyed by
+    id, blocks expanded through the same ``iter_allocs`` materialization
+    the MVCC snapshot uses, promoted block positions overridden by their
+    row events exactly as the store overrides them. This is the shadow
+    sanitizer's replica, factored out so its semantics are importable."""
+
+    def __init__(self) -> None:
+        self.allocs: Dict[str, tuple] = {}
+        self.nodes: Dict[str, tuple] = {}
+        self.evals: Dict[str, tuple] = {}
+        self.promoted: Set[str] = set()
+
+    # -- dispatch targets ---------------------------------------------
+
+    def on_alloc_row(self, p) -> None:
+        self.allocs[p.id] = alloc_entry(p)
+        if "." in p.id:
+            # a materialized block position got its own row: the row
+            # now overrides the block wherever both are visible
+            self.promoted.add(p.id)
+
+    def on_alloc_block(self, block) -> None:
+        from ..structs.alloc import BLOCK_SEP
+        prefix = f"{block.id}{BLOCK_SEP}"
+        live: Set[str] = set()
+        for a in block.iter_allocs():
+            live.add(a.id)
+            if a.id not in self.promoted:
+                self.allocs[a.id] = alloc_entry(a)
+        # a re-upserted block can only shrink its visible set (rejected
+        # rows / dropped positions); forget what fell out
+        for aid in [k for k in self.allocs
+                    if k.startswith(prefix) and k not in live
+                    and k not in self.promoted]:
+            del self.allocs[aid]
+
+    def on_alloc_gc(self, ids) -> None:
+        for aid in ids:
+            self.allocs.pop(aid, None)
+            self.promoted.discard(aid)
+
+    def on_node(self, p) -> None:
+        self.nodes[p.id] = node_entry(p)
+
+    def on_node_delete(self, p) -> None:
+        self.nodes.pop(p.id, None)
+
+    def on_eval(self, p) -> None:
+        self.evals[p.id] = eval_entry(p)
+
+    def on_eval_delete(self, ids) -> None:
+        for eid in ids:
+            self.evals.pop(eid, None)
+
+    # -- resync --------------------------------------------------------
+
+    def resync_from(self, store) -> int:
+        """Rebuild the entry maps from a fresh MVCC snapshot; returns
+        the snapshot index the maps are now consistent at."""
+        snap = store.snapshot()
+        try:
+            self.allocs = {a.id: alloc_entry(a) for a in snap.allocs()}
+            self.nodes = {n.id: node_entry(n) for n in snap.nodes()}
+            self.evals = {e.id: eval_entry(e) for e in snap.evals()}
+            self.promoted = {aid for aid in self.allocs
+                             if "." in aid
+                             and store._allocs.get(
+                                 aid, snap.index) is not None}
+            return snap.index
+        finally:
+            snap.close()

@@ -1880,7 +1880,9 @@ class StateStore:
                     ids = [i for i in cons_iter(cell)
                            if not (i in dead_set if type(i) is not BlockRef
                                    else i.block_id in dead_blocks)]
-                    if len(ids) != cell.length:
+                    # an earlier GC that emptied this key left a None
+                    # cell (cons_from_iter of nothing); nothing to drop
+                    if cell is not None and len(ids) != cell.length:
                         table.put(key, cons_from_iter(reversed(ids)), gen, live)
             self._commit(gen, gc_events + [("alloc-gc", dead)])
             return len(dead)

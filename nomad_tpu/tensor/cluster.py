@@ -33,6 +33,8 @@ from ..scheduler.feasible import (
     tg_mask_signature,
 )
 from ..scheduler.spread import IMPLICIT_TARGET, SpreadInfo, combined_spreads
+from .incremental import feed_for
+from .overlay import INFLIGHT
 
 
 def _pad_pow2(n: int, floor: int = 8) -> int:
@@ -40,6 +42,55 @@ def _pad_pow2(n: int, floor: int = 8) -> int:
     while out < n:
         out *= 2
     return out
+
+
+class NodeSlotRegistry:
+    """Stable node→slot assignment with a free-list, one per store: a
+    node keeps its slot for as long as it exists, a deleted node's slot
+    is recycled to the next joiner (lowest free slot first, so the slot
+    space stays dense under churn). The incremental feed keys its
+    epochs on row LAYOUT — today's statics still order rows by the
+    dense ready-list, so membership changes resync — but the registry
+    pins the identity the resync path and the join/leave tests reason
+    about, and is the anchor for the layout-stable statics stretch
+    (ROADMAP): a static ordering rows by slot would keep epochs alive
+    across joins/leaves entirely."""
+
+    def __init__(self):
+        self._slots: Dict[str, int] = {}
+        self._free: List[int] = []
+        self._next = 0
+        self._lock = threading.Lock()
+
+    def assign(self, node_ids: Sequence[str], store=None) -> Dict[str, int]:
+        """Slot per node id, allocating for new ids. When `store` is
+        given, slots of nodes deleted from it are released first (the
+        one authoritative leave signal; drained-but-present nodes keep
+        their slot)."""
+        import heapq
+
+        with self._lock:
+            if store is not None:
+                for nid in [n for n in self._slots
+                            if store._nodes.get_latest(n) is None]:
+                    heapq.heappush(self._free, self._slots.pop(nid))
+            out: Dict[str, int] = {}
+            for nid in node_ids:
+                s = self._slots.get(nid)
+                if s is None:
+                    if self._free:
+                        s = heapq.heappop(self._free)
+                    else:
+                        s = self._next
+                        self._next += 1
+                    self._slots[nid] = s
+                out[nid] = s
+            return out
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"assigned": len(self._slots), "free": len(self._free),
+                    "high_water": self._next}
 
 
 class ClusterStatic:
@@ -56,7 +107,7 @@ class ClusterStatic:
 
     __slots__ = ("nodes", "n_pad", "available", "node_index", "usage_rows",
                  "version", "mask_cache", "aff_cache", "intern_cache",
-                 "dev_cache", "device_arrays")
+                 "dev_cache", "device_arrays", "slots")
 
     def __init__(self, nodes: Sequence[Node], store=None, version=None):
         n = len(nodes)
@@ -70,6 +121,9 @@ class ClusterStatic:
             self.node_index[node.id] = i
         self.usage_rows = (store.usage_rows_for([n.id for n in nodes])
                            if store is not None and n else None)
+        # stable per-store node→slot identity (see NodeSlotRegistry);
+        # None for uncached per-eval statics with no store behind them
+        self.slots = None
         self.mask_cache: Dict[tuple, np.ndarray] = {}
         self.aff_cache: Dict[tuple, np.ndarray] = {}
         self.intern_cache: Dict[tuple, tuple] = {}
@@ -113,6 +167,11 @@ def _static_for(ctx: EvalContext, nodes: Sequence[Node]):
                 for k in [k for k in list(statics) if k[0] != version]:
                     statics.pop(k, None)
                 static = ClusterStatic(nodes, store=store, version=version)
+                registry = getattr(store, "_node_slots", None)
+                if registry is None:
+                    registry = store._node_slots = NodeSlotRegistry()
+                static.slots = registry.assign(
+                    [n.id for n in static.nodes], store=store)
                 statics[key] = static
     return static
 
@@ -128,19 +187,33 @@ class ClusterTensors:
     node_index: Dict[str, int]
     static: "ClusterStatic" = None
     _store: object = None
+    # `used` is the incremental feed's shared read-only base (zero-copy
+    # warm path); any write path must go through _ensure_private first
+    _used_shared: bool = False
 
     @classmethod
     def build(cls, ctx: EvalContext, nodes: Sequence[Node]) -> "ClusterTensors":
         static = _static_for(ctx, nodes)
         if static is None:
             static = ClusterStatic(nodes)  # per-eval, uncached
-        used = np.zeros((static.n_pad, RESOURCE_DIMS))
         t = cls(nodes=static.nodes, n_pad=static.n_pad,
-                available=static.available, used=used,
+                available=static.available, used=None,
                 node_index=static.node_index, static=static,
                 _store=getattr(ctx.snapshot, "_store", None))
         t.refresh_usage(ctx)
         return t
+
+    def _ensure_private(self) -> np.ndarray:
+        """A privately-owned writable `used` of the right shape —
+        allocates on first use, copies the shared feed base out of the
+        way, reuses an existing private buffer otherwise."""
+        u = self.used
+        if u is None or u.shape[0] != self.n_pad:
+            u = self.used = np.zeros((self.n_pad, RESOURCE_DIMS))
+        elif self._used_shared or not u.flags.writeable:
+            u = self.used = u.astype(np.float64, copy=True)
+        self._used_shared = False
+        return u
 
     def refresh_usage(self, ctx: EvalContext) -> None:
         """Proposed usage (state - evictions + placements). Base usage is
@@ -152,22 +225,46 @@ class ClusterTensors:
         (reference context.go:176 ProposedAllocs). Called between task
         groups so group B sees group A's in-plan placements."""
         snap = ctx.snapshot
-        used = self.used
         n = len(self.nodes)
-        rows = self.static.usage_rows if self.static is not None else None
-        if rows is not None and self._store is not None:
-            used[:n] = self._store._usage_mat[rows]
-            used[n:] = 0.0
-        else:
-            used[:] = 0.0
-            for i, node in enumerate(self.nodes):
-                u = snap.node_usage(node.id)
-                if u is not None:
-                    used[i] = u
         plan = ctx.plan
-        if plan is not None:
+        touched = ()
+        if plan is not None and (plan.node_update or plan.node_preemptions
+                                 or plan.node_allocation):
             touched = (set(plan.node_update) | set(plan.node_preemptions)
                        | set(plan.node_allocation))
+        # incremental fast path (tensor/incremental.py): the feed's
+        # delta-fed base already IS latest-committed usage in this
+        # static's row order. With no plan-touched rows and no racing
+        # in-flight placements the base is handed out as a shared
+        # read-only view — the O(N) gather disappears entirely from the
+        # warm path; otherwise it seeds a copy-on-write private buffer.
+        base = None
+        if self._store is not None and self.static is not None:
+            feed = feed_for(self._store)
+            if feed is not None:
+                base = feed.base_for(self.static)
+        if base is not None:
+            if not touched and not INFLIGHT.has_entries(
+                    exclude_plan=ctx.plan):
+                self.used = base
+                self._used_shared = True
+                return
+            used = self.used = base.copy()
+            self._used_shared = False
+        else:
+            used = self._ensure_private()
+            rows = (self.static.usage_rows if self.static is not None
+                    else None)
+            if rows is not None and self._store is not None:
+                used[:n] = self._store._usage_mat[rows]
+                used[n:] = 0.0
+            else:
+                used[:] = 0.0
+                for i, node in enumerate(self.nodes):
+                    u = snap.node_usage(node.id)
+                    if u is not None:
+                        used[i] = u
+        if plan is not None:
             for node_id in touched:
                 i = self.node_index.get(node_id)
                 if i is None:
@@ -180,8 +277,6 @@ class ClusterTensors:
         # placements: fold LAST so this solve plans around them instead
         # of colliding on the same best-fit nodes (tensor/overlay.py;
         # the per-eval twin of the bulk solver service's carry)
-        from .overlay import INFLIGHT
-
         INFLIGHT.fold(used[:n], self.node_index,
                       exclude_plan=ctx.plan)
 

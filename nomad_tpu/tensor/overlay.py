@@ -65,6 +65,19 @@ class InflightOverlay:
             if self._entries.pop(token, None) is not None:
                 self.stats["confirmed"] += 1
 
+    def has_entries(self, exclude_plan=None) -> bool:
+        """True when fold() would add anything: at least one live
+        (non-TTL-expired) entry not owned by `exclude_plan`. Lets the
+        incremental-state fast path hand out a shared read-only base
+        instead of copying it just to fold nothing in."""
+        now = time.time()
+        exclude = id(exclude_plan) if exclude_plan is not None else None
+        with self._lock:
+            return any(
+                now - e["born"] <= ENTRY_TTL
+                and (e.get("plan") != exclude or exclude is None)
+                for e in self._entries.values())
+
     def fold(self, used, node_index: Dict[str, int],
              exclude_plan=None) -> None:
         """Add every open entry's deltas into a canonical-order usage

@@ -159,36 +159,13 @@ _BULK_WARM: set = set()
 
 
 def _warm_launch(fn, shape_key, warm: set):
-    """Shape-keyed launch window around one kernel launch: a warm shape
-    runs under a hard jit_guard.no_retrace window (zero new compiles,
-    implicit transfers raise), a cold shape may compile once and then
-    marks itself warm. Either way the launch lands in the nomadjit
-    ledger (no-op unless NOMAD_TPU_SAN=1) with its warm/cold standing.
+    """Shape-keyed launch window around one kernel launch; the
+    implementation now lives in :func:`solver.warm_launch` (shared with
+    the solver service and the incremental-state scatter), kept here as
+    an alias so the placer's launch sites and tests keep their name."""
+    from .solver import warm_launch
 
-    Callers jax.device_put EVERY argument first — committed jax.Arrays
-    and bare numpy hit different jit cache entries, so a mixed diet
-    would read as a retrace — and read back through a single
-    jax.device_get, the launch's only host sync."""
-    import contextlib
-
-    from ..analysis import launch_ledger
-    from .jit_guard import count_compiles, no_retrace
-
-    is_warm = shape_key in warm
-
-    @contextlib.contextmanager
-    def _window():
-        name = getattr(fn, "__name__", str(fn))
-        with launch_ledger.window(name, key=shape_key, warm=is_warm):
-            if is_warm:
-                with no_retrace(fn):
-                    yield
-            else:
-                with count_compiles(fn):
-                    yield
-                warm.add(shape_key)
-
-    return _window()
+    return warm_launch(fn, shape_key, warm)
 
 
 def preempt_stats() -> Dict[str, int]:
@@ -208,18 +185,28 @@ def _count_preempt(**deltas: int) -> None:
             REGISTRY.incr(f"nomad.preempt.{key}", n)
 
 
-# Incremental-state seed telemetry (ROADMAP "device-resident
-# incremental state"): per tensor build, how many Allocation deltas hit
-# the event stream since the previous build anywhere in the process —
-# the exact row count an O(Δ) scatter update to ClusterTensors would
-# touch instead of this full O(nodes) rebuild.
+# Per tensor build, how many Allocation deltas hit the event stream
+# since the previous build anywhere in the process — the exact row
+# count the O(Δ) scatter update (tensor/incremental.py) touches instead
+# of a full O(nodes) rebuild. With an incremental feed attached to the
+# build's store the count is feed-native (exact: Allocation events the
+# feed actually drained, resyncs included); otherwise it falls back to
+# the process-wide counter diff that seeded the ROADMAP item.
 _DELTA_MARK_LOCK = __import__("threading").Lock()
 _DELTA_MARK = [0.0]
 
 
-def _changed_allocs_since_last_build() -> int:
+def _changed_allocs_since_last_build(store=None) -> int:
     from ..core.metrics import REGISTRY
 
+    if store is not None:
+        from .incremental import feed_for, incr_enabled
+
+        feed = feed_for(store) if incr_enabled() else None
+        if feed is not None:
+            delta = float(feed.take_build_delta_count())
+            REGISTRY.observe("nomad.worker.changed_allocs_per_build", delta)
+            return int(delta)
     now = REGISTRY.get("nomad.events.alloc_deltas")
     with _DELTA_MARK_LOCK:
         prev, _DELTA_MARK[0] = _DELTA_MARK[0], now
@@ -282,7 +269,8 @@ class TPUPlacer:
         # the kernel so the host-side node order stays canonical and the
         # per-node arrays stay cacheable across evals (ClusterStatic).
         with TRACER.span("worker.tensor_build", n=len(nodes),
-                         changed_allocs=_changed_allocs_since_last_build()):
+                         changed_allocs=_changed_allocs_since_last_build(
+                             getattr(ctx.snapshot, "_store", None))):
             cluster = ClusterTensors.build(ctx, nodes)
         nodes = cluster.nodes
         # crc32, not hash(): the seed must be deterministic ACROSS
@@ -589,6 +577,7 @@ class TPUPlacer:
             # Cost: the carry solve drops the per-node anti-affinity
             # term for the retried remainder (a score preference, not a
             # capacity constraint; fresh solves have placed_* == 0).
+            from .incremental import device_used_fn
             from .solver import get_service
 
             service = get_service()
@@ -597,6 +586,7 @@ class TPUPlacer:
                 aff=tgt.affinity_boost, ask=tgt.ask, k=k,
                 tg_count=tgt.tg_count, seed=seed,
                 used_fn=cluster.latest_usage,
+                used_dev_fn=device_used_fn(cluster._store, static),
                 joint=(self.algorithm == enums.SCHED_ALG_TPU_SOLVE))
             if ctx.plan is not None:
                 ctx.plan.post_apply_hooks.append(

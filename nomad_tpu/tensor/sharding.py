@@ -138,6 +138,47 @@ def shard_bulk_state(mesh: Mesh, used0: np.ndarray, available: np.ndarray,
             jax.device_put(np.asarray(available, np.float32), sh))
 
 
+_STATE_SCATTER_CACHE: dict = {}
+
+
+def make_state_scatter_sharded(mesh: Mesh, axis: str = "nodes",
+                               donate: bool = True):
+    """Row-sharded twin of the incremental state's delta scatter
+    (tensor/incremental._scatter_fn): (used (N,D) sharded P(axis,None),
+    idx (B,) replicated, delta (B,D) replicated) -> used with
+    used[idx] += delta. Each shard masks off-shard rows to a zero delta
+    and clips the index local — the same correction-fold idiom as
+    _bulk_shard_body, so the result is bit-exact vs the single-device
+    scatter (adds of integral f32 values commute exactly; a zero add is
+    an exact no-op, usage rows are never -0.0). Jitted per (mesh,
+    donate); donate=False is the solver's resync fold, which must keep
+    the feed's twin alive behind the copy."""
+    key = (mesh, axis, donate)
+    fn = _STATE_SCATTER_CACHE.get(key)
+    if fn is not None:
+        return fn
+    import jax.numpy as jnp
+
+    smap = _shard_map_nocheck()
+
+    def state_scatter_sharded(used, idx, delta):
+        n_loc = used.shape[0]
+        me = jax.lax.axis_index(axis)
+        lo = me * n_loc
+        local = idx - lo
+        own = (local >= 0) & (local < n_loc)
+        safe = jnp.clip(local, 0, n_loc - 1)
+        return used.at[safe].add(jnp.where(own[:, None], delta, 0.0))
+
+    body = smap(state_scatter_sharded, mesh=mesh,
+                in_specs=(P(axis, None), P(), P()),
+                out_specs=P(axis, None))
+    fn = (jax.jit(body, donate_argnums=(0,)) if donate
+          else jax.jit(body))
+    _STATE_SCATTER_CACHE[key] = fn
+    return fn
+
+
 def _shard_map_nocheck():
     """shard_map with replication checking disabled under whichever
     keyword this jax spells it (check_rep was renamed check_vma)."""

@@ -58,6 +58,40 @@ from ..obs import RECORDER, TRACER
 _STOP = object()
 
 
+def warm_launch(fn, shape_key, warm: set):
+    """Shape-keyed launch window around one kernel launch: a warm shape
+    runs under a hard jit_guard.no_retrace window (zero new compiles,
+    implicit transfers raise), a cold shape may compile once and then
+    marks itself warm. Either way the launch lands in the nomadjit
+    ledger (no-op unless NOMAD_TPU_SAN=1) with its warm/cold standing.
+
+    Callers jax.device_put EVERY argument first — committed jax.Arrays
+    and bare numpy hit different jit cache entries, so a mixed diet
+    would read as a retrace — and read back through a single
+    jax.device_get, the launch's only host sync. Shared by the placer's
+    per-eval launch sites and the incremental state's delta scatters."""
+    import contextlib
+
+    from ..analysis import launch_ledger
+    from .jit_guard import count_compiles, no_retrace
+
+    is_warm = shape_key in warm
+
+    @contextlib.contextmanager
+    def _window():
+        name = getattr(fn, "__name__", str(fn))
+        with launch_ledger.window(name, key=shape_key, warm=is_warm):
+            if is_warm:
+                with no_retrace(fn):
+                    yield
+            else:
+                with count_compiles(fn):
+                    yield
+                warm.add(shape_key)
+
+    return _window()
+
+
 class BatchContext:
     """Rendezvous for one `Worker.process_batch` under "tpu-solve": the
     worker opens a context sized to the dequeued batch, each member eval
@@ -172,11 +206,11 @@ def ensure_resident(static, feas_base, aff, mesh=None):
 
 class _Request:
     __slots__ = ("static", "feas_base", "aff", "ask", "k", "tg_count",
-                 "seed", "used_fn", "future", "token", "joint",
-                 "batch_ctx")
+                 "seed", "used_fn", "used_dev_fn", "future", "token",
+                 "joint", "batch_ctx")
 
     def __init__(self, static, feas_base, aff, ask, k, tg_count, seed,
-                 used_fn, joint=False, batch_ctx=None):
+                 used_fn, joint=False, batch_ctx=None, used_dev_fn=None):
         self.static = static
         self.feas_base = feas_base
         self.aff = aff
@@ -189,6 +223,11 @@ class _Request:
         # loses usage whose ledger entries already closed (measured
         # in-round: the 2M run's 1% rejection cascade)
         self.used_fn = used_fn
+        # optional device-resident base: (mesh) -> committed-usage twin
+        # on device (tensor/incremental.py), letting the resync fold
+        # ledger entries with one scatter instead of shipping an O(N)
+        # host rebuild. None or a failed call falls back to used_fn.
+        self.used_dev_fn = used_dev_fn
         self.future = Future()
         self.token = 0
         self.joint = joint          # solve via the batch auction tier
@@ -326,7 +365,7 @@ class BulkSolverService:
     # -- caller side (scheduler worker threads) --
 
     def solve(self, *, static, feas_base, aff, ask, k, tg_count, seed,
-              used_fn, joint=False):
+              used_fn, joint=False, used_dev_fn=None):
         """Blocking solve of one fresh-placement bulk eval ->
         ((N_pad,) int64 per-node counts in canonical order, token).
         The caller must arrange for confirm(token, rejected_node_ids)
@@ -340,7 +379,8 @@ class BulkSolverService:
                        np.asarray(ask, dtype=np.float32), int(k),
                        float(tg_count), np.uint32(seed), used_fn,
                        joint=joint,
-                       batch_ctx=current_batch() if joint else None)
+                       batch_ctx=current_batch() if joint else None,
+                       used_dev_fn=used_dev_fn)
         # put BEFORE ensure: the service thread clears self._thread
         # before its final stop-drain, so a request racing stop() is
         # either caught by that drain (failed, answered) or observes
@@ -554,6 +594,90 @@ class BulkSolverService:
                     self.stats["compiles"] += counters["compiles"]
         return window()
 
+    def _resync_base(self, r, static, mesh, d, ledger_entries):
+        """Fresh usage carry for a resync: committed usage + open ledger
+        entries. Preferred source is the incremental feed's
+        device-resident twin (tensor/incremental.py) — the ledger folds
+        on-device in one scatter and the O(N) host gather + device_put
+        never happens; any miss or failure falls back to the exact host
+        path (used_fn + host fold + ship)."""
+        import jax
+
+        if r.used_dev_fn is not None:
+            try:
+                dev_base = r.used_dev_fn(mesh)
+            except Exception:
+                dev_base = None
+            if dev_base is not None:
+                try:
+                    return self._fold_base_scatter(dev_base, static, mesh,
+                                                   d, ledger_entries)
+                except Exception:
+                    pass        # repairable: host path below is exact
+        base = np.asarray(r.used_fn(), dtype=np.float32).copy()
+        for idx, counts, ask in ledger_entries:
+            base[idx] += counts[:, None].astype(np.float32) * ask[None, :]
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return jax.device_put(base, NamedSharding(mesh, P("nodes", None)))
+        else:
+            return jax.device_put(base)
+
+    def _fold_base_scatter(self, dev_base, static, mesh, d,
+                           ledger_entries):
+        """Fold open-ledger + per-eval in-flight (overlay) usage into
+        the feed's device base with ONE non-donating scatter launch.
+        Non-donating on purpose: the solve kernels donate their usage
+        carry (argument 0), and the feed's twin must survive this solve
+        — the fold's fresh output array is what enters the donation
+        chain. Zero deltas still scatter: the copy IS the protection."""
+        import jax
+
+        from .incremental import _scatter_fn
+        from .overlay import INFLIGHT
+
+        n_pad = static.n_pad
+        rows_list, delta_list = [], []
+        for idx, counts, ask in ledger_entries:
+            rows_list.append(np.asarray(idx, dtype=np.int32))
+            delta_list.append(counts[:, None].astype(np.float32)
+                              * np.asarray(ask, np.float32)[None, :])
+        tmp = np.zeros((n_pad, d), dtype=np.float32)
+        INFLIGHT.fold(tmp[: len(static.nodes)], static.node_index)
+        nz = np.nonzero(np.any(tmp != 0.0, axis=1))[0]
+        if nz.size:
+            rows_list.append(nz.astype(np.int32))
+            delta_list.append(tmp[nz])
+        total = sum(len(x) for x in rows_list)
+        bucket = 8
+        while bucket < total:
+            bucket *= 2
+        idx = np.zeros(bucket, dtype=np.int32)
+        delta = np.zeros((bucket, d), dtype=np.float32)
+        pos = 0
+        for rr, dd in zip(rows_list, delta_list):
+            idx[pos: pos + len(rr)] = rr
+            delta[pos: pos + len(rr)] = dd
+            pos += len(rr)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from .sharding import make_state_scatter_sharded
+
+            n_dev = len(mesh.devices.reshape(-1))
+            fn = make_state_scatter_sharded(mesh, donate=False)
+            rep = NamedSharding(mesh, P())
+            idx = jax.device_put(idx, rep)
+            delta = jax.device_put(delta, rep)
+            key = ("statefold-sh", n_pad, d, bucket, n_dev)
+        else:
+            fn = _scatter_fn(donate=False)
+            idx, delta = jax.device_put((idx, delta))
+            key = ("statefold", n_pad, d, bucket)
+        with self._launch_guard(fn, key):
+            return fn(dev_base, idx, delta)
+
     def _device_arrays(self, static, rs, mesh=None):
         """Resident capacity + stacked per-eval mask/affinity arrays
         (node-axis sharded over `mesh` when given); the stacked (G, N)
@@ -643,11 +767,9 @@ class BulkSolverService:
                 # (queued corrections target phantoms in the old carry —
                 # the rebuild has none, so drop them)
                 self._corrections.clear()
-                base = np.asarray(rs[0].used_fn(), dtype=np.float32).copy()
-                for e in self._ledger.values():
-                    if e.static is static:
-                        base[e.idx] += (e.counts[:, None].astype(np.float32)
-                                        * e.ask[None, :])
+                ledger_entries = [(e.idx, e.counts, e.ask)
+                                  for e in self._ledger.values()
+                                  if e.static is static]
                 corrections = []
             else:
                 # take at most one launch's worth: confirm() may have
@@ -660,13 +782,8 @@ class BulkSolverService:
                 corrections = self._corrections[:self.CORRECTIONS]
                 self._corrections = self._corrections[self.CORRECTIONS:]
         if need_resync:
-            if mesh is not None:
-                from jax.sharding import NamedSharding, PartitionSpec as P
-
-                used_dev = jax.device_put(
-                    base, NamedSharding(mesh, P("nodes", None)))
-            else:
-                used_dev = jax.device_put(base)
+            used_dev = self._resync_base(rs[0], static, mesh, d,
+                                         ledger_entries)
             since = 0
             with self._lock:
                 self.stats["resyncs"] += 1

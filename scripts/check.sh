@@ -45,6 +45,12 @@
 #                                   leader crash mid-burst; no tier-0
 #                                   shed, zero acked-job loss, tier
 #                                   ordering on every replica)
+#   scripts/check.sh --state-smoke  also run the nomadstate incremental
+#                                   smoke (e2e pipeline riding the
+#                                   device-resident O(Δ) usage base
+#                                   across a leader crash AND a forced
+#                                   event-ring truncation; parity clean
+#                                   on every feed)
 set -u
 cd "$(dirname "$0")/.."
 
@@ -57,6 +63,7 @@ run_watch_smoke=0
 run_mesh_smoke=0
 run_flow_smoke=0
 run_load_smoke=0
+run_state_smoke=0
 for arg in "$@"; do
     case "$arg" in
         --e2e-smoke) run_e2e_smoke=1 ;;
@@ -68,6 +75,7 @@ for arg in "$@"; do
         --mesh-smoke) run_mesh_smoke=1 ;;
         --flow-smoke) run_flow_smoke=1 ;;
         --load-smoke) run_load_smoke=1 ;;
+        --state-smoke) run_state_smoke=1 ;;
         *) echo "unknown flag: $arg" >&2; exit 64 ;;
     esac
 done
@@ -115,6 +123,7 @@ echo "== nomadsan smoke (NOMAD_TPU_SAN=1) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" NOMAD_TPU_SAN=1 python -m pytest \
     tests/test_sanitizer.py tests/test_ownership.py \
     tests/test_tensor_rules.py tests/test_flow_rules.py \
+    tests/test_incremental_state.py \
     tests/test_state_store.py \
     tests/test_plan_apply_scale.py tests/test_e2e_pipeline.py \
     tests/test_batch_solver.py tests/test_preempt_solve.py \
@@ -257,6 +266,19 @@ if [ "$run_load_smoke" = 1 ]; then
     echo "== load smoke (python -m nomad_tpu.chaos --load-smoke) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 300 \
         python -m nomad_tpu.chaos --load-smoke || failed=1
+fi
+
+# nomadstate incremental smoke (opt-in, ~10s): the e2e failover
+# pipeline under tpu-binpack with the nomadstate parity digests armed —
+# every tensor build must ride the delta-fed device-resident usage base
+# (tensor/incremental.py), stay bit-exact against gen-bounded snapshot
+# rebuilds on every feed, and take the full-resync path (never patch)
+# across a forced event-ring truncation (PERF.md "Incremental device
+# state")
+if [ "$run_state_smoke" = 1 ]; then
+    echo "== state smoke (python -m nomad_tpu.chaos --state-smoke) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" timeout 300 \
+        python -m nomad_tpu.chaos --state-smoke || failed=1
 fi
 
 echo "== tier-1 tests =="

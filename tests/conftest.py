@@ -36,6 +36,7 @@ if _SAN:
     from nomad_tpu.analysis import ownership as _ownership
     from nomad_tpu.analysis import sanitizer as _sanitizer
     from nomad_tpu.analysis import shadow as _shadow
+    from nomad_tpu.tensor import incremental as _incremental
 
     _sanitizer.install()
     _ownership.install()
@@ -50,6 +51,11 @@ if _SAN:
     # that forgot its delta becomes a session failure, not a silently
     # stale read model
     _shadow.install()
+    # nomadstate (the incremental-state prong) rides the same switch:
+    # the delta-fed device-resident usage base (tensor/incremental.py)
+    # is periodically fingerprint-compared against gen-bounded snapshot
+    # rebuilds — a divergence is a session failure
+    _incremental.install()
 
 import pytest  # noqa: E402
 
@@ -60,6 +66,7 @@ def pytest_terminal_summary(terminalreporter):
         terminalreporter.write_line(_ownership.GLOBAL.report())
         terminalreporter.write_line(_launch_ledger.GLOBAL.report())
         terminalreporter.write_line(_shadow.GLOBAL.report())
+        terminalreporter.write_line(_incremental.GLOBAL.report())
 
 
 def pytest_sessionfinish(session, exitstatus):
@@ -67,7 +74,8 @@ def pytest_sessionfinish(session, exitstatus):
     if _SAN and (_sanitizer.GLOBAL.violations
                  or _ownership.GLOBAL.violations
                  or _launch_ledger.GLOBAL.violations
-                 or _shadow.GLOBAL.violations):
+                 or _shadow.GLOBAL.violations
+                 or _incremental.GLOBAL.violations):
         session.exitstatus = 3
 
 
